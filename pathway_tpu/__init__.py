@@ -1,0 +1,265 @@
+"""pathway_tpu — a TPU-native live-data framework.
+
+A brand-new implementation of the capabilities of Pathway
+(github.com/pathwaycom/pathway): incremental streaming tables, a sharded
+SPMD execution engine, streaming connectors, and an LLM/RAG toolkit — built
+on JAX/XLA for TPU hardware.  See SURVEY.md at the repo root for the
+structural analysis of the reference this build follows, and BASELINE.md for
+the performance targets.
+
+The public namespace mirrors ``import pathway as pw``.
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from pathway_tpu.engine.types import (
+    ERROR,
+    Json,
+    Pointer,
+    PyObjectWrapper,
+    wrap_py_object,
+)
+from pathway_tpu.internals import dtype as _dt
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals.config import (
+    local_pathway_config,
+    set_license_key,
+    set_monitoring_config,
+)
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    apply,
+    apply_async,
+    apply_with_type,
+    assert_table_has_schema,
+    cast,
+    coalesce,
+    declare_type,
+    fill_error,
+    if_else,
+    make_tuple,
+    require,
+    unwrap,
+)
+from pathway_tpu.internals.reducers import BaseCustomAccumulator
+from pathway_tpu.internals.schema import (
+    ColumnDefinition,
+    Schema,
+    SchemaProperties,
+    column_definition,
+    schema_builder,
+    schema_from_csv,
+    schema_from_dict,
+    schema_from_types,
+)
+from pathway_tpu.internals.table import (
+    GroupedTable,
+    Joinable,
+    JoinMode,
+    JoinResult,
+    Table,
+    TableLike,
+    TableSlice,
+    groupby,
+    join,
+    join_inner,
+    join_left,
+    join_outer,
+    join_right,
+)
+from pathway_tpu.internals.thisclass import left, right, this
+from pathway_tpu.internals.runner import run, run_all
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals import udfs as _udfs_internal
+from pathway_tpu.internals.udfs import UDF, udf
+
+import datetime as _datetime
+
+# datetime convenience types (pw.DateTimeNaive etc.)
+DateTimeNaive = _datetime.datetime
+DateTimeUtc = _datetime.datetime
+Duration = _datetime.timedelta
+
+
+class Type:
+    """Engine type enum facade (pw.Type.INT etc., api.py PathwayType)."""
+
+    ANY = _dt.ANY
+    STRING = _dt.STR
+    INT = _dt.INT
+    BOOL = _dt.BOOL
+    FLOAT = _dt.FLOAT
+    POINTER = _dt.POINTER
+    DATE_TIME_NAIVE = _dt.DATE_TIME_NAIVE
+    DATE_TIME_UTC = _dt.DATE_TIME_UTC
+    DURATION = _dt.DURATION
+    ARRAY = _dt.ANY_ARRAY
+    JSON = _dt.JSON
+    BYTES = _dt.BYTES
+    PY_OBJECT_WRAPPER = _dt.PY_OBJECT_WRAPPER
+
+
+import enum as _enum
+
+
+class MonitoringLevel(_enum.Enum):
+    AUTO = 0
+    AUTO_ALL = 1
+    NONE = 2
+    IN_OUT = 3
+    ALL = 4
+
+
+class PersistenceMode(_enum.Enum):
+    # mirrors engine PersistenceMode (src/connectors/mod.rs:108-116)
+    BATCH = 0
+    SPEEDRUN_REPLAY = 1
+    REALTIME_REPLAY = 2
+    PERSISTING = 3
+    SELECTIVE_PERSISTING = 4
+    OPERATOR_PERSISTING = 5
+    UDF_CACHING = 6
+
+
+# subpackages (imported lazily-ish at the bottom to avoid cycles)
+from pathway_tpu import debug  # noqa: E402
+from pathway_tpu import io  # noqa: E402
+from pathway_tpu import demo  # noqa: E402
+from pathway_tpu import persistence  # noqa: E402
+from pathway_tpu import udfs  # noqa: E402
+from pathway_tpu.stdlib import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils  # noqa: E402
+from pathway_tpu.stdlib.temporal import windowby  # noqa: E402
+from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
+from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer  # noqa: E402
+from pathway_tpu.internals.iterate import iterate, iterate_universe  # noqa: E402
+from pathway_tpu.internals.sql import sql  # noqa: E402
+from pathway_tpu.internals import universes  # noqa: E402
+from pathway_tpu.internals.errors import global_error_log, local_error_log  # noqa: E402
+from pathway_tpu.internals.table_io import table_transformer  # noqa: E402
+
+# attach stdlib-defined Table methods (windowby etc. — same trick the
+# reference uses to keep table.py free of temporal imports)
+Table.windowby = lambda self, *args, **kwargs: temporal.windowby(self, *args, **kwargs)
+Table.asof_join = lambda self, other, *args, **kwargs: temporal.asof_join(
+    self, other, *args, **kwargs
+)
+Table.asof_join_left = lambda self, other, *args, **kwargs: temporal.asof_join_left(
+    self, other, *args, **kwargs
+)
+Table.asof_join_right = lambda self, other, *args, **kwargs: temporal.asof_join_right(
+    self, other, *args, **kwargs
+)
+Table.asof_join_outer = lambda self, other, *args, **kwargs: temporal.asof_join_outer(
+    self, other, *args, **kwargs
+)
+Table.asof_now_join = lambda self, other, *args, **kwargs: temporal.asof_now_join(
+    self, other, *args, **kwargs
+)
+Table.interval_join = lambda self, other, *args, **kwargs: temporal.interval_join(
+    self, other, *args, **kwargs
+)
+Table.interval_join_left = lambda self, other, *args, **kwargs: temporal.interval_join_left(
+    self, other, *args, **kwargs
+)
+Table.interval_join_right = lambda self, other, *args, **kwargs: temporal.interval_join_right(
+    self, other, *args, **kwargs
+)
+Table.interval_join_outer = lambda self, other, *args, **kwargs: temporal.interval_join_outer(
+    self, other, *args, **kwargs
+)
+Table.window_join = lambda self, other, *args, **kwargs: temporal.window_join(
+    self, other, *args, **kwargs
+)
+Table.interpolate = lambda self, *args, **kwargs: statistical.interpolate(self, *args, **kwargs)
+
+
+def unwrap_err(x):  # small helper used in some pathway examples
+    return unwrap(x)
+
+
+__all__ = [
+    "ERROR",
+    "Json",
+    "Pointer",
+    "PyObjectWrapper",
+    "wrap_py_object",
+    "reducers",
+    "apply",
+    "apply_async",
+    "apply_with_type",
+    "assert_table_has_schema",
+    "cast",
+    "coalesce",
+    "declare_type",
+    "fill_error",
+    "if_else",
+    "make_tuple",
+    "require",
+    "unwrap",
+    "udf",
+    "UDF",
+    "udfs",
+    "BaseCustomAccumulator",
+    "ColumnDefinition",
+    "Schema",
+    "SchemaProperties",
+    "column_definition",
+    "schema_builder",
+    "schema_from_csv",
+    "schema_from_dict",
+    "schema_from_types",
+    "GroupedTable",
+    "Joinable",
+    "JoinMode",
+    "JoinResult",
+    "Table",
+    "TableLike",
+    "TableSlice",
+    "groupby",
+    "join",
+    "join_inner",
+    "join_left",
+    "join_outer",
+    "join_right",
+    "left",
+    "right",
+    "this",
+    "run",
+    "run_all",
+    "G",
+    "Type",
+    "MonitoringLevel",
+    "PersistenceMode",
+    "DateTimeNaive",
+    "DateTimeUtc",
+    "Duration",
+    "debug",
+    "demo",
+    "io",
+    "persistence",
+    "temporal",
+    "indexing",
+    "ml",
+    "graphs",
+    "stateful",
+    "statistical",
+    "ordered",
+    "utils",
+    "windowby",
+    "iterate",
+    "iterate_universe",
+    "sql",
+    "universes",
+    "AsyncTransformer",
+    "pandas_transformer",
+    "global_error_log",
+    "local_error_log",
+    "table_transformer",
+    "set_license_key",
+    "set_monitoring_config",
+    "local_pathway_config",
+    "__version__",
+]

@@ -1,0 +1,22 @@
+"""TPU-native incremental engine (the analog of Pathway's Rust engine crate)."""
+
+from pathway_tpu.engine import dataflow, types
+from pathway_tpu.engine.types import (
+    ERROR,
+    Error,
+    Json,
+    Pointer,
+    PyObjectWrapper,
+    wrap_py_object,
+)
+
+__all__ = [
+    "dataflow",
+    "types",
+    "ERROR",
+    "Error",
+    "Json",
+    "Pointer",
+    "PyObjectWrapper",
+    "wrap_py_object",
+]

@@ -1,0 +1,1308 @@
+"""The incremental dataflow engine.
+
+Parity target: ``/root/reference/src/engine/dataflow.rs`` (6,173 LoC) +
+``src/engine/graph.rs`` (the ~45-method ``Graph`` trait).  Re-designed rather
+than translated:
+
+* The reference schedules fine-grained differential operators cooperatively
+  (``worker.step_or_park``).  Here the unit of work is an **epoch batch**: all
+  deltas that share a commit timestamp flow through the operator DAG in one
+  topologically-ordered pass.  That matches how a TPU program wants to see
+  work — large consolidated batches that can be padded to fixed shapes and
+  jitted — instead of row-at-a-time callbacks.
+* Collections are multisets of ``(key, row, diff)`` with 128-bit keys
+  (``engine/types.py``); every operator is delta-correct: retractions
+  (diff = -1) flow through joins, groupbys, and indexes exactly as in
+  differential dataflow.
+* Stateful operators own explicit dict-based arrangements; there is no
+  shared-arrangement machinery, which differential needs because operators
+  run concurrently — here the per-epoch barrier makes sharing trivial.
+
+The node set mirrors the Graph trait surface (graph.rs:643-986): input,
+expression/select, filter, flatten, reindex, update_cells/update_rows,
+concat, intersect/difference/restrict, ix, join (all modes), groupby/reduce,
+deduplicate, buffer/freeze/forget (temporal behaviors from time_column.rs),
+sort (prev/next), external index as-of-now, output/subscribe, iterate,
+gradual_broadcast, error log.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import Counter, defaultdict
+from typing import Any, Callable, Iterable, Sequence
+
+from pathway_tpu.engine.types import (
+    ERROR,
+    Error,
+    Pointer,
+    Time,
+    hash_values,
+)
+
+Row = tuple
+Delta = tuple  # (key:int, row:Row, diff:int)
+
+
+def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
+    acc: Counter = Counter()
+    for key, row, diff in deltas:
+        acc[(key, row)] += diff
+    return [(k, r, d) for (k, r), d in acc.items() if d != 0]
+
+
+class EngineError(RuntimeError):
+    pass
+
+
+class Node:
+    """A dataflow operator. Subclasses implement ``step``."""
+
+    name: str = "node"
+
+    def __init__(self, scope: "Scope", inputs: Sequence["Node"] = ()):
+        self.scope = scope
+        self.inputs = list(inputs)
+        self.downstream: list[tuple[Node, int]] = []
+        self.pending: dict[int, list[Delta]] = defaultdict(list)
+        self.keep_state = False
+        self.state: dict[int, Row] = {}
+        # key -> Counter(row -> multiplicity); `state` holds the positive row
+        self._state_rows: dict[int, Counter] = {}
+        self.id = scope._register(self)
+        for port, inp in enumerate(self.inputs):
+            inp.downstream.append((self, port))
+        # monitoring counters (ProberStats analog, graph.rs:512)
+        self.rows_in = 0
+        self.rows_out = 0
+
+    # -- wiring --
+    def send(self, deltas: list[Delta], time: Time) -> None:
+        if not deltas:
+            return
+        self.rows_out += len(deltas)
+        for node, port in self.downstream:
+            node.pending[port].extend(deltas)
+
+    def take_pending(self, port: int = 0) -> list[Delta]:
+        deltas = self.pending.pop(port, [])
+        self.rows_in += len(deltas)
+        return deltas
+
+    def _update_state(self, deltas: list[Delta]) -> None:
+        for key, row, diff in deltas:
+            rows = self._state_rows.get(key)
+            if rows is None:
+                rows = self._state_rows[key] = Counter()
+            rows[row] += diff
+            if rows[row] == 0:
+                del rows[row]
+            if not rows:
+                del self._state_rows[key]
+                self.state.pop(key, None)
+            else:
+                for r, c in rows.items():
+                    if c > 0:
+                        self.state[key] = r
+                        break
+                else:
+                    self.state.pop(key, None)
+
+    def state_multiset(self) -> Counter:
+        """(key, row) -> positive multiplicity of the maintained state."""
+        out: Counter = Counter()
+        for key, rows in self._state_rows.items():
+            for r, c in rows.items():
+                if c > 0:
+                    out[(key, r)] = c
+        return out
+
+    def step(self, time: Time) -> None:
+        """Process this epoch's pending input; emit output deltas."""
+        deltas = self.take_pending()
+        if self.keep_state:
+            self._update_state(deltas)
+        self.send(deltas, time)
+
+    def flush(self, time: Time) -> None:
+        """Epoch-boundary hook (after every node stepped)."""
+
+    def on_finish(self) -> None:
+        """All inputs exhausted; release any remaining buffered work."""
+
+    def has_pending(self) -> bool:
+        return any(self.pending.values())
+
+    def require_state(self) -> "Node":
+        self.keep_state = True
+        return self
+
+    def __repr__(self):
+        return f"<{self.__class__.__name__}#{self.id}>"
+
+
+class InputNode(Node):
+    """An input session: rows pushed by connectors / static data.
+
+    Mirrors the InputSession+poller pattern (connectors/mod.rs:292, adaptors.rs).
+    """
+
+    name = "input"
+
+    def __init__(self, scope: "Scope"):
+        super().__init__(scope)
+        self._staged: dict[Time, list[Delta]] = defaultdict(list)
+        self.finished = False
+        # upsert sessions key rows and treat same-key insert as replace
+        self.upsert = False
+
+    def insert(self, key: int, row: Row, time: Time, diff: int = 1) -> None:
+        self._staged[time].append((key, row, diff))
+
+    def pending_times(self) -> list[Time]:
+        return sorted(self._staged.keys())
+
+    def emit_time(self, time: Time) -> None:
+        deltas = self._staged.pop(time, [])
+        if self.upsert:
+            out = []
+            for key, row, diff in deltas:
+                if diff > 0:
+                    prev = self.state.get(key)
+                    if prev is not None:
+                        out.append((key, prev, -1))
+                    out.append((key, row, 1))
+                else:
+                    prev = self.state.get(key)
+                    if prev is not None:
+                        out.append((key, prev, -1))
+            deltas = consolidate(out)
+            self._update_state(deltas)
+        else:
+            deltas = consolidate(deltas)
+            if self.keep_state:
+                self._update_state(deltas)
+        self.send(deltas, time)
+
+    def close(self) -> None:
+        self.finished = True
+
+
+class StaticNode(InputNode):
+    """A table whose rows are known at build time (debug tables)."""
+
+    name = "static"
+
+    def __init__(self, scope: "Scope", rows: Iterable[tuple[int, Row, Time, int]]):
+        super().__init__(scope)
+        for key, row, time, diff in rows:
+            self.insert(key, row, time, diff)
+        self.finished = True
+
+
+class ExprNode(Node):
+    """Row-wise map: select/with_columns — evaluates compiled expressions."""
+
+    name = "select"
+
+    def __init__(self, scope, inp: Node, fn: Callable[[int, Row], Row], deps: Sequence[Node] = ()):
+        super().__init__(scope, [inp])
+        self.fn = fn
+        for d in deps:
+            d.require_state()
+
+    def step(self, time):
+        out = []
+        for key, row, diff in self.take_pending():
+            out.append((key, self.fn(key, row), diff))
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class FilterNode(Node):
+    name = "filter"
+
+    def __init__(self, scope, inp: Node, pred: Callable[[int, Row], bool]):
+        super().__init__(scope, [inp])
+        self.pred = pred
+
+    def step(self, time):
+        out = []
+        for key, row, diff in self.take_pending():
+            res = self.pred(key, row)
+            if isinstance(res, Error):
+                self.scope.report_row_error(self, key, "filter predicate returned Error")
+                continue
+            if res:
+                out.append((key, row, diff))
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class FlattenNode(Node):
+    """flatten a column of sequences into multiple rows (dataflow.rs flatten_table)."""
+
+    name = "flatten"
+
+    def __init__(self, scope, inp: Node, fn: Callable[[int, Row], Iterable[tuple[int, Row]]]):
+        super().__init__(scope, [inp])
+        self.fn = fn
+
+    def step(self, time):
+        out = []
+        for key, row, diff in self.take_pending():
+            for new_key, new_row in self.fn(key, row):
+                out.append((new_key, new_row, diff))
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class ReindexNode(Node):
+    """Change row keys (with_id_from / reindex); detects duplicate new keys."""
+
+    name = "reindex"
+
+    def __init__(self, scope, inp: Node, key_fn: Callable[[int, Row], int]):
+        super().__init__(scope, [inp])
+        self.key_fn = key_fn
+        self.require_state()
+
+    def step(self, time):
+        out = []
+        for key, row, diff in self.take_pending():
+            out.append((self.key_fn(key, row), row, diff))
+        out = consolidate(out)
+        self._update_state(out)
+        self.send(out, time)
+
+
+class ConcatNode(Node):
+    name = "concat"
+
+    def __init__(self, scope, inputs: Sequence[Node]):
+        super().__init__(scope, inputs)
+
+    def step(self, time):
+        out = []
+        for port in range(len(self.inputs)):
+            out.extend(self.take_pending(port))
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class UpdateRowsNode(Node):
+    """update_rows: rows of the right table override same-key rows of the left
+    (dataflow.rs update_rows_table)."""
+
+    name = "update_rows"
+
+    def __init__(self, scope, left: Node, right: Node):
+        super().__init__(scope, [left, right])
+        self._left: dict[int, Row] = {}
+        self._right: dict[int, Row] = {}
+
+    def step(self, time):
+        out = []
+        dl = self.take_pending(0)
+        dr = self.take_pending(1)
+        for key, row, diff in dl:
+            overridden = key in self._right
+            if diff > 0:
+                self._left[key] = row
+            else:
+                self._left.pop(key, None)
+            if not overridden:
+                out.append((key, row, diff))
+        for key, row, diff in dr:
+            if diff > 0:
+                prev_r = self._right.get(key)
+                if prev_r is not None:
+                    out.append((key, prev_r, -1))
+                elif key in self._left:
+                    out.append((key, self._left[key], -1))
+                self._right[key] = row
+                out.append((key, row, 1))
+            else:
+                self._right.pop(key, None)
+                out.append((key, row, -1))
+                if key in self._left:
+                    out.append((key, self._left[key], 1))
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class UpdateCellsNode(Node):
+    """update_cells: override a subset of columns for keys present in right."""
+
+    name = "update_cells"
+
+    def __init__(self, scope, left: Node, right: Node, merge_fn: Callable[[Row, Row | None], Row]):
+        super().__init__(scope, [left, right])
+        self._left: dict[int, Row] = {}
+        self._right: dict[int, Row] = {}
+        self.merge_fn = merge_fn
+
+    def _merged(self, key: int) -> Row | None:
+        if key not in self._left:
+            return None
+        return self.merge_fn(self._left[key], self._right.get(key))
+
+    def step(self, time):
+        out = []
+        touched: set[int] = set()
+        before: dict[int, Row | None] = {}
+        for port, store in ((0, self._left), (1, self._right)):
+            for key, row, diff in self.take_pending(port):
+                if key not in before:
+                    before[key] = self._merged(key)
+                touched.add(key)
+                if diff > 0:
+                    store[key] = row
+                else:
+                    store.pop(key, None)
+        for key in touched:
+            old = before[key]
+            new = self._merged(key)
+            if old == new:
+                continue
+            if old is not None:
+                out.append((key, old, -1))
+            if new is not None:
+                out.append((key, new, 1))
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class IntersectNode(Node):
+    """restrict left to keys present in all other inputs (intersect_tables)."""
+
+    name = "intersect"
+
+    def __init__(self, scope, left: Node, others: Sequence[Node], difference: bool = False):
+        super().__init__(scope, [left, *others])
+        self._left: dict[int, Row] = {}
+        self._present: list[Counter] = [Counter() for _ in others]
+        self.difference = difference
+
+    def _visible(self, key: int) -> bool:
+        if self.difference:
+            return not any(c[key] > 0 for c in self._present)
+        return all(c[key] > 0 for c in self._present)
+
+    def step(self, time):
+        out = []
+        before: dict[int, tuple[Row | None, bool]] = {}
+
+        def snapshot(key):
+            if key not in before:
+                row = self._left.get(key)
+                before[key] = (row, row is not None and self._visible(key))
+
+        for key, row, diff in self.take_pending(0):
+            snapshot(key)
+            if diff > 0:
+                self._left[key] = row
+            else:
+                self._left.pop(key, None)
+        for i in range(len(self._present)):
+            for key, row, diff in self.take_pending(i + 1):
+                snapshot(key)
+                self._present[i][key] += diff
+        for key, (old_row, was_visible) in before.items():
+            new_row = self._left.get(key)
+            now_visible = new_row is not None and self._visible(key)
+            if was_visible and old_row is not None:
+                out.append((key, old_row, -1))
+            if now_visible and new_row is not None:
+                out.append((key, new_row, 1))
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class IxNode(Node):
+    """ix/ix_ref: for each row of the keys table, look up a row of the data
+    table by pointer (dataflow.rs ix_table). Emits joined rows; reacts to
+    changes on both sides."""
+
+    name = "ix"
+
+    def __init__(
+        self,
+        scope,
+        keys_node: Node,
+        data_node: Node,
+        key_fn: Callable[[int, Row], Any],
+        merge_fn: Callable[[Row, Row | None], Row],
+        optional: bool = False,
+        strict: bool = True,
+    ):
+        super().__init__(scope, [keys_node, data_node])
+        self._keys: dict[int, tuple[Row, Any]] = {}
+        self._data: dict[int, Row] = {}
+        self._by_target: dict[Any, set[int]] = defaultdict(set)
+        self.key_fn = key_fn
+        self.merge_fn = merge_fn
+        self.optional = optional
+        self.strict = strict
+
+    def _emit_for(self, key: int, out: list, sign: int):
+        row, target = self._keys[key]
+        if target is None and self.optional:
+            out.append((key, self.merge_fn(row, None), sign))
+            return
+        data_row = self._data.get(target)
+        if data_row is None:
+            if self.strict:
+                self.scope.report_row_error(self, key, f"ix: missing key {target!r}")
+            return
+        out.append((key, self.merge_fn(row, data_row), sign))
+
+    def step(self, time):
+        out = []
+        dk = self.take_pending(0)
+        dd = self.take_pending(1)
+        changed_targets = set()
+        for key, row, diff in dd:
+            changed_targets.add(key)
+        # retract outputs of key-rows pointing at changed data (old data value)
+        for target in changed_targets:
+            for key in list(self._by_target.get(target, ())):
+                self._emit_for(key, out, -1)
+        for key, row, diff in dd:
+            if diff > 0:
+                self._data[key] = row
+            else:
+                self._data.pop(key, None)
+        for target in changed_targets:
+            for key in list(self._by_target.get(target, ())):
+                self._emit_for(key, out, 1)
+        for key, row, diff in dk:
+            if diff > 0:
+                target = self.key_fn(key, row)
+                tkey = target.value if isinstance(target, Pointer) else target
+                self._keys[key] = (row, tkey)
+                self._by_target[tkey].add(key)
+                self._emit_for(key, out, 1)
+            else:
+                if key in self._keys:
+                    self._emit_for(key, out, -1)
+                    _, tkey = self._keys.pop(key)
+                    self._by_target[tkey].discard(key)
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class JoinNode(Node):
+    """Incremental equi-join, all modes (dataflow.rs join 2740).
+
+    Output rows are ``(left_key, right_key, left_row, right_row)`` tuples
+    (either row may be None in outer modes); the Table layer projects them.
+    Delta-join rule per epoch: dL⋈R ∪ L'⋈dR where L' already includes dL.
+    """
+
+    name = "join"
+
+    def __init__(
+        self,
+        scope,
+        left: Node,
+        right: Node,
+        left_key_fn: Callable[[int, Row], tuple],
+        right_key_fn: Callable[[int, Row], tuple],
+        out_key_fn: Callable[[int, int, tuple], int],
+        left_outer: bool = False,
+        right_outer: bool = False,
+        exact_match: bool = False,
+    ):
+        super().__init__(scope, [left, right])
+        self.left_key_fn = left_key_fn
+        self.right_key_fn = right_key_fn
+        self.out_key_fn = out_key_fn
+        self.left_outer = left_outer
+        self.right_outer = right_outer
+        # join-key → {row_key: (row, count)}
+        self._left_idx: dict[tuple, dict[int, Row]] = defaultdict(dict)
+        self._right_idx: dict[tuple, dict[int, Row]] = defaultdict(dict)
+        # for outer modes: per row match count
+        self._left_matches: Counter = Counter()
+        self._right_matches: Counter = Counter()
+
+    def _pair(self, lkey, rkey, lrow, rrow, jk, sign, out):
+        okey = self.out_key_fn(lkey, rkey, jk)
+        out.append((okey, (lkey, rkey, lrow, rrow), sign))
+
+    def _null_left(self, rkey, rrow, jk, sign, out):
+        okey = self.out_key_fn(None, rkey, jk)
+        out.append((okey, (None, rkey, None, rrow), sign))
+
+    def _null_right(self, lkey, lrow, jk, sign, out):
+        okey = self.out_key_fn(lkey, None, jk)
+        out.append((okey, (lkey, None, lrow, None), sign))
+
+    def step(self, time):
+        out: list[Delta] = []
+        dl = consolidate(self.take_pending(0))
+        dr = consolidate(self.take_pending(1))
+
+        # apply left deltas against current right index
+        for lkey, lrow, diff in dl:
+            jk = self.left_key_fn(lkey, lrow)
+            if jk is None:
+                continue
+            matches = self._right_idx.get(jk, {})
+            n_matches = len(matches)
+            for rkey, rrow in matches.items():
+                self._pair(lkey, rkey, lrow, rrow, jk, diff, out)
+                if self.right_outer:
+                    old = self._right_matches[rkey]
+                    self._right_matches[rkey] = old + diff
+                    if old == 0 and diff > 0:
+                        self._null_left(rkey, rrow, jk, -1, out)
+                    elif old + diff == 0:
+                        self._null_left(rkey, rrow, jk, 1, out)
+            if self.left_outer:
+                self._left_matches[lkey] += diff * n_matches
+                if n_matches == 0:
+                    self._null_right(lkey, lrow, jk, diff, out)
+            if diff > 0:
+                self._left_idx[jk][lkey] = lrow
+            else:
+                self._left_idx[jk].pop(lkey, None)
+                if not self._left_idx[jk]:
+                    del self._left_idx[jk]
+                self._left_matches.pop(lkey, None)
+
+        # apply right deltas against updated left index
+        for rkey, rrow, diff in dr:
+            jk = self.right_key_fn(rkey, rrow)
+            if jk is None:
+                continue
+            matches = self._left_idx.get(jk, {})
+            n_matches = len(matches)
+            for lkey, lrow in matches.items():
+                self._pair(lkey, rkey, lrow, rrow, jk, diff, out)
+                if self.left_outer:
+                    old = self._left_matches[lkey]
+                    self._left_matches[lkey] = old + diff
+                    if old == 0 and diff > 0:
+                        self._null_right(lkey, lrow, jk, -1, out)
+                    elif old + diff == 0:
+                        self._null_right(lkey, lrow, jk, 1, out)
+            if self.right_outer:
+                self._right_matches[rkey] += diff * n_matches
+                if n_matches == 0:
+                    self._null_left(rkey, rrow, jk, diff, out)
+            if diff > 0:
+                self._right_idx[jk][rkey] = rrow
+            else:
+                self._right_idx[jk].pop(rkey, None)
+                if not self._right_idx[jk]:
+                    del self._right_idx[jk]
+                self._right_matches.pop(rkey, None)
+
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class GroupByNode(Node):
+    """Incremental groupby + reduce (dataflow.rs group_by_table 3404)."""
+
+    name = "groupby"
+
+    def __init__(
+        self,
+        scope,
+        inp: Node,
+        group_key_fn: Callable[[int, Row], tuple],
+        out_key_fn: Callable[[tuple], int],
+        reducer_specs: Sequence[tuple[Any, Callable[[int, Row], tuple]]],
+        # each spec: (Reducer, args_fn row→tuple of reducer args)
+        result_fn: Callable[[tuple, tuple], Row] | None = None,
+    ):
+        super().__init__(scope, [inp])
+        self.group_key_fn = group_key_fn
+        self.out_key_fn = out_key_fn
+        self.reducer_specs = list(reducer_specs)
+        self.result_fn = result_fn or (lambda gk, vals: tuple(vals))
+        self._groups: dict[tuple, list] = {}
+        self._group_counts: Counter = Counter()  # rows per group (for
+        # reducer-less reduces: distinct group keys must still emit rows)
+        self._last_out: dict[tuple, Row] = {}
+
+    def step(self, time):
+        out = []
+        touched: set[tuple] = set()
+        for key, row, diff in consolidate(self.take_pending()):
+            gk = self.group_key_fn(key, row)
+            states = self._groups.get(gk)
+            if states is None:
+                states = [r.make_state() for (r, _) in self.reducer_specs]
+                self._groups[gk] = states
+            for state, (_, args_fn) in zip(states, self.reducer_specs):
+                state.add(args_fn(key, row), diff, time, key)
+            self._group_counts[gk] += diff
+            touched.add(gk)
+        for gk in touched:
+            states = self._groups[gk]
+            okey = self.out_key_fn(gk)
+            old = self._last_out.pop(gk, None)
+            if old is not None:
+                out.append((okey, old, -1))
+            if self._group_counts[gk] > 0:
+                values = tuple(s.extract() for s in states)
+                new_row = self.result_fn(gk, values)
+                out.append((okey, new_row, 1))
+                self._last_out[gk] = new_row
+            else:
+                del self._groups[gk]
+                del self._group_counts[gk]
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class DeduplicateNode(Node):
+    """deduplicate with a Python acceptor (dataflow.rs deduplicate 3514)."""
+
+    name = "deduplicate"
+
+    def __init__(
+        self,
+        scope,
+        inp: Node,
+        instance_fn: Callable[[int, Row], Any],
+        value_fn: Callable[[int, Row], Any],
+        acceptor: Callable[[Any, Any], bool],
+        out_key_fn: Callable[[Any], int],
+    ):
+        super().__init__(scope, [inp])
+        self.instance_fn = instance_fn
+        self.value_fn = value_fn
+        self.acceptor = acceptor
+        self.out_key_fn = out_key_fn
+        self._current: dict[Any, tuple[Any, Row]] = {}
+
+    def step(self, time):
+        out = []
+        for key, row, diff in consolidate(self.take_pending()):
+            if diff <= 0:
+                continue  # dedup consumes insertions only (append-only semantics)
+            inst = self.instance_fn(key, row)
+            value = self.value_fn(key, row)
+            prev = self._current.get(inst)
+            if prev is None:
+                accept = self.acceptor(value, None)
+            else:
+                accept = self.acceptor(value, prev[0])
+            if isinstance(accept, Error):
+                self.scope.report_row_error(self, key, "deduplicate acceptor returned Error")
+                continue
+            if accept:
+                okey = self.out_key_fn(inst)
+                if prev is not None:
+                    out.append((okey, prev[1], -1))
+                self._current[inst] = (value, row)
+                out.append((okey, row, 1))
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class BufferNode(Node):
+    """Temporal behavior buffer/delay (time_column.rs analog).
+
+    Holds rows until ``threshold_fn(row) <= current watermark column max seen``;
+    used by windowby behaviors. The watermark here is the maximum value of the
+    time column observed so far (event-time semantics).
+    """
+
+    name = "buffer"
+
+    def __init__(self, scope, inp: Node, time_fn, threshold_fn):
+        super().__init__(scope, [inp])
+        self.time_fn = time_fn
+        self.threshold_fn = threshold_fn
+        self._held: list[Delta] = []
+        self._watermark = None
+
+    def step(self, time):
+        incoming = self.take_pending()
+        for key, row, diff in incoming:
+            t = self.time_fn(key, row)
+            if self._watermark is None or t > self._watermark:
+                self._watermark = t
+        self._held.extend(incoming)
+        release, keep = [], []
+        for key, row, diff in self._held:
+            thr = self.threshold_fn(key, row)
+            if self._watermark is not None and thr <= self._watermark:
+                release.append((key, row, diff))
+            else:
+                keep.append((key, row, diff))
+        self._held = keep
+        release = consolidate(release)
+        if self.keep_state:
+            self._update_state(release)
+        self.send(release, time)
+
+    def on_finish(self):
+        release = consolidate(self._held)
+        self._held = []
+        if self.keep_state:
+            self._update_state(release)
+        self.send(release, self.scope.current_time)
+
+
+class ForgetNode(Node):
+    """Forget (free state for) rows older than the watermark minus a horizon;
+    emits retractions downstream (time_column.rs forget)."""
+
+    name = "forget"
+
+    def __init__(self, scope, inp: Node, time_fn, threshold_fn, mark_forgetting_records: bool = False):
+        super().__init__(scope, [inp])
+        self.time_fn = time_fn
+        self.threshold_fn = threshold_fn
+        self._alive: dict[int, Row] = {}
+        self._watermark = None
+
+    def step(self, time):
+        out = []
+        for key, row, diff in consolidate(self.take_pending()):
+            t = self.time_fn(key, row)
+            if self._watermark is None or t > self._watermark:
+                self._watermark = t
+            out.append((key, row, diff))
+            if diff > 0:
+                self._alive[key] = row
+            else:
+                self._alive.pop(key, None)
+        if self._watermark is not None:
+            for key in list(self._alive):
+                row = self._alive[key]
+                if self.threshold_fn(key, row) <= self._watermark:
+                    out.append((key, row, -1))
+                    del self._alive[key]
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class FreezeNode(Node):
+    """Ignore updates to rows older than threshold (exactly-once behaviors)."""
+
+    name = "freeze"
+
+    def __init__(self, scope, inp: Node, time_fn, threshold_fn):
+        super().__init__(scope, [inp])
+        self.time_fn = time_fn
+        self.threshold_fn = threshold_fn
+        self._watermark = None
+
+    def step(self, time):
+        out = []
+        for key, row, diff in consolidate(self.take_pending()):
+            t = self.time_fn(key, row)
+            thr = self.threshold_fn(key, row)
+            if self._watermark is not None and thr <= self._watermark:
+                continue  # frozen: late data dropped
+            if self._watermark is None or t > self._watermark:
+                self._watermark = t
+            out.append((key, row, diff))
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class SortNode(Node):
+    """Maintains prev/next pointers for sorted tables (prev_next.rs analog).
+
+    Output rows: (key, instance, prev_key|None, next_key|None).
+    Uses a per-instance sorted list: the bidirectional-cursor trick in the
+    reference's DD fork exists to walk neighbours cheaply; a host-side sorted
+    structure gives the same O(log n) updates here.
+    """
+
+    name = "sort"
+
+    def __init__(self, scope, inp: Node, key_fn, instance_fn):
+        super().__init__(scope, [inp])
+        self.key_fn = key_fn
+        self.instance_fn = instance_fn
+        self._by_instance: dict[Any, list] = defaultdict(list)  # sorted [(sort_key, key)]
+        self._rows: dict[int, tuple[Any, Any]] = {}
+
+    def _neighbors(self, lst, i):
+        prev_k = lst[i - 1][1] if i > 0 else None
+        next_k = lst[i + 1][1] if i + 1 < len(lst) else None
+        return prev_k, next_k
+
+    def step(self, time):
+        import bisect
+
+        out = []
+        touched_instances = set()
+        old_lists: dict[Any, list] = {}
+        for key, row, diff in consolidate(self.take_pending()):
+            sk = self.key_fn(key, row)
+            inst = self.instance_fn(key, row)
+            lst = self._by_instance[inst]
+            if inst not in old_lists:
+                old_lists[inst] = list(lst)
+            touched_instances.add(inst)
+            if diff > 0:
+                bisect.insort(lst, ((_SortWrap(sk)), key))
+                self._rows[key] = (sk, inst)
+            else:
+                try:
+                    lst.remove((_SortWrap(sk), key))
+                except ValueError:
+                    pass
+                self._rows.pop(key, None)
+        for inst in touched_instances:
+            old = old_lists[inst]
+            new = self._by_instance[inst]
+            old_out = {
+                k: self._neighbors(old, i) for i, (_, k) in enumerate(old)
+            }
+            new_out = {
+                k: self._neighbors(new, i) for i, (_, k) in enumerate(new)
+            }
+            for k, nb in old_out.items():
+                if new_out.get(k) != nb:
+                    out.append((k, (_ptr(nb[0]), _ptr(nb[1])), -1))
+            for k, nb in new_out.items():
+                if old_out.get(k) != nb:
+                    out.append((k, (_ptr(nb[0]), _ptr(nb[1])), 1))
+            if not new:
+                del self._by_instance[inst]
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class _SortWrap:
+    """Total order over mixed sort keys."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def _k(self):
+        v = self.v
+        if isinstance(v, bool):
+            return (0, int(v))
+        if isinstance(v, (int, float)):
+            return (1, v)
+        if isinstance(v, str):
+            return (2, v)
+        if isinstance(v, tuple):
+            return (3, tuple(_SortWrap(x)._k() for x in v))
+        if isinstance(v, Pointer):
+            return (4, v.value)
+        return (5, repr(v))
+
+    def __lt__(self, other):
+        return self._k() < other._k()
+
+    def __eq__(self, other):
+        return isinstance(other, _SortWrap) and self.v == other.v
+
+    def __hash__(self):
+        return hash(self._k())
+
+
+def _ptr(k):
+    return Pointer(k) if isinstance(k, int) else k
+
+
+class GradualBroadcastNode(Node):
+    """gradual_broadcast (gradual_broadcast.rs): broadcast a slowly-changing
+    scalar (lower/value/upper thresholds) onto every row of the input; updates
+    to rows only when the value leaves [lower, upper]."""
+
+    name = "gradual_broadcast"
+
+    def __init__(self, scope, inp: Node, threshold_node: Node, lvu_fn):
+        super().__init__(scope, [inp, threshold_node])
+        self.lvu_fn = lvu_fn
+        self._current_value = None
+        self._lower = None
+        self._upper = None
+        self._rows: dict[int, Row] = {}
+
+    def step(self, time):
+        out = []
+        new_bounds = None
+        for key, row, diff in consolidate(self.take_pending(1)):
+            if diff > 0:
+                new_bounds = self.lvu_fn(key, row)
+        changed = False
+        if new_bounds is not None:
+            lower, value, upper = new_bounds
+            if (
+                self._current_value is None
+                or value < (self._lower if self._lower is not None else value)
+                or value > (self._upper if self._upper is not None else value)
+            ):
+                self._current_value = value
+                self._lower, self._upper = lower, upper
+                changed = True
+        if changed:
+            # retract+re-emit all rows with new broadcast value
+            for key, row in list(self._rows.items()):
+                out.append((key, row, -1))
+                new_row = row[:-1] + (self._current_value,)
+                self._rows[key] = new_row
+                out.append((key, new_row, 1))
+        for key, row, diff in consolidate(self.take_pending(0)):
+            new_row = row + (self._current_value,)
+            if diff > 0:
+                self._rows[key] = new_row
+                out.append((key, new_row, 1))
+            else:
+                stored = self._rows.pop(key, new_row)
+                out.append((key, stored, -1))
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class ExternalIndexNode(Node):
+    """as-of-now external index (dataflow/operators/external_index.rs).
+
+    Port 0: index data stream (key, (vector/doc, filter_data)); port 1: query
+    stream.  Answers each query against the *current* index contents and
+    keeps the answer updated: on index change, affected queries are re-run
+    and old answers retracted — the retraction bookkeeping the reference
+    implements in external_index.rs:1-163.
+    """
+
+    name = "external_index"
+
+    def __init__(self, scope, data_node: Node, query_node: Node, index, res_fn):
+        super().__init__(scope, [data_node, query_node])
+        self.index = index  # duck-typed: add(key,row), remove(key), search(qrow) -> result value
+        self.res_fn = res_fn  # (query_key, query_row, result) -> out Row
+        self._queries: dict[int, Row] = {}
+        self._answers: dict[int, Row] = {}
+
+    def step(self, time):
+        out = []
+        dd = consolidate(self.take_pending(0))
+        dq = consolidate(self.take_pending(1))
+        index_changed = bool(dd)
+        for key, row, diff in dd:
+            if diff > 0:
+                self.index.add(key, row)
+            else:
+                self.index.remove(key)
+        # new/removed queries
+        for qkey, qrow, diff in dq:
+            if diff > 0:
+                self._queries[qkey] = qrow
+                result = self.index.search(qrow)
+                ans = self.res_fn(qkey, qrow, result)
+                self._answers[qkey] = ans
+                out.append((qkey, ans, 1))
+            else:
+                self._queries.pop(qkey, None)
+                old = self._answers.pop(qkey, None)
+                if old is not None:
+                    out.append((qkey, old, -1))
+        if index_changed:
+            for qkey, qrow in self._queries.items():
+                result = self.index.search(qrow)
+                ans = self.res_fn(qkey, qrow, result)
+                old = self._answers.get(qkey)
+                if old != ans:
+                    if old is not None:
+                        out.append((qkey, old, -1))
+                    out.append((qkey, ans, 1))
+                    self._answers[qkey] = ans
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class AsyncValuesNode(Node):
+    """Computes extra columns with async functions: all rows of an epoch are
+    awaited concurrently under one event loop, with an epoch barrier —
+    the semantics of async_apply_table (dataflow.rs:1899-1937,
+    executors.py:161-164).  Emits ``row + (v1, v2, ...)``; results are cached
+    per (key, input row) so retractions retract the original value even for
+    non-deterministic functions.
+    """
+
+    name = "async_values"
+
+    def __init__(self, scope, inp: Node, coro_fns: Sequence[Callable[[int, Row], Any]]):
+        super().__init__(scope, [inp])
+        self.coro_fns = list(coro_fns)
+        self._cache: dict[tuple[int, Row], tuple] = {}
+
+    def step(self, time):
+        import asyncio
+
+        deltas = consolidate(self.take_pending())
+        inserts = [(k, r, d) for (k, r, d) in deltas if d > 0]
+        others = [(k, r, d) for (k, r, d) in deltas if d <= 0]
+        to_run = [(k, r) for (k, r, _) in inserts if (k, r) not in self._cache]
+
+        if to_run:
+
+            async def run_all():
+                coros = [
+                    fn(k, r) for (k, r) in to_run for fn in self.coro_fns
+                ]
+                return await asyncio.gather(*coros, return_exceptions=True)
+
+            flat = asyncio.run(run_all())
+            n = len(self.coro_fns)
+            for i, (k, r) in enumerate(to_run):
+                values = []
+                for res in flat[i * n : (i + 1) * n]:
+                    if isinstance(res, Exception):
+                        self.scope.report_row_error(
+                            self, k, f"async UDF failed: {res}"
+                        )
+                        values.append(ERROR)
+                    else:
+                        values.append(res)
+                self._cache[(k, r)] = tuple(values)
+        out = []
+        for k, r, d in inserts:
+            out.append((k, r + self._cache[(k, r)], d))
+        for k, r, d in others:
+            cached = self._cache.pop((k, r), None)
+            if cached is not None:
+                out.append((k, r + cached, d))
+        out = consolidate(out)
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class OutputNode(Node):
+    """Terminal: delivers consolidated epoch deltas to a writer/callback
+    (output_table dataflow.rs:3979 / subscribe_table :4080)."""
+
+    name = "output"
+
+    def __init__(
+        self,
+        scope,
+        inp: Node,
+        on_data: Callable[[int, Row, Time, int], None] | None = None,
+        on_time_end: Callable[[Time], None] | None = None,
+        on_end: Callable[[], None] | None = None,
+        on_frontier: Callable[[Time], None] | None = None,
+    ):
+        super().__init__(scope, [inp])
+        self.on_data = on_data
+        self.on_time_end = on_time_end
+        self.on_end = on_end
+        self.on_frontier = on_frontier
+        self._saw_data_this_epoch = False
+        scope.outputs.append(self)
+
+    def step(self, time):
+        deltas = consolidate(self.take_pending())
+        if self.keep_state:
+            self._update_state(deltas)
+        if self.on_data is not None:
+            for key, row, diff in deltas:
+                self.on_data(key, row, time, diff)
+        self._saw_data_this_epoch = bool(deltas)
+
+    def flush(self, time):
+        if self.on_time_end is not None:
+            self.on_time_end(time)
+
+    def on_finish(self):
+        if self.on_end is not None:
+            self.on_end()
+
+
+class IterateNode(Node):
+    """Fixed-point iteration (dataflow.rs iterate 4185).
+
+    Holds a sub-scope built by ``body``; per epoch, feeds the epoch's deltas
+    into the sub-scope's iteration inputs and loops until quiescence or
+    ``limit`` iterations — semi-naive in the sense that each round processes
+    only the previous round's deltas.
+    """
+
+    name = "iterate"
+
+    def __init__(self, scope, inputs: Sequence[Node], build_body, limit: int | None = None):
+        super().__init__(scope, inputs)
+        self.limit = limit
+        self.subscope = Scope(parent=scope)
+        # iteration inputs: one InputNode in subscope per outer input
+        self.iter_inputs = [InputNode(self.subscope) for _ in inputs]
+        # build_body returns (result_nodes, back_pairs):
+        #   result_nodes: sub-scope nodes whose accumulated state is the result
+        #   back_pairs: list of (input_index, node) — node's output deltas are
+        #   fed into iter_inputs[input_index] on the next round
+        self.result_nodes, self.back_pairs = build_body(self.subscope, self.iter_inputs)
+        for rn in self.result_nodes:
+            rn.require_state()
+        for _, bn in self.back_pairs:
+            bn.require_state()
+        self._result_sent: list[dict[tuple[int, Row], int]] = [
+            {} for _ in self.result_nodes
+        ]
+        # everything ever fed into each iteration input (outer + feedback);
+        # the back edge REPLACES the variable: we feed state(f(X)) - X, the
+        # differential Variable semantics (X_{n+1} := f(X_n), not ∪)
+        self._input_acc: list[Counter] = [Counter() for _ in self.iter_inputs]
+
+    def step(self, time):
+        # feed epoch deltas in
+        for port, iin in enumerate(self.iter_inputs):
+            deltas = self.take_pending(port)
+            for key, row, diff in deltas:
+                iin.insert(key, row, 0, diff)
+                self._input_acc[port][(key, row)] += diff
+        rounds = 0
+        while True:
+            rounds += 1
+            for iin in self.iter_inputs:
+                iin.emit_time(0)
+            self.subscope.run_epoch(0)
+            fed_any = False
+            for input_idx, bn in self.back_pairs:
+                new_state = bn.state_multiset()
+                acc = self._input_acc[input_idx]
+                delta: list[Delta] = []
+                for entry, cnt in new_state.items():
+                    d = cnt - acc.get(entry, 0)
+                    if d:
+                        delta.append((entry[0], entry[1], d))
+                for entry, cnt in list(acc.items()):
+                    if cnt and entry not in new_state:
+                        delta.append((entry[0], entry[1], -cnt))
+                if delta:
+                    fed_any = True
+                    for key, row, d in delta:
+                        self.iter_inputs[input_idx].insert(key, row, 0, d)
+                        acc[(key, row)] += d
+                        if acc[(key, row)] == 0:
+                            del acc[(key, row)]
+            if not fed_any:
+                break
+            if self.limit is not None and rounds >= self.limit:
+                break
+        # diff accumulated results against last sent
+        out_all = []
+        for i, rn in enumerate(self.result_nodes):
+            current = rn.state_multiset()
+            last = self._result_sent[i]
+            out = []
+            for entry, cnt in current.items():
+                delta = cnt - last.get(entry, 0)
+                if delta:
+                    out.append((entry[0], entry[1], delta))
+            for entry, cnt in last.items():
+                if entry not in current:
+                    out.append((entry[0], entry[1], -cnt))
+            self._result_sent[i] = current
+            out_all.append(out)
+        merged = consolidate(itertools.chain.from_iterable(out_all))
+        # tag rows with source result index so Table layer can split
+        # — instead we send per-result through port-mapped downstream:
+        self.send(merged, time)
+        self._last_results = out_all
+
+    # Table layer attaches ResultExtractNodes reading _last_results
+
+
+class IterateResultNode(Node):
+    """Extracts the i-th result stream of an IterateNode."""
+
+    name = "iterate_result"
+
+    def __init__(self, scope, iterate_node: IterateNode, index: int):
+        super().__init__(scope, [iterate_node])
+        self.index = index
+
+    def step(self, time):
+        # consume the merged stream (ignored) and use the split results
+        self.take_pending()
+        it: IterateNode = self.inputs[0]  # type: ignore[assignment]
+        out = consolidate(getattr(it, "_last_results", [[]] * (self.index + 1))[self.index])
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
+class Scope:
+    """Holds the operator DAG; analog of the engine Scope/Graph
+    (python_api.rs Scope pyclass + graph.rs Graph trait)."""
+
+    def __init__(self, parent: "Scope | None" = None):
+        self.nodes: list[Node] = []
+        self.outputs: list[OutputNode] = []
+        self.parent = parent
+        self.current_time: Time = 0
+        self.error_log: list[tuple[Any, int, str]] = []
+        self.terminate_on_error = True
+
+    def _register(self, node: Node) -> int:
+        self.nodes.append(node)
+        return len(self.nodes) - 1
+
+    def report_row_error(self, node: Node, key: int, message: str) -> None:
+        self.error_log.append((node, key, message))
+        if self.terminate_on_error:
+            raise EngineError(f"{node!r} key {Pointer(key)!r}: {message}")
+
+    def run_epoch(self, time: Time) -> None:
+        """One topologically-ordered pass (nodes registered in topo order)."""
+        self.current_time = time
+        for node in self.nodes:
+            node.step(time)
+        for node in self.nodes:
+            node.flush(time)
+
+    def finish(self) -> None:
+        # release buffered work (temporal buffers etc.), propagate, then
+        # signal end-of-stream to outputs — ordering matters so subscribers
+        # see the released rows before on_end.
+        for node in self.nodes:
+            if not isinstance(node, OutputNode):
+                node.on_finish()
+        guard = 0
+        while any(node.has_pending() for node in self.nodes):
+            self.run_epoch(self.current_time + 2)
+            guard += 1
+            if guard > 1000:
+                raise EngineError("finish() did not quiesce")
+        for out in self.outputs:
+            out.on_finish()

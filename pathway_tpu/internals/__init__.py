@@ -1,0 +1,43 @@
+from pathway_tpu.internals import dtype
+from pathway_tpu.internals.schema import (
+    ColumnDefinition,
+    Schema,
+    SchemaProperties,
+    column_definition,
+    schema_builder,
+    schema_from_csv,
+    schema_from_dict,
+    schema_from_types,
+)
+from pathway_tpu.internals.expression import (
+    ColumnExpression,
+    ColumnReference,
+    apply,
+    apply_async,
+    apply_with_type,
+    assert_table_has_schema,
+    cast,
+    coalesce,
+    declare_type,
+    fill_error,
+    if_else,
+    make_tuple,
+    require,
+    unwrap,
+)
+from pathway_tpu.internals.table import (
+    GroupedTable,
+    Joinable,
+    JoinMode,
+    JoinResult,
+    Table,
+    TableLike,
+    TableSlice,
+    groupby,
+    join,
+    join_inner,
+    join_left,
+    join_outer,
+    join_right,
+)
+from pathway_tpu.internals.thisclass import left, right, this

@@ -1,0 +1,121 @@
+"""Env-var-driven runtime configuration.
+
+Parity target: ``/root/reference/python/pathway/internals/config.py`` (173
+LoC) + engine-side ``src/engine/dataflow/config.rs:88-127``.  Same env
+variables, same context-local override mechanism.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from contextvars import ContextVar
+from typing import Any
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class PathwayConfig:
+    # mirrors PathwayConfig (internals/config.py:57-97)
+    ignore_asserts: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("PATHWAY_IGNORE_ASSERTS")
+    )
+    runtime_typechecking: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("PATHWAY_RUNTIME_TYPECHECKING")
+    )
+    terminate_on_error: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("PATHWAY_TERMINATE_ON_ERROR", True)
+    )
+    replay_storage: str | None = dataclasses.field(
+        default_factory=lambda: os.environ.get("PATHWAY_REPLAY_STORAGE")
+    )
+    snapshot_access: str | None = dataclasses.field(
+        default_factory=lambda: os.environ.get("PATHWAY_SNAPSHOT_ACCESS")
+    )
+    persistence_mode: str | None = dataclasses.field(
+        default_factory=lambda: os.environ.get("PATHWAY_PERSISTENCE_MODE")
+    )
+    license_key: str | None = dataclasses.field(
+        default_factory=lambda: os.environ.get("PATHWAY_LICENSE_KEY")
+    )
+    monitoring_server: str | None = dataclasses.field(
+        default_factory=lambda: os.environ.get("PATHWAY_MONITORING_SERVER")
+    )
+    # worker topology (config.rs:88-120)
+    threads: int = dataclasses.field(default_factory=lambda: _env_int("PATHWAY_THREADS", 1))
+    processes: int = dataclasses.field(default_factory=lambda: _env_int("PATHWAY_PROCESSES", 1))
+    process_id: int = dataclasses.field(default_factory=lambda: _env_int("PATHWAY_PROCESS_ID", 0))
+    first_port: int = dataclasses.field(
+        default_factory=lambda: _env_int("PATHWAY_FIRST_PORT", 10000)
+    )
+    run_id: str | None = dataclasses.field(default_factory=lambda: os.environ.get("PATHWAY_RUN_ID"))
+    monitoring_http_port: int | None = dataclasses.field(
+        default_factory=lambda: (
+            int(os.environ["PATHWAY_MONITORING_HTTP_PORT"])
+            if "PATHWAY_MONITORING_HTTP_PORT" in os.environ
+            else None
+        )
+    )
+
+    @property
+    def worker_count(self) -> int:
+        return self.threads * self.processes
+
+
+_config_var: ContextVar[PathwayConfig | None] = ContextVar("pathway_config", default=None)
+_global_config: PathwayConfig | None = None
+
+
+def get_config() -> PathwayConfig:
+    cfg = _config_var.get()
+    if cfg is not None:
+        return cfg
+    global _global_config
+    if _global_config is None:
+        _global_config = PathwayConfig()
+    return _global_config
+
+
+def refresh_config() -> None:
+    global _global_config
+    _global_config = PathwayConfig()
+
+
+@contextlib.contextmanager
+def local_pathway_config(**overrides: Any):
+    base = get_config()
+    cfg = dataclasses.replace(base, **overrides)
+    token = _config_var.set(cfg)
+    try:
+        yield cfg
+    finally:
+        _config_var.reset(token)
+
+
+def set_license_key(key: str | None) -> None:
+    get_config().license_key = key
+
+
+def set_monitoring_config(*, server_endpoint: str | None = None) -> None:
+    get_config().monitoring_server = server_endpoint
+
+
+def pathway_config() -> PathwayConfig:
+    return get_config()

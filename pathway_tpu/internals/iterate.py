@@ -1,0 +1,110 @@
+"""``pw.iterate`` — fixed-point computation.
+
+Parity target: ``parse_graph.py:157-181`` (IterateOperator) +
+``dataflow.rs:4185-4724``.  The body function receives proxy tables bound to
+a nested engine scope; tables returned under the same keyword are fed back
+until quiescence (semi-naive, per outer epoch), as in differential's
+iterative scopes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.engine import dataflow as df
+from pathway_tpu.internals.table import Lowerer, Table, Universe
+
+
+class _IterationProxyTable(Table):
+    """Table bound to an iteration input inside the nested scope."""
+
+    def __init__(self, schema, node_getter):
+        super().__init__(schema, build=lambda lowerer: node_getter(lowerer), universe=Universe())
+
+
+def iterate(func: Callable, iteration_limit: int | None = None, **kwargs: Table):
+    """Iterate ``func`` to fixed point.
+
+    ``kwargs`` are input tables; ``func(**tables)`` returns a dict (or
+    dataclass/namedtuple) of tables.  Returned keys matching input names are
+    fed back for the next round; the fixed point of each returned table is
+    the result.
+    """
+    input_names = list(kwargs.keys())
+    input_tables = [kwargs[n] for n in input_names]
+
+    # results are produced lazily: a recipe that builds the IterateNode once
+    holder: dict[str, Any] = {}
+
+    def ensure_built(lowerer: Lowerer) -> dict[str, df.Node]:
+        cache_key = id(lowerer)
+        if holder.get("lowerer_id") == cache_key:
+            return holder["result_nodes_by_name"]
+
+        outer_nodes = [lowerer.node(t) for t in input_tables]
+        result_order: list[str] = []
+
+        def build_body(subscope: df.Scope, iter_inputs: list[df.InputNode]):
+            sub_lowerer = Lowerer(subscope)
+            proxies = {}
+            for name, table, iin in zip(input_names, input_tables, iter_inputs):
+                proxy = _IterationProxyTable(table.schema, lambda lw, _iin=iin: _iin)
+                sub_lowerer.memo[id(proxy)] = iin
+                proxies[name] = proxy
+            returned = func(**proxies)
+            if isinstance(returned, Table):
+                returned = {input_names[0]: returned}
+            elif not isinstance(returned, dict):
+                # dataclass / namedtuple
+                if hasattr(returned, "_asdict"):
+                    returned = returned._asdict()
+                else:
+                    returned = {
+                        k: v for k, v in vars(returned).items() if isinstance(v, Table)
+                    }
+            result_order.extend(returned.keys())
+            holder["returned_schemas"] = {k: v.schema for k, v in returned.items()}
+            result_nodes = [sub_lowerer.node(t) for t in returned.values()]
+            back_pairs = []
+            for n in input_names:
+                if n in returned:
+                    back_pairs.append((input_names.index(n), sub_lowerer.node(returned[n])))
+            return result_nodes, back_pairs
+
+        node = df.IterateNode(
+            lowerer.scope, outer_nodes, build_body, limit=iteration_limit
+        )
+
+        result_nodes_by_name = {}
+        for i, name in enumerate(result_order):
+            result_nodes_by_name[name] = df.IterateResultNode(lowerer.scope, node, i)
+        holder["lowerer_id"] = cache_key
+        holder["result_nodes_by_name"] = result_nodes_by_name
+        return result_nodes_by_name
+
+    # trial build to learn the returned table names/schemas (pure, on a
+    # throwaway scope)
+    trial_lowerer = Lowerer(df.Scope())
+    trial_nodes = ensure_built(trial_lowerer)
+    schemas = holder["returned_schemas"]
+
+    results = {}
+    for name in trial_nodes:
+        def make_build(n=name):
+            def build(lowerer: Lowerer) -> df.Node:
+                return ensure_built(lowerer)[n]
+
+            return build
+
+        results[name] = Table(schemas[name], make_build(), universe=Universe())
+    holder["lowerer_id"] = None  # invalidate trial
+
+    if len(results) == 1:
+        return next(iter(results.values()))
+    import types
+
+    return types.SimpleNamespace(**results)
+
+
+def iterate_universe(func: Callable, **kwargs):
+    return iterate(func, **kwargs)

@@ -1,0 +1,501 @@
+"""Incremental reducers.
+
+Parity target: ``/root/reference/src/engine/reduce.rs:22-38`` (engine side) and
+``/root/reference/python/pathway/reducers.py`` (user API): count, sum (int,
+float, array), min/max/argmin/argmax, unique, any, sorted_tuple, tuple,
+ndarray, avg, earliest/latest, stateful_single/stateful_many, plus
+``BaseCustomAccumulator`` custom reducers.
+
+Engine contract (mirrors the semigroup-vs-full split of reduce.rs:40-61):
+every reducer owns a per-group state object supporting ``add(args, diff,
+time, key)`` and ``extract()``.  Invertible reducers (count/sum/avg) update
+in O(1); non-invertible ones keep the group's value multiset and recompute
+on change — the same strategy differential dataflow's ``reduce`` uses, minus
+arrangement sharing.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from collections.abc import Sequence
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.engine.types import ERROR, Pointer
+from pathway_tpu.internals import dtype as dt
+
+
+class ReducerState:
+    def add(self, args: tuple, diff: int, time: int, key) -> None:
+        raise NotImplementedError
+
+    def extract(self) -> Any:
+        raise NotImplementedError
+
+    def is_empty(self) -> bool:
+        raise NotImplementedError
+
+
+class Reducer:
+    name: str = "reducer"
+
+    def result_dtype(self, arg_dtypes: list[dt.DType]) -> dt.DType:
+        return dt.ANY
+
+    def make_state(self) -> ReducerState:
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        from pathway_tpu.internals.expression import ReducerExpression
+
+        return ReducerExpression(self, *args, **kwargs)
+
+    def __repr__(self):
+        return f"pw.reducers.{self.name}"
+
+
+class _CountState(ReducerState):
+    __slots__ = ("n",)
+
+    def __init__(self):
+        self.n = 0
+
+    def add(self, args, diff, time, key):
+        self.n += diff
+
+    def extract(self):
+        return self.n
+
+    def is_empty(self):
+        return self.n == 0
+
+
+class CountReducer(Reducer):
+    name = "count"
+
+    def result_dtype(self, arg_dtypes):
+        return dt.INT
+
+    def make_state(self):
+        return _CountState()
+
+
+class _SumState(ReducerState):
+    __slots__ = ("total", "n", "is_array")
+
+    def __init__(self):
+        self.total = None
+        self.n = 0
+
+    def add(self, args, diff, time, key):
+        (v,) = args
+        if v is None:
+            return
+        contrib = v * diff if diff != 1 else v
+        if self.total is None:
+            self.total = contrib if diff == 1 else contrib
+        else:
+            self.total = self.total + contrib
+        self.n += diff
+
+    def extract(self):
+        if self.total is None:
+            return 0
+        if isinstance(self.total, float):
+            return self.total
+        return self.total
+
+    def is_empty(self):
+        return self.n == 0
+
+
+class SumReducer(Reducer):
+    name = "sum"
+
+    def result_dtype(self, arg_dtypes):
+        t = arg_dtypes[0].strip_optional() if arg_dtypes else dt.ANY
+        if t in (dt.INT, dt.FLOAT, dt.DURATION) or isinstance(t, dt._Array):
+            return t
+        return dt.ANY
+
+    def make_state(self):
+        return _SumState()
+
+
+class _AvgState(_SumState):
+    def extract(self):
+        if self.n == 0:
+            return None
+        return self.total / self.n
+
+
+class AvgReducer(Reducer):
+    name = "avg"
+
+    def result_dtype(self, arg_dtypes):
+        return dt.FLOAT
+
+    def make_state(self):
+        return _AvgState()
+
+
+def _sort_key(v):
+    # deterministic total order: numbers compare numerically across
+    # bool/int/float; other types are grouped and ordered within the group
+    if isinstance(v, (bool, int, float)):
+        return (0, float(v))
+    if isinstance(v, str):
+        return (1, v)
+    if isinstance(v, bytes):
+        return (2, v)
+    if isinstance(v, Sequence) and isinstance(v, tuple):
+        return (3, _builtin_tuple(_sort_key(x) for x in v))
+    if isinstance(v, Pointer):
+        return (4, v.value)
+    return (5, str(type(v).__name__), repr(v))
+
+
+class _MultisetState(ReducerState):
+    """Counter-of-rows state for non-invertible reducers."""
+
+    __slots__ = ("rows", "finish")
+
+    def __init__(self, finish: Callable[[Counter], Any]):
+        self.rows = Counter()
+        self.finish = finish
+
+    def add(self, args, diff, time, key):
+        entry = (args, key)
+        self.rows[entry] += diff
+        if self.rows[entry] == 0:
+            del self.rows[entry]
+
+    def extract(self):
+        return self.finish(self.rows)
+
+    def is_empty(self):
+        return not self.rows
+
+
+def _multiset_reducer(name_: str, finish: Callable[[Counter], Any], rdtype=None):
+    class _R(Reducer):
+        name = name_
+
+        def result_dtype(self, arg_dtypes):
+            if rdtype is not None:
+                return rdtype if isinstance(rdtype, dt.DType) else rdtype(arg_dtypes)
+            return arg_dtypes[0] if arg_dtypes else dt.ANY
+
+        def make_state(self):
+            return _MultisetState(finish)
+
+    _R.__name__ = f"{name_.title()}Reducer"
+    return _R()
+
+
+# `min`/`max`/`sum`/`any`/`tuple` are shadowed below by the public reducer
+# instances (mirroring pw.reducers naming); keep the builtins reachable.
+_builtin_min = min
+_builtin_max = max
+_builtin_sum = sum
+_builtin_any = any
+_builtin_tuple = tuple
+
+
+def _finish_min(rows: Counter):
+    return _builtin_min((a[0] for (a, k) in rows), key=_sort_key)
+
+
+def _finish_max(rows: Counter):
+    return _builtin_max((a[0] for (a, k) in rows), key=_sort_key)
+
+
+def _finish_argmin(rows: Counter):
+    best = _builtin_min(rows, key=lambda e: (_sort_key(e[0][0]), e[1]))
+    return Pointer(best[1]) if not isinstance(best[1], Pointer) else best[1]
+
+
+def _finish_argmax(rows: Counter):
+    mx = _builtin_max(_sort_key(e[0][0]) for e in rows)
+    best = _builtin_min((e for e in rows if _sort_key(e[0][0]) == mx), key=lambda e: e[1])
+    return Pointer(best[1]) if not isinstance(best[1], Pointer) else best[1]
+
+
+def _finish_unique(rows: Counter):
+    vals = {a[0] for (a, k) in rows if a[0] is not None}
+    if len(vals) > 1:
+        return ERROR
+    return next(iter(vals), None)
+
+
+def _finish_any(rows: Counter):
+    return _builtin_min(((a, k) for (a, k) in rows), key=lambda e: e[1])[0][0]
+
+
+def _finish_sorted_tuple_factory(skip_nones: bool):
+    def finish(rows: Counter):
+        out = []
+        for (a, k), cnt in rows.items():
+            v = a[0]
+            if skip_nones and v is None:
+                continue
+            out.extend([v] * cnt)
+        out.sort(key=_sort_key)
+        return _builtin_tuple(out)
+
+    return finish
+
+
+def _finish_tuple_factory(skip_nones: bool):
+    def finish(rows: Counter):
+        entries = []
+        for (a, k), cnt in rows.items():
+            v = a[0]
+            if skip_nones and v is None:
+                continue
+            # order by the sort column when 2 args are given (tuple(x, sort_by=...)),
+            # else by row key — matching reference tuple reducer ordering
+            sort_v = a[1] if len(a) > 1 else k
+            entries.extend([(sort_v, k, v)] * cnt)
+        entries.sort(key=lambda e: (_sort_key(e[0]), e[1]))
+        return _builtin_tuple(v for (_, _, v) in entries)
+
+    return finish
+
+
+def _finish_ndarray_factory(skip_nones: bool):
+    def finish(rows: Counter):
+        tup = _finish_tuple_factory(skip_nones)(rows)
+        return np.array(tup)
+
+    return finish
+
+
+class _TimeBasedState(ReducerState):
+    """earliest/latest — value at min/max processing time."""
+
+    __slots__ = ("rows", "latest")
+
+    def __init__(self, latest: bool):
+        self.rows = Counter()
+        self.latest = latest
+
+    def add(self, args, diff, time, key):
+        entry = (time, key, args)
+        self.rows[entry] += diff
+        if self.rows[entry] == 0:
+            del self.rows[entry]
+
+    def extract(self):
+        pick = _builtin_max if self.latest else _builtin_min
+        best = pick(self.rows, key=lambda e: (e[0], e[1]))
+        return best[2][0]
+
+    def is_empty(self):
+        return not self.rows
+
+
+class EarliestReducer(Reducer):
+    name = "earliest"
+
+    def result_dtype(self, arg_dtypes):
+        return arg_dtypes[0] if arg_dtypes else dt.ANY
+
+    def make_state(self):
+        return _TimeBasedState(latest=False)
+
+
+class LatestReducer(Reducer):
+    name = "latest"
+
+    def result_dtype(self, arg_dtypes):
+        return arg_dtypes[0] if arg_dtypes else dt.ANY
+
+    def make_state(self):
+        return _TimeBasedState(latest=True)
+
+
+class _StatefulState(ReducerState):
+    """Recompute a Python combiner over the group multiset (reduce.rs Stateful)."""
+
+    __slots__ = ("rows", "combine", "many")
+
+    def __init__(self, combine: Callable, many: bool):
+        self.rows = Counter()
+        self.combine = combine
+        self.many = many
+
+    def add(self, args, diff, time, key):
+        entry = (args, key)
+        self.rows[entry] += diff
+        if self.rows[entry] == 0:
+            del self.rows[entry]
+
+    def extract(self):
+        values = []
+        for (a, k), cnt in sorted(self.rows.items(), key=lambda e: e[0][1]):
+            values.extend([a] * cnt)
+        if self.many:
+            return self.combine(None, [(1, v) for v in values])
+        state = None
+        for v in values:
+            state = self.combine(state, *v)
+        return state
+
+    def is_empty(self):
+        return not self.rows
+
+
+class StatefulReducer(Reducer):
+    def __init__(self, combine: Callable, many: bool, name: str = "stateful"):
+        self._combine = combine
+        self._many = many
+        self.name = name
+
+    def result_dtype(self, arg_dtypes):
+        import typing
+
+        try:
+            hints = typing.get_type_hints(self._combine)
+            if "return" in hints:
+                return dt.wrap(hints["return"])
+        except Exception:
+            pass
+        return dt.ANY
+
+    def make_state(self):
+        return _StatefulState(self._combine, self._many)
+
+
+def stateful_single(combine_fn: Callable) -> StatefulReducer:
+    """pw.reducers.stateful_single — state = combine(state, *row_values)."""
+    return StatefulReducer(combine_fn, many=False, name=getattr(combine_fn, "__name__", "stateful"))
+
+
+def stateful_many(combine_fn: Callable) -> StatefulReducer:
+    """pw.reducers.stateful_many — combine(state, [(diff, row), ...])."""
+    return StatefulReducer(combine_fn, many=True, name=getattr(combine_fn, "__name__", "stateful"))
+
+
+class BaseCustomAccumulator:
+    """User-defined accumulator (pw.BaseCustomAccumulator).
+
+    Subclasses implement ``from_row``, ``update``, optionally ``retract`` and
+    ``neutral``, and ``compute_result``.
+    """
+
+    @classmethod
+    def from_row(cls, row):
+        raise NotImplementedError
+
+    def update(self, other):
+        raise NotImplementedError
+
+    def retract(self, other):
+        raise NotImplementedError
+
+    def compute_result(self):
+        raise NotImplementedError
+
+
+class _CustomAccState(ReducerState):
+    __slots__ = ("rows", "acc_cls")
+
+    def __init__(self, acc_cls):
+        self.rows = Counter()
+        self.acc_cls = acc_cls
+
+    def add(self, args, diff, time, key):
+        entry = (args, key)
+        self.rows[entry] += diff
+        if self.rows[entry] == 0:
+            del self.rows[entry]
+
+    def extract(self):
+        acc = None
+        for (a, k), cnt in sorted(self.rows.items(), key=lambda e: e[0][1]):
+            for _ in range(cnt):
+                nxt = self.acc_cls.from_row(list(a))
+                if acc is None:
+                    acc = nxt
+                else:
+                    acc.update(nxt)
+        return acc.compute_result() if acc is not None else None
+
+    def is_empty(self):
+        return not self.rows
+
+
+def udf_reducer(accumulator: type[BaseCustomAccumulator]):
+    class _R(Reducer):
+        name = getattr(accumulator, "__name__", "custom")
+
+        def result_dtype(self, arg_dtypes):
+            import typing
+
+            try:
+                hints = typing.get_type_hints(accumulator.compute_result)
+                if "return" in hints:
+                    return dt.wrap(hints["return"])
+            except Exception:
+                pass
+            return dt.ANY
+
+        def make_state(self):
+            return _CustomAccState(accumulator)
+
+    return _R()
+
+
+# --- public reducer instances -------------------------------------------------
+
+count = CountReducer()
+sum = SumReducer()  # noqa: A001 — mirrors pw.reducers.sum
+avg = AvgReducer()
+min = _multiset_reducer("min", _finish_min)  # noqa: A001
+max = _multiset_reducer("max", _finish_max)  # noqa: A001
+argmin = _multiset_reducer("argmin", _finish_argmin, dt.POINTER)
+argmax = _multiset_reducer("argmax", _finish_argmax, dt.POINTER)
+unique = _multiset_reducer("unique", _finish_unique)
+any = _multiset_reducer("any", _finish_any)  # noqa: A001
+earliest = EarliestReducer()
+latest = LatestReducer()
+
+
+def sorted_tuple(expr, *, skip_nones: bool = False):
+    r = _multiset_reducer(
+        "sorted_tuple",
+        _finish_sorted_tuple_factory(skip_nones),
+        lambda ts: dt.List(dt.unoptionalize(ts[0]) if skip_nones else ts[0]),
+    )
+    return r(expr)
+
+
+def tuple(expr, *, skip_nones: bool = False, sort_by=None):  # noqa: A001
+    r = _multiset_reducer(
+        "tuple",
+        _finish_tuple_factory(skip_nones),
+        lambda ts: dt.List(dt.unoptionalize(ts[0]) if skip_nones else ts[0]),
+    )
+    if sort_by is not None:
+        return r(expr, sort_by)
+    return r(expr)
+
+
+def ndarray(expr, *, skip_nones: bool = False):
+    r = _multiset_reducer(
+        "ndarray", _finish_ndarray_factory(skip_nones), dt.ANY_ARRAY
+    )
+    return r(expr)
+
+
+# count may be called with zero args inside reduce()
+class _CountCallable(CountReducer):
+    def __call__(self, *args, **kwargs):
+        from pathway_tpu.internals.expression import ReducerExpression
+
+        return ReducerExpression(self, *args)
+
+
+count = _CountCallable()

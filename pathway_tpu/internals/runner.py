@@ -1,0 +1,151 @@
+"""Graph runner: lowers registered sinks and drives the engine event loop.
+
+Parity target: ``/root/reference/python/pathway/internals/graph_runner/__init__.py``
+(the tree-shake → lower → ``run_with_new_graph`` path, §3.1 of SURVEY.md) and
+the worker event loop of ``src/engine/dataflow.rs:6051-6104`` (probers →
+flushers → pollers → step).  Single-process form: the epoch loop polls
+connector queues, picks the next commit timestamp across all input sessions,
+and runs one consolidated pass of the operator DAG per epoch.
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Any, Callable
+
+from pathway_tpu.engine import dataflow as df
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Lowerer, Table
+
+
+class Poller:
+    """A connector pump: moves rows from reader threads into InputNodes.
+
+    Mirrors the poller closure pattern (connectors/mod.rs:292; dataflow.rs:6084).
+    """
+
+    def poll(self) -> bool:
+        """Advance; return True when the source is exhausted."""
+        return True
+
+
+def add_debug_sink(name: str, table: Table) -> None:
+    def on_data(key, row, time, diff):
+        sign = "+" if diff > 0 else "-"
+        print(f"[{name}] {sign} key={key & 0xFFFFFFFF:x} time={time} row={row}")
+
+    table._subscribe_raw(on_data, name=f"debug:{name}")
+
+
+class RunResult:
+    def __init__(self):
+        self.epochs = 0
+
+
+def run(
+    *,
+    debug: bool = False,
+    monitoring_level: Any = None,
+    with_http_server: bool = False,
+    default_logging: bool = True,
+    persistence_config: Any = None,
+    runtime_typechecking: bool | None = None,
+    terminate_on_error: bool = True,
+    max_epochs: int | None = None,
+    **kwargs: Any,
+) -> RunResult:
+    """``pw.run`` — execute every registered sink to completion."""
+    scope = df.Scope()
+    scope.terminate_on_error = terminate_on_error
+    lowerer = Lowerer(scope)
+
+    if persistence_config is not None:
+        lowerer.persistence = persistence_config  # consumed by connectors
+
+    # lower all sinks (tree-shaking is implicit: only sink cones are built)
+    for name, table, attach in list(G.sinks):
+        node = lowerer.node(table)
+        attach(lowerer, node)
+
+    result = RunResult()
+    try:
+        _event_loop(scope, lowerer, result, max_epochs=max_epochs)
+    finally:
+        for cleanup in lowerer.cleanups:
+            try:
+                cleanup()
+            except Exception:
+                pass
+    return result
+
+
+def run_all(**kwargs: Any) -> RunResult:
+    return run(**kwargs)
+
+
+def _input_nodes(scope: df.Scope) -> list[df.InputNode]:
+    return [n for n in scope.nodes if isinstance(n, df.InputNode)]
+
+
+def _event_loop(
+    scope: df.Scope,
+    lowerer: Lowerer,
+    result: RunResult,
+    max_epochs: int | None = None,
+) -> None:
+    inputs = _input_nodes(scope)
+    pollers = lowerer.pollers
+    last_time = -1
+    while True:
+        exhausted = True
+        for poller in pollers:
+            if not poller.poll():
+                exhausted = False
+        # choose the next epoch: smallest staged time across inputs
+        times: set[int] = set()
+        for inp in inputs:
+            times.update(inp.pending_times())
+        if times:
+            t = min(times)
+            if t <= last_time:
+                t = last_time + 2  # keep times strictly increasing & even
+            for inp in inputs:
+                # merge any earlier-stamped staged rows into this epoch
+                merged: list = []
+                for staged in sorted(st for st in inp.pending_times() if st <= t):
+                    merged.extend(inp._staged.pop(staged))
+                if merged:
+                    inp._staged[t] = merged
+                inp.emit_time(t)
+            scope.run_epoch(t)
+            last_time = t
+            result.epochs += 1
+            if max_epochs is not None and result.epochs >= max_epochs:
+                break
+            continue
+        all_finished = exhausted and all(inp.finished for inp in inputs)
+        if all_finished:
+            break
+        _time.sleep(0.001)
+    scope.current_time = max(scope.current_time, last_time)
+    scope.finish()
+
+
+def run_pipeline_to_completion(sink_tables: list[tuple[Table, Callable]], **kwargs) -> RunResult:
+    """Internal: run only the given (table, attach) sinks, not the global G."""
+    scope = df.Scope()
+    scope.terminate_on_error = kwargs.get("terminate_on_error", True)
+    lowerer = Lowerer(scope)
+    for table, attach in sink_tables:
+        node = lowerer.node(table)
+        attach(lowerer, node)
+    result = RunResult()
+    try:
+        _event_loop(scope, lowerer, result)
+    finally:
+        for cleanup in lowerer.cleanups:
+            try:
+                cleanup()
+            except Exception:
+                pass
+    return result

@@ -1,0 +1,210 @@
+"""``pw.sql`` — a limited SQL → Table-operations compiler.
+
+Parity target: ``/root/reference/python/pathway/internals/sql.py`` (726 LoC,
+sqlglot-based).  sqlglot is not available in this environment, so this is a
+self-contained compiler for the subset the reference documents: SELECT
+projections/expressions with aliases, WHERE, GROUP BY (+ aggregates
+COUNT/SUM/AVG/MIN/MAX), HAVING, UNION ALL, and dotted table references over
+the keyword-provided tables.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any
+
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals.expression import ColumnExpression
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.thisclass import this
+
+_AGGS = {
+    "count": reducers.count,
+    "sum": reducers.sum,
+    "avg": reducers.avg,
+    "min": reducers.min,
+    "max": reducers.max,
+}
+
+
+def _sql_to_python(expr: str) -> str:
+    s = expr
+    s = re.sub(r"(?<![<>!=])=(?!=)", "==", s)
+    s = re.sub(r"<>", "!=", s)
+    s = re.sub(r"\bAND\b", "&", s, flags=re.I)
+    s = re.sub(r"\bOR\b", "|", s, flags=re.I)
+    s = re.sub(r"\bNOT\b", "~", s, flags=re.I)
+    s = re.sub(r"\bIS\s+NOT\s+NULL\b", ".is_not_none()", s, flags=re.I)
+    s = re.sub(r"\bIS\s+NULL\b", ".is_none()", s, flags=re.I)
+    s = s.replace("'", '"')
+    return s
+
+
+class _ExprBuilder(ast.NodeTransformer):
+    def __init__(self, tables: dict[str, Table], in_group: bool):
+        self.tables = tables
+        self.in_group = in_group
+        self.aggregates_used = False
+
+
+def _compile_expr(sql_expr: str, tables: dict[str, Table], group_ctx: bool = False):
+    py = _sql_to_python(sql_expr)
+    tree = ast.parse(py, mode="eval")
+
+    def build(node) -> Any:
+        if isinstance(node, ast.Expression):
+            return build(node.body)
+        if isinstance(node, ast.BinOp):
+            op_map = {
+                ast.Add: "__add__",
+                ast.Sub: "__sub__",
+                ast.Mult: "__mul__",
+                ast.Div: "__truediv__",
+                ast.FloorDiv: "__floordiv__",
+                ast.Mod: "__mod__",
+                ast.Pow: "__pow__",
+                ast.BitAnd: "__and__",
+                ast.BitOr: "__or__",
+                ast.BitXor: "__xor__",
+            }
+            left = build(node.left)
+            right = build(node.right)
+            return getattr(ColumnExpression, op_map[type(node.op)])(
+                left if isinstance(left, ColumnExpression) else _const(left),
+                right,
+            )
+        if isinstance(node, ast.UnaryOp):
+            v = build(node.operand)
+            if isinstance(node.op, ast.USub):
+                return -v
+            if isinstance(node.op, ast.Invert):
+                return ~v
+            return v
+        if isinstance(node, ast.Compare):
+            left = build(node.left)
+            right = build(node.comparators[0])
+            op = node.ops[0]
+            le = left if isinstance(left, ColumnExpression) else _const(left)
+            if isinstance(op, ast.Eq):
+                return le == right
+            if isinstance(op, ast.NotEq):
+                return le != right
+            if isinstance(op, ast.Lt):
+                return le < right
+            if isinstance(op, ast.LtE):
+                return le <= right
+            if isinstance(op, ast.Gt):
+                return le > right
+            if isinstance(op, ast.GtE):
+                return le >= right
+            raise ValueError("unsupported comparison")
+        if isinstance(node, ast.Name):
+            return getattr(this, node.id)
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in self_tables:
+                return getattr(self_tables[base.id], node.attr)
+            inner = build(base)
+            return getattr(inner, node.attr)
+        if isinstance(node, ast.Constant):
+            return node.value
+        if isinstance(node, ast.Call):
+            fname = node.func.id.lower() if isinstance(node.func, ast.Name) else None
+            if fname in _AGGS:
+                args = [build(a) for a in node.args]
+                if fname == "count":
+                    return reducers.count()
+                return _AGGS[fname](*args)
+            if isinstance(node.func, ast.Attribute):
+                # method call like x.is_none()
+                inner = build(node.func.value)
+                return getattr(inner, node.func.attr)(*[build(a) for a in node.args])
+            raise ValueError(f"unsupported SQL function {fname}")
+        if isinstance(node, ast.Starred) and isinstance(node.value, ast.Name):
+            return node.value.id
+        raise ValueError(f"unsupported SQL expression node {ast.dump(node)}")
+
+    self_tables = tables
+    return build(tree)
+
+
+def _const(v):
+    from pathway_tpu.internals.expression import ColumnConstExpression
+
+    return ColumnConstExpression(v)
+
+
+def _split_top(s: str, sep: str = ",") -> list[str]:
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == sep and depth == 0:
+            parts.append("".join(cur).strip())
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur).strip())
+    return parts
+
+
+def sql(query: str, **tables: Table) -> Table:
+    """Execute a SQL query over the provided tables."""
+    q = query.strip().rstrip(";")
+    if re.search(r"\bUNION\s+ALL\b", q, flags=re.I):
+        parts = re.split(r"\bUNION\s+ALL\b", q, flags=re.I)
+        result = sql(parts[0], **tables)
+        for p in parts[1:]:
+            result = result.concat_reindex(sql(p, **tables))
+        return result
+
+    m = re.match(
+        r"SELECT\s+(?P<proj>.+?)\s+FROM\s+(?P<frm>[\w.]+)"
+        r"(?:\s+WHERE\s+(?P<where>.+?))?"
+        r"(?:\s+GROUP\s+BY\s+(?P<group>.+?))?"
+        r"(?:\s+HAVING\s+(?P<having>.+?))?$",
+        q,
+        flags=re.I | re.S,
+    )
+    if not m:
+        raise ValueError(f"unsupported SQL: {query!r}")
+    table_name = m.group("frm")
+    if table_name not in tables:
+        raise ValueError(f"unknown table {table_name!r}")
+    t = tables[table_name]
+
+    if m.group("where"):
+        t = t.filter(_compile_expr(m.group("where"), tables))
+
+    proj_parts = _split_top(m.group("proj"))
+    group = m.group("group")
+    select_exprs: dict[str, Any] = {}
+    auto = 0
+    for part in proj_parts:
+        am = re.match(r"(.+?)\s+AS\s+(\w+)$", part, flags=re.I)
+        if am:
+            raw, alias = am.group(1), am.group(2)
+        else:
+            raw, alias = part, None
+        if raw.strip() == "*":
+            for n in t.column_names():
+                select_exprs[n] = getattr(this, n)
+            continue
+        e = _compile_expr(raw, tables, group_ctx=group is not None)
+        if alias is None:
+            alias = raw.strip() if re.match(r"^\w+$", raw.strip()) else f"col_{auto}"
+            auto += 1
+        select_exprs[alias] = e
+
+    if group:
+        gcols = [g.strip() for g in _split_top(group)]
+        grefs = [getattr(this, g) for g in gcols]
+        result = t.groupby(*grefs).reduce(**select_exprs)
+        if m.group("having"):
+            result = result.filter(_compile_expr(m.group("having"), tables, group_ctx=True))
+        return result
+    return t.select(**select_exprs)

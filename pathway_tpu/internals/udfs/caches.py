@@ -1,0 +1,105 @@
+"""UDF result caches (parity: internals/udfs/caches.py:23-141).
+
+``DiskCache`` persists through the persistence layer's cached-object storage
+(the reference routes it through engine persistence,
+``src/persistence/cached_object_storage.rs``); here it writes one pickle per
+key under the persistence root or a local cache dir.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import hashlib
+import os
+import pickle
+from typing import Any, Callable
+
+
+class CacheStrategy:
+    def wrap(self, fun: Callable) -> Callable:
+        raise NotImplementedError
+
+    @staticmethod
+    def _cache_key(fun: Callable, args, kwargs) -> str:
+        payload = pickle.dumps((getattr(fun, "__name__", "fn"), args, tuple(sorted(kwargs.items()))))
+        return hashlib.blake2b(payload, digest_size=16).hexdigest()
+
+
+class InMemoryCache(CacheStrategy):
+    def __init__(self):
+        self._store: dict[str, Any] = {}
+
+    def wrap(self, fun: Callable) -> Callable:
+        if asyncio.iscoroutinefunction(fun):
+
+            @functools.wraps(fun)
+            async def async_wrapper(*args, **kwargs):
+                key = self._cache_key(fun, args, kwargs)
+                if key not in self._store:
+                    self._store[key] = await fun(*args, **kwargs)
+                return self._store[key]
+
+            return async_wrapper
+
+        @functools.wraps(fun)
+        def wrapper(*args, **kwargs):
+            key = self._cache_key(fun, args, kwargs)
+            if key not in self._store:
+                self._store[key] = fun(*args, **kwargs)
+            return self._store[key]
+
+        return wrapper
+
+
+class DiskCache(CacheStrategy):
+    def __init__(self, name: str | None = None, size_limit: int | None = None):
+        self.name = name
+        self.size_limit = size_limit
+        root = os.environ.get("PATHWAY_PERSISTENT_STORAGE", ".pathway_tpu_cache")
+        self._dir = os.path.join(root, "udf_cache", name or "default")
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self._dir, key + ".pkl")
+
+    def _get(self, key: str):
+        path = self._path(key)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return True, pickle.load(f)
+        return False, None
+
+    def _put(self, key: str, value: Any) -> None:
+        os.makedirs(self._dir, exist_ok=True)
+        with open(self._path(key), "wb") as f:
+            pickle.dump(value, f)
+
+    def wrap(self, fun: Callable) -> Callable:
+        if asyncio.iscoroutinefunction(fun):
+
+            @functools.wraps(fun)
+            async def async_wrapper(*args, **kwargs):
+                key = self._cache_key(fun, args, kwargs)
+                hit, value = self._get(key)
+                if hit:
+                    return value
+                value = await fun(*args, **kwargs)
+                self._put(key, value)
+                return value
+
+            return async_wrapper
+
+        @functools.wraps(fun)
+        def wrapper(*args, **kwargs):
+            key = self._cache_key(fun, args, kwargs)
+            hit, value = self._get(key)
+            if hit:
+                return value
+            value = fun(*args, **kwargs)
+            self._put(key, value)
+            return value
+
+        return wrapper
+
+
+DefaultCache = DiskCache

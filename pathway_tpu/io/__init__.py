@@ -1,0 +1,71 @@
+"""``pw.io`` — connectors (parity: python/pathway/io/__init__.py:3-31).
+
+28 connector modules in the reference; here: fully functional fs/csv/
+jsonlines/plaintext/python/sqlite/http/kafka(+client)/null/subscribe, and
+API-parity gated modules for the externals whose client libraries are not
+available in this environment.
+"""
+
+from pathway_tpu.io import (
+    airbyte,
+    bigquery,
+    csv,
+    debezium,
+    deltalake,
+    elasticsearch,
+    fs,
+    gdrive,
+    http,
+    iceberg,
+    jsonlines,
+    kafka,
+    logstash,
+    minio,
+    mongodb,
+    nats,
+    null,
+    plaintext,
+    postgres,
+    pubsub,
+    pyfilesystem,
+    python,
+    redpanda,
+    s3,
+    s3_csv,
+    slack,
+    sqlite,
+)
+from pathway_tpu.io._subscribe import subscribe
+from pathway_tpu.io._utils import register_output
+
+__all__ = [
+    "airbyte",
+    "bigquery",
+    "csv",
+    "debezium",
+    "deltalake",
+    "elasticsearch",
+    "fs",
+    "gdrive",
+    "http",
+    "iceberg",
+    "jsonlines",
+    "kafka",
+    "logstash",
+    "minio",
+    "mongodb",
+    "nats",
+    "null",
+    "plaintext",
+    "postgres",
+    "pubsub",
+    "pyfilesystem",
+    "python",
+    "redpanda",
+    "s3",
+    "s3_csv",
+    "slack",
+    "sqlite",
+    "subscribe",
+    "register_output",
+]

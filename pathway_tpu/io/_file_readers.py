@@ -1,0 +1,176 @@
+"""Filesystem scanners/readers.
+
+Parity target: ``PosixLikeReader`` + filesystem scanner
+(``src/connectors/posix_like.rs:39``, ``src/connectors/scanner/filesystem.rs``)
+and the format parsers (``data_format.rs``: DsvParser:484, JsonLinesParser:1526,
+IdentityParser:812).  Static mode reads the current snapshot; streaming mode
+polls for new files and appended rows.
+"""
+
+from __future__ import annotations
+
+import csv as _csv
+import glob as _glob
+import io as _io
+import json as _json
+import os
+import time as _time
+from typing import Any, Callable, Iterator
+
+from pathway_tpu.engine.types import Json
+from pathway_tpu.io._utils import COMMIT, Reader
+
+
+def _list_files(path: str) -> list[str]:
+    if os.path.isdir(path):
+        out = []
+        for root, _dirs, files in os.walk(path):
+            for f in sorted(files):
+                out.append(os.path.join(root, f))
+        return sorted(out)
+    matched = sorted(_glob.glob(path))
+    if matched:
+        return matched
+    if os.path.exists(path):
+        return [path]
+    return []
+
+
+def _metadata(path: str) -> Json:
+    try:
+        st = os.stat(path)
+        return Json(
+            {
+                "path": os.path.abspath(path),
+                "size": st.st_size,
+                "modified_at": int(st.st_mtime),
+                "seen_at": int(_time.time()),
+                "owner": str(st.st_uid),
+            }
+        )
+    except OSError:
+        return Json({"path": os.path.abspath(path)})
+
+
+class FileReader(Reader):
+    """Scans `path`; parses each file with `parse_file`; optionally polls."""
+
+    def __init__(
+        self,
+        path: str,
+        parse_file: Callable[[str, int], tuple[Iterator[dict], int]],
+        *,
+        streaming: bool,
+        poll_interval: float = 0.5,
+        with_metadata: bool = False,
+    ):
+        self.path = path
+        self.parse_file = parse_file
+        self.streaming = streaming
+        self.poll_interval = poll_interval
+        self.with_metadata = with_metadata
+        # per-file progress: (mtime, consumed_units)
+        self._progress: dict[str, tuple[float, int]] = {}
+
+    def _emit_file(self, path: str, emit) -> bool:
+        try:
+            mtime = os.stat(path).st_mtime
+        except OSError:
+            return False
+        prev = self._progress.get(path)
+        offset = prev[1] if prev else 0
+        if prev and prev[0] == mtime:
+            return False
+        rows, new_offset = self.parse_file(path, offset)
+        emitted = False
+        meta = _metadata(path) if self.with_metadata else None
+        for row in rows:
+            if meta is not None:
+                row.setdefault("_metadata", meta)
+            emit(row)
+            emitted = True
+        self._progress[path] = (mtime, new_offset)
+        return emitted
+
+    def run(self, emit) -> None:
+        while True:
+            emitted = False
+            for path in _list_files(self.path):
+                if self._emit_file(path, emit):
+                    emitted = True
+            if emitted:
+                emit(COMMIT)
+            if not self.streaming:
+                return
+            _time.sleep(self.poll_interval)
+
+
+def csv_parse_file(csv_settings: dict | None = None):
+    settings = csv_settings or {}
+
+    def parse(path: str, offset: int):
+        with open(path, newline="", encoding="utf-8", errors="replace") as f:
+            reader = _csv.DictReader(f, **settings)
+            rows = list(reader)
+
+        def gen():
+            for row in rows[offset:]:
+                yield dict(row)
+
+        return gen(), len(rows)
+
+    return parse
+
+
+def jsonlines_parse_file(path: str, offset: int):
+    with open(path, encoding="utf-8", errors="replace") as f:
+        lines = f.readlines()
+
+    def gen():
+        for line in lines[offset:]:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = _json.loads(line)
+            except _json.JSONDecodeError:
+                continue
+            yield {
+                k: (Json(v) if isinstance(v, (dict, list)) else v)
+                for k, v in obj.items()
+            }
+
+    return gen(), len(lines)
+
+
+def plaintext_parse_file(path: str, offset: int):
+    with open(path, encoding="utf-8", errors="replace") as f:
+        lines = f.readlines()
+
+    def gen():
+        for line in lines[offset:]:
+            yield {"data": line.rstrip("\n")}
+
+    return gen(), len(lines)
+
+
+def plaintext_by_file_parse(path: str, offset: int):
+    if offset > 0:
+        return iter(()), 1
+    with open(path, encoding="utf-8", errors="replace") as f:
+        data = f.read()
+    return iter([{"data": data}]), 1
+
+
+def binary_parse_file(path: str, offset: int):
+    if offset > 0:
+        return iter(()), 1
+    with open(path, "rb") as f:
+        data = f.read()
+    return iter([{"data": data}]), 1
+
+
+def only_mode(mode: str) -> bool:
+    if mode not in ("streaming", "static"):
+        raise ValueError(f"unknown mode {mode!r}; use 'streaming' or 'static'")
+    return mode == "streaming"

@@ -1,0 +1,43 @@
+"""Helper for connectors whose client libraries are not in this environment.
+
+The reference links rdkafka/postgres/elasticsearch/... at build time; here
+optional Python clients are detected at call time and a clear error is
+raised when absent, keeping the API surface importable everywhere.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any
+
+
+def require(module: str, connector: str):
+    try:
+        return importlib.import_module(module)
+    except ImportError as exc:
+        raise ImportError(
+            f"pw.io.{connector} requires the {module!r} package, which is not "
+            "installed in this environment"
+        ) from exc
+
+
+def gated_reader(connector: str, module: str):
+    def read(*args: Any, **kwargs: Any):
+        require(module, connector)
+        raise NotImplementedError(
+            f"pw.io.{connector}.read: client library detected but the binding "
+            "is not implemented in this build yet"
+        )
+
+    return read
+
+
+def gated_writer(connector: str, module: str):
+    def write(*args: Any, **kwargs: Any):
+        require(module, connector)
+        raise NotImplementedError(
+            f"pw.io.{connector}.write: client library detected but the binding "
+            "is not implemented in this build yet"
+        )
+
+    return write

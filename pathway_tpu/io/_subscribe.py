@@ -1,0 +1,38 @@
+"""``pw.io.subscribe`` (parity: python/pathway/io/_subscribe.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.engine.types import Pointer
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import _utils
+
+
+def subscribe(
+    table: Table,
+    on_change: Callable[..., None] | None = None,
+    on_end: Callable[[], None] | None = None,
+    on_time_end: Callable[[int], None] | None = None,
+    *,
+    name: str | None = None,
+) -> None:
+    """Call ``on_change(key, row, time, is_addition)`` for every change."""
+    names = table.column_names()
+
+    def on_data(key, row, time, diff):
+        if on_change is not None:
+            on_change(
+                key=Pointer(key),
+                row=dict(zip(names, row)),
+                time=time,
+                is_addition=diff > 0,
+            )
+
+    _utils.register_output(
+        table,
+        on_data,
+        on_time_end=on_time_end,
+        on_end=on_end,
+        name=name or "subscribe",
+    )

@@ -1,0 +1,208 @@
+"""Connector plumbing shared by io modules.
+
+Parity target: the reader-thread → mpsc → poller pattern of
+``src/connectors/mod.rs:91-332`` and the parser layer of
+``src/connectors/data_format.rs``.  A source module provides a ``Reader``
+(iterator of parsed row dicts run on a thread); rows flow through a
+thread-safe queue into an engine ``InputNode``; the runner's event loop
+calls ``poll`` each iteration (dataflow.rs:6084-6092) and commits an epoch
+per ``autocommit_duration_ms``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time as _time
+from typing import Any, Callable, Iterable, Mapping
+
+from pathway_tpu.engine import dataflow as df
+from pathway_tpu.engine.types import Json, hash_values, sequential_key
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.parse_graph import G
+from pathway_tpu.internals.table import Lowerer, Table, Universe
+
+COMMIT = object()  # sentinel: force an epoch boundary
+FINISH = object()  # sentinel: source exhausted
+DELETE = "_pw_delete"  # row dict flag for deletions / upserts
+
+
+class Reader:
+    """Runs on its own thread; yields row dicts / COMMIT / FINISH."""
+
+    def run(self, emit: Callable[[Any], None]) -> None:
+        raise NotImplementedError
+
+    def seek(self, offset: Any) -> None:  # persistence hook
+        pass
+
+
+class _QueuePoller:
+    """Moves queued rows into the InputNode; stamps commit times.
+
+    One poller per source, mirroring StartedConnectorState (mod.rs:71).
+    """
+
+    def __init__(
+        self,
+        input_node: df.InputNode,
+        schema: type[schema_mod.Schema],
+        autocommit_duration_ms: int | None,
+    ):
+        self.q: queue.Queue = queue.Queue()
+        self.input_node = input_node
+        self.names = list(schema.__columns__.keys())
+        self.dtypes = [schema.__columns__[n].dtype for n in self.names]
+        self.pk = schema.primary_key_columns()
+        self.autocommit = (autocommit_duration_ms or 1500) / 1000.0
+        self._seq = itertools.count()
+        self._time = 2
+        self._staged = False
+        self._last_commit = _time.monotonic()
+        self.finished = False
+
+    def _key_of(self, values: list, row: Mapping) -> int:
+        if "_pw_key" in row:
+            k = row["_pw_key"]
+            return k if isinstance(k, int) else hash_values([k])
+        if self.pk:
+            return hash_values([values[self.names.index(c)] for c in self.pk])
+        return sequential_key(next(self._seq))
+
+    def poll(self) -> bool:
+        if self.finished:
+            return True
+        drained = 0
+        while drained < 100_000:
+            try:
+                item = self.q.get_nowait()
+            except queue.Empty:
+                break
+            drained += 1
+            if item is FINISH:
+                if self._staged:
+                    self._time += 2
+                self.input_node.close()
+                self.finished = True
+                return True
+            if item is COMMIT:
+                if self._staged:
+                    self._time += 2
+                    self._staged = False
+                    self._last_commit = _time.monotonic()
+                continue
+            row = item
+            diff = -1 if row.get(DELETE) else 1
+            values = [
+                dt.coerce(row.get(n), d) for n, d in zip(self.names, self.dtypes)
+            ]
+            key = self._key_of(values, row)
+            self.input_node.insert(key, tuple(values), self._time, diff)
+            self._staged = True
+        if self._staged and (_time.monotonic() - self._last_commit) >= self.autocommit:
+            self._time += 2
+            self._staged = False
+            self._last_commit = _time.monotonic()
+        return False
+
+
+def make_input_table(
+    schema: type[schema_mod.Schema],
+    reader_factory: Callable[[], Reader],
+    *,
+    autocommit_duration_ms: int | None = 1500,
+    upsert: bool = False,
+    name: str | None = None,
+) -> Table:
+    """Build a Table backed by a threaded reader (one thread per run)."""
+
+    def build(lowerer: Lowerer) -> df.Node:
+        node = df.InputNode(lowerer.scope)
+        node.upsert = upsert
+        if upsert:
+            node.require_state()
+        poller = _QueuePoller(node, schema, autocommit_duration_ms)
+        reader = reader_factory()
+
+        def target():
+            try:
+                reader.run(poller.q.put)
+            except Exception as exc:  # surface reader errors at finish
+                import logging
+
+                logging.getLogger("pathway_tpu.io").error(
+                    "connector reader failed: %s", exc
+                )
+            finally:
+                poller.q.put(FINISH)
+
+        thread = threading.Thread(target=target, name="pathway:connector", daemon=True)
+        thread.start()
+        lowerer.pollers.append(poller)
+        lowerer.cleanups.append(lambda: None)
+        return node
+
+    return Table(schema, build, universe=Universe())
+
+
+def make_static_input_table(
+    schema: type[schema_mod.Schema],
+    rows: Iterable[Mapping[str, Any]],
+) -> Table:
+    """Static source: all rows at time 0 (connector static mode)."""
+    names = list(schema.__columns__.keys())
+    dtypes = [schema.__columns__[n].dtype for n in names]
+    pk = schema.primary_key_columns()
+    keyed = []
+    seq = itertools.count()
+    for row in rows:
+        values = [dt.coerce(row.get(n), d) for n, d in zip(names, dtypes)]
+        if "_pw_key" in row:
+            k = row["_pw_key"]
+            key = k if isinstance(k, int) else hash_values([k])
+        elif pk:
+            key = hash_values([values[names.index(c)] for c in pk])
+        else:
+            key = sequential_key(next(seq))
+        keyed.append((key, tuple(values), 0, 1))
+
+    def build(lowerer: Lowerer) -> df.Node:
+        return df.StaticNode(lowerer.scope, keyed)
+
+    return Table(schema, build, universe=Universe())
+
+
+def register_output(
+    table: Table,
+    on_data: Callable[[int, tuple, int, int], None],
+    *,
+    on_time_end: Callable[[int], None] | None = None,
+    on_end: Callable[[], None] | None = None,
+    name: str = "output",
+) -> None:
+    def attach(lowerer: Lowerer, node: df.Node):
+        return df.OutputNode(
+            lowerer.scope, node, on_data=on_data, on_time_end=on_time_end, on_end=on_end
+        )
+
+    G.add_sink(name, table, attach)
+
+
+def schema_or_default(
+    schema: type[schema_mod.Schema] | None,
+    value_columns: list[str] | None = None,
+    primary_key: list[str] | None = None,
+    default_dtype: dt.DType = dt.ANY,
+) -> type[schema_mod.Schema]:
+    if schema is not None:
+        return schema
+    cols = {}
+    for c in primary_key or []:
+        cols[c] = schema_mod.ColumnSchema(name=c, dtype=default_dtype, primary_key=True)
+    for c in value_columns or []:
+        cols[c] = schema_mod.ColumnSchema(name=c, dtype=default_dtype)
+    if not cols:
+        raise ValueError("provide schema= or value_columns=")
+    return schema_mod.schema_from_columns(cols)

@@ -1,0 +1,11 @@
+"""Airbyte sources connector (parity: python/pathway/io/airbyte).
+
+The engine-side binding is gated on the optional ``airbyte_serverless`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("airbyte", "airbyte_serverless")
+write = gated_writer("airbyte", "airbyte_serverless")

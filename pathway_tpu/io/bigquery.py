@@ -1,0 +1,11 @@
+"""Google BigQuery sink connector (parity: python/pathway/io/bigquery).
+
+The engine-side binding is gated on the optional ``google.cloud.bigquery`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("bigquery", "google.cloud.bigquery")
+write = gated_writer("bigquery", "google.cloud.bigquery")

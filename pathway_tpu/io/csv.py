@@ -1,0 +1,128 @@
+"""CSV connector (parity: python/pathway/io/csv)."""
+
+from __future__ import annotations
+
+import csv as _csv
+import os
+import threading
+from typing import Any
+
+from pathway_tpu.engine.types import Pointer
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import _utils
+from pathway_tpu.io._file_readers import FileReader, csv_parse_file, only_mode
+
+
+class CsvParserSettings:
+    def __init__(self, delimiter=",", quote='"', escape=None, enable_double_quote_escapes=True, enable_quoting=True, comment_character=None):
+        self.delimiter = delimiter
+        self.quote = quote
+        self.escape = escape
+        self.enable_double_quote_escapes = enable_double_quote_escapes
+        self.comment_character = comment_character
+
+    def as_dict(self):
+        out = {"delimiter": self.delimiter, "quotechar": self.quote}
+        if self.escape:
+            out["escapechar"] = self.escape
+        out["doublequote"] = self.enable_double_quote_escapes
+        return out
+
+
+def read(
+    path: str,
+    *,
+    schema: type[schema_mod.Schema] | None = None,
+    csv_settings: CsvParserSettings | None = None,
+    mode: str = "streaming",
+    autocommit_duration_ms: int | None = 1500,
+    name: str | None = None,
+    with_metadata: bool = False,
+    value_columns: list[str] | None = None,
+    primary_key: list[str] | None = None,
+    types: dict | None = None,
+    **kwargs: Any,
+) -> Table:
+    """Read CSV file(s) into a table (reference io/csv read)."""
+    schema = _utils.schema_or_default(schema, value_columns, primary_key, dt.STR)
+    # CSV cells arrive as strings; coerce into declared dtypes
+    names = list(schema.__columns__.keys())
+    dtypes = {n: schema.__columns__[n].dtype for n in names}
+    settings = (csv_settings.as_dict() if csv_settings else None)
+    base_parse = csv_parse_file(settings)
+
+    def typed_parse(p, offset):
+        rows, new_offset = base_parse(p, offset)
+
+        def gen():
+            for row in rows:
+                out = {}
+                for n in names:
+                    raw = row.get(n)
+                    out[n] = _convert(raw, dtypes[n])
+                yield out
+
+        return gen(), new_offset
+
+    streaming = only_mode(mode)
+    return _utils.make_input_table(
+        schema,
+        lambda: FileReader(
+            path, typed_parse, streaming=streaming, with_metadata=with_metadata
+        ),
+        autocommit_duration_ms=autocommit_duration_ms,
+    )
+
+
+def _convert(raw: str | None, dtype: dt.DType):
+    if raw is None:
+        return None
+    base = dtype.strip_optional()
+    try:
+        if base is dt.INT:
+            return int(raw)
+        if base is dt.FLOAT:
+            return float(raw)
+        if base is dt.BOOL:
+            return raw.strip().lower() in ("true", "1", "yes", "on")
+        if base is dt.STR or base is dt.ANY:
+            return raw
+    except (ValueError, TypeError):
+        return None
+    return raw
+
+
+class _CsvWriter:
+    def __init__(self, filename: str, column_names: list[str]):
+        os.makedirs(os.path.dirname(os.path.abspath(filename)), exist_ok=True)
+        self._f = open(filename, "w", newline="")
+        self._w = _csv.writer(self._f)
+        self._w.writerow(column_names + ["time", "diff"])
+        self._lock = threading.Lock()
+
+    def write(self, key, row, time, diff):
+        with self._lock:
+            self._w.writerow([_fmt_cell(v) for v in row] + [time, diff])
+            self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+def _fmt_cell(v):
+    if isinstance(v, Pointer):
+        return repr(v)
+    return v
+
+
+def write(table: Table, filename: str, *, name: str | None = None, **kwargs: Any) -> None:
+    """Write the table's change stream as CSV (columns + time + diff)."""
+    writer = _CsvWriter(filename, table.column_names())
+    _utils.register_output(
+        table,
+        writer.write,
+        on_end=writer.close,
+        name=name or f"csv.write:{filename}",
+    )

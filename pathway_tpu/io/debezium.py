@@ -1,0 +1,11 @@
+"""Debezium CDC (Kafka transport) connector (parity: python/pathway/io/debezium).
+
+The engine-side binding is gated on the optional ``kafka`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("debezium", "kafka")
+write = gated_writer("debezium", "kafka")

@@ -1,0 +1,11 @@
+"""Delta Lake tables connector (parity: python/pathway/io/deltalake).
+
+The engine-side binding is gated on the optional ``deltalake`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("deltalake", "deltalake")
+write = gated_writer("deltalake", "deltalake")

@@ -1,0 +1,11 @@
+"""ElasticSearch sink connector (parity: python/pathway/io/elasticsearch).
+
+The engine-side binding is gated on the optional ``elasticsearch`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("elasticsearch", "elasticsearch")
+write = gated_writer("elasticsearch", "elasticsearch")

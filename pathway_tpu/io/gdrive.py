@@ -1,0 +1,11 @@
+"""Google Drive source connector (parity: python/pathway/io/gdrive).
+
+The engine-side binding is gated on the optional ``googleapiclient`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("gdrive", "googleapiclient")
+write = gated_writer("gdrive", "googleapiclient")

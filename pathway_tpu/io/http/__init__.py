@@ -1,0 +1,15 @@
+"""HTTP connectors (parity: python/pathway/io/http/_server.py:329-624).
+
+``PathwayWebserver`` + ``rest_connector``: HTTP requests become rows of a
+streaming table; responses are delivered through ``pw.io.subscribe`` when the
+result row for a request id appears — i.e. queries are just another
+streaming table (§3.4 of SURVEY.md).
+"""
+
+from pathway_tpu.io.http._server import (
+    EndpointDocumentation,
+    PathwayWebserver,
+    rest_connector,
+)
+
+__all__ = ["PathwayWebserver", "rest_connector", "EndpointDocumentation"]

@@ -1,0 +1,215 @@
+"""aiohttp-based REST ingress (parity: io/http/_server.py).
+
+One ``PathwayWebserver`` per (host, port); multiple ``rest_connector`` routes
+register handlers.  Each request: assign a request id → push a row into the
+input table (via ConnectorSubject) → wait on a future completed by the
+response writer subscribed to the result table → reply.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import json as _json
+import threading
+from typing import Any
+
+from pathway_tpu.engine.types import Json, Pointer, hash_values
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import _utils
+from pathway_tpu.io._utils import COMMIT, Reader
+
+
+class EndpointDocumentation:
+    def __init__(self, *, summary=None, description=None, tags=None, method_types=None, **kw):
+        self.summary = summary
+        self.description = description
+        self.tags = tags
+        self.method_types = method_types
+
+
+class PathwayWebserver:
+    """Shared aiohttp server; routes added by rest_connector."""
+
+    def __init__(self, host: str, port: int, with_schema_endpoint: bool = False, with_cors: bool = False):
+        self.host = host
+        self.port = port
+        self._routes: dict[tuple[str, str], Any] = {}
+        self._started = False
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._ready = threading.Event()
+
+    def _add_route(self, route: str, methods: list[str], handler) -> None:
+        for m in methods:
+            self._routes[(m.upper(), route)] = handler
+
+    def _start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+
+        def serve():
+            from aiohttp import web
+
+            async def dispatch(request: "web.Request"):
+                handler = self._routes.get((request.method, request.path))
+                if handler is None:
+                    return web.json_response({"error": "no such route"}, status=404)
+                return await handler(request)
+
+            async def main():
+                app = web.Application()
+                app.router.add_route("*", "/{tail:.*}", dispatch)
+                runner = web.AppRunner(app)
+                await runner.setup()
+                site = web.TCPSite(runner, self.host, self.port)
+                await site.start()
+                self._ready.set()
+                while True:
+                    await asyncio.sleep(3600)
+
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            self._loop.run_until_complete(main())
+
+        t = threading.Thread(target=serve, name="pathway:webserver", daemon=True)
+        t.start()
+        self._ready.wait(timeout=10)
+
+
+class _RestSubject(Reader):
+    """Bridges HTTP requests into the input table."""
+
+    def __init__(self, webserver: PathwayWebserver, route: str, methods: list[str], schema, delete_completed_queries: bool):
+        self.webserver = webserver
+        self.route = route
+        self.methods = methods
+        self.schema = schema
+        self.delete_completed_queries = delete_completed_queries
+        self.futures: dict[int, asyncio.Future] = {}
+        self._seq = itertools.count()
+        self._emit = None
+        self._stop = threading.Event()
+
+    def run(self, emit) -> None:
+        self._emit = emit
+        names = list(self.schema.__columns__.keys())
+        dtypes = {n: self.schema.__columns__[n].dtype for n in names}
+
+        async def handler(request):
+            from aiohttp import web
+
+            if request.method in ("POST", "PUT", "PATCH"):
+                try:
+                    payload = await request.json()
+                except Exception:
+                    payload = {}
+            else:
+                payload = dict(request.query)
+            rid = next(self._seq)
+            key = hash_values(["rest", id(self), rid])
+            row = {"_pw_key": key}
+            for n in names:
+                v = payload.get(n)
+                if dtypes[n].strip_optional() is dt.JSON and v is not None:
+                    v = Json(v)
+                row[n] = v
+            loop = asyncio.get_event_loop()
+            future = loop.create_future()
+            self.futures[key] = future
+            emit(row)
+            emit(COMMIT)
+            try:
+                result = await asyncio.wait_for(future, timeout=120)
+            except asyncio.TimeoutError:
+                return web.json_response({"error": "timeout"}, status=504)
+            finally:
+                self.futures.pop(key, None)
+                if self.delete_completed_queries:
+                    drow = dict(row)
+                    drow[_utils.DELETE] = True
+                    emit(drow)
+                    emit(COMMIT)
+            return web.json_response(result)
+
+        self.webserver._add_route(self.route, self.methods, handler)
+        self.webserver._start()
+        self._stop.wait()  # run forever (streaming source)
+
+    def complete(self, key: int, value: Any) -> None:
+        future = self.futures.get(key)
+        if future is not None and not future.done():
+            loop = future.get_loop()
+            loop.call_soon_threadsafe(
+                lambda: future.done() or future.set_result(value)
+            )
+
+
+def _jsonable(v):
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, Pointer):
+        return repr(v)
+    if isinstance(v, bytes):
+        return v.decode("utf-8", errors="replace")
+    if isinstance(v, tuple):
+        return [_jsonable(x) for x in v]
+    try:
+        import numpy as np
+
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+        if isinstance(v, np.generic):
+            return v.item()
+    except ImportError:
+        pass
+    return v
+
+
+def rest_connector(
+    host: str | None = None,
+    port: int | None = None,
+    *,
+    webserver: PathwayWebserver | None = None,
+    route: str = "/",
+    methods: list[str] = ("POST",),
+    schema: type[schema_mod.Schema] | None = None,
+    autocommit_duration_ms: int | None = 50,
+    keep_queries: bool | None = None,
+    delete_completed_queries: bool = False,
+    request_validator=None,
+    documentation: EndpointDocumentation | None = None,
+) -> tuple[Table, Any]:
+    """Returns (queries_table, response_writer)."""
+    if webserver is None:
+        if host is None or port is None:
+            raise ValueError("provide webserver= or host=/port=")
+        webserver = PathwayWebserver(host, port)
+    if schema is None:
+        schema = schema_mod.schema_from_types(query=str)
+    subject = _RestSubject(
+        webserver, route, list(methods), schema, delete_completed_queries
+    )
+    table = _utils.make_input_table(
+        schema,
+        lambda: subject,
+        autocommit_duration_ms=autocommit_duration_ms,
+    )
+
+    def response_writer(response_table: Table) -> None:
+        names = response_table.column_names()
+
+        def on_data(key, row, time, diff):
+            if diff <= 0:
+                return
+            if "result" in names:
+                value = _jsonable(row[names.index("result")])
+            else:
+                value = {n: _jsonable(v) for n, v in zip(names, row)}
+            subject.complete(key, value)
+
+        _utils.register_output(response_table, on_data, name=f"rest:{route}")
+
+    return table, response_writer

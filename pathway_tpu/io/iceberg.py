@@ -1,0 +1,11 @@
+"""Apache Iceberg tables connector (parity: python/pathway/io/iceberg).
+
+The engine-side binding is gated on the optional ``pyiceberg`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("iceberg", "pyiceberg")
+write = gated_writer("iceberg", "pyiceberg")

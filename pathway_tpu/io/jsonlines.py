@@ -1,0 +1,128 @@
+"""JSON Lines connector (parity: python/pathway/io/jsonlines)."""
+
+from __future__ import annotations
+
+import json as _json
+import os
+import threading
+from typing import Any
+
+from pathway_tpu.engine.types import Json, Pointer
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import _utils
+from pathway_tpu.io._file_readers import FileReader, jsonlines_parse_file, only_mode
+
+
+def read(
+    path: str,
+    *,
+    schema: type[schema_mod.Schema] | None = None,
+    mode: str = "streaming",
+    json_field_paths: dict | None = None,
+    autocommit_duration_ms: int | None = 1500,
+    name: str | None = None,
+    with_metadata: bool = False,
+    **kwargs: Any,
+) -> Table:
+    if schema is None:
+        raise ValueError("jsonlines.read requires schema=")
+    names = list(schema.__columns__.keys())
+    dtypes = {n: schema.__columns__[n].dtype for n in names}
+
+    def typed_parse(p, offset):
+        rows, new_offset = jsonlines_parse_file(p, offset)
+
+        def gen():
+            for row in rows:
+                out = {}
+                for n in names:
+                    if json_field_paths and n in json_field_paths:
+                        v = _extract_path(row, json_field_paths[n])
+                    else:
+                        v = row.get(n)
+                    out[n] = _coerce_json(v, dtypes[n])
+                yield out
+
+        return gen(), new_offset
+
+    streaming = only_mode(mode)
+    return _utils.make_input_table(
+        schema,
+        lambda: FileReader(
+            path, typed_parse, streaming=streaming, with_metadata=with_metadata
+        ),
+        autocommit_duration_ms=autocommit_duration_ms,
+    )
+
+
+def _extract_path(row: dict, path: str):
+    cur: Any = row
+    for part in path.strip("/").split("/"):
+        if isinstance(cur, Json):
+            cur = cur.value
+        if isinstance(cur, dict):
+            cur = cur.get(part)
+        else:
+            return None
+    return cur
+
+
+def _coerce_json(v, dtype: dt.DType):
+    if isinstance(v, Json) and dtype.strip_optional() is not dt.JSON:
+        v = v.value
+    if v is None:
+        return None
+    base = dtype.strip_optional()
+    if base is dt.JSON:
+        return v if isinstance(v, Json) else Json(v)
+    return dt.coerce(v, dtype)
+
+
+def _jsonable(v):
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, Pointer):
+        return repr(v)
+    if isinstance(v, bytes):
+        return v.decode("utf-8", errors="replace")
+    if isinstance(v, tuple):
+        return [_jsonable(x) for x in v]
+    try:
+        import numpy as np
+
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+        if isinstance(v, np.generic):
+            return v.item()
+    except ImportError:
+        pass
+    return v
+
+
+class _JsonLinesWriter:
+    def __init__(self, filename: str, column_names: list[str]):
+        dirname = os.path.dirname(os.path.abspath(filename))
+        os.makedirs(dirname, exist_ok=True)
+        self._f = open(filename, "w")
+        self._names = column_names
+        self._lock = threading.Lock()
+
+    def write(self, key, row, time, diff):
+        obj = {n: _jsonable(v) for n, v in zip(self._names, row)}
+        obj["time"] = time
+        obj["diff"] = diff
+        with self._lock:
+            self._f.write(_json.dumps(obj) + "\n")
+            self._f.flush()
+
+    def close(self):
+        self._f.close()
+
+
+def write(table: Table, filename: str, *, name: str | None = None, **kwargs: Any) -> None:
+    writer = _JsonLinesWriter(filename, table.column_names())
+    _utils.register_output(
+        table, writer.write, on_end=writer.close, name=name or f"jsonlines.write:{filename}"
+    )

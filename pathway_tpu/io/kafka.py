@@ -1,0 +1,154 @@
+"""Kafka connector (parity: python/pathway/io/kafka; KafkaReader
+data_storage.rs:663, KafkaWriter :1334).
+
+Uses ``kafka-python`` (or ``confluent_kafka``) when available; partitioned
+topics are read per-worker in the reference — single-process builds read all
+partitions on one consumer thread.
+"""
+
+from __future__ import annotations
+
+import json as _json
+from typing import Any
+
+from pathway_tpu.engine.types import Json
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import _utils
+from pathway_tpu.io._utils import COMMIT, Reader
+
+
+def _get_client():
+    try:
+        import confluent_kafka  # type: ignore
+
+        return ("confluent", confluent_kafka)
+    except ImportError:
+        pass
+    try:
+        import kafka  # type: ignore
+
+        return ("kafka-python", kafka)
+    except ImportError:
+        raise ImportError(
+            "pw.io.kafka requires confluent_kafka or kafka-python, neither of "
+            "which is installed in this environment"
+        )
+
+
+class _KafkaReader(Reader):
+    def __init__(self, rdkafka_settings, topic, format, schema):
+        self.settings = rdkafka_settings
+        self.topic = topic
+        self.format = format
+        self.schema = schema
+
+    def run(self, emit) -> None:
+        kind, client = _get_client()
+        names = list(self.schema.__columns__.keys()) if self.schema else ["data"]
+        if kind == "confluent":
+            consumer = client.Consumer(self.settings)
+            consumer.subscribe([self.topic])
+            while True:
+                msg = consumer.poll(0.5)
+                if msg is None:
+                    emit(COMMIT)
+                    continue
+                if msg.error():
+                    continue
+                self._emit_payload(msg.value(), names, emit)
+        else:
+            consumer = client.KafkaConsumer(
+                self.topic,
+                bootstrap_servers=self.settings.get("bootstrap.servers"),
+                group_id=self.settings.get("group.id"),
+            )
+            for msg in consumer:
+                self._emit_payload(msg.value, names, emit)
+                emit(COMMIT)
+
+    def _emit_payload(self, payload: bytes, names, emit) -> None:
+        if self.format == "raw":
+            emit({"data": payload})
+        elif self.format in ("json", "jsonlines"):
+            try:
+                obj = _json.loads(payload)
+            except _json.JSONDecodeError:
+                return
+            emit(
+                {
+                    n: (Json(v) if isinstance(v, (dict, list)) else v)
+                    for n, v in ((n, obj.get(n)) for n in names)
+                }
+            )
+        elif self.format == "plaintext":
+            emit({"data": payload.decode("utf-8", errors="replace")})
+
+
+def read(
+    rdkafka_settings: dict,
+    topic: str | None = None,
+    *,
+    schema: type[schema_mod.Schema] | None = None,
+    format: str = "raw",
+    autocommit_duration_ms: int | None = 1500,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    if format == "raw" and schema is None:
+        schema = schema_mod.schema_from_types(data=bytes)
+    elif format == "plaintext" and schema is None:
+        schema = schema_mod.schema_from_types(data=str)
+    elif schema is None:
+        raise ValueError("kafka.read with json format requires schema=")
+    return _utils.make_input_table(
+        schema,
+        lambda: _KafkaReader(rdkafka_settings, topic, format, schema),
+        autocommit_duration_ms=autocommit_duration_ms,
+    )
+
+
+def write(
+    table: Table,
+    rdkafka_settings: dict,
+    topic_name: str | None = None,
+    *,
+    format: str = "json",
+    name: str | None = None,
+    **kwargs: Any,
+) -> None:
+    kind, client = _get_client()
+    names = table.column_names()
+    topic = topic_name or kwargs.get("topic")
+    if kind == "confluent":
+        producer = client.Producer(rdkafka_settings)
+
+        def on_data(key, row, time, diff):
+            obj = {n: _plain(v) for n, v in zip(names, row)}
+            obj["time"], obj["diff"] = time, diff
+            producer.produce(topic, _json.dumps(obj).encode())
+            producer.poll(0)
+
+        _utils.register_output(table, on_data, on_end=producer.flush, name=f"kafka:{topic}")
+    else:
+        producer = client.KafkaProducer(
+            bootstrap_servers=rdkafka_settings.get("bootstrap.servers")
+        )
+
+        def on_data(key, row, time, diff):
+            obj = {n: _plain(v) for n, v in zip(names, row)}
+            obj["time"], obj["diff"] = time, diff
+            producer.send(topic, _json.dumps(obj).encode())
+
+        _utils.register_output(table, on_data, on_end=producer.flush, name=f"kafka:{topic}")
+
+
+def _plain(v):
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, bytes):
+        return v.decode("utf-8", errors="replace")
+    if isinstance(v, tuple):
+        return [_plain(x) for x in v]
+    return v

@@ -1,0 +1,11 @@
+"""Logstash HTTP sink connector (parity: python/pathway/io/logstash).
+
+The engine-side binding is gated on the optional ``aiohttp`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("logstash", "aiohttp")
+write = gated_writer("logstash", "aiohttp")

@@ -1,0 +1,11 @@
+"""MongoDB sink connector (parity: python/pathway/io/mongodb).
+
+The engine-side binding is gated on the optional ``pymongo`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("mongodb", "pymongo")
+write = gated_writer("mongodb", "pymongo")

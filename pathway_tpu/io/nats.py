@@ -1,0 +1,11 @@
+"""NATS messaging connector (parity: python/pathway/io/nats).
+
+The engine-side binding is gated on the optional ``nats`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("nats", "nats")
+write = gated_writer("nats", "nats")

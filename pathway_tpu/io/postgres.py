@@ -1,0 +1,11 @@
+"""PostgreSQL sink connector (parity: python/pathway/io/postgres).
+
+The engine-side binding is gated on the optional ``psycopg2`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("postgres", "psycopg2")
+write = gated_writer("postgres", "psycopg2")

@@ -1,0 +1,11 @@
+"""Google Pub/Sub connector (parity: python/pathway/io/pubsub).
+
+The engine-side binding is gated on the optional ``google.cloud.pubsub_v1`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("pubsub", "google.cloud.pubsub_v1")
+write = gated_writer("pubsub", "google.cloud.pubsub_v1")

@@ -1,0 +1,11 @@
+"""PyFilesystem sources connector (parity: python/pathway/io/pyfilesystem).
+
+The engine-side binding is gated on the optional ``fs`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("pyfilesystem", "fs")
+write = gated_writer("pyfilesystem", "fs")

@@ -1,0 +1,98 @@
+"""Python custom sources (parity: python/pathway/io/python/__init__.py:46-227).
+
+``ConnectorSubject``: subclass, implement ``run()``, call ``self.next(...)``
+(or next_str/next_bytes/next_json), ``self.commit()``, ``self.close()``.
+Bridged into the engine through the reader-thread/queue pattern — the role
+``PythonReader`` (data_storage.rs:806) plays in the reference.
+"""
+
+from __future__ import annotations
+
+import json as _json
+from typing import Any
+
+from pathway_tpu.engine.types import Json
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import _utils
+from pathway_tpu.io._utils import COMMIT, DELETE, Reader
+
+
+class ConnectorSubject:
+    """Base class for Python-defined sources."""
+
+    _emit: Any = None
+
+    def __init__(self, datasource_name: str | None = None):
+        self._datasource_name = datasource_name
+
+    # --- user API ---
+    def next(self, **kwargs) -> None:
+        self._emit(dict(kwargs))
+
+    def next_str(self, message: str) -> None:
+        self._emit({"data": message})
+
+    def next_bytes(self, message: bytes) -> None:
+        self._emit({"data": message})
+
+    def next_json(self, message: dict) -> None:
+        self._emit(
+            {
+                k: (Json(v) if isinstance(v, (dict, list)) else v)
+                for k, v in message.items()
+            }
+        )
+
+    def commit(self) -> None:
+        self._emit(COMMIT)
+
+    def close(self) -> None:
+        pass
+
+    def _remove(self, key, row: dict) -> None:
+        row = dict(row)
+        row[DELETE] = True
+        if key is not None:
+            row["_pw_key"] = key
+        self._emit(row)
+
+    def run(self) -> None:
+        raise NotImplementedError
+
+    def on_stop(self) -> None:
+        pass
+
+    @property
+    def _deletions_enabled(self) -> bool:
+        return True
+
+
+class _SubjectReader(Reader):
+    def __init__(self, subject: ConnectorSubject):
+        self.subject = subject
+
+    def run(self, emit) -> None:
+        self.subject._emit = emit
+        try:
+            self.subject.run()
+        finally:
+            self.subject.on_stop()
+
+
+def read(
+    subject: ConnectorSubject,
+    *,
+    schema: type[schema_mod.Schema] | None = None,
+    format: str = "row",
+    autocommit_duration_ms: int | None = 1500,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    if schema is None:
+        raise ValueError("python.read requires schema=")
+    return _utils.make_input_table(
+        schema,
+        lambda: _SubjectReader(subject),
+        autocommit_duration_ms=autocommit_duration_ms,
+    )

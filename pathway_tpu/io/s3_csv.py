@@ -1,0 +1,11 @@
+"""Amazon S3 CSV connector (parity: python/pathway/io/s3_csv).
+
+The engine-side binding is gated on the optional ``boto3`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("s3_csv", "boto3")
+write = gated_writer("s3_csv", "boto3")

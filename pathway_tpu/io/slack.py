@@ -1,0 +1,11 @@
+"""Slack notifications connector (parity: python/pathway/io/slack).
+
+The engine-side binding is gated on the optional ``aiohttp`` client package,
+which is not part of this environment; the API surface matches the
+reference so pipelines import and typecheck unchanged.
+"""
+
+from pathway_tpu.io._gated import gated_reader, gated_writer
+
+read = gated_reader("slack", "aiohttp")
+write = gated_writer("slack", "aiohttp")

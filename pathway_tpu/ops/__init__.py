@@ -1,0 +1,5 @@
+"""Device kernels: top-k similarity, pooling, padding helpers."""
+
+from pathway_tpu.ops import topk
+
+__all__ = ["topk"]

@@ -1,0 +1,171 @@
+"""Dense similarity scoring / top-k — the TPU replacement for the
+reference's ``mat_mul.rs`` + ``brute_force_knn_integration.rs`` dense scan.
+
+Design (SURVEY.md §7, BASELINE north star): the index matrix lives on device
+in HBM; queries are embedded on device; scores are one einsum on the MXU.
+Shapes are bucketed to powers of two so streaming index growth hits a warm
+XLA compile cache; the padded tail is masked to -inf.
+
+Falls back to numpy when jax is unavailable or matrices are tiny (device
+dispatch overhead dominates under ~256 rows).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import numpy as np
+
+_JAX_MIN_ROWS = 256  # below this, host numpy beats dispatch overhead
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+try:
+    import jax
+    import jax.numpy as jnp
+
+    _HAVE_JAX = True
+except Exception:  # pragma: no cover
+    _HAVE_JAX = False
+
+
+if _HAVE_JAX:
+
+    @functools.partial(jax.jit, static_argnames=("metric",))
+    def _score_jax(matrix, queries, metric: str):
+        m = matrix.astype(jnp.bfloat16)
+        q = queries.astype(jnp.bfloat16)
+        if metric == "cos":
+            mn = m / (jnp.linalg.norm(m, axis=1, keepdims=True).astype(jnp.bfloat16) + 1e-6)
+            qn = q / (jnp.linalg.norm(q, axis=1, keepdims=True).astype(jnp.bfloat16) + 1e-6)
+            return (qn @ mn.T).astype(jnp.float32)
+        if metric == "ip":
+            return (q @ m.T).astype(jnp.float32)
+        # l2sq: return negative squared distance so that larger = closer
+        m32 = matrix.astype(jnp.float32)
+        q32 = queries.astype(jnp.float32)
+        sq_m = jnp.sum(m32 * m32, axis=1)[None, :]
+        sq_q = jnp.sum(q32 * q32, axis=1)[:, None]
+        return -(sq_q + sq_m - 2.0 * (q32 @ m32.T))
+
+    @functools.partial(jax.jit, static_argnames=("metric", "k"))
+    def _masked_topk_jax(matrix, mask, queries, metric: str, k: int):
+        scores = _score_jax(matrix, queries, metric) + mask[None, :]
+        return jax.lax.top_k(scores, k)
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def _topk_jax(scores, k: int):
+        return jax.lax.top_k(scores, k)
+
+
+class DeviceIndexCache:
+    """Keeps the padded index matrix (and its padding mask) resident on
+    device across queries.
+
+    Rebuilds (re-pads, re-uploads) only when the index changed; the capacity
+    grows in power-of-two buckets so streaming index growth hits a warm XLA
+    compile cache instead of recompiling per row count.  Padded rows carry a
+    -inf mask so they never win top-k.
+    """
+
+    def __init__(self):
+        self._version = -1
+        self._padded = None
+        self._mask = None
+        self._n = 0
+
+    def get(self, matrix: np.ndarray, version: int):
+        if not _HAVE_JAX:
+            return None
+        n = matrix.shape[0]
+        cap = _next_pow2(max(n, _JAX_MIN_ROWS))
+        if (
+            self._padded is None
+            or version != self._version
+            or self._padded.shape[0] != cap
+            or self._padded.shape[1] != matrix.shape[1]
+        ):
+            padded = np.zeros((cap, matrix.shape[1]), dtype=np.float32)
+            padded[:n] = matrix
+            mask = np.full((cap,), -np.inf, dtype=np.float32)
+            mask[:n] = 0.0
+            self._padded = jax.device_put(jnp.asarray(padded))
+            self._mask = jax.device_put(jnp.asarray(mask))
+            self._version = version
+            self._n = n
+        return self._padded, self._mask, self._n
+
+
+def topk_search_cached(
+    matrix: np.ndarray,
+    queries: np.ndarray,
+    k: int,
+    metric: str,
+    *,
+    cache: DeviceIndexCache,
+    version: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Top-k against a device-resident padded index (warm across queries)."""
+    n = matrix.shape[0]
+    k_eff = min(k, n)
+    if not _HAVE_JAX or n < _JAX_MIN_ROWS:
+        scores = _score_numpy(
+            matrix.astype(np.float32), queries.astype(np.float32), metric
+        )
+        idx = np.argsort(-scores, kind="stable", axis=1)[:, :k_eff]
+        return idx, np.take_along_axis(scores, idx, axis=1)
+    device_matrix, mask, _n = cache.get(matrix, version)
+    vals, idx = _masked_topk_jax(
+        device_matrix, mask, jnp.asarray(queries.astype(np.float32)), metric, k_eff
+    )
+    return np.asarray(idx), np.asarray(vals)
+
+
+def _score_numpy(matrix: np.ndarray, queries: np.ndarray, metric: str) -> np.ndarray:
+    if metric == "cos":
+        mn = matrix / (np.linalg.norm(matrix, axis=1, keepdims=True) + 1e-12)
+        qn = queries / (np.linalg.norm(queries, axis=1, keepdims=True) + 1e-12)
+        return qn @ mn.T
+    if metric == "ip":
+        return queries @ matrix.T
+    sq_m = np.sum(matrix * matrix, axis=1)[None, :]
+    sq_q = np.sum(queries * queries, axis=1)[:, None]
+    return -(sq_q + sq_m - 2.0 * (queries @ matrix.T))
+
+
+def score_batch(matrix: np.ndarray, queries: np.ndarray, metric: str = "cos") -> np.ndarray:
+    """Scores [n_queries, n_docs]; larger = closer for every metric."""
+    if matrix.ndim != 2:
+        matrix = np.atleast_2d(matrix)
+    if queries.ndim != 2:
+        queries = np.atleast_2d(queries)
+    if not _HAVE_JAX or matrix.shape[0] < _JAX_MIN_ROWS:
+        return _score_numpy(
+            matrix.astype(np.float32), queries.astype(np.float32), metric
+        )
+    scores = _score_jax(jnp.asarray(matrix), jnp.asarray(queries), metric)
+    return np.asarray(scores)
+
+
+def topk_search(
+    matrix: np.ndarray, queries: np.ndarray, k: int, metric: str = "cos"
+) -> tuple[np.ndarray, np.ndarray]:
+    """(indices, scores) of the k best rows per query."""
+    n = matrix.shape[0]
+    k_eff = min(k, n)
+    if not _HAVE_JAX or n < _JAX_MIN_ROWS:
+        scores = _score_numpy(
+            matrix.astype(np.float32), queries.astype(np.float32), metric
+        )
+        idx = np.argsort(-scores, axis=1)[:, :k_eff]
+        return idx, np.take_along_axis(scores, idx, axis=1)
+    scores = _score_jax(jnp.asarray(matrix), jnp.asarray(queries), metric)
+    vals, idx = _topk_jax(scores, k_eff)
+    return np.asarray(idx), np.asarray(vals)

@@ -1,0 +1,23 @@
+"""stdlib: temporal, indexing, ml, graphs, stateful, statistical, ordered, utils."""
+
+from pathway_tpu.stdlib import (
+    graphs,
+    indexing,
+    ml,
+    ordered,
+    stateful,
+    statistical,
+    temporal,
+    utils,
+)
+
+__all__ = [
+    "graphs",
+    "indexing",
+    "ml",
+    "ordered",
+    "stateful",
+    "statistical",
+    "temporal",
+    "utils",
+]

@@ -1,0 +1,49 @@
+"""Bellman–Ford shortest paths (parity: stdlib/graphs/bellman_ford.py)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals.expression import ColumnReference
+from pathway_tpu.internals.iterate import iterate
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.thisclass import left as lp, right as rp, this
+
+
+def bellman_ford(vertices: Table, edges: Table, iteration_limit: int | None = None) -> Table:
+    """vertices: columns (is_source: bool); edges: (u, v, dist).
+
+    Returns dist_from_source per vertex id.
+    """
+    initial = vertices.select(
+        dist=expr_mod.if_else(this.is_source, 0.0, float("inf"))
+    )
+
+    def step(state: Table) -> dict:
+        relaxed = edges.join(
+            state, ColumnReference(lp, "u") == ColumnReference(rp, "id")
+        ).select(
+            v=ColumnReference(lp, "v"),
+            cand=ColumnReference(rp, "dist") + ColumnReference(lp, "dist"),
+        )
+        best = relaxed.groupby(this.v).reduce(
+            v=this.v, cand=reducers.min(this.cand)
+        )
+        keyed_best = best.with_id(ColumnReference(this, "v"))
+        new_state = state.join_left(
+            keyed_best, ColumnReference(lp, "id") == ColumnReference(rp, "id")
+        ).select(
+            dist=expr_mod.apply_with_type(
+                lambda d, c: d if c is None else min(d, c),
+                float,
+                ColumnReference(lp, "dist"),
+                ColumnReference(rp, "cand"),
+            ),
+        )
+        return dict(state=new_state)
+
+    result = iterate(lambda state: step(state), iteration_limit=iteration_limit, state=initial)
+    return result
+
+
+__all__ = ["bellman_ford"]

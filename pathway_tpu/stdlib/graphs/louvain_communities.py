@@ -1,0 +1,61 @@
+"""One level of Louvain community detection (parity: stdlib/graphs/louvain_communities.py).
+
+Simplified greedy modularity pass: each vertex adopts the community that the
+plurality of its neighbours hold, iterated to stability.
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals.expression import ColumnReference
+from pathway_tpu.internals.iterate import iterate
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.thisclass import left as lp, right as rp, this
+
+
+def louvain_level(edges: Table, iteration_limit: int = 10) -> Table:
+    """edges: (u, v) undirected; returns (v, community)."""
+    vertices = (
+        edges.select(v=this.u)
+        .concat_reindex(edges.select(v=this.v))
+        .groupby(this.v)
+        .reduce(v=this.v)
+    )
+    both_dirs = edges.select(u=this.u, v=this.v).concat_reindex(
+        edges.select(u=this.v, v=this.u)
+    )
+    initial = vertices.select(v=this.v, community=this.v)
+
+    def step(assign: Table) -> dict:
+        keyed = assign.with_id(ColumnReference(this, "v"))
+        neigh = both_dirs.join(
+            keyed, ColumnReference(lp, "v") == ColumnReference(rp, "v")
+        ).select(u=ColumnReference(lp, "u"), community=ColumnReference(rp, "community"))
+        votes = neigh.groupby(this.u, this.community).reduce(
+            u=this.u, community=this.community, n=reducers.count()
+        )
+        best = votes.groupby(this.u).reduce(
+            u=this.u,
+            best=reducers.argmax(this.n),
+        )
+        chosen = best.select(
+            u=this.u,
+            community=votes.ix(this.best).community,
+        )
+        keyed_chosen = chosen.with_id(ColumnReference(this, "u"))
+        new_assign = assign.join_left(
+            keyed_chosen,
+            ColumnReference(lp, "v") == ColumnReference(rp, "id"),
+        ).select(
+            v=ColumnReference(lp, "v"),
+            community=expr_mod.coalesce(
+                ColumnReference(rp, "community"), ColumnReference(lp, "community")
+            ),
+        )
+        return dict(assign=new_assign)
+
+    return iterate(lambda assign: step(assign), iteration_limit=iteration_limit, assign=initial)
+
+
+__all__ = ["louvain_level"]

@@ -1,0 +1,60 @@
+"""PageRank (parity: stdlib/graphs/pagerank.py) via pw.iterate."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import reducers
+from pathway_tpu.internals.expression import ColumnReference
+from pathway_tpu.internals.iterate import iterate
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.thisclass import this
+
+
+def pagerank(edges: Table, steps: int = 5, damping: int = 85) -> Table:
+    """Integer-arithmetic pagerank over an edge table (columns u, v)."""
+    # out-degrees
+    degrees = edges.groupby(this.u).reduce(u=this.u, degree=reducers.count())
+    vertices = (
+        edges.select(v=this.u)
+        .concat_reindex(edges.select(v=this.v))
+        .groupby(this.v)
+        .reduce(v=this.v)
+    )
+
+    def one_step(ranks: Table) -> dict:
+        # flow along edges: each u sends rank/degree to each v
+        from pathway_tpu.internals.thisclass import left as lp, right as rp
+        import pathway_tpu.internals.expression as expr_mod
+
+        with_deg = edges.join(
+            degrees, ColumnReference(lp, "u") == ColumnReference(rp, "u")
+        ).select(
+            u=ColumnReference(lp, "u"),
+            v=ColumnReference(lp, "v"),
+            degree=ColumnReference(rp, "degree"),
+        )
+        with_rank = with_deg.join(
+            ranks, ColumnReference(lp, "u") == ColumnReference(rp, "v")
+        ).select(
+            v=ColumnReference(lp, "v"),
+            flow=ColumnReference(rp, "rank") // ColumnReference(lp, "degree"),
+        )
+        inflow = with_rank.groupby(this.v).reduce(
+            v=this.v, total=reducers.sum(this.flow)
+        )
+        new_ranks = vertices.join_left(
+            inflow, ColumnReference(lp, "v") == ColumnReference(rp, "v")
+        ).select(
+            v=ColumnReference(lp, "v"),
+            rank=(100 - damping)
+            + (damping * expr_mod.coalesce(ColumnReference(rp, "total"), 0)) // 100,
+        )
+        return dict(ranks=new_ranks)
+
+    initial = vertices.select(v=this.v, rank=100)
+    result = iterate(
+        lambda ranks: one_step(ranks), iteration_limit=steps, ranks=initial
+    )
+    return result
+
+
+__all__ = ["pagerank"]

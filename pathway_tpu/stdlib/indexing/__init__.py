@@ -1,0 +1,51 @@
+"""Index abstractions for retrieval (parity: stdlib/indexing/).
+
+``DataIndex`` + inner indexes: BruteForceKnn (device top-k via ops/topk),
+USearchKnn (HNSW-style host graph index), TantivyBM25 analog (host BM25),
+HybridIndex (reciprocal-rank fusion), LshKnn.
+"""
+
+from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    BruteForceKnnFactory,
+    LshKnn,
+    USearchKnn,
+    USearchKnnFactory,
+    DistanceMetric,
+)
+from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25, TantivyBM25Factory
+from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex, HybridIndexFactory
+from pathway_tpu.stdlib.indexing.vector_document_index import (
+    default_brute_force_knn_document_index,
+    default_lsh_knn_document_index,
+    default_usearch_knn_document_index,
+    default_vector_document_index,
+)
+from pathway_tpu.stdlib.indexing.retrievers import (
+    AbstractRetrieverFactory,
+    BruteForceKnnMetricKind,
+    USearchMetricKind,
+)
+
+__all__ = [
+    "DataIndex",
+    "InnerIndex",
+    "BruteForceKnn",
+    "BruteForceKnnFactory",
+    "LshKnn",
+    "USearchKnn",
+    "USearchKnnFactory",
+    "DistanceMetric",
+    "TantivyBM25",
+    "TantivyBM25Factory",
+    "HybridIndex",
+    "HybridIndexFactory",
+    "default_vector_document_index",
+    "default_brute_force_knn_document_index",
+    "default_lsh_knn_document_index",
+    "default_usearch_knn_document_index",
+    "AbstractRetrieverFactory",
+    "BruteForceKnnMetricKind",
+    "USearchMetricKind",
+]

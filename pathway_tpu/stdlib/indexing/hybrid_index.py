@@ -1,0 +1,62 @@
+"""Hybrid index — reciprocal-rank fusion (parity: stdlib/indexing/hybrid_index.py:14)."""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Any
+
+from pathway_tpu.stdlib.indexing.data_index import InnerIndex
+
+
+class _HybridEngineIndex:
+    def __init__(self, inner_indexes, k: float = 60.0):
+        self.inners = inner_indexes
+        self.k = k
+
+    def add(self, key: int, data, filter_data=None) -> None:
+        # data is a tuple: one entry per inner index
+        for inner, d in zip(self.inners, data):
+            inner.add(key, d, filter_data)
+
+    def remove(self, key: int) -> None:
+        for inner in self.inners:
+            inner.remove(key)
+
+    def search(self, query, k: int | None, filter_query=None):
+        if k is None:
+            k = 3
+        fused: dict[int, float] = defaultdict(float)
+        for inner, q in zip(self.inners, query):
+            results = inner.search(q, k * 3, filter_query)
+            for rank, (key, _score) in enumerate(results):
+                fused[key] += 1.0 / (self.k + rank + 1)
+        ranked = sorted(fused.items(), key=lambda e: -e[1])
+        return [(key, score) for key, score in ranked[:k]]
+
+
+class HybridIndex(InnerIndex):
+    """Fuses several inner indexes by reciprocal rank fusion.
+
+    The data/query columns must be tuples with one element per sub-index
+    (e.g. ``(embedding, text)`` for dense + BM25).
+    """
+
+    def __init__(self, inner_indexes: list[InnerIndex], *, k: float = 60.0):
+        # data_column: synthesized by callers combining sub-columns
+        super().__init__(inner_indexes[0].data_column, inner_indexes[0].metadata_column)
+        self.inner_indexes = inner_indexes
+        self.k = k
+
+    def factory(self):
+        factories = [ix.factory() for ix in self.inner_indexes]
+        k = self.k
+
+        class _F:
+            @staticmethod
+            def build():
+                return _HybridEngineIndex([f.build() for f in factories], k)
+
+        return _F()
+
+
+HybridIndexFactory = HybridIndex

@@ -1,0 +1,23 @@
+"""Retriever factory enums/abstracts (parity: stdlib/indexing/retrievers.py)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class USearchMetricKind(enum.Enum):
+    # mirrors usearch MetricKind (usearch_integration.rs)
+    COS = "cos"
+    L2SQ = "l2sq"
+    IP = "ip"
+
+
+class BruteForceKnnMetricKind(enum.Enum):
+    # mirrors brute_force_knn_integration.rs metric kinds
+    COS = "cos"
+    L2SQ = "l2sq"
+
+
+class AbstractRetrieverFactory:
+    def build_index(self, data_column, data_table, metadata_column=None):
+        raise NotImplementedError

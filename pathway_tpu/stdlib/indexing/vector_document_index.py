@@ -1,0 +1,84 @@
+"""Document-index factory helpers (parity:
+stdlib/indexing/vector_document_index.py:34-157)."""
+
+from __future__ import annotations
+
+from pathway_tpu.internals.expression import ColumnReference
+from pathway_tpu.internals.table import Table
+from pathway_tpu.stdlib.indexing.data_index import DataIndex
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnn,
+    DistanceMetric,
+    LshKnn,
+    USearchKnn,
+)
+
+
+def default_vector_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    embedder=None,
+    dimensions: int | None = None,
+    metadata_column: ColumnReference | None = None,
+) -> DataIndex:
+    return default_usearch_knn_document_index(
+        data_column,
+        data_table,
+        embedder=embedder,
+        dimensions=dimensions,
+        metadata_column=metadata_column,
+    )
+
+
+def default_usearch_knn_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    embedder=None,
+    dimensions: int | None = None,
+    metadata_column: ColumnReference | None = None,
+) -> DataIndex:
+    inner = USearchKnn(
+        data_column,
+        metadata_column,
+        dimensions=dimensions,
+        metric=DistanceMetric.COS,
+        embedder=embedder,
+    )
+    return DataIndex(data_table, inner)
+
+
+def default_brute_force_knn_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    embedder=None,
+    dimensions: int | None = None,
+    metadata_column: ColumnReference | None = None,
+) -> DataIndex:
+    inner = BruteForceKnn(
+        data_column,
+        metadata_column,
+        dimensions=dimensions,
+        metric=DistanceMetric.COS,
+        embedder=embedder,
+    )
+    return DataIndex(data_table, inner)
+
+
+def default_lsh_knn_document_index(
+    data_column: ColumnReference,
+    data_table: Table,
+    *,
+    embedder=None,
+    dimensions: int,
+    metadata_column: ColumnReference | None = None,
+) -> DataIndex:
+    inner = LshKnn(
+        data_column,
+        metadata_column,
+        dimensions=dimensions,
+        embedder=embedder,
+    )
+    return DataIndex(data_table, inner)

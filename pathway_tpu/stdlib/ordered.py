@@ -1,0 +1,31 @@
+"""Ordered-table helpers (parity: stdlib/ordered/diff).
+
+``pw.Table.diff`` — difference between a row and the previous row in the
+order given by ``timestamp``, computed via the engine's sort (prev/next)
+operator.
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals.expression import ColumnReference
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.thisclass import this
+
+
+def diff(table: Table, timestamp, *values, instance=None) -> Table:
+    sorted_t = table.sort(key=timestamp, instance=instance)
+    exprs = {}
+    for v in values:
+        name = v.name if isinstance(v, ColumnReference) else str(v)
+        prev_view = table.ix(sorted_t.prev, optional=True)
+        exprs["diff_" + name] = expr_mod.if_else(
+            getattr(prev_view, name).is_none() if hasattr(prev_view, name) else expr_mod.ColumnConstExpression(True),
+            expr_mod.ColumnConstExpression(None),
+            getattr(this, name) - getattr(prev_view, name),
+        )
+    out = table.with_columns(**exprs)
+    return out
+
+
+__all__ = ["diff"]

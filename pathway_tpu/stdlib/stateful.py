@@ -1,0 +1,25 @@
+"""Stateful helpers (parity: stdlib/stateful: deduplicate)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from pathway_tpu.internals.table import Table
+
+
+def deduplicate(
+    table: Table,
+    *,
+    value,
+    instance=None,
+    acceptor: Callable[[Any, Any], bool],
+    persistent_id: str | None = None,
+    name: str | None = None,
+) -> Table:
+    """Keep one row per instance; replace when acceptor(new, old) is True."""
+    return table.deduplicate(
+        value=value, instance=instance, acceptor=acceptor, persistent_id=persistent_id, name=name
+    )
+
+
+__all__ = ["deduplicate"]

@@ -1,0 +1,325 @@
+"""Windows + ``windowby`` (parity: stdlib/temporal/_window.py:588-855).
+
+Window assignment is a flatten (each row → its window instances) followed by
+an incremental groupby on ``(instance, window_start, window_end)``; session
+windows merge chains of rows within ``max_gap`` per instance (recomputed per
+touched instance per epoch — the reference's session logic in
+``time_column.rs`` is likewise instance-scoped).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+from typing import Any, Callable
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals.expression import ApplyExpression, ColumnReference
+from pathway_tpu.internals.table import GroupedTable, Table
+from pathway_tpu.internals.thisclass import this
+from pathway_tpu.stdlib.temporal.temporal_behavior import (
+    Behavior,
+    CommonBehavior,
+    ExactlyOnceBehavior,
+)
+
+
+class Window:
+    def _assign(self, t: Any) -> list[tuple[Any, Any]]:
+        """Return the list of (window_start, window_end) containing time t."""
+        raise NotImplementedError
+
+
+def _zero_like(duration):
+    if isinstance(duration, datetime.timedelta):
+        return datetime.timedelta(0)
+    return 0
+
+
+@dataclasses.dataclass(frozen=True)
+class TumblingWindow(Window):
+    duration: Any
+    origin: Any = None
+    shift: Any = None
+
+    def _assign(self, t):
+        origin = self.origin
+        if origin is None:
+            origin = _zero_like(self.duration) if not isinstance(t, datetime.datetime) else datetime.datetime(1970, 1, 1, tzinfo=t.tzinfo)
+        offset = t - origin
+        n = offset // self.duration
+        start = origin + n * self.duration
+        if start > t:  # floor for negatives with timedelta arithmetic
+            start = start - self.duration
+        return [(start, start + self.duration)]
+
+
+@dataclasses.dataclass(frozen=True)
+class SlidingWindow(Window):
+    hop: Any
+    duration: Any
+    origin: Any = None
+
+    def _assign(self, t):
+        origin = self.origin
+        if origin is None:
+            origin = _zero_like(self.hop) if not isinstance(t, datetime.datetime) else datetime.datetime(1970, 1, 1, tzinfo=t.tzinfo)
+        out = []
+        # windows [origin + k*hop, origin + k*hop + duration) containing t
+        offset = t - origin
+        k_max = offset // self.hop
+        k = k_max
+        while True:
+            start = origin + k * self.hop
+            if start > t:
+                k -= 1
+                continue
+            if start + self.duration <= t:
+                break
+            out.append((start, start + self.duration))
+            k -= 1
+        out.reverse()
+        return out
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionWindow(Window):
+    predicate: Callable[[Any, Any], bool] | None = None
+    max_gap: Any = None
+
+    def merges(self, a, b) -> bool:
+        if self.predicate is not None:
+            return bool(self.predicate(a, b))
+        return (b - a) <= self.max_gap
+
+
+@dataclasses.dataclass(frozen=True)
+class IntervalsOverWindow(Window):
+    at: Any  # ColumnReference into a times table
+    lower_bound: Any = None
+    upper_bound: Any = None
+    is_outer: bool = True
+
+
+def tumbling(duration, origin=None, shift=None) -> TumblingWindow:
+    if shift is not None:
+        return SlidingWindow(hop=shift, duration=duration, origin=origin)
+    return TumblingWindow(duration=duration, origin=origin)
+
+
+def sliding(hop, duration=None, ratio=None, origin=None) -> SlidingWindow:
+    if duration is None and ratio is not None:
+        duration = hop * ratio
+    return SlidingWindow(hop=hop, duration=duration, origin=origin)
+
+
+def session(*, predicate=None, max_gap=None) -> SessionWindow:
+    if (predicate is None) == (max_gap is None):
+        raise ValueError("session window needs exactly one of predicate/max_gap")
+    return SessionWindow(predicate=predicate, max_gap=max_gap)
+
+
+def intervals_over(*, at, lower_bound=None, upper_bound=None, is_outer: bool = True) -> IntervalsOverWindow:
+    return IntervalsOverWindow(at, lower_bound, upper_bound, is_outer)
+
+
+class WindowGroupedTable:
+    """Result of windowby; reduce() closes over (instance, start, end) groups."""
+
+    def __init__(self, assigned: Table, has_instance: bool):
+        self._assigned = assigned
+        self._has_instance = has_instance
+
+    def reduce(self, *args, **kwargs) -> Table:
+        grouping = [
+            ColumnReference(this, "_pw_window"),
+            ColumnReference(this, "_pw_window_start"),
+            ColumnReference(this, "_pw_window_end"),
+        ]
+        if self._has_instance:
+            grouping.append(ColumnReference(this, "_pw_instance"))
+        return self._assigned.groupby(*grouping).reduce(*args, **kwargs)
+
+
+def windowby(
+    table: Table,
+    time_expr,
+    *,
+    window: Window,
+    behavior: Behavior | None = None,
+    instance=None,
+    origin=None,
+) -> WindowGroupedTable:
+    if isinstance(window, SessionWindow):
+        assigned = _assign_sessions(table, time_expr, window, instance)
+        if behavior is not None:
+            assigned = _apply_behavior(assigned, behavior)
+    elif isinstance(window, IntervalsOverWindow):
+        assigned = _assign_intervals_over(table, time_expr, window, instance)
+        if behavior is not None:
+            assigned = _apply_behavior(assigned, behavior)
+    else:
+        win = window
+
+        def windows_of(t):
+            if t is None:
+                return ()
+            return tuple(
+                (s, e) for (s, e) in win._assign(t)
+            )
+
+        with_windows = table.with_columns(
+            _pw_windows=ApplyExpression(windows_of, None, time_expr),
+            _pw_time=time_expr,
+        )
+        if instance is not None:
+            with_windows = with_windows.with_columns(_pw_instance=instance)
+        flat = with_windows.flatten(ColumnReference(this, "_pw_windows"))
+        assigned = flat.with_columns(
+            _pw_window=ColumnReference(this, "_pw_windows"),
+            _pw_window_start=ApplyExpression(
+                lambda w: w[0], None, ColumnReference(this, "_pw_windows")
+            ),
+            _pw_window_end=ApplyExpression(
+                lambda w: w[1], None, ColumnReference(this, "_pw_windows")
+            ),
+        )
+        if behavior is not None:
+            assigned = _apply_behavior(assigned, behavior)
+    return WindowGroupedTable(assigned, has_instance=instance is not None)
+
+
+def _apply_behavior(assigned: Table, behavior: Behavior) -> Table:
+    time_col = ColumnReference(this, "_pw_time")
+    if isinstance(behavior, CommonBehavior):
+        t = assigned
+        if behavior.delay is not None:
+            t = t._buffer(time_col + behavior.delay, time_col)
+        if behavior.cutoff is not None:
+            end_col = ColumnReference(this, "_pw_window_end")
+            t = t._freeze(end_col + behavior.cutoff, time_col)
+        return t
+    if isinstance(behavior, ExactlyOnceBehavior):
+        end_col = ColumnReference(this, "_pw_window_end")
+        shift = behavior.shift
+        thr = end_col + shift if shift is not None else end_col
+        t = assigned._buffer(thr, time_col)
+        t = t._freeze(thr, time_col)
+        return t
+    return assigned
+
+
+def _assign_sessions(table: Table, time_expr, window: SessionWindow, instance) -> Table:
+    """Sessionization: group rows per instance, merge chains via the window
+    predicate, emit (start, end) per session.  Incremental at instance
+    granularity via groupby+sorted_tuple then flatten."""
+    from pathway_tpu.internals import reducers
+
+    base = table.with_columns(_pw_time=time_expr)
+    if instance is not None:
+        base = base.with_columns(_pw_instance=instance)
+    else:
+        base = base.with_columns(_pw_instance=expr_mod.ColumnConstExpression(0))
+
+    win = window
+
+    def sessions_of(times_tuple):
+        times = sorted(times_tuple)
+        out = []
+        cur_start = None
+        prev = None
+        for t in times:
+            if cur_start is None:
+                cur_start = t
+            elif not win.merges(prev, t):
+                out.append((cur_start, prev))
+                cur_start = t
+            prev = t
+        if cur_start is not None:
+            out.append((cur_start, prev))
+        return tuple(out)
+
+    # session boundaries per instance
+    sessions = base.groupby(ColumnReference(this, "_pw_instance")).reduce(
+        _pw_instance=ColumnReference(this, "_pw_instance"),
+        _pw_sessions=ApplyExpression(
+            sessions_of, None, reducers.sorted_tuple(ColumnReference(this, "_pw_time"))
+        ),
+    )
+    sess_flat = sessions.flatten(ColumnReference(this, "_pw_sessions"))
+    sess_flat = sess_flat.with_columns(
+        _pw_window_start=ApplyExpression(
+            lambda w: w[0], None, ColumnReference(this, "_pw_sessions")
+        ),
+        _pw_window_end=ApplyExpression(
+            lambda w: w[1], None, ColumnReference(this, "_pw_sessions")
+        ),
+    )
+    # join rows back onto their session: time in [start, end]
+    from pathway_tpu.internals.thisclass import left as left_ph, right as right_ph
+
+    jr = base.join(
+        sess_flat,
+        expr_mod.ColumnBinaryOpExpression(
+            "==",
+            ColumnReference(left_ph, "_pw_instance"),
+            ColumnReference(right_ph, "_pw_instance"),
+        ),
+    )
+    cols = {n: ColumnReference(left_ph, n) for n in table.column_names()}
+    cols["_pw_time"] = ColumnReference(left_ph, "_pw_time")
+    cols["_pw_instance"] = ColumnReference(left_ph, "_pw_instance")
+    cols["_pw_window_start"] = ColumnReference(right_ph, "_pw_window_start")
+    cols["_pw_window_end"] = ColumnReference(right_ph, "_pw_window_end")
+    cols["_pw_window"] = expr_mod.make_tuple(
+        ColumnReference(right_ph, "_pw_window_start"),
+        ColumnReference(right_ph, "_pw_window_end"),
+    )
+    joined = jr.select(**cols)
+    return joined.filter(
+        (ColumnReference(this, "_pw_time") >= ColumnReference(this, "_pw_window_start"))
+        & (ColumnReference(this, "_pw_time") <= ColumnReference(this, "_pw_window_end"))
+    )
+
+
+def _assign_intervals_over(table: Table, time_expr, window: IntervalsOverWindow, instance) -> Table:
+    """intervals_over: windows centered at each value of ``window.at``."""
+    from pathway_tpu.internals.thisclass import left as left_ph, right as right_ph
+
+    at_ref = window.at  # ColumnReference on the times table
+    times_table = at_ref.table.select(_pw_at=at_ref)
+    base = table.with_columns(_pw_time=time_expr)
+    if instance is not None:
+        base = base.with_columns(_pw_instance=instance)
+    else:
+        base = base.with_columns(_pw_instance=expr_mod.ColumnConstExpression(0))
+    # cross join rows x window anchors (filtered by interval containment)
+    jr = base.join(
+        times_table,
+        expr_mod.ColumnBinaryOpExpression(
+            "==",
+            expr_mod.ColumnConstExpression(0),
+            expr_mod.ColumnConstExpression(0),
+        ),
+    )
+    lb, ub = window.lower_bound, window.upper_bound
+    cols = {n: ColumnReference(left_ph, n) for n in table.column_names()}
+    cols["_pw_time"] = ColumnReference(left_ph, "_pw_time")
+    cols["_pw_instance"] = ColumnReference(left_ph, "_pw_instance")
+    cols["_pw_window_start"] = (
+        ColumnReference(right_ph, "_pw_at") + lb
+        if lb is not None
+        else ColumnReference(right_ph, "_pw_at")
+    )
+    cols["_pw_window_end"] = (
+        ColumnReference(right_ph, "_pw_at") + ub
+        if ub is not None
+        else ColumnReference(right_ph, "_pw_at")
+    )
+    cols["_pw_window"] = ColumnReference(right_ph, "_pw_at")
+    joined = jr.select(**cols)
+    return joined.filter(
+        (ColumnReference(this, "_pw_time") >= ColumnReference(this, "_pw_window_start"))
+        & (ColumnReference(this, "_pw_time") <= ColumnReference(this, "_pw_window_end"))
+    )

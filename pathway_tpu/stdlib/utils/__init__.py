@@ -1,0 +1,16 @@
+"""stdlib.utils (parity: stdlib/utils/): col helpers, filtering, bucketing,
+AsyncTransformer, pandas_transformer."""
+
+from pathway_tpu.stdlib.utils.col import unpack_col, flatten_column
+from pathway_tpu.stdlib.utils.filtering import argmax_rows, argmin_rows
+from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
+from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer
+
+__all__ = [
+    "unpack_col",
+    "flatten_column",
+    "argmax_rows",
+    "argmin_rows",
+    "AsyncTransformer",
+    "pandas_transformer",
+]

@@ -1,0 +1,27 @@
+"""Test configuration: force a deterministic 8-device CPU mesh.
+
+Multi-chip sharding tests run on virtual CPU devices
+(xla_force_host_platform_device_count), the same trick the driver's
+dryrun_multichip uses; bench.py (not pytest) uses the real TPU chip.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clear_graph():
+    """Each test gets a fresh global graph (reference tests do G.clear())."""
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    yield
+    G.clear()

@@ -1,0 +1,195 @@
+"""Groupby/reduce and join tests (model: reference test_joins.py etc.)."""
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, assert_table_equality_wo_index
+
+
+def test_groupby_basic_reducers():
+    t = T(
+        """
+        g | v
+        a | 1
+        a | 3
+        b | 5
+        """
+    )
+    res = t.groupby(pw.this.g).reduce(
+        g=pw.this.g,
+        s=pw.reducers.sum(pw.this.v),
+        c=pw.reducers.count(),
+        mn=pw.reducers.min(pw.this.v),
+        mx=pw.reducers.max(pw.this.v),
+        av=pw.reducers.avg(pw.this.v),
+    )
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            g | s | c | mn | mx | av
+            a | 4 | 2 | 1  | 3  | 2.0
+            b | 5 | 1 | 5  | 5  | 5.0
+            """
+        ),
+    )
+
+
+def test_groupby_expression_over_reducers():
+    t = T("g | v\na | 1\na | 3")
+    res = t.groupby(pw.this.g).reduce(
+        g=pw.this.g, double_sum=pw.reducers.sum(pw.this.v) * 2
+    )
+    assert_table_equality_wo_index(res, T("g | double_sum\na | 8"))
+
+
+def test_global_reduce():
+    t = T("v\n1\n2\n3")
+    res = t.reduce(total=pw.reducers.sum(pw.this.v))
+    assert_table_equality_wo_index(res, T("total\n6"))
+
+
+def test_groupby_tuple_reducers():
+    t = T("g | v\na | 3\na | 1")
+    res = t.groupby(pw.this.g).reduce(
+        g=pw.this.g,
+        st=pw.reducers.sorted_tuple(pw.this.v),
+    )
+    rows = list(pw.debug.table_to_dicts(res)[1]["st"].values())
+    assert rows == [(1, 3)]
+
+
+def test_argmin_argmax():
+    t = T(
+        """
+          | g | v
+        A | a | 5
+        B | a | 1
+        C | b | 7
+        """
+    )
+    res = t.groupby(pw.this.g).reduce(g=pw.this.g, am=pw.reducers.argmin(pw.this.v))
+    _, cols = pw.debug.table_to_dicts(res)
+    vals = set(map(repr, cols["am"].values()))
+    from pathway_tpu.engine.types import Pointer, hash_values
+
+    assert repr(Pointer(hash_values(["B"]))) in vals
+    assert repr(Pointer(hash_values(["C"]))) in vals
+
+
+def test_unique_and_any():
+    t = T("g | v\na | 1\na | 1\nb | 2")
+    res = t.groupby(pw.this.g).reduce(g=pw.this.g, u=pw.reducers.unique(pw.this.v))
+    assert_table_equality_wo_index(res, T("g | u\na | 1\nb | 2"))
+
+
+def test_stateful_single():
+    @pw.reducers.stateful_single
+    def running_max(state, value):
+        if state is None or value > state:
+            return value
+        return state
+
+    t = T("g | v\na | 1\na | 5\na | 3")
+    res = t.groupby(pw.this.g).reduce(g=pw.this.g, m=running_max(pw.this.v))
+    assert_table_equality_wo_index(res, T("g | m\na | 5"))
+
+
+def test_custom_accumulator():
+    class SumAcc(pw.BaseCustomAccumulator):
+        def __init__(self, v):
+            self.v = v
+
+        @classmethod
+        def from_row(cls, row):
+            return cls(row[0])
+
+        def update(self, other):
+            self.v += other.v
+
+        def compute_result(self) -> int:
+            return self.v
+
+    from pathway_tpu.internals.reducers import udf_reducer
+
+    acc = udf_reducer(SumAcc)
+    t = T("g | v\na | 1\na | 2")
+    res = t.groupby(pw.this.g).reduce(g=pw.this.g, s=acc(pw.this.v))
+    assert_table_equality_wo_index(res, T("g | s\na | 3"))
+
+
+def test_inner_join():
+    t1 = T("owner | pet\nAlice | dog\nBob | cat\nCarol | dog")
+    t2 = T("pet | sound\ndog | woof\ncat | meow")
+    j = t1.join(t2, pw.left.pet == pw.right.pet).select(pw.left.owner, pw.right.sound)
+    assert_table_equality_wo_index(
+        j, T("owner | sound\nAlice | woof\nBob | meow\nCarol | woof")
+    )
+
+
+def test_left_right_outer_join():
+    t1 = T("k | a\n1 | x\n2 | y")
+    t2 = T("k | b\n2 | p\n3 | q")
+    lj = t1.join_left(t2, pw.left.k == pw.right.k).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(lj, T("a | b\nx |\ny | p"))
+    rj = t1.join_right(t2, pw.left.k == pw.right.k).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(rj, T("a | b\ny | p\n  | q"))
+    oj = t1.join_outer(t2, pw.left.k == pw.right.k).select(pw.left.a, pw.right.b)
+    assert_table_equality_wo_index(oj, T("a | b\nx |\ny | p\n  | q"))
+
+
+def test_join_this_disambiguation():
+    t1 = T("k | a\n1 | x")
+    t2 = T("k | b\n1 | y")
+    j = t1.join(t2, pw.left.k == pw.right.k).select(pw.this.a, pw.this.b)
+    assert_table_equality_wo_index(j, T("a | b\nx | y"))
+
+
+def test_join_id_from_left():
+    t1 = T("  | k | a\nA | 1 | x")
+    t2 = T("k | b\n1 | y")
+    j = t1.join(t2, pw.left.k == pw.right.k, id=pw.left.id).select(
+        pw.left.a, pw.right.b
+    )
+    from tests.utils import assert_table_equality
+
+    assert_table_equality(j, T("  | a | b\nA | x | y"))
+
+
+def test_join_chained_filter_reduce():
+    t1 = T("k | v\n1 | 10\n1 | 20\n2 | 5")
+    t2 = T("k | w\n1 | 100\n2 | 200")
+    jr = t1.join(t2, pw.left.k == pw.right.k)
+    res = jr.select(pw.left.k, pw.left.v, pw.right.w).groupby(pw.this.k).reduce(
+        k=pw.this.k, total=pw.reducers.sum(pw.this.v)
+    )
+    assert_table_equality_wo_index(res, T("k | total\n1 | 30\n2 | 5"))
+
+
+def test_groupby_instance():
+    t = T("g | i | v\na | 1 | 2\na | 1 | 3\na | 2 | 4")
+    res = t.groupby(pw.this.g, instance=pw.this.i).reduce(
+        g=pw.this.g, s=pw.reducers.sum(pw.this.v)
+    )
+    assert_table_equality_wo_index(res, T("g | s\na | 5\na | 4"))
+
+
+def test_incremental_groupby_stream():
+    t = T(
+        """
+        g | v | _time | _diff
+        a | 1 | 2     | 1
+        a | 2 | 4     | 1
+        a | 1 | 6     | -1
+        """
+    )
+    res = t.groupby(pw.this.g).reduce(g=pw.this.g, s=pw.reducers.sum(pw.this.v))
+    from pathway_tpu.debug import _capture_table
+
+    cap = _capture_table(res)
+    # final state: sum = 2
+    final = list(cap.final_rows().values())
+    assert final == [("a", 2)]
+    # stream went through 1 → 3 → 2
+    sums = [r[1] for (_k, r, _t, d) in sorted(cap.deltas, key=lambda e: (e[2], e[3])) if d > 0]
+    assert sums == [1, 3, 2]

@@ -1,0 +1,246 @@
+"""Core Table API round-trip tests (model: reference test_common.py)."""
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, assert_table_equality, assert_table_equality_wo_index
+
+
+def test_select_arithmetic():
+    t = T(
+        """
+        a | b
+        1 | 2
+        3 | 4
+        """
+    )
+    res = t.select(s=pw.this.a + pw.this.b, d=pw.this.b - pw.this.a, p=pw.this.a * pw.this.b)
+    expected = T(
+        """
+        s | d | p
+        3 | 1 | 2
+        7 | 1 | 12
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_select_preserves_ids():
+    t = T(
+        """
+          | a
+        A | 1
+        B | 2
+        """
+    )
+    res = t.select(b=pw.this.a * 10)
+    expected = T(
+        """
+          | b
+        A | 10
+        B | 20
+        """
+    )
+    assert_table_equality(res, expected)
+
+
+def test_filter():
+    t = T(
+        """
+        v
+        1
+        2
+        3
+        4
+        """
+    )
+    res = t.filter(pw.this.v % 2 == 0)
+    assert_table_equality_wo_index(res, T("v\n2\n4"))
+
+
+def test_filter_boolean_ops():
+    t = T(
+        """
+        v
+        1
+        2
+        3
+        4
+        5
+        """
+    )
+    res = t.filter((pw.this.v > 1) & (pw.this.v < 5) & ~(pw.this.v == 3))
+    assert_table_equality_wo_index(res, T("v\n2\n4"))
+
+
+def test_with_columns_and_rename():
+    t = T("a | b\n1 | 2")
+    res = t.with_columns(c=pw.this.a + pw.this.b).rename_columns(total=pw.this.c)
+    assert res.column_names() == ["a", "b", "total"]
+    assert_table_equality_wo_index(res, T("a | b | total\n1 | 2 | 3"))
+
+
+def test_division_semantics():
+    t = T("a | b\n7 | 2")
+    res = t.select(
+        q=pw.this.a / pw.this.b,
+        fd=pw.this.a // pw.this.b,
+        m=pw.this.a % pw.this.b,
+    )
+    assert_table_equality_wo_index(res, T("q   | fd | m\n3.5 | 3  | 1"))
+
+
+def test_if_else_and_coalesce():
+    t = T(
+        """
+        a | b
+        1 | 5
+        2 |
+        """
+    )
+    res = t.select(
+        v=pw.if_else(pw.this.a > 1, pw.this.a * 100, pw.this.a),
+        c=pw.coalesce(pw.this.b, 0),
+    )
+    assert_table_equality_wo_index(res, T("v   | c\n1   | 5\n200 | 0"))
+
+
+def test_apply_and_udf():
+    @pw.udf
+    def double(x: int) -> int:
+        return 2 * x
+
+    t = T("a\n1\n2")
+    res = t.select(b=double(pw.this.a), c=pw.apply_with_type(lambda x: x + 1, int, pw.this.a))
+    assert_table_equality_wo_index(res, T("b | c\n2 | 2\n4 | 3"))
+
+
+def test_concat_and_update_rows():
+    t1 = T("  | v\nA | 1")
+    t2 = T("  | v\nB | 2")
+    assert_table_equality_wo_index(t1.concat(t2), T("v\n1\n2"))
+    t3 = T("  | v\nA | 9\nC | 3")
+    assert_table_equality_wo_index(t1.update_rows(t3), T("v\n9\n3"))
+
+
+def test_update_cells():
+    a = T(
+        """
+          | x | y
+        A | 1 | 10
+        B | 2 | 20
+        """
+    )
+    b = T("  | x\nA | 9")
+    assert_table_equality(
+        a.update_cells(b),
+        T(
+            """
+              | x | y
+            A | 9 | 10
+            B | 2 | 20
+            """
+        ),
+    )
+
+
+def test_intersect_difference_restrict():
+    big = T("  | v\nA | 1\nB | 2\nC | 3")
+    small = T("  | w\nB | 5")
+    assert_table_equality_wo_index(big.intersect(small), T("v\n2"))
+    assert_table_equality_wo_index(big.difference(small), T("v\n1\n3"))
+    assert_table_equality_wo_index(big.restrict(small), T("v\n2"))
+
+
+def test_flatten():
+    t = T("w\nab\ncd")
+    tup = t.select(c=pw.apply_with_type(lambda s: tuple(s), tuple, pw.this.w))
+    res = tup.flatten(tup.c)
+    assert_table_equality_wo_index(res, T("c\na\nb\nc\nd"))
+
+
+def test_with_id_from():
+    t = T("a | b\n1 | x\n2 | y")
+    res = t.with_id_from(pw.this.b)
+    assert_table_equality_wo_index(res, t.select(a=pw.this.a, b=pw.this.b))
+
+
+def test_ix_same_universe():
+    orders = T(
+        """
+        item  | qty
+        apple | 2
+        plum  | 5
+        """
+    )
+    prices = orders.select(price=pw.if_else(pw.this.item == "apple", 3, 7))
+    tot = orders.select(total=pw.this.qty * prices.price)
+    assert_table_equality_wo_index(tot, T("total\n6\n35"))
+
+
+def test_sort_prev_next():
+    t = T("v\n30\n10\n20")
+    s = t.sort(key=pw.this.v)
+    res = t.with_columns(prev_v=t.ix(s.prev, optional=True).v)
+    assert_table_equality_wo_index(
+        res,
+        T(
+            """
+            v  | prev_v
+            10 |
+            20 | 10
+            30 | 20
+            """
+        ),
+    )
+
+
+def test_deduplicate():
+    t = T(
+        """
+        v | _time
+        1 | 2
+        5 | 4
+        3 | 6
+        8 | 8
+        """
+    )
+    res = t.deduplicate(value=pw.this.v, acceptor=lambda new, old: new > old)
+    assert_table_equality_wo_index(res, T("v\n8"))
+
+
+def test_string_and_num_namespaces():
+    t = T("s | x\nAbc | -2.7")
+    res = t.select(
+        lo=pw.this.s.str.lower(),
+        ln=pw.this.s.str.len(),
+        ab=pw.this.x.num.abs(),
+    )
+    assert_table_equality_wo_index(res, T("lo | ln | ab\nabc | 3 | 2.7"))
+
+
+def test_sequence_get_and_make_tuple():
+    t = T("a | b\n1 | 2")
+    res = t.select(
+        t=pw.make_tuple(pw.this.a, pw.this.b),
+    ).select(first=pw.this.t[0], second=pw.this.t.get(1), missing=pw.this.t.get(5, -1))
+    assert_table_equality_wo_index(res, T("first | second | missing\n1 | 2 | -1"))
+
+
+def test_cast_and_unwrap():
+    t = T("a\n1\n")
+    res = t.select(f=pw.cast(float, pw.this.a))
+    assert_table_equality_wo_index(res, T("f\n1.0\n"))
+
+
+def test_error_value_propagation():
+    t = T("a | b\n1 | 0")
+    res = t.select(d=pw.fill_error(pw.this.a // pw.this.b, -1))
+    assert_table_equality_wo_index(res, T("d\n-1"))
+
+
+def test_split():
+    t = T("v\n1\n2\n3")
+    pos, neg = t.split(pw.this.v > 1)
+    assert_table_equality_wo_index(pos, T("v\n2\n3"))
+    assert_table_equality_wo_index(neg, T("v\n1"))

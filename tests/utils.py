@@ -1,0 +1,41 @@
+"""Test helpers mirroring the reference's tests/utils.py round-trip pattern
+(``T``, ``assert_table_equality``, ``assert_table_equality_wo_index``)."""
+
+from __future__ import annotations
+
+from collections import Counter
+
+import pathway_tpu as pw
+from pathway_tpu.debug import _capture_table, table_from_markdown
+
+T = table_from_markdown
+
+
+def _final(table: pw.Table) -> dict:
+    cap = _capture_table(table)
+    return cap.final_rows()
+
+
+def assert_table_equality(actual: pw.Table, expected: pw.Table) -> None:
+    """Equal rows AND equal row keys."""
+    a = _final(actual)
+    e = _final(expected)
+    assert a == e, f"tables differ:\n actual={a}\n expected={e}"
+
+
+def assert_table_equality_wo_index(actual: pw.Table, expected: pw.Table) -> None:
+    """Equal row multisets, ignoring keys."""
+    a = Counter(_final(actual).values())
+    e = Counter(_final(expected).values())
+    assert a == e, f"tables differ (wo index):\n actual={sorted(map(repr, a))}\n expected={sorted(map(repr, e))}"
+
+
+def assert_stream_equality(actual: pw.Table, expected_deltas: list) -> None:
+    cap = _capture_table(actual)
+    got = sorted((r, t, d) for (_k, r, t, d) in cap.deltas)
+    want = sorted(expected_deltas)
+    assert got == want, f"streams differ:\n got={got}\n want={want}"
+
+
+def run_all() -> None:
+    pw.run()
