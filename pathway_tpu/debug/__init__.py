@@ -50,9 +50,13 @@ def _rows_from_markdown(md: str) -> tuple[list[str], list[list[Any]]]:
     lines = [ln for ln in lines if ln.strip() and not set(ln.strip()) <= set("|-+: ")]
     header_line = lines[0]
     sep = "|" if "|" in header_line else None
+    single_col = sep is None and len(header_line.split()) == 1
     grid = []
     for ln in lines:
-        cells = [c.strip() for c in (ln.split(sep) if sep else ln.split())]
+        if single_col:
+            cells = [ln.strip()]  # one column: whole line is the cell
+        else:
+            cells = [c.strip() for c in (ln.split(sep) if sep else ln.split())]
         grid.append(cells)
     width = max(len(r) for r in grid)
     for r in grid:

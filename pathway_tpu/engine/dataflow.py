@@ -37,6 +37,7 @@ from pathway_tpu.engine.types import (
     Error,
     Pointer,
     Time,
+    as_hashable,
     hash_values,
 )
 
@@ -1093,7 +1094,7 @@ class AsyncValuesNode(Node):
                         )
                         values.append(ERROR)
                     else:
-                        values.append(res)
+                        values.append(as_hashable(res))
                 self._cache[(k, r)] = tuple(values)
         out = []
         for k, r, d in inserts:

@@ -201,6 +201,56 @@ def wrap_py_object(value: Any, *, serializer: Any = None) -> PyObjectWrapper:
     return PyObjectWrapper(value, serializer=serializer)
 
 
+class HashableNDArray(np.ndarray):
+    """ndarray view hashable/equatable by contents.
+
+    The reference's ``Value::IntArray/FloatArray`` are hashable by contents
+    (value.rs HashInto); engine state (consolidation counters, arrangement
+    keys) requires the same here.  ``==`` returns a bool (contents equal),
+    not an elementwise array — inside engine rows arrays are *values*.
+    Arithmetic and numpy ops still work (it is an ndarray view).
+    """
+
+    def __hash__(self):  # type: ignore[override]
+        return hash(
+            (
+                self.shape,
+                str(self.dtype),
+                hashlib.blake2b(
+                    np.ascontiguousarray(self).tobytes(), digest_size=8
+                ).digest(),
+            )
+        )
+
+    def __eq__(self, other):  # type: ignore[override]
+        # strict: dtype is part of identity, matching __hash__ (hash/eq
+        # contract) — dtype coercion normalizes values before they enter rows
+        if isinstance(other, np.ndarray):
+            return (
+                self.shape == other.shape
+                and self.dtype == other.dtype
+                and bool(np.array_equal(np.asarray(self), np.asarray(other)))
+            )
+        return NotImplemented
+
+    def __ne__(self, other):  # type: ignore[override]
+        res = self.__eq__(other)
+        if res is NotImplemented:
+            return res
+        return not res
+
+
+def as_hashable(value: Any) -> Any:
+    """Wrap ndarrays into the hashable view, recursing into tuples (idempotent)."""
+    if isinstance(value, np.ndarray) and not isinstance(value, HashableNDArray):
+        return value.view(HashableNDArray)
+    if isinstance(value, tuple) and any(
+        isinstance(v, (np.ndarray, tuple)) for v in value
+    ):
+        return tuple(as_hashable(v) for v in value)
+    return value
+
+
 # --- stable hashing / key derivation ----------------------------------------
 #
 # The reference derives keys with xxh3-128 over a serialized value sequence

@@ -17,6 +17,11 @@ import numpy as np
 
 from pathway_tpu.engine import types as _etypes
 
+try:  # resolved once; coerce() runs per cell and must not retry imports
+    import jax as _jax
+except ImportError:  # pragma: no cover
+    _jax = None
+
 
 class DType:
     """Base of all dtypes. Instances are immutable and hash-consed."""
@@ -456,6 +461,12 @@ def dtype_of_value(value: Any) -> DType:
 def coerce(value: Any, dtype: DType) -> Any:
     if value is None or isinstance(value, _etypes.Error):
         return value
+    if isinstance(value, (np.ndarray, tuple)):
+        value = _etypes.as_hashable(value)
+        if isinstance(value, _etypes.HashableNDArray):
+            return value
+    elif _jax is not None and isinstance(value, _jax.Array):
+        return _etypes.as_hashable(np.asarray(value))
     base = dtype.strip_optional()
     if base is FLOAT and isinstance(value, (int, np.integer)) and not isinstance(value, bool):
         return float(value)

@@ -1,0 +1,22 @@
+"""Native model zoo: Flax encoders for the LLM xpack's device path."""
+
+from pathway_tpu.models.encoder import (
+    CrossEncoder,
+    EncoderConfig,
+    SentenceEncoder,
+    config_for,
+    shared_cross_encoder,
+    shared_sentence_encoder,
+)
+from pathway_tpu.models.tokenizer import HashTokenizer, load_tokenizer
+
+__all__ = [
+    "CrossEncoder",
+    "EncoderConfig",
+    "SentenceEncoder",
+    "config_for",
+    "shared_cross_encoder",
+    "shared_sentence_encoder",
+    "HashTokenizer",
+    "load_tokenizer",
+]

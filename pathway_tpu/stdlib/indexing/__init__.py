@@ -1,21 +1,20 @@
 """Index abstractions for retrieval (parity: stdlib/indexing/).
 
 ``DataIndex`` + inner indexes: BruteForceKnn (device top-k via ops/topk),
-USearchKnn (HNSW-style host graph index), TantivyBM25 analog (host BM25),
-HybridIndex (reciprocal-rank fusion), LshKnn.
+USearchKnn (API parity with the reference's HNSW index), TantivyBM25 analog
+(host BM25), HybridIndex (reciprocal-rank fusion), LshKnn; retriever
+factories for DocumentStore wiring.
 """
 
 from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndex
 from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     BruteForceKnn,
-    BruteForceKnnFactory,
+    DistanceMetric,
     LshKnn,
     USearchKnn,
-    USearchKnnFactory,
-    DistanceMetric,
 )
-from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25, TantivyBM25Factory
-from pathway_tpu.stdlib.indexing.hybrid_index import HybridIndex, HybridIndexFactory
+from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25
+from pathway_tpu.stdlib.indexing.hybrid_index import HybridDataIndex, HybridIndex
 from pathway_tpu.stdlib.indexing.vector_document_index import (
     default_brute_force_knn_document_index,
     default_lsh_knn_document_index,
@@ -24,28 +23,33 @@ from pathway_tpu.stdlib.indexing.vector_document_index import (
 )
 from pathway_tpu.stdlib.indexing.retrievers import (
     AbstractRetrieverFactory,
+    BruteForceKnnFactory,
     BruteForceKnnMetricKind,
+    HybridIndexFactory,
+    TantivyBM25Factory,
     USearchMetricKind,
+    UsearchKnnFactory,
 )
 
 __all__ = [
     "DataIndex",
     "InnerIndex",
     "BruteForceKnn",
-    "BruteForceKnnFactory",
     "LshKnn",
     "USearchKnn",
-    "USearchKnnFactory",
     "DistanceMetric",
     "TantivyBM25",
-    "TantivyBM25Factory",
     "HybridIndex",
-    "HybridIndexFactory",
+    "HybridDataIndex",
     "default_vector_document_index",
     "default_brute_force_knn_document_index",
     "default_lsh_knn_document_index",
     "default_usearch_knn_document_index",
     "AbstractRetrieverFactory",
+    "BruteForceKnnFactory",
     "BruteForceKnnMetricKind",
+    "HybridIndexFactory",
+    "TantivyBM25Factory",
     "USearchMetricKind",
+    "UsearchKnnFactory",
 ]

@@ -111,5 +111,3 @@ class TantivyBM25(InnerIndex):
 
         return _F()
 
-
-TantivyBM25Factory = TantivyBM25

@@ -34,6 +34,14 @@ class InnerIndex:
         """Optionally turn a raw query column into the index's vector space."""
         return column
 
+    def data_expr(self, index_column):
+        """Expression producing what the engine index stores per data row
+        (embeds the data column when an embedder is attached)."""
+        embedder = getattr(self, "embedder", None)
+        if embedder is not None:
+            return embedder(index_column)
+        return index_column
+
 
 class DataIndex:
     """Index over ``data_table`` with query methods returning result tables."""
@@ -81,6 +89,11 @@ class DataIndex:
             q_col = ColumnReference(query_table, "_pw_q_embedded")
         else:
             q_col = query_column
+        data_expr = self.inner_index.data_expr(index_col)
+        if data_expr is not index_col:
+            # embed the data column device-side before it enters the index
+            data_table = data_table.with_columns(_pw_data_prepared=data_expr)
+            index_col = ColumnReference(data_table, "_pw_data_prepared")
         replies = data_table._external_index_as_of_now(
             self.inner_index.factory(),
             query_table,
@@ -92,7 +105,9 @@ class DataIndex:
         )
         # replies: universe of query_table; _pw_index_reply = sorted tuple of
         # (Pointer, score)
-        data_names = list(data_table.column_names())
+        data_names = [
+            n for n in data_table.column_names() if not n.startswith("_pw_")
+        ]
 
         ranked = replies.with_columns(
             _pw_ranked=ApplyExpression(
@@ -141,8 +156,8 @@ class DataIndex:
                 continue
             final[n] = ColumnReference(this, n)
         for n in data_names:
-            final[n] = expr_mod.coalesce(getattr(cview, n), ())
+            final[n] = expr_mod.coalesce(cview[n], ())
         final["_pw_index_reply_score"] = expr_mod.coalesce(
-            getattr(cview, "_pw_index_reply_score"), ()
+            cview["_pw_index_reply_score"], ()
         )
         return query_table.select(**final)

@@ -37,12 +37,12 @@ class _HybridEngineIndex:
 class HybridIndex(InnerIndex):
     """Fuses several inner indexes by reciprocal rank fusion.
 
-    The data/query columns must be tuples with one element per sub-index
-    (e.g. ``(embedding, text)`` for dense + BM25).
+    The engine-side data/query payloads are tuples with one element per
+    sub-index (e.g. ``(embedding, text)`` for dense + BM25); ``embed`` and
+    ``data_expr`` synthesize those tuples from each child's preparation.
     """
 
     def __init__(self, inner_indexes: list[InnerIndex], *, k: float = 60.0):
-        # data_column: synthesized by callers combining sub-columns
         super().__init__(inner_indexes[0].data_column, inner_indexes[0].metadata_column)
         self.inner_indexes = inner_indexes
         self.k = k
@@ -57,6 +57,28 @@ class HybridIndex(InnerIndex):
                 return _HybridEngineIndex([f.build() for f in factories], k)
 
         return _F()
+
+    def embed(self, column):
+        from pathway_tpu.internals.expression import make_tuple
+
+        return make_tuple(*[ix.embed(column) for ix in self.inner_indexes])
+
+    def data_expr(self, index_column):
+        from pathway_tpu.internals.expression import make_tuple
+
+        return make_tuple(
+            *[ix.data_expr(index_column) for ix in self.inner_indexes]
+        )
+
+
+class HybridDataIndex:
+    """Table-level hybrid index fusing several DataIndexes (RRF)."""
+
+    def __new__(cls, data_table, data_indexes, *, k: float = 60.0):
+        from pathway_tpu.stdlib.indexing.data_index import DataIndex
+
+        inners = [di.inner_index for di in data_indexes]
+        return DataIndex(data_table, HybridIndex(inners, k=k))
 
 
 HybridIndexFactory = HybridIndex

@@ -188,8 +188,6 @@ class USearchKnn(BruteForceKnn):
         self.expansion_search = expansion_search
 
 
-USearchKnnFactory = USearchKnn
-BruteForceKnnFactory = BruteForceKnn
 
 
 class LshKnnIndex:

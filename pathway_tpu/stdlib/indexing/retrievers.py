@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import dataclasses
 import enum
 
 
@@ -21,3 +22,104 @@ class BruteForceKnnMetricKind(enum.Enum):
 class AbstractRetrieverFactory:
     def build_index(self, data_column, data_table, metadata_column=None):
         raise NotImplementedError
+
+
+@dataclasses.dataclass
+class BruteForceKnnFactory(AbstractRetrieverFactory):
+    """Factory for the dense device-backed index (parity: retrievers.py)."""
+
+    dimensions: int | None = None
+    reserved_space: int = 0
+    embedder: object | None = None
+    metric: "BruteForceKnnMetricKind" = None  # type: ignore[assignment]
+
+    def build_index(self, data_column, data_table, metadata_column=None):
+        from pathway_tpu.stdlib.indexing.data_index import DataIndex
+        from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+            BruteForceKnn,
+            DistanceMetric,
+        )
+
+        metric = self.metric or BruteForceKnnMetricKind.COS
+        inner = BruteForceKnn(
+            data_column,
+            metadata_column,
+            dimensions=self.dimensions,
+            reserved_space=self.reserved_space,
+            metric=DistanceMetric(metric.value),
+            embedder=self.embedder,
+        )
+        return DataIndex(data_table, inner)
+
+
+@dataclasses.dataclass
+class UsearchKnnFactory(AbstractRetrieverFactory):
+    """Factory keeping USearch HNSW API parity (shares the dense backend)."""
+
+    dimensions: int | None = None
+    reserved_space: int = 0
+    embedder: object | None = None
+    metric: "USearchMetricKind" = None  # type: ignore[assignment]
+    connectivity: int = 0
+    expansion_add: int = 0
+    expansion_search: int = 0
+
+    def build_index(self, data_column, data_table, metadata_column=None):
+        from pathway_tpu.stdlib.indexing.data_index import DataIndex
+        from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+            DistanceMetric,
+            USearchKnn,
+        )
+
+        metric = self.metric or USearchMetricKind.COS
+        inner = USearchKnn(
+            data_column,
+            metadata_column,
+            dimensions=self.dimensions,
+            reserved_space=self.reserved_space,
+            metric=DistanceMetric(metric.value),
+            connectivity=self.connectivity,
+            expansion_add=self.expansion_add,
+            expansion_search=self.expansion_search,
+            embedder=self.embedder,
+        )
+        return DataIndex(data_table, inner)
+
+
+@dataclasses.dataclass
+class TantivyBM25Factory(AbstractRetrieverFactory):
+    """Factory for the BM25 full-text index."""
+
+    ram_budget: int = 50_000_000
+    in_memory_index: bool = True
+
+    def build_index(self, data_column, data_table, metadata_column=None):
+        from pathway_tpu.stdlib.indexing.bm25 import TantivyBM25
+        from pathway_tpu.stdlib.indexing.data_index import DataIndex
+
+        inner = TantivyBM25(
+            data_column,
+            metadata_column,
+            ram_budget=self.ram_budget,
+            in_memory_index=self.in_memory_index,
+        )
+        return DataIndex(data_table, inner)
+
+
+@dataclasses.dataclass
+class HybridIndexFactory(AbstractRetrieverFactory):
+    """Reciprocal-rank fusion over several retriever factories."""
+
+    retriever_factories: list = None  # type: ignore[assignment]
+    k: float = 60.0
+
+    def build_index(self, data_column, data_table, metadata_column=None):
+        from pathway_tpu.stdlib.indexing.data_index import DataIndex
+        from pathway_tpu.stdlib.indexing.hybrid_index import HybridDataIndex
+
+        indexes = [
+            f.build_index(data_column, data_table, metadata_column)
+            for f in self.retriever_factories
+        ]
+        return HybridDataIndex(data_table, indexes, k=self.k)
+

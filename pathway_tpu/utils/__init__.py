@@ -1,0 +1,5 @@
+"""Host-side utilities: micro-batching for the device bridge."""
+
+from pathway_tpu.utils.batching import AsyncMicroBatcher
+
+__all__ = ["AsyncMicroBatcher"]

@@ -1,0 +1,82 @@
+"""Async micro-batching: the streaming→device bridge.
+
+The north star's key mechanism (BASELINE.json): "the Python-UDF bridge
+batches row-deltas coming out of the dataflow into fixed-shape device
+arrays so embed/rerank calls hit a warm XLA cache."  Embedder/reranker UDFs
+are *async*: the engine's AsyncValuesNode launches one coroutine per row of
+an epoch concurrently (§3.3 semantics), and this batcher coalesces all
+concurrently-pending requests into large device batches.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Callable, Sequence
+
+
+class AsyncMicroBatcher:
+    """Coalesces concurrent async submissions into batched process calls.
+
+    ``process_batch(items) -> results`` runs synchronously (typically a jit
+    call).  Per-event-loop state: the engine may run each epoch under a fresh
+    asyncio loop.
+    """
+
+    def __init__(
+        self,
+        process_batch: Callable[[list], Sequence],
+        max_batch_size: int = 256,
+        flush_delay: float = 0.002,
+    ):
+        self.process_batch = process_batch
+        self.max_batch_size = max_batch_size
+        self.flush_delay = flush_delay
+        self._per_loop: dict[int, tuple[list, asyncio.Event]] = {}
+
+    async def submit(self, item: Any) -> Any:
+        loop = asyncio.get_running_loop()
+        key = id(loop)
+        state = self._per_loop.get(key)
+        if state is None:
+            state = ([], asyncio.Event())
+            self._per_loop[key] = state
+            loop.create_task(self._flusher(key))
+        pending, _ev = state
+        future = loop.create_future()
+        pending.append((item, future))
+        if len(pending) >= self.max_batch_size:
+            self._flush(key)
+        return await future
+
+    def _flush(self, key: int) -> None:
+        state = self._per_loop.get(key)
+        if state is None:
+            return
+        pending, _ev = state
+        if not pending:
+            return
+        batch = pending[: self.max_batch_size]
+        del pending[: self.max_batch_size]
+        items = [it for (it, _f) in batch]
+        try:
+            results = self.process_batch(items)
+            for (_it, fut), res in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(res)
+        except Exception as exc:
+            for _it, fut in batch:
+                if not fut.done():
+                    fut.set_exception(exc)
+
+    async def _flusher(self, key: int) -> None:
+        # flush everything pending on this loop until it quiesces
+        try:
+            while True:
+                await asyncio.sleep(self.flush_delay)
+                state = self._per_loop.get(key)
+                if state is None or not state[0]:
+                    break
+                while state[0]:
+                    self._flush(key)
+        finally:
+            self._per_loop.pop(key, None)

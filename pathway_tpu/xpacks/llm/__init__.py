@@ -1,0 +1,41 @@
+"""LLM/RAG xpack (parity: python/pathway/xpacks/llm/, 8k LoC).
+
+Embedders and rerankers run as jit-compiled Flax models with epoch-batched
+device dispatch; indexes keep their matrices device-resident; REST servers
+ride the streaming engine.
+"""
+
+from pathway_tpu.xpacks.llm import (
+    embedders,
+    llms,
+    parsers,
+    prompts,
+    rerankers,
+    servers,
+    splitters,
+)
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.question_answering import (
+    AdaptiveRAGQuestionAnswerer,
+    BaseRAGQuestionAnswerer,
+    DeckRetriever,
+    SummaryQuestionAnswerer,
+)
+from pathway_tpu.xpacks.llm.vector_store import VectorStoreClient, VectorStoreServer
+
+__all__ = [
+    "embedders",
+    "llms",
+    "parsers",
+    "prompts",
+    "rerankers",
+    "servers",
+    "splitters",
+    "DocumentStore",
+    "AdaptiveRAGQuestionAnswerer",
+    "BaseRAGQuestionAnswerer",
+    "DeckRetriever",
+    "SummaryQuestionAnswerer",
+    "VectorStoreClient",
+    "VectorStoreServer",
+]

@@ -1,0 +1,35 @@
+"""Shared helpers for the LLM xpack (parity: xpacks/llm/_utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pathway_tpu.engine.types import Json
+
+
+def _coerce_sync(fn):
+    import asyncio
+    import functools
+
+    if not asyncio.iscoroutinefunction(fn):
+        return fn
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return asyncio.run(fn(*args, **kwargs))
+
+    return wrapper
+
+
+def _extract_value(value: Any) -> Any:
+    if isinstance(value, Json):
+        return value.value
+    return value
+
+
+def _unwrap_udf(udf) -> Any:
+    from pathway_tpu.internals.udfs import UDF
+
+    if isinstance(udf, UDF):
+        return udf.__wrapped__
+    return udf

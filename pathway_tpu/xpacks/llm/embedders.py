@@ -1,0 +1,163 @@
+"""Embedders (parity: xpacks/llm/embedders.py:85-401).
+
+``SentenceTransformerEmbedder`` is the TPU-native path: a jit-compiled Flax
+bi-encoder behind an async micro-batcher, so every concurrently-streaming
+row of an epoch lands in one padded device batch (the north-star bridge).
+API-based embedders (OpenAI/LiteLLM/Gemini) keep reference parity and are
+gated on their client packages.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+import numpy as np
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.expression import ColumnExpression
+from pathway_tpu.internals.udfs import UDF, async_executor
+from pathway_tpu.utils.batching import AsyncMicroBatcher
+
+
+class BaseEmbedder(UDF):
+    def get_embedding_dimension(self, **kwargs) -> int:
+        """Embed a probe string and measure (reference embedders.py)."""
+        result = self.__wrapped__("pathway_tpu probe")
+        if asyncio.iscoroutine(result):
+            result = asyncio.run(result)
+        return len(result)
+
+    def __call__(self, input: ColumnExpression | Any = None, **kwargs) -> ColumnExpression:
+        if input is None:
+            raise TypeError("embedder requires an input expression")
+        return super().__call__(input, **kwargs)
+
+
+class SentenceTransformerEmbedder(BaseEmbedder):
+    """Device-native analog of the reference's SentenceTransformer wrapper
+    (embedders.py:~301): same constructor surface, but ``model`` resolves to
+    a jitted Flax encoder rather than a torch module.
+    """
+
+    def __init__(
+        self,
+        model: str = "all-MiniLM-L6-v2",
+        call_kwargs: dict = {},
+        device: str = "auto",
+        *,
+        max_batch_size: int = 256,
+        **init_kwargs,
+    ):
+        super().__init__(executor=async_executor(), deterministic=True)
+        self.model_name = model
+        from pathway_tpu.models import shared_sentence_encoder
+
+        self._encoder = shared_sentence_encoder(model)
+        self._batcher = AsyncMicroBatcher(
+            self._process_batch, max_batch_size=max_batch_size
+        )
+
+        async def embed(text: str) -> np.ndarray:
+            return await self._batcher.submit(text if text is not None else "")
+
+        embed.__name__ = f"sentence_transformer:{model}"
+        self.__wrapped__ = embed
+
+    def _process_batch(self, texts: list[str]) -> list[np.ndarray]:
+        vectors = self._encoder.encode(texts)
+        return [vectors[i] for i in range(len(texts))]
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return self._encoder.dimensions
+
+
+# TPU-native default; the reference aliases its default embedder similarly
+SentenceTransformerTask = SentenceTransformerEmbedder
+
+
+class OpenAIEmbedder(BaseEmbedder):
+    """OpenAI API embedder (parity: embedders.py:85). Gated on `openai`."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        model: str | None = "text-embedding-3-small",
+        retry_strategy=None,
+        cache_strategy=None,
+        **openai_kwargs,
+    ):
+        super().__init__(
+            executor=async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
+        self.model = model
+        self.kwargs = dict(openai_kwargs)
+
+        async def embed(input: str, **kwargs) -> np.ndarray:
+            import openai  # gated
+
+            client = openai.AsyncOpenAI()
+            params = {**self.kwargs, **kwargs, "model": self.model}
+            ret = await client.embeddings.create(input=[input or "."], **params)
+            return np.array(ret.data[0].embedding)
+
+        self.__wrapped__ = embed
+
+
+class LiteLLMEmbedder(BaseEmbedder):
+    """LiteLLM embedder (parity: embedders.py). Gated on `litellm`."""
+
+    def __init__(
+        self,
+        capacity: int | None = None,
+        model: str | None = None,
+        retry_strategy=None,
+        cache_strategy=None,
+        **llmlite_kwargs,
+    ):
+        super().__init__(
+            executor=async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
+        self.model = model
+        self.kwargs = dict(llmlite_kwargs)
+
+        async def embed(input: str, **kwargs) -> np.ndarray:
+            import litellm  # gated
+
+            ret = await litellm.aembedding(
+                input=[input or "."], model=self.model, **{**self.kwargs, **kwargs}
+            )
+            return np.array(ret.data[0]["embedding"])
+
+        self.__wrapped__ = embed
+
+
+class GeminiEmbedder(BaseEmbedder):
+    """Gemini embedder (parity: embedders.py:~401). Gated on google client."""
+
+    def __init__(
+        self,
+        model: str | None = "models/embedding-001",
+        capacity: int | None = None,
+        retry_strategy=None,
+        cache_strategy=None,
+        **gemini_kwargs,
+    ):
+        super().__init__(
+            executor=async_executor(capacity=capacity, retry_strategy=retry_strategy),
+            cache_strategy=cache_strategy,
+        )
+        self.model = model
+        self.kwargs = dict(gemini_kwargs)
+
+        async def embed(input: str, **kwargs) -> np.ndarray:
+            import google.generativeai as genai  # gated
+
+            ret = genai.embed_content(
+                model=self.model, content=input or ".", **{**self.kwargs, **kwargs}
+            )
+            return np.array(ret["embedding"])
+
+        self.__wrapped__ = embed

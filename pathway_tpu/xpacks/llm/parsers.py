@@ -1,0 +1,78 @@
+"""Document parsers (parity: xpacks/llm/parsers.py, 849 LoC).
+
+``ParseUtf8`` (bytes→text), ``ParseUnstructured`` (gated on `unstructured`),
+``ParseFromDocStore``-style identity.  Parsers are UDFs:
+bytes → tuple[(text, metadata)].
+"""
+
+from __future__ import annotations
+
+import json as _json
+from typing import Any
+
+from pathway_tpu.engine.types import Json
+from pathway_tpu.internals.udfs import UDF
+
+
+class ParseUtf8(UDF):
+    """Decode bytes to one text document (parity: parsers.py ParseUtf8)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+        def parse(contents: bytes) -> tuple:
+            if isinstance(contents, bytes):
+                text = contents.decode("utf-8", errors="replace")
+            else:
+                text = str(contents)
+            return ((text, Json({})),)
+
+        self.__wrapped__ = parse
+
+
+# reference alias
+Utf8Parser = ParseUtf8
+
+
+class ParseUnstructured(UDF):
+    """unstructured-io parser (parity: parsers.py ParseUnstructured).
+    Gated on the `unstructured` package."""
+
+    def __init__(self, mode: str = "single", post_processors=None, **unstructured_kwargs):
+        super().__init__()
+        self.mode = mode
+        self.kwargs = dict(unstructured_kwargs)
+
+        def parse(contents: bytes) -> tuple:
+            import io
+
+            from unstructured.partition.auto import partition  # gated
+
+            elements = partition(file=io.BytesIO(contents), **self.kwargs)
+            if self.mode == "single":
+                text = "\n\n".join(str(e) for e in elements)
+                return ((text, Json({})),)
+            out = []
+            for e in elements:
+                meta = e.metadata.to_dict() if hasattr(e, "metadata") else {}
+                out.append((str(e), Json(meta)))
+            return tuple(out)
+
+        self.__wrapped__ = parse
+
+
+UnstructuredParser = ParseUnstructured
+
+
+class ParseJson(UDF):
+    """Parse a JSON document into (text, metadata) using a text field."""
+
+    def __init__(self, text_field: str = "text", **kwargs):
+        super().__init__(**kwargs)
+
+        def parse(contents: bytes) -> tuple:
+            obj = _json.loads(contents.decode("utf-8", errors="replace") if isinstance(contents, bytes) else str(contents))
+            text = obj.pop(text_field, "")
+            return ((str(text), Json(obj)),)
+
+        self.__wrapped__ = parse

@@ -1,0 +1,294 @@
+"""RAG question answering (parity: xpacks/llm/question_answering.py:97-788).
+
+``BaseRAGQuestionAnswerer`` — retrieve top-k, prompt, answer.
+``AdaptiveRAGQuestionAnswerer`` — geometric-k re-asking (:97-162): start
+with few documents; if the model answers "No information found", double
+the context and ask again.  ``SummaryQuestionAnswerer`` adds summarize.
+``DeckRetriever`` — slide-deck retrieval app built on the same base.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+import pathway_tpu as pw
+from pathway_tpu.engine.types import Json
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals.expression import ApplyExpression, ColumnReference
+from pathway_tpu.internals.table import Table
+from pathway_tpu.internals.thisclass import this
+from pathway_tpu.xpacks.llm import prompts
+from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.servers import QARestServer, QASummaryRestServer
+
+
+class BaseQuestionAnswerer:
+    AnswerQuerySchema: type[pw.Schema]
+    RetrieveQuerySchema: type[pw.Schema]
+    StatisticsQuerySchema: type[pw.Schema]
+    InputsQuerySchema: type[pw.Schema]
+
+
+class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
+    """Standard RAG: retrieve → prompt → LLM (parity :288)."""
+
+    class AnswerQuerySchema(pw.Schema):
+        prompt: str
+        filters: str | None
+        model: str | None
+        return_context_docs: bool | None
+
+    class RetrieveQuerySchema(DocumentStore.RetrieveQuerySchema):
+        pass
+
+    class StatisticsQuerySchema(pw.Schema):
+        pass
+
+    class InputsQuerySchema(DocumentStore.InputsQuerySchema):
+        pass
+
+    class SummarizeQuerySchema(pw.Schema):
+        text_list: Json
+        model: str | None
+
+    def __init__(
+        self,
+        llm,
+        indexer: DocumentStore,
+        *,
+        default_llm_name: str | None = None,
+        prompt_template=None,
+        search_topk: int = 6,
+        summarize_template=None,
+    ):
+        self.llm = llm
+        self.indexer = indexer
+        self.search_topk = search_topk
+        self.prompt_template = prompt_template or prompts.prompt_qa
+        self.summarize_template = summarize_template or prompts.prompt_summarize
+        self.server: Any = None
+
+    # -- internal: fetch docs for a query table --
+    def _retrieve_docs(self, queries: Table, k: int | None = None) -> Table:
+        augmented = queries.with_columns(
+            query=ColumnReference(this, "prompt"),
+            k=expr_mod.ColumnConstExpression(k or self.search_topk),
+            metadata_filter=expr_mod.coalesce(
+                ColumnReference(this, "filters"), None
+            )
+            if "filters" in queries.column_names()
+            else expr_mod.ColumnConstExpression(None),
+            filepath_globpattern=expr_mod.ColumnConstExpression(None),
+        )
+        replies = self.indexer.retrieve_query(augmented)
+        return queries.with_columns(
+            docs=replies.result,
+        )
+
+    def answer_query(self, pw_ai_queries: Table) -> Table:
+        """The /v1/pw_ai_answer handler (parity :387)."""
+        with_docs = self._retrieve_docs(pw_ai_queries)
+        prompted = with_docs.with_columns(
+            _pw_prompt=self.prompt_template(
+                ColumnReference(this, "docs"), ColumnReference(this, "prompt")
+            )
+        )
+        llm = self.llm
+
+        answered = prompted.with_columns(
+            _pw_answer=llm(
+                ApplyExpression(
+                    lambda p: Json([{"role": "user", "content": p}]),
+                    None,
+                    ColumnReference(this, "_pw_prompt"),
+                )
+            )
+        )
+
+        def pack(answer, docs, return_context_docs) -> Json:
+            out: dict = {"response": answer}
+            if return_context_docs:
+                out["context_docs"] = docs.value if isinstance(docs, Json) else docs
+            return Json(out)
+
+        return answered.select(
+            result=ApplyExpression(
+                pack,
+                None,
+                ColumnReference(this, "_pw_answer"),
+                ColumnReference(this, "docs"),
+                ColumnReference(this, "return_context_docs")
+                if "return_context_docs" in answered.column_names()
+                else expr_mod.ColumnConstExpression(False),
+                _propagate_none=False,
+            )
+        )
+
+    pw_ai_query = answer_query  # legacy name (reference keeps both)
+
+    def retrieve(self, retrieval_queries: Table) -> Table:
+        return self.indexer.retrieve_query(retrieval_queries)
+
+    def statistics(self, info_queries: Table) -> Table:
+        return self.indexer.statistics_query(info_queries)
+
+    def list_documents(self, input_queries: Table) -> Table:
+        return self.indexer.inputs_query(input_queries)
+
+    def summarize_query(self, summarize_queries: Table) -> Table:
+        """The /v1/pw_ai_summary handler (parity :~460)."""
+        prompted = summarize_queries.with_columns(
+            _pw_prompt=self.summarize_template(
+                ApplyExpression(
+                    lambda tl: tuple(tl.value) if isinstance(tl, Json) else tuple(tl or ()),
+                    None,
+                    ColumnReference(this, "text_list"),
+                )
+            )
+        )
+        answered = prompted.with_columns(
+            _pw_answer=self.llm(
+                ApplyExpression(
+                    lambda p: Json([{"role": "user", "content": p}]),
+                    None,
+                    ColumnReference(this, "_pw_prompt"),
+                )
+            )
+        )
+        return answered.select(
+            result=ApplyExpression(
+                lambda a: Json({"response": a}),
+                None,
+                ColumnReference(this, "_pw_answer"),
+                _propagate_none=False,
+            )
+        )
+
+    # -- serving --
+    def build_server(self, host: str, port: int, **rest_kwargs) -> None:
+        self.server = QASummaryRestServer(host, port, self, **rest_kwargs)
+
+    def run_server(self, *args, **kwargs):
+        if self.server is None:
+            raise ValueError("call build_server(host, port) first")
+        return self.server.run_server(*args, **kwargs)
+
+
+class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
+    """Geometric-k adaptive RAG (parity :97-162).
+
+    Over-fetches ``max_context_docs`` once from the as-of-now index, then
+    asks the LLM with n_starting_documents, doubling (factor) until the
+    answer is not the not-found response — the prompt-side behavior of the
+    reference's re-asking loop, with one index round-trip instead of many.
+    """
+
+    def __init__(
+        self,
+        llm,
+        indexer: DocumentStore,
+        *,
+        default_llm_name: str | None = None,
+        n_starting_documents: int = 2,
+        factor: int = 2,
+        max_iterations: int = 4,
+        strict_prompt: bool = False,
+        **kwargs,
+    ):
+        super().__init__(llm, indexer, **kwargs)
+        self.n_starting_documents = n_starting_documents
+        self.factor = factor
+        self.max_iterations = max_iterations
+        self.not_found_response = "No information found."
+
+    def answer_query(self, pw_ai_queries: Table) -> Table:
+        max_docs = self.n_starting_documents * (
+            self.factor ** (self.max_iterations - 1)
+        )
+        with_docs = self._retrieve_docs(pw_ai_queries, k=max_docs)
+        llm_fn = self.llm.__wrapped__
+        n0, factor, rounds = self.n_starting_documents, self.factor, self.max_iterations
+        not_found = self.not_found_response
+
+        @pw.udf(executor=pw.udfs.async_executor())
+        async def adaptive_answer(prompt: str, docs: Json) -> Json:
+            doc_list = docs.value if isinstance(docs, Json) else list(docs or ())
+            n = n0
+            answer = not_found
+            prev_size = -1
+            for _round in range(rounds):
+                subset = doc_list[:n]
+                if len(subset) == prev_size:
+                    break  # context exhausted; re-asking would repeat verbatim
+                prev_size = len(subset)
+                context = "\n\n".join(str(d.get("text", d)) for d in subset)
+                full_prompt = (
+                    "Use the below articles to answer the subsequent question. "
+                    f'If the answer cannot be found, write "{not_found}"\n'
+                    f"Articles:\n{context}\nQuestion: {prompt}\nAnswer:"
+                )
+                res = llm_fn([{"role": "user", "content": full_prompt}])
+                if asyncio.iscoroutine(res):
+                    res = await res
+                answer = res
+                if res and not_found.lower().rstrip(".") not in str(res).lower():
+                    break
+                n = min(n * factor, len(doc_list))
+            return Json({"response": answer})
+
+        return with_docs.select(
+            result=adaptive_answer(
+                ColumnReference(this, "prompt"), ColumnReference(this, "docs")
+            )
+        )
+
+
+class SummaryQuestionAnswerer(BaseRAGQuestionAnswerer):
+    """Alias emphasizing the summarization endpoints (parity)."""
+
+
+class DeckRetriever(BaseQuestionAnswerer):
+    """Slide-deck retrieval app (parity :288; search-only surface)."""
+
+    class AnswerQuerySchema(pw.Schema):
+        prompt: str
+        filters: str | None
+
+    class RetrieveQuerySchema(DocumentStore.RetrieveQuerySchema):
+        pass
+
+    class StatisticsQuerySchema(pw.Schema):
+        pass
+
+    class InputsQuerySchema(DocumentStore.InputsQuerySchema):
+        pass
+
+    def __init__(self, indexer: DocumentStore, *, search_topk: int = 6, **kwargs):
+        self.indexer = indexer
+        self.search_topk = search_topk
+        self.server = None
+
+    def answer_query(self, queries: Table) -> Table:
+        augmented = queries.with_columns(
+            query=ColumnReference(this, "prompt"),
+            k=expr_mod.ColumnConstExpression(self.search_topk),
+            metadata_filter=expr_mod.coalesce(ColumnReference(this, "filters"), None),
+            filepath_globpattern=expr_mod.ColumnConstExpression(None),
+        )
+        return self.indexer.retrieve_query(augmented)
+
+    def retrieve(self, queries: Table) -> Table:
+        return self.indexer.retrieve_query(queries)
+
+    def statistics(self, q: Table) -> Table:
+        return self.indexer.statistics_query(q)
+
+    def list_documents(self, q: Table) -> Table:
+        return self.indexer.inputs_query(q)
+
+    def build_server(self, host: str, port: int, **rest_kwargs) -> None:
+        self.server = QARestServer(host, port, self, **rest_kwargs)
+
+    def run_server(self, *args, **kwargs):
+        return self.server.run_server(*args, **kwargs)

@@ -1,0 +1,217 @@
+"""LLM xpack tests — mock components, real dataflow/index path
+(model: reference xpacks/llm/tests)."""
+
+import json
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.types import Json
+from pathway_tpu.io._utils import make_static_input_table
+from pathway_tpu.stdlib.indexing import (
+    BruteForceKnnFactory,
+    BruteForceKnn,
+    DataIndex,
+    HybridIndexFactory,
+    TantivyBM25Factory,
+)
+from pathway_tpu.xpacks.llm import DocumentStore
+from pathway_tpu.xpacks.llm.mocks import FakeChatModel, FakeEmbeddings, IdentityMockChat
+from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+from pathway_tpu.debug import _capture_table
+
+
+def _docs(entries):
+    return make_static_input_table(
+        pw.schema_from_types(data=bytes, _metadata=Json),
+        [
+            {"data": text.encode(), "_metadata": Json(meta)}
+            for text, meta in entries
+        ],
+    )
+
+
+def _one_result(table):
+    cap = _capture_table(table)
+    rows = list(cap.final_rows().values())
+    assert len(rows) == 1, rows
+    return rows[0]
+
+
+def test_document_store_retrieve():
+    docs = _docs(
+        [
+            ("alpha beta gamma", {"path": "/a.txt", "modified_at": 1}),
+            ("delta epsilon zeta", {"path": "/b.txt", "modified_at": 2}),
+        ]
+    )
+    store = DocumentStore(docs, BruteForceKnnFactory(embedder=FakeEmbeddings()))
+    queries = make_static_input_table(
+        DocumentStore.RetrieveQuerySchema,
+        [
+            {
+                "query": "alpha beta gamma",
+                "k": 1,
+                "metadata_filter": None,
+                "filepath_globpattern": None,
+            }
+        ],
+    )
+    (result,) = _one_result(store.retrieve_query(queries))
+    parsed = result.value
+    assert parsed[0]["text"] == "alpha beta gamma"
+    assert parsed[0]["metadata"]["path"] == "/a.txt"
+
+
+def test_document_store_glob_filter():
+    docs = _docs(
+        [
+            ("same text", {"path": "/x/a.txt"}),
+            ("same text", {"path": "/y/b.txt"}),
+        ]
+    )
+    store = DocumentStore(docs, BruteForceKnnFactory(embedder=FakeEmbeddings()))
+    queries = make_static_input_table(
+        DocumentStore.RetrieveQuerySchema,
+        [
+            {
+                "query": "same text",
+                "k": 10,
+                "metadata_filter": None,
+                "filepath_globpattern": "/x/*",
+            }
+        ],
+    )
+    (result,) = _one_result(store.retrieve_query(queries))
+    paths = [d["metadata"]["path"] for d in result.value]
+    assert paths == ["/x/a.txt"]
+
+
+def test_document_store_statistics_and_inputs():
+    docs = _docs(
+        [
+            ("one", {"path": "/a", "modified_at": 5}),
+            ("two", {"path": "/b", "modified_at": 9}),
+        ]
+    )
+    store = DocumentStore(docs, BruteForceKnnFactory(embedder=FakeEmbeddings()))
+    info_q = make_static_input_table(pw.schema_from_types(dummy=int), [{"dummy": 0}])
+    (stats,) = _one_result(store.statistics_query(info_q))
+    assert stats.value["file_count"] == 2
+    assert stats.value["last_modified"] == 9
+    inputs_q = make_static_input_table(
+        DocumentStore.InputsQuerySchema,
+        [{"metadata_filter": None, "filepath_globpattern": None}],
+    )
+    (files,) = _one_result(store.inputs_query(inputs_q))
+    assert sorted(f["path"] for f in files.value) == ["/a", "/b"]
+
+
+def test_bm25_index():
+    data = pw.debug.table_from_markdown(
+        """
+        txt
+        the quick brown fox jumps
+        incremental dataflow engines process updates
+        """
+    )
+    store_factory = TantivyBM25Factory()
+    idx = store_factory.build_index(data.txt, data)
+    queries = pw.debug.table_from_markdown("q\nquick fox")
+    res = idx.query_as_of_now(queries.q, number_of_matches=1)
+    (row,) = _capture_table(res).final_rows().values()
+    names = res.column_names()
+    assert row[names.index("txt")] == ("the quick brown fox jumps",)
+
+
+def test_hybrid_index():
+    data = pw.debug.table_from_markdown(
+        """
+        txt
+        machine learning on accelerators
+        cooking recipes for pasta
+        """
+    )
+    hybrid = HybridIndexFactory(
+        retriever_factories=[
+            BruteForceKnnFactory(embedder=FakeEmbeddings()),
+            TantivyBM25Factory(),
+        ]
+    )
+    idx = hybrid.build_index(data.txt, data)
+    queries = pw.debug.table_from_markdown("q\nmachine learning on accelerators")
+    res = idx.query_as_of_now(queries.q, number_of_matches=1)
+    (row,) = _capture_table(res).final_rows().values()
+    names = res.column_names()
+    assert row[names.index("txt")] == ("machine learning on accelerators",)
+
+
+def test_token_count_splitter():
+    sp = TokenCountSplitter(min_tokens=2, max_tokens=4)
+    chunks = sp.chunk("one two three four five six seven")
+    assert all(len(c.split()) <= 4 for c, _m in chunks)
+    assert " ".join(c for c, _m in chunks) == "one two three four five six seven"
+
+
+def test_fake_chat_pipeline():
+    chat = FakeChatModel()
+    t = pw.debug.table_from_markdown("q\nhello")
+    res = t.select(a=chat(pw.this.q))
+    (row,) = _capture_table(res).final_rows().values()
+    assert row == ("Text",)
+
+
+def test_rag_answerer_with_mock_llm():
+    from pathway_tpu.xpacks.llm.question_answering import BaseRAGQuestionAnswerer
+
+    docs = _docs([("context document", {"path": "/a"})])
+    store = DocumentStore(docs, BruteForceKnnFactory(embedder=FakeEmbeddings()))
+    rag = BaseRAGQuestionAnswerer(IdentityMockChat(), store)
+    queries = make_static_input_table(
+        rag.AnswerQuerySchema,
+        [
+            {
+                "prompt": "what is in the context?",
+                "filters": None,
+                "model": None,
+                "return_context_docs": True,
+            }
+        ],
+    )
+    (result,) = _one_result(rag.answer_query(queries))
+    out = result.value
+    assert "context document" in out["response"]
+    assert out["context_docs"][0]["text"] == "context document"
+
+
+def test_adaptive_rag_with_mock_llm():
+    from pathway_tpu.xpacks.llm.question_answering import AdaptiveRAGQuestionAnswerer
+
+    docs = _docs([(f"doc {i}", {"path": f"/{i}"}) for i in range(8)])
+    store = DocumentStore(docs, BruteForceKnnFactory(embedder=FakeEmbeddings()))
+    rag = AdaptiveRAGQuestionAnswerer(FakeChatModel(), store)
+    queries = make_static_input_table(
+        rag.AnswerQuerySchema,
+        [
+            {
+                "prompt": "anything",
+                "filters": None,
+                "model": None,
+                "return_context_docs": False,
+            }
+        ],
+    )
+    (result,) = _one_result(rag.answer_query(queries))
+    assert result.value["response"] == "Text"
+
+
+def test_cross_encoder_reranker_topk_filter():
+    from pathway_tpu.xpacks.llm.rerankers import rerank_topk_filter
+
+    t = pw.debug.table_from_markdown("x\n1").select(
+        docs=pw.make_tuple("a", "b", "c"),
+        scores=pw.make_tuple(0.1, 0.9, 0.5),
+    )
+    res = t.select(best=rerank_topk_filter(pw.this.docs, pw.this.scores, 2))
+    (row,) = _capture_table(res).final_rows().values()
+    assert row[0][0] == ("b", "c")
