@@ -105,6 +105,15 @@ class UDF:
             fun = self.cache_strategy.wrap(fun)
         return fun
 
+    def as_async_callable(self) -> Callable:
+        """The UDF's function as a directly-awaitable callable with its
+        configured cache strategy, retry strategy, capacity, and timeout
+        applied — for host-side callers (RAG handlers) that invoke the
+        model outside a dataflow expression."""
+        fun = self._wrapped_fun()
+        fun = self.executor.wrap_async(fun)
+        return coerce_async(fun)
+
     def __call__(self, *args, **kwargs) -> ColumnExpression:
         fun = self._wrapped_fun()
         ret = self._resolve_return_type(self.__wrapped__)

@@ -11,9 +11,12 @@ Architectures mirror the reference's default checkpoints:
   * all-MiniLM-L6-v2 : 6 layers, hidden 384, 12 heads, ffn 1536, vocab 30522
   * bge-base-en-v1.5 : 12 layers, hidden 768, 12 heads, ffn 3072
   * ms-marco-MiniLM-L-6-v2 cross-encoder: MiniLM trunk + scalar head
-Weights load from a local HuggingFace cache when present; otherwise
-deterministic random init keeps shapes/FLOPs identical (throughput and
-latency on TPU are weight-independent).
+Tokenizers load from a local HuggingFace cache when present; model weights
+are deterministic random init in this environment (zero egress — no
+checkpoint downloads), which keeps shapes/FLOPs identical: throughput and
+latency on TPU are weight-independent.  ``load_hf_weights`` maps a locally
+cached ``transformers`` BERT-family checkpoint into the Flax params when
+one is available.
 """
 
 from __future__ import annotations
@@ -130,6 +133,79 @@ class CrossEncoderModule(nn.Module):
         return nn.Dense(1, dtype=jnp.float32)(h)[:, 0]
 
 
+def load_hf_weights(model_name: str, params, config: EncoderConfig):
+    """Map a locally cached ``transformers`` BERT-family checkpoint onto the
+    Flax param tree; returns the updated tree or ``None`` when no local
+    checkpoint exists (zero-egress environments keep random init).
+
+    Token-type embeddings (always type 0 here) are folded into the word
+    embedding table so the architectures match exactly.
+    """
+    import os
+
+    os.environ.setdefault("HF_HUB_OFFLINE", "1")
+    try:
+        from transformers import AutoModel  # noqa: PLC0415
+
+        hf = AutoModel.from_pretrained(model_name, local_files_only=True)
+    except Exception:
+        return None
+
+    sd = {k: v.detach().cpu().numpy() for k, v in hf.state_dict().items()}
+    prefix = "encoder." if any(k.startswith("encoder.layer") for k in sd) else ""
+    h, heads = config.hidden, config.heads
+    hd = h // heads
+
+    import copy
+
+    new_params = copy.deepcopy(jax.device_get(params))
+
+    def put(path_parts, value):
+        # navigate the mutable dict-of-dicts copy
+        cur = new_params["params"]
+        for part in path_parts[:-1]:
+            cur = cur[part]
+        expect = cur[path_parts[-1]].shape
+        if tuple(value.shape) != tuple(expect):
+            raise ValueError(f"{path_parts}: shape {value.shape} != {expect}")
+        cur[path_parts[-1]] = value.astype(np.float32)
+
+    try:
+        enc = ["Encoder_0"] if "Encoder_0" in new_params["params"] else []
+        word = sd["embeddings.word_embeddings.weight"]
+        type0 = sd["embeddings.token_type_embeddings.weight"][0]
+        put(enc + ["Embed_0", "embedding"], word + type0[None, :])
+        put(
+            enc + ["Embed_1", "embedding"],
+            sd["embeddings.position_embeddings.weight"][: config.max_len],
+        )
+        put(enc + ["LayerNorm_0", "scale"], sd["embeddings.LayerNorm.weight"])
+        put(enc + ["LayerNorm_0", "bias"], sd["embeddings.LayerNorm.bias"])
+        for i in range(config.layers):
+            blk = enc + [f"TransformerBlock_{i}"]
+            lp = f"{prefix}layer.{i}." if prefix else f"encoder.layer.{i}."
+            attn = blk + ["MultiHeadDotProductAttention_0"]
+            for name, hf_name in (("query", "query"), ("key", "key"), ("value", "value")):
+                w = sd[f"{lp}attention.self.{hf_name}.weight"]
+                b = sd[f"{lp}attention.self.{hf_name}.bias"]
+                put(attn + [name, "kernel"], w.T.reshape(h, heads, hd))
+                put(attn + [name, "bias"], b.reshape(heads, hd))
+            wo = sd[f"{lp}attention.output.dense.weight"]
+            put(attn + ["out", "kernel"], wo.T.reshape(heads, hd, h))
+            put(attn + ["out", "bias"], sd[f"{lp}attention.output.dense.bias"])
+            put(blk + ["LayerNorm_0", "scale"], sd[f"{lp}attention.output.LayerNorm.weight"])
+            put(blk + ["LayerNorm_0", "bias"], sd[f"{lp}attention.output.LayerNorm.bias"])
+            put(blk + ["Dense_0", "kernel"], sd[f"{lp}intermediate.dense.weight"].T)
+            put(blk + ["Dense_0", "bias"], sd[f"{lp}intermediate.dense.bias"])
+            put(blk + ["Dense_1", "kernel"], sd[f"{lp}output.dense.weight"].T)
+            put(blk + ["Dense_1", "bias"], sd[f"{lp}output.dense.bias"])
+            put(blk + ["LayerNorm_1", "scale"], sd[f"{lp}output.LayerNorm.weight"])
+            put(blk + ["LayerNorm_1", "bias"], sd[f"{lp}output.LayerNorm.bias"])
+    except (KeyError, ValueError):
+        return None
+    return new_params
+
+
 class _JitModel:
     """Shared machinery: init params, bucket shapes, jit per bucket."""
 
@@ -144,6 +220,10 @@ class _JitModel:
         rng = jax.random.PRNGKey(seed)
         dummy = jnp.zeros((1, 16), dtype=jnp.int32)
         self.params = self.module.init(rng, dummy, jnp.ones((1, 16), jnp.int32))
+        loaded = load_hf_weights(model_name, self.params, self.config)
+        self.pretrained = loaded is not None
+        if loaded is not None:
+            self.params = jax.tree_util.tree_map(jnp.asarray, loaded)
         self._apply = jax.jit(
             lambda params, ids, mask: self.module.apply(params, ids, mask)
         )

@@ -1,0 +1,55 @@
+"""SPMD distribution over TPU device meshes.
+
+Parity target: SURVEY.md §2b. The reference's only parallelism is data
+parallelism by key-shard over identical dataflow replicas — timely workers
+exchanging ``(Key, Value, Timestamp, diff)`` tuples over shared memory or
+zero-copy TCP (``external/timely-dataflow/communication/``,
+``src/engine/dataflow/shard.rs``).  The TPU-native mapping replaces the
+row-tuple exchange with XLA collectives over ICI:
+
+* host rows are sharded by the 16-bit shard field of the 128-bit key,
+  exactly like the reference (``src/engine/value.rs:38``);
+* dense state (embedding matrices, index shards) stays resident in HBM,
+  sharded over the mesh; queries move, vectors do not;
+* the compute path (encoder fwd/bwd, top-k retrieval) is pjit-compiled
+  SPMD — XLA inserts ``all_gather``/``psum``/``reduce_scatter`` from the
+  sharding annotations instead of hand-written NCCL/MPI calls.
+
+Mesh convention: 2-D ``("data", "model")``. Batch/data parallelism rides
+the ``data`` axis; tensor parallelism of encoder weights rides ``model``;
+the document index is sharded over the *flattened* mesh (every chip holds
+one slice of the corpus — the analog of the reference's key-shard space).
+"""
+
+from __future__ import annotations
+
+from pathway_tpu.parallel.mesh import (
+    flat_axes,
+    make_mesh,
+    mesh_shape_for,
+)
+from pathway_tpu.parallel.sharding import (
+    replicated,
+    shard_batch,
+    shard_params,
+)
+from pathway_tpu.parallel.train import (
+    TrainState,
+    make_contrastive_train_step,
+    init_train_state,
+)
+from pathway_tpu.parallel.index import ShardedDeviceIndex, sharded_topk
+
+__all__ = [
+    "make_mesh",
+    "mesh_shape_for",
+    "flat_axes",
+    "shard_params",
+    "shard_batch",
+    "replicated",
+    "TrainState",
+    "init_train_state",
+    "make_contrastive_train_step",
+    "ShardedDeviceIndex",
+    "sharded_topk",
+]

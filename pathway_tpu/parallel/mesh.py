@@ -1,0 +1,55 @@
+"""Device mesh construction.
+
+The reference sizes its worker grid from ``PATHWAY_THREADS`` ×
+``PATHWAY_PROCESSES`` (``src/engine/dataflow/config.rs:88-120``).  Here the
+grid is a ``jax.sharding.Mesh``; one chip plays the role of one worker
+(BASELINE north star).  ``make_mesh`` factors the device count into
+``(data, model)`` with a modest tensor-parallel degree — encoder weights
+are small enough that dp should dominate.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "model")
+
+
+def mesh_shape_for(n_devices: int, max_model: int = 2) -> tuple[int, int]:
+    """Factor ``n_devices`` into (data, model).
+
+    Tensor parallelism is capped at ``max_model`` — MiniLM/BGE-class
+    encoders saturate a chip long before weight memory is a constraint, so
+    extra chips are worth more as data parallelism.
+    """
+    model = 1
+    for cand in range(min(max_model, n_devices), 0, -1):
+        if n_devices % cand == 0:
+            model = cand
+            break
+    return n_devices // model, model
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    *,
+    devices: list | None = None,
+    max_model: int = 2,
+) -> Mesh:
+    """An ``("data", "model")`` mesh over the first ``n_devices`` devices."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    data, model = mesh_shape_for(len(devices))
+    if model > max_model:
+        data, model = mesh_shape_for(len(devices), max_model)
+    grid = np.asarray(devices).reshape(data, model)
+    return Mesh(grid, AXES)
+
+
+def flat_axes(mesh: Mesh) -> tuple[str, ...]:
+    """All mesh axes — for state sharded over every chip (the index)."""
+    return tuple(mesh.axis_names)

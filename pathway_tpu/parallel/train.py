@@ -1,0 +1,93 @@
+"""Distributed contrastive training step for the embedding encoders.
+
+The reference consumes frozen sentence-transformer checkpoints; a TPU-native
+framework should also be able to *adapt* its embedders in place (the same
+InfoNCE objective sentence-transformers models are trained with).  This is
+the framework's full distributed train step: data-parallel batch over the
+``data`` axis, tensor-parallel encoder weights over ``model``, gradients
+psum-reduced by XLA from the sharding annotations alone.
+
+(The reference has no model training at all — SURVEY.md §2b — so pipeline
+and expert parallelism have no workload here; dp×tp plus the corpus-sharded
+index in ``parallel/index.py`` covers every axis this framework computes
+over.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pathway_tpu.parallel.sharding import shard_params
+
+
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: int = 0
+
+
+def init_train_state(
+    module,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    *,
+    seq_len: int = 16,
+    seed: int = 0,
+) -> tuple[TrainState, optax.GradientTransformation]:
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((1, seq_len), jnp.int32)
+    params = module.init(rng, dummy, jnp.ones((1, seq_len), jnp.int32))
+    params = shard_params(params, mesh)
+    opt_state = optimizer.init(params)
+    return TrainState(params=params, opt_state=opt_state), optimizer
+
+
+def make_contrastive_train_step(
+    module,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    temperature: float = 0.05,
+) -> Callable:
+    """jit-compiled SPMD step: (state, ids_a, mask_a, ids_b, mask_b) -> (state, loss).
+
+    Symmetric InfoNCE over in-batch negatives.  Batch arrives sharded over
+    ``data``; the logits matrix ``za @ zb.T`` is a cross-shard einsum, so XLA
+    all-gathers the (small) embedding vectors over ICI while the (large)
+    activations never leave their chip.
+    """
+
+    def loss_fn(params, ids_a, mask_a, ids_b, mask_b):
+        za = module.apply(params, ids_a, mask_a)
+        zb = module.apply(params, ids_b, mask_b)
+        logits = (za @ zb.T) / temperature
+        labels = jnp.arange(logits.shape[0])
+        l_ab = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        l_ba = optax.softmax_cross_entropy_with_integer_labels(logits.T, labels)
+        return 0.5 * (jnp.mean(l_ab) + jnp.mean(l_ba))
+
+    @jax.jit
+    def step(params, opt_state, ids_a, mask_a, ids_b, mask_b):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids_a, mask_a, ids_b, mask_b)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    batch_sharding = NamedSharding(mesh, P("data"))
+
+    def run(state: TrainState, ids_a, mask_a, ids_b, mask_b) -> tuple[TrainState, float]:
+        args = [
+            jax.device_put(jnp.asarray(x, jnp.int32), batch_sharding)
+            for x in (ids_a, mask_a, ids_b, mask_b)
+        ]
+        params, opt_state, loss = step(state.params, state.opt_state, *args)
+        return TrainState(params=params, opt_state=opt_state, step=state.step + 1), loss
+
+    return run

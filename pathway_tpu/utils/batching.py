@@ -31,17 +31,15 @@ class AsyncMicroBatcher:
         self.process_batch = process_batch
         self.max_batch_size = max_batch_size
         self.flush_delay = flush_delay
-        self._per_loop: dict[int, tuple[list, asyncio.Event]] = {}
+        self._per_loop: dict[int, list] = {}
 
     async def submit(self, item: Any) -> Any:
         loop = asyncio.get_running_loop()
         key = id(loop)
-        state = self._per_loop.get(key)
-        if state is None:
-            state = ([], asyncio.Event())
-            self._per_loop[key] = state
+        pending = self._per_loop.get(key)
+        if pending is None:
+            pending = self._per_loop[key] = []
             loop.create_task(self._flusher(key))
-        pending, _ev = state
         future = loop.create_future()
         pending.append((item, future))
         if len(pending) >= self.max_batch_size:
@@ -49,10 +47,7 @@ class AsyncMicroBatcher:
         return await future
 
     def _flush(self, key: int) -> None:
-        state = self._per_loop.get(key)
-        if state is None:
-            return
-        pending, _ev = state
+        pending = self._per_loop.get(key)
         if not pending:
             return
         batch = pending[: self.max_batch_size]
@@ -73,10 +68,10 @@ class AsyncMicroBatcher:
         try:
             while True:
                 await asyncio.sleep(self.flush_delay)
-                state = self._per_loop.get(key)
-                if state is None or not state[0]:
+                pending = self._per_loop.get(key)
+                if not pending:
                     break
-                while state[0]:
+                while self._per_loop.get(key):
                     self._flush(key)
         finally:
             self._per_loop.pop(key, None)

@@ -54,6 +54,11 @@ class DocumentStore:
             docs_tables = [docs]
         else:
             docs_tables = list(docs)
+        if not docs_tables:
+            raise ValueError(
+                "DocumentStore requires at least one documents table "
+                "(got an empty `docs`); pass e.g. pw.io.fs.read(...)"
+            )
         self.docs = (
             docs_tables[0].concat_reindex(*docs_tables[1:])
             if len(docs_tables) > 1
@@ -225,27 +230,40 @@ class DocumentStore:
         )
 
     def inputs_query(self, input_queries: Table) -> Table:
-        """List indexed input files (parity: document_store.py inputs)."""
+        """List indexed input files, honoring the query's ``metadata_filter``
+        and ``filepath_globpattern`` (parity: document_store.py inputs, which
+        applies merged filters per query)."""
+        import fnmatch
+
+        from pathway_tpu.stdlib.indexing.filters import metadata_matches
+
         files = self.parsed_docs.reduce(
             paths=reducers.tuple(
                 ApplyExpression(_meta_path_entry, None, ColumnReference(this, "metadata"))
             )
         )
 
-        def pack(paths) -> Json:
-            return Json(
-                [
-                    p.value if isinstance(p, Json) else p
-                    for p in (paths or ())
-                    if p is not None
-                ]
-            )
+        def pack(paths, metadata_filter, globpattern) -> Json:
+            out = []
+            for p in paths or ():
+                if p is None:
+                    continue
+                entry = p.value if isinstance(p, Json) else p
+                path = entry.get("path") if isinstance(entry, dict) else None
+                if globpattern and not fnmatch.fnmatch(str(path or ""), globpattern):
+                    continue
+                if metadata_filter and not metadata_matches(metadata_filter, entry):
+                    continue
+                out.append(entry)
+            return Json(out)
 
         return input_queries.select(
             result=ApplyExpression(
                 pack,
                 None,
                 _global_scalar(input_queries, files, "paths"),
+                ColumnReference(this, "metadata_filter"),
+                ColumnReference(this, "filepath_globpattern"),
                 _propagate_none=False,
             )
         )

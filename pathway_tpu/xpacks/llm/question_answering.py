@@ -207,7 +207,8 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
             self.factor ** (self.max_iterations - 1)
         )
         with_docs = self._retrieve_docs(pw_ai_queries, k=max_docs)
-        llm_fn = self.llm.__wrapped__
+        # directly-awaitable form keeps the LLM UDF's retry/capacity/cache config
+        llm_fn = self.llm.as_async_callable()
         n0, factor, rounds = self.n_starting_documents, self.factor, self.max_iterations
         not_found = self.not_found_response
 
@@ -228,9 +229,7 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
                     f'If the answer cannot be found, write "{not_found}"\n'
                     f"Articles:\n{context}\nQuestion: {prompt}\nAnswer:"
                 )
-                res = llm_fn([{"role": "user", "content": full_prompt}])
-                if asyncio.iscoroutine(res):
-                    res = await res
+                res = await llm_fn([{"role": "user", "content": full_prompt}])
                 answer = res
                 if res and not_found.lower().rstrip(".") not in str(res).lower():
                     break

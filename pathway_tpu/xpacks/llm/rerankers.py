@@ -84,17 +84,15 @@ class LLMReranker(UDF):
         self.llm = llm
 
         async def rerank(doc: str, query: str) -> float:
-            import asyncio
-
             prompt = (
                 "Given a query and a document, rate on a scale from 1 to 5 how "
                 "relevant the document is to the query. Respond with only the "
                 f"number.\nQuery: {query}\nDocument: {_doc_text(doc)}\nScore:"
             )
-            fn = self.llm.__wrapped__
-            res = fn([{"role": "user", "content": prompt}])
-            if asyncio.iscoroutine(res):
-                res = await res
+            # keeps the LLM UDF's retry/capacity/cache config applied
+            res = await self.llm.as_async_callable()(
+                [{"role": "user", "content": prompt}]
+            )
             m = re.search(r"[1-5]", str(res) or "")
             if not m:
                 raise ValueError(f"reranker LLM returned no score: {res!r}")

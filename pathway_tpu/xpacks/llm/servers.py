@@ -8,6 +8,7 @@ delivered when the result row appears.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Callable
 
@@ -55,16 +56,34 @@ class BaseRestServer:
         terminate_on_error: bool = True,
         **kwargs,
     ):
-        """Run the pipeline (parity: servers.py run_server)."""
-        if threaded:
-            t = threading.Thread(
-                target=lambda: pw.run(terminate_on_error=terminate_on_error),
-                daemon=True,
-                name="pathway:server",
+        """Run the pipeline (parity: servers.py run_server).
+
+        ``with_cache`` routes the UDF disk caches through the persistence
+        layer, matching the reference's engine-persistence-backed DiskCache
+        (udfs/caches.py:35, PersistenceMode::UdfCaching)."""
+        persistence_config = None
+        if with_cache:
+            from pathway_tpu import persistence as _persistence
+
+            backend = cache_backend or _persistence.Backend.filesystem(
+                "./Cache"
             )
+            persistence_config = _persistence.Config(backend)
+            if backend.kind == "filesystem":
+                # UDF DiskCache reads this root (caches.py)
+                os.environ.setdefault("PATHWAY_PERSISTENT_STORAGE", backend.path)
+
+        def _run():
+            return pw.run(
+                terminate_on_error=terminate_on_error,
+                persistence_config=persistence_config,
+            )
+
+        if threaded:
+            t = threading.Thread(target=_run, daemon=True, name="pathway:server")
             t.start()
             return t
-        return pw.run(terminate_on_error=terminate_on_error)
+        return _run()
 
 
 class DocumentStoreServer(BaseRestServer):

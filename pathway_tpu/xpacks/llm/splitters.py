@@ -101,6 +101,13 @@ class RecursiveSplitter(BaseSplitter):
         model_name: str | None = None,
         **kwargs,
     ):
+        if chunk_size <= 0:
+            raise ValueError(f"chunk_size must be positive, got {chunk_size}")
+        if chunk_overlap < 0 or chunk_overlap >= chunk_size:
+            raise ValueError(
+                f"chunk_overlap ({chunk_overlap}) must be in [0, chunk_size)"
+                f" — chunk_size is {chunk_size}"
+            )
         self.chunk_size = chunk_size
         self.chunk_overlap = chunk_overlap
         self.separators = separators or ["\n\n", "\n", ". ", " ", ""]

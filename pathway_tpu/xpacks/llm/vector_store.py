@@ -106,6 +106,30 @@ class VectorStoreServer:
                 def embedder(text: str) -> np.ndarray:  # noqa: F811
                     return np.asarray(emb.get_text_embedding(text))
 
+            elif hasattr(t, "split_text") or hasattr(t, "get_nodes_from_documents"):
+                # llamaindex node parsers (SentenceSplitter etc.)
+                node_parser = t
+                sp = UDF()
+                if hasattr(node_parser, "split_text"):
+                    sp.__wrapped__ = lambda text: [
+                        (c, Json({})) for c in node_parser.split_text(text)
+                    ]
+                else:
+                    def _split_nodes(text, _np=node_parser):
+                        from llama_index.core.schema import Document  # type: ignore
+
+                        nodes = _np.get_nodes_from_documents([Document(text=text)])
+                        return [(n.get_content(), Json({})) for n in nodes]
+
+                    sp.__wrapped__ = _split_nodes
+                splitter = sp
+        if embedder is None:
+            raise ValueError(
+                "from_llamaindex_components: no embedding transformation found "
+                "(expected one with .get_text_embedding); pass an embed_model "
+                "in `transformations` — refusing to silently substitute the "
+                "default embedder"
+            )
         return cls(*docs, embedder=embedder, parser=parser, splitter=splitter, **kwargs)
 
     # query handlers (same signatures as the reference)

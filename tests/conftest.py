@@ -3,16 +3,23 @@
 Multi-chip sharding tests run on virtual CPU devices
 (xla_force_host_platform_device_count), the same trick the driver's
 dryrun_multichip uses; bench.py (not pytest) uses the real TPU chip.
+
+The TPU plugin in this image force-registers itself and overrides
+``JAX_PLATFORMS`` from the environment, so the platform is pinned via
+``jax.config`` before any backend initialization instead.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
