@@ -1,0 +1,322 @@
+"""Engine-side persistence: snapshots, offset frontiers, crash-resume.
+
+Parity target: ``/root/reference/src/persistence/`` —
+``WorkerPersistentStorage`` (tracker.rs:49-260), input snapshot event logs
+(input_snapshot.rs: Event{Insert, Delete, AdvanceTime, Finished}), offset
+antichains (frontier.rs), and the file/S3/memory/mock backends
+(backends/*.rs).  Redesigned for this engine's epoch model:
+
+* Each persisted source owns an append-only **event log** of encoded events
+  (``engine/codec.py``), written one chunk per committed epoch.
+* A worker-level **metadata file** records, per source, how many chunks are
+  part of the last consistent snapshot plus the reader's **offset frontier**
+  (an opaque JSON-able object the reader knows how to ``seek`` to).  The
+  metadata write is atomic (tmp + rename), so a crash between chunk writes
+  and metadata commit simply ignores the trailing chunks — the same
+  "last consistent snapshot" rule the reference enforces with its antichains.
+* On resume, committed events replay into the input session at artificial
+  time 0 (``ARTIFICIAL_TIME_ON_REWIND_START``, connectors/mod.rs:222-258)
+  and the reader seeks to the stored frontier before producing new rows.
+
+Backend selection mirrors ``python/pathway/persistence/__init__.py``:
+filesystem / mock (in-memory) / s3 (gated on client library presence).
+"""
+
+from __future__ import annotations
+
+import json as _json
+import os
+import pickle
+import threading
+from typing import Any
+
+from pathway_tpu.engine import codec
+
+METADATA_FILE = "metadata.json"
+
+
+# ---------------------------------------------------------------------------
+# Blob backends (backends/{file,memory,mock,s3}.rs)
+# ---------------------------------------------------------------------------
+
+
+class BlobBackend:
+    """Key → bytes store; keys are slash-separated paths."""
+
+    def put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes | None:
+        raise NotImplementedError
+
+    def list_keys(self, prefix: str) -> list[str]:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def put_atomic(self, key: str, data: bytes) -> None:
+        self.put(key, data)
+
+
+class FileBackend(BlobBackend):
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, *key.split("/"))
+
+    def put(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+
+    def put_atomic(self, key: str, data: bytes) -> None:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def get(self, key: str) -> bytes | None:
+        path = self._path(key)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def list_keys(self, prefix: str) -> list[str]:
+        base = self._path(prefix)
+        if not os.path.isdir(base):
+            return []
+        out = []
+        for root, _dirs, files in os.walk(base):
+            for f in files:
+                if f.endswith(".tmp"):
+                    continue
+                full = os.path.join(root, f)
+                rel = os.path.relpath(full, self.root)
+                out.append(rel.replace(os.sep, "/"))
+        return sorted(out)
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+
+
+class MemoryBackend(BlobBackend):
+    """In-memory store; pass a shared dict to survive across runs in-process
+    (Backend.mock semantics, persistence/__init__.py:71)."""
+
+    def __init__(self, store: dict[str, bytes] | None = None):
+        self.store: dict[str, bytes] = store if store is not None else {}
+        self._lock = threading.Lock()
+
+    def put(self, key: str, data: bytes) -> None:
+        with self._lock:
+            self.store[key] = data
+
+    def get(self, key: str) -> bytes | None:
+        with self._lock:
+            return self.store.get(key)
+
+    def list_keys(self, prefix: str) -> list[str]:
+        with self._lock:
+            return sorted(k for k in self.store if k.startswith(prefix))
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self.store.pop(key, None)
+
+
+def backend_from_config(backend_cfg: Any) -> BlobBackend:
+    """Build an engine backend from the user-facing ``pw.persistence.Backend``."""
+    kind = getattr(backend_cfg, "kind", None)
+    if kind == "filesystem":
+        return FileBackend(backend_cfg.path)
+    if kind == "mock":
+        store = getattr(backend_cfg, "store", None)
+        return MemoryBackend(store if isinstance(store, dict) else {})
+    if kind == "s3":
+        raise NotImplementedError(
+            "persistence.Backend.s3 requires an S3 client library, which is "
+            "not available in this environment; use filesystem or mock"
+        )
+    if kind == "azure":
+        raise NotImplementedError("azure persistence backend is not available")
+    raise ValueError(f"unknown persistence backend {backend_cfg!r}")
+
+
+# ---------------------------------------------------------------------------
+# Per-source snapshot log
+# ---------------------------------------------------------------------------
+
+
+class SnapshotLog:
+    """Append-only event log for one persisted source (input_snapshot.rs)."""
+
+    def __init__(self, backend: BlobBackend, worker: int, source_id: str):
+        self.backend = backend
+        self.prefix = f"snapshots/{worker}/{source_id}"
+        self.chunks_written = 0
+        self._buffer: list[bytes] = []
+
+    def record(self, key: int, row: tuple, diff: int) -> None:
+        kind = codec.EV_INSERT if diff > 0 else codec.EV_DELETE
+        for _ in range(abs(diff)):
+            self._buffer.append(codec.encode_event(kind, key, row))
+
+    def record_advance(self, time: int) -> None:
+        self._buffer.append(codec.encode_event(codec.EV_ADVANCE_TIME, time=time))
+
+    def flush_chunk(self) -> None:
+        if not self._buffer:
+            return
+        data = b"".join(self._buffer)
+        self._buffer.clear()
+        self.backend.put(f"{self.prefix}/{self.chunks_written:08d}", data)
+        self.chunks_written += 1
+
+    def read_committed(self, committed_chunks: int):
+        """Yield (kind, key, row, time) from the first `committed_chunks`."""
+        for i in range(committed_chunks):
+            data = self.backend.get(f"{self.prefix}/{i:08d}")
+            if data is None:
+                raise RuntimeError(
+                    f"persistence: missing committed chunk {i} for {self.prefix}"
+                )
+            yield from codec.decode_events(data)
+
+
+# ---------------------------------------------------------------------------
+# Worker storage tracker (tracker.rs WorkerPersistentStorage)
+# ---------------------------------------------------------------------------
+
+
+class SourceState:
+    def __init__(self, log: SnapshotLog, committed_chunks: int, offset: Any):
+        self.log = log
+        self.committed_chunks = committed_chunks
+        self.offset = offset  # opaque reader frontier
+        self.pending_offset: Any = offset
+
+
+class PersistentStorage:
+    """Coordinates snapshot logs + the consistent-metadata commit for a worker."""
+
+    def __init__(
+        self,
+        backend: BlobBackend,
+        *,
+        worker: int = 0,
+        snapshot_interval_ms: int = 0,
+        mode: Any = None,
+    ):
+        self.backend = backend
+        self.worker = worker
+        self.snapshot_interval_ms = snapshot_interval_ms
+        self.mode = mode
+        self.sources: dict[str, SourceState] = {}
+        self._metadata = self._load_metadata()
+        self.replayed_rows = 0
+
+    # -- metadata --
+    def _meta_key(self) -> str:
+        return f"{METADATA_FILE}.{self.worker}"
+
+    def _load_metadata(self) -> dict:
+        raw = self.backend.get(self._meta_key())
+        if raw is None:
+            return {"sources": {}}
+        return _json.loads(raw.decode())
+
+    def commit(self) -> None:
+        """Atomically record the current consistent snapshot frontier.
+
+        Only chunks flushed at offset markers are committed — the mid-batch
+        event buffer stays out, so the committed (chunks, offset) pair always
+        refers to the same row prefix.  No-op when nothing advanced.
+        """
+        for sid, st in self.sources.items():
+            st.committed_chunks = st.log.chunks_written
+            st.offset = st.pending_offset
+        metadata = {
+            "sources": {
+                sid: {
+                    "chunks": st.committed_chunks,
+                    "offset": _offset_to_json(st.offset),
+                }
+                for sid, st in self.sources.items()
+            }
+        }
+        if metadata == self._metadata:
+            return
+        self._metadata = metadata
+        self.backend.put_atomic(
+            self._meta_key(), _json.dumps(self._metadata).encode()
+        )
+
+    @property
+    def input_snapshots_enabled(self) -> bool:
+        """False for UDF-caching-only mode (PersistenceMode::UdfCaching,
+        src/connectors/mod.rs:114): the persistence root backs UDF caches but
+        sources are neither snapshotted nor replayed."""
+        name = getattr(self.mode, "name", None)
+        return name != "UDF_CACHING"
+
+    # -- sources --
+    def register_source(self, source_id: str) -> SourceState:
+        if source_id in self.sources:
+            raise ValueError(
+                f"persistence: duplicate source name {source_id!r}; give each "
+                "persisted connector a unique name="
+            )
+        log = SnapshotLog(self.backend, self.worker, source_id)
+        meta = self._metadata["sources"].get(source_id, {})
+        committed = int(meta.get("chunks", 0))
+        offset = _offset_from_json(meta.get("offset"))
+        log.chunks_written = committed  # append after the committed prefix
+        state = SourceState(log, committed, offset)
+        self.sources[source_id] = state
+        return state
+
+    def replay_into(self, state: SourceState, insert) -> int:
+        """Feed committed events into an input session at rewind time 0.
+
+        Returns the number of replayed row events (mod.rs:222-258 rewind).
+        """
+        n = 0
+        for kind, key, row, _t in state.log.read_committed(state.committed_chunks):
+            if kind == codec.EV_INSERT:
+                insert(key, row, 1)
+                n += 1
+            elif kind == codec.EV_DELETE:
+                insert(key, row, -1)
+                n += 1
+        self.replayed_rows += n
+        return n
+
+
+def _offset_to_json(offset: Any) -> Any:
+    if offset is None:
+        return None
+    try:
+        _json.dumps(offset)
+        return {"j": offset}
+    except (TypeError, ValueError):
+        return {"p": pickle.dumps(offset).hex()}
+
+
+def _offset_from_json(obj: Any) -> Any:
+    if obj is None:
+        return None
+    if "j" in obj:
+        return obj["j"]
+    return pickle.loads(bytes.fromhex(obj["p"]))

@@ -59,8 +59,9 @@ def run(
     scope.terminate_on_error = terminate_on_error
     lowerer = Lowerer(scope)
 
-    if persistence_config is not None:
-        lowerer.persistence = persistence_config  # consumed by connectors
+    storage = _make_storage(persistence_config)
+    if storage is not None:
+        lowerer.persistence_storage = storage
 
     # lower all sinks (tree-shaking is implicit: only sink cones are built)
     for name, table, attach in list(G.sinks):
@@ -69,14 +70,40 @@ def run(
 
     result = RunResult()
     try:
-        _event_loop(scope, lowerer, result, max_epochs=max_epochs)
+        _event_loop(scope, lowerer, result, max_epochs=max_epochs, storage=storage)
     finally:
+        if storage is not None:
+            # also on interrupt/error: commit whatever frontier is consistent
+            storage.commit()
         for cleanup in lowerer.cleanups:
             try:
                 cleanup()
             except Exception:
                 pass
     return result
+
+
+def _make_storage(persistence_config: Any):
+    """Build engine PersistentStorage from a ``pw.persistence.Config``."""
+    if persistence_config is None:
+        return None
+    backend_cfg = getattr(persistence_config, "backend", None)
+    if backend_cfg is None:
+        return None
+    from pathway_tpu.engine import persistence as pz
+
+    backend = pz.backend_from_config(backend_cfg)
+    # UDF DiskCache shares the persistence root (PersistenceMode::UdfCaching,
+    # src/connectors/mod.rs:114, udfs/caches.py:35)
+    import os as _os
+
+    if isinstance(backend, pz.FileBackend):
+        _os.environ.setdefault("PATHWAY_PERSISTENT_STORAGE", backend.root)
+    return pz.PersistentStorage(
+        backend,
+        snapshot_interval_ms=getattr(persistence_config, "snapshot_interval_ms", 0),
+        mode=getattr(persistence_config, "persistence_mode", None),
+    )
 
 
 def run_all(**kwargs: Any) -> RunResult:
@@ -92,11 +119,24 @@ def _event_loop(
     lowerer: Lowerer,
     result: RunResult,
     max_epochs: int | None = None,
+    storage: Any = None,
 ) -> None:
     inputs = _input_nodes(scope)
     pollers = lowerer.pollers
     last_time = -1
+    # snapshot_interval_ms=0 means "as often as possible" (reference
+    # persistence/__init__.py:95-101); commit() no-ops when nothing advanced
+    snapshot_interval = (
+        (storage.snapshot_interval_ms / 1000.0) if storage is not None else None
+    )
+    last_snapshot = _time.monotonic()
     while True:
+        if (
+            storage is not None
+            and (_time.monotonic() - last_snapshot) >= snapshot_interval
+        ):
+            storage.commit()
+            last_snapshot = _time.monotonic()
         exhausted = True
         for poller in pollers:
             if not poller.poll():

@@ -108,6 +108,8 @@ class Lowerer:
         self.memo: dict[int, df.Node] = {}
         self.pollers: list[Any] = []  # objects with .poll() -> bool(finished)
         self.cleanups: list[Callable[[], None]] = []
+        self.persistence_storage: Any = None  # engine.persistence.PersistentStorage
+        self._source_counter = 0
 
     def node(self, table: "Table") -> df.Node:
         key = id(table)

@@ -18,7 +18,7 @@ import time as _time
 from typing import Any, Callable, Iterator
 
 from pathway_tpu.engine.types import Json
-from pathway_tpu.io._utils import COMMIT, Reader
+from pathway_tpu.io._utils import COMMIT, Offset, Reader
 
 
 def _list_files(path: str) -> list[str]:
@@ -53,7 +53,14 @@ def _metadata(path: str) -> Json:
 
 
 class FileReader(Reader):
-    """Scans `path`; parses each file with `parse_file`; optionally polls."""
+    """Scans `path`; parses each file with `parse_file`; optionally polls.
+
+    Persistence: the offset frontier is the per-file progress map
+    ``{path: [mtime, consumed_units]}`` (the role the offset antichain +
+    cached object storage play for PosixLikeReader, posix_like.rs:39).
+    """
+
+    supports_offsets = True
 
     def __init__(
         self,
@@ -92,6 +99,14 @@ class FileReader(Reader):
         self._progress[path] = (mtime, new_offset)
         return emitted
 
+    def seek(self, offset) -> None:
+        self._progress = {
+            path: (float(mtime), int(units)) for path, (mtime, units) in offset.items()
+        }
+
+    def _offset(self) -> Offset:
+        return Offset({p: [m, u] for p, (m, u) in self._progress.items()})
+
     def run(self, emit) -> None:
         while True:
             emitted = False
@@ -99,8 +114,11 @@ class FileReader(Reader):
                 if self._emit_file(path, emit):
                     emitted = True
             if emitted:
+                emit(self._offset())
                 emit(COMMIT)
             if not self.streaming:
+                if not emitted:
+                    emit(self._offset())
                 return
             _time.sleep(self.poll_interval)
 

@@ -18,7 +18,7 @@ import time as _time
 from typing import Any, Callable, Iterable, Mapping
 
 from pathway_tpu.engine import dataflow as df
-from pathway_tpu.engine.types import Json, hash_values, sequential_key
+from pathway_tpu.engine.types import KEY_MASK, Json, hash_values, sequential_key
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import schema as schema_mod
 from pathway_tpu.internals.parse_graph import G
@@ -29,14 +29,61 @@ FINISH = object()  # sentinel: source exhausted
 DELETE = "_pw_delete"  # row dict flag for deletions / upserts
 
 
+class Offset:
+    """Reader frontier marker: everything emitted before this message is
+    covered by ``value`` (the offset-antichain analog, persistence/frontier.rs).
+    Must be JSON-able or picklable."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any):
+        self.value = value
+
+
 class Reader:
-    """Runs on its own thread; yields row dicts / COMMIT / FINISH."""
+    """Runs on its own thread; yields row dicts / COMMIT / FINISH / Offset.
+
+    Readers that manage their own offset frontier (e.g. file scanners) set
+    ``supports_offsets = True``, emit ``Offset`` markers, and implement
+    ``seek``.  Others get a generic emitted-row-count frontier from the
+    connector plumbing (the PythonReader strategy, data_storage.rs:806).
+    """
+
+    supports_offsets = False
 
     def run(self, emit: Callable[[Any], None]) -> None:
         raise NotImplementedError
 
     def seek(self, offset: Any) -> None:  # persistence hook
         pass
+
+
+class _RowCountEmit:
+    """Wraps the queue put: counts data rows, skips the first ``skip`` after a
+    resume, and stamps a row-count Offset at every commit."""
+
+    __slots__ = ("put", "count", "skip")
+
+    def __init__(self, put: Callable[[Any], None], skip: int):
+        self.put = put
+        self.count = 0
+        self.skip = skip
+
+    def __call__(self, item: Any) -> None:
+        if item is COMMIT or item is FINISH:
+            # never regress below the persisted frontier: a resumed
+            # nondeterministic source may emit fewer rows than last run,
+            # but the committed chunks already cover `skip` rows
+            self.put(Offset({"rows": max(self.count, self.skip)}))
+            self.put(item)
+            return
+        if isinstance(item, Offset):
+            self.put(item)
+            return
+        self.count += 1
+        if self.count <= self.skip:
+            return
+        self.put(item)
 
 
 class _QueuePoller:
@@ -62,11 +109,14 @@ class _QueuePoller:
         self._staged = False
         self._last_commit = _time.monotonic()
         self.finished = False
+        self.persist_state: Any = None  # engine.persistence.SourceState
 
     def _key_of(self, values: list, row: Mapping) -> int:
         if "_pw_key" in row:
             k = row["_pw_key"]
-            return k if isinstance(k, int) else hash_values([k])
+            # normalize into the 128-bit key space (value.rs Key is u128) so
+            # live keys and snapshot-replayed keys agree
+            return (k & KEY_MASK) if isinstance(k, int) else hash_values([k])
         if self.pk:
             return hash_values([values[self.names.index(c)] for c in self.pk])
         return sequential_key(next(self._seq))
@@ -93,13 +143,25 @@ class _QueuePoller:
                     self._staged = False
                     self._last_commit = _time.monotonic()
                 continue
+            if isinstance(item, Offset):
+                # snapshot chunks flush exactly at offset markers so the
+                # committed (chunks, offset) pair always refers to the same
+                # row prefix — the consistency rule tracker.rs enforces with
+                # its offset antichains
+                if self.persist_state is not None:
+                    self.persist_state.pending_offset = item.value
+                    self.persist_state.log.flush_chunk()
+                continue
             row = item
             diff = -1 if row.get(DELETE) else 1
             values = [
                 dt.coerce(row.get(n), d) for n, d in zip(self.names, self.dtypes)
             ]
             key = self._key_of(values, row)
-            self.input_node.insert(key, tuple(values), self._time, diff)
+            vrow = tuple(values)
+            self.input_node.insert(key, vrow, self._time, diff)
+            if self.persist_state is not None:
+                self.persist_state.log.record(key, vrow, diff)
             self._staged = True
         if self._staged and (_time.monotonic() - self._last_commit) >= self.autocommit:
             self._time += 2
@@ -126,9 +188,33 @@ def make_input_table(
         poller = _QueuePoller(node, schema, autocommit_duration_ms)
         reader = reader_factory()
 
+        # persistence: replay committed snapshot, seek reader past it
+        storage = getattr(lowerer, "persistence_storage", None)
+        if storage is not None and not storage.input_snapshots_enabled:
+            storage = None  # UDF-caching-only mode: no input snapshots
+        skip_rows = 0
+        if storage is not None:
+            counter = getattr(lowerer, "_source_counter", 0)
+            lowerer._source_counter = counter + 1
+            sid = name or f"source_{counter}"
+            state = storage.register_source(sid)
+            storage.replay_into(
+                state, lambda k, r, d: node.insert(k, r, 0, d)
+            )
+            poller.persist_state = state
+            if state.offset is not None:
+                if reader.supports_offsets:
+                    reader.seek(state.offset)
+                else:
+                    skip_rows = int(state.offset.get("rows", 0))
+
+        emit = poller.q.put if reader.supports_offsets else _RowCountEmit(
+            poller.q.put, skip_rows
+        )
+
         def target():
             try:
-                reader.run(poller.q.put)
+                reader.run(emit)
             except Exception as exc:  # surface reader errors at finish
                 import logging
 
@@ -136,12 +222,11 @@ def make_input_table(
                     "connector reader failed: %s", exc
                 )
             finally:
-                poller.q.put(FINISH)
+                emit(FINISH)
 
         thread = threading.Thread(target=target, name="pathway:connector", daemon=True)
         thread.start()
         lowerer.pollers.append(poller)
-        lowerer.cleanups.append(lambda: None)
         return node
 
     return Table(schema, build, universe=Universe())
@@ -161,7 +246,7 @@ def make_static_input_table(
         values = [dt.coerce(row.get(n), d) for n, d in zip(names, dtypes)]
         if "_pw_key" in row:
             k = row["_pw_key"]
-            key = k if isinstance(k, int) else hash_values([k])
+            key = (k & KEY_MASK) if isinstance(k, int) else hash_values([k])
         elif pk:
             key = hash_values([values[names.index(c)] for c in pk])
         else:

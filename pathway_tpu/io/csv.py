@@ -73,6 +73,7 @@ def read(
             path, typed_parse, streaming=streaming, with_metadata=with_metadata
         ),
         autocommit_duration_ms=autocommit_duration_ms,
+        name=name,
     )
 
 

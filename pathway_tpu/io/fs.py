@@ -79,6 +79,7 @@ def read(
             path, parse, streaming=streaming, with_metadata=with_metadata
         ),
         autocommit_duration_ms=autocommit_duration_ms,
+        name=name,
     )
 
 

@@ -95,4 +95,5 @@ def read(
         schema,
         lambda: _SubjectReader(subject),
         autocommit_duration_ms=autocommit_duration_ms,
+        name=name,
     )

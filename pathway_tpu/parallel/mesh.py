@@ -43,9 +43,7 @@ def make_mesh(
         devices = jax.devices()
         if n_devices is not None:
             devices = devices[:n_devices]
-    data, model = mesh_shape_for(len(devices))
-    if model > max_model:
-        data, model = mesh_shape_for(len(devices), max_model)
+    data, model = mesh_shape_for(len(devices), max_model)
     grid = np.asarray(devices).reshape(data, model)
     return Mesh(grid, AXES)
 

@@ -68,7 +68,11 @@ class BaseRestServer:
             backend = cache_backend or _persistence.Backend.filesystem(
                 "./Cache"
             )
-            persistence_config = _persistence.Config(backend)
+            # UDF-cache-only: input snapshotting stays off, so restarting the
+            # server does not replay old HTTP query rows
+            persistence_config = _persistence.Config(
+                backend, persistence_mode=pw.PersistenceMode.UDF_CACHING
+            )
             if backend.kind == "filesystem":
                 # UDF DiskCache reads this root (caches.py)
                 os.environ.setdefault("PATHWAY_PERSISTENT_STORAGE", backend.path)
