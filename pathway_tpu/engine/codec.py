@@ -218,7 +218,7 @@ def decode_value(buf: memoryview, pos: int) -> tuple[Any, int]:
     raise ValueError(f"codec: unknown value tag {tag}")
 
 
-def encode_row(values: Iterable[Any]) -> bytes:
+def encode_row_py(values: Iterable[Any]) -> bytes:
     out = _io.BytesIO()
     vals = tuple(values)
     _w_len(out, len(vals))
@@ -227,7 +227,7 @@ def encode_row(values: Iterable[Any]) -> bytes:
     return out.getvalue()
 
 
-def decode_row(data: bytes | memoryview, pos: int = 0) -> tuple[tuple, int]:
+def decode_row_py(data: bytes | memoryview, pos: int = 0) -> tuple[tuple, int]:
     buf = memoryview(data)
     n, pos = _r_len(buf, pos)
     items = []
@@ -235,6 +235,24 @@ def decode_row(data: bytes | memoryview, pos: int = 0) -> tuple[tuple, int]:
         item, pos = decode_value(buf, pos)
         items.append(item)
     return tuple(items), pos
+
+
+def encode_row(values: Iterable[Any]) -> bytes:
+    from pathway_tpu.engine.types import _native
+
+    native = _native()
+    if native is not None:
+        return native.encode_row(tuple(values))
+    return encode_row_py(values)
+
+
+def decode_row(data: bytes | memoryview, pos: int = 0) -> tuple[tuple, int]:
+    from pathway_tpu.engine.types import _native
+
+    native = _native()
+    if native is not None:
+        return native.decode_row(data, pos)
+    return decode_row_py(data, pos)
 
 
 # --- snapshot events ---------------------------------------------------------

@@ -34,6 +34,21 @@ from pathway_tpu.engine import codec
 
 METADATA_FILE = "metadata.json"
 
+# Filesystem root of the persistence backend of the currently-running
+# pipeline (UDF DiskCache reads it; PersistenceMode::UdfCaching,
+# src/connectors/mod.rs:114).  Scoped to pw.run() — set/cleared by the
+# runner, never leaked into process env.
+_active_root: str | None = None
+
+
+def set_active_root(root: str | None) -> None:
+    global _active_root
+    _active_root = root
+
+
+def active_root() -> str | None:
+    return _active_root
+
 
 # ---------------------------------------------------------------------------
 # Blob backends (backends/{file,memory,mock,s3}.rs)
@@ -68,10 +83,14 @@ class FileBackend(BlobBackend):
         return os.path.join(self.root, *key.split("/"))
 
     def put(self, key: str, data: bytes) -> None:
+        # fsync: the metadata commit (put_atomic) durably references chunks,
+        # so chunks themselves must be durable first
         path = self._path(key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "wb") as f:
             f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
 
     def put_atomic(self, key: str, data: bytes) -> None:
         path = self._path(key)
@@ -206,6 +225,7 @@ class SourceState:
         self.committed_chunks = committed_chunks
         self.offset = offset  # opaque reader frontier
         self.pending_offset: Any = offset
+        self.schema_digest: str | None = None
 
 
 class PersistentStorage:
@@ -252,6 +272,7 @@ class PersistentStorage:
                 sid: {
                     "chunks": st.committed_chunks,
                     "offset": _offset_to_json(st.offset),
+                    "schema": st.schema_digest,
                 }
                 for sid, st in self.sources.items()
             }
@@ -272,7 +293,9 @@ class PersistentStorage:
         return name != "UDF_CACHING"
 
     # -- sources --
-    def register_source(self, source_id: str) -> SourceState:
+    def register_source(
+        self, source_id: str, schema_digest: str | None = None
+    ) -> SourceState:
         if source_id in self.sources:
             raise ValueError(
                 f"persistence: duplicate source name {source_id!r}; give each "
@@ -280,10 +303,25 @@ class PersistentStorage:
             )
         log = SnapshotLog(self.backend, self.worker, source_id)
         meta = self._metadata["sources"].get(source_id, {})
+        stored_digest = meta.get("schema")
+        if (
+            schema_digest is not None
+            and stored_digest is not None
+            and stored_digest != schema_digest
+        ):
+            # positional ids shift when unnamed sources are added/reordered;
+            # refuse to replay another source's snapshot into this input
+            raise ValueError(
+                f"persistence: source {source_id!r} has a snapshot with a "
+                "different schema — the program changed between runs. Give "
+                "persisted connectors stable name= arguments (or clear the "
+                "persistence directory)."
+            )
         committed = int(meta.get("chunks", 0))
         offset = _offset_from_json(meta.get("offset"))
         log.chunks_written = committed  # append after the committed prefix
         state = SourceState(log, committed, offset)
+        state.schema_digest = schema_digest
         self.sources[source_id] = state
         return state
 

@@ -298,8 +298,34 @@ def _ser_value(v: Any, out: list[bytes]) -> None:
         out.append(b"\x0a" + type(v).__name__.encode() + b":" + repr(v).encode())
 
 
+_native_mod: Any = None
+_native_checked = False
+
+
+def _native():
+    """The compiled runtime core (pathway_tpu/native), or None."""
+    global _native_mod, _native_checked
+    if not _native_checked:
+        from pathway_tpu import native as _n
+
+        _native_mod = _n.get()
+        _native_checked = True
+    return _native_mod
+
+
 def hash_values(values: Iterable[Any]) -> int:
     """Stable 128-bit hash of a value sequence (key derivation)."""
+    native = _native()
+    if native is not None:
+        return native.hash_values(tuple(values))
+    out: list[bytes] = []
+    for v in values:
+        _ser_value(v, out)
+    return _hash_bytes(b"".join(out))
+
+
+def hash_values_py(values: Iterable[Any]) -> int:
+    """Pure-Python reference path (native parity tests)."""
     out: list[bytes] = []
     for v in values:
         _ser_value(v, out)

@@ -69,12 +69,21 @@ def run(
         attach(lowerer, node)
 
     result = RunResult()
+    if storage is not None:
+        from pathway_tpu.engine import persistence as pz
+
+        if isinstance(storage.backend, pz.FileBackend):
+            # UDF DiskCache shares the persistence root for this run only
+            pz.set_active_root(storage.backend.root)
     try:
         _event_loop(scope, lowerer, result, max_epochs=max_epochs, storage=storage)
     finally:
         if storage is not None:
             # also on interrupt/error: commit whatever frontier is consistent
             storage.commit()
+            from pathway_tpu.engine import persistence as pz
+
+            pz.set_active_root(None)
         for cleanup in lowerer.cleanups:
             try:
                 cleanup()
@@ -93,12 +102,6 @@ def _make_storage(persistence_config: Any):
     from pathway_tpu.engine import persistence as pz
 
     backend = pz.backend_from_config(backend_cfg)
-    # UDF DiskCache shares the persistence root (PersistenceMode::UdfCaching,
-    # src/connectors/mod.rs:114, udfs/caches.py:35)
-    import os as _os
-
-    if isinstance(backend, pz.FileBackend):
-        _os.environ.setdefault("PATHWAY_PERSISTENT_STORAGE", backend.root)
     return pz.PersistentStorage(
         backend,
         snapshot_interval_ms=getattr(persistence_config, "snapshot_interval_ms", 0),

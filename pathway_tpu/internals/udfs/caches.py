@@ -56,8 +56,20 @@ class DiskCache(CacheStrategy):
     def __init__(self, name: str | None = None, size_limit: int | None = None):
         self.name = name
         self.size_limit = size_limit
-        root = os.environ.get("PATHWAY_PERSISTENT_STORAGE", ".pathway_tpu_cache")
-        self._dir = os.path.join(root, "udf_cache", name or "default")
+
+    @property
+    def _dir(self) -> str:
+        # resolved per call: the running pipeline's persistence root wins,
+        # then the env override, then a local default — so two runs with
+        # different persistence roots in one process stay isolated
+        from pathway_tpu.engine import persistence as pz
+
+        root = (
+            pz.active_root()
+            or os.environ.get("PATHWAY_PERSISTENT_STORAGE")
+            or ".pathway_tpu_cache"
+        )
+        return os.path.join(root, "udf_cache", self.name or "default")
 
     def _path(self, key: str) -> str:
         return os.path.join(self._dir, key + ".pkl")

@@ -45,11 +45,14 @@ class Reader:
 
     Readers that manage their own offset frontier (e.g. file scanners) set
     ``supports_offsets = True``, emit ``Offset`` markers, and implement
-    ``seek``.  Others get a generic emitted-row-count frontier from the
-    connector plumbing (the PythonReader strategy, data_storage.rs:806).
+    ``seek``.  Readers whose *external system* resumes past consumed data on
+    its own (Kafka consumer groups) set ``external_resume = True`` — they get
+    neither snapshot-replay skipping nor row counting.  Others get a generic
+    emitted-row-count frontier (the PythonReader strategy, data_storage.rs:806).
     """
 
     supports_offsets = False
+    external_resume = False
 
     def run(self, emit: Callable[[Any], None]) -> None:
         raise NotImplementedError
@@ -110,6 +113,9 @@ class _QueuePoller:
         self._last_commit = _time.monotonic()
         self.finished = False
         self.persist_state: Any = None  # engine.persistence.SourceState
+        # external-resume sources emit no Offset markers; their chunks flush
+        # at commit boundaries instead (offset frontier stays None)
+        self.flush_on_commit = False
 
     def _key_of(self, values: list, row: Mapping) -> int:
         if "_pw_key" in row:
@@ -134,6 +140,8 @@ class _QueuePoller:
             if item is FINISH:
                 if self._staged:
                     self._time += 2
+                if self.flush_on_commit and self.persist_state is not None:
+                    self.persist_state.log.flush_chunk()
                 self.input_node.close()
                 self.finished = True
                 return True
@@ -142,6 +150,8 @@ class _QueuePoller:
                     self._time += 2
                     self._staged = False
                     self._last_commit = _time.monotonic()
+                    if self.flush_on_commit and self.persist_state is not None:
+                        self.persist_state.log.flush_chunk()
                 continue
             if isinstance(item, Offset):
                 # snapshot chunks flush exactly at offset markers so the
@@ -197,7 +207,11 @@ def make_input_table(
             counter = getattr(lowerer, "_source_counter", 0)
             lowerer._source_counter = counter + 1
             sid = name or f"source_{counter}"
-            state = storage.register_source(sid)
+            digest = "|".join(
+                f"{n}:{schema.__columns__[n].dtype}"
+                for n in schema.__columns__
+            )
+            state = storage.register_source(sid, schema_digest=digest)
             storage.replay_into(
                 state, lambda k, r, d: node.insert(k, r, 0, d)
             )
@@ -205,12 +219,14 @@ def make_input_table(
             if state.offset is not None:
                 if reader.supports_offsets:
                     reader.seek(state.offset)
-                else:
+                elif not reader.external_resume:
                     skip_rows = int(state.offset.get("rows", 0))
 
-        emit = poller.q.put if reader.supports_offsets else _RowCountEmit(
-            poller.q.put, skip_rows
-        )
+        poller.flush_on_commit = reader.external_resume
+        if reader.supports_offsets or reader.external_resume:
+            emit = poller.q.put
+        else:
+            emit = _RowCountEmit(poller.q.put, skip_rows)
 
         def target():
             try:

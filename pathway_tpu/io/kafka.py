@@ -38,6 +38,11 @@ def _get_client():
 
 
 class _KafkaReader(Reader):
+    # the broker tracks the consumer-group offset: on restart the consumer
+    # resumes past consumed messages itself, so the generic row-count
+    # frontier must NOT additionally skip rows (it would drop fresh data)
+    external_resume = True
+
     def __init__(self, rdkafka_settings, topic, format, schema):
         self.settings = rdkafka_settings
         self.topic = topic
@@ -106,6 +111,7 @@ def read(
         schema,
         lambda: _KafkaReader(rdkafka_settings, topic, format, schema),
         autocommit_duration_ms=autocommit_duration_ms,
+        name=name,
     )
 
 

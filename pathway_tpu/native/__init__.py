@@ -1,0 +1,133 @@
+"""Native runtime core loader.
+
+Compiles ``src/_native.cpp`` with g++ on first use (cached as a .so keyed by
+the source hash), registers the engine's value classes and slow-path codec
+helpers, and exposes the module.  Pure-Python fallbacks stay in place when
+compilation is unavailable (``PATHWAY_NATIVE=0`` forces them).
+
+Parity role: the reference's value/key/snapshot hot paths are Rust
+(src/engine/value.rs, src/persistence/input_snapshot.rs); here they are C++
+behind the same Python interfaces.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "src", "_native.cpp")
+_BUILD_DIR = os.path.join(_HERE, "build")
+
+_lock = threading.Lock()
+_loaded = False
+_module = None
+
+
+def _compile() -> str | None:
+    with open(_SRC, "rb") as f:
+        src_hash = hashlib.blake2b(f.read(), digest_size=8).hexdigest()
+    so_path = os.path.join(_BUILD_DIR, f"_native_{src_hash}.so")
+    if os.path.exists(so_path):
+        return so_path
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "g++",
+        "-O2",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        f"-I{include}",
+        _SRC,
+        "-o",
+        so_path + ".tmp",
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, text=True, timeout=120
+        )
+    except (subprocess.SubprocessError, OSError) as exc:
+        import logging
+
+        detail = getattr(exc, "stderr", "") or str(exc)
+        logging.getLogger("pathway_tpu.native").warning(
+            "native core build failed, using Python fallbacks: %s", detail[-2000:]
+        )
+        return None
+    os.replace(so_path + ".tmp", so_path)
+    return so_path
+
+
+def _load():
+    so_path = _compile()
+    if so_path is None:
+        return None
+    # module name must match PyInit__native
+    spec = importlib.util.spec_from_file_location("_native", so_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    # register classes + slow-path helpers
+    import numpy as np
+
+    from pathway_tpu.engine import codec
+    from pathway_tpu.engine import types as tz
+
+    def encode_slow(v):
+        import io as _io
+
+        out = _io.BytesIO()
+        codec.encode_value(v, out)
+        return out.getvalue()
+
+    def decode_slow(tag, view, pos):
+        # pos points just past the tag byte; codec.decode_value re-reads it
+        return codec.decode_value(view, pos - 1)
+
+    def ser_slow(v):
+        out: list[bytes] = []
+        tz._ser_value(v, out)
+        return b"".join(out)
+
+    mod.setup(
+        tz.Pointer,
+        tz.Json,
+        tz.PyObjectWrapper,
+        np.ndarray,
+        tz.ERROR,
+        encode_slow,
+        decode_slow,
+        ser_slow,
+    )
+    return mod
+
+
+def get():
+    """The native module, or None when disabled/unavailable."""
+    global _loaded, _module
+    if _loaded:
+        return _module
+    with _lock:
+        if _loaded:
+            return _module
+        if os.environ.get("PATHWAY_NATIVE", "1") == "0":
+            _module = None
+        else:
+            try:
+                _module = _load()
+            except Exception:
+                import logging
+
+                logging.getLogger("pathway_tpu.native").warning(
+                    "native core unavailable, using Python fallbacks",
+                    exc_info=True,
+                )
+                _module = None
+        _loaded = True
+    return _module

@@ -1,0 +1,620 @@
+// Native runtime core for pathway_tpu.
+//
+// Parity role: the reference implements its value model, key derivation and
+// snapshot serialization in Rust (/root/reference/src/engine/value.rs:207-228
+// "HashInto" key hashing, bincode snapshot encoding in
+// src/persistence/input_snapshot.rs).  This is the TPU build's native
+// equivalent: a CPython extension implementing
+//
+//   * blake2b-128 (RFC 7693) — the stable key-derivation hash,
+//     bit-identical to hashlib.blake2b(digest_size=16),
+//   * the value-hash serialization of engine/types.py:_ser_value,
+//   * the PWT1 row codec of engine/codec.py (encode_row/decode_row),
+//
+// with fast inline paths for the scalar types that dominate row traffic and
+// delegation to registered Python helpers for the long tail (ndarray, Json,
+// datetime, pickled objects), so the wire format stays defined in exactly
+// one place per type.
+//
+// Built with plain g++ (no pybind11 in this environment); loaded lazily by
+// pathway_tpu/native/__init__.py with a pure-Python fallback.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+// ---------------------------------------------------------------------------
+// blake2b (RFC 7693), single-shot, no key
+// ---------------------------------------------------------------------------
+
+static const uint64_t B2B_IV[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL, 0x3c6ef372fe94f82bULL,
+    0xa54ff53a5f1d36f1ULL, 0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+
+static const uint8_t B2B_SIGMA[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+static inline uint64_t rotr64(uint64_t x, int n) {
+  return (x >> n) | (x << (64 - n));
+}
+
+static inline uint64_t load64(const uint8_t *p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);  // little-endian hosts only (x86/ARM)
+  return v;
+}
+
+static void b2b_compress(uint64_t h[8], const uint8_t block[128], uint64_t t0,
+                         uint64_t t1, bool last) {
+  uint64_t v[16], m[16];
+  for (int i = 0; i < 8; i++) v[i] = h[i];
+  for (int i = 0; i < 8; i++) v[i + 8] = B2B_IV[i];
+  v[12] ^= t0;
+  v[13] ^= t1;
+  if (last) v[14] = ~v[14];
+  for (int i = 0; i < 16; i++) m[i] = load64(block + 8 * i);
+
+#define G(a, b, c, d, x, y)       \
+  v[a] = v[a] + v[b] + (x);       \
+  v[d] = rotr64(v[d] ^ v[a], 32); \
+  v[c] = v[c] + v[d];             \
+  v[b] = rotr64(v[b] ^ v[c], 24); \
+  v[a] = v[a] + v[b] + (y);       \
+  v[d] = rotr64(v[d] ^ v[a], 16); \
+  v[c] = v[c] + v[d];             \
+  v[b] = rotr64(v[b] ^ v[c], 63);
+
+  for (int r = 0; r < 12; r++) {
+    const uint8_t *s = B2B_SIGMA[r];
+    G(0, 4, 8, 12, m[s[0]], m[s[1]]);
+    G(1, 5, 9, 13, m[s[2]], m[s[3]]);
+    G(2, 6, 10, 14, m[s[4]], m[s[5]]);
+    G(3, 7, 11, 15, m[s[6]], m[s[7]]);
+    G(0, 5, 10, 15, m[s[8]], m[s[9]]);
+    G(1, 6, 11, 12, m[s[10]], m[s[11]]);
+    G(2, 7, 8, 13, m[s[12]], m[s[13]]);
+    G(3, 4, 9, 14, m[s[14]], m[s[15]]);
+  }
+#undef G
+  for (int i = 0; i < 8; i++) h[i] ^= v[i] ^ v[i + 8];
+}
+
+static void blake2b_hash(uint8_t *out, size_t outlen, const uint8_t *in,
+                         size_t inlen) {
+  uint64_t h[8];
+  for (int i = 0; i < 8; i++) h[i] = B2B_IV[i];
+  h[0] ^= 0x01010000ULL ^ (uint64_t)outlen;  // param: digest len, fanout=depth=1
+
+  uint64_t t = 0;
+  uint8_t block[128];
+  while (inlen > 128) {
+    t += 128;
+    b2b_compress(h, in, t, 0, false);
+    in += 128;
+    inlen -= 128;
+  }
+  t += inlen;
+  std::memset(block, 0, 128);
+  if (inlen) std::memcpy(block, in, inlen);
+  b2b_compress(h, block, t, 0, true);
+
+  uint8_t full[64];
+  for (int i = 0; i < 8; i++) std::memcpy(full + 8 * i, &h[i], 8);
+  std::memcpy(out, full, outlen);
+}
+
+// ---------------------------------------------------------------------------
+// registered Python classes & helpers (set once via _native.setup(...))
+// ---------------------------------------------------------------------------
+
+static PyObject *g_pointer_cls = nullptr;      // engine.types.Pointer
+static PyObject *g_json_cls = nullptr;         // engine.types.Json
+static PyObject *g_pyobj_cls = nullptr;        // engine.types.PyObjectWrapper
+static PyObject *g_ndarray_cls = nullptr;      // numpy.ndarray
+static PyObject *g_error_obj = nullptr;        // engine.types.ERROR singleton
+static PyObject *g_encode_slow = nullptr;      // value -> bytes (PWT1)
+static PyObject *g_decode_slow = nullptr;      // (tag, memoryview, pos) -> (value, pos)
+static PyObject *g_ser_slow = nullptr;         // value -> bytes (hash ser)
+
+// value tags shared with engine/codec.py
+enum {
+  T_NONE = 0, T_FALSE = 1, T_TRUE = 2, T_INT = 3, T_BIGINT = 4, T_FLOAT = 5,
+  T_STR = 6, T_BYTES = 7, T_POINTER = 8, T_TUPLE = 9, T_NDARRAY = 10,
+  T_JSON = 11, T_DT_NAIVE = 12, T_DT_UTC = 13, T_DURATION = 14, T_ERROR = 15,
+  T_PYOBJECT = 16, T_DATE = 17,
+};
+
+struct Buf {
+  std::vector<uint8_t> d;
+  void u8(uint8_t b) { d.push_back(b); }
+  void raw(const void *p, size_t n) {
+    const uint8_t *q = (const uint8_t *)p;
+    d.insert(d.end(), q, q + n);
+  }
+  void u64(uint64_t v) { raw(&v, 8); }
+  void i64(int64_t v) { raw(&v, 8); }
+  void f64(double v) { raw(&v, 8); }
+};
+
+// append a Python int as 16-byte signed little-endian; returns false+sets
+// error on overflow (matching int.to_bytes(16, 'little', signed=True))
+static bool append_i128(Buf &out, PyObject *v) {
+  int overflow = 0;
+  long long ll = PyLong_AsLongLongAndOverflow(v, &overflow);
+  if (!overflow) {
+    if (ll == -1 && PyErr_Occurred()) return false;
+    uint8_t bytes[16];
+    std::memcpy(bytes, &ll, 8);
+    std::memset(bytes + 8, ll < 0 ? 0xFF : 0x00, 8);
+    out.raw(bytes, 16);
+    return true;
+  }
+  // v.to_bytes(16, 'little', signed=True); OverflowError propagates, as in
+  // the Python serializer
+  PyObject *meth = PyObject_GetAttrString(v, "to_bytes");
+  if (!meth) return false;
+  PyObject *args = Py_BuildValue("(is)", 16, "little");
+  PyObject *kwargs = Py_BuildValue("{s:O}", "signed", Py_True);
+  PyObject *res = PyObject_Call(meth, args, kwargs);
+  Py_DECREF(meth);
+  Py_DECREF(args);
+  Py_DECREF(kwargs);
+  if (!res) return false;  // OverflowError propagates, as in Python
+  out.raw(PyBytes_AS_STRING(res), PyBytes_GET_SIZE(res));
+  Py_DECREF(res);
+  return true;
+}
+
+// append Pointer.value as 16-byte unsigned little-endian
+static bool append_u128_attr(Buf &out, PyObject *ptr) {
+  PyObject *val = PyObject_GetAttrString(ptr, "value");
+  if (!val) return false;
+  uint64_t lo = 0, hi = 0;
+  PyObject *shifted = nullptr;
+  lo = PyLong_AsUnsignedLongLongMask(val);
+  PyObject *sixtyfour = PyLong_FromLong(64);
+  shifted = PyNumber_Rshift(val, sixtyfour);
+  Py_DECREF(sixtyfour);
+  Py_DECREF(val);
+  if (!shifted) return false;
+  hi = PyLong_AsUnsignedLongLongMask(shifted);
+  Py_DECREF(shifted);
+  out.raw(&lo, 8);
+  out.raw(&hi, 8);
+  return true;
+}
+
+// ---------------------------------------------------------------------------
+// hash serialization (mirror of engine/types.py:_ser_value)
+// ---------------------------------------------------------------------------
+
+static bool ser_value(PyObject *v, Buf &out) {
+  if (v == Py_None) {
+    out.u8(0x00);
+    return true;
+  }
+  if (v == Py_True) {
+    out.u8(0x01);
+    out.u8(0x01);
+    return true;
+  }
+  if (v == Py_False) {
+    out.u8(0x01);
+    out.u8(0x00);
+    return true;
+  }
+  if (PyLong_Check(v)) {
+    out.u8(0x02);
+    return append_i128(out, v);
+  }
+  if (PyFloat_Check(v)) {
+    out.u8(0x03);
+    out.f64(PyFloat_AS_DOUBLE(v));
+    return true;
+  }
+  if (PyUnicode_Check(v)) {
+    Py_ssize_t n;
+    const char *s = PyUnicode_AsUTF8AndSize(v, &n);
+    if (!s) return false;
+    out.u8(0x04);
+    out.u64((uint64_t)n);
+    out.raw(s, n);
+    return true;
+  }
+  if (PyBytes_Check(v)) {
+    out.u8(0x05);
+    out.u64((uint64_t)PyBytes_GET_SIZE(v));
+    out.raw(PyBytes_AS_STRING(v), PyBytes_GET_SIZE(v));
+    return true;
+  }
+  int is_ptr = PyObject_IsInstance(v, g_pointer_cls);
+  if (is_ptr < 0) return false;
+  if (is_ptr) {
+    out.u8(0x06);
+    return append_u128_attr(out, v);
+  }
+  if (PyTuple_Check(v)) {
+    out.u8(0x07);
+    Py_ssize_t n = PyTuple_GET_SIZE(v);
+    out.u64((uint64_t)n);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      if (!ser_value(PyTuple_GET_ITEM(v, i), out)) return false;
+    }
+    return true;
+  }
+  // long tail (ndarray, Json, PyObjectWrapper, repr fallback): Python helper
+  PyObject *b = PyObject_CallFunctionObjArgs(g_ser_slow, v, nullptr);
+  if (!b) return false;
+  out.raw(PyBytes_AS_STRING(b), PyBytes_GET_SIZE(b));
+  Py_DECREF(b);
+  return true;
+}
+
+// hash_values(iterable) -> 128-bit int
+static PyObject *py_hash_values(PyObject *, PyObject *arg) {
+  PyObject *seq = PySequence_Fast(arg, "hash_values expects a sequence");
+  if (!seq) return nullptr;
+  Buf out;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (!ser_value(PySequence_Fast_GET_ITEM(seq, i), out)) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+  }
+  Py_DECREF(seq);
+  uint8_t digest[16];
+  blake2b_hash(digest, 16, out.d.data(), out.d.size());
+  // int.from_bytes(digest, 'little')
+  uint64_t lo, hi;
+  std::memcpy(&lo, digest, 8);
+  std::memcpy(&hi, digest + 8, 8);
+  PyObject *plo = PyLong_FromUnsignedLongLong(lo);
+  PyObject *phi = PyLong_FromUnsignedLongLong(hi);
+  PyObject *sixtyfour = PyLong_FromLong(64);
+  PyObject *shifted = PyNumber_Lshift(phi, sixtyfour);
+  PyObject *res = PyNumber_Or(shifted, plo);
+  Py_DECREF(plo);
+  Py_DECREF(phi);
+  Py_DECREF(sixtyfour);
+  Py_DECREF(shifted);
+  return res;
+}
+
+// blake2b_128(data: bytes) -> bytes   (for tests / reuse)
+static PyObject *py_blake2b_128(PyObject *, PyObject *arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return nullptr;
+  uint8_t digest[16];
+  blake2b_hash(digest, 16, (const uint8_t *)view.buf, view.len);
+  PyBuffer_Release(&view);
+  return PyBytes_FromStringAndSize((const char *)digest, 16);
+}
+
+// ---------------------------------------------------------------------------
+// PWT1 codec (mirror of engine/codec.py)
+// ---------------------------------------------------------------------------
+
+static bool encode_value(PyObject *v, Buf &out) {
+  if (v == Py_None) {
+    out.u8(T_NONE);
+    return true;
+  }
+  if (v == Py_True) {
+    out.u8(T_TRUE);
+    return true;
+  }
+  if (v == Py_False) {
+    out.u8(T_FALSE);
+    return true;
+  }
+  if (PyLong_Check(v)) {
+    int overflow = 0;
+    long long ll = PyLong_AsLongLongAndOverflow(v, &overflow);
+    if (!overflow) {
+      if (ll == -1 && PyErr_Occurred()) return false;
+      out.u8(T_INT);
+      out.i64(ll);
+      return true;
+    }
+    // big int: length-prefixed signed little-endian, like codec.py
+    PyObject *nbits_obj = PyObject_CallMethod(v, "bit_length", nullptr);
+    if (!nbits_obj) return false;
+    size_t nbits = (size_t)PyLong_AsSize_t(nbits_obj);
+    Py_DECREF(nbits_obj);
+    if (nbits == (size_t)-1 && PyErr_Occurred()) return false;
+    size_t nbytes = (nbits + 8) / 8 + 1;  // (bit_length + 8) // 8 + 1
+    PyObject *meth = PyObject_GetAttrString(v, "to_bytes");
+    if (!meth) return false;
+    PyObject *args = Py_BuildValue("(ns)", (Py_ssize_t)nbytes, "little");
+    PyObject *kwargs = Py_BuildValue("{s:O}", "signed", Py_True);
+    PyObject *res = PyObject_Call(meth, args, kwargs);
+    Py_DECREF(meth);
+    Py_DECREF(args);
+    Py_DECREF(kwargs);
+    if (!res) return false;
+    out.u8(T_BIGINT);
+    out.u64((uint64_t)PyBytes_GET_SIZE(res));
+    out.raw(PyBytes_AS_STRING(res), PyBytes_GET_SIZE(res));
+    Py_DECREF(res);
+    return true;
+  }
+  if (PyFloat_Check(v)) {
+    out.u8(T_FLOAT);
+    out.f64(PyFloat_AS_DOUBLE(v));
+    return true;
+  }
+  if (PyUnicode_Check(v)) {
+    Py_ssize_t n;
+    const char *s = PyUnicode_AsUTF8AndSize(v, &n);
+    if (!s) return false;
+    out.u8(T_STR);
+    out.u64((uint64_t)n);
+    out.raw(s, n);
+    return true;
+  }
+  if (PyBytes_Check(v)) {
+    out.u8(T_BYTES);
+    out.u64((uint64_t)PyBytes_GET_SIZE(v));
+    out.raw(PyBytes_AS_STRING(v), PyBytes_GET_SIZE(v));
+    return true;
+  }
+  int is_ptr = PyObject_IsInstance(v, g_pointer_cls);
+  if (is_ptr < 0) return false;
+  if (is_ptr) {
+    out.u8(T_POINTER);
+    return append_u128_attr(out, v);
+  }
+  if (PyTuple_Check(v)) {
+    out.u8(T_TUPLE);
+    Py_ssize_t n = PyTuple_GET_SIZE(v);
+    out.u64((uint64_t)n);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      if (!encode_value(PyTuple_GET_ITEM(v, i), out)) return false;
+    }
+    return true;
+  }
+  if (v == g_error_obj) {
+    out.u8(T_ERROR);
+    return true;
+  }
+  // long tail: delegate to Python (ndarray/Json/datetime/pickle)
+  PyObject *b = PyObject_CallFunctionObjArgs(g_encode_slow, v, nullptr);
+  if (!b) return false;
+  out.raw(PyBytes_AS_STRING(b), PyBytes_GET_SIZE(b));
+  Py_DECREF(b);
+  return true;
+}
+
+// encode_row(tuple_or_seq) -> bytes
+static PyObject *py_encode_row(PyObject *, PyObject *arg) {
+  PyObject *seq = PySequence_Fast(arg, "encode_row expects a sequence");
+  if (!seq) return nullptr;
+  Buf out;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  out.u64((uint64_t)n);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (!encode_value(PySequence_Fast_GET_ITEM(seq, i), out)) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+  }
+  Py_DECREF(seq);
+  return PyBytes_FromStringAndSize((const char *)out.d.data(), out.d.size());
+}
+
+struct Cursor {
+  const uint8_t *p;
+  size_t len;
+  size_t pos;
+  bool need(size_t n) {
+    if (pos + n > len) {
+      PyErr_SetString(PyExc_ValueError, "codec: truncated buffer");
+      return false;
+    }
+    return true;
+  }
+  bool r_u64(uint64_t *v) {
+    if (!need(8)) return false;
+    std::memcpy(v, p + pos, 8);
+    pos += 8;
+    return true;
+  }
+};
+
+static PyObject *decode_value(Cursor &c, PyObject *view);
+
+static PyObject *decode_slow(Cursor &c, PyObject *view, uint8_t tag) {
+  // delegate to Python: (tag, view, pos_before_tag_payload) -> (value, new_pos)
+  PyObject *res = PyObject_CallFunction(g_decode_slow, "iOn", (int)tag, view,
+                                        (Py_ssize_t)c.pos);
+  if (!res) return nullptr;
+  PyObject *value = PyTuple_GetItem(res, 0);
+  PyObject *newpos = PyTuple_GetItem(res, 1);
+  if (!value || !newpos) {
+    Py_DECREF(res);
+    return nullptr;
+  }
+  c.pos = (size_t)PyLong_AsSsize_t(newpos);
+  Py_INCREF(value);
+  Py_DECREF(res);
+  return value;
+}
+
+static PyObject *decode_value(Cursor &c, PyObject *view) {
+  if (!c.need(1)) return nullptr;
+  uint8_t tag = c.p[c.pos++];
+  switch (tag) {
+    case T_NONE:
+      Py_RETURN_NONE;
+    case T_TRUE:
+      Py_RETURN_TRUE;
+    case T_FALSE:
+      Py_RETURN_FALSE;
+    case T_INT: {
+      if (!c.need(8)) return nullptr;
+      int64_t v;
+      std::memcpy(&v, c.p + c.pos, 8);
+      c.pos += 8;
+      return PyLong_FromLongLong(v);
+    }
+    case T_FLOAT: {
+      if (!c.need(8)) return nullptr;
+      double v;
+      std::memcpy(&v, c.p + c.pos, 8);
+      c.pos += 8;
+      return PyFloat_FromDouble(v);
+    }
+    case T_STR: {
+      uint64_t n;
+      if (!c.r_u64(&n) || !c.need(n)) return nullptr;
+      PyObject *s = PyUnicode_DecodeUTF8((const char *)c.p + c.pos, n, nullptr);
+      c.pos += n;
+      return s;
+    }
+    case T_BYTES: {
+      uint64_t n;
+      if (!c.r_u64(&n) || !c.need(n)) return nullptr;
+      PyObject *b = PyBytes_FromStringAndSize((const char *)c.p + c.pos, n);
+      c.pos += n;
+      return b;
+    }
+    case T_POINTER: {
+      if (!c.need(16)) return nullptr;
+      uint64_t lo, hi;
+      std::memcpy(&lo, c.p + c.pos, 8);
+      std::memcpy(&hi, c.p + c.pos + 8, 8);
+      c.pos += 16;
+      PyObject *plo = PyLong_FromUnsignedLongLong(lo);
+      PyObject *phi = PyLong_FromUnsignedLongLong(hi);
+      PyObject *sf = PyLong_FromLong(64);
+      PyObject *shifted = PyNumber_Lshift(phi, sf);
+      PyObject *key = PyNumber_Or(shifted, plo);
+      Py_DECREF(plo);
+      Py_DECREF(phi);
+      Py_DECREF(sf);
+      Py_DECREF(shifted);
+      if (!key) return nullptr;
+      PyObject *ptr = PyObject_CallFunctionObjArgs(g_pointer_cls, key, nullptr);
+      Py_DECREF(key);
+      return ptr;
+    }
+    case T_TUPLE: {
+      uint64_t n;
+      if (!c.r_u64(&n)) return nullptr;
+      PyObject *t = PyTuple_New((Py_ssize_t)n);
+      if (!t) return nullptr;
+      for (uint64_t i = 0; i < n; i++) {
+        PyObject *item = decode_value(c, view);
+        if (!item) {
+          Py_DECREF(t);
+          return nullptr;
+        }
+        PyTuple_SET_ITEM(t, (Py_ssize_t)i, item);
+      }
+      return t;
+    }
+    case T_ERROR:
+      Py_INCREF(g_error_obj);
+      return g_error_obj;
+    default:
+      // BIGINT, NDARRAY, JSON, datetimes, DATE, DURATION, PYOBJECT
+      return decode_slow(c, view, tag);
+  }
+}
+
+// decode_row(buffer, pos=0) -> (tuple, new_pos)
+static PyObject *py_decode_row(PyObject *, PyObject *args) {
+  PyObject *obj;
+  Py_ssize_t pos = 0;
+  if (!PyArg_ParseTuple(args, "O|n", &obj, &pos)) return nullptr;
+  Py_buffer view;
+  if (PyObject_GetBuffer(obj, &view, PyBUF_SIMPLE) < 0) return nullptr;
+  PyObject *mview = PyMemoryView_FromBuffer(&view);  // for slow-path calls
+  if (!mview) {
+    PyBuffer_Release(&view);
+    return nullptr;
+  }
+  Cursor c{(const uint8_t *)view.buf, (size_t)view.len, (size_t)pos};
+  uint64_t n = 0;
+  PyObject *result = nullptr;
+  if (c.r_u64(&n)) {
+    PyObject *t = PyTuple_New((Py_ssize_t)n);
+    if (t) {
+      bool ok = true;
+      for (uint64_t i = 0; i < n; i++) {
+        PyObject *item = decode_value(c, mview);
+        if (!item) {
+          ok = false;
+          break;
+        }
+        PyTuple_SET_ITEM(t, (Py_ssize_t)i, item);
+      }
+      if (ok) {
+        result = Py_BuildValue("(Nn)", t, (Py_ssize_t)c.pos);
+      } else {
+        Py_DECREF(t);
+      }
+    }
+  }
+  Py_DECREF(mview);
+  PyBuffer_Release(&view);
+  return result;
+}
+
+// ---------------------------------------------------------------------------
+// setup & module def
+// ---------------------------------------------------------------------------
+
+static PyObject *py_setup(PyObject *, PyObject *args) {
+  PyObject *pointer_cls, *json_cls, *pyobj_cls, *ndarray_cls, *error_obj,
+      *encode_slow, *decode_slow_fn, *ser_slow;
+  if (!PyArg_ParseTuple(args, "OOOOOOOO", &pointer_cls, &json_cls, &pyobj_cls,
+                        &ndarray_cls, &error_obj, &encode_slow, &decode_slow_fn,
+                        &ser_slow))
+    return nullptr;
+#define SETG(g, v) \
+  Py_XDECREF(g);   \
+  Py_INCREF(v);    \
+  g = v;
+  SETG(g_pointer_cls, pointer_cls);
+  SETG(g_json_cls, json_cls);
+  SETG(g_pyobj_cls, pyobj_cls);
+  SETG(g_ndarray_cls, ndarray_cls);
+  SETG(g_error_obj, error_obj);
+  SETG(g_encode_slow, encode_slow);
+  SETG(g_decode_slow, decode_slow_fn);
+  SETG(g_ser_slow, ser_slow);
+#undef SETG
+  Py_RETURN_NONE;
+}
+
+static PyMethodDef methods[] = {
+    {"setup", py_setup, METH_VARARGS, "register engine classes and helpers"},
+    {"hash_values", py_hash_values, METH_O, "stable 128-bit value hash"},
+    {"blake2b_128", py_blake2b_128, METH_O, "blake2b-128 digest"},
+    {"encode_row", py_encode_row, METH_O, "PWT1-encode a row"},
+    {"decode_row", py_decode_row, METH_VARARGS, "PWT1-decode a row"},
+    {nullptr, nullptr, 0, nullptr}};
+
+static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_native",
+                                       "pathway_tpu native runtime core", -1,
+                                       methods};
+
+PyMODINIT_FUNC PyInit__native(void) { return PyModule_Create(&moduledef); }

@@ -73,9 +73,6 @@ class BaseRestServer:
             persistence_config = _persistence.Config(
                 backend, persistence_mode=pw.PersistenceMode.UDF_CACHING
             )
-            if backend.kind == "filesystem":
-                # UDF DiskCache reads this root (caches.py)
-                os.environ.setdefault("PATHWAY_PERSISTENT_STORAGE", backend.path)
 
         def _run():
             return pw.run(

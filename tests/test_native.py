@@ -1,0 +1,132 @@
+"""Native C++ runtime core: bit-parity with the pure-Python paths."""
+
+from __future__ import annotations
+
+import datetime as dt
+import hashlib
+
+import numpy as np
+import pytest
+
+from pathway_tpu import native
+from pathway_tpu.engine import codec
+from pathway_tpu.engine import types as tz
+
+
+@pytest.fixture(scope="module")
+def nat():
+    mod = native.get()
+    if mod is None:
+        pytest.skip("native core unavailable (no g++?)")
+    return mod
+
+
+SAMPLE_VALUES = [
+    None,
+    True,
+    False,
+    0,
+    1,
+    -1,
+    2**62,
+    -(2**62),
+    2**100,
+    -(2**100),
+    0.0,
+    -3.75,
+    float("inf"),
+    "",
+    "hello world",
+    "ünïcødé ✓",
+    b"",
+    b"\x00\xff",
+    tz.Pointer(0),
+    tz.Pointer((1 << 128) - 1),
+    tz.Pointer(1234567890123456789012345678901234567),
+    (),
+    (1, "a", None),
+    ((1, 2), (3.5, "x"), (None, (True,))),
+    tz.Json({"b": [1, None], "a": "x"}),
+    tz.Json(None),
+    tz.ERROR,
+    dt.datetime(2024, 1, 2, 3, 4, 5, 678901),
+    dt.datetime(2024, 1, 2, 3, 4, 5, tzinfo=dt.timezone.utc),
+    dt.timedelta(seconds=90061, microseconds=5),
+    dt.date(1999, 12, 31),
+    np.arange(6, dtype=np.int64).reshape(2, 3),
+    np.linspace(0, 1, 5, dtype=np.float32),
+]
+
+
+class TestBlake2b:
+    @pytest.mark.parametrize("n", [0, 1, 63, 64, 127, 128, 129, 255, 256, 1000, 4096])
+    def test_matches_hashlib(self, nat, n):
+        data = bytes(range(256)) * (n // 256 + 1)
+        data = data[:n]
+        assert nat.blake2b_128(data) == hashlib.blake2b(data, digest_size=16).digest()
+
+
+class TestHashValues:
+    def test_scalar_parity(self, nat):
+        for v in SAMPLE_VALUES:
+            assert nat.hash_values((v,)) == tz.hash_values_py([v]), repr(v)
+
+    def test_sequence_parity(self, nat):
+        seq = tuple(SAMPLE_VALUES)
+        assert nat.hash_values(seq) == tz.hash_values_py(seq)
+
+    def test_random_rows(self, nat):
+        rng = np.random.default_rng(0)
+        pool = [
+            lambda: int(rng.integers(-(2**40), 2**40)),
+            lambda: float(rng.normal()),
+            lambda: "s" * int(rng.integers(0, 50)),
+            lambda: bytes(rng.integers(0, 256, size=int(rng.integers(0, 20))).tolist()),
+            lambda: None,
+            lambda: bool(rng.integers(0, 2)),
+        ]
+        for _ in range(200):
+            row = tuple(pool[int(rng.integers(0, len(pool)))]() for _ in range(4))
+            assert nat.hash_values(row) == tz.hash_values_py(row)
+
+    def test_hash_values_uses_native(self, nat):
+        row = (1, "x", 2.5)
+        assert tz.hash_values(row) == tz.hash_values_py(row)
+
+
+class TestCodecParity:
+    def test_encode_bytes_identical(self, nat):
+        for v in SAMPLE_VALUES:
+            assert nat.encode_row((v,)) == codec.encode_row_py((v,)), repr(v)
+
+    def test_cross_decode(self, nat):
+        row = tuple(SAMPLE_VALUES)
+        enc_native = nat.encode_row(row)
+        enc_py = codec.encode_row_py(row)
+        assert enc_native == enc_py
+        dec_native, pos_n = nat.decode_row(enc_py)
+        dec_py, pos_p = codec.decode_row_py(enc_native)
+        assert pos_n == pos_p == len(enc_py)
+        for a, b in zip(dec_native, dec_py):
+            if isinstance(a, np.ndarray):
+                assert np.array_equal(a, b)
+            else:
+                assert a == b
+
+    def test_decode_with_offset(self, nat):
+        prefix = b"abcd"
+        enc = codec.encode_row_py((1, "x"))
+        row, pos = nat.decode_row(prefix + enc, 4)
+        assert row == (1, "x")
+        assert pos == 4 + len(enc)
+
+    def test_truncated_raises(self, nat):
+        enc = codec.encode_row_py((1, "hello"))
+        with pytest.raises(ValueError):
+            nat.decode_row(enc[: len(enc) - 3])
+
+    def test_overflow_int_raises(self, nat):
+        with pytest.raises(OverflowError):
+            nat.hash_values((2**200,))
+        with pytest.raises(OverflowError):
+            tz.hash_values_py([2**200])

@@ -294,6 +294,29 @@ class TestModesAndErrors:
         with pytest.raises(ValueError, match="duplicate source name"):
             pw.run(persistence_config=pw.persistence.Config(backend))
 
+    def test_schema_change_rejected(self, tmp_path):
+        """A snapshot recorded under another schema must not replay."""
+        backend_path = str(tmp_path / "p")
+
+        def run_with(schema):
+            class Src(pw.io.python.ConnectorSubject):
+                def run(self):
+                    self.next(**{list(schema.__columns__)[0]: 1})
+                    self.commit()
+
+            t = pw.io.python.read(Src(), schema=schema, name="s")
+            pw.io.subscribe(t, on_change=lambda **kw: None)
+            pw.run(
+                persistence_config=pw.persistence.Config(
+                    pw.persistence.Backend.filesystem(backend_path)
+                )
+            )
+
+        run_with(pw.schema_from_types(k=int))
+        pw.internals.parse_graph.G.clear()
+        with pytest.raises(ValueError, match="different schema"):
+            run_with(pw.schema_from_types(other=str))
+
     def test_negative_user_key_persists(self, tmp_path):
         """Out-of-range _pw_key must not crash the snapshot encoder."""
         backend = pw.persistence.Backend.filesystem(str(tmp_path / "p"))
