@@ -104,13 +104,7 @@ class Type:
 
 import enum as _enum
 
-
-class MonitoringLevel(_enum.Enum):
-    AUTO = 0
-    AUTO_ALL = 1
-    NONE = 2
-    IN_OUT = 3
-    ALL = 4
+from pathway_tpu.internals.monitoring import MonitoringLevel
 
 
 class PersistenceMode(_enum.Enum):

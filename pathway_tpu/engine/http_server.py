@@ -1,0 +1,141 @@
+"""Monitoring HTTP server: ``/status`` (JSON) and ``/metrics`` (OpenMetrics).
+
+Parity target: ``src/engine/http_server.rs:21-215`` — a per-process
+endpoint on ``127.0.0.1:(20000 + process_id)`` (override with
+``PATHWAY_MONITORING_HTTP_PORT``), serving the latest ``ProberStats``
+snapshot in Prometheus text format.  The reference shares the snapshot via
+``ArcSwapOption``; here a lock-free attribute swap on the server object
+plays that role (the GIL makes the single reference assignment atomic).
+
+Runs in a daemon thread off the worker hot loop, exactly like the
+reference keeps hyper off the timely worker threads.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from pathway_tpu.engine.probes import ProberStats
+
+DEFAULT_FIRST_PORT = 20000  # http_server.rs:83
+
+
+def monitoring_port(process_id: int = 0, override: int | None = None) -> int:
+    return override if override is not None else DEFAULT_FIRST_PORT + process_id
+
+
+def render_prometheus(stats: ProberStats, run_id: str | None = None) -> str:
+    """OpenMetrics text, gauge names matching the reference's exposition."""
+    lines: list[str] = []
+    labels = f'{{run_id="{run_id}"}}' if run_id else ""
+
+    def gauge(name: str, value, help_: str, extra: str = "") -> None:
+        if value is None:
+            return
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{extra or labels} {value}")
+
+    gauge("input_latency_ms", stats.input_stats.lag_ms, "input processing lag")
+    gauge("output_latency_ms", stats.output_stats.lag_ms, "output processing lag")
+    gauge("input_time", stats.input_stats.time, "latest committed input epoch")
+    gauge("output_time", stats.output_stats.time, "latest produced output epoch")
+    gauge("epochs_total", stats.epochs, "consistent epochs processed")
+    gauge(
+        "input_rows_total", stats.input_stats.rows_out, "rows ingested across sources"
+    )
+    gauge(
+        "output_rows_total", stats.output_stats.rows_in, "rows delivered across sinks"
+    )
+    for op_id, op in stats.operator_stats.items():
+        extra = (
+            f'{{operator="{op.name}",id="{op_id}"'
+            + (f',run_id="{run_id}"' if run_id else "")
+            + "}"
+        )
+        gauge("operator_rows_in_total", op.rows_in, "rows consumed", extra)
+        gauge("operator_rows_out_total", op.rows_out, "rows produced", extra)
+    for op_id, n in stats.row_counts.items():
+        extra = f'{{id="{op_id}"' + (f',run_id="{run_id}"' if run_id else "") + "}"
+        gauge("operator_state_rows", n, "rows of maintained state", extra)
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def render_status(stats: ProberStats, run_id: str | None = None) -> str:
+    def op(s):
+        return {
+            "name": s.name,
+            "time": s.time,
+            "lag_ms": s.lag_ms,
+            "rows_in": s.rows_in,
+            "rows_out": s.rows_out,
+            "done": s.done,
+        }
+
+    return json.dumps(
+        {
+            "run_id": run_id,
+            "epochs": stats.epochs,
+            "input": op(stats.input_stats),
+            "output": op(stats.output_stats),
+            "operators": {str(k): op(v) for k, v in stats.operator_stats.items()},
+        }
+    )
+
+
+class MonitoringServer:
+    """Daemon-thread HTTP server exposing the latest stats snapshot."""
+
+    def __init__(
+        self,
+        *,
+        process_id: int = 0,
+        port: int | None = None,
+        run_id: str | None = None,
+        host: str = "127.0.0.1",
+    ):
+        self.run_id = run_id
+        self._stats = ProberStats()  # swapped whole, never mutated in place
+        self.port = monitoring_port(process_id, port)
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                if self.path.startswith("/metrics"):
+                    body = render_prometheus(server._stats, server.run_id)
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.startswith("/status"):
+                    body = render_status(server._stats, server.run_id)
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                data = body.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *args):  # silence request logging
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, self.port), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="pathway:http", daemon=True
+        )
+
+    def start(self) -> "MonitoringServer":
+        self._thread.start()
+        return self
+
+    def update(self, stats: ProberStats) -> None:
+        self._stats = stats
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)

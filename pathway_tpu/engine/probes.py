@@ -1,0 +1,160 @@
+"""Dataflow probes: per-operator progress/throughput statistics.
+
+Parity target: the reference's prober layer —
+``src/engine/graph.rs:512`` (``ProberStats``/``OperatorStats``),
+``src/engine/progress_reporter.rs`` (console stats loop) and the
+``attach_prober``/``probe_table`` Graph methods (``graph.rs:969-976``).
+
+TPU-first shape: the engine here is an epoch-stepped host runtime (device
+compute happens inside jitted ops), so a probe is a cheap post-epoch scan
+over the node arena rather than a timely probe handle.  Each ``Node``
+already counts rows in/out; the :class:`Prober` turns those counters into
+an immutable :class:`ProberStats` snapshot consumed by the console
+dashboard (``internals/monitoring.py``) and the HTTP metrics server
+(``engine/http_server.py``) — mirroring how the reference shares stats via
+``ArcSwapOption<ProberStats>`` (``src/engine/http_server.rs:21``).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING, Callable
+
+if TYPE_CHECKING:  # pragma: no cover
+    from pathway_tpu.engine.dataflow import Node, Scope
+
+
+@dataclass
+class OperatorStats:
+    """Progress of one operator (graph.rs ``OperatorStats``)."""
+
+    name: str = "node"
+    time: int | None = None  # latest epoch this operator processed
+    lag_ms: float | None = None  # now - wallclock of that epoch, if known
+    rows_in: int = 0
+    rows_out: int = 0
+    done: bool = False
+
+    def merge(self, other: "OperatorStats") -> "OperatorStats":
+        return OperatorStats(
+            name=self.name,
+            time=max_opt(self.time, other.time),
+            lag_ms=max_opt(self.lag_ms, other.lag_ms),
+            rows_in=self.rows_in + other.rows_in,
+            rows_out=self.rows_out + other.rows_out,
+            done=self.done and other.done,
+        )
+
+
+def max_opt(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return max(a, b)
+
+
+@dataclass
+class ConnectorStats:
+    """Per-source ingestion stats (connectors/monitoring.rs analog)."""
+
+    name: str = "source"
+    rows: int = 0
+    finished: bool = False
+
+
+@dataclass
+class ProberStats:
+    """One consistent snapshot of the whole dataflow (graph.rs:512)."""
+
+    input_stats: OperatorStats = field(default_factory=OperatorStats)
+    output_stats: OperatorStats = field(default_factory=OperatorStats)
+    operator_stats: dict[int, OperatorStats] = field(default_factory=dict)
+    connector_stats: list[ConnectorStats] = field(default_factory=list)
+    epochs: int = 0
+    row_counts: dict[int, int] = field(default_factory=dict)
+
+    @property
+    def latency_ms(self) -> float | None:
+        """Output latency: how far outputs trail inputs (progress_reporter.rs)."""
+        it, ot = self.input_stats.time, self.output_stats.time
+        if it is None or ot is None:
+            return None
+        return max(0.0, float(it - ot))
+
+
+class Prober:
+    """Collects :class:`ProberStats` from a :class:`Scope` after each epoch.
+
+    ``callbacks`` mirrors ``attach_prober(callback, ...)`` — every update
+    delivers the fresh snapshot; the dashboard and the HTTP server both
+    register one.
+    """
+
+    def __init__(self, scope: "Scope", callbacks: list[Callable[[ProberStats], None]] | None = None):
+        self.scope = scope
+        self.callbacks: list[Callable[[ProberStats], None]] = list(callbacks or [])
+        self.stats = ProberStats()
+        self._epoch_wallclock: dict[int, float] = {}
+
+    def update(self, *, done: bool = False, epochs: int | None = None) -> ProberStats:
+        from pathway_tpu.engine.dataflow import InputNode, OutputNode
+
+        if self.scope is None:  # final snapshot already taken
+            return self.stats
+        now = _time.monotonic()
+        t = self.scope.current_time
+        self._epoch_wallclock.setdefault(t, now)
+        # keep the wallclock map bounded
+        if len(self._epoch_wallclock) > 1024:
+            for old in sorted(self._epoch_wallclock)[:-512]:
+                del self._epoch_wallclock[old]
+
+        ops: dict[int, OperatorStats] = {}
+        inputs = OperatorStats(name="input", done=done)
+        outputs = OperatorStats(name="output", done=done)
+        row_counts: dict[int, int] = {}
+        for node in self.scope.nodes:
+            st = OperatorStats(
+                name=getattr(node, "name", None) or "node",
+                time=t,
+                rows_in=node.rows_in,
+                rows_out=node.rows_out,
+                done=done or (isinstance(node, InputNode) and node.finished),
+            )
+            seen = self._epoch_wallclock.get(t)
+            if seen is not None:
+                st.lag_ms = (now - seen) * 1000.0
+            ops[node.id] = st
+            if node.keep_state:
+                row_counts[node.id] = len(node.state)
+            if isinstance(node, InputNode):
+                inputs = inputs.merge(st)
+                inputs.done = done or all(
+                    n.finished for n in self.scope.nodes if isinstance(n, InputNode)
+                )
+            if isinstance(node, OutputNode):
+                outputs = outputs.merge(st)
+                outputs.done = done
+        self.stats = ProberStats(
+            input_stats=inputs,
+            output_stats=outputs,
+            operator_stats=ops,
+            connector_stats=self.stats.connector_stats,
+            # epoch count is owned by the runner's loop when provided; the
+            # final done-snapshot re-reads counters, it is not a new epoch
+            epochs=(
+                epochs
+                if epochs is not None
+                else self.stats.epochs + (0 if done else 1)
+            ),
+            row_counts=row_counts,
+        )
+        for cb in self.callbacks:
+            cb(self.stats)
+        if done:
+            # drop the graph so a retained RunResult.prober doesn't keep
+            # every node's state arena alive
+            self.scope = None
+        return self.stats

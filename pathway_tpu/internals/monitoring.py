@@ -1,0 +1,162 @@
+"""Live console monitoring dashboard.
+
+Parity target: ``python/pathway/internals/monitoring.py:165-273`` —
+``MonitoringLevel``, ``StatsMonitor`` and ``monitor_stats``: a
+rich-powered live view with connector/operator rows (latency, row
+counts) plus a tail of recent log lines, refreshed from each
+``ProberStats`` snapshot the engine prober publishes.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import sys
+from contextlib import contextmanager
+from typing import Any
+
+from pathway_tpu.engine.probes import OperatorStats, ProberStats
+
+
+class MonitoringLevel(enum.Enum):
+    """What the console dashboard shows (reference ``monitoring.py:228``)."""
+
+    AUTO = 0  # IN_OUT when stderr is a tty, NONE otherwise
+    AUTO_ALL = 1  # ALL when stderr is a tty, NONE otherwise
+    NONE = 2
+    IN_OUT = 3  # inputs + outputs only
+    ALL = 4  # every operator
+
+    def resolve(self, interactive: bool | None = None) -> "MonitoringLevel":
+        if interactive is None:
+            interactive = sys.stderr.isatty()
+        if self == MonitoringLevel.AUTO:
+            return MonitoringLevel.IN_OUT if interactive else MonitoringLevel.NONE
+        if self == MonitoringLevel.AUTO_ALL:
+            return MonitoringLevel.ALL if interactive else MonitoringLevel.NONE
+        return self
+
+
+class _LogBuffer(logging.Handler):
+    """Keeps the last N log lines for the dashboard footer."""
+
+    def __init__(self, limit: int = 10):
+        super().__init__()
+        self.limit = limit
+        self.lines: list[str] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            self.lines.append(self.format(record))
+        except Exception:  # pragma: no cover - formatting failure
+            return
+        del self.lines[: -self.limit]
+
+
+class StatsMonitor:
+    """Renders ProberStats snapshots as a live table (reference ``StatsMonitor``)."""
+
+    def __init__(
+        self,
+        level: MonitoringLevel = MonitoringLevel.IN_OUT,
+        *,
+        console: Any = None,
+        refresh_per_second: int = 4,
+    ):
+        from rich.console import Console
+
+        self.level = level
+        self.console = console or Console(file=sys.stderr)
+        self.refresh_per_second = refresh_per_second
+        self.stats: ProberStats = ProberStats()
+        self.log_buffer = _LogBuffer()
+        self.log_buffer.setFormatter(logging.Formatter("%(levelname)s %(message)s"))
+        self._live = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "StatsMonitor":
+        from rich.live import Live
+
+        logging.getLogger("pathway_tpu").addHandler(self.log_buffer)
+        self._live = Live(
+            self._render(),
+            console=self.console,
+            refresh_per_second=self.refresh_per_second,
+            transient=False,
+        )
+        self._live.start()
+        return self
+
+    def update(self, stats: ProberStats) -> None:
+        self.stats = stats
+        if self._live is not None:
+            self._live.update(self._render())
+
+    def close(self) -> None:
+        if self._live is not None:
+            self._live.update(self._render(final=True))
+            self._live.stop()
+            self._live = None
+        logging.getLogger("pathway_tpu").removeHandler(self.log_buffer)
+
+    # -- rendering ---------------------------------------------------------
+    def _rows(self) -> list[tuple[str, OperatorStats]]:
+        s = self.stats
+        rows: list[tuple[str, OperatorStats]] = [
+            ("input", s.input_stats),
+            ("output", s.output_stats),
+        ]
+        if self.level == MonitoringLevel.ALL:
+            rows += [(f"{op.name}#{oid}", op) for oid, op in s.operator_stats.items()]
+        return rows
+
+    def _render(self, final: bool = False):
+        from rich.console import Group
+        from rich.table import Table as RichTable
+        from rich.text import Text
+
+        table = RichTable(title=None, expand=False)
+        table.add_column("operator")
+        table.add_column("epoch", justify="right")
+        table.add_column("lag (ms)", justify="right")
+        table.add_column("rows in", justify="right")
+        table.add_column("rows out", justify="right")
+        for name, op in self._rows():
+            table.add_row(
+                name + (" [done]" if op.done else ""),
+                "-" if op.time is None else str(op.time),
+                "-" if op.lag_ms is None else f"{op.lag_ms:.0f}",
+                str(op.rows_in),
+                str(op.rows_out),
+            )
+        header = Text(
+            f"epochs: {self.stats.epochs}"
+            + ("  (finished)" if final else "")
+        )
+        parts: list[Any] = [header, table]
+        if self.log_buffer.lines:
+            parts.append(Text("\n".join(self.log_buffer.lines[-5:])))
+        return Group(*parts)
+
+
+@contextmanager
+def monitor_stats(
+    level: MonitoringLevel,
+    *,
+    console: Any = None,
+    interactive: bool | None = None,
+):
+    """Context manager yielding a stats callback (or None if monitoring is off).
+
+    Mirrors ``monitor_stats`` (reference ``monitoring.py:226``): resolves
+    AUTO levels against tty-ness, runs the live dashboard for the duration.
+    """
+    resolved = level.resolve(interactive)
+    if resolved == MonitoringLevel.NONE:
+        yield None
+        return
+    monitor = StatsMonitor(resolved, console=console).start()
+    try:
+        yield monitor
+    finally:
+        monitor.close()

@@ -40,6 +40,7 @@ def add_debug_sink(name: str, table: Table) -> None:
 class RunResult:
     def __init__(self):
         self.epochs = 0
+        self.prober = None  # engine.probes.Prober when monitoring ran
 
 
 def run(
@@ -75,9 +76,38 @@ def run(
         if isinstance(storage.backend, pz.FileBackend):
             # UDF DiskCache shares the persistence root for this run only
             pz.set_active_root(storage.backend.root)
+
+    from pathway_tpu.engine.probes import Prober
+    from pathway_tpu.internals.config import get_config
+    from pathway_tpu.internals.monitoring import MonitoringLevel, monitor_stats
+
+    config = get_config()
+    if monitoring_level is None:
+        monitoring_level = MonitoringLevel.AUTO
+    http_server = None
     try:
-        _event_loop(scope, lowerer, result, max_epochs=max_epochs, storage=storage)
+        if with_http_server:
+            from pathway_tpu.engine.http_server import MonitoringServer
+
+            http_server = MonitoringServer(
+                process_id=config.process_id,
+                port=config.monitoring_http_port,
+                run_id=config.run_id,
+            ).start()
+        with monitor_stats(monitoring_level) as monitor:
+            prober = Prober(scope)
+            if monitor is not None:
+                prober.callbacks.append(monitor.update)
+            if http_server is not None:
+                prober.callbacks.append(http_server.update)
+            result.prober = prober
+            _event_loop(
+                scope, lowerer, result, max_epochs=max_epochs, storage=storage,
+                prober=prober,
+            )
     finally:
+        if http_server is not None:
+            http_server.close()
         if storage is not None:
             # also on interrupt/error: commit whatever frontier is consistent
             storage.commit()
@@ -123,6 +153,7 @@ def _event_loop(
     result: RunResult,
     max_epochs: int | None = None,
     storage: Any = None,
+    prober: Any = None,
 ) -> None:
     inputs = _input_nodes(scope)
     pollers = lowerer.pollers
@@ -163,6 +194,8 @@ def _event_loop(
             scope.run_epoch(t)
             last_time = t
             result.epochs += 1
+            if prober is not None and prober.callbacks:
+                prober.update(epochs=result.epochs)
             if max_epochs is not None and result.epochs >= max_epochs:
                 break
             continue
@@ -172,6 +205,8 @@ def _event_loop(
         _time.sleep(0.001)
     scope.current_time = max(scope.current_time, last_time)
     scope.finish()
+    if prober is not None:
+        prober.update(done=True, epochs=result.epochs)
 
 
 def run_pipeline_to_completion(sink_tables: list[tuple[Table, Callable]], **kwargs) -> RunResult:
