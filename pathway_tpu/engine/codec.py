@@ -152,6 +152,14 @@ def _r_len(buf: memoryview, pos: int) -> tuple[int, int]:
     return _U64.unpack_from(buf, pos)[0], pos + 8
 
 
+def _take(buf: memoryview, pos: int, n: int) -> tuple[memoryview, int]:
+    # subtraction form: corrupted length fields near u64::MAX must not
+    # silently produce a short slice (matches the native Cursor::need)
+    if pos > len(buf) or n > len(buf) - pos:
+        raise ValueError("codec: truncated buffer")
+    return buf[pos : pos + n], pos + n
+
+
 def decode_value(buf: memoryview, pos: int) -> tuple[Any, int]:
     tag = buf[pos]
     pos += 1
@@ -165,18 +173,21 @@ def decode_value(buf: memoryview, pos: int) -> tuple[Any, int]:
         return _I64.unpack_from(buf, pos)[0], pos + 8
     if tag == _T_BIGINT:
         n, pos = _r_len(buf, pos)
-        return int.from_bytes(buf[pos : pos + n], "little", signed=True), pos + n
+        b, pos = _take(buf, pos, n)
+        return int.from_bytes(b, "little", signed=True), pos
     if tag == _T_FLOAT:
         return _F64.unpack_from(buf, pos)[0], pos + 8
     if tag == _T_STR:
         n, pos = _r_len(buf, pos)
-        return bytes(buf[pos : pos + n]).decode(), pos + n
+        b, pos = _take(buf, pos, n)
+        return bytes(b).decode(), pos
     if tag == _T_BYTES:
         n, pos = _r_len(buf, pos)
-        return bytes(buf[pos : pos + n]), pos + n
+        b, pos = _take(buf, pos, n)
+        return bytes(b), pos
     if tag == _T_POINTER:
-        v = int.from_bytes(buf[pos : pos + 16], "little")
-        return Pointer(v), pos + 16
+        b, pos = _take(buf, pos, 16)
+        return Pointer(int.from_bytes(b, "little")), pos
     if tag == _T_TUPLE:
         n, pos = _r_len(buf, pos)
         items = []
@@ -186,19 +197,21 @@ def decode_value(buf: memoryview, pos: int) -> tuple[Any, int]:
         return tuple(items), pos
     if tag == _T_NDARRAY:
         n, pos = _r_len(buf, pos)
-        dts = bytes(buf[pos : pos + n]).decode()
-        pos += n
+        b, pos = _take(buf, pos, n)
+        dts = bytes(b).decode()
         ndim, pos = _r_len(buf, pos)
         shape = []
         for _ in range(ndim):
             shape.append(_U64.unpack_from(buf, pos)[0])
             pos += 8
         n, pos = _r_len(buf, pos)
-        arr = np.frombuffer(buf[pos : pos + n], dtype=np.dtype(dts)).reshape(shape)
-        return as_hashable(arr.copy()), pos + n
+        b, pos = _take(buf, pos, n)
+        arr = np.frombuffer(b, dtype=np.dtype(dts)).reshape(shape)
+        return as_hashable(arr.copy()), pos
     if tag == _T_JSON:
         n, pos = _r_len(buf, pos)
-        return Json(_json.loads(bytes(buf[pos : pos + n]).decode())), pos + n
+        b, pos = _take(buf, pos, n)
+        return Json(_json.loads(bytes(b).decode())), pos
     if tag == _T_DATETIME_NAIVE:
         micros = _I64.unpack_from(buf, pos)[0]
         return _EPOCH_NAIVE + _dt.timedelta(microseconds=micros), pos + 8
@@ -214,7 +227,8 @@ def decode_value(buf: memoryview, pos: int) -> tuple[Any, int]:
         return ERROR, pos
     if tag == _T_PYOBJECT:
         n, pos = _r_len(buf, pos)
-        return pickle.loads(bytes(buf[pos : pos + n])), pos + n
+        b, pos = _take(buf, pos, n)
+        return pickle.loads(bytes(b)), pos
     raise ValueError(f"codec: unknown value tag {tag}")
 
 
@@ -229,11 +243,23 @@ def encode_row_py(values: Iterable[Any]) -> bytes:
 
 def decode_row_py(data: bytes | memoryview, pos: int = 0) -> tuple[tuple, int]:
     buf = memoryview(data)
-    n, pos = _r_len(buf, pos)
-    items = []
-    for _ in range(n):
-        item, pos = decode_value(buf, pos)
-        items.append(item)
+    try:
+        n, pos = _r_len(buf, pos)
+        items = []
+        for _ in range(n):
+            item, pos = decode_value(buf, pos)
+            items.append(item)
+    except ValueError:
+        raise
+    except MemoryError:
+        raise
+    except Exception as exc:
+        # any other decode failure is buffer corruption (bit-rotted dtype
+        # strings hit np.dtype's TypeError, mangled pickles raise
+        # UnpicklingError, short fixed reads raise struct.error/IndexError)
+        # — surface the single documented, catchable error the native
+        # decoder also raises
+        raise ValueError(f"codec: corrupt buffer ({exc})") from exc
     return tuple(items), pos
 
 

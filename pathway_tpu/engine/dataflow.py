@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import itertools
 from collections import Counter, defaultdict
+from time import monotonic as _monotonic
 from typing import Any, Callable, Iterable, Sequence
 
 from pathway_tpu.engine.types import (
@@ -153,17 +154,39 @@ class InputNode(Node):
     def __init__(self, scope: "Scope"):
         super().__init__(scope)
         self._staged: dict[Time, list[Delta]] = defaultdict(list)
+        self._staged_wallclock: dict[Time, float] = {}
         self.finished = False
         # upsert sessions key rows and treat same-key insert as replace
         self.upsert = False
 
     def insert(self, key: int, row: Row, time: Time, diff: int = 1) -> None:
         self._staged[time].append((key, row, diff))
+        self._staged_wallclock.setdefault(time, _monotonic())
 
     def pending_times(self) -> list[Time]:
         return sorted(self._staged.keys())
 
+    def merge_staged_through(self, time: Time) -> None:
+        """Fold rows staged at earlier times into epoch ``time`` (the runner
+        picks one commit timestamp across all inputs), keeping the earliest
+        ingest wallclock so latency probes measure from first arrival."""
+        merged: list[Delta] = []
+        wall: float | None = None
+        for staged in sorted(st for st in self._staged if st <= time):
+            merged.extend(self._staged.pop(staged))
+            w = self._staged_wallclock.pop(staged, None)
+            if w is not None:
+                wall = w if wall is None else min(wall, w)
+        if merged:
+            self._staged[time] = merged
+        if wall is not None:
+            self._staged_wallclock[time] = wall
+
     def emit_time(self, time: Time) -> None:
+        wall = self._staged_wallclock.pop(time, None)
+        if wall is not None:
+            ew = self.scope.epoch_wallclock
+            ew[time] = min(ew.get(time, wall), wall)
         deltas = self._staged.pop(time, [])
         if self.upsert:
             out = []
@@ -1274,6 +1297,8 @@ class Scope:
         self.current_time: Time = 0
         self.error_log: list[tuple[Any, int, str]] = []
         self.terminate_on_error = True
+        # epoch -> wallclock of its earliest staged row (latency probes)
+        self.epoch_wallclock: dict[Time, float] = {}
 
     def _register(self, node: Node) -> int:
         self.nodes.append(node)
@@ -1291,6 +1316,12 @@ class Scope:
             node.step(time)
         for node in self.nodes:
             node.flush(time)
+        if self.epoch_wallclock:
+            # processed epochs are read by the prober right after this call;
+            # older entries are dead — keep the map bounded on long runs
+            self.epoch_wallclock = {
+                k: v for k, v in self.epoch_wallclock.items() if k >= time
+            }
 
     def finish(self) -> None:
         # release buffered work (temporal buffers etc.), propagate, then

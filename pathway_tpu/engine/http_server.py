@@ -26,17 +26,32 @@ def monitoring_port(process_id: int = 0, override: int | None = None) -> int:
     return override if override is not None else DEFAULT_FIRST_PORT + process_id
 
 
-def render_prometheus(stats: ProberStats, run_id: str | None = None) -> str:
-    """OpenMetrics text, gauge names matching the reference's exposition."""
-    lines: list[str] = []
-    labels = f'{{run_id="{run_id}"}}' if run_id else ""
+def _esc(value: str) -> str:
+    """Escape a Prometheus label value per the exposition format."""
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
-    def gauge(name: str, value, help_: str, extra: str = "") -> None:
+
+def render_prometheus(stats: ProberStats, run_id: str | None = None) -> str:
+    """OpenMetrics text, gauge names matching the reference's exposition.
+
+    HELP/TYPE headers are emitted once per metric name (strict parsers
+    reject duplicates), followed by that metric's samples.
+    """
+    run_label = f'run_id="{_esc(run_id)}"' if run_id else ""
+
+    def labels(*pairs: str) -> str:
+        parts = [p for p in (*pairs, run_label) if p]
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    # metric -> (help text, [(label string, value), ...])
+    metrics: dict[str, tuple[str, list[tuple[str, object]]]] = {}
+
+    def gauge(name: str, value, help_: str, label_str: str | None = None) -> None:
         if value is None:
             return
-        lines.append(f"# HELP {name} {help_}")
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name}{extra or labels} {value}")
+        metrics.setdefault(name, (help_, []))[1].append(
+            (labels() if label_str is None else label_str, value)
+        )
 
     gauge("input_latency_ms", stats.input_stats.lag_ms, "input processing lag")
     gauge("output_latency_ms", stats.output_stats.lag_ms, "output processing lag")
@@ -50,16 +65,20 @@ def render_prometheus(stats: ProberStats, run_id: str | None = None) -> str:
         "output_rows_total", stats.output_stats.rows_in, "rows delivered across sinks"
     )
     for op_id, op in stats.operator_stats.items():
-        extra = (
-            f'{{operator="{op.name}",id="{op_id}"'
-            + (f',run_id="{run_id}"' if run_id else "")
-            + "}"
-        )
-        gauge("operator_rows_in_total", op.rows_in, "rows consumed", extra)
-        gauge("operator_rows_out_total", op.rows_out, "rows produced", extra)
+        op_labels = labels(f'operator="{_esc(op.name)}"', f'id="{op_id}"')
+        gauge("operator_rows_in_total", op.rows_in, "rows consumed", op_labels)
+        gauge("operator_rows_out_total", op.rows_out, "rows produced", op_labels)
     for op_id, n in stats.row_counts.items():
-        extra = f'{{id="{op_id}"' + (f',run_id="{run_id}"' if run_id else "") + "}"
-        gauge("operator_state_rows", n, "rows of maintained state", extra)
+        gauge(
+            "operator_state_rows", n, "rows of maintained state", labels(f'id="{op_id}"')
+        )
+
+    lines: list[str] = []
+    for name, (help_, samples) in metrics.items():
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} gauge")
+        for label_str, value in samples:
+            lines.append(f"{name}{label_str} {value}")
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 

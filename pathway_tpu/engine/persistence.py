@@ -28,6 +28,7 @@ import json as _json
 import os
 import pickle
 import threading
+from contextvars import ContextVar
 from typing import Any
 
 from pathway_tpu.engine import codec
@@ -36,18 +37,45 @@ METADATA_FILE = "metadata.json"
 
 # Filesystem root of the persistence backend of the currently-running
 # pipeline (UDF DiskCache reads it; PersistenceMode::UdfCaching,
-# src/connectors/mod.rs:114).  Scoped to pw.run() — set/cleared by the
-# runner, never leaked into process env.
+# src/connectors/mod.rs:114).  Context-local so concurrent runs in one
+# process each see their own root (UDFs execute in the runner's context);
+# the process-global fallback — for code that reads the root from a thread
+# outside any run context — is first-wins and released only by its owner.
+_root_var: ContextVar[str | None] = ContextVar("pathway_tpu_active_root", default=None)
 _active_root: str | None = None
+_root_owner: object | None = None
+_root_lock = threading.Lock()
 
 
-def set_active_root(root: str | None) -> None:
-    global _active_root
-    _active_root = root
+def acquire_active_root(root: str) -> tuple[object | None, object]:
+    """Claim the UDF-cache root for the current run; returns a release token."""
+    global _active_root, _root_owner
+    var_token = _root_var.set(root)
+    with _root_lock:
+        if _active_root is None:
+            _root_owner = object()
+            _active_root = root
+            return (_root_owner, var_token)
+        return (None, var_token)
+
+
+def release_active_root(token: tuple[object | None, object] | None) -> None:
+    global _active_root, _root_owner
+    if token is None:
+        return
+    owner, var_token = token
+    _root_var.reset(var_token)
+    if owner is None:
+        return
+    with _root_lock:
+        if owner is _root_owner:
+            _active_root = None
+            _root_owner = None
 
 
 def active_root() -> str | None:
-    return _active_root
+    ctx = _root_var.get()
+    return ctx if ctx is not None else _active_root
 
 
 # ---------------------------------------------------------------------------

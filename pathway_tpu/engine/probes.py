@@ -75,14 +75,6 @@ class ProberStats:
     epochs: int = 0
     row_counts: dict[int, int] = field(default_factory=dict)
 
-    @property
-    def latency_ms(self) -> float | None:
-        """Output latency: how far outputs trail inputs (progress_reporter.rs)."""
-        it, ot = self.input_stats.time, self.output_stats.time
-        if it is None or ot is None:
-            return None
-        return max(0.0, float(it - ot))
-
 
 class Prober:
     """Collects :class:`ProberStats` from a :class:`Scope` after each epoch.
@@ -96,7 +88,6 @@ class Prober:
         self.scope = scope
         self.callbacks: list[Callable[[ProberStats], None]] = list(callbacks or [])
         self.stats = ProberStats()
-        self._epoch_wallclock: dict[int, float] = {}
 
     def update(self, *, done: bool = False, epochs: int | None = None) -> ProberStats:
         from pathway_tpu.engine.dataflow import InputNode, OutputNode
@@ -105,11 +96,9 @@ class Prober:
             return self.stats
         now = _time.monotonic()
         t = self.scope.current_time
-        self._epoch_wallclock.setdefault(t, now)
-        # keep the wallclock map bounded
-        if len(self._epoch_wallclock) > 1024:
-            for old in sorted(self._epoch_wallclock)[:-512]:
-                del self._epoch_wallclock[old]
+        # wallclock of the epoch's earliest staged row, recorded by
+        # InputNode.emit_time — lag is real ingest→processed delay
+        seen = self.scope.epoch_wallclock.get(t)
 
         ops: dict[int, OperatorStats] = {}
         inputs = OperatorStats(name="input", done=done)
@@ -123,7 +112,6 @@ class Prober:
                 rows_out=node.rows_out,
                 done=done or (isinstance(node, InputNode) and node.finished),
             )
-            seen = self._epoch_wallclock.get(t)
             if seen is not None:
                 st.lag_ms = (now - seen) * 1000.0
             ops[node.id] = st

@@ -70,12 +70,14 @@ def run(
         attach(lowerer, node)
 
     result = RunResult()
+    root_token = None
     if storage is not None:
         from pathway_tpu.engine import persistence as pz
 
         if isinstance(storage.backend, pz.FileBackend):
             # UDF DiskCache shares the persistence root for this run only
-            pz.set_active_root(storage.backend.root)
+            # (first-wins across concurrent runs; released below)
+            root_token = pz.acquire_active_root(storage.backend.root)
 
     from pathway_tpu.engine.probes import Prober
     from pathway_tpu.internals.config import get_config
@@ -113,7 +115,7 @@ def run(
             storage.commit()
             from pathway_tpu.engine import persistence as pz
 
-            pz.set_active_root(None)
+            pz.release_active_root(root_token)
         for cleanup in lowerer.cleanups:
             try:
                 cleanup()
@@ -147,6 +149,23 @@ def _input_nodes(scope: df.Scope) -> list[df.InputNode]:
     return [n for n in scope.nodes if isinstance(n, df.InputNode)]
 
 
+def _ack_sources(pollers, *, persisted: bool, up_to_time: int | None = None) -> None:
+    """Tell external-offset sources (Kafka groups) a durability point passed.
+
+    ``persisted=True``: called after ``storage.commit()`` — acks pollers
+    whose rows land in input snapshots (replay covers them).
+    ``persisted=False``: called after an epoch ran — acks pollers with no
+    snapshot state, gated on the epoch time.
+    """
+    for poller in pollers:
+        ack = getattr(poller, "ack_processed", None)
+        if ack is None:
+            continue
+        has_snapshots = getattr(poller, "persist_state", None) is not None
+        if has_snapshots == persisted:
+            ack(up_to_time)
+
+
 def _event_loop(
     scope: df.Scope,
     lowerer: Lowerer,
@@ -171,6 +190,9 @@ def _event_loop(
         ):
             storage.commit()
             last_snapshot = _time.monotonic()
+            # snapshot persisted: sources whose rows are in it may commit
+            # their broker offsets for everything it covers
+            _ack_sources(pollers, persisted=True)
         exhausted = True
         for poller in pollers:
             if not poller.poll():
@@ -185,15 +207,15 @@ def _event_loop(
                 t = last_time + 2  # keep times strictly increasing & even
             for inp in inputs:
                 # merge any earlier-stamped staged rows into this epoch
-                merged: list = []
-                for staged in sorted(st for st in inp.pending_times() if st <= t):
-                    merged.extend(inp._staged.pop(staged))
-                if merged:
-                    inp._staged[t] = merged
+                inp.merge_staged_through(t)
                 inp.emit_time(t)
             scope.run_epoch(t)
             last_time = t
             result.epochs += 1
+            # sources without input snapshots (no persistence, or UDF-cache-
+            # only mode): the processed epoch is their durability boundary —
+            # broker offsets may cover rows up to it, and no further
+            _ack_sources(pollers, persisted=False, up_to_time=t)
             if prober is not None and prober.callbacks:
                 prober.update(epochs=result.epochs)
             if max_epochs is not None and result.epochs >= max_epochs:
@@ -202,6 +224,10 @@ def _event_loop(
         all_finished = exhausted and all(inp.finished for inp in inputs)
         if all_finished:
             break
+        # idle streams still drain commit markers: a Kafka source's
+        # timer-driven COMMITs keep arriving with no new epochs, and the
+        # offsets for the last processed epoch must still reach the broker
+        _ack_sources(pollers, persisted=False, up_to_time=last_time)
         _time.sleep(0.001)
     scope.current_time = max(scope.current_time, last_time)
     scope.finish()

@@ -59,9 +59,9 @@ class DiskCache(CacheStrategy):
 
     @property
     def _dir(self) -> str:
-        # resolved per call: the running pipeline's persistence root wins,
-        # then the env override, then a local default — so two runs with
-        # different persistence roots in one process stay isolated
+        # resolved per call: the current run's persistence root wins
+        # (context-local, so concurrent runs each see their own), then the
+        # env override, then a local default
         from pathway_tpu.engine import persistence as pz
 
         root = (

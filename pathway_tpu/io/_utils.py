@@ -15,6 +15,7 @@ import itertools
 import queue
 import threading
 import time as _time
+from collections import deque
 from typing import Any, Callable, Iterable, Mapping
 
 from pathway_tpu.engine import dataflow as df
@@ -116,6 +117,11 @@ class _QueuePoller:
         # external-resume sources emit no Offset markers; their chunks flush
         # at commit boundaries instead (offset frontier stays None)
         self.flush_on_commit = False
+        self.reader: Reader | None = None
+        self._drained_commits = 0  # COMMIT sentinels this poller has consumed
+        # (marker seq, epoch time its rows were stamped with) awaiting the
+        # engine's durability point; popped by ack_processed
+        self._commit_markers: deque[tuple[int, int]] = deque()
 
     def _key_of(self, values: list, row: Mapping) -> int:
         if "_pw_key" in row:
@@ -146,6 +152,12 @@ class _QueuePoller:
                 self.finished = True
                 return True
             if item is COMMIT:
+                self._drained_commits += 1
+                # rows covered by this marker were stamped with the epoch
+                # being closed (or an already-closed one if nothing staged);
+                # the marker may be acked once that epoch is durable
+                marker_time = self._time if self._staged else self._time - 2
+                self._commit_markers.append((self._drained_commits, marker_time))
                 if self._staged:
                     self._time += 2
                     self._staged = False
@@ -179,6 +191,26 @@ class _QueuePoller:
             self._last_commit = _time.monotonic()
         return False
 
+    def ack_processed(self, up_to_time: int | None = None) -> None:
+        """Durability point reached: let the reader commit its external
+        offsets (on its own thread) for every COMMIT marker whose rows are
+        covered.  ``up_to_time`` — the epoch the engine just processed —
+        gates markers for non-persisted sources (rows staged for a later
+        epoch are still in memory only); ``None`` means all drained markers
+        are durable (their snapshot chunks were flushed and committed).
+        The reader commits the offsets it captured at the marker — never
+        its live position, which may already cover unprocessed rows."""
+        request = getattr(self.reader, "request_offset_commit", None)
+        if request is None or not self._commit_markers:
+            return
+        seq = None
+        while self._commit_markers and (
+            up_to_time is None or self._commit_markers[0][1] <= up_to_time
+        ):
+            seq = self._commit_markers.popleft()[0]
+        if seq is not None:
+            request(seq)
+
 
 def make_input_table(
     schema: type[schema_mod.Schema],
@@ -197,6 +229,7 @@ def make_input_table(
             node.require_state()
         poller = _QueuePoller(node, schema, autocommit_duration_ms)
         reader = reader_factory()
+        poller.reader = reader
 
         # persistence: replay committed snapshot, seek reader past it
         storage = getattr(lowerer, "persistence_storage", None)

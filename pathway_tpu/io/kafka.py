@@ -9,6 +9,8 @@ partitions on one consumer thread.
 from __future__ import annotations
 
 import json as _json
+import threading as _threading
+import time as _time
 from typing import Any
 
 from pathway_tpu.engine.types import Json
@@ -43,35 +45,154 @@ class _KafkaReader(Reader):
     # frontier must NOT additionally skip rows (it would drop fresh data)
     external_resume = True
 
-    def __init__(self, rdkafka_settings, topic, format, schema):
+    def __init__(self, rdkafka_settings, topic, format, schema, commit_interval_s=1.5):
         self.settings = rdkafka_settings
         self.topic = topic
         self.format = format
         self.schema = schema
+        self.commit_interval_s = commit_interval_s
+        self._offset_commit_requested = _threading.Event()
+        self._lock = _threading.Lock()
+        self._commit_seq = 0  # COMMIT markers emitted so far
+        self._ack_up_to = 0  # highest marker the engine has acknowledged
+        self._captured: dict[int, Any] = {}  # marker seq -> offsets snapshot
+
+    def request_offset_commit(self, up_to: int | None = None) -> None:
+        """Called by the engine at its durability point (epoch processed /
+        snapshot committed); ``up_to`` is how many of our COMMIT markers the
+        engine has consumed.  The broker commit itself happens on the
+        consumer thread — Kafka clients are not thread-safe — and commits
+        the offsets captured at that marker, not the live position (which
+        may already be past rows the engine never processed)."""
+        with self._lock:
+            self._ack_up_to = max(
+                self._ack_up_to, self._commit_seq if up_to is None else up_to
+            )
+        self._offset_commit_requested.set()
+
+    def _capture(self, offsets: Any) -> None:
+        """Snapshot consumer positions at a just-emitted COMMIT marker."""
+        with self._lock:
+            self._commit_seq += 1
+            if offsets:
+                self._captured[self._commit_seq] = offsets
+
+    @staticmethod
+    def _try_commit(commit: Any) -> None:
+        """Broker offset commits are best-effort: a transient failure
+        (rebalance, coordinator loss) must not kill the reader thread —
+        uncommitted offsets just mean redelivery, i.e. at-least-once."""
+        try:
+            commit()
+        except Exception as exc:
+            import logging
+
+            logging.getLogger("pathway_tpu.io").warning(
+                "kafka offset commit failed (will retry at next ack): %s", exc
+            )
+
+    def _take_acked(self) -> Any:
+        """Offsets snapshot at the newest acknowledged marker, or None."""
+        self._offset_commit_requested.clear()
+        with self._lock:
+            acked = [s for s in self._captured if s <= self._ack_up_to]
+            if not acked:
+                return None
+            offsets = self._captured[max(acked)]
+            for s in acked:
+                del self._captured[s]
+            return offsets
 
     def run(self, emit) -> None:
         kind, client = _get_client()
         names = list(self.schema.__columns__.keys()) if self.schema else ["data"]
+        # broker offsets are committed manually, and only after the engine
+        # acknowledges the rows (request_offset_commit): client-side
+        # auto-commit runs on its own clock and would advance the group
+        # offset past rows the engine never saw — row loss on restart.
+        # Offsets trail the durability point, so restarts redeliver the
+        # tail: at-least-once, matching the reference's guarantee.
+        group_id = self.settings.get("group.id")
         if kind == "confluent":
-            consumer = client.Consumer(self.settings)
+            settings = dict(self.settings)
+            settings["enable.auto.commit"] = False
+            consumer = client.Consumer(settings)
             consumer.subscribe([self.topic])
+
+            def positions():
+                try:
+                    return [
+                        tp
+                        for tp in consumer.position(consumer.assignment())
+                        if tp.offset >= 0
+                    ]
+                except Exception:
+                    return []
+
+            last_epoch = _time.monotonic()
             while True:
                 msg = consumer.poll(0.5)
-                if msg is None:
+                if msg is not None and not msg.error():
+                    # emit before any COMMIT marker: poll() already advanced
+                    # the position past this message, so the marker's
+                    # snapshot must only be taken once the row is emitted
+                    self._emit_payload(msg.value(), names, emit)
+                now = _time.monotonic()
+                if msg is None or (now - last_epoch) >= self.commit_interval_s:
+                    # epoch boundary on idle AND on a timer under load —
+                    # a busy topic must still reach durability points
                     emit(COMMIT)
-                    continue
-                if msg.error():
-                    continue
-                self._emit_payload(msg.value(), names, emit)
+                    if group_id:  # group-less consumers never commit
+                        self._capture(positions())
+                    last_epoch = now
+                if group_id and self._offset_commit_requested.is_set():
+                    offsets = self._take_acked()
+                    if offsets:
+                        self._try_commit(
+                            lambda: consumer.commit(
+                                offsets=offsets, asynchronous=False
+                            )
+                        )
         else:
             consumer = client.KafkaConsumer(
                 self.topic,
                 bootstrap_servers=self.settings.get("bootstrap.servers"),
-                group_id=self.settings.get("group.id"),
+                group_id=group_id,
+                enable_auto_commit=False,
             )
-            for msg in consumer:
-                self._emit_payload(msg.value, names, emit)
-                emit(COMMIT)
+            meta_cls = getattr(client, "OffsetAndMetadata", None)
+
+            def positions():
+                out = {}
+                for tp in consumer.assignment():
+                    try:
+                        pos = consumer.position(tp)
+                    except Exception:
+                        continue
+                    if pos is None or pos < 0 or meta_cls is None:
+                        continue
+                    try:
+                        out[tp] = meta_cls(pos, "", -1)
+                    except TypeError:  # older kafka-python: no leader_epoch
+                        out[tp] = meta_cls(pos, "")
+                return out
+
+            last_epoch = _time.monotonic()
+            while True:
+                batches = consumer.poll(timeout_ms=500)
+                now = _time.monotonic()
+                for records in batches.values():
+                    for msg in records:
+                        self._emit_payload(msg.value, names, emit)
+                if not batches or (now - last_epoch) >= self.commit_interval_s:
+                    emit(COMMIT)
+                    if group_id:  # kafka-python asserts group_id on commit()
+                        self._capture(positions())
+                    last_epoch = now
+                if group_id and self._offset_commit_requested.is_set():
+                    offsets = self._take_acked()
+                    if offsets:
+                        self._try_commit(lambda: consumer.commit(offsets=offsets))
 
     def _emit_payload(self, payload: bytes, names, emit) -> None:
         if self.format == "raw":
@@ -109,7 +230,13 @@ def read(
         raise ValueError("kafka.read with json format requires schema=")
     return _utils.make_input_table(
         schema,
-        lambda: _KafkaReader(rdkafka_settings, topic, format, schema),
+        lambda: _KafkaReader(
+            rdkafka_settings,
+            topic,
+            format,
+            schema,
+            commit_interval_s=(autocommit_duration_ms or 1500) / 1000.0,
+        ),
         autocommit_duration_ms=autocommit_duration_ms,
         name=name,
     )

@@ -32,7 +32,10 @@ _module = None
 def _compile() -> str | None:
     with open(_SRC, "rb") as f:
         src_hash = hashlib.blake2b(f.read(), digest_size=8).hexdigest()
-    so_path = os.path.join(_BUILD_DIR, f"_native_{src_hash}.so")
+    # key the cache by interpreter ABI too: a .so built for another CPython
+    # version must not be dlopened into this one
+    abi = f"{sys.hexversion:08x}"
+    so_path = os.path.join(_BUILD_DIR, f"_native_{src_hash}_{abi}.so")
     if os.path.exists(so_path):
         return so_path
     os.makedirs(_BUILD_DIR, exist_ok=True)
@@ -87,8 +90,17 @@ def _load():
         return out.getvalue()
 
     def decode_slow(tag, view, pos):
-        # pos points just past the tag byte; codec.decode_value re-reads it
-        return codec.decode_value(view, pos - 1)
+        # pos points just past the tag byte; codec.decode_value re-reads it.
+        # Same corrupt-buffer contract as decode_row_py: everything decode
+        # raises surfaces as the one documented, catchable ValueError.
+        try:
+            return codec.decode_value(view, pos - 1)
+        except ValueError:
+            raise
+        except MemoryError:
+            raise
+        except Exception as exc:
+            raise ValueError(f"codec: corrupt buffer ({exc})") from exc
 
     def ser_slow(v):
         out: list[bytes] = []

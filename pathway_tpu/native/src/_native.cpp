@@ -424,7 +424,8 @@ struct Cursor {
   size_t len;
   size_t pos;
   bool need(size_t n) {
-    if (pos + n > len) {
+    // subtraction form: `pos + n` can wrap for corrupted length fields
+    if (pos > len || n > len - pos) {
       PyErr_SetString(PyExc_ValueError, "codec: truncated buffer");
       return false;
     }

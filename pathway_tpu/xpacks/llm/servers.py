@@ -8,7 +8,6 @@ delivered when the result row appears.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Any, Callable
 

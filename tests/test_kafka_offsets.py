@@ -1,0 +1,112 @@
+"""Kafka offset-commit protocol tests (no broker required).
+
+The at-least-once rule under test: broker offsets are committed only for
+rows the engine acknowledged (epoch processed / snapshot committed), using
+the positions snapshot captured at the COMMIT marker — never the consumer's
+live position, which may already be past unprocessed rows.
+"""
+
+import pathway_tpu as pw
+from pathway_tpu.io import _utils
+from pathway_tpu.io.kafka import _KafkaReader
+
+
+def _reader():
+    class S(pw.Schema):
+        data: bytes
+
+    return _KafkaReader({"bootstrap.servers": "x", "group.id": "g"}, "t", "raw", S)
+
+
+def test_capture_and_ack_selects_marker_snapshot():
+    r = _reader()
+    r._capture(["off@10"])  # marker 1
+    r._capture(["off@20"])  # marker 2
+    r._capture(["off@30"])  # marker 3
+    # engine acknowledged markers 1..2 only
+    r.request_offset_commit(2)
+    assert r._offset_commit_requested.is_set()
+    assert r._take_acked() == ["off@20"]  # newest acked snapshot, not live
+    # marker 3 stays pending until a later ack covers it
+    assert r._captured == {3: ["off@30"]}
+    r.request_offset_commit(3)
+    assert r._take_acked() == ["off@30"]
+    assert r._captured == {}
+
+
+def test_ack_before_any_capture_is_noop():
+    r = _reader()
+    r.request_offset_commit(5)
+    assert r._take_acked() is None
+
+
+def test_empty_positions_are_not_captured():
+    r = _reader()
+    r._capture([])  # no assignment yet
+    r.request_offset_commit(1)
+    assert r._take_acked() is None
+
+
+class FakeReader(_utils.Reader):
+    external_resume = True
+
+    def __init__(self):
+        self.acks = []
+
+    def request_offset_commit(self, up_to=None):
+        self.acks.append(up_to)
+
+    def run(self, emit):  # pragma: no cover - not started here
+        pass
+
+
+def _poller():
+    class S(pw.Schema):
+        v: int
+
+    from pathway_tpu.engine import dataflow as df
+
+    scope = df.Scope()
+    node = df.InputNode(scope)
+    poller = _utils._QueuePoller(node, S, autocommit_duration_ms=1500)
+    poller.reader = FakeReader()
+    return poller
+
+
+def test_epoch_gated_ack_excludes_unprocessed_markers():
+    # catch-up: two epochs of rows drained in one poll (times 2 and 4)
+    poller = _poller()
+    poller.q.put({"v": 1})
+    poller.q.put(_utils.COMMIT)  # marker 1, rows at time 2
+    poller.q.put({"v": 2})
+    poller.q.put(_utils.COMMIT)  # marker 2, rows at time 4
+    poller.poll()
+    # engine ran only epoch 2: marker 2's rows are still staged in memory,
+    # so its broker offsets must NOT be committed yet
+    poller.ack_processed(up_to_time=2)
+    assert poller.reader.acks == [1]
+    poller.ack_processed(up_to_time=4)
+    assert poller.reader.acks == [1, 2]
+    # nothing left to ack
+    poller.ack_processed(up_to_time=10)
+    assert poller.reader.acks == [1, 2]
+
+
+def test_unconditional_ack_covers_all_drained_markers():
+    # persisted sources: snapshot commit covers every flushed marker
+    poller = _poller()
+    poller.q.put({"v": 1})
+    poller.q.put(_utils.COMMIT)
+    poller.q.put({"v": 2})
+    poller.q.put(_utils.COMMIT)
+    poller.poll()
+    poller.ack_processed(None)
+    assert poller.reader.acks == [2]
+
+
+def test_empty_commit_marker_is_immediately_safe():
+    poller = _poller()
+    poller.q.put(_utils.COMMIT)  # no rows: marker covers nothing new
+    poller.poll()
+    poller.ack_processed(up_to_time=0)
+    assert poller.reader.acks == [1]

@@ -125,6 +125,29 @@ class TestCodecParity:
         with pytest.raises(ValueError):
             nat.decode_row(enc[: len(enc) - 3])
 
+    def test_corrupt_huge_length_raises(self, nat):
+        # a bit-rotted length field near u64::MAX must not wrap the
+        # bounds check (pos + n overflow) into an out-of-bounds read
+        enc = codec.encode_row_py(("hello",))
+        huge = (0xFFFFFFFFFFFFFFF8).to_bytes(8, "little")
+        corrupted = enc.replace((5).to_bytes(8, "little"), huge)
+        assert corrupted != enc  # the length field was found and patched
+        with pytest.raises(ValueError):
+            nat.decode_row(corrupted)
+        with pytest.raises(ValueError):
+            codec.decode_row_py(corrupted)
+
+    def test_corrupt_dtype_raises_valueerror_both_paths(self, nat):
+        # in-bounds corruption (bit-rotted ndarray dtype string) must
+        # surface the same catchable ValueError from both decoders
+        enc = codec.encode_row_py((np.arange(3.0),))
+        corrupted = enc.replace(b"<f8", b"zz9")
+        assert corrupted != enc
+        with pytest.raises(ValueError):
+            nat.decode_row(corrupted)
+        with pytest.raises(ValueError):
+            codec.decode_row_py(corrupted)
+
     def test_overflow_int_raises(self, nat):
         with pytest.raises(OverflowError):
             nat.hash_values((2**200,))
