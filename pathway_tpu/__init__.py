@@ -132,6 +132,7 @@ from pathway_tpu.internals.iterate import iterate, iterate_universe  # noqa: E40
 from pathway_tpu.internals.sql import sql  # noqa: E402
 from pathway_tpu.internals import universes  # noqa: E402
 from pathway_tpu.internals.errors import global_error_log, local_error_log  # noqa: E402
+from pathway_tpu.internals.yaml_loader import load_yaml  # noqa: E402
 from pathway_tpu.internals.table_io import table_transformer  # noqa: E402
 
 # attach stdlib-defined Table methods (windowby etc. — same trick the
@@ -246,6 +247,7 @@ __all__ = [
     "iterate",
     "iterate_universe",
     "sql",
+    "load_yaml",
     "universes",
     "AsyncTransformer",
     "pandas_transformer",

@@ -1,0 +1,5 @@
+"""``python -m pathway_tpu`` → the process-orchestration CLI."""
+
+from pathway_tpu.cli import main
+
+main()

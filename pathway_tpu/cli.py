@@ -1,0 +1,174 @@
+"""Process-orchestration CLI.
+
+Parity target: ``python/pathway/cli.py`` — ``spawn`` forks N identical
+processes of the user's script with ``PATHWAY_THREADS/PROCESSES/
+FIRST_PORT/PROCESS_ID/RUN_ID`` set (every worker builds the same dataflow
+and owns a shard, SURVEY.md §2b); ``replay`` re-runs a script against a
+recorded input stream; ``spawn-from-env`` re-execs ``spawn`` with
+arguments taken from ``PATHWAY_SPAWN_ARGS`` (the k8s-operator hook).
+
+TPU mapping: one spawned process per TPU host (the reference maps one per
+CPU socket); in-process workers become mesh axes, so ``--threads`` is
+accepted for parity but the device mesh is what actually scales compute.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import uuid
+from typing import NoReturn
+
+import click
+
+import pathway_tpu as pw
+
+
+def _plural(n: int, singular: str, plural: str) -> str:
+    return f"1 {singular}" if n == 1 else f"{n} {plural}"
+
+
+def spawn_program(
+    *,
+    threads: int,
+    processes: int,
+    first_port: int,
+    program: str,
+    arguments: tuple[str, ...],
+    env_base: dict[str, str],
+) -> NoReturn:
+    """Launch ``processes`` copies of ``program`` forming one SPMD cluster."""
+    click.echo(
+        f"Preparing {_plural(processes, 'process', 'processes')} "
+        f"({_plural(processes * threads, 'total worker', 'total workers')})",
+        err=True,
+    )
+    run_id = str(uuid.uuid4())
+    handles: list[subprocess.Popen] = []
+    try:
+        for process_id in range(processes):
+            env = dict(env_base)
+            env["PATHWAY_THREADS"] = str(threads)
+            env["PATHWAY_PROCESSES"] = str(processes)
+            env["PATHWAY_FIRST_PORT"] = str(first_port)
+            env["PATHWAY_PROCESS_ID"] = str(process_id)
+            env["PATHWAY_RUN_ID"] = run_id
+            handles.append(subprocess.Popen([program, *arguments], env=env))
+        for handle in handles:
+            handle.wait()
+    finally:
+        for handle in handles:
+            handle.terminate()
+    codes = [handle.returncode for handle in handles]
+    # a signal-killed worker (negative returncode) must not read as success;
+    # report it with the conventional 128+signum shell encoding
+    sys.exit(max(c if c >= 0 else 128 - c for c in codes))
+
+
+@click.group
+@click.version_option(version=pw.__version__, prog_name="pathway_tpu")
+def cli() -> None:
+    pass
+
+
+_SPAWN_SETTINGS = {"allow_interspersed_args": False, "show_default": True}
+
+
+@cli.command(context_settings=_SPAWN_SETTINGS)
+@click.option("-t", "--threads", metavar="N", type=click.IntRange(min=1), default=1, help="threads per process")
+@click.option("-n", "--processes", metavar="N", type=click.IntRange(min=1), default=1, help="number of processes")
+@click.option("--first-port", metavar="PORT", type=int, default=10000, help="first port for worker communication")
+@click.option("--record", is_flag=True, help="record data in the input connectors")
+@click.option("--record-path", type=str, default="record", help="directory in which the recording is saved")
+@click.argument("program")
+@click.argument("arguments", nargs=-1)
+def spawn(threads, processes, first_port, record, record_path, program, arguments):
+    """Run PROGRAM as an SPMD cluster of identical processes."""
+    env = os.environ.copy()
+    if record:
+        env["PATHWAY_REPLAY_STORAGE"] = record_path
+        env["PATHWAY_SNAPSHOT_ACCESS"] = "record"
+        env["PATHWAY_CONTINUE_AFTER_REPLAY"] = "true"
+    spawn_program(
+        threads=threads,
+        processes=processes,
+        first_port=first_port,
+        program=program,
+        arguments=arguments,
+        env_base=env,
+    )
+
+
+@cli.command(context_settings=_SPAWN_SETTINGS)
+@click.option("-t", "--threads", metavar="N", type=click.IntRange(min=1), default=1, help="threads per process")
+@click.option("-n", "--processes", metavar="N", type=click.IntRange(min=1), default=1, help="number of processes")
+@click.option("--first-port", metavar="PORT", type=int, default=10000, help="first port for worker communication")
+@click.option("--record-path", type=str, default="record", help="directory the recording is stored in")
+@click.option(
+    "--mode",
+    type=click.Choice(["batch", "speedrun"], case_sensitive=False),
+    help="mode of replaying data",
+)
+@click.option(
+    "--continue",
+    "continue_after_replay",
+    is_flag=True,
+    help="continue with live connector data after the recording is replayed",
+)
+@click.argument("program")
+@click.argument("arguments", nargs=-1)
+def replay(threads, processes, first_port, record_path, mode, continue_after_replay, program, arguments):
+    """Re-run PROGRAM against a recorded input stream."""
+    env = os.environ.copy()
+    env["PATHWAY_REPLAY_STORAGE"] = record_path
+    env["PATHWAY_SNAPSHOT_ACCESS"] = "replay"
+    if mode:
+        env["PATHWAY_PERSISTENCE_MODE"] = mode
+        env["PATHWAY_REPLAY_MODE"] = mode
+    if continue_after_replay:
+        env["PATHWAY_CONTINUE_AFTER_REPLAY"] = "true"
+    spawn_program(
+        threads=threads,
+        processes=processes,
+        first_port=first_port,
+        program=program,
+        arguments=arguments,
+        env_base=env,
+    )
+
+
+@cli.command(name="spawn-from-env")
+def spawn_from_env():
+    """Re-exec ``spawn`` with arguments from PATHWAY_SPAWN_ARGS."""
+    spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS")
+    if spawn_args is None:
+        click.echo("PATHWAY_SPAWN_ARGS variable is unspecified, exiting...", err=True)
+        return
+    os.execl(
+        sys.executable, sys.executable, "-m", "pathway_tpu", "spawn", *spawn_args.split()
+    )
+
+
+@cli.group()
+def airbyte() -> None:
+    pass
+
+
+@airbyte.command(name="create-source")
+@click.argument("connection")
+@click.option("--image", default="airbyte/source-faker:0.1.4", help="public Airbyte source Docker image")
+def create_source(connection, image):
+    """Scaffold an Airbyte connection config (requires docker at runtime)."""
+    from pathway_tpu.io.airbyte import write_connection_scaffold
+
+    path = write_connection_scaffold(connection, image)
+    click.echo(f"Connection `{connection}` with source `{image}` created at {path}")
+
+
+def main() -> NoReturn:
+    cli.main()
+
+
+if __name__ == "__main__":
+    main()

@@ -71,23 +71,24 @@ def run(
 
     result = RunResult()
     root_token = None
-    if storage is not None:
-        from pathway_tpu.engine import persistence as pz
-
-        if isinstance(storage.backend, pz.FileBackend):
-            # UDF DiskCache shares the persistence root for this run only
-            # (first-wins across concurrent runs; released below)
-            root_token = pz.acquire_active_root(storage.backend.root)
-
-    from pathway_tpu.engine.probes import Prober
-    from pathway_tpu.internals.config import get_config
-    from pathway_tpu.internals.monitoring import MonitoringLevel, monitor_stats
-
-    config = get_config()
-    if monitoring_level is None:
-        monitoring_level = MonitoringLevel.AUTO
     http_server = None
     try:
+        if storage is not None:
+            from pathway_tpu.engine import persistence as pz
+
+            if isinstance(storage.backend, pz.FileBackend):
+                # UDF DiskCache shares the persistence root for this run
+                # only; acquired inside the try so any failure below still
+                # releases it in the finally
+                root_token = pz.acquire_active_root(storage.backend.root)
+
+        from pathway_tpu.engine.probes import Prober
+        from pathway_tpu.internals.config import get_config
+        from pathway_tpu.internals.monitoring import MonitoringLevel, monitor_stats
+
+        config = get_config()
+        if monitoring_level is None:
+            monitoring_level = MonitoringLevel.AUTO
         if with_http_server:
             from pathway_tpu.engine.http_server import MonitoringServer
 

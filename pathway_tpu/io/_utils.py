@@ -155,15 +155,19 @@ class _QueuePoller:
                 self._drained_commits += 1
                 # rows covered by this marker were stamped with the epoch
                 # being closed (or an already-closed one if nothing staged);
-                # the marker may be acked once that epoch is durable
+                # the marker may be acked once that epoch is durable.  The
+                # snapshot buffer must flush BEFORE the marker exists, even
+                # when the autocommit timer already closed the epoch —
+                # otherwise a snapshot commit could ack broker offsets for
+                # rows still sitting in the unflushed buffer
+                if self.flush_on_commit and self.persist_state is not None:
+                    self.persist_state.log.flush_chunk()
                 marker_time = self._time if self._staged else self._time - 2
                 self._commit_markers.append((self._drained_commits, marker_time))
                 if self._staged:
                     self._time += 2
                     self._staged = False
                     self._last_commit = _time.monotonic()
-                    if self.flush_on_commit and self.persist_state is not None:
-                        self.persist_state.log.flush_chunk()
                 continue
             if isinstance(item, Offset):
                 # snapshot chunks flush exactly at offset markers so the
@@ -189,6 +193,8 @@ class _QueuePoller:
             self._time += 2
             self._staged = False
             self._last_commit = _time.monotonic()
+            if self.flush_on_commit and self.persist_state is not None:
+                self.persist_state.log.flush_chunk()
         return False
 
     def ack_processed(self, up_to_time: int | None = None) -> None:

@@ -5,7 +5,34 @@ which is not part of this environment; the API surface matches the
 reference so pipelines import and typecheck unchanged.
 """
 
+from __future__ import annotations
+
+import os
+
 from pathway_tpu.io._gated import gated_reader, gated_writer
 
 read = gated_reader("airbyte", "airbyte_serverless")
 write = gated_writer("airbyte", "airbyte_serverless")
+
+
+def write_connection_scaffold(connection: str, image: str) -> str:
+    """Create the connection config skeleton ``pathway_tpu airbyte
+    create-source`` edits by hand (reference: ``cli.py create_source`` /
+    airbyte-serverless ``ConnectionFromFile.init_yaml_config``).
+
+    The real spec discovery runs the source's Docker image; without docker
+    this writes the documented template with the image pinned, which the
+    gated reader validates at ``read`` time.
+    """
+    path = connection if connection.endswith((".yml", ".yaml")) else f"{connection}.yaml"
+    name = os.path.splitext(os.path.basename(path))[0]
+    with open(path, "x") as f:  # atomic create: refuses to overwrite
+        f.write(
+            "source:\n"
+            f"  docker_image: {image}\n"
+            "  config:\n"
+            "    # fill in the source's spec fields here\n"
+            "streams: []\n"
+            f"name: {name}\n"
+        )
+    return path

@@ -33,8 +33,9 @@ def _compile() -> str | None:
     with open(_SRC, "rb") as f:
         src_hash = hashlib.blake2b(f.read(), digest_size=8).hexdigest()
     # key the cache by interpreter ABI too: a .so built for another CPython
-    # version must not be dlopened into this one
-    abi = f"{sys.hexversion:08x}"
+    # version/ABI (including free-threaded or debug builds, which share a
+    # hexversion) must not be dlopened into this one
+    abi = sysconfig.get_config_var("SOABI") or f"{sys.hexversion:08x}"
     so_path = os.path.join(_BUILD_DIR, f"_native_{src_hash}_{abi}.so")
     if os.path.exists(so_path):
         return so_path

@@ -1,0 +1,240 @@
+"""CLI (spawn/replay/spawn-from-env) and YAML app-loader tests.
+
+Model: the reference launches N identical processes wired into one
+cluster via PATHWAY_* env vars (cli.py:53-110) and loads declarative
+app.yaml configs whose tags construct pipeline objects
+(internals/yaml_loader.py).
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.yaml_loader import import_object, load_yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(args, env_extra=None, cwd=None):
+    env = os.environ.copy()
+    env["PYTHONPATH"] = REPO
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "pathway_tpu", *args],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=cwd or REPO,
+        timeout=120,
+    )
+
+
+WORKER_SCRIPT = textwrap.dedent(
+    """
+    import json, os, sys
+    out = os.path.join(sys.argv[1], f"out_{os.environ['PATHWAY_PROCESS_ID']}.json")
+    with open(out, "w") as f:
+        json.dump({
+            "process_id": os.environ["PATHWAY_PROCESS_ID"],
+            "processes": os.environ["PATHWAY_PROCESSES"],
+            "threads": os.environ["PATHWAY_THREADS"],
+            "first_port": os.environ["PATHWAY_FIRST_PORT"],
+            "run_id": os.environ["PATHWAY_RUN_ID"],
+        }, f)
+    """
+)
+
+
+def _read_worker_outputs(tmp_path):
+    return [
+        json.loads(p.read_text()) for p in sorted(tmp_path.glob("out_*.json"))
+    ]
+
+
+def test_spawn_sets_cluster_env(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_SCRIPT)
+    res = _run_cli(
+        ["spawn", "-n", "2", "-t", "3", "--first-port", "12345",
+         sys.executable, str(script), str(tmp_path)]
+    )
+    assert res.returncode == 0, res.stderr
+    rows = _read_worker_outputs(tmp_path)
+    assert {r["process_id"] for r in rows} == {"0", "1"}
+    assert all(r["processes"] == "2" and r["threads"] == "3" for r in rows)
+    assert all(r["first_port"] == "12345" for r in rows)
+    assert len({r["run_id"] for r in rows}) == 1  # one run id for the cluster
+    assert "2 processes (6 total workers)" in res.stderr
+
+
+def test_spawn_propagates_failure_exit_code(tmp_path):
+    script = tmp_path / "boom.py"
+    script.write_text("raise SystemExit(3)")
+    res = _run_cli(["spawn", sys.executable, str(script)])
+    assert res.returncode == 3
+
+
+def test_replay_sets_replay_env(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, json\n"
+        "print(json.dumps({k: os.environ.get(k) for k in"
+        " ('PATHWAY_REPLAY_STORAGE','PATHWAY_SNAPSHOT_ACCESS','PATHWAY_PERSISTENCE_MODE')}))\n"
+    )
+    res = _run_cli(
+        ["replay", "--record-path", "rec", "--mode", "speedrun", sys.executable, str(script)]
+    )
+    assert res.returncode == 0, res.stderr
+    env_seen = json.loads(res.stdout.strip())
+    assert env_seen["PATHWAY_REPLAY_STORAGE"] == "rec"
+    assert env_seen["PATHWAY_SNAPSHOT_ACCESS"] == "replay"
+    assert env_seen["PATHWAY_PERSISTENCE_MODE"] == "speedrun"
+
+
+def test_spawn_from_env(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_SCRIPT)
+    res = _run_cli(
+        ["spawn-from-env"],
+        env_extra={"PATHWAY_SPAWN_ARGS": f"-n 2 {sys.executable} {script} {tmp_path}"},
+    )
+    assert res.returncode == 0, res.stderr
+    rows = _read_worker_outputs(tmp_path)
+    assert {r["process_id"] for r in rows} == {"0", "1"}
+
+
+def test_airbyte_create_source(tmp_path):
+    res = _run_cli(
+        ["airbyte", "create-source", "conn", "--image", "airbyte/source-faker:6.2.10"],
+        cwd=str(tmp_path),
+    )
+    assert res.returncode == 0, res.stderr
+    text = (tmp_path / "conn.yaml").read_text()
+    assert "airbyte/source-faker:6.2.10" in text
+
+
+# --- YAML loader ------------------------------------------------------------
+
+
+def test_import_object_forms():
+    assert import_object("pw.io.csv") is pw.io.csv
+    assert import_object("pathway_tpu.internals.yaml_loader:load_yaml") is load_yaml
+    assert import_object("len") is len
+
+
+def test_load_yaml_constructs_tagged_objects():
+    result = load_yaml(
+        io.StringIO(
+            """
+            table: !pw.debug.table_from_markdown
+              table_def: |
+                a | b
+                1 | 2
+            """
+        )
+    )
+    assert list(result["table"].column_names()) == ["a", "b"]
+
+
+def test_load_yaml_variables_and_sharing():
+    result = load_yaml(
+        io.StringIO(
+            """
+            $k: 7
+            first:
+              k: $k
+            second:
+              k: $k
+            shared: !pathway_tpu.internals.yaml_loader:Var
+              name: x
+            also_shared: $y
+            $y: !pathway_tpu.internals.yaml_loader:Var
+              name: x
+            """
+        )
+    )
+    assert result["first"]["k"] == 7 and result["second"]["k"] == 7
+    # a $var definition is constructed once and shared by reference
+    assert result["also_shared"].name == "x"
+
+
+def test_load_yaml_env_fallback(monkeypatch):
+    monkeypatch.setenv("MY_YAML_SETTING", "42")
+    assert load_yaml(io.StringIO("v: $MY_YAML_SETTING"))["v"] == 42
+    with pytest.raises(KeyError):
+        load_yaml(io.StringIO("v: $not_defined_lowercase"))
+
+
+def test_load_yaml_unused_variable_warns():
+    with pytest.warns(UserWarning, match="unused YAML variable"):
+        load_yaml(io.StringIO("$dead: 1\nlive: 2"))
+
+
+def test_load_yaml_lexical_scoping():
+    # a root definition must not capture an inner subtree's bindings
+    with pytest.raises(KeyError, match=r"\$b is not defined"):
+        load_yaml(
+            io.StringIO(
+                """
+                $a: $b
+                inner:
+                  $b: 1
+                  v: $a
+                """
+            )
+        )
+
+
+def test_load_yaml_var_keys_in_tagged_mapping():
+    out = load_yaml(
+        io.StringIO(
+            """
+            d: !dict
+              $p: 7
+              k: $p
+            """
+        )
+    )
+    assert out["d"] == {"k": 7}
+
+
+def test_load_yaml_env_value_constructed_once(monkeypatch):
+    monkeypatch.setenv(
+        "SHARED_OBJ", "!pathway_tpu.internals.yaml_loader:Var {name: x}"
+    )
+    out = load_yaml(io.StringIO("a: $SHARED_OBJ\nb: $SHARED_OBJ"))
+    assert out["a"] is out["b"]  # one construction, shared by reference
+
+
+def test_load_yaml_circular_variable_raises(monkeypatch):
+    monkeypatch.setenv("LOOPY", "$LOOPY")
+    with pytest.raises(ValueError, match="circular"):
+        load_yaml(io.StringIO("v: $LOOPY"))
+    with pytest.raises(ValueError, match="circular"):
+        load_yaml(io.StringIO("$a: $a\nv: $a"))
+
+
+def test_spawn_signal_death_is_failure(tmp_path):
+    script = tmp_path / "sig.py"
+    script.write_text("import os, signal; os.kill(os.getpid(), signal.SIGKILL)")
+    res = _run_cli(["spawn", sys.executable, str(script)])
+    assert res.returncode == 137  # 128 + SIGKILL
+
+
+def test_spawn_rejects_zero_processes(tmp_path):
+    res = _run_cli(["spawn", "-n", "0", sys.executable, "-c", "pass"])
+    assert res.returncode != 0
+    assert "is not in the range" in res.stderr or "Invalid value" in res.stderr
+
+
+def test_load_yaml_empty_tag_calls_or_returns():
+    out = load_yaml(io.StringIO("d: !dict\ns: !pathway_tpu.internals.yaml_loader:_VAR_TAG"))
+    assert out["d"] == {}
+    assert out["s"] == "tag:pathway.com,2024:variable"
