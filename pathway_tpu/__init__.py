@@ -133,6 +133,15 @@ from pathway_tpu.internals.sql import sql  # noqa: E402
 from pathway_tpu.internals import universes  # noqa: E402
 from pathway_tpu.internals.errors import global_error_log, local_error_log  # noqa: E402
 from pathway_tpu.internals.yaml_loader import load_yaml  # noqa: E402
+from pathway_tpu.internals.row_transformer import (  # noqa: E402
+    ClassArg,
+    attribute,
+    input_attribute,
+    input_method,
+    method,
+    output_attribute,
+    transformer,
+)
 from pathway_tpu.internals.table_io import table_transformer  # noqa: E402
 
 # attach stdlib-defined Table methods (windowby etc. — same trick the
@@ -248,6 +257,13 @@ __all__ = [
     "iterate_universe",
     "sql",
     "load_yaml",
+    "ClassArg",
+    "attribute",
+    "input_attribute",
+    "input_method",
+    "method",
+    "output_attribute",
+    "transformer",
     "universes",
     "AsyncTransformer",
     "pandas_transformer",

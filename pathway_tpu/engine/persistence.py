@@ -274,6 +274,12 @@ class PersistentStorage:
         self.sources: dict[str, SourceState] = {}
         self._metadata = self._load_metadata()
         self.replayed_rows = 0
+        # record/replay mode (PATHWAY_SNAPSHOT_ACCESS): None = both
+        # directions (ordinary persistence), "record" = write-only,
+        # "replay" = read snapshots; continue_after_replay then decides
+        # whether live connector data follows the replayed prefix
+        self.snapshot_access: str | None = None
+        self.continue_after_replay = True
 
     # -- metadata --
     def _meta_key(self) -> str:

@@ -1,0 +1,241 @@
+"""Telemetry: periodic process metrics and run spans, license-gated.
+
+Parity target: ``src/engine/telemetry.rs`` — gauges ``process.memory.
+usage``, ``process.cpu.utime``, ``process.cpu.stime``, ``latency.input``,
+``latency.output`` sampled on a periodic reader (60 s default,
+``telemetry.rs:39``), resource attributes ``service.*``/``run.id``/
+``root.trace.id``/``license.key`` shortcut, and tracing spans carrying a
+``traceparent`` from the Python layer (``graph_runner/telemetry.py``).
+
+Differences by design: the reference exports OTLP/gRPC to
+``usage.pathway.com`` by default when the license requires telemetry;
+this build has **zero egress**, so nothing is ever sent unless the user
+explicitly configures an endpoint (``pw.set_monitoring_config`` /
+``TelemetryConfig.create(monitoring_server=...)``), and the exporter is
+line-delimited JSON over HTTP POST rather than OTLP/gRPC (no
+opentelemetry wheels in the image; the payload carries the same names).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import secrets
+import threading
+import time
+import urllib.request
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+PERIODIC_READER_INTERVAL_S = 60.0
+EXPORT_TIMEOUT_S = 3.0
+
+PROCESS_MEMORY_USAGE = "process.memory.usage"
+PROCESS_CPU_USER_TIME = "process.cpu.utime"
+PROCESS_CPU_SYSTEM_TIME = "process.cpu.stime"
+INPUT_LATENCY = "latency.input"
+OUTPUT_LATENCY = "latency.output"
+
+LOCAL_DEV_NAMESPACE = "local-dev"
+
+logger = logging.getLogger("pathway_tpu.telemetry")
+
+
+class TelemetryError(RuntimeError):
+    pass
+
+
+@dataclass(frozen=True)
+class TelemetryConfig:
+    """Where (if anywhere) to deliver metrics/spans for this run."""
+
+    telemetry_enabled: bool = False
+    metrics_servers: tuple[str, ...] = ()
+    tracing_servers: tuple[str, ...] = ()
+    service_name: str = "pathway"
+    service_version: str = "0.0.0"
+    service_instance_id: str = ""
+    service_namespace: str = LOCAL_DEV_NAMESPACE
+    run_id: str = ""
+    trace_parent: str | None = None
+    license_shortcut: str = ""
+
+    @classmethod
+    def create(
+        cls,
+        *,
+        license: Any = None,
+        run_id: str | None = None,
+        monitoring_server: str | None = None,
+        trace_parent: str | None = None,
+    ) -> "TelemetryConfig":
+        """Mirror of ``TelemetryConfig::create`` (telemetry.rs): a
+        monitoring endpoint requires the MONITORING entitlement; with no
+        endpoint configured telemetry stays fully off (zero egress)."""
+        from pathway_tpu import __version__
+
+        if monitoring_server is not None and license is not None:
+            license.check_entitlements(["monitoring"])
+        servers = (monitoring_server,) if monitoring_server else ()
+        instance_id = os.environ.get("PATHWAY_SERVICE_INSTANCE_ID") or secrets.token_hex(8)
+        namespace = (
+            os.environ.get("PATHWAY_SERVICE_NAMESPACE") or LOCAL_DEV_NAMESPACE
+        )
+        return cls(
+            telemetry_enabled=bool(servers),
+            metrics_servers=tuple(servers),
+            tracing_servers=tuple(servers),
+            service_name="pathway",
+            service_version=__version__,
+            service_instance_id=instance_id,
+            service_namespace=namespace,
+            run_id=run_id or secrets.token_hex(8),
+            trace_parent=trace_parent,
+            license_shortcut=license.shortcut() if license is not None else "",
+        )
+
+    def resource(self) -> dict[str, str]:
+        return {
+            "service.name": self.service_name,
+            "service.version": self.service_version,
+            "service.instance.id": self.service_instance_id,
+            "service.namespace": self.service_namespace,
+            "run.id": self.run_id,
+            "root.trace.id": _root_trace_id(self.trace_parent) or "",
+            "license.key": self.license_shortcut,
+        }
+
+
+def _root_trace_id(trace_parent: str | None) -> str | None:
+    """trace-id field of a W3C ``traceparent`` header value."""
+    if not trace_parent:
+        return None
+    parts = trace_parent.split("-")
+    return parts[1] if len(parts) >= 3 and len(parts[1]) == 32 else None
+
+
+def _process_metrics() -> dict[str, float]:
+    utime, stime = os.times()[:2]
+    metrics = {PROCESS_CPU_USER_TIME: utime, PROCESS_CPU_SYSTEM_TIME: stime}
+    try:
+        with open("/proc/self/statm") as f:
+            metrics[PROCESS_MEMORY_USAGE] = (
+                int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+            )
+    except (OSError, ValueError, IndexError):
+        import resource
+
+        metrics[PROCESS_MEMORY_USAGE] = (
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        )
+    return metrics
+
+
+class Telemetry:
+    """Samples metrics on a timer and POSTs them; collects spans.
+
+    One instance per run (``maybe_run_telemetry_thread`` analog).
+    ``stats_supplier`` returns the latest ProberStats (or None) — the
+    prober feeds it, exactly like the reference's ``ArcSwapOption``.
+    """
+
+    def __init__(
+        self,
+        config: TelemetryConfig,
+        stats_supplier: Callable[[], Any] | None = None,
+        *,
+        interval_s: float = PERIODIC_READER_INTERVAL_S,
+    ):
+        self.config = config
+        self.stats_supplier = stats_supplier
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.spans: list[dict] = []
+        self._span_lock = threading.Lock()
+
+    # -- metrics -----------------------------------------------------------
+    def sample(self) -> dict[str, Any]:
+        metrics = dict(_process_metrics())
+        stats = self.stats_supplier() if self.stats_supplier is not None else None
+        if stats is not None:
+            if stats.input_stats.lag_ms is not None:
+                metrics[INPUT_LATENCY] = stats.input_stats.lag_ms
+            if stats.output_stats.lag_ms is not None:
+                metrics[OUTPUT_LATENCY] = stats.output_stats.lag_ms
+        return {
+            "resource": self.config.resource(),
+            "metrics": metrics,
+            "ts": time.time(),
+        }
+
+    def _export(self, kind: str, payload: dict, servers: tuple[str, ...]) -> None:
+        body = json.dumps({"kind": kind, **payload}).encode()
+        for endpoint in servers:
+            url = endpoint.rstrip("/") + f"/v1/{kind}"
+            try:
+                req = urllib.request.Request(
+                    url, data=body, headers={"Content-Type": "application/json"}
+                )
+                urllib.request.urlopen(req, timeout=EXPORT_TIMEOUT_S).read()
+            except Exception as exc:
+                logger.debug("telemetry export to %s failed: %s", url, exc)
+
+    # -- spans -------------------------------------------------------------
+    @contextmanager
+    def span(self, name: str, **attributes: Any):
+        start = time.time()
+        try:
+            yield
+        finally:
+            record = {
+                "name": name,
+                "start": start,
+                "duration_s": time.time() - start,
+                "attributes": attributes,
+                "trace_parent": self.config.trace_parent,
+            }
+            with self._span_lock:
+                self.spans.append(record)
+            if self.config.telemetry_enabled:
+                self._export(
+                    "traces",
+                    {"resource": self.config.resource(), "span": record},
+                    self.config.tracing_servers,
+                )
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "Telemetry":
+        if not self.config.telemetry_enabled:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name="pathway:telemetry", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._export("metrics", self.sample(), self.config.metrics_servers)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            # final flush so short runs still report once
+            self._export("metrics", self.sample(), self.config.metrics_servers)
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def maybe_run_telemetry_thread(
+    config: TelemetryConfig,
+    stats_supplier: Callable[[], Any] | None = None,
+    *,
+    interval_s: float = PERIODIC_READER_INTERVAL_S,
+) -> Telemetry | None:
+    """Start the telemetry loop when enabled (telemetry.rs glue)."""
+    if not config.telemetry_enabled:
+        return None
+    return Telemetry(config, stats_supplier, interval_s=interval_s).start()

@@ -52,6 +52,9 @@ class PathwayConfig:
     persistence_mode: str | None = dataclasses.field(
         default_factory=lambda: os.environ.get("PATHWAY_PERSISTENCE_MODE")
     )
+    continue_after_replay: bool = dataclasses.field(
+        default_factory=lambda: _env_bool("PATHWAY_CONTINUE_AFTER_REPLAY")
+    )
     license_key: str | None = dataclasses.field(
         default_factory=lambda: os.environ.get("PATHWAY_LICENSE_KEY")
     )

@@ -22,6 +22,14 @@ class ColumnExpression:
 
     _dtype_hint: dt.DType | None = None
 
+    def __call__(self, *args, **kwargs):
+        """Invoke a column of callables (row-transformer ``@method`` columns;
+        the reference lowers this via ``method_call_transformer``,
+        row_transformer.py:80)."""
+        return ApplyExpression(
+            lambda f, *a, **kw: f(*a, **kw), None, self, *args, **kwargs
+        )
+
     # -- arithmetic --
     def __add__(self, other):
         return ColumnBinaryOpExpression("+", self, other)

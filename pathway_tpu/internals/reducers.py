@@ -16,6 +16,7 @@ arrangement sharing.
 
 from __future__ import annotations
 
+import itertools
 from collections import Counter
 from collections.abc import Sequence
 from typing import Any, Callable
@@ -400,21 +401,30 @@ class BaseCustomAccumulator:
 
 
 class _CustomAccState(ReducerState):
-    __slots__ = ("rows", "acc_cls")
+    __slots__ = ("rows", "acc_cls", "order", "_seq")
 
     def __init__(self, acc_cls):
         self.rows = Counter()
         self.acc_cls = acc_cls
+        # arrival order (time, seq) per entry: order-sensitive accumulators
+        # (HMM) must replay in processing order, matching how the reference
+        # engine applies stateful updates per timestamp — keys are hashes
+        # and carry no ordering
+        self.order: dict = {}
+        self._seq = itertools.count()
 
     def add(self, args, diff, time, key):
         entry = (args, key)
+        if entry not in self.order:
+            self.order[entry] = (time, next(self._seq))
         self.rows[entry] += diff
         if self.rows[entry] == 0:
             del self.rows[entry]
+            del self.order[entry]
 
     def extract(self):
         acc = None
-        for (a, k), cnt in sorted(self.rows.items(), key=lambda e: e[0][1]):
+        for (a, _k), cnt in sorted(self.rows.items(), key=lambda e: self.order[e[0]]):
             for _ in range(cnt):
                 nxt = self.acc_cls.from_row(list(a))
                 if acc is None:

@@ -10,6 +10,7 @@ and runs one consolidated pass of the operator DAG per epoch.
 
 from __future__ import annotations
 
+import os
 import time as _time
 from typing import Any, Callable
 
@@ -41,6 +42,7 @@ class RunResult:
     def __init__(self):
         self.epochs = 0
         self.prober = None  # engine.probes.Prober when monitoring ran
+        self.telemetry = None  # engine.telemetry.Telemetry for this run
 
 
 def run(
@@ -89,6 +91,22 @@ def run(
         config = get_config()
         if monitoring_level is None:
             monitoring_level = MonitoringLevel.AUTO
+
+        from pathway_tpu.engine.telemetry import Telemetry, TelemetryConfig
+        from pathway_tpu.internals.license import License
+
+        license = License.new(config.license_key)
+        telemetry = Telemetry(
+            TelemetryConfig.create(
+                license=license,
+                run_id=config.run_id,
+                monitoring_server=config.monitoring_server,
+                trace_parent=os.environ.get("TRACEPARENT"),
+            ),
+            lambda: result.prober.stats if result.prober is not None else None,
+        ).start()
+        result.telemetry = telemetry
+
         if with_http_server:
             from pathway_tpu.engine.http_server import MonitoringServer
 
@@ -104,11 +122,14 @@ def run(
             if http_server is not None:
                 prober.callbacks.append(http_server.update)
             result.prober = prober
-            _event_loop(
-                scope, lowerer, result, max_epochs=max_epochs, storage=storage,
-                prober=prober,
-            )
+            with telemetry.span("pathway.run", workers=config.threads):
+                _event_loop(
+                    scope, lowerer, result, max_epochs=max_epochs, storage=storage,
+                    prober=prober,
+                )
     finally:
+        if result.telemetry is not None:
+            result.telemetry.close()
         if http_server is not None:
             http_server.close()
         if storage is not None:
@@ -126,20 +147,49 @@ def run(
 
 
 def _make_storage(persistence_config: Any):
-    """Build engine PersistentStorage from a ``pw.persistence.Config``."""
+    """Build engine PersistentStorage from a ``pw.persistence.Config``, or
+    from the record/replay env config (``PATHWAY_REPLAY_STORAGE`` +
+    ``PATHWAY_SNAPSHOT_ACCESS``, reference ``internals/config.py:35-54``)
+    when no explicit config is given."""
+    from pathway_tpu.internals.config import get_config
+
     if persistence_config is None:
-        return None
+        cfg = get_config()
+        if not cfg.replay_storage:
+            return None
+        from pathway_tpu.engine import persistence as pz
+
+        storage = pz.PersistentStorage(
+            pz.FileBackend(cfg.replay_storage), snapshot_interval_ms=0
+        )
+        storage.snapshot_access = _normalize_access(cfg.snapshot_access)
+        storage.continue_after_replay = cfg.continue_after_replay
+        return storage
     backend_cfg = getattr(persistence_config, "backend", None)
     if backend_cfg is None:
         return None
     from pathway_tpu.engine import persistence as pz
 
     backend = pz.backend_from_config(backend_cfg)
-    return pz.PersistentStorage(
+    storage = pz.PersistentStorage(
         backend,
         snapshot_interval_ms=getattr(persistence_config, "snapshot_interval_ms", 0),
         mode=getattr(persistence_config, "persistence_mode", None),
     )
+    storage.snapshot_access = _normalize_access(
+        getattr(persistence_config, "snapshot_access", None)
+    )
+    storage.continue_after_replay = getattr(
+        persistence_config, "continue_after_replay", True
+    )
+    return storage
+
+
+def _normalize_access(access: Any) -> str | None:
+    """"record"/"replay" as lowercase strings, whether given as str or enum."""
+    if access is None or isinstance(access, str):
+        return access.lower() if isinstance(access, str) else None
+    return str(getattr(access, "name", access)).lower()
 
 
 def run_all(**kwargs: Any) -> RunResult:

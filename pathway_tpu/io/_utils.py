@@ -251,9 +251,18 @@ def make_input_table(
                 for n in schema.__columns__
             )
             state = storage.register_source(sid, schema_digest=digest)
-            storage.replay_into(
-                state, lambda k, r, d: node.insert(k, r, 0, d)
-            )
+            access = getattr(storage, "snapshot_access", None)
+            if access != "record":
+                storage.replay_into(
+                    state, lambda k, r, d: node.insert(k, r, 0, d)
+                )
+            if access == "replay" and not getattr(
+                storage, "continue_after_replay", True
+            ):
+                # pure replay: the recording is the whole input — no
+                # reader thread, no live data (reference ReplayMode)
+                node.close()
+                return node
             poller.persist_state = state
             if state.offset is not None:
                 if reader.supports_offsets:

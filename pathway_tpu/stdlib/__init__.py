@@ -1,4 +1,4 @@
-"""stdlib: temporal, indexing, ml, graphs, stateful, statistical, ordered, utils."""
+"""stdlib: temporal, indexing, ml, graphs, stateful, statistical, ordered, utils, viz."""
 
 from pathway_tpu.stdlib import (
     graphs,
@@ -9,6 +9,7 @@ from pathway_tpu.stdlib import (
     statistical,
     temporal,
     utils,
+    viz,
 )
 
 __all__ = [
@@ -20,4 +21,5 @@ __all__ = [
     "statistical",
     "temporal",
     "utils",
+    "viz",
 ]

@@ -1,5 +1,5 @@
 """stdlib.ml (parity: stdlib/ml/): KNN index, classifiers, smart_table_ops, hmm, datasets."""
 
-from pathway_tpu.stdlib.ml import classifiers, index, smart_table_ops
+from pathway_tpu.stdlib.ml import classifiers, hmm, index, smart_table_ops
 
-__all__ = ["classifiers", "index", "smart_table_ops"]
+__all__ = ["classifiers", "hmm", "index", "smart_table_ops"]

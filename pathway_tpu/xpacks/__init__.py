@@ -1,0 +1,2 @@
+"""xpacks: llm toolkit and enterprise connectors."""
+from pathway_tpu.xpacks import connectors, llm  # noqa: F401,E402

@@ -120,6 +120,58 @@ def test_airbyte_create_source(tmp_path):
     assert "airbyte/source-faker:6.2.10" in text
 
 
+RECORD_SCRIPT = textwrap.dedent(
+    """
+    import sys
+    import pathway_tpu as pw
+
+    class S(pw.Schema):
+        v: int
+
+    class Source(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in (1, 2, 3):
+                self.next(v=i)
+            self.close()
+
+    live = "--live" in sys.argv
+    if live:
+        t = pw.io.python.read(Source(), schema=S, name="src")
+    else:
+        t = pw.io.python.read(
+            type("Dead", (pw.io.python.ConnectorSubject,), {"run": lambda self: self.close()})(),
+            schema=S,
+            name="src",
+        )
+    pw.io.jsonlines.write(t.select(d=pw.this.v * 2), sys.argv[1])
+    pw.run()
+    """
+)
+
+
+def test_record_then_replay_round_trip(tmp_path):
+    """spawn --record captures the stream; replay re-runs it with NO live
+    source (the recording is the whole input)."""
+    script = tmp_path / "app.py"
+    script.write_text(RECORD_SCRIPT)
+    rec = tmp_path / "recording"
+    out1, out2 = tmp_path / "o1.jsonl", tmp_path / "o2.jsonl"
+    res = _run_cli(
+        ["spawn", "--record", "--record-path", str(rec),
+         sys.executable, str(script), str(out1), "--live"],
+    )
+    assert res.returncode == 0, res.stderr
+    live = sorted(json.loads(l)["d"] for l in out1.read_text().splitlines())
+    assert live == [2, 4, 6]
+    # replay: the source emits nothing; rows come from the recording
+    res = _run_cli(
+        ["replay", "--record-path", str(rec), sys.executable, str(script), str(out2)],
+    )
+    assert res.returncode == 0, res.stderr
+    replayed = sorted(json.loads(l)["d"] for l in out2.read_text().splitlines())
+    assert replayed == [2, 4, 6]
+
+
 # --- YAML loader ------------------------------------------------------------
 
 

@@ -1,0 +1,193 @@
+"""License entitlements and telemetry tests.
+
+Model: src/engine/license.rs (key shapes, entitlement gates) and
+src/engine/telemetry.rs (gauge names, resource attributes, periodic
+export, license gating of the monitoring endpoint).  Zero-egress rule
+under test: nothing is exported unless an endpoint is explicitly
+configured.
+"""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.telemetry import (
+    INPUT_LATENCY,
+    PROCESS_CPU_USER_TIME,
+    PROCESS_MEMORY_USAGE,
+    Telemetry,
+    TelemetryConfig,
+    maybe_run_telemetry_thread,
+)
+from pathway_tpu.internals.license import (
+    InsufficientLicenseError,
+    License,
+    LicenseError,
+)
+from tests.utils import T
+
+SIGNING_KEY = "682e082b20053bf9591b11eabeadd95a0378e9d6e39a05117e782eaea4485e0b"
+
+
+def make_license_file(entitlements, policy="enterprise", telemetry_required=False):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+
+    payload = {
+        "entitlements": entitlements,
+        "policy": policy,
+        "telemetry_required": telemetry_required,
+    }
+    enc = base64.b64encode(json.dumps(payload).encode()).decode()
+    signer = Ed25519PrivateKey.from_private_bytes(bytes.fromhex(SIGNING_KEY))
+    sig = base64.b64encode(signer.sign(b"license/" + enc.encode())).decode()
+    outer = base64.b64encode(
+        json.dumps({"enc": enc, "sig": sig, "alg": "base64+ed25519"}).encode()
+    ).decode()
+    return f"-----BEGIN LICENSE FILE-----\n{outer}\n-----END LICENSE FILE-----"
+
+
+# --- license ----------------------------------------------------------------
+
+
+def test_no_key_has_no_entitlements():
+    lic = License.new(None)
+    with pytest.raises(InsufficientLicenseError):
+        lic.check_entitlements(["monitoring"])
+    assert not lic.has_entitlement("telemetry")
+
+
+def test_demo_key_grants_monitoring_and_telemetry():
+    lic = License.new("demo-license-key-with-telemetry-abc")
+    lic.check_entitlements(["monitoring", "telemetry"])  # no raise
+    assert lic.telemetry_required
+
+
+def test_offline_license_roundtrip():
+    lic = License.new(make_license_file(["MONITORING", "XPACK-SHAREPOINT"]))
+    assert lic.offline
+    lic.check_entitlements("monitoring")
+    lic.check_entitlements(["xpack-sharepoint"])
+    with pytest.raises(InsufficientLicenseError):
+        lic.check_entitlements(["full-persistence"])
+
+
+def test_offline_license_bad_signature_rejected():
+    good = make_license_file(["MONITORING"])
+    # flip a char inside the signed body
+    tampered = good.replace("-----BEGIN LICENSE FILE-----\n", "")
+    inner = json.loads(base64.b64decode(tampered.split("-----")[0]))
+    inner["enc"] = base64.b64encode(
+        json.dumps({"entitlements": ["EVERYTHING"]}).encode()
+    ).decode()
+    forged = (
+        "-----BEGIN LICENSE FILE-----\n"
+        + base64.b64encode(json.dumps(inner).encode()).decode()
+        + "\n-----END LICENSE FILE-----"
+    )
+    with pytest.raises(LicenseError):
+        License.new(forged)
+
+
+def test_unknown_plain_key_shortcut_and_gating():
+    lic = License.new("ABCDE-FGHIJ-KLMNO-PQRST-UVWXY")
+    assert lic.shortcut() == "ABCDE-FGHIJ"
+    with pytest.raises(InsufficientLicenseError):
+        lic.check_entitlements(["monitoring"])
+
+
+# --- telemetry --------------------------------------------------------------
+
+
+def test_telemetry_disabled_without_endpoint():
+    cfg = TelemetryConfig.create(license=License.new(None), run_id="r")
+    assert not cfg.telemetry_enabled
+    assert maybe_run_telemetry_thread(cfg) is None
+
+
+def test_monitoring_endpoint_requires_entitlement():
+    with pytest.raises(InsufficientLicenseError):
+        TelemetryConfig.create(
+            license=License.new(None), monitoring_server="http://127.0.0.1:1"
+        )
+    cfg = TelemetryConfig.create(
+        license=License.new("demo-license-key-with-telemetry-abc"),
+        monitoring_server="http://127.0.0.1:1",
+        run_id="r1",
+    )
+    assert cfg.telemetry_enabled
+    assert cfg.metrics_servers == ("http://127.0.0.1:1",)
+
+
+def test_sample_contains_reference_gauges():
+    cfg = TelemetryConfig.create(license=License.new(None), run_id="r2")
+    t = Telemetry(cfg)
+    sample = t.sample()
+    assert sample["metrics"][PROCESS_MEMORY_USAGE] > 0
+    assert sample["metrics"][PROCESS_CPU_USER_TIME] >= 0
+    assert sample["resource"]["run.id"] == "r2"
+    assert sample["resource"]["service.namespace"] == "local-dev"
+
+
+def test_trace_parent_root_id():
+    cfg = TelemetryConfig.create(
+        license=License.new(None),
+        run_id="r",
+        trace_parent="00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01",
+    )
+    assert cfg.resource()["root.trace.id"] == "0af7651916cd43dd8448eb211c80319c"
+
+
+def test_metrics_and_spans_posted_to_configured_endpoint():
+    received = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            received.append((self.path, json.loads(self.rfile.read(n))))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        endpoint = f"http://127.0.0.1:{server.server_address[1]}"
+        cfg = TelemetryConfig.create(
+            license=License.new("demo-license-key-with-telemetry-abc"),
+            monitoring_server=endpoint,
+            run_id="r3",
+        )
+        tele = Telemetry(cfg, interval_s=0.05).start()
+        with tele.span("pathway.run", workers=1):
+            pass
+        import time
+
+        time.sleep(0.3)
+        tele.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+    paths = {p for p, _ in received}
+    assert "/v1/metrics" in paths and "/v1/traces" in paths
+    metrics = next(b for p, b in received if p == "/v1/metrics")
+    assert PROCESS_MEMORY_USAGE in metrics["metrics"]
+    assert metrics["resource"]["run.id"] == "r3"
+    span = next(b for p, b in received if p == "/v1/traces")
+    assert span["span"]["name"] == "pathway.run"
+
+
+def test_run_records_span_without_egress():
+    t = T("v\n1\n2")
+    pw.io.subscribe(t.select(w=pw.this.v + 1), on_change=lambda **kw: None)
+    result = pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert result.telemetry is not None
+    assert not result.telemetry.config.telemetry_enabled  # zero egress default
+    assert [s["name"] for s in result.telemetry.spans] == ["pathway.run"]
+    assert result.telemetry.spans[0]["duration_s"] >= 0

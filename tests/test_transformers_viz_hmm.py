@@ -1,0 +1,279 @@
+"""Row transformers, HMM reducer, viz fallback, sharepoint gating.
+
+Models: reference test_transformers.py (simple/aux/pointer transformers),
+stdlib/ml/hmm.py doctest (manul HMM decode), stdlib/viz behavior, and the
+xpack-sharepoint entitlement gate.
+"""
+
+from functools import partial
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, assert_table_equality
+
+# --- row transformers -------------------------------------------------------
+
+
+def test_simple_transformer():
+    class OutputSchema(pw.Schema):
+        ret: int
+
+    @pw.transformer
+    class foo_transformer:
+        class table(pw.ClassArg, output=OutputSchema):
+            arg = pw.input_attribute()
+
+            @pw.output_attribute
+            def ret(self) -> int:
+                return self.arg + 1
+
+    table = T(
+        """
+            | arg
+        1   | 1
+        2   | 2
+        3   | 3
+        """
+    )
+    ret = foo_transformer(table).table
+    assert_table_equality(ret, T("  | ret\n1 | 2\n2 | 3\n3 | 4"))
+
+
+def test_transformer_pointer_recursion():
+    """linked-list length via next-pointers (reference examples/linked_list.py)."""
+
+    @pw.transformer
+    class linked_list_transformer:
+        class linked_list(pw.ClassArg):
+            next = pw.input_attribute()
+
+            @pw.output_attribute
+            def len(self) -> int:
+                if self.next is None:
+                    return 1
+                return 1 + self.transformer.linked_list[self.next].len
+
+    from pathway_tpu.engine.types import hash_values
+
+    t = T(
+        """
+            | n
+        1   | 2
+        2   | 3
+        3   |
+        """
+    )
+    # markdown symbolic ids hash to row keys; build a next-pointer column
+    nodes = t.select(
+        next=pw.apply(
+            lambda n: None if n is None else pw.Pointer(hash_values([str(n)])),
+            pw.this.n,
+        )
+    )
+    result = linked_list_transformer(nodes).linked_list
+    rows = {}
+    pw.io.subscribe(result, on_change=lambda key, row, time, is_addition: rows.__setitem__(key, row))
+    pw.run()
+    assert sorted(v["len"] for v in rows.values()) == [1, 2, 3]
+
+
+def test_transformer_methods_and_aux():
+    @pw.transformer
+    class m:
+        class table(pw.ClassArg):
+            arg = pw.input_attribute()
+            const = 10
+
+            @pw.attribute
+            def half(self) -> int:
+                return self.arg // 2
+
+            @pw.method
+            def fun(self, a) -> int:
+                return a * self.arg + self.const + self.half
+
+    t = T("  | arg\n1 | 4\n2 | 6")
+    out = m(t).table
+    applied = out.select(r=pw.this.fun(100))
+    rows = []
+    pw.io.subscribe(applied, on_change=lambda key, row, time, is_addition: rows.append(row["r"]))
+    pw.run()
+    # 100*4+10+2=412, 100*6+10+3=613
+    assert sorted(rows) == [412, 613]
+
+
+def test_transformer_cycle_detected():
+    @pw.transformer
+    class cyc:
+        class table(pw.ClassArg):
+            arg = pw.input_attribute()
+
+            @pw.output_attribute
+            def a(self) -> int:
+                return self.b
+
+            @pw.output_attribute
+            def b(self) -> int:
+                return self.a
+
+    t = T("  | arg\n1 | 1")
+    out = cyc(t).table
+    pw.io.subscribe(out, on_change=lambda **kw: None)
+    with pytest.raises(Exception, match="cyclic"):
+        pw.run()
+
+
+# --- HMM --------------------------------------------------------------------
+
+
+def _manul_hmm():
+    import networkx as nx
+
+    def emission(observation, state):
+        table = {
+            ("HUNGRY", "GRUMPY"): 0.9,
+            ("HUNGRY", "HAPPY"): 0.1,
+            ("FULL", "GRUMPY"): 0.7,
+            ("FULL", "HAPPY"): 0.3,
+        }
+        return np.log(table[(state, observation)])
+
+    g = nx.DiGraph()
+    g.add_node("HUNGRY", calc_emission_log_ppb=partial(emission, state="HUNGRY"))
+    g.add_node("FULL", calc_emission_log_ppb=partial(emission, state="FULL"))
+    g.add_edge("HUNGRY", "HUNGRY", log_transition_ppb=np.log(0.4))
+    g.add_edge("HUNGRY", "FULL", log_transition_ppb=np.log(0.6))
+    g.add_edge("FULL", "HUNGRY", log_transition_ppb=np.log(0.6))
+    g.add_edge("FULL", "FULL", log_transition_ppb=np.log(0.4))
+    g.graph["start_nodes"] = ["HUNGRY", "FULL"]
+    return g
+
+
+def test_hmm_decoding_matches_reference_doctest():
+    observations = T(
+        """
+        observation
+        HAPPY
+        HAPPY
+        GRUMPY
+        GRUMPY
+        HAPPY
+        GRUMPY
+        """
+    )
+    reducer = pw.reducers.udf_reducer(
+        pw.stdlib.ml.hmm.create_hmm_reducer(_manul_hmm(), num_results_kept=3)
+    )
+    decoded = observations.reduce(decoded_state=reducer(pw.this.observation))
+    rows = []
+    pw.io.subscribe(decoded, on_change=lambda key, row, time, is_addition: rows.append(row["decoded_state"]))
+    pw.run()
+    # final state over all six observations (reference doctest's last row)
+    assert rows[-1] == ("HUNGRY", "FULL", "HUNGRY")
+
+
+def test_hmm_beam_size_still_decodes():
+    observations = T("observation\nHAPPY\nGRUMPY")
+    reducer = pw.reducers.udf_reducer(
+        pw.stdlib.ml.hmm.create_hmm_reducer(_manul_hmm(), beam_size=1)
+    )
+    decoded = observations.reduce(s=reducer(pw.this.observation))
+    rows = []
+    pw.io.subscribe(decoded, on_change=lambda key, row, time, is_addition: rows.append(row["s"]))
+    pw.run()
+    assert len(rows[-1]) == 2
+
+
+def test_transformer_method_columns_do_not_churn():
+    """Unchanged rows must not be retracted/reinserted when another row
+    changes (method cells are identity-stable across epochs)."""
+
+    @pw.transformer
+    class m:
+        class table(pw.ClassArg):
+            arg = pw.input_attribute()
+
+            @pw.output_attribute
+            def out(self) -> int:
+                return self.arg
+
+            @pw.method
+            def f(self) -> int:
+                return self.arg
+
+    t = pw.debug.table_from_markdown(
+        """
+        arg | _time
+        1   | 2
+        2   | 2
+        3   | 4
+        """
+    )
+    events = []
+    pw.io.subscribe(
+        m(t).table,
+        on_change=lambda key, row, time, is_addition: events.append(
+            (row["out"], time, is_addition)
+        ),
+    )
+    pw.run()
+    # rows 1,2 inserted once at time 2; only row 3 arrives at time 4
+    assert sorted(e for e in events if e[1] == 2) == [(1, 2, True), (2, 2, True)]
+    assert [e for e in events if e[1] > 2] == [(3, 4, True)]
+
+
+# --- viz fallback -----------------------------------------------------------
+
+
+def test_show_fallback_snapshot():
+    t = T("a | b\n1 | 2\n3 | 4")
+    widget = t.show(include_id=False)
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    df = widget.to_pandas()
+    assert list(df.columns) == ["a", "b"]
+    assert sorted(df["a"].tolist()) == [1, 3]
+    assert "<table" in widget._repr_html_()
+
+
+def test_plot_raises_without_bokeh():
+    t = T("a\n1")
+    with pytest.raises(ImportError, match="panel"):
+        t.plot(lambda source: None)
+
+
+# --- sharepoint gate --------------------------------------------------------
+
+
+def test_sharepoint_requires_entitlement():
+    from pathway_tpu.internals.license import InsufficientLicenseError
+    from pathway_tpu.xpacks.connectors import sharepoint
+
+    with pytest.raises(InsufficientLicenseError):
+        sharepoint.read(
+            "https://company.sharepoint.com/sites/S",
+            tenant="t",
+            client_id="c",
+            cert_path="cert.pem",
+            thumbprint="TP",
+            root_path="/Shared Documents",
+        )
+
+
+def test_sharepoint_gated_on_office365_with_license(monkeypatch, tmp_path):
+    import tests.test_telemetry as tt
+    from pathway_tpu.internals.config import get_config
+    from pathway_tpu.xpacks.connectors import sharepoint
+
+    lic = tt.make_license_file(["XPACK-SHAREPOINT"])
+    monkeypatch.setattr(get_config(), "license_key", lic)
+    with pytest.raises(ImportError, match="office365"):
+        sharepoint.read(
+            "https://company.sharepoint.com/sites/S",
+            tenant="t",
+            client_id="c",
+            cert_path="cert.pem",
+            thumbprint="TP",
+            root_path="/Shared Documents",
+        )
