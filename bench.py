@@ -7,32 +7,74 @@ regime the RAG pipeline runs in), after warmup, pre-tokenized — matching
 how the reference separates host tokenization from model forward
 (sentence-transformers tokenizes on CPU there too).
 
+Also reports MFU: analytic encoder FLOPs (derived from the config) over
+the chip's peak bf16 FLOP/s.
+
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": N}
+
+Robustness: the TPU tunnel in this image can HANG (not error) at backend
+init, so the measurement runs in a killable child process with a hard
+deadline, retried with backoff; the parent never imports jax.  On
+persistent unavailability the JSON line is still printed, with an explicit
+"error" field — the artifact must exist either way.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import numpy as np
-
+METRIC = "embeddings_per_sec_per_chip_minilm_seq64"
 BASELINE_EMB_PER_SEC = 50_000.0
 BATCH = 512
 SEQ = 64
 WARMUP = 3
 ITERS = 20
+ATTEMPTS = 3
+ATTEMPT_TIMEOUT_S = 420  # first TPU compile can take minutes
+BACKOFF_S = 20.0
+
+# Peak dense bf16 FLOP/s by TPU generation (public spec sheets); used only
+# for the MFU estimate. Unknown device kinds fall back to v5e.
+PEAK_BF16_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5litepod": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "v6 lite": 918e12,  # jax reports v6e as 'TPU v6 lite'
+    "trillium": 918e12,
+}
+DEFAULT_PEAK = 197e12
 
 
-def main() -> None:
+def _analytic_flops_per_seq(cfg, seq: int) -> float:
+    """Forward FLOPs for one padded sequence (2*m*n*k per matmul).
+
+    Per token per layer: QKV+O projections 8*h^2, FFN 4*h*ffn, attention
+    score/value einsums 4*seq*h. Embedding lookups/layernorms are noise.
+    """
+    h, ffn = cfg.hidden, cfg.intermediate
+    per_token_layer = 8 * h * h + 4 * h * ffn + 4 * seq * h
+    return float(cfg.layers * per_token_layer * seq)
+
+
+def child() -> None:
+    """Runs in a subprocess: full measurement, prints the JSON line."""
+    import numpy as np
+
     import jax
     import jax.numpy as jnp
 
     from pathway_tpu.models.encoder import SentenceEncoderModule, config_for
 
-    print(f"devices: {jax.devices()}", file=sys.stderr)
+    devs = jax.devices()
+    print(f"devices: {devs}", file=sys.stderr)
 
     cfg = config_for("all-MiniLM-L6-v2")
     module = SentenceEncoderModule(cfg)
@@ -44,14 +86,14 @@ def main() -> None:
     fwd = jax.jit(lambda p, i, m: module.apply(p, i, m))
 
     host_rng = np.random.default_rng(0)
-    ids = jnp.asarray(host_rng.integers(104, cfg.vocab_size, size=(BATCH, SEQ)), jnp.int32)
+    ids = jnp.asarray(
+        host_rng.integers(104, cfg.vocab_size, size=(BATCH, SEQ)), jnp.int32
+    )
     mask = jnp.ones((BATCH, SEQ), jnp.int32)
 
     # Force real materialization via a scalar D2H fetch: under the remote
     # TPU tunnel block_until_ready can return before execution finishes,
     # so timing hangs a data dependency off every iteration instead.
-    import jax.numpy as _jnp
-
     for _ in range(WARMUP):
         float(fwd(params, ids, mask).sum())
 
@@ -65,21 +107,90 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     emb_per_sec = BATCH * ITERS / dt
+
+    kind = getattr(devs[0], "device_kind", "").lower()
+    peak = DEFAULT_PEAK
+    for tag, val in PEAK_BF16_FLOPS.items():
+        if tag in kind:
+            peak = val
+            break
+    achieved = _analytic_flops_per_seq(cfg, SEQ) * emb_per_sec
+    mfu = achieved / peak
+
     print(
-        f"{BATCH}x{SEQ} x{ITERS} iters in {dt:.3f}s -> {emb_per_sec:,.0f} emb/s",
+        f"{BATCH}x{SEQ} x{ITERS} iters in {dt:.3f}s -> {emb_per_sec:,.0f} emb/s, "
+        f"{achieved/1e12:.1f} TFLOP/s on '{kind}' (peak {peak/1e12:.0f}) "
+        f"-> MFU {mfu:.3f}",
         file=sys.stderr,
     )
     print(
         json.dumps(
             {
-                "metric": "embeddings_per_sec_per_chip_minilm_seq64",
+                "metric": METRIC,
                 "value": round(emb_per_sec, 1),
                 "unit": "embeddings/s",
                 "vs_baseline": round(emb_per_sec / BASELINE_EMB_PER_SEC, 4),
+                "mfu": round(mfu, 4),
+                "device_kind": kind or "unknown",
+            }
+        )
+    )
+
+
+def main() -> None:
+    last_err = "unknown"
+    for attempt in range(1, ATTEMPTS + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                capture_output=True,
+                text=True,
+                timeout=ATTEMPT_TIMEOUT_S,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            last_err = (
+                f"attempt {attempt}: TPU backend init/compile hung "
+                f">{ATTEMPT_TIMEOUT_S}s (tunnel unavailable)"
+            )
+            print(last_err, file=sys.stderr)
+            if attempt < ATTEMPTS:
+                time.sleep(BACKOFF_S)
+            continue
+        sys.stderr.write(proc.stderr[-4000:])
+        line = next(
+            (
+                ln
+                for ln in proc.stdout.strip().splitlines()
+                if ln.startswith("{") and '"metric"' in ln
+            ),
+            None,
+        )
+        if proc.returncode == 0 and line:
+            print(line)
+            return
+        last_err = (
+            f"attempt {attempt}: rc={proc.returncode}, "
+            f"stderr tail: {proc.stderr[-500:]}"
+        )
+        print(last_err, file=sys.stderr)
+        if attempt < ATTEMPTS:
+            time.sleep(BACKOFF_S)
+    print(
+        json.dumps(
+            {
+                "metric": METRIC,
+                "value": 0.0,
+                "unit": "embeddings/s",
+                "vs_baseline": 0.0,
+                "error": last_err,
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        child()
+    else:
+        main()

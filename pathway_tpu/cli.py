@@ -25,8 +25,24 @@ import click
 import pathway_tpu as pw
 
 
-def _plural(n: int, singular: str, plural: str) -> str:
-    return f"1 {singular}" if n == 1 else f"{n} {plural}"
+def _cluster_env(
+    env_base: dict[str, str],
+    *,
+    threads: int,
+    processes: int,
+    first_port: int,
+    process_id: int,
+    run_id: str,
+) -> dict[str, str]:
+    env = dict(env_base)
+    env.update(
+        PATHWAY_THREADS=str(threads),
+        PATHWAY_PROCESSES=str(processes),
+        PATHWAY_FIRST_PORT=str(first_port),
+        PATHWAY_PROCESS_ID=str(process_id),
+        PATHWAY_RUN_ID=run_id,
+    )
+    return env
 
 
 def spawn_program(
@@ -40,20 +56,22 @@ def spawn_program(
 ) -> NoReturn:
     """Launch ``processes`` copies of ``program`` forming one SPMD cluster."""
     click.echo(
-        f"Preparing {_plural(processes, 'process', 'processes')} "
-        f"({_plural(processes * threads, 'total worker', 'total workers')})",
+        f"[pathway_tpu] launching SPMD cluster: {processes} process(es), "
+        f"ports {first_port}..{first_port + processes - 1}",
         err=True,
     )
     run_id = str(uuid.uuid4())
     handles: list[subprocess.Popen] = []
     try:
         for process_id in range(processes):
-            env = dict(env_base)
-            env["PATHWAY_THREADS"] = str(threads)
-            env["PATHWAY_PROCESSES"] = str(processes)
-            env["PATHWAY_FIRST_PORT"] = str(first_port)
-            env["PATHWAY_PROCESS_ID"] = str(process_id)
-            env["PATHWAY_RUN_ID"] = run_id
+            env = _cluster_env(
+                env_base,
+                threads=threads,
+                processes=processes,
+                first_port=first_port,
+                process_id=process_id,
+                run_id=run_id,
+            )
             handles.append(subprocess.Popen([program, *arguments], env=env))
         for handle in handles:
             handle.wait()

@@ -1,0 +1,331 @@
+"""Host-side worker communication: the TCP exchange mesh.
+
+Parity target: timely's communication crate — zero-copy TCP allocator and
+exchange channels routed by key shard
+(``external/timely-dataflow/communication/src/allocator/zero_copy/tcp.rs``,
+``src/engine/dataflow.rs:1414``).  The design here is different and much
+smaller because the engine is epoch-batched (BSP), not asynchronous
+record-at-a-time dataflow:
+
+* every process runs the identical script → identical operator DAG, so
+  node ids agree across workers (the SPMD invariant of
+  ``docs/.../10.worker-architecture.md:36-43``);
+* each epoch is a superstep: workers agree on the epoch time (worker 0
+  sequences), then walk the DAG in the same topological order, performing
+  one all-to-all per exchange point;
+* routing is by the 16-bit shard field of the 128-bit row key —
+  ``shard_to_worker(key, n)`` — exactly the reference's rule.
+
+Wire format: 8-byte big-endian length + pickle of ``(tag, payload)``.
+Everything rides localhost/DCN TCP; dense device state never crosses here
+(it lives in HBM and moves over ICI via XLA collectives — see
+``pathway_tpu/parallel/``).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Any, Callable, Hashable
+
+from pathway_tpu.engine.types import shard_to_worker
+
+_FRAME = struct.Struct(">Q")
+CONNECT_TIMEOUT_S = 60.0
+RECV_TIMEOUT_S = 300.0
+
+
+class CommError(RuntimeError):
+    pass
+
+
+class TcpMesh:
+    """Full mesh of TCP links between N worker processes.
+
+    Worker ``i`` listens on ``first_port + i``; workers with higher ids dial
+    workers with lower ids, so every pair has exactly one duplex link.
+    A reader thread per link demultiplexes frames into per-(src, tag) queues.
+    """
+
+    def __init__(
+        self,
+        worker_id: int,
+        worker_count: int,
+        first_port: int,
+        host: str = "127.0.0.1",
+    ):
+        self.worker_id = worker_id
+        self.worker_count = worker_count
+        self.first_port = first_port
+        self.host = host
+        self._socks: dict[int, socket.socket] = {}
+        self._send_locks: dict[int, threading.Lock] = {}
+        self._inbox: dict[tuple[int, Hashable], deque] = defaultdict(deque)
+        self._cv = threading.Condition()
+        self._closed = False
+        self._threads: list[threading.Thread] = []
+        self._listener: socket.socket | None = None
+
+    # -- setup -----------------------------------------------------------
+    def start(self) -> "TcpMesh":
+        if self.worker_count <= 1:
+            return self
+        self._listener = socket.create_server(
+            (self.host, self.first_port + self.worker_id), reuse_port=False
+        )
+        self._listener.settimeout(CONNECT_TIMEOUT_S)
+        accept_from = [w for w in range(self.worker_count) if w > self.worker_id]
+        dial_to = [w for w in range(self.worker_count) if w < self.worker_id]
+
+        accepted: dict[int, socket.socket] = {}
+        acc_err: list[BaseException] = []
+
+        def accept_loop():
+            try:
+                for _ in accept_from:
+                    sock, _addr = self._listener.accept()
+                    peer = _FRAME.unpack(_recv_exact(sock, _FRAME.size))[0]
+                    accepted[peer] = sock
+            except BaseException as exc:  # noqa: BLE001 — re-raised by start()
+                acc_err.append(exc)
+
+        acceptor = threading.Thread(target=accept_loop, daemon=True)
+        acceptor.start()
+
+        for peer in dial_to:
+            self._socks[peer] = _dial(
+                self.host, self.first_port + peer, self.worker_id
+            )
+
+        acceptor.join(CONNECT_TIMEOUT_S)
+        if acc_err:
+            raise CommError(f"worker {self.worker_id}: accept failed: {acc_err[0]}")
+        if acceptor.is_alive() or len(accepted) != len(accept_from):
+            raise CommError(
+                f"worker {self.worker_id}: timed out waiting for peers "
+                f"{sorted(set(accept_from) - set(accepted))}"
+            )
+        self._socks.update(accepted)
+
+        for peer, sock in self._socks.items():
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._send_locks[peer] = threading.Lock()
+            t = threading.Thread(
+                target=self._reader, args=(peer, sock), daemon=True,
+                name=f"pathway:comm-{self.worker_id}<-{peer}",
+            )
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _reader(self, peer: int, sock: socket.socket) -> None:
+        try:
+            while not self._closed:
+                header = _recv_exact(sock, _FRAME.size)
+                (size,) = _FRAME.unpack(header)
+                blob = _recv_exact(sock, size)
+                tag, payload = pickle.loads(blob)
+                with self._cv:
+                    self._inbox[(peer, tag)].append(payload)
+                    self._cv.notify_all()
+        except (OSError, EOFError, ConnectionError):
+            if not self._closed:
+                with self._cv:
+                    self._inbox[(peer, _PEER_DEAD)].append(None)
+                    self._cv.notify_all()
+
+    # -- point to point --------------------------------------------------
+    def send(self, dest: int, tag: Hashable, payload: Any) -> None:
+        if dest == self.worker_id:
+            with self._cv:
+                self._inbox[(dest, tag)].append(payload)
+                self._cv.notify_all()
+            return
+        blob = pickle.dumps((tag, payload), protocol=pickle.HIGHEST_PROTOCOL)
+        sock = self._socks[dest]
+        with self._send_locks[dest]:
+            sock.sendall(_FRAME.pack(len(blob)) + blob)
+
+    def recv(self, src: int, tag: Hashable, timeout: float = RECV_TIMEOUT_S) -> Any:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while True:
+                q = self._inbox.get((src, tag))
+                if q:
+                    payload = q.popleft()
+                    if not q:
+                        self._inbox.pop((src, tag), None)
+                    return payload
+                if self._inbox.get((src, _PEER_DEAD)):
+                    raise CommError(
+                        f"worker {self.worker_id}: peer {src} disconnected "
+                        f"while waiting for {tag!r}"
+                    )
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise CommError(
+                        f"worker {self.worker_id}: timeout waiting for "
+                        f"{tag!r} from worker {src}"
+                    )
+                self._cv.wait(min(remaining, 1.0))
+
+    # -- collectives -----------------------------------------------------
+    def alltoall(self, tag: Hashable, per_dest: list[list]) -> list:
+        """Send ``per_dest[w]`` to worker ``w``; return concatenation of what
+        every worker sent here (own bucket included), ordered by worker id."""
+        for w in range(self.worker_count):
+            if w != self.worker_id:
+                self.send(w, tag, per_dest[w])
+        merged: list = []
+        for w in range(self.worker_count):
+            if w == self.worker_id:
+                merged.extend(per_dest[w])
+            else:
+                merged.extend(self.recv(w, tag))
+        return merged
+
+    def gather(self, tag: Hashable, payload: Any, root: int = 0) -> list | None:
+        """Root returns [payload per worker, ordered]; others return None."""
+        if self.worker_id == root:
+            out = []
+            for w in range(self.worker_count):
+                out.append(payload if w == root else self.recv(w, tag))
+            return out
+        self.send(root, tag, payload)
+        return None
+
+    def bcast(self, tag: Hashable, payload: Any = None, root: int = 0) -> Any:
+        if self.worker_id == root:
+            for w in range(self.worker_count):
+                if w != root:
+                    self.send(w, tag, payload)
+            return payload
+        return self.recv(root, tag)
+
+    def barrier(self, tag: Hashable) -> None:
+        self.gather(("barrier", tag), None)
+        self.bcast(("barrier-go", tag))
+
+    def close(self) -> None:
+        self._closed = True
+        for sock in self._socks.values():
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+
+_PEER_DEAD = ("__peer_dead__",)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            raise EOFError("peer closed")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def _dial(host: str, port: int, my_id: int) -> socket.socket:
+    deadline = time.monotonic() + CONNECT_TIMEOUT_S
+    last: Exception | None = None
+    while time.monotonic() < deadline:
+        try:
+            sock = socket.create_connection((host, port), timeout=5.0)
+            sock.settimeout(None)
+            sock.sendall(_FRAME.pack(my_id))
+            return sock
+        except OSError as exc:
+            last = exc
+            time.sleep(0.1)
+    raise CommError(f"could not reach worker at {host}:{port}: {last}")
+
+
+class WorkerContext:
+    """Per-process view of the worker group, driving exchange + epochs.
+
+    ``exchange_node`` implements the reference's exchange-before-stateful-
+    operator pattern: contributions are routed to the worker that owns the
+    key the operator's state is sharded on (``dataflow.rs:1414``,
+    ``shard.rs:15-20``).  Nodes declare ownership via ``exchange_routes``
+    (port → routing-key fn) or ``exchange_gather0`` (all rows to worker 0,
+    for globally-ordered operators: sort, iterate, external index).
+    """
+
+    def __init__(self, mesh: TcpMesh):
+        self.mesh = mesh
+        self.worker_id = mesh.worker_id
+        self.worker_count = mesh.worker_count
+
+    def owner_of(self, routing_key: int) -> int:
+        return shard_to_worker(routing_key, self.worker_count)
+
+    def exchange_deltas(
+        self,
+        tag: Hashable,
+        deltas: list,
+        route: Callable[[int, Any], int] | None,
+    ) -> list:
+        """All-to-all one delta list. ``route(key, row) -> routing key``;
+        ``None`` routes by the row key itself."""
+        per_dest: list[list] = [[] for _ in range(self.worker_count)]
+        for key, row, diff in deltas:
+            if route is None:
+                rk = key
+            else:
+                try:
+                    rk = route(key, row)
+                except Exception:
+                    rk = key  # poisoned rows resolve locally; the node's own
+                    # step reports the error through the error log
+            per_dest[self.owner_of(rk)].append((key, row, diff))
+        return self.mesh.alltoall(tag, per_dest)
+
+    def gather0_deltas(self, tag: Hashable, deltas: list) -> list:
+        per_dest: list[list] = [[] for _ in range(self.worker_count)]
+        per_dest[0] = list(deltas)
+        return self.mesh.alltoall(tag, per_dest)
+
+    def exchange_node(self, node: Any, time_: int) -> None:
+        """Pre-step exchange for one operator (same call order on every
+        worker — the DAG is identical, so collectives pair up)."""
+        routes = getattr(node, "exchange_routes", None)
+        gather0 = getattr(node, "exchange_gather0", False)
+        if routes is None and not gather0:
+            return
+        n_ports = len(node.inputs) if node.inputs else 1
+        for port in range(n_ports):
+            pending = node.pending.pop(port, [])
+            tag = ("x", node.id, port, time_)
+            if gather0:
+                merged = self.gather0_deltas(tag, pending)
+            else:
+                route = routes.get(port) if routes else None
+                if route is None and routes is not None and port not in routes:
+                    # port not exchanged (already co-located) — but peers
+                    # still ran alltoall for declared ports only, so skip
+                    node.pending[port] = pending
+                    continue
+                merged = self.exchange_deltas(tag, pending, route)
+            if merged:
+                node.pending[port] = merged
+
+    def close(self) -> None:
+        self.mesh.close()

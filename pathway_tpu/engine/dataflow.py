@@ -77,6 +77,13 @@ class Node:
         # monitoring counters (ProberStats analog, graph.rs:512)
         self.rows_in = 0
         self.rows_out = 0
+        # multi-worker exchange declaration (engine/comm.py WorkerContext):
+        # port -> routing-key fn (None = route by row key), or gather-to-0
+        # for globally-ordered operators.  The exchange point is exactly
+        # where the reference reshards before stateful operators
+        # (dataflow.rs:1414, shard.rs:15-20).
+        self.exchange_routes: dict[int, Callable[[int, Row], int] | None] | None = None
+        self.exchange_gather0 = False
 
     # -- wiring --
     def send(self, deltas: list[Delta], time: Time) -> None:
@@ -295,6 +302,8 @@ class ReindexNode(Node):
         super().__init__(scope, [inp])
         self.key_fn = key_fn
         self.require_state()
+        # duplicate detection state lives with the owner of the NEW key
+        self.exchange_routes = {0: lambda k, r: self.key_fn(k, r)}
 
     def step(self, time):
         out = []
@@ -331,6 +340,7 @@ class UpdateRowsNode(Node):
         super().__init__(scope, [left, right])
         self._left: dict[int, Row] = {}
         self._right: dict[int, Row] = {}
+        self.exchange_routes = {0: None, 1: None}  # co-shard both sides by key
 
     def step(self, time):
         out = []
@@ -374,6 +384,7 @@ class UpdateCellsNode(Node):
         self._left: dict[int, Row] = {}
         self._right: dict[int, Row] = {}
         self.merge_fn = merge_fn
+        self.exchange_routes = {0: None, 1: None}  # co-shard both sides by key
 
     def _merged(self, key: int) -> Row | None:
         if key not in self._left:
@@ -418,6 +429,7 @@ class IntersectNode(Node):
         self._left: dict[int, Row] = {}
         self._present: list[Counter] = [Counter() for _ in others]
         self.difference = difference
+        self.exchange_routes = {p: None for p in range(1 + len(others))}
 
     def _visible(self, key: int) -> bool:
         if self.difference:
@@ -481,6 +493,17 @@ class IxNode(Node):
         self.merge_fn = merge_fn
         self.optional = optional
         self.strict = strict
+        # key-rows travel to the owner of the row they point at; data rows
+        # stay with their own key's owner — lookups are then local
+        self.exchange_routes = {0: self._route_target, 1: None}
+
+    def _route_target(self, key: int, row: Row) -> int:
+        target = self.key_fn(key, row)
+        if isinstance(target, Pointer):
+            return target.value
+        if isinstance(target, int):
+            return target
+        return key  # optional/None targets resolve locally
 
     def _emit_for(self, key: int, out: list, sign: int):
         row, target = self._keys[key]
@@ -559,12 +582,24 @@ class JoinNode(Node):
         self.out_key_fn = out_key_fn
         self.left_outer = left_outer
         self.right_outer = right_outer
+        # both sides co-shard on the join key (dataflow.rs:2744 ShardPolicy)
+        self.exchange_routes = {
+            0: lambda k, r: self._route_jk(self.left_key_fn, k, r),
+            1: lambda k, r: self._route_jk(self.right_key_fn, k, r),
+        }
         # join-key → {row_key: (row, count)}
         self._left_idx: dict[tuple, dict[int, Row]] = defaultdict(dict)
         self._right_idx: dict[tuple, dict[int, Row]] = defaultdict(dict)
         # for outer modes: per row match count
         self._left_matches: Counter = Counter()
         self._right_matches: Counter = Counter()
+
+    @staticmethod
+    def _route_jk(key_fn, key: int, row: Row) -> int:
+        jk = key_fn(key, row)
+        if jk is None:
+            return key  # unjoined (error) rows resolve locally
+        return hash_values(jk)
 
     def _pair(self, lkey, rkey, lrow, rrow, jk, sign, out):
         okey = self.out_key_fn(lkey, rkey, jk)
@@ -661,6 +696,10 @@ class GroupByNode(Node):
         result_fn: Callable[[tuple, tuple], Row] | None = None,
     ):
         super().__init__(scope, [inp])
+        # contributions travel to the owner of the group's output key
+        self.exchange_routes = {
+            0: lambda k, r: self.out_key_fn(self.group_key_fn(k, r))
+        }
         self.group_key_fn = group_key_fn
         self.out_key_fn = out_key_fn
         self.reducer_specs = list(reducer_specs)
@@ -723,6 +762,11 @@ class DeduplicateNode(Node):
         self.acceptor = acceptor
         self.out_key_fn = out_key_fn
         self._current: dict[Any, tuple[Any, Row]] = {}
+        # the per-instance "current winner" state lives with the owner of
+        # the instance's output key
+        self.exchange_routes = {
+            0: lambda k, r: self.out_key_fn(self.instance_fn(k, r))
+        }
 
     def step(self, time):
         out = []
@@ -767,6 +811,7 @@ class BufferNode(Node):
         self.threshold_fn = threshold_fn
         self._held: list[Delta] = []
         self._watermark = None
+        self.exchange_routes = {0: None}  # buffer state lives with key owner
 
     def step(self, time):
         incoming = self.take_pending()
@@ -808,6 +853,7 @@ class ForgetNode(Node):
         self.threshold_fn = threshold_fn
         self._alive: dict[int, Row] = {}
         self._watermark = None
+        self.exchange_routes = {0: None}  # alive-set lives with key owner
 
     def step(self, time):
         out = []
@@ -876,6 +922,10 @@ class SortNode(Node):
         self.instance_fn = instance_fn
         self._by_instance: dict[Any, list] = defaultdict(list)  # sorted [(sort_key, key)]
         self._rows: dict[int, tuple[Any, Any]] = {}
+        # global per-instance ordering: all rows on one worker (the analog
+        # of the reference's arranged total order walked by bidirectional
+        # cursors; per-shard ordering would give wrong neighbours)
+        self.exchange_gather0 = True
 
     def _neighbors(self, lst, i):
         prev_k = lst[i - 1][1] if i > 0 else None
@@ -977,6 +1027,8 @@ class GradualBroadcastNode(Node):
         self._lower = None
         self._upper = None
         self._rows: dict[int, Row] = {}
+        # one global slowly-changing scalar: single-owner state
+        self.exchange_gather0 = True
 
     def step(self, time):
         out = []
@@ -1034,6 +1086,10 @@ class ExternalIndexNode(Node):
         self.res_fn = res_fn  # (query_key, query_row, result) -> out Row
         self._queries: dict[int, Row] = {}
         self._answers: dict[int, Row] = {}
+        # the index structure is one logical object: host bookkeeping on
+        # worker 0 (its device path still shards the corpus over the mesh —
+        # ops/topk.py DeviceIndexCache(mesh))
+        self.exchange_gather0 = True
 
     def step(self, time):
         out = []
@@ -1187,6 +1243,9 @@ class IterateNode(Node):
     def __init__(self, scope, inputs: Sequence[Node], build_body, limit: int | None = None):
         super().__init__(scope, inputs)
         self.limit = limit
+        # fixed-point rounds are driven locally: gather all input to one
+        # worker; the nested subscope never performs exchanges
+        self.exchange_gather0 = True
         self.subscope = Scope(parent=scope)
         # iteration inputs: one InputNode in subscope per outer input
         self.iter_inputs = [InputNode(self.subscope) for _ in inputs]
@@ -1299,6 +1358,10 @@ class Scope:
         self.terminate_on_error = True
         # epoch -> wallclock of its earliest staged row (latency probes)
         self.epoch_wallclock: dict[Time, float] = {}
+        # multi-worker context (engine/comm.py WorkerContext); None =
+        # single-process.  Only ever set on the root scope — nested scopes
+        # (iterate bodies) always run locally.
+        self.worker = None
 
     def _register(self, node: Node) -> int:
         self.nodes.append(node)
@@ -1310,9 +1373,18 @@ class Scope:
             raise EngineError(f"{node!r} key {Pointer(key)!r}: {message}")
 
     def run_epoch(self, time: Time) -> None:
-        """One topologically-ordered pass (nodes registered in topo order)."""
+        """One topologically-ordered pass (nodes registered in topo order).
+
+        With a worker context, each declared exchange point performs one
+        all-to-all right before the owning node steps — every worker walks
+        the identical DAG in the same order, so the collectives pair up
+        (the BSP superstep form of timely's exchange channels).
+        """
         self.current_time = time
+        worker = self.worker
         for node in self.nodes:
+            if worker is not None:
+                worker.exchange_node(node, time)
             node.step(time)
         for node in self.nodes:
             node.flush(time)
@@ -1326,15 +1398,25 @@ class Scope:
     def finish(self) -> None:
         # release buffered work (temporal buffers etc.), propagate, then
         # signal end-of-stream to outputs — ordering matters so subscribers
-        # see the released rows before on_end.
+        # see the released rows before on_end.  In multi-worker mode the
+        # quiesce check is a global any() — a worker with nothing pending
+        # must still join its peers' exchange rounds.
         for node in self.nodes:
             if not isinstance(node, OutputNode):
                 node.on_finish()
         guard = 0
-        while any(node.has_pending() for node in self.nodes):
+        while self._any_pending_global(guard):
             self.run_epoch(self.current_time + 2)
             guard += 1
             if guard > 1000:
                 raise EngineError("finish() did not quiesce")
         for out in self.outputs:
             out.on_finish()
+
+    def _any_pending_global(self, round_: int) -> bool:
+        local = any(node.has_pending() for node in self.nodes)
+        if self.worker is None:
+            return local
+        mesh = self.worker.mesh
+        flags = mesh.gather(("finish", round_), local)
+        return mesh.bcast(("finish-go", round_), flags is not None and any(flags))

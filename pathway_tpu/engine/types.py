@@ -116,6 +116,10 @@ class Error:
     def __bool__(self) -> bool:
         raise TypeError("cannot use pw Error value in a boolean context")
 
+    def __reduce__(self):
+        # keep singleton identity across the worker exchange (pickle)
+        return (Error, ())
+
 
 ERROR = Error()
 

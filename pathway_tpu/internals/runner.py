@@ -60,6 +60,23 @@ def run(
     """``pw.run`` — execute every registered sink to completion."""
     scope = df.Scope()
     scope.terminate_on_error = terminate_on_error
+
+    # multi-process SPMD: every process runs this same script and builds the
+    # identical graph; a TCP mesh exchanges rows by key shard
+    # (engine/comm.py; the reference's timely Cluster config analog).
+    from pathway_tpu.internals.config import get_config as _get_config
+
+    _cfg = _get_config()
+    worker_ctx = None
+    if _cfg.processes > 1:
+        from pathway_tpu.engine.comm import TcpMesh, WorkerContext
+
+        mesh = TcpMesh(
+            _cfg.process_id, _cfg.processes, _cfg.first_port
+        ).start()
+        worker_ctx = WorkerContext(mesh)
+        scope.worker = worker_ctx
+
     lowerer = Lowerer(scope)
 
     storage = _make_storage(persistence_config)
@@ -128,6 +145,8 @@ def run(
                     prober=prober,
                 )
     finally:
+        if worker_ctx is not None:
+            worker_ctx.close()
         if result.telemetry is not None:
             result.telemetry.close()
         if http_server is not None:
@@ -225,6 +244,11 @@ def _event_loop(
     storage: Any = None,
     prober: Any = None,
 ) -> None:
+    if scope.worker is not None:
+        return _event_loop_coordinated(
+            scope, lowerer, result, max_epochs=max_epochs, storage=storage,
+            prober=prober,
+        )
     inputs = _input_nodes(scope)
     pollers = lowerer.pollers
     last_time = -1
@@ -280,6 +304,97 @@ def _event_loop(
         # offsets for the last processed epoch must still reach the broker
         _ack_sources(pollers, persisted=False, up_to_time=last_time)
         _time.sleep(0.001)
+    scope.current_time = max(scope.current_time, last_time)
+    scope.finish()
+    if prober is not None:
+        prober.update(done=True, epochs=result.epochs)
+
+
+def _event_loop_coordinated(
+    scope: df.Scope,
+    lowerer: Lowerer,
+    result: RunResult,
+    max_epochs: int | None = None,
+    storage: Any = None,
+    prober: Any = None,
+) -> None:
+    """Multi-worker BSP loop: worker 0 sequences epochs, every worker runs
+    them in lockstep, exchanging rows at the declared exchange points.
+
+    Mirrors the single-process loop; the extra steps are (a) epoch
+    negotiation (the progress-gossip analog of timely frontiers over the
+    cluster, SURVEY.md §2b) and (b) the post-ingest exchange that routes
+    each staged row to the worker owning its key shard (dataflow.rs:1414).
+    """
+    ctx = scope.worker
+    mesh = ctx.mesh
+    inputs = _input_nodes(scope)
+    pollers = lowerer.pollers
+    last_time = -1
+    round_ = 0
+    snapshot_interval = (
+        (storage.snapshot_interval_ms / 1000.0) if storage is not None else None
+    )
+    last_snapshot = _time.monotonic()
+    while True:
+        if (
+            storage is not None
+            and (_time.monotonic() - last_snapshot) >= snapshot_interval
+        ):
+            storage.commit()
+            last_snapshot = _time.monotonic()
+            _ack_sources(pollers, persisted=True)
+        exhausted = True
+        for poller in pollers:
+            if not poller.poll():
+                exhausted = False
+        times: set[int] = set()
+        for inp in inputs:
+            times.update(inp.pending_times())
+        local_min = min(times) if times else None
+        all_finished = exhausted and all(inp.finished for inp in inputs)
+
+        round_ += 1
+        gathered = mesh.gather(("epoch", round_), (local_min, all_finished))
+        if mesh.worker_id == 0:
+            mins = [m for m, _ in gathered if m is not None]
+            if mins:
+                t = min(mins)
+                if t <= last_time:
+                    t = last_time + 2  # strictly increasing, even
+                decision = ("epoch", t)
+            elif all(fin for _, fin in gathered):
+                decision = ("stop", None)
+            else:
+                decision = ("idle", None)
+        else:
+            decision = None
+        kind, t = mesh.bcast(("epoch-go", round_), decision)
+
+        if kind == "stop":
+            break
+        if kind == "idle":
+            _ack_sources(pollers, persisted=False, up_to_time=last_time)
+            _time.sleep(0.001)
+            continue
+        for inp in inputs:
+            inp.merge_staged_through(t)
+        # route each staged row to the worker owning its key shard; a
+        # non-partitioned source read on worker 0 scatters here
+        for inp in inputs:
+            staged = inp._staged.pop(t, [])
+            merged = ctx.exchange_deltas(("in", inp.id, t), staged, None)
+            if merged:
+                inp._staged[t] = merged
+            inp.emit_time(t)
+        scope.run_epoch(t)
+        last_time = t
+        result.epochs += 1
+        _ack_sources(pollers, persisted=False, up_to_time=t)
+        if prober is not None and prober.callbacks:
+            prober.update(epochs=result.epochs)
+        if max_epochs is not None and result.epochs >= max_epochs:
+            break
     scope.current_time = max(scope.current_time, last_time)
     scope.finish()
     if prober is not None:

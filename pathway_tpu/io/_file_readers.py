@@ -36,6 +36,14 @@ def _list_files(path: str) -> list[str]:
     return []
 
 
+def _path_owner(path: str, worker_count: int) -> int:
+    """Stable worker assignment for a file (survives new files appearing)."""
+    import hashlib
+
+    digest = hashlib.blake2b(path.encode(), digest_size=8).digest()
+    return int.from_bytes(digest, "little") % worker_count
+
+
 def _metadata(path: str) -> Json:
     try:
         st = os.stat(path)
@@ -78,6 +86,21 @@ class FileReader(Reader):
         self.with_metadata = with_metadata
         # per-file progress: (mtime, consumed_units)
         self._progress: dict[str, tuple[float, int]] = {}
+        # multi-worker file split: ownership is a stable hash of the file
+        # path — NOT the listing index, which would reassign existing files
+        # (and re-emit them) whenever a new file sorts in front of them
+        self._stripe: tuple[int, int] | None = None
+
+    def partition(self, worker_id: int, worker_count: int) -> "FileReader":
+        self._stripe = (worker_id, worker_count)
+        return self
+
+    def _my_files(self) -> list[str]:
+        files = _list_files(self.path)
+        if self._stripe is None:
+            return files
+        wid, n = self._stripe
+        return [f for f in files if _path_owner(f, n) == wid]
 
     def _emit_file(self, path: str, emit) -> bool:
         try:
@@ -110,7 +133,7 @@ class FileReader(Reader):
     def run(self, emit) -> None:
         while True:
             emitted = False
-            for path in _list_files(self.path):
+            for path in self._my_files():
                 if self._emit_file(path, emit):
                     emitted = True
             if emitted:

@@ -61,6 +61,16 @@ class Reader:
     def seek(self, offset: Any) -> None:  # persistence hook
         pass
 
+    def partition(self, worker_id: int, worker_count: int) -> "Reader | None":
+        """Multi-worker split of this source.  Partitionable readers (file
+        scanners stride the sorted file list, Kafka takes partitions by
+        ``partition % worker_count``) override this; the default is the
+        reference's rule for non-partitioned sources — read everything on
+        one worker, the post-ingest exchange scatters the rows
+        (docs/.../10.worker-architecture.md:40-42, dataflow.rs:1414-1437).
+        Returning ``None`` means this worker reads nothing."""
+        return self if worker_id == 0 else None
+
 
 class _RowCountEmit:
     """Wraps the queue put: counts data rows, skips the first ``skip`` after a
@@ -234,7 +244,16 @@ def make_input_table(
         if upsert:
             node.require_state()
         poller = _QueuePoller(node, schema, autocommit_duration_ms)
+        worker = getattr(lowerer.scope, "worker", None)
         reader = reader_factory()
+        if worker is not None and worker.worker_count > 1:
+            reader = reader.partition(worker.worker_id, worker.worker_count)
+            if reader is None:
+                node.close()  # this worker owns no slice of the source
+                return node
+            # salt autogenerated row keys by worker so striped partitions
+            # never collide in the shared 128-bit key space
+            poller._seq = itertools.count(worker.worker_id << 64)
         poller.reader = reader
 
         # persistence: replay committed snapshot, seek reader past it
@@ -246,6 +265,9 @@ def make_input_table(
             counter = getattr(lowerer, "_source_counter", 0)
             lowerer._source_counter = counter + 1
             sid = name or f"source_{counter}"
+            if worker is not None and worker.worker_count > 1:
+                # worker-sharded snapshot files (tracker.rs worker sharding)
+                sid = f"{sid}-w{worker.worker_id}"
             digest = "|".join(
                 f"{n}:{schema.__columns__[n].dtype}"
                 for n in schema.__columns__
@@ -318,9 +340,30 @@ def make_static_input_table(
         keyed.append((key, tuple(values), 0, 1))
 
     def build(lowerer: Lowerer) -> df.Node:
-        return df.StaticNode(lowerer.scope, keyed)
+        rows_for_worker = keyed
+        worker = getattr(lowerer.scope, "worker", None)
+        if worker is not None and worker.worker_count > 1:
+            # every worker computed identical keys from identical build-time
+            # data; each keeps only its own shard (SPMD data ownership)
+            rows_for_worker = [
+                e for e in keyed if worker.owner_of(e[0]) == worker.worker_id
+            ]
+        return df.StaticNode(lowerer.scope, rows_for_worker)
 
     return Table(schema, build, universe=Universe())
+
+
+def worker_part_path(filename: str) -> str:
+    """Per-worker output path: in multi-process runs each worker writes its
+    own shard of the output stream, so file sinks get a ``.part-N`` suffix
+    for workers > 0 (worker 0 keeps the plain name; single-process is
+    unchanged).  The combined output is the union of the part files."""
+    from pathway_tpu.internals.config import get_config
+
+    cfg = get_config()
+    if cfg.processes > 1 and cfg.process_id > 0:
+        return f"{filename}.part-{cfg.process_id}"
+    return filename
 
 
 def register_output(

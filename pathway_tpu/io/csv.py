@@ -97,6 +97,7 @@ def _convert(raw: str | None, dtype: dt.DType):
 
 class _CsvWriter:
     def __init__(self, filename: str, column_names: list[str]):
+        filename = _utils.worker_part_path(filename)
         os.makedirs(os.path.dirname(os.path.abspath(filename)), exist_ok=True)
         self._f = open(filename, "w", newline="")
         self._w = _csv.writer(self._f)

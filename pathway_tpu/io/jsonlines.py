@@ -104,6 +104,7 @@ def _jsonable(v):
 
 class _JsonLinesWriter:
     def __init__(self, filename: str, column_names: list[str]):
+        filename = _utils.worker_part_path(filename)
         dirname = os.path.dirname(os.path.abspath(filename))
         os.makedirs(dirname, exist_ok=True)
         self._f = open(filename, "w")

@@ -51,11 +51,25 @@ class _KafkaReader(Reader):
         self.format = format
         self.schema = schema
         self.commit_interval_s = commit_interval_s
+        # multi-worker: (worker_id, worker_count) → manual assignment of
+        # partitions with partition % worker_count == worker_id (the
+        # reference's partitioned-source rule, worker-architecture.md:40)
+        self._stripe: tuple[int, int] | None = None
         self._offset_commit_requested = _threading.Event()
         self._lock = _threading.Lock()
         self._commit_seq = 0  # COMMIT markers emitted so far
         self._ack_up_to = 0  # highest marker the engine has acknowledged
         self._captured: dict[int, Any] = {}  # marker seq -> offsets snapshot
+
+    def partition(self, worker_id: int, worker_count: int) -> "_KafkaReader":
+        self._stripe = (worker_id, worker_count)
+        return self
+
+    def _my_partitions(self, all_partitions: list[int]) -> list[int]:
+        if self._stripe is None:
+            return all_partitions
+        wid, n = self._stripe
+        return [p for p in all_partitions if p % n == wid]
 
     def request_offset_commit(self, up_to: int | None = None) -> None:
         """Called by the engine at its durability point (epoch processed /
@@ -117,7 +131,22 @@ class _KafkaReader(Reader):
             settings = dict(self.settings)
             settings["enable.auto.commit"] = False
             consumer = client.Consumer(settings)
-            consumer.subscribe([self.topic])
+            if self._stripe is not None:
+                meta = consumer.list_topics(self.topic, timeout=10.0)
+                parts = sorted(meta.topics[self.topic].partitions.keys())
+                if not parts:
+                    raise RuntimeError(
+                        f"kafka: no partition metadata for topic "
+                        f"{self.topic!r}; cannot stripe it across workers"
+                    )
+                consumer.assign(
+                    [
+                        client.TopicPartition(self.topic, p)
+                        for p in self._my_partitions(parts)
+                    ]
+                )
+            else:
+                consumer.subscribe([self.topic])
 
             def positions():
                 try:
@@ -154,12 +183,39 @@ class _KafkaReader(Reader):
                             )
                         )
         else:
-            consumer = client.KafkaConsumer(
-                self.topic,
-                bootstrap_servers=self.settings.get("bootstrap.servers"),
-                group_id=group_id,
-                enable_auto_commit=False,
-            )
+            if self._stripe is not None:
+                consumer = client.KafkaConsumer(
+                    bootstrap_servers=self.settings.get("bootstrap.servers"),
+                    group_id=group_id,
+                    enable_auto_commit=False,
+                )
+                # manual assign() never re-fetches metadata, so a missing
+                # topic must fail loudly, not pin the cluster to nothing
+                parts = None
+                for _ in range(20):
+                    parts = consumer.partitions_for_topic(self.topic)
+                    if parts:
+                        break
+                    _time.sleep(0.5)
+                if not parts:
+                    raise RuntimeError(
+                        f"kafka: no partition metadata for topic "
+                        f"{self.topic!r}; cannot stripe it across workers"
+                    )
+                tp_cls = client.TopicPartition
+                consumer.assign(
+                    [
+                        tp_cls(self.topic, p)
+                        for p in self._my_partitions(sorted(parts))
+                    ]
+                )
+            else:
+                consumer = client.KafkaConsumer(
+                    self.topic,
+                    bootstrap_servers=self.settings.get("bootstrap.servers"),
+                    group_id=group_id,
+                    enable_auto_commit=False,
+                )
             meta_cls = getattr(client, "OffsetAndMetadata", None)
 
             def positions():

@@ -38,8 +38,15 @@ except Exception:  # pragma: no cover
 
 if _HAVE_JAX:
 
-    @functools.partial(jax.jit, static_argnames=("metric",))
-    def _score_jax(matrix, queries, metric: str):
+    def score_block(matrix, queries, metric: str):
+        """Traceable similarity scores [n_queries, n_rows]; larger = closer.
+
+        The ONE device-side definition of each metric — used by both the
+        single-chip jitted path below and the shard_map distributed top-k
+        (``pathway_tpu/parallel/index.py``), so scores agree bit-for-bit
+        between them.  cos/ip run the matmul in bfloat16 (MXU-native);
+        l2sq stays float32 (catastrophic cancellation in bf16).
+        """
         m = matrix.astype(jnp.bfloat16)
         q = queries.astype(jnp.bfloat16)
         if metric == "cos":
@@ -54,6 +61,8 @@ if _HAVE_JAX:
         sq_m = jnp.sum(m32 * m32, axis=1)[None, :]
         sq_q = jnp.sum(q32 * q32, axis=1)[:, None]
         return -(sq_q + sq_m - 2.0 * (q32 @ m32.T))
+
+    _score_jax = functools.partial(jax.jit, static_argnames=("metric",))(score_block)
 
     @functools.partial(jax.jit, static_argnames=("metric", "k"))
     def _masked_topk_jax(matrix, mask, queries, metric: str, k: int):
@@ -73,19 +82,36 @@ class DeviceIndexCache:
     grows in power-of-two buckets so streaming index growth hits a warm XLA
     compile cache instead of recompiling per row count.  Padded rows carry a
     -inf mask so they never win top-k.
+
+    With a ``mesh``, the padded matrix is sharded row-wise over every chip
+    (``NamedSharding(P(axes, None))``) and queries run through the shard_map
+    distributed top-k (``pathway_tpu/parallel/index.py``) — the corpus never
+    leaves HBM; only ``n_chips × k`` (id, score) pairs cross ICI.
     """
 
-    def __init__(self):
+    def __init__(self, mesh=None):
+        self.mesh = mesh
         self._version = -1
         self._padded = None
         self._mask = None
         self._n = 0
+
+    def _n_chips(self) -> int:
+        if self.mesh is None:
+            return 1
+        n = 1
+        for ax in self.mesh.axis_names:
+            n *= self.mesh.shape[ax]
+        return n
 
     def get(self, matrix: np.ndarray, version: int):
         if not _HAVE_JAX:
             return None
         n = matrix.shape[0]
         cap = _next_pow2(max(n, _JAX_MIN_ROWS))
+        chips = self._n_chips()
+        if cap % chips:  # non-power-of-two meshes: equal slices per chip
+            cap = ((cap + chips - 1) // chips) * chips
         if (
             self._padded is None
             or version != self._version
@@ -96,8 +122,17 @@ class DeviceIndexCache:
             padded[:n] = matrix
             mask = np.full((cap,), -np.inf, dtype=np.float32)
             mask[:n] = 0.0
-            self._padded = jax.device_put(jnp.asarray(padded))
-            self._mask = jax.device_put(jnp.asarray(mask))
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                axes = tuple(self.mesh.axis_names)
+                self._padded = jax.device_put(
+                    padded, NamedSharding(self.mesh, P(axes, None))
+                )
+                self._mask = jax.device_put(mask, NamedSharding(self.mesh, P(axes)))
+            else:
+                self._padded = jax.device_put(jnp.asarray(padded))
+                self._mask = jax.device_put(jnp.asarray(mask))
             self._version = version
             self._n = n
         return self._padded, self._mask, self._n
@@ -115,13 +150,25 @@ def topk_search_cached(
     """Top-k against a device-resident padded index (warm across queries)."""
     n = matrix.shape[0]
     k_eff = min(k, n)
-    if not _HAVE_JAX or n < _JAX_MIN_ROWS:
+    if not _HAVE_JAX or (n < _JAX_MIN_ROWS and cache.mesh is None):
         scores = _score_numpy(
             matrix.astype(np.float32), queries.astype(np.float32), metric
         )
         idx = np.argsort(-scores, kind="stable", axis=1)[:, :k_eff]
         return idx, np.take_along_axis(scores, idx, axis=1)
     device_matrix, mask, _n = cache.get(matrix, version)
+    if cache.mesh is not None:
+        from pathway_tpu.parallel.index import sharded_topk
+
+        idx, vals = sharded_topk(
+            cache.mesh,
+            device_matrix,
+            mask,
+            jnp.asarray(queries.astype(np.float32)),
+            k_eff,
+            metric,
+        )
+        return np.asarray(idx), np.asarray(vals)
     vals, idx = _masked_topk_jax(
         device_matrix, mask, jnp.asarray(queries.astype(np.float32)), metric, k_eff
     )

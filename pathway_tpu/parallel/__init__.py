@@ -25,8 +25,10 @@ from __future__ import annotations
 
 from pathway_tpu.parallel.mesh import (
     flat_axes,
+    get_default_index_mesh,
     make_mesh,
     mesh_shape_for,
+    set_default_index_mesh,
 )
 from pathway_tpu.parallel.sharding import (
     replicated,
@@ -44,6 +46,8 @@ __all__ = [
     "make_mesh",
     "mesh_shape_for",
     "flat_axes",
+    "set_default_index_mesh",
+    "get_default_index_mesh",
     "shard_params",
     "shard_batch",
     "replicated",

@@ -33,8 +33,10 @@ def _flat_axis_index(axes: tuple[str, ...], mesh: Mesh):
     return idx
 
 
-@functools.partial(jax.jit, static_argnames=("k", "mesh", "axes"))
-def _sharded_topk_impl(docs, mask, queries, *, k: int, mesh: Mesh, axes: tuple[str, ...]):
+@functools.partial(jax.jit, static_argnames=("k", "mesh", "axes", "metric"))
+def _sharded_topk_impl(
+    docs, mask, queries, *, k: int, mesh: Mesh, axes: tuple[str, ...], metric: str = "ip"
+):
     n_chips = 1
     for ax in axes:
         n_chips *= mesh.shape[ax]
@@ -43,7 +45,11 @@ def _sharded_topk_impl(docs, mask, queries, *, k: int, mesh: Mesh, axes: tuple[s
     k_local = min(k, docs.shape[0] // n_chips)
 
     def local(docs_blk, mask_blk, q):
-        scores = (q @ docs_blk.T).astype(jnp.float32) + mask_blk[None, :]
+        # shared metric definition — scores match the single-chip path
+        # (ops/topk.py score_block) bit-for-bit
+        from pathway_tpu.ops.topk import score_block
+
+        scores = score_block(docs_blk, q, metric) + mask_blk[None, :]
         vals, idx = lax.top_k(scores, k_local)
         shard = _flat_axis_index(axes, mesh)
         idx = idx + shard * docs_blk.shape[0]
@@ -67,10 +73,13 @@ def sharded_topk(
     mask: jax.Array,
     queries: jax.Array,
     k: int,
+    metric: str = "ip",
 ) -> tuple[jax.Array, jax.Array]:
     """(indices, scores) of the k best doc rows per query, across all chips."""
     axes = tuple(mesh.axis_names)
-    return _sharded_topk_impl(docs, mask, queries, k=k, mesh=mesh, axes=axes)
+    return _sharded_topk_impl(
+        docs, mask, queries, k=k, mesh=mesh, axes=axes, metric=metric
+    )
 
 
 class ShardedDeviceIndex:

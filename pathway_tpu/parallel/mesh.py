@@ -51,3 +51,21 @@ def make_mesh(
 def flat_axes(mesh: Mesh) -> tuple[str, ...]:
     """All mesh axes — for state sharded over every chip (the index)."""
     return tuple(mesh.axis_names)
+
+
+# Process-wide default mesh for device-resident indexes.  When set, every
+# BruteForceKnn/USearchKnn index (and the DocumentStore/VectorStore built on
+# them) shards its corpus matrix over this mesh and answers queries through
+# the shard_map top-k — the analog of the reference attaching its external
+# index to every SPMD worker (src/engine/dataflow.rs:2694).
+_DEFAULT_INDEX_MESH: Mesh | None = None
+
+
+def set_default_index_mesh(mesh: Mesh | None) -> None:
+    """Route all subsequently-built device indexes over ``mesh``."""
+    global _DEFAULT_INDEX_MESH
+    _DEFAULT_INDEX_MESH = mesh
+
+
+def get_default_index_mesh() -> Mesh | None:
+    return _DEFAULT_INDEX_MESH

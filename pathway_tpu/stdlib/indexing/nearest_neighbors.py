@@ -47,17 +47,24 @@ class BruteForceKnnIndex:
     scan runs through the jitted device kernel when available.
     """
 
-    def __init__(self, metric: DistanceMetric, reserved_space: int = 0, dimensions: int | None = None):
+    def __init__(
+        self,
+        metric: DistanceMetric,
+        reserved_space: int = 0,
+        dimensions: int | None = None,
+        mesh=None,
+    ):
         from pathway_tpu.ops import topk as topk_ops
 
         self.metric = metric
+        self.mesh = mesh
         self._vectors: dict[int, np.ndarray] = {}
         self._filters: dict[int, Any] = {}
         self._dirty = True
         self._version = 0  # bumped on every change; keys the device cache
         self._keys: list[int] = []
         self._matrix: np.ndarray | None = None
-        self._device_cache = topk_ops.DeviceIndexCache()
+        self._device_cache = topk_ops.DeviceIndexCache(mesh=mesh)
 
     def add(self, key: int, vector, filter_data=None) -> None:
         self._vectors[key] = _as_vec(vector)
@@ -138,6 +145,7 @@ class BruteForceKnn(InnerIndex):
         reserved_space: int = 0,
         metric: BruteForceKnnMetricKind | DistanceMetric = DistanceMetric.COS,
         embedder=None,
+        mesh=None,
     ):
         super().__init__(data_column, metadata_column)
         if isinstance(metric, BruteForceKnnMetricKind):
@@ -145,10 +153,20 @@ class BruteForceKnn(InnerIndex):
         self.metric = metric
         self.dimensions = dimensions
         self.embedder = embedder
+        self.mesh = mesh
 
     def factory(self):
         metric = self.metric
-        return _SimpleFactory(lambda: BruteForceKnnIndex(metric))
+        explicit_mesh = self.mesh
+
+        def make():
+            # late-bound so set_default_index_mesh() before pw.run() applies
+            from pathway_tpu.parallel.mesh import get_default_index_mesh
+
+            mesh = explicit_mesh if explicit_mesh is not None else get_default_index_mesh()
+            return BruteForceKnnIndex(metric, mesh=mesh)
+
+        return _SimpleFactory(make)
 
     def embed(self, column):
         if self.embedder is not None:
@@ -172,6 +190,7 @@ class USearchKnn(BruteForceKnn):
         expansion_add: int = 0,
         expansion_search: int = 0,
         embedder=None,
+        mesh=None,
     ):
         if isinstance(metric, USearchMetricKind):
             metric = DistanceMetric(metric.value)
@@ -182,6 +201,7 @@ class USearchKnn(BruteForceKnn):
             reserved_space=reserved_space,
             metric=metric,
             embedder=embedder,
+            mesh=mesh,
         )
         self.connectivity = connectivity
         self.expansion_add = expansion_add

@@ -32,6 +32,7 @@ class BruteForceKnnFactory(AbstractRetrieverFactory):
     reserved_space: int = 0
     embedder: object | None = None
     metric: "BruteForceKnnMetricKind" = None  # type: ignore[assignment]
+    mesh: object | None = None  # jax.sharding.Mesh → corpus-sharded device index
 
     def build_index(self, data_column, data_table, metadata_column=None):
         from pathway_tpu.stdlib.indexing.data_index import DataIndex
@@ -48,6 +49,7 @@ class BruteForceKnnFactory(AbstractRetrieverFactory):
             reserved_space=self.reserved_space,
             metric=DistanceMetric(metric.value),
             embedder=self.embedder,
+            mesh=self.mesh,
         )
         return DataIndex(data_table, inner)
 
@@ -63,6 +65,7 @@ class UsearchKnnFactory(AbstractRetrieverFactory):
     connectivity: int = 0
     expansion_add: int = 0
     expansion_search: int = 0
+    mesh: object | None = None  # jax.sharding.Mesh → corpus-sharded device index
 
     def build_index(self, data_column, data_table, metadata_column=None):
         from pathway_tpu.stdlib.indexing.data_index import DataIndex
@@ -82,6 +85,7 @@ class UsearchKnnFactory(AbstractRetrieverFactory):
             expansion_add=self.expansion_add,
             expansion_search=self.expansion_search,
             embedder=self.embedder,
+            mesh=self.mesh,
         )
         return DataIndex(data_table, inner)
 

@@ -71,7 +71,8 @@ def test_spawn_sets_cluster_env(tmp_path):
     assert all(r["processes"] == "2" and r["threads"] == "3" for r in rows)
     assert all(r["first_port"] == "12345" for r in rows)
     assert len({r["run_id"] for r in rows}) == 1  # one run id for the cluster
-    assert "2 processes (6 total workers)" in res.stderr
+    assert "SPMD cluster: 2 process(es)" in res.stderr
+    assert "ports 12345..12346" in res.stderr
 
 
 def test_spawn_propagates_failure_exit_code(tmp_path):

