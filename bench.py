@@ -34,8 +34,8 @@ BATCH = 512
 SEQ = 64
 WARMUP = 3
 ITERS = 20
-ATTEMPTS = 3
-ATTEMPT_TIMEOUT_S = 420  # first TPU compile can take minutes
+ATTEMPTS = 2
+ATTEMPT_TIMEOUT_S = 360  # first TPU compile can take minutes
 BACKOFF_S = 20.0
 
 # Peak dense bf16 FLOP/s by TPU generation (public spec sheets); used only

@@ -1,0 +1,111 @@
+"""Host-engine throughput benchmark: the wordcount-class ETL workload.
+
+Mirrors the role of the reference's in-repo perf harness
+(``integration_tests/wordcount/base.py:217-224``): rows through a
+select → filter → groupby(count/sum) pipeline, reported as rows/sec.
+Runs the identical pipeline twice — columnar epoch execution ON (the
+default) and OFF (the per-row interpreter baseline) — so the speedup is
+measured in-repo, not claimed.
+
+Usage: python benchmarks/host_wordcount.py [n_rows]
+Prints one JSON line per mode plus a speedup summary; RESULTS.md records
+committed numbers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+WORDS = [
+    "the", "of", "and", "to", "in", "a", "is", "that", "for", "it",
+    "stream", "table", "epoch", "shard", "index", "vector", "batch",
+]
+
+
+def build_pipeline(n_rows: int):
+    import pathway_tpu as pw
+    from pathway_tpu.io._utils import make_static_input_table
+
+    rows = [
+        {"word": WORDS[(i * 7919) % len(WORDS)], "val": (i * 31) % 1000}
+        for i in range(n_rows)
+    ]
+    t = make_static_input_table(pw.schema_from_types(word=str, val=int), rows)
+    t = t.with_columns(scaled=pw.this.val * 3 + 1)
+    t = t.filter(pw.this.scaled % 7 != 0)
+    return t.groupby(pw.this.word).reduce(
+        word=pw.this.word,
+        n=pw.reducers.count(),
+        total=pw.reducers.sum(pw.this.scaled),
+    )
+
+
+def run_once(n_rows: int, columnar: bool):
+    import pathway_tpu as pw
+    from pathway_tpu.internals import vector_compiler as vc
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import run_pipeline_to_completion
+    from pathway_tpu.engine import dataflow as df
+
+    G.clear()
+    vc.set_enabled(columnar)
+    try:
+        result = build_pipeline(n_rows)
+        collected = []
+
+        def attach(lowerer, node):
+            return df.OutputNode(
+                lowerer.scope,
+                node,
+                on_data=lambda key, row, t, diff: collected.append((row, diff)),
+            )
+
+        t0 = time.perf_counter()
+        run_pipeline_to_completion([(result, attach)])
+        dt_s = time.perf_counter() - t0
+    finally:
+        vc.set_enabled(True)
+        G.clear()
+    return dt_s, collected
+
+
+def main() -> None:
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 200_000
+    results = {}
+    outputs = {}
+    for label, columnar in (("columnar", True), ("row", False)):
+        dt_s, collected = run_once(n_rows, columnar)
+        rate = n_rows / dt_s
+        results[label] = rate
+        outputs[label] = sorted(r for r, d in collected if d > 0)
+        print(
+            json.dumps(
+                {
+                    "metric": f"host_wordcount_rows_per_sec_{label}",
+                    "value": round(rate, 1),
+                    "unit": "rows/s",
+                    "rows": n_rows,
+                    "seconds": round(dt_s, 3),
+                }
+            )
+        )
+    assert outputs["columnar"] == outputs["row"], "columnar path diverged!"
+    print(
+        json.dumps(
+            {
+                "metric": "host_wordcount_columnar_speedup",
+                "value": round(results["columnar"] / results["row"], 2),
+                "unit": "x",
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,20 @@ Delta = tuple  # (key:int, row:Row, diff:int)
 
 
 def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
+    if not isinstance(deltas, list):
+        deltas = list(deltas)
+    # fast path: all-distinct-key inserts cannot cancel or merge — an int-set
+    # scan is far cheaper than hashing every full row tuple (hot for the
+    # insert-heavy ingest epochs)
+    keys: set[int] = set()
+    clean = True
+    for key, _, diff in deltas:
+        if diff != 1 or key in keys:
+            clean = False
+            break
+        keys.add(key)
+    if clean:
+        return deltas
     acc: Counter = Counter()
     for key, row, diff in deltas:
         acc[(key, row)] += diff
@@ -55,6 +69,13 @@ def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
 
 class EngineError(RuntimeError):
     pass
+
+
+def _vec_threshold() -> int:
+    # single source of truth for the columnar batch threshold
+    from pathway_tpu.internals import vector_compiler as vc
+
+    return vc.VEC_THRESHOLD
 
 
 class Node:
@@ -232,20 +253,58 @@ class StaticNode(InputNode):
 
 
 class ExprNode(Node):
-    """Row-wise map: select/with_columns — evaluates compiled expressions."""
+    """Row-wise map: select/with_columns — evaluates compiled expressions.
+
+    ``vec_select`` (set by the Lowerer when every output expression compiles
+    to column ops) switches large batches to a numpy columnar evaluation —
+    the §7.3 "columnar batches instead of row tuples" path.  The vector
+    path bails back to the row interpreter on anything it cannot honor
+    exactly (mixed/None columns, zero divisors, …).
+    """
 
     name = "select"
 
     def __init__(self, scope, inp: Node, fn: Callable[[int, Row], Row], deps: Sequence[Node] = ()):
         super().__init__(scope, [inp])
         self.fn = fn
+        # (needed_col_indices, [fn per out col], [out dtype per out col])
+        self.vec_select = None
         for d in deps:
             d.require_state()
 
+    def _try_columnar(self, deltas: list[Delta]) -> list[Delta] | None:
+        from pathway_tpu.internals import vector_compiler as vc
+
+        if not vc.ENABLED:
+            return None
+        needed, out_fns, out_dtypes = self.vec_select
+        rows = [r for (_, r, _) in deltas]
+        cols = vc.materialize_columns(rows, needed)
+        if cols is None:
+            return None
+        n = len(rows)
+        try:
+            out_cols = []
+            for f, d in zip(out_fns, out_dtypes):
+                arr = f(cols, n)
+                if not vc.result_kind_ok(arr, d):
+                    return None
+                out_cols.append(arr.tolist())  # C-speed → Python scalars
+        except vc.VecBail:
+            return None
+        out_rows = list(zip(*out_cols)) if out_cols else [()] * n
+        return [
+            (key, new_row, diff)
+            for (key, _, diff), new_row in zip(deltas, out_rows)
+        ]
+
     def step(self, time):
-        out = []
-        for key, row, diff in self.take_pending():
-            out.append((key, self.fn(key, row), diff))
+        deltas = self.take_pending()
+        out = None
+        if self.vec_select is not None and len(deltas) >= _vec_threshold():
+            out = self._try_columnar(deltas)
+        if out is None:
+            out = [(key, self.fn(key, row), diff) for key, row, diff in deltas]
         out = consolidate(out)
         if self.keep_state:
             self._update_state(out)
@@ -253,6 +312,8 @@ class ExprNode(Node):
 
 
 class FilterNode(Node):
+    # the Table layer's filter() lowers to its own _PredFilter with the
+    # columnar fast path; this plain node serves engine-internal filters
     name = "filter"
 
     def __init__(self, scope, inp: Node, pred: Callable[[int, Row], bool]):
@@ -708,20 +769,94 @@ class GroupByNode(Node):
         self._group_counts: Counter = Counter()  # rows per group (for
         # reducer-less reduces: distinct group keys must still emit rows)
         self._last_out: dict[tuple, Row] = {}
+        # columnar fast path (set by the Lowerer): (group_col_idx,
+        # [("count", None) | ("sum", value_col_idx), ...]) — batch reducer
+        # updates become np.unique grouping + one add_bulk per touched group
+        self.vec_group = None
+
+    def _ensure_group(self, gk):
+        states = self._groups.get(gk)
+        if states is None:
+            states = [r.make_state() for (r, _) in self.reducer_specs]
+            self._groups[gk] = states
+        return states
+
+    def _step_columnar(self, deltas: list[Delta], touched: set) -> bool:
+        import numpy as np
+
+        from pathway_tpu.internals import vector_compiler as vc
+
+        if not vc.ENABLED:
+            return False
+        gidx, red_cols = self.vec_group
+        rows = [r for (_, r, _) in deltas]
+        needed = {gidx} | {vidx for kind, vidx in red_cols if kind != "count"}
+        # shared materializer: uniform-Python-type + int64-range checks
+        cols = vc.materialize_columns(rows, needed)
+        if cols is None:
+            return False
+        garr = cols[gidx]
+        val_arrs = [
+            None if kind == "count" else cols[vidx] for kind, vidx in red_cols
+        ]
+        if any(v is not None and v.dtype.kind not in "bif" for v in val_arrs):
+            return False
+        diffs = np.asarray([d for (_, _, d) in deltas], np.int64)
+        max_diff = vc._abs_bound(diffs)
+        for varr in val_arrs:
+            # per-batch int sums must stay within i64 (state accumulates in
+            # Python bignums, so only the numpy partial sums can wrap)
+            if (
+                varr is not None
+                and varr.dtype.kind == "i"
+                and vc._abs_bound(varr) * max_diff * max(1, len(rows)) > vc._I64_MAX
+            ):
+                return False
+        uniq, inv = np.unique(garr, return_inverse=True)
+        n_groups = len(uniq)
+        counts = np.zeros(n_groups, np.int64)
+        np.add.at(counts, inv, diffs)
+        contribs = []
+        for varr in val_arrs:
+            if varr is None:
+                contribs.append(None)
+                continue
+            if varr.dtype.kind == "f":
+                contribs.append(np.bincount(inv, weights=varr * diffs, minlength=n_groups))
+            else:
+                acc = np.zeros(n_groups, np.int64)
+                np.add.at(acc, inv, varr.astype(np.int64) * diffs)
+                contribs.append(acc)
+        gvals = uniq.tolist()
+        counts_l = counts.tolist()
+        contribs_l = [c.tolist() if c is not None else None for c in contribs]
+        for ui, gval in enumerate(gvals):
+            gk = (gval,)
+            states = self._ensure_group(gk)
+            for state, contrib in zip(states, contribs_l):
+                if contrib is None:
+                    state.add_bulk(counts_l[ui])
+                else:
+                    state.add_bulk(contrib[ui], counts_l[ui])
+            self._group_counts[gk] += counts_l[ui]
+            touched.add(gk)
+        return True
 
     def step(self, time):
         out = []
         touched: set[tuple] = set()
-        for key, row, diff in consolidate(self.take_pending()):
-            gk = self.group_key_fn(key, row)
-            states = self._groups.get(gk)
-            if states is None:
-                states = [r.make_state() for (r, _) in self.reducer_specs]
-                self._groups[gk] = states
-            for state, (_, args_fn) in zip(states, self.reducer_specs):
-                state.add(args_fn(key, row), diff, time, key)
-            self._group_counts[gk] += diff
-            touched.add(gk)
+        deltas = consolidate(self.take_pending())
+        handled = False
+        if self.vec_group is not None and len(deltas) >= _vec_threshold():
+            handled = self._step_columnar(deltas, touched)
+        if not handled:
+            for key, row, diff in deltas:
+                gk = self.group_key_fn(key, row)
+                states = self._ensure_group(gk)
+                for state, (_, args_fn) in zip(states, self.reducer_specs):
+                    state.add(args_fn(key, row), diff, time, key)
+                self._group_counts[gk] += diff
+                touched.add(gk)
         for gk in touched:
             states = self._groups[gk]
             okey = self.out_key_fn(gk)

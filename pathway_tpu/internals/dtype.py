@@ -459,6 +459,16 @@ def dtype_of_value(value: Any) -> DType:
 
 # Coercions applied when a value enters a column of a known dtype.
 def coerce(value: Any, dtype: DType) -> Any:
+    # hot-path exact (value type, dtype) exits — ingest calls this per cell
+    t = type(value)
+    if (
+        (dtype is INT and t is int)
+        or (dtype is FLOAT and t is float)
+        or (dtype is STR and t is str)
+        or (dtype is BOOL and t is bool)
+        or (dtype is ANY and (t is int or t is float or t is str or t is bool))
+    ):
+        return value
     if value is None or isinstance(value, _etypes.Error):
         return value
     if isinstance(value, (np.ndarray, tuple)):

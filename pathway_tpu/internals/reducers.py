@@ -65,6 +65,10 @@ class _CountState(ReducerState):
     def add(self, args, diff, time, key):
         self.n += diff
 
+    def add_bulk(self, n_contrib: int) -> None:
+        """Columnar path: fold a whole batch's diff total in one call."""
+        self.n += n_contrib
+
     def extract(self):
         return self.n
 
@@ -99,6 +103,15 @@ class _SumState(ReducerState):
         else:
             self.total = self.total + contrib
         self.n += diff
+
+    def add_bulk(self, total_contrib, n_contrib: int) -> None:
+        """Columnar path: Σ value·diff and Σ diff for a batch (no Nones —
+        the vector path only runs on typed columns)."""
+        if self.total is None:
+            self.total = total_contrib
+        else:
+            self.total = self.total + total_contrib
+        self.n += n_contrib
 
     def extract(self):
         if self.total is None:

@@ -619,7 +619,23 @@ class Table(Joinable):
                     out.append(dt.coerce(v, d))
                 return tuple(out)
 
-            return df.ExprNode(lowerer.scope, node_in, fn)
+            node_out = df.ExprNode(lowerer.scope, node_in, fn)
+            if not coro_fns and not binder.fetches:
+                # columnar fast path: all output expressions must vectorize
+                from pathway_tpu.internals import vector_compiler as vc
+
+                vec_fns, needed = [], set()
+                for e in desugared.values():
+                    compiled = vc.try_compile_vec(e, binder)
+                    if compiled is None:
+                        vec_fns = None
+                        break
+                    f_vec, used = compiled
+                    vec_fns.append(f_vec)
+                    needed |= used
+                if vec_fns is not None:
+                    node_out.vec_select = (needed, vec_fns, out_dtypes)
+            return node_out
 
         # schema inference
         tmp_binder = RowBinder(Lowerer(df.Scope()), self)
@@ -689,17 +705,48 @@ class Table(Joinable):
             node_in = _fetch_chain(lowerer, base, binder)
             n_cols = len(self.column_names())
 
+            from pathway_tpu.internals import vector_compiler as vc
+
+            vec = None if binder.fetches else vc.try_compile_vec(e, binder)
+
             class _PredFilter(df.Node):
                 name = "filter"
 
+                def _try_columnar(self_inner, deltas):
+                    f_vec, needed = vec
+                    rows = [r for (_, r, _) in deltas]
+                    cols = vc.materialize_columns(rows, needed)
+                    if cols is None:
+                        return None
+                    try:
+                        mask = f_vec(cols, len(rows))
+                    except vc.VecBail:
+                        return None
+                    if mask.dtype.kind != "b":
+                        return None
+                    return [
+                        (key, row[:n_cols], diff)
+                        for (key, row, diff), keep in zip(deltas, mask.tolist())
+                        if keep
+                    ]
+
                 def step(self_inner, time):
-                    out = []
-                    for key, row, diff in self_inner.take_pending():
-                        res = pred(key, row)
-                        if isinstance(res, Error):
-                            continue
-                        if res:
-                            out.append((key, row[:n_cols], diff))
+                    deltas = self_inner.take_pending()
+                    out = None
+                    if (
+                        vec is not None
+                        and vc.ENABLED
+                        and len(deltas) >= vc.VEC_THRESHOLD
+                    ):
+                        out = self_inner._try_columnar(deltas)
+                    if out is None:
+                        out = []
+                        for key, row, diff in deltas:
+                            res = pred(key, row)
+                            if isinstance(res, Error):
+                                continue
+                            if res:
+                                out.append((key, row[:n_cols], diff))
                     if self_inner.keep_state:
                         self_inner._update_state(out)
                     self_inner.send(out, time)
@@ -1491,7 +1538,7 @@ class GroupedTable:
                     dt.coerce(f(okey, row), d) for f, d in zip(out_fns, out_dtypes)
                 )
 
-            return df.GroupByNode(
+            gb_node = df.GroupByNode(
                 lowerer.scope,
                 node_in,
                 group_key_fn,
@@ -1499,6 +1546,50 @@ class GroupedTable:
                 reducer_specs,
                 result_fn,
             )
+            gb_node.vec_group = _vec_group_spec(
+                g_exprs, inst_expr, grouped_by_id, slots, binder
+            )
+            return gb_node
+
+        def _vec_group_spec(g_exprs, inst_expr, grouped_by_id, slots, binder):
+            """Columnar groupby spec (GroupByNode.vec_group) when the shape
+            allows it: one plain grouping column, count/sum/avg reducers over
+            plain columns.  Anything else keeps the row path."""
+            from pathway_tpu.internals.reducers import (
+                AvgReducer,
+                CountReducer,
+                SumReducer,
+            )
+            from pathway_tpu.internals.thisclass import ThisPlaceholder
+
+            def plain_idx(e):
+                if not isinstance(e, ColumnReference):
+                    return None
+                if not (isinstance(e.table, ThisPlaceholder) or e.table is binder.table):
+                    return None
+                if e.name == "id" or e.name not in binder.col_index:
+                    return None
+                return binder.col_index[e.name]
+
+            if grouped_by_id or inst_expr is not None or len(g_exprs) != 1:
+                return None
+            gidx = plain_idx(g_exprs[0])
+            if gidx is None:
+                return None
+            red_cols = []
+            for r in slots:
+                red = r._reducer
+                # isinstance: count is exported as a _CountCallable subclass
+                if isinstance(red, CountReducer) and not r._args:
+                    red_cols.append(("count", None))
+                    continue
+                if type(red) in (SumReducer, AvgReducer) and len(r._args) == 1:
+                    vidx = plain_idx(r._args[0])
+                    if vidx is not None:
+                        red_cols.append(("sum", vidx))
+                        continue
+                return None
+            return (gidx, red_cols)
 
         # schema inference
         tmp_binder = RowBinder(Lowerer(df.Scope()), table)

@@ -1,0 +1,335 @@
+"""Columnar (vectorized) expression compilation for large epoch batches.
+
+SURVEY.md §7.3: the host hot path should move columnar batches, not Python
+row tuples.  The engine stays delta-correct and row-oriented at its edges;
+inside an epoch, ``ExprNode``/``FilterNode``/``GroupByNode`` switch to a
+numpy fast path when (a) the expression compiles to vector ops and (b) the
+batch's columns materialize as typed 1-D arrays (no ``None``/``Error``
+values, no mixed types).  Anything else falls back to the per-row
+interpreter — semantics are identical by construction, because the fast
+path *bails* (``VecBail``) rather than approximating:
+
+* division/modulo with any zero divisor bails (per-row path yields ERROR
+  for exactly the offending rows);
+* ``**`` on ints bails (Python bignum semantics ≠ int64);
+* columns containing None/Error/mixed types materialize as object arrays
+  and bail.
+
+Int arithmetic runs in int64 — the reference engine's own integer type
+(``Value::Int`` is ``i64``, value.rs:210) — with overflow surfaced by
+numpy where detectable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import expression as expr_mod
+from pathway_tpu.internals.expression import (
+    CastExpression,
+    CoalesceExpression,
+    ColumnBinaryOpExpression,
+    ColumnConstExpression,
+    ColumnExpression,
+    ColumnReference,
+    ColumnUnaryOpExpression,
+    ConvertExpression,
+    IfElseExpression,
+    IsNoneExpression,
+    IsNotNoneExpression,
+    UnwrapExpression,
+)
+from pathway_tpu.internals.thisclass import ThisPlaceholder
+
+VEC_THRESHOLD = 64  # below this, per-row beats transpose + dispatch
+
+# process-wide switch (benchmark baselines, debugging); the row path is the
+# reference semantics, the vector path must be observationally identical
+ENABLED = True
+
+
+def set_enabled(flag: bool) -> None:
+    global ENABLED
+    ENABLED = bool(flag)
+
+VecFn = Callable[[dict, int], np.ndarray]  # (columns by index, n) -> array
+
+
+class VecBail(Exception):
+    """Data-dependent condition the vector path cannot honor; caller falls
+    back to the per-row interpreter for this batch."""
+
+
+def _const_array(v, n: int) -> np.ndarray:
+    return np.full(n, v)
+
+
+def try_compile_vec(e: ColumnExpression, binder) -> tuple[VecFn, set[int]] | None:
+    """Compile to a columnar evaluator, or None if not vectorizable.
+
+    ``binder`` is the row binder (needs ``table``, ``col_index``).  Returns
+    (fn, needed_column_indices).
+    """
+    needed: set[int] = set()
+    fn = _compile(e, binder, needed)
+    if fn is None:
+        return None
+    return fn, needed
+
+
+def _compile(e, binder, needed: set[int]) -> VecFn | None:
+    if isinstance(e, ColumnConstExpression):
+        v = e._val
+        if isinstance(v, (bool, int, float, str)):
+            return lambda cols, n: _const_array(v, n)
+        return None
+
+    if isinstance(e, ColumnReference):
+        tbl = e.table
+        if not (isinstance(tbl, ThisPlaceholder) or tbl is binder.table):
+            return None  # foreign/fetched columns use the row path
+        if e.name == "id" or e.name not in binder.col_index:
+            return None
+        idx = binder.col_index[e.name]
+        needed.add(idx)
+        return lambda cols, n: cols[idx]
+
+    if isinstance(e, ColumnBinaryOpExpression):
+        lf = _compile(e._left, binder, needed)
+        rf = _compile(e._right, binder, needed)
+        if lf is None or rf is None:
+            return None
+        op = e._op
+        return _bin_vec(op, lf, rf)
+
+    if isinstance(e, ColumnUnaryOpExpression):
+        f = _compile(e._expr, binder, needed)
+        if f is None:
+            return None
+        if e._op == "-":
+
+            def neg(cols, n):
+                v = f(cols, n)
+                if v.dtype.kind not in "if":
+                    raise VecBail
+                return -v
+
+            return neg
+        if e._op == "~":
+
+            def inv(cols, n):
+                v = f(cols, n)
+                if v.dtype.kind == "b":
+                    return ~v
+                if v.dtype.kind == "i":
+                    return ~v
+                raise VecBail
+
+            return inv
+        return None
+
+    if isinstance(e, IfElseExpression):
+        cf = _compile(e._if, binder, needed)
+        tf = _compile(e._then, binder, needed)
+        ff = _compile(e._else, binder, needed)
+        if cf is None or tf is None or ff is None:
+            return None
+
+        def where(cols, n):
+            c = cf(cols, n)
+            if c.dtype.kind != "b":
+                raise VecBail
+            return np.where(c, tf(cols, n), ff(cols, n))
+
+        return where
+
+    if isinstance(e, IsNoneExpression):
+        f = _compile(e._expr, binder, needed)
+        if f is None:
+            return None
+        # typed columns cannot hold None
+        return lambda cols, n: np.zeros(n, bool)
+
+    if isinstance(e, IsNotNoneExpression):
+        f = _compile(e._expr, binder, needed)
+        if f is None:
+            return None
+        return lambda cols, n: np.ones(n, bool)
+
+    if isinstance(e, CoalesceExpression):
+        f = _compile(e._args[0], binder, needed)
+        return f  # typed first arg is never None
+
+    if isinstance(e, UnwrapExpression):
+        return _compile(e._expr, binder, needed)
+
+    if isinstance(e, CastExpression):  # Convert (from Json) stays row-wise
+        f = _compile(e._expr, binder, needed)
+        if f is None:
+            return None
+        target = e._return_type.strip_optional()
+        if target is dt.INT:
+
+            def to_int(cols, n):
+                v = f(cols, n)
+                if v.dtype.kind not in "bif":
+                    raise VecBail
+                return v.astype(np.int64)
+
+            return to_int
+        if target is dt.FLOAT:
+
+            def to_float(cols, n):
+                v = f(cols, n)
+                if v.dtype.kind not in "bif":
+                    raise VecBail
+                return v.astype(np.float64)
+
+            return to_float
+        if target is dt.BOOL:
+
+            def to_bool(cols, n):
+                v = f(cols, n)
+                if v.dtype.kind != "b":
+                    raise VecBail
+                return v
+
+            return to_bool
+        return None
+
+    return None
+
+
+_NUMERIC = "bif"
+
+_I64_MAX = 2**63 - 1
+
+
+def _abs_bound(arr: np.ndarray) -> int:
+    """Largest |value| in an int array, computed safely in Python ints."""
+    if arr.size == 0:
+        return 0
+    return max(abs(int(arr.max())), abs(int(arr.min())))
+
+
+def _guard_int_overflow(op: str, lv: np.ndarray, rv: np.ndarray) -> None:
+    """numpy int64 wraps silently; the row path uses Python bignums — any
+    result that could exceed i64 must bail to the row interpreter."""
+    if lv.dtype.kind != "i" and rv.dtype.kind != "i":
+        return
+    m1, m2 = _abs_bound(lv), _abs_bound(rv)
+    if op in ("+", "-"):
+        if m1 + m2 > _I64_MAX:
+            raise VecBail
+    elif op == "*":
+        if m1 and m2 and m1 * m2 > _I64_MAX:
+            raise VecBail
+
+
+def _bin_vec(op: str, lf: VecFn, rf: VecFn) -> VecFn:
+    def run(cols, n):
+        lv = lf(cols, n)
+        rv = rf(cols, n)
+        lk, rk = lv.dtype.kind, rv.dtype.kind
+        if op in ("==", "!="):
+            if (lk == "U") != (rk == "U"):
+                raise VecBail  # str vs non-str: row semantics return False/True
+            res = lv == rv if op == "==" else lv != rv
+            return res
+        if op in ("<", "<=", ">", ">="):
+            if lk == "U" and rk == "U":
+                pass  # lexicographic, matches Python
+            elif lk not in _NUMERIC or rk not in _NUMERIC:
+                raise VecBail
+            if op == "<":
+                return lv < rv
+            if op == "<=":
+                return lv <= rv
+            if op == ">":
+                return lv > rv
+            return lv >= rv
+        if op in ("&", "|", "^"):
+            if lk == "b" and rk == "b":
+                return {"&": lv & rv, "|": lv | rv, "^": lv ^ rv}[op]
+            if lk == "i" and rk == "i":
+                return {"&": lv & rv, "|": lv | rv, "^": lv ^ rv}[op]
+            raise VecBail
+        if lk not in _NUMERIC or rk not in _NUMERIC:
+            raise VecBail
+        if op == "+":
+            _guard_int_overflow(op, lv, rv)
+            return lv + rv
+        if op == "-":
+            _guard_int_overflow(op, lv, rv)
+            return lv - rv
+        if op == "*":
+            _guard_int_overflow(op, lv, rv)
+            return lv * rv
+        if op == "/":
+            if np.any(rv == 0):
+                raise VecBail  # per-row path poisons exactly those rows
+            return lv / rv
+        if op == "//":
+            if np.any(rv == 0):
+                raise VecBail
+            return lv // rv
+        if op == "%":
+            if np.any(rv == 0):
+                raise VecBail
+            return lv % rv
+        if op == "**":
+            if lk in "bi" and rk in "bi":
+                raise VecBail  # Python bignum semantics
+            return lv**rv
+        raise VecBail
+
+    return run
+
+
+def materialize_columns(rows: list, needed: set[int]) -> dict[int, np.ndarray] | None:
+    """Extract the needed columns as typed 1-D arrays; None if any column is
+    not cleanly typed (None/Error/mixed/nested values).
+
+    Uniform *Python* types are required — np.asarray would silently promote
+    int/float mixes to float64 (precision loss above 2**53) and bool/int
+    mixes to int64, changing values the row path preserves exactly.
+    """
+    cols: dict[int, np.ndarray] = {}
+    for i in needed:
+        vals = [r[i] for r in rows]
+        t0 = type(vals[0])
+        if t0 not in (bool, int, float, str):
+            return None
+        if any(type(v) is not t0 for v in vals):
+            return None
+        try:
+            arr = np.asarray(vals)
+        except (ValueError, OverflowError, TypeError):
+            return None
+        if arr.ndim != 1 or arr.dtype.kind not in "bifU":
+            return None
+        if arr.dtype.kind == "i" and arr.size and int(arr.min()) == -(2**63):
+            return None  # INT64_MIN: negation / // -1 would wrap
+        cols[i] = arr
+    return cols
+
+
+_KIND_OK = {
+    dt.INT: "bi",
+    dt.FLOAT: "f",
+    dt.BOOL: "b",
+    dt.STR: "U",
+}
+
+
+def result_kind_ok(arr: np.ndarray, out_dtype) -> bool:
+    """The vector result must already be in the declared dtype's kind —
+    otherwise the per-row path's dt.coerce would alter values and we bail."""
+    base = out_dtype.strip_optional() if hasattr(out_dtype, "strip_optional") else out_dtype
+    allowed = _KIND_OK.get(base)
+    if allowed is None:
+        return True  # ANY etc. — whatever the math produced is the value
+    return arr.dtype.kind in allowed
