@@ -16,6 +16,7 @@ arrangement sharing.
 
 from __future__ import annotations
 
+import datetime as _datetime
 import itertools
 from collections import Counter
 from collections.abc import Sequence
@@ -163,10 +164,16 @@ def _sort_key(v):
         return (1, v)
     if isinstance(v, bytes):
         return (2, v)
-    if isinstance(v, Sequence) and isinstance(v, tuple):
+    if isinstance(v, _builtin_tuple):
         return (3, _builtin_tuple(_sort_key(x) for x in v))
     if isinstance(v, Pointer):
         return (4, v.value)
+    if isinstance(v, _datetime.datetime):
+        if v.tzinfo is not None:
+            return (6, 1, v.astimezone(_datetime.timezone.utc).isoformat())
+        return (6, 0, v.isoformat())
+    if isinstance(v, _datetime.timedelta):
+        return (7, v.total_seconds())
     return (5, str(type(v).__name__), repr(v))
 
 

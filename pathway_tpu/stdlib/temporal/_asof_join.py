@@ -68,6 +68,7 @@ class AsofJoinResult:
         direction: Direction = Direction.BACKWARD,
     ):
         self._left = left_table
+        self._orig_left = left_table
         self._right = right_table
         self._mode = mode
         self._defaults = {}
@@ -148,6 +149,17 @@ class AsofJoinResult:
             {id(this): self._left, id(left_ph): self._left}
         )
 
+        def fix_left(e: ColumnExpression) -> ColumnExpression:
+            if isinstance(e, ColumnReference):
+                if e.table is self._orig_left and e.table is not self._left:
+                    return ColumnReference(self._left, e.name)
+                return e
+            new = e._substitute({})
+            _rewrite_children(new, fix_left)
+            return new
+
+        lt_expr = fix_left(lt_expr)
+
         def right_col_expr(name: str) -> ColumnExpression:
             idx = r_names.index(name)
             default = defaults.get(name)
@@ -173,6 +185,10 @@ class AsofJoinResult:
                     isinstance(tbl, ThisPlaceholder) and tbl._kind == "right"
                 ):
                     return right_col_expr(e.name)
+                if tbl is self._orig_left and tbl is not self._left:
+                    # the unkeyed path wraps the left table; refs to the
+                    # user's original table must land on the wrapped one
+                    return ColumnReference(self._left, e.name)
                 return e
             new = e._substitute({})
             _rewrite_children(new, substitute_right)

@@ -75,10 +75,9 @@ class IntervalJoinResult:
             results.append(self._unmatched_side(exprs, side="left", jr_mode=jr))
         if self._mode in (JoinMode.RIGHT, JoinMode.OUTER):
             results.append(self._unmatched_side(exprs, side="right", jr_mode=jr))
-        out = results[0]
-        for r in results[1:]:
-            out = out.concat(r)
-        return out
+        # the three parts keep their source tables' row keys, which can
+        # collide across sides — reindex while concatenating
+        return results[0].concat_reindex(*results[1:])
 
     def _unmatched_side(self, exprs, side: str, jr_mode) -> Table:
         """Rows of one side with no interval match, None-padded."""

@@ -1,0 +1,839 @@
+"""Temporal stdlib round-trip tests.
+
+Model: the reference's test_windows.py / test_asof_join.py /
+test_interval_join.py / test_window_join.py round-trip pattern
+(build from markdown, run the engine, diff captured outputs).
+"""
+
+import datetime
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib import temporal
+from tests.utils import T, assert_table_equality_wo_index, rows
+
+
+# ---------------------------------------------------------------------------
+# tumbling windows
+# ---------------------------------------------------------------------------
+
+
+def test_tumbling_window_reduce():
+    t = T(
+        """
+        t  | v
+        1  | 10
+        2  | 20
+        3  | 30
+        12 | 40
+        13 | 50
+        16 | 60
+        """
+    )
+    res = t.windowby(pw.this.t, window=temporal.tumbling(duration=5)).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        cnt=pw.reducers.count(),
+        total=pw.reducers.sum(pw.this.v),
+    )
+    expected = T(
+        """
+        start | end | cnt | total
+        0     | 5   | 3   | 60
+        10    | 15  | 2   | 90
+        15    | 20  | 1   | 60
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_tumbling_window_origin():
+    t = T(
+        """
+        t
+        1
+        6
+        11
+        """
+    )
+    res = t.windowby(pw.this.t, window=temporal.tumbling(duration=10, origin=1)).reduce(
+        start=pw.this._pw_window_start,
+        cnt=pw.reducers.count(),
+    )
+    expected = T(
+        """
+        start | cnt
+        1     | 2
+        11    | 1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_tumbling_window_negative_times():
+    t = T(
+        """
+        t
+        -7
+        -3
+        -1
+        2
+        """
+    )
+    res = t.windowby(pw.this.t, window=temporal.tumbling(duration=5)).reduce(
+        start=pw.this._pw_window_start,
+        cnt=pw.reducers.count(),
+    )
+    expected = T(
+        """
+        start | cnt
+        -10   | 1
+        -5    | 2
+        0     | 1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_tumbling_window_datetime():
+    fmt = "%Y-%m-%d %H:%M"
+    data = [
+        ("2024-01-01 00:01",),
+        ("2024-01-01 00:02",),
+        ("2024-01-01 00:07",),
+    ]
+    t = pw.debug.table_from_rows(pw.schema_from_types(ts=str), data)
+    t = t.select(ts=pw.apply(lambda s: datetime.datetime.strptime(s, fmt), pw.this.ts))
+    res = t.windowby(
+        pw.this.ts, window=temporal.tumbling(duration=datetime.timedelta(minutes=5))
+    ).reduce(cnt=pw.reducers.count())
+    assert sorted(r[0] for r in rows(res)) == [1, 2]
+
+
+def test_tumbling_window_instance():
+    t = T(
+        """
+        t | who
+        1 | a
+        2 | a
+        2 | b
+        8 | b
+        """
+    )
+    res = t.windowby(
+        pw.this.t, window=temporal.tumbling(duration=5), instance=pw.this.who
+    ).reduce(
+        who=pw.this._pw_instance,
+        start=pw.this._pw_window_start,
+        cnt=pw.reducers.count(),
+    )
+    expected = T(
+        """
+        who | start | cnt
+        a   | 0     | 2
+        b   | 0     | 1
+        b   | 5     | 1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+# ---------------------------------------------------------------------------
+# sliding windows
+# ---------------------------------------------------------------------------
+
+
+def test_sliding_window_reduce():
+    t = T(
+        """
+        t
+        1
+        4
+        6
+        """
+    )
+    # hop 3, duration 6: windows [-3,3) {1}, [0,6) {1,4}, [3,9) {4,6}, [6,12) {6}
+    res = t.windowby(pw.this.t, window=temporal.sliding(hop=3, duration=6)).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        cnt=pw.reducers.count(),
+    )
+    expected = T(
+        """
+        start | end | cnt
+        -3    | 3   | 1
+        0     | 6   | 2
+        3     | 9   | 2
+        6     | 12  | 1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_sliding_window_ratio():
+    t = T("t\n0\n5")
+    res = t.windowby(pw.this.t, window=temporal.sliding(hop=5, ratio=2)).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        cnt=pw.reducers.count(),
+    )
+    expected = T(
+        """
+        start | end | cnt
+        -5    | 5   | 1
+        0     | 10  | 2
+        5     | 15  | 1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_tumbling_shift_is_sliding():
+    w = temporal.tumbling(duration=4, shift=2)
+    assert isinstance(w, temporal.Window)
+    t = T("t\n0")
+    res = t.windowby(pw.this.t, window=w).reduce(
+        start=pw.this._pw_window_start, cnt=pw.reducers.count()
+    )
+    expected = T(
+        """
+        start | cnt
+        -2    | 1
+        0     | 1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+# ---------------------------------------------------------------------------
+# session windows
+# ---------------------------------------------------------------------------
+
+
+def test_session_window_max_gap():
+    t = T(
+        """
+        t | v
+        1 | 1
+        2 | 2
+        4 | 3
+        8 | 4
+        9 | 5
+        """
+    )
+    res = t.windowby(pw.this.t, window=temporal.session(max_gap=2)).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    expected = T(
+        """
+        start | end | total
+        1     | 4   | 6
+        8     | 9   | 9
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_session_window_predicate():
+    t = T(
+        """
+        t
+        1
+        3
+        10
+        """
+    )
+    res = t.windowby(
+        pw.this.t, window=temporal.session(predicate=lambda a, b: b - a < 5)
+    ).reduce(start=pw.this._pw_window_start, cnt=pw.reducers.count())
+    expected = T(
+        """
+        start | cnt
+        1     | 2
+        10    | 1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_session_window_instance():
+    t = T(
+        """
+        t  | who
+        1  | a
+        2  | a
+        10 | a
+        1  | b
+        """
+    )
+    res = t.windowby(
+        pw.this.t, window=temporal.session(max_gap=3), instance=pw.this.who
+    ).reduce(
+        who=pw.this._pw_instance,
+        start=pw.this._pw_window_start,
+        cnt=pw.reducers.count(),
+    )
+    expected = T(
+        """
+        who | start | cnt
+        a   | 1     | 2
+        a   | 10    | 1
+        b   | 1     | 1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_session_window_requires_exactly_one_mode():
+    with pytest.raises(ValueError):
+        temporal.session()
+    with pytest.raises(ValueError):
+        temporal.session(predicate=lambda a, b: True, max_gap=1)
+
+
+def test_session_window_incremental_merge():
+    # streaming: a late row bridges two sessions; the engine must retract the
+    # two old sessions and emit the merged one
+    t = T(
+        """
+        t | _time
+        1 | 2
+        6 | 2
+        3 | 4
+        """
+    )
+    res = t.windowby(pw.this.t, window=temporal.session(max_gap=3)).reduce(
+        start=pw.this._pw_window_start,
+        end=pw.this._pw_window_end,
+        cnt=pw.reducers.count(),
+    )
+    expected = T(
+        """
+        start | end | cnt
+        1     | 6   | 3
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+# ---------------------------------------------------------------------------
+# intervals_over
+# ---------------------------------------------------------------------------
+
+
+def test_intervals_over():
+    data = T(
+        """
+        t | v
+        1 | 10
+        3 | 20
+        5 | 30
+        7 | 40
+        """
+    )
+    probes = T(
+        """
+        pt
+        3
+        7
+        """
+    )
+    res = data.windowby(
+        pw.this.t,
+        window=temporal.intervals_over(at=probes.pt, lower_bound=-2, upper_bound=0),
+    ).reduce(
+        at=pw.this._pw_window,
+        vals=pw.reducers.sorted_tuple(pw.this.v),
+    )
+    vals = {r[0]: r[1] for r in rows(res)}
+    assert vals == {3: (10, 20), 7: (30, 40)}
+
+
+# ---------------------------------------------------------------------------
+# temporal behaviors (streaming)
+# ---------------------------------------------------------------------------
+
+
+def _window_stream_deltas(behavior):
+    t = T(
+        """
+        t  | _time
+        1  | 2
+        2  | 4
+        11 | 6
+        12 | 8
+        21 | 10
+        """
+    )
+    res = t.windowby(
+        pw.this.t, window=temporal.tumbling(duration=10), behavior=behavior
+    ).reduce(
+        start=pw.this._pw_window_start,
+        cnt=pw.reducers.count(),
+    )
+    cap = pw.debug._capture_table(res)
+    return cap.deltas
+
+
+def test_exactly_once_behavior_no_retractions():
+    deltas = _window_stream_deltas(temporal.exactly_once_behavior())
+    assert all(d == 1 for (_k, _r, _t, d) in deltas), deltas
+    got = sorted(r for (_k, r, _t, d) in deltas)
+    # each window emitted exactly once, including the final flush of the
+    # still-buffered [20,30) window when the stream ends
+    assert got == [(0, 2), (10, 2), (20, 1)]
+
+
+def test_no_behavior_emits_retractions():
+    deltas = _window_stream_deltas(None)
+    # growing window [0,10): cnt=1 then retract + cnt=2
+    assert any(d == -1 for (_k, _r, _t, d) in deltas)
+    rows = sorted(r for (_k, r, _t, d) in deltas if d == 1)
+    assert (0, 1) in rows and (0, 2) in rows and (20, 1) in rows
+
+
+def test_common_behavior_cutoff_drops_late_rows():
+    t = T(
+        """
+        t  | _time
+        1  | 2
+        11 | 4
+        21 | 6
+        2  | 8
+        """
+    )
+    # cutoff 5: by the time t=2 arrives (engine time 8, max event time 21),
+    # window [0,10) closed at 10+5=15 ≤ 21 → the late row is dropped
+    res = t.windowby(
+        pw.this.t,
+        window=temporal.tumbling(duration=10),
+        behavior=temporal.common_behavior(cutoff=5),
+    ).reduce(start=pw.this._pw_window_start, cnt=pw.reducers.count())
+    final = rows(res)
+    assert (0, 1) in final, final
+    assert (0, 2) not in final, final
+
+
+# ---------------------------------------------------------------------------
+# asof joins
+# ---------------------------------------------------------------------------
+
+
+def _trades_quotes():
+    trades = T(
+        """
+        tt | ticker | qty
+        2  | AAPL   | 10
+        5  | AAPL   | 20
+        3  | MSFT   | 30
+        """
+    )
+    quotes = T(
+        """
+        qt | ticker | price
+        1  | AAPL   | 100
+        4  | AAPL   | 110
+        2  | MSFT   | 200
+        """
+    )
+    return trades, quotes
+
+
+def test_asof_join_backward():
+    trades, quotes = _trades_quotes()
+    res = trades.asof_join(
+        quotes,
+        trades.tt,
+        quotes.qt,
+        trades.ticker == quotes.ticker,
+    ).select(
+        ticker=trades.ticker,
+        qty=trades.qty,
+        price=quotes.price,
+    )
+    expected = T(
+        """
+        ticker | qty | price
+        AAPL   | 10  | 100
+        AAPL   | 20  | 110
+        MSFT   | 30  | 200
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_asof_join_forward():
+    trades, quotes = _trades_quotes()
+    res = trades.asof_join(
+        quotes,
+        trades.tt,
+        quotes.qt,
+        trades.ticker == quotes.ticker,
+        direction=temporal.Direction.FORWARD,
+    ).select(qty=trades.qty, price=quotes.price)
+    # trade@2 AAPL → quote@4; trade@5 AAPL → none (inner drops); MSFT@3 → none
+    expected = T(
+        """
+        qty | price
+        10  | 110
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_asof_join_nearest():
+    trades, quotes = _trades_quotes()
+    res = trades.asof_join(
+        quotes,
+        trades.tt,
+        quotes.qt,
+        trades.ticker == quotes.ticker,
+        direction=temporal.Direction.NEAREST,
+    ).select(qty=trades.qty, price=quotes.price)
+    expected = T(
+        """
+        qty | price
+        10  | 100
+        20  | 110
+        30  | 200
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_asof_join_left_with_defaults():
+    trades = T(
+        """
+        tt | ticker | qty
+        1  | GOOG   | 5
+        """
+    )
+    quotes = T(
+        """
+        qt | ticker | price
+        4  | GOOG   | 300
+        """
+    )
+    res = temporal.asof_join_left(
+        trades,
+        quotes,
+        trades.tt,
+        quotes.qt,
+        trades.ticker == quotes.ticker,
+        defaults={quotes.price: -1},
+    ).select(qty=trades.qty, price=quotes.price)
+    expected = T(
+        """
+        qty | price
+        5   | -1
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_asof_join_unkeyed():
+    a = T("at\n3\n10")
+    b = T(
+        """
+        bt | v
+        1  | 100
+        5  | 200
+        """
+    )
+    res = a.asof_join(b, a.at, b.bt).select(at=a.at, v=b.v)
+    expected = T(
+        """
+        at | v
+        3  | 100
+        10 | 200
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_asof_join_streaming_update():
+    # a later-arriving quote re-matches an existing trade incrementally
+    trades = T(
+        """
+        tt | qty | _time
+        5  | 10  | 2
+        """
+    )
+    quotes = T(
+        """
+        qt | price | _time
+        1  | 100   | 2
+        4  | 110   | 4
+        """
+    )
+    res = trades.asof_join(quotes, trades.tt, quotes.qt).select(
+        qty=trades.qty, price=quotes.price
+    )
+    cap = pw.debug._capture_table(res)
+    assert sorted(cap.final_rows().values()) == [(10, 110)]
+    # and the intermediate (10, 100) was emitted then retracted
+    emitted = [(r, d) for (_k, r, _t, d) in cap.deltas]
+    assert ((10, 100), 1) in emitted and ((10, 100), -1) in emitted
+
+
+# ---------------------------------------------------------------------------
+# asof_now join
+# ---------------------------------------------------------------------------
+
+
+def test_asof_now_join_no_retractions():
+    # queries join the state of `data` as of query arrival; later data changes
+    # must NOT retract answered queries
+    data = T(
+        """
+          | k | v | _time | _diff
+        A | 1 | a | 2     | 1
+        A | 1 | a | 6     | -1
+        B | 1 | b | 6     | 1
+        """
+    )
+    queries = T(
+        """
+        qk | _time
+        1  | 4
+        1  | 8
+        """
+    )
+    res = temporal.asof_now_join(queries, data, queries.qk == data.k).select(
+        qk=queries.qk, v=data.v
+    )
+    cap = pw.debug._capture_table(res)
+    # the query answered 'a' at time 4 must NOT be retracted when the data
+    # row is replaced at time 6; the later query sees the new state
+    assert all(d == 1 for (_k, _r, _t, d) in cap.deltas)
+    assert sorted(r[1] for r in cap.final_rows().values()) == ["a", "b"]
+
+
+def test_asof_now_join_left():
+    data = T(
+        """
+        k | v | _time
+        1 | a | 2
+        """
+    )
+    queries = T(
+        """
+        qk | _time
+        2  | 4
+        """
+    )
+    res = temporal.asof_now_join_left(queries, data, queries.qk == data.k).select(
+        qk=queries.qk, v=data.v
+    )
+    assert rows(res) == [(2, None)]
+
+
+# ---------------------------------------------------------------------------
+# interval joins
+# ---------------------------------------------------------------------------
+
+
+def _interval_tables():
+    a = T(
+        """
+        at | av
+        0  | a0
+        4  | a4
+        9  | a9
+        """
+    )
+    b = T(
+        """
+        bt | bv
+        1  | b1
+        5  | b5
+        20 | b20
+        """
+    )
+    return a, b
+
+
+def test_interval_join_inner():
+    a, b = _interval_tables()
+    res = a.interval_join(
+        b, a.at, b.bt, temporal.interval(-1, 2)
+    ).select(av=a.av, bv=b.bv)
+    # pairs with -1 <= bt-at <= 2: (0,1),(4,5),(9,?)→none... bt-at: 1-0=1 ok;
+    # 5-4=1 ok; 1-4=-3 no; 5-0=5 no; 20-9=11 no
+    expected = T(
+        """
+        av | bv
+        a0 | b1
+        a4 | b5
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_interval_join_left():
+    a, b = _interval_tables()
+    res = temporal.interval_join_left(
+        a, b, a.at, b.bt, temporal.interval(-1, 2)
+    ).select(av=a.av, bv=b.bv)
+    expected = T(
+        """
+        av | bv
+        a0 | b1
+        a4 | b5
+        a9 |
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_interval_join_right():
+    a, b = _interval_tables()
+    res = temporal.interval_join_right(
+        a, b, a.at, b.bt, temporal.interval(-1, 2)
+    ).select(av=a.av, bv=b.bv)
+    expected = T(
+        """
+        av | bv
+        a0 | b1
+        a4 | b5
+            | b20
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_interval_join_outer():
+    a, b = _interval_tables()
+    res = temporal.interval_join_outer(
+        a, b, a.at, b.bt, temporal.interval(-1, 2)
+    ).select(av=a.av, bv=b.bv)
+    expected = T(
+        """
+        av | bv
+        a0 | b1
+        a4 | b5
+        a9 |
+            | b20
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_interval_join_with_on_key():
+    a = T(
+        """
+        at | k | av
+        1  | x | a1
+        1  | y | a2
+        """
+    )
+    b = T(
+        """
+        bt | k | bv
+        1  | x | b1
+        1  | y | b2
+        """
+    )
+    res = a.interval_join(
+        b, a.at, b.bt, temporal.interval(0, 0), a.k == b.k
+    ).select(av=a.av, bv=b.bv)
+    expected = T(
+        """
+        av | bv
+        a1 | b1
+        a2 | b2
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_interval_join_multiple_matches():
+    a = T("at\n5")
+    b = T(
+        """
+        bt | bv
+        4  | p
+        5  | q
+        6  | r
+        """
+    )
+    res = a.interval_join(b, a.at, b.bt, temporal.interval(-1, 1)).select(
+        at=a.at, bv=b.bv
+    )
+    expected = T(
+        """
+        at | bv
+        5  | p
+        5  | q
+        5  | r
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+# ---------------------------------------------------------------------------
+# window joins
+# ---------------------------------------------------------------------------
+
+
+def test_window_join_inner():
+    a = T(
+        """
+        at | av
+        1  | a1
+        7  | a7
+        """
+    )
+    b = T(
+        """
+        bt | bv
+        2  | b2
+        4  | b4
+        13 | b13
+        """
+    )
+    res = temporal.window_join(
+        a, b, a.at, b.bt, temporal.tumbling(duration=5)
+    ).select(av=a.av, bv=b.bv)
+    # windows [0,5): a1 x {b2,b4}; [5,10): a7 x {}; [10,15): {} x b13
+    expected = T(
+        """
+        av | bv
+        a1 | b2
+        a1 | b4
+        """
+    )
+    assert_table_equality_wo_index(res, expected)
+
+
+def test_window_join_left_right_outer():
+    a = T("at | av\n1 | a1\n7 | a7")
+    b = T("bt | bv\n2 | b2\n13 | b13")
+    w = temporal.tumbling(duration=5)
+
+    left = temporal.window_join_left(a, b, a.at, b.bt, w).select(av=a.av, bv=b.bv)
+    assert_table_equality_wo_index(
+        left, T("av | bv\na1 | b2\na7 |")
+    )
+    right = temporal.window_join_right(a, b, a.at, b.bt, w).select(av=a.av, bv=b.bv)
+    assert_table_equality_wo_index(
+        right, T("av | bv\na1 | b2\n | b13")
+    )
+    outer = temporal.window_join_outer(a, b, a.at, b.bt, w).select(av=a.av, bv=b.bv)
+    assert_table_equality_wo_index(
+        outer, T("av | bv\na1 | b2\na7 |\n | b13")
+    )
+
+
+def test_window_join_sliding_duplicates_pairs():
+    # sliding windows assign each row to several windows; a pair co-resident
+    # in two windows appears twice (reference semantics)
+    a = T("at\n2")
+    b = T("bt\n3")
+    res = temporal.window_join(
+        a, b, a.at, b.bt, temporal.sliding(hop=2, duration=4)
+    ).select(at=a.at, bt=b.bt)
+    assert rows(res).count((2, 3)) == 2

@@ -30,6 +30,11 @@ def assert_table_equality_wo_index(actual: pw.Table, expected: pw.Table) -> None
     assert a == e, f"tables differ (wo index):\n actual={sorted(map(repr, a))}\n expected={sorted(map(repr, e))}"
 
 
+def rows(table: pw.Table) -> list:
+    """Run and return the final rows as a sorted list of tuples (no keys)."""
+    return sorted(_final(table).values(), key=repr)
+
+
 def assert_stream_equality(actual: pw.Table, expected_deltas: list) -> None:
     cap = _capture_table(actual)
     got = sorted((r, t, d) for (_k, r, t, d) in cap.deltas)
