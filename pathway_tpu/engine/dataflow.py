@@ -64,7 +64,12 @@ def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
     acc: Counter = Counter()
     for key, row, diff in deltas:
         acc[(key, row)] += diff
-    return [(k, r, d) for (k, r), d in acc.items() if d != 0]
+    # retractions before insertions: stateful consumers replace a row by
+    # applying (-old, +new) for the same key — the insert landing first
+    # would be popped by the retract and the row silently lost
+    out = [(k, r, d) for (k, r), d in acc.items() if d != 0]
+    out.sort(key=lambda d: d[2] > 0)
+    return out
 
 
 class EngineError(RuntimeError):
@@ -159,6 +164,10 @@ class Node:
 
     def on_finish(self) -> None:
         """All inputs exhausted; release any remaining buffered work."""
+
+    def final_check(self) -> None:
+        """After the finish-quiesce: report errors that only count if they
+        survived to end-of-stream (e.g. strict ix dangling pointers)."""
 
     def has_pending(self) -> bool:
         return any(self.pending.values())
@@ -405,8 +414,8 @@ class UpdateRowsNode(Node):
 
     def step(self, time):
         out = []
-        dl = self.take_pending(0)
-        dr = self.take_pending(1)
+        dl = consolidate(self.take_pending(0))
+        dr = consolidate(self.take_pending(1))
         for key, row, diff in dl:
             overridden = key in self._right
             if diff > 0:
@@ -457,7 +466,7 @@ class UpdateCellsNode(Node):
         touched: set[int] = set()
         before: dict[int, Row | None] = {}
         for port, store in ((0, self._left), (1, self._right)):
-            for key, row, diff in self.take_pending(port):
+            for key, row, diff in consolidate(self.take_pending(port)):
                 if key not in before:
                     before[key] = self._merged(key)
                 touched.add(key)
@@ -506,7 +515,7 @@ class IntersectNode(Node):
                 row = self._left.get(key)
                 before[key] = (row, row is not None and self._visible(key))
 
-        for key, row, diff in self.take_pending(0):
+        for key, row, diff in consolidate(self.take_pending(0)):
             snapshot(key)
             if diff > 0:
                 self._left[key] = row
@@ -550,6 +559,11 @@ class IxNode(Node):
         self._keys: dict[int, tuple[Row, Any]] = {}
         self._data: dict[int, Row] = {}
         self._by_target: dict[Any, set[int]] = defaultdict(set)
+        # key-rows whose target is currently absent: a dangling pointer is
+        # only an error if it survives to end-of-stream — mid-epoch (and
+        # mid-iteration-round) dangling is a normal transient, e.g. an
+        # argmax pointer into a groupby output that re-emits next round
+        self._unresolved: set[int] = set()
         self.key_fn = key_fn
         self.merge_fn = merge_fn
         self.optional = optional
@@ -573,15 +587,19 @@ class IxNode(Node):
             return
         data_row = self._data.get(target)
         if data_row is None:
-            if self.strict:
-                self.scope.report_row_error(self, key, f"ix: missing key {target!r}")
+            if sign > 0:
+                self._unresolved.add(key)
+            else:
+                self._unresolved.discard(key)
             return
+        if sign > 0:
+            self._unresolved.discard(key)
         out.append((key, self.merge_fn(row, data_row), sign))
 
     def step(self, time):
         out = []
-        dk = self.take_pending(0)
-        dd = self.take_pending(1)
+        dk = consolidate(self.take_pending(0))
+        dd = consolidate(self.take_pending(1))
         changed_targets = set()
         for key, row, diff in dd:
             changed_targets.add(key)
@@ -613,6 +631,16 @@ class IxNode(Node):
         if self.keep_state:
             self._update_state(out)
         self.send(out, time)
+
+    def final_check(self):
+        # runs after the finish-quiesce so rows released by other nodes'
+        # on_finish (e.g. temporal buffers) have already resolved lookups
+        if self.strict:
+            for key in sorted(self._unresolved):
+                _row, target = self._keys.get(key, (None, None))
+                self.scope.report_row_error(
+                    self, key, f"ix: missing key {target!r}"
+                )
 
 
 class JoinNode(Node):
@@ -1376,19 +1404,35 @@ class IterateNode(Node):
     name = "iterate"
 
     def __init__(self, scope, inputs: Sequence[Node], build_body, limit: int | None = None):
-        super().__init__(scope, inputs)
+        # body builds BEFORE the node registers: any outer node it lowers
+        # (scope imports) must get a lower registration id than this node —
+        # run_epoch steps nodes in registration order, so an import landing
+        # after the IterateNode would deliver its deltas one epoch late
+        subscope = Scope(parent=scope)
+        iter_inputs = [InputNode(subscope) for _ in inputs]
+        # build_body returns (result_nodes, back_pairs, import_pairs):
+        #   result_nodes: sub-scope nodes whose accumulated state is the result
+        #   back_pairs: list of (input_index, node) — node's output deltas are
+        #   fed into iter_inputs[input_index] on the next round
+        #   import_pairs: list of (outer_node, sub_input) — outer-scope tables
+        #   referenced by the body stream in per outer epoch, NOT part of the
+        #   feedback variable (the reference's import/export of collections
+        #   between scopes, dataflow.rs:4315-4724)
+        result_nodes, back_pairs, import_pairs = build_body(subscope, iter_inputs)
+
+        n_iter = len(inputs)
+        super().__init__(scope, list(inputs) + [onode for onode, _ in import_pairs])
         self.limit = limit
         # fixed-point rounds are driven locally: gather all input to one
         # worker; the nested subscope never performs exchanges
         self.exchange_gather0 = True
-        self.subscope = Scope(parent=scope)
-        # iteration inputs: one InputNode in subscope per outer input
-        self.iter_inputs = [InputNode(self.subscope) for _ in inputs]
-        # build_body returns (result_nodes, back_pairs):
-        #   result_nodes: sub-scope nodes whose accumulated state is the result
-        #   back_pairs: list of (input_index, node) — node's output deltas are
-        #   fed into iter_inputs[input_index] on the next round
-        self.result_nodes, self.back_pairs = build_body(self.subscope, self.iter_inputs)
+        self.subscope = subscope
+        self.iter_inputs = iter_inputs
+        self.result_nodes = result_nodes
+        self.back_pairs = back_pairs
+        self._import_subinputs: list[tuple[int, InputNode]] = [
+            (n_iter + i, sub_in) for i, (_onode, sub_in) in enumerate(import_pairs)
+        ]
         for rn in self.result_nodes:
             rn.require_state()
         for _, bn in self.back_pairs:
@@ -1403,16 +1447,33 @@ class IterateNode(Node):
 
     def step(self, time):
         # feed epoch deltas in
+        had_input = False
         for port, iin in enumerate(self.iter_inputs):
             deltas = self.take_pending(port)
             for key, row, diff in deltas:
+                had_input = True
                 iin.insert(key, row, 0, diff)
                 self._input_acc[port][(key, row)] += diff
+        # imported outer collections: plain per-epoch streams into the
+        # subscope, not part of the feedback variable
+        for port, sub_in in self._import_subinputs:
+            for key, row, diff in self.take_pending(port):
+                had_input = True
+                sub_in.insert(key, row, 0, diff)
+        if not had_input:
+            # nothing changed this epoch — re-running the rounds would both
+            # waste work and (with iteration_limit) advance the fixed point
+            # past the requested round budget
+            self._last_results = [[] for _ in self.result_nodes]
+            return
         rounds = 0
+        limit_hit = False
         while True:
             rounds += 1
             for iin in self.iter_inputs:
                 iin.emit_time(0)
+            for _, sub_in in self._import_subinputs:
+                sub_in.emit_time(0)
             self.subscope.run_epoch(0)
             fed_any = False
             for input_idx, bn in self.back_pairs:
@@ -1436,7 +1497,18 @@ class IterateNode(Node):
             if not fed_any:
                 break
             if self.limit is not None and rounds >= self.limit:
+                limit_hit = True
                 break
+        if limit_hit:
+            # the loop fed one round of feedback it will not run — discard it
+            # so the variable stays at f^limit(X) instead of leaking into the
+            # next epoch (or finish) and exceeding the round budget
+            for idx, iin in enumerate(self.iter_inputs):
+                acc = self._input_acc[idx]
+                for key, row, d in iin._staged.pop(0, []):
+                    acc[(key, row)] -= d
+                    if acc[(key, row)] == 0:
+                        del acc[(key, row)]
         # diff accumulated results against last sent
         out_all = []
         for i, rn in enumerate(self.result_nodes):
@@ -1459,6 +1531,19 @@ class IterateNode(Node):
         self._last_results = out_all
 
     # Table layer attaches ResultExtractNodes reading _last_results
+
+    def on_finish(self):
+        # end-of-stream propagates into the body: release its buffered work
+        # (temporal buffers etc.), re-run the fixed point, and emit any
+        # result change so the outer quiesce loop delivers it
+        for node in self.subscope.nodes:
+            if not isinstance(node, OutputNode):
+                node.on_finish()
+        self.step(self.scope.current_time)
+
+    def final_check(self):
+        for node in self.subscope.nodes:
+            node.final_check()
 
 
 class IterateResultNode(Node):
@@ -1545,6 +1630,8 @@ class Scope:
             guard += 1
             if guard > 1000:
                 raise EngineError("finish() did not quiesce")
+        for node in self.nodes:
+            node.final_check()
         for out in self.outputs:
             out.on_finish()
 

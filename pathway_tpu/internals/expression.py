@@ -563,7 +563,9 @@ class IsNoneExpression(ColumnExpression):
         return (self._expr,)
 
     def _substitute(self, mapping):
-        return IsNoneExpression(self._expr._substitute(mapping))
+        # type(self): IsNotNoneExpression inherits this — substituting must
+        # not collapse it into the base class
+        return type(self)(self._expr._substitute(mapping))
 
     def _infer_dtype(self, resolver):
         return dt.BOOL

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Any, Callable
 
 from pathway_tpu.engine import dataflow as df
+from pathway_tpu.internals import parse_graph
 from pathway_tpu.internals.table import Lowerer, Table, Universe
 
 
@@ -20,6 +21,44 @@ class _IterationProxyTable(Table):
 
     def __init__(self, schema, node_getter):
         super().__init__(schema, build=lambda lowerer: node_getter(lowerer), universe=Universe())
+
+
+class _IterSubLowerer(Lowerer):
+    """Lowerer for the iteration subscope.
+
+    Tables created by the body build in the subscope; any other table is an
+    outer-scope collection — it lowers in the OUTER scope and streams into
+    the subscope through an import InputNode (the reference's scope
+    import/export, dataflow.rs:4315-4724).
+    """
+
+    def __init__(self, subscope, outer_lowerer, marker: int, import_pairs: list):
+        super().__init__(subscope)
+        self._outer = outer_lowerer
+        self._marker = marker  # G.tables index where the body started
+        self._scan = marker
+        self._inside_ids: set[int] = set()
+        self._imports = import_pairs
+
+    def _is_inside(self, table) -> bool:
+        tables = parse_graph.G.tables
+        while self._scan < len(tables):
+            self._inside_ids.add(id(tables[self._scan]))
+            self._scan += 1
+        return id(table) in self._inside_ids
+
+    def node(self, table) -> df.Node:
+        key = id(table)
+        if key in self.memo:
+            return self.memo[key]
+        if self._is_inside(table):
+            self.memo[key] = table._build(self)
+            return self.memo[key]
+        outer_node = self._outer.node(table)
+        sub_in = df.InputNode(self.scope)
+        self._imports.append((outer_node, sub_in))
+        self.memo[key] = sub_in
+        return sub_in
 
 
 def iterate(func: Callable, iteration_limit: int | None = None, **kwargs: Table):
@@ -45,7 +84,9 @@ def iterate(func: Callable, iteration_limit: int | None = None, **kwargs: Table)
         result_order: list[str] = []
 
         def build_body(subscope: df.Scope, iter_inputs: list[df.InputNode]):
-            sub_lowerer = Lowerer(subscope)
+            import_pairs: list = []
+            marker = len(parse_graph.G.tables)
+            sub_lowerer = _IterSubLowerer(subscope, lowerer, marker, import_pairs)
             proxies = {}
             for name, table, iin in zip(input_names, input_tables, iter_inputs):
                 proxy = _IterationProxyTable(table.schema, lambda lw, _iin=iin: _iin)
@@ -69,7 +110,7 @@ def iterate(func: Callable, iteration_limit: int | None = None, **kwargs: Table)
             for n in input_names:
                 if n in returned:
                     back_pairs.append((input_names.index(n), sub_lowerer.node(returned[n])))
-            return result_nodes, back_pairs
+            return result_nodes, back_pairs, import_pairs
 
         node = df.IterateNode(
             lowerer.scope, outer_nodes, build_body, limit=iteration_limit

@@ -30,8 +30,12 @@ def bellman_ford(vertices: Table, edges: Table, iteration_limit: int | None = No
             v=this.v, cand=reducers.min(this.cand)
         )
         keyed_best = best.with_id(ColumnReference(this, "v"))
+        # id=left.id keeps the state keyed by vertex id across rounds — the
+        # next round's edges⋈state lookup depends on it
         new_state = state.join_left(
-            keyed_best, ColumnReference(lp, "id") == ColumnReference(rp, "id")
+            keyed_best,
+            ColumnReference(lp, "id") == ColumnReference(rp, "id"),
+            id=ColumnReference(lp, "id"),
         ).select(
             dist=expr_mod.apply_with_type(
                 lambda d, c: d if c is None else min(d, c),

@@ -28,25 +28,56 @@ def louvain_level(edges: Table, iteration_limit: int = 10) -> Table:
     initial = vertices.select(v=this.v, community=this.v)
 
     def step(assign: Table) -> dict:
-        keyed = assign.with_id(ColumnReference(this, "v"))
+        # join on column values, not row ids — v labels are arbitrary values
+        # (strings/ints), so rekeying the assignment via with_id would break
         neigh = both_dirs.join(
-            keyed, ColumnReference(lp, "v") == ColumnReference(rp, "v")
+            assign, ColumnReference(lp, "v") == ColumnReference(rp, "v")
         ).select(u=ColumnReference(lp, "u"), community=ColumnReference(rp, "community"))
         votes = neigh.groupby(this.u, this.community).reduce(
             u=this.u, community=this.community, n=reducers.count()
         )
-        best = votes.groupby(this.u).reduce(
-            u=this.u,
-            best=reducers.argmax(this.n),
+        # deterministic preference: plurality, then the vertex's current
+        # community (stops synchronous-update oscillation), then min label
+        flagged = votes.join(
+            assign, ColumnReference(lp, "u") == ColumnReference(rp, "v")
+        ).select(
+            u=ColumnReference(lp, "u"),
+            community=ColumnReference(lp, "community"),
+            score=expr_mod.make_tuple(
+                ColumnReference(lp, "n"),
+                expr_mod.if_else(
+                    expr_mod.ColumnBinaryOpExpression(
+                        "==",
+                        ColumnReference(lp, "community"),
+                        ColumnReference(rp, "community"),
+                    ),
+                    1,
+                    0,
+                ),
+            ),
         )
-        chosen = best.select(
-            u=this.u,
-            community=votes.ix(this.best).community,
+        top = flagged.groupby(this.u).reduce(
+            u=this.u, s=reducers.max(this.score)
         )
-        keyed_chosen = chosen.with_id(ColumnReference(this, "u"))
+        tied = flagged.join(
+            top, ColumnReference(lp, "u") == ColumnReference(rp, "u")
+        ).select(
+            u=ColumnReference(lp, "u"),
+            community=ColumnReference(lp, "community"),
+            ok=expr_mod.ColumnBinaryOpExpression(
+                "==", ColumnReference(lp, "score"), ColumnReference(rp, "s")
+            ),
+        )
+        chosen = (
+            tied.filter(ColumnReference(this, "ok"))
+            .groupby(this.u)
+            .reduce(u=this.u, community=reducers.min(this.community))
+        )
+        # id=left.id keeps assignment rows keyed stably across rounds
         new_assign = assign.join_left(
-            keyed_chosen,
-            ColumnReference(lp, "v") == ColumnReference(rp, "id"),
+            chosen,
+            ColumnReference(lp, "v") == ColumnReference(rp, "u"),
+            id=ColumnReference(lp, "id"),
         ).select(
             v=ColumnReference(lp, "v"),
             community=expr_mod.coalesce(

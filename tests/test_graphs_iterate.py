@@ -1,0 +1,254 @@
+"""Graph algorithms + pw.iterate fixed points.
+
+Model: reference stdlib tests for pagerank/bellman_ford and the iterate
+cases in test_common.py.
+"""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib import graphs
+from tests.utils import T, assert_table_equality_wo_index, rows
+
+
+# ---------------------------------------------------------------------------
+# pw.iterate
+# ---------------------------------------------------------------------------
+
+
+def test_iterate_collatz_reaches_one():
+    t = T(
+        """
+        n
+        6
+        7
+        27
+        1
+        """
+    )
+
+    def step(tab):
+        def next_n(n):
+            if n == 1:
+                return 1
+            return n // 2 if n % 2 == 0 else 3 * n + 1
+
+        return dict(tab=tab.select(n=pw.apply_with_type(next_n, int, pw.this.n)))
+
+    res = pw.iterate(lambda tab: step(tab), tab=t)
+    assert rows(res) == [(1,), (1,), (1,), (1,)]
+
+
+def test_iterate_respects_limit():
+    t = T("n\n0")
+    res = pw.iterate(
+        lambda tab: dict(tab=tab.select(n=pw.this.n + 1)),
+        iteration_limit=3,
+        tab=t,
+    )
+    assert rows(res) == [(3,)]
+
+
+def test_iterate_transitive_closure():
+    # reachability from A via iterated relational join
+    edges = T(
+        """
+        u | v
+        A | B
+        B | C
+        C | D
+        X | Y
+        """
+    )
+    reach = T("v\nA")
+
+    def step(reach):
+        new = edges.join(reach, pw.left.u == pw.right.v).select(v=pw.left.v)
+        merged = (
+            reach.concat_reindex(new)
+            .groupby(pw.this.v)
+            .reduce(v=pw.this.v)
+        )
+        return dict(reach=merged)
+
+    res = pw.iterate(lambda reach: step(reach), reach=reach)
+    assert sorted(r[0] for r in rows(res)) == ["A", "B", "C", "D"]
+
+
+def test_iterate_incremental_update():
+    # a streamed extra edge extends the fixed point incrementally
+    edges = T(
+        """
+        u | v | _time
+        A | B | 2
+        B | C | 4
+        """
+    )
+    reach = T("v\nA")
+
+    def step(reach):
+        new = edges.join(reach, pw.left.u == pw.right.v).select(v=pw.left.v)
+        merged = reach.concat_reindex(new).groupby(pw.this.v).reduce(v=pw.this.v)
+        return dict(reach=merged)
+
+    res = pw.iterate(lambda reach: step(reach), reach=reach)
+    assert sorted(r[0] for r in rows(res)) == ["A", "B", "C"]
+
+
+# ---------------------------------------------------------------------------
+# pagerank
+# ---------------------------------------------------------------------------
+
+
+def test_pagerank_cycle_is_uniform():
+    edges = T(
+        """
+        u | v
+        A | B
+        B | C
+        C | A
+        """
+    )
+    res = graphs.pagerank(edges, steps=20)
+    got = rows(res)
+    ranks = [r[1] for r in got]
+    # symmetric cycle: equal ranks (integer arithmetic leaks a little mass
+    # through floor division, so the fixed point sits slightly under 100)
+    assert len(set(ranks)) == 1, got
+    assert 50 <= ranks[0] <= 100, got
+
+
+def test_pagerank_sink_concentrates_rank():
+    edges = T(
+        """
+        u | v
+        A | C
+        B | C
+        C | A
+        """
+    )
+    res = graphs.pagerank(edges, steps=15)
+    by_v = {r[0]: r[1] for r in rows(res)}
+    assert by_v["C"] > by_v["B"]
+    assert by_v["A"] > by_v["B"]
+
+
+def test_pagerank_incremental_edge_addition():
+    edges = T(
+        """
+        u | v | _time
+        A | B | 2
+        B | A | 2
+        C | B | 4
+        """
+    )
+    res = graphs.pagerank(edges, steps=50)
+    by_v = {r[0]: r[1] for r in rows(res)}
+    # after C→B arrives, B outranks A
+    assert by_v["B"] > by_v["A"]
+    assert by_v["C"] < by_v["A"]
+    # and the incremental result matches a from-scratch run of the final graph
+    static = graphs.pagerank(T("u | v\nA | B\nB | A\nC | B"), steps=50)
+    assert sorted(rows(res)) == sorted(rows(static))
+
+
+# ---------------------------------------------------------------------------
+# bellman-ford
+# ---------------------------------------------------------------------------
+
+
+def _bf_fixture():
+    vertices = T(
+        """
+          | is_source
+        A | True
+        B | False
+        C | False
+        D | False
+        """
+    )
+    labeled = T(
+        """
+        lu | lv | dist
+        A  | B  | 1.0
+        B  | C  | 2.0
+        A  | C  | 10.0
+        """
+    )
+    edges = labeled.select(
+        u=vertices.pointer_from(pw.this.lu),
+        v=vertices.pointer_from(pw.this.lv),
+        dist=pw.this.dist,
+    )
+    return vertices, edges
+
+
+def test_bellman_ford():
+    vertices, edges = _bf_fixture()
+    res = graphs.bellman_ford(vertices, edges, iteration_limit=10)
+    dists = sorted(r[0] for r in rows(res))
+    # A=0, B=1, C=3 (via B), D unreachable (inf)
+    assert dists[:3] == [0.0, 1.0, 3.0]
+    assert dists[3] == float("inf")
+
+
+# ---------------------------------------------------------------------------
+# louvain (one level)
+# ---------------------------------------------------------------------------
+
+
+def test_strict_ix_error_surfaces_inside_iterate():
+    # a dangling pointer that SURVIVES to the fixed point must still error
+    # (transient mid-round danglers are fine — see louvain)
+    data = T("v\n1")
+    keys = data.select(tgt=pw.apply_with_type(lambda v: pw.Pointer(12345), pw.Pointer, pw.this.v))
+
+    def step(tab):
+        looked = keys.select(got=data.ix(keys.tgt).v)
+        return dict(tab=tab, probe=looked)
+
+    import pathway_tpu.engine.dataflow as df
+
+    with pytest.raises(df.EngineError, match="ix: missing key"):
+        rows(pw.iterate(lambda tab: step(tab), iteration_limit=2, tab=data).probe)
+
+
+def test_iterate_import_registers_before_node():
+    # imports lowered during body build must step BEFORE the IterateNode in
+    # each epoch, so per-epoch results are consistent with that epoch's input
+    edges = T("u | v\nA | B")
+    reach = T("v\nA")
+
+    def step(reach):
+        new = edges.join(reach, pw.left.u == pw.right.v).select(v=pw.left.v)
+        merged = reach.concat_reindex(new).groupby(pw.this.v).reduce(v=pw.this.v)
+        return dict(reach=merged)
+
+    res = pw.iterate(lambda reach: step(reach), reach=reach)
+    cap = pw.debug._capture_table(res)
+    # both rows must land in the FIRST epoch (time 0), not trickle in later
+    assert sorted((r, t) for (_k, r, t, _d) in cap.deltas) == [
+        (("A",), 0),
+        (("B",), 0),
+    ]
+
+
+def test_louvain_level_two_cliques():
+    edges = T(
+        """
+        u | v
+        a1 | a2
+        a2 | a3
+        a1 | a3
+        b1 | b2
+        b2 | b3
+        b1 | b3
+        a1 | b1
+        """
+    )
+    res = graphs.louvain_level(edges, iteration_limit=10)
+    comm = {r[0]: r[1] for r in rows(res)}
+    assert comm["a2"] == comm["a3"]
+    assert comm["b2"] == comm["b3"]
+    # the two triangles do not merge into one community
+    assert comm["a2"] != comm["b2"]

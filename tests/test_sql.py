@@ -1,0 +1,219 @@
+"""pw.sql compiler tests (parity: reference internals/sql.py docs)."""
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, assert_table_equality_wo_index, rows
+
+
+def _tab():
+    return T(
+        """
+        a | b | grp
+        1 | 10 | x
+        2 | 20 | x
+        3 | 30 | y
+        4 | 40 | y
+        """
+    )
+
+
+def test_sql_select_projection():
+    t = _tab()
+    res = pw.sql("SELECT a, b FROM tab", tab=t)
+    assert sorted(rows(res)) == [(1, 10), (2, 20), (3, 30), (4, 40)]
+
+
+def test_sql_select_expression_alias():
+    t = _tab()
+    res = pw.sql("SELECT a + b AS s FROM tab", tab=t)
+    assert sorted(r[0] for r in rows(res)) == [11, 22, 33, 44]
+
+
+def test_sql_where():
+    t = _tab()
+    res = pw.sql("SELECT a FROM tab WHERE b > 20", tab=t)
+    assert sorted(r[0] for r in rows(res)) == [3, 4]
+
+
+def test_sql_where_and_or():
+    t = _tab()
+    res = pw.sql("SELECT a FROM tab WHERE a > 1 AND b < 40", tab=t)
+    assert sorted(r[0] for r in rows(res)) == [2, 3]
+    res2 = pw.sql("SELECT a FROM tab WHERE a = 1 OR a = 4", tab=t)
+    assert sorted(r[0] for r in rows(res2)) == [1, 4]
+
+
+def test_sql_group_by():
+    t = _tab()
+    res = pw.sql(
+        "SELECT grp, COUNT(*) AS c, SUM(b) AS s FROM tab GROUP BY grp", tab=t
+    )
+    assert sorted(rows(res)) == [("x", 2, 30), ("y", 2, 70)]
+
+
+def test_sql_group_by_having():
+    t = _tab()
+    res = pw.sql(
+        "SELECT grp, SUM(a) AS s FROM tab GROUP BY grp HAVING SUM(a) > 3", tab=t
+    )
+    assert rows(res) == [("y", 7)]
+
+
+def test_sql_union_all():
+    t1 = T("a\n1")
+    t2 = T("a\n2")
+    res = pw.sql("SELECT a FROM t1 UNION ALL SELECT a FROM t2", t1=t1, t2=t2)
+    assert sorted(r[0] for r in rows(res)) == [1, 2]
+
+
+def test_sql_avg_min_max():
+    t = _tab()
+    res = pw.sql(
+        "SELECT grp, AVG(b) AS m, MIN(a) AS lo, MAX(a) AS hi FROM tab GROUP BY grp",
+        tab=t,
+    )
+    assert sorted(rows(res)) == [("x", 15.0, 1, 2), ("y", 35.0, 3, 4)]
+
+
+def test_sql_select_star():
+    t = T("a | b\n1 | 2")
+    res = pw.sql("SELECT * FROM t", t=t)
+    assert rows(res) == [(1, 2)]
+
+
+def test_sql_inner_join():
+    orders = T(
+        """
+        oid | cust | amount
+        1   | a    | 10
+        2   | b    | 20
+        3   | zz   | 30
+        """
+    )
+    customers = T(
+        """
+        cname | city
+        a     | rome
+        b     | oslo
+        """
+    )
+    res = pw.sql(
+        "SELECT o.oid, c.city FROM orders o JOIN customers c ON o.cust = c.cname",
+        orders=orders,
+        customers=customers,
+    )
+    assert sorted(rows(res)) == [(1, "rome"), (2, "oslo")]
+
+
+def test_sql_left_join_pads_null():
+    a = T("k | v\n1 | x\n2 | y")
+    b = T("k2 | w\n1 | z")
+    res = pw.sql(
+        "SELECT a.v, b.w FROM a LEFT JOIN b ON a.k = b.k2", a=a, b=b
+    )
+    assert sorted(rows(res), key=repr) == [("x", "z"), ("y", None)]
+
+
+def test_sql_join_with_residual_condition():
+    a = T("k | v\n1 | 5\n1 | 50")
+    b = T("k2 | lim\n1 | 10")
+    res = pw.sql(
+        "SELECT a.v FROM a JOIN b ON a.k = b.k2 AND a.v < b.lim", a=a, b=b
+    )
+    assert rows(res) == [(5,)]
+
+
+def test_sql_cross_join():
+    a = T("x\n1\n2")
+    b = T("y\n10")
+    res = pw.sql("SELECT a.x, b.y FROM a, b", a=a, b=b)
+    assert sorted(rows(res)) == [(1, 10), (2, 10)]
+
+
+def test_sql_three_way_join():
+    a = T("ka | va\n1 | p")
+    b = T("kb | vb\n1 | q")
+    c = T("kc | vc\n1 | r")
+    res = pw.sql(
+        "SELECT a.va, b.vb, c.vc FROM a JOIN b ON a.ka = b.kb JOIN c ON b.kb = c.kc",
+        a=a, b=b, c=c,
+    )
+    assert rows(res) == [("p", "q", "r")]
+
+
+def test_sql_subquery_in_from():
+    t = _tab()
+    res = pw.sql(
+        "SELECT grp, s FROM (SELECT grp, SUM(a) AS s FROM tab GROUP BY grp) sub "
+        "WHERE s > 3",
+        tab=t,
+    )
+    assert rows(res) == [("y", 7)]
+
+
+def test_sql_distinct():
+    t = T("v\n1\n1\n2")
+    res = pw.sql("SELECT DISTINCT v FROM t", t=t)
+    assert sorted(r[0] for r in rows(res)) == [1, 2]
+
+
+def test_sql_union_dedups():
+    t1 = T("a\n1\n2")
+    t2 = T("a\n2\n3")
+    res = pw.sql("SELECT a FROM t1 UNION SELECT a FROM t2", t1=t1, t2=t2)
+    assert sorted(r[0] for r in rows(res)) == [1, 2, 3]
+
+
+def test_sql_between_and_in():
+    t = _tab()
+    res = pw.sql("SELECT a FROM tab WHERE a BETWEEN 2 AND 3", tab=t)
+    assert sorted(r[0] for r in rows(res)) == [2, 3]
+    res2 = pw.sql("SELECT a FROM tab WHERE grp IN ('y')", tab=t)
+    assert sorted(r[0] for r in rows(res2)) == [3, 4]
+
+
+def test_sql_is_null():
+    t = T("a | b\n1 | x\n2 |")
+    res = pw.sql("SELECT a FROM t WHERE b IS NULL", t=t)
+    assert rows(res) == [(2,)]
+    res2 = pw.sql("SELECT a FROM t WHERE b IS NOT NULL", t=t)
+    assert rows(res2) == [(1,)]
+
+
+def test_sql_count_column_and_aliasless_agg():
+    t = T("a | b\n1 | 2\n3 |")
+    res = pw.sql("SELECT COUNT(*) AS n, SUM(a) AS s FROM t", t=t)
+    assert rows(res) == [(2, 4)]
+
+
+def test_sql_string_literal_quotes():
+    t = T("name | v\nann's | 1\nbob | 2")
+    res = pw.sql("SELECT v FROM t WHERE name = 'ann''s'", t=t)
+    assert rows(res) == [(1,)]
+
+
+def test_sql_error_on_unknown_column():
+    t = T("a\n1")
+    with pytest.raises(Exception):
+        pw.sql("SELECT nope FROM t", t=t)
+
+
+def test_sql_mangle_no_alias_collision():
+    # (a, b_c) and (a_b, c) must not collide in the internal column mangling
+    t1 = T("k | b_c\n1 | 100")
+    t2 = T("k | c\n1 | 999")
+    res = pw.sql(
+        "SELECT a.b_c AS x, a_b.c AS y FROM t1 AS a JOIN t2 AS a_b ON a.k = a_b.k",
+        t1=t1,
+        t2=t2,
+    )
+    assert rows(res) == [(100, 999)]
+
+
+def test_sql_duplicate_output_name_errors():
+    t = T("a | b\n1 | 2")
+    from pathway_tpu.internals.sql import SqlError
+
+    with pytest.raises(SqlError):
+        pw.sql("SELECT SUM(a), SUM(b) FROM t", t=t)

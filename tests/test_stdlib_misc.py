@@ -1,0 +1,295 @@
+"""stdlib misc: deduplicate, interpolate, ordered.diff, utils, demo, debug.
+
+Model: the reference stdlib test files (test_deduplicate.py,
+test_interpolate.py, utils tests) using the round-trip pattern.
+"""
+
+import asyncio
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib import ordered, stateful, statistical, utils
+from pathway_tpu.stdlib.utils.col import flatten_column, unpack_col
+from pathway_tpu.stdlib.utils.filtering import argmax_rows, argmin_rows
+from tests.utils import T, assert_table_equality_wo_index, rows
+
+
+# ---------------------------------------------------------------------------
+# stateful.deduplicate
+# ---------------------------------------------------------------------------
+
+
+def test_deduplicate_keeps_latest_accepted():
+    t = T(
+        """
+        v | _time
+        1 | 2
+        3 | 4
+        2 | 6
+        5 | 8
+        """
+    )
+    # accept only increasing values
+    res = stateful.deduplicate(t, value=pw.this.v, acceptor=lambda new, old: new > old)
+    assert rows(res) == [(5,)]
+
+
+def test_deduplicate_stream_has_single_live_row():
+    t = T(
+        """
+        v | _time
+        1 | 2
+        2 | 4
+        """
+    )
+    res = t.deduplicate(value=pw.this.v)
+    cap = pw.debug._capture_table(res)
+    # change stream: +1, then -1/+2
+    assert [(r, d) for (_k, r, _t2, d) in cap.deltas] == [
+        ((1,), 1),
+        ((1,), -1),
+        ((2,), 1),
+    ]
+
+
+def test_deduplicate_per_instance():
+    t = T(
+        """
+        k | v | _time
+        a | 1 | 2
+        b | 9 | 2
+        a | 5 | 4
+        """
+    )
+    res = t.deduplicate(value=pw.this.v, instance=pw.this.k)
+    assert sorted(rows(res)) == [("a", 5), ("b", 9)]
+
+
+# ---------------------------------------------------------------------------
+# statistical.interpolate
+# ---------------------------------------------------------------------------
+
+
+def test_interpolate_linear():
+    t = T(
+        """
+        t  | v
+        0  | 0.0
+        2  |
+        4  | 4.0
+        """
+    )
+    res = statistical.interpolate(t, pw.this.t, pw.this.v)
+    got = {r[0]: r[1] for r in rows(res)}
+    assert got == {0: 0.0, 2: 2.0, 4: 4.0}
+
+
+def test_interpolate_edges_clamp():
+    t = T(
+        """
+        t | v
+        0 |
+        1 | 5.0
+        2 |
+        """
+    )
+    res = statistical.interpolate(t, pw.this.t, pw.this.v)
+    got = {r[0]: r[1] for r in rows(res)}
+    assert got == {0: 5.0, 1: 5.0, 2: 5.0}
+
+
+# ---------------------------------------------------------------------------
+# ordered.diff
+# ---------------------------------------------------------------------------
+
+
+def test_ordered_diff():
+    t = T(
+        """
+        t | v
+        1 | 10
+        2 | 13
+        4 | 11
+        """
+    )
+    res = t.diff(pw.this.t, pw.this.v)
+    got = {r[0]: r[2] for r in rows(res)}
+    assert got == {1: None, 2: 3, 4: -2}
+
+
+def test_ordered_diff_instance():
+    t = T(
+        """
+        t | k | v
+        1 | a | 10
+        2 | a | 30
+        1 | b | 5
+        2 | b | 6
+        """
+    )
+    res = t.diff(pw.this.t, pw.this.v, instance=pw.this.k)
+    got = {(r[1], r[0]): r[3] for r in rows(res)}
+    assert got == {("a", 1): None, ("a", 2): 20, ("b", 1): None, ("b", 2): 1}
+
+
+# ---------------------------------------------------------------------------
+# utils.col / utils.filtering
+# ---------------------------------------------------------------------------
+
+
+def test_argmax_argmin_rows():
+    t = T(
+        """
+        k | v
+        a | 3
+        a | 7
+        b | 2
+        b | 1
+        """
+    )
+    mx = argmax_rows(t, pw.this.k, what=pw.this.v)
+    assert sorted(rows(mx)) == [("a", 7), ("b", 2)]
+    mn = argmin_rows(t, pw.this.k, what=pw.this.v)
+    assert sorted(rows(mn)) == [("a", 3), ("b", 1)]
+
+
+def test_unpack_col():
+    t = T("a | b\n1 | x\n2 | y")
+    packed = t.select(data=pw.make_tuple(pw.this.a, pw.this.b))
+    unpacked = unpack_col(packed.data, "num", "name")
+    assert sorted(rows(unpacked)) == [(1, "x"), (2, "y")]
+
+
+def test_flatten_column():
+    t = T("k\na")
+    packed = t.select(k=pw.this.k, vals=pw.apply(lambda _: (1, 2, 3), pw.this.k))
+    flat = flatten_column(packed.vals)
+    idx = flat.column_names().index("vals")
+    assert sorted(r[idx] for r in rows(flat)) == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# AsyncTransformer
+# ---------------------------------------------------------------------------
+
+
+def test_async_transformer():
+    class Doubler(pw.AsyncTransformer):
+        output_schema = pw.schema_from_types(doubled=int)
+
+        async def invoke(self, v) -> dict:
+            await asyncio.sleep(0.001)
+            return dict(doubled=2 * v)
+
+    t = T("v\n1\n2\n3")
+    res = Doubler(t).successful
+    assert sorted(r[0] for r in rows(res)) == [2, 4, 6]
+
+
+def test_async_transformer_streaming_decoupled():
+    class Echo(pw.AsyncTransformer):
+        output_schema = pw.schema_from_types(out=int)
+
+        async def invoke(self, v) -> dict:
+            return dict(out=v)
+
+    t = T(
+        """
+        v | _time
+        1 | 2
+        2 | 4
+        """
+    )
+    res = Echo(t).successful
+    cap = pw.debug._capture_table(res)
+    assert sorted(r[0] for r in cap.final_rows().values()) == [1, 2]
+    # results only ever appear with +1 diffs (new stream, no retractions)
+    assert all(d == 1 for (_k, _r, _t2, d) in cap.deltas)
+
+
+# ---------------------------------------------------------------------------
+# pandas_transformer
+# ---------------------------------------------------------------------------
+
+
+def test_pandas_transformer():
+    import pandas as pd
+
+    @pw.pandas_transformer(output_schema=pw.schema_from_types(s=int))
+    def total(df: pd.DataFrame) -> pd.DataFrame:
+        return pd.DataFrame({"s": [int(df["v"].sum())]})
+
+    t = T("v\n1\n2\n3")
+    res = total(t)
+    assert rows(res) == [(6,)]
+
+
+# ---------------------------------------------------------------------------
+# demo generators & debug round-trips
+# ---------------------------------------------------------------------------
+
+
+def test_demo_range_stream():
+    t = pw.demo.range_stream(nb_rows=5, input_rate=1e6)
+    got = sorted(r[0] for r in rows(t))
+    assert got == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+
+def test_demo_generate_custom_stream():
+    t = pw.demo.generate_custom_stream(
+        {"n": lambda i: i, "sq": lambda i: i * i},
+        schema=pw.schema_from_types(n=int, sq=int),
+        nb_rows=4,
+        input_rate=1e6,
+    )
+    assert sorted(rows(t)) == [(0, 0), (1, 1), (2, 4), (3, 9)]
+
+
+def test_demo_noisy_linear_stream():
+    t = pw.demo.noisy_linear_stream(nb_rows=5, input_rate=1e6)
+    got = rows(t)
+    assert len(got) == 5
+    assert all(isinstance(x, float) and isinstance(y, float) for (x, y) in got)
+
+
+def test_demo_replay_csv(tmp_path):
+    p = tmp_path / "in.csv"
+    p.write_text("a,b\n1,x\n2,y\n")
+    t = pw.demo.replay_csv(
+        str(p), schema=pw.schema_from_types(a=int, b=str), input_rate=1e6
+    )
+    assert sorted(rows(t)) == [(1, "x"), (2, "y")]
+
+
+def test_stream_generator_batches():
+    sg = pw.debug.StreamGenerator()
+    t = sg.table_from_list_of_batches(
+        [[{"v": 1}], [{"v": 2}, {"v": 3}]], pw.schema_from_types(v=int)
+    )
+    cap = pw.debug._capture_table(t)
+    times = sorted({t2 for (_k, _r, t2, _d) in cap.deltas})
+    assert len(times) == 2  # two distinct epochs
+    assert sorted(r[0] for r in cap.final_rows().values()) == [1, 2, 3]
+
+
+def test_compute_and_print_smoke(capsys):
+    t = T("a\n1")
+    pw.debug.compute_and_print(t, include_id=False)
+    out = capsys.readouterr().out
+    assert "a" in out and "1" in out
+
+
+def test_table_from_pandas_roundtrip():
+    import pandas as pd
+
+    df = pd.DataFrame({"x": [1, 2], "y": ["a", "b"]})
+    t = pw.debug.table_from_pandas(df)
+    back = pw.debug.table_to_pandas(t, include_id=False)
+    assert sorted(back["x"].tolist()) == [1, 2]
+    assert sorted(back["y"].tolist()) == ["a", "b"]
+
+
+def test_table_from_rows():
+    t = pw.debug.table_from_rows(pw.schema_from_types(a=int), [(1,), (2,)])
+    assert sorted(r[0] for r in rows(t)) == [1, 2]
