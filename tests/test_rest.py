@@ -1,0 +1,106 @@
+"""rest_connector round-trip over real HTTP.
+
+Model: reference integration_tests/webserver — serve a pipeline with
+rest_connector, POST queries, assert computed responses.
+"""
+
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
+
+SERVER_SCRIPT = """
+import sys
+import pathway_tpu as pw
+
+port = int(sys.argv[1])
+
+class QuerySchema(pw.Schema):
+    a: int
+    b: int
+
+queries, respond = pw.io.http.rest_connector(
+    host="127.0.0.1", port=port, schema=QuerySchema, delete_completed_queries=True
+)
+results = queries.select(result=pw.this.a + pw.this.b)
+respond(results)
+pw.run()
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(port: int, payload: dict, timeout: float = 5.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture()
+def rest_server(tmp_path):
+    port = _free_port()
+    script = tmp_path / "serve.py"
+    script.write_text(SERVER_SCRIPT)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(port)],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        env=env,
+    )
+    # wait until the server answers (first query also warms the pipeline)
+    deadline = time.monotonic() + 20
+    last_err = None
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"server died: {proc.stderr.read().decode(errors='replace')}"
+            )
+        try:
+            _post(port, {"a": 1, "b": 1}, timeout=2)
+            break
+        except (urllib.error.URLError, ConnectionError, TimeoutError) as e:
+            last_err = e
+            time.sleep(0.2)
+    else:
+        proc.kill()
+        raise RuntimeError(f"server never became ready: {last_err}")
+    yield port
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def test_rest_connector_roundtrip(rest_server):
+    port = rest_server
+    assert _post(port, {"a": 2, "b": 40}) == 42
+    assert _post(port, {"a": -1, "b": 1}) == 0
+
+
+def test_rest_connector_concurrent_queries(rest_server):
+    port = rest_server
+    import concurrent.futures
+
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+        futs = [ex.submit(_post, port, {"a": i, "b": i}) for i in range(8)]
+        got = sorted(f.result() for f in futs)
+    assert got == [2 * i for i in range(8)]
