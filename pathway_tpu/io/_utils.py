@@ -366,6 +366,28 @@ def worker_part_path(filename: str) -> str:
     return filename
 
 
+def plain_value(v: Any, *, bytes_as: str = "text") -> Any:
+    """Engine value → JSON-able plain value for sink formatters.
+
+    ``bytes_as``: "text" decodes utf-8 (lossy), "base64" encodes.
+    """
+    import base64
+
+    from pathway_tpu.engine.types import Pointer
+
+    if isinstance(v, Json):
+        return v.value
+    if isinstance(v, bytes):
+        if bytes_as == "base64":
+            return base64.b64encode(v).decode()
+        return v.decode("utf-8", errors="replace")
+    if isinstance(v, Pointer):
+        return str(v)
+    if isinstance(v, tuple):
+        return [plain_value(x, bytes_as=bytes_as) for x in v]
+    return v
+
+
 def register_output(
     table: Table,
     on_data: Callable[[int, tuple, int, int], None],

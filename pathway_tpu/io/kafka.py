@@ -334,10 +334,4 @@ def write(
 
 
 def _plain(v):
-    if isinstance(v, Json):
-        return v.value
-    if isinstance(v, bytes):
-        return v.decode("utf-8", errors="replace")
-    if isinstance(v, tuple):
-        return [_plain(x) for x in v]
-    return v
+    return _utils.plain_value(v)

@@ -1,11 +1,88 @@
-"""Logstash HTTP sink connector (parity: python/pathway/io/logstash).
+"""Logstash sink connector (parity: python/pathway/io/logstash).
 
-The engine-side binding is gated on the optional ``aiohttp`` client package,
-which is not part of this environment; the API surface matches the
-reference so pipelines import and typecheck unchanged.
+Posts change-stream rows as JSON to a Logstash HTTP input plugin over
+``http.client`` (the reference posts via its generic HTTP sink).  Rows
+carry ``time``/``diff`` like the reference's formatter output.
 """
 
-from pathway_tpu.io._gated import gated_reader, gated_writer
+from __future__ import annotations
 
-read = gated_reader("logstash", "aiohttp")
-write = gated_writer("logstash", "aiohttp")
+import http.client
+import json as _json
+import threading
+import urllib.parse
+from typing import Any
+
+from pathway_tpu.engine.types import Json, Pointer
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import _utils
+
+__all__ = ["write"]
+
+
+def _plain(v: Any):
+    return _utils.plain_value(v)
+
+
+class _HttpSink:
+    def __init__(self, endpoint: str, headers: dict[str, str] | None):
+        parsed = urllib.parse.urlparse(
+            endpoint if "//" in endpoint else "http://" + endpoint
+        )
+        self.secure = parsed.scheme == "https"
+        self.netloc = parsed.netloc
+        self.path = parsed.path or "/"
+        self.headers = {"Content-Type": "application/json", **(headers or {})}
+        self._rows: list[dict] = []
+        self._lock = threading.Lock()
+
+    def add(self, obj: dict) -> None:
+        with self._lock:
+            self._rows.append(obj)
+
+    def flush(self, _time: int | None = None) -> None:
+        conn_cls = http.client.HTTPSConnection if self.secure else http.client.HTTPConnection
+        conn = None
+        try:
+            while True:
+                with self._lock:
+                    if not self._rows:
+                        return
+                    obj = self._rows[0]
+                if conn is None:
+                    conn = conn_cls(self.netloc, timeout=30)
+                conn.request(
+                    "POST", self.path, body=_json.dumps(obj).encode(), headers=self.headers
+                )
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status >= 300:
+                    raise RuntimeError(f"logstash POST failed ({resp.status})")
+                # drain only after the row is durably posted — a mid-flush
+                # failure keeps the remainder for the next flush
+                with self._lock:
+                    self._rows.pop(0)
+        finally:
+            if conn is not None:
+                conn.close()
+
+
+def write(
+    table: Table,
+    endpoint: str,
+    *,
+    headers: dict[str, str] | None = None,
+    name: str | None = None,
+    _sink_factory: Any = None,
+) -> None:
+    names = table.column_names()
+    sink = (_sink_factory or _HttpSink)(endpoint, headers)
+
+    def on_data(key, row, time, diff):
+        obj = {n: _plain(v) for n, v in zip(names, row)}
+        obj["time"], obj["diff"] = time, diff
+        sink.add(obj)
+
+    _utils.register_output(
+        table, on_data, on_time_end=sink.flush, on_end=sink.flush, name=name or "logstash"
+    )

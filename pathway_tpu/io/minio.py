@@ -1,11 +1,60 @@
 """MinIO (S3-compatible) storage connector (parity: python/pathway/io/minio).
 
-The engine-side binding is gated on the optional ``boto3`` client package,
-which is not part of this environment; the API surface matches the
-reference so pipelines import and typecheck unchanged.
+A thin shim over ``pw.io.s3``: MinIO speaks the S3 REST API, so the signed
+client in ``io/_s3http.py`` covers it — only the endpoint settings differ
+(path-style addressing on a custom endpoint).
 """
 
-from pathway_tpu.io._gated import gated_reader, gated_writer
+from __future__ import annotations
 
-read = gated_reader("minio", "boto3")
-write = gated_writer("minio", "boto3")
+from typing import Any
+
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import s3 as _s3
+from pathway_tpu.io._s3http import AwsS3Settings
+
+__all__ = ["MinIOSettings", "read"]
+
+
+class MinIOSettings:
+    """Parity: pw.io.minio.MinIOSettings."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        bucket_name: str,
+        access_key: str,
+        secret_access_key: str,
+        *,
+        with_path_style: bool = True,
+        region: str = "us-east-1",
+        **_kw: Any,
+    ):
+        self.endpoint = endpoint
+        self.bucket_name = bucket_name
+        self.access_key = access_key
+        self.secret_access_key = secret_access_key
+        self.with_path_style = with_path_style
+        self.region = region
+
+    def as_s3(self) -> AwsS3Settings:
+        return AwsS3Settings(
+            bucket_name=self.bucket_name,
+            access_key=self.access_key,
+            secret_access_key=self.secret_access_key,
+            region=self.region,
+            endpoint=self.endpoint,
+            with_path_style=self.with_path_style,
+        )
+
+
+def read(
+    path: str,
+    minio_settings: MinIOSettings,
+    *,
+    format: str = "csv",
+    **kwargs: Any,
+) -> Table:
+    return _s3.read(
+        path, aws_s3_settings=minio_settings.as_s3(), format=format, **kwargs
+    )

@@ -1,11 +1,10 @@
-"""Redpanda (Kafka API) connector (parity: python/pathway/io/redpanda).
+"""Redpanda connector (parity: python/pathway/io/redpanda).
 
-The engine-side binding is gated on the optional ``kafka`` client package,
-which is not part of this environment; the API surface matches the
-reference so pipelines import and typecheck unchanged.
+Redpanda speaks the Kafka protocol, so this is ``pw.io.kafka`` under a
+different name — exactly how the reference implements it
+(python/pathway/io/redpanda re-exports the kafka connector).
 """
 
-from pathway_tpu.io._gated import gated_reader, gated_writer
+from pathway_tpu.io.kafka import read, write
 
-read = gated_reader("redpanda", "kafka")
-write = gated_writer("redpanda", "kafka")
+__all__ = ["read", "write"]

@@ -1,11 +1,22 @@
-"""Amazon S3 CSV connector (parity: python/pathway/io/s3_csv).
+"""Amazon S3 CSV connector (parity: python/pathway/io/s3_csv) —
+``pw.io.s3.read`` specialized to csv."""
 
-The engine-side binding is gated on the optional ``boto3`` client package,
-which is not part of this environment; the API surface matches the
-reference so pipelines import and typecheck unchanged.
-"""
+from __future__ import annotations
 
-from pathway_tpu.io._gated import gated_reader, gated_writer
+from typing import Any
 
-read = gated_reader("s3_csv", "boto3")
-write = gated_writer("s3_csv", "boto3")
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import s3 as _s3
+from pathway_tpu.io._s3http import AwsS3Settings
+
+__all__ = ["read"]
+
+
+def read(
+    path: str,
+    *,
+    aws_s3_settings: AwsS3Settings | None = None,
+    **kwargs: Any,
+) -> Table:
+    kwargs.pop("format", None)
+    return _s3.read(path, aws_s3_settings=aws_s3_settings, format="csv", **kwargs)
