@@ -169,6 +169,34 @@ class Node:
         """After the finish-quiesce: report errors that only count if they
         survived to end-of-stream (e.g. strict ix dangling pointers)."""
 
+    # -- operator snapshots (persistence/operator_snapshot.rs analog) --
+    # subclasses list their arrangement attributes; dumps hold plain
+    # picklable data (callables are re-bound by the rebuilt graph)
+    _persist_attrs: tuple = ()
+
+    def persist_dump(self):
+        data: dict = {}
+        if self.keep_state and self._state_rows:
+            data["__state_rows"] = self._state_rows
+        for a in self._persist_attrs:
+            data[a] = getattr(self, a)
+        if not data and not self.keep_state:
+            return None
+        return data
+
+    def persist_load(self, data) -> None:
+        for a, v in data.items():
+            if a == "__state_rows":
+                self._state_rows = {k: Counter(c) for k, c in v.items()}
+                self.state = {}
+                for k, rows in self._state_rows.items():
+                    for r, c in rows.items():
+                        if c > 0:
+                            self.state[k] = r
+                            break
+            else:
+                setattr(self, a, v)
+
     def has_pending(self) -> bool:
         return any(self.pending.values())
 
@@ -405,6 +433,8 @@ class UpdateRowsNode(Node):
     (dataflow.rs update_rows_table)."""
 
     name = "update_rows"
+    _persist_attrs = ("_left", "_right")
+
 
     def __init__(self, scope, left: Node, right: Node):
         super().__init__(scope, [left, right])
@@ -448,6 +478,8 @@ class UpdateCellsNode(Node):
     """update_cells: override a subset of columns for keys present in right."""
 
     name = "update_cells"
+    _persist_attrs = ("_left", "_right")
+
 
     def __init__(self, scope, left: Node, right: Node, merge_fn: Callable[[Row, Row | None], Row]):
         super().__init__(scope, [left, right])
@@ -493,6 +525,8 @@ class IntersectNode(Node):
     """restrict left to keys present in all other inputs (intersect_tables)."""
 
     name = "intersect"
+    _persist_attrs = ("_left", "_present")
+
 
     def __init__(self, scope, left: Node, others: Sequence[Node], difference: bool = False):
         super().__init__(scope, [left, *others])
@@ -544,6 +578,8 @@ class IxNode(Node):
     changes on both sides."""
 
     name = "ix"
+    _persist_attrs = ("_keys", "_data", "_by_target", "_unresolved")
+
 
     def __init__(
         self,
@@ -652,6 +688,8 @@ class JoinNode(Node):
     """
 
     name = "join"
+    _persist_attrs = ("_left_idx", "_right_idx", "_left_matches", "_right_matches")
+
 
     def __init__(
         self,
@@ -904,11 +942,34 @@ class GroupByNode(Node):
             self._update_state(out)
         self.send(out, time)
 
+    def persist_dump(self):
+        data = super().persist_dump() or {}
+        data["__groups"] = {
+            gk: [st.dump() for st in states] for gk, states in self._groups.items()
+        }
+        data["__group_counts"] = self._group_counts
+        data["__last_out"] = self._last_out
+        return data
+
+    def persist_load(self, data):
+        groups = data.pop("__groups")
+        self._group_counts = Counter(data.pop("__group_counts"))
+        self._last_out = dict(data.pop("__last_out"))
+        super().persist_load(data)
+        self._groups = {}
+        for gk, dumps in groups.items():
+            states = [r.make_state() for (r, _) in self.reducer_specs]
+            for st, d in zip(states, dumps):
+                st.load(d)
+            self._groups[gk] = states
+
 
 class DeduplicateNode(Node):
     """deduplicate with a Python acceptor (dataflow.rs deduplicate 3514)."""
 
     name = "deduplicate"
+    _persist_attrs = ("_current",)
+
 
     def __init__(
         self,
@@ -967,6 +1028,8 @@ class BufferNode(Node):
     """
 
     name = "buffer"
+    _persist_attrs = ("_held", "_watermark")
+
 
     def __init__(self, scope, inp: Node, time_fn, threshold_fn):
         super().__init__(scope, [inp])
@@ -1009,6 +1072,8 @@ class ForgetNode(Node):
     emits retractions downstream (time_column.rs forget)."""
 
     name = "forget"
+    _persist_attrs = ("_alive", "_watermark")
+
 
     def __init__(self, scope, inp: Node, time_fn, threshold_fn, mark_forgetting_records: bool = False):
         super().__init__(scope, [inp])
@@ -1045,6 +1110,8 @@ class FreezeNode(Node):
     """Ignore updates to rows older than threshold (exactly-once behaviors)."""
 
     name = "freeze"
+    _persist_attrs = ("_watermark",)
+
 
     def __init__(self, scope, inp: Node, time_fn, threshold_fn):
         super().__init__(scope, [inp])
@@ -1078,6 +1145,8 @@ class SortNode(Node):
     """
 
     name = "sort"
+    _persist_attrs = ("_by_instance", "_rows")
+
 
     def __init__(self, scope, inp: Node, key_fn, instance_fn):
         super().__init__(scope, [inp])
@@ -1182,6 +1251,8 @@ class GradualBroadcastNode(Node):
     to rows only when the value leaves [lower, upper]."""
 
     name = "gradual_broadcast"
+    _persist_attrs = ("_current_value", "_lower", "_upper", "_rows")
+
 
     def __init__(self, scope, inp: Node, threshold_node: Node, lvu_fn):
         super().__init__(scope, [inp, threshold_node])
@@ -1249,6 +1320,9 @@ class ExternalIndexNode(Node):
         self.res_fn = res_fn  # (query_key, query_row, result) -> out Row
         self._queries: dict[int, Row] = {}
         self._answers: dict[int, Row] = {}
+        # raw indexed rows: operator snapshots rebuild the (arbitrary,
+        # non-picklable) index structure by re-adding these on restore
+        self._data_rows: dict[int, Row] = {}
         # the index structure is one logical object: host bookkeeping on
         # worker 0 (its device path still shards the corpus over the mesh —
         # ops/topk.py DeviceIndexCache(mesh))
@@ -1262,8 +1336,10 @@ class ExternalIndexNode(Node):
         for key, row, diff in dd:
             if diff > 0:
                 self.index.add(key, row)
+                self._data_rows[key] = row
             else:
                 self.index.remove(key)
+                self._data_rows.pop(key, None)
         # new/removed queries
         for qkey, qrow, diff in dq:
             if diff > 0:
@@ -1292,6 +1368,13 @@ class ExternalIndexNode(Node):
             self._update_state(out)
         self.send(out, time)
 
+    _persist_attrs = ("_queries", "_answers", "_data_rows")
+
+    def persist_load(self, data):
+        super().persist_load(data)
+        for key, row in self._data_rows.items():
+            self.index.add(key, row)
+
 
 class AsyncValuesNode(Node):
     """Computes extra columns with async functions: all rows of an epoch are
@@ -1303,6 +1386,8 @@ class AsyncValuesNode(Node):
     """
 
     name = "async_values"
+    _persist_attrs = ("_cache",)
+
 
     def __init__(self, scope, inp: Node, coro_fns: Sequence[Callable[[int, Row], Any]]):
         super().__init__(scope, [inp])
@@ -1544,6 +1629,24 @@ class IterateNode(Node):
     def final_check(self):
         for node in self.subscope.nodes:
             node.final_check()
+
+    def persist_dump(self):
+        sub = {}
+        for node in self.subscope.nodes:
+            d = node.persist_dump()
+            if d is not None:
+                sub[node.id] = d
+        return {
+            "__sub": sub,
+            "__acc": self._input_acc,
+            "__result_sent": self._result_sent,
+        }
+
+    def persist_load(self, data):
+        for nid, d in data["__sub"].items():
+            self.subscope.nodes[nid].persist_load(d)
+        self._input_acc = [Counter(c) for c in data["__acc"]]
+        self._result_sent = [dict(r) for r in data["__result_sent"]]
 
 
 class IterateResultNode(Node):

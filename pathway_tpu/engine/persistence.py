@@ -254,6 +254,16 @@ class SourceState:
         self.offset = offset  # opaque reader frontier
         self.pending_offset: Any = offset
         self.schema_digest: str | None = None
+        # operator-persisting mode: no input event log; offsets commit only
+        # once the epoch their rows were staged into has been PROCESSED —
+        # operator snapshots cover processed epochs, so an offset past an
+        # unprocessed row would lose it on crash
+        self.operator_mode = False
+        self.pending_offsets: list[tuple[Any, int]] = []  # (offset, epoch)
+        # high-water mark of auto-generated row keys: resumed runs continue
+        # the sequence so fresh rows never collide with keys that already
+        # live inside restored operator state / replayed snapshots
+        self.key_seq = 0
 
 
 class PersistentStorage:
@@ -274,6 +284,16 @@ class PersistentStorage:
         self.sources: dict[str, SourceState] = {}
         self._metadata = self._load_metadata()
         self.replayed_rows = 0
+        # PersistenceMode::OperatorPersisting (mod.rs:108-116): persist
+        # operator arrangements instead of input event logs, so resume is
+        # O(state) not O(history)
+        self.operator_persistence = (
+            getattr(mode, "name", None) == "OPERATOR_PERSISTING"
+        )
+        self._op_gen = int(self._metadata.get("operators", {}).get("gen", 0))
+        # set by the runner: returns {node_id: bytes} of dirty operator
+        # states + the graph digest, collected at commit time
+        self.collect_operator_states: Any = None
         # record/replay mode (PATHWAY_SNAPSHOT_ACCESS): None = both
         # directions (ordinary persistence), "record" = write-only,
         # "replay" = read snapshots; continue_after_replay then decides
@@ -291,32 +311,91 @@ class PersistentStorage:
             return {"sources": {}}
         return _json.loads(raw.decode())
 
-    def commit(self) -> None:
+    def commit(
+        self, processed_up_to: int | None = None, full_operator_dump: bool = False
+    ) -> None:
         """Atomically record the current consistent snapshot frontier.
 
         Only chunks flushed at offset markers are committed — the mid-batch
         event buffer stays out, so the committed (chunks, offset) pair always
         refers to the same row prefix.  No-op when nothing advanced.
+
+        Operator-persisting mode additionally dumps dirty operator states
+        (via ``collect_operator_states``) and gates source offsets on
+        ``processed_up_to`` (the last epoch the engine ran; None = all).
         """
         for sid, st in self.sources.items():
-            st.committed_chunks = st.log.chunks_written
-            st.offset = st.pending_offset
+            if st.operator_mode:
+                while st.pending_offsets and (
+                    processed_up_to is None
+                    or st.pending_offsets[0][1] <= processed_up_to
+                ):
+                    st.offset = st.pending_offsets.pop(0)[0]
+                st.pending_offset = st.offset
+            else:
+                st.committed_chunks = st.log.chunks_written
+                st.offset = st.pending_offset
         metadata = {
             "sources": {
                 sid: {
                     "chunks": st.committed_chunks,
                     "offset": _offset_to_json(st.offset),
                     "schema": st.schema_digest,
+                    "key_seq": st.key_seq,
                 }
                 for sid, st in self.sources.items()
             }
         }
+        if self.operator_persistence and self.collect_operator_states is not None:
+            dirty, digest = self.collect_operator_states(full_operator_dump)
+            op_meta = dict(self._metadata.get("operators", {}).get("nodes", {}))
+            if dirty:
+                self._op_gen += 1
+                for node_id, blob in dirty.items():
+                    key = f"operators/{self.worker}/{self._op_gen}/{node_id}"
+                    self.backend.put(key, blob)
+                    op_meta[str(node_id)] = key
+            metadata["operators"] = {
+                "gen": self._op_gen,
+                "digest": digest,
+                "nodes": op_meta,
+            }
         if metadata == self._metadata:
             return
         self._metadata = metadata
         self.backend.put_atomic(
             self._meta_key(), _json.dumps(self._metadata).encode()
         )
+        self._gc_operator_chunks()
+
+    def _gc_operator_chunks(self) -> None:
+        """Drop operator chunks superseded by the just-committed metadata."""
+        meta = self._metadata.get("operators")
+        if not meta:
+            return
+        live = set(meta.get("nodes", {}).values())
+        for key in self.backend.list_keys(f"operators/{self.worker}/"):
+            if key not in live:
+                self.backend.delete(key)
+
+    def load_operator_states(self, digest: str) -> dict[int, bytes]:
+        """Committed operator snapshots keyed by node id; {} on first run."""
+        meta = self._metadata.get("operators")
+        if not meta or not meta.get("nodes"):
+            return {}
+        if meta.get("digest") != digest:
+            raise ValueError(
+                "persistence: operator snapshots were written by a different "
+                "program shape — the dataflow graph changed between runs "
+                "(clear the persistence directory to start fresh)"
+            )
+        out = {}
+        for node_id, key in meta["nodes"].items():
+            blob = self.backend.get(key)
+            if blob is None:
+                raise RuntimeError(f"persistence: missing operator chunk {key}")
+            out[int(node_id)] = blob
+        return out
 
     @property
     def input_snapshots_enabled(self) -> bool:
@@ -356,6 +435,8 @@ class PersistentStorage:
         log.chunks_written = committed  # append after the committed prefix
         state = SourceState(log, committed, offset)
         state.schema_digest = schema_digest
+        state.operator_mode = self.operator_persistence
+        state.key_seq = int(meta.get("key_seq", 0))
         self.sources[source_id] = state
         return state
 
@@ -363,7 +444,11 @@ class PersistentStorage:
         """Feed committed events into an input session at rewind time 0.
 
         Returns the number of replayed row events (mod.rs:222-258 rewind).
+        Operator-persisting mode replays nothing — restored operator states
+        already contain the effect of every committed row.
         """
+        if state.operator_mode:
+            return 0
         n = 0
         for kind, key, row, _t in state.log.read_committed(state.committed_chunks):
             if kind == codec.EV_INSERT:

@@ -38,6 +38,15 @@ class ReducerState:
     def is_empty(self) -> bool:
         raise NotImplementedError
 
+    # operator-snapshot hooks (persistence/operator_snapshot.rs analog):
+    # dump() returns plain picklable data; load() restores it into a state
+    # freshly created by Reducer.make_state(), which re-binds any callables
+    def dump(self) -> Any:
+        raise NotImplementedError(f"{type(self).__name__} is not persistable")
+
+    def load(self, data: Any) -> None:
+        raise NotImplementedError(f"{type(self).__name__} is not persistable")
+
 
 class Reducer:
     name: str = "reducer"
@@ -75,6 +84,12 @@ class _CountState(ReducerState):
 
     def is_empty(self):
         return self.n == 0
+
+    def dump(self):
+        return self.n
+
+    def load(self, data):
+        self.n = data
 
 
 class CountReducer(Reducer):
@@ -123,6 +138,12 @@ class _SumState(ReducerState):
 
     def is_empty(self):
         return self.n == 0
+
+    def dump(self):
+        return (self.total, self.n)
+
+    def load(self, data):
+        self.total, self.n = data
 
 
 class SumReducer(Reducer):
@@ -197,6 +218,12 @@ class _MultisetState(ReducerState):
 
     def is_empty(self):
         return not self.rows
+
+    def dump(self):
+        return self.rows
+
+    def load(self, data):
+        self.rows = Counter(data)
 
 
 def _multiset_reducer(name_: str, finish: Callable[[Counter], Any], rdtype=None):
@@ -316,6 +343,12 @@ class _TimeBasedState(ReducerState):
     def is_empty(self):
         return not self.rows
 
+    def dump(self):
+        return self.rows
+
+    def load(self, data):
+        self.rows = Counter(data)
+
 
 class EarliestReducer(Reducer):
     name = "earliest"
@@ -366,6 +399,12 @@ class _StatefulState(ReducerState):
 
     def is_empty(self):
         return not self.rows
+
+    def dump(self):
+        return self.rows
+
+    def load(self, data):
+        self.rows = Counter(data)
 
 
 class StatefulReducer(Reducer):
@@ -455,6 +494,15 @@ class _CustomAccState(ReducerState):
 
     def is_empty(self):
         return not self.rows
+
+    def dump(self):
+        return (self.rows, self.order, max((s for (_t, s) in self.order.values()), default=-1) + 1)
+
+    def load(self, data):
+        rows, order, seq_next = data
+        self.rows = Counter(rows)
+        self.order = dict(order)
+        self._seq = itertools.count(seq_next)
 
 
 def udf_reducer(accumulator: type[BaseCustomAccumulator]):

@@ -43,6 +43,62 @@ class RunResult:
         self.epochs = 0
         self.prober = None  # engine.probes.Prober when monitoring ran
         self.telemetry = None  # engine.telemetry.Telemetry for this run
+        self.last_time: int | None = None  # last processed epoch
+        self.clean_finish = False
+        # an exception escaped mid-run_epoch: node states are inconsistent
+        # (some nodes stepped the failing epoch, some did not)
+        self.epoch_failed = False
+
+
+def _graph_digest(scope: df.Scope) -> str:
+    """Structural fingerprint for operator-snapshot compatibility.
+
+    Covers node kinds, wiring (input ids/ports), and iterate subscopes.
+    Best-effort: changes inside Python callables (UDF bodies, filter
+    predicates) are invisible to it — the same limitation the reference has
+    with its positionally-matched operator snapshots."""
+    import hashlib as _hashlib
+
+    def scope_sig(s: df.Scope) -> str:
+        parts = []
+        for n in s.nodes:
+            wires = ",".join(str(i.id) for i in n.inputs)
+            part = f"{n.name}({wires})"
+            sub = getattr(n, "subscope", None)
+            if sub is not None:
+                part += "{" + scope_sig(sub) + "}"
+            parts.append(part)
+        return ";".join(parts)
+
+    sig = scope_sig(scope)
+    return f"{len(scope.nodes)}:{_hashlib.md5(sig.encode()).hexdigest()}"
+
+
+def _wire_operator_persistence(scope: df.Scope, storage: Any, result: RunResult) -> None:
+    """Operator-snapshot mode: restore node arrangements from the last
+    committed generation, and hand the storage a collector that dumps dirty
+    nodes at each commit (persistence/operator_snapshot.rs analog)."""
+    import pickle as _pickle
+
+    digest = _graph_digest(scope)
+    for node_id, blob in storage.load_operator_states(digest).items():
+        scope.nodes[node_id].persist_load(_pickle.loads(blob))
+    last_rows_in: dict[int, int] = {n.id: n.rows_in for n in scope.nodes}
+
+    def collect(full: bool):
+        # full=True (clean finish): dump everything — on_finish hooks
+        # mutate state (buffer drains) without touching rows_in
+        dirty: dict[int, bytes] = {}
+        for node in scope.nodes:
+            if not full and node.rows_in == last_rows_in.get(node.id, -1):
+                continue
+            data = node.persist_dump()
+            last_rows_in[node.id] = node.rows_in
+            if data is not None:
+                dirty[node.id] = _pickle.dumps(data)
+        return dirty, digest
+
+    storage.collect_operator_states = collect
 
 
 def run(
@@ -89,6 +145,8 @@ def run(
         attach(lowerer, node)
 
     result = RunResult()
+    if storage is not None and storage.operator_persistence:
+        _wire_operator_persistence(scope, storage, result)
     root_token = None
     http_server = None
     try:
@@ -152,8 +210,24 @@ def run(
         if http_server is not None:
             http_server.close()
         if storage is not None:
-            # also on interrupt/error: commit whatever frontier is consistent
-            storage.commit()
+            # also on interrupt/error: commit whatever frontier is consistent.
+            # Offsets never advance past the last PROCESSED epoch (rows
+            # staged for later epochs are not yet in any snapshot), and a
+            # failure mid-epoch must not dump half-stepped operator state —
+            # the previous consistent generation stays committed instead.
+            frontier = result.last_time if result.last_time is not None else -1
+            if result.epoch_failed and storage.operator_persistence:
+                import logging
+
+                logging.getLogger("pathway_tpu").warning(
+                    "run failed mid-epoch; keeping the previous consistent "
+                    "operator snapshot generation"
+                )
+            else:
+                storage.commit(
+                    processed_up_to=frontier,
+                    full_operator_dump=result.clean_finish,
+                )
             from pathway_tpu.engine import persistence as pz
 
             pz.release_active_root(root_token)
@@ -263,7 +337,7 @@ def _event_loop(
             storage is not None
             and (_time.monotonic() - last_snapshot) >= snapshot_interval
         ):
-            storage.commit()
+            storage.commit(processed_up_to=last_time)
             last_snapshot = _time.monotonic()
             # snapshot persisted: sources whose rows are in it may commit
             # their broker offsets for everything it covers
@@ -284,8 +358,11 @@ def _event_loop(
                 # merge any earlier-stamped staged rows into this epoch
                 inp.merge_staged_through(t)
                 inp.emit_time(t)
+            result.epoch_failed = True
             scope.run_epoch(t)
+            result.epoch_failed = False
             last_time = t
+            result.last_time = t
             result.epochs += 1
             # sources without input snapshots (no persistence, or UDF-cache-
             # only mode): the processed epoch is their durability boundary —
@@ -306,6 +383,7 @@ def _event_loop(
         _time.sleep(0.001)
     scope.current_time = max(scope.current_time, last_time)
     scope.finish()
+    result.clean_finish = True
     if prober is not None:
         prober.update(done=True, epochs=result.epochs)
 
@@ -341,7 +419,7 @@ def _event_loop_coordinated(
             storage is not None
             and (_time.monotonic() - last_snapshot) >= snapshot_interval
         ):
-            storage.commit()
+            storage.commit(processed_up_to=last_time)
             last_snapshot = _time.monotonic()
             _ack_sources(pollers, persisted=True)
         exhausted = True
@@ -387,8 +465,11 @@ def _event_loop_coordinated(
             if merged:
                 inp._staged[t] = merged
             inp.emit_time(t)
+        result.epoch_failed = True
         scope.run_epoch(t)
+        result.epoch_failed = False
         last_time = t
+        result.last_time = t
         result.epochs += 1
         _ack_sources(pollers, persisted=False, up_to_time=t)
         if prober is not None and prober.callbacks:
@@ -397,6 +478,7 @@ def _event_loop_coordinated(
             break
     scope.current_time = max(scope.current_time, last_time)
     scope.finish()
+    result.clean_finish = True
     if prober is not None:
         prober.update(done=True, epochs=result.epochs)
 

@@ -110,7 +110,12 @@ class S3Client:
         conn_cls = http.client.HTTPSConnection if self.secure else http.client.HTTPConnection
         conn = conn_cls(self.host, timeout=self.timeout)
         try:
-            url = path + ("?" + canonical_query if canonical_query else "")
+            # request the exact path that was signed — an unencoded space or
+            # special character would both break the request line and fail
+            # the server-side signature check
+            url = urllib.parse.quote(path) + (
+                "?" + canonical_query if canonical_query else ""
+            )
             req_headers = {
                 "Host": self.host,
                 "x-amz-content-sha256": payload_hash,

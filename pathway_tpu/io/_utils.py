@@ -118,7 +118,12 @@ class _QueuePoller:
         self.dtypes = [schema.__columns__[n].dtype for n in self.names]
         self.pk = schema.primary_key_columns()
         self.autocommit = (autocommit_duration_ms or 1500) / 1000.0
-        self._seq = itertools.count()
+        # auto-key counter: base salts multi-worker streams apart; the
+        # running count persists per source so resumed runs continue the
+        # sequence (fresh rows must never reuse keys already inside
+        # replayed snapshots / restored operator state)
+        self._seq_base = 0
+        self._auto_seq = 0
         self._time = 2
         self._staged = False
         self._last_commit = _time.monotonic()
@@ -141,7 +146,11 @@ class _QueuePoller:
             return (k & KEY_MASK) if isinstance(k, int) else hash_values([k])
         if self.pk:
             return hash_values([values[self.names.index(c)] for c in self.pk])
-        return sequential_key(next(self._seq))
+        n = self._auto_seq
+        self._auto_seq = n + 1
+        if self.persist_state is not None:
+            self.persist_state.key_seq = self._auto_seq
+        return sequential_key(self._seq_base + n)
 
     def poll(self) -> bool:
         if self.finished:
@@ -185,8 +194,17 @@ class _QueuePoller:
                 # row prefix — the consistency rule tracker.rs enforces with
                 # its offset antichains
                 if self.persist_state is not None:
-                    self.persist_state.pending_offset = item.value
-                    self.persist_state.log.flush_chunk()
+                    if self.persist_state.operator_mode:
+                        # operator snapshots cover processed epochs only:
+                        # stamp the offset with the epoch its rows were
+                        # staged into so commit() can gate on it
+                        marker_time = self._time if self._staged else self._time - 2
+                        self.persist_state.pending_offsets.append(
+                            (item.value, marker_time)
+                        )
+                    else:
+                        self.persist_state.pending_offset = item.value
+                        self.persist_state.log.flush_chunk()
                 continue
             row = item
             diff = -1 if row.get(DELETE) else 1
@@ -196,15 +214,24 @@ class _QueuePoller:
             key = self._key_of(values, row)
             vrow = tuple(values)
             self.input_node.insert(key, vrow, self._time, diff)
-            if self.persist_state is not None:
+            if self.persist_state is not None and not self.persist_state.operator_mode:
                 self.persist_state.log.record(key, vrow, diff)
             self._staged = True
         if self._staged and (_time.monotonic() - self._last_commit) >= self.autocommit:
-            self._time += 2
-            self._staged = False
-            self._last_commit = _time.monotonic()
-            if self.flush_on_commit and self.persist_state is not None:
-                self.persist_state.log.flush_chunk()
+            # operator-persisting sources close epochs only at COMMIT/Offset
+            # markers: a timer-closed epoch could be processed and dumped
+            # into an operator snapshot before its offset marker arrives,
+            # and the committed offset would lag the snapshot (re-ingestion
+            # on resume).  Marker-aligned epochs make snapshot and offset
+            # frontiers agree by construction.
+            if not (
+                self.persist_state is not None and self.persist_state.operator_mode
+            ):
+                self._time += 2
+                self._staged = False
+                self._last_commit = _time.monotonic()
+                if self.flush_on_commit and self.persist_state is not None:
+                    self.persist_state.log.flush_chunk()
         return False
 
     def ack_processed(self, up_to_time: int | None = None) -> None:
@@ -253,7 +280,7 @@ def make_input_table(
                 return node
             # salt autogenerated row keys by worker so striped partitions
             # never collide in the shared 128-bit key space
-            poller._seq = itertools.count(worker.worker_id << 64)
+            poller._seq_base = worker.worker_id << 64
         poller.reader = reader
 
         # persistence: replay committed snapshot, seek reader past it
@@ -286,6 +313,7 @@ def make_input_table(
                 node.close()
                 return node
             poller.persist_state = state
+            poller._auto_seq = state.key_seq
             if state.offset is not None:
                 if reader.supports_offsets:
                     reader.seek(state.offset)

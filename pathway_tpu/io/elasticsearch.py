@@ -112,6 +112,24 @@ class _BulkSink:
                     f"elasticsearch bulk failed ({resp.status}): "
                     f"{payload[:500].decode(errors='replace')}"
                 )
+            # a 200 can still carry per-item failures (mapping conflicts,
+            # 429 rejections) under "errors": true — silent success here
+            # would drop the batch
+            try:
+                parsed = _json.loads(payload)
+            except ValueError:
+                parsed = {}
+            if parsed.get("errors"):
+                failed = [
+                    item
+                    for item in parsed.get("items", [])
+                    for action in item.values()
+                    if action.get("status", 200) >= 300
+                ]
+                raise RuntimeError(
+                    f"elasticsearch bulk reported {len(failed)} failed items: "
+                    f"{str(failed[:3])[:500]}"
+                )
         finally:
             conn.close()
         # drain only after the bulk posted — a failed flush keeps the batch

@@ -40,6 +40,7 @@ class AsofNowJoinNode(df.Node):
     """
 
     name = "asof_now_join"
+    _persist_attrs = ("_right_idx", "_emitted")
 
     def __init__(self, scope, left_node, right_node, lkey_fn, rkey_fn, out_key_fn, left_outer):
         super().__init__(scope, [left_node, right_node])
