@@ -271,6 +271,9 @@ class InputNode(Node):
             deltas = consolidate(deltas)
             if self.keep_state:
                 self._update_state(deltas)
+        # input rows bypass take_pending, so count them here — monitoring
+        # and the operator-snapshot dirty check both key off rows_in
+        self.rows_in += len(deltas)
         self.send(deltas, time)
 
     def close(self) -> None:

@@ -292,8 +292,10 @@ class PersistentStorage:
         )
         self._op_gen = int(self._metadata.get("operators", {}).get("gen", 0))
         # set by the runner: returns {node_id: bytes} of dirty operator
-        # states + the graph digest, collected at commit time
+        # states + the graph digest, collected at commit time; confirm is
+        # invoked only after the referencing metadata write succeeds
         self.collect_operator_states: Any = None
+        self.confirm_operator_commit: Any = None
         # record/replay mode (PATHWAY_SNAPSHOT_ACCESS): None = both
         # directions (ordinary persistence), "record" = write-only,
         # "replay" = read snapshots; continue_after_replay then decides
@@ -361,11 +363,15 @@ class PersistentStorage:
                 "nodes": op_meta,
             }
         if metadata == self._metadata:
+            if self.confirm_operator_commit is not None:
+                self.confirm_operator_commit()  # nothing new: dumps are moot
             return
         self._metadata = metadata
         self.backend.put_atomic(
             self._meta_key(), _json.dumps(self._metadata).encode()
         )
+        if self.confirm_operator_commit is not None:
+            self.confirm_operator_commit()
         self._gc_operator_chunks()
 
     def _gc_operator_chunks(self) -> None:

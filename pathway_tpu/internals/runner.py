@@ -74,7 +74,7 @@ def _graph_digest(scope: df.Scope) -> str:
     return f"{len(scope.nodes)}:{_hashlib.md5(sig.encode()).hexdigest()}"
 
 
-def _wire_operator_persistence(scope: df.Scope, storage: Any, result: RunResult) -> None:
+def _wire_operator_persistence(scope: df.Scope, storage: Any) -> None:
     """Operator-snapshot mode: restore node arrangements from the last
     committed generation, and hand the storage a collector that dumps dirty
     nodes at each commit (persistence/operator_snapshot.rs analog)."""
@@ -84,21 +84,30 @@ def _wire_operator_persistence(scope: df.Scope, storage: Any, result: RunResult)
     for node_id, blob in storage.load_operator_states(digest).items():
         scope.nodes[node_id].persist_load(_pickle.loads(blob))
     last_rows_in: dict[int, int] = {n.id: n.rows_in for n in scope.nodes}
+    staged_marks: dict[int, int] = {}
 
     def collect(full: bool):
         # full=True (clean finish): dump everything — on_finish hooks
         # mutate state (buffer drains) without touching rows_in
         dirty: dict[int, bytes] = {}
+        staged_marks.clear()
         for node in scope.nodes:
             if not full and node.rows_in == last_rows_in.get(node.id, -1):
                 continue
             data = node.persist_dump()
-            last_rows_in[node.id] = node.rows_in
+            staged_marks[node.id] = node.rows_in
             if data is not None:
                 dirty[node.id] = _pickle.dumps(data)
         return dirty, digest
 
+    def confirm():
+        # nodes count as clean only once the metadata referencing their
+        # dumps is durably committed — a failed commit must re-dump them
+        last_rows_in.update(staged_marks)
+        staged_marks.clear()
+
     storage.collect_operator_states = collect
+    storage.confirm_operator_commit = confirm
 
 
 def run(
@@ -146,7 +155,7 @@ def run(
 
     result = RunResult()
     if storage is not None and storage.operator_persistence:
-        _wire_operator_persistence(scope, storage, result)
+        _wire_operator_persistence(scope, storage)
     root_token = None
     http_server = None
     try:

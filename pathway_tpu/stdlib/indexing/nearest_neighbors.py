@@ -175,8 +175,12 @@ class BruteForceKnn(InnerIndex):
 
 
 class USearchKnn(BruteForceKnn):
-    """API parity with the reference's USearch HNSW index
-    (nearest_neighbors.py:65).  Shares the dense device backend."""
+    """Approximate KNN over an HNSW graph (parity: the reference's USearch
+    index, nearest_neighbors.py:65 + usearch_integration.rs:163).
+
+    Backed by the self-contained HNSW implementation in ``hnsw.py``; the
+    USearch tuning parameters map directly: ``connectivity`` = M,
+    ``expansion_add`` = efConstruction, ``expansion_search`` = ef."""
 
     def __init__(
         self,
@@ -206,6 +210,24 @@ class USearchKnn(BruteForceKnn):
         self.connectivity = connectivity
         self.expansion_add = expansion_add
         self.expansion_search = expansion_search
+
+    def factory(self):
+        metric = self.metric
+        connectivity = self.connectivity
+        expansion_add = self.expansion_add
+        expansion_search = self.expansion_search
+
+        def make():
+            from pathway_tpu.stdlib.indexing.hnsw import HnswIndex
+
+            return HnswIndex(
+                metric=metric.value,
+                connectivity=connectivity,
+                expansion_add=expansion_add,
+                expansion_search=expansion_search,
+            )
+
+        return _SimpleFactory(make)
 
 
 

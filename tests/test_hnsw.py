@@ -1,0 +1,210 @@
+"""HNSW index: recall vs exact brute force, deletes, filters, integration.
+
+Model: the reference's USearch integration tests — approximate results must
+track the exact scan closely, honor the HNSW tuning parameters, and stay
+correct under incremental adds/removes through the as-of-now index path.
+"""
+
+import numpy as np
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib.indexing.hnsw import HnswIndex
+from tests.utils import T
+
+
+def _dataset(n=1500, dim=32, seed=7):
+    rng = np.random.default_rng(seed)
+    vecs = rng.normal(size=(n, dim)).astype(np.float32)
+    vecs /= np.linalg.norm(vecs, axis=1, keepdims=True)
+    return vecs
+
+
+def _exact_topk(vecs, q, k):
+    sims = vecs @ q
+    return set(int(i) for i in np.argsort(-sims)[:k])
+
+
+def test_recall_against_exact():
+    vecs = _dataset()
+    idx = HnswIndex(metric="cos", connectivity=16, expansion_add=128, expansion_search=96)
+    for i, v in enumerate(vecs):
+        idx.add(i, v)
+    rng = np.random.default_rng(1)
+    queries = rng.normal(size=(30, vecs.shape[1])).astype(np.float32)
+    queries /= np.linalg.norm(queries, axis=1, keepdims=True)
+    k = 10
+    hits = total = 0
+    for q in queries:
+        exact = _exact_topk(vecs, q, k)
+        got = {key for key, _s in idx.search(q, k)}
+        hits += len(got & exact)
+        total += k
+    recall = hits / total
+    assert recall >= 0.9, f"recall {recall:.3f} too low"
+
+
+def test_scores_match_cosine_similarity():
+    vecs = _dataset(n=200)
+    idx = HnswIndex(metric="cos")
+    for i, v in enumerate(vecs):
+        idx.add(i, v)
+    q = vecs[17]
+    results = idx.search(q, 5)
+    assert results[0][0] == 17
+    assert results[0][1] == pytest.approx(1.0, abs=1e-5)
+    # scores descend
+    scores = [s for _k, s in results]
+    assert scores == sorted(scores, reverse=True)
+
+
+def test_l2_metric():
+    idx = HnswIndex(metric="l2sq")
+    idx.add(1, [0.0, 0.0])
+    idx.add(2, [1.0, 0.0])
+    idx.add(3, [5.0, 5.0])
+    res = idx.search([0.1, 0.0], 2)
+    assert [k for k, _s in res] == [1, 2]
+    # l2 scores are distances: ascending with rank
+    assert res[0][1] < res[1][1]
+
+
+def test_remove_and_tombstone_compaction():
+    vecs = _dataset(n=300)
+    idx = HnswIndex(metric="cos")
+    for i, v in enumerate(vecs):
+        idx.add(i, v)
+    # remove the exact best match for query vecs[0]
+    res = idx.search(vecs[0], 3)
+    assert res[0][0] == 0
+    idx.remove(0)
+    res2 = idx.search(vecs[0], 3)
+    assert all(k != 0 for k, _s in res2)
+    # mass-removal triggers compaction; survivors still searchable
+    for i in range(1, 260):
+        idx.remove(i)
+    assert len(idx) == 40
+    res3 = idx.search(vecs[280], 5)
+    assert res3 and res3[0][0] == 280
+
+
+def test_re_add_after_remove():
+    idx = HnswIndex(metric="cos")
+    idx.add(1, [1.0, 0.0])
+    idx.add(2, [0.0, 1.0])
+    idx.remove(1)
+    idx.add(1, [1.0, 0.0])
+    assert [k for k, _s in idx.search([1.0, 0.0], 1)] == [1]
+
+
+def test_update_vector_in_place():
+    idx = HnswIndex(metric="cos")
+    idx.add(1, [1.0, 0.0])
+    idx.add(2, [0.0, 1.0])
+    idx.add(1, [0.0, 1.0])  # moved
+    res = idx.search([0.0, 1.0], 2)
+    assert {k for k, _s in res} == {1, 2}
+    assert len(idx) == 2
+
+
+def test_metadata_filter():
+    idx = HnswIndex(metric="cos")
+    idx.add(1, [1.0, 0.0], filter_data={"lang": "en"})
+    idx.add(2, [0.99, 0.14], filter_data={"lang": "de"})
+    res = idx.search([1.0, 0.0], 5, filter_query="lang == 'de'")
+    assert [k for k, _s in res] == [2]
+
+
+def test_connectivity_param_bounds_degree():
+    vecs = _dataset(n=400)
+    m = 4
+    idx = HnswIndex(metric="cos", connectivity=m, expansion_add=32)
+    for i, v in enumerate(vecs):
+        idx.add(i, v)
+    # layer-0 degree bounded by 2M after pruning
+    assert max(len(v) for v in idx._links[0].values()) <= 2 * m
+
+
+def test_expansion_search_improves_recall():
+    vecs = _dataset(n=1200, dim=24, seed=3)
+    lo = HnswIndex(metric="cos", connectivity=8, expansion_add=64, expansion_search=4)
+    hi = HnswIndex(metric="cos", connectivity=8, expansion_add=64, expansion_search=128)
+    for i, v in enumerate(vecs):
+        lo.add(i, v)
+        hi.add(i, v)
+    rng = np.random.default_rng(5)
+    queries = rng.normal(size=(25, 24)).astype(np.float32)
+    queries /= np.linalg.norm(queries, axis=1, keepdims=True)
+
+    def recall(idx):
+        hits = 0
+        for q in queries:
+            exact = _exact_topk(vecs, q, 10)
+            got = {k for k, _s in idx.search(q, 10)}
+            hits += len(got & exact)
+        return hits / (len(queries) * 10)
+
+    assert recall(hi) > recall(lo)
+    assert recall(hi) >= 0.85
+
+
+def test_empty_and_tiny_index():
+    idx = HnswIndex(metric="cos")
+    assert idx.search([1.0, 0.0], 3) == []
+    idx.add(7, [1.0, 0.0])
+    assert [k for k, _s in idx.search([1.0, 0.0], 3)] == [7]
+
+
+def test_usearch_knn_retrieval_path():
+    # the full as-of-now retrieval path with the HNSW backend, streaming
+    docs = T(
+        """
+          | x   | y   | _time
+        A | 1.0 | 0.0 | 2
+        B | 0.0 | 1.0 | 2
+        C | 0.9 | 0.1 | 4
+        """
+    )
+    data = docs.select(vec=pw.make_tuple(pw.this.x, pw.this.y))
+    queries = T(
+        """
+        qx  | qy  | _time
+        1.0 | 0.0 | 6
+        """
+    )
+    q = queries.select(qvec=pw.make_tuple(pw.this.qx, pw.this.qy))
+
+    from pathway_tpu.stdlib.indexing import USearchKnn
+    from pathway_tpu.stdlib.indexing.data_index import DataIndex
+
+    inner = USearchKnn(data.vec, connectivity=8, expansion_search=32)
+    index = DataIndex(data, inner)
+    res = index.query_as_of_now(q.qvec, number_of_matches=2)
+    rows_out = list(pw.debug.table_to_pandas(res, include_id=False).itertuples(index=False))
+    assert len(rows_out) == 1
+    matches = rows_out[0][-1]
+    assert len(matches) == 2  # A and C are the two closest to (1,0)
+
+
+def test_hnsw_matches_brute_force_in_dataindex():
+    rng = np.random.default_rng(11)
+    vecs = rng.normal(size=(100, 8)).astype(np.float32)
+    rows = [(i, tuple(float(x) for x in vecs[i])) for i in range(100)]
+    data = pw.debug.table_from_rows(
+        pw.schema_from_types(i=int, vec=tuple), rows
+    )
+    qrows = [(tuple(float(x) for x in vecs[3]),)]
+    queries = pw.debug.table_from_rows(pw.schema_from_types(qvec=tuple), qrows)
+
+    from pathway_tpu.stdlib.indexing import BruteForceKnn, USearchKnn
+    from pathway_tpu.stdlib.indexing.data_index import DataIndex
+
+    def top_ids(inner):
+        index = DataIndex(data, inner)
+        res = index.query_as_of_now(queries.qvec, number_of_matches=5)
+        df = pw.debug.table_to_pandas(res, include_id=False)
+        return list(df.iloc[0]["i"])  # ids of the matched rows, ranked
+
+    exact = top_ids(BruteForceKnn(data.vec))
+    approx = top_ids(USearchKnn(data.vec, expansion_search=64))
+    assert len(set(exact) & set(approx)) >= 4  # ≥80% overlap on tiny data
