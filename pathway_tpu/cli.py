@@ -63,16 +63,23 @@ def spawn_program(
     run_id = str(uuid.uuid4())
     handles: list[subprocess.Popen] = []
     try:
+        # spawn inside the try: a mid-spawn failure (EAGAIN, missing
+        # program) must still terminate the workers already started, or
+        # they hang forever waiting for mesh peers
         for process_id in range(processes):
-            env = _cluster_env(
-                env_base,
-                threads=threads,
-                processes=processes,
-                first_port=first_port,
-                process_id=process_id,
-                run_id=run_id,
+            handles.append(
+                subprocess.Popen(
+                    [program, *arguments],
+                    env=_cluster_env(
+                        env_base,
+                        threads=threads,
+                        processes=processes,
+                        first_port=first_port,
+                        process_id=process_id,
+                        run_id=run_id,
+                    ),
+                )
             )
-            handles.append(subprocess.Popen([program, *arguments], env=env))
         for handle in handles:
             handle.wait()
     finally:
@@ -82,6 +89,27 @@ def spawn_program(
     # a signal-killed worker (negative returncode) must not read as success;
     # report it with the conventional 128+signum shell encoding
     sys.exit(max(c if c >= 0 else 128 - c for c in codes))
+
+
+def _recording_env(
+    *,
+    access: str | None = None,
+    record_path: str | None = None,
+    mode: str | None = None,
+    continue_after_replay: bool = False,
+) -> dict[str, str]:
+    """Base environment for record/replay runs (PATHWAY_* protocol)."""
+    env = os.environ.copy()
+    if record_path is not None:
+        env["PATHWAY_REPLAY_STORAGE"] = record_path
+    if access is not None:
+        env["PATHWAY_SNAPSHOT_ACCESS"] = access
+    if mode is not None:
+        env["PATHWAY_PERSISTENCE_MODE"] = mode
+        env["PATHWAY_REPLAY_MODE"] = mode
+    if continue_after_replay:
+        env["PATHWAY_CONTINUE_AFTER_REPLAY"] = "true"
+    return env
 
 
 @click.group
@@ -94,20 +122,22 @@ _SPAWN_SETTINGS = {"allow_interspersed_args": False, "show_default": True}
 
 
 @cli.command(context_settings=_SPAWN_SETTINGS)
-@click.option("-t", "--threads", metavar="N", type=click.IntRange(min=1), default=1, help="threads per process")
-@click.option("-n", "--processes", metavar="N", type=click.IntRange(min=1), default=1, help="number of processes")
-@click.option("--first-port", metavar="PORT", type=int, default=10000, help="first port for worker communication")
-@click.option("--record", is_flag=True, help="record data in the input connectors")
-@click.option("--record-path", type=str, default="record", help="directory in which the recording is saved")
+@click.option("-t", "--threads", metavar="N", type=click.IntRange(min=1), default=1, help="worker threads per spawned process")
+@click.option("-n", "--processes", metavar="N", type=click.IntRange(min=1), default=1, help="cluster size (identical SPMD processes)")
+@click.option("--first-port", metavar="PORT", type=int, default=10000, help="base port of the worker TCP mesh")
+@click.option("--record", is_flag=True, help="capture every connector's input stream while running")
+@click.option("--record-path", type=str, default="record", help="where the captured stream is written")
 @click.argument("program")
 @click.argument("arguments", nargs=-1)
 def spawn(threads, processes, first_port, record, record_path, program, arguments):
     """Run PROGRAM as an SPMD cluster of identical processes."""
-    env = os.environ.copy()
-    if record:
-        env["PATHWAY_REPLAY_STORAGE"] = record_path
-        env["PATHWAY_SNAPSHOT_ACCESS"] = "record"
-        env["PATHWAY_CONTINUE_AFTER_REPLAY"] = "true"
+    env = (
+        _recording_env(
+            access="record", record_path=record_path, continue_after_replay=True
+        )
+        if record
+        else os.environ.copy()
+    )
     spawn_program(
         threads=threads,
         processes=processes,
@@ -119,40 +149,37 @@ def spawn(threads, processes, first_port, record, record_path, program, argument
 
 
 @cli.command(context_settings=_SPAWN_SETTINGS)
-@click.option("-t", "--threads", metavar="N", type=click.IntRange(min=1), default=1, help="threads per process")
-@click.option("-n", "--processes", metavar="N", type=click.IntRange(min=1), default=1, help="number of processes")
-@click.option("--first-port", metavar="PORT", type=int, default=10000, help="first port for worker communication")
-@click.option("--record-path", type=str, default="record", help="directory the recording is stored in")
+@click.option("-t", "--threads", metavar="N", type=click.IntRange(min=1), default=1, help="worker threads per spawned process")
+@click.option("-n", "--processes", metavar="N", type=click.IntRange(min=1), default=1, help="cluster size (identical SPMD processes)")
+@click.option("--first-port", metavar="PORT", type=int, default=10000, help="base port of the worker TCP mesh")
+@click.option("--record-path", type=str, default="record", help="where the captured stream was written")
 @click.option(
     "--mode",
     type=click.Choice(["batch", "speedrun"], case_sensitive=False),
-    help="mode of replaying data",
+    help="replay pacing: one batch, or recorded timing",
 )
 @click.option(
     "--continue",
     "continue_after_replay",
     is_flag=True,
-    help="continue with live connector data after the recording is replayed",
+    help="after the recording drains, keep consuming live connector data",
 )
 @click.argument("program")
 @click.argument("arguments", nargs=-1)
 def replay(threads, processes, first_port, record_path, mode, continue_after_replay, program, arguments):
-    """Re-run PROGRAM against a recorded input stream."""
-    env = os.environ.copy()
-    env["PATHWAY_REPLAY_STORAGE"] = record_path
-    env["PATHWAY_SNAPSHOT_ACCESS"] = "replay"
-    if mode:
-        env["PATHWAY_PERSISTENCE_MODE"] = mode
-        env["PATHWAY_REPLAY_MODE"] = mode
-    if continue_after_replay:
-        env["PATHWAY_CONTINUE_AFTER_REPLAY"] = "true"
+    """Re-run PROGRAM against a previously captured input stream."""
     spawn_program(
         threads=threads,
         processes=processes,
         first_port=first_port,
         program=program,
         arguments=arguments,
-        env_base=env,
+        env_base=_recording_env(
+            access="replay",
+            record_path=record_path,
+            mode=mode,
+            continue_after_replay=continue_after_replay,
+        ),
     )
 
 

@@ -199,16 +199,23 @@ def _sort_key(v):
 
 
 class _MultisetState(ReducerState):
-    """Counter-of-rows state for non-invertible reducers."""
+    """Counter-of-rows state for non-invertible reducers.
 
-    __slots__ = ("rows", "finish")
+    ``keyed=False`` collapses entries by VALUE: reducers that never look at
+    the row key (min/max/unique/sorted_tuple) then hold one counter per
+    distinct value instead of one per contributing row — the semigroup-style
+    compaction the reference applies to these reducers (reduce.rs:40-61),
+    bounding per-group memory on high-churn groups."""
 
-    def __init__(self, finish: Callable[[Counter], Any]):
+    __slots__ = ("rows", "finish", "keyed")
+
+    def __init__(self, finish: Callable[[Counter], Any], keyed: bool = True):
         self.rows = Counter()
         self.finish = finish
+        self.keyed = keyed
 
     def add(self, args, diff, time, key):
-        entry = (args, key)
+        entry = (args, key if self.keyed else None)
         self.rows[entry] += diff
         if self.rows[entry] == 0:
             del self.rows[entry]
@@ -223,10 +230,21 @@ class _MultisetState(ReducerState):
         return self.rows
 
     def load(self, data):
-        self.rows = Counter(data)
+        if self.keyed:
+            self.rows = Counter(data)
+            return
+        # snapshots written before value-collapsing keep (args, key)
+        # entries — normalize so later retractions (args, None) cancel them
+        self.rows = Counter()
+        for (args, _key), cnt in Counter(data).items():
+            self.rows[(args, None)] += cnt
+        for entry in [e for e, c in self.rows.items() if c == 0]:
+            del self.rows[entry]
 
 
-def _multiset_reducer(name_: str, finish: Callable[[Counter], Any], rdtype=None):
+def _multiset_reducer(
+    name_: str, finish: Callable[[Counter], Any], rdtype=None, keyed: bool = True
+):
     class _R(Reducer):
         name = name_
 
@@ -236,7 +254,7 @@ def _multiset_reducer(name_: str, finish: Callable[[Counter], Any], rdtype=None)
             return arg_dtypes[0] if arg_dtypes else dt.ANY
 
         def make_state(self):
-            return _MultisetState(finish)
+            return _MultisetState(finish, keyed=keyed)
 
     _R.__name__ = f"{name_.title()}Reducer"
     return _R()
@@ -531,11 +549,11 @@ def udf_reducer(accumulator: type[BaseCustomAccumulator]):
 count = CountReducer()
 sum = SumReducer()  # noqa: A001 — mirrors pw.reducers.sum
 avg = AvgReducer()
-min = _multiset_reducer("min", _finish_min)  # noqa: A001
-max = _multiset_reducer("max", _finish_max)  # noqa: A001
+min = _multiset_reducer("min", _finish_min, keyed=False)  # noqa: A001
+max = _multiset_reducer("max", _finish_max, keyed=False)  # noqa: A001
 argmin = _multiset_reducer("argmin", _finish_argmin, dt.POINTER)
 argmax = _multiset_reducer("argmax", _finish_argmax, dt.POINTER)
-unique = _multiset_reducer("unique", _finish_unique)
+unique = _multiset_reducer("unique", _finish_unique, keyed=False)
 any = _multiset_reducer("any", _finish_any)  # noqa: A001
 earliest = EarliestReducer()
 latest = LatestReducer()
@@ -546,6 +564,7 @@ def sorted_tuple(expr, *, skip_nones: bool = False):
         "sorted_tuple",
         _finish_sorted_tuple_factory(skip_nones),
         lambda ts: dt.List(dt.unoptionalize(ts[0]) if skip_nones else ts[0]),
+        keyed=False,
     )
     return r(expr)
 

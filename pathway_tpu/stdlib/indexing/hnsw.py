@@ -52,6 +52,9 @@ class HnswIndex:
         self._levels: dict[int, int] = {}
         # per-layer adjacency: layer -> key -> [neighbor keys]
         self._links: list[dict[int, list[int]]] = []
+        # reverse edges: target -> {(layer, source)} — makes in-place
+        # updates O(degree) instead of a full-graph scan
+        self._rev: dict[int, set[tuple[int, int]]] = {}
         self._entry: int | None = None
         self._deleted: set[int] = set()
 
@@ -84,6 +87,15 @@ class HnswIndex:
 
     def __len__(self) -> int:
         return len(self._vectors) - len(self._deleted)
+
+    def _set_links(self, layer: int, src: int, new_list: list[int]) -> None:
+        """Replace src's adjacency on a layer, keeping reverse edges in sync."""
+        old = self._links[layer].get(src, ())
+        for t in old:
+            self._rev.get(t, set()).discard((layer, src))
+        self._links[layer][src] = new_list
+        for t in new_list:
+            self._rev.setdefault(t, set()).add((layer, src))
 
     def add(self, key: int, vector, filter_data=None) -> None:
         if key in self._vectors:
@@ -118,16 +130,16 @@ class HnswIndex:
             cands = self._search_layer(q, ep, layer, self.ef_construction)
             m_max = self.m0 if layer == 0 else self.m
             chosen = [k for (_d, k) in heapq.nsmallest(self.m, cands) if k != key]
-            self._links[layer][key] = list(chosen)
+            self._set_links(layer, key, list(chosen))
             for nb in chosen:
-                lst = self._links[layer].setdefault(nb, [])
-                lst.append(key)
+                lst = self._links[layer].get(nb, []) + [key]
                 if len(lst) > m_max:
                     # prune: keep the m_max closest to nb
                     nbv = self._prepped[nb]
                     d = self._dists(nbv, lst)
                     order = np.argsort(d)[:m_max]
-                    self._links[layer][nb] = [lst[i] for i in order]
+                    lst = [lst[i] for i in order]
+                self._set_links(layer, nb, lst)
             ep = [k for (_d, k) in cands] or ep
         if level > self._levels.get(self._entry, 0):
             self._entry = key
@@ -143,12 +155,20 @@ class HnswIndex:
             self._entry = self._pick_entry()
 
     def _unlink(self, key: int) -> None:
-        """Remove a node and every edge referencing it (for re-inserts)."""
-        for layer in self._links:
-            layer.pop(key, None)
-            for nb, lst in layer.items():
-                if key in lst:
-                    layer[nb] = [x for x in lst if x != key]
+        """Remove a node and every edge referencing it (for re-inserts).
+
+        O(degree) via the reverse-edge index — a full-graph scan here would
+        make streaming in-place updates quadratic."""
+        for layer_idx, src in list(self._rev.get(key, ())):
+            lst = self._links[layer_idx].get(src)
+            if lst and key in lst:
+                self._links[layer_idx][src] = [x for x in lst if x != key]
+        self._rev.pop(key, None)
+        for layer_idx, layer in enumerate(self._links):
+            out = layer.pop(key, None)
+            if out:
+                for t in out:
+                    self._rev.get(t, set()).discard((layer_idx, key))
         self._vectors.pop(key, None)
         self._prepped.pop(key, None)
         self._filters.pop(key, None)
@@ -179,6 +199,7 @@ class HnswIndex:
         self._filters.clear()
         self._levels.clear()
         self._links = []
+        self._rev = {}
         self._entry = None
         self._deleted.clear()
         for k, v, f in live:

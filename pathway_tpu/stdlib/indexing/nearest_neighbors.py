@@ -4,10 +4,11 @@ and src/external_integration/{brute_force_knn,usearch}_integration.rs).
 ``BruteForceKnn`` is the TPU-first index: vectors are packed into a matrix
 and top-k is a (jit-compiled) matmul + top_k — see
 ``pathway_tpu/ops/topk.py``.  ``LshKnn`` is the pure-host LSH analog of the
-reference's ``ml/classifiers/_knn_lsh.py``.  ``USearchKnn`` keeps API parity
-with the reference's HNSW index; in this build it shares the brute-force
-device backend (an approximate on-device backend is a planned optimization,
-not a semantic difference — results are exact rather than approximate).
+reference's ``ml/classifiers/_knn_lsh.py``.  ``USearchKnn`` is approximate:
+an HNSW graph (``hnsw.py``) honoring the USearch tuning parameters
+(connectivity / expansion_add / expansion_search) — pick it over
+``BruteForceKnn`` when corpus size makes the exact device scan too slow
+and bounded recall loss is acceptable.
 """
 
 from __future__ import annotations

@@ -208,3 +208,42 @@ def test_hnsw_matches_brute_force_in_dataindex():
     exact = top_ids(BruteForceKnn(data.vec))
     approx = top_ids(USearchKnn(data.vec, expansion_search=64))
     assert len(set(exact) & set(approx)) >= 4  # ≥80% overlap on tiny data
+
+def test_legacy_keyed_snapshot_load_normalizes():
+    # operator snapshots written before value-collapsing carry (args, key)
+    # entries; loading must normalize so later retractions cancel them
+    from pathway_tpu.internals import reducers
+
+    state = reducers.min.make_state()
+    state.load({((5,), 101): 1, ((7,), 102): 1})
+    state.add((5,), -1, 0, key=101)
+    assert state.extract() == 7
+    state.add((7,), -1, 0, key=102)
+    assert state.is_empty()
+
+
+def test_unlink_keeps_reverse_index_consistent():
+    vecs = _dataset(n=300, dim=16, seed=2)
+    idx = HnswIndex(metric="cos", connectivity=8, expansion_add=48)
+    for i, v in enumerate(vecs):
+        idx.add(i, v)
+    # churn: update a third of the vectors in place
+    for i in range(0, 300, 3):
+        idx.add(i, vecs[(i + 1) % 300])
+    # reverse index must exactly mirror forward adjacency
+    forward = {
+        (layer_idx, src, t)
+        for layer_idx, layer in enumerate(idx._links)
+        for src, lst in layer.items()
+        for t in lst
+    }
+    reverse = {
+        (layer_idx, src, t)
+        for t, pairs in idx._rev.items()
+        for (layer_idx, src) in pairs
+        if src in idx._links[layer_idx] and t in idx._links[layer_idx][src]
+    }
+    assert forward == reverse
+    # and search still works
+    res = idx.search(vecs[10], 5)
+    assert len(res) == 5

@@ -293,3 +293,33 @@ def test_table_from_pandas_roundtrip():
 def test_table_from_rows():
     t = pw.debug.table_from_rows(pw.schema_from_types(a=int), [(1,), (2,)])
     assert sorted(r[0] for r in rows(t)) == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# reducer state compaction (memory bounding)
+# ---------------------------------------------------------------------------
+
+
+def test_min_max_state_collapses_by_value():
+    # high-churn group with few distinct values: the min/max arrangement
+    # must hold one entry per distinct value, not one per contributing row
+    from pathway_tpu.internals import reducers
+
+    state = reducers.min.make_state()
+    for i in range(10_000):
+        state.add((i % 4,), 1, 0, key=i)
+    assert len(state.rows) == 4
+    assert state.extract() == 0
+    # retractions shrink it back
+    for i in range(10_000):
+        state.add((i % 4,), -1, 0, key=i)
+    assert state.is_empty()
+
+
+def test_argmax_keeps_row_identity():
+    from pathway_tpu.internals import reducers
+
+    state = reducers.argmax.make_state()
+    state.add((5,), 1, 0, key=111)
+    state.add((9,), 1, 0, key=222)
+    assert state.extract().value == 222
