@@ -57,11 +57,21 @@ class TcpMesh:
         worker_count: int,
         first_port: int,
         host: str = "127.0.0.1",
+        peer_hosts: list[str] | None = None,
     ):
         self.worker_id = worker_id
         self.worker_count = worker_count
         self.first_port = first_port
         self.host = host
+        # multi-host deployments (one process per k8s pod / TPU host):
+        # peer_hosts[i] is worker i's hostname; ports stay first_port+i so
+        # the same config also works on localhost
+        if peer_hosts is not None and len(peer_hosts) != worker_count:
+            raise CommError(
+                f"peer_hosts has {len(peer_hosts)} entries for "
+                f"{worker_count} workers"
+            )
+        self.peer_hosts = peer_hosts
         self._socks: dict[int, socket.socket] = {}
         self._send_locks: dict[int, threading.Lock] = {}
         self._inbox: dict[tuple[int, Hashable], deque] = defaultdict(deque)
@@ -74,8 +84,9 @@ class TcpMesh:
     def start(self) -> "TcpMesh":
         if self.worker_count <= 1:
             return self
+        listen_host = "" if self.peer_hosts is not None else self.host
         self._listener = socket.create_server(
-            (self.host, self.first_port + self.worker_id), reuse_port=False
+            (listen_host, self.first_port + self.worker_id), reuse_port=False
         )
         self._listener.settimeout(CONNECT_TIMEOUT_S)
         accept_from = [w for w in range(self.worker_count) if w > self.worker_id]
@@ -97,8 +108,11 @@ class TcpMesh:
         acceptor.start()
 
         for peer in dial_to:
+            peer_host = (
+                self.peer_hosts[peer] if self.peer_hosts is not None else self.host
+            )
             self._socks[peer] = _dial(
-                self.host, self.first_port + peer, self.worker_id
+                peer_host, self.first_port + peer, self.worker_id
             )
 
         acceptor.join(CONNECT_TIMEOUT_S)

@@ -68,6 +68,15 @@ class PathwayConfig:
     first_port: int = dataclasses.field(
         default_factory=lambda: _env_int("PATHWAY_FIRST_PORT", 10000)
     )
+    # multi-host clusters: comma-separated hostname per worker id
+    # (PATHWAY_PEER_HOSTS=pod-0.svc,pod-1.svc,...); empty = localhost mesh
+    peer_hosts: list | None = dataclasses.field(
+        default_factory=lambda: (
+            [h.strip() for h in os.environ["PATHWAY_PEER_HOSTS"].split(",")]
+            if os.environ.get("PATHWAY_PEER_HOSTS")
+            else None
+        )
+    )
     run_id: str | None = dataclasses.field(default_factory=lambda: os.environ.get("PATHWAY_RUN_ID"))
     monitoring_http_port: int | None = dataclasses.field(
         default_factory=lambda: (

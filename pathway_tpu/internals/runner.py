@@ -137,7 +137,10 @@ def run(
         from pathway_tpu.engine.comm import TcpMesh, WorkerContext
 
         mesh = TcpMesh(
-            _cfg.process_id, _cfg.processes, _cfg.first_port
+            _cfg.process_id,
+            _cfg.processes,
+            _cfg.first_port,
+            peer_hosts=_cfg.peer_hosts,
         ).start()
         worker_ctx = WorkerContext(mesh)
         scope.worker = worker_ctx

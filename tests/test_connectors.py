@@ -618,3 +618,219 @@ def test_csv_settings_object_unpacked_via_as_dict(mock_s3):
     )
     got = pw.debug.table_to_pandas(t, include_id=False)
     assert got["a"].tolist() == [1] and got["b"].tolist() == ["x"]
+
+
+# ---------------------------------------------------------------------------
+# mongodb (OP_MSG wire protocol)
+# ---------------------------------------------------------------------------
+
+
+class MockMongo:
+    """Records every OP_MSG command body; answers {ok: 1}."""
+
+    def __init__(self):
+        import struct
+        import threading
+
+        from pathway_tpu.io._bson import decode_document, encode_document
+
+        self.commands: list = []
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+
+        def handle(c):
+            try:
+                while True:
+                    header = b""
+                    while len(header) < 16:
+                        chunk = c.recv(16 - len(header))
+                        if not chunk:
+                            return
+                        header += chunk
+                    length, rid, _rto, _op = struct.unpack("<iiii", header)
+                    payload = b""
+                    while len(payload) < length - 16:
+                        payload += c.recv(length - 16 - len(payload))
+                    doc, _ = decode_document(payload, 5)
+                    self.commands.append(doc)
+                    reply = encode_document({"ok": 1})
+                    body = struct.pack("<I", 0) + b"\x00" + reply
+                    c.sendall(struct.pack("<iiii", 16 + len(body), 1, rid, 2013) + body)
+            except OSError:
+                pass
+            finally:
+                c.close()
+
+        def serve():
+            while True:
+                try:
+                    conn, _ = self.sock.accept()
+                except OSError:
+                    return
+                threading.Thread(target=handle, args=(conn,), daemon=True).start()
+
+        threading.Thread(target=serve, daemon=True).start()
+
+    def close(self):
+        self.sock.close()
+
+
+def test_bson_roundtrip():
+    import datetime
+
+    from pathway_tpu.io._bson import decode_document, encode_document
+
+    doc = {
+        "s": "héllo",
+        "i": 7,
+        "big": 2**40,
+        "f": 1.5,
+        "b": True,
+        "n": None,
+        "bin": b"\x00\x01",
+        "arr": [1, "two", None],
+        "sub": {"x": 1},
+        "dt": datetime.datetime(2026, 1, 2, tzinfo=datetime.timezone.utc),
+    }
+    back, _ = decode_document(encode_document(doc))
+    assert back == doc
+
+
+def test_mongodb_write(mock_es):  # mock_es unused; keeps fixtures simple
+    srv = MockMongo()
+    try:
+        t = T(
+            """
+              | v | _time | _diff
+            A | 1 | 2     | 1
+            A | 1 | 4     | -1
+            B | 2 | 4     | 1
+            """
+        )
+        pw.io.mongodb.write(
+            t, f"mongodb://127.0.0.1:{srv.port}", "db1", "coll1"
+        )
+        pw.run()
+        inserts = [c for c in srv.commands if "insert" in c]
+        deletes = [c for c in srv.commands if "delete" in c]
+        assert inserts and deletes
+        assert inserts[0]["$db"] == "db1" and inserts[0]["insert"] == "coll1"
+        docs = [d for c in inserts for d in c["documents"]]
+        assert sorted(d["v"] for d in docs) == [1, 2]
+        assert all("_id" in d for d in docs)
+        del_ids = [q["q"]["_id"] for c in deletes for q in c["deletes"]]
+        # the retraction deletes the same _id the insert used
+        assert del_ids and del_ids[0] in {d["_id"] for d in docs}
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# nats (text protocol)
+# ---------------------------------------------------------------------------
+
+
+class MockNats:
+    """Speaks enough NATS: INFO banner, records PUBs, feeds MSGs to SUBs."""
+
+    def __init__(self, feed: list[bytes] = (), close_after_feed: bool = False):
+        self.published: list = []
+        self.feed = list(feed)
+        self.close_after_feed = close_after_feed
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(4)
+        self.port = self.sock.getsockname()[1]
+
+        def handle(c):
+            try:
+                c.sendall(b'INFO {"server_id":"mock"}\r\n')
+                buf = b""
+                subscribed = False
+                while True:
+                    if subscribed and self.feed:
+                        payload = self.feed.pop(0)
+                        c.sendall(
+                            f"MSG topic 1 {len(payload)}\r\n".encode() + payload + b"\r\n"
+                        )
+                        if not self.feed and self.close_after_feed:
+                            return  # simulate end-of-stream for the reader
+                        continue
+                    try:
+                        chunk = c.recv(65536)
+                    except OSError:
+                        return
+                    if not chunk:
+                        return
+                    buf += chunk
+                    while b"\r\n" in buf:
+                        line, buf = buf.split(b"\r\n", 1)
+                        if line.startswith(b"PUB "):
+                            n = int(line.decode().split(" ")[-1])
+                            while len(buf) < n + 2:
+                                buf += c.recv(65536)
+                            self.published.append((line.decode(), buf[:n]))
+                            buf = buf[n + 2 :]
+                        elif line.startswith(b"SUB "):
+                            subscribed = True
+            except OSError:
+                pass
+            finally:
+                c.close()
+
+        import threading
+
+        def serve():
+            while True:
+                try:
+                    conn, _ = self.sock.accept()
+                except OSError:
+                    return
+                threading.Thread(target=handle, args=(conn,), daemon=True).start()
+
+        threading.Thread(target=serve, daemon=True).start()
+
+    def close(self):
+        self.sock.close()
+
+
+def test_nats_write():
+    srv = MockNats()
+    try:
+        t = T("a | b\n1 | x")
+        pw.io.nats.write(t, f"nats://127.0.0.1:{srv.port}", topic="out.stream")
+        pw.run()
+        import time as _t
+
+        deadline = _t.monotonic() + 5
+        while not srv.published and _t.monotonic() < deadline:
+            _t.sleep(0.05)
+        assert srv.published
+        header, payload = srv.published[0]
+        assert header.startswith("PUB out.stream ")
+        obj = json.loads(payload)
+        assert obj["a"] == 1 and obj["b"] == "x" and obj["diff"] == 1
+    finally:
+        srv.close()
+
+
+def test_nats_read():
+    msgs = [json.dumps({"v": i}).encode() for i in (10, 20, 30)]
+    srv = MockNats(feed=msgs, close_after_feed=True)
+    try:
+        t = pw.io.nats.read(
+            f"nats://127.0.0.1:{srv.port}",
+            topic="topic",
+            schema=pw.schema_from_types(v=int),
+        )
+        got = []
+        pw.io.subscribe(
+            t,
+            on_change=lambda key, row, time, is_addition: got.append(row["v"]),
+        )
+        pw.run()
+        assert sorted(got) == [10, 20, 30]
+    finally:
+        srv.close()

@@ -240,3 +240,34 @@ def test_multiprocess_fs_partitioned(tmp_path):
     assert combined == expected
     got_lines = sorted(json.loads(k)["data"] for k in combined)
     assert got_lines == sorted(all_lines)
+
+
+def test_peer_hosts_mesh_localhost():
+    """PATHWAY_PEER_HOSTS path: explicit per-worker hostnames (here all
+    localhost) — the addressing mode k8s pods use."""
+    import threading
+
+    from pathway_tpu.engine.comm import TcpMesh
+
+    port = _free_port_base()
+    hosts = ["127.0.0.1", "localhost", "127.0.0.1"]
+    results = {}
+
+    def worker(wid):
+        mesh = TcpMesh(wid, 3, port, peer_hosts=hosts).start()
+        try:
+            got = mesh.gather(("t", 1), wid * 10)
+            if wid == 0:
+                results["gathered"] = got
+            val = mesh.bcast(("b", 1), sum(got) if wid == 0 else None)
+            results[wid] = val
+        finally:
+            mesh.close()
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert results["gathered"] == [0, 10, 20]
+    assert results[0] == results[1] == results[2] == 30
