@@ -1,11 +1,140 @@
-"""PyFilesystem sources connector (parity: python/pathway/io/pyfilesystem).
+"""Virtual-filesystem source connector (parity: python/pathway/io/pyfilesystem).
 
-The engine-side binding is gated on the optional ``fs`` client package,
-which is not part of this environment; the API surface matches the
-reference so pipelines import and typecheck unchanged.
+Reads objects from any filesystem abstraction: a PyFilesystem2 ``FS``
+object (``walk.files``/``readbytes``), an fsspec filesystem (``find``/
+``cat_file`` — fsspec ships in this image, covering memory://, zip, local,
+and any installed remote protocols), or anything duck-typing either API.
+Emits one row per object: path, raw bytes, and modification stamp.
 """
 
-from pathway_tpu.io._gated import gated_reader, gated_writer
+from __future__ import annotations
 
-read = gated_reader("pyfilesystem", "fs")
-write = gated_writer("pyfilesystem", "fs")
+import time as _time
+from typing import Any
+
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import _utils
+from pathway_tpu.io._utils import COMMIT, Offset, Reader
+
+__all__ = ["read"]
+
+
+def _list_files(source: Any, path: str) -> list[str]:
+    # detect by the reading API, not `walk`: fsspec also has a walk METHOD
+    if hasattr(source, "readbytes"):  # PyFilesystem2
+        return sorted(source.walk.files(path or "/"))
+    if hasattr(source, "cat_file"):  # fsspec
+        return sorted(source.find(path or ""))
+    raise TypeError(
+        "pyfilesystem source must expose walk.files/readbytes (PyFilesystem) "
+        "or find/cat_file (fsspec)"
+    )
+
+
+def _read_bytes(source: Any, path: str) -> bytes:
+    if hasattr(source, "readbytes"):
+        return source.readbytes(path)
+    if hasattr(source, "cat_file"):
+        return source.cat_file(path)
+    raise TypeError("source cannot read files")
+
+
+def _modified(source: Any, path: str) -> str:
+    try:
+        if hasattr(source, "getinfo"):  # PyFilesystem2
+            info = source.getinfo(path, namespaces=["details"])
+            m = info.modified
+            return m.isoformat() if m is not None else ""
+        if hasattr(source, "info"):  # fsspec
+            info = source.info(path)
+            m = info.get("mtime") or info.get("LastModified") or info.get("created")
+            return str(m) if m is not None else ""
+    except Exception:
+        pass
+    return ""
+
+
+class _VfsReader(Reader):
+    supports_offsets = True
+
+    def __init__(self, source: Any, path: str, format: str, mode: str, refresh_interval: float):
+        self.source = source
+        self.path = path
+        self.format = format
+        self.mode = mode
+        self.refresh_interval = refresh_interval
+        self._done: dict[str, str] = {}  # path -> modified stamp
+
+    def seek(self, offset: Any) -> None:
+        self._done = dict(offset.get("files", {}))
+
+    def _offset(self) -> Offset:
+        return Offset({"files": dict(self._done)})
+
+    def run(self, emit) -> None:
+        from pathway_tpu.io._utils import DELETE
+
+        while True:
+            seen = set()
+            changed = False
+            for p in _list_files(self.source, self.path):
+                seen.add(p)
+                stamp = _modified(self.source, p)
+                if self._done.get(p) == stamp and p in self._done:
+                    continue
+                data = _read_bytes(self.source, p)
+                if self.format != "binary":
+                    data = data.decode("utf-8", errors="replace")
+                # _pw_key = path: the input session runs in upsert mode, so
+                # a re-read modified file REPLACES its previous row (the
+                # engine retracts the old contents itself)
+                emit(
+                    {"data": data, "path": p, "modified_at": stamp, "_pw_key": p}
+                )
+                self._done[p] = stamp
+                changed = True
+            # deleted files leave the table
+            for gone in [p for p in self._done if p not in seen]:
+                emit({"_pw_key": gone, DELETE: True, "path": gone})
+                del self._done[gone]
+                changed = True
+            if changed:
+                emit(self._offset())
+                emit(COMMIT)
+            if self.mode == "static":
+                return
+            _time.sleep(self.refresh_interval)
+
+
+def read(
+    source: Any,
+    path: str = "",
+    *,
+    format: str = "binary",
+    mode: str = "streaming",
+    refresh_interval: float = 30.0,
+    autocommit_duration_ms: int | None = 1500,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    """Read every object under ``path`` of a virtual filesystem.
+
+    Reference: ``pw.io.pyfilesystem.read`` (python/pathway/io/pyfilesystem).
+    """
+    value_type = bytes if format == "binary" else str
+    schema = schema_mod.schema_from_columns(
+        {
+            "data": schema_mod.ColumnSchema(name="data", dtype=dt.wrap(value_type)),
+            "path": schema_mod.ColumnSchema(name="path", dtype=dt.STR),
+            "modified_at": schema_mod.ColumnSchema(name="modified_at", dtype=dt.STR),
+        }
+    )
+    return _utils.make_input_table(
+        schema,
+        lambda: _VfsReader(source, path, format, mode, refresh_interval),
+        autocommit_duration_ms=autocommit_duration_ms,
+        upsert=True,  # modified objects replace their previous row
+        name=name,
+    )

@@ -1,11 +1,95 @@
-"""Slack notifications connector (parity: python/pathway/io/slack).
+"""Slack notifications connector (parity: python/pathway/io/slack —
+``send_alerts`` posting row messages to a channel).
 
-The engine-side binding is gated on the optional ``aiohttp`` client package,
-which is not part of this environment; the API surface matches the
-reference so pipelines import and typecheck unchanged.
+Posts through the documented ``chat.postMessage`` REST endpoint over
+``http.client`` — no client library needed.
 """
 
-from pathway_tpu.io._gated import gated_reader, gated_writer
+from __future__ import annotations
 
-read = gated_reader("slack", "aiohttp")
-write = gated_writer("slack", "aiohttp")
+import http.client
+import json as _json
+import threading
+from typing import Any
+
+from pathway_tpu.internals.table import Table
+from pathway_tpu.io import _utils
+
+__all__ = ["send_alerts"]
+
+
+class _SlackSink:
+    def __init__(self, channel: str, token: str, host: str = "slack.com"):
+        self.channel = channel
+        self.token = token
+        self.host = host
+        self._pending: list[str] = []
+        self._lock = threading.Lock()
+
+    def add(self, text: str) -> None:
+        with self._lock:
+            self._pending.append(text)
+
+    def flush(self, _time: int | None = None) -> None:
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                text = self._pending[0]
+            conn = http.client.HTTPSConnection(self.host, timeout=30)
+            try:
+                conn.request(
+                    "POST",
+                    "/api/chat.postMessage",
+                    body=_json.dumps({"channel": self.channel, "text": text}).encode(),
+                    headers={
+                        "Content-Type": "application/json; charset=utf-8",
+                        "Authorization": f"Bearer {self.token}",
+                    },
+                )
+                resp = conn.getresponse()
+                payload = _json.loads(resp.read() or b"{}")
+                if resp.status >= 300 or not payload.get("ok", False):
+                    raise RuntimeError(
+                        f"slack postMessage failed: {payload.get('error', resp.status)}"
+                    )
+            finally:
+                conn.close()
+            # drain only after the message durably posted
+            with self._lock:
+                self._pending.pop(0)
+
+
+def send_alerts(
+    alerts: Table,
+    slack_channel_id: str,
+    slack_token: str,
+    *,
+    name: str | None = None,
+    _sink_factory: Any = None,
+) -> None:
+    """Post each new row's first column as a message to a Slack channel.
+
+    Reference: ``pw.io.slack.send_alerts`` (python/pathway/io/slack).
+    """
+    names = alerts.column_names()
+    sink = (_sink_factory or _SlackSink)(slack_channel_id, slack_token)
+
+    def on_data(key, row, time, diff):
+        if diff <= 0:
+            return  # alerts are append-only; retractions are not re-posted
+        if len(names) == 1 and isinstance(row[0], str):
+            text = row[0]
+        else:
+            text = _json.dumps(
+                {n: _utils.plain_value(v) for n, v in zip(names, row)}
+            )
+        sink.add(text)
+
+    _utils.register_output(
+        alerts,
+        on_data,
+        on_time_end=sink.flush,
+        on_end=sink.flush,
+        name=name or f"slack:{slack_channel_id}",
+    )

@@ -834,3 +834,156 @@ def test_nats_read():
         assert sorted(got) == [10, 20, 30]
     finally:
         srv.close()
+
+
+# ---------------------------------------------------------------------------
+# slack / deltalake / pyfilesystem
+# ---------------------------------------------------------------------------
+
+
+def test_slack_send_alerts():
+    posted = []
+
+    class FakeSink:
+        def __init__(self, channel, token):
+            self.channel = channel
+
+        def add(self, text):
+            posted.append(text)
+
+        def flush(self, _t=None):
+            pass
+
+    t = T("msg\nalert-one\nalert-two")
+    pw.io.slack.send_alerts(t, "C123", "xoxb-token", _sink_factory=FakeSink)
+    pw.run()
+    assert sorted(posted) == ["alert-one", "alert-two"]
+
+
+def test_deltalake_roundtrip(tmp_path):
+    uri = str(tmp_path / "dl")
+    t = T(
+        """
+          | k | v | _time | _diff
+        A | 1 | a | 2     | 1
+        B | 2 | b | 2     | 1
+        A | 1 | a | 4     | -1
+        C | 1 | z | 4     | 1
+        """
+    )
+    pw.io.deltalake.write(t, uri)
+    pw.run()
+
+    import os
+
+    log_files = sorted(os.listdir(os.path.join(uri, "_delta_log")))
+    assert log_files[0] == f"{0:020d}.json"
+    assert len(log_files) >= 2  # metadata + at least one data commit
+
+    pw.G.clear()
+
+    class S(pw.Schema):
+        k: int
+        v: str
+
+    back = pw.io.deltalake.read(uri, schema=S, mode="static")
+    got = sorted(
+        pw.debug.table_to_pandas(back, include_id=False).itertuples(index=False)
+    )
+    # the retraction of (1, a) cancels it; final state is (1, z), (2, b)
+    assert [tuple(r) for r in got] == [(1, "z"), (2, "b")]
+
+
+def test_deltalake_read_raw_change_stream(tmp_path):
+    uri = str(tmp_path / "dl2")
+    t = T("k\n7")
+    pw.io.deltalake.write(t, uri)
+    pw.run()
+    pw.G.clear()
+    back = pw.io.deltalake.read(
+        uri, schema=pw.schema_from_types(k=int, time=int, diff=int), mode="static"
+    )
+    df = pw.debug.table_to_pandas(back, include_id=False)
+    assert df["k"].tolist() == [7] and df["diff"].tolist() == [1]
+
+
+def test_pyfilesystem_read_fsspec_memory():
+    import fsspec
+
+    mem = fsspec.filesystem("memory")
+    mem.pipe_file("/vfs-test/a.txt", b"hello")
+    mem.pipe_file("/vfs-test/sub/b.txt", b"world")
+    try:
+        t = pw.io.pyfilesystem.read(mem, "/vfs-test", format="plaintext", mode="static")
+        df = pw.debug.table_to_pandas(t, include_id=False)
+        assert sorted(df["data"].tolist()) == ["hello", "world"]
+        assert all(p.lstrip("/").startswith("vfs-test") for p in df["path"])
+    finally:
+        mem.rm("/vfs-test", recursive=True)
+
+
+def test_deltalake_remove_action_retracts(tmp_path):
+    uri = str(tmp_path / "dl3")
+    t = T("k | v\n1 | a\n2 | b")
+    pw.io.deltalake.write(t, uri)
+    pw.run()
+    pw.G.clear()
+    # a foreign writer removes the data file (e.g. a DELETE/overwrite)
+    import json as _j
+    import os
+
+    log = os.path.join(uri, "_delta_log")
+    versions = sorted(os.listdir(log))
+    adds = []
+    for f in versions:
+        with open(os.path.join(log, f)) as fh:
+            for line in fh:
+                a = _j.loads(line)
+                if "add" in a:
+                    adds.append(a["add"]["path"])
+    nxt = os.path.join(log, f"{len(versions):020d}.json")
+    with open(nxt, "w") as fh:
+        fh.write(_j.dumps({"remove": {"path": adds[0], "dataChange": True}}) + "\n")
+
+    back = pw.io.deltalake.read(
+        uri, schema=pw.schema_from_types(k=int, v=str), mode="static"
+    )
+    assert pw.debug.table_to_pandas(back, include_id=False).empty
+
+
+def test_deltalake_reserved_column_rejected(tmp_path):
+    t = T("time | v\n1 | a")
+    with pytest.raises(ValueError, match="collide"):
+        pw.io.deltalake.write(t, str(tmp_path / "dl4"))
+
+
+def test_pyfilesystem_modified_file_replaces_row():
+    import fsspec
+
+    mem = fsspec.filesystem("memory")
+    mem.pipe_file("/vfs-upd/a.txt", b"old")
+    try:
+        from pathway_tpu.io.pyfilesystem import _VfsReader
+        from pathway_tpu.io._utils import DELETE, Offset
+
+        reader = _VfsReader(mem, "/vfs-upd", "plaintext", "static", 0.1)
+        got1 = []
+        reader.run(lambda i: got1.append(i) if isinstance(i, dict) else None)
+        assert [r["data"] for r in got1] == ["old"]
+        # overwrite and delete between polls
+        mem.pipe_file("/vfs-upd/a.txt", b"new")
+        got2 = []
+        reader.run(lambda i: got2.append(i) if isinstance(i, dict) else None)
+        # re-emitted under the SAME key (upsert replaces the old row)
+        assert [(r["data"], r["_pw_key"]) for r in got2] == [
+            ("new", got1[0]["_pw_key"])
+        ]
+        mem.rm("/vfs-upd/a.txt")
+        got3 = []
+        reader.run(lambda i: got3.append(i) if isinstance(i, dict) else None)
+        assert got3 and got3[0].get(DELETE) is True
+    finally:
+        try:
+            mem.rm("/vfs-upd", recursive=True)
+        except FileNotFoundError:
+            pass
