@@ -123,27 +123,46 @@ class _DeltaSink:
                 }
             },
         ]
-        won = self._commit(0, actions)
-        if won != 0:
-            # another worker created the table first — its metadata stands;
-            # our protocol/metaData actions landed as a harmless no-op entry
-            pass
-        self._version = won
+        tmp = self._write_tmp(actions)
+        try:
+            if self._claim(tmp, _version_path(self.uri, 0)):
+                self._version = 0
+            else:
+                # another worker created the table first — adopt its
+                # metadata; committing our own metaData action would REPLACE
+                # the table id for spec-conforming readers
+                self._version = max(_list_versions(self.uri))
+        finally:
+            os.unlink(tmp)
+
+    def _write_tmp(self, actions: list[dict]) -> str:
+        tmp = os.path.join(_log_dir(self.uri), f".{uuid.uuid4().hex}.tmp")
+        with open(tmp, "w") as f:
+            f.write("".join(_json.dumps(a) + "\n" for a in actions))
+            f.flush()
+            os.fsync(f.fileno())
+        return tmp
+
+    @staticmethod
+    def _claim(tmp: str, path: str) -> bool:
+        """Atomically publish tmp as path iff path does not exist yet —
+        hardlink gives create-if-absent AND full-content visibility (readers
+        never observe a half-written log entry)."""
+        try:
+            os.link(tmp, path)
+            return True
+        except FileExistsError:
+            return False
 
     def _commit(self, version: int, actions: list[dict]) -> int:
-        """Atomically claim the next version (Delta's create-if-absent rule);
-        on a lost race, advance past the winner and retry."""
-        data = "".join(_json.dumps(a) + "\n" for a in actions)
-        while True:
-            path = _version_path(self.uri, version)
-            try:
-                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            except FileExistsError:
+        """Claim the next free version for these actions."""
+        tmp = self._write_tmp(actions)
+        try:
+            while not self._claim(tmp, _version_path(self.uri, version)):
                 version += 1
-                continue
-            with os.fdopen(fd, "w") as f:
-                f.write(data)
             return version
+        finally:
+            os.unlink(tmp)
 
     def add(self, row: tuple) -> None:
         with self._lock:
@@ -223,8 +242,11 @@ class _DeltaReader(Reader):
         import pyarrow.parquet as pq
 
         full = os.path.join(self.uri, part)
-        if invert and not os.path.exists(full):
-            return  # already vacuumed: nothing to retract from
+        if not os.path.exists(full):
+            # vacuumed: the file was removed by a later version and
+            # physically deleted.  Skipping BOTH its add (here) and its
+            # remove keeps the replayed snapshot consistent.
+            return
         for rec in pq.read_table(full).to_pylist():
             row = {n: rec.get(n) for n in names}
             stored_key = rec.get("_pw_key")
@@ -240,9 +262,37 @@ class _DeltaReader(Reader):
                 row[DELETE] = True
             emit(row)
 
+    def _load_checkpoint(self, names, has_diff_col, emit) -> None:
+        """Foreign tables compact old log entries into parquet checkpoints
+        (`_last_checkpoint` → `<N>.checkpoint.parquet`, holding the
+        reconciled live add set); expired JSON versions are deleted, so a
+        reader that only replays JSON would silently miss pre-checkpoint
+        rows."""
+        import pyarrow.parquet as pq
+
+        marker = os.path.join(_log_dir(self.uri), "_last_checkpoint")
+        if not os.path.exists(marker):
+            return
+        with open(marker) as f:
+            info = _json.loads(f.read())
+        version = int(info["version"])
+        if version <= self._applied_version:
+            return
+        cp = os.path.join(
+            _log_dir(self.uri), f"{version:020d}.checkpoint.parquet"
+        )
+        for rec in pq.read_table(cp).to_pylist():
+            add = rec.get("add")
+            if add and add.get("path"):
+                self._emit_file(add["path"], names, has_diff_col, emit, invert=False)
+        self._applied_version = version
+        emit(self._offset())
+        emit(COMMIT)
+
     def run(self, emit) -> None:
         names = list(self.schema.__columns__.keys())
         has_diff_col = "diff" in names
+        self._load_checkpoint(names, has_diff_col, emit)
         while True:
             versions = [
                 v for v in _list_versions(self.uri) if v > self._applied_version

@@ -42,18 +42,25 @@ def _read_bytes(source: Any, path: str) -> bytes:
 
 
 def _modified(source: Any, path: str) -> str:
+    """Change stamp for a file: mtime when the filesystem has one, else
+    size — an empty constant stamp would make modified files invisible to
+    the streaming re-read check forever."""
+    size = ""
     try:
         if hasattr(source, "getinfo"):  # PyFilesystem2
             info = source.getinfo(path, namespaces=["details"])
-            m = info.modified
-            return m.isoformat() if m is not None else ""
-        if hasattr(source, "info"):  # fsspec
+            if info.modified is not None:
+                return info.modified.isoformat()
+            size = f"sz:{info.size}"
+        elif hasattr(source, "info"):  # fsspec
             info = source.info(path)
             m = info.get("mtime") or info.get("LastModified") or info.get("created")
-            return str(m) if m is not None else ""
+            if m is not None:
+                return str(m)
+            size = f"sz:{info.get('size', '')}"
     except Exception:
         pass
-    return ""
+    return size
 
 
 class _VfsReader(Reader):

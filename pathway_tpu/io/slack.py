@@ -30,31 +30,62 @@ class _SlackSink:
         with self._lock:
             self._pending.append(text)
 
+    MAX_ATTEMPTS = 5
+
+    def _post_once(self, text: str) -> tuple[bool, float, str]:
+        """(posted, retry_after_s, error) — retryable failures return
+        posted=False instead of raising."""
+        import time as _t  # noqa: F401 — kept local for monkeypatching
+
+        conn = http.client.HTTPSConnection(self.host, timeout=30)
+        try:
+            conn.request(
+                "POST",
+                "/api/chat.postMessage",
+                body=_json.dumps({"channel": self.channel, "text": text}).encode(),
+                headers={
+                    "Content-Type": "application/json; charset=utf-8",
+                    "Authorization": f"Bearer {self.token}",
+                },
+            )
+            resp = conn.getresponse()
+            raw = resp.read()
+            try:
+                payload = _json.loads(raw or b"{}")
+            except ValueError:
+                payload = {}
+            if resp.status < 300 and payload.get("ok", False):
+                return True, 0.0, ""
+            err = str(payload.get("error", resp.status))
+            # rate limits and server errors are routine for an alert burst
+            # (chat.postMessage allows ~1 msg/s) — retry, don't kill the
+            # monitoring pipeline
+            if resp.status == 429 or err == "ratelimited" or resp.status >= 500:
+                retry_after = float(resp.headers.get("Retry-After", 1.0) or 1.0)
+                return False, retry_after, err
+            raise RuntimeError(f"slack postMessage failed: {err}")
+        finally:
+            conn.close()
+
     def flush(self, _time: int | None = None) -> None:
+        import time as _t
+
         while True:
             with self._lock:
                 if not self._pending:
                     return
                 text = self._pending[0]
-            conn = http.client.HTTPSConnection(self.host, timeout=30)
-            try:
-                conn.request(
-                    "POST",
-                    "/api/chat.postMessage",
-                    body=_json.dumps({"channel": self.channel, "text": text}).encode(),
-                    headers={
-                        "Content-Type": "application/json; charset=utf-8",
-                        "Authorization": f"Bearer {self.token}",
-                    },
+            last_err = ""
+            for attempt in range(self.MAX_ATTEMPTS):
+                posted, retry_after, last_err = self._post_once(text)
+                if posted:
+                    break
+                _t.sleep(min(retry_after * (attempt + 1), 30.0))
+            else:
+                raise RuntimeError(
+                    f"slack postMessage failed after {self.MAX_ATTEMPTS} "
+                    f"attempts: {last_err}"
                 )
-                resp = conn.getresponse()
-                payload = _json.loads(resp.read() or b"{}")
-                if resp.status >= 300 or not payload.get("ok", False):
-                    raise RuntimeError(
-                        f"slack postMessage failed: {payload.get('error', resp.status)}"
-                    )
-            finally:
-                conn.close()
             # drain only after the message durably posted
             with self._lock:
                 self._pending.pop(0)

@@ -47,11 +47,14 @@ if _HAVE_JAX:
         between them.  cos/ip run the matmul in bfloat16 (MXU-native);
         l2sq stays float32 (catastrophic cancellation in bf16).
         """
-        m = matrix.astype(jnp.bfloat16)
-        q = queries.astype(jnp.bfloat16)
+        # bf16 is MXU-native; on CPU it is software-emulated and far slower
+        # than f32, so the fallback path keeps the native dtype
+        mm_dtype = jnp.bfloat16 if jax.default_backend() not in ("cpu",) else jnp.float32
+        m = matrix.astype(mm_dtype)
+        q = queries.astype(mm_dtype)
         if metric == "cos":
-            mn = m / (jnp.linalg.norm(m, axis=1, keepdims=True).astype(jnp.bfloat16) + 1e-6)
-            qn = q / (jnp.linalg.norm(q, axis=1, keepdims=True).astype(jnp.bfloat16) + 1e-6)
+            mn = m / (jnp.linalg.norm(m, axis=1, keepdims=True).astype(mm_dtype) + 1e-6)
+            qn = q / (jnp.linalg.norm(q, axis=1, keepdims=True).astype(mm_dtype) + 1e-6)
             return (qn @ mn.T).astype(jnp.float32)
         if metric == "ip":
             return (q @ m.T).astype(jnp.float32)
@@ -66,8 +69,12 @@ if _HAVE_JAX:
 
     @functools.partial(jax.jit, static_argnames=("metric", "k"))
     def _masked_topk_jax(matrix, mask, queries, metric: str, k: int):
-        scores = _score_jax(matrix, queries, metric) + mask[None, :]
-        return jax.lax.top_k(scores, k)
+        scores = score_block(matrix, queries, metric)
+        # keep the dot out of the top_k fusion: XLA (notably on CPU) would
+        # otherwise inline the GEMM into the sort fusion and lose the fast
+        # matmul path — measured 18x slower without the barrier
+        scores = jax.lax.optimization_barrier(scores)
+        return jax.lax.top_k(scores + mask[None, :], k)
 
     @functools.partial(jax.jit, static_argnames=("k",))
     def _topk_jax(scores, k: int):
@@ -92,6 +99,7 @@ class DeviceIndexCache:
     def __init__(self, mesh=None):
         self.mesh = mesh
         self._version = -1
+        self._metric = None
         self._padded = None
         self._mask = None
         self._n = 0
@@ -104,7 +112,7 @@ class DeviceIndexCache:
             n *= self.mesh.shape[ax]
         return n
 
-    def get(self, matrix: np.ndarray, version: int):
+    def get(self, matrix: np.ndarray, version: int, metric: str = "raw"):
         if not _HAVE_JAX:
             return None
         n = matrix.shape[0]
@@ -115,11 +123,18 @@ class DeviceIndexCache:
         if (
             self._padded is None
             or version != self._version
+            or metric != self._metric
             or self._padded.shape[0] != cap
             or self._padded.shape[1] != matrix.shape[1]
         ):
             padded = np.zeros((cap, matrix.shape[1]), dtype=np.float32)
             padded[:n] = matrix
+            if metric == "cos":
+                # normalize ONCE at build: the query kernel then runs a
+                # plain inner product — re-normalizing the corpus per query
+                # would add a full HBM sweep to every search
+                norms = np.linalg.norm(padded[:n], axis=1, keepdims=True)
+                padded[:n] /= np.maximum(norms, 1e-12)
             mask = np.full((cap,), -np.inf, dtype=np.float32)
             mask[:n] = 0.0
             if self.mesh is not None:
@@ -134,6 +149,7 @@ class DeviceIndexCache:
                 self._padded = jax.device_put(jnp.asarray(padded))
                 self._mask = jax.device_put(jnp.asarray(mask))
             self._version = version
+            self._metric = metric
             self._n = n
         return self._padded, self._mask, self._n
 
@@ -156,7 +172,14 @@ def topk_search_cached(
         )
         idx = np.argsort(-scores, kind="stable", axis=1)[:, :k_eff]
         return idx, np.take_along_axis(scores, idx, axis=1)
-    device_matrix, mask, _n = cache.get(matrix, version)
+    device_matrix, mask, _n = cache.get(matrix, version, metric)
+    q = queries.astype(np.float32)
+    kernel_metric = metric
+    if metric == "cos":
+        # the cached matrix is pre-normalized; normalize the (tiny) query
+        # batch on host and run the kernel as a plain inner product
+        q = q / np.maximum(np.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+        kernel_metric = "ip"
     if cache.mesh is not None:
         from pathway_tpu.parallel.index import sharded_topk
 
@@ -164,13 +187,13 @@ def topk_search_cached(
             cache.mesh,
             device_matrix,
             mask,
-            jnp.asarray(queries.astype(np.float32)),
+            jnp.asarray(q),
             k_eff,
-            metric,
+            kernel_metric,
         )
         return np.asarray(idx), np.asarray(vals)
     vals, idx = _masked_topk_jax(
-        device_matrix, mask, jnp.asarray(queries.astype(np.float32)), metric, k_eff
+        device_matrix, mask, jnp.asarray(q), kernel_metric, k_eff
     )
     return np.asarray(idx), np.asarray(vals)
 

@@ -49,7 +49,10 @@ def _sharded_topk_impl(
         # (ops/topk.py score_block) bit-for-bit
         from pathway_tpu.ops.topk import score_block
 
-        scores = score_block(docs_blk, q, metric) + mask_blk[None, :]
+        scores = score_block(docs_blk, q, metric)
+        # keep the GEMM out of the top_k fusion (see ops/topk.py — 18x on
+        # the CPU backend, harmless on TPU)
+        scores = lax.optimization_barrier(scores) + mask_blk[None, :]
         vals, idx = lax.top_k(scores, k_local)
         shard = _flat_axis_index(axes, mesh)
         idx = idx + shard * docs_blk.shape[0]

@@ -987,3 +987,61 @@ def test_pyfilesystem_modified_file_replaces_row():
             mem.rm("/vfs-upd", recursive=True)
         except FileNotFoundError:
             pass
+
+
+def test_deltalake_reads_checkpointed_table(tmp_path):
+    # a foreign table whose early log entries were compacted into a parquet
+    # checkpoint and expired — the reader must pick up the checkpoint state
+    import json as _j
+    import os
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    uri = str(tmp_path / "dl5")
+    log = os.path.join(uri, "_delta_log")
+    os.makedirs(log)
+    # data file referenced only by the checkpoint
+    pq.write_table(pa.table({"k": [1, 2], "v": ["a", "b"]}), os.path.join(uri, "old.parquet"))
+    cp = pa.table(
+        {
+            "add": [
+                {"path": "old.parquet", "size": 1, "dataChange": True},
+                None,
+            ],
+            "metaData": [None, {"id": "t1"}],
+        }
+    )
+    pq.write_table(cp, os.path.join(log, f"{5:020d}.checkpoint.parquet"))
+    with open(os.path.join(log, "_last_checkpoint"), "w") as f:
+        f.write(_j.dumps({"version": 5, "size": 2}))
+    # one post-checkpoint JSON commit
+    pq.write_table(pa.table({"k": [3], "v": ["c"]}), os.path.join(uri, "new.parquet"))
+    with open(os.path.join(log, f"{6:020d}.json"), "w") as f:
+        f.write(_j.dumps({"add": {"path": "new.parquet", "dataChange": True}}) + "\n")
+
+    back = pw.io.deltalake.read(
+        uri, schema=pw.schema_from_types(k=int, v=str), mode="static"
+    )
+    got = sorted(pw.debug.table_to_pandas(back, include_id=False).itertuples(index=False))
+    assert [tuple(r) for r in got] == [(1, "a"), (2, "b"), (3, "c")]
+
+
+def test_deltalake_vacuumed_file_tolerated(tmp_path):
+    import json as _j
+    import os
+
+    uri = str(tmp_path / "dl6")
+    t = T("k\n1")
+    pw.io.deltalake.write(t, uri)
+    pw.run()
+    pw.G.clear()
+    # simulate vacuum: remove-action committed AND the file physically gone
+    log = os.path.join(uri, "_delta_log")
+    parts = [f for f in os.listdir(uri) if f.endswith(".parquet")]
+    versions = len(os.listdir(log))
+    with open(os.path.join(log, f"{versions:020d}.json"), "w") as f:
+        f.write(_j.dumps({"remove": {"path": parts[0], "dataChange": True}}) + "\n")
+    os.remove(os.path.join(uri, parts[0]))
+    back = pw.io.deltalake.read(uri, schema=pw.schema_from_types(k=int), mode="static")
+    assert pw.debug.table_to_pandas(back, include_id=False).empty
