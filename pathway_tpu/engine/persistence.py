@@ -183,6 +183,51 @@ class MemoryBackend(BlobBackend):
             self.store.pop(key, None)
 
 
+class S3Backend(BlobBackend):
+    """Object-storage persistence (backends/s3.rs analog) over the signed
+    REST client in ``io/_s3http.py`` — works against AWS S3 and any
+    S3-compatible endpoint (MinIO).  S3 PUTs are atomic per object (readers
+    see the whole object or none), so ``put_atomic`` is plain ``put``."""
+
+    def __init__(self, client: Any, prefix: str = ""):
+        self.client = client
+        self.prefix = prefix.strip("/")
+
+    def _key(self, key: str) -> str:
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def put(self, key: str, data: bytes) -> None:
+        self.client.put_object(self._key(key), data)
+
+    def get(self, key: str) -> bytes | None:
+        from pathway_tpu.io._s3http import S3Error
+
+        try:
+            return self.client.get_object(self._key(key))
+        except S3Error as exc:
+            if exc.status == 404:
+                return None
+            # a transient 5xx/403 must NOT read as "no snapshot" — that
+            # would silently restart the pipeline from scratch
+            raise
+
+    def list_keys(self, prefix: str) -> list[str]:
+        full = self._key(prefix)
+        strip = len(self.prefix) + 1 if self.prefix else 0
+        return sorted(
+            o["key"][strip:] for o in self.client.list_objects(full)
+        )
+
+    def delete(self, key: str) -> None:
+        from pathway_tpu.io._s3http import S3Error
+
+        try:
+            self.client.delete_object(self._key(key))
+        except S3Error as exc:
+            if exc.status != 404:
+                raise
+
+
 def backend_from_config(backend_cfg: Any) -> BlobBackend:
     """Build an engine backend from the user-facing ``pw.persistence.Backend``."""
     kind = getattr(backend_cfg, "kind", None)
@@ -192,10 +237,16 @@ def backend_from_config(backend_cfg: Any) -> BlobBackend:
         store = getattr(backend_cfg, "store", None)
         return MemoryBackend(store if isinstance(store, dict) else {})
     if kind == "s3":
-        raise NotImplementedError(
-            "persistence.Backend.s3 requires an S3 client library, which is "
-            "not available in this environment; use filesystem or mock"
-        )
+        from pathway_tpu.io._s3http import AwsS3Settings
+
+        settings = getattr(backend_cfg, "bucket_settings", None) or AwsS3Settings()
+        path = getattr(backend_cfg, "path", "") or ""
+        if path.startswith("s3://"):
+            rest = path[5:]
+            bucket, _, prefix = rest.partition("/")
+        else:
+            bucket, prefix = settings.bucket_name, path
+        return S3Backend(settings.client(bucket), prefix)
     if kind == "azure":
         raise NotImplementedError("azure persistence backend is not available")
     raise ValueError(f"unknown persistence backend {backend_cfg!r}")

@@ -19,7 +19,9 @@ from typing import Any
 
 
 class S3Error(RuntimeError):
-    pass
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = status
 
 
 def _sign(key: bytes, msg: str) -> bytes:
@@ -61,11 +63,13 @@ class S3Client:
 
     # -- signing (SigV4) --
 
-    def _request(self, path: str, query: dict[str, str]) -> bytes:
+    def _request(
+        self, path: str, query: dict[str, str], method: str = "GET", body: bytes = b""
+    ) -> bytes:
         now = datetime.datetime.now(datetime.timezone.utc)
         amz_date = now.strftime("%Y%m%dT%H%M%SZ")
         datestamp = now.strftime("%Y%m%d")
-        payload_hash = hashlib.sha256(b"").hexdigest()
+        payload_hash = hashlib.sha256(body).hexdigest()
 
         canonical_query = "&".join(
             f"{urllib.parse.quote(k, safe='-_.~')}={urllib.parse.quote(str(v), safe='-_.~')}"
@@ -80,7 +84,7 @@ class S3Client:
         canonical_headers = "".join(f"{k}:{headers[k]}\n" for k in sorted(headers))
         canonical_request = "\n".join(
             [
-                "GET",
+                method,
                 urllib.parse.quote(path),
                 canonical_query,
                 canonical_headers,
@@ -123,12 +127,13 @@ class S3Client:
             }
             if self.access_key:
                 req_headers["Authorization"] = auth
-            conn.request("GET", url, headers=req_headers)
+            conn.request(method, url, body=body or None, headers=req_headers)
             resp = conn.getresponse()
             body = resp.read()
             if resp.status >= 300:
                 raise S3Error(
-                    f"S3 {resp.status} for {url}: {body[:500].decode(errors='replace')}"
+                    f"S3 {resp.status} for {url}: {body[:500].decode(errors='replace')}",
+                    status=resp.status,
                 )
             return body
         finally:
@@ -168,6 +173,12 @@ class S3Client:
 
     def get_object(self, key: str) -> bytes:
         return self._request(f"{self._base_path()}/{key}", {})
+
+    def put_object(self, key: str, data: bytes) -> None:
+        self._request(f"{self._base_path()}/{key}", {}, method="PUT", body=data)
+
+    def delete_object(self, key: str) -> None:
+        self._request(f"{self._base_path()}/{key}", {}, method="DELETE")
 
 
 class AwsS3Settings:

@@ -222,6 +222,10 @@ def write(
     )
 
 
+class DeltaReadError(RuntimeError):
+    pass
+
+
 class _DeltaReader(Reader):
     supports_offsets = True
 
@@ -231,6 +235,9 @@ class _DeltaReader(Reader):
         self.mode = mode
         self.poll_interval_s = poll_interval_s
         self._applied_version = -1
+        # live streaming: rows emitted per part file, kept so a remove of a
+        # since-vacuumed file can still retract exactly what was emitted
+        self._part_rows: dict[str, list[dict]] = {}
 
     def seek(self, offset: Any) -> None:
         self._applied_version = int(offset.get("version", -1))
@@ -238,16 +245,11 @@ class _DeltaReader(Reader):
     def _offset(self) -> Offset:
         return Offset({"version": self._applied_version})
 
-    def _emit_file(self, part: str, names, has_diff_col, emit, *, invert: bool) -> None:
+    def _read_rows(self, part: str, names, has_diff_col) -> list[dict]:
         import pyarrow.parquet as pq
 
-        full = os.path.join(self.uri, part)
-        if not os.path.exists(full):
-            # vacuumed: the file was removed by a later version and
-            # physically deleted.  Skipping BOTH its add (here) and its
-            # remove keeps the replayed snapshot consistent.
-            return
-        for rec in pq.read_table(full).to_pylist():
+        rows = []
+        for rec in pq.read_table(os.path.join(self.uri, part)).to_pylist():
             row = {n: rec.get(n) for n in names}
             stored_key = rec.get("_pw_key")
             if stored_key is not None and "_pw_key" not in names:
@@ -255,39 +257,70 @@ class _DeltaReader(Reader):
                 # they cancel
                 row["_pw_key"] = int(stored_key, 16)
             # change-stream tables: a stored diff of -1 is a retraction
-            # (unless the user asked for the raw diff column); removing a
-            # file inverts each of its rows
-            negative = (not has_diff_col and rec.get("diff", 1) < 0) != invert
-            if negative:
+            # unless the user asked for the raw diff column
+            if not has_diff_col and rec.get("diff", 1) < 0:
                 row[DELETE] = True
-            emit(row)
+            rows.append(row)
+        return rows
+
+    @staticmethod
+    def _invert(row: dict) -> dict:
+        out = dict(row)
+        if out.pop(DELETE, False):
+            return out  # retraction removed = the row comes back
+        out[DELETE] = True
+        return out
+
+    def _checkpoint_files(self, version: int, parts: int | None) -> list[str]:
+        log = _log_dir(self.uri)
+        if not parts:
+            return [os.path.join(log, f"{version:020d}.checkpoint.parquet")]
+        return [
+            os.path.join(
+                log, f"{version:020d}.checkpoint.{i + 1:010d}.{parts:010d}.parquet"
+            )
+            for i in range(parts)
+        ]
 
     def _load_checkpoint(self, names, has_diff_col, emit) -> None:
         """Foreign tables compact old log entries into parquet checkpoints
-        (`_last_checkpoint` → `<N>.checkpoint.parquet`, holding the
+        (`_last_checkpoint` → checkpoint parquet part(s), holding the
         reconciled live add set); expired JSON versions are deleted, so a
         reader that only replays JSON would silently miss pre-checkpoint
-        rows."""
+        rows.  Cold start only: a resumed reader already replayed versions
+        <= its offset from the persistence snapshot, and re-emitting the
+        checkpoint's live set would duplicate them."""
         import pyarrow.parquet as pq
 
         marker = os.path.join(_log_dir(self.uri), "_last_checkpoint")
-        if not os.path.exists(marker):
+        if not os.path.exists(marker) or self._applied_version >= 0:
             return
         with open(marker) as f:
             info = _json.loads(f.read())
         version = int(info["version"])
-        if version <= self._applied_version:
-            return
-        cp = os.path.join(
-            _log_dir(self.uri), f"{version:020d}.checkpoint.parquet"
-        )
-        for rec in pq.read_table(cp).to_pylist():
-            add = rec.get("add")
-            if add and add.get("path"):
-                self._emit_file(add["path"], names, has_diff_col, emit, invert=False)
+        for cp in self._checkpoint_files(version, info.get("parts")):
+            for rec in pq.read_table(cp).to_pylist():
+                add = rec.get("add")
+                if add and add.get("path"):
+                    for row in self._read_rows(add["path"], names, has_diff_col):
+                        emit(row)
         self._applied_version = version
         emit(self._offset())
         emit(COMMIT)
+
+    def _removed_later(self, from_version: int) -> set[str]:
+        """Paths removed by any currently-visible version > from_version."""
+        out: set[str] = set()
+        for v in _list_versions(self.uri):
+            if v <= from_version:
+                continue
+            with open(_version_path(self.uri, v)) as f:
+                for line in f:
+                    if line.strip():
+                        a = _json.loads(line)
+                        if a.get("remove"):
+                            out.add(a["remove"]["path"])
+        return out
 
     def run(self, emit) -> None:
         names = list(self.schema.__columns__.keys())
@@ -297,6 +330,13 @@ class _DeltaReader(Reader):
             versions = [
                 v for v in _list_versions(self.uri) if v > self._applied_version
             ]
+            if versions and self._applied_version >= 0 and versions[0] > self._applied_version + 1:
+                raise DeltaReadError(
+                    f"delta log gap: resumed at version {self._applied_version} "
+                    f"but the next available version is {versions[0]} — the "
+                    "intervening log entries were expired (checkpointed); "
+                    "cannot resume incrementally"
+                )
             for version in versions:
                 with open(_version_path(self.uri, version)) as f:
                     actions = [_json.loads(line) for line in f if line.strip()]
@@ -304,14 +344,35 @@ class _DeltaReader(Reader):
                     add = action.get("add")
                     removed = action.get("remove")
                     if add and add.get("dataChange", True):
-                        self._emit_file(add["path"], names, has_diff_col, emit, invert=False)
+                        part = add["path"]
+                        if not os.path.exists(os.path.join(self.uri, part)):
+                            # tolerable ONLY if a later visible version
+                            # removes it (add+remove both skip → net zero);
+                            # otherwise the table is missing data
+                            if part in self._removed_later(version):
+                                continue
+                            raise DeltaReadError(
+                                f"delta data file missing: {part} (version "
+                                f"{version}) and no later remove action covers it"
+                            )
+                        rows = self._read_rows(part, names, has_diff_col)
+                        for row in rows:
+                            emit(row)
+                        if self.mode != "static":
+                            self._part_rows[part] = rows
                     elif removed and removed.get("dataChange", True):
-                        # a removed file's rows leave the table: retract
-                        # them (delta keeps the parquet until vacuum, so
-                        # it is still readable)
-                        self._emit_file(
-                            removed["path"], names, has_diff_col, emit, invert=True
-                        )
+                        part = removed["path"]
+                        emitted = self._part_rows.pop(part, None)
+                        if emitted is not None:
+                            # we emitted this file live — retract from
+                            # memory even if the file was since vacuumed
+                            for row in emitted:
+                                emit(self._invert(row))
+                        elif os.path.exists(os.path.join(self.uri, part)):
+                            for row in self._read_rows(part, names, has_diff_col):
+                                emit(self._invert(row))
+                        # else: cold replay of an already-vacuumed pair —
+                        # its add was skipped too, net zero
                 self._applied_version = version
                 emit(self._offset())
                 emit(COMMIT)

@@ -35,8 +35,6 @@ class _SlackSink:
     def _post_once(self, text: str) -> tuple[bool, float, str]:
         """(posted, retry_after_s, error) — retryable failures return
         posted=False instead of raising."""
-        import time as _t  # noqa: F401 — kept local for monkeypatching
-
         conn = http.client.HTTPSConnection(self.host, timeout=30)
         try:
             conn.request(
@@ -80,7 +78,8 @@ class _SlackSink:
                 posted, retry_after, last_err = self._post_once(text)
                 if posted:
                     break
-                _t.sleep(min(retry_after * (attempt + 1), 30.0))
+                if attempt < self.MAX_ATTEMPTS - 1:  # no sleep before raising
+                    _t.sleep(min(retry_after * (attempt + 1), 30.0))
             else:
                 raise RuntimeError(
                     f"slack postMessage failed after {self.MAX_ATTEMPTS} "
