@@ -344,7 +344,29 @@ class ExprNode(Node):
         if self.vec_select is not None and len(deltas) >= _vec_threshold():
             out = self._try_columnar(deltas)
         if out is None:
-            out = [(key, self.fn(key, row), diff) for key, row, diff in deltas]
+            out = []
+            for key, row, diff in deltas:
+                new_row = self.fn(key, row)
+                if (
+                    diff > 0
+                    and any(isinstance(v, Error) for v in new_row)
+                    and not any(isinstance(v, Error) for v in row)
+                ):
+                    # a NEW Error value (division by zero, bad cast, …):
+                    # poison the cell and log it — the error-log tables
+                    # (pw.global_error_log) read scope.error_log.  Logged
+                    # directly (not report_row_error): cell poisoning is
+                    # recoverable via fill_error/remove_errors, so it must
+                    # not abort the run even with terminate_on_error=True
+                    self.scope.error_log.append(
+                        (
+                            self,
+                            key,
+                            "expression evaluated to Error (division by "
+                            "zero, bad cast, or type error)",
+                        )
+                    )
+                out.append((key, new_row, diff))
         out = consolidate(out)
         if self.keep_state:
             self._update_state(out)

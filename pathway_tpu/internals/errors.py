@@ -33,7 +33,7 @@ class _ErrorLogNode(df.InputNode):
         self.finished = True
         self._drained = 0
 
-    def step(self, time):
+    def _drain(self, time):
         log = self.scope.error_log
         out = []
         for node, key, message in log[self._drained :]:
@@ -41,6 +41,18 @@ class _ErrorLogNode(df.InputNode):
             out.append((k, (node.id if node is not None else -1, message), 1))
             self._drained += 1
         self.send(out, time)
+
+    def step(self, time):
+        pass
+
+    def flush(self, time):
+        # errors surface at the epoch BOUNDARY: draining in step() would
+        # miss failures from nodes that run later in the same epoch (the
+        # downstream delivery then happens in the finish quiesce)
+        self._drain(time)
+
+    def on_finish(self):
+        self._drain(self.scope.current_time)
 
 
 _global_log_table: Table | None = None

@@ -235,9 +235,10 @@ class _DeltaReader(Reader):
         self.mode = mode
         self.poll_interval_s = poll_interval_s
         self._applied_version = -1
-        # live streaming: rows emitted per part file, kept so a remove of a
-        # since-vacuumed file can still retract exactly what was emitted
-        self._part_rows: dict[str, list[dict]] = {}
+        # names of parts this reader emitted live (streaming): a remove of a
+        # file that was vacuumed before we could re-read it is unrecoverable
+        # and must error, not silently skip
+        self._emitted_parts: set[str] = set()
 
     def seek(self, offset: Any) -> None:
         self._applied_version = int(offset.get("version", -1))
@@ -308,12 +309,10 @@ class _DeltaReader(Reader):
         emit(self._offset())
         emit(COMMIT)
 
-    def _removed_later(self, from_version: int) -> set[str]:
-        """Paths removed by any currently-visible version > from_version."""
+    def _removed_paths(self, versions: list[int]) -> set[str]:
+        """Paths removed by any of the given versions (one pass per poll)."""
         out: set[str] = set()
-        for v in _list_versions(self.uri):
-            if v <= from_version:
-                continue
+        for v in versions:
             with open(_version_path(self.uri, v)) as f:
                 for line in f:
                     if line.strip():
@@ -330,14 +329,23 @@ class _DeltaReader(Reader):
             versions = [
                 v for v in _list_versions(self.uri) if v > self._applied_version
             ]
-            if versions and self._applied_version >= 0 and versions[0] > self._applied_version + 1:
+            if versions and self._applied_version == -1 and versions[0] != 0:
+                # cold start with a truncated log and no checkpoint: the
+                # missing early versions' rows are unrecoverable
                 raise DeltaReadError(
-                    f"delta log gap: resumed at version {self._applied_version} "
-                    f"but the next available version is {versions[0]} — the "
-                    "intervening log entries were expired (checkpointed); "
-                    "cannot resume incrementally"
+                    f"delta log starts at version {versions[0]} with no "
+                    "checkpoint — earlier versions were expired; the table "
+                    "cannot be read completely"
                 )
+            removed_set = self._removed_paths(versions)
             for version in versions:
+                if self._applied_version >= 0 and version != self._applied_version + 1:
+                    raise DeltaReadError(
+                        f"delta log gap: version {self._applied_version} is "
+                        f"followed by {version} — intervening log entries "
+                        "are missing (expired or still being written); "
+                        "cannot continue without losing data"
+                    )
                 with open(_version_path(self.uri, version)) as f:
                     actions = [_json.loads(line) for line in f if line.strip()]
                 for action in actions:
@@ -346,31 +354,34 @@ class _DeltaReader(Reader):
                     if add and add.get("dataChange", True):
                         part = add["path"]
                         if not os.path.exists(os.path.join(self.uri, part)):
-                            # tolerable ONLY if a later visible version
+                            # tolerable ONLY if a visible later version
                             # removes it (add+remove both skip → net zero);
                             # otherwise the table is missing data
-                            if part in self._removed_later(version):
+                            if part in removed_set:
                                 continue
                             raise DeltaReadError(
                                 f"delta data file missing: {part} (version "
                                 f"{version}) and no later remove action covers it"
                             )
-                        rows = self._read_rows(part, names, has_diff_col)
-                        for row in rows:
+                        for row in self._read_rows(part, names, has_diff_col):
                             emit(row)
                         if self.mode != "static":
-                            self._part_rows[part] = rows
+                            self._emitted_parts.add(part)
                     elif removed and removed.get("dataChange", True):
                         part = removed["path"]
-                        emitted = self._part_rows.pop(part, None)
-                        if emitted is not None:
-                            # we emitted this file live — retract from
-                            # memory even if the file was since vacuumed
-                            for row in emitted:
-                                emit(self._invert(row))
-                        elif os.path.exists(os.path.join(self.uri, part)):
+                        if os.path.exists(os.path.join(self.uri, part)):
+                            # delta keeps removed files until vacuum (default
+                            # retention days), so re-reading for the
+                            # retraction is the normal path
                             for row in self._read_rows(part, names, has_diff_col):
                                 emit(self._invert(row))
+                            self._emitted_parts.discard(part)
+                        elif part in self._emitted_parts:
+                            raise DeltaReadError(
+                                f"cannot retract {part}: its rows were "
+                                "emitted but the file was vacuumed before "
+                                "the remove could be replayed"
+                            )
                         # else: cold replay of an already-vacuumed pair —
                         # its add was skipped too, net zero
                 self._applied_version = version

