@@ -388,6 +388,14 @@ def _event_loop(
         all_finished = exhausted and all(inp.finished for inp in inputs)
         if all_finished:
             break
+        # epoch-boundary hooks (error-log drains, buffer releases) may have
+        # parked deltas in node pending queues; an idle stream must still
+        # deliver them to subscribers rather than wait for the next input
+        if any(n.has_pending() for n in scope.nodes):
+            last_time += 2
+            scope.run_epoch(last_time)
+            result.last_time = last_time
+            continue
         # idle streams still drain commit markers: a Kafka source's
         # timer-driven COMMITs keep arriving with no new epochs, and the
         # offsets for the last processed epoch must still reach the broker
@@ -444,16 +452,23 @@ def _event_loop_coordinated(
         local_min = min(times) if times else None
         all_finished = exhausted and all(inp.finished for inp in inputs)
 
+        local_pending = any(n.has_pending() for n in scope.nodes)
         round_ += 1
-        gathered = mesh.gather(("epoch", round_), (local_min, all_finished))
+        gathered = mesh.gather(
+            ("epoch", round_), (local_min, all_finished, local_pending)
+        )
         if mesh.worker_id == 0:
-            mins = [m for m, _ in gathered if m is not None]
+            mins = [m for m, _f, _p in gathered if m is not None]
             if mins:
                 t = min(mins)
                 if t <= last_time:
                     t = last_time + 2  # strictly increasing, even
                 decision = ("epoch", t)
-            elif all(fin for _, fin in gathered):
+            elif any(p for _m, _f, p in gathered):
+                # boundary-produced deltas (error logs, buffer releases)
+                # drain in lockstep on every worker
+                decision = ("epoch", last_time + 2)
+            elif all(fin for _m, fin, _p in gathered):
                 decision = ("stop", None)
             else:
                 decision = ("idle", None)

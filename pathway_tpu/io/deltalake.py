@@ -237,14 +237,22 @@ class _DeltaReader(Reader):
         self._applied_version = -1
         # names of parts this reader emitted live (streaming): a remove of a
         # file that was vacuumed before we could re-read it is unrecoverable
-        # and must error, not silently skip
+        # and must error, not silently skip.  Persisted with the offset so a
+        # resumed reader keeps the same guarantee.
         self._emitted_parts: set[str] = set()
+        self._gap_polls = 0  # consecutive polls a version gap persisted
 
     def seek(self, offset: Any) -> None:
         self._applied_version = int(offset.get("version", -1))
+        self._emitted_parts = set(offset.get("emitted", []))
 
     def _offset(self) -> Offset:
-        return Offset({"version": self._applied_version})
+        return Offset(
+            {
+                "version": self._applied_version,
+                "emitted": sorted(self._emitted_parts),
+            }
+        )
 
     def _read_rows(self, part: str, names, has_diff_col) -> list[dict]:
         import pyarrow.parquet as pq
@@ -309,17 +317,24 @@ class _DeltaReader(Reader):
         emit(self._offset())
         emit(COMMIT)
 
-    def _removed_paths(self, versions: list[int]) -> set[str]:
-        """Paths removed by any of the given versions (one pass per poll)."""
-        out: set[str] = set()
+    def _parse_versions(
+        self, versions: list[int]
+    ) -> tuple[dict[int, list[dict]], dict[int, set[str]]]:
+        """One parse per poll: version → actions, and version → paths
+        removed by any STRICTLY LATER version in the batch (a remove at or
+        before an add never excuses that add's missing file)."""
+        parsed = {}
         for v in versions:
             with open(_version_path(self.uri, v)) as f:
-                for line in f:
-                    if line.strip():
-                        a = _json.loads(line)
-                        if a.get("remove"):
-                            out.add(a["remove"]["path"])
-        return out
+                parsed[v] = [_json.loads(line) for line in f if line.strip()]
+        removed_after: dict[int, set[str]] = {}
+        acc: set[str] = set()
+        for v in sorted(versions, reverse=True):
+            removed_after[v] = set(acc)
+            for a in parsed[v]:
+                if a.get("remove"):
+                    acc.add(a["remove"]["path"])
+        return parsed, removed_after
 
     def run(self, emit) -> None:
         names = list(self.schema.__columns__.keys())
@@ -337,17 +352,34 @@ class _DeltaReader(Reader):
                     "checkpoint — earlier versions were expired; the table "
                     "cannot be read completely"
                 )
-            removed_set = self._removed_paths(versions)
-            for version in versions:
-                if self._applied_version >= 0 and version != self._applied_version + 1:
+            # a gap can be a transient listdir race with a concurrent
+            # writer: process the contiguous prefix, re-poll, and only
+            # raise if the same gap survives several polls (static mode has
+            # no next poll, so it raises immediately below)
+            contiguous = []
+            expect = self._applied_version + 1 if self._applied_version >= 0 else None
+            for v in versions:
+                if expect is not None and v != expect:
+                    break
+                contiguous.append(v)
+                expect = v + 1
+            if len(contiguous) < len(versions):
+                self._gap_polls += 1
+                if self.mode == "static" or self._gap_polls > 3:
+                    nxt = versions[len(contiguous)]
                     raise DeltaReadError(
-                        f"delta log gap: version {self._applied_version} is "
-                        f"followed by {version} — intervening log entries "
-                        "are missing (expired or still being written); "
-                        "cannot continue without losing data"
+                        f"delta log gap: version "
+                        f"{contiguous[-1] if contiguous else self._applied_version} "
+                        f"is followed by {nxt} — intervening log entries are "
+                        "missing (expired, or a commit that never completed)"
                     )
-                with open(_version_path(self.uri, version)) as f:
-                    actions = [_json.loads(line) for line in f if line.strip()]
+                versions = contiguous
+            else:
+                self._gap_polls = 0
+            parsed, removed_after = self._parse_versions(versions)
+            for version in versions:
+                actions = parsed[version]
+                removed_set = removed_after[version]
                 for action in actions:
                     add = action.get("add")
                     removed = action.get("remove")
