@@ -338,6 +338,7 @@ def _event_loop(
     inputs = _input_nodes(scope)
     pollers = lowerer.pollers
     last_time = -1
+    drain_spins = 0  # consecutive idle drain epochs (quiesce guard)
     # snapshot_interval_ms=0 means "as often as possible" (reference
     # persistence/__init__.py:95-101); commit() no-ops when nothing advanced
     snapshot_interval = (
@@ -373,6 +374,7 @@ def _event_loop(
             result.epoch_failed = True
             scope.run_epoch(t)
             result.epoch_failed = False
+            drain_spins = 0
             last_time = t
             result.last_time = t
             result.epochs += 1
@@ -392,8 +394,16 @@ def _event_loop(
         # parked deltas in node pending queues; an idle stream must still
         # deliver them to subscribers rather than wait for the next input
         if any(n.has_pending() for n in scope.nodes):
+            drain_spins += 1
+            if drain_spins > 1000:
+                raise df.EngineError(
+                    "idle drain did not quiesce: a node re-parks deltas "
+                    "every epoch (same condition finish() guards against)"
+                )
             last_time += 2
+            result.epoch_failed = True
             scope.run_epoch(last_time)
+            result.epoch_failed = False
             result.last_time = last_time
             continue
         # idle streams still drain commit markers: a Kafka source's
@@ -429,6 +439,7 @@ def _event_loop_coordinated(
     inputs = _input_nodes(scope)
     pollers = lowerer.pollers
     last_time = -1
+    drain_spins = 0
     round_ = 0
     snapshot_interval = (
         (storage.snapshot_interval_ms / 1000.0) if storage is not None else None
@@ -467,7 +478,11 @@ def _event_loop_coordinated(
             elif any(p for _m, _f, p in gathered):
                 # boundary-produced deltas (error logs, buffer releases)
                 # drain in lockstep on every worker
-                decision = ("epoch", last_time + 2)
+                drain_spins += 1
+                if drain_spins > 1000:
+                    decision = ("stop", None)  # non-quiescing node; bail
+                else:
+                    decision = ("epoch", last_time + 2)
             elif all(fin for _m, fin, _p in gathered):
                 decision = ("stop", None)
             else:
@@ -495,6 +510,8 @@ def _event_loop_coordinated(
         result.epoch_failed = True
         scope.run_epoch(t)
         result.epoch_failed = False
+        if kind == "epoch":
+            drain_spins = 0
         last_time = t
         result.last_time = t
         result.epochs += 1

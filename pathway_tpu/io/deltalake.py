@@ -240,7 +240,8 @@ class _DeltaReader(Reader):
         # and must error, not silently skip.  Persisted with the offset so a
         # resumed reader keeps the same guarantee.
         self._emitted_parts: set[str] = set()
-        self._gap_polls = 0  # consecutive polls a version gap persisted
+        self._gap_polls = 0  # consecutive polls the SAME gap persisted
+        self._gap_at: int | None = None  # expected version at the gap
 
     def seek(self, offset: Any) -> None:
         self._applied_version = int(offset.get("version", -1))
@@ -364,7 +365,16 @@ class _DeltaReader(Reader):
                 contiguous.append(v)
                 expect = v + 1
             if len(contiguous) < len(versions):
-                self._gap_polls += 1
+                gap_at = (
+                    contiguous[-1] + 1 if contiguous else self._applied_version + 1
+                )
+                if gap_at != self._gap_at:
+                    # a different gap than last poll: the old one resolved
+                    # (normal tip race with an active writer) — restart count
+                    self._gap_at = gap_at
+                    self._gap_polls = 1
+                else:
+                    self._gap_polls += 1
                 if self.mode == "static" or self._gap_polls > 3:
                     nxt = versions[len(contiguous)]
                     raise DeltaReadError(
@@ -376,6 +386,7 @@ class _DeltaReader(Reader):
                 versions = contiguous
             else:
                 self._gap_polls = 0
+                self._gap_at = None
             parsed, removed_after = self._parse_versions(versions)
             for version in versions:
                 actions = parsed[version]

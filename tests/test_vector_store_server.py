@@ -1,0 +1,123 @@
+"""VectorStoreServer REST integration: serve, query over HTTP, assert.
+
+Model: reference integration_tests/webserver/test_llm_xpack.py — the full
+streaming serving stack (fs docs → DocumentStore → rest endpoints), with
+the mock embedder so the dataflow path is real but no model download runs.
+"""
+
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO_ROOT = str(pathlib.Path(__file__).resolve().parent.parent)
+
+SERVER_SCRIPT = """
+import sys
+
+import pathway_tpu as pw
+from pathway_tpu.xpacks.llm.mocks import fake_embeddings_model
+from pathway_tpu.xpacks.llm.vector_store import VectorStoreServer
+
+port = int(sys.argv[1])
+docs_dir = sys.argv[2]
+
+docs = pw.io.fs.read(docs_dir, format="binary", mode="streaming", with_metadata=True)
+server = VectorStoreServer(docs, embedder=fake_embeddings_model)
+server.run_server(host="127.0.0.1", port=port, with_cache=False)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(port: int, route: str, payload: dict, timeout: float = 5.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{route}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture()
+def vector_server(tmp_path):
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "cats.txt").write_text("cats purr and nap in sunbeams")
+    (docs / "rockets.txt").write_text("rockets burn fuel to reach orbit")
+    port = _free_port()
+    script = tmp_path / "serve.py"
+    script.write_text(SERVER_SCRIPT)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(port), str(docs)],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        env=env,
+    )
+    deadline = time.monotonic() + 40
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"server died: {proc.stderr.read().decode(errors='replace')}"
+            )
+        try:
+            stats = _post(port, "/v1/statistics", {}, timeout=2)
+            if stats.get("file_count", 0) >= 2:
+                break
+        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError):
+            pass
+        time.sleep(0.3)
+    else:
+        proc.kill()
+        raise RuntimeError("server never indexed the documents")
+    yield port, docs
+    proc.kill()
+    proc.wait(timeout=10)
+
+
+def test_vector_store_rest_round_trip(vector_server):
+    port, docs = vector_server
+
+    # retrieval returns the indexed chunks ranked by the mock embedding
+    res = _post(port, "/v1/retrieve", {"query": "cats purr", "k": 2})
+    assert isinstance(res, list) and len(res) == 2
+    texts = [r["text"] for r in res]
+    assert any("cats" in t for t in texts)
+    assert all({"text", "dist", "metadata"} <= set(r) for r in res)
+
+    # statistics reflect the corpus
+    stats = _post(port, "/v1/statistics", {})
+    assert stats["file_count"] == 2
+
+    # inputs lists the source files
+    inputs = _post(port, "/v1/inputs", {})
+    paths = {i["path"] for i in inputs}
+    assert any("cats.txt" in p for p in paths)
+
+    # live update: a new document becomes retrievable without restart
+    (docs / "pasta.txt").write_text("pasta boils in salted water")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        stats = _post(port, "/v1/statistics", {})
+        if stats.get("file_count", 0) >= 3:
+            break
+        time.sleep(0.4)
+    assert stats["file_count"] == 3
+    res = _post(port, "/v1/retrieve", {"query": "pasta boils", "k": 1})
+    assert "pasta" in res[0]["text"]
