@@ -482,7 +482,7 @@ def _event_loop_coordinated(
                 if drain_spins > 1000:
                     decision = ("stop", None)  # non-quiescing node; bail
                 else:
-                    decision = ("epoch", last_time + 2)
+                    decision = ("drain", last_time + 2)
             elif all(fin for _m, fin, _p in gathered):
                 decision = ("stop", None)
             else:
@@ -493,6 +493,15 @@ def _event_loop_coordinated(
 
         if kind == "stop":
             break
+        if kind == "drain":
+            # boundary-delta drain: run the epoch but do NOT reset the
+            # quiesce counter (only real input epochs prove progress)
+            result.epoch_failed = True
+            scope.run_epoch(t)
+            result.epoch_failed = False
+            last_time = t
+            result.last_time = t
+            continue
         if kind == "idle":
             _ack_sources(pollers, persisted=False, up_to_time=last_time)
             _time.sleep(0.001)
@@ -510,8 +519,7 @@ def _event_loop_coordinated(
         result.epoch_failed = True
         scope.run_epoch(t)
         result.epoch_failed = False
-        if kind == "epoch":
-            drain_spins = 0
+        drain_spins = 0  # an input-driven epoch proves progress
         last_time = t
         result.last_time = t
         result.epochs += 1

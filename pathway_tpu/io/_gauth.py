@@ -168,7 +168,11 @@ class ServiceAccountCredentials:
                 headers={"Content-Type": "application/x-www-form-urlencoded"},
             )
             resp = conn.getresponse()
-            payload = _json.loads(resp.read() or b"{}")
+            raw = resp.read()
+            try:
+                payload = _json.loads(raw or b"{}")
+            except ValueError:
+                payload = {"raw": raw[:300].decode(errors="replace")}
             if resp.status >= 300 or "access_token" not in payload:
                 raise RuntimeError(
                     f"token exchange failed ({resp.status}): "
@@ -181,6 +185,35 @@ class ServiceAccountCredentials:
         return self._token
 
 
+# per-thread connection cache: polling readers issue one request per loop
+# turn, and a fresh TLS handshake per call would dominate latency and churn
+# sockets.  Thread-local because http.client connections are not thread-safe.
+_conn_local = __import__("threading").local()
+
+
+def _get_conn(scheme: str, netloc: str):
+    cache = getattr(_conn_local, "conns", None)
+    if cache is None:
+        cache = _conn_local.conns = {}
+    conn = cache.get((scheme, netloc))
+    if conn is None:
+        conn_cls = (
+            http.client.HTTPSConnection
+            if scheme == "https"
+            else http.client.HTTPConnection
+        )
+        conn = conn_cls(netloc, timeout=60)
+        cache[(scheme, netloc)] = conn
+    return conn
+
+
+def _drop_conn(scheme: str, netloc: str) -> None:
+    cache = getattr(_conn_local, "conns", {})
+    conn = cache.pop((scheme, netloc), None)
+    if conn is not None:
+        conn.close()
+
+
 def api_request(
     creds: ServiceAccountCredentials,
     method: str,
@@ -189,19 +222,42 @@ def api_request(
     content_type: str = "application/json",
 ) -> tuple[int, bytes]:
     parsed = urllib.parse.urlparse(url)
-    conn_cls = (
-        http.client.HTTPSConnection
-        if parsed.scheme == "https"
-        else http.client.HTTPConnection
-    )
-    conn = conn_cls(parsed.netloc, timeout=60)
-    try:
-        path = parsed.path + ("?" + parsed.query if parsed.query else "")
-        headers = {"Authorization": f"Bearer {creds.token()}"}
-        if body is not None:
-            headers["Content-Type"] = content_type
-        conn.request(method, path, body=body, headers=headers)
-        resp = conn.getresponse()
-        return resp.status, resp.read()
-    finally:
-        conn.close()
+    path = parsed.path + ("?" + parsed.query if parsed.query else "")
+    headers = {"Authorization": f"Bearer {creds.token()}"}
+    if body is not None:
+        headers["Content-Type"] = content_type
+    for attempt in (1, 2):  # one transparent retry on a dead pooled socket
+        conn = _get_conn(parsed.scheme, parsed.netloc)
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            return resp.status, resp.read()
+        except (http.client.HTTPException, ConnectionError, OSError):
+            _drop_conn(parsed.scheme, parsed.netloc)
+            if attempt == 2:
+                raise
+    raise AssertionError("unreachable")
+
+
+_RETRYABLE = {429, 500, 502, 503, 504}
+
+
+def api_request_retry(
+    creds: ServiceAccountCredentials,
+    method: str,
+    url: str,
+    body: bytes | None = None,
+    *,
+    attempts: int = 5,
+) -> tuple[int, bytes]:
+    """api_request with exponential backoff on throttle/server errors —
+    streaming readers must survive the transient 429/5xx the Google APIs
+    document as routine, not die and report clean source exhaustion."""
+    delay = 0.5
+    for attempt in range(attempts):
+        status, payload = api_request(creds, method, url, body)
+        if status not in _RETRYABLE or attempt == attempts - 1:
+            return status, payload
+        time.sleep(delay)
+        delay = min(delay * 2, 15.0)
+    return status, payload

@@ -51,7 +51,10 @@ class _BigQuerySink:
                 {"rows": [{"json": r} for r in self._rows]}
             ).encode()
             status, payload = api_request(self.creds, "POST", self.url, body)
-            parsed = _json.loads(payload or b"{}")
+            try:
+                parsed = _json.loads(payload or b"{}")
+            except ValueError:
+                parsed = {"raw": payload[:300].decode(errors="replace")}
             if status >= 300 or parsed.get("insertErrors"):
                 raise RuntimeError(
                     f"bigquery insertAll failed ({status}): "

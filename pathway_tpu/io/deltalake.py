@@ -98,6 +98,12 @@ class _DeltaSink:
         ]
         self._rows: list[tuple] = []
         self._lock = threading.Lock()
+        # engine row keys restart per (non-persisted) run: salting the
+        # stored identity keeps independent runs' inserts distinct.  With
+        # persistence the keys ARE stable across resumes, so the salt must
+        # be too — it derives from the persistence root when one is active
+        # (lazily: the root is known only once pw.run starts)
+        self._run_id: str | None = None
         self._version: int | None = None
 
     def _ensure_table(self) -> None:
@@ -164,6 +170,20 @@ class _DeltaSink:
         finally:
             os.unlink(tmp)
 
+    def run_salt(self) -> str:
+        if self._run_id is None:
+            import hashlib
+
+            from pathway_tpu.engine.persistence import active_root
+
+            root = active_root()
+            self._run_id = (
+                hashlib.md5(root.encode()).hexdigest()[:8]
+                if root
+                else uuid.uuid4().hex[:8]
+            )
+        return self._run_id
+
     def add(self, row: tuple) -> None:
         with self._lock:
             self._rows.append(row)
@@ -211,7 +231,7 @@ def write(
         plain = tuple(
             v if isinstance(v, bytes) else _utils.plain_value(v) for v in row
         )
-        sink.add(plain + (time, diff, f"{key:032x}"))
+        sink.add(plain + (time, diff, f"{sink.run_salt()}:{key:032x}"))
 
     _utils.register_output(
         table,
@@ -264,8 +284,8 @@ class _DeltaReader(Reader):
             stored_key = rec.get("_pw_key")
             if stored_key is not None and "_pw_key" not in names:
                 # retractions must land on the same engine key as the rows
-                # they cancel
-                row["_pw_key"] = int(stored_key, 16)
+                # they cancel; opaque string keys are hashed by ingestion
+                row["_pw_key"] = stored_key
             # change-stream tables: a stored diff of -1 is a retraction
             # unless the user asked for the raw diff column
             if not has_diff_col and rec.get("diff", 1) < 0:

@@ -19,7 +19,7 @@ from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import schema as schema_mod
 from pathway_tpu.internals.table import Table
 from pathway_tpu.io import _utils
-from pathway_tpu.io._gauth import ServiceAccountCredentials, api_request
+from pathway_tpu.io._gauth import ServiceAccountCredentials, api_request_retry
 from pathway_tpu.io._utils import COMMIT, DELETE, Offset, Reader
 
 __all__ = ["read"]
@@ -66,7 +66,7 @@ class _GDriveReader(Reader):
             if token:
                 params["pageToken"] = token
             url = f"{self.api_base}/drive/v3/files?{urllib.parse.urlencode(params)}"
-            status, payload = api_request(self.creds, "GET", url)
+            status, payload = api_request_retry(self.creds, "GET", url)
             if status >= 300:
                 raise RuntimeError(f"gdrive list failed ({status}): {payload[:300]!r}")
             parsed = _json.loads(payload or b"{}")
@@ -108,7 +108,7 @@ class _GDriveReader(Reader):
             )
         else:
             url = f"{self.api_base}/drive/v3/files/{f['id']}?alt=media"
-        status, payload = api_request(self.creds, "GET", url)
+        status, payload = api_request_retry(self.creds, "GET", url)
         if status >= 300:
             raise RuntimeError(f"gdrive download failed ({status})")
         return payload

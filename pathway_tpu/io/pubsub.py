@@ -19,7 +19,11 @@ from pathway_tpu.engine.types import Json
 from pathway_tpu.internals import schema as schema_mod
 from pathway_tpu.internals.table import Table
 from pathway_tpu.io import _utils
-from pathway_tpu.io._gauth import ServiceAccountCredentials, api_request
+from pathway_tpu.io._gauth import (
+    ServiceAccountCredentials,
+    api_request,
+    api_request_retry,
+)
 from pathway_tpu.io._utils import COMMIT, Reader
 
 __all__ = ["read", "write"]
@@ -135,7 +139,9 @@ class _PubSubReader(Reader):
         names = list(self.schema.__columns__.keys()) if self.schema else ["data"]
         while True:
             body = _json.dumps({"maxMessages": 100}).encode()
-            status, payload = api_request(self.creds, "POST", f"{self.base}:pull", body)
+            status, payload = api_request_retry(
+                self.creds, "POST", f"{self.base}:pull", body
+            )
             if status >= 300:
                 raise RuntimeError(f"pubsub pull failed ({status}): {payload[:300]!r}")
             received = _json.loads(payload or b"{}").get("receivedMessages", [])

@@ -64,17 +64,19 @@ def vector_server(tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     env.setdefault("JAX_PLATFORMS", "cpu")
+    errlog = open(tmp_path / "server.err", "w+b")
     proc = subprocess.Popen(
         [sys.executable, str(script), str(port), str(docs)],
         stdout=subprocess.DEVNULL,
-        stderr=subprocess.PIPE,
+        stderr=errlog,  # a PIPE would deadlock once the 64KB buffer fills
         env=env,
     )
     deadline = time.monotonic() + 40
     while time.monotonic() < deadline:
         if proc.poll() is not None:
+            errlog.seek(0)
             raise RuntimeError(
-                f"server died: {proc.stderr.read().decode(errors='replace')}"
+                f"server died: {errlog.read().decode(errors='replace')}"
             )
         try:
             stats = _post(port, "/v1/statistics", {}, timeout=2)
