@@ -10,6 +10,7 @@ caching metaclass machinery.
 from __future__ import annotations
 
 import datetime
+import types as _pytypes
 import typing
 from typing import Any
 
@@ -354,7 +355,7 @@ def wrap(input_type) -> DType:
         return _SIMPLE_FROM_PY[input_type]
     origin = typing.get_origin(input_type)
     args = typing.get_args(input_type)
-    if origin is typing.Union:
+    if origin is typing.Union or origin is _pytypes.UnionType:  # X | None (PEP 604)
         non_none = [a for a in args if a is not type(None)]
         has_none = len(non_none) != len(args)
         if len(non_none) == 1:

@@ -321,9 +321,11 @@ def schema_from_csv(
 
 
 def is_subschema(left: type[Schema], right: type[Schema]) -> bool:
+    """Reference semantics (internals/schema.py:630): identical column sets
+    with every left dtype a subtype of the right one."""
+    if left.__columns__.keys() != right.__columns__.keys():
+        return False
     for name, col in right.__columns__.items():
-        if name not in left.__columns__:
-            return False
         if not left.__columns__[name].dtype.is_subclass_of(col.dtype):
             return False
     return True

@@ -1,0 +1,138 @@
+"""Schema system: class schemas, primary keys, defaults, builders, csv
+inference, subschema relation, runtime integration.
+
+Model: the reference's test_schema.py round-trip pattern.
+"""
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import dtype as dt
+from pathway_tpu.internals.schema import is_subschema
+from tests.utils import T, rows
+
+
+def test_class_schema_types_and_order():
+    class S(pw.Schema):
+        a: int
+        b: str
+        c: float
+
+    assert list(S.__columns__.keys()) == ["a", "b", "c"]
+    assert S.__columns__["a"].dtype is dt.INT
+    assert S.__columns__["b"].dtype is dt.STR
+    assert S.__columns__["c"].dtype is dt.FLOAT
+
+
+def test_primary_key_drives_row_identity():
+    class S(pw.Schema):
+        k: int = pw.column_definition(primary_key=True)
+        v: str
+
+    t1 = pw.debug.table_from_rows(S, [(1, "a"), (2, "b")])
+    t2 = pw.debug.table_from_rows(S, [(1, "A")])
+    # same primary key -> same row key: update_rows overrides by key
+    merged = t1.update_rows(t2)
+    assert sorted(rows(merged)) == [(1, "A"), (2, "b")]
+
+
+def test_column_definition_default_value():
+    class S(pw.Schema):
+        a: int
+        b: int = pw.column_definition(default_value=7)
+
+    assert S.default_values() == {"b": 7}
+
+
+def test_optional_types():
+    class S(pw.Schema):
+        a: int | None
+
+    d = S.__columns__["a"].dtype
+    assert d.strip_optional() is dt.INT
+
+
+def test_schema_from_types_and_builder():
+    S1 = pw.schema_from_types(x=int, y=str)
+    assert list(S1.__columns__) == ["x", "y"]
+    S2 = pw.schema_builder(
+        {
+            "k": pw.column_definition(dtype=int, primary_key=True),
+            "v": pw.column_definition(dtype=str),
+        }
+    )
+    assert S2.primary_key_columns() == ["k"]
+
+
+def test_schema_from_dict():
+    S = pw.schema_from_dict({"a": int, "b": {"dtype": str, "default_value": "z"}})
+    assert S.__columns__["a"].dtype is dt.INT
+    assert S.default_values().get("b") == "z"
+
+
+def test_schema_from_csv(tmp_path):
+    p = tmp_path / "sample.csv"
+    p.write_text("id,name,score,flag\n1,ann,2.5,true\n2,bob,3.5,false\n")
+    S = pw.schema_from_csv(str(p))
+    assert S.__columns__["id"].dtype is dt.INT
+    assert S.__columns__["name"].dtype is dt.STR
+    assert S.__columns__["score"].dtype is dt.FLOAT
+
+
+def test_with_types_override():
+    class S(pw.Schema):
+        a: int
+        b: str
+
+    S2 = S.with_types(a=float)
+    assert S2.__columns__["a"].dtype is dt.FLOAT
+    assert S2.__columns__["b"].dtype is dt.STR
+
+
+def test_is_subschema():
+    # reference semantics: identical column sets, dtypes pairwise subtypes
+    class IntS(pw.Schema):
+        a: int
+
+    class FloatS(pw.Schema):
+        a: float
+
+    class Other(pw.Schema):
+        a: int
+        b: str
+
+    assert is_subschema(IntS, FloatS)  # int narrows to float
+    assert not is_subschema(FloatS, IntS)
+    assert not is_subschema(IntS, Other)  # differing column sets
+
+
+def test_schema_inheritance():
+    class Base(pw.Schema):
+        a: int
+
+    class Child(Base):
+        b: str
+
+    assert list(Child.__columns__) == ["a", "b"]
+
+
+def test_runtime_typechecking_flag():
+    class S(pw.Schema):
+        a: int
+
+    # valid data passes regardless
+    t = pw.debug.table_from_rows(S, [(1,)])
+    assert rows(t) == [(1,)]
+
+
+def test_assert_table_has_schema():
+    class S(pw.Schema):
+        a: int
+
+    t = T("a\n1")
+    pw.assert_table_has_schema(t, S)  # same columns/types: no raise
+    class Wrong(pw.Schema):
+        a: str
+
+    with pytest.raises(Exception):
+        pw.assert_table_has_schema(t, Wrong, allow_superset=False)
