@@ -55,10 +55,27 @@ class _AirbyteReader(Reader):
         self.mode = mode
         self.refresh_interval = refresh_interval
         self.env_vars = env_vars or {}
-        self._state: Any = None  # latest Airbyte STATE payload
+        self._state: Any = None  # serializable aggregate of STATE payloads
 
     def seek(self, offset: Any) -> None:
         self._state = offset.get("state")
+
+    def _record_state(self, st: Any) -> None:
+        """Fold one STATE message into the resumable aggregate.
+
+        Modern sources emit one STREAM-typed message *per stream*; keeping
+        only the latest would drop every other stream's cursor, so they are
+        accumulated keyed by stream descriptor.  GLOBAL-typed and legacy
+        blobs cover all streams at once and replace the aggregate.
+        """
+        if isinstance(st, dict) and st.get("type") == "STREAM":
+            if not (isinstance(self._state, dict) and "per_stream" in self._state):
+                self._state = {"per_stream": {}}
+            desc = st.get("stream", {}).get("stream_descriptor", {})
+            key = f"{desc.get('namespace', '')}:{desc.get('name', '')}"
+            self._state["per_stream"][key] = st
+        else:
+            self._state = st
 
     def _offset(self) -> Offset:
         return Offset({"state": self._state})
@@ -148,42 +165,57 @@ class _AirbyteReader(Reader):
             if self._state is not None:
                 st = os.path.join(td, "state.json")
                 with open(st, "w") as f:
-                    _json.dump(self._state, f)
+                    _json.dump(self._state_file_payload(self._state), f)
                 args += ["--state", st]
-            errlog = open(os.path.join(td, "stderr.log"), "w+")
-            proc = subprocess.Popen(
-                self._command(args, mount_dir=td),
-                stdout=subprocess.PIPE,
-                stderr=errlog,
-                text=True,
-                env={**os.environ, **self.env_vars},
-            )
             emitted_after_state = False
-            try:
-                for line in proc.stdout:
-                    try:
-                        msg = _json.loads(line)
-                    except _json.JSONDecodeError:
-                        continue
-                    kind = msg.get("type")
-                    if kind == "RECORD":
-                        rec = msg["record"]
-                        emit(
-                            {
-                                "stream": rec.get("stream", ""),
-                                "data": Json(rec.get("data", {})),
-                            }
-                        )
-                        emitted_after_state = True
-                    elif kind == "STATE":
-                        # checkpoint: everything before this STATE is
-                        # covered by it (the protocol's contract)
-                        self._state = msg["state"]
-                        emit(self._offset())
-                        emit(COMMIT)
-                        emitted_after_state = False
-            finally:
-                proc.wait(timeout=60)
+            with open(os.path.join(td, "stderr.log"), "w+") as errlog:
+                proc = subprocess.Popen(
+                    self._command(args, mount_dir=td),
+                    stdout=subprocess.PIPE,
+                    stderr=errlog,
+                    text=True,
+                    env={**os.environ, **self.env_vars},
+                )
+                try:
+                    for line in proc.stdout:
+                        try:
+                            msg = _json.loads(line)
+                        except _json.JSONDecodeError:
+                            continue
+                        kind = msg.get("type")
+                        if kind == "RECORD":
+                            rec = msg["record"]
+                            emit(
+                                {
+                                    "stream": rec.get("stream", ""),
+                                    "data": Json(rec.get("data", {})),
+                                }
+                            )
+                            emitted_after_state = True
+                        elif kind == "STATE":
+                            # checkpoint: everything before this STATE is
+                            # covered by it (the protocol's contract)
+                            self._record_state(msg["state"])
+                            emit(self._offset())
+                            emit(COMMIT)
+                            emitted_after_state = False
+                except BaseException:
+                    # reader died mid-stream: don't block on a connector
+                    # that may be wedged writing to a full pipe
+                    proc.kill()
+                    proc.wait()
+                    raise
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+                    proc.wait()
+                    raise AirbyteError(
+                        "source kept running 60s after closing its stdout"
+                    )
+                rc = proc.returncode
+                errlog.seek(0)
+                errtail = errlog.read()[-300:]
             if emitted_after_state:
                 # rows after the connector's last STATE have no covering
                 # checkpoint: close the epoch so they are visible, but emit
@@ -191,12 +223,24 @@ class _AirbyteReader(Reader):
                 # state (the restart would redeliver them: at-least-once,
                 # the strongest guarantee the protocol offers here)
                 emit(COMMIT)
-            if proc.returncode not in (0, None):
-                errlog.seek(0)
-                raise AirbyteError(
-                    f"source read exited with rc={proc.returncode}: "
-                    f"{errlog.read()[-300:]}"
-                )
+            if rc not in (0, None):
+                raise AirbyteError(f"source read exited with rc={rc}: {errtail}")
+
+    @staticmethod
+    def _state_file_payload(state):
+        """Shape the captured STATE payload the way sources expect --state.
+
+        Modern CDK sources take a JSON *list* of AirbyteStateMessage objects
+        (``{"type": "STREAM"|"GLOBAL", ...}``); legacy sources take the bare
+        ``state.data`` blob.  Anything else passes through unchanged.
+        """
+        if isinstance(state, dict) and "per_stream" in state:
+            return [state["per_stream"][k] for k in sorted(state["per_stream"])]
+        if isinstance(state, dict) and "type" in state:
+            return [state]
+        if isinstance(state, dict) and set(state) == {"data"}:
+            return state["data"]
+        return state
 
 
 def read(

@@ -37,6 +37,7 @@ from pathway_tpu.models.tokenizer import (
     load_tokenizer,
     pad_batch,
 )
+from pathway_tpu.ops.attention import encoder_attention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,6 +134,124 @@ class CrossEncoderModule(nn.Module):
         return nn.Dense(1, dtype=jnp.float32)(h)[:, 0]
 
 
+# ---------------------------------------------------------------------------
+# Fused inference path.
+#
+# The Flax modules above are the parameter-structure source of truth (init,
+# checkpoint mapping, training).  For the streaming hot path the same params
+# are repacked once into a flat bf16 tree (QKV kernels concatenated into one
+# [H, 3H] matmul operand) and run through a hand-scheduled forward: 2D
+# [B*S, H] activations end to end (no relayout copies) with attention in the
+# pallas kernel (`ops/attention.py`).  Measured on v5e this is ~3x the
+# throughput of the stock module.apply lowering at MiniLM shapes.
+# ---------------------------------------------------------------------------
+
+
+def pack_fast_params(params, config: EncoderConfig):
+    """Repack a module param tree into the flat bf16 tree the fused forward
+    consumes.  Works for both SentenceEncoderModule and CrossEncoderModule
+    trees (the latter adds the scoring head)."""
+    p = params["params"]
+    enc = p["Encoder_0"] if "Encoder_0" in p else p
+    H = config.hidden
+
+    def bf(x):
+        return jnp.asarray(x, jnp.bfloat16)
+
+    layers = []
+    for i in range(config.layers):
+        blk = enc[f"TransformerBlock_{i}"]
+        att = blk["MultiHeadDotProductAttention_0"]
+        qkv_k = jnp.concatenate(
+            [att[n]["kernel"].reshape(H, H) for n in ("query", "key", "value")],
+            axis=1,
+        )
+        qkv_b = jnp.concatenate(
+            [att[n]["bias"].reshape(H) for n in ("query", "key", "value")]
+        )
+        layers.append(
+            dict(
+                qkv_k=bf(qkv_k),
+                qkv_b=bf(qkv_b),
+                out_k=bf(att["out"]["kernel"].reshape(H, H)),
+                out_b=bf(att["out"]["bias"]),
+                ln0_s=bf(blk["LayerNorm_0"]["scale"]),
+                ln0_b=bf(blk["LayerNorm_0"]["bias"]),
+                ff1_k=bf(blk["Dense_0"]["kernel"]),
+                ff1_b=bf(blk["Dense_0"]["bias"]),
+                ff2_k=bf(blk["Dense_1"]["kernel"]),
+                ff2_b=bf(blk["Dense_1"]["bias"]),
+                ln1_s=bf(blk["LayerNorm_1"]["scale"]),
+                ln1_b=bf(blk["LayerNorm_1"]["bias"]),
+            )
+        )
+    tree = dict(
+        emb_word=bf(enc["Embed_0"]["embedding"]),
+        emb_pos=bf(enc["Embed_1"]["embedding"]),
+        eln_s=bf(enc["LayerNorm_0"]["scale"]),
+        eln_b=bf(enc["LayerNorm_0"]["bias"]),
+        layers=layers,
+    )
+    if "Dense_0" in p:  # cross-encoder scoring head (kept in f32, tiny)
+        tree["head"] = dict(
+            d0_k=jnp.asarray(p["Dense_0"]["kernel"], jnp.float32),
+            d0_b=jnp.asarray(p["Dense_0"]["bias"], jnp.float32),
+            d1_k=jnp.asarray(p["Dense_1"]["kernel"], jnp.float32),
+            d1_b=jnp.asarray(p["Dense_1"]["bias"], jnp.float32),
+        )
+    return tree
+
+
+def _ln(x, scale, bias, eps: float = 1e-6):
+    """LayerNorm with f32 statistics on bf16 activations (flax semantics)."""
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - m), axis=-1, keepdims=True)
+    y = ((xf - m) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+    return y * scale + bias
+
+
+def fused_trunk(tree, input_ids, attention_mask, config: EncoderConfig, *, interpret=False):
+    """BERT trunk over the packed tree; returns token reps ``[B, S, H]``."""
+    B, S = input_ids.shape
+    H = config.hidden
+    x = tree["emb_word"][input_ids] + tree["emb_pos"][:S][None, :, :]
+    x = _ln(x, tree["eln_s"], tree["eln_b"]).reshape(B * S, H)
+    bias = jnp.where(attention_mask > 0, 0.0, -1e9).astype(jnp.float32)  # [B, S]
+    for lp in tree["layers"]:
+        qkv = x @ lp["qkv_k"] + lp["qkv_b"]  # [B*S, 3H]
+        ctx = encoder_attention(
+            qkv[:, :H].reshape(B, S, H),
+            qkv[:, H : 2 * H].reshape(B, S, H),
+            qkv[:, 2 * H :].reshape(B, S, H),
+            bias,
+            config.heads,
+            interpret=interpret,
+        ).reshape(B * S, H)
+        x = _ln(x + ctx @ lp["out_k"] + lp["out_b"], lp["ln0_s"], lp["ln0_b"])
+        h = jax.nn.gelu(x @ lp["ff1_k"] + lp["ff1_b"], approximate=True)
+        x = _ln(x + h @ lp["ff2_k"] + lp["ff2_b"], lp["ln1_s"], lp["ln1_b"])
+    return x.reshape(B, S, H)
+
+
+def fused_sentence_apply(tree, input_ids, attention_mask, config: EncoderConfig, *, interpret=False):
+    """Fused equivalent of ``SentenceEncoderModule.apply``."""
+    x = fused_trunk(tree, input_ids, attention_mask, config, interpret=interpret)
+    m = attention_mask[:, :, None].astype(x.dtype)
+    pooled = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    pooled = pooled.astype(jnp.float32)
+    return pooled / (jnp.linalg.norm(pooled, axis=1, keepdims=True) + 1e-12)
+
+
+def fused_cross_apply(tree, input_ids, attention_mask, config: EncoderConfig, *, interpret=False):
+    """Fused equivalent of ``CrossEncoderModule.apply``."""
+    x = fused_trunk(tree, input_ids, attention_mask, config, interpret=interpret)
+    head = tree["head"]
+    cls = x[:, 0, :].astype(jnp.float32)
+    h = jnp.tanh(cls @ head["d0_k"] + head["d0_b"])
+    return (h @ head["d1_k"] + head["d1_b"])[:, 0]
+
+
 def load_hf_weights(model_name: str, params, config: EncoderConfig):
     """Map a locally cached ``transformers`` BERT-family checkpoint onto the
     Flax param tree; returns the updated tree or ``None`` when no local
@@ -209,7 +328,9 @@ def load_hf_weights(model_name: str, params, config: EncoderConfig):
 class _JitModel:
     """Shared machinery: init params, bucket shapes, jit per bucket."""
 
-    def __init__(self, module_cls, model_name: str, seed: int = 0, max_batch: int = 256):
+    def __init__(self, module_cls, model_name: str, seed: int = 0, max_batch: int = 512):
+        import os
+
         self.config = config_for(model_name)
         self.model_name = model_name
         self.module = module_cls(self.config)
@@ -224,8 +345,33 @@ class _JitModel:
         self.pretrained = loaded is not None
         if loaded is not None:
             self.params = jax.tree_util.tree_map(jnp.asarray, loaded)
-        self._apply = jax.jit(
-            lambda params, ids, mask: self.module.apply(params, ids, mask)
+        # Fused inference path (packed bf16 weights + pallas attention);
+        # PATHWAY_FUSED_ENCODER=0 falls back to the stock module lowering.
+        # `_infer_params` is whatever tree `_apply` consumes, so weight
+        # updates flow through `set_params` on either path.
+        self._fused = os.environ.get("PATHWAY_FUSED_ENCODER", "1") != "0"
+        if self._fused:
+            fused = (
+                fused_cross_apply
+                if module_cls is CrossEncoderModule
+                else fused_sentence_apply
+            )
+            cfg = self.config
+            self._infer_params = pack_fast_params(self.params, cfg)
+            self._apply = jax.jit(
+                lambda tree, ids, mask: fused(tree, ids, mask, cfg)
+            )
+        else:
+            self._infer_params = self.params
+            self._apply = jax.jit(
+                lambda params, ids, mask: self.module.apply(params, ids, mask)
+            )
+
+    def set_params(self, params) -> None:
+        """Replace model weights (both the module tree and the fused tree)."""
+        self.params = params
+        self._infer_params = (
+            pack_fast_params(params, self.config) if self._fused else params
         )
 
     def n_params(self) -> int:
@@ -244,7 +390,7 @@ class _JitModel:
             b = bucket_batch(len(chunk), self.max_batch)
             padded = chunk + [[0]] * (b - len(chunk))
             ids, mask = pad_batch(padded, seq)
-            res = self._apply(self.params, jnp.asarray(ids), jnp.asarray(mask))
+            res = self._apply(self._infer_params, jnp.asarray(ids), jnp.asarray(mask))
             out_chunks.append(np.asarray(res)[: len(chunk)])
             i += self.max_batch
         return np.concatenate(out_chunks, axis=0)
@@ -253,7 +399,7 @@ class _JitModel:
 class SentenceEncoder(_JitModel):
     """Text → normalized embedding vectors (device-batched)."""
 
-    def __init__(self, model_name: str = "all-MiniLM-L6-v2", seed: int = 0, max_batch: int = 256):
+    def __init__(self, model_name: str = "all-MiniLM-L6-v2", seed: int = 0, max_batch: int = 512):
         super().__init__(SentenceEncoderModule, model_name, seed, max_batch)
 
     @property
@@ -275,7 +421,7 @@ class CrossEncoder(_JitModel):
         self,
         model_name: str = "cross-encoder/ms-marco-MiniLM-L-6-v2",
         seed: int = 0,
-        max_batch: int = 256,
+        max_batch: int = 512,
     ):
         super().__init__(CrossEncoderModule, model_name, seed, max_batch)
 

@@ -1,0 +1,185 @@
+"""Pallas TPU kernel: bidirectional (encoder) multi-head attention.
+
+The embedding/rerank hot path runs BERT-family encoders at short sequence
+lengths (document chunks, seq buckets 16..512).  XLA's stock lowering of
+multi-head attention materializes four `[B, S, heads, hd]` relayout copies
+per layer (q, k, v, ctx between the packed `[B*S, H]` matmul layout and the
+`[B, heads, S, hd]` batched-matmul layout) plus fp32 score tensors — at
+MiniLM shapes that is ~1.2 GB of pure copy traffic per 512x64 batch, more
+HBM time than the matmuls themselves (measured: 3.8 ms copies + 3.3 ms
+converts vs 3.7 ms of real fusions per step on v5e).
+
+This kernel keeps q/k/v in their natural packed ``[B, S, H]`` lane layout
+(exactly what the fused QKV projection produces), computes scores + softmax
++ context entirely in VMEM, and writes ctx back in packed layout — zero
+relayouts, zero HBM score traffic.
+
+Head/sequence packing: the MXU wants 128-lane contractions but ``hd`` is 32
+(MiniLM) or 64 (BGE), and one sequence is only S<=512 rows.  Each program
+takes ``bb`` sequences and, per 128-lane head group (G = 128//hd heads),
+stacks the group's heads along MXU rows via a block-diagonal Q operand:
+
+    Q_bd [G*bb*S, 128] = tile(q_rows, (G,1)) * head-block mask
+    scores = Q_bd @ k_rows.T          # one full-width MXU matmul
+    softmax over lanes (cross-sequence / cross-head lanes masked to -inf)
+    ctx = probs @ v_rows              # second full-width matmul
+    out = sum_h ctx[h-block] * lane-mask(h)
+
+The zero blocks kill cross-head terms; masking kills cross-sequence terms.
+FLOP waste is G*bb x on the attention einsums only — a few percent of
+encoder FLOPs — in exchange for full MXU utilization, straight-line code
+(no serial inner loops), and one-kernel fusion.
+
+Reference analog: the reference runs attention inside torch/CUDA via
+sentence-transformers (`/root/reference/python/pathway/xpacks/llm/
+embedders.py:85-401`); this is the TPU-native equivalent of its fused
+attention path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANE_GROUP = 128
+
+
+def _attn_kernel(
+    q_ref, k_ref, v_ref, bias_ref, out_ref, *, S: int, hd: int, scale: float
+):
+    """One program: bb sequences x all heads, softmax in VMEM (f32)."""
+    rows, H = q_ref.shape  # rows = bb * S
+    G = LANE_GROUP // hd  # heads per 128-lane group
+    n_groups = H // LANE_GROUP
+
+    # Structural validity of scores[r, c]: the q row r belongs to sequence
+    # (r % rows) // S and the key column c to sequence c // S.
+    r_seq = jax.lax.broadcasted_iota(jnp.int32, (G * rows, rows), 0) % rows // S
+    c_seq = jax.lax.broadcasted_iota(jnp.int32, (G * rows, rows), 1) // S
+    struct = jnp.where(r_seq == c_seq, 0.0, -1e9).astype(jnp.float32)
+
+    # Q_bd head-block mask: row block h only keeps lanes of head h.
+    qb_row = jax.lax.broadcasted_iota(jnp.int32, (G * rows, LANE_GROUP), 0)
+    qb_col = jax.lax.broadcasted_iota(jnp.int32, (G * rows, LANE_GROUP), 1)
+    qmask = (qb_row // rows == qb_col // hd).astype(jnp.bfloat16)
+
+    # Per-head lane masks for the output fold.
+    lane = jax.lax.broadcasted_iota(jnp.int32, (rows, LANE_GROUP), 1)
+
+    bias_row = bias_ref[0, :, :].astype(jnp.float32)  # [1, rows] key bias
+
+    for g in range(n_groups):
+        lanes = pl.dslice(g * LANE_GROUP, LANE_GROUP)
+        q_rows = q_ref[:, lanes]
+        k_rows = k_ref[:, lanes]
+        v_rows = v_ref[:, lanes]
+
+        q_bd = jnp.tile(q_rows, (G, 1)) * qmask  # [G*rows, 128]
+        scores = (
+            jax.lax.dot_general(
+                q_bd,
+                k_rows,
+                (((1,), (1,)), ((), ())),  # contract lanes: Q_bd @ k_rows.T
+                preferred_element_type=jnp.float32,
+            )
+            * scale
+            + struct
+            + bias_row
+        )  # [G*rows, rows] f32
+        m = jnp.max(scores, axis=-1, keepdims=True)
+        p = jnp.exp(scores - m)
+        probs = (p / jnp.sum(p, axis=-1, keepdims=True)).astype(jnp.bfloat16)
+        ctx = jax.lax.dot(
+            probs, v_rows, preferred_element_type=jnp.float32
+        )  # [G*rows, 128]
+        out = jnp.zeros((rows, LANE_GROUP), jnp.float32)
+        for h in range(G):
+            blk = ctx[h * rows : (h + 1) * rows, :]
+            out = out + jnp.where((lane // hd) == h, blk, 0.0)
+        out_ref[:, lanes] = out.astype(out_ref.dtype)
+
+
+def _supported(S: int, H: int, heads: int) -> bool:
+    if H % heads:
+        return False
+    hd = H // heads
+    return hd in (32, 64, 128) and H % LANE_GROUP == 0 and S >= 16
+
+
+def _xla_attention(q, k, v, mask_bias, heads: int):
+    """Reference/fallback path: plain XLA batched attention."""
+    B, S, H = q.shape
+    hd = H // heads
+    scale = 1.0 / (hd**0.5)
+    q4 = q.reshape(B, S, heads, hd)
+    k4 = k.reshape(B, S, heads, hd)
+    v4 = v.reshape(B, S, heads, hd)
+    scores = jax.lax.dot_general(
+        q4, k4, (((3,), (3,)), ((0, 2), (0, 2))), preferred_element_type=jnp.float32
+    )  # [B, heads, S, S]
+    scores = scores * scale + mask_bias[:, None, None, :].astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    ctx = jax.lax.dot_general(
+        probs, v4, (((3,), (1,)), ((0, 1), (0, 2)))
+    )  # [B, heads, S, hd]
+    return jnp.swapaxes(ctx, 1, 2).reshape(B, S, H)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("heads", "block_seqs", "force_xla", "interpret")
+)
+def encoder_attention(
+    q,
+    k,
+    v,
+    mask_bias,
+    heads: int,
+    block_seqs: int | None = None,
+    force_xla: bool = False,
+    interpret: bool = False,
+):
+    """Bidirectional multi-head attention over packed-layout tensors.
+
+    Args:
+      q, k, v: ``[B, S, H]`` (heads packed in the lane dim, ``H = heads*hd``).
+      mask_bias: ``[B, S]`` additive key bias (0 for valid, ``-1e9`` for pad).
+      heads: number of attention heads.
+      block_seqs: sequences per kernel program (default: tuned by S).
+    Returns:
+      ctx ``[B, S, H]`` in the same packed layout and dtype as ``q``.
+    """
+    B, S, H = q.shape
+    on_tpu = jax.default_backend() == "tpu"
+    use_pallas = (interpret or on_tpu) and not force_xla and _supported(S, H, heads)
+    if not use_pallas:
+        return _xla_attention(q, k, v, mask_bias, heads)
+
+    hd = H // heads
+    # Padded score width bb*S of ~128 lanes measures fastest on v5e (larger
+    # bb multiplies the masked-out score work; smaller starves the MXU).
+    bb = block_seqs or max(1, min(B, 128 // S, 8))
+    while B % bb:
+        bb //= 2
+    rows = bb * S
+    grid = (B // bb,)
+    # 2D refs keep every in-kernel access a plain (sublane, lane) slice —
+    # collapsing [B, S, H] -> [B*S, H] is free outside the kernel.
+    q2 = q.reshape(B * S, H)
+    k2 = k.reshape(B * S, H)
+    v2 = v.reshape(B * S, H)
+    bias3 = mask_bias.astype(jnp.float32).reshape(B // bb, 1, rows)
+    spec2 = pl.BlockSpec((rows, H), lambda i: (i, 0))
+    bias_spec = pl.BlockSpec((1, 1, rows), lambda i: (i, 0, 0))
+    kernel = functools.partial(_attn_kernel, S=S, hd=hd, scale=1.0 / (hd**0.5))
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[spec2, spec2, spec2, bias_spec],
+        out_specs=spec2,
+        out_shape=jax.ShapeDtypeStruct((B * S, H), q.dtype),
+        interpret=interpret,
+    )(q2, k2, v2, bias3)
+    return out.reshape(B, S, H)
