@@ -132,8 +132,7 @@ def table_from_markdown(
     col_dtypes = [schema.__columns__[h].dtype for h in data_headers]
     pk = id_from or schema.primary_key_columns()
 
-    keyed = []
-    seq = itertools.count()
+    entries = []
     for r in rows:
         values = []
         pos = 0
@@ -149,16 +148,54 @@ def table_from_markdown(
             pos += 1
         t = int(r[time_idx]) if time_idx is not None else 0
         d = int(r[diff_idx]) if diff_idx is not None else 1
+        values = tuple(values)
         if sym_id is not None:
             key = hash_values([str(sym_id)])
         elif pk:
             key = hash_values([values[data_headers.index(c)] for c in pk])
         elif unsafe_trusted_ids:
-            key = sequential_key(next(seq))
+            # reference contract: stable ids from textual row order
+            key = sequential_key(len(entries))
         else:
-            key = sequential_key(next(seq))
-        keyed.append((key, tuple(values), t, d))
-    return table_from_list_of_tuples(keyed, schema)
+            key = None  # auto key; retractions pair with their addition
+        entries.append((key, values, t, d))
+    return table_from_list_of_tuples(_assign_auto_keys(entries), schema)
+
+
+def _assign_auto_keys(entries: list) -> list:
+    """Resolve ``None`` keys: fresh sequential keys for additions, and for a
+    retraction the key of the most recent *live* addition with identical
+    content — matched in time order (not textual order), so streams may be
+    written with rows in any order.  An auto-keyed retraction with no live
+    matching addition is an authoring error and raises rather than silently
+    retracting a row the engine never saw.
+
+    Input/output: ``[(key_or_None, values, time, diff), ...]``.
+    """
+    seq = itertools.count()
+    # additions precede retractions within an epoch, so a same-epoch
+    # add/retract pair pairs up regardless of textual order
+    order = sorted(
+        range(len(entries)), key=lambda i: (entries[i][2], -entries[i][3])
+    )
+    keys: list = [None] * len(entries)
+    live: dict = {}  # values -> [keys of live auto-keyed additions]
+    for i in order:
+        explicit, values, t, d = entries[i]
+        if explicit is not None:
+            keys[i] = explicit
+        elif d == -1:
+            stack = live.get(values)
+            if not stack:
+                raise ValueError(
+                    f"_diff=-1 row {values!r} at _time={t} retracts a row "
+                    "that is not live (no earlier matching addition)"
+                )
+            keys[i] = stack.pop()
+        else:
+            keys[i] = sequential_key(next(seq))
+            live.setdefault(values, []).append(keys[i])
+    return [(keys[i], e[1], e[2], e[3]) for i, e in enumerate(entries)]
 
 
 # T is the conventional alias used across reference tests (tests/utils.py:547)
@@ -175,8 +212,7 @@ def table_from_rows(
     names = list(schema.__columns__.keys())
     dtypes = [schema.__columns__[n].dtype for n in names]
     pk = schema.primary_key_columns()
-    keyed = []
-    seq = itertools.count()
+    entries = []
     for r in rows:
         if is_stream:
             vals, t, d = list(r[: len(names)]), int(r[len(names)]), int(r[len(names) + 1])
@@ -185,10 +221,12 @@ def table_from_rows(
         vals = [dt.coerce(v, dty) for v, dty in zip(vals, dtypes)]
         if pk:
             key = hash_values([vals[names.index(c)] for c in pk])
+        elif unsafe_trusted_ids:
+            key = sequential_key(len(entries))  # stable ids from row order
         else:
-            key = sequential_key(next(seq))
-        keyed.append((key, tuple(vals), t, d))
-    return table_from_list_of_tuples(keyed, schema)
+            key = None
+        entries.append((key, tuple(vals), t, d))
+    return table_from_list_of_tuples(_assign_auto_keys(entries), schema)
 
 
 def table_from_pandas(
@@ -221,8 +259,7 @@ def table_from_pandas(
             cols[c] = schema_mod.ColumnSchema(name=c, dtype=d)
         schema = schema_mod.schema_from_columns(cols)
     dtypes = [schema.__columns__[n].dtype for n in names]
-    keyed = []
-    seq = itertools.count()
+    entries = []
     pk = id_from or schema.primary_key_columns()
     for idx, row in df_pd.iterrows():
         vals = []
@@ -246,11 +283,12 @@ def table_from_pandas(
         if pk:
             key = hash_values([vals[names.index(c)] for c in pk])
         elif isinstance(idx, (int, np.integer)) and unsafe_trusted_ids:
+            # trusted explicit index: same index retracts the same key
             key = sequential_key(int(idx))
         else:
-            key = hash_values([str(idx), next(seq)]) if False else sequential_key(next(seq))
-        keyed.append((key, tuple(vals), t, d))
-    return table_from_list_of_tuples(keyed, schema)
+            key = None
+        entries.append((key, tuple(vals), t, d))
+    return table_from_list_of_tuples(_assign_auto_keys(entries), schema)
 
 
 def table_from_parquet(path: str, **kwargs) -> Table:

@@ -1,0 +1,315 @@
+"""Per-timestamp streaming semantics: the diff-stream checker tier.
+
+Model: the reference validates not just final tables but the *change
+stream* — per-epoch additions/retractions — with DiffEntry checkers
+(`python/pathway/tests/utils.py:120-246`) driven by `_time`/`_diff`
+markdown columns. These tests pin the incremental behavior of the core
+operators: every intermediate epoch state, not just the fixpoint.
+"""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib import temporal
+from tests.utils import (
+    T,
+    assert_snapshots,
+    assert_stream_consistent,
+    capture_deltas,
+    snapshots_by_time,
+)
+
+
+# ---------------------------------------------------------------------------
+# groupby: incremental aggregate updates emit retraction + new value
+# ---------------------------------------------------------------------------
+
+
+def test_groupby_sum_updates_per_epoch():
+    t = T(
+        """
+        k | v  | _time
+        a | 1  | 2
+        a | 2  | 4
+        b | 10 | 4
+        a | 4  | 6
+        """
+    )
+    res = t.groupby(pw.this.k).reduce(k=pw.this.k, s=pw.reducers.sum(pw.this.v))
+    deltas = assert_stream_consistent(res)
+    # epoch 2: a=1 appears; epoch 4: a retracted, a=3 + b=10 appear; epoch 6: a=7
+    assert_snapshots(
+        res,
+        {
+            2: [("a", 1)],
+            4: [("a", 3), ("b", 10)],
+            6: [("a", 7), ("b", 10)],
+        },
+        deltas,
+    )
+    # the update at epoch 4 must be retraction(a,1) + addition(a,3)
+    ep4 = sorted((r, d) for (_k, r, t, d) in deltas if t == 4)
+    assert ep4 == [(("a", 1), -1), (("a", 3), 1), (("b", 10), 1)]
+
+
+def test_groupby_handles_input_retraction():
+    t = T(
+        """
+        k | v  | _time | _diff
+        a | 1  | 2     | 1
+        a | 5  | 2     | 1
+        a | 1  | 4     | -1
+        """
+    )
+    res = t.groupby(pw.this.k).reduce(
+        k=pw.this.k, s=pw.reducers.sum(pw.this.v), c=pw.reducers.count()
+    )
+    deltas = assert_stream_consistent(res)
+    assert_snapshots(res, {2: [("a", 6, 2)], 4: [("a", 5, 1)]}, deltas)
+
+
+def test_min_reducer_recovers_previous_min_on_retraction():
+    t = T(
+        """
+        k | v | _time | _diff
+        a | 3 | 2     | 1
+        a | 1 | 2     | 1
+        a | 1 | 4     | -1
+        """
+    )
+    res = t.groupby(pw.this.k).reduce(k=pw.this.k, m=pw.reducers.min(pw.this.v))
+    deltas = assert_stream_consistent(res)
+    assert_snapshots(res, {2: [("a", 1)], 4: [("a", 3)]}, deltas)
+
+
+# ---------------------------------------------------------------------------
+# filter / select: row updates flow as retract+add pairs
+# ---------------------------------------------------------------------------
+
+
+def test_filter_emits_retraction_when_row_leaves_predicate():
+    t = T(
+        """
+        k | v | _time | _diff
+        x | 5 | 2     | 1
+        x | 5 | 4     | -1
+        x | 1 | 4     | 1
+        """
+    )
+    res = t.filter(pw.this.v > 3).select(pw.this.k, pw.this.v)
+    deltas = assert_stream_consistent(res)
+    snaps = snapshots_by_time(res, deltas)
+    assert sorted(snaps[2].values()) == [("x", 5)]
+    assert sorted(snaps[4].values()) == []  # left the predicate -> retracted
+
+
+# ---------------------------------------------------------------------------
+# join: updates on either side retract derived rows
+# ---------------------------------------------------------------------------
+
+
+def test_join_retracts_when_left_row_updates():
+    left = T(
+        """
+        k | v | _time | _diff
+        a | 1 | 2     | 1
+        a | 1 | 6     | -1
+        a | 2 | 6     | 1
+        """
+    )
+    right = T(
+        """
+        k | w | _time
+        a | 7 | 4
+        """
+    )
+    res = left.join(right, left.k == right.k).select(
+        left.k, pw.this.v, pw.this.w
+    )
+    deltas = assert_stream_consistent(res)
+    assert_snapshots(
+        res,
+        {
+            4: [("a", 1, 7)],
+            6: [("a", 2, 7)],
+        },
+        deltas,
+    )
+    # nothing live before the right side arrives
+    assert 2 not in snapshots_by_time(res, deltas)
+
+
+def test_left_join_fills_then_replaces_missing_match():
+    left = T(
+        """
+        k | v | _time
+        a | 1 | 2
+        """
+    )
+    right = T(
+        """
+        k | w | _time
+        a | 9 | 4
+        """
+    )
+    res = left.join_left(right, left.k == right.k).select(
+        left.k, pw.this.v, w=pw.coalesce(pw.this.w, -1)
+    )
+    deltas = assert_stream_consistent(res)
+    # epoch 2: unmatched row with the fill value; epoch 4: replaced by match
+    assert_snapshots(res, {2: [("a", 1, -1)], 4: [("a", 1, 9)]}, deltas)
+
+
+# ---------------------------------------------------------------------------
+# deduplicate: only changes of the accepted row are emitted
+# ---------------------------------------------------------------------------
+
+
+def test_deduplicate_streaming_keeps_first_then_updates_on_acceptance():
+    t = T(
+        """
+        k | v  | _time
+        a | 1  | 2
+        a | 5  | 4
+        a | 99 | 6
+        """
+    )
+
+    def acceptor(new, old) -> bool:
+        return new > old + 10  # only a big jump replaces the held value
+
+    res = t.deduplicate(value=pw.this.v, instance=pw.this.k, acceptor=acceptor)
+    deltas = assert_stream_consistent(res)
+    snaps = snapshots_by_time(res, deltas)
+    assert sorted(r[-1] for r in snaps[2].values()) == [1]
+    # v=5 rejected (1 -> 5 is not a big-enough jump): no epoch-4 deltas
+    assert 4 not in snaps
+    assert sorted(r[-1] for r in snaps[6].values()) == [99]
+
+
+# ---------------------------------------------------------------------------
+# windows: late rows re-open and update their window incrementally
+# ---------------------------------------------------------------------------
+
+
+def test_tumbling_window_updates_on_late_row():
+    t = T(
+        """
+        at | v  | _time
+        1  | 10 | 2
+        12 | 40 | 2
+        3  | 30 | 6
+        """
+    )
+    res = t.windowby(pw.this.at, window=temporal.tumbling(duration=5)).reduce(
+        start=pw.this._pw_window_start,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    deltas = assert_stream_consistent(res)
+    assert_snapshots(
+        res,
+        {
+            2: [(0, 10), (10, 40)],
+            6: [(0, 40), (10, 40)],  # late at=3 folded into window [0,5)
+        },
+        deltas,
+    )
+
+
+def test_sliding_window_membership_updates():
+    t = T(
+        """
+        at | _time
+        4  | 2
+        6  | 4
+        """
+    )
+    res = t.windowby(
+        pw.this.at, window=temporal.sliding(hop=5, duration=10)
+    ).reduce(start=pw.this._pw_window_start, cnt=pw.reducers.count())
+    deltas = assert_stream_consistent(res)
+    # at=4 joins windows starting 0 and -5; at=6 joins 0 and 5
+    assert_snapshots(
+        res,
+        {
+            2: [(-5, 1), (0, 1)],
+            4: [(-5, 1), (0, 2), (5, 1)],
+        },
+        deltas,
+    )
+
+
+# ---------------------------------------------------------------------------
+# asof join: each left row re-pairs when a closer right row arrives
+# ---------------------------------------------------------------------------
+
+
+def test_asof_join_repairs_on_new_right_row():
+    left = T(
+        """
+        t  | v | _time
+        10 | 1 | 2
+        """
+    )
+    right = T(
+        """
+        t | w  | _time
+        2 | 20 | 2
+        8 | 80 | 6
+        """
+    )
+    res = temporal.asof_join(
+        left, right, left.t, right.t, how=temporal.Direction.BACKWARD
+    ).select(left.v, right.w)
+    deltas = assert_stream_consistent(res)
+    assert_snapshots(res, {2: [(1, 20)], 6: [(1, 80)]}, deltas)
+
+
+# ---------------------------------------------------------------------------
+# temporal behaviors: forgetting closes windows and drops late data
+# ---------------------------------------------------------------------------
+
+
+def test_exactly_once_behavior_freezes_windows():
+    t = T(
+        """
+        at | v  | _time
+        1  | 10 | 2
+        6  | 60 | 4
+        12 | 70 | 6
+        2  | 99 | 8
+        """
+    )
+    res = t.windowby(
+        pw.this.at,
+        window=temporal.tumbling(duration=5),
+        behavior=temporal.exactly_once_behavior(),
+    ).reduce(start=pw.this._pw_window_start, total=pw.reducers.sum(pw.this.v))
+    deltas = assert_stream_consistent(res)
+    rows = sorted(r for (_k, r, _t, d) in deltas if d == 1)
+    # window [0,5) emitted exactly once with the on-time row only; the
+    # at=2 straggler arriving after the window closed is dropped
+    assert (0, 10) in rows
+    assert not any(r == (0, 109) or r == (0, 99) for r in rows)
+    retractions = [r for (_k, r, _t, d) in deltas if d == -1]
+    assert retractions == [], "exactly-once windows must never retract"
+
+
+# ---------------------------------------------------------------------------
+# idle-epoch boundaries: commit markers alone advance the frontier
+# ---------------------------------------------------------------------------
+
+
+def test_update_stream_times_are_monotone_and_even():
+    t = T(
+        """
+        k | _time
+        a | 2
+        b | 4
+        c | 8
+        """
+    )
+    deltas = capture_deltas(t.select(pw.this.k))
+    times = [t_ for (_k, _r, t_, _d) in deltas]
+    assert times == sorted(times)
+    assert all(t_ % 2 == 0 for t_ in times), "original rows carry even times"

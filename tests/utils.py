@@ -42,5 +42,73 @@ def assert_stream_equality(actual: pw.Table, expected_deltas: list) -> None:
     assert got == want, f"streams differ:\n got={got}\n want={want}"
 
 
+def capture_deltas(table: pw.Table) -> list:
+    """Run and return the raw change stream: [(key, row, time, diff), ...]."""
+    return list(_capture_table(table).deltas)
+
+
+def assert_stream_consistent(table: pw.Table) -> list:
+    """Validate the change stream the way the reference's DiffEntry checkers
+    do (``python/pathway/tests/utils.py:120-246``): per-key prefix counts
+    never go negative (no retraction of a row that is not live), unit diffs,
+    non-decreasing times, and a retraction always matches the live row.
+    Returns the deltas for further assertions.
+    """
+    deltas = capture_deltas(table)
+    last_t = None
+    live: Counter = Counter()
+    live_keys: Counter = Counter()
+    for key, row, t, d in deltas:
+        assert d in (1, -1), f"non-unit diff {d} for {row}"
+        assert last_t is None or t >= last_t, f"time went backwards at {row}"
+        last_t = t
+        live[(key, row)] += d
+        live_keys[key] += d
+        assert live[(key, row)] >= 0, f"retracted non-live row {row} @t={t}"
+        assert live[(key, row)] <= 1, f"row {row} added twice under one key @t={t}"
+        assert live_keys[key] <= 1, f"key {key} live with two different rows @t={t}"
+        assert live_keys[key] >= 0, f"key {key} over-retracted @t={t}"
+    return deltas
+
+
+def snapshots_by_time(table: pw.Table, deltas: list | None = None) -> dict:
+    """Return {epoch_time: {key: row}} — the live state after each epoch
+    that produced any delta.  Pass ``deltas`` (e.g. the return value of
+    ``assert_stream_consistent``) to avoid re-running the pipeline."""
+    if deltas is None:
+        deltas = capture_deltas(table)
+    state: dict = {}
+    out: dict = {}
+    for key, row, t, d in deltas:
+        if d == 1:
+            assert key not in state, f"key {key} added while live @t={t}"
+            state[key] = row
+        else:
+            assert d == -1, f"non-unit diff {d} for {row} @t={t}"
+            assert state.get(key) == row, (
+                f"retraction of {row} @t={t} but live row is {state.get(key)!r}"
+            )
+            del state[key]
+        out[t] = dict(state)
+    return out
+
+
+def assert_snapshots(
+    table: pw.Table, expected_by_time: dict, deltas: list | None = None
+) -> None:
+    """Assert the live row multiset (ignoring keys) after each listed epoch.
+
+    ``expected_by_time`` maps epoch time -> list of row tuples expected to
+    be live once that epoch is fully applied.  Epochs not listed are not
+    checked, so tests can pin just the interesting frontier states.
+    """
+    snaps = snapshots_by_time(table, deltas)
+    for t, want in expected_by_time.items():
+        assert t in snaps, f"no epoch {t} in stream (have {sorted(snaps)})"
+        got = sorted(snaps[t].values(), key=repr)
+        want = sorted(want, key=repr)
+        assert got == want, f"state after t={t}:\n got={got}\n want={want}"
+
+
 def run_all() -> None:
     pw.run()
