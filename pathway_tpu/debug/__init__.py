@@ -108,7 +108,17 @@ def table_from_markdown(
     *,
     _stream: bool = False,
 ) -> Table:
-    """Build a static (or, with ``_time`` column, streaming) table from markdown."""
+    r"""Build a static (or, with ``_time`` column, streaming) table from markdown.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('a | b\n1 | x\n2 | y')
+    >>> pw.debug.compute_and_print(t, include_id=False)
+    a | b
+    1 | x
+    2 | y
+    """
     headers, rows = _rows_from_markdown(table_def)
     has_symbolic_id = bool(headers) and headers[0] in ("", "id")
     special = {"_time", "_diff"}
@@ -377,7 +387,17 @@ def compute_and_print(
     n_rows: int | None = None,
     **kwargs,
 ) -> None:
-    """Run the graph and print the final state of ``table``."""
+    r"""Run the graph and print the final state of ``table``.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('v\n2\n1')
+    >>> pw.debug.compute_and_print(t, include_id=False)
+    v
+    1
+    2
+    """
     cap = _capture_table(table, **kwargs)
     names = table.column_names()
     rows = cap.final_rows()
@@ -406,7 +426,17 @@ def compute_and_print_update_stream(
     n_rows: int | None = None,
     **kwargs,
 ) -> None:
-    """Run and print the full change stream with __time__ and __diff__."""
+    r"""Run and print the full change stream with __time__ and __diff__.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('v | _time\n1 | 2\n2 | 4')
+    >>> pw.debug.compute_and_print_update_stream(t, include_id=False)
+    v | __time__ | __diff__
+    1 | 2        | 1
+    2 | 4        | 1
+    """
     cap = _capture_table(table, **kwargs)
     names = table.column_names()
     header = (["id"] if include_id else []) + [str(n) for n in names] + [

@@ -447,7 +447,20 @@ class StatefulReducer(Reducer):
 
 
 def stateful_single(combine_fn: Callable) -> StatefulReducer:
-    """pw.reducers.stateful_single — state = combine(state, *row_values)."""
+    r"""pw.reducers.stateful_single — state = combine(state, *row_values).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> concat = pw.reducers.stateful_single(
+    ...     lambda state, v: (state or '') + v
+    ... )
+    >>> t = pw.debug.table_from_markdown('k | v\na | x\na | y')
+    >>> r = t.groupby(pw.this.k).reduce(pw.this.k, s=concat(pw.this.v))
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    k | s
+    a | xy
+    """
     return StatefulReducer(combine_fn, many=False, name=getattr(combine_fn, "__name__", "stateful"))
 
 
@@ -524,6 +537,29 @@ class _CustomAccState(ReducerState):
 
 
 def udf_reducer(accumulator: type[BaseCustomAccumulator]):
+    r"""Custom reducer from a ``BaseCustomAccumulator`` subclass (supports retractions).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> class Sum(pw.BaseCustomAccumulator):
+    ...     def __init__(self, v):
+    ...         self.s = v
+    ...     @classmethod
+    ...     def from_row(cls, row):
+    ...         return cls(row[0])
+    ...     def update(self, other):
+    ...         self.s += other.s
+    ...     def retract(self, other):
+    ...         self.s -= other.s
+    ...     def compute_result(self):
+    ...         return self.s
+    >>> ssum = pw.reducers.udf_reducer(Sum)
+    >>> t = pw.debug.table_from_markdown('k | v\na | 2\na | 3')
+    >>> pw.debug.compute_and_print(t.groupby(pw.this.k).reduce(pw.this.k, s=ssum(pw.this.v)), include_id=False)
+    k | s
+    a | 5
+    """
     class _R(Reducer):
         name = getattr(accumulator, "__name__", "custom")
 
@@ -560,6 +596,18 @@ latest = LatestReducer()
 
 
 def sorted_tuple(expr, *, skip_nones: bool = False):
+    r"""Aggregate the values of ``expr`` into a sorted tuple per group.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('k | v\na | 3\na | 1\nb | 2')
+    >>> r = t.groupby(pw.this.k).reduce(pw.this.k, vs=pw.reducers.sorted_tuple(pw.this.v))
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    k | vs
+    a | (1, 3)
+    b | (2,)
+    """
     r = _multiset_reducer(
         "sorted_tuple",
         _finish_sorted_tuple_factory(skip_nones),
@@ -570,6 +618,17 @@ def sorted_tuple(expr, *, skip_nones: bool = False):
 
 
 def tuple(expr, *, skip_nones: bool = False, sort_by=None):  # noqa: A001
+    r"""Aggregate values into a tuple per group, optionally ordered by ``sort_by``.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('k | v | o\na | x | 2\na | y | 1')
+    >>> r = t.groupby(pw.this.k).reduce(pw.this.k, vs=pw.reducers.tuple(pw.this.v, sort_by=pw.this.o))
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    k | vs
+    a | ('y', 'x')
+    """
     r = _multiset_reducer(
         "tuple",
         _finish_tuple_factory(skip_nones),

@@ -722,9 +722,19 @@ def _compile_query(ast, tables: dict[str, Table]) -> Table:
 
 
 def sql(query: str, **tables: Table) -> Table:
-    """Execute a SQL query over the provided tables.
+    r"""Execute a SQL query over the provided tables.
 
     Reference: ``pw.sql`` (`internals/sql.py:613`).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('k | v\na | 1\na | 2\nb | 5')
+    >>> r = pw.sql('SELECT k, SUM(v) AS s FROM t GROUP BY k', t=t)
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    k | s
+    a | 3
+    b | 5
     """
     p = _Parser(_tokenize(query.strip().rstrip(";")))
     ast = _parse_query(p)

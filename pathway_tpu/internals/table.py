@@ -470,12 +470,53 @@ class JoinMode(enum.Enum):
 
 class Joinable:
     def join(self, other, *on, id=None, how=JoinMode.INNER, left_instance=None, right_instance=None):
+        """Join with ``other`` on equality conditions; ``how`` picks the join mode.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t1 = pw.debug.table_from_markdown('''
+        ... owner | pet
+        ... Alice | dog
+        ... Bob   | cat
+        ... ''')
+        >>> t2 = pw.debug.table_from_markdown('''
+        ... pet | sound
+        ... dog | woof
+        ... cat | meow
+        ... ''')
+        >>> j = t1.join(t2, t1.pet == t2.pet).select(t1.owner, t2.sound)
+        >>> pw.debug.compute_and_print(j, include_id=False)
+        owner | sound
+        Alice | woof
+        Bob   | meow
+        """
         return JoinResult(self, other, on, mode=how, id=id)
 
     def join_inner(self, other, *on, id=None, **kw):
         return JoinResult(self, other, on, mode=JoinMode.INNER, id=id)
 
     def join_left(self, other, *on, id=None, **kw):
+        """Left outer join: unmatched left rows survive with ``None`` fills.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t1 = pw.debug.table_from_markdown('''
+        ... owner | pet
+        ... Alice | dog
+        ... Eve   | axolotl
+        ... ''')
+        >>> t2 = pw.debug.table_from_markdown('''
+        ... pet | sound
+        ... dog | woof
+        ... ''')
+        >>> j = t1.join_left(t2, t1.pet == t2.pet).select(t1.owner, t2.sound)
+        >>> pw.debug.compute_and_print(j, include_id=False)
+        owner | sound
+        Alice | woof
+        Eve   | None
+        """
         return JoinResult(self, other, on, mode=JoinMode.LEFT, id=id)
 
     def join_right(self, other, *on, id=None, **kw):
@@ -565,6 +606,23 @@ class Table(Joinable):
 
     # -- core ops --
     def select(self, *args, **kwargs) -> "Table":
+        """Produce a new table with the given columns (same rows/keys).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... owner | pet  | age
+        ... Alice | dog  | 5
+        ... Bob   | cat  | 3
+        ... Carol | dog  | 8
+        ... ''')
+        >>> pw.debug.compute_and_print(t.select(pw.this.owner, older=pw.this.age + 1), include_id=False)
+        owner | older
+        Alice | 6
+        Bob   | 4
+        Carol | 9
+        """
         exprs = _expand_args(args, self)
         exprs.update(kwargs)
         return self._select_impl(exprs, universe=self._universe)
@@ -652,6 +710,23 @@ class Table(Joinable):
         return self._select_impl(exprs, universe=self._universe)
 
     def without(self, *columns) -> "Table":
+        """Drop the given columns.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... owner | pet  | age
+        ... Alice | dog  | 5
+        ... Bob   | cat  | 3
+        ... Carol | dog  | 8
+        ... ''')
+        >>> pw.debug.compute_and_print(t.without(pw.this.age), include_id=False)
+        owner | pet
+        Alice | dog
+        Bob   | cat
+        Carol | dog
+        """
         names = {c if isinstance(c, str) else c.name for c in columns}
         exprs = {
             n: ColumnReference(this, n) for n in self.column_names() if n not in names
@@ -659,6 +734,23 @@ class Table(Joinable):
         return self._select_impl(exprs, universe=self._universe)
 
     def rename(self, names_mapping: dict | None = None, **kwargs) -> "Table":
+        """Rename columns (``new=old`` keyword form or a ``{old: new}`` mapping).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... owner | pet  | age
+        ... Alice | dog  | 5
+        ... Bob   | cat  | 3
+        ... Carol | dog  | 8
+        ... ''')
+        >>> pw.debug.compute_and_print(t.rename(years=pw.this.age).select(pw.this.owner, pw.this.years), include_id=False)
+        owner | years
+        Alice | 5
+        Bob   | 3
+        Carol | 8
+        """
         if names_mapping:
             return self.rename_by_dict(names_mapping)
         return self.rename_columns(**kwargs)
@@ -696,6 +788,22 @@ class Table(Joinable):
         return self.rename_by_dict({n: n + suffix for n in self.column_names()})
 
     def filter(self, filter_expression) -> "Table":
+        """Keep only the rows satisfying the predicate.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... owner | pet  | age
+        ... Alice | dog  | 5
+        ... Bob   | cat  | 3
+        ... Carol | dog  | 8
+        ... ''')
+        >>> pw.debug.compute_and_print(t.filter(pw.this.pet == 'dog'), include_id=False)
+        owner | pet | age
+        Alice | dog | 5
+        Carol | dog | 8
+        """
         e = _desugar(filter_expression, self)
 
         def build(lowerer: Lowerer) -> df.Node:
@@ -772,6 +880,23 @@ class Table(Joinable):
         return Table(self._schema, build, universe=self._universe)
 
     def flatten(self, to_flatten: ColumnReference, *, origin_id: str | None = None) -> "Table":
+        """One output row per element of an iterable column.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... owner | pets
+        ... Alice | dog,cat
+        ... Bob   | fish
+        ... ''')
+        >>> s = t.select(pw.this.owner, pet=pw.this.pets.str.split(','))
+        >>> pw.debug.compute_and_print(s.flatten(pw.this.pet), include_id=False)
+        owner | pet
+        Alice | cat
+        Alice | dog
+        Bob   | fish
+        """
         col = to_flatten.name
         col_idx = self.column_names().index(col)
         names = self.column_names()
@@ -819,6 +944,24 @@ class Table(Joinable):
         return expr_mod.PointerExpression(self, *args, optional=optional, instance=instance)
 
     def with_id_from(self, *args, instance=None) -> "Table":
+        """Re-key rows from the given expressions (primary-key change).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... owner | pet  | age
+        ... Alice | dog  | 5
+        ... Bob   | cat  | 3
+        ... Carol | dog  | 8
+        ... ''')
+        >>> r = t.with_id_from(pw.this.owner)
+        >>> pw.debug.compute_and_print(r.select(pw.this.owner, pw.this.age), include_id=False)
+        owner | age
+        Alice | 5
+        Bob   | 3
+        Carol | 8
+        """
         exprs = [_desugar(expr_mod._wrap(a), self) for a in args]
         if instance is not None:
             exprs.append(_desugar(expr_mod._wrap(instance), self))
@@ -857,6 +1000,18 @@ class Table(Joinable):
 
     # -- set ops --
     def concat(self, *others: "Table") -> "Table":
+        r"""Union of rows of same-schema tables (keys must be disjoint).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> a = pw.debug.table_from_markdown('v\n1\n2')
+        >>> b = pw.debug.table_from_markdown('v\n3')
+        >>> pw.debug.compute_and_print(a.concat(b), include_id=False)
+        v
+        2
+        3
+        """
         tables = [self, *others]
         names = self.column_names()
         for t in others:
@@ -897,6 +1052,19 @@ class Table(Joinable):
         return Table(self._schema, build, universe=Universe())
 
     def update_rows(self, other: "Table") -> "Table":
+        r"""Upsert: rows of ``other`` replace/extend rows with the same key.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> old = pw.debug.table_from_markdown('k | v\na | 1\nb | 2', id_from=['k'])
+        >>> new = pw.debug.table_from_markdown('k | v\nb | 9\nc | 3', id_from=['k'])
+        >>> pw.debug.compute_and_print(old.update_rows(new), include_id=False)
+        k | v
+        a | 1
+        b | 9
+        c | 3
+        """
         if other.column_names() != self.column_names():
             raise ValueError("update_rows: column sets must match")
 
@@ -916,6 +1084,18 @@ class Table(Joinable):
         return Table(schema_mod.schema_from_columns(cols), build, universe=Universe())
 
     def update_cells(self, other: "Table") -> "Table":
+        r"""Overwrite cells for keys present in ``other`` (same universe or subset).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> old = pw.debug.table_from_markdown('k | v | w\na | 1 | x\nb | 2 | y', id_from=['k'])
+        >>> new = pw.debug.table_from_markdown('k | v\nb | 9', id_from=['k'])
+        >>> pw.debug.compute_and_print(old.update_cells(new.select(pw.this.v)), include_id=False)
+        k | v | w
+        a | 1 | x
+        b | 9 | y
+        """
         extra = set(other.column_names()) - set(self.column_names())
         if extra:
             raise ValueError(f"update_cells: unknown columns {extra}")
@@ -948,6 +1128,17 @@ class Table(Joinable):
         return self.update_cells(other)
 
     def intersect(self, *tables: "Table") -> "Table":
+        r"""Restrict to rows whose keys appear in every argument table.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> a = pw.debug.table_from_markdown('k | v\nx | 1\ny | 2', id_from=['k'])
+        >>> b = pw.debug.table_from_markdown('k | w\ny | 9', id_from=['k'])
+        >>> pw.debug.compute_and_print(a.intersect(b), include_id=False)
+        k | v
+        y | 2
+        """
         def build(lowerer: Lowerer) -> df.Node:
             return df.IntersectNode(
                 lowerer.scope,
@@ -958,6 +1149,17 @@ class Table(Joinable):
         return Table(self._schema, build, universe=Universe(parent=self._universe))
 
     def difference(self, other: "Table") -> "Table":
+        r"""Keep rows whose keys do NOT appear in ``other``.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> a = pw.debug.table_from_markdown('k | v\nx | 1\ny | 2', id_from=['k'])
+        >>> b = pw.debug.table_from_markdown('k | w\ny | 9', id_from=['k'])
+        >>> pw.debug.compute_and_print(a.difference(b), include_id=False)
+        k | v
+        x | 1
+        """
         def build(lowerer: Lowerer) -> df.Node:
             return df.IntersectNode(
                 lowerer.scope,
@@ -994,6 +1196,24 @@ class Table(Joinable):
 
     # -- ix --
     def ix(self, expression, *, optional: bool = False, context=None) -> IxRowView:
+        """Row lookup by pointer: read columns of the row ``expression`` points at.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... name  | boss
+        ... Alice | Carol
+        ... Bob   | Carol
+        ... Carol | Carol
+        ... ''', id_from=['name'])
+        >>> r = t.select(pw.this.name, boss_of_boss=t.ix(t.pointer_from(pw.this.boss)).boss)
+        >>> pw.debug.compute_and_print(r, include_id=False)
+        name  | boss_of_boss
+        Alice | Carol
+        Bob   | Carol
+        Carol | Carol
+        """
         return IxRowView(self, expression, optional=optional)
 
     def ix_ref(self, *args, optional: bool = False, context=None, instance=None) -> IxRowView:
@@ -1002,9 +1222,45 @@ class Table(Joinable):
 
     # -- groupby / reduce --
     def groupby(self, *args, id=None, sort_by=None, instance=None, **kwargs) -> "GroupedTable":
+        """Group rows by the given expressions; follow with ``.reduce(...)``.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... owner | pet  | age
+        ... Alice | dog  | 5
+        ... Bob   | cat  | 3
+        ... Carol | dog  | 8
+        ... ''')
+        >>> res = t.groupby(pw.this.pet).reduce(
+        ...     pw.this.pet,
+        ...     n=pw.reducers.count(),
+        ...     oldest=pw.reducers.max(pw.this.age),
+        ... )
+        >>> pw.debug.compute_and_print(res, include_id=False)
+        pet | n | oldest
+        cat | 1 | 3
+        dog | 2 | 8
+        """
         return GroupedTable(self, args, id=id, sort_by=sort_by, instance=instance)
 
     def reduce(self, *args, **kwargs) -> "Table":
+        """Reduce the whole table to a single row of aggregates.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... owner | pet  | age
+        ... Alice | dog  | 5
+        ... Bob   | cat  | 3
+        ... Carol | dog  | 8
+        ... ''')
+        >>> pw.debug.compute_and_print(t.reduce(total_age=pw.reducers.sum(pw.this.age)), include_id=False)
+        total_age
+        16
+        """
         return GroupedTable(self, (), id=None).reduce(*args, **kwargs)
 
     def deduplicate(
@@ -1016,6 +1272,22 @@ class Table(Joinable):
         persistent_id: str | None = None,
         name: str | None = None,
     ) -> "Table":
+        """Keep one accepted row per ``instance``; ``acceptor`` decides replacement.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... k | v  | _time
+        ... a | 1  | 2
+        ... a | 5  | 4
+        ... a | 2  | 6
+        ... ''')
+        >>> res = t.deduplicate(value=pw.this.v, instance=pw.this.k, acceptor=lambda new, old: new > old)
+        >>> pw.debug.compute_and_print(res.select(pw.this.v), include_id=False)
+        v
+        5
+        """
         if value is None:
             raise ValueError("deduplicate requires value=")
         if acceptor is None:
@@ -1055,6 +1327,25 @@ class Table(Joinable):
 
     # -- sort --
     def sort(self, key, instance=None) -> "Table":
+        """Add ``prev``/``next`` pointer columns reflecting the sort order.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown('''
+        ... owner | pet  | age
+        ... Alice | dog  | 5
+        ... Bob   | cat  | 3
+        ... Carol | dog  | 8
+        ... ''')
+        >>> s = t.sort(key=pw.this.age)
+        >>> r = t.select(pw.this.owner, next_owner=t.ix(s.next, optional=True).owner)
+        >>> pw.debug.compute_and_print(r, include_id=False)
+        owner | next_owner
+        Alice | Carol
+        Bob   | Alice
+        Carol | None
+        """
         key_e = _desugar(expr_mod._wrap(key), self)
         inst_e = _desugar(expr_mod._wrap(instance), self) if instance is not None else None
 

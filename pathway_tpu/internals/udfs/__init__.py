@@ -159,7 +159,20 @@ def udf(
     cache_strategy: CacheStrategy | None = None,
     max_batch_size: int | None = None,
 ):
-    """``@pw.udf`` — turn a Python function into a column-expression builder."""
+    r"""``@pw.udf`` — turn a Python function into a column-expression builder.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> @pw.udf
+    ... def shout(s: str) -> str:
+    ...     return s.upper()
+    >>> t = pw.debug.table_from_markdown('w\nhi\nyo')
+    >>> pw.debug.compute_and_print(t.select(loud=shout(pw.this.w)), include_id=False)
+    loud
+    HI
+    YO
+    """
 
     def wrapper(f: Callable) -> _FunctionUDF:
         return _FunctionUDF(

@@ -16,7 +16,18 @@ def deduplicate(
     persistent_id: str | None = None,
     name: str | None = None,
 ) -> Table:
-    """Keep one row per instance; replace when acceptor(new, old) is True."""
+    r"""Keep one row per instance; replace when acceptor(new, old) is True.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> from pathway_tpu.stdlib.stateful import deduplicate
+    >>> t = pw.debug.table_from_markdown('k | v | _time\na | 1 | 2\na | 9 | 4')
+    >>> r = deduplicate(t, value=pw.this.v, instance=pw.this.k, acceptor=lambda new, old: new > old)
+    >>> pw.debug.compute_and_print(r.select(pw.this.v), include_id=False)
+    v
+    9
+    """
     return table.deduplicate(
         value=value, instance=instance, acceptor=acceptor, persistent_id=persistent_id, name=name
     )

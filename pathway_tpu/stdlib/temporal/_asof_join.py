@@ -260,7 +260,21 @@ def asof_join(
     direction: Direction = Direction.BACKWARD,
     behavior=None,
 ) -> AsofJoinResult:
-    """``pw.temporal.asof_join`` (reference _asof_join.py:479)."""
+    r"""``pw.temporal.asof_join`` (reference _asof_join.py:479).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> trades = pw.debug.table_from_markdown('t | px\n3 | 100\n7 | 101')
+    >>> quotes = pw.debug.table_from_markdown('t | bid\n2 | 99\n6 | 98')
+    >>> r = pw.temporal.asof_join(
+    ...     trades, quotes, trades.t, quotes.t, how=pw.temporal.Direction.BACKWARD
+    ... ).select(trades.px, quotes.bid)
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    px  | bid
+    100 | 99
+    101 | 98
+    """
     return AsofJoinResult(
         self, other, self_time, other_time, on, mode=how, defaults=defaults, direction=direction
     )

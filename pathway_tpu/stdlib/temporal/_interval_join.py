@@ -191,7 +191,20 @@ def interval_join(
     how: JoinMode = JoinMode.INNER,
     behavior=None,
 ) -> IntervalJoinResult:
-    """``pw.temporal.interval_join`` (reference _interval_join.py:577)."""
+    r"""``pw.temporal.interval_join`` (reference _interval_join.py:577).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> a = pw.debug.table_from_markdown('t | v\n1 | x\n5 | y')
+    >>> b = pw.debug.table_from_markdown('t | w\n2 | p\n9 | q')
+    >>> r = pw.temporal.interval_join(
+    ...     a, b, a.t, b.t, pw.temporal.interval(-1, 1)
+    ... ).select(a.v, b.w)
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    v | w
+    x | p
+    """
     return IntervalJoinResult(self, other, self_time, other_time, iv, on, how)
 
 

@@ -103,18 +103,61 @@ class IntervalsOverWindow(Window):
 
 
 def tumbling(duration, origin=None, shift=None) -> TumblingWindow:
+    r"""Fixed-size non-overlapping event-time windows.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('at | v\n1 | 10\n3 | 20\n7 | 30')
+    >>> r = t.windowby(pw.this.at, window=pw.temporal.tumbling(duration=5)).reduce(
+    ...     start=pw.this._pw_window_start, total=pw.reducers.sum(pw.this.v)
+    ... )
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    start | total
+    0     | 30
+    5     | 30
+    """
     if shift is not None:
         return SlidingWindow(hop=shift, duration=duration, origin=origin)
     return TumblingWindow(duration=duration, origin=origin)
 
 
 def sliding(hop, duration=None, ratio=None, origin=None) -> SlidingWindow:
+    r"""Overlapping windows of ``duration`` starting every ``hop``.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('at\n4\n6')
+    >>> r = t.windowby(pw.this.at, window=pw.temporal.sliding(hop=5, duration=10)).reduce(
+    ...     start=pw.this._pw_window_start, n=pw.reducers.count()
+    ... )
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    start | n
+    -5    | 1
+    0     | 2
+    5     | 1
+    """
     if duration is None and ratio is not None:
         duration = hop * ratio
     return SlidingWindow(hop=hop, duration=duration, origin=origin)
 
 
 def session(*, predicate=None, max_gap=None) -> SessionWindow:
+    r"""Windows that merge events closer than ``max_gap`` (or by ``predicate``).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('at\n1\n2\n10')
+    >>> r = t.windowby(pw.this.at, window=pw.temporal.session(max_gap=3)).reduce(
+    ...     n=pw.reducers.count()
+    ... )
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    n
+    1
+    2
+    """
     if (predicate is None) == (max_gap is None):
         raise ValueError("session window needs exactly one of predicate/max_gap")
     return SessionWindow(predicate=predicate, max_gap=max_gap)

@@ -1,0 +1,53 @@
+"""Execute the runnable ``>>>`` examples in public-API docstrings.
+
+Model: the reference runs every docstring example in CI (SURVEY.md §4,
+e.g. ``udfs/executors.py:51-87``).  Each doctest runs against a cleared
+parse graph so examples stay independent.
+"""
+
+from __future__ import annotations
+
+import doctest
+import importlib
+
+import pytest
+
+from pathway_tpu.internals.parse_graph import G
+
+MODULES = [
+    "pathway_tpu.internals.table",
+    "pathway_tpu.internals.reducers",
+    "pathway_tpu.internals.expression",
+    "pathway_tpu.internals.sql",
+    "pathway_tpu.internals.udfs",
+    "pathway_tpu.debug",
+    "pathway_tpu.stdlib.temporal._window",
+    "pathway_tpu.stdlib.temporal._asof_join",
+    "pathway_tpu.stdlib.temporal._interval_join",
+    "pathway_tpu.stdlib.indexing.nearest_neighbors",
+    "pathway_tpu.stdlib.stateful",
+]
+
+
+def _collect():
+    finder = doctest.DocTestFinder(exclude_empty=True)
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        for test in finder.find(mod, name=modname):
+            if test.examples:
+                yield pytest.param(test, id=test.name)
+
+
+@pytest.mark.parametrize("dtest", _collect())
+def test_doctest(dtest):
+    G.clear()
+    runner = doctest.DocTestRunner(optionflags=doctest.NORMALIZE_WHITESPACE)
+    runner.run(dtest)
+    results = runner.summarize(verbose=False)
+    assert results.failed == 0, f"{dtest.name}: {results.failed} failed"
+
+
+def test_doctest_coverage_floor():
+    """Guard: the public API keeps a baseline of runnable examples."""
+    n = sum(1 for _ in _collect())
+    assert n >= 18, f"only {n} doctests collected"
