@@ -39,7 +39,26 @@ def generate_custom_stream(
     input_rate: float = 1.0,
     persistent_id: str | None = None,
 ) -> Table:
-    """Generate a stream from per-column generator functions."""
+    """Generate a stream from per-column generator functions.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> class S(pw.Schema):
+    ...     n: int
+    >>> t = pw.demo.generate_custom_stream(
+    ...     value_generators={'n': lambda i: i * i},
+    ...     schema=S,
+    ...     nb_rows=3,
+    ...     autocommit_duration_ms=10,
+    ...     input_rate=1000.0,
+    ... )
+    >>> pw.debug.compute_and_print(t, include_id=False)
+    n
+    0
+    1
+    4
+    """
 
     def row_fn(i: int) -> dict:
         return {name: gen(i) for name, gen in value_generators.items()}

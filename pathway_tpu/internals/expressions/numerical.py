@@ -15,6 +15,18 @@ from pathway_tpu.internals.expression import (
 
 
 class NumericalNamespace:
+    r"""``col.num`` — numerical operations on column expressions.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('x\n-2.75\n3.5')
+    >>> r = t.select(a=pw.this.x.num.abs(), rnd=pw.this.x.num.round(1))
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    a    | rnd
+    2.75 | -2.8
+    3.5  | 3.5
+    """
     def __init__(self, expr: ColumnExpression):
         self._expr = expr
 

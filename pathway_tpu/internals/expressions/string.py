@@ -10,6 +10,22 @@ from pathway_tpu.internals.expression import ColumnExpression, MethodCallExpress
 
 
 class StringNamespace:
+    r"""``col.str`` — string operations on column expressions.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('w\nHello World\nbye')
+    >>> r = t.select(
+    ...     up=pw.this.w.str.upper(),
+    ...     n=pw.this.w.str.len(),
+    ...     first=pw.this.w.str.split(' ').get(0, default=''),
+    ... )
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    up          | n  | first
+    BYE         | 3  | bye
+    HELLO WORLD | 11 | Hello
+    """
     def __init__(self, expr: ColumnExpression):
         self._expr = expr
 

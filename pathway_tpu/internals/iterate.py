@@ -62,12 +62,29 @@ class _IterSubLowerer(Lowerer):
 
 
 def iterate(func: Callable, iteration_limit: int | None = None, **kwargs: Table):
-    """Iterate ``func`` to fixed point.
+    r"""Iterate ``func`` to fixed point.
 
     ``kwargs`` are input tables; ``func(**tables)`` returns a dict (or
     dataclass/namedtuple) of tables.  Returned keys matching input names are
     fed back for the next round; the fixed point of each returned table is
     the result.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> def collatz(t):
+    ...     return t.select(
+    ...         v=pw.if_else(
+    ...             pw.this.v == 1, 1,
+    ...             pw.if_else(pw.this.v % 2 == 0, pw.this.v // 2, 3 * pw.this.v + 1),
+    ...         )
+    ...     )
+    >>> t = pw.debug.table_from_markdown('v\n6\n27')
+    >>> res = pw.iterate(collatz, t=t)
+    >>> pw.debug.compute_and_print(res, include_id=False)
+    v
+    1
+    1
     """
     input_names = list(kwargs.keys())
     input_tables = [kwargs[n] for n in input_names]

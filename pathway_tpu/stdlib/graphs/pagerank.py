@@ -10,7 +10,24 @@ from pathway_tpu.internals.thisclass import this
 
 
 def pagerank(edges: Table, steps: int = 5, damping: int = 85) -> Table:
-    """Integer-arithmetic pagerank over an edge table (columns u, v)."""
+    """Integer-arithmetic pagerank over an edge table (columns u, v).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> from pathway_tpu.stdlib.graphs.pagerank import pagerank
+    >>> edges = pw.debug.table_from_markdown('''
+    ... u | v
+    ... a | b
+    ... b | c
+    ... c | a
+    ... ''')
+    >>> g = edges.select(u=edges.pointer_from(pw.this.u), v=edges.pointer_from(pw.this.v))
+    >>> ranks = pagerank(g, steps=3)
+    >>> pw.debug.compute_and_print(ranks.reduce(n=pw.reducers.count()), include_id=False)
+    n
+    3
+    """
     # out-degrees
     degrees = edges.groupby(this.u).reduce(u=this.u, degree=reducers.count())
     vertices = (
