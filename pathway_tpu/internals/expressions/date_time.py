@@ -58,11 +58,11 @@ class DateTimeNamespace:
     >>> import pathway_tpu as pw
     >>> t = pw.debug.table_from_markdown('ts\n2024-03-01T10:30:00')
     >>> r = t.select(
-    ...     d=pw.this.ts.dt.strptime('%Y-%m-%dT%H:%M:%S').dt.strftime('%d %b %Y'),
+    ...     d=pw.this.ts.dt.strptime('%Y-%m-%dT%H:%M:%S').dt.strftime('%d.%m.%Y'),
     ... )
     >>> pw.debug.compute_and_print(r, include_id=False)
     d
-    01 Mar 2024
+    01.03.2024
     """
     def __init__(self, expr: ColumnExpression):
         self._expr = expr

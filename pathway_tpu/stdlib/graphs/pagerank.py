@@ -19,14 +19,17 @@ def pagerank(edges: Table, steps: int = 5, damping: int = 85) -> Table:
     >>> edges = pw.debug.table_from_markdown('''
     ... u | v
     ... a | b
+    ... a | c
     ... b | c
     ... c | a
     ... ''')
     >>> g = edges.select(u=edges.pointer_from(pw.this.u), v=edges.pointer_from(pw.this.v))
     >>> ranks = pagerank(g, steps=3)
-    >>> pw.debug.compute_and_print(ranks.reduce(n=pw.reducers.count()), include_id=False)
-    n
-    3
+    >>> pw.debug.compute_and_print(ranks.select(pw.this.rank), include_id=False)
+    rank
+    104
+    120
+    71
     """
     # out-degrees
     degrees = edges.groupby(this.u).reduce(u=this.u, degree=reducers.count())
