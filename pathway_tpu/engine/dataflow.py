@@ -46,7 +46,20 @@ Row = tuple
 Delta = tuple  # (key:int, row:Row, diff:int)
 
 
+class CleanDeltas(list):
+    """Delta list known to be all-insert (+1) with pairwise-distinct keys.
+
+    Such a list cannot cancel or merge, so ``consolidate`` is the identity
+    on it.  Producers whose transformation preserves the property (1:1 maps,
+    filters, key-fresh flattens) re-tag their output, letting the ingest-
+    heavy epochs skip the O(n) clean-scan at every node boundary — that scan
+    was the hottest host-path line at 1M rows/epoch.
+    """
+
+
 def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
+    if isinstance(deltas, CleanDeltas):
+        return deltas
     if not isinstance(deltas, list):
         deltas = list(deltas)
     # fast path: all-distinct-key inserts cannot cancel or merge — an int-set
@@ -60,7 +73,7 @@ def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
             break
         keys.add(key)
     if clean:
-        return deltas
+        return CleanDeltas(deltas)
     acc: Counter = Counter()
     for key, row, diff in deltas:
         acc[(key, row)] += diff
@@ -117,7 +130,18 @@ class Node:
             return
         self.rows_out += len(deltas)
         for node, port in self.downstream:
-            node.pending[port].extend(deltas)
+            cur = node.pending.get(port)
+            if not cur:
+                # preserve the clean marker while the port holds one chunk;
+                # concatenated chunks may collide keys, so they downgrade
+                cls = CleanDeltas if isinstance(deltas, CleanDeltas) else list
+                node.pending[port] = cls(deltas)
+            elif isinstance(cur, CleanDeltas):
+                plain = list(cur)
+                plain.extend(deltas)
+                node.pending[port] = plain
+            else:
+                cur.extend(deltas)
 
     def take_pending(self, port: int = 0) -> list[Delta]:
         deltas = self.pending.pop(port, [])
@@ -287,8 +311,15 @@ class StaticNode(InputNode):
 
     def __init__(self, scope: "Scope", rows: Iterable[tuple[int, Row, Time, int]]):
         super().__init__(scope)
+        # bulk-stage by time: per-row insert() was a measurable share of the
+        # static-ingest epoch at 1M rows
+        now = _monotonic()
+        by_time: dict[Time, list[Delta]] = defaultdict(list)
         for key, row, time, diff in rows:
-            self.insert(key, row, time, diff)
+            by_time[time].append((key, row, diff))
+        for time, deltas in by_time.items():
+            self._staged[time].extend(deltas)
+            self._staged_wallclock.setdefault(time, now)
         self.finished = True
 
 
@@ -340,6 +371,7 @@ class ExprNode(Node):
 
     def step(self, time):
         deltas = self.take_pending()
+        clean_in = isinstance(deltas, CleanDeltas)
         out = None
         if self.vec_select is not None and len(deltas) >= _vec_threshold():
             out = self._try_columnar(deltas)
@@ -367,7 +399,8 @@ class ExprNode(Node):
                         )
                     )
                 out.append((key, new_row, diff))
-        out = consolidate(out)
+        # a 1:1 map preserves keys and diffs, hence cleanliness
+        out = CleanDeltas(out) if clean_in else consolidate(out)
         if self.keep_state:
             self._update_state(out)
         self.send(out, time)
@@ -383,14 +416,15 @@ class FilterNode(Node):
         self.pred = pred
 
     def step(self, time):
-        out = []
-        for key, row, diff in self.take_pending():
+        deltas = self.take_pending()
+        out = CleanDeltas() if isinstance(deltas, CleanDeltas) else []
+        for key, row, diff in deltas:
             res = self.pred(key, row)
             if isinstance(res, Error):
                 self.scope.report_row_error(self, key, "filter predicate returned Error")
                 continue
             if res:
-                out.append((key, row, diff))
+                out.append((key, row, diff))  # subset of clean stays clean
         if self.keep_state:
             self._update_state(out)
         self.send(out, time)
@@ -406,11 +440,17 @@ class FlattenNode(Node):
         self.fn = fn
 
     def step(self, time):
+        deltas = self.take_pending()
         out = []
-        for key, row, diff in self.take_pending():
+        for key, row, diff in deltas:
             for new_key, new_row in self.fn(key, row):
                 out.append((new_key, new_row, diff))
-        out = consolidate(out)
+        if isinstance(deltas, CleanDeltas):
+            # key-fresh flatten: new keys are hash(origin key, position),
+            # distinct when the origin keys are distinct
+            out = CleanDeltas(out)
+        else:
+            out = consolidate(out)
         if self.keep_state:
             self._update_state(out)
         self.send(out, time)

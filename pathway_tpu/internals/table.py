@@ -855,6 +855,8 @@ class Table(Joinable):
                                 continue
                             if res:
                                 out.append((key, row[:n_cols], diff))
+                    if isinstance(deltas, df.CleanDeltas):
+                        out = df.CleanDeltas(out)  # key-subset of clean
                     if self_inner.keep_state:
                         self_inner._update_state(out)
                     self_inner.send(out, time)

@@ -1,0 +1,73 @@
+"""CleanDeltas fast-path invariants (engine/dataflow.py).
+
+The marker lets consolidate() skip its O(n) scan; these tests pin the
+invariant that no false tag can form — in particular the send() downgrade
+when a second chunk lands on a port already holding a clean chunk.
+"""
+
+from __future__ import annotations
+
+import pathway_tpu as pw
+from pathway_tpu.engine.dataflow import CleanDeltas, Node, Scope, consolidate
+from tests.utils import T, assert_stream_consistent, rows
+
+
+def test_consolidate_tags_clean_and_is_identity_on_tagged():
+    deltas = [(1, ("a",), 1), (2, ("b",), 1)]
+    out = consolidate(deltas)
+    assert isinstance(out, CleanDeltas)
+    assert consolidate(out) is out  # identity on tagged input
+
+
+def test_consolidate_does_not_tag_dirty():
+    dirty = [(1, ("a",), 1), (1, ("a",), -1)]
+    out = consolidate(dirty)
+    assert not isinstance(out, CleanDeltas)
+    assert out == []
+
+
+def test_send_downgrades_marker_on_second_chunk():
+    scope = Scope()
+    src = Node(scope, [])
+    dst = Node(scope, [src])
+    # first chunk: clean marker preserved on the pending port
+    src.send(CleanDeltas([(1, ("a",), 1)]), 0)
+    assert isinstance(dst.pending[0], CleanDeltas)
+    # second chunk with a COLLIDING key: the port must downgrade to a plain
+    # list so consolidate re-scans (a kept tag would skip cancellation)
+    src.send(CleanDeltas([(1, ("a",), -1)]), 0)
+    merged = dst.pending[0]
+    assert not isinstance(merged, CleanDeltas)
+    assert consolidate(merged) == []
+
+
+def test_send_downgrade_also_from_plain_then_clean():
+    scope = Scope()
+    src = Node(scope, [])
+    dst = Node(scope, [src])
+    src.send([(1, ("a",), 1)], 0)
+    src.send(CleanDeltas([(2, ("b",), 1)]), 0)
+    assert not isinstance(dst.pending[0], CleanDeltas)
+    assert len(dst.pending[0]) == 2
+
+
+def test_flatten_chain_results_match_row_semantics():
+    """select -> flatten -> filter -> groupby over a retraction stream gives
+    identical results whether or not the clean fast path engages."""
+    md = """
+    phrase | _time | _diff
+    a_b    | 2     | 1
+    b_c    | 2     | 1
+    a_b    | 4     | -1
+    """
+
+    def pipeline():
+        t = T(md)
+        words = t.select(w=pw.this.phrase.str.split("_")).flatten(pw.this.w)
+        return words.groupby(pw.this.w).reduce(
+            w=pw.this.w, n=pw.reducers.count()
+        )
+
+    res = pipeline()
+    assert_stream_consistent(res)
+    assert rows(pipeline()) == [("b", 1), ("c", 1)]
