@@ -435,9 +435,20 @@ class FlattenNode(Node):
 
     name = "flatten"
 
-    def __init__(self, scope, inp: Node, fn: Callable[[int, Row], Iterable[tuple[int, Row]]]):
+    def __init__(
+        self,
+        scope,
+        inp: Node,
+        fn: Callable[[int, Row], Iterable[tuple[int, Row]]],
+        *,
+        key_fresh: bool = False,
+    ):
         super().__init__(scope, [inp])
         self.fn = fn
+        # set by callers whose fn derives pairwise-distinct new keys from
+        # the origin key (e.g. hash(origin, position)); only then can clean
+        # input imply clean output
+        self.key_fresh = key_fresh
 
     def step(self, time):
         deltas = self.take_pending()
@@ -445,9 +456,7 @@ class FlattenNode(Node):
         for key, row, diff in deltas:
             for new_key, new_row in self.fn(key, row):
                 out.append((new_key, new_row, diff))
-        if isinstance(deltas, CleanDeltas):
-            # key-fresh flatten: new keys are hash(origin key, position),
-            # distinct when the origin keys are distinct
+        if self.key_fresh and isinstance(deltas, CleanDeltas):
             out = CleanDeltas(out)
         else:
             out = consolidate(out)

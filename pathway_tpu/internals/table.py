@@ -924,7 +924,8 @@ class Table(Joinable):
                         new_row = new_row + (Pointer(key),)
                     yield (new_key, new_row)
 
-            return df.FlattenNode(lowerer.scope, base, fn)
+            # new keys are hash(origin key, position): pairwise distinct
+            return df.FlattenNode(lowerer.scope, base, fn, key_fresh=True)
 
         cols = dict(self._schema.__columns__)
         inner_t = cols[col].dtype.strip_optional()

@@ -41,6 +41,7 @@ from pathway_tpu.parallel.train import (
     init_train_state,
 )
 from pathway_tpu.parallel.index import ShardedDeviceIndex, sharded_topk
+from pathway_tpu.parallel.ring_attention import ring_encoder_attention
 
 __all__ = [
     "make_mesh",
@@ -56,4 +57,5 @@ __all__ = [
     "make_contrastive_train_step",
     "ShardedDeviceIndex",
     "sharded_topk",
+    "ring_encoder_attention",
 ]
