@@ -183,11 +183,17 @@ class MemoryBackend(BlobBackend):
             self.store.pop(key, None)
 
 
-class S3Backend(BlobBackend):
-    """Object-storage persistence (backends/s3.rs analog) over the signed
-    REST client in ``io/_s3http.py`` — works against AWS S3 and any
-    S3-compatible endpoint (MinIO).  S3 PUTs are atomic per object (readers
-    see the whole object or none), so ``put_atomic`` is plain ``put``."""
+class _PrefixedObjectStore(BlobBackend):
+    """Shared behavior for object-storage backends (S3, Azure, ...): prefix
+    handling, 404 → None/no-op on get/delete, and the rule that a transient
+    5xx/403 must NOT read as "no snapshot" (that would silently restart the
+    pipeline from scratch).  Object PUTs are atomic per object on these
+    stores, so ``put_atomic`` is plain ``put``.
+
+    Subclasses set ``_error_cls`` and implement ``_put/_get/_list/_delete``.
+    """
+
+    _error_cls: type[Exception] = Exception
 
     def __init__(self, client: Any, prefix: str = ""):
         self.client = client
@@ -197,35 +203,87 @@ class S3Backend(BlobBackend):
         return f"{self.prefix}/{key}" if self.prefix else key
 
     def put(self, key: str, data: bytes) -> None:
-        self.client.put_object(self._key(key), data)
+        self._put(self._key(key), data)
 
     def get(self, key: str) -> bytes | None:
-        from pathway_tpu.io._s3http import S3Error
-
         try:
-            return self.client.get_object(self._key(key))
-        except S3Error as exc:
-            if exc.status == 404:
+            return self._get(self._key(key))
+        except Exception as exc:
+            if isinstance(exc, self._error_cls) and getattr(exc, "status", 0) == 404:
                 return None
-            # a transient 5xx/403 must NOT read as "no snapshot" — that
-            # would silently restart the pipeline from scratch
             raise
 
     def list_keys(self, prefix: str) -> list[str]:
         full = self._key(prefix)
         strip = len(self.prefix) + 1 if self.prefix else 0
-        return sorted(
-            o["key"][strip:] for o in self.client.list_objects(full)
-        )
+        return sorted(k[strip:] for k in self._list(full))
 
     def delete(self, key: str) -> None:
+        try:
+            self._delete(self._key(key))
+        except Exception as exc:
+            if isinstance(exc, self._error_cls) and getattr(exc, "status", 0) == 404:
+                return
+            raise
+
+    def _put(self, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def _get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def _list(self, prefix: str) -> list[str]:
+        raise NotImplementedError
+
+    def _delete(self, key: str) -> None:
+        raise NotImplementedError
+
+
+class S3Backend(_PrefixedObjectStore):
+    """Object-storage persistence (backends/s3.rs analog) over the signed
+    REST client in ``io/_s3http.py`` — works against AWS S3 and any
+    S3-compatible endpoint (MinIO)."""
+
+    @property
+    def _error_cls(self):
         from pathway_tpu.io._s3http import S3Error
 
-        try:
-            self.client.delete_object(self._key(key))
-        except S3Error as exc:
-            if exc.status != 404:
-                raise
+        return S3Error
+
+    def _put(self, key: str, data: bytes) -> None:
+        self.client.put_object(key, data)
+
+    def _get(self, key: str) -> bytes:
+        return self.client.get_object(key)
+
+    def _list(self, prefix: str) -> list[str]:
+        return [o["key"] for o in self.client.list_objects(prefix)]
+
+    def _delete(self, key: str) -> None:
+        self.client.delete_object(key)
+
+
+class AzureBackend(_PrefixedObjectStore):
+    """Azure Blob persistence over the SharedKey REST client in
+    ``io/_azureblob.py``."""
+
+    @property
+    def _error_cls(self):
+        from pathway_tpu.io._azureblob import AzureBlobError
+
+        return AzureBlobError
+
+    def _put(self, key: str, data: bytes) -> None:
+        self.client.put_blob(key, data)
+
+    def _get(self, key: str) -> bytes:
+        return self.client.get_blob(key)
+
+    def _list(self, prefix: str) -> list[str]:
+        return list(self.client.list_blobs(prefix))
+
+    def _delete(self, key: str) -> None:
+        self.client.delete_blob(key)
 
 
 def backend_from_config(backend_cfg: Any) -> BlobBackend:
@@ -248,7 +306,25 @@ def backend_from_config(backend_cfg: Any) -> BlobBackend:
             bucket, prefix = settings.bucket_name, path
         return S3Backend(settings.client(bucket), prefix)
     if kind == "azure":
-        raise NotImplementedError("azure persistence backend is not available")
+        from pathway_tpu.io._azureblob import AzureBlobClient
+
+        # az://container/prefix — the prefix applies in BOTH construction
+        # modes; a pre-built client with a diverging root_path prefix would
+        # silently look in a different blob location on resume
+        path = getattr(backend_cfg, "path", "") or ""
+        rest = path.split("://", 1)[-1]
+        container, _, prefix = rest.partition("/")
+        prefix = getattr(backend_cfg, "prefix", "") or prefix
+        client = getattr(backend_cfg, "client", None)
+        if client is None:
+            acct = getattr(backend_cfg, "account", None) or {}
+            client = AzureBlobClient(
+                acct.get("account_name", ""),
+                container,
+                account_key=acct.get("account_key", ""),
+                endpoint=acct.get("endpoint"),
+            )
+        return AzureBackend(client, prefix)
     raise ValueError(f"unknown persistence backend {backend_cfg!r}")
 
 

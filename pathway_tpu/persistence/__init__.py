@@ -34,9 +34,16 @@ class Backend:
 
     @classmethod
     def azure(cls, root_path: str, account: Any = None, **kw) -> "Backend":
+        """Azure Blob persistence.  ``root_path`` is ``az://container/prefix``;
+        ``account`` is ``{"account_name", "account_key", "endpoint"?}`` (the
+        endpoint override targets emulators), or pass ``client=`` in ``kw``
+        with a pre-built ``AzureBlobClient`` plus optional ``prefix=``."""
         b = cls()
         b.kind = "azure"
         b.path = root_path
+        b.account = account
+        b.client = kw.get("client")
+        b.prefix = kw.get("prefix", "")
         return b
 
     @classmethod
