@@ -205,11 +205,18 @@ class _PrefixedObjectStore(BlobBackend):
     def put(self, key: str, data: bytes) -> None:
         self._put(self._key(key), data)
 
+    @staticmethod
+    def _is_not_found(exc: Exception) -> bool:
+        # clients that distinguish auth-404s set is_not_found themselves
+        return bool(
+            getattr(exc, "is_not_found", getattr(exc, "status", 0) == 404)
+        )
+
     def get(self, key: str) -> bytes | None:
         try:
             return self._get(self._key(key))
         except Exception as exc:
-            if isinstance(exc, self._error_cls) and getattr(exc, "status", 0) == 404:
+            if isinstance(exc, self._error_cls) and self._is_not_found(exc):
                 return None
             raise
 
@@ -222,7 +229,7 @@ class _PrefixedObjectStore(BlobBackend):
         try:
             self._delete(self._key(key))
         except Exception as exc:
-            if isinstance(exc, self._error_cls) and getattr(exc, "status", 0) == 404:
+            if isinstance(exc, self._error_cls) and self._is_not_found(exc):
                 return
             raise
 
@@ -263,6 +270,30 @@ class S3Backend(_PrefixedObjectStore):
         self.client.delete_object(key)
 
 
+class GcsBackend(_PrefixedObjectStore):
+    """Google Cloud Storage persistence over the JSON-API client in
+    ``io/_gcshttp.py`` — the natural store for TPU-pod deployments (ambient
+    metadata-server identity, no key distribution)."""
+
+    @property
+    def _error_cls(self):
+        from pathway_tpu.io._gcshttp import GcsError
+
+        return GcsError
+
+    def _put(self, key: str, data: bytes) -> None:
+        self.client.put_object(key, data)
+
+    def _get(self, key: str) -> bytes:
+        return self.client.get_object(key)
+
+    def _list(self, prefix: str) -> list[str]:
+        return self.client.list_objects(prefix)
+
+    def _delete(self, key: str) -> None:
+        self.client.delete_object(key)
+
+
 class AzureBackend(_PrefixedObjectStore):
     """Azure Blob persistence over the SharedKey REST client in
     ``io/_azureblob.py``."""
@@ -286,6 +317,20 @@ class AzureBackend(_PrefixedObjectStore):
         self.client.delete_blob(key)
 
 
+def _object_store_cfg(backend_cfg: Any) -> tuple[str, str, Any]:
+    """``(bucket_or_container, prefix, client_or_None)`` from a Backend cfg.
+
+    The root_path's ``scheme://bucket/prefix`` applies in BOTH construction
+    modes — a pre-built client with a diverging root_path prefix would
+    silently resume from a different object location.
+    """
+    path = getattr(backend_cfg, "path", "") or ""
+    rest = path.split("://", 1)[-1]
+    bucket, _, prefix = rest.partition("/")
+    prefix = getattr(backend_cfg, "prefix", "") or prefix
+    return bucket, prefix, getattr(backend_cfg, "client", None)
+
+
 def backend_from_config(backend_cfg: Any) -> BlobBackend:
     """Build an engine backend from the user-facing ``pw.persistence.Backend``."""
     kind = getattr(backend_cfg, "kind", None)
@@ -305,17 +350,21 @@ def backend_from_config(backend_cfg: Any) -> BlobBackend:
         else:
             bucket, prefix = settings.bucket_name, path
         return S3Backend(settings.client(bucket), prefix)
+    if kind == "gcs":
+        from pathway_tpu.io._gcshttp import GcsClient
+
+        bucket, prefix, client = _object_store_cfg(backend_cfg)
+        if client is None:
+            client = GcsClient(
+                bucket,
+                token_provider=getattr(backend_cfg, "token_provider", None),
+                endpoint=getattr(backend_cfg, "endpoint", None),
+            )
+        return GcsBackend(client, prefix)
     if kind == "azure":
         from pathway_tpu.io._azureblob import AzureBlobClient
 
-        # az://container/prefix — the prefix applies in BOTH construction
-        # modes; a pre-built client with a diverging root_path prefix would
-        # silently look in a different blob location on resume
-        path = getattr(backend_cfg, "path", "") or ""
-        rest = path.split("://", 1)[-1]
-        container, _, prefix = rest.partition("/")
-        prefix = getattr(backend_cfg, "prefix", "") or prefix
-        client = getattr(backend_cfg, "client", None)
+        container, prefix, client = _object_store_cfg(backend_cfg)
         if client is None:
             acct = getattr(backend_cfg, "account", None) or {}
             client = AzureBlobClient(

@@ -33,6 +33,21 @@ class Backend:
         return b
 
     @classmethod
+    def gcs(cls, root_path: str, **kw) -> "Backend":
+        """Google Cloud Storage persistence.  ``root_path`` is
+        ``gs://bucket/prefix``; ambient GCE/TPU-VM metadata identity by
+        default, or pass ``token_provider=`` / ``endpoint=`` (emulator) /
+        a pre-built ``client=``."""
+        b = cls()
+        b.kind = "gcs"
+        b.path = root_path
+        b.token_provider = kw.get("token_provider")
+        b.endpoint = kw.get("endpoint")
+        b.client = kw.get("client")
+        b.prefix = kw.get("prefix", "")
+        return b
+
+    @classmethod
     def azure(cls, root_path: str, account: Any = None, **kw) -> "Backend":
         """Azure Blob persistence.  ``root_path`` is ``az://container/prefix``;
         ``account`` is ``{"account_name", "account_key", "endpoint"?}`` (the
