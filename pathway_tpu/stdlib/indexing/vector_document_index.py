@@ -57,6 +57,29 @@ def default_brute_force_knn_document_index(
     dimensions: int | None = None,
     metadata_column: ColumnReference | None = None,
 ) -> DataIndex:
+    r"""Dense KNN document index over the device top-k path.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> from pathway_tpu.stdlib.indexing import default_brute_force_knn_document_index
+    >>> from pathway_tpu.xpacks.llm.mocks import FakeEmbeddings
+    >>> docs = pw.debug.table_from_markdown('''
+    ... text
+    ... apples_and_pears
+    ... tpu_systolic_arrays
+    ... ''')
+    >>> index = default_brute_force_knn_document_index(
+    ...     docs.text, docs, embedder=FakeEmbeddings(), dimensions=16
+    ... )
+    >>> queries = pw.debug.table_from_markdown('q\ntpu_systolic_arrays')
+    >>> res = index.query_as_of_now(queries.q, number_of_matches=1).select(
+    ...     match=pw.this.text
+    ... )
+    >>> pw.debug.compute_and_print(res, include_id=False)
+    match
+    ('tpu_systolic_arrays',)
+    """
     inner = BruteForceKnn(
         data_column,
         metadata_column,

@@ -24,6 +24,18 @@ def _docs_to_context(docs: Any) -> str:
 
 
 def prompt_short_qa(docs, query, additional_rules: str = "") -> ColumnExpression:
+    r"""Build the short-answer QA prompt as a column expression.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> from pathway_tpu.xpacks.llm import prompts
+    >>> t = pw.debug.table_from_markdown('q\nwhat_is_a_tpu')
+    >>> r = t.select(p=prompts.prompt_short_qa(pw.make_tuple('doc one'), pw.this.q))
+    >>> out = pw.debug.table_to_pandas(r, include_id=False)
+    >>> print('Answer the question' in out['p'][0], 'doc one' in out['p'][0])
+    False True
+    """
     def build(docs_v, query_v) -> str:
         return (
             "Please provide an answer based solely on the provided sources. "

@@ -52,7 +52,17 @@ class TokenCountSplitter(BaseSplitter):
     """Split into chunks of [min_tokens, max_tokens] tokens, preferring to
     break at sentence/punctuation boundaries (parity: splitters.py
     TokenCountSplitter, tiktoken-based in the reference; token = whitespace
-    word here unless a local HF tokenizer is available)."""
+    word here unless a local HF tokenizer is available).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> from pathway_tpu.xpacks.llm.splitters import TokenCountSplitter
+    >>> split = TokenCountSplitter(min_tokens=2, max_tokens=3)
+    >>> chunks = split.__wrapped__('one two three four five')
+    >>> print([c[0] for c in chunks])
+    ['one two three', 'four five']
+    """
 
     def __init__(
         self,

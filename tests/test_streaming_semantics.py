@@ -313,3 +313,51 @@ def test_update_stream_times_are_monotone_and_even():
     times = [t_ for (_k, _r, t_, _d) in deltas]
     assert times == sorted(times)
     assert all(t_ % 2 == 0 for t_ in times), "original rows carry even times"
+
+
+# ---------------------------------------------------------------------------
+# out-of-order multi-input times: the runner's frontier is the min over all
+# staged input times (the total-order collapse of the reference's antichain)
+# ---------------------------------------------------------------------------
+
+
+def test_out_of_order_rows_fold_into_next_epoch():
+    """Rows staged with an earlier time than an already-committed epoch are
+    folded into the next epoch rather than dropped or reordered backwards."""
+    left = T(
+        """
+        k | v | _time
+        a | 1 | 10
+        b | 2 | 2
+        """
+    )
+    res = left.select(pw.this.k, pw.this.v)
+    deltas = assert_stream_consistent(res)
+    times = {r[0]: t for (_k, r, t, _d) in deltas}
+    # b (t=2) commits before a (t=10); both rows survive with monotone times
+    assert times["b"] < times["a"]
+    assert sorted(r for (_k, r, _t, _d) in deltas) == [("a", 1), ("b", 2)]
+
+
+def test_two_sources_different_rates_share_min_frontier():
+    """A join's epoch frontier advances at the min of its two inputs."""
+    fast = T(
+        """
+        k | v | _time
+        x | 1 | 2
+        x | 2 | 4
+        x | 3 | 6
+        """
+    )
+    slow = T(
+        """
+        k | w | _time
+        x | 9 | 6
+        """
+    )
+    res = fast.join(slow, fast.k == slow.k).select(pw.this.v, pw.this.w)
+    deltas = assert_stream_consistent(res)
+    # no join output can appear before the slow side's first epoch
+    assert min(t for (_k, _r, t, _d) in deltas) >= 6
+    live = [r for (_k, r, _t, d) in deltas if d == 1]
+    assert sorted(live) == [(1, 9), (2, 9), (3, 9)]
