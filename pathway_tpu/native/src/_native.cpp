@@ -25,6 +25,7 @@
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 // ---------------------------------------------------------------------------
@@ -580,6 +581,94 @@ static PyObject *py_decode_row(PyObject *, PyObject *args) {
 }
 
 // ---------------------------------------------------------------------------
+// consolidate_dirty: the accumulation half of the engine's per-node delta
+// normalization (dataflow.py consolidate).  PRECONDITION: the caller has
+// already proven the batch dirty with its (faster, CPython-set) clean scan —
+// clean batches must never reach here.  Returns a NEW list of
+// (key, row, summed_diff != 0), retractions before insertions in stable
+// first-seen order — exactly the Python Counter path's semantics.
+// ---------------------------------------------------------------------------
+
+static PyObject *py_consolidate_dirty(PyObject *, PyObject *arg) {
+  if (!PyList_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "consolidate expects a list");
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(arg);
+
+  // full accumulation pass over (key, row) pairs
+  struct Entry {
+    PyObject *key;
+    PyObject *row;
+    long long acc;
+  };
+  std::vector<Entry> entries;
+  entries.reserve(static_cast<size_t>(n));
+  std::unordered_map<Py_hash_t, std::vector<size_t>> index;
+  index.reserve(static_cast<size_t>(n) * 2 + 8);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *d = PyList_GET_ITEM(arg, i);
+    if (!PyTuple_Check(d) || PyTuple_GET_SIZE(d) < 3) {
+      PyErr_SetString(PyExc_TypeError, "delta must be (key, row, diff)");
+      return nullptr;
+    }
+    PyObject *key = PyTuple_GET_ITEM(d, 0);
+    PyObject *row = PyTuple_GET_ITEM(d, 1);
+    long long dv = PyLong_AsLongLong(PyTuple_GET_ITEM(d, 2));
+    if (dv == -1 && PyErr_Occurred()) return nullptr;
+    Py_hash_t hk = PyObject_Hash(key);
+    if (hk == -1) return nullptr;
+    Py_hash_t hr = PyObject_Hash(row);
+    if (hr == -1) return nullptr;
+    Py_hash_t combined =
+        static_cast<Py_hash_t>(static_cast<uint64_t>(hk) * 1000003ull ^
+                               static_cast<uint64_t>(hr));
+    auto &bucket = index[combined];
+    bool merged = false;
+    for (size_t idx : bucket) {
+      Entry &e = entries[idx];
+      int eqk = PyObject_RichCompareBool(e.key, key, Py_EQ);
+      if (eqk < 0) return nullptr;
+      if (!eqk) continue;
+      int eqr = PyObject_RichCompareBool(e.row, row, Py_EQ);
+      if (eqr < 0) return nullptr;
+      if (eqr) {
+        e.acc += dv;
+        merged = true;
+        break;
+      }
+    }
+    if (!merged) {
+      bucket.push_back(entries.size());
+      entries.push_back(Entry{key, row, dv});
+    }
+  }
+  PyObject *out = PyList_New(0);
+  if (!out) return nullptr;
+  for (int pass = 0; pass < 2; pass++) {
+    for (const Entry &e : entries) {
+      if (e.acc == 0) continue;
+      bool positive = e.acc > 0;
+      if ((pass == 0) != !positive) continue;  // retractions first
+      PyObject *diff = PyLong_FromLongLong(e.acc);
+      if (!diff) {
+        Py_DECREF(out);
+        return nullptr;
+      }
+      PyObject *t = PyTuple_Pack(3, e.key, e.row, diff);
+      Py_DECREF(diff);
+      if (!t || PyList_Append(out, t) < 0) {
+        Py_XDECREF(t);
+        Py_DECREF(out);
+        return nullptr;
+      }
+      Py_DECREF(t);
+    }
+  }
+  return out;
+}
+
+// ---------------------------------------------------------------------------
 // setup & module def
 // ---------------------------------------------------------------------------
 
@@ -612,6 +701,8 @@ static PyMethodDef methods[] = {
     {"blake2b_128", py_blake2b_128, METH_O, "blake2b-128 digest"},
     {"encode_row", py_encode_row, METH_O, "PWT1-encode a row"},
     {"decode_row", py_decode_row, METH_VARARGS, "PWT1-decode a row"},
+    {"consolidate_dirty", py_consolidate_dirty, METH_O,
+     "accumulate a known-dirty delta list (retractions first)"},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_native",

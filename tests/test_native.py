@@ -153,3 +153,44 @@ class TestCodecParity:
             nat.hash_values((2**200,))
         with pytest.raises(OverflowError):
             tz.hash_values_py([2**200])
+
+
+def test_native_consolidate_equivalence():
+    """Native accumulation must match the Python reference exactly,
+    including merge/cancel behavior and retractions-first stable order."""
+    import random
+    from collections import Counter
+
+    from pathway_tpu import native
+    from pathway_tpu.engine.dataflow import CleanDeltas, consolidate
+
+    mod = native.get()
+    if mod is None or not hasattr(mod, "consolidate_dirty"):
+        import pytest
+
+        pytest.skip("native core unavailable")
+
+    def py_reference(deltas):
+        acc = Counter()
+        for key, row, diff in deltas:
+            acc[(key, row)] += diff
+        out = [(k, r, d) for (k, r), d in acc.items() if d != 0]
+        out.sort(key=lambda d: d[2] > 0)
+        return out
+
+    rng = random.Random(7)
+    deltas = [
+        (
+            rng.getrandbits(127) if rng.random() < 0.5 else rng.randrange(50),
+            ("w%d" % rng.randrange(30), rng.randrange(5)),
+            rng.choice([1, 1, 1, -1, 2]),
+        )
+        for _ in range(5000)
+    ]
+    assert consolidate(list(deltas)) == py_reference(deltas)
+
+    # clean input comes back tagged and unchanged
+    clean = [(i, ("r", i), 1) for i in range(100)]
+    out = consolidate(list(clean))
+    assert isinstance(out, CleanDeltas)
+    assert list(out) == clean
