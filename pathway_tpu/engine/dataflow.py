@@ -94,7 +94,9 @@ def consolidate(deltas: Iterable[Delta]) -> list[Delta]:
         return CleanDeltas(deltas)
     nc = _get_native_consolidate()
     if nc is not None:
-        return nc(deltas)  # precondition: batch proven dirty above
+        out = nc(deltas)  # precondition: batch proven dirty above
+        if out is not None:  # None = diffs beyond int64, use Python path
+            return out
     acc: Counter = Counter()
     for key, row, diff in deltas:
         acc[(key, row)] += diff

@@ -586,17 +586,19 @@ static PyObject *py_decode_row(PyObject *, PyObject *args) {
 // already proven the batch dirty with its (faster, CPython-set) clean scan —
 // clean batches must never reach here.  Returns a NEW list of
 // (key, row, summed_diff != 0), retractions before insertions in stable
-// first-seen order — exactly the Python Counter path's semantics.
+// first-seen order — exactly the Python Counter path's semantics — or
+// Py_None when a diff exceeds int64 range (the caller falls back to the
+// arbitrary-precision Python path).
 // ---------------------------------------------------------------------------
 
 static PyObject *py_consolidate_dirty(PyObject *, PyObject *arg) {
-  if (!PyList_Check(arg)) {
-    PyErr_SetString(PyExc_TypeError, "consolidate expects a list");
-    return nullptr;
-  }
-  Py_ssize_t n = PyList_GET_SIZE(arg);
+  // private copy: __hash__/__eq__ of engine values run arbitrary Python
+  // code that could otherwise mutate the caller's list under our borrowed
+  // pointers (the copy holds its own refs to every delta tuple)
+  PyObject *seq = PySequence_List(arg);
+  if (!seq) return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(seq);
 
-  // full accumulation pass over (key, row) pairs
   struct Entry {
     PyObject *key;
     PyObject *row;
@@ -606,20 +608,53 @@ static PyObject *py_consolidate_dirty(PyObject *, PyObject *arg) {
   entries.reserve(static_cast<size_t>(n));
   std::unordered_map<Py_hash_t, std::vector<size_t>> index;
   index.reserve(static_cast<size_t>(n) * 2 + 8);
+  // keeps every PySequence_Fast result alive until the end: for non-tuple
+  // deltas the fast object OWNS the key/row items the entries point at
+  std::vector<PyObject *> fast_holds;
+  fast_holds.reserve(static_cast<size_t>(n));
+  auto cleanup = [&]() {
+    for (PyObject *f : fast_holds) Py_DECREF(f);
+    Py_DECREF(seq);
+  };
   for (Py_ssize_t i = 0; i < n; i++) {
-    PyObject *d = PyList_GET_ITEM(arg, i);
-    if (!PyTuple_Check(d) || PyTuple_GET_SIZE(d) < 3) {
-      PyErr_SetString(PyExc_TypeError, "delta must be (key, row, diff)");
+    PyObject *d = PyList_GET_ITEM(seq, i);
+    // same contract as the Python `key, row, diff = d` unpack: any
+    // 3-element sequence; wrong length -> ValueError
+    PyObject *fast = PySequence_Fast(d, "delta must be (key, row, diff)");
+    if (!fast) {
+      cleanup();
       return nullptr;
     }
-    PyObject *key = PyTuple_GET_ITEM(d, 0);
-    PyObject *row = PyTuple_GET_ITEM(d, 1);
-    long long dv = PyLong_AsLongLong(PyTuple_GET_ITEM(d, 2));
-    if (dv == -1 && PyErr_Occurred()) return nullptr;
+    fast_holds.push_back(fast);
+    if (PySequence_Fast_GET_SIZE(fast) != 3) {
+      cleanup();
+      PyErr_SetString(PyExc_ValueError,
+                      "delta must have exactly 3 fields (key, row, diff)");
+      return nullptr;
+    }
+    PyObject *key = PySequence_Fast_GET_ITEM(fast, 0);
+    PyObject *row = PySequence_Fast_GET_ITEM(fast, 1);
+    long long dv = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(fast, 2));
+    if (dv == -1 && PyErr_Occurred()) {
+      if (PyErr_ExceptionMatches(PyExc_OverflowError)) {
+        // beyond int64: let the arbitrary-precision Python path handle it
+        PyErr_Clear();
+        cleanup();
+        Py_RETURN_NONE;
+      }
+      cleanup();
+      return nullptr;
+    }
     Py_hash_t hk = PyObject_Hash(key);
-    if (hk == -1) return nullptr;
+    if (hk == -1) {
+      cleanup();
+      return nullptr;
+    }
     Py_hash_t hr = PyObject_Hash(row);
-    if (hr == -1) return nullptr;
+    if (hr == -1) {
+      cleanup();
+      return nullptr;
+    }
     Py_hash_t combined =
         static_cast<Py_hash_t>(static_cast<uint64_t>(hk) * 1000003ull ^
                                static_cast<uint64_t>(hr));
@@ -628,12 +663,23 @@ static PyObject *py_consolidate_dirty(PyObject *, PyObject *arg) {
     for (size_t idx : bucket) {
       Entry &e = entries[idx];
       int eqk = PyObject_RichCompareBool(e.key, key, Py_EQ);
-      if (eqk < 0) return nullptr;
+      if (eqk < 0) {
+        cleanup();
+        return nullptr;
+      }
       if (!eqk) continue;
       int eqr = PyObject_RichCompareBool(e.row, row, Py_EQ);
-      if (eqr < 0) return nullptr;
+      if (eqr < 0) {
+        cleanup();
+        return nullptr;
+      }
       if (eqr) {
-        e.acc += dv;
+        long long sum;
+        if (__builtin_add_overflow(e.acc, dv, &sum)) {
+          cleanup();
+          Py_RETURN_NONE;  // int64 overflow: Python fallback
+        }
+        e.acc = sum;
         merged = true;
         break;
       }
@@ -644,15 +690,19 @@ static PyObject *py_consolidate_dirty(PyObject *, PyObject *arg) {
     }
   }
   PyObject *out = PyList_New(0);
-  if (!out) return nullptr;
+  if (!out) {
+    cleanup();
+    return nullptr;
+  }
   for (int pass = 0; pass < 2; pass++) {
     for (const Entry &e : entries) {
       if (e.acc == 0) continue;
-      bool positive = e.acc > 0;
-      if ((pass == 0) != !positive) continue;  // retractions first
+      // pass 0 emits retractions (acc < 0), pass 1 the insertions
+      if ((e.acc > 0) == (pass == 0)) continue;
       PyObject *diff = PyLong_FromLongLong(e.acc);
       if (!diff) {
         Py_DECREF(out);
+        cleanup();
         return nullptr;
       }
       PyObject *t = PyTuple_Pack(3, e.key, e.row, diff);
@@ -660,11 +710,13 @@ static PyObject *py_consolidate_dirty(PyObject *, PyObject *arg) {
       if (!t || PyList_Append(out, t) < 0) {
         Py_XDECREF(t);
         Py_DECREF(out);
+        cleanup();
         return nullptr;
       }
       Py_DECREF(t);
     }
   }
+  cleanup();
   return out;
 }
 

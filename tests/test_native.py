@@ -194,3 +194,16 @@ def test_native_consolidate_equivalence():
     out = consolidate(list(clean))
     assert isinstance(out, CleanDeltas)
     assert list(out) == clean
+
+    # diffs beyond int64 fall back to the arbitrary-precision Python path
+    big = [(1, ("r",), 2**70), (1, ("r",), 2**70), (2, ("q",), -1)]
+    assert consolidate(list(big)) == py_reference(big)
+    ovf = [(1, ("r",), 2**62), (1, ("r",), 2**62), (2, ("q",), -1)]
+    assert consolidate(list(ovf)) == py_reference(ovf)
+
+    # unpack-contract parity: list-shaped deltas work, 4-tuples raise
+    assert consolidate([[1, ("a",), 1], [1, ("a",), -1]]) == []
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        consolidate([(1, ("a",), -1), (2, ("b",), 1, "extra")])

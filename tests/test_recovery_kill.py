@@ -1,0 +1,119 @@
+"""Hard-crash recovery: SIGKILL a worker mid-stream, resume, exactly-once.
+
+Model: the reference's wordcount recovery harness kills pipeline processes
+mid-run and asserts exactly-once-style combined results
+(`integration_tests/wordcount/test_recovery.py`).  Here a forked worker
+streams rows with per-row commits and frequent snapshots, the parent
+SIGKILLs it once output proves mid-stream progress, and a resumed run must
+produce the complete totals without double-counting the prefix covered by
+the crash-time snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import signal
+import time
+from pathlib import Path
+
+import pytest
+
+N_ROWS = 30
+ROW_DELAY_S = 0.05
+
+
+def _worker(pstore: str, out_path: str, n_rows: int, row_delay: float):
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+    import pathway_tpu as pw
+
+    pw.internals.parse_graph.G.clear()
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(n_rows):
+                self.next(k=i % 3, v=1)
+                self.commit()
+                if row_delay:
+                    time.sleep(row_delay)
+
+    t = pw.io.python.read(
+        Src(), schema=pw.schema_from_types(k=int, v=int), name="src"
+    )
+    counts = t.groupby(t.k).reduce(k=t.k, n=pw.reducers.count())
+    pw.io.jsonlines.write(counts, out_path)
+    pw.run(
+        persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem(pstore),
+            snapshot_interval_ms=50,
+        )
+    )
+
+
+def test_sigkill_mid_stream_then_resume_exactly_once(tmp_path):
+    pstore = str(tmp_path / "pstore")
+    out1 = str(tmp_path / "out1.jsonl")
+    out2 = str(tmp_path / "out2.jsonl")
+
+    ctx = multiprocessing.get_context("fork")
+    p = ctx.Process(
+        target=_worker, args=(pstore, out1, N_ROWS, ROW_DELAY_S), daemon=True
+    )
+    p.start()
+    # wait for proof of mid-stream progress, then kill without warning
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if os.path.exists(out1) and Path(out1).stat().st_size > 0:
+            break
+        time.sleep(0.02)
+    else:
+        p.terminate()
+        pytest.fail("worker produced no output within 30s")
+    time.sleep(3 * ROW_DELAY_S)  # let a snapshot cover a genuine prefix
+    os.kill(p.pid, signal.SIGKILL)
+    p.join(10)
+
+    # the kill must have hit a LIVE worker mid-stream — a 0 exit would mean
+    # the run finished first and the test proves nothing
+    assert p.exitcode == -signal.SIGKILL, p.exitcode
+    partial: dict = {}
+    for line in Path(out1).read_text().splitlines():
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn tail write from the kill
+        if obj.pop("diff") > 0:
+            partial[obj["k"]] = obj["n"]
+        elif partial.get(obj["k"]) == obj["n"]:
+            del partial[obj["k"]]
+    assert sum(partial.values()) < N_ROWS, partial  # genuinely mid-stream
+    # metadata must exist from the periodic snapshots
+    assert any(f.startswith("metadata") for f in os.listdir(pstore))
+
+    # resume: the source replays, the offset frontier skips the persisted
+    # prefix, and the run completes the remaining rows quickly
+    p2 = ctx.Process(
+        target=_worker, args=(pstore, out2, N_ROWS, 0.0), daemon=True
+    )
+    p2.start()
+    p2.join(60)
+    assert p2.exitcode == 0, p2.exitcode
+
+    # net state of the resumed run's sink = complete exactly-once totals
+    state: dict = {}
+    for line in Path(out2).read_text().splitlines():
+        obj = json.loads(line)
+        obj.pop("time")
+        diff = obj.pop("diff")
+        key = obj["k"]
+        if diff > 0:
+            state[key] = obj["n"]
+        elif state.get(key) == obj["n"]:
+            del state[key]
+    assert state == {0: 10, 1: 10, 2: 10}, state
