@@ -1,0 +1,95 @@
+"""Update-churn benchmark: the retraction-heavy half of the host engine.
+
+Wordcount measures the all-insert ingest path (clean consolidation
+fast-path); streaming products spend much of their life in the other
+regime — rows being *updated*, so every epoch carries retract+insert
+pairs through consolidation, stateful groupby, and the sinks.  This
+harness upserts over a bounded key space so a large share of deltas are
+retractions, which is the path the native C++ accumulator serves.
+
+Prints one JSON line per configuration:
+  {"metric": "host_churn_rows_per_sec", "value": N, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_KEYS = 5_000  # bounded key space -> constant churn after warm-up
+
+
+def build_pipeline(n_rows: int):
+    import pathway_tpu as pw
+    from pathway_tpu.engine import dataflow as df
+    from pathway_tpu.engine.types import sequential_key
+    from pathway_tpu.internals.table import Lowerer, Table, Universe
+
+    # upsert stream: row i replaces key i % N_KEYS — after the first
+    # N_KEYS rows every delta is a (retract old, insert new) pair
+    schema = pw.schema_from_types(k=int, v=int)
+
+    def build(lowerer: Lowerer) -> df.Node:
+        node = df.InputNode(lowerer.scope)
+        node.upsert = True
+        per_epoch = 50_000
+        t = 0
+        for start in range(0, n_rows, per_epoch):
+            t += 2
+            for i in range(start, min(start + per_epoch, n_rows)):
+                key = sequential_key(i % N_KEYS)
+                node.insert(key, (i % N_KEYS, i), t)
+        node.finished = True
+        return node
+
+    t = Table(schema, build, universe=Universe())
+    t = t.with_columns(bucket=pw.this.k % 97)
+    return t.groupby(pw.this.bucket).reduce(
+        bucket=pw.this.bucket,
+        n=pw.reducers.count(),
+        total=pw.reducers.sum(pw.this.v),
+    )
+
+
+def run_once(n_rows: int) -> float:
+    import pathway_tpu as pw  # noqa: F401
+    from pathway_tpu.engine import dataflow as df
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import run_pipeline_to_completion
+
+    G.clear()
+    result = build_pipeline(n_rows)
+
+    def attach(lowerer, node):
+        return df.OutputNode(lowerer.scope, node, on_data=lambda *a: None)
+
+    t0 = time.perf_counter()
+    run_pipeline_to_completion([(result, attach)])
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+    dt = run_once(n_rows)
+    print(
+        json.dumps(
+            {
+                "metric": "host_churn_rows_per_sec",
+                "value": round(n_rows / dt, 1),
+                "unit": "rows/s",
+                "rows": n_rows,
+                "keys": N_KEYS,
+                "seconds": round(dt, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

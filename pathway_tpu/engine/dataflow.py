@@ -131,8 +131,9 @@ class Node:
         self.pending: dict[int, list[Delta]] = defaultdict(list)
         self.keep_state = False
         self.state: dict[int, Row] = {}
-        # key -> Counter(row -> multiplicity); `state` holds the positive row
-        self._state_rows: dict[int, Counter] = {}
+        # key -> plain row (the common single-row multiplicity-1 case) or
+        # Counter(row -> multiplicity); `state` holds the positive row
+        self._state_rows: dict[int, Row | Counter] = {}
         self.id = scope._register(self)
         for port, inp in enumerate(self.inputs):
             inp.downstream.append((self, port))
@@ -172,28 +173,51 @@ class Node:
         return deltas
 
     def _update_state(self, deltas: list[Delta]) -> None:
+        """Maintain the per-key row multiset and the live-row view.
+
+        Representation: ``_state_rows[key]`` is a plain row tuple while the
+        key holds exactly one row at multiplicity 1 (the overwhelmingly
+        common case — measured as the churn-benchmark hot spot when every
+        key carried a Counter), and promotes to a ``Counter`` only for
+        multi-row / non-unit multiplicities.
+        """
+        state_rows = self._state_rows
+        state = self.state
         for key, row, diff in deltas:
-            rows = self._state_rows.get(key)
-            if rows is None:
-                rows = self._state_rows[key] = Counter()
-            rows[row] += diff
-            if rows[row] == 0:
-                del rows[row]
-            if not rows:
-                del self._state_rows[key]
-                self.state.pop(key, None)
+            cur = state_rows.get(key)
+            if cur is None:
+                if diff == 1:
+                    state_rows[key] = row
+                    state[key] = row
+                    continue
+                cur = state_rows[key] = Counter()
+            elif not isinstance(cur, Counter):
+                if diff == -1 and cur == row:
+                    del state_rows[key]
+                    state.pop(key, None)
+                    continue
+                cur = state_rows[key] = Counter({cur: 1})
+            cur[row] += diff
+            if cur[row] == 0:
+                del cur[row]
+            if not cur:
+                del state_rows[key]
+                state.pop(key, None)
             else:
-                for r, c in rows.items():
+                for r, c in cur.items():
                     if c > 0:
-                        self.state[key] = r
+                        state[key] = r
                         break
                 else:
-                    self.state.pop(key, None)
+                    state.pop(key, None)
 
     def state_multiset(self) -> Counter:
         """(key, row) -> positive multiplicity of the maintained state."""
         out: Counter = Counter()
         for key, rows in self._state_rows.items():
+            if not isinstance(rows, Counter):
+                out[(key, rows)] = 1
+                continue
             for r, c in rows.items():
                 if c > 0:
                     out[(key, r)] = c
@@ -234,9 +258,17 @@ class Node:
     def persist_load(self, data) -> None:
         for a, v in data.items():
             if a == "__state_rows":
-                self._state_rows = {k: Counter(c) for k, c in v.items()}
+                # snapshots may hold either form: plain row (multiplicity
+                # 1) or a Counter/dict of multiplicities
+                self._state_rows = {
+                    k: Counter(c) if isinstance(c, dict) else c
+                    for k, c in v.items()
+                }
                 self.state = {}
                 for k, rows in self._state_rows.items():
+                    if not isinstance(rows, Counter):
+                        self.state[k] = rows
+                        continue
                     for r, c in rows.items():
                         if c > 0:
                             self.state[k] = r

@@ -613,6 +613,10 @@ static PyObject *py_consolidate_dirty(PyObject *, PyObject *arg) {
   std::vector<PyObject *> fast_holds;
   fast_holds.reserve(static_cast<size_t>(n));
   auto cleanup = [&]() {
+    for (Entry &e : entries) {
+      Py_DECREF(e.key);
+      Py_DECREF(e.row);
+    }
     for (PyObject *f : fast_holds) Py_DECREF(f);
     Py_DECREF(seq);
   };
@@ -686,6 +690,10 @@ static PyObject *py_consolidate_dirty(PyObject *, PyObject *arg) {
     }
     if (!merged) {
       bucket.push_back(entries.size());
+      // own references: a later delta's __hash__/__eq__ may mutate a
+      // list-shaped delta and free the borrowed key/row otherwise
+      Py_INCREF(key);
+      Py_INCREF(row);
       entries.push_back(Entry{key, row, dv});
     }
   }

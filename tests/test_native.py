@@ -207,3 +207,28 @@ def test_native_consolidate_equivalence():
 
     with _pytest.raises(ValueError):
         consolidate([(1, ("a",), -1), (2, ("b",), 1, "extra")])
+
+
+def test_native_consolidate_survives_mutating_hash():
+    """A delta value whose __hash__ mutates a list-shaped delta must not
+    dangle the accumulator's pointers (was an interpreter segfault)."""
+    from pathway_tpu import native
+
+    mod = native.get()
+    if mod is None or not hasattr(mod, "consolidate_dirty"):
+        import pytest
+
+        pytest.skip("native core unavailable")
+
+    victim = [7, ("victim_row", 1), 1]
+
+    class EvilKey:
+        def __hash__(self):
+            victim[1] = None  # frees the row the accumulator saw
+            return 42
+
+        def __eq__(self, other):
+            return self is other
+
+    out = mod.consolidate_dirty([victim, (EvilKey(), ("other",), -1)])
+    assert (7, ("victim_row", 1), 1) in out
