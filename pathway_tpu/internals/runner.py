@@ -265,7 +265,9 @@ def _make_storage(persistence_config: Any):
         from pathway_tpu.engine import persistence as pz
 
         storage = pz.PersistentStorage(
-            pz.FileBackend(cfg.replay_storage), snapshot_interval_ms=0
+            pz.FileBackend(cfg.replay_storage),
+            snapshot_interval_ms=0,
+            worker=cfg.process_id,
         )
         storage.snapshot_access = _normalize_access(cfg.snapshot_access)
         storage.continue_after_replay = cfg.continue_after_replay
@@ -280,6 +282,10 @@ def _make_storage(persistence_config: Any):
         backend,
         snapshot_interval_ms=getattr(persistence_config, "snapshot_interval_ms", 0),
         mode=getattr(persistence_config, "persistence_mode", None),
+        # worker-sharded snapshots: each process owns metadata.json.<id> and
+        # snapshots/<id>/... — without this, multi-process runs clobber one
+        # another's state (the reference shards snapshot files per worker)
+        worker=get_config().process_id,
     )
     storage.snapshot_access = _normalize_access(
         getattr(persistence_config, "snapshot_access", None)

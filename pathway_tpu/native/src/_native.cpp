@@ -638,8 +638,18 @@ static PyObject *py_consolidate_dirty(PyObject *, PyObject *arg) {
     }
     PyObject *key = PySequence_Fast_GET_ITEM(fast, 0);
     PyObject *row = PySequence_Fast_GET_ITEM(fast, 1);
+    // own references BEFORE any __hash__/__eq__ runs: even this delta's
+    // own key hash may mutate a list-shaped delta and free the borrowed
+    // row pointer (reviewer-reproduced segfault)
+    Py_INCREF(key);
+    Py_INCREF(row);
+    auto drop_kr = [&]() {
+      Py_DECREF(key);
+      Py_DECREF(row);
+    };
     long long dv = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(fast, 2));
     if (dv == -1 && PyErr_Occurred()) {
+      drop_kr();
       if (PyErr_ExceptionMatches(PyExc_OverflowError)) {
         // beyond int64: let the arbitrary-precision Python path handle it
         PyErr_Clear();
@@ -651,11 +661,13 @@ static PyObject *py_consolidate_dirty(PyObject *, PyObject *arg) {
     }
     Py_hash_t hk = PyObject_Hash(key);
     if (hk == -1) {
+      drop_kr();
       cleanup();
       return nullptr;
     }
     Py_hash_t hr = PyObject_Hash(row);
     if (hr == -1) {
+      drop_kr();
       cleanup();
       return nullptr;
     }
@@ -668,18 +680,21 @@ static PyObject *py_consolidate_dirty(PyObject *, PyObject *arg) {
       Entry &e = entries[idx];
       int eqk = PyObject_RichCompareBool(e.key, key, Py_EQ);
       if (eqk < 0) {
+        drop_kr();
         cleanup();
         return nullptr;
       }
       if (!eqk) continue;
       int eqr = PyObject_RichCompareBool(e.row, row, Py_EQ);
       if (eqr < 0) {
+        drop_kr();
         cleanup();
         return nullptr;
       }
       if (eqr) {
         long long sum;
         if (__builtin_add_overflow(e.acc, dv, &sum)) {
+          drop_kr();
           cleanup();
           Py_RETURN_NONE;  // int64 overflow: Python fallback
         }
@@ -688,13 +703,11 @@ static PyObject *py_consolidate_dirty(PyObject *, PyObject *arg) {
         break;
       }
     }
-    if (!merged) {
+    if (merged) {
+      drop_kr();
+    } else {
       bucket.push_back(entries.size());
-      // own references: a later delta's __hash__/__eq__ may mutate a
-      // list-shaped delta and free the borrowed key/row otherwise
-      Py_INCREF(key);
-      Py_INCREF(row);
-      entries.push_back(Entry{key, row, dv});
+      entries.push_back(Entry{key, row, dv});  // refs owned above
     }
   }
   PyObject *out = PyList_New(0);

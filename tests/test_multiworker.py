@@ -69,7 +69,8 @@ def _worker_main(scenario, process_id, n, port, tmpdir, errq):
         scenario(tmpdir)
         import pathway_tpu as pw
 
-        pw.run()
+        if not getattr(scenario, "runs_itself", False):
+            pw.run()
         errq.put((process_id, None))
     except Exception:
         errq.put((process_id, traceback.format_exc()))
@@ -287,3 +288,60 @@ def test_peer_hosts_mesh_localhost():
         t.join(timeout=30)
     assert results["gathered"] == [0, 10, 20]
     assert results[0] == results[1] == results[2] == 30
+
+
+def _persistent_wordcount_scenario(tmpdir):
+    """Wordcount over fs input with worker-sharded persistence; the
+    scenario drives pw.run itself so it can pass persistence_config."""
+    import pathway_tpu as pw
+
+    t = pw.io.csv.read(
+        os.path.join(tmpdir, "pin"),
+        schema=pw.schema_from_types(word=str),
+        mode="static",
+        name="pwords",
+    )
+    counts = t.groupby(t.word).reduce(t.word, n=pw.reducers.count())
+    pw.io.jsonlines.write(counts, os.path.join(tmpdir, "pcounts.jsonl"))
+    pw.run(
+        persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem(os.path.join(tmpdir, "pstore"))
+        )
+    )
+
+
+_persistent_wordcount_scenario.runs_itself = True
+
+
+def test_multiprocess_persistence_resume(tmp_path):
+    """Cluster run with per-worker snapshot shards, then a resumed cluster
+    run with extra input: combined output is exactly-once, and each worker
+    owns its own metadata shard."""
+    pin = tmp_path / "pin"
+    pin.mkdir()
+    (pin / "a.csv").write_text("word\nfoo\nbar\nfoo\n")
+
+    _run_cluster(_persistent_wordcount_scenario, tmp_path)
+    combined = _read_parts(tmp_path, "pcounts.jsonl")
+    state = {json.loads(k)["word"]: json.loads(k)["n"] for k in combined}
+    assert state == {"foo": 2, "bar": 1}, state
+
+    # every worker committed its own metadata shard (no clobbering)
+    pstore = tmp_path / "pstore"
+    metas = sorted(
+        f for f in os.listdir(pstore) if f.startswith("metadata.json")
+    )
+    assert len(metas) == N_WORKERS, metas
+
+    # wipe sinks, add input, resume: prior rows come from the snapshots
+    for w in range(N_WORKERS + 1):
+        p = tmp_path / (
+            "pcounts.jsonl" if w == 0 else f"pcounts.jsonl.part-{w}"
+        )
+        if p.exists():
+            p.unlink()
+    (pin / "b.csv").write_text("word\nfoo\nbaz\n")
+    _run_cluster(_persistent_wordcount_scenario, tmp_path)
+    combined2 = _read_parts(tmp_path, "pcounts.jsonl")
+    state2 = {json.loads(k)["word"]: json.loads(k)["n"] for k in combined2}
+    assert state2 == {"foo": 3, "bar": 1, "baz": 1}, state2

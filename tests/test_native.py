@@ -232,3 +232,31 @@ def test_native_consolidate_survives_mutating_hash():
 
     out = mod.consolidate_dirty([victim, (EvilKey(), ("other",), -1)])
     assert (7, ("victim_row", 1), 1) in out
+
+
+def test_native_consolidate_survives_self_mutating_hash():
+    """A delta whose OWN key __hash__ mutates its list container must not
+    dangle the row pointer either (second reviewer-reproduced segfault)."""
+    from pathway_tpu import native
+
+    mod = native.get()
+    if mod is None or not hasattr(mod, "consolidate_dirty"):
+        import pytest
+
+        pytest.skip("native core unavailable")
+
+    d: list = []
+
+    class EvilKey:
+        def __hash__(self):
+            if len(d) > 1:
+                d[1] = None  # frees this delta's own row mid-extraction
+            return 7
+
+        def __eq__(self, other):
+            return self is other
+
+    evil = EvilKey()
+    d.extend([evil, ("self_row", 1), 1])
+    out = mod.consolidate_dirty([d, (2, ("other",), -1)])
+    assert any(r == ("self_row", 1) for (_k, r, _d) in out)

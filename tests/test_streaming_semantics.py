@@ -361,3 +361,29 @@ def test_two_sources_different_rates_share_min_frontier():
     assert min(t for (_k, _r, t, _d) in deltas) >= 6
     live = [r for (_k, r, _t, d) in deltas if d == 1]
     assert sorted(live) == [(1, 9), (2, 9), (3, 9)]
+
+
+def test_session_window_merges_on_bridging_row():
+    """A late row bridging two sessions must retract both and emit the
+    merged session."""
+    t = T(
+        """
+        at | _time
+        1  | 2
+        2  | 2
+        10 | 2
+        6  | 6
+        """
+    )
+    res = t.windowby(
+        pw.this.at, window=temporal.session(max_gap=5)
+    ).reduce(n=pw.reducers.count())
+    deltas = assert_stream_consistent(res)
+    assert_snapshots(
+        res,
+        {
+            2: [(2,), (1,)],  # {1,2} and {10}
+            6: [(4,)],  # at=6 bridges: gap(2->6)=4<5, gap(6->10)=4<5
+        },
+        deltas,
+    )
