@@ -196,7 +196,21 @@ class SchemaMetaclass(type):
 
 
 class Schema(metaclass=SchemaMetaclass):
-    """Base class for user-defined schemas (``class S(pw.Schema): x: int``)."""
+    r"""Base class for user-defined schemas (``class S(pw.Schema): x: int``).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> class Person(pw.Schema):
+    ...     name: str
+    ...     age: int
+    >>> print(Person.column_names())
+    ['name', 'age']
+    >>> t = pw.debug.table_from_markdown('name | age\nAda | 36', schema=Person)
+    >>> pw.debug.compute_and_print(t, include_id=False)
+    name | age
+    Ada  | 36
+    """
 
     def __init_subclass__(cls, **kwargs):
         super().__init_subclass__(**kwargs)

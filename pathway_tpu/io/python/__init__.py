@@ -19,7 +19,23 @@ from pathway_tpu.io._utils import COMMIT, DELETE, Reader
 
 
 class ConnectorSubject:
-    """Base class for Python-defined sources."""
+    """Base class for Python-defined sources.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> class Numbers(pw.io.python.ConnectorSubject):
+    ...     def run(self):
+    ...         for i in range(3):
+    ...             self.next(n=i)
+    ...         self.commit()
+    >>> t = pw.io.python.read(Numbers(), schema=pw.schema_from_types(n=int))
+    >>> pw.debug.compute_and_print(t.select(sq=pw.this.n * pw.this.n), include_id=False)
+    sq
+    0
+    1
+    4
+    """
 
     _emit: Any = None
 

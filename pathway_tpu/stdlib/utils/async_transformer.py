@@ -17,10 +17,25 @@ from pathway_tpu.internals.table import Lowerer, Table, Universe
 
 
 class AsyncTransformer:
-    """Subclass and implement ``async def invoke(self, **kwargs) -> dict``.
+    r"""Subclass and implement ``async def invoke(self, **kwargs) -> dict``.
 
     ``output_schema`` must be declared as a class attribute or passed to
     ``__init__``; ``.successful`` gives the result table.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer
+    >>> class Upper(AsyncTransformer):
+    ...     output_schema = pw.schema_from_types(out=str)
+    ...     async def invoke(self, w):
+    ...         return {"out": w.upper()}
+    >>> t = pw.debug.table_from_markdown('w\nhi\nyo')
+    >>> res = Upper(input_table=t).successful
+    >>> pw.debug.compute_and_print(res, include_id=False)
+    out
+    HI
+    YO
     """
 
     output_schema: type[schema_mod.Schema] | None = None

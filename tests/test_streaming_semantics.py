@@ -387,3 +387,60 @@ def test_session_window_merges_on_bridging_row():
         },
         deltas,
     )
+
+
+def test_intervals_over_updates_when_data_arrives_late():
+    """A probe's interval re-aggregates when a covered row arrives later."""
+    data = T(
+        """
+        t | v  | _time
+        1 | 10 | 2
+        3 | 20 | 6
+        """
+    )
+    probes = T(
+        """
+        pt | _time
+        3  | 2
+        """
+    )
+    res = data.windowby(
+        pw.this.t,
+        window=temporal.intervals_over(
+            at=probes.pt, lower_bound=-2, upper_bound=0
+        ),
+    ).reduce(
+        at=pw.this._pw_window,
+        vals=pw.reducers.sorted_tuple(pw.this.v),
+    )
+    deltas = assert_stream_consistent(res)
+    assert_snapshots(
+        res,
+        {
+            2: [(3, (10,))],
+            6: [(3, (10, 20))],  # late t=3 row folds into the probe window
+        },
+        deltas,
+    )
+
+
+def test_window_join_retracts_pair_when_row_leaves():
+    """Retracting one side of a window-join pair retracts the joined row."""
+    a = T(
+        """
+        at | av | _time | _diff
+        1  | a1 | 2     | 1
+        """
+    )
+    b = T(
+        """
+        bt | bv | _time | _diff
+        2  | b2 | 2     | 1
+        2  | b2 | 6     | -1
+        """
+    )
+    res = temporal.window_join(
+        a, b, a.at, b.bt, temporal.tumbling(duration=5)
+    ).select(av=a.av, bv=b.bv)
+    deltas = assert_stream_consistent(res)
+    assert_snapshots(res, {2: [("a1", "b2")], 6: []}, deltas)
