@@ -967,6 +967,9 @@ class GroupByNode(Node):
         # columnar fast path (set by the Lowerer): (group_col_idx,
         # [("count", None) | ("sum", value_col_idx), ...]) — batch reducer
         # updates become np.unique grouping + one add_bulk per touched group
+        # columnar spec set by the Lowerer: (group_col_idx, [(kind, idx)])
+        # with kind in {"count" (idx None), "sum", "mm" (min/max multiset)};
+        # _step_columnar applies add_bulk for count/sum and add_pairs for mm
         self.vec_group = None
 
     def _ensure_group(self, gk):
@@ -994,15 +997,18 @@ class GroupByNode(Node):
         val_arrs = [
             None if kind == "count" else cols[vidx] for kind, vidx in red_cols
         ]
-        if any(v is not None and v.dtype.kind not in "bif" for v in val_arrs):
-            return False
+        for (kind, _), varr in zip(red_cols, val_arrs):
+            # sums need numeric columns; min/max works on any materialized
+            # dtype (incl. str) since it only groups and counts
+            if kind == "sum" and varr.dtype.kind not in "bif":
+                return False
         diffs = np.asarray([d for (_, _, d) in deltas], np.int64)
         max_diff = vc._abs_bound(diffs)
-        for varr in val_arrs:
+        for (kind, _), varr in zip(red_cols, val_arrs):
             # per-batch int sums must stay within i64 (state accumulates in
             # Python bignums, so only the numpy partial sums can wrap)
             if (
-                varr is not None
+                kind == "sum"
                 and varr.dtype.kind == "i"
                 and vc._abs_bound(varr) * max_diff * max(1, len(rows)) > vc._I64_MAX
             ):
@@ -1012,11 +1018,26 @@ class GroupByNode(Node):
         counts = np.zeros(n_groups, np.int64)
         np.add.at(counts, inv, diffs)
         contribs = []
-        for varr in val_arrs:
-            if varr is None:
+        for (kind, _), varr in zip(red_cols, val_arrs):
+            if kind == "count":
                 contribs.append(None)
-                continue
-            if varr.dtype.kind == "f":
+            elif kind == "mm":
+                # per-(group, value) summed diffs for the multiset states
+                vu, vinv = np.unique(varr, return_inverse=True)
+                combo = inv.astype(np.int64) * len(vu) + vinv
+                cu, cinv = np.unique(combo, return_inverse=True)
+                pair_counts = np.zeros(len(cu), np.int64)
+                np.add.at(pair_counts, cinv, diffs)
+                pair_groups = (cu // len(vu)).tolist()
+                pair_vals = vu[cu % len(vu)].tolist()
+                by_group: dict[int, tuple[list, list]] = {}
+                for g, v, c in zip(pair_groups, pair_vals, pair_counts.tolist()):
+                    if c:
+                        vs, cs = by_group.setdefault(g, ([], []))
+                        vs.append(v)
+                        cs.append(c)
+                contribs.append(("mm", by_group))
+            elif varr.dtype.kind == "f":
                 contribs.append(np.bincount(inv, weights=varr * diffs, minlength=n_groups))
             else:
                 acc = np.zeros(n_groups, np.int64)
@@ -1024,13 +1045,19 @@ class GroupByNode(Node):
                 contribs.append(acc)
         gvals = uniq.tolist()
         counts_l = counts.tolist()
-        contribs_l = [c.tolist() if c is not None else None for c in contribs]
+        contribs_l = [
+            c.tolist() if isinstance(c, np.ndarray) else c for c in contribs
+        ]
         for ui, gval in enumerate(gvals):
             gk = (gval,)
             states = self._ensure_group(gk)
             for state, contrib in zip(states, contribs_l):
                 if contrib is None:
                     state.add_bulk(counts_l[ui])
+                elif isinstance(contrib, tuple):  # ("mm", by_group)
+                    pairs = contrib[1].get(ui)
+                    if pairs is not None:
+                        state.add_pairs(pairs[0], pairs[1])
                 else:
                     state.add_bulk(contrib[ui], counts_l[ui])
             self._group_counts[gk] += counts_l[ui]

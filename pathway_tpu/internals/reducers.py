@@ -220,6 +220,16 @@ class _MultisetState(ReducerState):
         if self.rows[entry] == 0:
             del self.rows[entry]
 
+    def add_pairs(self, values, counts):
+        """Columnar bulk update: per distinct value, a summed diff.
+        Only valid for ``keyed=False`` states (min/max/...)."""
+        rows = self.rows
+        for v, c in zip(values, counts):
+            entry = ((v,), None)
+            rows[entry] += c
+            if rows[entry] == 0:
+                del rows[entry]
+
     def extract(self):
         return self.finish(self.rows)
 

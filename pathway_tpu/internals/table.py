@@ -1847,8 +1847,8 @@ class GroupedTable:
 
         def _vec_group_spec(g_exprs, inst_expr, grouped_by_id, slots, binder):
             """Columnar groupby spec (GroupByNode.vec_group) when the shape
-            allows it: one plain grouping column, count/sum/avg reducers over
-            plain columns.  Anything else keeps the row path."""
+            allows it: one plain grouping column, count/sum/avg/min/max
+            reducers over plain columns.  Anything else keeps the row path."""
             from pathway_tpu.internals.reducers import (
                 AvgReducer,
                 CountReducer,
@@ -1881,6 +1881,16 @@ class GroupedTable:
                     vidx = plain_idx(r._args[0])
                     if vidx is not None:
                         red_cols.append(("sum", vidx))
+                        continue
+                from pathway_tpu.internals import reducers as _red_mod
+
+                # identity against the public singletons: a user reducer
+                # merely NAMED "min" must not be routed to the mm path
+                if red in (_red_mod.min, _red_mod.max) and len(r._args) == 1:
+                    vidx = plain_idx(r._args[0])
+                    if vidx is not None:
+                        # multiset pair update; extraction stays in the state
+                        red_cols.append(("mm", vidx))
                         continue
                 return None
             return (gidx, red_cols)

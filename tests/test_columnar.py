@@ -226,3 +226,80 @@ def test_mixed_int_float_column_bails_not_promotes():
     rows = _both_modes(build)
     exact = [r[0] for r in rows.values() if isinstance(r[0], int)]
     assert exact and all(v == big_odd for v in exact)
+
+
+def test_groupby_min_max_columnar_parity():
+    """min/max engage the columnar path via per-(group, value) pair
+    updates into the multiset state; retractions recover the prior
+    extremum exactly as the row path does."""
+
+    def build():
+        import pandas as pd
+
+        recs = [
+            {"k": i, "word": f"w{i % 7}", "v": (i * 37) % 1000, "_time": 0, "_diff": 1}
+            for i in range(N)
+        ]
+        # retract a later slice: some retracted rows were their group's max
+        recs += [
+            {"k": i, "word": f"w{i % 7}", "v": (i * 37) % 1000, "_time": 2, "_diff": -1}
+            for i in range(0, N, 4)
+        ]
+        t = pw.debug.table_from_pandas(pd.DataFrame(recs), id_from=["k"])
+        return t.without(pw.this.k).groupby(pw.this.word).reduce(
+            word=pw.this.word,
+            lo=pw.reducers.min(pw.this.v),
+            hi=pw.reducers.max(pw.this.v),
+        )
+
+    rows = _both_modes(build)
+    alive = [i for i in range(N) if i % 4 != 0]
+    import collections
+
+    expect: dict = collections.defaultdict(list)
+    for i in alive:
+        expect[f"w{i % 7}"].append((i * 37) % 1000)
+    for r in rows.values():
+        word, lo, hi = r
+        assert lo == min(expect[word]), (word, lo)
+        assert hi == max(expect[word]), (word, hi)
+
+
+def test_groupby_min_max_string_columnar_parity():
+    def build():
+        t = make_static_input_table(
+            pw.schema_from_types(g=int, w=str),
+            [{"g": i % 3, "w": f"word{(i * 31) % 97:02d}"} for i in range(N)],
+        )
+        return t.groupby(pw.this.g).reduce(
+            g=pw.this.g,
+            first=pw.reducers.min(pw.this.w),
+            last=pw.reducers.max(pw.this.w),
+        )
+
+    rows = _both_modes(build)
+    assert len(rows) == 3
+    for r in rows.values():
+        assert r[1] <= r[2]
+        assert r[1].startswith("word") and r[2].startswith("word")
+
+
+def test_user_reducer_named_min_stays_on_row_path():
+    """A stateful reducer whose combine fn is named 'min' must not be
+    routed to the columnar multiset path (was an AttributeError)."""
+
+    def build():
+        def min(state, v):  # noqa: A001 - the name is the point
+            return v if state is None or v < state else state
+
+        smin = pw.reducers.stateful_single(min)
+        t = make_static_input_table(
+            pw.schema_from_types(g=int, v=int),
+            [{"g": i % 3, "v": (i * 17) % 100} for i in range(N)],
+        )
+        return t.groupby(pw.this.g).reduce(g=pw.this.g, m=smin(pw.this.v))
+
+    rows = _both_modes(build)
+    assert len(rows) == 3
+    for r in rows.values():
+        assert 0 <= r[1] < 100
