@@ -965,11 +965,10 @@ class GroupByNode(Node):
         # reducer-less reduces: distinct group keys must still emit rows)
         self._last_out: dict[tuple, Row] = {}
         # columnar fast path (set by the Lowerer): (group_col_idx,
-        # [("count", None) | ("sum", value_col_idx), ...]) — batch reducer
-        # updates become np.unique grouping + one add_bulk per touched group
-        # columnar spec set by the Lowerer: (group_col_idx, [(kind, idx)])
-        # with kind in {"count" (idx None), "sum", "mm" (min/max multiset)};
-        # _step_columnar applies add_bulk for count/sum and add_pairs for mm
+        # [(kind, value_col_idx), ...]) with kind in {"count" (idx None),
+        # "sum" (also avg), "mm" (min/max)} — batch updates become
+        # np.unique grouping + add_bulk per group (count/sum) or
+        # per-(group, value) add_pairs into the multiset states (mm)
         self.vec_group = None
 
     def _ensure_group(self, gk):

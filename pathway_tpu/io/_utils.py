@@ -30,6 +30,17 @@ FINISH = object()  # sentinel: source exhausted
 DELETE = "_pw_delete"  # row dict flag for deletions / upserts
 
 
+class RawRows:
+    """Bulk-ingest batch: value tuples already coerced to the source schema
+    (in schema order).  Readers emit one of these instead of per-row dicts
+    when they can vector-parse a whole file (e.g. the pandas CSV path)."""
+
+    __slots__ = ("rows",)
+
+    def __init__(self, rows: list):
+        self.rows = rows
+
+
 class Offset:
     """Reader frontier marker: everything emitted before this message is
     covered by ``value`` (the offset-antichain analog, persistence/frontier.rs).
@@ -138,6 +149,42 @@ class _QueuePoller:
         # engine's durability point; popped by ack_processed
         self._commit_markers: deque[tuple[int, int]] = deque()
 
+    def _bulk_insert(self, rows: list) -> None:
+        """Stage a RawRows batch: values are already coerced to the schema
+        dtypes and in schema order, so the per-row dict/coerce layers are
+        skipped (the bulk-ingest fast path of file sources)."""
+        pk_idx = (
+            [self.names.index(c) for c in self.pk] if self.pk else None
+        )
+        ins = self.input_node.insert
+        log = (
+            self.persist_state.log
+            if self.persist_state is not None
+            and not self.persist_state.operator_mode
+            else None
+        )
+        t = self._time
+        if pk_idx is None:
+            n = self._auto_seq
+            base = self._seq_base
+            for vrow in rows:
+                key = sequential_key(base + n)
+                n += 1
+                ins(key, vrow, t, 1)
+                if log is not None:
+                    log.record(key, vrow, 1)
+            self._auto_seq = n
+            if self.persist_state is not None:
+                self.persist_state.key_seq = n
+        else:
+            for vrow in rows:
+                key = hash_values([vrow[i] for i in pk_idx])
+                ins(key, vrow, t, 1)
+                if log is not None:
+                    log.record(key, vrow, 1)
+        if rows:
+            self._staged = True
+
     def _key_of(self, values: list, row: Mapping) -> int:
         if "_pw_key" in row:
             k = row["_pw_key"]
@@ -205,6 +252,9 @@ class _QueuePoller:
                     else:
                         self.persist_state.pending_offset = item.value
                         self.persist_state.log.flush_chunk()
+                continue
+            if isinstance(item, RawRows):
+                self._bulk_insert(item.rows)
                 continue
             row = item
             diff = -1 if row.get(DELETE) else 1

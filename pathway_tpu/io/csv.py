@@ -53,7 +53,24 @@ def read(
     settings = (csv_settings.as_dict() if csv_settings else None)
     base_parse = csv_parse_file(settings)
 
+    simple_settings = csv_settings is None or (
+        csv_settings.escape is None and csv_settings.comment_character is None
+    )
+    vector_ok = (
+        not with_metadata
+        and simple_settings
+        and all(
+            dtypes[n].strip_optional() in (dt.INT, dt.FLOAT, dt.BOOL, dt.STR, dt.ANY)
+            for n in names
+        )
+    )
+
     def typed_parse(p, offset):
+        if vector_ok:
+            parsed = _pandas_parse(p, offset, names, dtypes, csv_settings)
+            if parsed is not None:
+                raw_batch, total = parsed
+                return [raw_batch], total
         rows, new_offset = base_parse(p, offset)
 
         def gen():
@@ -75,6 +92,83 @@ def read(
         autocommit_duration_ms=autocommit_duration_ms,
         name=name,
     )
+
+
+def _pandas_parse(path, offset, names, dtypes, csv_settings):
+    """Vector parse: pandas' C reader + per-column conversion, emitted as
+    one ``RawRows`` batch so the poller skips the per-row dict/coerce
+    layers.  Returns ``None`` to fall back to the row-at-a-time parser
+    whenever exact `_convert` semantics cannot be guaranteed vectorized.
+    """
+    try:
+        import io as _io
+
+        import numpy as np
+        import pandas as pd
+
+        delim = csv_settings.delimiter if csv_settings else ","
+        quote = csv_settings.quote if csv_settings else '"'
+        with open(path, encoding="utf-8", errors="replace", newline="") as f:
+            text = f.read()
+        # exact-parity guards: quoted cells make field counting ambiguous,
+        # and ragged rows diverge from DictReader (None vs "" fills, or
+        # pandas' silent implicit-index column shift) — fall back for both
+        if quote in text:
+            return None
+        lines = [ln for ln in text.splitlines() if ln]
+        if not lines:
+            return None
+        counts = np.char.count(np.array(lines, dtype=str), delim)
+        if not (counts == counts[0]).all():
+            return None
+        df_pd = pd.read_csv(
+            _io.StringIO(text),
+            dtype=str,
+            keep_default_na=False,
+            sep=delim,
+            quotechar=quote,
+            doublequote=(
+                csv_settings.enable_double_quote_escapes if csv_settings else True
+            ),
+            engine="c",
+            index_col=False,
+        )
+    except Exception:
+        return None
+    total = len(df_pd)
+    if offset:
+        df_pd = df_pd.iloc[offset:]
+    cols = []
+    n_rows = len(df_pd)
+    for n in names:
+        base = dtypes[n].strip_optional()
+        if n not in df_pd.columns:
+            cols.append([None] * n_rows)
+            continue
+        s = df_pd[n]
+        if base is dt.STR or base is dt.ANY:
+            cols.append(s.tolist())
+        elif base is dt.BOOL:
+            cols.append(
+                s.str.strip().str.lower().isin(("true", "1", "yes", "on")).tolist()
+            )
+        elif base is dt.INT:
+            # the C path only for columns of pure integer LITERALS (what
+            # int() accepts): '2.0'/'1e3' must stay None like the row
+            # path, and <= 15 digits keeps float64 round-tripping exact
+            lit = s.str.fullmatch(r"[+-]?\d{1,15}")
+            if n_rows and lit.all():
+                cols.append(
+                    pd.to_numeric(s).to_numpy(np.int64).tolist()
+                )
+            else:
+                cols.append([_convert(x, dt.INT) for x in s.tolist()])
+        elif base is dt.FLOAT:
+            # float('nan')/'inf' literals must survive (match _convert)
+            cols.append([_convert(x, dt.FLOAT) for x in s.tolist()])
+        else:
+            return None
+    return _utils.RawRows(list(zip(*cols))), total
 
 
 def _convert(raw: str | None, dtype: dt.DType):

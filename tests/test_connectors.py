@@ -1774,3 +1774,122 @@ def test_gcs_auth_failure_is_not_read_as_missing_snapshot(mock_gcs):
         backend.get("metadata.json")
     with pytest.raises(GcsAuthError):
         backend.delete("metadata.json")
+
+
+# ---------------------------------------------------------------------------
+# csv vector-parse fast path (pandas C reader -> RawRows bulk ingest)
+# ---------------------------------------------------------------------------
+
+
+_csv_dir_seq = [0]
+
+
+def _csv_roundtrip(tmp_path, content, schema, force_row_path=False):
+    import pathway_tpu.io.csv as csv_mod
+
+    _csv_dir_seq[0] += 1
+    d = tmp_path / f"{'row' if force_row_path else 'vec'}{_csv_dir_seq[0]}"
+    d.mkdir()
+    (d / "data.csv").write_text(content)
+    pw.G.clear()
+    from tests.utils import rows as engine_rows
+
+    orig = csv_mod._pandas_parse
+    if force_row_path:
+        csv_mod._pandas_parse = lambda *a, **k: None
+    try:
+        t = pw.io.csv.read(str(d), schema=schema, mode="static")
+        rows = sorted(engine_rows(t), key=repr)
+    finally:
+        csv_mod._pandas_parse = orig
+        pw.G.clear()
+    return rows
+
+
+def test_csv_vector_parse_matches_row_path(tmp_path):
+    content = (
+        "word,n,x,ok\n"
+        "alpha,1,1.5,true\n"
+        "beta,,bad,no\n"  # empty int -> None, bad float -> None
+        "gamma,9007199254740993,2.5,1\n"  # > 2^53: exact bignum required
+        ",3,nan,yes\n"  # empty str stays "", nan literal survives
+    )
+    schema = pw.schema_from_types(word=str, n=int | None, x=float | None, ok=bool)
+    vec = _csv_roundtrip(tmp_path, content, schema)
+    row = _csv_roundtrip(tmp_path, content, schema, force_row_path=True)
+
+    def norm(rows):
+        out = []
+        for r in rows:
+            out.append(
+                tuple("nan" if isinstance(v, float) and v != v else v for v in r)
+            )
+        return out
+
+    assert norm(vec) == norm(row)
+    by_word = {r[0]: r for r in vec}
+    assert by_word["gamma"][1] == 9007199254740993  # no float53 truncation
+    assert by_word["beta"][1] is None and by_word["beta"][2] is None
+    assert by_word["alpha"][3] is True and by_word["beta"][3] is False
+
+
+def test_csv_vector_parse_resume_offsets(tmp_path):
+    """The RawRows path must keep the same per-file offset units so
+    persistence resume skips exactly the consumed prefix."""
+    d = tmp_path / "in"
+    d.mkdir()
+    (d / "a.csv").write_text("v\n1\n2\n")
+    pstore = tmp_path / "ps"
+
+    def run_once(results):
+        pw.G.clear()
+        t = pw.io.csv.read(
+            str(d), schema=pw.schema_from_types(v=int), mode="static", name="vsrc"
+        )
+        pw.io.subscribe(
+            t,
+            on_change=lambda key, row, time, is_addition: results.append(row["v"]),
+        )
+        pw.run(
+            persistence_config=pw.persistence.Config(
+                pw.persistence.Backend.filesystem(str(pstore))
+            )
+        )
+
+    r1: list = []
+    run_once(r1)
+    assert sorted(r1) == [1, 2]
+    # appended rows: only the delta re-processes
+    (d / "a.csv").write_text("v\n1\n2\n3\n")
+    r2: list = []
+    run_once(r2)
+    assert sorted(r2) == [1, 2, 3]  # snapshot replays 1,2; file adds 3
+
+
+def test_csv_vector_parse_divergence_guards(tmp_path):
+    """Reviewer cases: float-literal ints, ragged rows, and quoted cells
+    must behave identically on both parse paths (by bailing when needed)."""
+    # '2.0'/'1e3' are NOT int literals -> None on both paths
+    schema = pw.schema_from_types(a=int | None, b=str)
+    content = "a,b\n1,x\n2.0,y\n1e3,z\n"
+    assert _csv_roundtrip(tmp_path, content, schema) == _csv_roundtrip(
+        tmp_path, content, schema, force_row_path=True
+    )
+    vec = dict(
+        (b, a) for (a, b) in _csv_roundtrip(tmp_path, content, schema)
+    )
+    assert vec == {"x": 1, "y": None, "z": None}
+
+    # ragged rows (extra + missing fields): both paths agree
+    schema2 = pw.schema_from_types(a=str, b=str | None)
+    ragged = "a,b\n1,2,3\nonly\n4,5\n"
+    assert _csv_roundtrip(tmp_path, ragged, schema2) == _csv_roundtrip(
+        tmp_path, ragged, schema2, force_row_path=True
+    )
+
+    # quoted delimiter cells: both paths agree
+    schema3 = pw.schema_from_types(a=str, b=str)
+    quoted = 'a,b\n"x,y",z\n'
+    assert _csv_roundtrip(tmp_path, quoted, schema3) == _csv_roundtrip(
+        tmp_path, quoted, schema3, force_row_path=True
+    )
