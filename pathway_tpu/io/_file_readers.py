@@ -163,7 +163,10 @@ def csv_parse_file(csv_settings: dict | None = None):
     return parse
 
 
-def jsonlines_parse_file(path: str, offset: int):
+def jsonlines_objects(path: str, offset: int):
+    """Shared line scan for BOTH jsonlines paths (dict rows and the bulk
+    RawRows path): yields parsed objects, skipping blank/malformed lines;
+    the offset unit is raw line count."""
     with open(path, encoding="utf-8", errors="replace") as f:
         lines = f.readlines()
 
@@ -173,15 +176,24 @@ def jsonlines_parse_file(path: str, offset: int):
             if not line:
                 continue
             try:
-                obj = _json.loads(line)
+                yield _json.loads(line)
             except _json.JSONDecodeError:
                 continue
+
+    return gen(), len(lines)
+
+
+def jsonlines_parse_file(path: str, offset: int):
+    objs, new_offset = jsonlines_objects(path, offset)
+
+    def gen():
+        for obj in objs:
             yield {
                 k: (Json(v) if isinstance(v, (dict, list)) else v)
                 for k, v in obj.items()
             }
 
-    return gen(), len(lines)
+    return gen(), new_offset
 
 
 def plaintext_parse_file(path: str, offset: int):

@@ -121,6 +121,11 @@ def _pandas_parse(path, offset, names, dtypes, csv_settings):
         counts = np.char.count(np.array(lines, dtype=str), delim)
         if not (counts == counts[0]).all():
             return None
+        header = lines[0].split(delim)
+        if len(set(header)) != len(header):
+            # duplicate header names: DictReader keeps the LAST duplicate,
+            # pandas mangles to a.1 — exact parity needs the row path
+            return None
         df_pd = pd.read_csv(
             _io.StringIO(text),
             dtype=str,
@@ -133,42 +138,42 @@ def _pandas_parse(path, offset, names, dtypes, csv_settings):
             engine="c",
             index_col=False,
         )
-    except Exception:
-        return None
-    total = len(df_pd)
-    if offset:
-        df_pd = df_pd.iloc[offset:]
-    cols = []
-    n_rows = len(df_pd)
-    for n in names:
-        base = dtypes[n].strip_optional()
-        if n not in df_pd.columns:
-            cols.append([None] * n_rows)
-            continue
-        s = df_pd[n]
-        if base is dt.STR or base is dt.ANY:
-            cols.append(s.tolist())
-        elif base is dt.BOOL:
-            cols.append(
-                s.str.strip().str.lower().isin(("true", "1", "yes", "on")).tolist()
-            )
-        elif base is dt.INT:
-            # the C path only for columns of pure integer LITERALS (what
-            # int() accepts): '2.0'/'1e3' must stay None like the row
-            # path, and <= 15 digits keeps float64 round-tripping exact
-            lit = s.str.fullmatch(r"[+-]?\d{1,15}")
-            if n_rows and lit.all():
+        total = len(df_pd)
+        if offset:
+            df_pd = df_pd.iloc[offset:]
+        cols = []
+        n_rows = len(df_pd)
+        for n in names:
+            base = dtypes[n].strip_optional()
+            if n not in df_pd.columns:
+                cols.append([None] * n_rows)
+                continue
+            s = df_pd[n]
+            if base is dt.STR or base is dt.ANY:
+                cols.append(s.tolist())
+            elif base is dt.BOOL:
                 cols.append(
-                    pd.to_numeric(s).to_numpy(np.int64).tolist()
+                    s.str.strip().str.lower().isin(("true", "1", "yes", "on")).tolist()
                 )
+            elif base is dt.INT:
+                # the C path only for columns of pure ASCII integer
+                # LITERALS: '2.0'/'1e3' must stay None like the row path,
+                # Unicode digits take the exact per-cell int() semantics,
+                # and <= 15 digits keeps float64 round-tripping exact
+                lit = s.str.fullmatch(r"[+-]?[0-9]{1,15}")
+                if n_rows and lit.all():
+                    cols.append(pd.to_numeric(s).to_numpy(np.int64).tolist())
+                else:
+                    cols.append([_convert(x, dt.INT) for x in s.tolist()])
+            elif base is dt.FLOAT:
+                # float('nan')/'inf' literals must survive (match _convert)
+                cols.append([_convert(x, dt.FLOAT) for x in s.tolist()])
             else:
-                cols.append([_convert(x, dt.INT) for x in s.tolist()])
-        elif base is dt.FLOAT:
-            # float('nan')/'inf' literals must survive (match _convert)
-            cols.append([_convert(x, dt.FLOAT) for x in s.tolist()])
-        else:
-            return None
-    return _utils.RawRows(list(zip(*cols))), total
+                return None
+        return _utils.RawRows(list(zip(*cols))), total
+    except Exception:
+        # ANY vector-path surprise falls back to the exact row parser
+        return None
 
 
 def _convert(raw: str | None, dtype: dt.DType):

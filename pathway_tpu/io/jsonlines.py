@@ -12,7 +12,12 @@ from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import schema as schema_mod
 from pathway_tpu.internals.table import Table
 from pathway_tpu.io import _utils
-from pathway_tpu.io._file_readers import FileReader, jsonlines_parse_file, only_mode
+from pathway_tpu.io._file_readers import (
+    FileReader,
+    jsonlines_objects,
+    jsonlines_parse_file,
+    only_mode,
+)
 
 
 def read(
@@ -31,7 +36,34 @@ def read(
     names = list(schema.__columns__.keys())
     dtypes = {n: schema.__columns__[n].dtype for n in names}
 
+    cols_spec = [
+        (
+            n,
+            dtypes[n],
+            json_field_paths.get(n) if json_field_paths else None,
+        )
+        for n in names
+    ]
+
     def typed_parse(p, offset):
+        if not with_metadata:
+            # bulk path: parse + coerce straight into one RawRows batch,
+            # skipping the per-row dict layers and per-row queue traffic.
+            # The line scan (skip rules, line-count offsets) is shared with
+            # the row path via jsonlines_objects.
+            objs, new_offset = jsonlines_objects(p, offset)
+            coerce = dt.coerce
+            out_rows = []
+            for obj in objs:
+                vals = []
+                for n, d, pth in cols_spec:
+                    v = _extract_path(obj, pth) if pth else obj.get(n)
+                    if isinstance(v, (dict, list)):
+                        v = Json(v)
+                    vals.append(coerce(_coerce_json(v, d), d))
+                out_rows.append(tuple(vals))
+            return [_utils.RawRows(out_rows)], new_offset
+
         rows, new_offset = jsonlines_parse_file(p, offset)
 
         def gen():

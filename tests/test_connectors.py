@@ -1893,3 +1893,19 @@ def test_csv_vector_parse_divergence_guards(tmp_path):
     assert _csv_roundtrip(tmp_path, quoted, schema3) == _csv_roundtrip(
         tmp_path, quoted, schema3, force_row_path=True
     )
+
+
+def test_csv_vector_parse_duplicate_header_and_unicode_digits(tmp_path):
+    """Reviewer cases: duplicate header names and non-ASCII digits must
+    agree between parse paths (by bailing to the row parser)."""
+    schema = pw.schema_from_types(a=str)
+    dup = "a,a\n1,2\n"
+    assert _csv_roundtrip(tmp_path, dup, schema) == _csv_roundtrip(
+        tmp_path, dup, schema, force_row_path=True
+    )
+    schema2 = pw.schema_from_types(n=int | None)
+    uni = "n\n٣\n7\n"  # Arabic-Indic three: int() accepts it
+    vec = _csv_roundtrip(tmp_path, uni, schema2)
+    row = _csv_roundtrip(tmp_path, uni, schema2, force_row_path=True)
+    assert vec == row
+    assert sorted(v for (v,) in vec) == [3, 7]
