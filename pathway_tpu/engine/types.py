@@ -351,6 +351,17 @@ def sequential_key(seq: int) -> int:
     return _hash_bytes(_SEQ_SALT + seq.to_bytes(16, "little", signed=True))
 
 
+def sequential_keys(start: int, count: int) -> list[int]:
+    """Bulk ``[sequential_key(start + i) for i in range(count)]`` — the
+    native core derives them in one C loop (bulk-ingest hot path)."""
+    native = _native()
+    if native is not None and hasattr(native, "sequential_keys"):
+        return native.sequential_keys(
+            _SEQ_SALT, start.to_bytes(16, "little", signed=True), count
+        )
+    return [sequential_key(start + i) for i in range(count)]
+
+
 def key_to_u64_pair(key: int) -> tuple[int, int]:
     """Split a 128-bit key into (hi, lo) uint64 for device-side id tensors."""
     return (key >> 64) & 0xFFFFFFFFFFFFFFFF, key & 0xFFFFFFFFFFFFFFFF

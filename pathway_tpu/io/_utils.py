@@ -19,7 +19,13 @@ from collections import deque
 from typing import Any, Callable, Iterable, Mapping
 
 from pathway_tpu.engine import dataflow as df
-from pathway_tpu.engine.types import KEY_MASK, Json, hash_values, sequential_key
+from pathway_tpu.engine.types import (
+    KEY_MASK,
+    Json,
+    hash_values,
+    sequential_key,
+    sequential_keys,
+)
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import schema as schema_mod
 from pathway_tpu.internals.parse_graph import G
@@ -166,16 +172,14 @@ class _QueuePoller:
         t = self._time
         if pk_idx is None:
             n = self._auto_seq
-            base = self._seq_base
-            for vrow in rows:
-                key = sequential_key(base + n)
-                n += 1
+            keys = sequential_keys(self._seq_base + n, len(rows))
+            for key, vrow in zip(keys, rows):
                 ins(key, vrow, t, 1)
                 if log is not None:
                     log.record(key, vrow, 1)
-            self._auto_seq = n
+            self._auto_seq = n + len(rows)
             if self.persist_state is not None:
-                self.persist_state.key_seq = n
+                self.persist_state.key_seq = self._auto_seq
         else:
             for vrow in rows:
                 key = hash_values([vrow[i] for i in pk_idx])

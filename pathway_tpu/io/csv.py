@@ -64,6 +64,8 @@ def read(
             for n in names
         )
     )
+    if vector_ok:
+        _warm_pandas()  # main-thread init; the parse runs on the reader thread
 
     def typed_parse(p, offset):
         if vector_ok:
@@ -92,6 +94,31 @@ def read(
         autocommit_duration_ms=autocommit_duration_ms,
         name=name,
     )
+
+
+_PANDAS_WARM = False
+
+
+def _warm_pandas() -> None:
+    """Initialize pandas' arrow-string machinery on the MAIN thread.
+
+    pandas 3.0's lazy ArrowStringArray setup is not thread-safe: if its
+    first use happens on the connector reader thread the interpreter
+    segfaults (reproduced in this environment with pandas 3.0.3 +
+    pyarrow 25).  One tiny main-thread parse makes later thread use safe.
+    """
+    global _PANDAS_WARM
+    if _PANDAS_WARM:
+        return
+    try:
+        import io as _io
+
+        import pandas as pd
+
+        pd.read_csv(_io.StringIO("a\nx\n"), dtype=str)
+    except Exception:
+        pass
+    _PANDAS_WARM = True
 
 
 def _pandas_parse(path, offset, names, dtypes, csv_settings):

@@ -298,6 +298,65 @@ static PyObject *py_hash_values(PyObject *, PyObject *arg) {
   return res;
 }
 
+// sequential_keys(salt: bytes, start16: bytes, count: int) -> list[int]
+// Bulk form of engine/types.py sequential_key: key_i =
+// blake2b16(salt + le16(start + i)).  start16 is the 16-byte little-endian
+// two's-complement of the starting sequence number; the counter increments
+// at byte level so arbitrary (worker-salted, > 2^64) starts stay exact.
+static PyObject *py_sequential_keys(PyObject *, PyObject *args) {
+  const char *salt;
+  Py_ssize_t salt_len;
+  const char *start16;
+  Py_ssize_t start_len;
+  Py_ssize_t count;
+  if (!PyArg_ParseTuple(args, "y#y#n", &salt, &salt_len, &start16, &start_len,
+                        &count))
+    return nullptr;
+  if (start_len != 16) {
+    PyErr_SetString(PyExc_ValueError, "start must be 16 bytes");
+    return nullptr;
+  }
+  PyObject *out = PyList_New(count);
+  if (!out) return nullptr;
+  std::vector<uint8_t> buf(static_cast<size_t>(salt_len) + 16);
+  std::memcpy(buf.data(), salt, salt_len);
+  uint8_t ctr[16];
+  std::memcpy(ctr, start16, 16);
+  PyObject *sixtyfour = PyLong_FromLong(64);
+  for (Py_ssize_t i = 0; i < count; i++) {
+    std::memcpy(buf.data() + salt_len, ctr, 16);
+    uint8_t digest[16];
+    blake2b_hash(digest, 16, buf.data(), buf.size());
+    uint64_t lo, hi;
+    std::memcpy(&lo, digest, 8);
+    std::memcpy(&hi, digest + 8, 8);
+    PyObject *key;
+    if (hi == 0) {
+      key = PyLong_FromUnsignedLongLong(lo);
+    } else {
+      PyObject *plo = PyLong_FromUnsignedLongLong(lo);
+      PyObject *phi = PyLong_FromUnsignedLongLong(hi);
+      PyObject *shifted = phi ? PyNumber_Lshift(phi, sixtyfour) : nullptr;
+      key = (plo && shifted) ? PyNumber_Or(shifted, plo) : nullptr;
+      Py_XDECREF(plo);
+      Py_XDECREF(phi);
+      Py_XDECREF(shifted);
+    }
+    if (!key) {
+      Py_DECREF(out);
+      Py_DECREF(sixtyfour);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, i, key);
+    // little-endian increment with carry
+    for (int b = 0; b < 16; b++) {
+      if (++ctr[b] != 0) break;
+    }
+  }
+  Py_DECREF(sixtyfour);
+  return out;
+}
+
 // blake2b_128(data: bytes) -> bytes   (for tests / reuse)
 static PyObject *py_blake2b_128(PyObject *, PyObject *arg) {
   Py_buffer view;
@@ -776,6 +835,8 @@ static PyMethodDef methods[] = {
     {"decode_row", py_decode_row, METH_VARARGS, "PWT1-decode a row"},
     {"consolidate_dirty", py_consolidate_dirty, METH_O,
      "accumulate a known-dirty delta list (retractions first)"},
+    {"sequential_keys", py_sequential_keys, METH_VARARGS,
+     "bulk sequential row keys: blake2b16(salt + le16(start+i))"},
     {nullptr, nullptr, 0, nullptr}};
 
 static struct PyModuleDef moduledef = {PyModuleDef_HEAD_INIT, "_native",

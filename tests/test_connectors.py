@@ -1909,3 +1909,44 @@ def test_csv_vector_parse_duplicate_header_and_unicode_digits(tmp_path):
     row = _csv_roundtrip(tmp_path, uni, schema2, force_row_path=True)
     assert vec == row
     assert sorted(v for (v,) in vec) == [3, 7]
+
+
+def test_jsonlines_bulk_matches_row_path(tmp_path):
+    """The RawRows jsonlines path must match the with_metadata row path
+    on nested paths, Json columns, missing fields, and skipped lines."""
+    import json as _j
+
+    from pathway_tpu.engine.types import Json
+    from tests.utils import rows as engine_rows
+
+    d = tmp_path / "jin"
+    d.mkdir()
+    lines = [
+        _j.dumps({"a": 1, "meta": {"k": "x"}, "extra": [1, 2]}),
+        "",  # blank: skipped
+        "not json",  # malformed: skipped
+        _j.dumps({"a": None, "meta": {}}),  # missing nested key + extra
+        _j.dumps({"meta": {"k": "z"}, "extra": {"n": 5}}),  # missing a
+    ]
+    (d / "x.jsonl").write_text("\n".join(lines) + "\n")
+    schema = pw.schema_from_types(a=int | None, k=str | None, extra=Json | None)
+
+    def run(with_metadata):
+        pw.G.clear()
+        t = pw.io.jsonlines.read(
+            str(d),
+            schema=schema,
+            mode="static",
+            json_field_paths={"k": "/meta/k"},
+            with_metadata=with_metadata,
+        )
+        if with_metadata:
+            t = t.without(pw.this._metadata)
+        out = sorted(engine_rows(t), key=repr)
+        pw.G.clear()
+        return out
+
+    bulk = run(False)
+    row = run(True)
+    assert bulk == row
+    assert len(bulk) == 3

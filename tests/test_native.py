@@ -260,3 +260,23 @@ def test_native_consolidate_survives_self_mutating_hash():
     d.extend([evil, ("self_row", 1), 1])
     out = mod.consolidate_dirty([d, (2, ("other",), -1)])
     assert any(r == ("self_row", 1) for (_k, r, _d) in out)
+
+
+def test_sequential_keys_bulk_matches_scalar():
+    """The C bulk derivation must be bit-identical to sequential_key —
+    persistence replays and multi-worker key spaces depend on it.
+    Calls the native entry point directly so the Python fallback can
+    never make this pass vacuously."""
+    from pathway_tpu import native
+    from pathway_tpu.engine.types import _SEQ_SALT, sequential_key
+
+    mod = native.get()
+    if mod is None or not hasattr(mod, "sequential_keys"):
+        import pytest
+
+        pytest.skip("native core unavailable")
+    for start in (0, 37, (1 << 64) - 2, (3 << 64) + 255, (5 << 64) + 255):
+        bulk = mod.sequential_keys(
+            _SEQ_SALT, start.to_bytes(16, "little", signed=True), 5
+        )
+        assert bulk == [sequential_key(start + i) for i in range(5)], start
