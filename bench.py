@@ -71,10 +71,14 @@ def child() -> None:
 
     import jax
 
+    batch, iters, windows, warmup = BATCH, ITERS, WINDOWS, WARMUP
     if "--cpu" in sys.argv:
         # explicit CPU fallback run: pin BEFORE backend init (the TPU
-        # plugin force-registers itself and would hijack/hang otherwise)
+        # plugin force-registers itself and would hijack/hang otherwise),
+        # and scale the measurement down — the full TPU-sized workload
+        # takes >10 min on CPU and would blow the attempt deadline
         jax.config.update("jax_platforms", "cpu")
+        batch, iters, windows, warmup = 64, 4, 1, 1
 
     import jax.numpy as jnp
 
@@ -101,27 +105,27 @@ def child() -> None:
 
     host_rng = np.random.default_rng(0)
     ids = jnp.asarray(
-        host_rng.integers(104, cfg.vocab_size, size=(BATCH, SEQ)), jnp.int32
+        host_rng.integers(104, cfg.vocab_size, size=(batch, SEQ)), jnp.int32
     )
-    mask = jnp.ones((BATCH, SEQ), jnp.int32)
+    mask = jnp.ones((batch, SEQ), jnp.int32)
 
     # Force real materialization via a scalar D2H fetch: under the remote
     # TPU tunnel block_until_ready can return before execution finishes,
     # so timing hangs a data dependency off every iteration instead.
-    for _ in range(WARMUP):
+    for _ in range(warmup):
         float(fwd(params, ids, mask).sum())
 
     emb_per_sec = 0.0
-    for _ in range(WINDOWS):
+    for _ in range(windows):
         t0 = time.perf_counter()
         acc = None
-        for _ in range(ITERS):
+        for _ in range(iters):
             out = fwd(params, ids, mask)
             s = out.sum()
             acc = s if acc is None else acc + s
         assert np.isfinite(float(acc))  # D2H of a scalar syncs the chain
         dt = time.perf_counter() - t0
-        emb_per_sec = max(emb_per_sec, BATCH * ITERS / dt)
+        emb_per_sec = max(emb_per_sec, batch * iters / dt)
 
     kind = getattr(devs[0], "device_kind", "").lower()
     peak = DEFAULT_PEAK
@@ -133,7 +137,7 @@ def child() -> None:
     mfu = achieved / peak
 
     print(
-        f"{BATCH}x{SEQ} x{ITERS} iters in {dt:.3f}s -> {emb_per_sec:,.0f} emb/s, "
+        f"{batch}x{SEQ} x{iters} iters in {dt:.3f}s -> {emb_per_sec:,.0f} emb/s, "
         f"{achieved/1e12:.1f} TFLOP/s on '{kind}' (peak {peak/1e12:.0f}) "
         f"-> MFU {mfu:.3f}",
         file=sys.stderr,

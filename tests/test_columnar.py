@@ -21,16 +21,12 @@ N = 500  # comfortably above VEC_THRESHOLD
 
 
 def _both_modes(build):
-    results = {}
-    for label, flag in (("columnar", True), ("row", False)):
-        G.clear()
-        vc.set_enabled(flag)
-        try:
-            cap = _capture_table(build())
-            results[label] = cap.final_rows()
-        finally:
-            vc.set_enabled(True)
-        G.clear()
+    from tests.utils import run_with_vector_mode
+
+    results = {
+        label: run_with_vector_mode(build, flag)
+        for label, flag in (("columnar", True), ("row", False))
+    }
     assert results["columnar"] == results["row"]
     return results["columnar"]
 

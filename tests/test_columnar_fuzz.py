@@ -18,7 +18,7 @@ import pytest
 import pathway_tpu as pw
 from pathway_tpu.internals import vector_compiler as vc
 from pathway_tpu.io._utils import make_static_input_table
-from tests.utils import rows as engine_rows
+from tests.utils import run_with_vector_mode
 
 N = max(600, vc.VEC_THRESHOLD * 2)
 
@@ -91,13 +91,7 @@ def _norm(rows_list):
 
 
 def _run(build, columnar: bool):
-    pw.G.clear()
-    vc.set_enabled(columnar)
-    try:
-        return _norm(engine_rows(build()))
-    finally:
-        vc.set_enabled(True)
-        pw.G.clear()
+    return _norm(run_with_vector_mode(build, columnar).values())
 
 
 @pytest.mark.parametrize("seed", range(12))

@@ -112,3 +112,19 @@ def assert_snapshots(
 
 def run_all() -> None:
     pw.run()
+
+
+def run_with_vector_mode(build, columnar: bool):
+    """Run a pipeline builder with the vector compiler forced on/off,
+    restoring the default (enabled) afterwards — the one shared toggle
+    harness for columnar-vs-row parity tests."""
+    from pathway_tpu.internals import vector_compiler as vc
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    vc.set_enabled(columnar)
+    try:
+        return _capture_table(build()).final_rows()
+    finally:
+        vc.set_enabled(True)
+        G.clear()
