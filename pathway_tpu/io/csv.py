@@ -45,7 +45,21 @@ def read(
     types: dict | None = None,
     **kwargs: Any,
 ) -> Table:
-    """Read CSV file(s) into a table (reference io/csv read)."""
+    r"""Read CSV file(s) into a table (reference io/csv read).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> import os, tempfile
+    >>> d = tempfile.mkdtemp()
+    >>> with open(os.path.join(d, 'fruit.csv'), 'w') as f:
+    ...     _ = f.write('name,qty\napple,3\nplum,7\n')
+    >>> t = pw.io.csv.read(d, schema=pw.schema_from_types(name=str, qty=int), mode='static')
+    >>> pw.debug.compute_and_print(t.select(pw.this.name, double=pw.this.qty * 2), include_id=False)
+    name  | double
+    apple | 6
+    plum  | 14
+    """
     schema = _utils.schema_or_default(schema, value_columns, primary_key, dt.STR)
     # CSV cells arrive as strings; coerce into declared dtypes
     names = list(schema.__columns__.keys())

@@ -31,6 +31,21 @@ def read(
     with_metadata: bool = False,
     **kwargs: Any,
 ) -> Table:
+    r"""Read JSON Lines file(s) into a table (bulk-ingested when metadata is off).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> import os, tempfile
+    >>> d = tempfile.mkdtemp()
+    >>> with open(os.path.join(d, 'rows.jsonl'), 'w') as f:
+    ...     _ = f.write('{"k": "a", "v": 1}\n{"k": "b", "v": 2}\n')
+    >>> t = pw.io.jsonlines.read(d, schema=pw.schema_from_types(k=str, v=int), mode='static')
+    >>> pw.debug.compute_and_print(t, include_id=False)
+    k | v
+    a | 1
+    b | 2
+    """
     if schema is None:
         raise ValueError("jsonlines.read requires schema=")
     names = list(schema.__columns__.keys())
@@ -156,6 +171,19 @@ class _JsonLinesWriter:
 
 
 def write(table: Table, filename: str, *, name: str | None = None, **kwargs: Any) -> None:
+    r"""Write a table's change stream as JSON Lines (one object per delta).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> import json, tempfile, os
+    >>> out = os.path.join(tempfile.mkdtemp(), 'out.jsonl')
+    >>> t = pw.debug.table_from_markdown('x\n1\n2')
+    >>> pw.io.jsonlines.write(t.select(y=pw.this.x * 10), out)
+    >>> _ = pw.run()
+    >>> print(sorted(json.loads(l)['y'] for l in open(out)))
+    [10, 20]
+    """
     writer = _JsonLinesWriter(filename, table.column_names())
     _utils.register_output(
         table, writer.write, on_end=writer.close, name=name or f"jsonlines.write:{filename}"

@@ -156,3 +156,29 @@ def test_set_params_refreshes_fused_tree():
     enc.set_params(new_params)
     after = enc.encode(["a sentence"])
     assert not np.allclose(before, after, atol=1e-3)
+
+
+def test_encode_chunks_across_max_batch():
+    """Batches beyond max_batch split into bucketed chunks whose results
+    concatenate exactly (order preserved, no padding rows leaking)."""
+    enc = SentenceEncoder("all-MiniLM-L6-v2", max_batch=8)
+    texts = [f"sentence number {i} about topic {i % 5}" for i in range(19)]
+    full = enc.encode(texts)
+    assert full.shape == (19, 384)
+    # per-chunk equality with one-at-a-time encodes
+    for i in (0, 7, 8, 15, 18):
+        solo = enc.encode([texts[i]])[0]
+        cos = float(full[i] @ solo)
+        assert cos > 0.9999, (i, cos)
+
+
+def test_encode_mixed_lengths_bucket_by_longest():
+    enc = SentenceEncoder("all-MiniLM-L6-v2")
+    short = "hi"
+    long = " ".join(["tok"] * 120)  # crosses into the 128 seq bucket
+    both = enc.encode([short, long])
+    solo_short = enc.encode([short])[0]
+    # same text must embed identically regardless of batch companions up
+    # to padding-bucket effects; cosine must stay essentially 1
+    cos = float(both[0] @ solo_short)
+    assert cos > 0.999, cos

@@ -38,6 +38,8 @@ MODULES = [
     "pathway_tpu.internals.schema",
     "pathway_tpu.io.python",
     "pathway_tpu.stdlib.utils.async_transformer",
+    "pathway_tpu.io.csv",
+    "pathway_tpu.io.jsonlines",
 ]
 
 
@@ -62,4 +64,4 @@ def test_doctest(dtest):
 def test_doctest_coverage_floor():
     """Guard: the public API keeps a baseline of runnable examples."""
     n = sum(1 for _ in _collect())
-    assert n >= 44, f"only {n} doctests collected"
+    assert n >= 47, f"only {n} doctests collected"
