@@ -345,3 +345,31 @@ def test_multiprocess_persistence_resume(tmp_path):
     combined2 = _read_parts(tmp_path, "pcounts.jsonl")
     state2 = {json.loads(k)["word"]: json.loads(k)["n"] for k in combined2}
     assert state2 == {"foo": 3, "bar": 1, "baz": 1}, state2
+
+
+def _sort_scenario(tmpdir):
+    """Global ordering across workers: sort gathers to worker 0, and
+    prev/next neighbor lookups must reflect the CLUSTER-wide order."""
+    import pathway_tpu as pw
+    from pathway_tpu.io._utils import make_static_input_table
+
+    t = make_static_input_table(
+        pw.schema_from_types(v=int),
+        [{"v": v} for v in [30, 10, 50, 20, 40, 60, 5, 45]],
+    )
+    s = t.sort(key=pw.this.v)
+    res = t.with_columns(prev_v=t.ix(s.prev, optional=True).v)
+    pw.io.jsonlines.write(res, os.path.join(tmpdir, "sorted.jsonl"))
+
+
+def test_multiprocess_global_sort(tmp_path):
+    expected = _expected_single(_sort_scenario, str(tmp_path), "sorted.jsonl")
+    assert expected
+    _run_cluster(_sort_scenario, tmp_path)
+    combined = _read_parts(tmp_path, "sorted.jsonl")
+    assert combined == expected
+    pairs = sorted(
+        (json.loads(k)["v"], json.loads(k)["prev_v"]) for k in combined
+    )
+    want = [(5, None), (10, 5), (20, 10), (30, 20), (40, 30), (45, 40), (50, 45), (60, 50)]
+    assert pairs == want, pairs
