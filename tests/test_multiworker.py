@@ -373,3 +373,46 @@ def test_multiprocess_global_sort(tmp_path):
     )
     want = [(5, None), (10, 5), (20, 10), (30, 20), (40, 30), (45, 40), (50, 45), (60, 50)]
     assert pairs == want, pairs
+
+
+def _retrieval_scenario(tmpdir):
+    """As-of-now KNN retrieval in a cluster: docs and queries are sharded
+    across workers; the external index gathers to its owner and answers
+    must match the single-process run exactly."""
+    import pathway_tpu as pw
+    from pathway_tpu.io._utils import make_static_input_table
+    from pathway_tpu.stdlib.indexing import default_brute_force_knn_document_index
+    from pathway_tpu.xpacks.llm.mocks import FakeEmbeddings
+
+    docs = make_static_input_table(
+        pw.schema_from_types(text=str),
+        [{"text": t} for t in [
+            "alpha beta", "gamma delta", "epsilon zeta", "eta theta",
+            "iota kappa", "lambda mu",
+        ]],
+    )
+    queries = make_static_input_table(
+        pw.schema_from_types(q=str),
+        [{"q": q} for q in ["alpha beta", "eta theta", "lambda mu"]],
+    )
+    index = default_brute_force_knn_document_index(
+        docs.text, docs, embedder=FakeEmbeddings(), dimensions=16
+    )
+    res = index.query_as_of_now(queries.q, number_of_matches=1).select(
+        q=queries.q, match=pw.this.text
+    )
+    pw.io.jsonlines.write(res, os.path.join(tmpdir, "matches.jsonl"))
+
+
+def test_multiprocess_knn_retrieval(tmp_path):
+    expected = _expected_single(_retrieval_scenario, str(tmp_path), "matches.jsonl")
+    assert expected
+    _run_cluster(_retrieval_scenario, tmp_path)
+    combined = _read_parts(tmp_path, "matches.jsonl")
+    assert combined == expected
+    got = {json.loads(k)["q"]: json.loads(k)["match"] for k in combined}
+    assert got == {
+        "alpha beta": ["alpha beta"],
+        "eta theta": ["eta theta"],
+        "lambda mu": ["lambda mu"],
+    }, got

@@ -244,3 +244,41 @@ def test_split():
     pos, neg = t.split(pw.this.v > 1)
     assert_table_equality_wo_index(pos, T("v\n2\n3"))
     assert_table_equality_wo_index(neg, T("v\n1"))
+
+
+# ---------------------------------------------------------------------------
+# gradual_broadcast (engine GradualBroadcastNode; gradual_broadcast.rs analog)
+# ---------------------------------------------------------------------------
+
+
+def test_gradual_broadcast_attaches_value_and_dampens_updates():
+    """Every row carries the broadcast value; in-bounds threshold updates
+    must NOT re-emit the whole table (the operator's entire point)."""
+    rows_t = T(
+        """
+        name | _time
+        a    | 2
+        b    | 2
+        """
+    )
+    thresholds = T(
+        """
+        lo | v   | hi  | _time
+        1  | 5   | 9   | 2
+        1  | 6   | 9   | 4
+        1  | 20  | 25  | 6
+        """
+    )
+    res = rows_t._gradual_broadcast(
+        thresholds, thresholds.lo, thresholds.v, thresholds.hi
+    )
+    from tests.utils import assert_stream_consistent, snapshots_by_time
+
+    deltas = assert_stream_consistent(res)
+    snaps = snapshots_by_time(res, deltas)
+    # epoch 2: both rows carry 5
+    assert sorted(r[-1] for r in snaps[2].values()) == [5.0, 5.0]
+    # epoch 4: v=6 stays inside [1, 9] -> no deltas at t=4 (dampened)
+    assert 4 not in snaps
+    # epoch 6: v=20 leaves the band -> rows re-emit with the new value
+    assert sorted(r[-1] for r in snaps[6].values()) == [20.0, 20.0]
