@@ -282,3 +282,33 @@ def test_gradual_broadcast_attaches_value_and_dampens_updates():
     assert 4 not in snaps
     # epoch 6: v=20 leaves the band -> rows re-emit with the new value
     assert sorted(r[-1] for r in snaps[6].values()) == [20.0, 20.0]
+
+
+# ---------------------------------------------------------------------------
+# universe promises (pw.universes; universe_solver parity)
+# ---------------------------------------------------------------------------
+
+
+def test_universe_promise_enables_cross_table_select():
+    from tests.utils import rows
+    a = T("k | x\n1 | 10\n2 | 20", id_from=["k"])
+    b = T("k | y\n1 | 7\n2 | 9", id_from=["k"])
+    # same keys but distinct universes: cross-table select must be refused
+    # (the check fires at lowering time, i.e. when the graph runs)
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="different universe"):
+        rows(a.select(pw.this.x, b.y))
+    # after the promise, the same select works and aligns rows by key
+    pw.universes.promise_are_equal(a, b)
+    res = a.select(pw.this.x, b.y)
+    assert rows(res) == [(10, 7), (20, 9)]
+
+
+def test_universe_subset_promise_for_restrict():
+    from tests.utils import rows
+    big = T("k | x\n1 | 10\n2 | 20\n3 | 30", id_from=["k"])
+    small = T("k | y\n1 | 1\n3 | 3", id_from=["k"])
+    pw.universes.promise_is_subset_of(small, big)
+    res = big.restrict(small)
+    assert rows(res) == [(1, 10), (3, 30)]
