@@ -1,0 +1,118 @@
+"""Coverage fill for modules the symbol sweep found untested: the LSH
+classifier, fuzzy table matching, llm parsers, chat prompt helpers, and
+the sqlite connector."""
+
+from __future__ import annotations
+
+import sqlite3
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.io._utils import make_static_input_table
+from tests.utils import T, rows
+
+
+# ---------------------------------------------------------------------------
+# stdlib.ml.classifiers — LSH KNN classifier (ml/index.py + classifiers)
+# ---------------------------------------------------------------------------
+
+
+def test_knn_lsh_classifier_labels_queries():
+    from pathway_tpu.stdlib.ml.classifiers import knn_lsh_classifier_train
+
+    data = make_static_input_table(
+        pw.schema_from_types(data=tuple, label=str),
+        [
+            {"data": (0.0, 0.1), "label": "low"},
+            {"data": (0.1, 0.0), "label": "low"},
+            {"data": (5.0, 5.1), "label": "high"},
+            {"data": (5.1, 5.0), "label": "high"},
+        ],
+    )
+    classify = knn_lsh_classifier_train(data, L=4, d=2)
+    queries = make_static_input_table(
+        pw.schema_from_types(data=tuple),
+        [{"data": (0.05, 0.05)}, {"data": (5.05, 5.05)}],
+    )
+    labeled = classify(data, queries, k=2)
+    got = sorted(r[-1] for r in rows(labeled))
+    assert got == ["high", "low"], got
+
+
+# ---------------------------------------------------------------------------
+# stdlib.ml.smart_table_ops — fuzzy join
+# ---------------------------------------------------------------------------
+
+
+def test_fuzzy_match_tables_pairs_similar_names():
+    from pathway_tpu.stdlib.ml.smart_table_ops import fuzzy_match_tables
+
+    left = T("name\nAlice Cooper\nBob Marley\nCarol King")
+    right = T("name\nalice cooper\nbob marley\nunrelated person")
+    matches = fuzzy_match_tables(left, right)
+    got = rows(matches)  # (left_ptr, right_ptr, shared-token weight)
+    weights = sorted(r[2] for r in got)
+    # the two case-insensitive name pairs share both tokens; Carol shares
+    # none with any right row, so exactly two weight-2 matches exist
+    assert weights == [2, 2], got
+
+
+# ---------------------------------------------------------------------------
+# xpacks.llm.parsers / llms prompt helper
+# ---------------------------------------------------------------------------
+
+
+def test_parse_utf8_and_json():
+    from pathway_tpu.engine.types import Json
+    from pathway_tpu.xpacks.llm.parsers import ParseJson, ParseUtf8
+
+    out = ParseUtf8().__wrapped__(b"hello doc")
+    assert out[0][0] == "hello doc"
+    jout = ParseJson().__wrapped__(b'{"text": "body", "k": 1}')
+    assert jout[0][0] == "body"
+    assert isinstance(jout[0][1], (dict, Json))
+
+
+def test_messages_to_prompt_and_single_qa():
+    from pathway_tpu.xpacks.llm.llms import _messages_to_prompt, prompt_chat_single_qa
+
+    p = _messages_to_prompt(
+        [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi"},
+        ]
+    )
+    assert "be brief" in p and "hi" in p
+    t = T("q\nwhat_is_up")
+    r = t.select(msgs=prompt_chat_single_qa(pw.this.q))
+    (row,) = rows(r)
+    content = str(row[0])
+    assert "what_is_up" in content
+
+
+# ---------------------------------------------------------------------------
+# io.sqlite
+# ---------------------------------------------------------------------------
+
+
+def test_sqlite_read_static(tmp_path):
+    db = tmp_path / "t.db"
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE items (name TEXT, qty INTEGER)")
+    conn.executemany(
+        "INSERT INTO items VALUES (?, ?)", [("apple", 3), ("plum", 7)]
+    )
+    conn.commit()
+    conn.close()
+
+    t = pw.io.sqlite.read(
+        str(db),
+        table_name="items",
+        schema=pw.schema_from_types(name=str, qty=int),
+        mode="static",
+    )
+    assert rows(t.select(pw.this.name, pw.this.qty)) == [
+        ("apple", 3),
+        ("plum", 7),
+    ]
