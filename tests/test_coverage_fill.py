@@ -116,3 +116,65 @@ def test_sqlite_read_static(tmp_path):
         ("apple", 3),
         ("plum", 7),
     ]
+
+
+# ---------------------------------------------------------------------------
+# utils.batching — AsyncMicroBatcher (the streaming -> device bridge)
+# ---------------------------------------------------------------------------
+
+
+def test_async_micro_batcher_coalesces_concurrent_submissions():
+    import asyncio
+
+    from pathway_tpu.utils.batching import AsyncMicroBatcher
+
+    batch_sizes = []
+
+    def process(items):
+        batch_sizes.append(len(items))
+        return [x * 10 for x in items]
+
+    batcher = AsyncMicroBatcher(process, max_batch_size=64, flush_delay=0.001)
+
+    async def main():
+        return await asyncio.gather(*(batcher.submit(i) for i in range(50)))
+
+    results = asyncio.run(main())
+    assert results == [i * 10 for i in range(50)]
+    # concurrent submissions coalesced into far fewer process calls
+    assert len(batch_sizes) <= 3, batch_sizes
+    assert max(batch_sizes) >= 40, batch_sizes
+
+
+def test_async_micro_batcher_propagates_batch_errors():
+    import asyncio
+
+    from pathway_tpu.utils.batching import AsyncMicroBatcher
+
+    def process(items):
+        raise RuntimeError("device fell over")
+
+    batcher = AsyncMicroBatcher(process, max_batch_size=8, flush_delay=0.001)
+
+    async def main():
+        with pytest.raises(RuntimeError, match="device fell over"):
+            await asyncio.gather(batcher.submit(1), batcher.submit(2))
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# pw.table_transformer — schema-validating decorator
+# ---------------------------------------------------------------------------
+
+
+def test_table_transformer_validates_schemas():
+    class In(pw.Schema):
+        x: int
+
+    @pw.table_transformer
+    def double(t: pw.Table[In]) -> pw.Table:
+        return t.select(y=pw.this.x * 2)
+
+    t = T("x\n1\n2")
+    assert rows(double(t)) == [(2,), (4,)]
