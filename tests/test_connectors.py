@@ -1950,3 +1950,22 @@ def test_jsonlines_bulk_matches_row_path(tmp_path):
     row = run(True)
     assert bulk == row
     assert len(bulk) == 3
+
+
+def test_s3_csv_read_static(mock_s3):
+    """pw.io.s3_csv — the csv-specialized S3 reader over SigV4 REST."""
+    MockS3Handler.objects = {
+        "data/a.csv": b"name,qty\napple,3\nplum,7\n",
+    }
+    pw.G.clear()
+    t = pw.io.s3_csv.read(
+        "s3://bkt/data/",
+        aws_s3_settings=_s3_settings(mock_s3),
+        schema=pw.schema_from_types(name=str, qty=int),
+        mode="static",
+    )
+    from tests.utils import rows
+
+    got = rows(t.select(pw.this.name, pw.this.qty))
+    assert got == [("apple", 3), ("plum", 7)], got
+    pw.G.clear()
