@@ -178,3 +178,24 @@ def test_table_transformer_validates_schemas():
 
     t = T("x\n1\n2")
     assert rows(double(t)) == [(2,), (4,)]
+
+
+# ---------------------------------------------------------------------------
+# pw.io.fs — binary and plaintext_by_file formats
+# ---------------------------------------------------------------------------
+
+
+def test_fs_binary_and_plaintext_by_file(tmp_path):
+    d = tmp_path / "files"
+    d.mkdir()
+    (d / "a.bin").write_bytes(b"\x00\x01payload")
+    (d / "b.bin").write_bytes(b"other")
+
+    t = pw.io.fs.read(str(d), format="binary", mode="static")
+    got = sorted(r[0] for r in rows(t.select(pw.this.data)))
+    assert got == [b"\x00\x01payload", b"other"]
+
+    pw.G.clear()
+    t2 = pw.io.fs.read(str(d), format="plaintext_by_file", mode="static")
+    got2 = sorted(r[0] for r in rows(t2.select(pw.this.data)))
+    assert len(got2) == 2 and all(isinstance(v, str) for v in got2)
