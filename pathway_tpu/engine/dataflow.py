@@ -993,6 +993,10 @@ class GroupByNode(Node):
         if cols is None:
             return False
         garr = cols[gidx]
+        # NaN group keys: np.unique collapses all NaNs into one group while
+        # the row path's dict keeps one group per NaN object — bail
+        if garr.dtype.kind == "f" and np.isnan(garr).any():
+            return False
         val_arrs = [
             None if kind == "count" else cols[vidx] for kind, vidx in red_cols
         ]
@@ -1000,6 +1004,11 @@ class GroupByNode(Node):
             # sums need numeric columns; min/max works on any materialized
             # dtype (incl. str) since it only groups and counts
             if kind == "sum" and varr.dtype.kind not in "bif":
+                return False
+            # NaN breaks the mm multiset grouping: np.unique collapses all
+            # NaNs into one entry while the row path's Counter keeps one
+            # entry per object — bail to the row path to keep parity
+            if kind == "mm" and varr.dtype.kind == "f" and np.isnan(varr).any():
                 return False
         diffs = np.asarray([d for (_, _, d) in deltas], np.int64)
         max_diff = vc._abs_bound(diffs)

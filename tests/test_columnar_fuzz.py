@@ -131,6 +131,68 @@ def test_random_groupby_parity(seed):
 
 
 @pytest.mark.parametrize("seed", range(4))
+def test_nan_min_max_groupby_parity(seed):
+    """NaN-bearing float columns must not diverge under columnar min/max.
+
+    np.unique collapses all NaNs into one multiset entry while the row
+    path's Counter keeps one per object; the columnar path must bail to
+    the row path in that case (a group containing NaN reduces to
+    (nan, nan) for min/max, not the finite extremes).
+    """
+    rng = random.Random(3000 + seed)
+    schema = pw.schema_from_types(g=int, f=float)
+    data = [
+        {
+            "g": rng.randrange(0, 5),
+            "f": float("nan") if rng.random() < 0.1 else rng.uniform(-50, 50),
+        }
+        for _ in range(N)
+    ]
+    # make sure at least one group definitely contains a NaN
+    data[0] = {"g": 0, "f": float("nan")}
+    data[1] = {"g": 0, "f": 2.0}
+    data[2] = {"g": 0, "f": 48.0}
+
+    def build():
+        t = make_static_input_table(schema, data)
+        return t.groupby(pw.this.g).reduce(
+            g=pw.this.g,
+            lo=pw.reducers.min(pw.this.f),
+            hi=pw.reducers.max(pw.this.f),
+            n=pw.reducers.count(),
+        )
+
+    assert _run(build, True) == _run(build, False), f"seed={seed}"
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_nan_group_key_parity(seed):
+    """NaN in the GROUP-KEY column must not diverge: np.unique merges all
+    NaN keys into one group while the row path keeps one group per NaN
+    object, so the columnar path must bail."""
+    rng = random.Random(4000 + seed)
+    schema = pw.schema_from_types(f=float, i=int)
+    data = [
+        {
+            "f": float("nan") if rng.random() < 0.1 else float(rng.randrange(0, 5)),
+            "i": rng.randrange(-20, 20),
+        }
+        for _ in range(N)
+    ]
+    data[0]["f"] = float("nan")
+    data[1]["f"] = float("nan")
+
+    def build():
+        t = make_static_input_table(schema, data)
+        return t.groupby(pw.this.f).reduce(
+            n=pw.reducers.count(),
+            tot=pw.reducers.sum(pw.this.i),
+        )
+
+    assert _run(build, True) == _run(build, False), f"seed={seed}"
+
+
+@pytest.mark.parametrize("seed", range(4))
 def test_random_optional_columns_parity(seed):
     """None-bearing columns force the row path; results must still agree."""
     rng = random.Random(2000 + seed)

@@ -1453,7 +1453,15 @@ def test_airbyte_multi_stream_state_accumulates():
 
 class MockAzuriteHandler(http.server.BaseHTTPRequestHandler):
     """Just enough of the Blob service for the persistence backend: PUT/GET/
-    DELETE blob and List Blobs, routed as /<account>/<container>/<blob>."""
+    DELETE blob and List Blobs, routed as /<account>/<container>/<blob>.
+
+    Verifies every SharedKey signature against the known account key by
+    recomputing the HMAC from the received request per the Authorize-with-
+    Shared-Key spec (2015-02-21+ rules), so client canonicalization bugs
+    fail here as 403s instead of only against real Azure."""
+
+    ACCOUNT = "acct"
+    KEY = b"secret"  # base64 of this is what the tests hand the client
 
     blobs: dict = {}
     auth_headers: list = []
@@ -1466,14 +1474,70 @@ class MockAzuriteHandler(http.server.BaseHTTPRequestHandler):
         parts = path.lstrip("/").split("/", 2)  # account/container/blob
         return urllib.parse.unquote(parts[2]) if len(parts) > 2 else ""
 
+    def _expected_auth(self, verb: str) -> str:
+        import base64
+        import hashlib
+        import hmac
+
+        parsed = urllib.parse.urlparse(self.path)
+        xms = sorted(
+            (k.lower(), v.strip())
+            for k, v in self.headers.items()
+            if k.lower().startswith("x-ms-")
+        )
+        canon_headers = "".join(f"{k}:{v}\n" for k, v in xms)
+        canon_res = f"/{self.ACCOUNT}{parsed.path}"
+        q = urllib.parse.parse_qs(parsed.query, keep_blank_values=True)
+        for k in sorted(q, key=str.lower):
+            canon_res += f"\n{k.lower()}:{','.join(sorted(q[k]))}"
+        length = self.headers.get("Content-Length", "")
+        if length == "0":
+            length = ""  # 2015-02-21+: zero-length bodies sign as empty
+        to_sign = "\n".join(
+            [
+                verb,
+                self.headers.get("Content-Encoding", ""),
+                self.headers.get("Content-Language", ""),
+                length,
+                self.headers.get("Content-MD5", ""),
+                self.headers.get("Content-Type", ""),
+                "",  # Date is empty when x-ms-date is present
+                self.headers.get("If-Modified-Since", ""),
+                self.headers.get("If-Match", ""),
+                self.headers.get("If-None-Match", ""),
+                self.headers.get("If-Unmodified-Since", ""),
+                self.headers.get("Range", ""),
+                canon_headers + canon_res,
+            ]
+        )
+        sig = base64.b64encode(
+            hmac.new(self.KEY, to_sign.encode(), hashlib.sha256).digest()
+        ).decode()
+        return f"SharedKey {self.ACCOUNT}:{sig}"
+
+    def _check_auth(self, verb: str) -> bool:
+        got = self.headers.get("Authorization", "")
+        self.auth_headers.append(got)
+        if got != self._expected_auth(verb):
+            body = b"<Error><Code>AuthenticationFailed</Code></Error>"
+            self.send_response(403)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return False
+        return True
+
     def do_PUT(self):
-        self.auth_headers.append(self.headers.get("Authorization", ""))
+        if not self._check_auth("PUT"):
+            return
         ln = int(self.headers.get("Content-Length", 0))
         MockAzuriteHandler.blobs[self._blob()] = self.rfile.read(ln)
         self.send_response(201)
         self.end_headers()
 
     def do_DELETE(self):
+        if not self._check_auth("DELETE"):
+            return
         if self._blob() in MockAzuriteHandler.blobs:
             del MockAzuriteHandler.blobs[self._blob()]
             self.send_response(202)
@@ -1482,6 +1546,8 @@ class MockAzuriteHandler(http.server.BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_GET(self):
+        if not self._check_auth("GET"):
+            return
         q = urllib.parse.parse_qs(urllib.parse.urlparse(self.path).query)
         if q.get("comp") == ["list"]:
             prefix = q.get("prefix", [""])[0]
@@ -1540,6 +1606,23 @@ def test_azure_blob_client_and_backend(mock_azurite):
     # every request carried a SharedKey signature
     assert MockAzuriteHandler.auth_headers
     assert all(h.startswith("SharedKey acct:") for h in MockAzuriteHandler.auth_headers)
+
+
+def test_azure_blob_bad_key_rejected(mock_azurite):
+    """The mock recomputes the HMAC, so a wrong account key must 403."""
+    import base64
+
+    from pathway_tpu.io._azureblob import AzureBlobClient, AzureBlobError
+
+    client = AzureBlobClient(
+        "acct",
+        "cont",
+        account_key=base64.b64encode(b"wrong-key").decode(),
+        endpoint=mock_azurite,
+    )
+    with pytest.raises(AzureBlobError) as ei:
+        client.put_blob("x", b"data")
+    assert ei.value.status == 403
 
 
 def test_azure_persistence_crash_resume(mock_azurite, tmp_path):
