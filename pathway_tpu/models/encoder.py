@@ -49,23 +49,60 @@ class EncoderConfig:
     intermediate: int = 1536
     max_len: int = 512
     dtype: Any = jnp.bfloat16
+    # sentence-embedding pooling: "mean" (MiniLM family) or "cls" (BGE
+    # family) — mirrors the pooling module sentence-transformers reads
+    # from the checkpoint (reference embedders.py:270 delegates to it)
+    pooling: str = "mean"
 
 
 PRESETS: dict[str, EncoderConfig] = {
     "all-MiniLM-L6-v2": EncoderConfig(),
     "sentence-transformers/all-MiniLM-L6-v2": EncoderConfig(),
-    "BAAI/bge-base-en-v1.5": EncoderConfig(hidden=768, layers=12, intermediate=3072),
-    "bge-base-en-v1.5": EncoderConfig(hidden=768, layers=12, intermediate=3072),
-    "BAAI/bge-small-en-v1.5": EncoderConfig(),
+    "BAAI/bge-base-en-v1.5": EncoderConfig(
+        hidden=768, layers=12, intermediate=3072, pooling="cls"
+    ),
+    "bge-base-en-v1.5": EncoderConfig(
+        hidden=768, layers=12, intermediate=3072, pooling="cls"
+    ),
+    "BAAI/bge-small-en-v1.5": EncoderConfig(layers=12, pooling="cls"),
     "cross-encoder/ms-marco-MiniLM-L-6-v2": EncoderConfig(),
     "mixedbread-ai/mxbai-embed-large-v1": EncoderConfig(
-        hidden=1024, layers=24, heads=16, intermediate=4096
+        hidden=1024, layers=24, heads=16, intermediate=4096, pooling="cls"
     ),
 }
 
 
 def config_for(model_name: str) -> EncoderConfig:
-    return PRESETS.get(model_name, EncoderConfig())
+    """Preset lookup, or — for a local checkpoint directory — the shape
+    read from its ``config.json`` (any BERT-family ``transformers`` save),
+    with the pooling mode taken from a sentence-transformers ``1_Pooling``
+    module config when one is present."""
+    import json
+    import os
+
+    if model_name in PRESETS:
+        return PRESETS[model_name]
+    cfg_path = os.path.join(model_name, "config.json")
+    if os.path.isfile(cfg_path):
+        with open(cfg_path) as f:
+            hf = json.load(f)
+        pooling = "mean"
+        pool_path = os.path.join(model_name, "1_Pooling", "config.json")
+        if os.path.isfile(pool_path):
+            with open(pool_path) as f:
+                pool_cfg = json.load(f)
+            if pool_cfg.get("pooling_mode_cls_token"):
+                pooling = "cls"
+        return EncoderConfig(
+            vocab_size=hf.get("vocab_size", 30522),
+            hidden=hf.get("hidden_size", 384),
+            layers=hf.get("num_hidden_layers", 6),
+            heads=hf.get("num_attention_heads", 12),
+            intermediate=hf.get("intermediate_size", 1536),
+            max_len=hf.get("max_position_embeddings", 512),
+            pooling=pooling,
+        )
+    return EncoderConfig()
 
 
 class TransformerBlock(nn.Module):
@@ -80,11 +117,14 @@ class TransformerBlock(nn.Module):
             dtype=cfg.dtype,
             deterministic=True,
         )(x, x, mask=mask)
-        x = nn.LayerNorm(dtype=cfg.dtype)(x + attn_out)
+        # exact (erf) gelu and 1e-12 LN eps match BERT-family checkpoints;
+        # the module tree is the numerical source of truth the golden
+        # parity suite checks against torch (tests/test_model_parity.py)
+        x = nn.LayerNorm(dtype=cfg.dtype, epsilon=1e-12)(x + attn_out)
         h = nn.Dense(cfg.intermediate, dtype=cfg.dtype)(x)
-        h = nn.gelu(h)
+        h = nn.gelu(h, approximate=False)
         h = nn.Dense(cfg.hidden, dtype=cfg.dtype)(h)
-        return nn.LayerNorm(dtype=cfg.dtype)(x + h)
+        return nn.LayerNorm(dtype=cfg.dtype, epsilon=1e-12)(x + h)
 
 
 class Encoder(nn.Module):
@@ -98,7 +138,7 @@ class Encoder(nn.Module):
         positions = jnp.arange(input_ids.shape[1])[None, :]
         tok = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype)(input_ids)
         pos = nn.Embed(cfg.max_len, cfg.hidden, dtype=cfg.dtype)(positions)
-        x = nn.LayerNorm(dtype=cfg.dtype)(tok + pos)
+        x = nn.LayerNorm(dtype=cfg.dtype, epsilon=1e-12)(tok + pos)
         # [batch, 1, 1, seq] additive-style boolean mask for attention
         attn_mask = attention_mask[:, None, None, :].astype(bool)
         for _ in range(cfg.layers):
@@ -106,17 +146,24 @@ class Encoder(nn.Module):
         return x
 
 
+def _pool(x, attention_mask, pooling: str):
+    """Masked mean or CLS pooling of token reps ``[B, S, H]`` → f32 [B, H]."""
+    if pooling == "cls":
+        return x[:, 0, :].astype(jnp.float32)
+    m = attention_mask[:, :, None].astype(x.dtype)
+    pooled = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
+    return pooled.astype(jnp.float32)
+
+
 class SentenceEncoderModule(nn.Module):
-    """Trunk + masked mean pooling + L2 normalization → sentence embedding."""
+    """Trunk + masked pooling + L2 normalization → sentence embedding."""
 
     config: EncoderConfig
 
     @nn.compact
     def __call__(self, input_ids, attention_mask):
         x = Encoder(self.config)(input_ids, attention_mask)
-        m = attention_mask[:, :, None].astype(x.dtype)
-        pooled = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
-        pooled = pooled.astype(jnp.float32)
+        pooled = _pool(x, attention_mask, self.config.pooling)
         return pooled / (jnp.linalg.norm(pooled, axis=1, keepdims=True) + 1e-12)
 
 
@@ -256,9 +303,7 @@ def fused_trunk(tree, input_ids, attention_mask, config: EncoderConfig, *, inter
 def fused_sentence_apply(tree, input_ids, attention_mask, config: EncoderConfig, *, interpret=False):
     """Fused equivalent of ``SentenceEncoderModule.apply``."""
     x = fused_trunk(tree, input_ids, attention_mask, config, interpret=interpret)
-    m = attention_mask[:, :, None].astype(x.dtype)
-    pooled = jnp.sum(x * m, axis=1) / jnp.maximum(jnp.sum(m, axis=1), 1.0)
-    pooled = pooled.astype(jnp.float32)
+    pooled = _pool(x, attention_mask, config.pooling)
     return pooled / (jnp.linalg.norm(pooled, axis=1, keepdims=True) + 1e-12)
 
 
@@ -277,20 +322,49 @@ def load_hf_weights(model_name: str, params, config: EncoderConfig):
     checkpoint exists (zero-egress environments keep random init).
 
     Token-type embeddings (always type 0 here) are folded into the word
-    embedding table so the architectures match exactly.
+    embedding table so the architectures match exactly.  Cross-encoder
+    trees (scoring head at the tree root) load through
+    ``AutoModelForSequenceClassification`` so the pooler + classifier map
+    onto the head denses (matching the reference's CrossEncoder,
+    ``xpacks/llm/rerankers.py:58``).
     """
     import os
 
     os.environ.setdefault("HF_HUB_OFFLINE", "1")
+    tree_root = params["params"]
+    has_head = "Dense_0" in tree_root and "Encoder_0" in tree_root
     try:
-        from transformers import AutoModel  # noqa: PLC0415
+        if has_head:
+            from transformers import AutoModelForSequenceClassification
 
-        hf = AutoModel.from_pretrained(model_name, local_files_only=True)
+            hf = AutoModelForSequenceClassification.from_pretrained(
+                model_name, local_files_only=True
+            )
+        else:
+            from transformers import AutoModel  # noqa: PLC0415
+
+            hf = AutoModel.from_pretrained(model_name, local_files_only=True)
     except Exception:
         return None
 
     sd = {k: v.detach().cpu().numpy() for k, v in hf.state_dict().items()}
+    # *ForSequenceClassification prefixes the trunk with the model type
+    sd = {
+        (k[5:] if k.startswith("bert.") else k): v for k, v in sd.items()
+    }
     prefix = "encoder." if any(k.startswith("encoder.layer") for k in sd) else ""
+    # the checkpoint's layer count must match the config exactly: mapping
+    # only a prefix of a deeper trunk would silently truncate the model
+    ckpt_layers = 1 + max(
+        (
+            int(k.split("layer.")[1].split(".")[0])
+            for k in sd
+            if "layer." in k
+        ),
+        default=-1,
+    )
+    if ckpt_layers != config.layers:
+        return None
     h, heads = config.hidden, config.heads
     hd = h // heads
 
@@ -339,6 +413,11 @@ def load_hf_weights(model_name: str, params, config: EncoderConfig):
             put(blk + ["Dense_1", "bias"], sd[f"{lp}output.dense.bias"])
             put(blk + ["LayerNorm_1", "scale"], sd[f"{lp}output.LayerNorm.weight"])
             put(blk + ["LayerNorm_1", "bias"], sd[f"{lp}output.LayerNorm.bias"])
+        if has_head and "classifier.weight" in sd:
+            put(["Dense_0", "kernel"], sd["pooler.dense.weight"].T)
+            put(["Dense_0", "bias"], sd["pooler.dense.bias"])
+            put(["Dense_1", "kernel"], sd["classifier.weight"].T)
+            put(["Dense_1", "bias"], sd["classifier.bias"])
     except (KeyError, ValueError):
         return None
     return new_params

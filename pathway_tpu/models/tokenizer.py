@@ -53,13 +53,14 @@ class HashTokenizer:
 
 
 def load_tokenizer(model_name: str, vocab_size: int, max_length: int) -> Any:
-    """HF tokenizer if cached locally, else the hashing stand-in."""
+    """HF tokenizer if ``model_name`` is a local checkpoint directory or is
+    present in the local HF cache; else the hashing stand-in."""
     import os
 
     cache = os.path.expanduser(
         os.environ.get("HF_HOME", "~/.cache/huggingface")
     )
-    if not os.path.isdir(cache):
+    if not os.path.isdir(cache) and not os.path.isdir(model_name):
         # no local model cache: skip the (slow) transformers import entirely
         return HashTokenizer(vocab_size=vocab_size, max_length=max_length)
     try:
