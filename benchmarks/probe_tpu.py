@@ -1,0 +1,45 @@
+"""Cheap TPU-tunnel liveness probe.
+
+The tunnel's failure mode is a HANG at backend init (not an error), so the
+check runs in a killable child with a hard deadline.  Exit 0 = a real TPU
+chip answered a tiny computation; exit 1 = tunnel down/hung.
+
+Usage: python benchmarks/probe_tpu.py [deadline_seconds]
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+CHILD_CODE = """
+import jax
+devs = jax.devices()
+assert devs[0].platform == "tpu", devs
+import jax.numpy as jnp
+assert float(jnp.ones((8, 8)).sum()) == 64.0
+print("tpu-ok", devs[0].device_kind)
+"""
+
+
+def main() -> int:
+    deadline = float(sys.argv[1]) if len(sys.argv) > 1 else 90.0
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", CHILD_CODE],
+            capture_output=True,
+            text=True,
+            timeout=deadline,
+        )
+    except subprocess.TimeoutExpired:
+        print("tpu-down: backend init hung", file=sys.stderr)
+        return 1
+    if proc.returncode == 0 and "tpu-ok" in proc.stdout:
+        print(proc.stdout.strip())
+        return 0
+    print(f"tpu-down: rc={proc.returncode} {proc.stderr[-300:]}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

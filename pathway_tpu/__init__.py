@@ -129,6 +129,11 @@ from pathway_tpu.stdlib.temporal import windowby  # noqa: E402
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
 from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer  # noqa: E402
 from pathway_tpu.internals.iterate import iterate, iterate_universe  # noqa: E402
+from pathway_tpu.internals.export_import import (  # noqa: E402
+    ExportedTable,
+    export_table,
+    import_table,
+)
 from pathway_tpu.internals.sql import sql  # noqa: E402
 from pathway_tpu.internals import universes  # noqa: E402
 from pathway_tpu.internals.errors import global_error_log, local_error_log  # noqa: E402

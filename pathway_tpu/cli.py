@@ -15,6 +15,7 @@ accepted for parity but the device mesh is what actually scales compute.
 from __future__ import annotations
 
 import os
+import secrets
 import subprocess
 import sys
 import uuid
@@ -61,6 +62,11 @@ def spawn_program(
         err=True,
     )
     run_id = str(uuid.uuid4())
+    # every worker must hold the same mesh handshake secret
+    # (engine/comm.py); honor a deployment-provided one, else mint one
+    # for this run
+    env_base = dict(env_base)
+    env_base.setdefault("PATHWAY_COMM_SECRET", secrets.token_hex(16))
     handles: list[subprocess.Popen] = []
     try:
         # spawn inside the try: a mid-spawn failure (EAGAIN, missing

@@ -51,8 +51,10 @@ _T_DATETIME_NAIVE = 12  # microseconds since epoch, 8-byte signed
 _T_DATETIME_UTC = 13
 _T_DURATION = 14  # microseconds, 8-byte signed
 _T_ERROR = 15
-_T_PYOBJECT = 16  # pickled
+_T_PYOBJECT = 16  # pickled (opaque fallback; decodes to the raw object)
 _T_DATE = 17
+_T_LIST = 18  # same layout as _T_TUPLE; decodes back to a list
+_T_PYOBJECT_WRAPPED = 19  # pickled PyObjectWrapper.value; re-wrapped on decode
 
 _U64 = struct.Struct("<Q")
 _I64 = struct.Struct("<q")
@@ -97,8 +99,12 @@ def encode_value(v: Any, out: _io.BytesIO) -> None:
     elif isinstance(v, Pointer):
         out.write(bytes([_T_POINTER]))
         out.write(v.value.to_bytes(16, "little"))
-    elif isinstance(v, tuple):
-        out.write(bytes([_T_TUPLE]))
+    elif isinstance(v, (tuple, list)):
+        # lists get their own tag (same layout) so they round-trip as
+        # lists: falling to the pickle tail would make delta buckets
+        # opaque on the comm wire, and decoding them as tuples would make
+        # value shapes differ between local and exchanged rows
+        out.write(bytes([_T_TUPLE if isinstance(v, tuple) else _T_LIST]))
         _w_len(out, len(v))
         for item in v:
             encode_value(item, out)
@@ -137,8 +143,10 @@ def encode_value(v: Any, out: _io.BytesIO) -> None:
     elif isinstance(v, Error):
         out.write(bytes([_T_ERROR]))
     elif isinstance(v, PyObjectWrapper):
+        # distinct tag so decode re-wraps: wrapper equality must survive a
+        # round trip (an exchanged retraction has to cancel a local insert)
         b = pickle.dumps(v.value)
-        out.write(bytes([_T_PYOBJECT]))
+        out.write(bytes([_T_PYOBJECT_WRAPPED]))
         _w_len(out, len(b))
         out.write(b)
     else:  # last resort: opaque pickle (keeps UDF-produced objects alive)
@@ -160,9 +168,17 @@ def _take(buf: memoryview, pos: int, n: int) -> tuple[memoryview, int]:
     return buf[pos : pos + n], pos + n
 
 
-def decode_value(buf: memoryview, pos: int) -> tuple[Any, int]:
+def decode_value(
+    buf: memoryview, pos: int, *, allow_pyobject: bool = True
+) -> tuple[Any, int]:
     tag = buf[pos]
     pos += 1
+    if tag in (_T_PYOBJECT, _T_PYOBJECT_WRAPPED) and not allow_pyobject:
+        raise ValueError(
+            "codec: python-object (pickled) value refused by typed-only "
+            "decode — on the comm mesh this means a PyObjectWrapper row "
+            "crossed an unauthenticated link; set PATHWAY_COMM_SECRET"
+        )
     if tag == _T_NONE:
         return None, pos
     if tag == _T_TRUE:
@@ -188,13 +204,13 @@ def decode_value(buf: memoryview, pos: int) -> tuple[Any, int]:
     if tag == _T_POINTER:
         b, pos = _take(buf, pos, 16)
         return Pointer(int.from_bytes(b, "little")), pos
-    if tag == _T_TUPLE:
+    if tag in (_T_TUPLE, _T_LIST):
         n, pos = _r_len(buf, pos)
         items = []
         for _ in range(n):
-            item, pos = decode_value(buf, pos)
+            item, pos = decode_value(buf, pos, allow_pyobject=allow_pyobject)
             items.append(item)
-        return tuple(items), pos
+        return (tuple(items) if tag == _T_TUPLE else items), pos
     if tag == _T_NDARRAY:
         n, pos = _r_len(buf, pos)
         b, pos = _take(buf, pos, n)
@@ -229,6 +245,10 @@ def decode_value(buf: memoryview, pos: int) -> tuple[Any, int]:
         n, pos = _r_len(buf, pos)
         b, pos = _take(buf, pos, n)
         return pickle.loads(bytes(b)), pos
+    if tag == _T_PYOBJECT_WRAPPED:
+        n, pos = _r_len(buf, pos)
+        b, pos = _take(buf, pos, n)
+        return PyObjectWrapper(pickle.loads(bytes(b))), pos
     raise ValueError(f"codec: unknown value tag {tag}")
 
 
@@ -241,13 +261,15 @@ def encode_row_py(values: Iterable[Any]) -> bytes:
     return out.getvalue()
 
 
-def decode_row_py(data: bytes | memoryview, pos: int = 0) -> tuple[tuple, int]:
+def decode_row_py(
+    data: bytes | memoryview, pos: int = 0, *, allow_pyobject: bool = True
+) -> tuple[tuple, int]:
     buf = memoryview(data)
     try:
         n, pos = _r_len(buf, pos)
         items = []
         for _ in range(n):
-            item, pos = decode_value(buf, pos)
+            item, pos = decode_value(buf, pos, allow_pyobject=allow_pyobject)
             items.append(item)
     except ValueError:
         raise
@@ -279,6 +301,16 @@ def decode_row(data: bytes | memoryview, pos: int = 0) -> tuple[tuple, int]:
     if native is not None:
         return native.decode_row(data, pos)
     return decode_row_py(data, pos)
+
+
+def decode_row_typed(data: bytes | memoryview, pos: int = 0) -> tuple[tuple, int]:
+    """Typed-only decode: raises ValueError on pickled (PYOBJECT) values.
+
+    Used by the comm mesh for links without a handshake secret, where a
+    pickle payload from the network would be arbitrary code execution.
+    Always the Python decoder — the native one has no refusal hook.
+    """
+    return decode_row_py(data, pos, allow_pyobject=False)
 
 
 # --- snapshot events ---------------------------------------------------------

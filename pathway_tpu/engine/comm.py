@@ -16,7 +16,13 @@ record-at-a-time dataflow:
 * routing is by the 16-bit shard field of the 128-bit row key —
   ``shard_to_worker(key, n)`` — exactly the reference's rule.
 
-Wire format: 8-byte big-endian length + pickle of ``(tag, payload)``.
+Wire format: a mutual HMAC-SHA256 handshake (shared secret from
+``PATHWAY_COMM_SECRET``; ``cli spawn`` generates a fresh one per run), then
+8-byte big-endian length + PWT1-typed ``(tag, payload)`` frames — the same
+typed codec the persistence layer uses (``engine/codec.py``, native-
+accelerated), matching the reference's typed bincode exchange
+(``zero_copy/tcp.rs``) rather than trusting arbitrary object streams.
+Unauthenticated or malformed peers are rejected before any frame decode.
 Everything rides localhost/DCN TCP; dense device state never crosses here
 (it lives in HBM and moves over ICI via XLA collectives — see
 ``pathway_tpu/parallel/``).
@@ -24,7 +30,9 @@ Everything rides localhost/DCN TCP; dense device state never crosses here
 
 from __future__ import annotations
 
-import pickle
+import hmac as _hmac
+import os
+import secrets as _secrets
 import socket
 import struct
 import threading
@@ -32,15 +40,91 @@ import time
 from collections import defaultdict, deque
 from typing import Any, Callable, Hashable
 
+from pathway_tpu.engine import codec as _codec
 from pathway_tpu.engine.types import shard_to_worker
 
 _FRAME = struct.Struct(">Q")
 CONNECT_TIMEOUT_S = 60.0
 RECV_TIMEOUT_S = 300.0
+HANDSHAKE_TIMEOUT_S = 10.0
+# frame-size cap: a corrupt or hostile length field must not OOM the
+# worker.  256 MiB default comfortably covers real epoch batches (tune via
+# PATHWAY_COMM_MAX_FRAME_MB for enormous-epoch deployments).
+MAX_FRAME_BYTES = (
+    int(os.environ.get("PATHWAY_COMM_MAX_FRAME_MB", "256") or "256") << 20
+)
+
+_MAGIC = b"PWC1"
+_NONCE = 16
+_TAG = 32  # HMAC-SHA256
 
 
 class CommError(RuntimeError):
     pass
+
+
+def _resolve_secret(secret: bytes | str | None) -> bytes:
+    """Shared handshake secret: explicit arg, else PATHWAY_COMM_SECRET,
+    else the run id (``cli spawn`` mints both per run — the uuid4 run id is
+    a 122-bit token shared only by the cluster's processes).
+
+    With an empty secret the handshake still runs (frames stay typed and
+    framed) but offers no authentication, so frame decode additionally
+    refuses pickled values (``decode_row_typed``) — set
+    PATHWAY_COMM_SECRET for any mesh that crosses a machine boundary.
+    """
+    if secret is None:
+        secret = os.environ.get("PATHWAY_COMM_SECRET") or os.environ.get(
+            "PATHWAY_RUN_ID", ""
+        )
+    if isinstance(secret, str):
+        secret = secret.encode()
+    return secret
+
+
+def _auth_tag(secret: bytes, role: bytes, a: bytes, b: bytes) -> bytes:
+    return _hmac.new(secret, role + _MAGIC + a + b, "sha256").digest()
+
+
+def _handshake_dial(sock: socket.socket, my_id: int, secret: bytes) -> None:
+    """Dialer side: send hello, verify listener's proof, send ours."""
+    nonce_d = _secrets.token_bytes(_NONCE)
+    sock.sendall(_MAGIC + _FRAME.pack(my_id) + nonce_d)
+    reply = _recv_exact(sock, _NONCE + _TAG)
+    nonce_l, tag_l = reply[:_NONCE], reply[_NONCE:]
+    if not _hmac.compare_digest(tag_l, _auth_tag(secret, b"l", nonce_d, nonce_l)):
+        raise CommError("handshake failed: listener authentication mismatch")
+    sock.sendall(_auth_tag(secret, b"d", nonce_l, nonce_d))
+
+
+def _handshake_accept(sock: socket.socket, secret: bytes) -> int:
+    """Listener side: verify dialer; returns the peer worker id."""
+    hello = _recv_exact(sock, len(_MAGIC) + _FRAME.size + _NONCE)
+    if hello[: len(_MAGIC)] != _MAGIC:
+        raise CommError("handshake failed: bad magic")
+    (peer,) = _FRAME.unpack(hello[len(_MAGIC) : len(_MAGIC) + _FRAME.size])
+    nonce_d = hello[len(_MAGIC) + _FRAME.size :]
+    nonce_l = _secrets.token_bytes(_NONCE)
+    sock.sendall(nonce_l + _auth_tag(secret, b"l", nonce_d, nonce_l))
+    tag_d = _recv_exact(sock, _TAG)
+    if not _hmac.compare_digest(tag_d, _auth_tag(secret, b"d", nonce_l, nonce_d)):
+        raise CommError("handshake failed: dialer authentication mismatch")
+    return peer
+
+
+def _encode_frame(tag: Hashable, payload: Any) -> bytes:
+    blob = _codec.encode_row((tag, payload))
+    return _FRAME.pack(len(blob)) + blob
+
+
+def _decode_frame(blob: bytes, typed_only: bool) -> tuple[Hashable, Any]:
+    if typed_only:
+        row, _pos = _codec.decode_row_typed(blob)
+    else:
+        row, _pos = _codec.decode_row(blob)
+    if len(row) != 2:
+        raise ValueError(f"comm frame: expected (tag, payload), got {len(row)} values")
+    return row[0], row[1]
 
 
 class TcpMesh:
@@ -58,11 +142,13 @@ class TcpMesh:
         first_port: int,
         host: str = "127.0.0.1",
         peer_hosts: list[str] | None = None,
+        secret: bytes | str | None = None,
     ):
         self.worker_id = worker_id
         self.worker_count = worker_count
         self.first_port = first_port
         self.host = host
+        self.secret = _resolve_secret(secret)
         # multi-host deployments (one process per k8s pod / TPU host):
         # peer_hosts[i] is worker i's hostname; ports stay first_port+i so
         # the same config also works on localhost
@@ -95,15 +181,46 @@ class TcpMesh:
         accepted: dict[int, socket.socket] = {}
         acc_err: list[BaseException] = []
 
-        def accept_loop():
+        acc_lock = threading.Lock()
+        acc_done = threading.Event()
+
+        def handshake_one(sock: socket.socket) -> None:
+            # per-connection thread: a stalled or malicious client burns
+            # only its own HANDSHAKE_TIMEOUT_S, never the accept loop
             try:
-                for _ in accept_from:
-                    sock, _addr = self._listener.accept()
-                    peer = _FRAME.unpack(_recv_exact(sock, _FRAME.size))[0]
+                sock.settimeout(HANDSHAKE_TIMEOUT_S)
+                peer = _handshake_accept(sock, self.secret)
+                with acc_lock:
+                    if peer not in accept_from or peer in accepted:
+                        raise CommError(f"unexpected peer id {peer}")
+                    sock.settimeout(None)
                     accepted[peer] = sock
+                    if len(accepted) == len(accept_from):
+                        acc_done.set()
+            except (CommError, OSError, EOFError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        def accept_loop():
+            # a connection that fails the handshake (port scanner, stray
+            # client, wrong secret) is dropped and accepting continues;
+            # only listener-socket errors abort the loop
+            try:
+                while not acc_done.is_set():
+                    try:
+                        sock, _addr = self._listener.accept()
+                    except TimeoutError:
+                        break  # start() reports which peers are missing
+                    threading.Thread(
+                        target=handshake_one, args=(sock,), daemon=True
+                    ).start()
             except BaseException as exc:  # noqa: BLE001 — re-raised by start()
                 acc_err.append(exc)
 
+        if not accept_from:
+            acc_done.set()
         acceptor = threading.Thread(target=accept_loop, daemon=True)
         acceptor.start()
 
@@ -112,13 +229,16 @@ class TcpMesh:
                 self.peer_hosts[peer] if self.peer_hosts is not None else self.host
             )
             self._socks[peer] = _dial(
-                peer_host, self.first_port + peer, self.worker_id
+                peer_host, self.first_port + peer, self.worker_id, self.secret
             )
 
-        acceptor.join(CONNECT_TIMEOUT_S)
+        # wait on the completion event, not the thread: the acceptor may
+        # still be blocked in accept() (it lingers as a daemon rejecting
+        # stray connections until close() shuts the listener)
+        done = acc_done.wait(CONNECT_TIMEOUT_S)
         if acc_err:
             raise CommError(f"worker {self.worker_id}: accept failed: {acc_err[0]}")
-        if acceptor.is_alive() or len(accepted) != len(accept_from):
+        if not done or len(accepted) != len(accept_from):
             raise CommError(
                 f"worker {self.worker_id}: timed out waiting for peers "
                 f"{sorted(set(accept_from) - set(accepted))}"
@@ -141,12 +261,31 @@ class TcpMesh:
             while not self._closed:
                 header = _recv_exact(sock, _FRAME.size)
                 (size,) = _FRAME.unpack(header)
+                if size > MAX_FRAME_BYTES:
+                    raise ValueError(f"comm frame of {size} bytes exceeds cap")
                 blob = _recv_exact(sock, size)
-                tag, payload = pickle.loads(blob)
+                # no shared secret = unauthenticated link: refuse pickled
+                # values so a reachable port is not code execution
+                tag, payload = _decode_frame(blob, typed_only=not self.secret)
                 with self._cv:
                     self._inbox[(peer, tag)].append(payload)
                     self._cv.notify_all()
-        except (OSError, EOFError, ConnectionError):
+        except Exception as exc:  # noqa: BLE001
+            # socket errors AND decode errors land here: a malformed or
+            # corrupt frame means framing is lost and the link is unusable,
+            # so any failure is treated exactly like a dead peer (the
+            # waiting recv() raises CommError; the process survives).
+            # Decode refusals are logged — "peer disconnected" alone would
+            # hide e.g. the typed-only pickle refusal and its remedy.
+            if isinstance(exc, ValueError):
+                import logging
+
+                logging.getLogger("pathway_tpu.comm").error(
+                    "worker %d: dropping link to peer %d: %s",
+                    self.worker_id,
+                    peer,
+                    exc,
+                )
             if not self._closed:
                 with self._cv:
                     self._inbox[(peer, _PEER_DEAD)].append(None)
@@ -155,14 +294,16 @@ class TcpMesh:
     # -- point to point --------------------------------------------------
     def send(self, dest: int, tag: Hashable, payload: Any) -> None:
         if dest == self.worker_id:
+            # the codec round-trips every value shape exactly (lists stay
+            # lists, wrappers stay wrapped), so a self-send can skip it
             with self._cv:
                 self._inbox[(dest, tag)].append(payload)
                 self._cv.notify_all()
             return
-        blob = pickle.dumps((tag, payload), protocol=pickle.HIGHEST_PROTOCOL)
+        frame = _encode_frame(tag, payload)
         sock = self._socks[dest]
         with self._send_locks[dest]:
-            sock.sendall(_FRAME.pack(len(blob)) + blob)
+            sock.sendall(frame)
 
     def recv(self, src: int, tag: Hashable, timeout: float = RECV_TIMEOUT_S) -> Any:
         deadline = time.monotonic() + timeout
@@ -257,16 +398,30 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def _dial(host: str, port: int, my_id: int) -> socket.socket:
+def _dial(host: str, port: int, my_id: int, secret: bytes) -> socket.socket:
     deadline = time.monotonic() + CONNECT_TIMEOUT_S
     last: Exception | None = None
     while time.monotonic() < deadline:
         try:
             sock = socket.create_connection((host, port), timeout=5.0)
-            sock.settimeout(None)
-            sock.sendall(_FRAME.pack(my_id))
-            return sock
         except OSError as exc:
+            last = exc
+            time.sleep(0.1)
+            continue
+        try:
+            sock.settimeout(HANDSHAKE_TIMEOUT_S)
+            _handshake_dial(sock, my_id, secret)
+            sock.settimeout(None)
+            return sock
+        except CommError:
+            # auth mismatch is fatal, not retryable: the peer is alive but
+            # holds a different secret
+            sock.close()
+            raise
+        except (OSError, EOFError) as exc:
+            # listener may have dropped us mid-handshake during startup
+            # races — retry like a refused connection
+            sock.close()
             last = exc
             time.sleep(0.1)
     raise CommError(f"could not reach worker at {host}:{port}: {last}")

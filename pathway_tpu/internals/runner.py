@@ -120,9 +120,16 @@ def run(
     runtime_typechecking: bool | None = None,
     terminate_on_error: bool = True,
     max_epochs: int | None = None,
+    _sinks: list | None = None,
     **kwargs: Any,
 ) -> RunResult:
-    """``pw.run`` — execute every registered sink to completion."""
+    """``pw.run`` — execute every registered sink to completion.
+
+    ``_sinks`` (internal) runs an explicit sink list instead of the
+    graph's registry — ``Table.live()`` uses it to run one export sink's
+    cone on a background thread while the interactive graph stays open
+    (the reference's ``runner.run_nodes([operator])``).
+    """
     scope = df.Scope()
     scope.terminate_on_error = terminate_on_error
 
@@ -152,7 +159,7 @@ def run(
         lowerer.persistence_storage = storage
 
     # lower all sinks (tree-shaking is implicit: only sink cones are built)
-    for name, table, attach in list(G.sinks):
+    for name, table, attach in (list(G.sinks) if _sinks is None else _sinks):
         node = lowerer.node(table)
         attach(lowerer, node)
 
@@ -210,10 +217,20 @@ def run(
                 prober.callbacks.append(http_server.update)
             result.prober = prober
             with telemetry.span("pathway.run", workers=config.threads):
-                _event_loop(
-                    scope, lowerer, result, max_epochs=max_epochs, storage=storage,
-                    prober=prober,
-                )
+                try:
+                    _event_loop(
+                        scope, lowerer, result, max_epochs=max_epochs,
+                        storage=storage, prober=prober,
+                    )
+                except BaseException:
+                    # failure hooks: exported tables must flip to failed so
+                    # concurrent importers raise instead of waiting forever
+                    # (the scopeguard of dataflow/export.rs:143-146)
+                    for node in scope.nodes:
+                        abort = getattr(node, "on_abort", None)
+                        if abort is not None:
+                            abort()
+                    raise
     finally:
         if worker_ctx is not None:
             worker_ctx.close()

@@ -593,6 +593,19 @@ class Table(Joinable):
         )
         return f"<pw.Table ({cols})>"
 
+    def live(self):
+        """Run this table's cone on a background thread and return a
+        LiveTable (inspectable while streaming, composable into further
+        graph operations).  Experimental — match:
+        ``/root/reference/python/pathway/internals/table.py:2565``.
+        """
+        import warnings
+
+        from pathway_tpu.internals.interactive import LiveTable
+
+        warnings.warn("live tables are an experimental feature", stacklevel=2)
+        return LiveTable._create(self)
+
     @property
     def slice(self) -> "TableSlice":
         return TableSlice(self, self.column_names())

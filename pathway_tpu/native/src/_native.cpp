@@ -579,6 +579,13 @@ static PyObject *decode_value(Cursor &c, PyObject *view) {
     case T_TUPLE: {
       uint64_t n;
       if (!c.r_u64(&n)) return nullptr;
+      // every element takes >=1 byte, so a length beyond the remaining
+      // buffer is corruption — reject before PyTuple_New sees a bogus
+      // (possibly negative-after-cast) size
+      if (n > c.len - c.pos) {
+        PyErr_SetString(PyExc_ValueError, "codec: corrupt buffer (tuple length)");
+        return nullptr;
+      }
       PyObject *t = PyTuple_New((Py_ssize_t)n);
       if (!t) return nullptr;
       for (uint64_t i = 0; i < n; i++) {
@@ -615,7 +622,9 @@ static PyObject *py_decode_row(PyObject *, PyObject *args) {
   Cursor c{(const uint8_t *)view.buf, (size_t)view.len, (size_t)pos};
   uint64_t n = 0;
   PyObject *result = nullptr;
-  if (c.r_u64(&n)) {
+  // each row value takes >=1 byte, so a count beyond the remaining buffer
+  // is corruption — reject before PyTuple_New sees a bogus size
+  if (c.r_u64(&n) && n <= c.len - c.pos) {
     PyObject *t = PyTuple_New((Py_ssize_t)n);
     if (t) {
       bool ok = true;
@@ -633,6 +642,9 @@ static PyObject *py_decode_row(PyObject *, PyObject *args) {
         Py_DECREF(t);
       }
     }
+  }
+  if (!result && !PyErr_Occurred()) {
+    PyErr_SetString(PyExc_ValueError, "codec: corrupt buffer (row length)");
   }
   Py_DECREF(mview);
   PyBuffer_Release(&view);

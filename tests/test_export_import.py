@@ -1,0 +1,226 @@
+"""Inter-graph export/import (parity: src/engine/dataflow/export.rs:1-205,
+graph.rs:978-984): one graph exports a table, another imports it —
+sequentially or concurrently — preserving keys, update streams, and
+failure propagation.
+"""
+
+from __future__ import annotations
+
+import threading
+import time as _time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals.export_import import ImportedTableFailed
+from pathway_tpu.io._utils import COMMIT, FINISH, Reader, make_input_table
+
+
+def _collect(table):
+    """subscribe-collect: list of (key, row_dict, time, is_addition)."""
+    out = []
+    pw.io.subscribe(
+        table,
+        on_change=lambda key, row, time, is_addition: out.append(
+            (key, row, time, is_addition)
+        ),
+    )
+    return out
+
+
+def test_sequential_export_then_import():
+    """Graph A computes and exports; graph B (fresh graph) imports."""
+    pw.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+        word  | n
+        apple | 2
+        plum  | 3
+        apple | 5
+        """
+    )
+    summed = t.groupby(pw.this.word).reduce(
+        word=pw.this.word, total=pw.reducers.sum(pw.this.n)
+    )
+    exported = pw.export_table(summed)
+    pw.run()
+    assert exported.done and not exported.failed
+    assert exported.frontier() >= 0
+
+    pw.G.clear()
+    imported = pw.import_table(exported)
+    assert set(imported.column_names()) == {"word", "total"}
+    doubled = imported.select(pw.this.word, d=pw.this.total * 2)
+    rows = _collect(doubled)
+    pw.run()
+    got = {r[1]["word"]: r[1]["d"] for r in rows}
+    assert got == {"apple": 14, "plum": 6}
+
+
+def test_export_preserves_keys_and_updates():
+    """Keys survive the hop; retraction streams replay faithfully."""
+    pw.G.clear()
+    t = pw.debug.table_from_markdown(
+        """
+        word  | n | _time | _diff
+        a     | 1 | 2     | 1
+        a     | 1 | 4     | -1
+        a     | 7 | 4     | 1
+        b     | 2 | 6     | 1
+        """
+    )
+    exported = pw.export_table(t)
+    pw.run()
+    # the update stream carries the retraction
+    diffs = [d for (_k, _r, _t, d) in exported.data_from_offset(0)[0]]
+    assert -1 in diffs
+
+    pw.G.clear()
+    imported = pw.import_table(exported)
+    changes = _collect(imported)
+    pw.run()
+    # final state after replay: a=7 and b=2 present
+    state = {}
+    for _key, row, _tm, add in changes:
+        if add:
+            state[row["word"]] = row["n"]
+        elif state.get(row["word"]) == row["n"]:
+            del state[row["word"]]
+    assert state == {"a": 7, "b": 2}
+    # keys are preserved bit-for-bit across graphs
+    exported_keys = {k for (k, _r, _t, _d) in exported.data_from_offset(0)[0]}
+    imported_keys = {k.value for (k, _r, _t, _d) in changes}
+    assert imported_keys <= exported_keys
+
+
+class _SlowReader(Reader):
+    """Emits two epochs with a pause, so a concurrent importer really
+    overlaps with the exporting run."""
+
+    def run(self, emit):
+        emit({"word": "x", "n": 1})
+        emit(COMMIT)
+        _time.sleep(0.3)
+        emit({"word": "y", "n": 2})
+        emit(COMMIT)
+
+
+def test_concurrent_export_import():
+    """Importer consumes while the exporting graph is still running."""
+    pw.G.clear()
+    schema = pw.schema_from_types(word=str, n=int)
+    t = make_input_table(schema, _SlowReader, autocommit_duration_ms=50)
+    exported = pw.export_table(t)
+
+    errs = []
+
+    def run_exporter():
+        try:
+            pw.run()
+        except Exception as exc:  # noqa: BLE001
+            errs.append(exc)
+
+    th = threading.Thread(target=run_exporter)
+    th.start()
+    # wait until the exporter has produced its first epoch, then build the
+    # importing graph (the exporter's graph was lowered at run() start)
+    exported.wait(0, 0, timeout=10)
+
+    pw.G.clear()
+    imported = pw.import_table(exported)
+    rows = _collect(imported)
+    pw.run()
+    th.join(10)
+    assert not errs, errs
+    assert {r[1]["word"] for r in rows} == {"x", "y"}
+    # the two exporter epochs arrive as two distinct import times
+    assert len({r[2] for r in rows}) == 2
+
+
+def test_failed_export_propagates_to_importer():
+    """Exporting graph dies mid-run → importer raises ImportedTableFailed."""
+    pw.G.clear()
+    schema = pw.schema_from_types(n=int)
+
+    class _FailingReader(Reader):
+        def run(self, emit):
+            emit({"n": 1})
+            emit(COMMIT)
+            _time.sleep(0.2)
+            emit({"n": 2})
+            emit(COMMIT)
+
+    t = make_input_table(schema, _FailingReader, autocommit_duration_ms=50)
+    # a UDF that explodes on the second row, with terminate_on_error
+    boom = pw.udf(lambda n: 1 // (2 - n))
+    out = t.select(v=boom(pw.this.n))
+    exported = pw.export_table(out)
+
+    def run_exporter():
+        with pytest.raises(Exception):
+            pw.run(terminate_on_error=True)
+
+    th = threading.Thread(target=run_exporter)
+    th.start()
+    exported.wait(0, 0, timeout=10)
+
+    pw.G.clear()
+    imported = pw.import_table(exported)
+    _collect(imported)
+    with pytest.raises(ImportedTableFailed):
+        pw.run()
+    th.join(10)
+
+
+def test_table_live():
+    """Table.live(): origin cone runs on a background thread; the handle
+    is inspectable mid-stream and composable into a later pw.run()."""
+    pw.G.clear()
+    schema = pw.schema_from_types(word=str, n=int)
+    t = make_input_table(schema, _SlowReader, autocommit_duration_ms=50)
+    with pytest.warns(UserWarning, match="experimental"):
+        lt = t.live()
+
+    # inspectable while (or shortly after) streaming
+    lt.wait_for(15)
+    assert not lt.failed()
+    snap = lt.snapshot()
+    assert snap.done
+    assert sorted(row for (_k, row) in snap.data) == [("x", 1), ("y", 2)]
+    assert "final snapshot" in str(snap) and "final snapshot" in str(lt)
+
+    # composable: LiveTable is a real Table
+    doubled = lt.select(pw.this.word, d=pw.this.n * 2)
+    rows = _collect(doubled)
+    pw.run()
+    assert {r[1]["word"]: r[1]["d"] for r in rows} == {"x": 2, "y": 4}
+
+
+def test_import_only_closed_epochs():
+    """Rows of a not-yet-closed exporter epoch are withheld (frontier
+    gating): the importer never sees a partial epoch."""
+    from pathway_tpu.internals.export_import import ExportedTable, _ImportPoller
+    from pathway_tpu.engine import dataflow as df
+
+    schema = pw.schema_from_types(n=int)
+    exported = ExportedTable(schema)
+    scope = df.Scope()
+    node = df.InputNode(scope)
+    poller = _ImportPoller(node, exported)
+
+    exported._push(1, (10,), 2, 1)
+    exported._push(2, (20,), 2, 1)
+    # epoch 2 not closed yet
+    assert poller.poll() is False
+    assert node.pending_times() == []
+
+    exported._advance(2)
+    exported._push(3, (30,), 4, 1)  # next epoch, open
+    poller.poll()
+    assert node.pending_times() == [2]
+
+    exported._advance(4)
+    exported._finish()
+    assert poller.poll() is True
+    assert node.pending_times() == [2, 4]
+    assert node.finished

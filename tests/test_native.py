@@ -55,6 +55,10 @@ SAMPLE_VALUES = [
     dt.date(1999, 12, 31),
     np.arange(6, dtype=np.int64).reshape(2, 3),
     np.linspace(0, 1, 5, dtype=np.float32),
+    [],
+    [1, "a", None],
+    [[1, 2], (3, [4.5])],  # lists round-trip as lists, tuples as tuples
+    tz.PyObjectWrapper({"k": [1, 2]}),  # re-wrapped on decode
 ]
 
 
