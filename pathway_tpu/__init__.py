@@ -238,6 +238,9 @@ __all__ = [
     "this",
     "run",
     "run_all",
+    "ExportedTable",
+    "export_table",
+    "import_table",
     "G",
     "Type",
     "MonitoringLevel",

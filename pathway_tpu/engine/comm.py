@@ -64,9 +64,9 @@ class CommError(RuntimeError):
 
 
 def _resolve_secret(secret: bytes | str | None) -> bytes:
-    """Shared handshake secret: explicit arg, else PATHWAY_COMM_SECRET,
-    else the run id (``cli spawn`` mints both per run — the uuid4 run id is
-    a 122-bit token shared only by the cluster's processes).
+    """Shared handshake secret: explicit arg, else PATHWAY_COMM_SECRET
+    (``cli spawn`` mints one per run).  Deliberately NOT the run id — the
+    monitoring endpoints publish it, so it cannot double as an auth token.
 
     With an empty secret the handshake still runs (frames stay typed and
     framed) but offers no authentication, so frame decode additionally
@@ -74,9 +74,7 @@ def _resolve_secret(secret: bytes | str | None) -> bytes:
     PATHWAY_COMM_SECRET for any mesh that crosses a machine boundary.
     """
     if secret is None:
-        secret = os.environ.get("PATHWAY_COMM_SECRET") or os.environ.get(
-            "PATHWAY_RUN_ID", ""
-        )
+        secret = os.environ.get("PATHWAY_COMM_SECRET", "")
     if isinstance(secret, str):
         secret = secret.encode()
     return secret
@@ -301,6 +299,14 @@ class TcpMesh:
                 self._cv.notify_all()
             return
         frame = _encode_frame(tag, payload)
+        if len(frame) > MAX_FRAME_BYTES:
+            # fail fast on the sender with the actionable message — the
+            # receiver would just drop the link as "peer disconnected"
+            raise CommError(
+                f"comm frame of {len(frame)} bytes exceeds the "
+                f"{MAX_FRAME_BYTES}-byte cap; raise PATHWAY_COMM_MAX_FRAME_MB "
+                "on every worker for enormous-epoch workloads"
+            )
         sock = self._socks[dest]
         with self._send_locks[dest]:
             sock.sendall(frame)

@@ -14,7 +14,7 @@ from pathway_tpu.xpacks.llm import (
     servers,
     splitters,
 )
-from pathway_tpu.xpacks.llm.document_store import DocumentStore
+from pathway_tpu.xpacks.llm.document_store import DocumentStore, SlidesDocumentStore
 from pathway_tpu.xpacks.llm.question_answering import (
     AdaptiveRAGQuestionAnswerer,
     BaseRAGQuestionAnswerer,
@@ -32,6 +32,7 @@ __all__ = [
     "servers",
     "splitters",
     "DocumentStore",
+    "SlidesDocumentStore",
     "AdaptiveRAGQuestionAnswerer",
     "BaseRAGQuestionAnswerer",
     "DeckRetriever",

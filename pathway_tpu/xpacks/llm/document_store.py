@@ -22,10 +22,6 @@ from pathway_tpu.xpacks.llm.parsers import ParseUtf8
 from pathway_tpu.xpacks.llm.splitters import NullSplitter
 
 
-class SlidesDocumentStore:  # forward-declared subclass placeholder (parity)
-    pass
-
-
 class DocumentStore:
     """Builds and serves a document index over streaming input tables."""
 
@@ -264,6 +260,56 @@ class DocumentStore:
                 _global_scalar(input_queries, files, "paths"),
                 ColumnReference(this, "metadata_filter"),
                 ColumnReference(this, "filepath_globpattern"),
+                _propagate_none=False,
+            )
+        )
+
+
+class SlidesDocumentStore(DocumentStore):
+    """Document store for the slide-search application (parity:
+    document_store.py:471-529): a DocumentStore whose default parser is
+    the slide parser, plus a ``parsed_documents_query`` returning the
+    per-slide metadata after parsing/post-processing (with the bulky
+    ``b64_image`` entries stripped, like the reference)."""
+
+    excluded_response_metadata = ["b64_image"]
+
+    def __init__(self, docs, retriever_factory, parser=None, **kwargs):
+        if parser is None:
+            from pathway_tpu.xpacks.llm.parsers import SlideParser
+
+            parser = SlideParser()
+        super().__init__(docs, retriever_factory, parser=parser, **kwargs)
+
+    def parsed_documents_query(self, parse_docs_queries: Table) -> Table:
+        """List parsed documents' metadata, honoring ``metadata_filter``
+        (InputsQuerySchema queries)."""
+        from pathway_tpu.stdlib.indexing.filters import metadata_matches
+
+        metas = self.parsed_docs.reduce(
+            metadatas=reducers.tuple(ColumnReference(this, "metadata"))
+        )
+        merged = self.merge_filters(parse_docs_queries)
+
+        def pack(metadatas, metadata_filter) -> Json:
+            out = []
+            for m in metadatas or ():
+                entry = dict(m.value) if isinstance(m, Json) else dict(m or {})
+                if metadata_filter and not metadata_matches(
+                    metadata_filter, entry
+                ):
+                    continue
+                for key in self.excluded_response_metadata:
+                    entry.pop(key, None)
+                out.append(entry)
+            return Json(out)
+
+        return merged.select(
+            result=ApplyExpression(
+                pack,
+                None,
+                _global_scalar(merged, metas, "metadatas"),
+                ColumnReference(this, "metadata_filter"),
                 _propagate_none=False,
             )
         )
