@@ -32,7 +32,7 @@ from xml.etree import ElementTree as ET
 
 _OBJ_RE = re.compile(rb"(\d+)\s+(\d+)\s+obj\b(.*?)endobj", re.S)
 _STREAM_RE = re.compile(rb"stream\r?\n(.*?)\r?\nendstream", re.S)
-_REF_RE = re.compile(rb"/Contents\s+(?:(\d+)\s+\d+\s+R|\[(.*?)\])", re.S)
+_REF_RE = re.compile(rb"/Contents\s*(?:(\d+)\s+\d+\s+R|\[(.*?)\])", re.S)
 _KIDS_RE = re.compile(rb"/Kids\s*\[(.*?)\]", re.S)
 _NUM_REF_RE = re.compile(rb"(\d+)\s+\d+\s+R")
 
@@ -65,14 +65,28 @@ def _object_stream(body: bytes) -> bytes | None:
 
 def _page_objects(objs: dict[int, bytes]) -> list[int]:
     """Page object numbers in page-tree order (fallback: document order)."""
-    roots = [
+    pages_nodes = {
         num
         for num, body in objs.items()
         if b"/Type" in body and re.search(rb"/Type\s*/Pages\b", body)
-    ]
+    }
+    # intermediate /Pages nodes are Kids of another /Pages node — walking
+    # them as roots would extract their subtree once per ancestor
+    kids_of_pages: set[int] = set()
+    for num in pages_nodes:
+        kids = _KIDS_RE.search(objs[num])
+        if kids:
+            kids_of_pages.update(
+                int(r.group(1)) for r in _NUM_REF_RE.finditer(kids.group(1))
+            )
+    roots = sorted(pages_nodes - kids_of_pages) or sorted(pages_nodes)
     pages_in_order: list[int] = []
+    visited: set[int] = set()
 
     def walk(num: int) -> None:
+        if num in visited:
+            return
+        visited.add(num)
         body = objs.get(num)
         if body is None:
             return
@@ -84,7 +98,6 @@ def _page_objects(objs: dict[int, bytes]) -> list[int]:
             for ref in _NUM_REF_RE.finditer(kids.group(1)):
                 walk(int(ref.group(1)))
 
-    # prefer the root /Pages node without a parent reference
     for root in roots:
         walk(root)
     if not pages_in_order:
@@ -236,8 +249,19 @@ def pdf_extract_pages(data: bytes) -> list[str]:
                 content_ids.extend(
                     int(r.group(1)) for r in _NUM_REF_RE.finditer(m.group(2))
                 )
-        texts = []
+        # the single-ref form may point at an array object of stream refs
+        # (the legal indirect-array variant) — expand one level
+        expanded: list[int] = []
         for cid in content_ids:
+            body_c = objs.get(cid, b"")
+            if b"stream" not in body_c and body_c.strip().startswith(b"["):
+                expanded.extend(
+                    int(r.group(1)) for r in _NUM_REF_RE.finditer(body_c)
+                )
+            else:
+                expanded.append(cid)
+        texts = []
+        for cid in expanded:
             if cid in objs:
                 stream = _object_stream(objs[cid])
                 if stream:

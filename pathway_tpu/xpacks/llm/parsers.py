@@ -333,6 +333,7 @@ class ImageParser(UDF):
             if self.downsize_horizontal_width:
                 data = _downsize_image(data, self.downsize_horizontal_width)
             b64 = base64.b64encode(data).decode()
+            mime = _sniff_image_mime(data)
             messages = [
                 {
                     "role": "user",
@@ -341,7 +342,7 @@ class ImageParser(UDF):
                         {
                             "type": "image_url",
                             "image_url": {
-                                "url": f"data:image/png;base64,{b64}"
+                                "url": f"data:{mime};base64,{b64}"
                             },
                         },
                     ],
@@ -351,6 +352,20 @@ class ImageParser(UDF):
             return ((str(text), Json({})),)
 
         self.__wrapped__ = parse
+
+
+def _sniff_image_mime(data: bytes) -> str:
+    """Media type from magic bytes — vision APIs reject a mislabeled
+    payload (e.g. a JPEG claiming image/png)."""
+    if data[:8] == b"\x89PNG\r\n\x1a\n":
+        return "image/png"
+    if data[:2] == b"\xff\xd8":
+        return "image/jpeg"
+    if data[:6] in (b"GIF87a", b"GIF89a"):
+        return "image/gif"
+    if data[:4] == b"RIFF" and data[8:12] == b"WEBP":
+        return "image/webp"
+    return "image/png"
 
 
 def _downsize_image(data: bytes, width: int) -> bytes:

@@ -7,10 +7,6 @@ the extractors are exercised on genuine bytes, not golden files.
 
 from __future__ import annotations
 
-import io
-import zipfile
-import zlib
-
 import pytest
 
 from pathway_tpu.engine.types import Json
@@ -24,116 +20,7 @@ from pathway_tpu.xpacks.llm.parsers import (
     Utf8Parser,
     chunk_elements,
 )
-
-# ---------------------------------------------------------------------------
-# fixture writers
-# ---------------------------------------------------------------------------
-
-
-def _pdf_escape(text: str) -> bytes:
-    return (
-        text.replace("\\", "\\\\").replace("(", "\\(").replace(")", "\\)")
-    ).encode("latin-1", "replace")
-
-
-def _page_content(text: str) -> bytes:
-    ops = [b"BT /F1 12 Tf 72 720 Td"]
-    for i, line in enumerate(text.splitlines() or [""]):
-        if i:
-            ops.append(b"0 -14 Td")
-        ops.append(b"(" + _pdf_escape(line) + b") Tj")
-    ops.append(b"ET")
-    return b" ".join(ops)
-
-
-def make_pdf(pages: list[str]) -> bytes:
-    """A real multi-page PDF: catalog, page tree, Helvetica, FlateDecode
-    content streams, xref table."""
-    out = io.BytesIO()
-    out.write(b"%PDF-1.4\n%\xe2\xe3\xcf\xd3\n")
-    offsets: dict[int, int] = {}
-
-    def w_obj(num: int, body: bytes) -> None:
-        offsets[num] = out.tell()
-        out.write(f"{num} 0 obj\n".encode() + body + b"\nendobj\n")
-
-    n = len(pages)
-    page_ids = [3 + 2 * i for i in range(n)]
-    content_ids = [4 + 2 * i for i in range(n)]
-    kids = " ".join(f"{pid} 0 R" for pid in page_ids).encode()
-    w_obj(1, b"<< /Type /Catalog /Pages 2 0 R >>")
-    w_obj(2, b"<< /Type /Pages /Kids [" + kids + b"] /Count %d >>" % n)
-    for i, text in enumerate(pages):
-        comp = zlib.compress(_page_content(text))
-        w_obj(
-            page_ids[i],
-            b"<< /Type /Page /Parent 2 0 R /MediaBox [0 0 612 792] "
-            b"/Contents %d 0 R /Resources << /Font << /F1 << /Type /Font "
-            b"/Subtype /Type1 /BaseFont /Helvetica >> >> >> >>"
-            % content_ids[i],
-        )
-        w_obj(
-            content_ids[i],
-            b"<< /Length %d /Filter /FlateDecode >>\nstream\n" % len(comp)
-            + comp
-            + b"\nendstream",
-        )
-    xref_at = out.tell()
-    total = 2 * n + 3
-    out.write(b"xref\n0 %d\n0000000000 65535 f \n" % total)
-    for num in range(1, total):
-        out.write(b"%010d 00000 n \n" % offsets[num])
-    out.write(
-        b"trailer\n<< /Size %d /Root 1 0 R >>\nstartxref\n%d\n%%%%EOF\n"
-        % (total, xref_at)
-    )
-    return out.getvalue()
-
-
-_W = "http://schemas.openxmlformats.org/wordprocessingml/2006/main"
-_A = "http://schemas.openxmlformats.org/drawingml/2006/main"
-
-
-def make_docx(paragraphs: list[str]) -> bytes:
-    body = "".join(
-        f"<w:p><w:r><w:t xml:space='preserve'>{p}</w:t></w:r></w:p>"
-        for p in paragraphs
-    )
-    doc = (
-        f"<?xml version='1.0' encoding='UTF-8'?>"
-        f"<w:document xmlns:w='{_W}'><w:body>{body}</w:body></w:document>"
-    )
-    buf = io.BytesIO()
-    with zipfile.ZipFile(buf, "w") as zf:
-        zf.writestr(
-            "[Content_Types].xml",
-            "<?xml version='1.0'?><Types "
-            "xmlns='http://schemas.openxmlformats.org/package/2006/content-types'>"
-            "<Default Extension='xml' ContentType='application/xml'/></Types>",
-        )
-        zf.writestr("word/document.xml", doc)
-    return buf.getvalue()
-
-
-def make_pptx(slides: list[list[str]]) -> bytes:
-    buf = io.BytesIO()
-    with zipfile.ZipFile(buf, "w") as zf:
-        zf.writestr(
-            "[Content_Types].xml",
-            "<?xml version='1.0'?><Types "
-            "xmlns='http://schemas.openxmlformats.org/package/2006/content-types'>"
-            "<Default Extension='xml' ContentType='application/xml'/></Types>",
-        )
-        for i, texts in enumerate(slides, 1):
-            runs = "".join(f"<a:t>{t}</a:t>" for t in texts)
-            zf.writestr(
-                f"ppt/slides/slide{i}.xml",
-                f"<?xml version='1.0'?><p:sld "
-                f"xmlns:p='http://schemas.openxmlformats.org/presentationml/2006/main' "
-                f"xmlns:a='{_A}'><p:cSld>{runs}</p:cSld></p:sld>",
-            )
-    return buf.getvalue()
-
+from tests.doc_fixtures import make_docx, make_pdf, make_pptx
 
 # ---------------------------------------------------------------------------
 # PDF extraction
@@ -401,3 +288,75 @@ def test_slides_document_store():
     assert len(metas) == 2  # one entry per slide
     assert {m["slide_number"] for m in metas} == {1, 2}
     assert all("b64_image" not in m for m in metas)  # excluded metadata
+
+
+def test_pdf_nested_page_tree_no_duplicates():
+    """Intermediate /Pages nodes (standard for >8 pages) must not double
+    the pages: only true roots are walked, with a visited guard."""
+    import zlib as _zlib
+
+    from tests.doc_fixtures import _page_content
+
+    comp = _zlib.compress(_page_content("hello nested"))
+    body = (
+        b"%PDF-1.4\n"
+        b"1 0 obj\n<< /Type /Catalog /Pages 2 0 R >>\nendobj\n"
+        b"2 0 obj\n<< /Type /Pages /Kids [3 0 R] /Count 1 >>\nendobj\n"
+        b"3 0 obj\n<< /Type /Pages /Parent 2 0 R /Kids [4 0 R] /Count 1 >>\nendobj\n"
+        b"4 0 obj\n<< /Type /Page /Parent 3 0 R /Contents 5 0 R >>\nendobj\n"
+        + (b"5 0 obj\n<< /Length %d /Filter /FlateDecode >>\nstream\n" % len(comp))
+        + comp
+        + b"\nendstream\nendobj\n"
+    )
+    pages = _doc_extract.pdf_extract_pages(body)
+    assert len(pages) == 1
+    assert pages[0].count("hello nested") == 1
+
+
+def test_pdf_contents_array_no_space_and_indirect():
+    """'/Contents[4 0 R]' (no space) and the indirect-array form both
+    resolve to the content streams."""
+    import zlib as _zlib
+
+    from tests.doc_fixtures import _page_content
+
+    comp = _zlib.compress(_page_content("array form"))
+    no_space = (
+        b"%PDF-1.4\n"
+        b"1 0 obj\n<< /Type /Catalog /Pages 2 0 R >>\nendobj\n"
+        b"2 0 obj\n<< /Type /Pages /Kids [3 0 R] /Count 1 >>\nendobj\n"
+        b"3 0 obj\n<< /Type /Page /Contents[4 0 R] >>\nendobj\n"
+        + (b"4 0 obj\n<< /Length %d /Filter /FlateDecode >>\nstream\n" % len(comp))
+        + comp
+        + b"\nendstream\nendobj\n"
+    )
+    assert "array form" in _doc_extract.pdf_extract_pages(no_space)[0]
+
+    indirect = (
+        b"%PDF-1.4\n"
+        b"1 0 obj\n<< /Type /Catalog /Pages 2 0 R >>\nendobj\n"
+        b"2 0 obj\n<< /Type /Pages /Kids [3 0 R] /Count 1 >>\nendobj\n"
+        b"3 0 obj\n<< /Type /Page /Contents 5 0 R >>\nendobj\n"
+        b"5 0 obj\n[4 0 R]\nendobj\n"
+        + (b"4 0 obj\n<< /Length %d /Filter /FlateDecode >>\nstream\n" % len(comp))
+        + comp
+        + b"\nendstream\nendobj\n"
+    )
+    assert "array form" in _doc_extract.pdf_extract_pages(indirect)[0]
+
+
+def test_docx_pptx_fixture_escaping():
+    """Fixture writers must escape XML specials so punctuation-bearing
+    corpora survive the round trip."""
+    from tests.doc_fixtures import make_docx, make_pptx
+
+    text = 'AT&T <report> says "5 < 7"'
+    assert _doc_extract.docx_extract_text(make_docx([text])) == text
+    assert _doc_extract.pptx_extract_slides(make_pptx([[text]])) == [text]
+
+
+def test_image_parser_sniffs_jpeg():
+    chat = _FakeChat()
+    ImageParser(llm=chat).__wrapped__(b"\xff\xd8\xff\xe0 fake jpeg")
+    url = chat.calls[0][0]["content"][1]["image_url"]["url"]
+    assert url.startswith("data:image/jpeg;base64,")

@@ -1,0 +1,78 @@
+"""Retrieval-quality eval (parity: integration_tests/rag_evals): the full
+parse→split→embed→index→query DocumentStore path must clear recall@k /
+MRR thresholds on a deterministic corpus, per retriever kind.
+
+Thresholds sit well under the measured values (bm25 1.0/1.0, hash-dense
+0.83@5 / 0.71 MRR, golden-checkpoint dense 0.85@5 / 0.74 MRR, hybrid
+1.0@5 / 0.90 MRR) so they catch real regressions, not noise.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from benchmarks.rag_eval import build_corpus, make_retriever, run_eval
+
+
+def test_corpus_is_deterministic():
+    docs1, queries1 = build_corpus()
+    docs2, queries2 = build_corpus()
+    assert docs1 == docs2 and queries1 == queries2
+    # every query has exactly one target document
+    paths = {p for _t, p in docs1}
+    assert all(t in paths for _q, t in queries1)
+
+
+def test_bm25_retrieval_quality():
+    m = run_eval(make_retriever("bm25"))
+    assert m["recall_at_1"] >= 0.95, m
+    assert m["mrr"] >= 0.95, m
+
+
+def test_dense_retrieval_quality():
+    """Deterministic seeded encoder + hashing tokenizer: embeddings still
+    carry lexical signal through shared token vectors."""
+    m = run_eval(make_retriever("dense"))
+    assert m["recall_at_5"] >= 0.7, m
+    assert m["mrr"] >= 0.5, m
+
+
+def test_hybrid_beats_or_matches_dense():
+    dense = run_eval(make_retriever("dense"))
+    hybrid = run_eval(make_retriever("hybrid"))
+    assert hybrid["recall_at_5"] >= 0.95, hybrid
+    assert hybrid["mrr"] >= dense["mrr"], (hybrid, dense)
+
+
+def test_dense_golden_checkpoint_quality(tmp_path):
+    """The full path with a REAL loaded checkpoint (load_hf_weights) and
+    the real HF WordPiece tokenizer covering the corpus vocabulary."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from benchmarks.rag_eval import TOPICS
+
+    words = sorted(
+        {w for v in TOPICS.values() for w in v.split()}
+        | set("the report describes how a process can slowly change over time".split())
+    )
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", *words, "."]
+    (tmp_path / "vocab.txt").write_text("\n".join(vocab) + "\n")
+    transformers.BertTokenizer(
+        str(tmp_path / "vocab.txt"), do_lower_case=True
+    ).save_pretrained(str(tmp_path))
+    cfg = transformers.BertConfig(
+        vocab_size=len(vocab),
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        intermediate_size=64,
+        max_position_embeddings=128,
+        type_vocab_size=2,
+    )
+    torch.manual_seed(0)
+    transformers.BertModel(cfg).save_pretrained(str(tmp_path))
+
+    m = run_eval(make_retriever("dense", embedder_model=str(tmp_path)))
+    assert m["recall_at_5"] >= 0.7, m
+    assert m["mrr"] >= 0.55, m
