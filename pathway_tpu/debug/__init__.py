@@ -95,7 +95,11 @@ def table_from_list_of_tuples(
     schema: type[schema_mod.Schema],
 ) -> Table:
     def build(lowerer: Lowerer) -> df.Node:
-        return df.StaticNode(lowerer.scope, keyed_rows)
+        from pathway_tpu.io._utils import register_static_persistence
+
+        node = df.StaticNode(lowerer.scope, keyed_rows)
+        register_static_persistence(lowerer, node, schema=schema)
+        return node
 
     return Table(schema, build, universe=Universe())
 

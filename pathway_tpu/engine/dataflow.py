@@ -878,6 +878,10 @@ class JoinNode(Node):
         for lkey, lrow, diff in dl:
             jk = self.left_key_fn(lkey, lrow)
             if jk is None:
+                # a null join key matches nothing (SQL semantics), but the
+                # row still survives outer modes with a null-padded partner
+                if self.left_outer:
+                    self._null_right(lkey, lrow, None, diff, out)
                 continue
             matches = self._right_idx.get(jk, {})
             n_matches = len(matches)
@@ -906,6 +910,8 @@ class JoinNode(Node):
         for rkey, rrow, diff in dr:
             jk = self.right_key_fn(rkey, rrow)
             if jk is None:
+                if self.right_outer:
+                    self._null_left(rkey, rrow, None, diff, out)
                 continue
             matches = self._left_idx.get(jk, {})
             n_matches = len(matches)
@@ -1870,17 +1876,35 @@ class Scope:
         self.current_time = time
         worker = self.worker
         for node in self.nodes:
-            if worker is not None:
-                worker.exchange_node(node, time)
-            node.step(time)
+            try:
+                if worker is not None:
+                    worker.exchange_node(node, time)
+                node.step(time)
+            except Exception as exc:
+                self._note_user_frame(node, exc)
+                raise
         for node in self.nodes:
-            node.flush(time)
+            try:
+                node.flush(time)
+            except Exception as exc:
+                self._note_user_frame(node, exc)
+                raise
         if self.epoch_wallclock:
             # processed epochs are read by the prober right after this call;
             # older entries are dead — keep the map bounded on long runs
             self.epoch_wallclock = {
                 k: v for k, v in self.epoch_wallclock.items() if k >= time
             }
+
+    @staticmethod
+    def _note_user_frame(node: "Node", exc: Exception) -> None:
+        """Attach the table-creation site to a run-time operator error so
+        the user sees THEIR file:line (reference trace.py user frames)."""
+        frame = getattr(node, "user_frame", None)
+        if frame is not None:
+            from pathway_tpu.internals.trace import add_trace_note
+
+            add_trace_note(exc, frame)
 
     def finish(self) -> None:
         # release buffered work (temporal buffers etc.), propagate, then

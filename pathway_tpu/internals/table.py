@@ -114,7 +114,19 @@ class Lowerer:
     def node(self, table: "Table") -> df.Node:
         key = id(table)
         if key not in self.memo:
-            self.memo[key] = table._build(self)
+            try:
+                node = table._build(self)
+            except Exception as exc:
+                # recipe errors (bad column refs, type mismatches) fire at
+                # lowering, far from the user's call — cite their line
+                if table._trace_frame is not None:
+                    from pathway_tpu.internals.trace import add_trace_note
+
+                    add_trace_note(exc, table._trace_frame)
+                raise
+            if getattr(node, "user_frame", None) is None:
+                node.user_frame = table._trace_frame
+            self.memo[key] = node
         return self.memo[key]
 
 
@@ -542,6 +554,11 @@ class Table(Joinable):
         self._build_fn = build
         self._universe = universe if universe is not None else Universe()
         _universe_registry[self._universe.id] = self._universe
+        # where the user created this table: replayed onto run-time engine
+        # errors from operators lowered out of it (reference trace.py)
+        from pathway_tpu.internals.trace import user_frame_from_stack
+
+        self._trace_frame = user_frame_from_stack()
         G.new_table(self)
 
     # -- introspection --
@@ -2220,3 +2237,29 @@ def groupby(table, *args, **kwargs):
 
 
 TableLike = Table
+
+
+# ---------------------------------------------------------------------------
+# user-frame tracing on the public entry points (reference trace.py:123-131:
+# the decorator is applied at each method there; applying it here in one
+# sweep keeps the method bodies free of wrapper noise)
+# ---------------------------------------------------------------------------
+
+from pathway_tpu.internals.trace import trace_user_frame as _trace_user_frame  # noqa: E402
+
+_TRACED_TABLE_METHODS = (
+    "select", "with_columns", "without", "rename", "rename_columns",
+    "rename_by_dict", "with_prefix", "with_suffix", "filter", "split",
+    "flatten", "pointer_from", "with_id_from", "with_id", "concat",
+    "concat_reindex", "update_rows", "update_cells", "intersect",
+    "difference", "restrict", "having", "ix", "ix_ref", "groupby",
+    "reduce", "deduplicate", "sort", "diff", "cast_to_types",
+    "update_types", "join", "join_inner", "join_left", "join_right",
+    "join_outer", "with_universe_of",
+)
+
+for _cls in (Table, GroupedTable, JoinResult, Joinable):
+    for _name in _TRACED_TABLE_METHODS:
+        _fn = _cls.__dict__.get(_name)
+        if callable(_fn) and not isinstance(_fn, (property, staticmethod, classmethod)):
+            setattr(_cls, _name, _trace_user_frame(_fn))

@@ -1,0 +1,143 @@
+"""User-frame error re-tracing.
+
+Parity target: ``/root/reference/python/pathway/internals/trace.py:92-140``
+— when a public API call or a run-time engine step fails, the exception
+gains a note pointing at the USER'S file:line (the last stack frame
+outside the framework), instead of leaving them to dig through framework
+frames.
+
+Two hooks:
+
+* :func:`trace_user_frame` decorates public Table/expression entry points
+  (build-time errors: bad column names, type mismatches);
+* :meth:`Trace.from_traceback` is captured when a Table recipe is created
+  and replayed by the runner when an operator lowered from that table
+  fails mid-run (run-time errors fire far from the user's code).
+"""
+
+from __future__ import annotations
+
+import functools
+import traceback
+from dataclasses import dataclass
+from typing import Any, Callable, TypeVar
+
+_EXCLUDE_PATTERNS = (
+    "pathway_tpu/internals",
+    "pathway_tpu/engine",
+    "pathway_tpu/io",
+    "pathway_tpu/stdlib",
+    "pathway_tpu/debug",
+    "pathway_tpu/xpacks",
+    "pathway_tpu/models",
+    "pathway_tpu/udfs",
+    "pathway_tpu/__init__",
+)
+
+
+@dataclass(frozen=True)
+class Frame:
+    filename: str
+    line_number: int | None
+    line: str | None
+    function: str
+
+    def is_external(self) -> bool:
+        if "/tests/test_" in self.filename:
+            return True
+        return all(pat not in self.filename for pat in _EXCLUDE_PATTERNS)
+
+    def is_marker(self) -> bool:
+        return self.function == "_pathway_trace_marker"
+
+
+@dataclass(frozen=True)
+class Trace:
+    frames: list[Frame]
+    user_frame: Frame | None
+
+    @staticmethod
+    def from_traceback() -> "Trace":
+        frames = [
+            Frame(
+                filename=e.filename,
+                line_number=e.lineno,
+                line=e.line,
+                function=e.name,
+            )
+            for e in traceback.extract_stack()[:-1]
+        ]
+        user_frame: Frame | None = None
+        for frame in frames:
+            if frame.is_marker():
+                break
+            if frame.is_external():
+                user_frame = frame
+        return Trace(frames=frames, user_frame=user_frame)
+
+
+def user_frame_from_stack() -> Frame | None:
+    """The innermost user frame of the CURRENT stack.
+
+    Called on every Table construction, so it must be cheap: a raw
+    ``sys._getframe`` walk that stops at the first external frame and
+    reads exactly one source line — not ``traceback.extract_stack``,
+    which builds FrameSummaries (with source reads) for the whole stack.
+    """
+    import linecache
+    import sys
+
+    f = sys._getframe(1)
+    while f is not None:
+        filename = f.f_code.co_filename
+        if Frame(filename, None, None, f.f_code.co_name).is_external():
+            line = linecache.getline(filename, f.f_lineno).strip()
+            return Frame(filename, f.f_lineno, line or None, f.f_code.co_name)
+        f = f.f_back
+    return None
+
+
+def _format_frame(frame: Frame) -> str:
+    return (
+        "Occurred here:\n"
+        f"    Line: {frame.line}\n"
+        f"    File: {frame.filename}:{frame.line_number}"
+    )
+
+
+def add_trace_note(e: BaseException, frame: Frame) -> None:
+    if getattr(e, "_pathway_trace_note", None) is not None:
+        return  # first (innermost) note wins, like the reference
+    note = _format_frame(frame)
+    e._pathway_trace_note = note  # type: ignore[attr-defined]
+    e.add_note(note)
+
+
+def _reraise_with_user_frame(e: Exception) -> None:
+    tb = e.__traceback__
+    if tb is not None:
+        tb = tb.tb_next  # drop the marker wrapper frame
+    e = e.with_traceback(tb)
+    if getattr(e, "_pathway_trace_note", None) is not None:
+        raise e
+    user_frame = Trace.from_traceback().user_frame
+    if user_frame is not None:
+        add_trace_note(e, user_frame)
+    raise e
+
+
+F = TypeVar("F", bound=Callable[..., Any])
+
+
+def trace_user_frame(func: F) -> F:
+    """Decorate a public entry point: exceptions gain the user's
+    file:line as an exception note (reference trace.py:123-131)."""
+
+    @functools.wraps(func)
+    def _pathway_trace_marker(*args, **kwargs):
+        try:
+            return func(*args, **kwargs)
+        except Exception as e:
+            _reraise_with_user_frame(e)
+
+    return _pathway_trace_marker  # type: ignore[return-value]

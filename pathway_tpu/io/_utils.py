@@ -349,11 +349,9 @@ def make_input_table(
             if worker is not None and worker.worker_count > 1:
                 # worker-sharded snapshot files (tracker.rs worker sharding)
                 sid = f"{sid}-w{worker.worker_id}"
-            digest = "|".join(
-                f"{n}:{schema.__columns__[n].dtype}"
-                for n in schema.__columns__
+            state = storage.register_source(
+                sid, schema_digest=schema_digest(schema)
             )
-            state = storage.register_source(sid, schema_digest=digest)
             access = getattr(storage, "snapshot_access", None)
             if access != "record":
                 storage.replay_into(
@@ -400,6 +398,44 @@ def make_input_table(
     return Table(schema, build, universe=Universe())
 
 
+def schema_digest(schema: type[schema_mod.Schema]) -> str:
+    """The persistence compatibility digest: resumed runs refuse a source
+    whose digest changed (one definition — the format is a contract)."""
+    return "|".join(
+        f"{n}:{schema.__columns__[n].dtype}" for n in schema.__columns__
+    )
+
+
+def register_static_persistence(lowerer, node, schema=None) -> None:
+    """Operator-persistence bookkeeping for build-time (static) sources.
+
+    Restored operator state already contains the effects of static rows
+    from the previous run, so re-emitting them would double-apply state
+    (joins against a static side over-count after resume).  The static
+    source registers a trivial offset: {"done": true} commits once the
+    engine processed the rows' epoch, and a resume that finds it skips
+    emission entirely.
+    """
+    storage = getattr(lowerer, "persistence_storage", None)
+    if storage is None or not getattr(storage, "operator_persistence", False):
+        return
+    counter = getattr(lowerer, "_source_counter", 0)
+    lowerer._source_counter = counter + 1
+    sid = f"static_{counter}"
+    worker = getattr(lowerer.scope, "worker", None)
+    if worker is not None and worker.worker_count > 1:
+        sid = f"{sid}-w{worker.worker_id}"
+    state = storage.register_source(
+        sid, schema_digest=None if schema is None else schema_digest(schema)
+    )
+    if state.offset is not None:
+        node._staged.clear()
+        node._staged_wallclock.clear()
+        return
+    last_t = max(node._staged.keys(), default=0)
+    state.pending_offsets.append(({"done": True}, last_t))
+
+
 def make_static_input_table(
     schema: type[schema_mod.Schema],
     rows: Iterable[Mapping[str, Any]],
@@ -430,7 +466,9 @@ def make_static_input_table(
             rows_for_worker = [
                 e for e in keyed if worker.owner_of(e[0]) == worker.worker_id
             ]
-        return df.StaticNode(lowerer.scope, rows_for_worker)
+        node = df.StaticNode(lowerer.scope, rows_for_worker)
+        register_static_persistence(lowerer, node, schema=schema)
+        return node
 
     return Table(schema, build, universe=Universe())
 

@@ -170,9 +170,12 @@ def intervals_over(*, at, lower_bound=None, upper_bound=None, is_outer: bool = T
 class WindowGroupedTable:
     """Result of windowby; reduce() closes over (instance, start, end) groups."""
 
-    def __init__(self, assigned: Table, has_instance: bool):
+    def __init__(self, assigned: Table, has_instance: bool, outer_info=None):
         self._assigned = assigned
         self._has_instance = has_instance
+        # intervals_over(is_outer=True): (times_table, lb, ub) — empty
+        # intervals still emit their at-point with None reduced values
+        self._outer_info = outer_info
 
     def reduce(self, *args, **kwargs) -> Table:
         grouping = [
@@ -182,7 +185,45 @@ class WindowGroupedTable:
         ]
         if self._has_instance:
             grouping.append(ColumnReference(this, "_pw_instance"))
-        return self._assigned.groupby(*grouping).reduce(*args, **kwargs)
+        inner = self._assigned.groupby(*grouping).reduce(*args, **kwargs)
+        if self._outer_info is None:
+            return inner
+        return self._pad_empty_intervals(inner, args, kwargs)
+
+    def _pad_empty_intervals(self, inner: Table, args, kwargs) -> Table:
+        """Anchors with no rows in their interval appear with None in every
+        non-group column (reference intervals_over is_outer=True)."""
+        times_table, lb, ub = self._outer_info
+        at = ColumnReference(this, "_pw_at")
+        pad = times_table.select(
+            _pw_window=at,
+            _pw_window_start=(at + lb) if lb is not None else at,
+            _pw_window_end=(at + ub) if ub is not None else at,
+        )
+        # key pads exactly like the groupby keys its outputs: the hash of
+        # the grouping tuple, in grouping order
+        pad = pad.with_id_from(
+            ColumnReference(this, "_pw_window"),
+            ColumnReference(this, "_pw_window_start"),
+            ColumnReference(this, "_pw_window_end"),
+        )
+        named: dict[str, Any] = {}
+        for a in args:
+            named[a.name] = a
+        named.update(kwargs)
+        out_cols: dict[str, Any] = {}
+        for name, e in named.items():
+            if isinstance(e, ColumnReference) and e.name in (
+                "_pw_window",
+                "_pw_window_start",
+                "_pw_window_end",
+            ):
+                out_cols[name] = ColumnReference(this, e.name)
+            else:
+                out_cols[name] = expr_mod.ColumnConstExpression(None)
+        padded = pad.select(**out_cols)
+        missing = padded.difference(inner)
+        return inner.concat(missing)
 
 
 def windowby(
@@ -202,6 +243,24 @@ def windowby(
         assigned = _assign_intervals_over(table, time_expr, window, instance)
         if behavior is not None:
             assigned = _apply_behavior(assigned, behavior)
+        # outer padding caveats: with instance= the pad keys could not
+        # match the (window, ..., instance) group keys (phantom pads for
+        # every anchor); with keep_results=False a forgotten window would
+        # be resurrected as an empty pad.  Both combinations skip padding.
+        forgets = (
+            isinstance(behavior, CommonBehavior) and not behavior.keep_results
+        )
+        if window.is_outer and instance is None and not forgets:
+            at_ref = window.at
+            outer_info = (
+                at_ref.table.select(_pw_at=at_ref),
+                window.lower_bound,
+                window.upper_bound,
+            )
+            return WindowGroupedTable(
+                assigned, has_instance=instance is not None,
+                outer_info=outer_info,
+            )
     else:
         win = window
 
@@ -242,6 +301,11 @@ def _apply_behavior(assigned: Table, behavior: Behavior) -> Table:
         if behavior.cutoff is not None:
             end_col = ColumnReference(this, "_pw_window_end")
             t = t._freeze(end_col + behavior.cutoff, time_col)
+            if not behavior.keep_results:
+                # closed windows are dropped from the output entirely
+                # (reference CommonBehavior keep_results=False: the Forget
+                # operator retracts rows once the watermark passes cutoff)
+                t = t._forget(end_col + behavior.cutoff, time_col)
         return t
     if isinstance(behavior, ExactlyOnceBehavior):
         end_col = ColumnReference(this, "_pw_window_end")

@@ -1,0 +1,185 @@
+"""Persistence crash matrix (model: the reference's recovery integration
+suites — ``integration_tests/wordcount/test_recovery.py`` and the Rust
+``test_seek.rs``/``test_operator_persistence.rs`` matrices): SIGKILL ×
+{pipeline shape} × {persistence mode}, plus a double-crash run.  Every
+cell must resume to exactly-once final state.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import signal
+import time
+from pathlib import Path
+
+import pytest
+
+N_ROWS = 24
+ROW_DELAY_S = 0.04
+
+
+def _build_pipeline(pw, shape: str, t):
+    if shape == "groupby":
+        return t.groupby(t.k).reduce(k=t.k, n=pw.reducers.count())
+    if shape == "join":
+        sides = pw.debug.table_from_markdown(
+            """
+            k | name
+            0 | zero
+            1 | one
+            2 | two
+            """
+        )
+        joined = t.join(sides, t.k == sides.k).select(name=sides.name, v=t.v)
+        return joined.groupby(pw.this.name).reduce(
+            k=pw.this.name, n=pw.reducers.sum(pw.this.v)
+        )
+    if shape == "deduplicate":
+        dedup = t.deduplicate(value=t.k, acceptor=lambda new, old: True)
+        return dedup.groupby(dedup.k).reduce(k=dedup.k, n=pw.reducers.count())
+    raise ValueError(shape)
+
+
+def _worker(pstore: str, out_path: str, shape: str, mode: str, n_rows: int, row_delay: float):
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+    import pathway_tpu as pw
+
+    pw.internals.parse_graph.G.clear()
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(n_rows):
+                self.next(k=i % 3, v=1)
+                self.commit()
+                if row_delay:
+                    time.sleep(row_delay)
+
+    t = pw.io.python.read(
+        Src(), schema=pw.schema_from_types(k=int, v=int), name="src"
+    )
+    result = _build_pipeline(pw, shape, t)
+    pw.io.jsonlines.write(result, out_path)
+    pw.run(
+        persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem(pstore),
+            snapshot_interval_ms=50,
+            persistence_mode=(
+                pw.PersistenceMode.OPERATOR_PERSISTING
+                if mode == "operator"
+                else None
+            ),
+        )
+    )
+
+
+def _net_state(path: str) -> dict:
+    state: dict = {}
+    for line in Path(path).read_text().splitlines():
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue  # torn tail write from a kill
+        diff = obj.pop("diff")
+        obj.pop("time", None)
+        key = obj["k"]
+        if diff > 0:
+            state[key] = obj["n"]
+        elif state.get(key) == obj["n"]:
+            del state[key]
+    return state
+
+
+_EXPECTED = {
+    "groupby": {0: 8, 1: 8, 2: 8},
+    "join": {"zero": 8, "one": 8, "two": 8},
+    "deduplicate": None,  # dedup keeps one live k; checked structurally
+}
+
+
+def _kill_resume(tmp_path, shape: str, mode: str, kills: int = 1):
+    pstore = str(tmp_path / "pstore")
+    ctx = multiprocessing.get_context("fork")
+    outs = []
+    for attempt in range(kills):
+        out = str(tmp_path / f"out{attempt}.jsonl")
+        outs.append(out)
+        p = ctx.Process(
+            target=_worker,
+            args=(pstore, out, shape, mode, N_ROWS, ROW_DELAY_S),
+            daemon=True,
+        )
+        p.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if os.path.exists(out) and Path(out).stat().st_size > 0:
+                break
+            time.sleep(0.02)
+        else:
+            p.terminate()
+            pytest.fail(f"worker {attempt} produced no output within 30s")
+        time.sleep(3 * ROW_DELAY_S)
+        os.kill(p.pid, signal.SIGKILL)
+        p.join(10)
+        if p.exitcode == 0:
+            # run finished before the kill: rare on CI — the attempt still
+            # proves resume-from-complete, continue to the final check
+            break
+        assert p.exitcode == -signal.SIGKILL, p.exitcode
+
+    final_out = str(tmp_path / "final.jsonl")
+    p = ctx.Process(
+        target=_worker,
+        args=(pstore, final_out, shape, mode, N_ROWS, 0.0),
+        daemon=True,
+    )
+    p.start()
+    p.join(60)
+    assert p.exitcode == 0, p.exitcode
+    return _net_state(final_out)
+
+
+@pytest.mark.parametrize("mode", ["input", "operator"])
+@pytest.mark.parametrize("shape", ["groupby", "join"])
+def test_kill_resume_matrix(tmp_path, shape, mode):
+    state = _kill_resume(tmp_path, shape, mode)
+    assert state == _EXPECTED[shape], (shape, mode, state)
+
+
+@pytest.mark.parametrize("mode", ["input", "operator"])
+def test_double_crash_then_resume(tmp_path, mode):
+    """Two consecutive SIGKILLs (crash during recovery territory) must
+    still converge to exactly-once totals."""
+    state = _kill_resume(tmp_path, "groupby", mode, kills=2)
+    assert state == _EXPECTED["groupby"], (mode, state)
+
+
+@pytest.mark.parametrize("mode", ["input", "operator"])
+def test_deduplicate_state_survives_kill(tmp_path, mode):
+    state = _kill_resume(tmp_path, "deduplicate", mode)
+    # deduplicate(acceptor=always) keeps exactly one live row; count 1
+    assert list(state.values()) == [1], (mode, state)
+
+
+def test_resume_from_clean_finish_is_noop(tmp_path):
+    """Resuming after a COMPLETE run must not re-emit or double-count."""
+    pstore = str(tmp_path / "pstore")
+    out1 = str(tmp_path / "a.jsonl")
+    out2 = str(tmp_path / "b.jsonl")
+    ctx = multiprocessing.get_context("fork")
+    for out in (out1, out2):
+        p = ctx.Process(
+            target=_worker, args=(pstore, out, "groupby", "input", N_ROWS, 0.0),
+            daemon=True,
+        )
+        p.start()
+        p.join(60)
+        assert p.exitcode == 0
+    assert _net_state(out1) == _EXPECTED["groupby"]
+    assert _net_state(out2) == _EXPECTED["groupby"]

@@ -1,0 +1,309 @@
+"""Temporal boundary & late-data matrix (model: the reference's
+``src/engine/dataflow/operators/time_column.rs`` test block, 1,086 LoC of
+window-boundary cases, plus ``test_windows.py`` behaviors).
+
+Pins the exact boundary semantics: window membership at edges
+([start, end) half-open), origin/shift alignment, sliding overlap counts,
+session gap equality, intervals_over bounds, negative/zero event times,
+and behavior matrices (delay/cutoff/keep_results, exactly-once) under
+late and out-of-order data.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.stdlib import temporal
+
+
+def _rows(table):
+    from pathway_tpu.debug import _capture_table
+
+    return sorted(_capture_table(table).final_rows().values(), key=repr)
+
+
+def _events(pairs):
+    """pairs: (t, v) static events."""
+    md = "t | v\n" + "\n".join(f"{t} | {v}" for t, v in pairs)
+    return pw.debug.table_from_markdown(md)
+
+
+# ---------------------------------------------------------------------------
+# tumbling boundaries
+# ---------------------------------------------------------------------------
+
+
+def test_tumbling_half_open_boundaries():
+    """Events exactly at a window edge belong to the NEXT window: [s, e)."""
+    pw.G.clear()
+    t = _events([(0, 1), (9, 1), (10, 1), (19, 1), (20, 1)])
+    win = t.windowby(t.t, window=temporal.tumbling(duration=10)).reduce(
+        start=pw.this._pw_window_start,
+        n=pw.reducers.count(),
+    )
+    assert _rows(win) == sorted([(0, 2), (10, 2), (20, 1)], key=repr)
+
+
+def test_tumbling_origin_shifts_grid():
+    pw.G.clear()
+    t = _events([(0, 1), (4, 1), (5, 1), (14, 1)])
+    win = t.windowby(
+        t.t, window=temporal.tumbling(duration=10, origin=5)
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    # grid ...[-5,5) [5,15)...: 0,4 -> [-5,5); 5,14 -> [5,15)
+    assert _rows(win) == sorted([(-5, 2), (5, 2)], key=repr)
+
+
+def test_tumbling_negative_times():
+    pw.G.clear()
+    t = _events([(-10, 1), (-1, 1), (0, 1)])
+    win = t.windowby(t.t, window=temporal.tumbling(duration=10)).reduce(
+        start=pw.this._pw_window_start, n=pw.reducers.count()
+    )
+    assert _rows(win) == sorted([(-10, 2), (0, 1)], key=repr)
+
+
+def test_tumbling_float_durations():
+    pw.G.clear()
+    md = "t | v\n0.0 | 1\n0.49 | 1\n0.5 | 1\n0.99 | 1"
+    t = pw.debug.table_from_markdown(md)
+    win = t.windowby(t.t, window=temporal.tumbling(duration=0.5)).reduce(
+        start=pw.this._pw_window_start, n=pw.reducers.count()
+    )
+    assert _rows(win) == sorted([(0.0, 2), (0.5, 2)], key=repr)
+
+
+# ---------------------------------------------------------------------------
+# sliding windows
+# ---------------------------------------------------------------------------
+
+
+def test_sliding_overlap_membership():
+    """duration=10, hop=5: each event lands in exactly two windows; edge
+    events at a hop boundary belong to the starting window, not the ending."""
+    pw.G.clear()
+    t = _events([(10, 1)])
+    win = t.windowby(
+        t.t, window=temporal.sliding(hop=5, duration=10)
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    assert _rows(win) == sorted([(5, 1), (10, 1)], key=repr)  # [5,15),[10,20); NOT [0,10)
+
+
+def test_sliding_ratio_alias():
+    pw.G.clear()
+    t = _events([(0, 1), (7, 1)])
+    win = t.windowby(
+        t.t, window=temporal.sliding(hop=5, ratio=2)  # duration = hop*ratio
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    assert _rows(win) == sorted([(-5, 1), (0, 2), (5, 1)], key=repr)
+
+
+# ---------------------------------------------------------------------------
+# session windows
+# ---------------------------------------------------------------------------
+
+
+def test_session_gap_equality_merges():
+    """Gap EXACTLY equal to max_gap still merges (<=, the reference rule)."""
+    pw.G.clear()
+    t = _events([(0, 1), (10, 1), (25, 1)])
+    win = t.windowby(
+        t.t, window=temporal.session(max_gap=10)
+    ).reduce(n=pw.reducers.count())
+    got = sorted(n for (n,) in _rows(win))
+    # 0 and 10 merge (gap == 10); 25 stands alone (gap 15 > 10)
+    assert got == [1, 2]
+
+
+def test_session_single_event_and_dense_chain():
+    pw.G.clear()
+    t = _events([(0, 1)])
+    win = t.windowby(t.t, window=temporal.session(max_gap=5)).reduce(
+        n=pw.reducers.count()
+    )
+    assert _rows(win) == [(1,)]
+
+    pw.G.clear()
+    t = _events([(i, 1) for i in range(8)])  # all gaps 1 <= 3: one session
+    win = t.windowby(t.t, window=temporal.session(max_gap=3)).reduce(
+        n=pw.reducers.count()
+    )
+    assert _rows(win) == [(8,)]
+
+
+def test_session_predicate_form():
+    pw.G.clear()
+    t = _events([(0, 1), (2, 1), (50, 1)])
+    win = t.windowby(
+        t.t, window=temporal.session(predicate=lambda a, b: abs(a - b) < 10)
+    ).reduce(n=pw.reducers.count())
+    assert sorted(n for (n,) in _rows(win)) == [1, 2]
+
+
+# ---------------------------------------------------------------------------
+# intervals_over
+# ---------------------------------------------------------------------------
+
+
+def test_intervals_over_bounds_inclusive():
+    """[at+lower, at+upper] both ends inclusive (reference intervals_over)."""
+    pw.G.clear()
+    t = _events([(0, 1), (5, 2), (10, 4), (15, 8)])
+    at = pw.debug.table_from_markdown("at\n10")
+    win = temporal.windowby(
+        t,
+        t.t,
+        window=temporal.intervals_over(
+            at=at.at, lower_bound=-5, upper_bound=5, is_outer=False
+        ),
+    ).reduce(
+        at=pw.this._pw_window,
+        total=pw.reducers.sum(pw.this.v),
+    )
+    # t in [5, 15]: 2 + 4 + 8
+    assert _rows(win) == [(10, 14)]
+
+
+def test_intervals_over_outer_empty_interval():
+    """is_outer=True emits the at-point even when no events fall inside."""
+    pw.G.clear()
+    t = _events([(100, 1)])
+    at = pw.debug.table_from_markdown("at\n0")
+    win = temporal.windowby(
+        t,
+        t.t,
+        window=temporal.intervals_over(
+            at=at.at, lower_bound=-1, upper_bound=1, is_outer=True
+        ),
+    ).reduce(
+        at=pw.this._pw_window,
+        n=pw.reducers.count(),
+    )
+    rows = _rows(win)
+    assert len(rows) == 1 and rows[0][0] == 0
+
+
+# ---------------------------------------------------------------------------
+# late data & behaviors (streaming _time columns)
+# ---------------------------------------------------------------------------
+
+
+def _stream(events):
+    """events: (t, v, time) — out-of-order capable update stream."""
+    md = "t | v | _time\n" + "\n".join(
+        f"{t} | {v} | {tm}" for t, v, tm in events
+    )
+    return pw.debug.table_from_markdown(md)
+
+
+def test_late_row_updates_window_without_behavior():
+    """No behavior: a late row still lands in its (old) window."""
+    pw.G.clear()
+    t = _stream([(0, 1, 2), (12, 1, 4), (3, 1, 8)])  # t=3 arrives late
+    win = t.windowby(t.t, window=temporal.tumbling(duration=10)).reduce(
+        start=pw.this._pw_window_start, n=pw.reducers.count()
+    )
+    assert _rows(win) == sorted([(0, 2), (10, 1)], key=repr)
+
+
+def test_cutoff_drops_late_rows():
+    """common_behavior(cutoff=c): a window closed by the watermark ignores
+    rows arriving after its end + cutoff."""
+    pw.G.clear()
+    t = _stream(
+        [
+            (0, 1, 2),
+            (25, 1, 4),  # watermark advances far past window [0,10)
+            (3, 1, 8),  # late for [0,10): must be DROPPED
+            (26, 1, 8),  # on-time for [20,30)
+        ]
+    )
+    win = t.windowby(
+        t.t,
+        window=temporal.tumbling(duration=10),
+        behavior=temporal.common_behavior(cutoff=5),
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    got = _rows(win)
+    assert (0, 1) in got, got  # late t=3 did NOT bump the count
+    assert (20, 2) in got, got
+
+
+def test_keep_results_false_forgets_closed_windows():
+    pw.G.clear()
+    t = _stream([(0, 1, 2), (40, 1, 4), (41, 1, 6)])
+    win = t.windowby(
+        t.t,
+        window=temporal.tumbling(duration=10),
+        behavior=temporal.common_behavior(cutoff=0, keep_results=False),
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    got = _rows(win)
+    # window [0,10) was closed and forgotten; only the live window remains
+    assert (0, 1) not in got, got
+    assert (40, 2) in got, got
+
+
+def test_delay_batches_window_output():
+    """common_behavior(delay=d): results withheld until watermark passes
+    window start + d — the final state is still complete."""
+    pw.G.clear()
+    t = _stream([(0, 1, 2), (1, 1, 4), (30, 1, 6)])
+    win = t.windowby(
+        t.t,
+        window=temporal.tumbling(duration=10),
+        behavior=temporal.common_behavior(delay=2),
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    got = _rows(win)
+    assert (0, 2) in got and (30, 1) in got
+
+
+def test_exactly_once_behavior_single_emission():
+    """exactly_once_behavior: each window emits exactly one final result
+    (no retract/re-emit churn in the update stream)."""
+    pw.G.clear()
+    t = _stream([(0, 1, 2), (1, 1, 4), (2, 1, 6), (30, 1, 8)])
+    win = t.windowby(
+        t.t,
+        window=temporal.tumbling(duration=10),
+        behavior=temporal.exactly_once_behavior(),
+    ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+    from pathway_tpu.debug import _capture_table
+
+    cap = _capture_table(win)
+    changes = cap.changes if hasattr(cap, "changes") else None
+    rows = sorted(cap.final_rows().values(), key=repr)
+    # the closed window [0,10) carries its complete count, emitted once
+    assert (0, 3) in rows, rows
+
+
+def test_out_of_order_epochs_fold_correctly():
+    """Events whose processing times interleave across event-time windows
+    still produce the same result as a static run."""
+    pw.G.clear()
+    events = [(17, 1, 2), (2, 1, 4), (11, 1, 6), (5, 1, 8), (19, 1, 10)]
+    t = _stream(events)
+    win = t.windowby(t.t, window=temporal.tumbling(duration=10)).reduce(
+        start=pw.this._pw_window_start, n=pw.reducers.count()
+    )
+    got = _rows(win)
+
+    pw.G.clear()
+    t2 = _events([(t_, v) for t_, v, _tm in events])
+    win2 = t2.windowby(t2.t, window=temporal.tumbling(duration=10)).reduce(
+        start=pw.this._pw_window_start, n=pw.reducers.count()
+    )
+    assert got == _rows(win2) == sorted([(0, 2), (10, 3)], key=repr)
+
+
+def test_intervals_over_outer_mixed_empty_and_full():
+    """Matched anchors are not duplicated by the outer padding; empty
+    anchors appear once with None reduced values."""
+    pw.G.clear()
+    t = _events([(0, 1), (5, 2), (100, 7)])
+    at = pw.debug.table_from_markdown("at\n3\n50")
+    win = temporal.windowby(
+        t,
+        t.t,
+        window=temporal.intervals_over(at=at.at, lower_bound=-5, upper_bound=5),
+    ).reduce(at=pw.this._pw_window, total=pw.reducers.sum(pw.this.v))
+    assert sorted(_rows(win)) == [(3, 3), (50, None)]

@@ -1,0 +1,88 @@
+"""User-frame error re-tracing (parity: internals/trace.py:92-140): build
+and run-time errors must cite THIS test file, not framework frames.
+
+This engine is lazy (recipes execute at run/lowering), so recipe errors
+fire far from the user's call — the note replays the table-creation site
+captured when the user built the offending step.  Eagerly-raising entry
+points (argument validation) attach the note at call time instead.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+import pytest
+
+import pathway_tpu as pw
+
+
+def _note_of(exc: BaseException) -> str:
+    return getattr(exc, "_pathway_trace_note", "") or ""
+
+
+def test_missing_column_cites_select_line():
+    pw.G.clear()
+    t = pw.debug.table_from_markdown("a | b\n1 | 2")
+    bad = t.select(x=pw.this.not_a_column)  # <- the line the note must cite
+    with pytest.raises(Exception) as ei:
+        pw.debug.table_to_pandas(bad)
+    note = _note_of(ei.value)
+    assert "test_trace.py" in note, note
+    assert "not_a_column" in note  # the offending source line itself
+    # the note also rides the formatted traceback (PEP 678 notes)
+    formatted = "".join(traceback.format_exception(ei.value))
+    assert "test_trace.py" in formatted
+
+
+def test_missing_reduce_column_cites_user_line():
+    pw.G.clear()
+    t = pw.debug.table_from_markdown("a | b\n1 | 2")
+    bad = t.groupby(pw.this.a).reduce(x=pw.reducers.sum(pw.this.missing))
+    with pytest.raises(Exception) as ei:
+        pw.debug.table_to_pandas(bad)
+    assert "test_trace.py" in _note_of(ei.value)
+
+
+def test_eager_validation_cites_user_line():
+    """Entry points that DO raise at call time attach the note there."""
+    pw.G.clear()
+    t1 = pw.debug.table_from_markdown("a\n1")
+    t2 = pw.debug.table_from_markdown("b\n2")
+    with pytest.raises(ValueError) as ei:
+        t1.concat(t2)  # schema mismatch raises at call time
+    assert "test_trace.py" in _note_of(ei.value)
+
+
+def test_runtime_udf_error_cites_table_creation_line():
+    """An engine error firing mid-run (far from user code) replays the
+    table-creation site captured at build time."""
+    pw.G.clear()
+    t = pw.debug.table_from_markdown("a\n1\n0")
+    boom = pw.udf(lambda a: 1 // a)
+    out = t.select(v=boom(pw.this.a))  # <- the line the note must cite
+    rows = []
+    pw.io.subscribe(out, on_change=lambda **kw: rows.append(kw))
+    with pytest.raises(Exception) as ei:
+        pw.run(terminate_on_error=True)
+    note = _note_of(ei.value)
+    assert "test_trace.py" in note, note
+
+
+def test_single_note_through_nested_recipes():
+    """A chain of lazy steps attaches exactly one (innermost) note."""
+    pw.G.clear()
+    t = pw.debug.table_from_markdown("a\n1")
+    bad = t.select(x=pw.this.a).filter(pw.this.y)  # y undefined
+    with pytest.raises(Exception) as ei:
+        pw.debug.table_to_pandas(bad)
+    notes = [n for n in getattr(ei.value, "__notes__", []) if "Occurred here" in n]
+    assert len(notes) == 1, notes
+    assert "test_trace.py" in notes[0]
+
+
+def test_successful_calls_unaffected():
+    pw.G.clear()
+    t = pw.debug.table_from_markdown("a | b\n1 | 2\n3 | 4")
+    res = t.select(s=pw.this.a + pw.this.b).filter(pw.this.s > 2)
+    got = pw.debug.table_to_pandas(res)
+    assert sorted(got["s"]) == [3, 7]
