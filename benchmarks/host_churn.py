@@ -28,7 +28,6 @@ N_KEYS = 5_000  # bounded key space -> constant churn after warm-up
 def build_pipeline(n_rows: int):
     import pathway_tpu as pw
     from pathway_tpu.engine import dataflow as df
-    from pathway_tpu.engine.types import sequential_key
     from pathway_tpu.internals.table import Lowerer, Table, Universe
 
     # upsert stream: row i replaces key i % N_KEYS — after the first
@@ -36,15 +35,19 @@ def build_pipeline(n_rows: int):
     schema = pw.schema_from_types(k=int, v=int)
 
     def build(lowerer: Lowerer) -> df.Node:
+        from pathway_tpu.engine.types import sequential_keys
+
         node = df.InputNode(lowerer.scope)
         node.upsert = True
         per_epoch = 50_000
+        # derive the key cycle once in bulk (native blake2b loop) — this is
+        # fixture setup, not engine work, and must not dominate the metric
+        key_cycle = sequential_keys(0, N_KEYS)
         t = 0
         for start in range(0, n_rows, per_epoch):
             t += 2
             for i in range(start, min(start + per_epoch, n_rows)):
-                key = sequential_key(i % N_KEYS)
-                node.insert(key, (i % N_KEYS, i), t)
+                node.insert(key_cycle[i % N_KEYS], (i % N_KEYS, i), t)
         node.finished = True
         return node
 
@@ -75,17 +78,28 @@ def run_once(n_rows: int) -> float:
 
 
 def main() -> None:
+    """Variance-tamed method: fixed work per window, median of 5 — the
+    container's run-to-run jitter (±15% observed) collapses to the median,
+    and the spread is reported so regressions are distinguishable from
+    noise."""
     n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
-    dt = run_once(n_rows)
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 5
+    run_once(min(n_rows, 100_000))  # warm caches/imports outside the timing
+    rates = sorted(n_rows / run_once(n_rows) for _ in range(reps))
+    median = rates[len(rates) // 2]
+    spread = (rates[-1] - rates[0]) / median if median else 0.0
     print(
         json.dumps(
             {
                 "metric": "host_churn_rows_per_sec",
-                "value": round(n_rows / dt, 1),
+                "value": round(median, 1),
                 "unit": "rows/s",
                 "rows": n_rows,
                 "keys": N_KEYS,
-                "seconds": round(dt, 3),
+                "reps": reps,
+                "spread": round(spread, 4),
+                "min": round(rates[0], 1),
+                "max": round(rates[-1], 1),
             }
         )
     )

@@ -333,17 +333,24 @@ class InputNode(Node):
             ew[time] = min(ew.get(time, wall), wall)
         deltas = self._staged.pop(time, [])
         if self.upsert:
+            # multiple updates of one key within an epoch must chain
+            # (each retracts the PREVIOUS value, not the epoch-start one):
+            # `seen` overlays committed state with this epoch's staged rows
             out = []
+            seen: dict[int, Row | None] = {}
+            state_get = self.state.get
+            _MISS = object()
             for key, row, diff in deltas:
+                prev = seen.get(key, _MISS)
+                if prev is _MISS:
+                    prev = state_get(key)
+                if prev is not None:
+                    out.append((key, prev, -1))
                 if diff > 0:
-                    prev = self.state.get(key)
-                    if prev is not None:
-                        out.append((key, prev, -1))
                     out.append((key, row, 1))
+                    seen[key] = row
                 else:
-                    prev = self.state.get(key)
-                    if prev is not None:
-                        out.append((key, prev, -1))
+                    seen[key] = None
             deltas = consolidate(out)
             self._update_state(deltas)
         else:

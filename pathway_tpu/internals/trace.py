@@ -22,17 +22,13 @@ import traceback
 from dataclasses import dataclass
 from typing import Any, Callable, TypeVar
 
-_EXCLUDE_PATTERNS = (
-    "pathway_tpu/internals",
-    "pathway_tpu/engine",
-    "pathway_tpu/io",
-    "pathway_tpu/stdlib",
-    "pathway_tpu/debug",
-    "pathway_tpu/xpacks",
-    "pathway_tpu/models",
-    "pathway_tpu/udfs",
-    "pathway_tpu/__init__",
-)
+import os as _os
+
+# everything under the installed package is framework code — excluding by
+# package root (not an enumerated subpackage list) means a frame inside
+# e.g. pathway_tpu/demo or pathway_tpu/ops can never masquerade as user
+# code when a new subpackage is added
+_PKG_ROOT = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))) + _os.sep
 
 
 @dataclass(frozen=True)
@@ -45,7 +41,7 @@ class Frame:
     def is_external(self) -> bool:
         if "/tests/test_" in self.filename:
             return True
-        return all(pat not in self.filename for pat in _EXCLUDE_PATTERNS)
+        return not self.filename.startswith(_PKG_ROOT) and "@beartype" not in self.filename
 
     def is_marker(self) -> bool:
         return self.function == "_pathway_trace_marker"

@@ -240,7 +240,10 @@ def windowby(
         if behavior is not None:
             assigned = _apply_behavior(assigned, behavior)
     elif isinstance(window, IntervalsOverWindow):
-        assigned = _assign_intervals_over(table, time_expr, window, instance)
+        times_table = window.at.table.select(_pw_at=window.at)
+        assigned = _assign_intervals_over(
+            table, time_expr, window, instance, times_table
+        )
         if behavior is not None:
             assigned = _apply_behavior(assigned, behavior)
         # outer padding caveats: with instance= the pad keys could not
@@ -251,9 +254,8 @@ def windowby(
             isinstance(behavior, CommonBehavior) and not behavior.keep_results
         )
         if window.is_outer and instance is None and not forgets:
-            at_ref = window.at
             outer_info = (
-                at_ref.table.select(_pw_at=at_ref),
+                times_table,
                 window.lower_bound,
                 window.upper_bound,
             )
@@ -390,12 +392,12 @@ def _assign_sessions(table: Table, time_expr, window: SessionWindow, instance) -
     )
 
 
-def _assign_intervals_over(table: Table, time_expr, window: IntervalsOverWindow, instance) -> Table:
+def _assign_intervals_over(
+    table: Table, time_expr, window: IntervalsOverWindow, instance, times_table: Table
+) -> Table:
     """intervals_over: windows centered at each value of ``window.at``."""
     from pathway_tpu.internals.thisclass import left as left_ph, right as right_ph
 
-    at_ref = window.at  # ColumnReference on the times table
-    times_table = at_ref.table.select(_pw_at=at_ref)
     base = table.with_columns(_pw_time=time_expr)
     if instance is not None:
         base = base.with_columns(_pw_instance=instance)

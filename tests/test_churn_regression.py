@@ -1,0 +1,24 @@
+"""Churn-regime throughput regression guard (VERDICT round-2 weak #5).
+
+The update-churn path (retraction-heavy upserts through consolidation +
+stateful groupby) must stay above a conservative floor.  The floor sits
+~3x under the measured median (515k rows/s on the dev container at 500k
+rows) so container jitter cannot trip it, while a real regression —
+losing the plain-row state fast path, the native consolidation, or the
+within-epoch upsert chaining — lands well below it.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+
+def test_churn_throughput_floor():
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from benchmarks.host_churn import run_once
+
+    n_rows = 200_000
+    run_once(50_000)  # warmup
+    rate = max(n_rows / run_once(n_rows) for _ in range(3))
+    assert rate > 150_000, f"churn throughput collapsed: {rate:,.0f} rows/s"

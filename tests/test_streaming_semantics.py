@@ -444,3 +444,52 @@ def test_window_join_retracts_pair_when_row_leaves():
     ).select(av=a.av, bv=b.bv)
     deltas = assert_stream_consistent(res)
     assert_snapshots(res, {2: [("a1", "b2")], 6: []}, deltas)
+
+
+def test_upsert_chains_within_one_epoch():
+    """Several upserts of one key inside a single epoch chain correctly:
+    each retracts the PREVIOUS value, so the net effect is last-write-wins
+    (was: every update retracted the epoch-start value, corrupting
+    downstream multiplicities — sum saw -3*old + v1+v2+v3)."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine import dataflow as df
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import run_pipeline_to_completion
+    from pathway_tpu.internals.table import Table, Universe
+
+    G.clear()
+    schema = pw.schema_from_types(k=int, v=int)
+
+    def build(lowerer):
+        node = df.InputNode(lowerer.scope)
+        node.upsert = True
+        node.insert(111, (1, 5), 2)
+        for v in (6, 7, 8):  # three same-epoch updates
+            node.insert(111, (1, v), 4)
+        node.insert(111, (1, 9), 6)  # update, delete, re-add in one epoch
+        node.insert(111, (1, 9), 6, -1)
+        node.insert(111, (1, 10), 6)
+        node.finished = True
+        return node
+
+    t = Table(schema, build, universe=Universe())
+    res = t.groupby(pw.this.k).reduce(
+        k=pw.this.k, n=pw.reducers.count(), total=pw.reducers.sum(pw.this.v)
+    )
+    got = []
+
+    def attach(lowerer, node):
+        return df.OutputNode(
+            lowerer.scope,
+            node,
+            on_data=lambda key, row, time, diff: got.append((row, diff)),
+        )
+
+    run_pipeline_to_completion([(res, attach)])
+    state = {}
+    for row, diff in got:
+        if diff > 0:
+            state[row[0]] = row
+        elif state.get(row[0]) == row:
+            del state[row[0]]
+    assert state == {1: (1, 1, 10)}, state

@@ -270,10 +270,13 @@ def test_exactly_once_behavior_single_emission():
     from pathway_tpu.debug import _capture_table
 
     cap = _capture_table(win)
-    changes = cap.changes if hasattr(cap, "changes") else None
     rows = sorted(cap.final_rows().values(), key=repr)
     # the closed window [0,10) carries its complete count, emitted once
     assert (0, 3) in rows, rows
+    # and the update stream shows NO retract/re-emit churn for it: one
+    # +1 delta, zero retractions
+    deltas = [(r, d) for (_k, r, _t, d) in cap.deltas if r[0] == 0]
+    assert deltas == [((0, 3), 1)], deltas
 
 
 def test_out_of_order_epochs_fold_correctly():
