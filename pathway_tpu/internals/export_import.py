@@ -128,12 +128,27 @@ class _ExportNode(df.OutputNode):
 
 
 def export_table(table: Table) -> ExportedTable:
-    """Register ``table`` for export from the CURRENT graph's next run.
+    r"""Register ``table`` for export from the CURRENT graph's next run.
 
     The handle fills while ``pw.run()`` executes and is complete once the
     run finishes; pass it to :func:`import_table` inside another graph
     (sequentially after ``G.clear()``, or on a concurrent run).
     Match: ``graph.rs:978`` ``export_table``.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('a | b\n1 | 2\n3 | 4')
+    >>> exported = pw.export_table(t.select(s=pw.this.a + pw.this.b))
+    >>> _ = pw.run()
+    >>> exported.done
+    True
+    >>> pw.G.clear()  # a NEW graph imports the finished handle
+    >>> imported = pw.import_table(exported)
+    >>> pw.debug.compute_and_print(imported, include_id=False)
+    s
+    3
+    7
     """
     exported = ExportedTable(table.schema)
 

@@ -11,9 +11,36 @@ from pathway_tpu.internals.thisclass import left as lp, right as rp, this
 
 
 def bellman_ford(vertices: Table, edges: Table, iteration_limit: int | None = None) -> Table:
-    """vertices: columns (is_source: bool); edges: (u, v, dist).
+    r"""Single-source shortest paths (parity: stdlib/graphs/bellman_ford).
 
-    Returns dist_from_source per vertex id.
+    ``vertices``: columns (is_source: bool); ``edges``: (u, v, dist) with
+    u/v pointing at vertex ids.  Returns dist_from_source per vertex id.
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> vertices = pw.debug.table_from_markdown('''
+    ...   | is_source
+    ... A | True
+    ... B | False
+    ... C | False
+    ... ''')
+    >>> edges = pw.debug.table_from_markdown('''
+    ... lu | lv | dist
+    ... A  | B  | 1.0
+    ... B  | C  | 2.0
+    ... A  | C  | 10.0
+    ... ''').select(
+    ...     u=vertices.pointer_from(pw.this.lu),
+    ...     v=vertices.pointer_from(pw.this.lv),
+    ...     dist=pw.this.dist,
+    ... )
+    >>> res = pw.graphs.bellman_ford(vertices, edges, iteration_limit=5)
+    >>> pw.debug.compute_and_print(res, include_id=False)
+    dist
+    0.0
+    1.0
+    3.0
     """
     initial = vertices.select(
         dist=expr_mod.if_else(this.is_source, 0.0, float("inf"))

@@ -150,7 +150,22 @@ class _Parser:
 
 
 def metadata_matches(filter_expression: str | None, metadata: Any) -> bool:
-    """Evaluate a filter expression against one document's metadata."""
+    """Evaluate a filter expression against one document's metadata
+    (the JMESPath-style filter language of DocumentStore queries).
+
+    Example:
+
+    >>> from pathway_tpu.stdlib.indexing.filters import metadata_matches
+    >>> meta = {"path": "/docs/a.pdf", "owner": "kim", "size": 4096}
+    >>> metadata_matches("owner == 'kim'", meta)
+    True
+    >>> metadata_matches("size > 10000", meta)
+    False
+    >>> metadata_matches("globmatch('/docs/*.pdf', path) && owner == 'kim'", meta)
+    True
+    >>> metadata_matches(None, meta)  # no filter matches everything
+    True
+    """
     if filter_expression is None or filter_expression == "":
         return True
     if isinstance(filter_expression, Json):

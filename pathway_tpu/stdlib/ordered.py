@@ -14,6 +14,20 @@ from pathway_tpu.internals.thisclass import this
 
 
 def diff(table: Table, timestamp, *values, instance=None) -> Table:
+    r"""Per-row difference vs the previous row in ``timestamp`` order
+    (parity: stdlib/ordered/diff).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('t | v\n1 | 10\n2 | 13\n4 | 19')
+    >>> r = pw.ordered.diff(t, pw.this.t, pw.this.v)
+    >>> pw.debug.compute_and_print(r.select(pw.this.t, pw.this.diff_v), include_id=False)
+    t | diff_v
+    1 | None
+    2 | 3
+    4 | 6
+    """
     sorted_t = table.sort(key=timestamp, instance=instance)
     exprs = {}
     for v in values:

@@ -17,7 +17,20 @@ class InterpolateMode(enum.Enum):
 def interpolate(
     table: Table, timestamp, *values, mode: InterpolateMode = InterpolateMode.LINEAR
 ) -> Table:
-    """Linear interpolation of missing values along the timestamp order."""
+    r"""Linear interpolation of missing values along the timestamp order
+    (parity: stdlib/statistical/interpolate).
+
+    Example:
+
+    >>> import pathway_tpu as pw
+    >>> t = pw.debug.table_from_markdown('t | v\n0 | 0.0\n2 |\n4 | 4.0')
+    >>> r = pw.statistical.interpolate(t, pw.this.t, pw.this.v)
+    >>> pw.debug.compute_and_print(r, include_id=False)
+    t | v
+    0 | 0.0
+    2 | 2.0
+    4 | 4.0
+    """
     sorted_t = table.sort(key=timestamp)
     t_name = timestamp.name if isinstance(timestamp, ColumnReference) else "_t"
 

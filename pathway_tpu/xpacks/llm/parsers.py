@@ -41,7 +41,23 @@ def chunk_elements(
     ``elements`` keeps one doc per element, ``paged`` groups by
     ``page_number``, ``by_title`` starts a chunk at each Title element,
     ``basic`` packs elements into ≤``max_characters`` chunks (soft break
-    at ``new_after_n_chars``)."""
+    at ``new_after_n_chars``).
+
+    Example:
+
+    >>> from pathway_tpu.xpacks.llm.parsers import chunk_elements
+    >>> els = [
+    ...     ("Intro", {"category": "Title", "page_number": 1}),
+    ...     ("First paragraph.", {"page_number": 1}),
+    ...     ("Methods", {"category": "Title", "page_number": 2}),
+    ... ]
+    >>> chunk_elements(els, "single")
+    [('Intro\\n\\nFirst paragraph.\\n\\nMethods', {})]
+    >>> [t for t, _m in chunk_elements(els, "by_title")]
+    ['Intro\\nFirst paragraph.', 'Methods']
+    >>> [m["page_number"] for _t, m in chunk_elements(els, "paged")]
+    [1, 2]
+    """
     if mode not in get_args(ChunkingMode):
         raise ValueError(
             f"Got {mode} for `chunking_mode`, but should be one of "
