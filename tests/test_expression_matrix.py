@@ -1,0 +1,312 @@
+"""Expression & dtype semantics matrix (model: the reference's
+test_common.py / test_expression_* mass — enumerated operator semantics,
+error poisoning, optional propagation, casts, datetime arithmetic).
+
+Complements the randomized columnar fuzz suite with PINNED cases: each
+test names the exact semantic rule it guards.
+"""
+
+from __future__ import annotations
+
+import datetime
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.types import ERROR
+
+
+def _one(build):
+    pw.G.clear()
+    t = build()
+    df = pw.debug.table_to_pandas(t)
+    assert len(df) == 1
+    return df.iloc[0].to_dict()
+
+
+def _md(md):
+    return pw.debug.table_from_markdown(md)
+
+
+# ---------------------------------------------------------------------------
+# arithmetic & error poisoning
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "expr_fn",
+    [
+        lambda t: t.a // 0,
+        lambda t: t.a / 0,
+        lambda t: t.a % 0,
+    ],
+    ids=["floordiv0", "truediv0", "mod0"],
+)
+def test_division_by_zero_poisons_to_error(expr_fn):
+    """Division by zero yields the ERROR value (Value::Error poisoning),
+    not an exception that kills the run."""
+    row = _one(lambda: _md("a\n7").select(x=expr_fn(_md_this())))
+    assert row["x"] is ERROR
+
+
+def _md_this():
+    return pw.this
+
+
+def test_fill_error_replaces_poison():
+    row = _one(lambda: _md("a\n7").select(x=pw.fill_error(pw.this.a // 0, -1)))
+    assert row["x"] == -1
+
+
+def test_error_propagates_through_arithmetic():
+    """ERROR in a subexpression poisons the enclosing expression."""
+    row = _one(
+        lambda: _md("a\n7").select(x=(pw.this.a // 0) + 100)
+    )
+    assert row["x"] is ERROR
+
+
+def test_python_modulo_semantics():
+    """% follows Python sign rules (reference uses Rust rem_euclid-adjusted
+    semantics matching Python's for the Python API)."""
+    row = _one(
+        lambda: _md("a | b\n-7 | 2").select(
+            m1=pw.this.a % pw.this.b, m2=pw.this.a % (-2)
+        )
+    )
+    assert row["m1"] == 1  # -7 % 2 == 1 in Python
+    assert row["m2"] == -1
+
+
+def test_floordiv_rounds_toward_negative_infinity():
+    row = _one(lambda: _md("a\n-7").select(x=pw.this.a // 2))
+    assert row["x"] == -4  # Python floor, not C truncation
+
+
+def test_int_overflow_is_bignum_not_wrap():
+    """Python ints never wrap; 2**62 * 4 must be exact."""
+    row = _one(lambda: _md("a\n4611686018427387904").select(x=pw.this.a * 4))
+    assert row["x"] == 2**64
+
+
+def test_mixed_int_float_promotes_to_float():
+    row = _one(lambda: _md("a | b\n3 | 0.5").select(x=pw.this.a + pw.this.b))
+    assert row["x"] == 3.5 and isinstance(row["x"], float)
+
+
+# ---------------------------------------------------------------------------
+# optionals / None
+# ---------------------------------------------------------------------------
+
+
+def test_coalesce_chain_takes_first_non_none():
+    row = _one(
+        lambda: _md("a | b | c\n | | 9").select(
+            x=pw.coalesce(pw.this.a, pw.this.b, pw.this.c)
+        )
+    )
+    assert row["x"] == 9
+
+
+def test_arithmetic_with_none_propagates_none():
+    row = _one(lambda: _md("a | b\n | 5").select(x=pw.this.a + pw.this.b))
+    assert row["x"] is None
+
+
+def test_is_none_and_is_not_none():
+    row = _one(
+        lambda: _md("a\nNone").select(
+            yes=pw.this.a.is_none(), no=pw.this.a.is_not_none()
+        )
+    )
+    assert row["yes"] is True and row["no"] is False
+
+
+def test_unwrap_raises_error_value_on_none():
+    row = _one(lambda: _md("a\nNone").select(x=pw.unwrap(pw.this.a)))
+    assert row["x"] is ERROR
+
+
+def test_if_else_branch_selection_does_not_poison():
+    """The untaken branch's error must not leak into the result."""
+    row = _one(
+        lambda: _md("a\n5").select(
+            x=pw.if_else(pw.this.a > 0, pw.this.a, pw.this.a // 0)
+        )
+    )
+    assert row["x"] == 5
+
+
+# ---------------------------------------------------------------------------
+# casts
+# ---------------------------------------------------------------------------
+
+
+def test_casts_between_scalar_types():
+    row = _one(
+        lambda: _md("a\n5").select(
+            f=pw.cast(float, pw.this.a),
+            s=pw.cast(str, pw.this.a),
+            b=pw.cast(bool, pw.this.a),
+        )
+    )
+    assert row["f"] == 5.0 and isinstance(row["f"], float)
+    assert row["s"] == "5"
+    assert row["b"] is True
+
+
+def test_cast_float_to_int_truncates():
+    row = _one(lambda: _md("a\n2.9").select(x=pw.cast(int, pw.this.a)))
+    assert row["x"] == 2
+
+
+def test_cast_str_to_int_parses():
+    row = _one(lambda: _md("a\n'42'").select(x=pw.cast(int, pw.this.a)))
+    assert row["x"] == 42
+
+
+# ---------------------------------------------------------------------------
+# strings
+# ---------------------------------------------------------------------------
+
+
+def test_string_namespace_surface():
+    row = _one(
+        lambda: _md("s\nHello World").select(
+            up=pw.this.s.str.upper(),
+            low=pw.this.s.str.lower(),
+            n=pw.this.s.str.len(),
+            sub=pw.this.s.str.slice(0, 5),
+            finds=pw.this.s.str.find("World"),
+            rep=pw.this.s.str.replace("World", "TPU"),
+            starts=pw.this.s.str.startswith("Hello"),
+            ends=pw.this.s.str.endswith("!"),
+        )
+    )
+    assert row["up"] == "HELLO WORLD"
+    assert row["low"] == "hello world"
+    assert row["n"] == 11
+    assert row["sub"] == "Hello"
+    assert row["finds"] == 6
+    assert row["rep"] == "Hello TPU"
+    assert row["starts"] is True and row["ends"] is False
+
+
+def test_string_concat_operator():
+    row = _one(
+        lambda: _md("a | b\nfoo | bar").select(x=pw.this.a + pw.this.b)
+    )
+    assert row["x"] == "foobar"
+
+
+# ---------------------------------------------------------------------------
+# datetimes / durations
+# ---------------------------------------------------------------------------
+
+
+def test_datetime_arithmetic():
+    pw.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(ts=pw.DateTimeNaive, d=pw.Duration),
+        [
+            (
+                datetime.datetime(2026, 7, 30, 12, 0),
+                datetime.timedelta(hours=3),
+            )
+        ],
+    )
+    out = t.select(
+        later=pw.this.ts + pw.this.d,
+        gap=(pw.this.ts + pw.this.d) - pw.this.ts,
+    )
+    row = pw.debug.table_to_pandas(out).iloc[0].to_dict()
+    assert row["later"] == datetime.datetime(2026, 7, 30, 15, 0)
+    assert row["gap"] == datetime.timedelta(hours=3)
+
+
+def test_dt_namespace_parts():
+    pw.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(ts=pw.DateTimeNaive),
+        [(datetime.datetime(2026, 7, 30, 12, 34, 56),)],
+    )
+    out = t.select(
+        y=pw.this.ts.dt.year(),
+        mo=pw.this.ts.dt.month(),
+        d=pw.this.ts.dt.day(),
+        h=pw.this.ts.dt.hour(),
+    )
+    row = pw.debug.table_to_pandas(out).iloc[0].to_dict()
+    assert (row["y"], row["mo"], row["d"], row["h"]) == (2026, 7, 30, 12)
+
+
+# ---------------------------------------------------------------------------
+# tuples / json
+# ---------------------------------------------------------------------------
+
+
+def test_make_tuple_and_indexing():
+    row = _one(
+        lambda: _md("a | b\n1 | 2").select(
+            t=pw.make_tuple(pw.this.a, pw.this.b, 7)
+        )
+    )
+    assert row["t"] == (1, 2, 7)
+
+
+def test_json_get_path():
+    pw.G.clear()
+    t = pw.debug.table_from_rows(
+        pw.schema_from_types(j=pw.Json),
+        [(pw.Json({"user": {"name": "kim", "tags": [1, 2]}}),)],
+    )
+    out = t.select(
+        name=pw.this.j.get("user").get("name"),
+        tag0=pw.this.j.get("user").get("tags").get(0),
+    )
+    row = pw.debug.table_to_pandas(out).iloc[0].to_dict()
+    assert row["name"].value == "kim"
+    assert row["tag0"].value == 1
+
+
+# ---------------------------------------------------------------------------
+# comparisons & booleans
+# ---------------------------------------------------------------------------
+
+
+def test_comparison_operators_full_set():
+    row = _one(
+        lambda: _md("a | b\n3 | 5").select(
+            lt=pw.this.a < pw.this.b,
+            le=pw.this.a <= 3,
+            gt=pw.this.a > pw.this.b,
+            ge=pw.this.b >= 5,
+            eq=pw.this.a == 3,
+            ne=pw.this.a != pw.this.b,
+        )
+    )
+    assert (row["lt"], row["le"], row["gt"], row["ge"], row["eq"], row["ne"]) == (
+        True,
+        True,
+        False,
+        True,
+        True,
+        True,
+    )
+
+
+def test_boolean_ops_and_not():
+    row = _one(
+        lambda: _md("a | b\nTrue | False").select(
+            conj=pw.this.a & pw.this.b,
+            disj=pw.this.a | pw.this.b,
+            inv=~pw.this.a,
+            xo=pw.this.a ^ pw.this.b,
+        )
+    )
+    assert (row["conj"], row["disj"], row["inv"], row["xo"]) == (
+        False,
+        True,
+        False,
+        True,
+    )
