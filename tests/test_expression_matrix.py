@@ -17,8 +17,7 @@ from pathway_tpu.engine.types import ERROR
 
 
 def _one(build):
-    pw.G.clear()
-    t = build()
+    t = build()  # conftest's autouse fixture clears G around every test
     df = pw.debug.table_to_pandas(t)
     assert len(df) == 1
     return df.iloc[0].to_dict()
@@ -36,21 +35,17 @@ def _md(md):
 @pytest.mark.parametrize(
     "expr_fn",
     [
-        lambda t: t.a // 0,
-        lambda t: t.a / 0,
-        lambda t: t.a % 0,
+        lambda: pw.this.a // 0,
+        lambda: pw.this.a / 0,
+        lambda: pw.this.a % 0,
     ],
     ids=["floordiv0", "truediv0", "mod0"],
 )
 def test_division_by_zero_poisons_to_error(expr_fn):
     """Division by zero yields the ERROR value (Value::Error poisoning),
     not an exception that kills the run."""
-    row = _one(lambda: _md("a\n7").select(x=expr_fn(_md_this())))
+    row = _one(lambda: _md("a\n7").select(x=expr_fn()))
     assert row["x"] is ERROR
-
-
-def _md_this():
-    return pw.this
 
 
 def test_fill_error_replaces_poison():
@@ -205,7 +200,6 @@ def test_string_concat_operator():
 
 
 def test_datetime_arithmetic():
-    pw.G.clear()
     t = pw.debug.table_from_rows(
         pw.schema_from_types(ts=pw.DateTimeNaive, d=pw.Duration),
         [
@@ -225,7 +219,6 @@ def test_datetime_arithmetic():
 
 
 def test_dt_namespace_parts():
-    pw.G.clear()
     t = pw.debug.table_from_rows(
         pw.schema_from_types(ts=pw.DateTimeNaive),
         [(datetime.datetime(2026, 7, 30, 12, 34, 56),)],
@@ -255,7 +248,6 @@ def test_make_tuple_and_indexing():
 
 
 def test_json_get_path():
-    pw.G.clear()
     t = pw.debug.table_from_rows(
         pw.schema_from_types(j=pw.Json),
         [(pw.Json({"user": {"name": "kim", "tags": [1, 2]}}),)],
