@@ -423,6 +423,23 @@ def load_hf_weights(model_name: str, params, config: EncoderConfig):
     return new_params
 
 
+def init_model_params(module, model_name: str, config: EncoderConfig, seed: int = 0):
+    """Deterministic init + local-checkpoint load: the ONE weight-loading
+    sequence shared by the single-chip and long-context encoders.
+
+    Returns ``(params, pretrained)``.
+    """
+    params = module.init(
+        jax.random.PRNGKey(seed),
+        jnp.zeros((1, 16), jnp.int32),
+        jnp.ones((1, 16), jnp.int32),
+    )
+    loaded = load_hf_weights(model_name, params, config)
+    if loaded is not None:
+        return jax.tree_util.tree_map(jnp.asarray, loaded), True
+    return params, False
+
+
 class _JitModel:
     """Shared machinery: init params, bucket shapes, jit per bucket."""
 
@@ -436,13 +453,9 @@ class _JitModel:
             model_name, self.config.vocab_size, self.config.max_len
         )
         self.max_batch = max_batch
-        rng = jax.random.PRNGKey(seed)
-        dummy = jnp.zeros((1, 16), dtype=jnp.int32)
-        self.params = self.module.init(rng, dummy, jnp.ones((1, 16), jnp.int32))
-        loaded = load_hf_weights(model_name, self.params, self.config)
-        self.pretrained = loaded is not None
-        if loaded is not None:
-            self.params = jax.tree_util.tree_map(jnp.asarray, loaded)
+        self.params, self.pretrained = init_model_params(
+            self.module, model_name, self.config, seed
+        )
         # Fused inference path (packed bf16 weights + pallas attention);
         # PATHWAY_FUSED_ENCODER=0 falls back to the stock module lowering.
         # `_infer_params` is whatever tree `_apply` consumes, so weight

@@ -1,0 +1,164 @@
+"""Long-context sentence encoding: sequence-sharded trunk over a mesh.
+
+The product consumer of ``parallel/ring_attention.py``: documents longer
+than one chip's comfortable sequence length are embedded by sharding the
+SEQUENCE axis of the BERT trunk across the device mesh — attention runs
+as a K/V ring (``ppermute`` per block with the online-softmax
+recurrence), while the per-token work (QKV/FFN matmuls, layernorms,
+gelu) stays local to each chip's sequence block under the same jit.
+Pooling is a masked mean whose cross-block reduction XLA lowers onto the
+mesh collectives.
+
+The reference has no long-context path at all (its embedders truncate at
+the model's max length); this module is TPU-native capability beyond the
+reference, wired into the xpack embedder via
+``SentenceTransformerEmbedder(mesh=...)``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.models.encoder import (
+    EncoderConfig,
+    SentenceEncoderModule,
+    _ln,
+    _pool,
+    config_for,
+    init_model_params,
+    pack_fast_params,
+)
+from pathway_tpu.models.tokenizer import load_tokenizer, pad_batch
+from pathway_tpu.parallel.ring_attention import ring_attention_traced
+
+
+def long_context_trunk(tree, input_ids, attention_mask, config: EncoderConfig, mesh, axis=None):
+    """BERT trunk with the sequence axis sharded over ``mesh``.
+
+    Activations stay 3-D ``[B, S, H]`` (the fused single-chip path uses
+    packed 2-D ``[B*S, H]``); attention is the ring kernel, everything
+    else is per-token and runs locally on each sequence block.
+    """
+    B, S = input_ids.shape
+    H = config.hidden
+    # beyond the checkpoint's position table, positions tile (chunk-local
+    # positions — the standard long-document extension for absolute-
+    # position BERT checkpoints; exact for S <= max_len)
+    n_pos = tree["emb_pos"].shape[0]
+    pos_ids = jnp.arange(S) % n_pos
+    x = tree["emb_word"][input_ids] + tree["emb_pos"][pos_ids][None, :, :]
+    x = _ln(x, tree["eln_s"], tree["eln_b"])
+    bias = jnp.where(attention_mask > 0, 0.0, -1e9).astype(jnp.float32)
+    for lp in tree["layers"]:
+        qkv = x @ lp["qkv_k"] + lp["qkv_b"]  # [B, S, 3H]
+        ctx = ring_attention_traced(
+            mesh,
+            qkv[..., :H],
+            qkv[..., H : 2 * H],
+            qkv[..., 2 * H :],
+            bias,
+            config.heads,
+            axis,
+        )
+        x = _ln(x + ctx @ lp["out_k"] + lp["out_b"], lp["ln0_s"], lp["ln0_b"])
+        h = jax.nn.gelu(x @ lp["ff1_k"] + lp["ff1_b"], approximate=True)
+        x = _ln(x + h @ lp["ff2_k"] + lp["ff2_b"], lp["ln1_s"], lp["ln1_b"])
+    return x
+
+
+def long_context_sentence_apply(tree, input_ids, attention_mask, config: EncoderConfig, mesh, axis=None):
+    """Sequence-sharded equivalent of ``fused_sentence_apply``."""
+    x = long_context_trunk(tree, input_ids, attention_mask, config, mesh, axis)
+    pooled = _pool(x, attention_mask, config.pooling)
+    return pooled / (jnp.linalg.norm(pooled, axis=1, keepdims=True) + 1e-12)
+
+
+class LongContextSentenceEncoder:
+    """Text → embeddings with the sequence axis sharded over a mesh.
+
+    Same checkpoint/tokenizer handling as :class:`SentenceEncoder`; the
+    forward shards S over ``mesh`` so max_len scales with the number of
+    chips instead of one chip's HBM/compute.
+    """
+
+    def __init__(self, model_name: str = "all-MiniLM-L6-v2", mesh=None, *, axis=None, seed: int = 0, max_batch: int = 64):
+        if mesh is None:
+            raise ValueError("LongContextSentenceEncoder requires a jax Mesh")
+        self.mesh = mesh
+        self.axis = axis or mesh.axis_names[0]
+        self.config = config_for(model_name)
+        self.model_name = model_name
+        self.max_batch = max_batch
+        self.tokenizer = load_tokenizer(
+            model_name, self.config.vocab_size, self.config.max_len
+        )
+        module = SentenceEncoderModule(self.config)
+        params, self.pretrained = init_model_params(
+            module, model_name, self.config, seed
+        )
+        self._tree = pack_fast_params(params, self.config)
+        cfg, m, ax = self.config, self.mesh, self.axis
+        self._apply = jax.jit(
+            lambda tree, ids, mask: long_context_sentence_apply(
+                tree, ids, mask, cfg, m, ax
+            )
+        )
+
+    @property
+    def dimensions(self) -> int:
+        return self.config.hidden
+
+    def _bucket_seq(self, longest: int) -> int:
+        """Sequence bucket: doubling AND divisible by the mesh axis (the
+        ring needs equal blocks per chip) — the base is the smallest
+        multiple of the axis size >= 16, so every doubling stays
+        divisible for any axis size."""
+        n = self.mesh.shape[self.axis]
+        seq = n * max(1, -(-16 // n))
+        while seq < longest and seq < self.config.max_len * n:
+            seq *= 2
+        return seq
+
+    def encode(self, texts: list[str]) -> np.ndarray:
+        id_lists = [
+            self.tokenizer.encode(
+                t or "", max_length=self.config.max_len * self.mesh.shape[self.axis]
+            )
+            for t in texts
+        ]
+        longest = max((len(x) for x in id_lists), default=1)
+        seq = self._bucket_seq(longest)
+        out = []
+        for i in range(0, len(id_lists), self.max_batch):
+            chunk = id_lists[i : i + self.max_batch]
+            ids, mask = pad_batch(chunk, seq)
+            res = self._apply(
+                self._tree, jnp.asarray(ids), jnp.asarray(mask)
+            )
+            out.append(np.asarray(res)[: len(chunk)])
+        return np.concatenate(out, axis=0) if out else np.zeros((0, self.dimensions), np.float32)
+
+    def encode_one(self, text: str) -> np.ndarray:
+        return self.encode([text])[0]
+
+
+_SHARED: dict = {}
+
+
+def shared_long_context_encoder(
+    model_name: str, mesh, axis=None
+) -> LongContextSentenceEncoder:
+    """Per-(model, mesh) cache, mirroring ``shared_sentence_encoder`` —
+    repeated embedder construction must not reload weights or re-jit."""
+    key = (model_name, id(mesh), axis)
+    enc = _SHARED.get(key)
+    if enc is None:
+        enc = _SHARED[key] = LongContextSentenceEncoder(
+            model_name, mesh, axis=axis
+        )
+    return enc

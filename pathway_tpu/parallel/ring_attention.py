@@ -86,6 +86,30 @@ def _ring_attention_local(q, k, v, bias, *, heads: int, axis_name: str):
     return jnp.swapaxes(out, 1, 2).reshape(B, S_blk, H).astype(q.dtype)
 
 
+def ring_attention_traced(
+    mesh: Mesh, q, k, v, mask_bias, heads: int, axis: str | None = None
+):
+    """Jit-traceable form: same computation as
+    :func:`ring_encoder_attention` but without the eager ``device_put``
+    calls, so it composes inside a larger jitted forward (shard_map
+    splits the operands per ``in_specs`` itself).  Used by the
+    long-context encoder (``models/long_context.py``)."""
+    axis = axis or mesh.axis_names[0]
+    B, S, H = q.shape
+    n = mesh.shape[axis]
+    if S % n:
+        raise ValueError(f"sequence length {S} not divisible by mesh axis {n}")
+    spec3 = P(None, axis, None)
+    spec2 = P(None, axis)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, heads=heads, axis_name=axis),
+        mesh=mesh,
+        in_specs=(spec3, spec3, spec3, spec2),
+        out_specs=spec3,
+    )
+    return fn(q, k, v, mask_bias)
+
+
 def ring_encoder_attention(
     mesh: Mesh, q, k, v, mask_bias, heads: int, axis: str | None = None
 ):
@@ -101,23 +125,22 @@ def ring_encoder_attention(
       ctx ``[B, S, H]``, sharded like the inputs along ``S``.
     """
     axis = axis or mesh.axis_names[0]
-    B, S, H = q.shape
+    # eager entry point: pre-place the operands on the mesh, then run the
+    # same traced computation.  Check divisibility BEFORE device_put so
+    # the caller sees the actionable error, not a sharding failure.
     n = mesh.shape[axis]
-    if S % n:
-        raise ValueError(f"sequence length {S} not divisible by mesh axis {n}")
-    spec3 = P(None, axis, None)
-    spec2 = P(None, axis)
-    fn = shard_map(
-        functools.partial(_ring_attention_local, heads=heads, axis_name=axis),
-        mesh=mesh,
-        in_specs=(spec3, spec3, spec3, spec2),
-        out_specs=spec3,
-    )
-    sh3 = NamedSharding(mesh, spec3)
-    sh2 = NamedSharding(mesh, spec2)
-    return fn(
+    if q.shape[1] % n:
+        raise ValueError(
+            f"sequence length {q.shape[1]} not divisible by mesh axis {n}"
+        )
+    sh3 = NamedSharding(mesh, P(None, axis, None))
+    sh2 = NamedSharding(mesh, P(None, axis))
+    return ring_attention_traced(
+        mesh,
         jax.device_put(q, sh3),
         jax.device_put(k, sh3),
         jax.device_put(v, sh3),
         jax.device_put(mask_bias, sh2),
+        heads,
+        axis,
     )

@@ -47,13 +47,24 @@ class SentenceTransformerEmbedder(BaseEmbedder):
         device: str = "auto",
         *,
         max_batch_size: int = 256,
+        mesh=None,
         **init_kwargs,
     ):
         super().__init__(executor=async_executor(), deterministic=True)
         self.model_name = model
-        from pathway_tpu.models import shared_sentence_encoder
+        if mesh is not None:
+            # long-context mode: the sequence axis shards over the mesh
+            # (ring attention), so documents far beyond the model's
+            # max_len embed without truncation
+            from pathway_tpu.models.long_context import (
+                shared_long_context_encoder,
+            )
 
-        self._encoder = shared_sentence_encoder(model)
+            self._encoder = shared_long_context_encoder(model, mesh)
+        else:
+            from pathway_tpu.models import shared_sentence_encoder
+
+            self._encoder = shared_sentence_encoder(model)
         self._batcher = AsyncMicroBatcher(
             self._process_batch, max_batch_size=max_batch_size
         )
