@@ -133,9 +133,16 @@ _SPAWN_SETTINGS = {"allow_interspersed_args": False, "show_default": True}
 @click.option("--first-port", metavar="PORT", type=int, default=10000, help="base port of the worker TCP mesh")
 @click.option("--record", is_flag=True, help="capture every connector's input stream while running")
 @click.option("--record-path", type=str, default="record", help="where the captured stream is written")
+@click.option(
+    "--jax-distributed",
+    is_flag=True,
+    help="form a multi-host DEVICE mesh too: each process calls "
+    "jax.distributed.initialize so jax.devices() spans the cluster "
+    "(coordinator derived from the PATHWAY_* env)",
+)
 @click.argument("program")
 @click.argument("arguments", nargs=-1)
-def spawn(threads, processes, first_port, record, record_path, program, arguments):
+def spawn(threads, processes, first_port, record, record_path, jax_distributed, program, arguments):
     """Run PROGRAM as an SPMD cluster of identical processes."""
     env = (
         _recording_env(
@@ -144,6 +151,8 @@ def spawn(threads, processes, first_port, record, record_path, program, argument
         if record
         else os.environ.copy()
     )
+    if jax_distributed:
+        env["PATHWAY_JAX_DISTRIBUTED"] = "1"
     spawn_program(
         threads=threads,
         processes=processes,

@@ -26,8 +26,10 @@ from __future__ import annotations
 from pathway_tpu.parallel.mesh import (
     flat_axes,
     get_default_index_mesh,
+    initialize_distributed,
     make_mesh,
     mesh_shape_for,
+    put_global,
     set_default_index_mesh,
 )
 from pathway_tpu.parallel.sharding import (
@@ -44,6 +46,8 @@ from pathway_tpu.parallel.index import ShardedDeviceIndex, sharded_topk
 from pathway_tpu.parallel.ring_attention import ring_encoder_attention
 
 __all__ = [
+    "initialize_distributed",
+    "put_global",
     "make_mesh",
     "mesh_shape_for",
     "flat_axes",

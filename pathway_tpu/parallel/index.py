@@ -132,8 +132,10 @@ class ShardedDeviceIndex:
         mask = np.full((cap,), -np.inf, np.float32)
         mask[: self._n] = 0.0
         axes = tuple(self.mesh.axis_names)
-        self._docs = jax.device_put(padded, NamedSharding(self.mesh, P(axes, None)))
-        self._mask = jax.device_put(mask, NamedSharding(self.mesh, P(axes)))
+        from pathway_tpu.parallel.mesh import put_global
+
+        self._docs = put_global(padded, NamedSharding(self.mesh, P(axes, None)))
+        self._mask = put_global(mask, NamedSharding(self.mesh, P(axes)))
         self._dirty = False
 
     def search(self, queries: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
@@ -144,7 +146,15 @@ class ShardedDeviceIndex:
                 np.zeros((q.shape[0], 0), np.float32),
             )
         self._sync()
-        q = jnp.asarray(np.atleast_2d(np.asarray(queries, np.float32)))
+        from pathway_tpu.parallel.mesh import put_global
+
+        # queries are replicated; route through put_global so a mesh that
+        # spans hosts still accepts them (device_put cannot target
+        # non-addressable devices)
+        q = put_global(
+            np.atleast_2d(np.asarray(queries, np.float32)),
+            NamedSharding(self.mesh, P(None, None)),
+        )
         k_eff = min(k, self._n)
         idx, vals = sharded_topk(self.mesh, self._docs, self._mask, q, k_eff)
         return np.asarray(idx), np.asarray(vals)

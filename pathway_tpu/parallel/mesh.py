@@ -10,11 +10,71 @@ are small enough that dp should dominate.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 from jax.sharding import Mesh
 
 AXES = ("data", "model")
+
+_DISTRIBUTED = False
+
+
+def initialize_distributed(
+    *,
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join the multi-host device runtime so ``jax.devices()`` spans hosts.
+
+    The reference sizes its worker grid from the ``PATHWAY_*`` env
+    (``src/engine/dataflow/config.rs:88-120``) and its ``spawn`` CLI forks
+    processes with those variables set (``python/pathway/cli.py:53-110``);
+    here the same env powers ``jax.distributed.initialize`` so ``make_mesh``
+    returns a GLOBAL mesh and XLA collectives ride DCN between hosts (ICI
+    within one).  Resolution order per field: explicit argument →
+    ``PATHWAY_DEVICE_COORDINATOR`` env → derived from the worker-cluster
+    config (first peer host, ``first_port + 1000`` — off the TCP-mesh port
+    range).  Returns False (no-op) for single-process runs; idempotent.
+    """
+    global _DISTRIBUTED
+    if _DISTRIBUTED:
+        return True
+    from pathway_tpu.internals.config import get_config
+
+    cfg = get_config()
+    nproc = cfg.processes if num_processes is None else num_processes
+    pid = cfg.process_id if process_id is None else process_id
+    if nproc <= 1:
+        return False
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("PATHWAY_DEVICE_COORDINATOR")
+    if coordinator_address is None:
+        host = (cfg.peer_hosts[0] if cfg.peer_hosts else "127.0.0.1")
+        coordinator_address = f"{host}:{cfg.first_port + 1000}"
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=nproc,
+        process_id=pid,
+    )
+    _DISTRIBUTED = True
+    return True
+
+
+def put_global(arr: np.ndarray, sharding) -> jax.Array:
+    """``device_put`` that also works when the mesh spans hosts.
+
+    Multi-host: every process holds the full host-side array (the SPMD
+    "every worker builds the same data" invariant) and each device reads
+    its own slice via ``make_array_from_callback`` — ``jax.device_put``
+    alone cannot target non-addressable devices.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(arr.shape, sharding, lambda idx: arr[idx])
 
 
 def mesh_shape_for(n_devices: int, max_model: int = 2) -> tuple[int, int]:

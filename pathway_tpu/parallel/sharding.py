@@ -12,6 +12,8 @@ from __future__ import annotations
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pathway_tpu.parallel.mesh import put_global
+
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
@@ -35,7 +37,7 @@ def shard_params(params, mesh: Mesh):
 
     def place(path, leaf):
         spec = _spec_for(tuple(k.key if hasattr(k, "key") else str(k) for k in path), leaf, model_size)
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return put_global(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(place, params)
 
@@ -45,6 +47,6 @@ def shard_batch(batch, mesh: Mesh):
     sharding = NamedSharding(mesh, P("data"))
 
     def place(leaf):
-        return jax.device_put(leaf, sharding)
+        return put_global(leaf, sharding)
 
     return jax.tree_util.tree_map(place, batch)

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from pathway_tpu.parallel.mesh import put_global
 from pathway_tpu.parallel.sharding import shard_params
 
 
@@ -83,8 +84,10 @@ def make_contrastive_train_step(
     batch_sharding = NamedSharding(mesh, P("data"))
 
     def run(state: TrainState, ids_a, mask_a, ids_b, mask_b) -> tuple[TrainState, float]:
+        import numpy as _np
+
         args = [
-            jax.device_put(jnp.asarray(x, jnp.int32), batch_sharding)
+            put_global(_np.asarray(x, _np.int32), batch_sharding)
             for x in (ids_a, mask_a, ids_b, mask_b)
         ]
         params, opt_state, loss = step(state.params, state.opt_state, *args)
