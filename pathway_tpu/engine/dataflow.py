@@ -123,8 +123,18 @@ class Node:
     """A dataflow operator. Subclasses implement ``step``."""
 
     name: str = "node"
+    # Append-only dataflow analysis (parity: column properties threaded
+    # through lowering, python/pathway/internals/column_properties.py,
+    # consumed by the engine's append_only_or_deterministic switches,
+    # src/engine/dataflow.rs:1741): classes whose output stream is
+    # append-only whenever every input stream is set
+    # ``preserves_append_only``; ``infer_append_only`` fills the per-node
+    # flags after lowering, and stateful operators pick cheaper
+    # no-retraction accumulator variants off their input's flag.
+    preserves_append_only = False
 
     def __init__(self, scope: "Scope", inputs: Sequence["Node"] = ()):
+        self.append_only = False
         self.scope = scope
         self.inputs = list(inputs)
         self.downstream: list[tuple[Node, int]] = []
@@ -283,8 +293,25 @@ class Node:
         self.keep_state = True
         return self
 
+    def _infer_append_only(self) -> bool:
+        return (
+            self.preserves_append_only
+            and bool(self.inputs)
+            and all(i.append_only for i in self.inputs)
+        )
+
     def __repr__(self):
         return f"<{self.__class__.__name__}#{self.id}>"
+
+
+def infer_append_only(scope: "Scope") -> None:
+    """Fill ``Node.append_only`` over a built graph.
+
+    Creation order is topological (inputs exist before their consumers), so
+    one forward pass suffices.  Runs after lowering, before any state is
+    restored or stepped."""
+    for node in scope.nodes:
+        node.append_only = node._infer_append_only()
 
 
 class InputNode(Node):
@@ -302,8 +329,22 @@ class InputNode(Node):
         self.finished = False
         # upsert sessions key rows and treat same-key insert as replace
         self.upsert = False
+        # set by the io layer when the source schema declares append_only
+        # (column_definition / schema properties); enforced at insert
+        self.declared_append_only = False
+
+    def _infer_append_only(self) -> bool:
+        # upsert sessions synthesize retractions for overwritten keys, so a
+        # declared-append-only upsert source still is not append-only
+        return self.declared_append_only and not self.upsert
 
     def insert(self, key: int, row: Row, time: Time, diff: int = 1) -> None:
+        if diff < 0 and self.append_only:
+            raise EngineError(
+                "retraction arrived at an append-only input: the schema "
+                "declares append_only=True but the source produced a "
+                "deletion"
+            )
         self._staged[time].append((key, row, diff))
         self._staged_wallclock.setdefault(time, _monotonic())
 
@@ -383,6 +424,11 @@ class StaticNode(InputNode):
             self._staged[time].extend(deltas)
             self._staged_wallclock.setdefault(time, now)
         self.finished = True
+        # build-time rows are fully known: a static table with no deletion
+        # diffs is factually append-only, no declaration needed
+        self.declared_append_only = all(
+            d >= 0 for ds in self._staged.values() for (_, _, d) in ds
+        )
 
 
 class ExprNode(Node):
@@ -396,6 +442,7 @@ class ExprNode(Node):
     """
 
     name = "select"
+    preserves_append_only = True
 
     def __init__(self, scope, inp: Node, fn: Callable[[int, Row], Row], deps: Sequence[Node] = ()):
         super().__init__(scope, [inp])
@@ -472,6 +519,7 @@ class FilterNode(Node):
     # the Table layer's filter() lowers to its own _PredFilter with the
     # columnar fast path; this plain node serves engine-internal filters
     name = "filter"
+    preserves_append_only = True
 
     def __init__(self, scope, inp: Node, pred: Callable[[int, Row], bool]):
         super().__init__(scope, [inp])
@@ -496,6 +544,7 @@ class FlattenNode(Node):
     """flatten a column of sequences into multiple rows (dataflow.rs flatten_table)."""
 
     name = "flatten"
+    preserves_append_only = True
 
     def __init__(
         self,
@@ -531,6 +580,7 @@ class ReindexNode(Node):
     """Change row keys (with_id_from / reindex); detects duplicate new keys."""
 
     name = "reindex"
+    preserves_append_only = True
 
     def __init__(self, scope, inp: Node, key_fn: Callable[[int, Row], int]):
         super().__init__(scope, [inp])
@@ -550,6 +600,7 @@ class ReindexNode(Node):
 
 class ConcatNode(Node):
     name = "concat"
+    preserves_append_only = True
 
     def __init__(self, scope, inputs: Sequence[Node]):
         super().__init__(scope, inputs)
@@ -857,6 +908,15 @@ class JoinNode(Node):
         self._left_matches: Counter = Counter()
         self._right_matches: Counter = Counter()
 
+    def _infer_append_only(self) -> bool:
+        # inner joins of append-only sides only ever add pairs; outer modes
+        # retract their null-padding when a first match arrives
+        return (
+            not self.left_outer
+            and not self.right_outer
+            and all(i.append_only for i in self.inputs)
+        )
+
     @staticmethod
     def _route_jk(key_fn, key: int, row: Row) -> int:
         jk = key_fn(key, row)
@@ -984,10 +1044,19 @@ class GroupByNode(Node):
         # per-(group, value) add_pairs into the multiset states (mm)
         self.vec_group = None
 
+    def _make_states(self) -> list:
+        # append-only input: non-invertible reducers (min/max/argmin/…)
+        # swap their value multisets for O(1) running accumulators — the
+        # engine-variant choice the reference drives off column properties
+        # (dataflow.rs append_only_or_deterministic)
+        if self.inputs[0].append_only:
+            return [r.make_append_state() for (r, _) in self.reducer_specs]
+        return [r.make_state() for (r, _) in self.reducer_specs]
+
     def _ensure_group(self, gk):
         states = self._groups.get(gk)
         if states is None:
-            states = [r.make_state() for (r, _) in self.reducer_specs]
+            states = self._make_states()
             self._groups[gk] = states
         return states
 
@@ -1135,7 +1204,7 @@ class GroupByNode(Node):
         super().persist_load(data)
         self._groups = {}
         for gk, dumps in groups.items():
-            states = [r.make_state() for (r, _) in self.reducer_specs]
+            states = self._make_states()
             for st, d in zip(states, dumps):
                 st.load(d)
             self._groups[gk] = states

@@ -57,6 +57,13 @@ class Reducer:
     def make_state(self) -> ReducerState:
         raise NotImplementedError
 
+    def make_append_state(self) -> ReducerState:
+        """State variant for groups fed by an append-only input stream
+        (``Node.append_only``): never sees retractions, so non-invertible
+        reducers may keep O(1) running entries instead of value multisets.
+        Default: same as ``make_state`` (already O(1) or order-dependent)."""
+        return self.make_state()
+
     def __call__(self, *args, **kwargs):
         from pathway_tpu.internals.expression import ReducerExpression
 
@@ -240,6 +247,7 @@ class _MultisetState(ReducerState):
         return self.rows
 
     def load(self, data):
+        _reject_running_dump(data)
         if self.keyed:
             self.rows = Counter(data)
             return
@@ -250,6 +258,174 @@ class _MultisetState(ReducerState):
             self.rows[(args, None)] += cnt
         for entry in [e for e, c in self.rows.items() if c == 0]:
             del self.rows[entry]
+
+
+def _reject_running_dump(data) -> None:
+    """Multiset/time states must refuse a running-state dump (the other
+    direction of the _RunningState.load guard): a snapshot written while
+    the source was append-only cannot resume after the declaration was
+    dropped — Counter(data) would silently build garbage state."""
+    if (
+        isinstance(data, _builtin_tuple)
+        and len(data) == 3
+        and data[0] in ("ro1", "ru1")
+    ):
+        raise ValueError(
+            "operator snapshot holds an append-only reducer state but the "
+            "source is no longer append-only; resume with the original "
+            "schema properties or clear the persistence dir"
+        )
+
+
+def _append_only_violation():
+    from pathway_tpu.engine.dataflow import EngineError
+
+    raise EngineError(
+        "retraction reached an append-only reduction state: the input "
+        "stream was inferred append-only (declared via "
+        "column_definition(append_only=True) or a retraction-free source) "
+        "but produced a deletion"
+    )
+
+
+class _RunningState(ReducerState):
+    """O(1) accumulator for groups fed by an append-only stream.
+
+    Non-invertible reducers (min/max/argmin/argmax/any/earliest/latest)
+    need their value multiset only to survive retractions; when the lowered
+    input can never retract (``Node.append_only``) a single running entry
+    suffices.  This is the operator-variant choice the reference drives off
+    column append-onlyness (``internals/column_properties.py``; engine
+    switches ``src/engine/dataflow.rs:1741``).
+
+    ``enter(args, time, key)`` builds a comparable entry; ``better`` says
+    whether a new entry replaces the running one (strict — ties keep the
+    first arrival, matching multiset iteration order); ``result`` maps the
+    running entry to the reducer output.
+    """
+
+    __slots__ = ("entry", "n", "enter", "better", "result")
+
+    def __init__(self, enter: Callable, better: Callable, result: Callable):
+        self.entry = None
+        self.n = 0
+        self.enter = enter
+        self.better = better
+        self.result = result
+
+    def add(self, args, diff, time, key):
+        if diff < 0:
+            _append_only_violation()
+        self.n += diff
+        e = self.enter(args, time, key)
+        if self.entry is None or self.better(e, self.entry):
+            self.entry = e
+
+    def add_pairs(self, values, counts):
+        """Columnar bulk update (GroupByNode "mm" path): per distinct
+        value, a summed diff — only keyless reducers (min/max) get here."""
+        enter, better = self.enter, self.better
+        for v, c in zip(values, counts):
+            if c < 0:
+                _append_only_violation()
+            self.n += c
+            e = enter((v,), 0, None)
+            if self.entry is None or better(e, self.entry):
+                self.entry = e
+
+    def extract(self):
+        return self.result(self.entry)
+
+    def is_empty(self):
+        return self.n <= 0
+
+    def dump(self):
+        return ("ro1", self.entry, self.n)
+
+    def load(self, data):
+        if not (isinstance(data, _builtin_tuple) and len(data) == 3 and data[0] == "ro1"):
+            raise ValueError(
+                "operator snapshot holds a multiset reducer state but the "
+                "source is now append-only (or vice versa); resume with the "
+                "original schema properties or clear the persistence dir"
+            )
+        _, self.entry, self.n = data
+
+
+def _running_min_factory(latest: bool):
+    def enter(args, time, key):
+        return (_sort_key(args[0]), args[0])
+
+    def better(e, cur):
+        return e[0] > cur[0] if latest else e[0] < cur[0]
+
+    return lambda: _RunningState(enter, better, lambda e: e[1])
+
+
+_running_states: dict[str, Callable[[], _RunningState]] = {
+    "min": _running_min_factory(latest=False),
+    "max": _running_min_factory(latest=True),
+    # argmin: min by (value sort key, row key) — the tie rule of
+    # _finish_argmin; argmax: max by value, tie broken by MIN row key
+    "argmin": lambda: _RunningState(
+        lambda a, t, k: (_sort_key(a[0]), k),
+        lambda e, c: e < c,
+        lambda e: e[1] if isinstance(e[1], Pointer) else Pointer(e[1]),
+    ),
+    "argmax": lambda: _RunningState(
+        lambda a, t, k: (_sort_key(a[0]), k),
+        lambda e, c: e[0] > c[0] or (e[0] == c[0] and e[1] < c[1]),
+        lambda e: e[1] if isinstance(e[1], Pointer) else Pointer(e[1]),
+    ),
+    # any: the row with the smallest key (the _finish_any pick)
+    "any": lambda: _RunningState(
+        lambda a, t, k: (k, a[0]),
+        lambda e, c: e[0] < c[0],
+        lambda e: e[1],
+    ),
+}
+
+
+class _RunningUniqueState(ReducerState):
+    """Append-only ``unique``: remembers at most two distinct non-None
+    values — two suffice to report ERROR, exactly as _finish_unique."""
+
+    __slots__ = ("vals", "n")
+
+    def __init__(self):
+        self.vals: list = []
+        self.n = 0
+
+    def add(self, args, diff, time, key):
+        if diff < 0:
+            _append_only_violation()
+        self.n += diff
+        v = args[0]
+        if v is not None and v not in self.vals and len(self.vals) < 2:
+            self.vals.append(v)
+
+    def add_pairs(self, values, counts):
+        for v, c in zip(values, counts):
+            self.add((v,), c, 0, None)
+
+    def extract(self):
+        if len(self.vals) > 1:
+            return ERROR
+        return self.vals[0] if self.vals else None
+
+    def is_empty(self):
+        return self.n <= 0
+
+    def dump(self):
+        return ("ru1", self.vals, self.n)
+
+    def load(self, data):
+        if not (isinstance(data, _builtin_tuple) and len(data) == 3 and data[0] == "ru1"):
+            raise ValueError(
+                "operator snapshot reducer-state format mismatch (see "
+                "_RunningState.load)"
+            )
+        _, self.vals, self.n = data
 
 
 def _multiset_reducer(
@@ -265,6 +441,12 @@ def _multiset_reducer(
 
         def make_state(self):
             return _MultisetState(finish, keyed=keyed)
+
+        def make_append_state(self):
+            if name_ == "unique":
+                return _RunningUniqueState()
+            factory = _running_states.get(name_)
+            return factory() if factory is not None else self.make_state()
 
     _R.__name__ = f"{name_.title()}Reducer"
     return _R()
@@ -375,7 +557,16 @@ class _TimeBasedState(ReducerState):
         return self.rows
 
     def load(self, data):
+        _reject_running_dump(data)
         self.rows = Counter(data)
+
+
+def _time_running_state(latest: bool) -> _RunningState:
+    return _RunningState(
+        lambda a, t, k: ((t, k), a[0]),
+        (lambda e, c: e[0] > c[0]) if latest else (lambda e, c: e[0] < c[0]),
+        lambda e: e[1],
+    )
 
 
 class EarliestReducer(Reducer):
@@ -387,6 +578,9 @@ class EarliestReducer(Reducer):
     def make_state(self):
         return _TimeBasedState(latest=False)
 
+    def make_append_state(self):
+        return _time_running_state(latest=False)
+
 
 class LatestReducer(Reducer):
     name = "latest"
@@ -396,6 +590,9 @@ class LatestReducer(Reducer):
 
     def make_state(self):
         return _TimeBasedState(latest=True)
+
+    def make_append_state(self):
+        return _time_running_state(latest=True)
 
 
 class _StatefulState(ReducerState):

@@ -139,6 +139,13 @@ def run(
     from pathway_tpu.internals.config import get_config as _get_config
 
     _cfg = _get_config()
+    if _cfg.processes > 1 and os.environ.get("PATHWAY_JAX_DISTRIBUTED") == "1":
+        # `pathway spawn --jax-distributed`: the host workers double as JAX
+        # processes of one global device mesh (DCN between hosts) — must
+        # run before any backend init
+        from pathway_tpu.parallel.mesh import initialize_distributed
+
+        initialize_distributed()
     worker_ctx = None
     if _cfg.processes > 1:
         from pathway_tpu.engine.comm import TcpMesh, WorkerContext
@@ -162,6 +169,10 @@ def run(
     for name, table, attach in (list(G.sinks) if _sinks is None else _sinks):
         node = lowerer.node(table)
         attach(lowerer, node)
+
+    # append-only analysis must run before any state is restored or stepped:
+    # GroupByNode picks its accumulator variant off the inferred flags
+    df.infer_append_only(scope)
 
     result = RunResult()
     if storage is not None and storage.operator_persistence:
@@ -566,6 +577,7 @@ def run_pipeline_to_completion(sink_tables: list[tuple[Table, Callable]], **kwar
     for table, attach in sink_tables:
         node = lowerer.node(table)
         attach(lowerer, node)
+    df.infer_append_only(scope)
     result = RunResult()
     try:
         _event_loop(scope, lowerer, result)

@@ -75,6 +75,16 @@ class SchemaProperties:
     append_only: bool = False
 
 
+def is_append_only(schema: "type[Schema]") -> bool:
+    """Table-level append-onlyness: the schema-level flag, or every column
+    declared append_only — the same fold the reference applies when it
+    builds column properties (reference schema.py:251-259)."""
+    if schema.__properties__.append_only:
+        return True
+    cols = schema.__columns__
+    return bool(cols) and all(c.append_only for c in cols.values())
+
+
 class SchemaMetaclass(type):
     __columns__: dict[str, ColumnSchema]
     __properties__: SchemaProperties
@@ -213,6 +223,10 @@ class Schema(metaclass=SchemaMetaclass):
     """
 
     def __init_subclass__(cls, **kwargs):
+        # class keywords consumed by SchemaMetaclass.__init__ (e.g.
+        # ``class S(pw.Schema, append_only=True)``) must not reach
+        # object.__init_subclass__, which takes none
+        kwargs.pop("append_only", None)
         super().__init_subclass__(**kwargs)
 
 

@@ -417,6 +417,7 @@ class _IxMerge:
 # IxNode passes raw data rows; wrap data node so fetched region includes id.
 class _DataWithIdNode(df.Node):
     name = "with_id_col"
+    preserves_append_only = True
 
     def __init__(self, scope, inp):
         super().__init__(scope, [inp])
@@ -849,6 +850,7 @@ class Table(Joinable):
 
             class _PredFilter(df.Node):
                 name = "filter"
+                preserves_append_only = True
 
                 def _try_columnar(self_inner, deltas):
                     f_vec, needed = vec
@@ -906,6 +908,7 @@ class Table(Joinable):
 
             class _Copy(df.Node):
                 name = "copy"
+                preserves_append_only = True
 
             return _Copy(lowerer.scope, [base])
 
@@ -1423,6 +1426,7 @@ class Table(Joinable):
 
             class _Retype(df.Node):
                 name = "update_types"
+                preserves_append_only = True
 
             return _Retype(lowerer.scope, [base])
 

@@ -1,0 +1,260 @@
+"""Append-only column properties: inference, operator variants, enforcement.
+
+Parity target: the reference threads ``append_only`` from
+``column_definition`` / schema properties through lowering
+(``python/pathway/internals/column_properties.py``) and the engine picks
+cheaper operator variants off it (``append_only_or_deterministic``,
+``src/engine/dataflow.rs:1741``).  Here: ``infer_append_only`` fills
+per-node flags after lowering; GroupByNode swaps value multisets for O(1)
+running accumulators; inputs declared append-only reject retractions.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import dataflow as df
+from pathway_tpu.engine.types import ERROR, Pointer
+from pathway_tpu.internals import reducers as red
+from pathway_tpu.internals.reducers import _RunningState, _RunningUniqueState
+from pathway_tpu.internals.schema import is_append_only
+from pathway_tpu.io._utils import COMMIT, Reader, make_input_table
+from tests.utils import T
+
+
+class TestInference:
+    def _chain(self, declared: bool):
+        scope = df.Scope()
+        inp = df.InputNode(scope)
+        inp.declared_append_only = declared
+        expr = df.ExprNode(scope, inp, lambda k, r: r)
+        filt = df.FilterNode(scope, expr, lambda k, r: True)
+        gb = df.GroupByNode(
+            scope,
+            filt,
+            group_key_fn=lambda k, r: (r[0],),
+            out_key_fn=lambda gk: hash(gk),
+            reducer_specs=[(red.min, lambda k, r: (r[1],))],
+        )
+        df.infer_append_only(scope)
+        return inp, expr, filt, gb
+
+    def test_flags_propagate_through_rowwise_chain(self):
+        inp, expr, filt, gb = self._chain(declared=True)
+        assert inp.append_only and expr.append_only and filt.append_only
+        # groupby OUTPUT retracts old aggregates — never append-only
+        assert not gb.append_only
+        # but its states come from the append-only input variant
+        assert isinstance(gb._make_states()[0], _RunningState)
+
+    def test_undeclared_input_keeps_multiset_states(self):
+        inp, expr, filt, gb = self._chain(declared=False)
+        assert not inp.append_only and not expr.append_only
+        assert not isinstance(gb._make_states()[0], _RunningState)
+
+    def test_upsert_input_never_append_only(self):
+        scope = df.Scope()
+        inp = df.InputNode(scope)
+        inp.declared_append_only = True
+        inp.upsert = True
+        df.infer_append_only(scope)
+        assert not inp.append_only
+
+    def test_static_node_is_append_only_iff_no_deletions(self):
+        scope = df.Scope()
+        a = df.StaticNode(scope, [(1, ("x",), 0, 1), (2, ("y",), 0, 1)])
+        b = df.StaticNode(scope, [(1, ("x",), 0, 1), (1, ("x",), 2, -1)])
+        df.infer_append_only(scope)
+        assert a.append_only
+        assert not b.append_only
+
+    def test_inner_join_preserves_outer_does_not(self):
+        scope = df.Scope()
+        l = df.StaticNode(scope, [(1, ("a", 1), 0, 1)])
+        r = df.StaticNode(scope, [(2, ("a", 2), 0, 1)])
+        inner = df.JoinNode(
+            scope, l, r,
+            lambda k, row: (row[0],), lambda k, row: (row[0],),
+            lambda lk, rk, jk: hash((lk, rk)),
+        )
+        outer = df.JoinNode(
+            scope, l, r,
+            lambda k, row: (row[0],), lambda k, row: (row[0],),
+            lambda lk, rk, jk: hash((lk, rk)),
+            left_outer=True,
+        )
+        df.infer_append_only(scope)
+        assert inner.append_only
+        assert not outer.append_only
+
+    def test_schema_level_fold(self):
+        class ColWise(pw.Schema):
+            a: int = pw.column_definition(append_only=True)
+            b: str = pw.column_definition(append_only=True)
+
+        class Partial(pw.Schema):
+            a: int = pw.column_definition(append_only=True)
+            b: str
+
+        class TableWise(pw.Schema, append_only=True):
+            a: int
+
+        assert is_append_only(ColWise)
+        assert not is_append_only(Partial)
+        assert is_append_only(TableWise)
+
+
+class TestRunningStateParity:
+    """Running accumulators must agree with the multiset states on any
+    insert-only sequence — including tie rules."""
+
+    CASES = [
+        ("min", red.min), ("max", red.max), ("argmin", red.argmin),
+        ("argmax", red.argmax), ("any", red.any), ("unique", red.unique),
+        ("earliest", red.earliest), ("latest", red.latest),
+    ]
+
+    @pytest.mark.parametrize("name,reducer", CASES, ids=[c[0] for c in CASES])
+    def test_parity_on_insert_only_sequences(self, name, reducer):
+        import random
+
+        rng = random.Random(7)
+        for trial in range(40):
+            n = rng.randint(1, 12)
+            seq = []
+            for i in range(n):
+                v = rng.choice([0, 1, -3, 2.5, 7, "s", "t", None])
+                if name in ("argmin", "argmax") and v is None:
+                    v = 0
+                seq.append((v, rng.randint(2, 6) * 2, rng.randrange(100)))
+            general = reducer.make_state()
+            append = reducer.make_append_state()
+            assert type(append) is not type(general) or name in ()
+            for v, t, k in seq:
+                general.add((v,), 1, t, k)
+                append.add((v,), 1, t, k)
+            g, a = general.extract(), append.extract()
+            if isinstance(g, float) and g != g:  # NaN
+                assert a != a
+            else:
+                assert g == a, f"{name} trial {trial}: {g!r} != {a!r} on {seq}"
+
+    def test_unique_error_on_two_distinct(self):
+        st = _RunningUniqueState()
+        st.add((1,), 1, 2, 10)
+        st.add((1,), 1, 2, 11)
+        assert st.extract() == 1
+        st.add((2,), 1, 4, 12)
+        assert st.extract() is ERROR
+
+    def test_running_state_rejects_retraction(self):
+        st = red.min.make_append_state()
+        st.add((1,), 1, 2, 10)
+        with pytest.raises(df.EngineError, match="append-only"):
+            st.add((1,), -1, 2, 10)
+
+    def test_dump_load_roundtrip(self):
+        st = red.max.make_append_state()
+        st.add((3,), 1, 2, 1)
+        st.add((9,), 1, 2, 2)
+        st2 = red.max.make_append_state()
+        st2.load(st.dump())
+        assert st2.extract() == 9
+
+    def test_load_rejects_multiset_dump(self):
+        st = red.min.make_state()
+        st.add((3,), 1, 2, 1)
+        with pytest.raises(ValueError, match="snapshot"):
+            red.min.make_append_state().load(st.dump())
+
+
+class TestEndToEnd:
+    def test_static_pipeline_results_unchanged(self):
+        """Markdown tables are insert-only → the whole groupby below runs on
+        running states; results must match the documented semantics."""
+        t = T(
+            """
+            g | v
+            a | 3
+            a | 1
+            b | 5
+            a | 2
+            b | 4
+            """
+        )
+        r = t.groupby(pw.this.g).reduce(
+            pw.this.g,
+            lo=pw.reducers.min(pw.this.v),
+            hi=pw.reducers.max(pw.this.v),
+            am=pw.reducers.argmax(pw.this.v),
+            u=pw.reducers.unique(pw.this.g),
+        )
+        out = pw.debug.table_to_pandas(r)
+        by_g = {row["g"]: row for _, row in out.iterrows()}
+        assert (by_g["a"]["lo"], by_g["a"]["hi"]) == (1, 3)
+        assert (by_g["b"]["lo"], by_g["b"]["hi"]) == (4, 5)
+        assert isinstance(by_g["a"]["am"], Pointer)
+        assert by_g["a"]["u"] == "a"
+
+    def test_retraction_stream_still_exact(self):
+        """A stream WITH deletions must keep the multiset path and stay
+        correct (the inference must not over-claim)."""
+        t = T(
+            """
+            g | v | _time | _diff
+            a | 3 | 2     | 1
+            a | 9 | 2     | 1
+            a | 9 | 4     | -1
+            """
+        )
+        r = t.groupby(pw.this.g).reduce(pw.this.g, hi=pw.reducers.max(pw.this.v))
+        out = pw.debug.table_to_pandas(r)
+        assert out.iloc[0]["hi"] == 3
+
+    def test_declared_append_only_source_rejects_delete(self):
+        class S(pw.Schema, append_only=True):
+            k: int
+
+        class DeletingReader(Reader):
+            def run(self, emit):
+                emit({"k": 1})
+                emit({"k": 2, "_pw_delete": True})
+                emit(COMMIT)
+
+        t = make_input_table(S, DeletingReader, autocommit_duration_ms=50)
+        rows: list = []
+        pw.io.subscribe(
+            t, on_change=lambda key, row, time, is_addition: rows.append(row)
+        )
+        with pytest.raises(df.EngineError, match="append-only"):
+            pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+
+    def test_append_only_streaming_min_max(self):
+        class S(pw.Schema, append_only=True):
+            g: str
+            v: int
+
+        class Feed(Reader):
+            def run(self, emit):
+                for g, v in [("a", 5), ("b", 2), ("a", 1), ("b", 9)]:
+                    emit({"g": g, "v": v})
+                    emit(COMMIT)
+
+        t = make_input_table(S, Feed, autocommit_duration_ms=50)
+        r = t.groupby(pw.this.g).reduce(
+            pw.this.g,
+            lo=pw.reducers.min(pw.this.v),
+            hi=pw.reducers.max(pw.this.v),
+        )
+        final: dict = {}
+        pw.io.subscribe(
+            r,
+            on_change=lambda key, row, time, is_addition: final.__setitem__(
+                row["g"], (row["lo"], row["hi"])
+            )
+            if is_addition
+            else None,
+        )
+        pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+        assert final == {"a": (1, 5), "b": (2, 9)}
