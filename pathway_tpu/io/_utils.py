@@ -67,10 +67,20 @@ class Reader:
     its own (Kafka consumer groups) set ``external_resume = True`` — they get
     neither snapshot-replay skipping nor row counting.  Others get a generic
     emitted-row-count frontier (the PythonReader strategy, data_storage.rs:806).
+
+    ``max_allowed_consecutive_errors`` is the transient-failure budget
+    (parity: ``Reader::max_allowed_consecutive_errors``
+    data_storage.rs:481, enforced by the read loop mod.rs:294-332): a
+    failed ``run`` is restarted with backoff while the consecutive-failure
+    count stays within the budget; any successfully emitted item resets
+    the count.  Past the budget the pipeline fails cleanly (the poller
+    re-raises on the engine thread).  The default 0 means the first error
+    is fatal, as in the reference; brokered sources (Kafka/NATS) override.
     """
 
     supports_offsets = False
     external_resume = False
+    max_allowed_consecutive_errors = 0
 
     def run(self, emit: Callable[[Any], None]) -> None:
         raise NotImplementedError
@@ -87,6 +97,38 @@ class Reader:
         (docs/.../10.worker-architecture.md:40-42, dataflow.rs:1414-1437).
         Returning ``None`` means this worker reads nothing."""
         return self if worker_id == 0 else None
+
+
+class ReaderFailed:
+    """Queue sentinel: the reader exhausted its consecutive-error budget.
+    The poller re-raises on the engine thread so ``pw.run`` fails cleanly
+    (the ``error_reporter.report(ReaderFailed)`` path of mod.rs:319)."""
+
+    __slots__ = ("exc", "consecutive")
+
+    def __init__(self, exc: BaseException, consecutive: int):
+        self.exc = exc
+        self.consecutive = consecutive
+
+
+class _ReadProgress:
+    """Emit wrapper for the supervision loop: records that the reader made
+    progress since its last failure (any item — the reference resets
+    ``consecutive_errors`` on every successful ``read()``) and remembers the
+    newest ``Offset`` so a restart of an offset-aware reader can re-``seek``."""
+
+    __slots__ = ("put", "progressed", "last_offset")
+
+    def __init__(self, put: Callable[[Any], None]):
+        self.put = put
+        self.progressed = False
+        self.last_offset: Any = None
+
+    def __call__(self, item: Any) -> None:
+        self.progressed = True
+        if isinstance(item, Offset):
+            self.last_offset = item.value
+        self.put(item)
 
 
 class _RowCountEmit:
@@ -213,6 +255,14 @@ class _QueuePoller:
             except queue.Empty:
                 break
             drained += 1
+            if isinstance(item, ReaderFailed):
+                self.finished = True
+                self.input_node.close()
+                raise df.EngineError(
+                    f"connector reader failed after {item.consecutive} "
+                    f"consecutive errors (budget "
+                    f"{item.consecutive - 1}): {item.exc!r}"
+                ) from item.exc
             if item is FINISH:
                 if self._staged:
                     self._time += 2
@@ -324,6 +374,9 @@ def make_input_table(
         node.upsert = upsert
         if upsert:
             node.require_state()
+        # a declared append-only schema turns on the engine's no-retraction
+        # operator variants downstream and rejects deletions at the input
+        node.declared_append_only = schema_mod.is_append_only(schema)
         poller = _QueuePoller(node, schema, autocommit_duration_ms)
         worker = getattr(lowerer.scope, "worker", None)
         reader = reader_factory()
@@ -379,16 +432,80 @@ def make_input_table(
             emit = _RowCountEmit(poller.q.put, skip_rows)
 
         def target():
+            # supervision with a consecutive-error budget (parity:
+            # read_realtime_updates, mod.rs:294-332): a failing reader is
+            # restarted with backoff until `max_allowed_consecutive_errors`
+            # failures in a row, then the pipeline fails cleanly via the
+            # ReaderFailed sentinel.  Every exit path terminates the queue
+            # (the old try/finally emit(FINISH) guarantee).
+            tracker = _ReadProgress(emit)
+            done = False
             try:
-                reader.run(emit)
-            except Exception as exc:  # surface reader errors at finish
-                import logging
-
-                logging.getLogger("pathway_tpu.io").error(
-                    "connector reader failed: %s", exc
-                )
+                if _supervise(reader, tracker):
+                    emit(FINISH)  # via the wrapper: stamps the final offset
+                else:
+                    poller.q.put(FINISH)  # failure path: no offset stamp
+                done = True
+            except BaseException as exc:  # SystemExit/KeyboardInterrupt:
+                # a non-Exception escape must FAIL the pipeline, not let it
+                # complete as if the source drained
+                poller.q.put(ReaderFailed(exc, 1))
+                raise
             finally:
-                emit(FINISH)
+                if not done:
+                    poller.q.put(FINISH)
+
+        def _supervise(reader, tracker) -> bool:
+            """True = source drained cleanly; False = budget exhausted
+            (ReaderFailed already queued).  Progress (any emitted item)
+            resets the count, like the reference's per-read() reset."""
+            import logging
+
+            log = logging.getLogger("pathway_tpu.io")
+            consecutive = 0
+            while True:
+                try:
+                    reader.run(tracker)
+                    return True
+                except Exception as exc:
+                    if tracker.progressed:
+                        consecutive = 0
+                        tracker.progressed = False
+                    consecutive += 1
+                    budget = reader.max_allowed_consecutive_errors
+                    if consecutive > budget:
+                        log.error(
+                            "connector reader failed (%d consecutive errors, "
+                            "budget %d): %s",
+                            consecutive,
+                            budget,
+                            exc,
+                        )
+                        poller.q.put(ReaderFailed(exc, consecutive))
+                        return False
+                    log.warning(
+                        "transient connector reader error (%d/%d), "
+                        "restarting: %s",
+                        consecutive,
+                        budget,
+                        exc,
+                    )
+                    # reposition so the restarted run resumes, not repeats:
+                    # offset-aware readers re-seek to the newest emitted
+                    # offset; row-count readers fold the rows already seen
+                    # into the skip prefix (their run() restarts from the
+                    # source beginning); external-resume readers (Kafka)
+                    # re-attach at the broker's committed position
+                    # (redelivery of uncommitted rows = at-least-once).
+                    if reader.supports_offsets and tracker.last_offset is not None:
+                        try:
+                            reader.seek(tracker.last_offset)
+                        except Exception as seek_exc:  # noqa: BLE001
+                            log.warning("reader re-seek failed: %s", seek_exc)
+                    elif isinstance(emit, _RowCountEmit):
+                        emit.skip = max(emit.skip, emit.count)
+                        emit.count = 0
+                    _time.sleep(min(0.05 * (2 ** (consecutive - 1)), 2.0))
 
         thread = threading.Thread(target=target, name="pathway:connector", daemon=True)
         thread.start()

@@ -44,6 +44,9 @@ class _KafkaReader(Reader):
     # resumes past consumed messages itself, so the generic row-count
     # frontier must NOT additionally skip rows (it would drop fresh data)
     external_resume = True
+    # transient broker failures (rebalance, coordinator churn) are ridden
+    # out, as in the reference's KafkaReader (data_storage.rs:766)
+    max_allowed_consecutive_errors = 32
 
     def __init__(self, rdkafka_settings, topic, format, schema, commit_interval_s=1.5):
         self.settings = rdkafka_settings

@@ -29,6 +29,12 @@ class NatsError(RuntimeError):
     pass
 
 
+class NatsClosed(NatsError):
+    """Server closed the connection (EOF) — end-of-stream, not an error:
+    the read loop finishes cleanly instead of burning the reader's
+    consecutive-error budget on reconnect attempts."""
+
+
 class _NatsConn:
     def __init__(self, uri: str, timeout: float = 15.0):
         import urllib.parse
@@ -55,7 +61,7 @@ class _NatsConn:
         while b"\r\n" not in self._buf:
             chunk = self.sock.recv(65536)
             if not chunk:
-                raise NatsError("connection closed")
+                raise NatsClosed("connection closed")
             self._buf += chunk
         line, self._buf = self._buf.split(b"\r\n", 1)
         return line
@@ -64,7 +70,7 @@ class _NatsConn:
         while len(self._buf) < n:
             chunk = self.sock.recv(65536)
             if not chunk:
-                raise NatsError("connection closed")
+                raise NatsClosed("connection closed")
             self._buf += chunk
         out, self._buf = self._buf[:n], self._buf[n:]
         return out
@@ -76,6 +82,9 @@ class _NatsConn:
 class _NatsReader(Reader):
     # NATS core is at-most-once fire-and-forget: no offsets to resume from
     external_resume = True
+    # ride out transient server failures (parity: NatsReader
+    # data_storage.rs:1788)
+    max_allowed_consecutive_errors = 32
 
     def __init__(self, uri: str, topic: str, format: str, schema, queue_group: str | None):
         self.uri = uri
@@ -101,26 +110,35 @@ class _NatsReader(Reader):
         import time as _time
 
         last_commit = _time.monotonic()
-        while True:
-            try:
-                line = conn.read_line()
-            except socket.timeout:
-                emit(COMMIT)
-                last_commit = _time.monotonic()
-                continue
-            if line.startswith(b"MSG "):
-                parts = line.decode().split(" ")
-                nbytes = int(parts[-1])
-                payload = conn.read_exact(nbytes)
-                conn.read_exact(2)  # trailing \r\n
-                self._emit_payload(payload, names, emit)
-            elif line == b"PING":
-                conn.send(b"PONG\r\n")
-            elif line.startswith(b"-ERR"):
-                raise NatsError(line.decode())
-            if (_time.monotonic() - last_commit) >= 1.0:
-                emit(COMMIT)
-                last_commit = _time.monotonic()
+        # A server-initiated close (EOF) ends the subscription cleanly —
+        # NATS core is at-most-once with no replay position, so there is
+        # nothing to resume; this holds at ANY byte position (between
+        # lines or mid-payload).  Protocol errors (-ERR) and connect
+        # failures, by contrast, consume the reader's consecutive-error
+        # budget and are retried by the supervisor.
+        try:
+            while True:
+                try:
+                    line = conn.read_line()
+                except socket.timeout:
+                    emit(COMMIT)
+                    last_commit = _time.monotonic()
+                    continue
+                if line.startswith(b"MSG "):
+                    parts = line.decode().split(" ")
+                    nbytes = int(parts[-1])
+                    payload = conn.read_exact(nbytes)
+                    conn.read_exact(2)  # trailing \r\n
+                    self._emit_payload(payload, names, emit)
+                elif line == b"PING":
+                    conn.send(b"PONG\r\n")
+                elif line.startswith(b"-ERR"):
+                    raise NatsError(line.decode())
+                if (_time.monotonic() - last_commit) >= 1.0:
+                    emit(COMMIT)
+                    last_commit = _time.monotonic()
+        except NatsClosed:
+            return
 
     def _emit_payload(self, payload: bytes, names, emit) -> None:
         if self.format == "raw":

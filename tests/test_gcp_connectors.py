@@ -296,7 +296,13 @@ def test_pubsub_read(mock_google):
     )
     got = []
     pw.io.subscribe(t, on_change=lambda key, row, time, is_addition: got.append(row["v"]))
-    pw.run()
+    # the mock ends its infinite feed with a 500; with the reader
+    # error-budget semantics (default 0, reference data_storage.rs:481)
+    # the rows arrive AND the dead subscription fails the pipeline loudly
+    from pathway_tpu.engine.dataflow import EngineError
+
+    with pytest.raises(EngineError, match="pubsub pull failed"):
+        pw.run()
     assert sorted(got) == [10, 20]
 
 

@@ -1,0 +1,168 @@
+"""Connector reader error tolerance.
+
+Parity target: the consecutive-error budget of the reference's read loop
+(``src/connectors/mod.rs:294-332``, per-reader budget
+``data_storage.rs:481`` default 0, Kafka/NATS 32): transient reader
+failures within the budget are ridden out with a restart + backoff; past
+the budget the pipeline fails cleanly.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine.dataflow import EngineError
+from pathway_tpu.io._utils import COMMIT, Offset, Reader, make_input_table
+
+
+class KV(pw.Schema):
+    k: int
+
+
+def _collect(table) -> list[tuple[int, bool]]:
+    rows: list[tuple[int, bool]] = []
+    pw.io.subscribe(
+        table,
+        on_change=lambda key, row, time, is_addition: rows.append(
+            (row["k"], is_addition)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    return rows
+
+
+def test_flaky_reader_survives_within_budget():
+    """Two transient failures under a budget of 3: every row is delivered
+    exactly once — the row-count restart path folds already-seen rows into
+    the skip prefix, so the re-run from the source beginning does not
+    duplicate."""
+
+    class Flaky(Reader):
+        max_allowed_consecutive_errors = 3
+
+        def __init__(self):
+            self.attempts = 0
+
+        def run(self, emit):
+            self.attempts += 1
+            for i in range(5):
+                if self.attempts < 3 and i == 1 + self.attempts:
+                    raise RuntimeError("transient poll failure")
+                emit({"k": i})
+            emit(COMMIT)
+
+    reader = Flaky()
+    t = make_input_table(KV, lambda: reader, autocommit_duration_ms=50)
+    rows = _collect(t)
+    assert reader.attempts == 3
+    assert sorted(k for k, add in rows if add) == [0, 1, 2, 3, 4]
+    assert all(add for _, add in rows)
+
+
+def test_reader_fails_cleanly_past_budget():
+    class Doomed(Reader):
+        max_allowed_consecutive_errors = 2
+
+        def __init__(self):
+            self.attempts = 0
+
+        def run(self, emit):
+            self.attempts += 1
+            raise ConnectionError("broker unreachable")
+
+    reader = Doomed()
+    t = make_input_table(KV, lambda: reader, autocommit_duration_ms=50)
+    with pytest.raises(EngineError, match="consecutive errors"):
+        _collect(t)
+    # budget 2 = 3 attempts (initial + 2 retries), then give up
+    assert reader.attempts == 3
+
+
+def test_default_budget_zero_first_error_is_fatal():
+    """Parity: the reference's default budget is 0 (data_storage.rs:481)."""
+
+    class OneShot(Reader):
+        def __init__(self):
+            self.attempts = 0
+
+        def run(self, emit):
+            self.attempts += 1
+            raise RuntimeError("boom")
+
+    reader = OneShot()
+    t = make_input_table(KV, lambda: reader, autocommit_duration_ms=50)
+    with pytest.raises(EngineError, match="consecutive errors"):
+        _collect(t)
+    assert reader.attempts == 1
+
+
+def test_progress_resets_consecutive_count():
+    """A reader that fails every other attempt but always makes progress
+    first never accumulates consecutive failures, so a budget of 1
+    survives arbitrarily many interleaved failures."""
+
+    class Interleaved(Reader):
+        max_allowed_consecutive_errors = 1
+
+        def __init__(self):
+            self.attempts = 0
+
+        def run(self, emit):
+            self.attempts += 1
+            for i in range(self.attempts):
+                emit({"k": i})
+            if self.attempts < 4:
+                raise RuntimeError("transient")
+            emit(COMMIT)
+
+    reader = Interleaved()
+    t = make_input_table(KV, lambda: reader, autocommit_duration_ms=50)
+    rows = _collect(t)
+    assert reader.attempts == 4
+    assert sorted(k for k, add in rows if add) == [0, 1, 2, 3]
+
+
+def test_offset_reader_reseeks_on_restart():
+    """Offset-aware readers resume by re-``seek``-ing to the newest emitted
+    offset instead of the row-count skip."""
+
+    class OffsetReader(Reader):
+        supports_offsets = True
+        max_allowed_consecutive_errors = 2
+
+        def __init__(self):
+            self.pos = 0
+            self.attempts = 0
+            self.seeks: list[int] = []
+
+        def seek(self, offset) -> None:
+            self.seeks.append(offset["pos"])
+            self.pos = offset["pos"]
+
+        def run(self, emit):
+            self.attempts += 1
+            while self.pos < 5:
+                emit({"k": self.pos})
+                self.pos += 1
+                emit(Offset({"pos": self.pos}))
+                if self.attempts == 1 and self.pos == 3:
+                    self.pos = 0  # simulate losing in-memory position
+                    raise RuntimeError("transient")
+            emit(COMMIT)
+
+    reader = OffsetReader()
+    t = make_input_table(KV, lambda: reader, autocommit_duration_ms=50)
+    rows = _collect(t)
+    assert reader.attempts == 2
+    assert reader.seeks == [3]  # re-sought to the last emitted offset
+    assert sorted(k for k, add in rows if add) == [0, 1, 2, 3, 4]
+
+
+def test_kafka_and_nats_budgets_match_reference():
+    from pathway_tpu.io.kafka import _KafkaReader
+    from pathway_tpu.io.nats import _NatsReader
+
+    assert _KafkaReader.max_allowed_consecutive_errors == 32
+    assert _NatsReader.max_allowed_consecutive_errors == 32
+    assert Reader.max_allowed_consecutive_errors == 0
