@@ -14,7 +14,13 @@ reference documents:
 * GROUP BY (columns or expressions) with aggregates COUNT(*)/COUNT(x)/
   SUM/AVG/MIN/MAX, and HAVING (aggregates allowed)
 * subqueries in FROM: ``SELECT ... FROM (SELECT ...) alias``
-* UNION ALL (concatenation) and UNION (deduplicating)
+* UNION ALL (concatenation) and UNION (deduplicating), INTERSECT and
+  EXCEPT (distinct set semantics, value-based, INTERSECT binding tighter
+  as in standard SQL)
+* WITH (non-recursive CTEs, referencable by later CTEs and the body)
+* uncorrelated scalar subqueries in WHERE/HAVING
+  (``WHERE v > (SELECT AVG(v) FROM t)`` — must be a single-row aggregate)
+* projection-alias reuse in HAVING (``SELECT SUM(v) AS s ... HAVING s > 3``)
 
 Not covered (as in the reference's documented limitations): correlated
 subqueries, window functions, ORDER BY/LIMIT (meaningless on streams).
@@ -44,6 +50,7 @@ _KEYWORDS = {
     "select", "distinct", "from", "where", "group", "by", "having", "union",
     "all", "join", "inner", "left", "right", "full", "outer", "cross", "on",
     "as", "and", "or", "not", "is", "null", "between", "in", "true", "false",
+    "with", "recursive", "intersect", "except",
 }
 
 
@@ -193,6 +200,10 @@ def _parse_cmp(p: _Parser):
 
 def _parse_in_tail(p: _Parser, e):
     p.expect_op("(")
+    if p.peek() in (("kw", "select"), ("kw", "with")):
+        raise SqlError(
+            "IN (SELECT ...) subqueries are not supported; rewrite as a JOIN"
+        )
     items = [_parse_add(p)]
     while p.accept_op(","):
         items.append(_parse_add(p))
@@ -240,6 +251,10 @@ def _parse_primary(p: _Parser):
         return ("const", None)
     if t == "op" and v == "(":
         p.next()
+        if p.peek() in (("kw", "select"), ("kw", "with")):
+            sub = _parse_query(p)
+            p.expect_op(")")
+            return ("scalar_subquery", sub)
         e = _parse_expr(p)
         p.expect_op(")")
         return e
@@ -364,12 +379,37 @@ def _parse_from_item(p: _Parser):
 
 
 def _parse_query(p: _Parser):
+    ctes = []
+    if p.accept_kw("with"):
+        if p.accept_kw("recursive"):
+            raise SqlError("WITH RECURSIVE is not supported; use pw.iterate")
+        while True:
+            name = p.expect_name()
+            p.expect_kw("as")
+            p.expect_op("(")
+            sub = _parse_query(p)
+            p.expect_op(")")
+            ctes.append((name, sub))
+            if not p.accept_op(","):
+                break
     stmts = [_parse_select(p)]
-    modes = []
-    while p.accept_kw("union"):
-        modes.append("all" if p.accept_kw("all") else "distinct")
+    ops = []  # ("union"|"intersect"|"except", "all"|"distinct")
+    while True:
+        if p.accept_kw("union"):
+            ops.append(("union", "all" if p.accept_kw("all") else "distinct"))
+        elif p.accept_kw("intersect"):
+            if p.accept_kw("all"):
+                raise SqlError("INTERSECT ALL is not supported")
+            ops.append(("intersect", "distinct"))
+        elif p.accept_kw("except"):
+            if p.accept_kw("all"):
+                raise SqlError("EXCEPT ALL is not supported")
+            ops.append(("except", "distinct"))
+        else:
+            break
         stmts.append(_parse_select(p))
-    return ("union", stmts, modes) if modes else ("select", stmts[0])
+    body = ("compound", stmts, ops) if ops else ("select", stmts[0])
+    return ("with", ctes, body) if ctes else body
 
 
 # ---------------------------------------------------------------------------
@@ -403,6 +443,8 @@ class _Env:
         out = []
         seen = set()
         for (al, col), m in self.qualified.items():
+            if al.startswith("#"):
+                continue  # hidden scalar-subquery bindings
             if qualifier is not None and al != qualifier:
                 continue
             if col in seen:
@@ -452,6 +494,14 @@ def _compile_scalar(ast, env: _Env, agg_ok: bool = False) -> Any:
         if fname == "coalesce":
             return coalesce(*compiled)
         raise SqlError(f"unsupported SQL function {fname!r}")
+    if kind == "anycol":
+        # a scalar-subquery placeholder inside HAVING: constant per group,
+        # so ANY over the group extracts it through the reduce
+        return reducers.any(env.resolve(ast[1], ast[2]))
+    if kind == "scalar_subquery":
+        raise SqlError(
+            "scalar subqueries are only supported in WHERE and HAVING"
+        )
     if kind == "star":
         raise SqlError("* only allowed as a projection or inside COUNT(*)")
     raise SqlError(f"cannot compile {ast!r}")
@@ -621,6 +671,86 @@ def _has_agg(ast) -> bool:
     return False
 
 
+# ---------------------------------------------------------------------------
+# scalar subqueries (uncorrelated, WHERE/HAVING)
+# ---------------------------------------------------------------------------
+
+
+def _rewrite_subqueries(ast, found: list, col_kind: str):
+    """Replace ``scalar_subquery`` nodes with placeholder column refs
+    (qualifier ``#subqN``); collects the subquery asts in ``found``."""
+    if isinstance(ast, list):
+        return [_rewrite_subqueries(x, found, col_kind) for x in ast]
+    if not isinstance(ast, tuple):
+        return ast
+    if ast[0] == "scalar_subquery":
+        idx = len(found)
+        found.append(ast[1])
+        return (col_kind, f"#subq{idx}", "val")
+    return tuple(_rewrite_subqueries(x, found, col_kind) for x in ast)
+
+
+def _scalar_subquery_table(q_ast, tables: dict[str, Table]) -> Table:
+    """Compile a scalar subquery; enforce single-row shape statically.
+
+    Streams have no runtime "more than one row" error point, so the
+    single-row guarantee must hold by construction: exactly one aggregate
+    projection, no GROUP BY, no set operations.
+    """
+    scoped = dict(tables)
+    body = q_ast
+    while body[0] == "with":
+        for name, sub in body[1]:
+            scoped[name] = _compile_query(sub, scoped)
+        body = body[2]
+    if body[0] != "select":
+        raise SqlError("scalar subquery cannot be a UNION/INTERSECT/EXCEPT")
+    s = body[1]
+    projs = s["projections"]
+    if (
+        s["group"] is not None
+        or len(projs) != 1
+        or projs[0][0][0] == "star"
+        or not _has_agg(projs[0][0])
+    ):
+        raise SqlError(
+            "scalar subquery must be a single aggregate projection without "
+            "GROUP BY (uncorrelated)"
+        )
+    return _compile_select(s, scoped)
+
+
+def _attach_scalar_subqueries(stmt: dict, env: _Env, tables: dict[str, Table]) -> _Env:
+    """Cross-join each uncorrelated scalar subquery's single-row result
+    onto the working table so WHERE/HAVING can reference it as a column."""
+    found: list = []  # WHERE and HAVING placeholders share one numbering
+    if stmt["where"] is not None:
+        stmt["where"] = _rewrite_subqueries(stmt["where"], found, "col")
+    if stmt["having"] is not None:
+        stmt["having"] = _rewrite_subqueries(stmt["having"], found, "anycol")
+    if not found:
+        return env
+    qualified = dict(env.qualified)
+    working = env.table
+    for i, sub_ast in enumerate(found):
+        sub = _scalar_subquery_table(sub_ast, tables)
+        mangled = f"_pw_subq_{i}"
+        sub1 = sub.select(
+            **{mangled: ColumnReference(this, sub.column_names()[0])}
+        )
+        always = expr_mod.ColumnBinaryOpExpression(
+            "==",
+            expr_mod.ColumnConstExpression(0),
+            expr_mod.ColumnConstExpression(0),
+        )
+        jr = JoinResult(working, sub1, [always], mode=JoinMode.INNER)
+        sel = {m: ColumnReference(left_ph, m) for m in working.column_names()}
+        sel[mangled] = ColumnReference(right_ph, mangled)
+        working = jr.select(**sel)
+        qualified[(f"#subq{i}", "val")] = mangled
+    return _Env(working, qualified)
+
+
 def _projection_name(ast, alias: str | None, auto: list[int]) -> str:
     if alias:
         return alias
@@ -633,8 +763,30 @@ def _projection_name(ast, alias: str | None, auto: list[int]) -> str:
     return f"col_{auto[0] - 1}"
 
 
+def _rewrite_having_aliases(ast, alias_map: dict, env: _Env):
+    """HAVING may reuse projection aliases (``SELECT SUM(v) AS s ...
+    HAVING s > 3``).  A name that resolves as a source column wins (the
+    standard rule); otherwise a matching projection's expression is
+    substituted."""
+    if isinstance(ast, list):
+        return [_rewrite_having_aliases(x, alias_map, env) for x in ast]
+    if not isinstance(ast, tuple):
+        return ast
+    if ast[0] == "col" and ast[1] is None:
+        name = ast[2]
+        try:
+            env.resolve(None, name)
+            return ast
+        except SqlError:
+            if name in alias_map:
+                return alias_map[name]
+            return ast
+    return tuple(_rewrite_having_aliases(x, alias_map, env) for x in ast)
+
+
 def _compile_select(stmt: dict, tables: dict[str, Table]) -> Table:
     env = _compile_from(stmt, tables)
+    env = _attach_scalar_subqueries(stmt, env, tables)
 
     if stmt["where"] is not None:
         env = _Env(env.table.filter(_compile_scalar(stmt["where"], env)), env.qualified)
@@ -687,8 +839,15 @@ def _compile_select(stmt: dict, tables: dict[str, Table]) -> Table:
 
     having_name = None
     if stmt["having"] is not None:
+        alias_map = {
+            (alias or (e[2] if e[0] == "col" else e[1] if e[0] == "agg" else None)): e
+            for e, alias in stmt["projections"]
+            if e[0] != "star"
+        }
+        alias_map.pop(None, None)
+        having_ast = _rewrite_having_aliases(stmt["having"], alias_map, env)
         having_name = "_pw_having"
-        select_exprs[having_name] = _compile_scalar(stmt["having"], env, agg_ok=True)
+        select_exprs[having_name] = _compile_scalar(having_ast, env, agg_ok=True)
 
     if group_refs:
         result = work.groupby(*group_refs).reduce(**select_exprs)
@@ -708,16 +867,77 @@ def _distinct(table: Table) -> Table:
     )
 
 
+def _align_columns(a: Table, b: Table) -> Table:
+    """Rename ``b``'s columns positionally to ``a``'s (set-op convention:
+    the first query names the output)."""
+    a_names, b_names = a.column_names(), b.column_names()
+    if len(a_names) != len(b_names):
+        raise SqlError(
+            f"set operation arity mismatch: {len(a_names)} vs {len(b_names)} columns"
+        )
+    if a_names == b_names:
+        return b
+    return b.select(
+        **{an: ColumnReference(this, bn) for an, bn in zip(a_names, b_names)}
+    )
+
+
+def _set_op(a: Table, b: Table, keep: str) -> Table:
+    """Value-based INTERSECT / EXCEPT with distinct set semantics.
+
+    Tag rows by side, concat, group by every value column, keep groups by
+    side-presence.  Grouping (not joining) makes NULLs compare equal, the
+    SQL set-operation rule that a join-based plan would violate.
+    """
+    b = _align_columns(a, b)
+    names = a.column_names()
+    ta = a.with_columns(_pw_setl=1, _pw_setr=0)
+    tb = b.with_columns(_pw_setl=0, _pw_setr=1)
+    both = ta.concat_reindex(tb)
+    refs = [ColumnReference(this, n) for n in names]
+    grouped = both.groupby(*refs).reduce(
+        **{n: ColumnReference(this, n) for n in names},
+        _pw_setl=reducers.sum(ColumnReference(this, "_pw_setl")),
+        _pw_setr=reducers.sum(ColumnReference(this, "_pw_setr")),
+    )
+    l_ref = ColumnReference(this, "_pw_setl")
+    r_ref = ColumnReference(this, "_pw_setr")
+    if keep == "intersect":
+        cond = (l_ref > 0) & (r_ref > 0)
+    else:  # except
+        cond = (l_ref > 0) & (r_ref == 0)
+    return grouped.filter(cond).without("_pw_setl", "_pw_setr")
+
+
 def _compile_query(ast, tables: dict[str, Table]) -> Table:
+    if ast[0] == "with":
+        # CTEs: each is visible to later CTEs and the body; user tables of
+        # the same name are shadowed for this query only
+        scoped = dict(tables)
+        for name, sub in ast[1]:
+            scoped[name] = _compile_query(sub, scoped)
+        return _compile_query(ast[2], scoped)
     if ast[0] == "select":
         return _compile_select(ast[1], tables)
-    _, stmts, modes = ast
-    result = _compile_select(stmts[0], tables)
-    for stmt, mode in zip(stmts[1:], modes):
-        nxt = _compile_select(stmt, tables)
-        result = result.concat_reindex(nxt)
-        if mode == "distinct":
-            result = _distinct(result)
+    _, stmts, ops = ast
+    # standard SQL precedence: INTERSECT binds tighter than UNION/EXCEPT
+    items: list[Table] = [_compile_select(s, tables) for s in stmts]
+    folded: list[Table] = [items[0]]
+    fold_ops: list[tuple[str, str]] = []
+    for (op, mode), nxt in zip(ops, items[1:]):
+        if op == "intersect":
+            folded[-1] = _set_op(folded[-1], nxt, "intersect")
+        else:
+            fold_ops.append((op, mode))
+            folded.append(nxt)
+    result = folded[0]
+    for (op, mode), nxt in zip(fold_ops, folded[1:]):
+        if op == "except":
+            result = _set_op(result, nxt, "except")
+        else:
+            result = result.concat_reindex(_align_columns(result, nxt))
+            if mode == "distinct":
+                result = _distinct(result)
     return result
 
 
