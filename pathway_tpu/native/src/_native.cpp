@@ -839,7 +839,335 @@ static PyObject *py_setup(PyObject *, PyObject *args) {
   Py_RETURN_NONE;
 }
 
+// ---------------------------------------------------------------------------
+// HNSW approximate-nearest-neighbor core (Malkov & Yashunin 2016).
+//
+// Parity role: the reference links the USearch C library for its HNSW
+// external index (src/external_integration/usearch_integration.rs:163); this
+// is the equivalent native core.  The Python layer
+// (stdlib/indexing/hnsw.py) keeps key mapping, metadata filters and
+// tombstone-compaction policy; this core owns the graph, the vector store
+// and the hot search/insert loops over dense u32 node ids.
+// ---------------------------------------------------------------------------
+
+#include <cmath>
+#include <queue>
+#include <random>
+#include <algorithm>
+
+namespace hnsw {
+
+struct Index {
+  int dim;
+  int metric;  // 0 = dot-based (cos/ip; cos pre-normalized on add), 1 = l2sq
+  int m, m0, ef_construction;
+  bool normalize;
+  double ml;
+  std::mt19937_64 rng;
+  std::vector<float> vecs;                               // node * dim
+  std::vector<int> levels;                               // per node
+  std::vector<char> dead;                                // tombstones
+  std::vector<std::vector<std::vector<uint32_t>>> links; // [layer][node]
+  int64_t entry = -1;
+  size_t n_dead = 0;
+  // visited-set epoch marking: O(1) reset per search
+  std::vector<uint32_t> visit_mark;
+  uint32_t visit_epoch = 0;
+
+  size_t size() const { return levels.size(); }
+
+  const float *vec(uint32_t id) const { return vecs.data() + (size_t)id * dim; }
+
+  float dist(const float *a, const float *b) const {
+    float acc = 0.f;
+    if (metric == 1) {
+      for (int i = 0; i < dim; i++) {
+        float d = a[i] - b[i];
+        acc += d * d;
+      }
+      return acc;
+    }
+    for (int i = 0; i < dim; i++) acc += a[i] * b[i];
+    return -acc;  // similarity -> distance
+  }
+
+  int draw_level() {
+    double u = std::uniform_real_distribution<double>(1e-12, 1.0)(rng);
+    return (int)(-std::log(u) * ml);
+  }
+
+  uint32_t greedy(const float *q, uint32_t start, int layer) const {
+    uint32_t cur = start;
+    float cur_d = dist(q, vec(cur));
+    bool improved = true;
+    while (improved) {
+      improved = false;
+      for (uint32_t nb : links[layer][cur]) {
+        float d = dist(q, vec(nb));
+        if (d < cur_d) {
+          cur_d = d;
+          cur = nb;
+          improved = true;
+        }
+      }
+    }
+    return cur;
+  }
+
+  // beam search on a layer; results (dist, id) sorted ascending, may
+  // include tombstoned nodes (callers filter)
+  void search_layer(const float *q, uint32_t ep, int layer, int ef,
+                    std::vector<std::pair<float, uint32_t>> &out) {
+    if (++visit_epoch == 0) {  // u32 wrap: clear marks once per 4G searches
+      std::fill(visit_mark.begin(), visit_mark.end(), 0);
+      visit_epoch = 1;
+    }
+    visit_mark.resize(size(), 0);
+    using Entry = std::pair<float, uint32_t>;
+    std::priority_queue<Entry, std::vector<Entry>, std::greater<Entry>> cand;
+    std::priority_queue<Entry> best;  // max-heap
+    float d0 = dist(q, vec(ep));
+    cand.push({d0, ep});
+    best.push({d0, ep});
+    visit_mark[ep] = visit_epoch;
+    while (!cand.empty()) {
+      auto [d, id] = cand.top();
+      if ((int)best.size() >= ef && d > best.top().first) break;
+      cand.pop();
+      for (uint32_t nb : links[layer][id]) {
+        if (visit_mark[nb] == visit_epoch) continue;
+        visit_mark[nb] = visit_epoch;
+        float nd = dist(q, vec(nb));
+        if ((int)best.size() < ef || nd < best.top().first) {
+          cand.push({nd, nb});
+          best.push({nd, nb});
+          if ((int)best.size() > ef) best.pop();
+        }
+      }
+    }
+    out.resize(best.size());
+    for (size_t i = best.size(); i-- > 0;) {
+      out[i] = best.top();
+      best.pop();
+    }
+  }
+
+  int64_t add(const float *raw) {
+    uint32_t id = (uint32_t)size();
+    vecs.insert(vecs.end(), raw, raw + dim);
+    if (normalize) {
+      float *v = vecs.data() + (size_t)id * dim;
+      float n = 0.f;
+      for (int i = 0; i < dim; i++) n += v[i] * v[i];
+      if (n > 0.f) {
+        n = 1.0f / std::sqrt(n);
+        for (int i = 0; i < dim; i++) v[i] *= n;
+      }
+    }
+    int level = draw_level();
+    levels.push_back(level);
+    dead.push_back(0);
+    while ((int)links.size() <= level) links.emplace_back();
+    for (auto &layer : links) layer.resize(size());
+
+    if (entry < 0 || dead[entry]) {
+      entry = id;
+      return id;
+    }
+    const float *q = vec(id);
+    uint32_t ep = (uint32_t)entry;
+    int top = levels[entry];
+    for (int layer = top; layer > level; layer--) ep = greedy(q, ep, layer);
+    std::vector<std::pair<float, uint32_t>> cands;
+    for (int layer = std::min(level, top); layer >= 0; layer--) {
+      search_layer(q, ep, layer, ef_construction, cands);
+      int m_max = layer == 0 ? m0 : m;
+      auto &mine = links[layer][id];
+      mine.clear();
+      for (auto &[d, k] : cands) {
+        if (k == id) continue;
+        mine.push_back(k);
+        if ((int)mine.size() >= m) break;
+      }
+      for (uint32_t nb : mine) {
+        auto &lst = links[layer][nb];
+        lst.push_back(id);
+        if ((int)lst.size() > m_max) {
+          // prune: keep the m_max closest to nb
+          const float *nv = vec(nb);
+          std::vector<std::pair<float, uint32_t>> scored;
+          scored.reserve(lst.size());
+          for (uint32_t t : lst) scored.push_back({dist(nv, vec(t)), t});
+          std::nth_element(scored.begin(), scored.begin() + m_max,
+                           scored.end());
+          lst.clear();
+          for (int i = 0; i < m_max; i++) lst.push_back(scored[i].second);
+        }
+      }
+      if (!cands.empty()) ep = cands[0].second;
+    }
+    if (level > levels[entry]) entry = id;
+    return id;
+  }
+
+  void remove(uint32_t id) {
+    if (id >= size() || dead[id]) return;
+    dead[id] = 1;
+    n_dead++;
+    if ((int64_t)id == entry) {
+      entry = -1;
+      int best_level = -1;
+      for (size_t i = 0; i < size(); i++)
+        if (!dead[i] && levels[i] > best_level) {
+          best_level = levels[i];
+          entry = (int64_t)i;
+        }
+    }
+  }
+
+  void search(const float *raw_q, int k, int ef,
+              std::vector<std::pair<float, uint32_t>> &out) {
+    out.clear();
+    if (entry < 0) return;
+    std::vector<float> qbuf(raw_q, raw_q + dim);
+    if (normalize) {
+      float n = 0.f;
+      for (int i = 0; i < dim; i++) n += qbuf[i] * qbuf[i];
+      if (n > 0.f) {
+        n = 1.0f / std::sqrt(n);
+        for (int i = 0; i < dim; i++) qbuf[i] *= n;
+      }
+    }
+    const float *q = qbuf.data();
+    if (ef < k) ef = k;
+    uint32_t ep = (uint32_t)entry;
+    for (int layer = levels[entry]; layer > 0; layer--) ep = greedy(q, ep, layer);
+    std::vector<std::pair<float, uint32_t>> found;
+    search_layer(q, ep, 0, ef, found);
+    for (auto &e : found)
+      if (!dead[e.second]) out.push_back(e);
+  }
+};
+
+}  // namespace hnsw
+
+static void hnsw_capsule_free(PyObject *cap) {
+  delete (hnsw::Index *)PyCapsule_GetPointer(cap, "pathway_tpu.hnsw");
+}
+
+static hnsw::Index *hnsw_from(PyObject *cap) {
+  return (hnsw::Index *)PyCapsule_GetPointer(cap, "pathway_tpu.hnsw");
+}
+
+static PyObject *py_hnsw_new(PyObject *, PyObject *args) {
+  int dim, m, efc;
+  unsigned long long seed;
+  const char *metric;
+  if (!PyArg_ParseTuple(args, "isiiK", &dim, &metric, &m, &efc, &seed))
+    return nullptr;
+  auto *ix = new hnsw::Index();
+  ix->dim = dim;
+  std::string ms(metric);
+  ix->metric = ms == "l2sq" ? 1 : 0;
+  ix->normalize = ms == "cos";
+  ix->m = m < 2 ? 2 : m;
+  ix->m0 = 2 * ix->m;
+  ix->ef_construction = efc < ix->m ? ix->m : efc;
+  ix->ml = 1.0 / std::log((double)ix->m);
+  ix->rng.seed(seed);
+  return PyCapsule_New(ix, "pathway_tpu.hnsw", hnsw_capsule_free);
+}
+
+static int hnsw_get_floats(PyObject *obj, int dim, Py_buffer *view) {
+  if (PyObject_GetBuffer(obj, view, PyBUF_CONTIG_RO) != 0) return -1;
+  if (view->len != (Py_ssize_t)(dim * sizeof(float))) {
+    PyBuffer_Release(view);
+    PyErr_Format(PyExc_ValueError, "expected %d float32 values", dim);
+    return -1;
+  }
+  return 0;
+}
+
+static PyObject *py_hnsw_add(PyObject *, PyObject *args) {
+  PyObject *cap, *buf;
+  if (!PyArg_ParseTuple(args, "OO", &cap, &buf)) return nullptr;
+  auto *ix = hnsw_from(cap);
+  if (!ix) return nullptr;
+  Py_buffer view;
+  if (hnsw_get_floats(buf, ix->dim, &view) != 0) return nullptr;
+  int64_t id = ix->add((const float *)view.buf);
+  PyBuffer_Release(&view);
+  return PyLong_FromLongLong(id);
+}
+
+static PyObject *py_hnsw_remove(PyObject *, PyObject *args) {
+  PyObject *cap;
+  unsigned long id;
+  if (!PyArg_ParseTuple(args, "Ok", &cap, &id)) return nullptr;
+  auto *ix = hnsw_from(cap);
+  if (!ix) return nullptr;
+  ix->remove((uint32_t)id);
+  Py_RETURN_NONE;
+}
+
+static PyObject *py_hnsw_search(PyObject *, PyObject *args) {
+  PyObject *cap, *buf;
+  int k, ef;
+  if (!PyArg_ParseTuple(args, "OOii", &cap, &buf, &k, &ef)) return nullptr;
+  auto *ix = hnsw_from(cap);
+  if (!ix) return nullptr;
+  Py_buffer view;
+  if (hnsw_get_floats(buf, ix->dim, &view) != 0) return nullptr;
+  std::vector<std::pair<float, uint32_t>> out;
+  ix->search((const float *)view.buf, k, ef, out);
+  PyBuffer_Release(&view);
+  PyObject *res = PyList_New((Py_ssize_t)out.size());
+  if (!res) return nullptr;
+  for (size_t i = 0; i < out.size(); i++) {
+    PyObject *pair =
+        Py_BuildValue("(kf)", (unsigned long)out[i].second, out[i].first);
+    if (!pair) {
+      Py_DECREF(res);
+      return nullptr;
+    }
+    PyList_SET_ITEM(res, (Py_ssize_t)i, pair);
+  }
+  return res;
+}
+
+static PyObject *py_hnsw_get_vector(PyObject *, PyObject *args) {
+  PyObject *cap;
+  unsigned long id;
+  if (!PyArg_ParseTuple(args, "Ok", &cap, &id)) return nullptr;
+  auto *ix = hnsw_from(cap);
+  if (!ix) return nullptr;
+  if (id >= ix->size()) {
+    PyErr_SetString(PyExc_KeyError, "unknown hnsw node id");
+    return nullptr;
+  }
+  // prepped form (cos: normalized) — re-adding it is idempotent
+  return PyBytes_FromStringAndSize((const char *)ix->vec((uint32_t)id),
+                                   (Py_ssize_t)ix->dim * sizeof(float));
+}
+
+static PyObject *py_hnsw_stats(PyObject *, PyObject *arg) {
+  auto *ix = hnsw_from(arg);
+  if (!ix) return nullptr;
+  return Py_BuildValue("(kk)", (unsigned long)ix->size(),
+                       (unsigned long)ix->n_dead);
+}
+
 static PyMethodDef methods[] = {
+    {"hnsw_new", py_hnsw_new, METH_VARARGS,
+     "HNSW index: (dim, metric, m, ef_construction, seed) -> capsule"},
+    {"hnsw_add", py_hnsw_add, METH_VARARGS,
+     "(capsule, float32 buffer) -> dense node id"},
+    {"hnsw_remove", py_hnsw_remove, METH_VARARGS, "(capsule, id) tombstone"},
+    {"hnsw_search", py_hnsw_search, METH_VARARGS,
+     "(capsule, query buffer, k, ef) -> [(id, dist)] live nodes, ascending"},
+    {"hnsw_get_vector", py_hnsw_get_vector, METH_VARARGS,
+     "(capsule, id) -> float32 bytes of the stored (prepped) vector"},
+    {"hnsw_stats", py_hnsw_stats, METH_O, "(capsule) -> (n_total, n_dead)"},
     {"setup", py_setup, METH_VARARGS, "register engine classes and helpers"},
     {"hash_values", py_hash_values, METH_O, "stable 128-bit value hash"},
     {"blake2b_128", py_blake2b_128, METH_O, "blake2b-128 digest"},

