@@ -1,0 +1,145 @@
+"""Opportunistic real-TPU benchmark attestation loop.
+
+The axon TPU tunnel is down for hours at a time (VERDICT r3 weak #1: three
+rounds of perf claims rest on builder attestation because the driver's
+fixed-time bench run kept landing in a down window).  This loop runs through
+the whole round: every ``--interval`` seconds it probes the tunnel
+(``probe_tpu.py`` — killable child, hard deadline), and on the first up
+window it runs the FULL driver-format ``bench.py`` measurement, writes the
+JSON artifact plus the profiler trace to ``benchmarks/attested/``, and
+commits them.  ``bench.py`` populates a persistent XLA compile cache on the
+first (cold) window so any later window — including the driver's
+end-of-round run — compiles in seconds.
+
+Usage: python benchmarks/attest_loop.py [--interval 900] [--once]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ATTEST_DIR = os.path.join(REPO, "benchmarks", "attested")
+TRACE_DIR = os.path.join(REPO, "benchmarks", "traces", "bench")
+
+
+def _probe(deadline: float = 90.0) -> str | None:
+    """Returns the probe's device line when the tunnel is up, else None."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "probe_tpu.py"), str(deadline)],
+        capture_output=True,
+        text=True,
+        timeout=deadline + 30,
+    )
+    if proc.returncode == 0:
+        return proc.stdout.strip()
+    return None
+
+
+def _run_bench() -> dict | None:
+    """Full bench.py run; returns the parsed headline JSON or None."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True,
+        text=True,
+        timeout=1400,  # 2 attempts x 540s child deadline + slack
+        cwd=REPO,
+    )
+    sys.stderr.write(proc.stderr[-2000:])
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def _is_real_tpu(result: dict) -> bool:
+    kind = str(result.get("device_kind", "")).lower()
+    return (
+        result.get("platform") != "cpu-fallback"
+        and "error" not in result
+        and ("tpu" in kind or "v5" in kind or "v6" in kind)
+    )
+
+
+def _commit(paths: list[str], message: str) -> None:
+    try:
+        subprocess.run(["git", "add", "--", *paths], cwd=REPO, check=True, timeout=60)
+        subprocess.run(
+            ["git", "commit", "-m", message, "--", *paths],
+            cwd=REPO,
+            check=True,
+            timeout=60,
+            capture_output=True,
+        )
+        print(f"attest_loop: committed {message}", flush=True)
+    except subprocess.CalledProcessError as exc:
+        # a concurrent commit holds the index lock, or nothing to commit —
+        # the artifact is on disk either way; the next cycle (or the
+        # driver's end-of-round sweep) picks it up
+        print(f"attest_loop: commit failed: {exc}", file=sys.stderr, flush=True)
+
+
+def attest_once() -> bool:
+    probe_line = _probe()
+    if probe_line is None:
+        print("attest_loop: tunnel down", flush=True)
+        return False
+    print(f"attest_loop: tunnel UP ({probe_line}); running bench", flush=True)
+    result = _run_bench()
+    if result is None or not _is_real_tpu(result):
+        print(f"attest_loop: bench did not land on TPU: {result}", flush=True)
+        return False
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    head = subprocess.run(
+        ["git", "rev-parse", "HEAD"], cwd=REPO, capture_output=True, text=True
+    ).stdout.strip()
+    result["attested_at_utc"] = stamp
+    result["git_head"] = head
+    result["probe"] = probe_line
+    os.makedirs(ATTEST_DIR, exist_ok=True)
+    out_path = os.path.join(ATTEST_DIR, f"BENCH_attested_{stamp}.json")
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+        f.write("\n")
+    paths = [out_path]
+    # the profiler trace (written by bench.py's profile_trace extra) is the
+    # hard evidence — copy the newest session into the attested dir
+    if os.path.isdir(TRACE_DIR):
+        dest = os.path.join(ATTEST_DIR, f"trace_{stamp}")
+        shutil.copytree(TRACE_DIR, dest, dirs_exist_ok=True)
+        paths.append(dest)
+    _commit(paths, f"Attested TPU bench: {result.get('value')} emb/s ({stamp})")
+    return True
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=900.0)
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args()
+    import time
+
+    while True:
+        try:
+            ok = attest_once()
+        except Exception as exc:  # noqa: BLE001 — the loop must survive anything
+            print(f"attest_loop: cycle error: {exc}", file=sys.stderr, flush=True)
+            ok = False
+        if args.once:
+            sys.exit(0 if ok else 1)
+        # after a successful capture, still keep looping (more windows =
+        # more evidence) but back off harder
+        time.sleep(args.interval * (4 if ok else 1))
+
+
+if __name__ == "__main__":
+    main()
