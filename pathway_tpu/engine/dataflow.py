@@ -61,17 +61,28 @@ _native_consolidate = None
 _native_checked = False
 
 
-def _get_native_consolidate():
-    global _native_consolidate, _native_checked
+_native_module = None
+
+
+def _get_native_module():
+    global _native_module, _native_checked, _native_consolidate
     if not _native_checked:
         _native_checked = True
         try:
             from pathway_tpu import native as _nat
 
-            mod = _nat.get()
-            _native_consolidate = getattr(mod, "consolidate_dirty", None)
+            _native_module = _nat.get()
+            _native_consolidate = getattr(
+                _native_module, "consolidate_dirty", None
+            )
         except Exception:
+            _native_module = None
             _native_consolidate = None
+    return _native_module
+
+
+def _get_native_consolidate():
+    _get_native_module()
     return _native_consolidate
 
 
@@ -355,9 +366,21 @@ class InputNode(Node):
         """Fold rows staged at earlier times into epoch ``time`` (the runner
         picks one commit timestamp across all inputs), keeping the earliest
         ingest wallclock so latency probes measure from first arrival."""
+        below = [st for st in self._staged if st <= time]
+        if len(below) == 1:
+            # single staged bucket: move the list object itself so a
+            # CleanDeltas tag (stage_static's cleanliness proof) survives
+            # and emit_time's consolidate becomes O(1)
+            st = below[0]
+            if st != time:
+                self._staged[time] = self._staged.pop(st)
+                w = self._staged_wallclock.pop(st, None)
+                if w is not None:
+                    self._staged_wallclock[time] = w
+            return
         merged: list[Delta] = []
         wall: float | None = None
-        for staged in sorted(st for st in self._staged if st <= time):
+        for staged in sorted(below):
             merged.extend(self._staged.pop(staged))
             w = self._staged_wallclock.pop(staged, None)
             if w is not None:
@@ -412,22 +435,54 @@ class StaticNode(InputNode):
 
     name = "static"
 
-    def __init__(self, scope: "Scope", rows: Iterable[tuple[int, Row, Time, int]]):
+    def __init__(
+        self,
+        scope: "Scope",
+        rows: Iterable[tuple[int, Row, Time, int]] | None = None,
+        *,
+        prestaged: "list[Delta] | None" = None,
+        prestaged_time: Time = 0,
+    ):
         super().__init__(scope)
-        # bulk-stage by time: per-row insert() was a measurable share of the
-        # static-ingest epoch at 1M rows
         now = _monotonic()
-        by_time: dict[Time, list[Delta]] = defaultdict(list)
-        for key, row, time, diff in rows:
-            by_time[time].append((key, row, diff))
-        for time, deltas in by_time.items():
-            self._staged[time].extend(deltas)
-            self._staged_wallclock.setdefault(time, now)
+        if prestaged is not None:
+            # the builder already produced epoch-shaped deltas (and tagged
+            # CleanDeltas when provably clean) — stage the object as-is,
+            # zero extra passes
+            self._staged[prestaged_time] = prestaged
+            self._staged_wallclock.setdefault(prestaged_time, now)
+            self.finished = True
+            self.declared_append_only = isinstance(
+                prestaged, CleanDeltas
+            ) or all(d >= 0 for (_, _, d) in prestaged)
+            return
+        # bulk-stage by time: per-row insert() was a measurable share of the
+        # static-ingest epoch at 1M rows.  The native partitioner also
+        # proves per-bucket cleanliness (unique keys, all diffs +1) so the
+        # emit path's consolidate scan collapses to an O(1) tag check.
+        stage = None
+        nat = _get_native_module()
+        if nat is not None:
+            stage = getattr(nat, "stage_static", None)
+        if stage is not None:
+            rows_list = rows if isinstance(rows, list) else list(rows)
+            staged = stage(rows_list, CleanDeltas)
+            for time, deltas, clean in staged:
+                self._staged[time] = deltas  # already CleanDeltas iff clean
+                self._staged_wallclock.setdefault(time, now)
+        else:
+            by_time: dict[Time, list[Delta]] = defaultdict(list)
+            for key, row, time, diff in rows:
+                by_time[time].append((key, row, diff))
+            for time, deltas in by_time.items():
+                self._staged[time].extend(deltas)
+                self._staged_wallclock.setdefault(time, now)
         self.finished = True
         # build-time rows are fully known: a static table with no deletion
         # diffs is factually append-only, no declaration needed
         self.declared_append_only = all(
-            d >= 0 for ds in self._staged.values() for (_, _, d) in ds
+            isinstance(ds, CleanDeltas) or all(d >= 0 for (_, _, d) in ds)
+            for ds in self._staged.values()
         )
 
 
@@ -458,25 +513,23 @@ class ExprNode(Node):
         if not vc.ENABLED:
             return None
         needed, out_fns, out_dtypes = self.vec_select
-        rows = [r for (_, r, _) in deltas]
-        cols = vc.materialize_columns(rows, needed)
+        cols = vc.materialize_delta_columns(deltas, needed)
         if cols is None:
             return None
-        n = len(rows)
+        n = len(deltas)
         try:
             out_cols = []
             for f, d in zip(out_fns, out_dtypes):
+                if isinstance(f, int):  # passthrough: copy from input row
+                    out_cols.append(("P", f))
+                    continue
                 arr = f(cols, n)
                 if not vc.result_kind_ok(arr, d):
                     return None
-                out_cols.append(arr.tolist())  # C-speed → Python scalars
+                out_cols.append(arr)
         except vc.VecBail:
             return None
-        out_rows = list(zip(*out_cols)) if out_cols else [()] * n
-        return [
-            (key, new_row, diff)
-            for (key, _, diff), new_row in zip(deltas, out_rows)
-        ]
+        return vc.rebuild_delta_rows(deltas, out_cols, n)
 
     def step(self, time):
         deltas = self.take_pending()
@@ -1068,20 +1121,43 @@ class GroupByNode(Node):
         if not vc.ENABLED:
             return False
         gidx, red_cols = self.vec_group
-        rows = [r for (_, r, _) in deltas]
         needed = {gidx} | {vidx for kind, vidx in red_cols if kind != "count"}
-        # shared materializer: uniform-Python-type + int64-range checks
-        cols = vc.materialize_columns(rows, needed)
-        if cols is None:
+        # shared materializer: uniform-Python-type + int64-range checks.
+        # Raw form keeps str columns as Python lists so the group keys can
+        # hash-group natively (np.unique on a 1M-row U-array pays a full
+        # array build plus a sort — the hot spot of the wordcount epoch).
+        raw = vc.materialize_delta_columns_raw(deltas, needed)
+        gvals_list = None
+        inv = None
+        if raw is NotImplemented:
+            cols = vc.materialize_delta_columns(deltas, needed)
+            if cols is None:
+                return False
+        elif raw is None:
             return False
+        else:
+            cols = {}
+            for i, (kind, payload) in raw.items():
+                if i == gidx and kind == "U":
+                    gvals_list, inv = vc.group_indices(payload)
+                    cols[i] = payload  # raw list; only grouped, never math
+                else:
+                    cols[i] = vc.wrap_native_col(kind, payload)
         garr = cols[gidx]
-        # NaN group keys: np.unique collapses all NaNs into one group while
-        # the row path's dict keeps one group per NaN object — bail
-        if garr.dtype.kind == "f" and np.isnan(garr).any():
-            return False
+        if gvals_list is None:
+            # NaN group keys: np.unique collapses all NaNs into one group
+            # while the row path's dict keeps one group per NaN object — bail
+            if garr.dtype.kind == "f" and np.isnan(garr).any():
+                return False
         val_arrs = [
             None if kind == "count" else cols[vidx] for kind, vidx in red_cols
         ]
+        if any(isinstance(v, list) for v in val_arrs):
+            # a str group column doubling as a reducer value column: rare —
+            # wrap it for the mm path
+            val_arrs = [
+                np.asarray(v) if isinstance(v, list) else v for v in val_arrs
+            ]
         for (kind, _), varr in zip(red_cols, val_arrs):
             # sums need numeric columns; min/max works on any materialized
             # dtype (incl. str) since it only groups and counts
@@ -1092,7 +1168,7 @@ class GroupByNode(Node):
             # entry per object — bail to the row path to keep parity
             if kind == "mm" and varr.dtype.kind == "f" and np.isnan(varr).any():
                 return False
-        diffs = np.asarray([d for (_, _, d) in deltas], np.int64)
+        diffs = vc.delta_diffs(deltas)
         max_diff = vc._abs_bound(diffs)
         for (kind, _), varr in zip(red_cols, val_arrs):
             # per-batch int sums must stay within i64 (state accumulates in
@@ -1100,11 +1176,13 @@ class GroupByNode(Node):
             if (
                 kind == "sum"
                 and varr.dtype.kind == "i"
-                and vc._abs_bound(varr) * max_diff * max(1, len(rows)) > vc._I64_MAX
+                and vc._abs_bound(varr) * max_diff * max(1, len(deltas)) > vc._I64_MAX
             ):
                 return False
-        uniq, inv = np.unique(garr, return_inverse=True)
-        n_groups = len(uniq)
+        if gvals_list is None:
+            uniq, inv = np.unique(garr, return_inverse=True)
+            gvals_list = uniq.tolist()
+        n_groups = len(gvals_list)
         counts = np.zeros(n_groups, np.int64)
         np.add.at(counts, inv, diffs)
         contribs = []
@@ -1133,7 +1211,7 @@ class GroupByNode(Node):
                 acc = np.zeros(n_groups, np.int64)
                 np.add.at(acc, inv, varr.astype(np.int64) * diffs)
                 contribs.append(acc)
-        gvals = uniq.tolist()
+        gvals = gvals_list
         counts_l = counts.tolist()
         contribs_l = [
             c.tolist() if isinstance(c, np.ndarray) else c for c in contribs

@@ -715,6 +715,12 @@ class Table(Joinable):
 
                 vec_fns, needed = [], set()
                 for e in desugared.values():
+                    # bare column refs skip materialize/rebuild entirely:
+                    # the native rebuild copies them from the input row
+                    pt = vc.passthrough_index(e, binder)
+                    if pt is not None:
+                        vec_fns.append(pt)
+                        continue
                     compiled = vc.try_compile_vec(e, binder)
                     if compiled is None:
                         vec_fns = None
@@ -854,21 +860,16 @@ class Table(Joinable):
 
                 def _try_columnar(self_inner, deltas):
                     f_vec, needed = vec
-                    rows = [r for (_, r, _) in deltas]
-                    cols = vc.materialize_columns(rows, needed)
+                    cols = vc.materialize_delta_columns(deltas, needed)
                     if cols is None:
                         return None
                     try:
-                        mask = f_vec(cols, len(rows))
+                        mask = f_vec(cols, len(deltas))
                     except vc.VecBail:
                         return None
                     if mask.dtype.kind != "b":
                         return None
-                    return [
-                        (key, row[:n_cols], diff)
-                        for (key, row, diff), keep in zip(deltas, mask.tolist())
-                        if keep
-                    ]
+                    return vc.filter_deltas(deltas, mask, n_cols)
 
                 def step(self_inner, time):
                     deltas = self_inner.take_pending()

@@ -67,6 +67,25 @@ def _const_array(v, n: int) -> np.ndarray:
     return np.full(n, v)
 
 
+def passthrough_index(e, binder) -> int | None:
+    """Source-column index when ``e`` is a bare same-table column reference
+    (the dominant ``with_columns`` shape).  The columnar path then copies
+    the value straight from the input row — no materialization, no
+    array↔scalar conversions — which also means dirty columns (None/Error/
+    mixed) no longer force the whole node onto the row path.  Values in
+    rows are already coerced to their column dtypes, so the copy matches
+    the row path's ``dt.coerce`` identity bit-for-bit."""
+    if isinstance(e, ColumnReference):
+        tbl = e.table
+        if (
+            (isinstance(tbl, ThisPlaceholder) or tbl is binder.table)
+            and e.name != "id"
+            and e.name in binder.col_index
+        ):
+            return binder.col_index[e.name]
+    return None
+
+
 def try_compile_vec(e: ColumnExpression, binder) -> tuple[VecFn, set[int]] | None:
     """Compile to a columnar evaluator, or None if not vectorizable.
 
@@ -289,6 +308,158 @@ def _bin_vec(op: str, lf: VecFn, rf: VecFn) -> VecFn:
     return run
 
 
+_NAT_KIND_DTYPE = {"q": np.int64, "d": np.float64, "?": np.bool_}
+
+_native_syms: dict[str, Any] | None = None
+
+
+def _native_sym(name: str):
+    """Memoized lookup of a native-core function (None when unavailable) —
+    the hot paths below must not pay an import + getattr per epoch batch."""
+    global _native_syms
+    if _native_syms is None:
+        syms: dict[str, Any] = {}
+        try:
+            from pathway_tpu import native as _nat
+
+            mod = _nat.get()
+            for n in (
+                "materialize_columns",
+                "rebuild_delta_rows",
+                "filter_deltas",
+                "group_indices",
+                "delta_diffs",
+            ):
+                syms[n] = getattr(mod, n, None)
+        except Exception:
+            syms = {}
+        _native_syms = syms
+    return _native_syms.get(name)
+
+
+def _get_native_materialize():
+    return _native_sym("materialize_columns")
+
+
+def _wrap_native_cols(res: dict) -> dict[int, np.ndarray]:
+    return {
+        i: (
+            np.asarray(payload)
+            if kind == "U"
+            else np.frombuffer(payload, dtype=_NAT_KIND_DTYPE[kind])
+        )
+        for i, (kind, payload) in res.items()
+    }
+
+
+def materialize_delta_columns(
+    deltas: list, needed: set[int]
+) -> dict[int, np.ndarray] | None:
+    """materialize_columns straight from a delta list (no rows listcomp) —
+    the native single-pass when available."""
+    nm = _get_native_materialize()
+    if nm is not None:
+        res = nm(deltas, tuple(needed), True)
+        return None if res is None else _wrap_native_cols(res)
+    return materialize_columns([r for (_, r, _) in deltas], needed)
+
+
+def materialize_delta_columns_raw(deltas: list, needed: set[int]):
+    """Native raw form ``{idx: (kind, payload)}`` — str columns stay Python
+    lists (no U-array build), which the hash-grouping path wants.  Returns
+    ``NotImplemented`` when the native core is unavailable."""
+    nm = _get_native_materialize()
+    if nm is None:
+        return NotImplemented
+    return nm(deltas, tuple(needed), True)
+
+
+def wrap_native_col(kind: str, payload) -> np.ndarray:
+    if kind == "U":
+        return np.asarray(payload)
+    return np.frombuffer(payload, dtype=_NAT_KIND_DTYPE[kind])
+
+
+def group_indices(values: list) -> tuple[list, np.ndarray]:
+    """(uniques, inverse) by hash grouping — np.unique(return_inverse)
+    without the sort or the U-array conversion.  Uniques are in first-seen
+    order (callers must not rely on sortedness)."""
+    gi = _native_sym("group_indices")
+    if gi is not None:
+        uniques, inv = gi(values)
+        return uniques, np.frombuffer(inv, np.int64)
+    index: dict = {}
+    inv = np.empty(len(values), np.int64)
+    uniques: list = []
+    for i, v in enumerate(values):
+        pos = index.get(v)
+        if pos is None:
+            pos = index[v] = len(uniques)
+            uniques.append(v)
+        inv[i] = pos
+    return uniques, inv
+
+
+def delta_diffs(deltas: list) -> np.ndarray:
+    """int64 diffs column of a delta list (native single pass)."""
+    dd = _native_sym("delta_diffs")
+    if dd is not None:
+        buf = dd(deltas)
+        if buf is not None:
+            return np.frombuffer(buf, np.int64)
+    return np.asarray([d for (_, _, d) in deltas], np.int64)
+
+
+_NAT_DTYPE_KIND = {"i": "q", "f": "d", "b": "?"}
+
+
+def rebuild_delta_rows(deltas: list, out_cols: list, n: int) -> list:
+    """Zip result columns back into (key, row_tuple, diff) deltas, reusing
+    the input keys/diffs.  ``out_cols`` entries are ndarrays or
+    ``("P", src_idx)`` passthrough markers (copied from the input row).
+    Native single pass when available; the Python fallback is the
+    semantics reference (tolist -> zip)."""
+    rb = _native_sym("rebuild_delta_rows")
+    if rb is not None:
+        packed = []
+        for arr in out_cols:
+            if isinstance(arr, tuple):  # ("P", src_idx)
+                packed.append(arr)
+                continue
+            kind = _NAT_DTYPE_KIND.get(arr.dtype.kind)
+            if kind is not None and arr.dtype.itemsize in (1, 8):
+                packed.append((kind, np.ascontiguousarray(arr)))
+            else:  # U / object / narrow dtypes: go through Python scalars
+                packed.append(("U", arr.tolist()))
+        return rb(deltas, packed)
+    out_lists = [
+        (
+            [row[arr[1]] for (_, row, _) in deltas]
+            if isinstance(arr, tuple)
+            else arr.tolist()
+        )
+        for arr in out_cols
+    ]
+    out_rows = list(zip(*out_lists)) if out_lists else [()] * n
+    return [
+        (key, new_row, diff)
+        for (key, _, diff), new_row in zip(deltas, out_rows)
+    ]
+
+
+def filter_deltas(deltas: list, mask: np.ndarray, n_cols: int) -> list:
+    """Keep deltas where ``mask`` is true, truncating rows to ``n_cols``.
+    Native single pass when available."""
+    fd = _native_sym("filter_deltas")
+    if fd is not None:
+        return fd(deltas, np.ascontiguousarray(mask, dtype=np.uint8), n_cols)
+    return [
+        (key, row[:n_cols], diff)
+        for (key, row, diff), keep in zip(deltas, mask.tolist())
+        if keep
+    ]
+
+
 def materialize_columns(rows: list, needed: set[int]) -> dict[int, np.ndarray] | None:
     """Extract the needed columns as typed 1-D arrays; None if any column is
     not cleanly typed (None/Error/mixed/nested values).
@@ -296,7 +467,15 @@ def materialize_columns(rows: list, needed: set[int]) -> dict[int, np.ndarray] |
     Uniform *Python* types are required — np.asarray would silently promote
     int/float mixes to float64 (precision loss above 2**53) and bool/int
     mixes to int64, changing values the row path preserves exactly.
+
+    The native core does the scan+extract in one C pass per column when
+    available; the Python loop below is the fallback and the semantics
+    reference.
     """
+    nm = _get_native_materialize()
+    if nm is not None:
+        res = nm(rows, tuple(needed), False)
+        return None if res is None else _wrap_native_cols(res)
     cols: dict[int, np.ndarray] = {}
     for i in needed:
         vals = [r[i] for r in rows]

