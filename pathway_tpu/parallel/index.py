@@ -94,11 +94,15 @@ class ShardedDeviceIndex:
     L2-normalized (the encoders in ``models/encoder.py`` guarantee this).
     """
 
-    def __init__(self, mesh: Mesh, dim: int, block: int = 1024):
+    def __init__(self, mesh: Mesh, dim: int, block: int = 1024, dtype=None):
         self.mesh = mesh
         self.dim = dim
         self.n_chips = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
         self.block = block
+        # north-star layout stores the corpus in bf16 (HBM: 2 bytes/dim —
+        # 10M x 384 over 16 chips = 480 MB/chip); score_block casts to the
+        # matmul dtype per backend, so storage dtype only sets memory
+        self.dtype = np.float32 if dtype is None else dtype
         self._n = 0
         self._docs = None
         self._mask = None
@@ -127,7 +131,7 @@ class ShardedDeviceIndex:
             else np.zeros((0, self.dim), np.float32)
         )
         cap = self._capacity(self._n)
-        padded = np.zeros((cap, self.dim), np.float32)
+        padded = np.zeros((cap, self.dim), self.dtype)
         padded[: self._n] = full
         mask = np.full((cap,), -np.inf, np.float32)
         mask[: self._n] = 0.0
