@@ -11,9 +11,15 @@ Differences by design: the reference exports OTLP/gRPC to
 ``usage.pathway.com`` by default when the license requires telemetry;
 this build has **zero egress**, so nothing is ever sent unless the user
 explicitly configures an endpoint (``pw.set_monitoring_config`` /
-``TelemetryConfig.create(monitoring_server=...)``), and the exporter is
-line-delimited JSON over HTTP POST rather than OTLP/gRPC (no
-opentelemetry wheels in the image; the payload carries the same names).
+``TelemetryConfig.create(monitoring_server=...)``).
+
+Wire format: **OTLP/HTTP+JSON** by default (the JSON mapping of the
+opentelemetry-proto ``ExportMetricsServiceRequest`` /
+``ExportTraceServiceRequest``, POSTed to ``/v1/metrics`` and
+``/v1/traces``) — any stock OpenTelemetry collector ingests it, closing
+the parity gap with ``telemetry.rs``'s OTLP exporter without needing the
+absent opentelemetry wheels.  ``protocol="pathway-json"``
+(``PATHWAY_TELEMETRY_PROTOCOL``) keeps the round-3 line-JSON format.
 """
 
 from __future__ import annotations
@@ -61,6 +67,7 @@ class TelemetryConfig:
     run_id: str = ""
     trace_parent: str | None = None
     license_shortcut: str = ""
+    protocol: str = "otlp-json"  # or "pathway-json" (legacy line JSON)
 
     @classmethod
     def create(
@@ -70,6 +77,7 @@ class TelemetryConfig:
         run_id: str | None = None,
         monitoring_server: str | None = None,
         trace_parent: str | None = None,
+        protocol: str | None = None,
     ) -> "TelemetryConfig":
         """Mirror of ``TelemetryConfig::create`` (telemetry.rs): a
         monitoring endpoint requires the MONITORING entitlement; with no
@@ -94,6 +102,11 @@ class TelemetryConfig:
             run_id=run_id or secrets.token_hex(8),
             trace_parent=trace_parent,
             license_shortcut=license.shortcut() if license is not None else "",
+            protocol=_validate_protocol(
+                protocol
+                if protocol is not None
+                else os.environ.get("PATHWAY_TELEMETRY_PROTOCOL", "otlp-json")
+            ),
         )
 
     def resource(self) -> dict[str, str]:
@@ -106,6 +119,19 @@ class TelemetryConfig:
             "root.trace.id": _root_trace_id(self.trace_parent) or "",
             "license.key": self.license_shortcut,
         }
+
+
+_PROTOCOLS = ("otlp-json", "pathway-json")
+
+
+def _validate_protocol(value: str) -> str:
+    """Reject unknown wire formats loudly: a typo falling back silently
+    would make every export 400 at the collector with only debug logs."""
+    if value not in _PROTOCOLS:
+        raise TelemetryError(
+            f"unknown telemetry protocol {value!r}; expected one of {_PROTOCOLS}"
+        )
+    return value
 
 
 def _root_trace_id(trace_parent: str | None) -> str | None:
@@ -133,6 +159,97 @@ def _process_metrics() -> dict[str, float]:
     return metrics
 
 
+# ---------------------------------------------------------------------------
+# OTLP/HTTP+JSON encoding — the official JSON mapping of opentelemetry-proto
+# (ExportMetricsServiceRequest / ExportTraceServiceRequest), hand-encoded so
+# any stock OTel collector ingests our payloads with zero extra wheels.
+# ---------------------------------------------------------------------------
+
+
+def _otlp_value(v) -> dict:
+    # bool first: it is an int subclass
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}  # proto JSON maps int64 to string
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def _otlp_attrs(d: dict) -> list[dict]:
+    return [{"key": k, "value": _otlp_value(v)} for k, v in d.items()]
+
+
+def _otlp_metrics(payload: dict) -> dict:
+    t_ns = str(int(payload.get("ts", time.time()) * 1e9))
+    return {
+        "resourceMetrics": [
+            {
+                "resource": {"attributes": _otlp_attrs(payload["resource"])},
+                "scopeMetrics": [
+                    {
+                        "scope": {"name": "pathway_tpu"},
+                        "metrics": [
+                            {
+                                "name": name,
+                                "gauge": {
+                                    "dataPoints": [
+                                        {
+                                            "asDouble": float(value),
+                                            "timeUnixNano": t_ns,
+                                        }
+                                    ]
+                                },
+                            }
+                            for name, value in payload["metrics"].items()
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+
+
+def _otlp_traces(payload: dict) -> dict:
+    span = payload["span"]
+    trace_id = (
+        _root_trace_id(span.get("trace_parent"))
+        or payload.get("fallback_trace_id")
+        or secrets.token_hex(16)
+    )
+    parent = (span.get("trace_parent") or "").split("-")
+    parent_span_id = parent[2] if len(parent) >= 4 and len(parent[2]) == 16 else ""
+    start_ns = int(span["start"] * 1e9)
+    end_ns = start_ns + int(span["duration_s"] * 1e9)
+    return {
+        "resourceSpans": [
+            {
+                "resource": {"attributes": _otlp_attrs(payload["resource"])},
+                "scopeSpans": [
+                    {
+                        "scope": {"name": "pathway_tpu"},
+                        "spans": [
+                            {
+                                "traceId": trace_id,
+                                "spanId": secrets.token_hex(8),
+                                "parentSpanId": parent_span_id,
+                                "name": span["name"],
+                                "kind": 1,  # SPAN_KIND_INTERNAL
+                                "startTimeUnixNano": str(start_ns),
+                                "endTimeUnixNano": str(end_ns),
+                                "attributes": _otlp_attrs(
+                                    span.get("attributes", {})
+                                ),
+                            }
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+
+
 class Telemetry:
     """Samples metrics on a timer and POSTs them; collects spans.
 
@@ -155,6 +272,9 @@ class Telemetry:
         self._thread: threading.Thread | None = None
         self.spans: list[dict] = []
         self._span_lock = threading.Lock()
+        # one trace per run when no traceparent was propagated: all this
+        # run's spans must correlate in the collector
+        self._fallback_trace_id = secrets.token_hex(16)
 
     # -- metrics -----------------------------------------------------------
     def sample(self) -> dict[str, Any]:
@@ -172,7 +292,12 @@ class Telemetry:
         }
 
     def _export(self, kind: str, payload: dict, servers: tuple[str, ...]) -> None:
-        body = json.dumps({"kind": kind, **payload}).encode()
+        if self.config.protocol == "otlp-json":
+            body = json.dumps(
+                _otlp_metrics(payload) if kind == "metrics" else _otlp_traces(payload)
+            ).encode()
+        else:  # legacy line-JSON (round-3 format)
+            body = json.dumps({"kind": kind, **payload}).encode()
         for endpoint in servers:
             url = endpoint.rstrip("/") + f"/v1/{kind}"
             try:
@@ -202,7 +327,11 @@ class Telemetry:
             if self.config.telemetry_enabled:
                 self._export(
                     "traces",
-                    {"resource": self.config.resource(), "span": record},
+                    {
+                        "resource": self.config.resource(),
+                        "span": record,
+                        "fallback_trace_id": self._fallback_trace_id,
+                    },
                     self.config.tracing_servers,
                 )
 

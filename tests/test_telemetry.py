@@ -141,7 +141,7 @@ def test_trace_parent_root_id():
     assert cfg.resource()["root.trace.id"] == "0af7651916cd43dd8448eb211c80319c"
 
 
-def test_metrics_and_spans_posted_to_configured_endpoint():
+def _capture_server():
     received = []
 
     class Handler(BaseHTTPRequestHandler):
@@ -155,8 +155,14 @@ def test_metrics_and_spans_posted_to_configured_endpoint():
             pass
 
     server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-    thread = threading.Thread(target=server.serve_forever, daemon=True)
-    thread.start()
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, received
+
+
+def test_metrics_and_spans_posted_to_configured_endpoint(monkeypatch):
+    # legacy line-JSON wire format stays available behind the env switch
+    monkeypatch.setenv("PATHWAY_TELEMETRY_PROTOCOL", "pathway-json")
+    server, received = _capture_server()
     try:
         endpoint = f"http://127.0.0.1:{server.server_address[1]}"
         cfg = TelemetryConfig.create(
@@ -191,3 +197,46 @@ def test_run_records_span_without_egress():
     assert not result.telemetry.config.telemetry_enabled  # zero egress default
     assert [s["name"] for s in result.telemetry.spans] == ["pathway.run"]
     assert result.telemetry.spans[0]["duration_s"] >= 0
+
+
+def test_otlp_json_is_the_default_wire_format():
+    """OTLP/HTTP+JSON (opentelemetry-proto JSON mapping): a stock OTel
+    collector must be able to ingest our payloads — VERDICT r3 weak #6."""
+    server, received = _capture_server()
+    try:
+        endpoint = f"http://127.0.0.1:{server.server_address[1]}"
+        cfg = TelemetryConfig.create(
+            license=License.new("demo-license-key-with-telemetry-abc"),
+            monitoring_server=endpoint,
+            run_id="r4",
+            trace_parent="00-" + "ab" * 16 + "-" + "cd" * 8 + "-01",
+        )
+        assert cfg.protocol == "otlp-json"
+        tele = Telemetry(cfg, interval_s=0.05).start()
+        with tele.span("pathway.run", workers=2):
+            pass
+        import time as _t
+
+        _t.sleep(0.3)
+        tele.close()
+    finally:
+        server.shutdown()
+        server.server_close()
+    metrics = next(b for p, b in received if p == "/v1/metrics")
+    rm = metrics["resourceMetrics"][0]
+    attrs = {a["key"]: a["value"]["stringValue"] for a in rm["resource"]["attributes"]}
+    assert attrs["run.id"] == "r4"
+    gauges = {m["name"]: m for m in rm["scopeMetrics"][0]["metrics"]}
+    assert PROCESS_MEMORY_USAGE in gauges
+    dp = gauges[PROCESS_MEMORY_USAGE]["gauge"]["dataPoints"][0]
+    assert float(dp["asDouble"]) > 0 and dp["timeUnixNano"].isdigit()
+    traces = next(b for p, b in received if p == "/v1/traces")
+    span = traces["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    assert span["name"] == "pathway.run"
+    assert span["traceId"] == "ab" * 16  # propagated from traceparent
+    assert span["parentSpanId"] == "cd" * 8
+    assert len(span["spanId"]) == 16
+    assert int(span["endTimeUnixNano"]) >= int(span["startTimeUnixNano"])
+    # attributes keep OTLP type fidelity: ints arrive as intValue
+    sattrs = {a["key"]: a["value"] for a in span["attributes"]}
+    assert sattrs["workers"] == {"intValue": "2"}
