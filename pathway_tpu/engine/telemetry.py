@@ -87,6 +87,11 @@ class TelemetryConfig:
         if monitoring_server is not None and license is not None:
             license.check_entitlements(["monitoring"])
         servers = (monitoring_server,) if monitoring_server else ()
+        requested = (
+            protocol
+            if protocol is not None
+            else os.environ.get("PATHWAY_TELEMETRY_PROTOCOL", "otlp-json")
+        )
         instance_id = os.environ.get("PATHWAY_SERVICE_INSTANCE_ID") or secrets.token_hex(8)
         namespace = (
             os.environ.get("PATHWAY_SERVICE_NAMESPACE") or LOCAL_DEV_NAMESPACE
@@ -102,10 +107,13 @@ class TelemetryConfig:
             run_id=run_id or secrets.token_hex(8),
             trace_parent=trace_parent,
             license_shortcut=license.shortcut() if license is not None else "",
-            protocol=_validate_protocol(
-                protocol
-                if protocol is not None
-                else os.environ.get("PATHWAY_TELEMETRY_PROTOCOL", "otlp-json")
+            # validate only when something will actually be exported: a
+            # typo'd env var must not crash zero-egress runs that never
+            # touch the wire format
+            protocol=(
+                _validate_protocol(requested)
+                if servers
+                else (requested if requested in _PROTOCOLS else "otlp-json")
             ),
         )
 
@@ -296,8 +304,17 @@ class Telemetry:
             body = json.dumps(
                 _otlp_metrics(payload) if kind == "metrics" else _otlp_traces(payload)
             ).encode()
-        else:  # legacy line-JSON (round-3 format)
-            body = json.dumps({"kind": kind, **payload}).encode()
+        elif self.config.protocol == "pathway-json":
+            # legacy line-JSON (round-3 format) — exactly that format:
+            # fallback_trace_id is an otlp-only hint, not part of it
+            legacy = {k: v for k, v in payload.items() if k != "fallback_trace_id"}
+            body = json.dumps({"kind": kind, **legacy}).encode()
+        else:
+            # a directly-constructed config can bypass create()'s check;
+            # never fall back silently to a format the endpoint will 400
+            raise TelemetryError(
+                f"unknown telemetry protocol {self.config.protocol!r}"
+            )
         for endpoint in servers:
             url = endpoint.rstrip("/") + f"/v1/{kind}"
             try:

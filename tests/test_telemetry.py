@@ -199,9 +199,10 @@ def test_run_records_span_without_egress():
     assert result.telemetry.spans[0]["duration_s"] >= 0
 
 
-def test_otlp_json_is_the_default_wire_format():
+def test_otlp_json_is_the_default_wire_format(monkeypatch):
     """OTLP/HTTP+JSON (opentelemetry-proto JSON mapping): a stock OTel
     collector must be able to ingest our payloads — VERDICT r3 weak #6."""
+    monkeypatch.delenv("PATHWAY_TELEMETRY_PROTOCOL", raising=False)
     server, received = _capture_server()
     try:
         endpoint = f"http://127.0.0.1:{server.server_address[1]}"
@@ -240,3 +241,19 @@ def test_otlp_json_is_the_default_wire_format():
     # attributes keep OTLP type fidelity: ints arrive as intValue
     sattrs = {a["key"]: a["value"] for a in span["attributes"]}
     assert sattrs["workers"] == {"intValue": "2"}
+
+
+def test_bad_protocol_harmless_when_telemetry_disabled(monkeypatch):
+    """A typo'd PATHWAY_TELEMETRY_PROTOCOL must not crash zero-egress runs
+    (no monitoring server -> the wire format is never used)."""
+    monkeypatch.setenv("PATHWAY_TELEMETRY_PROTOCOL", "otlp")  # typo
+    cfg = TelemetryConfig.create(run_id="r")
+    assert not cfg.telemetry_enabled
+    # but WITH an endpoint the typo is rejected loudly
+    from pathway_tpu.engine.telemetry import TelemetryError
+
+    with pytest.raises(TelemetryError, match="unknown telemetry protocol"):
+        TelemetryConfig.create(
+            license=License.new("demo-license-key-with-telemetry-abc"),
+            monitoring_server="http://127.0.0.1:1",
+        )
