@@ -56,10 +56,12 @@ from pathway_tpu.internals.schema import (
     schema_from_types,
 )
 from pathway_tpu.internals.table import (
+    GroupedJoinResult,
     GroupedTable,
     Joinable,
     JoinMode,
     JoinResult,
+    OuterJoinResult,
     Table,
     TableLike,
     TableSlice,
@@ -124,8 +126,22 @@ from pathway_tpu import io  # noqa: E402
 from pathway_tpu import demo  # noqa: E402
 from pathway_tpu import persistence  # noqa: E402
 from pathway_tpu import udfs  # noqa: E402
-from pathway_tpu.stdlib import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils  # noqa: E402
+from pathway_tpu.stdlib import graphs, indexing, ml, ordered, stateful, statistical, temporal, utils, viz  # noqa: E402
 from pathway_tpu.stdlib.temporal import windowby  # noqa: E402
+from pathway_tpu.stdlib.temporal import (  # noqa: E402
+    AsofJoinResult,
+    IntervalJoinResult,
+    WindowJoinResult,
+)
+from pathway_tpu.internals.interactive import (  # noqa: E402
+    LiveTable,
+    enable_interactive_mode,
+)
+# legacy aliases the reference still lists in __all__: `asynchronous` was
+# the pre-rename home of the async UDF helpers (now `udfs`), `window` of
+# the temporal window types (now `temporal`)
+from pathway_tpu import udfs as asynchronous  # noqa: E402
+from pathway_tpu.stdlib.temporal import _window as window  # noqa: E402
 from pathway_tpu.stdlib.utils.async_transformer import AsyncTransformer  # noqa: E402
 from pathway_tpu.stdlib.utils.pandas_transformer import pandas_transformer  # noqa: E402
 from pathway_tpu.internals.iterate import iterate, iterate_universe  # noqa: E402
@@ -190,6 +206,16 @@ def unwrap_err(x):  # small helper used in some pathway examples
 
 
 __all__ = [
+    "AsofJoinResult",
+    "GroupedJoinResult",
+    "IntervalJoinResult",
+    "LiveTable",
+    "OuterJoinResult",
+    "WindowJoinResult",
+    "asynchronous",
+    "enable_interactive_mode",
+    "viz",
+    "window",
     "ERROR",
     "Json",
     "Pointer",

@@ -116,3 +116,51 @@ class LiveTable(Table):
 
     def __str__(self) -> str:
         return str(self.snapshot())
+
+
+class InteractiveModeController:
+    """REPL display hook: live tables and snapshots print as their current
+    contents instead of ``<object at 0x...>`` (reference
+    ``interactive.py:180-203``).  One controller per process; created by
+    :func:`enable_interactive_mode`."""
+
+    def __init__(self, _pathway_internal: bool = False):
+        assert _pathway_internal, "use pw.enable_interactive_mode()"
+        import sys
+
+        self._orig_displayhook = sys.displayhook
+        sys.displayhook = self._displayhook
+
+    def _displayhook(self, value: object) -> None:
+        if isinstance(value, (LiveTable, LiveTableSnapshot)):
+            import builtins
+
+            builtins._ = value
+            print(str(value))
+        else:
+            self._orig_displayhook(value)
+
+    def disable(self) -> None:
+        import sys
+
+        sys.displayhook = self._orig_displayhook
+        global _interactive_controller
+        _interactive_controller = None
+
+
+_interactive_controller: InteractiveModeController | None = None
+
+
+def is_interactive_mode_enabled() -> bool:
+    return _interactive_controller is not None
+
+
+def enable_interactive_mode() -> InteractiveModeController:
+    """``pw.enable_interactive_mode()`` — experimental, like the reference."""
+    import warnings
+
+    global _interactive_controller
+    warnings.warn("interactive mode is experimental", stacklevel=2)
+    if _interactive_controller is None:
+        _interactive_controller = InteractiveModeController(_pathway_internal=True)
+    return _interactive_controller

@@ -2225,6 +2225,14 @@ def join_inner(left_t, right_t, *on, **kw):
     return left_t.join_inner(right_t, *on, **kw)
 
 
+# Typed aliases for reference API parity (reference exports distinct
+# GroupedJoinResult / OuterJoinResult classes from groupbys.py/joins.py;
+# here joins of every mode share JoinResult and groupby-after-join goes
+# through GroupedTable, so the names bind to those implementations).
+GroupedJoinResult = GroupedTable
+OuterJoinResult = JoinResult
+
+
 def join_left(left_t, right_t, *on, **kw):
     return left_t.join_left(right_t, *on, **kw)
 

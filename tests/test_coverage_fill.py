@@ -199,3 +199,23 @@ def test_fs_binary_and_plaintext_by_file(tmp_path):
     t2 = pw.io.fs.read(str(d), format="plaintext_by_file", mode="static")
     got2 = sorted(r[0] for r in rows(t2.select(pw.this.data)))
     assert len(got2) == 2 and all(isinstance(v, str) for v in got2)
+
+
+def test_reference_public_all_fully_covered():
+    """Every name in the reference's top-level __all__ (88 names,
+    python/pathway/__init__.py) resolves on pathway_tpu — the 'switch and
+    find everything' contract, pinned."""
+    import re
+    from pathlib import Path
+
+    import pathway_tpu as pw
+
+    ref_init = Path("/root/reference/python/pathway/__init__.py")
+    if not ref_init.exists():
+        import pytest
+
+        pytest.skip("reference checkout not present")
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", ref_init.read_text(), re.S)
+    ref_names = set(re.findall(r'"([^"]+)"', m.group(1)))
+    missing = sorted(n for n in ref_names if not hasattr(pw, n))
+    assert not missing, f"reference __all__ names absent: {missing}"
