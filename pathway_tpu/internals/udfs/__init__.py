@@ -33,6 +33,7 @@ from pathway_tpu.internals.udfs.caches import (
 from pathway_tpu.internals.udfs.executors import (
     Executor,
     async_executor,
+    async_options,
     auto_executor,
     fully_async_executor,
     sync_executor,
@@ -49,6 +50,7 @@ __all__ = [
     "UDF",
     "auto_executor",
     "async_executor",
+    "async_options",
     "sync_executor",
     "fully_async_executor",
     "CacheStrategy",

@@ -104,3 +104,37 @@ def fully_async_executor(
         retry_strategy=retry_strategy,
         autocommit_duration_ms=autocommit_duration_ms,
     )
+
+
+def async_options(
+    capacity: int | None = None,
+    timeout: float | None = None,
+    retry_strategy: AsyncRetryStrategy | None = None,
+    cache_strategy=None,
+):
+    """Decorator applying async options to a plain function, returning an
+    AWAITABLE callable (parity: udfs/executors.py:286 — the reference
+    composes the with_* wrappers, not a UDF; use ``@pw.udf`` with
+    ``executor=async_executor(...)`` for the column-expression form)."""
+
+    def decorator(fun):
+        from pathway_tpu.internals.udfs import (
+            coerce_async,
+            with_cache_strategy,
+            with_capacity,
+            with_retry_strategy,
+            with_timeout,
+        )
+
+        wrapped = coerce_async(fun)
+        if timeout is not None:
+            wrapped = with_timeout(wrapped, timeout)
+        if retry_strategy is not None:
+            wrapped = with_retry_strategy(wrapped, retry_strategy)
+        if capacity is not None:
+            wrapped = with_capacity(wrapped, capacity)
+        if cache_strategy is not None:
+            wrapped = with_cache_strategy(wrapped, cache_strategy)
+        return wrapped
+
+    return decorator

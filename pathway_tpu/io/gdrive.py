@@ -22,7 +22,14 @@ from pathway_tpu.io import _utils
 from pathway_tpu.io._gauth import ServiceAccountCredentials, api_request_retry
 from pathway_tpu.io._utils import COMMIT, DELETE, Offset, Reader
 
-__all__ = ["read"]
+__all__ = [
+    "read",
+    "extend_metadata",
+    "add_path",
+    "add_seen_at",
+    "add_status",
+    "add_url",
+]
 
 _SCOPE = "https://www.googleapis.com/auth/drive.readonly"
 _DEFAULT_API = "https://www.googleapis.com"
@@ -180,3 +187,34 @@ def read(
         upsert=True,  # modified files replace their previous row
         name=name,
     )
+
+
+# -- metadata post-processors (reference io/gdrive/__init__.py:44-70) --------
+
+STATUS_DOWNLOADED = "downloaded"
+
+
+def extend_metadata(metadata: dict) -> dict:
+    """url + path + seen_at + status, composed."""
+    return add_status(add_seen_at(add_path(add_url(metadata))))
+
+
+def add_seen_at(metadata: dict) -> dict:
+    metadata["seen_at"] = int(_time.time())
+    return metadata
+
+
+def add_url(metadata: dict) -> dict:
+    file_id = metadata["id"]
+    metadata["url"] = f"https://drive.google.com/file/d/{file_id}/"
+    return metadata
+
+
+def add_path(metadata: dict) -> dict:
+    metadata["path"] = metadata["name"]
+    return metadata
+
+
+def add_status(metadata: dict) -> dict:
+    metadata["status"] = STATUS_DOWNLOADED
+    return metadata

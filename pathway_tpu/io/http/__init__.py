@@ -12,4 +12,15 @@ from pathway_tpu.io.http._server import (
     rest_connector,
 )
 
-__all__ = ["PathwayWebserver", "rest_connector", "EndpointDocumentation"]
+from pathway_tpu.io.http._client import RetryPolicy, read, write  # noqa: E402
+from pathway_tpu.io.http._server import EndpointExamples  # noqa: E402
+
+__all__ = [
+    "PathwayWebserver",
+    "rest_connector",
+    "EndpointDocumentation",
+    "EndpointExamples",
+    "RetryPolicy",
+    "read",
+    "write",
+]

@@ -22,12 +22,39 @@ from pathway_tpu.io import _utils
 from pathway_tpu.io._utils import COMMIT, Reader
 
 
+class EndpointExamples:
+    """Named request examples for endpoint documentation (reference
+    _server.py:89); rendered into the OpenAPI schema's ``examples`` map."""
+
+    def __init__(self):
+        self.examples_by_id = {}
+
+    def add_example(self, id, summary, values):
+        if id in self.examples_by_id:
+            raise ValueError(f"Duplicate example id: {id}")
+        self.examples_by_id[id] = {"summary": summary, "value": values}
+        return self
+
+    def _openapi_description(self):
+        return self.examples_by_id
+
+
 class EndpointDocumentation:
-    def __init__(self, *, summary=None, description=None, tags=None, method_types=None, **kw):
+    def __init__(
+        self,
+        *,
+        summary=None,
+        description=None,
+        tags=None,
+        method_types=None,
+        examples: "EndpointExamples | None" = None,
+        **kw,
+    ):
         self.summary = summary
         self.description = description
         self.tags = tags
         self.method_types = method_types
+        self.examples = examples
 
 
 class PathwayWebserver:
@@ -37,13 +64,63 @@ class PathwayWebserver:
         self.host = host
         self.port = port
         self._routes: dict[tuple[str, str], Any] = {}
+        self._route_docs: dict[str, dict] = {}  # route -> openapi path item
+        self.with_schema_endpoint = with_schema_endpoint
         self._started = False
         self._loop: asyncio.AbstractEventLoop | None = None
         self._ready = threading.Event()
 
-    def _add_route(self, route: str, methods: list[str], handler) -> None:
+    def _add_route(
+        self, route: str, methods: list[str], handler, *, schema=None, documentation=None
+    ) -> None:
         for m in methods:
             self._routes[(m.upper(), route)] = handler
+        self._route_docs[route] = self._openapi_path_item(
+            methods, schema, documentation
+        )
+
+    @staticmethod
+    def _openapi_path_item(methods, schema, documentation) -> dict:
+        """OpenAPI v3 path item for one route (the reference's schema
+        endpoint, _server.py:188): request properties from the input
+        schema's columns, plus summary/description/tags/examples from the
+        EndpointDocumentation."""
+        _PRIMITIVES = {int: "integer", float: "number", bool: "boolean", str: "string"}
+        properties = {}
+        if schema is not None:
+            for name, col in schema.__columns__.items():
+                hint = getattr(col.dtype, "typehint", str)
+                properties[name] = {
+                    "type": _PRIMITIVES.get(hint, "string")
+                }
+        body_schema = {"type": "object", "properties": properties}
+        item: dict = {}
+        doc = documentation
+        for m in methods:
+            op: dict = {"responses": {"200": {"description": "OK"}}}
+            if doc is not None:
+                if doc.summary:
+                    op["summary"] = doc.summary
+                if doc.description:
+                    op["description"] = doc.description
+                if doc.tags:
+                    op["tags"] = list(doc.tags)
+            content: dict = {"schema": body_schema}
+            if doc is not None and getattr(doc, "examples", None) is not None:
+                content["examples"] = doc.examples._openapi_description()
+            if m.upper() in ("POST", "PUT", "PATCH"):
+                op["requestBody"] = {
+                    "content": {"application/json": content}
+                }
+            item[m.lower()] = op
+        return item
+
+    def openapi_description_json(self) -> dict:
+        return {
+            "openapi": "3.0.3",
+            "info": {"title": "Pathway REST API", "version": "1.0.0"},
+            "paths": dict(self._route_docs),
+        }
 
     def _start(self) -> None:
         if self._started:
@@ -54,6 +131,12 @@ class PathwayWebserver:
             from aiohttp import web
 
             async def dispatch(request: "web.Request"):
+                if (
+                    self.with_schema_endpoint
+                    and request.method == "GET"
+                    and request.path == "/_schema"
+                ):
+                    return web.json_response(self.openapi_description_json())
                 handler = self._routes.get((request.method, request.path))
                 if handler is None:
                     return web.json_response({"error": "no such route"}, status=404)
@@ -82,12 +165,13 @@ class PathwayWebserver:
 class _RestSubject(Reader):
     """Bridges HTTP requests into the input table."""
 
-    def __init__(self, webserver: PathwayWebserver, route: str, methods: list[str], schema, delete_completed_queries: bool):
+    def __init__(self, webserver: PathwayWebserver, route: str, methods: list[str], schema, delete_completed_queries: bool, documentation=None):
         self.webserver = webserver
         self.route = route
         self.methods = methods
         self.schema = schema
         self.delete_completed_queries = delete_completed_queries
+        self.documentation = documentation
         self.futures: dict[int, asyncio.Future] = {}
         self._seq = itertools.count()
         self._emit = None
@@ -134,7 +218,13 @@ class _RestSubject(Reader):
                     emit(COMMIT)
             return web.json_response(result)
 
-        self.webserver._add_route(self.route, self.methods, handler)
+        self.webserver._add_route(
+            self.route,
+            self.methods,
+            handler,
+            schema=self.schema,
+            documentation=self.documentation,
+        )
         self.webserver._start()
         self._stop.wait()  # run forever (streaming source)
 
@@ -190,7 +280,8 @@ def rest_connector(
     if schema is None:
         schema = schema_mod.schema_from_types(query=str)
     subject = _RestSubject(
-        webserver, route, list(methods), schema, delete_completed_queries
+        webserver, route, list(methods), schema, delete_completed_queries,
+        documentation=documentation,
     )
     table = _utils.make_input_table(
         schema,

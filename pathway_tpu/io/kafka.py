@@ -120,6 +120,29 @@ class _KafkaReader(Reader):
                 del self._captured[s]
             return offsets
 
+    def _kafka_python_kwargs(self, group_id) -> dict:
+        """Map rdkafka-style settings onto kafka-python constructor kwargs —
+        the fallback backend must honor offset-reset and SASL credentials,
+        not silently drop them (simple_read/read_from_upstash rely on both)."""
+        st = self.settings
+        kwargs = {
+            "bootstrap_servers": st.get("bootstrap.servers"),
+            "group_id": group_id,
+            "enable_auto_commit": False,
+        }
+        if "auto.offset.reset" in st:
+            kwargs["auto_offset_reset"] = st["auto.offset.reset"]
+        proto = st.get("security.protocol")
+        if proto:
+            kwargs["security_protocol"] = proto.upper()
+        if "sasl.mechanism" in st:
+            kwargs["sasl_mechanism"] = st["sasl.mechanism"]
+        if "sasl.username" in st:
+            kwargs["sasl_plain_username"] = st["sasl.username"]
+        if "sasl.password" in st:
+            kwargs["sasl_plain_password"] = st["sasl.password"]
+        return kwargs
+
     def run(self, emit) -> None:
         kind, client = _get_client()
         names = list(self.schema.__columns__.keys()) if self.schema else ["data"]
@@ -188,9 +211,7 @@ class _KafkaReader(Reader):
         else:
             if self._stripe is not None:
                 consumer = client.KafkaConsumer(
-                    bootstrap_servers=self.settings.get("bootstrap.servers"),
-                    group_id=group_id,
-                    enable_auto_commit=False,
+                    **self._kafka_python_kwargs(group_id)
                 )
                 # manual assign() never re-fetches metadata, so a missing
                 # topic must fail loudly, not pin the cluster to nothing
@@ -214,10 +235,7 @@ class _KafkaReader(Reader):
                 )
             else:
                 consumer = client.KafkaConsumer(
-                    self.topic,
-                    bootstrap_servers=self.settings.get("bootstrap.servers"),
-                    group_id=group_id,
-                    enable_auto_commit=False,
+                    self.topic, **self._kafka_python_kwargs(group_id)
                 )
             meta_cls = getattr(client, "OffsetAndMetadata", None)
 
@@ -338,3 +356,74 @@ def write(
 
 def _plain(v):
     return _utils.plain_value(v)
+
+
+def simple_read(
+    server: str,
+    topic: str,
+    *,
+    read_only_new: bool = False,
+    schema: type[schema_mod.Schema] | None = None,
+    format: str = "raw",
+    autocommit_duration_ms: int | None = 1500,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    """Simplified ``read``: just a bootstrap server and a topic (parity:
+    io/kafka/__init__.py:276).  Reads from the beginning of the topic
+    unless ``read_only_new``; a random group id keeps replays independent."""
+    import uuid as _uuid
+
+    settings = {
+        "bootstrap.servers": server,
+        "group.id": str(_uuid.uuid4()),
+        "session.timeout.ms": "6000",
+        "auto.offset.reset": "latest" if read_only_new else "earliest",
+    }
+    return read(
+        settings,
+        topic,
+        schema=schema,
+        format=format,
+        autocommit_duration_ms=autocommit_duration_ms,
+        name=name,
+        **kwargs,
+    )
+
+
+def read_from_upstash(
+    endpoint: str,
+    username: str,
+    password: str,
+    topic: str,
+    *,
+    read_only_new: bool = False,
+    schema: type[schema_mod.Schema] | None = None,
+    format: str = "raw",
+    autocommit_duration_ms: int | None = 1500,
+    name: str | None = None,
+    **kwargs: Any,
+) -> Table:
+    """``read`` preconfigured for Upstash-hosted Kafka (SCRAM over SSL;
+    parity: io/kafka/__init__.py:375)."""
+    import uuid as _uuid
+
+    settings = {
+        "bootstrap.servers": endpoint,
+        "group.id": str(_uuid.uuid4()),
+        "session.timeout.ms": "6000",
+        "security.protocol": "sasl_ssl",
+        "sasl.mechanism": "SCRAM-SHA-256",
+        "sasl.username": username,
+        "sasl.password": password,
+        "auto.offset.reset": "latest" if read_only_new else "earliest",
+    }
+    return read(
+        settings,
+        topic,
+        schema=schema,
+        format=format,
+        autocommit_duration_ms=autocommit_duration_ms,
+        name=name,
+        **kwargs,
+    )

@@ -113,3 +113,67 @@ def read(
         autocommit_duration_ms=autocommit_duration_ms,
         name=name,
     )
+
+
+class InteractiveCsvPlayer(ConnectorSubject):
+    """Replay a CSV interactively: rows stream as the position advances.
+
+    Parity: ``io/python/__init__.py:440``.  In a notebook with ``panel``
+    installed this renders the reference's slider widget; headless
+    environments drive it programmatically via :meth:`advance_to` /
+    :meth:`play_all` instead (the widget stack is optional here, matching
+    the zero-extra-deps stance of this build).
+    """
+
+    def __init__(self, csv_file: str = "") -> None:
+        import queue as _queue
+
+        super().__init__()
+        self.q: "_queue.Queue[int]" = _queue.Queue()
+        import pandas as pd
+
+        self.df = pd.read_csv(csv_file)
+        self._widget = None
+        try:  # optional notebook widget, exactly the reference's UI
+            import panel as pn
+            from IPython.display import display
+
+            slider = pn.widgets.IntSlider(
+                name="Row position in csv",
+                start=0,
+                end=len(self.df),
+                step=1,
+                value=0,
+            )
+
+            def _on_change(event):
+                if event.new > event.old:
+                    self.q.put_nowait(event.new)
+
+            slider.param.watch(_on_change, "value")
+            self._widget = slider
+            display(pn.Row(slider, f"{len(self.df)} rows in csv"))
+        except Exception:
+            pass  # headless: advance_to()/play_all() drive the stream
+
+    def advance_to(self, position: int) -> None:
+        """Stream rows up to (excluding) ``position``."""
+        self.q.put_nowait(min(position, len(self.df)))
+
+    def play_all(self) -> None:
+        self.advance_to(len(self.df))
+
+    def run(self) -> None:
+        import time as _time
+
+        last_streamed_idx = -1
+        while True:
+            new_pos = self.q.get()
+            for i in range(last_streamed_idx + 1, new_pos):
+                self.next(**self.df.iloc[i].to_dict())
+            self.commit()
+            last_streamed_idx = max(last_streamed_idx, new_pos - 1)
+            if new_pos >= len(self.df):
+                break
+            _time.sleep(0.05)
+        self.close()

@@ -22,7 +22,14 @@ from pathway_tpu.io import _utils
 from pathway_tpu.io._s3http import AwsS3Settings, S3Client
 from pathway_tpu.io._utils import COMMIT, Offset, Reader
 
-__all__ = ["AwsS3Settings", "read"]
+__all__ = [
+    "AwsS3Settings",
+    "DigitalOceanS3Settings",
+    "WasabiS3Settings",
+    "read",
+    "read_from_digital_ocean",
+    "read_from_wasabi",
+]
 
 
 class _S3Reader(Reader):
@@ -224,3 +231,75 @@ def _split_path(path: str, settings: AwsS3Settings) -> tuple[str | None, str]:
         bucket, _, prefix = rest.partition("/")
         return bucket, prefix
     return settings.bucket_name, path.lstrip("/")
+
+
+class DigitalOceanS3Settings(AwsS3Settings):
+    """Digital Ocean Spaces settings (parity: io/s3/__init__.py:23) —
+    AwsS3Settings preconfigured with the DO endpoint convention."""
+
+    def __init__(
+        self,
+        bucket_name: str,
+        *,
+        access_key: str = "",
+        secret_access_key: str = "",
+        region: str,
+    ):
+        if not region:
+            raise ValueError(
+                "DigitalOceanS3Settings requires region= — it routes the "
+                "endpoint (e.g. 'ams3' -> ams3.digitaloceanspaces.com); "
+                "without it reads would silently target AWS S3"
+            )
+        super().__init__(
+            bucket_name=bucket_name,
+            access_key=access_key,
+            secret_access_key=secret_access_key,
+            region=region,
+            endpoint=f"https://{region}.digitaloceanspaces.com",
+        )
+
+
+class WasabiS3Settings(AwsS3Settings):
+    """Wasabi S3 settings (parity: io/s3/__init__.py:58)."""
+
+    def __init__(
+        self,
+        bucket_name: str,
+        *,
+        access_key: str = "",
+        secret_access_key: str = "",
+        region: str,
+    ):
+        if not region:
+            raise ValueError(
+                "WasabiS3Settings requires region= — it routes the endpoint "
+                "(e.g. 'us-west-1' -> s3.us-west-1.wasabisys.com)"
+            )
+        super().__init__(
+            bucket_name=bucket_name,
+            access_key=access_key,
+            secret_access_key=secret_access_key,
+            region=region,
+            endpoint=f"https://s3.{region}.wasabisys.com",
+        )
+
+
+def read_from_digital_ocean(
+    path: str,
+    do_s3_settings: DigitalOceanS3Settings,
+    format: str = "csv",
+    **kwargs: Any,
+) -> Table:
+    """``pw.io.s3.read`` preconfigured for Digital Ocean Spaces."""
+    return read(path, aws_s3_settings=do_s3_settings, format=format, **kwargs)
+
+
+def read_from_wasabi(
+    path: str,
+    wasabi_s3_settings: WasabiS3Settings,
+    format: str = "csv",
+    **kwargs: Any,
+) -> Table:
+    """``pw.io.s3.read`` preconfigured for Wasabi S3."""
+    return read(path, aws_s3_settings=wasabi_s3_settings, format=format, **kwargs)

@@ -1,0 +1,22 @@
+"""``import pathway_tpu.reducers`` — module-path parity with the
+reference's ``pathway/reducers.py`` (the same objects are also reachable
+as ``pw.reducers``)."""
+
+from pathway_tpu.internals.reducers import *  # noqa: F401,F403
+from pathway_tpu.internals.reducers import (  # noqa: F401
+    any,
+    avg,
+    count,
+    earliest,
+    latest,
+    max,
+    min,
+    ndarray,
+    sorted_tuple,
+    stateful_many,
+    stateful_single,
+    sum,
+    tuple,
+    udf_reducer,
+    unique,
+)

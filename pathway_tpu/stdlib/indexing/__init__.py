@@ -6,6 +6,9 @@ USearchKnn (API parity with the reference's HNSW index), TantivyBM25 analog
 factories for DocumentStore wiring.
 """
 
+from pathway_tpu.stdlib.indexing.full_text_document_index import (
+    default_full_text_document_index,
+)
 from pathway_tpu.stdlib.indexing.data_index import DataIndex, InnerIndex
 from pathway_tpu.stdlib.indexing.nearest_neighbors import (
     BruteForceKnn,
@@ -29,6 +32,7 @@ from pathway_tpu.stdlib.indexing.retrievers import (
     TantivyBM25Factory,
     USearchMetricKind,
     UsearchKnnFactory,
+    LshKnnFactory,
 )
 
 __all__ = [
@@ -41,6 +45,7 @@ __all__ = [
     "TantivyBM25",
     "HybridIndex",
     "HybridDataIndex",
+    "default_full_text_document_index",
     "default_vector_document_index",
     "default_brute_force_knn_document_index",
     "default_lsh_knn_document_index",
@@ -49,6 +54,7 @@ __all__ = [
     "BruteForceKnnFactory",
     "BruteForceKnnMetricKind",
     "HybridIndexFactory",
+    "LshKnnFactory",
     "TantivyBM25Factory",
     "USearchMetricKind",
     "UsearchKnnFactory",

@@ -127,3 +127,43 @@ class HybridIndexFactory(AbstractRetrieverFactory):
         ]
         return HybridDataIndex(data_table, indexes, k=self.k)
 
+
+
+@dataclasses.dataclass
+class LshKnnFactory(AbstractRetrieverFactory):
+    """Factory for LSH-bucketed approximate KNN (parity:
+    nearest_neighbors.py:528)."""
+
+    dimensions: int | None = None
+    n_or: int = 20
+    n_and: int = 10
+    bucket_length: float = 10.0
+    distance_type: str = "euclidean"
+    embedder: object | None = None
+
+    def build_index(self, data_column, data_table, metadata_column=None):
+        from pathway_tpu.stdlib.indexing.data_index import DataIndex
+        from pathway_tpu.stdlib.indexing.nearest_neighbors import LshKnn
+
+        if not isinstance(self.dimensions, int):
+            # fail at configuration time, not mid-run inside rng.normal
+            raise ValueError("LshKnnFactory requires dimensions= (int)")
+
+        from pathway_tpu.stdlib.indexing.nearest_neighbors import DistanceMetric
+
+        metric = (
+            DistanceMetric.COS
+            if self.distance_type == "cosine"
+            else DistanceMetric.L2SQ
+        )
+        inner = LshKnn(
+            data_column,
+            metadata_column,
+            dimensions=self.dimensions,
+            n_or=self.n_or,
+            n_and=self.n_and,
+            bucket_length=self.bucket_length,
+            metric=metric,
+            embedder=self.embedder,
+        )
+        return DataIndex(data_table, inner)

@@ -29,6 +29,7 @@ from pathway_tpu.stdlib.temporal._asof_join import (
     asof_join_right,
 )
 from pathway_tpu.stdlib.temporal._asof_now_join import (
+    AsofNowJoinResult,
     asof_now_join,
     asof_now_join_inner,
     asof_now_join_left,
@@ -53,6 +54,9 @@ from pathway_tpu.stdlib.temporal._window_join import (
 )
 
 __all__ = [
+    "AsofNowJoinResult",
+    "inactivity_detection",
+    "utc_now",
     "Behavior",
     "CommonBehavior",
     "ExactlyOnceBehavior",
@@ -88,3 +92,10 @@ __all__ = [
     "window_join_right",
     "window_join_outer",
 ]
+
+from pathway_tpu.stdlib.temporal.time_utils import (
+    TimestampSchema,
+    TimestampSubject,
+    inactivity_detection,
+    utc_now,
+)

@@ -1,0 +1,4 @@
+"""``import pathway_tpu.universes`` — module-path parity with the
+reference's ``pathway/universes.py``."""
+
+from pathway_tpu.internals.universes import *  # noqa: F401,F403

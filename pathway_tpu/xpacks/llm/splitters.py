@@ -15,6 +15,11 @@ from pathway_tpu.engine.types import Json
 from pathway_tpu.internals.udfs import UDF
 
 
+def null_splitter(txt: str) -> list[tuple[str, dict]]:
+    """One chunk, the full text, empty metadata (reference splitters.py:13)."""
+    return [(txt, {})]
+
+
 def _to_text(data: Any) -> str:
     if isinstance(data, bytes):
         return data.decode("utf-8", errors="replace")

@@ -104,3 +104,70 @@ def test_rest_connector_concurrent_queries(rest_server):
         futs = [ex.submit(_post, port, {"a": i, "b": i}) for i in range(8)]
         got = sorted(f.result() for f in futs)
     assert got == [2 * i for i in range(8)]
+
+
+SCHEMA_SERVER_SCRIPT = """
+import sys
+import pathway_tpu as pw
+
+port = int(sys.argv[1])
+
+class QuerySchema(pw.Schema):
+    a: int
+    note: str
+
+examples = pw.io.http.EndpointExamples()
+examples.add_example("default", "Add two", {"a": 2, "note": "hi"})
+server = pw.io.http.PathwayWebserver(
+    host="127.0.0.1", port=port, with_schema_endpoint=True
+)
+queries, respond = pw.io.http.rest_connector(
+    webserver=server,
+    schema=QuerySchema,
+    delete_completed_queries=True,
+    documentation=pw.io.http.EndpointDocumentation(
+        summary="Adder", description="adds", tags=["math"], examples=examples
+    ),
+)
+respond(queries.select(result=pw.this.a))
+pw.run()
+"""
+
+
+def test_schema_endpoint_serves_openapi(tmp_path):
+    """`with_schema_endpoint=True` serves an OpenAPI v3 document at
+    /_schema with per-route request schemas and the registered examples
+    (reference _server.py:188)."""
+    port = _free_port()
+    script = tmp_path / "serve.py"
+    script.write_text(SCHEMA_SERVER_SCRIPT)
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(port)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+    )
+    try:
+        doc = None
+        for _ in range(100):
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/_schema", timeout=2
+                ) as r:
+                    doc = json.loads(r.read())
+                break
+            except (urllib.error.URLError, ConnectionError, OSError):
+                time.sleep(0.2)
+        assert doc is not None, "schema endpoint never came up"
+        assert doc["openapi"].startswith("3.")
+        post = doc["paths"]["/"]["post"]
+        assert post["summary"] == "Adder"
+        assert post["tags"] == ["math"]
+        content = post["requestBody"]["content"]["application/json"]
+        assert content["schema"]["properties"]["a"]["type"] == "integer"
+        assert content["schema"]["properties"]["note"]["type"] == "string"
+        assert content["examples"]["default"]["value"] == {"a": 2, "note": "hi"}
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)

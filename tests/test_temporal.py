@@ -837,3 +837,49 @@ def test_window_join_sliding_duplicates_pairs():
         a, b, a.at, b.bt, temporal.sliding(hop=2, duration=4)
     ).select(at=a.at, bt=b.bt)
     assert rows(res).count((2, 3)) == 2
+
+
+def test_utc_now_streams_timestamps():
+    import datetime
+
+    from pathway_tpu.stdlib.temporal import utc_now
+
+    utc_now.cache_clear()  # the per-process cache would return a Table
+    # bound to a previous test's cleared graph
+    t = utc_now(refresh_rate=datetime.timedelta(milliseconds=50))
+    seen = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: seen.append(
+            row["timestamp_utc"]
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE, max_epochs=2)
+    utc_now.cache_clear()
+    assert seen, "no clock ticks streamed"
+    assert all(ts.tzinfo is not None for ts in seen)
+
+
+def test_inactivity_detection_builds():
+    """Graph-construction smoke: the alert pattern wires utc_now +
+    asof_now_join + groupby correctly (full temporal behavior needs a live
+    clock; covered by the reference's integration tier)."""
+    import datetime
+
+    from pathway_tpu.stdlib.temporal import inactivity_detection, utc_now
+
+    utc_now.cache_clear()
+    events = pw.debug.table_from_markdown("v\n1")
+    events = events.select(
+        at=pw.cast(
+            pw.DateTimeUtc,
+            datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc),
+        )
+    )
+    inact, resumed = inactivity_detection(
+        events.at, datetime.timedelta(seconds=5)
+    )
+    assert "inactive_t" in inact.column_names()
+    assert "resumed_t" in resumed.column_names()
+    utc_now.cache_clear()
+    pw.internals.parse_graph.G.clear()
