@@ -1419,6 +1419,110 @@ class Table(Joinable):
             exprs[n] = expr_mod.cast(t, ColumnReference(this, n))
         return self._select_impl(exprs, universe=self._universe)
 
+    @staticmethod
+    def empty(**kwargs) -> "Table":
+        """An empty table with the schema given by column-name → type kwargs
+        (reference table.py:355).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t1 = pw.Table.empty(age=float, pet=float)
+        >>> pw.debug.compute_and_print(t1, include_id=False)
+        age | pet
+        """
+        from pathway_tpu.io._utils import make_static_input_table
+
+        return make_static_input_table(
+            schema_mod.schema_from_types(**kwargs), []
+        )
+
+    @staticmethod
+    def from_columns(*args, **kwargs) -> "Table":
+        """Build a table from same-universe columns, optionally renamed via
+        kwargs (reference table.py:265).
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown("a | b\\n1 | 2")
+        >>> t2 = pw.Table.from_columns(t.a, bb=t.b)
+        >>> pw.debug.compute_and_print(t2, include_id=False)
+        a | bb
+        1 | 2
+        """
+        refs: list[tuple[str, ColumnReference]] = []
+        for ref in args:
+            refs.append((ref.name, ref))
+        for name, ref in kwargs.items():
+            refs.append((name, ref))
+        if not refs:
+            raise ValueError("from_columns requires at least one column")
+        names = [n for (n, _r) in refs]
+        if len(set(names)) != len(names):
+            # silent last-wins would drop a requested column
+            dupes = sorted({n for n in names if names.count(n) > 1})
+            raise ValueError(f"from_columns: duplicate column names {dupes}")
+        base = refs[0][1].table
+        for _n, r in refs[1:]:
+            # is_equal honors promise_are_equal unification, unlike identity
+            if not r.table._universe.is_equal(base._universe):
+                raise ValueError(
+                    "from_columns: all columns must share one universe"
+                )
+        return base.select(**{n: r for (n, r) in refs})
+
+    def update_id_type(self, id_type, *, id_append_only: bool | None = None) -> "Table":
+        """Declare the id column's Pointer type (reference table.py:2003).
+        Row keys here are untyped 128-bit hashes, so this is a typing-level
+        declaration: it validates the type and returns the same rows."""
+        wrapped = dt.wrap(id_type)
+        if not (wrapped is dt.POINTER or isinstance(wrapped, dt._Pointer)):
+            raise TypeError(f"update_id_type expects a Pointer type, got {id_type!r}")
+        return self.copy()
+
+    def eval_type(self, expression) -> "dt.DType":
+        """The dtype ``expression`` evaluates to in this table's context
+        (reference table.py:2549).  Unknown column references raise;
+        operator typing follows this build's (lenient) interpreter.
+
+        Example:
+
+        >>> import pathway_tpu as pw
+        >>> t = pw.debug.table_from_markdown("a | b\\n1 | 2")
+        >>> t.eval_type(t.a + t.b)
+        INT
+        """
+        wrapped = expr_mod._wrap(expression)
+        self._validate_column_refs(wrapped)
+        tmp_binder = RowBinder(Lowerer(df.Scope()), self)
+        return _infer_dtype(wrapped, tmp_binder)
+
+    def _validate_column_refs(self, e) -> None:
+        """Raise KeyError for refs to columns this table does not have —
+        the silent ANY fallback of dtype inference must not hide typos in
+        the public introspection API."""
+        if isinstance(e, ColumnReference):
+            tbl = e.table
+            if isinstance(tbl, ThisPlaceholder) or tbl is self:
+                if e.name != "id" and e.name not in self._schema.__columns__:
+                    raise KeyError(
+                        f"no column {e.name!r} in this table "
+                        f"(has {self.column_names()})"
+                    )
+            return
+        for attr in getattr(e, "__slots__", ()):
+            try:
+                v = getattr(e, attr)
+            except AttributeError:
+                continue
+            if isinstance(v, ColumnExpression):
+                self._validate_column_refs(v)
+            elif isinstance(v, tuple):
+                for x in v:
+                    if isinstance(x, ColumnExpression):
+                        self._validate_column_refs(x)
+
     def update_types(self, **kwargs) -> "Table":
         new_schema = self._schema.update_types(**kwargs)
 
