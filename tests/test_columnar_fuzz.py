@@ -213,3 +213,168 @@ def test_random_optional_columns_parity(seed):
         )
 
     assert _run(build, True) == _run(build, False), f"seed={seed}"
+
+
+# ---------------------------------------------------------------------------
+# native entry points under adversarial inputs (round 4: the columnar hot
+# paths moved into C — malformed shapes must BAIL or raise cleanly, never
+# read out of bounds or crash the interpreter)
+# ---------------------------------------------------------------------------
+
+
+def _native():
+    from pathway_tpu import native
+
+    mod = native.get()
+    if mod is None or not hasattr(mod, "materialize_columns"):
+        pytest.skip("native core unavailable")
+    return mod
+
+
+def test_native_materialize_malformed_deltas_bail():
+    nat = _native()
+    good = [(1, (5, 1.5), 1), (2, (6, 2.5), 1)]
+    # every malformed variant must return None (bail) or raise — not crash
+    variants = [
+        [(1, (5,), 1), (2, "not-a-tuple", 1)],          # non-tuple row
+        [(1, (5,), 1), (2,)],                            # short delta
+        [(1, (5, 6), 1), (2, (7,), 1)],                  # ragged: col 1 missing
+        [(1, (5,), 1), (2, (True,), 1)],                 # bool into int col
+        [(1, (5,), 1), (2, (2**70,), 1)],                # int64 overflow
+        [(1, (-(2**63),), 1)],                           # INT64_MIN
+    ]
+    assert nat.materialize_columns(good, (0, 1), True) is not None
+    for bad in variants:
+        needed = (0, 1) if any(
+            isinstance(d, tuple) and len(d) == 3 and isinstance(d[1], tuple)
+            and len(d[1]) > 1 for d in bad
+        ) else (0,)
+        try:
+            res = nat.materialize_columns(bad, needed, True)
+        except (ValueError, TypeError):
+            continue
+        assert res is None, bad
+
+
+def test_native_materialize_rows_mode_mixed_and_subclasses():
+    nat = _native()
+
+    class MyInt(int):
+        pass
+
+    class MyStr(str):
+        pass
+
+    # exact-type rule: subclasses must BAIL (the Python reference path
+    # bails too — np.asarray would silently coerce them)
+    assert nat.materialize_columns([(MyInt(1),), (2,)], (0,), False) is None
+    assert nat.materialize_columns([("a",), (MyStr("b"),)], (0,), False) is None
+    # bool is not int, int is not float, None is not typed
+    assert nat.materialize_columns([(1,), (True,)], (0,), False) is None
+    assert nat.materialize_columns([(1.0,), (1,)], (0,), False) is None
+    assert nat.materialize_columns([(None,), (1,)], (0,), False) is None
+
+
+def test_native_rebuild_length_mismatch_raises():
+    nat = _native()
+    deltas = [(1, (5,), 1), (2, (6,), 1)]
+    short = bytearray(8)  # one int64 for two rows
+    with pytest.raises(ValueError, match="mismatch"):
+        nat.rebuild_delta_rows(deltas, [("q", short)])
+    with pytest.raises(ValueError):
+        nat.rebuild_delta_rows(deltas, [("U", ["only-one"])])
+    with pytest.raises(ValueError):
+        nat.rebuild_delta_rows(deltas, [("P", 7)])  # passthrough out of range
+    with pytest.raises(ValueError):
+        nat.rebuild_delta_rows(deltas, [("Z", bytearray(16))])  # unknown kind
+
+
+def test_native_filter_mask_mismatch_raises():
+    nat = _native()
+    deltas = [(1, (5, 6), 1), (2, (7, 8), 1)]
+    import numpy as np
+
+    with pytest.raises(ValueError, match="mask"):
+        nat.filter_deltas(deltas, np.ones(3, np.uint8), 2)
+    with pytest.raises(ValueError, match="short row"):
+        nat.filter_deltas(deltas, np.ones(2, np.uint8), 5)
+    out = nat.filter_deltas(deltas, np.asarray([1, 0], np.uint8), 1)
+    assert out == [(1, (5,), 1)]
+
+
+def test_native_stage_static_malformed_quads():
+    nat = _native()
+    from pathway_tpu.engine.dataflow import CleanDeltas
+
+    with pytest.raises(ValueError, match="quads"):
+        nat.stage_static([(1, ("a",), 0)], CleanDeltas)  # triple, not quad
+    with pytest.raises(TypeError):
+        nat.stage_static("nope", CleanDeltas)
+    # huge diffs do not crash the cleanliness scan
+    out = nat.stage_static([(1, ("a",), 0, 2**80)], CleanDeltas)
+    [(t, deltas, clean)] = out
+    assert t == 0 and not clean and deltas[0][2] == 2**80
+
+
+def test_native_group_indices_unhashable_raises_cleanly():
+    nat = _native()
+    uniques, inv = nat.group_indices(["a", "b", "a", "c", "b"])
+    import numpy as np
+
+    assert uniques == ["a", "b", "c"]
+    assert np.frombuffer(inv, np.int64).tolist() == [0, 1, 0, 2, 1]
+    with pytest.raises(TypeError):
+        nat.group_indices([["unhashable"]])
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_native_vs_python_materialize_random_parity(seed):
+    """The native materializer and the Python reference path must agree on
+    ACCEPT/BAIL and on every accepted value, over random well/ill-typed
+    batches."""
+    import numpy as np
+
+    from pathway_tpu.internals import vector_compiler as vc
+
+    nat = _native()
+    rng = np.random.default_rng(seed)
+    pools = [
+        lambda: int(rng.integers(-1000, 1000)),
+        lambda: float(rng.normal()),
+        lambda: bool(rng.integers(2)),
+        lambda: "s" + str(rng.integers(5)),
+        lambda: None,
+        lambda: (int(rng.integers(-(2**31), 2**31)) << 40),  # beyond int64 often
+    ]
+    for _ in range(20):
+        n_rows = int(rng.integers(1, 12))
+        n_cols = int(rng.integers(1, 4))
+        col_pools = [
+            pools[int(rng.integers(len(pools)))] for _ in range(n_cols)
+        ]
+        mix = rng.random() < 0.3
+        rows = []
+        for _ in range(n_rows):
+            row = []
+            for c in range(n_cols):
+                pool = (
+                    pools[int(rng.integers(len(pools)))] if mix else col_pools[c]
+                )
+                row.append(pool())
+            rows.append(tuple(row))
+        needed = set(range(n_cols))
+        res_nat = nat.materialize_columns(rows, tuple(sorted(needed)), False)
+        # python reference: temporarily disable the native hook
+        saved = vc._native_syms
+        vc._native_syms = {}
+        try:
+            res_py = vc.materialize_columns(rows, needed)
+        finally:
+            vc._native_syms = saved
+        if res_py is None:
+            assert res_nat is None, (rows, res_nat)
+        else:
+            assert res_nat is not None, rows
+            wrapped = vc._wrap_native_cols(res_nat)
+            for i in needed:
+                assert wrapped[i].tolist() == res_py[i].tolist(), (i, rows)
