@@ -277,6 +277,29 @@ def _extra_profile_trace(fwd, params, ids, mask) -> str:
     return trace_dir
 
 
+def _host_wordcount_rate() -> float:
+    """Single-worker host-engine wordcount rows/s (300k rows, best of 2) —
+    measured in a subprocess with a hard deadline like everything else."""
+    code = (
+        "import sys; sys.path.insert(0, %r); "
+        "from benchmarks.host_wordcount import run_once; "
+        "run_once(50_000, columnar=True); "
+        "r = max(300_000 / run_once(300_000, columnar=True)[0] for _ in range(2)); "
+        "print('HOSTRATE', round(r, 1))"
+    ) % os.path.dirname(os.path.abspath(__file__))
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    for ln in proc.stdout.splitlines():
+        if ln.startswith("HOSTRATE "):
+            return float(ln.split()[1])
+    raise RuntimeError(f"no rate line: rc={proc.returncode} {proc.stderr[-200:]}")
+
+
 def _run_child(extra_args: list[str]) -> tuple[str | None, str]:
     """One measurement subprocess; returns (json_line|None, error)."""
     try:
@@ -351,19 +374,36 @@ def main() -> None:
     if line:
         result = json.loads(line)
         result["error"] = last_err
+        # the HOST engine needs no tunnel: measure it so a tunnel-down
+        # artifact still proves the framework alive with a real number
+        # (target >=1M rows/s; benchmarks/RESULTS.md "round 4")
+        _attach_host_rate(result)
         print(json.dumps(result))
         return
-    print(
-        json.dumps(
-            {
-                "metric": METRIC,
-                "value": 0.0,
-                "unit": "embeddings/s",
-                "vs_baseline": 0.0,
-                "error": last_err,
-            }
+    # deepest fallback: even with jax fully broken the HOST engine can
+    # still prove the framework alive — it never touches the device
+    result = {
+        "metric": METRIC,
+        "value": 0.0,
+        "unit": "embeddings/s",
+        "vs_baseline": 0.0,
+        "error": last_err,
+    }
+    _attach_host_rate(result)
+    print(json.dumps(result))
+
+
+def _attach_host_rate(result: dict) -> None:
+    try:
+        result["host_wordcount_rows_per_sec"] = _host_wordcount_rate()
+    except subprocess.TimeoutExpired:
+        result["host_wordcount_error"] = "timed out after 240s"
+    except Exception as exc:  # noqa: BLE001
+        # keep the TAIL of the message: subprocess errors prefix the whole
+        # command line, burying the actual cause
+        result["host_wordcount_error"] = (
+            f"{type(exc).__name__}: ...{str(exc)[-160:]}"
         )
-    )
 
 
 if __name__ == "__main__":
