@@ -68,12 +68,39 @@ def main() -> None:
     lat_ms.sort()
     p50 = lat_ms[len(lat_ms) // 2]
     p99 = lat_ms[int(len(lat_ms) * 0.99) - 1]
+
+    # Per-call wall latency through the axon tunnel is dominated by the
+    # network round trip (dispatch + D2H fetch cross the wire every call),
+    # which a pod-local host never pays.  Separate the two: amortize many
+    # independent single-query dispatches per fetch — the per-query DEVICE
+    # time is what the <20 ms north-star budget is about.
+    # topk_search_cached returns numpy (it fetches) — go one level down to
+    # the jitted kernel so results can stay device-resident and ONE fetch
+    # covers the whole chain (every D2H over the tunnel costs a full RTT).
+    import jax.numpy as jnp
+
+    device_matrix, mask, _n = cache.get(docs, 1, "cos")
+    qn = queries / np.maximum(np.linalg.norm(queries, axis=1, keepdims=True), 1e-12)
+    dev_queries = [jnp.asarray(qn[j % len(qn)][None, :]) for j in range(64)]
+    reps = len(dev_queries)
+    from pathway_tpu.ops.topk import _masked_topk_jax
+
+    _ = np.asarray(_masked_topk_jax(device_matrix, mask, dev_queries[0], "ip", k)[0])
+    t0 = time.perf_counter()
+    outs = [
+        _masked_topk_jax(device_matrix, mask, dq, "ip", k)[1] for dq in dev_queries
+    ]
+    np.asarray(jnp.concatenate(outs))  # single D2H sync for the chain
+    amortized_ms = (time.perf_counter() - t0) * 1000.0 / reps
+    rtt_ms = max(p50 - amortized_ms, 0.0)
     print(
         json.dumps(
             {
                 "metric": "retrieval_p50_ms_topk",
                 "p50_ms": round(p50, 3),
                 "p99_ms": round(p99, 3),
+                "device_ms_per_query_amortized": round(amortized_ms, 3),
+                "tunnel_rtt_ms_est": round(rtt_ms, 3),
                 "docs": n_docs,
                 "dim": dim,
                 "k": k,

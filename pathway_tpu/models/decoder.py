@@ -1,0 +1,474 @@
+"""TPU-native decoder-only LLM (Mistral/LLaMA-class) for local serving.
+
+The reference serves local chat models through a host-side torch pipeline
+(``xpacks/llm/llms.py:314`` ``HFPipelineChat``); its Adaptive RAG template
+runs Mistral-7B-Instruct that way.  Here the decoder is a jit-compiled JAX
+program designed for the TPU serving split:
+
+  * **prefill** — one bucketed-length causal forward over the whole prompt
+    that fills the KV cache and returns the first sampled logits; all the
+    FLOPs land in large bf16 matmuls on the MXU.
+  * **decode** — a single-token step against the cache, jitted once and
+    re-used for every generated token (static cache capacity, dynamic
+    position — no recompiles during generation).
+
+Layer parameters are stacked along a leading ``[layers, ...]`` axis and the
+trunk runs under ``lax.scan``, so a 32-layer model traces one layer once
+(fast compiles) and the cache is a single ``[layers, B, C, KH, D]`` array
+per K/V.  Weights follow the LLaMA family: RMSNorm, rotary position
+embeddings, grouped-query attention, SwiGLU MLP.  ``tp_param_specs`` /
+``tp_cache_specs`` give the tensor-parallel layout (heads and FFN sharded
+over a ``model`` mesh axis; XLA inserts the all-reduces after ``wo``/``wd``
+contractions), used by the multi-chip dry run.
+
+Checkpoints: a locally cached HF llama/mistral-family checkpoint maps onto
+the param tree via ``load_hf_decoder_weights``; without one (zero-egress
+image) deterministic random init keeps shapes/FLOPs identical, which is
+what the serving-throughput path measures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from pathway_tpu.models.tokenizer import load_tokenizer
+
+
+def _bucket_prompt_len(n: int, cap: int) -> int:
+    """Power-of-two prefill bucket, clamped to the cache capacity (the
+    shared ``bucket_seq_len`` stops at 512, which a long-cache decoder
+    must exceed)."""
+    b = 16
+    while b < n and b < cap:
+        b <<= 1
+    return min(b, cap)
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int = 32000
+    hidden: int = 4096
+    layers: int = 32
+    heads: int = 32
+    kv_heads: int = 8
+    intermediate: int = 14336
+    max_len: int = 4096
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+
+PRESETS: dict[str, DecoderConfig] = {
+    "mistral-7b-instruct": DecoderConfig(),
+    "mistralai/Mistral-7B-Instruct-v0.2": DecoderConfig(rope_theta=1e6),
+    "tinyllama-1.1b": DecoderConfig(
+        hidden=2048, layers=22, heads=32, kv_heads=4, intermediate=5632,
+        max_len=2048,
+    ),
+    # tiny deterministic shape for tests: f32 so CPU numerics are exact
+    "pw-tiny-decoder": DecoderConfig(
+        vocab_size=512, hidden=64, layers=2, heads=4, kv_heads=2,
+        intermediate=128, max_len=128, dtype=jnp.float32,
+    ),
+}
+
+
+def decoder_config_for(model_name: str) -> DecoderConfig:
+    """Preset lookup, or the shape read from a local llama-family
+    ``config.json`` (``transformers`` save directory)."""
+    import json
+    import os
+
+    if model_name in PRESETS:
+        return PRESETS[model_name]
+    cfg_path = os.path.join(model_name, "config.json")
+    if os.path.isfile(cfg_path):
+        with open(cfg_path) as f:
+            hf = json.load(f)
+        return DecoderConfig(
+            vocab_size=hf.get("vocab_size", 32000),
+            hidden=hf.get("hidden_size", 4096),
+            layers=hf.get("num_hidden_layers", 32),
+            heads=hf.get("num_attention_heads", 32),
+            kv_heads=hf.get("num_key_value_heads", hf.get("num_attention_heads", 32)),
+            intermediate=hf.get("intermediate_size", 14336),
+            max_len=min(hf.get("max_position_embeddings", 4096), 8192),
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        )
+    # an unknown name would otherwise build (and compile) a random 7B —
+    # fail loudly instead, a typo should not cost 14 GB and minutes
+    raise ValueError(
+        f"unknown decoder model {model_name!r}: not a preset "
+        f"({sorted(PRESETS)}) and not a local checkpoint directory"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+
+def init_decoder_params(cfg: DecoderConfig, seed: int = 0):
+    """Deterministic scaled-normal init of the stacked param tree."""
+    H, L, F = cfg.hidden, cfg.layers, cfg.intermediate
+    NH, KH, D = cfg.heads, cfg.kv_heads, cfg.head_dim
+    keys = jax.random.split(jax.random.PRNGKey(seed), 10)
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)).astype(
+            cfg.dtype
+        )
+
+    return {
+        "embed": norm_init(keys[0], (cfg.vocab_size, H), H),
+        "final_norm": jnp.ones((H,), cfg.dtype),
+        "lm_head": norm_init(keys[1], (H, cfg.vocab_size), H),
+        "layers": {
+            "ln0": jnp.ones((L, H), cfg.dtype),
+            "ln1": jnp.ones((L, H), cfg.dtype),
+            "wq": norm_init(keys[2], (L, H, NH * D), H),
+            "wk": norm_init(keys[3], (L, H, KH * D), H),
+            "wv": norm_init(keys[4], (L, H, KH * D), H),
+            "wo": norm_init(keys[5], (L, NH * D, H), NH * D),
+            "wg": norm_init(keys[6], (L, H, F), H),
+            "wu": norm_init(keys[7], (L, H, F), H),
+            "wd": norm_init(keys[8], (L, F, H), F),
+        },
+    }
+
+
+def tp_param_specs(cfg: DecoderConfig, axis: str = "model"):
+    """Tensor-parallel PartitionSpecs: attention heads and FFN width sharded
+    over ``axis``; contractions back to hidden leave XLA one all-reduce per
+    block (the Megatron layout, expressed as shardings not collectives)."""
+    return {
+        "embed": P(None, None),
+        "final_norm": P(None),
+        "lm_head": P(None, axis),
+        "layers": {
+            "ln0": P(None, None),
+            "ln1": P(None, None),
+            "wq": P(None, None, axis),
+            "wk": P(None, None, axis),
+            "wv": P(None, None, axis),
+            "wo": P(None, axis, None),
+            "wg": P(None, None, axis),
+            "wu": P(None, None, axis),
+            "wd": P(None, axis, None),
+        },
+    }
+
+
+def tp_cache_specs(axis: str = "model"):
+    """KV cache sharded over kv heads: ``[L, B, C, KH, D]``."""
+    return P(None, None, None, axis, None)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _rms(x, scale, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+def _rope(x, positions, theta):
+    """Rotary embedding; ``x`` is ``[..., S, H, D]``, positions ``[..., S]``."""
+    d = x.shape[-1]
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = positions[..., None].astype(jnp.float32) * inv  # [..., S, D/2]
+    cos = jnp.cos(freqs)[..., None, :]  # [..., S, 1, D/2]
+    sin = jnp.sin(freqs)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attend(q, k, v, mask, cfg: DecoderConfig):
+    """GQA attention.  q ``[B, S, NH, D]``; k/v ``[B, C, KH, D]``;
+    mask ``[B, S, C]`` boolean (True = attend)."""
+    B, S, NH, D = q.shape
+    KH = k.shape[2]
+    G = NH // KH
+    qg = q.reshape(B, S, KH, G, D)
+    scores = jnp.einsum(
+        "bskgd,bckd->bkgsc", qg, k, preferred_element_type=jnp.float32
+    ) / np.sqrt(D)
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    ctx = jnp.einsum("bkgsc,bckd->bskgd", probs, v)
+    return ctx.reshape(B, S, NH * D)
+
+
+def prefill(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
+    """Causal forward over the whole (padded) prompt.
+
+    Returns ``(logits_last, k_cache, v_cache)``: logits at each row's final
+    real token and caches of shape ``[L, B, cache_len, KH, D]`` with the
+    prompt keys/values written at positions ``[0, S)``.
+    """
+    B, S = ids.shape
+    KH, D = cfg.kv_heads, cfg.head_dim
+    x = tree["embed"][ids]  # [B, S, H]
+    positions = jnp.arange(S)[None, :].repeat(B, axis=0)
+    valid = positions < lengths[:, None]  # [B, S]
+    causal = jnp.tril(jnp.ones((S, S), bool))
+    mask = causal[None, :, :] & valid[:, None, :]  # [B, S(q), S(kv)]
+
+    def layer(x, lp):
+        h = _rms(x, lp["ln0"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(B, S, cfg.heads, D)
+        k = (h @ lp["wk"]).reshape(B, S, KH, D)
+        v = (h @ lp["wv"]).reshape(B, S, KH, D)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        x = x + _attend(q, k, v, mask, cfg) @ lp["wo"]
+        h = _rms(x, lp["ln1"], cfg.norm_eps)
+        x = x + (jax.nn.silu(h @ lp["wg"]) * (h @ lp["wu"])) @ lp["wd"]
+        # zero K/V beyond each row's real length: decode_step scatters new
+        # entries additively, which requires untouched slots to hold zeros
+        keep = valid[:, :, None, None].astype(k.dtype)
+        pad = ((0, 0), (0, cache_len - S), (0, 0), (0, 0))
+        return x, (jnp.pad(k * keep, pad), jnp.pad(v * keep, pad))
+
+    x, (k_cache, v_cache) = lax.scan(layer, x, tree["layers"])
+    x = _rms(x, tree["final_norm"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].repeat(cfg.hidden, 2), axis=1
+    )[:, 0, :]
+    logits = (last @ tree["lm_head"]).astype(jnp.float32)
+    return logits, k_cache, v_cache
+
+
+def decode_step(tree, k_cache, v_cache, token, pos, cfg: DecoderConfig):
+    """One generation step: ``token`` ``[B]`` at position ``pos`` ``[B]``.
+
+    Returns ``(logits, k_cache, v_cache)`` with the new K/V written at
+    ``pos``.  Cache capacity is static; ``pos`` is data, so every step of a
+    generation reuses the same compiled program.
+    """
+    B = token.shape[0]
+    C = k_cache.shape[2]
+    KH, D = cfg.kv_heads, cfg.head_dim
+    x = tree["embed"][token][:, None, :]  # [B, 1, H]
+    positions = pos[:, None]  # [B, 1]
+    idx = jnp.arange(C)[None, None, :]
+    mask = idx <= pos[:, None, None]  # [B, 1, C]
+
+    def layer(x, lp):
+        lp, kc, vc = lp
+        h = _rms(x, lp["ln0"], cfg.norm_eps)
+        q = (h @ lp["wq"]).reshape(B, 1, cfg.heads, D)
+        k = (h @ lp["wk"]).reshape(B, 1, KH, D)
+        v = (h @ lp["wv"]).reshape(B, 1, KH, D)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        # scatter the new kv at each row's position
+        onehot = (idx[:, 0, :] == pos[:, None]).astype(kc.dtype)  # [B, C]
+        kc = kc + onehot[:, :, None, None] * k
+        vc = vc + onehot[:, :, None, None] * v
+        x = x + _attend(q, kc, vc, mask, cfg) @ lp["wo"]
+        h = _rms(x, lp["ln1"], cfg.norm_eps)
+        x = x + (jax.nn.silu(h @ lp["wg"]) * (h @ lp["wu"])) @ lp["wd"]
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = lax.scan(layer, x, (tree["layers"], k_cache, v_cache))
+    x = _rms(x, tree["final_norm"], cfg.norm_eps)
+    logits = (x[:, 0, :] @ tree["lm_head"]).astype(jnp.float32)
+    return logits, k_cache, v_cache
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint mapping
+# ---------------------------------------------------------------------------
+
+
+def load_hf_decoder_weights(model_name: str, cfg: DecoderConfig):
+    """Map a locally cached llama/mistral-family ``transformers`` checkpoint
+    onto the stacked tree; returns ``None`` when absent (zero-egress)."""
+    import os
+
+    os.environ.setdefault("HF_HUB_OFFLINE", "1")
+    try:
+        from transformers import AutoModelForCausalLM
+
+        hf = AutoModelForCausalLM.from_pretrained(model_name, local_files_only=True)
+    except Exception:
+        return None
+    sd = {k: v.detach().cpu().numpy() for k, v in hf.state_dict().items()}
+    if "model.layers.0.self_attn.q_proj.weight" not in sd:
+        return None
+
+    def stack(fmt, transpose=True):
+        mats = [sd[fmt.format(i)] for i in range(cfg.layers)]
+        arr = np.stack([m.T if transpose else m for m in mats])
+        return jnp.asarray(arr, cfg.dtype)
+
+    lm_head = sd.get("lm_head.weight", sd["model.embed_tokens.weight"])
+    return {
+        "embed": jnp.asarray(sd["model.embed_tokens.weight"], cfg.dtype),
+        "final_norm": jnp.asarray(sd["model.norm.weight"], cfg.dtype),
+        "lm_head": jnp.asarray(lm_head.T, cfg.dtype),
+        "layers": {
+            "ln0": stack("model.layers.{}.input_layernorm.weight", transpose=False),
+            "ln1": stack(
+                "model.layers.{}.post_attention_layernorm.weight", transpose=False
+            ),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "wg": stack("model.layers.{}.mlp.gate_proj.weight"),
+            "wu": stack("model.layers.{}.mlp.up_proj.weight"),
+            "wd": stack("model.layers.{}.mlp.down_proj.weight"),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Serving wrapper
+# ---------------------------------------------------------------------------
+
+
+class DecoderLM:
+    """Local decoder LLM: tokenizer + jitted prefill/decode + sampling.
+
+    ``generate`` runs a Python loop over the jitted single-token step — the
+    step program is compiled once per (batch, cache) shape and the loop
+    carries device arrays only (one scalar D2H per token for the stop
+    check).
+    """
+
+    def __init__(
+        self,
+        model_name: str = "mistral-7b-instruct",
+        seed: int = 0,
+        max_cache: int = 1024,
+        eos_id: int | None = 2,
+    ):
+        self.config = decoder_config_for(model_name)
+        self.model_name = model_name
+        self.max_cache = min(max_cache, self.config.max_len)
+        self.eos_id = eos_id
+        self.tokenizer = load_tokenizer(
+            model_name, self.config.vocab_size, self.config.max_len
+        )
+        tree = load_hf_decoder_weights(model_name, self.config)
+        self.pretrained = tree is not None
+        self.params = tree if tree is not None else init_decoder_params(
+            self.config, seed
+        )
+        cfg = self.config
+        self._prefill = jax.jit(
+            lambda t, ids, lens: prefill(t, ids, lens, cfg, self.max_cache)
+        )
+        self._step = jax.jit(
+            lambda t, kc, vc, tok, pos: decode_step(t, kc, vc, tok, pos, cfg)
+        )
+
+    def n_params(self) -> int:
+        return sum(
+            int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self.params)
+        )
+
+    def generate_ids(
+        self,
+        prompt_ids: list[list[int]],
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> list[list[int]]:
+        """Batched generation; returns the newly generated ids per row.
+
+        Prompts longer than the cache budget keep their TAIL (the recent
+        context — the part chat serving cares about)."""
+        if max_new_tokens >= self.max_cache:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} must be < max_cache={self.max_cache}"
+            )
+        B = len(prompt_ids)
+        limit = self.max_cache - max_new_tokens
+        prompt_ids = [p[-limit:] if len(p) > limit else p for p in prompt_ids]
+        lengths = np.array([max(len(p), 1) for p in prompt_ids], np.int32)
+        S = _bucket_prompt_len(int(lengths.max()), self.max_cache)
+        ids = np.zeros((B, S), np.int32)
+        for i, p in enumerate(prompt_ids):
+            ids[i, : len(p)] = p
+        logits, kc, vc = self._prefill(
+            self.params, jnp.asarray(ids), jnp.asarray(lengths)
+        )
+        key = jax.random.PRNGKey(seed)
+        pos = jnp.asarray(lengths)  # next write position per row
+        out: list[list[int]] = [[] for _ in range(B)]
+        done = np.zeros(B, bool)
+        for _ in range(max_new_tokens):
+            if temperature > 0.0:
+                key, sub = jax.random.split(key)
+                token = jax.random.categorical(sub, logits / temperature, axis=-1)
+            else:
+                token = jnp.argmax(logits, axis=-1)
+            host_tok = np.asarray(token)
+            for i, t in enumerate(host_tok):
+                if not done[i]:
+                    if self.eos_id is not None and int(t) == self.eos_id:
+                        done[i] = True
+                    else:
+                        out[i].append(int(t))
+            if done.all():
+                break
+            logits, kc, vc = self._step(
+                self.params, kc, vc, token.astype(jnp.int32), pos
+            )
+            pos = pos + 1
+        return out
+
+    def generate(
+        self,
+        prompt: str,
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> str:
+        ids = self._encode_prompt(prompt)
+        new_ids = self.generate_ids([ids], max_new_tokens, temperature, seed)[0]
+        return self.tokenizer.decode(new_ids)
+
+    def _encode_prompt(self, prompt: str) -> list[int]:
+        """Tokenize at the MODEL limit, not the cache limit: tokenizers
+        truncate from the head, but chat serving must keep the prompt's
+        TAIL — ``generate_ids`` does that tail-keeping against the cache
+        budget itself."""
+        return self.tokenizer.encode(prompt, max_length=self.config.max_len)
+
+    def generate_many(
+        self,
+        prompts: list[str],
+        max_new_tokens: int = 64,
+        temperature: float = 0.0,
+        seed: int = 0,
+    ) -> list[str]:
+        """One padded ragged batch through prefill+decode for all prompts."""
+        id_lists = [self._encode_prompt(p) for p in prompts]
+        outs = self.generate_ids(id_lists, max_new_tokens, temperature, seed)
+        return [self.tokenizer.decode(o) for o in outs]
+
+
+@functools.lru_cache(maxsize=4)
+def shared_decoder(model_name: str = "mistral-7b-instruct", max_cache: int = 1024) -> DecoderLM:
+    return DecoderLM(model_name, max_cache=max_cache)

@@ -51,6 +51,10 @@ class HashTokenizer:
             ids[-1] = SEP_ID
         return ids
 
+    def decode(self, ids: list[int]) -> str:
+        """Hashing is one-way; emit stable placeholders (shape-true text)."""
+        return " ".join(f"tok{int(i)}" for i in ids if i not in (CLS_ID, SEP_ID, PAD_ID))
+
 
 def load_tokenizer(model_name: str, vocab_size: int, max_length: int) -> Any:
     """HF tokenizer if ``model_name`` is a local checkpoint directory or is
@@ -78,6 +82,9 @@ def load_tokenizer(model_name: str, vocab_size: int, max_length: int) -> Any:
 
             def encode_pair(self, a, b, max_length=max_length):
                 return hf.encode(a, b, truncation=True, max_length=max_length)
+
+            def decode(self, ids):
+                return hf.decode(ids, skip_special_tokens=True)
 
         return _HFAdapter()
     except Exception:

@@ -47,13 +47,15 @@ def _sharded_topk_impl(
     def local(docs_blk, mask_blk, q):
         # shared metric definition — scores match the single-chip path
         # (ops/topk.py score_block) bit-for-bit
-        from pathway_tpu.ops.topk import score_block
+        from pathway_tpu.ops.topk import exact_topk, score_block
 
         scores = score_block(docs_blk, q, metric)
         # keep the GEMM out of the top_k fusion (see ops/topk.py — 18x on
         # the CPU backend, harmless on TPU)
         scores = lax.optimization_barrier(scores) + mask_blk[None, :]
-        vals, idx = lax.top_k(scores, k_local)
+        # two-stage exact top-k: a full sort over the shard's megarow
+        # (not the GEMM) is what dominates large-corpus latency
+        vals, idx = exact_topk(scores, k_local)
         shard = _flat_axis_index(axes, mesh)
         idx = idx + shard * docs_blk.shape[0]
         vals_g = lax.all_gather(vals, axes, axis=1, tiled=True)

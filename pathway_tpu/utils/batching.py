@@ -27,11 +27,20 @@ class AsyncMicroBatcher:
         process_batch: Callable[[list], Sequence],
         max_batch_size: int = 256,
         flush_delay: float = 0.002,
+        run_in_thread: bool = False,
     ):
+        """``run_in_thread=True`` runs each batch via ``asyncio.to_thread``
+        so the event loop stays responsive during long device calls (LLM
+        generation takes seconds; embedder batches take milliseconds and
+        keep the default synchronous flush)."""
         self.process_batch = process_batch
         self.max_batch_size = max_batch_size
         self.flush_delay = flush_delay
+        self.run_in_thread = run_in_thread
         self._per_loop: dict[int, list] = {}
+        # strong refs: the loop only weak-refs tasks, and a GC'd batch
+        # task would strand its futures forever
+        self._tasks: set = set()
 
     async def submit(self, item: Any) -> Any:
         loop = asyncio.get_running_loop()
@@ -52,6 +61,16 @@ class AsyncMicroBatcher:
             return
         batch = pending[: self.max_batch_size]
         del pending[: self.max_batch_size]
+        if self.run_in_thread:
+            task = asyncio.get_running_loop().create_task(
+                self._run_batch_async(batch)
+            )
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+        else:
+            self._run_batch(batch)
+
+    def _run_batch(self, batch: list) -> None:
         items = [it for (it, _f) in batch]
         try:
             results = self.process_batch(items)
@@ -59,6 +78,18 @@ class AsyncMicroBatcher:
                 if not fut.done():
                     fut.set_result(res)
         except Exception as exc:
+            for _it, fut in batch:
+                if not fut.done():
+                    fut.set_exception(exc)
+
+    async def _run_batch_async(self, batch: list) -> None:
+        items = [it for (it, _f) in batch]
+        try:
+            results = await asyncio.to_thread(self.process_batch, items)
+            for (_it, fut), res in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(res)
+        except Exception as exc:  # noqa: BLE001 — deliver to every waiter
             for _it, fut in batch:
                 if not fut.done():
                     fut.set_exception(exc)

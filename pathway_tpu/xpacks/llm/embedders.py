@@ -172,3 +172,103 @@ class GeminiEmbedder(BaseEmbedder):
             return np.array(ret["embedding"])
 
         self.__wrapped__ = embed
+
+
+class MultimodalEmbedder(BaseEmbedder):
+    """SigLIP-class image+text embedder into one shared space.
+
+    Beyond-reference capability named by BASELINE.md's multimodal RAG
+    config (the reference's embedders are text-only API/torch wrappers,
+    ``xpacks/llm/embedders.py:85-401``).  Both towers are jitted JAX
+    programs (``models/vision.py``); text rows and image rows land in the
+    same ``proj_dim`` space, so one ``DocumentStore``/sharded index serves
+    a mixed corpus.
+
+    Accepted inputs per row: ``str`` (text), ``np.ndarray`` (HWC image),
+    or ``bytes`` — a ``.npy`` serialization, or any image format Pillow
+    can open when Pillow is importable.
+    """
+
+    def __init__(
+        self,
+        model: str = "siglip-base-patch16-224",
+        *,
+        max_batch_size: int = 64,
+        **init_kwargs,
+    ):
+        super().__init__(executor=async_executor(), deterministic=True)
+        from pathway_tpu.models.vision import shared_multimodal_encoder
+
+        self.model_name = model
+        self._encoder = shared_multimodal_encoder(model)
+        self._text_batcher = AsyncMicroBatcher(
+            lambda texts: list(self._encoder.embed_texts(texts)),
+            max_batch_size=max_batch_size,
+        )
+        self._image_batcher = AsyncMicroBatcher(
+            lambda imgs: list(self._encoder.embed_images(np.stack(imgs))),
+            max_batch_size=max_batch_size,
+        )
+
+        async def embed(input: Any = None, **kwargs) -> np.ndarray:
+            img = _decode_image(input, self._encoder.vision_config.image_size)
+            if img is not None:
+                return await self._image_batcher.submit(img)
+            return await self._text_batcher.submit(
+                input if isinstance(input, str) else str(input or "")
+            )
+
+        embed.__name__ = f"multimodal:{model}"
+        self.__wrapped__ = embed
+
+    def get_embedding_dimension(self, **kwargs) -> int:
+        return self._encoder.dimensions
+
+
+def _decode_image(value: Any, image_size: int) -> np.ndarray | None:
+    """Best-effort decode of a row value into a ``[S, S, 3]`` f32 image;
+    returns None for text rows.  Pre-resizes so ragged sources stack into
+    one device batch."""
+    from pathway_tpu.models.vision import _resize_bilinear
+
+    arr = None
+    if isinstance(value, np.ndarray) and value.ndim >= 2:
+        arr = value
+    elif isinstance(value, bytes):
+        import io
+
+        try:
+            loaded = np.load(io.BytesIO(value), allow_pickle=False)
+            if isinstance(loaded, np.ndarray) and loaded.ndim >= 2:
+                arr = loaded
+        except Exception:
+            try:
+                from PIL import Image  # gated: Pillow is optional
+
+                arr = np.asarray(Image.open(io.BytesIO(value)).convert("RGB"))
+            except Exception:
+                return None
+    if arr is None:
+        return None
+    arr = np.asarray(arr)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.ndim != 3:
+        return None
+    # CHW layouts (channel-count leading, spatial dims trailing) → HWC
+    if arr.shape[0] in (1, 3, 4) and arr.shape[-1] not in (1, 2, 3, 4):
+        arr = arr.transpose(1, 2, 0)
+    c = arr.shape[-1]
+    if c == 1:
+        arr = np.repeat(arr, 3, axis=2)
+    elif c == 2:  # e.g. gray+alpha: keep luminance, drop alpha
+        arr = np.repeat(arr[..., :1], 3, axis=2)
+    elif c > 3:
+        arr = arr[..., :3]
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    # keep [0, 1] floats: embed_images applies the [-1, 1] mapping once
+    arr = arr.astype(np.float32)
+    if arr.shape[0] != image_size or arr.shape[1] != image_size:
+        arr = _resize_bilinear(arr[None, ...], image_size)[0]
+    return arr

@@ -145,8 +145,8 @@ class HFPipelineChat(BaseChat):
     """Local transformers pipeline chat (parity: llms.py HFPipelineChat).
 
     Works offline when the model is in the local HF cache; the reference
-    runs this on CPU/GPU torch — a flax causal-LM serving path is the
-    planned TPU upgrade for the generation side.
+    runs this on CPU/GPU torch — ``JaxChat`` below is the TPU-native
+    serving path for the generation side.
     """
 
     def __init__(
@@ -185,6 +185,86 @@ class HFPipelineChat(BaseChat):
                 "text-generation", model=self.model, **self.pipeline_kwargs
             )
         return self._pipeline
+
+    def crop_to_max_prompt_size(self, text: str, max_tokens: int = 1024) -> str:
+        return text[: max_tokens * 4]
+
+
+class JaxChat(BaseChat):
+    """TPU-native local chat: jitted JAX decoder with a KV cache.
+
+    The reference's local-serving story is a host-side torch pipeline
+    (``xpacks/llm/llms.py:314`` HFPipelineChat; the Adaptive RAG template
+    runs Mistral-7B-Instruct through it).  Here generation runs as two
+    compiled XLA programs — bucketed-prompt prefill and a single-token
+    decode step reused for every generated token (``models/decoder.py``) —
+    so the serving path is device-resident end to end.  Concurrent rows of
+    an epoch are micro-batched into one padded ragged generation batch.  A
+    locally cached llama/mistral-family checkpoint is mapped in when
+    present; otherwise deterministic random weights keep shapes/FLOPs (and
+    thus serving latency) identical.
+    """
+
+    def __init__(
+        self,
+        model: str = "mistral-7b-instruct",
+        max_new_tokens: int = 128,
+        temperature: float = 0.0,
+        max_cache: int = 1024,
+        max_batch: int = 32,
+        capacity: int | None = None,
+        cache_strategy=None,
+    ):
+        super().__init__(
+            executor=async_executor(capacity=capacity),
+            cache_strategy=cache_strategy,
+        )
+        self.model = model
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.max_cache = max_cache
+        self.max_batch = max_batch
+        self._model = None
+        self._init_lock = None
+        self._batchers: dict[tuple, Any] = {}
+
+        async def chat(messages: Any, **kwargs) -> str:
+            import asyncio
+
+            if self._model is None:
+                # first call compiles; keep the loop free while it does,
+                # and hold a lock so concurrent rows build it only once
+                if self._init_lock is None:
+                    self._init_lock = asyncio.Lock()
+                async with self._init_lock:
+                    if self._model is None:
+                        self._model = await asyncio.to_thread(self._build_model)
+            lm = self._model
+            mnt = int(kwargs.get("max_tokens", self.max_new_tokens))
+            temp = float(kwargs.get("temperature", self.temperature))
+            batcher = self._batchers.get((mnt, temp))
+            if batcher is None:
+                from pathway_tpu.utils.batching import AsyncMicroBatcher
+
+                # one batcher per sampling config; generation is seconds
+                # long, so batches run in a thread to keep the loop live
+                batcher = AsyncMicroBatcher(
+                    lambda prompts: lm.generate_many(
+                        prompts, max_new_tokens=mnt, temperature=temp
+                    ),
+                    max_batch_size=self.max_batch,
+                    flush_delay=0.01,
+                    run_in_thread=True,
+                )
+                self._batchers[(mnt, temp)] = batcher
+            return await batcher.submit(_messages_to_prompt(messages))
+
+        self.__wrapped__ = chat
+
+    def _build_model(self):
+        from pathway_tpu.models.decoder import shared_decoder
+
+        return shared_decoder(self.model, max_cache=self.max_cache)
 
     def crop_to_max_prompt_size(self, text: str, max_tokens: int = 1024) -> str:
         return text[: max_tokens * 4]
