@@ -117,8 +117,42 @@ def attest_once() -> bool:
         dest = os.path.join(ATTEST_DIR, f"trace_{stamp}")
         shutil.copytree(TRACE_DIR, dest, dirs_exist_ok=True)
         paths.append(dest)
+    # independent retrieval-latency artifact at the north-star shard size
+    try:
+        ret = _run_retrieval()
+        if ret is not None and ret.get("platform") == "tpu":
+            ret["attested_at_utc"] = stamp
+            ret["git_head"] = head
+            ret_path = os.path.join(ATTEST_DIR, f"RETRIEVAL_attested_{stamp}.json")
+            with open(ret_path, "w") as f:
+                json.dump(ret, f, indent=1)
+                f.write("\n")
+            paths.append(ret_path)
+    except Exception as exc:  # noqa: BLE001 — retrieval evidence is best-effort
+        print(f"attest_loop: retrieval capture failed: {exc}", file=sys.stderr)
     _commit(paths, f"Attested TPU bench: {result.get('value')} emb/s ({stamp})")
     return True
+
+
+def _run_retrieval() -> dict | None:
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "benchmarks", "retrieval_latency.py"),
+            "625000",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=580,
+        cwd=REPO,
+    )
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
 
 
 def main() -> None:

@@ -33,6 +33,18 @@ def main() -> None:
 
     import jax
 
+    # persistent XLA compile cache (shared with bench.py): a warm tunnel
+    # window then spends its budget measuring, not compiling
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks",
+        ".xla_cache",
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
         # the TPU plugin in this image force-registers itself and overrides
         # the env var; an unpinned run hijacks backend init and hangs when

@@ -215,3 +215,30 @@ def test_cross_encoder_reranker_topk_filter():
     res = t.select(best=rerank_topk_filter(pw.this.docs, pw.this.scores, 2))
     (row,) = _capture_table(res).final_rows().values()
     assert row[0][0] == ("b", "c")
+
+
+def test_adaptive_rag_with_local_jax_decoder():
+    """BASELINE.md's Adaptive RAG config end to end with the LOCAL JAX
+    decoder serving path (JaxChat -> models/decoder.py) instead of an API
+    chat: retrieval, prompt build, batched generation, answer plumbing."""
+    from pathway_tpu.xpacks.llm.llms import JaxChat
+    from pathway_tpu.xpacks.llm.question_answering import AdaptiveRAGQuestionAnswerer
+
+    docs = _docs([(f"doc {i}", {"path": f"/{i}"}) for i in range(4)])
+    store = DocumentStore(docs, BruteForceKnnFactory(embedder=FakeEmbeddings()))
+    chat = JaxChat(model="pw-tiny-decoder", max_new_tokens=4, max_cache=128)
+    rag = AdaptiveRAGQuestionAnswerer(chat, store, n_starting_documents=2)
+    queries = make_static_input_table(
+        rag.AnswerQuerySchema,
+        [
+            {
+                "prompt": "what is in the corpus?",
+                "filters": None,
+                "model": None,
+                "return_context_docs": False,
+            }
+        ],
+    )
+    (result,) = _one_result(rag.answer_query(queries))
+    assert isinstance(result.value["response"], str)
+    assert result.value["response"]
