@@ -217,7 +217,7 @@ def child() -> None:
 
     for key, fn in (
         ("bge_mfu", lambda: _extra_bge_mfu(peak)),
-        ("retrieval_p50_ms_625k", _extra_retrieval_p50),
+        ("retrieval_625k", _extra_retrieval_p50),
         ("profile_trace", lambda: _extra_profile_trace(fwd, params, ids, mask)),
     ):
         try:
@@ -254,19 +254,28 @@ def _extra_retrieval_p50() -> dict:
     rng = np.random.default_rng(0)
     docs = rng.normal(size=(625_000, 384)).astype(np.float32)
     queries = rng.normal(size=(64, 384)).astype(np.float32)
-    queries /= np.linalg.norm(queries, axis=1, keepdims=True)
     cache = topk_ops.DeviceIndexCache()
-    device_matrix, mask, _n = cache.get(docs, 1, "cos")
-    kernel = topk_ops._masked_topk_jax
-    dev_qs = [jnp.asarray(queries[j][None, :]) for j in range(64)]
-    np.asarray(kernel(device_matrix, mask, dev_qs[0], "ip", 10)[0])  # warm
+    # wall p50 goes through the PUBLIC search path (includes the cache
+    # version check, query normalization, result fetch) — what a served
+    # query actually pays per call
+    topk_ops.topk_search_cached(docs, queries[:1], 10, "cos", cache=cache, version=1)
     lat = []
     for i in range(30):
         t0 = time.perf_counter()
-        np.asarray(kernel(device_matrix, mask, dev_qs[i % 64], "ip", 10)[0])
+        idx, _ = topk_ops.topk_search_cached(
+            docs, queries[i % 64][None, :], 10, "cos", cache=cache, version=1
+        )
+        np.asarray(idx)
         lat.append((time.perf_counter() - t0) * 1000.0)
     lat.sort()
     p50_wall = lat[len(lat) // 2]
+    # device time per query: a device-resident chain of the underlying
+    # jitted kernel (same program the public path dispatches), ONE fetch
+    qn = queries / np.linalg.norm(queries, axis=1, keepdims=True)
+    device_matrix, mask, _n = cache.get(docs, 1, "cos")
+    kernel = topk_ops._masked_topk_jax
+    dev_qs = [jnp.asarray(qn[j][None, :]) for j in range(64)]
+    np.asarray(kernel(device_matrix, mask, dev_qs[0], "ip", 10)[0])  # warm
     t0 = time.perf_counter()
     outs = [kernel(device_matrix, mask, q, "ip", 10)[1] for q in dev_qs]
     np.asarray(jnp.concatenate(outs))  # one D2H sync for the whole chain

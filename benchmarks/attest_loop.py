@@ -130,17 +130,30 @@ def attest_once() -> bool:
             paths.append(ret_path)
     except Exception as exc:  # noqa: BLE001 — retrieval evidence is best-effort
         print(f"attest_loop: retrieval capture failed: {exc}", file=sys.stderr)
+    # decoder serving throughput (tinyllama-class prefill + cached decode)
+    try:
+        dec = _run_json_bench("decoder_throughput.py")
+        if dec is not None and dec.get("platform") == "tpu":
+            dec["attested_at_utc"] = stamp
+            dec["git_head"] = head
+            dec_path = os.path.join(ATTEST_DIR, f"DECODER_attested_{stamp}.json")
+            with open(dec_path, "w") as f:
+                json.dump(dec, f, indent=1)
+                f.write("\n")
+            paths.append(dec_path)
+    except Exception as exc:  # noqa: BLE001
+        print(f"attest_loop: decoder capture failed: {exc}", file=sys.stderr)
     _commit(paths, f"Attested TPU bench: {result.get('value')} emb/s ({stamp})")
     return True
 
 
 def _run_retrieval() -> dict | None:
+    return _run_json_bench("retrieval_latency.py", "625000")
+
+
+def _run_json_bench(script: str, *args: str) -> dict | None:
     proc = subprocess.run(
-        [
-            sys.executable,
-            os.path.join(REPO, "benchmarks", "retrieval_latency.py"),
-            "625000",
-        ],
+        [sys.executable, os.path.join(REPO, "benchmarks", script), *args],
         capture_output=True,
         text=True,
         timeout=580,

@@ -1,0 +1,113 @@
+"""Decoder-LLM serving throughput: prefill tokens/s and decode tokens/s.
+
+Measures the two compiled programs JaxChat serving runs on
+(``models/decoder.py``): bucketed prefill over a prompt batch, and the
+cached single-token decode step.  The decode chain stays device-resident
+(argmax feeds the next step on device; ONE D2H sync at the end) — over the
+axon tunnel every fetch costs a full network RTT that a pod-local host
+never pays, so per-token fetch timing would measure the tunnel, not the
+chip.
+
+Model shape: tinyllama-1.1b class on TPU (2.2 GB bf16 — deterministic
+random weights, throughput is weight-independent); self-scales down on
+CPU so CI can sanity-check the harness.
+
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    import jax
+
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks",
+        ".xla_cache",
+    )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from pathway_tpu.models.decoder import (
+        DecoderLM,
+        decode_step,
+        prefill,
+    )
+
+    platform = jax.devices()[0].platform
+    if platform == "tpu":
+        model, batch, prompt_len, steps, cache = "tinyllama-1.1b", 8, 512, 64, 1024
+    else:
+        model, batch, prompt_len, steps, cache = "pw-tiny-decoder", 4, 32, 16, 64
+
+    lm = DecoderLM(model, max_cache=cache, eos_id=None)
+    cfg = lm.config
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, cfg.vocab_size, size=(batch, prompt_len)).astype(np.int32)
+    lens = jnp.full((batch,), prompt_len, jnp.int32)
+
+    pre = jax.jit(lambda t, i, l: prefill(t, i, l, cfg, cache))
+    step = jax.jit(lambda t, kc, vc, tok, pos: decode_step(t, kc, vc, tok, pos, cfg))
+
+    # warm both programs, then time prefill with a scalar-fetch sync
+    logits, kc, vc = pre(lm.params, jnp.asarray(ids), lens)
+    float(logits.sum())
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        logits, kc, vc = pre(lm.params, jnp.asarray(ids), lens)
+        float(logits.sum())
+    prefill_tok_s = batch * prompt_len * reps / (time.perf_counter() - t0)
+
+    # decode chain: token feedback stays on device, one sync at the end
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    pos = lens
+    l2, kc2, vc2 = step(lm.params, kc, vc, tok, pos)  # warm
+    float(l2.sum())
+    t0 = time.perf_counter()
+    acc = None
+    for _ in range(steps):
+        logits, kc, vc = step(lm.params, kc, vc, tok, pos)
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        pos = pos + 1
+        s = logits.sum()
+        acc = s if acc is None else acc + s
+    assert np.isfinite(float(acc))
+    dt = time.perf_counter() - t0
+    decode_tok_s = batch * steps / dt
+
+    n_params = lm.n_params()
+    print(
+        json.dumps(
+            {
+                "metric": "decoder_serving_throughput",
+                "model": model,
+                "n_params": n_params,
+                "batch": batch,
+                "prefill_tokens_per_sec": round(prefill_tok_s, 1),
+                "decode_tokens_per_sec": round(decode_tok_s, 1),
+                "decode_ms_per_token_per_seq": round(dt / steps * 1000.0, 3),
+                "platform": platform,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

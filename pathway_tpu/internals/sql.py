@@ -21,6 +21,8 @@ reference documents:
 * uncorrelated scalar subqueries in WHERE/HAVING
   (``WHERE v > (SELECT AVG(v) FROM t)`` — must be a single-row aggregate)
 * projection-alias reuse in HAVING (``SELECT SUM(v) AS s ... HAVING s > 3``)
+* CASE (searched and simple forms, aggregates allowed in branches under
+  GROUP BY), IF(cond, a, b), NULLIF(a, b), COALESCE
 
 Not covered (as in the reference's documented limitations): correlated
 subqueries, window functions, ORDER BY/LIMIT (meaningless on streams).
@@ -50,7 +52,8 @@ _KEYWORDS = {
     "select", "distinct", "from", "where", "group", "by", "having", "union",
     "all", "join", "inner", "left", "right", "full", "outer", "cross", "on",
     "as", "and", "or", "not", "is", "null", "between", "in", "true", "false",
-    "with", "recursive", "intersect", "except",
+    "with", "recursive", "intersect", "except", "case", "when", "then",
+    "else", "end",
 }
 
 
@@ -249,6 +252,28 @@ def _parse_primary(p: _Parser):
     if t == "kw" and v == "null":
         p.next()
         return ("const", None)
+    if t == "kw" and v == "case":
+        # CASE [operand] WHEN x THEN y [WHEN ...] [ELSE z] END
+        # (searched and simple forms — sqlglot's Case node in the
+        # reference maps to the same if_else chain, sql.py:69)
+        p.next()
+        operand = None
+        if p.peek() != ("kw", "when"):
+            operand = _parse_expr(p)
+        whens = []
+        while p.accept_kw("when"):
+            cond = _parse_expr(p)
+            p.expect_kw("then")
+            whens.append((cond, _parse_expr(p)))
+        default = ("const", None)
+        if p.accept_kw("else"):
+            default = _parse_expr(p)
+        p.expect_kw("end")
+        if not whens:
+            raise SqlError("CASE requires at least one WHEN clause")
+        # operand stays a single AST node: the simple form compiles it ONCE
+        # and shares the compiled expression across every WHEN comparison
+        return ("case", operand, whens, default)
     if t == "op" and v == "(":
         p.next()
         if p.peek() in (("kw", "select"), ("kw", "with")):
@@ -488,11 +513,39 @@ def _compile_scalar(ast, env: _Env, agg_ok: bool = False) -> Any:
         if fname == "count" and arg is None:
             return reducers.count()
         return _AGGS[fname](_compile_scalar(arg, env, agg_ok))
+    if kind == "case":
+        operand, whens, default = ast[1], ast[2], ast[3]
+        op_expr = (
+            _compile_scalar(operand, env, agg_ok) if operand is not None else None
+        )
+        out = _compile_scalar(default, env, agg_ok)
+        for cond_ast, then_ast in reversed(whens):
+            cond = _compile_scalar(cond_ast, env, agg_ok)
+            if op_expr is not None:
+                cond = expr_mod.ColumnBinaryOpExpression("==", op_expr, cond)
+            out = expr_mod.IfElseExpression(
+                cond, _compile_scalar(then_ast, env, agg_ok), out
+            )
+        return out
     if kind == "func":
         fname, args = ast[1], ast[2]
         compiled = [_compile_scalar(a, env, agg_ok) for a in args]
         if fname == "coalesce":
             return coalesce(*compiled)
+        if fname in ("if", "iff"):
+            if len(compiled) != 3:
+                raise SqlError(
+                    f"IF takes 3 arguments (condition, then, else); got {len(compiled)}"
+                )
+            return expr_mod.IfElseExpression(*compiled)
+        if fname == "nullif":
+            if len(compiled) != 2:
+                raise SqlError(f"NULLIF takes 2 arguments; got {len(compiled)}")
+            return expr_mod.IfElseExpression(
+                expr_mod.ColumnBinaryOpExpression("==", compiled[0], compiled[1]),
+                expr_mod.ColumnConstExpression(None),
+                compiled[0],
+            )
         raise SqlError(f"unsupported SQL function {fname!r}")
     if kind == "anycol":
         # a scalar-subquery placeholder inside HAVING: constant per group,
@@ -524,6 +577,12 @@ def _ast_columns(ast) -> list[tuple[str | None, str]]:
         return _ast_columns(ast[2]) if ast[2] is not None else []
     if kind == "func":
         return [c for a in ast[2] for c in _ast_columns(a)]
+    if kind == "case":
+        operand, whens, default = ast[1], ast[2], ast[3]
+        out = [] if operand is None else _ast_columns(operand)
+        out += [c for (cond, then) in whens
+                for c in _ast_columns(cond) + _ast_columns(then)]
+        return out + _ast_columns(default)
     return []
 
 
@@ -668,6 +727,13 @@ def _has_agg(ast) -> bool:
         return _has_agg(ast[1])
     if ast[0] == "func":
         return any(_has_agg(a) for a in ast[2])
+    if ast[0] == "case":
+        operand, whens, default = ast[1], ast[2], ast[3]
+        if operand is not None and _has_agg(operand):
+            return True
+        return any(
+            _has_agg(c) or _has_agg(th) for (c, th) in whens
+        ) or _has_agg(default)
     return False
 
 

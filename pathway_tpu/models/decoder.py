@@ -216,13 +216,8 @@ def _attend(q, k, v, mask, cfg: DecoderConfig):
     return ctx.reshape(B, S, NH * D)
 
 
-def prefill(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
-    """Causal forward over the whole (padded) prompt.
-
-    Returns ``(logits_last, k_cache, v_cache)``: logits at each row's final
-    real token and caches of shape ``[L, B, cache_len, KH, D]`` with the
-    prompt keys/values written at positions ``[0, S)``.
-    """
+def _causal_trunk(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
+    """Shared causal forward: final-norm token reps + K/V caches."""
     B, S = ids.shape
     KH, D = cfg.kv_heads, cfg.head_dim
     x = tree["embed"][ids]  # [B, S, H]
@@ -249,11 +244,33 @@ def prefill(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
 
     x, (k_cache, v_cache) = lax.scan(layer, x, tree["layers"])
     x = _rms(x, tree["final_norm"], cfg.norm_eps)
+    return x, k_cache, v_cache
+
+
+def prefill(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
+    """Causal forward over the whole (padded) prompt.
+
+    Returns ``(logits_last, k_cache, v_cache)``: logits at each row's final
+    real token and caches of shape ``[L, B, cache_len, KH, D]`` with the
+    prompt keys/values written at positions ``[0, S)``.
+    """
+    x, k_cache, v_cache = _causal_trunk(tree, ids, lengths, cfg, cache_len)
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].repeat(cfg.hidden, 2), axis=1
     )[:, 0, :]
     logits = (last @ tree["lm_head"]).astype(jnp.float32)
     return logits, k_cache, v_cache
+
+
+def causal_lm_logits(tree, ids, lengths, cfg: DecoderConfig):
+    """All-position logits ``[B, S, vocab]`` (f32) for next-token training.
+
+    The unused K/V scan outputs are dead code under ``jax.grad``/``jit`` —
+    XLA eliminates them, so training pays no cache-materialization cost.
+    """
+    S = ids.shape[1]
+    x, _, _ = _causal_trunk(tree, ids, lengths, cfg, S)
+    return (x @ tree["lm_head"]).astype(jnp.float32)
 
 
 def decode_step(tree, k_cache, v_cache, token, pos, cfg: DecoderConfig):

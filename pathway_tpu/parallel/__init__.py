@@ -39,6 +39,7 @@ from pathway_tpu.parallel.sharding import (
 )
 from pathway_tpu.parallel.train import (
     TrainState,
+    make_causal_lm_train_step,
     make_contrastive_train_step,
     init_train_state,
 )
@@ -58,6 +59,7 @@ __all__ = [
     "replicated",
     "TrainState",
     "init_train_state",
+    "make_causal_lm_train_step",
     "make_contrastive_train_step",
     "ShardedDeviceIndex",
     "sharded_topk",

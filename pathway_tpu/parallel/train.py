@@ -94,3 +94,64 @@ def make_contrastive_train_step(
         return TrainState(params=params, opt_state=opt_state, step=state.step + 1), loss
 
     return run
+
+
+def make_causal_lm_train_step(
+    cfg,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+) -> tuple[Callable, Callable]:
+    """Distributed next-token training for the decoder LLM family.
+
+    Returns ``(init_state, run)``: data-parallel batch over ``data``,
+    tensor-parallel decoder weights over ``model`` (the same
+    ``tp_param_specs`` layout serving uses — train and serve share one
+    placement, so fine-tuned weights drop straight into ``DecoderLM``).
+    Loss is masked next-token cross-entropy; gradients are psum-reduced by
+    XLA from the sharding annotations alone.
+    """
+    from pathway_tpu.models.decoder import (
+        causal_lm_logits,
+        init_decoder_params,
+        tp_param_specs,
+    )
+
+    def init_state(seed: int = 0) -> TrainState:
+        tree = init_decoder_params(cfg, seed)
+        specs = tp_param_specs(cfg)
+        tree = jax.tree_util.tree_map(
+            lambda t, s: jax.device_put(t, NamedSharding(mesh, s)), tree, specs
+        )
+        return TrainState(params=tree, opt_state=optimizer.init(tree))
+
+    def loss_fn(tree, ids, lengths):
+        logits = causal_lm_logits(tree, ids, lengths, cfg)  # [B, S, V] f32
+        targets = ids[:, 1:]
+        logp = jax.nn.log_softmax(logits[:, :-1, :], axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        pos = jnp.arange(ids.shape[1] - 1)[None, :]
+        m = (pos < (lengths - 1)[:, None]).astype(jnp.float32)
+        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+    @jax.jit
+    def step(params, opt_state, ids, lengths):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids, lengths)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    batch_sharding = NamedSharding(mesh, P("data"))
+    len_sharding = NamedSharding(mesh, P("data"))
+
+    def run(state: TrainState, ids, lengths) -> tuple[TrainState, float]:
+        import numpy as _np
+
+        ids = put_global(_np.asarray(ids, _np.int32), batch_sharding)
+        lengths = put_global(_np.asarray(lengths, _np.int32), len_sharding)
+        params, opt_state, loss = step(state.params, state.opt_state, ids, lengths)
+        return (
+            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            loss,
+        )
+
+    return init_state, run

@@ -302,7 +302,7 @@ def test_ten_million_doc_rehearsal(mesh):
     10M x 384 bf16 over 8 devices (each virtual device holds 2 v5e chips'
     worth), planted-neighbor exactness, padded-capacity math, p50 timing
     (CPU — the committed TPU latency comes from bench.py's
-    retrieval_p50_ms_625k on a tunnel-up window)."""
+    retrieval_625k extra on a tunnel-up window)."""
     import time
 
     import jax.numpy as jnp

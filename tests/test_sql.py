@@ -380,3 +380,64 @@ def test_sql_cte_with_set_ops_and_subquery_combined():
         tab=t,
     )
     assert sorted(x[0] for x in rows(res)) == [1, 2, 3]
+
+
+def test_case_when_searched():
+    t = pw.debug.table_from_markdown("a\n1\n5\n9")
+    res = pw.sql(
+        "SELECT a, CASE WHEN a > 4 THEN 'big' ELSE 'small' END AS size FROM t",
+        t=t,
+    )
+    assert sorted(rows(res)) == [(1, "small"), (5, "big"), (9, "big")]
+
+
+def test_case_simple_form_and_no_else():
+    t = pw.debug.table_from_markdown("a | b\n1 | x\n5 | y\n9 | z")
+    res = pw.sql(
+        "SELECT a, CASE b WHEN 'x' THEN 10 WHEN 'y' THEN 20 END AS code FROM t",
+        t=t,
+    )
+    got = {r[0]: r[1] for r in rows(res)}
+    assert got == {1: 10, 5: 20, 9: None}
+
+
+def test_case_nested_priority_order():
+    t = pw.debug.table_from_markdown("a\n1\n5\n9")
+    res = pw.sql(
+        "SELECT CASE WHEN a > 6 THEN 'hi' WHEN a > 2 THEN 'mid' ELSE 'lo' END"
+        " AS lvl FROM t",
+        t=t,
+    )
+    assert sorted(r[0] for r in rows(res)) == ["hi", "lo", "mid"]
+
+
+def test_case_with_aggregate_in_group_by():
+    t = pw.debug.table_from_markdown("a | b\n1 | x\n5 | y\n9 | x")
+    res = pw.sql(
+        "SELECT b, CASE WHEN SUM(a) > 5 THEN 'hot' ELSE 'cold' END AS tag"
+        " FROM t GROUP BY b",
+        t=t,
+    )
+    assert sorted(rows(res)) == [("x", "hot"), ("y", "cold")]
+
+
+def test_if_function():
+    t = pw.debug.table_from_markdown("a\n1\n9")
+    res = pw.sql("SELECT IF(a > 4, 'big', 'small') AS s FROM t", t=t)
+    assert sorted(r[0] for r in rows(res)) == ["big", "small"]
+
+
+def test_nullif_function():
+    t = pw.debug.table_from_markdown("a\n1\n5")
+    res = pw.sql("SELECT NULLIF(a, 1) AS n FROM t", t=t)
+    assert sorted(
+        (r[0] for r in rows(res)), key=lambda v: (v is not None, v or 0)
+    ) == [None, 5]
+
+
+def test_case_requires_when():
+    t = pw.debug.table_from_markdown("a\n1")
+    with pytest.raises(Exception, match="WHEN|unexpected token"):
+        pw.sql("SELECT CASE ELSE 1 END AS x FROM t", t=t)
+    with pytest.raises(Exception, match="WHEN"):
+        pw.sql("SELECT CASE a END AS x FROM t", t=t)
