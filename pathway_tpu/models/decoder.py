@@ -216,10 +216,32 @@ def _attend(q, k, v, mask, cfg: DecoderConfig):
     return ctx.reshape(B, S, NH * D)
 
 
+def decoder_layer(lp, x, positions, mask, cfg: DecoderConfig):
+    """One pre-norm transformer block (GQA attention + SwiGLU MLP).
+
+    ``lp`` holds a single layer's weights (no leading layer axis).
+    Returns ``(x, (k, v))`` — the new residual stream and this layer's
+    key/value projections ``[B, S, KH, D]``.  Shared by the scanned trunk
+    below and the pipeline-parallel stage runner
+    (``parallel/pipeline.py``), so both paths compute identical math.
+    """
+    B, S = x.shape[0], x.shape[1]
+    KH, D = cfg.kv_heads, cfg.head_dim
+    h = _rms(x, lp["ln0"], cfg.norm_eps)
+    q = (h @ lp["wq"]).reshape(B, S, cfg.heads, D)
+    k = (h @ lp["wk"]).reshape(B, S, KH, D)
+    v = (h @ lp["wv"]).reshape(B, S, KH, D)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    x = x + _attend(q, k, v, mask, cfg) @ lp["wo"]
+    h = _rms(x, lp["ln1"], cfg.norm_eps)
+    x = x + (jax.nn.silu(h @ lp["wg"]) * (h @ lp["wu"])) @ lp["wd"]
+    return x, (k, v)
+
+
 def _causal_trunk(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
     """Shared causal forward: final-norm token reps + K/V caches."""
     B, S = ids.shape
-    KH, D = cfg.kv_heads, cfg.head_dim
     x = tree["embed"][ids]  # [B, S, H]
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
     valid = positions < lengths[:, None]  # [B, S]
@@ -227,15 +249,7 @@ def _causal_trunk(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
     mask = causal[None, :, :] & valid[:, None, :]  # [B, S(q), S(kv)]
 
     def layer(x, lp):
-        h = _rms(x, lp["ln0"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(B, S, cfg.heads, D)
-        k = (h @ lp["wk"]).reshape(B, S, KH, D)
-        v = (h @ lp["wv"]).reshape(B, S, KH, D)
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
-        x = x + _attend(q, k, v, mask, cfg) @ lp["wo"]
-        h = _rms(x, lp["ln1"], cfg.norm_eps)
-        x = x + (jax.nn.silu(h @ lp["wg"]) * (h @ lp["wu"])) @ lp["wd"]
+        x, (k, v) = decoder_layer(lp, x, positions, mask, cfg)
         # zero K/V beyond each row's real length: decode_step scatters new
         # entries additively, which requires untouched slots to hold zeros
         keep = valid[:, :, None, None].astype(k.dtype)

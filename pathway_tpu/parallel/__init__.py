@@ -19,6 +19,10 @@ Mesh convention: 2-D ``("data", "model")``. Batch/data parallelism rides
 the ``data`` axis; tensor parallelism of encoder weights rides ``model``;
 the document index is sharded over the *flattened* mesh (every chip holds
 one slice of the corpus — the analog of the reference's key-shard space).
+Further axes for the decoder family: ``("stage",)`` pipeline meshes
+(``pipeline.py``, GPipe over ``ppermute``), ``("data", "expert")`` MoE
+meshes (``moe.py``, GShard dispatch lowering to ``all_to_all``), and the
+sequence-parallel ring (``ring_attention.py``).
 """
 
 from __future__ import annotations
@@ -45,6 +49,21 @@ from pathway_tpu.parallel.train import (
 )
 from pathway_tpu.parallel.index import ShardedDeviceIndex, sharded_topk
 from pathway_tpu.parallel.ring_attention import ring_encoder_attention
+from pathway_tpu.parallel.moe import (
+    MoEConfig,
+    ep_param_specs,
+    init_moe_params,
+    make_ep_mesh,
+    make_moe_train_step,
+    moe_ffn,
+)
+from pathway_tpu.parallel.pipeline import (
+    make_pipelined_causal_lm,
+    make_pp_mesh,
+    make_pp_train_step,
+    place_pp_params,
+    pp_param_specs,
+)
 
 __all__ = [
     "initialize_distributed",
@@ -64,4 +83,15 @@ __all__ = [
     "ShardedDeviceIndex",
     "sharded_topk",
     "ring_encoder_attention",
+    "MoEConfig",
+    "init_moe_params",
+    "ep_param_specs",
+    "make_ep_mesh",
+    "make_moe_train_step",
+    "moe_ffn",
+    "make_pp_mesh",
+    "pp_param_specs",
+    "place_pp_params",
+    "make_pipelined_causal_lm",
+    "make_pp_train_step",
 ]

@@ -1,0 +1,218 @@
+"""Mixture-of-Experts layer with expert parallelism (the ``expert`` mesh axis).
+
+The reference serves dense Mistral-class chat models through a host torch
+pipeline (``xpacks/llm/llms.py:314``); the MoE siblings of that family
+(Mixtral-class) are out of its reach on one GPU.  On TPU they are the
+natural scale-out: expert FFN weights shard over an ``expert`` mesh axis,
+tokens route to experts through the GShard einsum formulation — dispatch
+and combine are dense one-hot contractions, so XLA lowers the token
+exchange to ``all_to_all`` over ICI from the sharding annotations alone
+(no hand-written collectives, per the scaling-book recipe).
+
+Design points, all MXU/XLA-motivated:
+
+* **Static capacity.**  Each expert processes a fixed ``capacity`` of
+  token slots per batch; overflow tokens are dropped from that expert
+  (their residual stream passes through unchanged).  Static shapes keep
+  the whole layer one compiled program — no data-dependent reshapes.
+* **Top-k routing with renormalised gates** (k=2 default, the
+  Mixtral/GShard setting): the combine weights of the selected experts
+  are renormalised to sum to 1, so with identical experts the layer
+  degenerates exactly to the dense FFN (pinned by tests).
+* **Load-balance auxiliary loss** (Switch-Transformer form):
+  ``E * Σ_e f_e · P_e`` where ``f_e`` is the fraction of tokens whose
+  top-1 choice is ``e`` and ``P_e`` the mean router probability — keeps
+  routing from collapsing onto one chip's experts.
+* **Router in f32.**  Routing decisions are taken in f32 regardless of
+  the activation dtype (bf16 softmax ties break non-deterministically
+  across backends).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    hidden: int
+    experts: int
+    intermediate: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+
+    def capacity(self, n_tokens: int) -> int:
+        """Static per-expert token slots for a ``n_tokens`` batch."""
+        return max(
+            self.top_k,
+            int(math.ceil(self.capacity_factor * self.top_k * n_tokens / self.experts)),
+        )
+
+
+def init_moe_params(cfg: MoEConfig, seed: int = 0):
+    """Scaled-normal init; expert weights stacked on a leading [E, ...] axis."""
+    E, H, F = cfg.experts, cfg.hidden, cfg.intermediate
+    keys = jax.random.split(jax.random.PRNGKey(seed), 4)
+
+    def norm_init(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)).astype(
+            cfg.dtype
+        )
+
+    return {
+        # routing is f32 end-to-end: init directly in f32, never rounded
+        # through cfg.dtype
+        "router": jax.random.normal(keys[0], (H, E), jnp.float32) / np.sqrt(H),
+        "wg": norm_init(keys[1], (E, H, F), H),
+        "wu": norm_init(keys[2], (E, H, F), H),
+        "wd": norm_init(keys[3], (E, F, H), F),
+    }
+
+
+def ep_param_specs(axis: str = "expert"):
+    """Expert-parallel PartitionSpecs: each chip owns ``E / |axis|`` experts'
+    FFN weights; the router (tiny) is replicated."""
+    return {
+        "router": P(None, None),
+        "wg": P(axis, None, None),
+        "wu": P(axis, None, None),
+        "wd": P(axis, None, None),
+    }
+
+
+def _routing(router_logits: jnp.ndarray, cfg: MoEConfig, capacity: int):
+    """Top-k dispatch/combine tensors from router logits ``[T, E]`` (f32).
+
+    Returns ``(dispatch [T,E,C] bool-ish, combine [T,E,C] f32, aux f32)``.
+    Buffer positions are assigned rank-major (every token's first choice
+    beats any token's second choice), token-major within a rank — the
+    GShard priority order, so capacity overflow drops second opinions
+    first.
+    """
+    T, E = router_logits.shape
+    K = cfg.top_k
+    probs = jax.nn.softmax(router_logits, axis=-1)  # [T, E] f32
+    gate_k, idx_k = jax.lax.top_k(probs, K)  # [T, K]
+    gate_k = gate_k / jnp.maximum(gate_k.sum(-1, keepdims=True), 1e-9)
+
+    sel = jax.nn.one_hot(idx_k.T, E, dtype=jnp.float32)  # [K, T, E]
+    flat = sel.reshape(K * T, E)
+    pos = jnp.cumsum(flat, axis=0) - flat  # buffer slot per (rank, token)
+    keep = (pos < capacity).astype(jnp.float32) * flat  # dropped past capacity
+    cap_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+    disp_flat = keep[..., None] * cap_oh  # [K*T, E, C]
+    gates_flat = gate_k.T.reshape(K * T)
+    dispatch = disp_flat.reshape(K, T, E, capacity).sum(0)
+    combine = (disp_flat * gates_flat[:, None, None]).reshape(
+        K, T, E, capacity
+    ).sum(0)
+
+    # Switch load-balance loss over top-1 assignment
+    top1 = jax.nn.one_hot(idx_k[:, 0], E, dtype=jnp.float32)
+    frac_tokens = top1.mean(0)
+    frac_probs = probs.mean(0)
+    aux = E * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+def moe_ffn(params, x: jnp.ndarray, cfg: MoEConfig, mesh: Mesh | None = None):
+    """MoE feed-forward over tokens ``x [..., H]`` → ``(y [..., H], aux)``.
+
+    Pure function of sharded inputs: under ``jit`` with ``ep_param_specs``
+    placements, the ``tec,th->ech`` dispatch einsum (token-sharded ×
+    expert-sharded) lowers to an ``all_to_all`` over the ``expert`` axis,
+    and the combine einsum to its inverse.  ``mesh`` adds explicit
+    sharding constraints on the expert-major intermediates so the
+    placement is pinned rather than inferred.
+    """
+    orig_shape = x.shape
+    H = orig_shape[-1]
+    xt = x.reshape(-1, H)
+    T = xt.shape[0]
+    C = cfg.capacity(T)
+    router_logits = xt.astype(jnp.float32) @ params["router"]  # [T, E] f32
+    dispatch, combine, aux = _routing(router_logits, cfg, C)
+    dispatch = dispatch.astype(cfg.dtype)
+
+    expert_in = jnp.einsum("tec,th->ech", dispatch, xt.astype(cfg.dtype))
+    if mesh is not None and "expert" in mesh.axis_names:
+        expert_in = jax.lax.with_sharding_constraint(
+            expert_in, NamedSharding(mesh, P("expert", None, None))
+        )
+    h = jax.nn.silu(jnp.einsum("ech,ehf->ecf", expert_in, params["wg"]))
+    h = h * jnp.einsum("ech,ehf->ecf", expert_in, params["wu"])
+    expert_out = jnp.einsum("ecf,efh->ech", h, params["wd"])
+    if mesh is not None and "expert" in mesh.axis_names:
+        expert_out = jax.lax.with_sharding_constraint(
+            expert_out, NamedSharding(mesh, P("expert", None, None))
+        )
+    y = jnp.einsum("tec,ech->th", combine.astype(cfg.dtype), expert_out)
+    return y.reshape(orig_shape).astype(x.dtype), aux
+
+
+def make_ep_mesh(n_devices: int, expert_parallel: int | None = None) -> Mesh:
+    """A ``("data", "expert")`` mesh: expert axis as large as divides both
+    the device count and nothing else — callers pass ``expert_parallel``
+    to pin it (defaults to all devices on the expert axis)."""
+    devices = jax.devices()[:n_devices]
+    ep = expert_parallel or len(devices)
+    assert len(devices) % ep == 0, (len(devices), ep)
+    grid = np.asarray(devices).reshape(len(devices) // ep, ep)
+    return Mesh(grid, ("data", "expert"))
+
+
+def make_moe_train_step(
+    cfg: MoEConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    aux_weight: float = 0.01,
+) -> tuple[Callable, Callable]:
+    """Expert-parallel training: tokens sharded over ``data``, expert
+    weights over ``expert``; the objective is denoising regression (fit
+    the layer to a fixed random target map), enough to drive gradients
+    through routing, dispatch and both collectives.
+
+    Returns ``(init_fn, step_fn)`` where ``step_fn(params, opt_state, x,
+    target) -> (params, opt_state, loss)`` is jitted SPMD.
+    """
+    from pathway_tpu.parallel.mesh import put_global
+
+    specs = ep_param_specs()
+
+    def init_fn(seed: int = 0):
+        params = init_moe_params(cfg, seed)
+        params = jax.tree_util.tree_map(
+            lambda t, s: jax.device_put(t, NamedSharding(mesh, s)), params, specs
+        )
+        return params, optimizer.init(params)
+
+    def loss_fn(params, x, target):
+        y, aux = moe_ffn(params, x, cfg, mesh)
+        mse = jnp.mean(jnp.square(y.astype(jnp.float32) - target.astype(jnp.float32)))
+        return mse + aux_weight * aux
+
+    @jax.jit
+    def _step(params, opt_state, x, target):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, target)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    data_sharding = NamedSharding(mesh, P("data"))
+
+    def step_fn(params, opt_state, x, target):
+        x = put_global(np.asarray(x), data_sharding)
+        target = put_global(np.asarray(target), data_sharding)
+        return _step(params, opt_state, x, target)
+
+    return init_fn, step_fn

@@ -7,10 +7,11 @@ the framework's full distributed train step: data-parallel batch over the
 ``data`` axis, tensor-parallel encoder weights over ``model``, gradients
 psum-reduced by XLA from the sharding annotations alone.
 
-(The reference has no model training at all — SURVEY.md §2b — so pipeline
-and expert parallelism have no workload here; dp×tp plus the corpus-sharded
-index in ``parallel/index.py`` covers every axis this framework computes
-over.)
+(The reference has no model training at all — SURVEY.md §2b.  Beyond the
+dp×tp step here, ``parallel/pipeline.py`` adds the GPipe stage axis and
+``parallel/moe.py`` the expert axis; together with the sequence-parallel
+ring (``ring_attention.py``) and the corpus-sharded index
+(``index.py``) the framework computes over all five dp/tp/pp/sp/ep axes.)
 """
 
 from __future__ import annotations
@@ -96,6 +97,18 @@ def make_contrastive_train_step(
     return run
 
 
+def masked_next_token_loss(logits, ids, lengths):
+    """Length-masked next-token NLL shared by the dp×tp and pipeline-parallel
+    causal-LM train steps (``parallel/pipeline.py``) — one definition so the
+    two paths cannot drift."""
+    targets = ids[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1, :], axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    pos = jnp.arange(ids.shape[1] - 1)[None, :]
+    m = (pos < (lengths - 1)[:, None]).astype(jnp.float32)
+    return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
 def make_causal_lm_train_step(
     cfg,
     optimizer: optax.GradientTransformation,
@@ -126,12 +139,7 @@ def make_causal_lm_train_step(
 
     def loss_fn(tree, ids, lengths):
         logits = causal_lm_logits(tree, ids, lengths, cfg)  # [B, S, V] f32
-        targets = ids[:, 1:]
-        logp = jax.nn.log_softmax(logits[:, :-1, :], axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        pos = jnp.arange(ids.shape[1] - 1)[None, :]
-        m = (pos < (lengths - 1)[:, None]).astype(jnp.float32)
-        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+        return masked_next_token_loss(logits, ids, lengths)
 
     @jax.jit
     def step(params, opt_state, ids, lengths):

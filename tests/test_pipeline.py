@@ -1,0 +1,87 @@
+"""Pipeline parallelism (parallel/pipeline.py).
+
+The GPipe schedule must be a pure re-ordering of the computation: the
+pipelined forward matches ``causal_lm_logits`` exactly in f32, the
+pipelined train loss at init matches the single-device loss, and training
+through the schedule reduces it.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from pathway_tpu.models.decoder import (
+    DecoderConfig,
+    causal_lm_logits,
+    init_decoder_params,
+)
+from pathway_tpu.parallel.pipeline import (
+    make_pipelined_causal_lm,
+    make_pp_mesh,
+    make_pp_train_step,
+    place_pp_params,
+)
+
+CFG = DecoderConfig(
+    vocab_size=128, hidden=32, layers=4, heads=4, kv_heads=2,
+    intermediate=64, max_len=64, dtype=jnp.float32,
+)
+
+
+def _batch(rng, b=8, s=12):
+    ids = rng.integers(1, CFG.vocab_size, size=(b, s)).astype(np.int32)
+    lengths = rng.integers(s // 2, s + 1, size=(b,)).astype(np.int32)
+    return jnp.asarray(ids), jnp.asarray(lengths)
+
+
+def test_pipelined_forward_matches_reference_trunk():
+    mesh = make_pp_mesh(4)
+    tree = init_decoder_params(CFG, seed=0)
+    pp_tree = place_pp_params(tree, mesh)
+    ids, lengths = _batch(np.random.default_rng(0))
+    want = causal_lm_logits(tree, ids, lengths, CFG)
+    fwd = make_pipelined_causal_lm(CFG, mesh, n_micro=4)
+    got = jax.jit(fwd)(pp_tree, ids, lengths)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_pipelined_forward_single_microbatch_degenerates():
+    # n_micro=1: pure model parallelism (one bubble-free-ish pass)
+    mesh = make_pp_mesh(2)
+    tree = init_decoder_params(CFG, seed=1)
+    pp_tree = place_pp_params(tree, mesh)
+    ids, lengths = _batch(np.random.default_rng(1), b=3, s=9)
+    want = causal_lm_logits(tree, ids, lengths, CFG)
+    got = jax.jit(make_pipelined_causal_lm(CFG, mesh, n_micro=1))(
+        pp_tree, ids, lengths
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_pp_train_step_matches_and_learns():
+    from pathway_tpu.parallel.train import make_causal_lm_train_step
+    from pathway_tpu.parallel.mesh import make_mesh
+
+    mesh = make_pp_mesh(4)
+    init_state, run = make_pp_train_step(CFG, optax.adam(1e-2), mesh, n_micro=2)
+    state = init_state(seed=0)
+    rng = np.random.default_rng(2)
+    ids, lengths = _batch(rng)
+
+    # reference loss at the same init on the plain dp×tp step
+    ref_init, ref_run = make_causal_lm_train_step(CFG, optax.adam(1e-2), make_mesh(1))
+    ref_state = ref_init(seed=0)
+    _, ref_loss = ref_run(ref_state, np.asarray(ids), np.asarray(lengths))
+
+    losses = []
+    for _ in range(8):
+        state, loss = run(state, ids, lengths)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses[0], float(ref_loss), rtol=1e-4)
+    assert losses[-1] < losses[0], losses
+    assert state.step == 8
