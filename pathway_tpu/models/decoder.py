@@ -65,6 +65,12 @@ class DecoderConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # experts > 0 switches the MLP to Mixtral-style sparse MoE: per-layer
+    # router + stacked expert SwiGLU weights, dispatched by the GShard
+    # machinery in parallel/moe.py (expert axis shardable over the mesh)
+    experts: int = 0
+    experts_top_k: int = 2
+    expert_capacity_factor: float = 2.0
 
     @property
     def head_dim(self) -> int:
@@ -78,10 +84,20 @@ PRESETS: dict[str, DecoderConfig] = {
         hidden=2048, layers=22, heads=32, kv_heads=4, intermediate=5632,
         max_len=2048,
     ),
+    # the MoE sibling of the Mistral family the reference's Adaptive RAG
+    # template serves (block-sparse FFN, 8 experts, top-2 routing)
+    "mixtral-8x7b-instruct": DecoderConfig(
+        rope_theta=1e6, experts=8, experts_top_k=2, max_len=8192,
+    ),
     # tiny deterministic shape for tests: f32 so CPU numerics are exact
     "pw-tiny-decoder": DecoderConfig(
         vocab_size=512, hidden=64, layers=2, heads=4, kv_heads=2,
         intermediate=128, max_len=128, dtype=jnp.float32,
+    ),
+    "pw-tiny-moe-decoder": DecoderConfig(
+        vocab_size=512, hidden=64, layers=2, heads=4, kv_heads=2,
+        intermediate=128, max_len=128, dtype=jnp.float32,
+        experts=4, experts_top_k=2,
     ),
 }
 
@@ -108,6 +124,8 @@ def decoder_config_for(model_name: str) -> DecoderConfig:
             max_len=min(hf.get("max_position_embeddings", 4096), 8192),
             rope_theta=float(hf.get("rope_theta", 10000.0)),
             norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+            experts=hf.get("num_local_experts", 0),
+            experts_top_k=hf.get("num_experts_per_tok", 2),
         )
     # an unknown name would otherwise build (and compile) a random 7B —
     # fail loudly instead, a typo should not cost 14 GB and minutes
@@ -123,53 +141,95 @@ def decoder_config_for(model_name: str) -> DecoderConfig:
 
 
 def init_decoder_params(cfg: DecoderConfig, seed: int = 0):
-    """Deterministic scaled-normal init of the stacked param tree."""
+    """Deterministic scaled-normal init of the stacked param tree.
+
+    With ``cfg.experts > 0`` the MLP weights carry an extra expert axis
+    (``[L, E, H, F]``) plus a per-layer f32 router ``[L, H, E]``.
+    """
     H, L, F = cfg.hidden, cfg.layers, cfg.intermediate
     NH, KH, D = cfg.heads, cfg.kv_heads, cfg.head_dim
-    keys = jax.random.split(jax.random.PRNGKey(seed), 10)
+    keys = jax.random.split(jax.random.PRNGKey(seed), 11)
 
     def norm_init(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)).astype(
             cfg.dtype
         )
 
+    layers = {
+        "ln0": jnp.ones((L, H), cfg.dtype),
+        "ln1": jnp.ones((L, H), cfg.dtype),
+        "wq": norm_init(keys[2], (L, H, NH * D), H),
+        "wk": norm_init(keys[3], (L, H, KH * D), H),
+        "wv": norm_init(keys[4], (L, H, KH * D), H),
+        "wo": norm_init(keys[5], (L, NH * D, H), NH * D),
+    }
+    if cfg.experts:
+        E = cfg.experts
+        layers.update(
+            {
+                # router stays f32 (routing decisions are f32 end-to-end)
+                "moe_router": jax.random.normal(keys[9], (L, H, E), jnp.float32)
+                / np.sqrt(H),
+                "wg": norm_init(keys[6], (L, E, H, F), H),
+                "wu": norm_init(keys[7], (L, E, H, F), H),
+                "wd": norm_init(keys[8], (L, E, F, H), F),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "wg": norm_init(keys[6], (L, H, F), H),
+                "wu": norm_init(keys[7], (L, H, F), H),
+                "wd": norm_init(keys[8], (L, F, H), F),
+            }
+        )
     return {
         "embed": norm_init(keys[0], (cfg.vocab_size, H), H),
         "final_norm": jnp.ones((H,), cfg.dtype),
         "lm_head": norm_init(keys[1], (H, cfg.vocab_size), H),
-        "layers": {
-            "ln0": jnp.ones((L, H), cfg.dtype),
-            "ln1": jnp.ones((L, H), cfg.dtype),
-            "wq": norm_init(keys[2], (L, H, NH * D), H),
-            "wk": norm_init(keys[3], (L, H, KH * D), H),
-            "wv": norm_init(keys[4], (L, H, KH * D), H),
-            "wo": norm_init(keys[5], (L, NH * D, H), NH * D),
-            "wg": norm_init(keys[6], (L, H, F), H),
-            "wu": norm_init(keys[7], (L, H, F), H),
-            "wd": norm_init(keys[8], (L, F, H), F),
-        },
+        "layers": layers,
     }
 
 
 def tp_param_specs(cfg: DecoderConfig, axis: str = "model"):
     """Tensor-parallel PartitionSpecs: attention heads and FFN width sharded
     over ``axis``; contractions back to hidden leave XLA one all-reduce per
-    block (the Megatron layout, expressed as shardings not collectives)."""
+    block (the Megatron layout, expressed as shardings not collectives).
+
+    MoE configs shard the EXPERT axis over ``axis`` instead of the FFN
+    width — each chip owns ``E / |axis|`` whole experts and the GShard
+    dispatch/combine einsums lower to ``all_to_all`` (expert parallelism
+    in serving)."""
+    layer_specs = {
+        "ln0": P(None, None),
+        "ln1": P(None, None),
+        "wq": P(None, None, axis),
+        "wk": P(None, None, axis),
+        "wv": P(None, None, axis),
+        "wo": P(None, axis, None),
+    }
+    if cfg.experts:
+        layer_specs.update(
+            {
+                "moe_router": P(None, None, None),
+                "wg": P(None, axis, None, None),
+                "wu": P(None, axis, None, None),
+                "wd": P(None, axis, None, None),
+            }
+        )
+    else:
+        layer_specs.update(
+            {
+                "wg": P(None, None, axis),
+                "wu": P(None, None, axis),
+                "wd": P(None, axis, None),
+            }
+        )
     return {
         "embed": P(None, None),
         "final_norm": P(None),
         "lm_head": P(None, axis),
-        "layers": {
-            "ln0": P(None, None),
-            "ln1": P(None, None),
-            "wq": P(None, None, axis),
-            "wk": P(None, None, axis),
-            "wv": P(None, None, axis),
-            "wo": P(None, axis, None),
-            "wg": P(None, None, axis),
-            "wu": P(None, None, axis),
-            "wd": P(None, axis, None),
-        },
+        "layers": layer_specs,
     }
 
 
@@ -216,14 +276,44 @@ def _attend(q, k, v, mask, cfg: DecoderConfig):
     return ctx.reshape(B, S, NH * D)
 
 
+def _ffn(lp, h, cfg: DecoderConfig, *, full_capacity: bool = False):
+    """SwiGLU MLP — dense, or Mixtral-style sparse MoE when
+    ``cfg.experts > 0`` (GShard dispatch from ``parallel/moe.py``; the
+    expert axis of ``wg/wu/wd`` is shardable over a mesh axis, see
+    ``tp_param_specs``).  Returns ``(out, aux)`` with the load-balance
+    auxiliary loss (0 for dense).  ``full_capacity`` selects the lossless
+    dispatch the single-token decode path needs (capacity drops there
+    would silently degrade generations)."""
+    if cfg.experts:
+        from pathway_tpu.parallel.moe import MoEConfig, moe_ffn
+
+        mcfg = MoEConfig(
+            hidden=cfg.hidden,
+            experts=cfg.experts,
+            intermediate=cfg.intermediate,
+            top_k=cfg.experts_top_k,
+            capacity_factor=cfg.expert_capacity_factor,
+            dtype=cfg.dtype,
+        )
+        params = {
+            "router": lp["moe_router"],
+            "wg": lp["wg"],
+            "wu": lp["wu"],
+            "wd": lp["wd"],
+        }
+        return moe_ffn(params, h, mcfg, full_capacity=full_capacity)
+    return (jax.nn.silu(h @ lp["wg"]) * (h @ lp["wu"])) @ lp["wd"], jnp.float32(0.0)
+
+
 def decoder_layer(lp, x, positions, mask, cfg: DecoderConfig):
-    """One pre-norm transformer block (GQA attention + SwiGLU MLP).
+    """One pre-norm transformer block (GQA attention + SwiGLU/MoE MLP).
 
     ``lp`` holds a single layer's weights (no leading layer axis).
-    Returns ``(x, (k, v))`` — the new residual stream and this layer's
-    key/value projections ``[B, S, KH, D]``.  Shared by the scanned trunk
-    below and the pipeline-parallel stage runner
-    (``parallel/pipeline.py``), so both paths compute identical math.
+    Returns ``(x, (k, v), aux)`` — the new residual stream, this layer's
+    key/value projections ``[B, S, KH, D]``, and the MoE load-balance aux
+    loss (0 for dense).  Shared by the scanned trunk below and the
+    pipeline-parallel stage runner (``parallel/pipeline.py``), so both
+    paths compute identical math.
     """
     B, S = x.shape[0], x.shape[1]
     KH, D = cfg.kv_heads, cfg.head_dim
@@ -235,8 +325,9 @@ def decoder_layer(lp, x, positions, mask, cfg: DecoderConfig):
     k = _rope(k, positions, cfg.rope_theta)
     x = x + _attend(q, k, v, mask, cfg) @ lp["wo"]
     h = _rms(x, lp["ln1"], cfg.norm_eps)
-    x = x + (jax.nn.silu(h @ lp["wg"]) * (h @ lp["wu"])) @ lp["wd"]
-    return x, (k, v)
+    mlp, aux = _ffn(lp, h, cfg)
+    x = x + mlp
+    return x, (k, v), aux
 
 
 def _causal_trunk(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
@@ -249,16 +340,16 @@ def _causal_trunk(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
     mask = causal[None, :, :] & valid[:, None, :]  # [B, S(q), S(kv)]
 
     def layer(x, lp):
-        x, (k, v) = decoder_layer(lp, x, positions, mask, cfg)
+        x, (k, v), aux = decoder_layer(lp, x, positions, mask, cfg)
         # zero K/V beyond each row's real length: decode_step scatters new
         # entries additively, which requires untouched slots to hold zeros
         keep = valid[:, :, None, None].astype(k.dtype)
         pad = ((0, 0), (0, cache_len - S), (0, 0), (0, 0))
-        return x, (jnp.pad(k * keep, pad), jnp.pad(v * keep, pad))
+        return x, (jnp.pad(k * keep, pad), jnp.pad(v * keep, pad), aux)
 
-    x, (k_cache, v_cache) = lax.scan(layer, x, tree["layers"])
+    x, (k_cache, v_cache, auxs) = lax.scan(layer, x, tree["layers"])
     x = _rms(x, tree["final_norm"], cfg.norm_eps)
-    return x, k_cache, v_cache
+    return x, k_cache, v_cache, auxs.sum()
 
 
 def prefill(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
@@ -268,7 +359,7 @@ def prefill(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
     real token and caches of shape ``[L, B, cache_len, KH, D]`` with the
     prompt keys/values written at positions ``[0, S)``.
     """
-    x, k_cache, v_cache = _causal_trunk(tree, ids, lengths, cfg, cache_len)
+    x, k_cache, v_cache, _ = _causal_trunk(tree, ids, lengths, cfg, cache_len)
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].repeat(cfg.hidden, 2), axis=1
     )[:, 0, :]
@@ -282,9 +373,16 @@ def causal_lm_logits(tree, ids, lengths, cfg: DecoderConfig):
     The unused K/V scan outputs are dead code under ``jax.grad``/``jit`` —
     XLA eliminates them, so training pays no cache-materialization cost.
     """
+    return causal_lm_logits_and_aux(tree, ids, lengths, cfg)[0]
+
+
+def causal_lm_logits_and_aux(tree, ids, lengths, cfg: DecoderConfig):
+    """``(logits [B, S, vocab] f32, aux)`` — aux is the summed MoE
+    load-balance loss over layers (0 for dense configs); MoE training
+    adds it to the LM loss so routing stays spread over experts."""
     S = ids.shape[1]
-    x, _, _ = _causal_trunk(tree, ids, lengths, cfg, S)
-    return (x @ tree["lm_head"]).astype(jnp.float32)
+    x, _, _, aux = _causal_trunk(tree, ids, lengths, cfg, S)
+    return (x @ tree["lm_head"]).astype(jnp.float32), aux
 
 
 def decode_step(tree, k_cache, v_cache, token, pos, cfg: DecoderConfig):
@@ -316,7 +414,8 @@ def decode_step(tree, k_cache, v_cache, token, pos, cfg: DecoderConfig):
         vc = vc + onehot[:, :, None, None] * v
         x = x + _attend(q, kc, vc, mask, cfg) @ lp["wo"]
         h = _rms(x, lp["ln1"], cfg.norm_eps)
-        x = x + (jax.nn.silu(h @ lp["wg"]) * (h @ lp["wu"])) @ lp["wd"]
+        mlp, _ = _ffn(lp, h, cfg, full_capacity=True)
+        x = x + mlp
         return x, (kc, vc)
 
     x, (k_cache, v_cache) = lax.scan(layer, x, (tree["layers"], k_cache, v_cache))
@@ -351,24 +450,61 @@ def load_hf_decoder_weights(model_name: str, cfg: DecoderConfig):
         arr = np.stack([m.T if transpose else m for m in mats])
         return jnp.asarray(arr, cfg.dtype)
 
+    layers = {
+        "ln0": stack("model.layers.{}.input_layernorm.weight", transpose=False),
+        "ln1": stack(
+            "model.layers.{}.post_attention_layernorm.weight", transpose=False
+        ),
+        "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+        "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+        "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+        "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+    }
+    if cfg.experts and "model.layers.0.block_sparse_moe.gate.weight" in sd:
+        # Mixtral block-sparse MoE: w1→wg (gate), w3→wu (up), w2→wd (down);
+        # torch Linear weights are [out, in], transposed into matmul layout
+        def stack_experts(wname, transpose=True):
+            per_layer = []
+            for i in range(cfg.layers):
+                mats = [
+                    sd[f"model.layers.{i}.block_sparse_moe.experts.{e}.{wname}.weight"]
+                    for e in range(cfg.experts)
+                ]
+                per_layer.append(np.stack([m.T if transpose else m for m in mats]))
+            return jnp.asarray(np.stack(per_layer), cfg.dtype)
+
+        layers.update(
+            {
+                "moe_router": jnp.asarray(
+                    np.stack(
+                        [
+                            sd[f"model.layers.{i}.block_sparse_moe.gate.weight"].T
+                            for i in range(cfg.layers)
+                        ]
+                    ),
+                    jnp.float32,
+                ),
+                "wg": stack_experts("w1"),
+                "wu": stack_experts("w3"),
+                "wd": stack_experts("w2"),
+            }
+        )
+    elif cfg.experts:
+        return None  # MoE config but a dense checkpoint on disk
+    else:
+        layers.update(
+            {
+                "wg": stack("model.layers.{}.mlp.gate_proj.weight"),
+                "wu": stack("model.layers.{}.mlp.up_proj.weight"),
+                "wd": stack("model.layers.{}.mlp.down_proj.weight"),
+            }
+        )
     lm_head = sd.get("lm_head.weight", sd["model.embed_tokens.weight"])
     return {
         "embed": jnp.asarray(sd["model.embed_tokens.weight"], cfg.dtype),
         "final_norm": jnp.asarray(sd["model.norm.weight"], cfg.dtype),
         "lm_head": jnp.asarray(lm_head.T, cfg.dtype),
-        "layers": {
-            "ln0": stack("model.layers.{}.input_layernorm.weight", transpose=False),
-            "ln1": stack(
-                "model.layers.{}.post_attention_layernorm.weight", transpose=False
-            ),
-            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
-            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
-            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
-            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
-            "wg": stack("model.layers.{}.mlp.gate_proj.weight"),
-            "wu": stack("model.layers.{}.mlp.up_proj.weight"),
-            "wd": stack("model.layers.{}.mlp.down_proj.weight"),
-        },
+        "layers": layers,
     }
 
 

@@ -48,10 +48,15 @@ class MoEConfig:
     intermediate: int
     top_k: int = 2
     capacity_factor: float = 1.25
+    # GShard group axis: tokens are chunked into groups of at most this
+    # many and dispatched group-locally, so the [G, Tg, E, C] dispatch
+    # tensor stays LINEAR in the total token count (C scales with Tg, not
+    # T).  0 disables grouping (one global group).
+    group_size: int = 4096
     dtype: Any = jnp.float32
 
     def capacity(self, n_tokens: int) -> int:
-        """Static per-expert token slots for a ``n_tokens`` batch."""
+        """Static per-expert token slots for an ``n_tokens`` group."""
         return max(
             self.top_k,
             int(math.ceil(self.capacity_factor * self.top_k * n_tokens / self.experts)),
@@ -89,14 +94,20 @@ def ep_param_specs(axis: str = "expert"):
     }
 
 
-def _routing(router_logits: jnp.ndarray, cfg: MoEConfig, capacity: int):
+def _routing(
+    router_logits: jnp.ndarray,
+    cfg: MoEConfig,
+    capacity: int,
+    valid: jnp.ndarray | None = None,
+):
     """Top-k dispatch/combine tensors from router logits ``[T, E]`` (f32).
 
     Returns ``(dispatch [T,E,C] bool-ish, combine [T,E,C] f32, aux f32)``.
     Buffer positions are assigned rank-major (every token's first choice
     beats any token's second choice), token-major within a rank — the
     GShard priority order, so capacity overflow drops second opinions
-    first.
+    first.  ``valid`` masks padding tokens out of dispatch, capacity
+    accounting, and the aux statistics.
     """
     T, E = router_logits.shape
     K = cfg.top_k
@@ -105,6 +116,8 @@ def _routing(router_logits: jnp.ndarray, cfg: MoEConfig, capacity: int):
     gate_k = gate_k / jnp.maximum(gate_k.sum(-1, keepdims=True), 1e-9)
 
     sel = jax.nn.one_hot(idx_k.T, E, dtype=jnp.float32)  # [K, T, E]
+    if valid is not None:
+        sel = sel * valid.astype(jnp.float32)[None, :, None]
     flat = sel.reshape(K * T, E)
     pos = jnp.cumsum(flat, axis=0) - flat  # buffer slot per (rank, token)
     keep = (pos < capacity).astype(jnp.float32) * flat  # dropped past capacity
@@ -116,46 +129,84 @@ def _routing(router_logits: jnp.ndarray, cfg: MoEConfig, capacity: int):
         K, T, E, capacity
     ).sum(0)
 
-    # Switch load-balance loss over top-1 assignment
+    # Switch load-balance loss over top-1 assignment (valid tokens only)
     top1 = jax.nn.one_hot(idx_k[:, 0], E, dtype=jnp.float32)
-    frac_tokens = top1.mean(0)
-    frac_probs = probs.mean(0)
+    if valid is not None:
+        v = valid.astype(jnp.float32)[:, None]
+        n = jnp.maximum(v.sum(), 1.0)
+        frac_tokens = (top1 * v).sum(0) / n
+        frac_probs = (probs * v).sum(0) / n
+    else:
+        frac_tokens = top1.mean(0)
+        frac_probs = probs.mean(0)
     aux = E * jnp.sum(frac_tokens * frac_probs)
     return dispatch, combine, aux
 
 
-def moe_ffn(params, x: jnp.ndarray, cfg: MoEConfig, mesh: Mesh | None = None):
+def moe_ffn(
+    params,
+    x: jnp.ndarray,
+    cfg: MoEConfig,
+    mesh: Mesh | None = None,
+    *,
+    full_capacity: bool = False,
+):
     """MoE feed-forward over tokens ``x [..., H]`` → ``(y [..., H], aux)``.
 
     Pure function of sharded inputs: under ``jit`` with ``ep_param_specs``
-    placements, the ``tec,th->ech`` dispatch einsum (token-sharded ×
+    placements, the ``gtec,gth->gech`` dispatch einsum (token-sharded ×
     expert-sharded) lowers to an ``all_to_all`` over the ``expert`` axis,
     and the combine einsum to its inverse.  ``mesh`` adds explicit
     sharding constraints on the expert-major intermediates so the
     placement is pinned rather than inferred.
+
+    Tokens beyond ``cfg.group_size`` are chunked into GShard groups and
+    dispatched group-locally (one ragged tail group padded and masked),
+    keeping dispatch memory linear in the token count.
+    ``full_capacity=True`` gives every token guaranteed slots
+    (``C = T``, single group) — the lossless setting the single-token
+    decode path uses, where capacity drops would silently degrade
+    generations (training keeps the capacity-factor drop policy, which
+    is what makes routing learnable under a static budget).
     """
     orig_shape = x.shape
     H = orig_shape[-1]
     xt = x.reshape(-1, H)
     T = xt.shape[0]
-    C = cfg.capacity(T)
-    router_logits = xt.astype(jnp.float32) @ params["router"]  # [T, E] f32
-    dispatch, combine, aux = _routing(router_logits, cfg, C)
+    if full_capacity or not cfg.group_size or T <= cfg.group_size:
+        G, Tg = 1, T
+    else:
+        G = -(-T // cfg.group_size)
+        Tg = cfg.group_size
+    pad = G * Tg - T
+    if pad:
+        xt = jnp.concatenate([xt, jnp.zeros((pad, H), xt.dtype)], axis=0)
+    C = Tg if full_capacity else cfg.capacity(Tg)
+    xg = xt.reshape(G, Tg, H)
+    router_logits = xg.astype(jnp.float32) @ params["router"]  # [G, Tg, E]
+    valid = (jnp.arange(G * Tg) < T).reshape(G, Tg)
+    dispatch, combine, aux_g = jax.vmap(
+        lambda lg, vg: _routing(lg, cfg, C, vg)
+    )(router_logits, valid)
+    # aux: weighted mean over groups by their real-token counts
+    w = valid.astype(jnp.float32).sum(axis=1)
+    aux = (aux_g * w).sum() / jnp.maximum(w.sum(), 1.0)
     dispatch = dispatch.astype(cfg.dtype)
 
-    expert_in = jnp.einsum("tec,th->ech", dispatch, xt.astype(cfg.dtype))
+    expert_in = jnp.einsum("gtec,gth->gech", dispatch, xg.astype(cfg.dtype))
     if mesh is not None and "expert" in mesh.axis_names:
         expert_in = jax.lax.with_sharding_constraint(
-            expert_in, NamedSharding(mesh, P("expert", None, None))
+            expert_in, NamedSharding(mesh, P(None, "expert", None, None))
         )
-    h = jax.nn.silu(jnp.einsum("ech,ehf->ecf", expert_in, params["wg"]))
-    h = h * jnp.einsum("ech,ehf->ecf", expert_in, params["wu"])
-    expert_out = jnp.einsum("ecf,efh->ech", h, params["wd"])
+    h = jax.nn.silu(jnp.einsum("gech,ehf->gecf", expert_in, params["wg"]))
+    h = h * jnp.einsum("gech,ehf->gecf", expert_in, params["wu"])
+    expert_out = jnp.einsum("gecf,efh->gech", h, params["wd"])
     if mesh is not None and "expert" in mesh.axis_names:
         expert_out = jax.lax.with_sharding_constraint(
-            expert_out, NamedSharding(mesh, P("expert", None, None))
+            expert_out, NamedSharding(mesh, P(None, "expert", None, None))
         )
-    y = jnp.einsum("tec,ech->th", combine.astype(cfg.dtype), expert_out)
+    y = jnp.einsum("gtec,gech->gth", combine.astype(cfg.dtype), expert_out)
+    y = y.reshape(G * Tg, H)[:T]
     return y.reshape(orig_shape).astype(x.dtype), aux
 
 

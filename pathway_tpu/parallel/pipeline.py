@@ -69,18 +69,15 @@ def stack_stages(tree, n_stages: int):
     }
 
 
-def pp_param_specs(axis: str = "stage"):
-    """PartitionSpecs for a stage-stacked tree: each chip holds its own
-    stage's layer rows; embed/norm/head replicated (computed off-pipeline)."""
-    layer_spec = {
-        name: P(axis)
-        for name in ("ln0", "ln1", "wq", "wk", "wv", "wo", "wg", "wu", "wd")
-    }
+def pp_param_specs(tree, axis: str = "stage"):
+    """PartitionSpecs matching a stage-stacked ``tree``: every layer leaf
+    (whatever its name — dense or MoE) shards its leading stage axis;
+    embed/norm/head replicated (computed off-pipeline)."""
     return {
         "embed": P(None, None),
         "final_norm": P(None),
         "lm_head": P(None, None),
-        "layers": layer_spec,
+        "layers": jax.tree_util.tree_map(lambda _: P(axis), tree["layers"]),
     }
 
 
@@ -88,7 +85,7 @@ def place_pp_params(tree, mesh: Mesh):
     """Stack ``tree`` by the mesh's stage count and shard it."""
     n_stages = mesh.shape["stage"]
     stacked = stack_stages(tree, n_stages)
-    specs = pp_param_specs()
+    specs = pp_param_specs(stacked)
     return jax.tree_util.tree_map(
         lambda t, s: jax.device_put(t, NamedSharding(mesh, s)), stacked, specs
     )
@@ -102,7 +99,7 @@ def _stage_forward(stage_layers, x, valid, cfg: DecoderConfig):
     mask = causal[None, :, :] & (valid > 0)[:, None, :]
 
     def body(x, lp):
-        x, _ = decoder_layer(lp, x, positions, mask, cfg)
+        x, _, _ = decoder_layer(lp, x, positions, mask, cfg)
         return x, None
 
     x, _ = lax.scan(body, x, stage_layers)
@@ -119,6 +116,13 @@ def make_pipelined_causal_lm(
     axis.  Matches ``causal_lm_logits`` within tight f32 tolerance (pinned
     by tests at 2e-4) — the schedule changes the execution order, not the
     math.
+
+    MoE configs pipeline too (each stage runs its layers' GShard dispatch
+    locally); note the MoE capacity group is then the *microbatch*, not
+    the whole batch, so capacity-drop behaviour matches the unpipelined
+    trunk only when capacity is ample (no drops).  The aux loss is not
+    collected — see ``make_pp_train_step`` for why pp MoE *training*
+    is rejected.
     """
     n_stages = mesh.shape["stage"]
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
@@ -163,7 +167,9 @@ def make_pipelined_causal_lm(
     trunk_sm = shard_map(
         trunk,
         mesh=mesh,
-        in_specs=(pp_param_specs()["layers"], P(None), P(None)),
+        # P("stage") is a tree prefix: every layer leaf (dense or MoE)
+        # shards its leading stage axis
+        in_specs=(P("stage"), P(None), P(None)),
         out_specs=P(None),
         check_vma=False,
     )
@@ -201,6 +207,15 @@ def make_pp_train_step(
     """
     from pathway_tpu.models.decoder import init_decoder_params
     from pathway_tpu.parallel.train import TrainState, masked_next_token_loss
+
+    if cfg.experts:
+        raise NotImplementedError(
+            "pipeline-parallel MoE training is not supported: the MoE "
+            "load-balance aux loss is not threaded through the GPipe "
+            "schedule (it would be silently dropped) — train MoE decoders "
+            "with make_causal_lm_train_step (dp×tp×ep) instead; the "
+            "pipelined FORWARD supports MoE configs"
+        )
 
     fwd = make_pipelined_causal_lm(cfg, mesh, n_micro)
 

@@ -113,6 +113,8 @@ def make_causal_lm_train_step(
     cfg,
     optimizer: optax.GradientTransformation,
     mesh: Mesh,
+    *,
+    moe_aux_weight: float = 0.01,
 ) -> tuple[Callable, Callable]:
     """Distributed next-token training for the decoder LLM family.
 
@@ -124,7 +126,7 @@ def make_causal_lm_train_step(
     XLA from the sharding annotations alone.
     """
     from pathway_tpu.models.decoder import (
-        causal_lm_logits,
+        causal_lm_logits_and_aux,
         init_decoder_params,
         tp_param_specs,
     )
@@ -138,8 +140,9 @@ def make_causal_lm_train_step(
         return TrainState(params=tree, opt_state=optimizer.init(tree))
 
     def loss_fn(tree, ids, lengths):
-        logits = causal_lm_logits(tree, ids, lengths, cfg)  # [B, S, V] f32
-        return masked_next_token_loss(logits, ids, lengths)
+        logits, aux = causal_lm_logits_and_aux(tree, ids, lengths, cfg)
+        # aux is exactly 0 for dense configs, so one code path serves both
+        return masked_next_token_loss(logits, ids, lengths) + moe_aux_weight * aux
 
     @jax.jit
     def step(params, opt_state, ids, lengths):

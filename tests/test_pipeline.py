@@ -63,6 +63,29 @@ def test_pipelined_forward_single_microbatch_degenerates():
     )
 
 
+def test_pipelined_moe_forward_matches_and_training_rejected():
+    import dataclasses
+    import pytest
+
+    # ample capacity so per-microbatch capacity groups drop nothing
+    moe_cfg = dataclasses.replace(
+        CFG, layers=2, experts=4, expert_capacity_factor=16.0
+    )
+    mesh = make_pp_mesh(2)
+    tree = init_decoder_params(moe_cfg, seed=5)
+    pp_tree = place_pp_params(tree, mesh)
+    ids, lengths = _batch(np.random.default_rng(5), b=4, s=8)
+    want = causal_lm_logits(tree, ids, lengths, moe_cfg)
+    got = jax.jit(make_pipelined_causal_lm(moe_cfg, mesh, n_micro=2))(
+        pp_tree, ids, lengths
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4
+    )
+    with pytest.raises(NotImplementedError, match="aux"):
+        make_pp_train_step(moe_cfg, optax.adam(1e-2), mesh, n_micro=2)
+
+
 def test_pp_train_step_matches_and_learns():
     from pathway_tpu.parallel.train import make_causal_lm_train_step
     from pathway_tpu.parallel.mesh import make_mesh
