@@ -238,57 +238,45 @@ def _extra_bge_mfu(peak: float) -> float:
 
 
 def _extra_retrieval_p50() -> dict:
-    """Top-k latency at the 625k-docs/chip north-star shard.
+    """Top-k DEVICE time at the 625k-docs/chip north-star shard.
 
-    Two numbers: per-call wall p50 (each call pays a full tunnel round
-    trip in this image — a pod-local host would not), and the per-query
-    DEVICE time from a device-resident dispatch chain synced by ONE
-    fetch, which is the number the <20 ms north-star budget is about.
+    The corpus matrix is generated ON DEVICE (bf16, the resident format):
+    the per-query device time of the jitted masked-top-k kernel is the
+    number the <20 ms north-star budget is about.  The public-path wall
+    latency — including the ~1 GB host→device corpus upload that used to
+    blow this extra's deadline through the dev tunnel, and the per-call
+    RTT — is attested separately by ``benchmarks/retrieval_latency.py``
+    (committed under ``benchmarks/attested/``).
     """
     import numpy as np
 
+    import jax
     import jax.numpy as jnp
 
     from pathway_tpu.ops import topk as topk_ops
 
-    rng = np.random.default_rng(0)
-    docs = rng.normal(size=(625_000, 384)).astype(np.float32)
-    queries = rng.normal(size=(64, 384)).astype(np.float32)
-    cache = topk_ops.DeviceIndexCache()
-    # wall p50 goes through the PUBLIC search path (includes the cache
-    # version check, query normalization, result fetch) — what a served
-    # query actually pays per call
-    topk_ops.topk_search_cached(docs, queries[:1], 10, "cos", cache=cache, version=1)
-    lat = []
-    for i in range(30):
-        t0 = time.perf_counter()
-        idx, _ = topk_ops.topk_search_cached(
-            docs, queries[i % 64][None, :], 10, "cos", cache=cache, version=1
-        )
-        np.asarray(idx)
-        lat.append((time.perf_counter() - t0) * 1000.0)
-    lat.sort()
-    p50_wall = lat[len(lat) // 2]
-    # device time per query: a device-resident chain of the underlying
-    # jitted kernel (same program the public path dispatches), ONE fetch
-    qn = queries / np.linalg.norm(queries, axis=1, keepdims=True)
-    device_matrix, mask, _n = cache.get(docs, 1, "cos")
+    # pad to the next power of two exactly like DeviceIndexCache does —
+    # an unpadded 625k (= 2^3·5^6) corpus would collapse the two-stage
+    # block top-k's block size and silently time the full-sort fallback
+    # instead of the kernel serving actually runs
+    n_docs, cap = 625_000, 1 << 20
+    key = jax.random.PRNGKey(0)
+    docs = jax.random.normal(key, (cap, 384), jnp.bfloat16)
+    mask = jnp.where(jnp.arange(cap) < n_docs, 0.0, -jnp.inf).astype(jnp.float32)
+    qs = jax.random.normal(jax.random.PRNGKey(1), (64, 384), jnp.float32)
+    qs = qs / jnp.linalg.norm(qs, axis=1, keepdims=True)
     kernel = topk_ops._masked_topk_jax
-    dev_qs = [jnp.asarray(qn[j][None, :]) for j in range(64)]
-    np.asarray(kernel(device_matrix, mask, dev_qs[0], "ip", 10)[0])  # warm
+    dev_qs = [qs[j][None, :] for j in range(64)]
+    np.asarray(kernel(docs, mask, dev_qs[0], "ip", 10)[0])  # warm + compile
     t0 = time.perf_counter()
-    outs = [kernel(device_matrix, mask, q, "ip", 10)[1] for q in dev_qs]
+    outs = [kernel(docs, mask, q, "ip", 10)[1] for q in dev_qs]
     np.asarray(jnp.concatenate(outs))  # one D2H sync for the whole chain
     device_ms = (time.perf_counter() - t0) * 1000.0 / len(dev_qs)
     print(
-        f"retrieval at 625k docs: wall p50 {p50_wall:.2f} ms, "
-        f"device {device_ms:.3f} ms/query",
+        f"retrieval at 625k docs: device {device_ms:.3f} ms/query",
         file=sys.stderr,
     )
-    return {
-        "wall_p50_ms": round(p50_wall, 3),
-        "device_ms_per_query": round(device_ms, 3),
-    }
+    return {"device_ms_per_query": round(device_ms, 3)}
 
 
 def _extra_profile_trace(fwd, params, ids, mask) -> str:

@@ -46,7 +46,7 @@ def main() -> None:
 
     from pathway_tpu.models.decoder import (
         DecoderLM,
-        decode_step,
+        decode_chunk,
         prefill,
     )
 
@@ -62,8 +62,14 @@ def main() -> None:
     ids = rng.integers(1, cfg.vocab_size, size=(batch, prompt_len)).astype(np.int32)
     lens = jnp.full((batch,), prompt_len, jnp.int32)
 
+    chunk_len = lm._chunk_len  # the bucket size generate_ids dispatches
+    assert steps % chunk_len == 0
     pre = jax.jit(lambda t, i, l: prefill(t, i, l, cfg, cache))
-    step = jax.jit(lambda t, kc, vc, tok, pos: decode_step(t, kc, vc, tok, pos, cfg))
+    chunk = jax.jit(
+        lambda t, kc, vc, lg, pos, done, key, temp: decode_chunk(
+            t, kc, vc, lg, pos, done, key, temp, cfg, chunk_len, True, None
+        )
+    )
 
     # warm both programs, then time prefill with a scalar-fetch sync
     logits, kc, vc = pre(lm.params, jnp.asarray(ids), lens)
@@ -75,22 +81,27 @@ def main() -> None:
         float(logits.sum())
     prefill_tok_s = batch * prompt_len * reps / (time.perf_counter() - t0)
 
-    # decode chain: token feedback stays on device, one sync at the end
-    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    pos = lens
-    l2, kc2, vc2 = step(lm.params, kc, vc, tok, pos)  # warm
-    float(l2.sum())
+    # decode: chunk_len-step decode_chunk programs with one host sync per
+    # chunk — exactly the dispatch pattern DecoderLM.generate_ids serves
+    # through (so per-chunk dispatch + sync costs are measured, not hidden)
+    done = jnp.zeros((batch,), bool)
+    key = jax.random.PRNGKey(0)
+    temp = jnp.float32(1.0)
+    toks, *_ = chunk(lm.params, kc, vc, logits, lens, done, key, temp)
+    np.asarray(toks)  # warm + sync
+    n_chunks = steps // chunk_len
+    lg, kc2, vc2, pos2, done2, key2 = logits, kc, vc, lens, done, key
+    total = 0
     t0 = time.perf_counter()
-    acc = None
-    for _ in range(steps):
-        logits, kc, vc = step(lm.params, kc, vc, tok, pos)
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        pos = pos + 1
-        s = logits.sum()
-        acc = s if acc is None else acc + s
-    assert np.isfinite(float(acc))
+    for _ in range(n_chunks):
+        toks, valids, lg, kc2, vc2, pos2, done2, key2 = chunk(
+            lm.params, kc2, vc2, lg, pos2, done2, key2, temp
+        )
+        np.asarray(toks), np.asarray(done2)  # per-chunk host sync
+        total += int(toks.shape[0])
     dt = time.perf_counter() - t0
-    decode_tok_s = batch * steps / dt
+    assert total == steps
+    decode_tok_s = batch * total / dt
 
     n_params = lm.n_params()
     print(

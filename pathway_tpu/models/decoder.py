@@ -305,7 +305,7 @@ def _ffn(lp, h, cfg: DecoderConfig, *, full_capacity: bool = False):
     return (jax.nn.silu(h @ lp["wg"]) * (h @ lp["wu"])) @ lp["wd"], jnp.float32(0.0)
 
 
-def decoder_layer(lp, x, positions, mask, cfg: DecoderConfig):
+def decoder_layer(lp, x, positions, mask, cfg: DecoderConfig, *, full_capacity=False):
     """One pre-norm transformer block (GQA attention + SwiGLU/MoE MLP).
 
     ``lp`` holds a single layer's weights (no leading layer axis).
@@ -313,7 +313,8 @@ def decoder_layer(lp, x, positions, mask, cfg: DecoderConfig):
     key/value projections ``[B, S, KH, D]``, and the MoE load-balance aux
     loss (0 for dense).  Shared by the scanned trunk below and the
     pipeline-parallel stage runner (``parallel/pipeline.py``), so both
-    paths compute identical math.
+    paths compute identical math.  ``full_capacity`` selects lossless MoE
+    dispatch (serving) vs the capacity-drop policy (training).
     """
     B, S = x.shape[0], x.shape[1]
     KH, D = cfg.kv_heads, cfg.head_dim
@@ -325,12 +326,14 @@ def decoder_layer(lp, x, positions, mask, cfg: DecoderConfig):
     k = _rope(k, positions, cfg.rope_theta)
     x = x + _attend(q, k, v, mask, cfg) @ lp["wo"]
     h = _rms(x, lp["ln1"], cfg.norm_eps)
-    mlp, aux = _ffn(lp, h, cfg)
+    mlp, aux = _ffn(lp, h, cfg, full_capacity=full_capacity)
     x = x + mlp
     return x, (k, v), aux
 
 
-def _causal_trunk(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
+def _causal_trunk(
+    tree, ids, lengths, cfg: DecoderConfig, cache_len: int, *, full_capacity=False
+):
     """Shared causal forward: final-norm token reps + K/V caches."""
     B, S = ids.shape
     x = tree["embed"][ids]  # [B, S, H]
@@ -340,7 +343,9 @@ def _causal_trunk(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
     mask = causal[None, :, :] & valid[:, None, :]  # [B, S(q), S(kv)]
 
     def layer(x, lp):
-        x, (k, v), aux = decoder_layer(lp, x, positions, mask, cfg)
+        x, (k, v), aux = decoder_layer(
+            lp, x, positions, mask, cfg, full_capacity=full_capacity
+        )
         # zero K/V beyond each row's real length: decode_step scatters new
         # entries additively, which requires untouched slots to hold zeros
         keep = valid[:, :, None, None].astype(k.dtype)
@@ -359,7 +364,11 @@ def prefill(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
     real token and caches of shape ``[L, B, cache_len, KH, D]`` with the
     prompt keys/values written at positions ``[0, S)``.
     """
-    x, k_cache, v_cache, _ = _causal_trunk(tree, ids, lengths, cfg, cache_len)
+    # serving path: lossless MoE dispatch — a capacity drop here would
+    # corrupt the K/V cache conditioning every later decode step
+    x, k_cache, v_cache, _ = _causal_trunk(
+        tree, ids, lengths, cfg, cache_len, full_capacity=True
+    )
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].repeat(cfg.hidden, 2), axis=1
     )[:, 0, :]
@@ -422,6 +431,61 @@ def decode_step(tree, k_cache, v_cache, token, pos, cfg: DecoderConfig):
     x = _rms(x, tree["final_norm"], cfg.norm_eps)
     logits = (x[:, 0, :] @ tree["lm_head"]).astype(jnp.float32)
     return logits, k_cache, v_cache
+
+
+def decode_chunk(
+    tree,
+    k_cache,
+    v_cache,
+    logits,
+    pos,
+    done,
+    key,
+    temp,
+    cfg: DecoderConfig,
+    n_steps: int,
+    greedy: bool,
+    eos_id: int | None,
+):
+    """``n_steps`` generation steps fused into ONE device program.
+
+    A ``lax.scan`` over sample→decode_step, with sampling and EOS masking
+    on device: the host dispatches once and syncs once per chunk instead
+    of once per token — through the axon tunnel (or any remote runtime)
+    per-call dispatch latency dominates single-token decode, so chunking
+    is the difference between tunnel-bound and HBM-bound generation.
+
+    Carries ``(logits, caches, pos, done, key)``; emits per step
+    ``(token [B], valid [B])`` where ``valid`` marks tokens the caller
+    should append (False once a row has finished or sampled EOS).  Rows
+    past their EOS keep stepping on garbage — their emissions are masked,
+    matching the per-token host loop this replaces.
+    """
+
+    def body(carry, _):
+        logits, kc, vc, pos, done, key = carry
+        key, sub = jax.random.split(key)
+        if greedy:
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            tok = jax.random.categorical(sub, logits / temp, axis=-1).astype(
+                jnp.int32
+            )
+        if eos_id is not None:
+            stop = tok == eos_id
+        else:
+            stop = jnp.zeros_like(done)
+        valid = jnp.logical_and(~done, ~stop)
+        done = jnp.logical_or(done, stop)
+        logits, kc, vc = decode_step(tree, kc, vc, tok, pos, cfg)
+        pos = pos + 1
+        return (logits, kc, vc, pos, done, key), (tok, valid)
+
+    carry = (logits, k_cache, v_cache, pos, done, key)
+    (logits, k_cache, v_cache, pos, done, key), (toks, valids) = lax.scan(
+        body, carry, None, length=n_steps
+    )
+    return toks, valids, logits, k_cache, v_cache, pos, done, key
 
 
 # ---------------------------------------------------------------------------
@@ -516,10 +580,11 @@ def load_hf_decoder_weights(model_name: str, cfg: DecoderConfig):
 class DecoderLM:
     """Local decoder LLM: tokenizer + jitted prefill/decode + sampling.
 
-    ``generate`` runs a Python loop over the jitted single-token step — the
-    step program is compiled once per (batch, cache) shape and the loop
-    carries device arrays only (one scalar D2H per token for the stop
-    check).
+    Generation dispatches ``decode_chunk`` programs — up to 16 decode
+    steps (sampling and EOS masking included) fused into one device call,
+    with a single host sync per chunk.  Each chunk program is compiled
+    once per (batch, cache, steps-bucket) shape and reused for every
+    generation.
     """
 
     def __init__(
@@ -545,9 +610,24 @@ class DecoderLM:
         self._prefill = jax.jit(
             lambda t, ids, lens: prefill(t, ids, lens, cfg, self.max_cache)
         )
-        self._step = jax.jit(
-            lambda t, kc, vc, tok, pos: decode_step(t, kc, vc, tok, pos, cfg)
-        )
+        # device-side multi-token decode: up to _chunk_len steps fuse into
+        # one dispatch; power-of-two step buckets keep short generations
+        # from over-running while bounding compile variants
+        self._chunk_len = 16
+        self._chunk_fns: dict[tuple[bool, int], Any] = {}
+
+    def _chunk_fn(self, greedy: bool, n_steps: int):
+        fn = self._chunk_fns.get((greedy, n_steps))
+        if fn is None:
+            cfg = self.config
+            fn = jax.jit(
+                lambda t, kc, vc, lg, pos, done, key, temp: decode_chunk(
+                    t, kc, vc, lg, pos, done, key, temp, cfg,
+                    n_steps, greedy, self.eos_id,
+                )
+            )
+            self._chunk_fns[(greedy, n_steps)] = fn
+        return fn
 
     def n_params(self) -> int:
         return sum(
@@ -582,27 +662,31 @@ class DecoderLM:
         )
         key = jax.random.PRNGKey(seed)
         pos = jnp.asarray(lengths)  # next write position per row
+        done = jnp.zeros(B, bool)
+        temp = jnp.float32(temperature if temperature > 0.0 else 1.0)
+        greedy = temperature <= 0.0
         out: list[list[int]] = [[] for _ in range(B)]
-        done = np.zeros(B, bool)
-        for _ in range(max_new_tokens):
-            if temperature > 0.0:
-                key, sub = jax.random.split(key)
-                token = jax.random.categorical(sub, logits / temperature, axis=-1)
-            else:
-                token = jnp.argmax(logits, axis=-1)
-            host_tok = np.asarray(token)
-            for i, t in enumerate(host_tok):
-                if not done[i]:
-                    if self.eos_id is not None and int(t) == self.eos_id:
-                        done[i] = True
-                    else:
-                        out[i].append(int(t))
-            if done.all():
+        produced = 0
+        while produced < max_new_tokens:
+            remaining = max_new_tokens - produced
+            # next power-of-two bucket covering `remaining`, capped at the
+            # chunk length: short generations run exactly-sized programs
+            K = min(self._chunk_len, 1 << (remaining - 1).bit_length())
+            toks, valids, logits, kc, vc, pos, done, key = self._chunk_fn(
+                greedy, K
+            )(self.params, kc, vc, logits, pos, done, key, temp)
+            # one host sync per chunk (vs one per token): tokens, validity
+            # and the done flags arrive together
+            htoks = np.asarray(toks)
+            hvalid = np.asarray(valids)
+            take = min(K, remaining)
+            for t in range(take):
+                for i in range(B):
+                    if hvalid[t, i]:
+                        out[i].append(int(htoks[t, i]))
+            produced += take
+            if np.asarray(done).all():
                 break
-            logits, kc, vc = self._step(
-                self.params, kc, vc, token.astype(jnp.int32), pos
-            )
-            pos = pos + 1
         return out
 
     def generate(

@@ -163,17 +163,19 @@ def moe_ffn(
     Tokens beyond ``cfg.group_size`` are chunked into GShard groups and
     dispatched group-locally (one ragged tail group padded and masked),
     keeping dispatch memory linear in the token count.
-    ``full_capacity=True`` gives every token guaranteed slots
-    (``C = T``, single group) — the lossless setting the single-token
-    decode path uses, where capacity drops would silently degrade
-    generations (training keeps the capacity-factor drop policy, which
-    is what makes routing learnable under a static budget).
+    ``full_capacity=True`` gives every token guaranteed slots — capacity
+    ``C = Tg`` per group, which no expert can exceed, still linear in the
+    token count (``T·E·Tg`` dispatch elements).  The serving paths
+    (prefill and single-token decode) use it: capacity drops there would
+    silently degrade generations.  Training keeps the capacity-factor
+    drop policy, which is what makes routing learnable under a static
+    budget.
     """
     orig_shape = x.shape
     H = orig_shape[-1]
     xt = x.reshape(-1, H)
     T = xt.shape[0]
-    if full_capacity or not cfg.group_size or T <= cfg.group_size:
+    if not cfg.group_size or T <= cfg.group_size:
         G, Tg = 1, T
     else:
         G = -(-T // cfg.group_size)

@@ -70,7 +70,7 @@ def stack_stages(tree, n_stages: int):
 
 
 def pp_param_specs(tree, axis: str = "stage"):
-    """PartitionSpecs matching a stage-stacked ``tree``: every layer leaf
+    """PartitionSpecs for the stage-stacked ``tree``: every layer leaf
     (whatever its name — dense or MoE) shards its leading stage axis;
     embed/norm/head replicated (computed off-pipeline)."""
     return {
@@ -99,7 +99,9 @@ def _stage_forward(stage_layers, x, valid, cfg: DecoderConfig):
     mask = causal[None, :, :] & (valid > 0)[:, None, :]
 
     def body(x, lp):
-        x, _, _ = decoder_layer(lp, x, positions, mask, cfg)
+        # the pipelined trunk is a serving path (MoE training under pp is
+        # rejected), so MoE dispatch runs lossless
+        x, _, _ = decoder_layer(lp, x, positions, mask, cfg, full_capacity=True)
         return x, None
 
     x, _ = lax.scan(body, x, stage_layers)
@@ -117,12 +119,12 @@ def make_pipelined_causal_lm(
     by tests at 2e-4) — the schedule changes the execution order, not the
     math.
 
-    MoE configs pipeline too (each stage runs its layers' GShard dispatch
-    locally); note the MoE capacity group is then the *microbatch*, not
-    the whole batch, so capacity-drop behaviour matches the unpipelined
-    trunk only when capacity is ample (no drops).  The aux loss is not
-    collected — see ``make_pp_train_step`` for why pp MoE *training*
-    is rejected.
+    MoE configs pipeline too, with LOSSLESS expert dispatch (the pipelined
+    trunk is a serving path — MoE training under pp is rejected), so it
+    matches ``causal_lm_logits`` — whose training-policy dispatch can drop
+    tokens — only when the trunk drops nothing (ample capacity factor; the
+    MoE pinning test uses 16.0).  The aux loss is not collected — see
+    ``make_pp_train_step``.
     """
     n_stages = mesh.shape["stage"]
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
