@@ -255,14 +255,25 @@ def _extra_retrieval_p50() -> dict:
 
     from pathway_tpu.ops import topk as topk_ops
 
-    # pad to the next power of two exactly like DeviceIndexCache does —
-    # an unpadded 625k (= 2^3·5^6) corpus would collapse the two-stage
-    # block top-k's block size and silently time the full-sort fallback
-    # instead of the kernel serving actually runs
+    # mirror DeviceIndexCache's resident format: pad to the next power of
+    # two (an unpadded 625k = 2^3·5^6 corpus would collapse the two-stage
+    # block top-k's block size and silently time the full-sort fallback),
+    # bf16 on accelerators / f32 on CPU, sharded over the default index
+    # mesh when one is configured — the same program serving dispatches
+    from pathway_tpu.parallel.mesh import get_default_index_mesh
+
     n_docs, cap = 625_000, 1 << 20
+    dtype = jnp.float32 if jax.default_backend() == "cpu" else jnp.bfloat16
     key = jax.random.PRNGKey(0)
-    docs = jax.random.normal(key, (cap, 384), jnp.bfloat16)
+    docs = jax.random.normal(key, (cap, 384), dtype)
     mask = jnp.where(jnp.arange(cap) < n_docs, 0.0, -jnp.inf).astype(jnp.float32)
+    mesh = get_default_index_mesh()
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        axes = tuple(mesh.axis_names)
+        docs = jax.device_put(docs, NamedSharding(mesh, P(axes, None)))
+        mask = jax.device_put(mask, NamedSharding(mesh, P(axes)))
     qs = jax.random.normal(jax.random.PRNGKey(1), (64, 384), jnp.float32)
     qs = qs / jnp.linalg.norm(qs, axis=1, keepdims=True)
     kernel = topk_ops._masked_topk_jax

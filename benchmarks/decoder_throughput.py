@@ -1,12 +1,12 @@
 """Decoder-LLM serving throughput: prefill tokens/s and decode tokens/s.
 
 Measures the two compiled programs JaxChat serving runs on
-(``models/decoder.py``): bucketed prefill over a prompt batch, and the
-cached single-token decode step.  The decode chain stays device-resident
-(argmax feeds the next step on device; ONE D2H sync at the end) — over the
-axon tunnel every fetch costs a full network RTT that a pod-local host
-never pays, so per-token fetch timing would measure the tunnel, not the
-chip.
+(``models/decoder.py``): bucketed prefill over a prompt batch, and
+``decode_chunk`` — 16 sample→decode steps fused into one device program.
+Decode is timed exactly as ``DecoderLM.generate_ids`` dispatches it:
+chunk_len-step programs with one host sync per chunk, so the reported
+tokens/s INCLUDES the per-chunk dispatch + sync cost serving pays (and
+amortizes the tunnel RTT over 16 tokens instead of paying it per token).
 
 Model shape: tinyllama-1.1b class on TPU (2.2 GB bf16 — deterministic
 random weights, throughput is weight-independent); self-scales down on

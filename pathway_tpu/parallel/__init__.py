@@ -64,6 +64,7 @@ from pathway_tpu.parallel.pipeline import (
     place_pp_params,
     pp_param_specs,
 )
+from pathway_tpu.parallel.checkpoint import TrainCheckpointer
 
 __all__ = [
     "initialize_distributed",
@@ -94,4 +95,5 @@ __all__ = [
     "place_pp_params",
     "make_pipelined_causal_lm",
     "make_pp_train_step",
+    "TrainCheckpointer",
 ]

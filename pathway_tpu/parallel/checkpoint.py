@@ -1,0 +1,123 @@
+"""Training-state checkpoint/resume for the model families (orbax).
+
+The reference persists *pipeline* state — connector offsets and operator
+snapshots (``src/persistence/tracker.rs:49``) — but has no trainable
+models, so it has nothing like this.  This framework trains (contrastive
+encoder fine-tuning, causal-LM, MoE), which makes model/optimizer
+checkpointing part of its persistence story: long fine-tunes must survive
+preemption the same way pipelines survive crashes.
+
+Design: a thin ``TrainCheckpointer`` over ``orbax.checkpoint``'s
+``CheckpointManager`` —
+
+* **Sharding-agnostic saves.**  Orbax gathers each array from however it
+  is sharded (dp×tp, stage-stacked pp, expert-sharded MoE trees all work);
+  what lands on disk is placement-free.
+* **Sharding-aware restores.**  ``restore`` takes a ``like`` TrainState
+  (typically a fresh ``init``) and re-places every leaf onto that state's
+  exact ``NamedSharding`` — so a checkpoint written from one mesh layout
+  can resume on another (chips added, tp degree changed) without a
+  reshard step.
+* **Retention.**  ``max_to_keep`` prunes old steps; ``latest_step`` +
+  ``restore(like)`` resumes from the newest checkpoint, mirroring how the
+  engine's persistence rewinds to the last committed frontier.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+from pathway_tpu.parallel.train import TrainState
+
+
+def _abstract_like(tree):
+    """ShapeDtypeStructs carrying each concrete leaf's sharding, so orbax
+    restores arrays directly onto their target devices.
+
+    Leaves still on a single default device (a fresh ``optimizer.init``
+    leaves scalar state like Adam's ``count`` unplaced until the first
+    jitted step) are restored REPLICATED over the like-tree's mesh —
+    restoring them single-device would clash with the mesh-wide params
+    inside the next jitted step.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = None
+    for x in jax.tree_util.tree_leaves(tree):
+        if isinstance(x, jax.Array) and isinstance(x.sharding, NamedSharding):
+            mesh = x.sharding.mesh
+            break
+
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            sh = x.sharding
+            if mesh is not None and not isinstance(sh, NamedSharding):
+                sh = NamedSharding(mesh, P())
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+        if isinstance(x, np.ndarray):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+class TrainCheckpointer:
+    """Save/restore ``TrainState`` snapshots under ``directory/<step>/``."""
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def save(self, state: TrainState, *, wait: bool = True) -> int:
+        """Write ``state`` at its step number; returns the step."""
+        tree = {"params": state.params, "opt_state": state.opt_state}
+        self.manager.save(
+            int(state.step), args=self._ocp.args.StandardSave(tree)
+        )
+        if wait:
+            self.manager.wait_until_finished()
+        return int(state.step)
+
+    def latest_step(self) -> int | None:
+        return self.manager.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self.manager.all_steps())
+
+    def restore(self, like: TrainState, step: int | None = None) -> TrainState:
+        """Restore the checkpoint at ``step`` (default: newest), placing
+        every leaf with the sharding of the corresponding ``like`` leaf."""
+        if step is None:
+            step = self.manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory!r}"
+            )
+        abstract = _abstract_like(
+            {"params": like.params, "opt_state": like.opt_state}
+        )
+        tree = self.manager.restore(
+            int(step), args=self._ocp.args.StandardRestore(abstract)
+        )
+        return TrainState(
+            params=tree["params"], opt_state=tree["opt_state"], step=int(step)
+        )
+
+    def close(self) -> None:
+        self.manager.close()
+
+    def __enter__(self) -> "TrainCheckpointer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
