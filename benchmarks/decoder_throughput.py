@@ -48,6 +48,7 @@ def main() -> None:
         DecoderLM,
         decode_chunk,
         prefill,
+        quantize_decoder_tree,
     )
 
     platform = jax.devices()[0].platform
@@ -87,21 +88,29 @@ def main() -> None:
     done = jnp.zeros((batch,), bool)
     key = jax.random.PRNGKey(0)
     temp = jnp.float32(1.0)
-    toks, *_ = chunk(lm.params, kc, vc, logits, lens, done, key, temp)
-    np.asarray(toks)  # warm + sync
     n_chunks = steps // chunk_len
-    lg, kc2, vc2, pos2, done2, key2 = logits, kc, vc, lens, done, key
-    total = 0
-    t0 = time.perf_counter()
-    for _ in range(n_chunks):
-        toks, valids, lg, kc2, vc2, pos2, done2, key2 = chunk(
-            lm.params, kc2, vc2, lg, pos2, done2, key2, temp
-        )
-        np.asarray(toks), np.asarray(done2)  # per-chunk host sync
-        total += int(toks.shape[0])
-    dt = time.perf_counter() - t0
-    assert total == steps
-    decode_tok_s = batch * total / dt
+
+    def time_decode(tree):
+        """(tokens/s, wall) of the full chunked decode chain for ``tree``."""
+        toks, *_ = chunk(tree, kc, vc, logits, lens, done, key, temp)
+        np.asarray(toks)  # warm + sync
+        lg, kc2, vc2, pos2, done2, key2 = logits, kc, vc, lens, done, key
+        total = 0
+        t0 = time.perf_counter()
+        for _ in range(n_chunks):
+            toks, valids, lg, kc2, vc2, pos2, done2, key2 = chunk(
+                tree, kc2, vc2, lg, pos2, done2, key2, temp
+            )
+            np.asarray(toks), np.asarray(done2)  # per-chunk host sync
+            total += int(toks.shape[0])
+        dt = time.perf_counter() - t0
+        assert total == steps
+        return batch * total / dt, dt
+
+    decode_tok_s, dt = time_decode(lm.params)
+    # weight-only int8: same chunked dispatch, half the HBM weight bytes
+    # per decode sweep
+    decode_tok_s_int8, _ = time_decode(quantize_decoder_tree(lm.params))
 
     n_params = lm.n_params()
     print(
@@ -113,6 +122,7 @@ def main() -> None:
                 "batch": batch,
                 "prefill_tokens_per_sec": round(prefill_tok_s, 1),
                 "decode_tokens_per_sec": round(decode_tok_s, 1),
+                "decode_tokens_per_sec_int8": round(decode_tok_s_int8, 1),
                 "decode_ms_per_token_per_seq": round(dt / steps * 1000.0, 3),
                 "platform": platform,
             }

@@ -248,6 +248,52 @@ def _rms(x, scale, eps):
     return (x.astype(jnp.float32) * lax.rsqrt(var + eps)).astype(x.dtype) * scale
 
 
+def _mm(x, w):
+    """``x @ w`` for a float weight or an int8 weight-only quant pair.
+
+    Quantized weights are ``{"q": int8, "s": f32}`` with per-output-channel
+    scales over the contraction axis (always ``-2`` in this tree's
+    layouts), so the dequant commutes with the dot and is applied to the
+    OUTPUT: the MXU reads int8 bytes from HBM (half of bf16 — decode is
+    bandwidth-bound, so this is directly tokens/s) and XLA fuses the
+    int8→bf16 convert into the dot's operand load.
+    """
+    if isinstance(w, dict) and "q" in w:
+        return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    return x @ w
+
+
+def quantize_decoder_tree(tree):
+    """Weight-only int8 quantization of a decoder param tree (serving).
+
+    Every matmul weight (attention projections, dense or expert MLP,
+    lm_head) becomes ``{"q": int8, "s": f32}`` with symmetric
+    per-output-channel scales (``max|w| / 127`` over the contraction
+    axis, which is ``-2`` in every layout here).  Embedding, norms and
+    the MoE router stay full precision — they are lookup/elementwise/f32
+    paths, not HBM-bound matmuls.  Inference-only: training keeps float
+    trees.
+    """
+    quant_names = {"wq", "wk", "wv", "wo", "wg", "wu", "wd"}
+
+    def quant(w):
+        w32 = jnp.asarray(w, jnp.float32)
+        s = jnp.max(jnp.abs(w32), axis=-2, keepdims=True) / 127.0
+        s = jnp.maximum(s, 1e-12)
+        q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+        return {"q": q, "s": s}
+
+    return {
+        "embed": tree["embed"],
+        "final_norm": tree["final_norm"],
+        "lm_head": quant(tree["lm_head"]),
+        "layers": {
+            name: (quant(w) if name in quant_names else w)
+            for name, w in tree["layers"].items()
+        },
+    }
+
+
 def _rope(x, positions, theta):
     """Rotary embedding; ``x`` is ``[..., S, H, D]``, positions ``[..., S]``."""
     d = x.shape[-1]
@@ -302,7 +348,10 @@ def _ffn(lp, h, cfg: DecoderConfig, *, full_capacity: bool = False):
             "wd": lp["wd"],
         }
         return moe_ffn(params, h, mcfg, full_capacity=full_capacity)
-    return (jax.nn.silu(h @ lp["wg"]) * (h @ lp["wu"])) @ lp["wd"], jnp.float32(0.0)
+    return (
+        _mm(jax.nn.silu(_mm(h, lp["wg"])) * _mm(h, lp["wu"]), lp["wd"]),
+        jnp.float32(0.0),
+    )
 
 
 def decoder_layer(lp, x, positions, mask, cfg: DecoderConfig, *, full_capacity=False):
@@ -319,12 +368,12 @@ def decoder_layer(lp, x, positions, mask, cfg: DecoderConfig, *, full_capacity=F
     B, S = x.shape[0], x.shape[1]
     KH, D = cfg.kv_heads, cfg.head_dim
     h = _rms(x, lp["ln0"], cfg.norm_eps)
-    q = (h @ lp["wq"]).reshape(B, S, cfg.heads, D)
-    k = (h @ lp["wk"]).reshape(B, S, KH, D)
-    v = (h @ lp["wv"]).reshape(B, S, KH, D)
+    q = _mm(h, lp["wq"]).reshape(B, S, cfg.heads, D)
+    k = _mm(h, lp["wk"]).reshape(B, S, KH, D)
+    v = _mm(h, lp["wv"]).reshape(B, S, KH, D)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    x = x + _attend(q, k, v, mask, cfg) @ lp["wo"]
+    x = x + _mm(_attend(q, k, v, mask, cfg), lp["wo"])
     h = _rms(x, lp["ln1"], cfg.norm_eps)
     mlp, aux = _ffn(lp, h, cfg, full_capacity=full_capacity)
     x = x + mlp
@@ -372,7 +421,7 @@ def prefill(tree, ids, lengths, cfg: DecoderConfig, cache_len: int):
     last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].repeat(cfg.hidden, 2), axis=1
     )[:, 0, :]
-    logits = (last @ tree["lm_head"]).astype(jnp.float32)
+    logits = _mm(last, tree["lm_head"]).astype(jnp.float32)
     return logits, k_cache, v_cache
 
 
@@ -391,7 +440,7 @@ def causal_lm_logits_and_aux(tree, ids, lengths, cfg: DecoderConfig):
     adds it to the LM loss so routing stays spread over experts."""
     S = ids.shape[1]
     x, _, _, aux = _causal_trunk(tree, ids, lengths, cfg, S)
-    return (x @ tree["lm_head"]).astype(jnp.float32), aux
+    return _mm(x, tree["lm_head"]).astype(jnp.float32), aux
 
 
 def decode_step(tree, k_cache, v_cache, token, pos, cfg: DecoderConfig):
@@ -412,16 +461,16 @@ def decode_step(tree, k_cache, v_cache, token, pos, cfg: DecoderConfig):
     def layer(x, lp):
         lp, kc, vc = lp
         h = _rms(x, lp["ln0"], cfg.norm_eps)
-        q = (h @ lp["wq"]).reshape(B, 1, cfg.heads, D)
-        k = (h @ lp["wk"]).reshape(B, 1, KH, D)
-        v = (h @ lp["wv"]).reshape(B, 1, KH, D)
+        q = _mm(h, lp["wq"]).reshape(B, 1, cfg.heads, D)
+        k = _mm(h, lp["wk"]).reshape(B, 1, KH, D)
+        v = _mm(h, lp["wv"]).reshape(B, 1, KH, D)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         # scatter the new kv at each row's position
         onehot = (idx[:, 0, :] == pos[:, None]).astype(kc.dtype)  # [B, C]
         kc = kc + onehot[:, :, None, None] * k
         vc = vc + onehot[:, :, None, None] * v
-        x = x + _attend(q, kc, vc, mask, cfg) @ lp["wo"]
+        x = x + _mm(_attend(q, kc, vc, mask, cfg), lp["wo"])
         h = _rms(x, lp["ln1"], cfg.norm_eps)
         mlp, _ = _ffn(lp, h, cfg, full_capacity=True)
         x = x + mlp
@@ -429,7 +478,7 @@ def decode_step(tree, k_cache, v_cache, token, pos, cfg: DecoderConfig):
 
     x, (k_cache, v_cache) = lax.scan(layer, x, (tree["layers"], k_cache, v_cache))
     x = _rms(x, tree["final_norm"], cfg.norm_eps)
-    logits = (x[:, 0, :] @ tree["lm_head"]).astype(jnp.float32)
+    logits = _mm(x[:, 0, :], tree["lm_head"]).astype(jnp.float32)
     return logits, k_cache, v_cache
 
 
@@ -593,7 +642,10 @@ class DecoderLM:
         seed: int = 0,
         max_cache: int = 1024,
         eos_id: int | None = 2,
+        quantize: str | None = None,
     ):
+        if quantize not in (None, "int8"):
+            raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
         self.config = decoder_config_for(model_name)
         self.model_name = model_name
         self.max_cache = min(max_cache, self.config.max_len)
@@ -606,6 +658,11 @@ class DecoderLM:
         self.params = tree if tree is not None else init_decoder_params(
             self.config, seed
         )
+        self.quantized = quantize == "int8"
+        if self.quantized:
+            # weight-only int8: halves the HBM bytes every decode step
+            # sweeps (decode is bandwidth-bound, so ~2x tokens/s headroom)
+            self.params = quantize_decoder_tree(self.params)
         cfg = self.config
         self._prefill = jax.jit(
             lambda t, ids, lens: prefill(t, ids, lens, cfg, self.max_cache)
@@ -721,5 +778,9 @@ class DecoderLM:
 
 
 @functools.lru_cache(maxsize=4)
-def shared_decoder(model_name: str = "mistral-7b-instruct", max_cache: int = 1024) -> DecoderLM:
-    return DecoderLM(model_name, max_cache=max_cache)
+def shared_decoder(
+    model_name: str = "mistral-7b-instruct",
+    max_cache: int = 1024,
+    quantize: str | None = None,
+) -> DecoderLM:
+    return DecoderLM(model_name, max_cache=max_cache, quantize=quantize)

@@ -143,6 +143,19 @@ def _routing(
     return dispatch, combine, aux
 
 
+def _qeinsum(spec: str, x, w):
+    """``einsum`` over a float weight or an int8 weight-only quant pair
+    (``{"q", "s"}`` with per-output-channel scales over the contraction
+    axis): the dot consumes int8→activation-dtype converts and the scale
+    multiplies the OUTPUT (exact for per-output-channel scales)."""
+    if isinstance(w, dict) and "q" in w:
+        out = jnp.einsum(spec, x, w["q"].astype(x.dtype))
+        # s keeps a singleton on the contraction axis, which lines up
+        # against the batch-ish axis of the output under broadcasting
+        return out * w["s"].astype(x.dtype)[None]
+    return jnp.einsum(spec, x, w)
+
+
 def moe_ffn(
     params,
     x: jnp.ndarray,
@@ -200,9 +213,9 @@ def moe_ffn(
         expert_in = jax.lax.with_sharding_constraint(
             expert_in, NamedSharding(mesh, P(None, "expert", None, None))
         )
-    h = jax.nn.silu(jnp.einsum("gech,ehf->gecf", expert_in, params["wg"]))
-    h = h * jnp.einsum("gech,ehf->gecf", expert_in, params["wu"])
-    expert_out = jnp.einsum("gecf,efh->gech", h, params["wd"])
+    h = jax.nn.silu(_qeinsum("gech,ehf->gecf", expert_in, params["wg"]))
+    h = h * _qeinsum("gech,ehf->gecf", expert_in, params["wu"])
+    expert_out = _qeinsum("gecf,efh->gech", h, params["wd"])
     if mesh is not None and "expert" in mesh.axis_names:
         expert_out = jax.lax.with_sharding_constraint(
             expert_out, NamedSharding(mesh, P(None, "expert", None, None))

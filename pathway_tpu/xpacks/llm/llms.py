@@ -214,6 +214,7 @@ class JaxChat(BaseChat):
         max_batch: int = 32,
         capacity: int | None = None,
         cache_strategy=None,
+        quantize: str | None = None,
     ):
         super().__init__(
             executor=async_executor(capacity=capacity),
@@ -224,6 +225,9 @@ class JaxChat(BaseChat):
         self.temperature = temperature
         self.max_cache = max_cache
         self.max_batch = max_batch
+        if quantize not in (None, "int8"):  # fail at config time, not first row
+            raise ValueError(f"quantize must be None or 'int8', got {quantize!r}")
+        self.quantize = quantize
         self._model = None
         self._init_lock = None
         self._batchers: dict[tuple, Any] = {}
@@ -264,7 +268,9 @@ class JaxChat(BaseChat):
     def _build_model(self):
         from pathway_tpu.models.decoder import shared_decoder
 
-        return shared_decoder(self.model, max_cache=self.max_cache)
+        return shared_decoder(
+            self.model, max_cache=self.max_cache, quantize=self.quantize
+        )
 
     def crop_to_max_prompt_size(self, text: str, max_tokens: int = 1024) -> str:
         return text[: max_tokens * 4]
