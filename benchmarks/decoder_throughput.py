@@ -49,6 +49,7 @@ def main() -> None:
         decode_chunk,
         prefill,
         quantize_decoder_tree,
+        speculative_decode_chunk,
     )
 
     platform = jax.devices()[0].platform
@@ -110,7 +111,34 @@ def main() -> None:
     decode_tok_s, dt = time_decode(lm.params)
     # weight-only int8: same chunked dispatch, half the HBM weight bytes
     # per decode sweep
-    decode_tok_s_int8, _ = time_decode(quantize_decoder_tree(lm.params))
+    qtree = quantize_decoder_tree(lm.params)
+    decode_tok_s_int8, _ = time_decode(qtree)
+
+    # self-speculative greedy: int8 draft, float verify — exact float
+    # chain at (ideally) near-int8 cost; tokens/round is data-dependent,
+    # so run rounds until `steps` tokens/row are accepted
+    n_draft = 8
+    spec = jax.jit(
+        lambda t, d, c1, c2, lg, ps: speculative_decode_chunk(
+            t, d, c1, c2, lg, ps, cfg, n_draft
+        )
+    )
+    toks, n, *_ = spec(lm.params, qtree, kc, vc, logits, lens)
+    np.asarray(toks)  # warm + sync
+    lg, kc2, vc2, pos2 = logits, kc, vc, lens
+    # bound rounds so even a row accepting n_draft every round stays
+    # inside the cache (overflow writes would be silently dropped and
+    # corrupt the measurement)
+    max_rounds = min(steps // n_draft, (cache - prompt_len) // n_draft - 1)
+    assert max_rounds >= 1
+    accepted = rounds = 0
+    t0 = time.perf_counter()
+    while accepted < steps * batch and rounds < max_rounds:
+        toks, n, lg, kc2, vc2, pos2 = spec(lm.params, qtree, kc2, vc2, lg, pos2)
+        accepted += int(np.asarray(n).sum())
+        rounds += 1
+    spec_tok_s = accepted / (time.perf_counter() - t0)
+    mean_accept = accepted / max(rounds * batch, 1)
 
     n_params = lm.n_params()
     print(
@@ -123,6 +151,8 @@ def main() -> None:
                 "prefill_tokens_per_sec": round(prefill_tok_s, 1),
                 "decode_tokens_per_sec": round(decode_tok_s, 1),
                 "decode_tokens_per_sec_int8": round(decode_tok_s_int8, 1),
+                "decode_tokens_per_sec_speculative": round(spec_tok_s, 1),
+                "speculative_mean_accept": round(mean_accept, 2),
                 "decode_ms_per_token_per_seq": round(dt / steps * 1000.0, 3),
                 "platform": platform,
             }

@@ -71,6 +71,9 @@ class DecoderConfig:
     experts: int = 0
     experts_top_k: int = 2
     expert_capacity_factor: float = 2.0
+    # Mistral-v0.1-style sliding-window attention: each query attends to
+    # at most the last `sliding_window` positions (None = full causal)
+    sliding_window: int | None = None
 
     @property
     def head_dim(self) -> int:
@@ -78,7 +81,8 @@ class DecoderConfig:
 
 
 PRESETS: dict[str, DecoderConfig] = {
-    "mistral-7b-instruct": DecoderConfig(),
+    # v0.1 family: sliding-window attention over the last 4096 positions
+    "mistral-7b-instruct": DecoderConfig(sliding_window=4096),
     "mistralai/Mistral-7B-Instruct-v0.2": DecoderConfig(rope_theta=1e6),
     "tinyllama-1.1b": DecoderConfig(
         hidden=2048, layers=22, heads=32, kv_heads=4, intermediate=5632,
@@ -126,6 +130,7 @@ def decoder_config_for(model_name: str) -> DecoderConfig:
             norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
             experts=hf.get("num_local_experts", 0),
             experts_top_k=hf.get("num_experts_per_tok", 2),
+            sliding_window=hf.get("sliding_window"),
         )
     # an unknown name would otherwise build (and compile) a random 7B —
     # fail loudly instead, a typo should not cost 14 GB and minutes
@@ -246,6 +251,14 @@ def tp_cache_specs(axis: str = "model"):
 def _rms(x, scale, eps):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x.astype(jnp.float32) * lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+def _sw_mask(q_pos, k_pos, window: int):
+    """True where key position ``k_pos`` lies inside the sliding window of
+    query position ``q_pos`` (``q_pos - window < k_pos``); shapes
+    broadcast.  The ONE definition of the window edge — shared by the
+    trunk, decode, verify, and pipeline masks so they cannot drift."""
+    return k_pos > q_pos - window
 
 
 def _mm(x, w):
@@ -389,6 +402,11 @@ def _causal_trunk(
     positions = jnp.arange(S)[None, :].repeat(B, axis=0)
     valid = positions < lengths[:, None]  # [B, S]
     causal = jnp.tril(jnp.ones((S, S), bool))
+    if cfg.sliding_window is not None:
+        # each query sees at most the last `sliding_window` keys
+        causal = causal & _sw_mask(
+            jnp.arange(S)[:, None], jnp.arange(S)[None, :], cfg.sliding_window
+        )
     mask = causal[None, :, :] & valid[:, None, :]  # [B, S(q), S(kv)]
 
     def layer(x, lp):
@@ -457,6 +475,8 @@ def decode_step(tree, k_cache, v_cache, token, pos, cfg: DecoderConfig):
     positions = pos[:, None]  # [B, 1]
     idx = jnp.arange(C)[None, None, :]
     mask = idx <= pos[:, None, None]  # [B, 1, C]
+    if cfg.sliding_window is not None:
+        mask = mask & _sw_mask(pos[:, None, None], idx, cfg.sliding_window)
 
     def layer(x, lp):
         lp, kc, vc = lp
@@ -535,6 +555,113 @@ def decode_chunk(
         body, carry, None, length=n_steps
     )
     return toks, valids, logits, k_cache, v_cache, pos, done, key
+
+
+def verify_block(tree, k_cache, v_cache, tokens, pos0, cfg: DecoderConfig):
+    """Forward ``K`` already-chosen tokens against the cache in ONE pass.
+
+    ``tokens [B, K]`` sit at positions ``pos0 + 0..K-1`` (``pos0 [B]``);
+    the caches hold history for positions ``< pos0`` and empty (zero)
+    slots at the block's positions.  Returns ``(logits [B, K, V] f32,
+    k_cache, v_cache)`` with the block's K/V written in — exactly what
+    ``K`` sequential ``decode_step`` calls would produce, but as one
+    batched program: this is the verification pass of speculative
+    decoding (all K target-model logits for the draft block at the cost
+    of one matmul sweep instead of K).
+    """
+    B, K = tokens.shape
+    C = k_cache.shape[2]
+    KH, D = cfg.kv_heads, cfg.head_dim
+    x = tree["embed"][tokens]  # [B, K, H]
+    positions = pos0[:, None] + jnp.arange(K)[None, :]  # [B, K]
+    idx = jnp.arange(C)[None, None, :]  # [1, 1, C]
+    # query i attends to every cache slot <= its own position (the block's
+    # K/V are scattered in before attending, so self/intra-block edges are
+    # included); sliding window bounds the lookback like decode_step
+    mask = idx <= positions[:, :, None]
+    if cfg.sliding_window is not None:
+        mask = mask & _sw_mask(positions[:, :, None], idx, cfg.sliding_window)
+    onehot = (idx[:, :, :, None] == positions[:, :, None, None]).astype(
+        cfg.dtype
+    )  # [B, K, C, 1] — scatter weights per block token
+
+    def layer(x, lp):
+        lp, kc, vc = lp
+        h = _rms(x, lp["ln0"], cfg.norm_eps)
+        q = _mm(h, lp["wq"]).reshape(B, K, cfg.heads, D)
+        k = _mm(h, lp["wk"]).reshape(B, K, KH, D)
+        v = _mm(h, lp["wv"]).reshape(B, K, KH, D)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        kc = kc + jnp.einsum("bkcx,bkhd->bchd", onehot, k)
+        vc = vc + jnp.einsum("bkcx,bkhd->bchd", onehot, v)
+        x = x + _mm(_attend(q, kc, vc, mask, cfg), lp["wo"])
+        h = _rms(x, lp["ln1"], cfg.norm_eps)
+        mlp, _ = _ffn(lp, h, cfg, full_capacity=True)
+        x = x + mlp
+        return x, (kc, vc)
+
+    x, (k_cache, v_cache) = lax.scan(layer, x, (tree["layers"], k_cache, v_cache))
+    x = _rms(x, tree["final_norm"], cfg.norm_eps)
+    logits = _mm(x, tree["lm_head"]).astype(jnp.float32)
+    return logits, k_cache, v_cache
+
+
+def speculative_decode_chunk(
+    tree, draft_tree, k_cache, v_cache, logits, pos, cfg: DecoderConfig, n_draft: int
+):
+    """One greedy speculative round: draft ``n_draft`` tokens with
+    ``draft_tree`` (sequential single-token decodes — cheap when the
+    draft is the int8-quantized tree), then verify them against ``tree``
+    with ONE ``verify_block`` sweep and accept the longest matching
+    prefix.
+
+    The emitted chain is EXACTLY the target model's greedy chain:
+    ``toks[:, 0]`` is the argmax of the incoming (target) logits, and
+    each further draft token only counts if the target's own argmax at
+    the preceding position agrees.  At least one token is accepted per
+    round (guaranteed progress); up to ``n_draft`` when the draft tracks
+    the target — which is what buys throughput: the target model then
+    runs one batched K-token sweep instead of K sequential single-token
+    steps.
+
+    Returns ``(toks [B, n_draft], n_match [B], next_logits, k_cache,
+    v_cache, pos + n_match)``; ``toks[b, :n_match[b]]`` are the accepted
+    tokens, the caches hold target-model K/V for exactly the accepted
+    positions (unaccepted writes are zeroed so the slots stay scatter-
+    ready), and ``next_logits`` are the target logits after the last
+    accepted token.
+    """
+    B = logits.shape[0]
+    C = k_cache.shape[2]
+
+    def draft_step(carry, _):
+        lg, dk, dv, p = carry
+        tok = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        lg, dk, dv = decode_step(draft_tree, dk, dv, tok, p, cfg)
+        return (lg, dk, dv, p + 1), tok
+
+    # draft K/V lives in scan-carried copies; the real cache is untouched
+    _, toks = lax.scan(
+        draft_step, (logits, k_cache, v_cache, pos), None, length=n_draft
+    )
+    toks = toks.swapaxes(0, 1)  # [B, n_draft]
+
+    vlogits, k_cache, v_cache = verify_block(tree, k_cache, v_cache, toks, pos, cfg)
+    pred = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # target's next-token
+    match = (toks[:, 1:] == pred[:, :-1]).astype(jnp.int32)
+    n_match = 1 + jnp.cumprod(match, axis=1).sum(axis=1)  # [B] in 1..n_draft
+    next_logits = jnp.take_along_axis(
+        vlogits, (n_match - 1)[:, None, None].repeat(vlogits.shape[-1], 2), axis=1
+    )[:, 0]
+    # zero the rejected positions' K/V so those slots stay additive-ready
+    cidx = jnp.arange(C)[None, :]
+    keep = ~(
+        (cidx >= (pos + n_match)[:, None]) & (cidx < (pos + n_draft)[:, None])
+    )
+    k_cache = k_cache * keep[None, :, :, None, None].astype(k_cache.dtype)
+    v_cache = v_cache * keep[None, :, :, None, None].astype(v_cache.dtype)
+    return toks, n_match, next_logits, k_cache, v_cache, pos + n_match
 
 
 # ---------------------------------------------------------------------------
@@ -672,6 +799,9 @@ class DecoderLM:
         # from over-running while bounding compile variants
         self._chunk_len = 16
         self._chunk_fns: dict[tuple[bool, int], Any] = {}
+        # self-speculative decoding: int8 draft tree + jitted round fns
+        self._draft_tree = None
+        self._spec_fns: dict[int, Any] = {}
 
     def _chunk_fn(self, greedy: bool, n_steps: int):
         fn = self._chunk_fns.get((greedy, n_steps))
@@ -744,6 +874,79 @@ class DecoderLM:
             produced += take
             if np.asarray(done).all():
                 break
+        return out
+
+    def generate_ids_speculative(
+        self,
+        prompt_ids: list[list[int]],
+        max_new_tokens: int = 64,
+        n_draft: int = 8,
+    ) -> list[list[int]]:
+        """Greedy generation via SELF-SPECULATIVE decoding.
+
+        Drafts ``n_draft`` tokens per round with the int8-quantized tree
+        (half the HBM sweep per draft step), verifies them with the float
+        tree in one ``verify_block`` sweep, and accepts the matching
+        prefix — the emitted chain is IDENTICAL to
+        ``generate_ids(temperature=0)`` (pinned by tests), but the float
+        model runs one batched K-token pass per round instead of K
+        single-token steps.  Worth it when the int8 draft tracks the
+        float argmax (typically >90% — see test_quantized_decoder).
+        """
+        if self.quantized:
+            raise ValueError(
+                "speculative decoding verifies with the float tree: "
+                "construct DecoderLM without quantize (the int8 draft is "
+                "built internally)"
+            )
+        if max_new_tokens >= self.max_cache:
+            raise ValueError(
+                f"max_new_tokens={max_new_tokens} must be < max_cache={self.max_cache}"
+            )
+        if self._draft_tree is None:
+            self._draft_tree = quantize_decoder_tree(self.params)
+        spec = self._spec_fns.get(n_draft)
+        if spec is None:
+            cfg = self.config
+            spec = jax.jit(
+                lambda t, d, kc, vc, lg, ps: speculative_decode_chunk(
+                    t, d, kc, vc, lg, ps, cfg, n_draft
+                )
+            )
+            self._spec_fns[n_draft] = spec
+
+        B = len(prompt_ids)
+        limit = self.max_cache - max_new_tokens
+        prompt_ids = [p[-limit:] if len(p) > limit else p for p in prompt_ids]
+        lengths = np.array([max(len(p), 1) for p in prompt_ids], np.int32)
+        S = _bucket_prompt_len(int(lengths.max()), self.max_cache)
+        ids = np.zeros((B, S), np.int32)
+        for i, p in enumerate(prompt_ids):
+            ids[i, : len(p)] = p
+        logits, kc, vc = self._prefill(
+            self.params, jnp.asarray(ids), jnp.asarray(lengths)
+        )
+        pos = jnp.asarray(lengths)
+        out: list[list[int]] = [[] for _ in range(B)]
+        done = np.zeros(B, bool)
+        while not done.all():
+            toks, n_match, logits, kc, vc, pos = spec(
+                self.params, self._draft_tree, kc, vc, logits, pos
+            )
+            htoks = np.asarray(toks)
+            hn = np.asarray(n_match)
+            for i in range(B):
+                if done[i]:
+                    continue
+                for t in range(int(hn[i])):
+                    tok = int(htoks[i, t])
+                    if self.eos_id is not None and tok == self.eos_id:
+                        done[i] = True
+                        break
+                    out[i].append(tok)
+                    if len(out[i]) >= max_new_tokens:
+                        done[i] = True
+                        break
         return out
 
     def generate(

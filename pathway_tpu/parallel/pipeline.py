@@ -45,7 +45,7 @@ import optax
 from jax import lax, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from pathway_tpu.models.decoder import DecoderConfig, decoder_layer, _rms
+from pathway_tpu.models.decoder import DecoderConfig, decoder_layer, _rms, _sw_mask
 
 
 def make_pp_mesh(n_stages: int) -> Mesh:
@@ -96,6 +96,10 @@ def _stage_forward(stage_layers, x, valid, cfg: DecoderConfig):
     S = x.shape[1]
     positions = jnp.arange(S)[None, :].repeat(x.shape[0], axis=0)
     causal = jnp.tril(jnp.ones((S, S), bool))
+    if cfg.sliding_window is not None:
+        causal = causal & _sw_mask(
+            jnp.arange(S)[:, None], jnp.arange(S)[None, :], cfg.sliding_window
+        )
     mask = causal[None, :, :] & (valid > 0)[:, None, :]
 
     def body(x, lp):
