@@ -9,8 +9,16 @@ from pathway_tpu.models.encoder import (
     shared_sentence_encoder,
 )
 from pathway_tpu.models.tokenizer import HashTokenizer, load_tokenizer
+from pathway_tpu.models.lora import (
+    lora_decoder_tree,
+    make_lora_train_step,
+    merge_lora,
+)
 
 __all__ = [
+    "lora_decoder_tree",
+    "make_lora_train_step",
+    "merge_lora",
     "CrossEncoder",
     "EncoderConfig",
     "SentenceEncoder",

@@ -262,7 +262,8 @@ def _sw_mask(q_pos, k_pos, window: int):
 
 
 def _mm(x, w):
-    """``x @ w`` for a float weight or an int8 weight-only quant pair.
+    """``x @ w`` for a float weight, an int8 weight-only quant pair, or a
+    LoRA-adapted weight.
 
     Quantized weights are ``{"q": int8, "s": f32}`` with per-output-channel
     scales over the contraction axis (always ``-2`` in this tree's
@@ -270,9 +271,16 @@ def _mm(x, w):
     OUTPUT: the MXU reads int8 bytes from HBM (half of bf16 — decode is
     bandwidth-bound, so this is directly tokens/s) and XLA fuses the
     int8→bf16 convert into the dot's operand load.
+
+    LoRA weights are ``{"w": frozen base, "a": [..., H, r], "b": [...,
+    r, O]}``: the update routes through the rank-``r`` bottleneck
+    (``(x@a)@b`` — never materializing the dense delta); the standard
+    ``alpha/r`` scale is folded into ``a``'s init (``b`` starts zero).
     """
     if isinstance(w, dict) and "q" in w:
         return (x @ w["q"].astype(x.dtype)) * w["s"].astype(x.dtype)
+    if isinstance(w, dict) and "a" in w:
+        return x @ w["w"] + (x @ w["a"].astype(x.dtype)) @ w["b"].astype(x.dtype)
     return x @ w
 
 
@@ -288,6 +296,14 @@ def quantize_decoder_tree(tree):
     trees.
     """
     quant_names = {"wq", "wk", "wv", "wo", "wg", "wu", "wd"}
+    for name in quant_names:
+        w = tree["layers"].get(name)
+        if isinstance(w, dict) and "a" in w:
+            raise ValueError(
+                f"layer weight {name!r} carries LoRA adapters — call "
+                "models.lora.merge_lora(tree) before quantizing (or "
+                "before speculative decoding, which quantizes its draft)"
+            )
 
     def quant(w):
         w32 = jnp.asarray(w, jnp.float32)

@@ -109,6 +109,47 @@ def masked_next_token_loss(logits, ids, lengths):
     return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
 
 
+def make_lm_step_runner(
+    cfg,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    moe_aux_weight: float = 0.01,
+) -> Callable:
+    """The shared causal-LM training core: jitted value_and_grad step over
+    ``masked_next_token_loss`` (+ MoE aux) with the batch sharded over
+    ``data``.  One definition serves full fine-tuning below and LoRA
+    (``models/lora.py``) so the loss/step semantics cannot drift."""
+    from pathway_tpu.models.decoder import causal_lm_logits_and_aux
+
+    def loss_fn(tree, ids, lengths):
+        logits, aux = causal_lm_logits_and_aux(tree, ids, lengths, cfg)
+        # aux is exactly 0 for dense configs, so one code path serves both
+        return masked_next_token_loss(logits, ids, lengths) + moe_aux_weight * aux
+
+    @jax.jit
+    def step(params, opt_state, ids, lengths):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids, lengths)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    batch_sharding = NamedSharding(mesh, P("data"))
+
+    def run(state: TrainState, ids, lengths) -> tuple[TrainState, float]:
+        import numpy as _np
+
+        ids = put_global(_np.asarray(ids, _np.int32), batch_sharding)
+        lengths = put_global(_np.asarray(lengths, _np.int32), batch_sharding)
+        params, opt_state, loss = step(state.params, state.opt_state, ids, lengths)
+        return (
+            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            loss,
+        )
+
+    return run
+
+
 def make_causal_lm_train_step(
     cfg,
     optimizer: optax.GradientTransformation,
@@ -125,11 +166,7 @@ def make_causal_lm_train_step(
     Loss is masked next-token cross-entropy; gradients are psum-reduced by
     XLA from the sharding annotations alone.
     """
-    from pathway_tpu.models.decoder import (
-        causal_lm_logits_and_aux,
-        init_decoder_params,
-        tp_param_specs,
-    )
+    from pathway_tpu.models.decoder import init_decoder_params, tp_param_specs
 
     def init_state(seed: int = 0) -> TrainState:
         tree = init_decoder_params(cfg, seed)
@@ -139,30 +176,5 @@ def make_causal_lm_train_step(
         )
         return TrainState(params=tree, opt_state=optimizer.init(tree))
 
-    def loss_fn(tree, ids, lengths):
-        logits, aux = causal_lm_logits_and_aux(tree, ids, lengths, cfg)
-        # aux is exactly 0 for dense configs, so one code path serves both
-        return masked_next_token_loss(logits, ids, lengths) + moe_aux_weight * aux
-
-    @jax.jit
-    def step(params, opt_state, ids, lengths):
-        loss, grads = jax.value_and_grad(loss_fn)(params, ids, lengths)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, opt_state, loss
-
-    batch_sharding = NamedSharding(mesh, P("data"))
-    len_sharding = NamedSharding(mesh, P("data"))
-
-    def run(state: TrainState, ids, lengths) -> tuple[TrainState, float]:
-        import numpy as _np
-
-        ids = put_global(_np.asarray(ids, _np.int32), batch_sharding)
-        lengths = put_global(_np.asarray(lengths, _np.int32), len_sharding)
-        params, opt_state, loss = step(state.params, state.opt_state, ids, lengths)
-        return (
-            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
-            loss,
-        )
-
+    run = make_lm_step_runner(cfg, optimizer, mesh, moe_aux_weight=moe_aux_weight)
     return init_state, run
