@@ -108,6 +108,10 @@ def _stage_forward(stage_layers, x, valid, cfg: DecoderConfig):
         x, _, _ = decoder_layer(lp, x, positions, mask, cfg, full_capacity=True)
         return x, None
 
+    if cfg.remat:
+        # honor the memory knob under pp training too: each stage's
+        # backward recomputes its layers instead of storing activations
+        body = jax.checkpoint(body, prevent_cse=False)
     x, _ = lax.scan(body, x, stage_layers)
     return x
 
