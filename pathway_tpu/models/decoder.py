@@ -528,6 +528,38 @@ def decode_step(tree, k_cache, v_cache, token, pos, cfg: DecoderConfig):
     return logits, k_cache, v_cache
 
 
+def sample_logits(logits, key, temp, *, top_k: int | None = None,
+                  top_p: float | None = None):
+    """On-device sampling: temperature, then optional top-k and nucleus
+    (top-p) truncation, then categorical.  ``logits [B, V]`` f32.
+
+    top-p keeps the smallest probability-sorted prefix whose mass reaches
+    ``top_p`` (the first token always survives, so the distribution is
+    never empty); both filters set rejected logits to -inf BEFORE the
+    categorical draw, all inside the compiled program.
+    """
+    lg = logits / temp
+    if top_k is not None:
+        # clamp: an oversized k (unvalidated client kwarg) must degrade to
+        # "no truncation", not crash the whole serving micro-batch
+        kth = jax.lax.top_k(lg, min(int(top_k), lg.shape[-1]))[0][..., -1:]
+        lg = jnp.where(lg < kth, -jnp.inf, lg)
+    if top_p is not None:
+        # top_p may be a TRACED scalar (serving varies it per request
+        # without recompiles — same treatment as temperature)
+        sorted_lg = jnp.sort(lg, axis=-1)[..., ::-1]  # descending
+        probs = jax.nn.softmax(sorted_lg, axis=-1)
+        # exclusive prefix mass: token i survives while the mass BEFORE it
+        # is still < top_p; the top token is forced alive so non-positive
+        # top_p degrades to argmax instead of an empty distribution
+        before = jnp.cumsum(probs, axis=-1) - probs
+        keep = (before < top_p).at[..., 0].set(True)
+        # threshold = smallest kept logit; everything below is cut
+        kept_min = jnp.min(jnp.where(keep, sorted_lg, jnp.inf), axis=-1, keepdims=True)
+        lg = jnp.where(lg < kept_min, -jnp.inf, lg)
+    return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
+
+
 def decode_chunk(
     tree,
     k_cache,
@@ -541,6 +573,8 @@ def decode_chunk(
     n_steps: int,
     greedy: bool,
     eos_id: int | None,
+    top_k: int | None = None,
+    top_p: float | None = None,
 ):
     """``n_steps`` generation steps fused into ONE device program.
 
@@ -563,9 +597,7 @@ def decode_chunk(
         if greedy:
             tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
-            tok = jax.random.categorical(sub, logits / temp, axis=-1).astype(
-                jnp.int32
-            )
+            tok = sample_logits(logits, sub, temp, top_k=top_k, top_p=top_p)
         if eos_id is not None:
             stop = tok == eos_id
         else:
@@ -824,22 +856,35 @@ class DecoderLM:
         # one dispatch; power-of-two step buckets keep short generations
         # from over-running while bounding compile variants
         self._chunk_len = 16
-        self._chunk_fns: dict[tuple[bool, int], Any] = {}
+        self._chunk_fns: dict[tuple, Any] = {}
         # self-speculative decoding: int8 draft tree + jitted round fns
         self._draft_tree = None
         self._spec_fns: dict[int, Any] = {}
 
-    def _chunk_fn(self, greedy: bool, n_steps: int):
-        fn = self._chunk_fns.get((greedy, n_steps))
+    def _chunk_fn(self, greedy: bool, n_steps: int,
+                  top_k: int | None, has_top_p: bool):
+        # top_k must be static (lax.top_k shape) but top_p is TRACED — a
+        # serving client sweeping top_p must not recompile per value, so
+        # the cache keys only whether a nucleus arg exists
+        cache_key = (greedy, n_steps, top_k, has_top_p)
+        fn = self._chunk_fns.get(cache_key)
         if fn is None:
             cfg = self.config
-            fn = jax.jit(
-                lambda t, kc, vc, lg, pos, done, key, temp: decode_chunk(
-                    t, kc, vc, lg, pos, done, key, temp, cfg,
-                    n_steps, greedy, self.eos_id,
+            if has_top_p:
+                fn = jax.jit(
+                    lambda t, kc, vc, lg, pos, done, key, temp, tp: decode_chunk(
+                        t, kc, vc, lg, pos, done, key, temp, cfg,
+                        n_steps, greedy, self.eos_id, top_k, tp,
+                    )
                 )
-            )
-            self._chunk_fns[(greedy, n_steps)] = fn
+            else:
+                fn = jax.jit(
+                    lambda t, kc, vc, lg, pos, done, key, temp: decode_chunk(
+                        t, kc, vc, lg, pos, done, key, temp, cfg,
+                        n_steps, greedy, self.eos_id, top_k, None,
+                    )
+                )
+            self._chunk_fns[cache_key] = fn
         return fn
 
     def n_params(self) -> int:
@@ -853,11 +898,15 @@ class DecoderLM:
         max_new_tokens: int = 64,
         temperature: float = 0.0,
         seed: int = 0,
+        top_k: int | None = None,
+        top_p: float | None = None,
     ) -> list[list[int]]:
         """Batched generation; returns the newly generated ids per row.
 
-        Prompts longer than the cache budget keep their TAIL (the recent
-        context — the part chat serving cares about)."""
+        ``top_k``/``top_p`` truncate the sampling distribution on device
+        (only meaningful with ``temperature > 0``).  Prompts longer than
+        the cache budget keep their TAIL (the recent context — the part
+        chat serving cares about)."""
         if max_new_tokens >= self.max_cache:
             raise ValueError(
                 f"max_new_tokens={max_new_tokens} must be < max_cache={self.max_cache}"
@@ -885,9 +934,12 @@ class DecoderLM:
             # next power-of-two bucket covering `remaining`, capped at the
             # chunk length: short generations run exactly-sized programs
             K = min(self._chunk_len, 1 << (remaining - 1).bit_length())
+            args = (self.params, kc, vc, logits, pos, done, key, temp)
+            if top_p is not None:
+                args += (jnp.float32(top_p),)
             toks, valids, logits, kc, vc, pos, done, key = self._chunk_fn(
-                greedy, K
-            )(self.params, kc, vc, logits, pos, done, key, temp)
+                greedy, K, top_k, top_p is not None
+            )(*args)
             # one host sync per chunk (vs one per token): tokens, validity
             # and the done flags arrive together
             htoks = np.asarray(toks)
@@ -981,9 +1033,13 @@ class DecoderLM:
         max_new_tokens: int = 64,
         temperature: float = 0.0,
         seed: int = 0,
+        top_k: int | None = None,
+        top_p: float | None = None,
     ) -> str:
         ids = self._encode_prompt(prompt)
-        new_ids = self.generate_ids([ids], max_new_tokens, temperature, seed)[0]
+        new_ids = self.generate_ids(
+            [ids], max_new_tokens, temperature, seed, top_k=top_k, top_p=top_p
+        )[0]
         return self.tokenizer.decode(new_ids)
 
     def _encode_prompt(self, prompt: str) -> list[int]:
@@ -999,10 +1055,14 @@ class DecoderLM:
         max_new_tokens: int = 64,
         temperature: float = 0.0,
         seed: int = 0,
+        top_k: int | None = None,
+        top_p: float | None = None,
     ) -> list[str]:
         """One padded ragged batch through prefill+decode for all prompts."""
         id_lists = [self._encode_prompt(p) for p in prompts]
-        outs = self.generate_ids(id_lists, max_new_tokens, temperature, seed)
+        outs = self.generate_ids(
+            id_lists, max_new_tokens, temperature, seed, top_k=top_k, top_p=top_p
+        )
         return [self.tokenizer.decode(o) for o in outs]
 
 

@@ -246,7 +246,14 @@ class JaxChat(BaseChat):
             lm = self._model
             mnt = int(kwargs.get("max_tokens", self.max_new_tokens))
             temp = float(kwargs.get("temperature", self.temperature))
-            batcher = self._batchers.get((mnt, temp))
+            # coerce BEFORE keying: 5 and 5.0 must share one batcher (and
+            # one compiled program), and a malformed kwarg should fail
+            # here with a clear TypeError, not inside the batch worker
+            top_k = kwargs.get("top_k")
+            top_k = None if top_k is None else int(top_k)
+            top_p = kwargs.get("top_p")
+            top_p = None if top_p is None else float(top_p)
+            batcher = self._batchers.get((mnt, temp, top_k, top_p))
             if batcher is None:
                 from pathway_tpu.utils.batching import AsyncMicroBatcher
 
@@ -254,13 +261,17 @@ class JaxChat(BaseChat):
                 # long, so batches run in a thread to keep the loop live
                 batcher = AsyncMicroBatcher(
                     lambda prompts: lm.generate_many(
-                        prompts, max_new_tokens=mnt, temperature=temp
+                        prompts,
+                        max_new_tokens=mnt,
+                        temperature=temp,
+                        top_k=top_k,
+                        top_p=top_p,
                     ),
                     max_batch_size=self.max_batch,
                     flush_delay=0.01,
                     run_in_thread=True,
                 )
-                self._batchers[(mnt, temp)] = batcher
+                self._batchers[(mnt, temp, top_k, top_p)] = batcher
             return await batcher.submit(_messages_to_prompt(messages))
 
         self.__wrapped__ = chat

@@ -1,0 +1,101 @@
+"""On-device sampling knobs (sample_logits: temperature, top-k, top-p).
+
+Pinned: top_k=1 is argmax, tiny top_p is argmax, samples always fall in
+the allowed truncated set, the first token always survives top-p, and
+the serving surface is deterministic per seed.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pathway_tpu.models.decoder import DecoderLM, sample_logits
+
+
+def _logits(rng, b=64, v=32):
+    return jnp.asarray(rng.normal(size=(b, v)) * 3.0, jnp.float32)
+
+
+def test_top_k_1_and_tiny_top_p_are_argmax():
+    lg = _logits(np.random.default_rng(0))
+    want = np.argmax(np.asarray(lg), -1)
+    key = jax.random.PRNGKey(0)
+    np.testing.assert_array_equal(
+        np.asarray(sample_logits(lg, key, jnp.float32(1.0), top_k=1)), want
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sample_logits(lg, key, jnp.float32(1.0), top_p=1e-9)), want
+    )
+
+
+def test_top_k_samples_stay_in_top_k_set():
+    rng = np.random.default_rng(1)
+    lg = _logits(rng)
+    k = 5
+    allowed = np.argsort(np.asarray(lg), -1)[:, -k:]
+    for seed in range(8):
+        toks = np.asarray(
+            sample_logits(lg, jax.random.PRNGKey(seed), jnp.float32(1.0), top_k=k)
+        )
+        for b in range(lg.shape[0]):
+            assert toks[b] in allowed[b]
+
+
+def test_top_p_samples_stay_in_nucleus():
+    rng = np.random.default_rng(2)
+    lg = _logits(rng)
+    p = 0.6
+    probs = np.asarray(jax.nn.softmax(lg, -1))
+    order = np.argsort(-probs, -1)
+    for seed in range(8):
+        toks = np.asarray(
+            sample_logits(lg, jax.random.PRNGKey(seed), jnp.float32(1.0), top_p=p)
+        )
+        for b in range(lg.shape[0]):
+            sorted_probs = probs[b][order[b]]
+            before = np.cumsum(sorted_probs) - sorted_probs
+            nucleus = set(order[b][before < p].tolist())
+            assert int(toks[b]) in nucleus
+
+
+def test_peaked_distribution_survives_top_p():
+    # one token with ~all the mass: nucleus is that single token
+    lg = jnp.full((2, 16), -10.0).at[:, 3].set(10.0)
+    toks = sample_logits(lg, jax.random.PRNGKey(0), jnp.float32(1.0), top_p=0.5)
+    assert toks.tolist() == [3, 3]
+
+
+def test_boundary_top_p_zero_and_oversized_top_k():
+    lg = _logits(np.random.default_rng(3), b=8, v=16)
+    want = np.argmax(np.asarray(lg), -1)
+    key = jax.random.PRNGKey(0)
+    # top_p=0.0 degrades to argmax (top token forced alive), not an
+    # empty distribution
+    np.testing.assert_array_equal(
+        np.asarray(sample_logits(lg, key, jnp.float32(1.0), top_p=0.0)), want
+    )
+    # oversized top_k clamps to the vocab (no truncation) instead of
+    # crashing the trace
+    toks = sample_logits(lg, key, jnp.float32(1.0), top_k=10_000)
+    assert np.asarray(toks).shape == (8,)
+
+
+def test_traced_top_p_shares_one_compile():
+    lm = DecoderLM("pw-tiny-decoder", max_cache=64, eos_id=None)
+    lm.generate_ids([[5, 9]], max_new_tokens=4, temperature=0.9, top_p=0.9)
+    n = len(lm._chunk_fns)
+    lm.generate_ids([[5, 9]], max_new_tokens=4, temperature=0.9, top_p=0.73)
+    lm.generate_ids([[5, 9]], max_new_tokens=4, temperature=0.9, top_p=0.42)
+    assert len(lm._chunk_fns) == n  # top_p is traced, not baked in
+
+
+def test_generation_with_knobs_is_deterministic():
+    lm = DecoderLM("pw-tiny-decoder", max_cache=64, eos_id=None)
+    a = lm.generate_ids([[5, 9, 3]], max_new_tokens=8, temperature=0.9,
+                        seed=7, top_k=10, top_p=0.9)
+    b = lm.generate_ids([[5, 9, 3]], max_new_tokens=8, temperature=0.9,
+                        seed=7, top_k=10, top_p=0.9)
+    assert a == b
+    c = lm.generate_ids([[5, 9, 3]], max_new_tokens=8, temperature=0.9, seed=8,
+                        top_k=10, top_p=0.9)
+    assert len(c[0]) == 8
