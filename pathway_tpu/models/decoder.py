@@ -529,16 +529,29 @@ def decode_step(tree, k_cache, v_cache, token, pos, cfg: DecoderConfig):
 
 
 def sample_logits(logits, key, temp, *, top_k: int | None = None,
-                  top_p: float | None = None):
-    """On-device sampling: temperature, then optional top-k and nucleus
-    (top-p) truncation, then categorical.  ``logits [B, V]`` f32.
+                  top_p: float | None = None, min_p: float | None = None):
+    """On-device sampling: temperature, then optional top-k / nucleus
+    (top-p) / min-p truncation, then categorical.  ``logits [B, V]`` f32.
 
     top-p keeps the smallest probability-sorted prefix whose mass reaches
     ``top_p`` (the first token always survives, so the distribution is
-    never empty); both filters set rejected logits to -inf BEFORE the
-    categorical draw, all inside the compiled program.
+    never empty); min-p keeps tokens whose probability is at least
+    ``min_p ×`` the top token's (the relative cutoff that adapts to how
+    peaked the distribution is).  All filters set rejected logits to
+    -inf BEFORE the categorical draw, inside the compiled program;
+    ``top_p``/``min_p`` may be traced scalars.
     """
     lg = logits / temp
+    if min_p is not None:
+        # log-space form of probs < min_p * max(probs): the softmax
+        # normalizer cancels, so one max-reduce replaces a full-vocab
+        # softmax in the per-token loop.  The clamp makes min_p > 1 (bad
+        # client value) degrade to argmax-only, never an empty
+        # distribution; min_p = 0 gives log 0 = -inf → a no-op.
+        cut = jnp.max(lg, axis=-1, keepdims=True) + jnp.log(
+            jnp.minimum(min_p, 1.0)
+        )
+        lg = jnp.where(lg < cut, -jnp.inf, lg)
     if top_k is not None:
         # clamp: an oversized k (unvalidated client kwarg) must degrade to
         # "no truncation", not crash the whole serving micro-batch
@@ -575,6 +588,7 @@ def decode_chunk(
     eos_id: int | None,
     top_k: int | None = None,
     top_p: float | None = None,
+    min_p: float | None = None,
 ):
     """``n_steps`` generation steps fused into ONE device program.
 
@@ -597,7 +611,9 @@ def decode_chunk(
         if greedy:
             tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
-            tok = sample_logits(logits, sub, temp, top_k=top_k, top_p=top_p)
+            tok = sample_logits(
+                logits, sub, temp, top_k=top_k, top_p=top_p, min_p=min_p
+            )
         if eos_id is not None:
             stop = tok == eos_id
         else:
@@ -861,29 +877,29 @@ class DecoderLM:
         self._draft_tree = None
         self._spec_fns: dict[int, Any] = {}
 
-    def _chunk_fn(self, greedy: bool, n_steps: int,
-                  top_k: int | None, has_top_p: bool):
-        # top_k must be static (lax.top_k shape) but top_p is TRACED — a
-        # serving client sweeping top_p must not recompile per value, so
-        # the cache keys only whether a nucleus arg exists
-        cache_key = (greedy, n_steps, top_k, has_top_p)
+    def _chunk_fn(self, greedy: bool, n_steps: int, top_k: int | None,
+                  has_top_p: bool, has_min_p: bool = False):
+        # top_k must be static (lax.top_k shape) but top_p/min_p are
+        # TRACED — a serving client sweeping them must not recompile per
+        # value, so the cache keys only which knobs exist (their filters
+        # cost a sort/softmax, so absent knobs compile leaner programs)
+        cache_key = (greedy, n_steps, top_k, has_top_p, has_min_p)
         fn = self._chunk_fns.get(cache_key)
         if fn is None:
             cfg = self.config
-            if has_top_p:
-                fn = jax.jit(
-                    lambda t, kc, vc, lg, pos, done, key, temp, tp: decode_chunk(
-                        t, kc, vc, lg, pos, done, key, temp, cfg,
-                        n_steps, greedy, self.eos_id, top_k, tp,
-                    )
+            eos_id = self.eos_id
+
+            def chunk(t, kc, vc, lg, pos, done, key, temp, *extra):
+                i = 0
+                tp = extra[i] if has_top_p else None
+                i += int(has_top_p)
+                mp = extra[i] if has_min_p else None
+                return decode_chunk(
+                    t, kc, vc, lg, pos, done, key, temp, cfg,
+                    n_steps, greedy, eos_id, top_k, tp, mp,
                 )
-            else:
-                fn = jax.jit(
-                    lambda t, kc, vc, lg, pos, done, key, temp: decode_chunk(
-                        t, kc, vc, lg, pos, done, key, temp, cfg,
-                        n_steps, greedy, self.eos_id, top_k, None,
-                    )
-                )
+
+            fn = jax.jit(chunk)
             self._chunk_fns[cache_key] = fn
         return fn
 
@@ -900,13 +916,14 @@ class DecoderLM:
         seed: int = 0,
         top_k: int | None = None,
         top_p: float | None = None,
+        min_p: float | None = None,
     ) -> list[list[int]]:
         """Batched generation; returns the newly generated ids per row.
 
-        ``top_k``/``top_p`` truncate the sampling distribution on device
-        (only meaningful with ``temperature > 0``).  Prompts longer than
-        the cache budget keep their TAIL (the recent context — the part
-        chat serving cares about)."""
+        ``top_k``/``top_p``/``min_p`` truncate the sampling distribution
+        on device (only meaningful with ``temperature > 0``).  Prompts
+        longer than the cache budget keep their TAIL (the recent context
+        — the part chat serving cares about)."""
         if max_new_tokens >= self.max_cache:
             raise ValueError(
                 f"max_new_tokens={max_new_tokens} must be < max_cache={self.max_cache}"
@@ -937,8 +954,10 @@ class DecoderLM:
             args = (self.params, kc, vc, logits, pos, done, key, temp)
             if top_p is not None:
                 args += (jnp.float32(top_p),)
+            if min_p is not None:
+                args += (jnp.float32(min_p),)
             toks, valids, logits, kc, vc, pos, done, key = self._chunk_fn(
-                greedy, K, top_k, top_p is not None
+                greedy, K, top_k, top_p is not None, min_p is not None
             )(*args)
             # one host sync per chunk (vs one per token): tokens, validity
             # and the done flags arrive together
@@ -1035,10 +1054,12 @@ class DecoderLM:
         seed: int = 0,
         top_k: int | None = None,
         top_p: float | None = None,
+        min_p: float | None = None,
     ) -> str:
         ids = self._encode_prompt(prompt)
         new_ids = self.generate_ids(
-            [ids], max_new_tokens, temperature, seed, top_k=top_k, top_p=top_p
+            [ids], max_new_tokens, temperature, seed,
+            top_k=top_k, top_p=top_p, min_p=min_p,
         )[0]
         return self.tokenizer.decode(new_ids)
 
@@ -1057,11 +1078,13 @@ class DecoderLM:
         seed: int = 0,
         top_k: int | None = None,
         top_p: float | None = None,
+        min_p: float | None = None,
     ) -> list[str]:
         """One padded ragged batch through prefill+decode for all prompts."""
         id_lists = [self._encode_prompt(p) for p in prompts]
         outs = self.generate_ids(
-            id_lists, max_new_tokens, temperature, seed, top_k=top_k, top_p=top_p
+            id_lists, max_new_tokens, temperature, seed,
+            top_k=top_k, top_p=top_p, min_p=min_p,
         )
         return [self.tokenizer.decode(o) for o in outs]
 

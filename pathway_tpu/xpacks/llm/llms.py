@@ -253,7 +253,9 @@ class JaxChat(BaseChat):
             top_k = None if top_k is None else int(top_k)
             top_p = kwargs.get("top_p")
             top_p = None if top_p is None else float(top_p)
-            batcher = self._batchers.get((mnt, temp, top_k, top_p))
+            min_p = kwargs.get("min_p")
+            min_p = None if min_p is None else float(min_p)
+            batcher = self._batchers.get((mnt, temp, top_k, top_p, min_p))
             if batcher is None:
                 from pathway_tpu.utils.batching import AsyncMicroBatcher
 
@@ -266,12 +268,13 @@ class JaxChat(BaseChat):
                         temperature=temp,
                         top_k=top_k,
                         top_p=top_p,
+                        min_p=min_p,
                     ),
                     max_batch_size=self.max_batch,
                     flush_delay=0.01,
                     run_in_thread=True,
                 )
-                self._batchers[(mnt, temp, top_k, top_p)] = batcher
+                self._batchers[(mnt, temp, top_k, top_p, min_p)] = batcher
             return await batcher.submit(_messages_to_prompt(messages))
 
         self.__wrapped__ = chat

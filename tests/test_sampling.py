@@ -89,6 +89,40 @@ def test_traced_top_p_shares_one_compile():
     assert len(lm._chunk_fns) == n  # top_p is traced, not baked in
 
 
+def test_min_p_relative_cutoff():
+    # peaked distribution: min_p keeps only tokens near the max
+    lg = jnp.asarray([[10.0, 9.9, 5.0, 0.0]], jnp.float32)
+    key = jax.random.PRNGKey(0)
+    for seed in range(16):
+        tok = int(
+            sample_logits(lg, jax.random.PRNGKey(seed), jnp.float32(1.0), min_p=0.5)[0]
+        )
+        assert tok in (0, 1)  # token 2 is e^-5 of the max — cut
+    # min_p > 1 degrades to argmax, never an empty distribution
+    np.testing.assert_array_equal(
+        np.asarray(sample_logits(lg, key, jnp.float32(1.0), min_p=5.0)), [0]
+    )
+    # min_p=0 is a no-op (full distribution reachable)
+    seen = {
+        int(sample_logits(lg * 0, jax.random.PRNGKey(s), jnp.float32(1.0), min_p=0.0)[0])
+        for s in range(64)
+    }
+    assert len(seen) == 4
+
+
+def test_min_p_generation_traced_and_deterministic():
+    lm = DecoderLM("pw-tiny-decoder", max_cache=64, eos_id=None)
+    a = lm.generate_ids([[5, 9, 3]], max_new_tokens=6, temperature=0.9,
+                        seed=3, min_p=0.1)
+    b = lm.generate_ids([[5, 9, 3]], max_new_tokens=6, temperature=0.9,
+                        seed=3, min_p=0.1)
+    assert a == b and len(a[0]) == 6
+    n = len(lm._chunk_fns)
+    lm.generate_ids([[5, 9, 3]], max_new_tokens=6, temperature=0.9,
+                    seed=3, min_p=0.4)
+    assert len(lm._chunk_fns) == n  # min_p traced, no recompile
+
+
 def test_generation_with_knobs_is_deterministic():
     lm = DecoderLM("pw-tiny-decoder", max_cache=64, eos_id=None)
     a = lm.generate_ids([[5, 9, 3]], max_new_tokens=8, temperature=0.9,
