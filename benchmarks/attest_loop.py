@@ -132,7 +132,10 @@ def attest_once() -> bool:
         print(f"attest_loop: retrieval capture failed: {exc}", file=sys.stderr)
     # decoder serving throughput (tinyllama-class prefill + cached decode)
     try:
-        dec = _run_json_bench("decoder_throughput.py")
+        # cold windows compile four decode programs (float/int8 chunks,
+        # spec round, prefill) through the tunnel — give it headroom; the
+        # persistent XLA cache makes later windows fast
+        dec = _run_json_bench("decoder_throughput.py", timeout=1200)
         if dec is not None and dec.get("platform") == "tpu":
             dec["attested_at_utc"] = stamp
             dec["git_head"] = head
@@ -151,12 +154,12 @@ def _run_retrieval() -> dict | None:
     return _run_json_bench("retrieval_latency.py", "625000")
 
 
-def _run_json_bench(script: str, *args: str) -> dict | None:
+def _run_json_bench(script: str, *args: str, timeout: int = 580) -> dict | None:
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "benchmarks", script), *args],
         capture_output=True,
         text=True,
-        timeout=580,
+        timeout=timeout,
         cwd=REPO,
     )
     for line in reversed((proc.stdout or "").strip().splitlines()):
