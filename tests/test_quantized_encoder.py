@@ -1,0 +1,105 @@
+"""W8A8 quantized encoder serving (models/encoder.py).
+
+Pinned: quantized embeddings agree closely with the bf16 path (cosine
+> 0.99 on every row), stay unit-norm, preserve nearest-neighbor
+structure on a small corpus, and plain trees are untouched by _qdot.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pathway_tpu.models.encoder import (
+    EncoderConfig,
+    SentenceEncoderModule,
+    fused_sentence_apply,
+    pack_fast_params,
+    quantize_encoder_tree,
+)
+
+CFG = EncoderConfig(
+    vocab_size=512, hidden=64, layers=2, heads=4, intermediate=128, max_len=64
+)
+
+
+def _tree(seed=0):
+    module = SentenceEncoderModule(CFG)
+    params = module.init(
+        jax.random.PRNGKey(seed),
+        jnp.zeros((1, 8), jnp.int32),
+        jnp.ones((1, 8), jnp.int32),
+    )
+    return pack_fast_params(params, CFG)
+
+
+def _batch(rng, b=16, s=24):
+    ids = rng.integers(1, CFG.vocab_size, size=(b, s)).astype(np.int32)
+    mask = np.ones((b, s), np.int32)
+    mask[:, s - 4 :] = 0  # ragged tail
+    return jnp.asarray(ids), jnp.asarray(mask)
+
+
+def test_quantized_embeddings_agree_with_bf16():
+    tree = _tree()
+    qtree = quantize_encoder_tree(tree)
+    ids, mask = _batch(np.random.default_rng(0))
+    ref = np.asarray(fused_sentence_apply(tree, ids, mask, CFG), np.float32)
+    got = np.asarray(fused_sentence_apply(qtree, ids, mask, CFG), np.float32)
+    cos = (ref * got).sum(-1)  # both unit-norm
+    assert cos.min() > 0.99, cos.min()
+    np.testing.assert_allclose(np.linalg.norm(got, axis=1), 1.0, atol=1e-3)
+
+
+def test_quantized_preserves_neighbor_structure():
+    tree = _tree(seed=1)
+    qtree = quantize_encoder_tree(tree)
+    rng = np.random.default_rng(1)
+    ids, mask = _batch(rng, b=32)
+    ref = np.asarray(fused_sentence_apply(tree, ids, mask, CFG), np.float32)
+    got = np.asarray(fused_sentence_apply(qtree, ids, mask, CFG), np.float32)
+    # top-3 neighbors (excluding self) mostly identical under both
+    def top3(emb):
+        scores = emb @ emb.T
+        np.fill_diagonal(scores, -np.inf)
+        return np.argsort(-scores, axis=1)[:, :3]
+
+    a, b = top3(ref), top3(got)
+    overlap = np.mean([len(set(x) & set(y)) / 3 for x, y in zip(a, b)])
+    assert overlap > 0.85, overlap
+
+
+def test_sentence_encoder_quantize_surface():
+    from pathway_tpu.models.encoder import SentenceEncoder
+
+    import pytest
+
+    q = SentenceEncoder("all-MiniLM-L6-v2", max_batch=8, quantize="int8")
+    f = SentenceEncoder("all-MiniLM-L6-v2", max_batch=8)
+    a = q.encode(["hello world", "quantized serving"])
+    b = f.encode(["hello world", "quantized serving"])
+    cos = (a * b).sum(-1)
+    assert cos.min() > 0.99, cos
+    with pytest.raises(ValueError, match="int8"):
+        SentenceEncoder("all-MiniLM-L6-v2", quantize="fp4")
+
+
+def test_env_quantize_skips_cross_encoder(monkeypatch):
+    from pathway_tpu.models.encoder import CrossEncoder, SentenceEncoder
+
+    monkeypatch.setenv("PATHWAY_ENCODER_QUANTIZE", "int8")
+    assert SentenceEncoder("all-MiniLM-L6-v2", max_batch=8)._quantize == "int8"
+    # rerankers only quantize by explicit opt-in (score fidelity unpinned)
+    assert CrossEncoder(max_batch=8)._quantize is None
+    assert CrossEncoder(max_batch=8, quantize="int8")._quantize == "int8"
+
+
+def test_weight_roundtrip_within_scale():
+    tree = _tree(seed=2)
+    qtree = quantize_encoder_tree(tree)
+    w = np.asarray(tree["layers"][0]["ff1_k"], np.float32)
+    lp = qtree["layers"][0]["ff1_k"]
+    deq = np.asarray(lp["q"], np.float32) * np.asarray(lp["s"])
+    assert np.all(np.abs(deq - w) <= 0.51 * np.asarray(lp["s"]) + 1e-8)
+    # non-matmul leaves untouched
+    assert qtree["layers"][0]["qkv_b"] is tree["layers"][0]["qkv_b"]
+    assert qtree["emb_word"] is tree["emb_word"]
