@@ -82,6 +82,14 @@ def test_speculative_respects_eos():
     assert eos not in got[0]
 
 
+def test_speculative_matches_greedy_on_moe_decoder():
+    lm = DecoderLM("pw-tiny-moe-decoder", max_cache=64, eos_id=None)
+    prompts = [[5, 9, 3], [7, 11]]
+    want = lm.generate_ids(prompts, max_new_tokens=8)
+    got = lm.generate_ids_speculative(prompts, max_new_tokens=8, n_draft=4)
+    assert got == want
+
+
 def test_speculative_rejects_quantized_target():
     lm = DecoderLM("pw-tiny-decoder", max_cache=64, quantize="int8")
     with pytest.raises(ValueError, match="float tree"):
