@@ -573,6 +573,15 @@ def sample_logits(logits, key, temp, *, top_k: int | None = None,
     return jax.random.categorical(key, lg, axis=-1).astype(jnp.int32)
 
 
+def apply_repetition_penalty(logits, seen, penalty):
+    """HF-semantics repetition penalty: logits of already-generated tokens
+    (``seen [B, V]`` bool) divide by ``penalty`` when positive, multiply
+    when negative — pushing repeats down regardless of sign.  ``penalty``
+    may be a traced scalar; 1.0 is a no-op."""
+    scaled = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen, scaled, logits)
+
+
 def decode_chunk(
     tree,
     k_cache,
@@ -589,6 +598,8 @@ def decode_chunk(
     top_k: int | None = None,
     top_p: float | None = None,
     min_p: float | None = None,
+    rep_penalty=None,
+    seen=None,
 ):
     """``n_steps`` generation steps fused into ONE device program.
 
@@ -605,14 +616,21 @@ def decode_chunk(
     matching the per-token host loop this replaces.
     """
 
+    use_rep = rep_penalty is not None
+
     def body(carry, _):
-        logits, kc, vc, pos, done, key = carry
+        if use_rep:
+            logits, kc, vc, pos, done, key, seen = carry
+            lg_eff = apply_repetition_penalty(logits, seen, rep_penalty)
+        else:
+            logits, kc, vc, pos, done, key = carry
+            lg_eff = logits
         key, sub = jax.random.split(key)
         if greedy:
-            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            tok = jnp.argmax(lg_eff, axis=-1).astype(jnp.int32)
         else:
             tok = sample_logits(
-                logits, sub, temp, top_k=top_k, top_p=top_p, min_p=min_p
+                lg_eff, sub, temp, top_k=top_k, top_p=top_p, min_p=min_p
             )
         if eos_id is not None:
             stop = tok == eos_id
@@ -622,12 +640,21 @@ def decode_chunk(
         done = jnp.logical_or(done, stop)
         logits, kc, vc = decode_step(tree, kc, vc, tok, pos, cfg)
         pos = pos + 1
+        if use_rep:
+            seen = jnp.logical_or(
+                seen, jax.nn.one_hot(tok, lg_eff.shape[-1], dtype=bool)
+            )
+            return (logits, kc, vc, pos, done, key, seen), (tok, valid)
         return (logits, kc, vc, pos, done, key), (tok, valid)
 
     carry = (logits, k_cache, v_cache, pos, done, key)
-    (logits, k_cache, v_cache, pos, done, key), (toks, valids) = lax.scan(
-        body, carry, None, length=n_steps
-    )
+    if use_rep:
+        carry = carry + (seen,)
+    carry, (toks, valids) = lax.scan(body, carry, None, length=n_steps)
+    if use_rep:
+        logits, k_cache, v_cache, pos, done, key, seen = carry
+        return toks, valids, logits, k_cache, v_cache, pos, done, key, seen
+    logits, k_cache, v_cache, pos, done, key = carry
     return toks, valids, logits, k_cache, v_cache, pos, done, key
 
 
@@ -878,12 +905,14 @@ class DecoderLM:
         self._spec_fns: dict[int, Any] = {}
 
     def _chunk_fn(self, greedy: bool, n_steps: int, top_k: int | None,
-                  has_top_p: bool, has_min_p: bool = False):
-        # top_k must be static (lax.top_k shape) but top_p/min_p are
-        # TRACED — a serving client sweeping them must not recompile per
-        # value, so the cache keys only which knobs exist (their filters
-        # cost a sort/softmax, so absent knobs compile leaner programs)
-        cache_key = (greedy, n_steps, top_k, has_top_p, has_min_p)
+                  has_top_p: bool, has_min_p: bool = False,
+                  has_rep: bool = False):
+        # top_k must be static (lax.top_k shape) but top_p/min_p/the
+        # repetition penalty are TRACED — a serving client sweeping them
+        # must not recompile per value, so the cache keys only which
+        # knobs exist (their filters cost a sort/softmax/[B,V] mask, so
+        # absent knobs compile leaner programs)
+        cache_key = (greedy, n_steps, top_k, has_top_p, has_min_p, has_rep)
         fn = self._chunk_fns.get(cache_key)
         if fn is None:
             cfg = self.config
@@ -894,9 +923,12 @@ class DecoderLM:
                 tp = extra[i] if has_top_p else None
                 i += int(has_top_p)
                 mp = extra[i] if has_min_p else None
+                i += int(has_min_p)
+                rp = extra[i] if has_rep else None
+                sn = extra[i + 1] if has_rep else None
                 return decode_chunk(
                     t, kc, vc, lg, pos, done, key, temp, cfg,
-                    n_steps, greedy, eos_id, top_k, tp, mp,
+                    n_steps, greedy, eos_id, top_k, tp, mp, rp, sn,
                 )
 
             fn = jax.jit(chunk)
@@ -917,16 +949,26 @@ class DecoderLM:
         top_k: int | None = None,
         top_p: float | None = None,
         min_p: float | None = None,
+        repetition_penalty: float | None = None,
     ) -> list[list[int]]:
         """Batched generation; returns the newly generated ids per row.
 
         ``top_k``/``top_p``/``min_p`` truncate the sampling distribution
-        on device (only meaningful with ``temperature > 0``).  Prompts
-        longer than the cache budget keep their TAIL (the recent context
-        — the part chat serving cares about)."""
+        on device (only meaningful with ``temperature > 0``);
+        ``repetition_penalty`` (HF semantics, > 1 discourages repeats)
+        penalizes every token already in the prompt or generated so far.
+        Prompts longer than the cache budget keep their TAIL (the recent
+        context — the part chat serving cares about)."""
         if max_new_tokens >= self.max_cache:
             raise ValueError(
                 f"max_new_tokens={max_new_tokens} must be < max_cache={self.max_cache}"
+            )
+        if repetition_penalty is not None and repetition_penalty <= 0:
+            # HF semantics: penalty 0 would divide logits by zero (turning
+            # repeats into the unconditional winner) and negatives flip
+            # the sign branches — reject like RepetitionPenaltyLogitsProcessor
+            raise ValueError(
+                f"repetition_penalty must be > 0, got {repetition_penalty}"
             )
         B = len(prompt_ids)
         limit = self.max_cache - max_new_tokens
@@ -944,6 +986,18 @@ class DecoderLM:
         done = jnp.zeros(B, bool)
         temp = jnp.float32(temperature if temperature > 0.0 else 1.0)
         greedy = temperature <= 0.0
+        seen = None
+        if repetition_penalty is not None:
+            # HF counts the prompt too: mark every real prompt token
+            valid_pos = np.zeros((B, S), bool)
+            for i, p in enumerate(prompt_ids):
+                valid_pos[i, : len(p)] = True
+            seen0 = np.zeros((B, self.config.vocab_size), bool)
+            rows = np.repeat(np.arange(B), S)
+            np.maximum.at(
+                seen0, (rows, ids.reshape(-1)), valid_pos.reshape(-1)
+            )
+            seen = jnp.asarray(seen0)
         out: list[list[int]] = [[] for _ in range(B)]
         produced = 0
         while produced < max_new_tokens:
@@ -956,9 +1010,16 @@ class DecoderLM:
                 args += (jnp.float32(top_p),)
             if min_p is not None:
                 args += (jnp.float32(min_p),)
-            toks, valids, logits, kc, vc, pos, done, key = self._chunk_fn(
-                greedy, K, top_k, top_p is not None, min_p is not None
+            if repetition_penalty is not None:
+                args += (jnp.float32(repetition_penalty), seen)
+            res = self._chunk_fn(
+                greedy, K, top_k, top_p is not None, min_p is not None,
+                repetition_penalty is not None,
             )(*args)
+            if repetition_penalty is not None:
+                toks, valids, logits, kc, vc, pos, done, key, seen = res
+            else:
+                toks, valids, logits, kc, vc, pos, done, key = res
             # one host sync per chunk (vs one per token): tokens, validity
             # and the done flags arrive together
             htoks = np.asarray(toks)
@@ -1055,11 +1116,13 @@ class DecoderLM:
         top_k: int | None = None,
         top_p: float | None = None,
         min_p: float | None = None,
+        repetition_penalty: float | None = None,
     ) -> str:
         ids = self._encode_prompt(prompt)
         new_ids = self.generate_ids(
             [ids], max_new_tokens, temperature, seed,
             top_k=top_k, top_p=top_p, min_p=min_p,
+            repetition_penalty=repetition_penalty,
         )[0]
         return self.tokenizer.decode(new_ids)
 
@@ -1079,12 +1142,14 @@ class DecoderLM:
         top_k: int | None = None,
         top_p: float | None = None,
         min_p: float | None = None,
+        repetition_penalty: float | None = None,
     ) -> list[str]:
         """One padded ragged batch through prefill+decode for all prompts."""
         id_lists = [self._encode_prompt(p) for p in prompts]
         outs = self.generate_ids(
             id_lists, max_new_tokens, temperature, seed,
             top_k=top_k, top_p=top_p, min_p=min_p,
+            repetition_penalty=repetition_penalty,
         )
         return [self.tokenizer.decode(o) for o in outs]
 

@@ -255,7 +255,10 @@ class JaxChat(BaseChat):
             top_p = None if top_p is None else float(top_p)
             min_p = kwargs.get("min_p")
             min_p = None if min_p is None else float(min_p)
-            batcher = self._batchers.get((mnt, temp, top_k, top_p, min_p))
+            rep = kwargs.get("repetition_penalty")
+            rep = None if rep is None else float(rep)
+            bkey = (mnt, temp, top_k, top_p, min_p, rep)
+            batcher = self._batchers.get(bkey)
             if batcher is None:
                 from pathway_tpu.utils.batching import AsyncMicroBatcher
 
@@ -269,12 +272,13 @@ class JaxChat(BaseChat):
                         top_k=top_k,
                         top_p=top_p,
                         min_p=min_p,
+                        repetition_penalty=rep,
                     ),
                     max_batch_size=self.max_batch,
                     flush_delay=0.01,
                     run_in_thread=True,
                 )
-                self._batchers[(mnt, temp, top_k, top_p, min_p)] = batcher
+                self._batchers[bkey] = batcher
             return await batcher.submit(_messages_to_prompt(messages))
 
         self.__wrapped__ = chat
