@@ -337,6 +337,12 @@ class InputNode(Node):
         super().__init__(scope)
         self._staged: dict[Time, list[Delta]] = defaultdict(list)
         self._staged_wallclock: dict[Time, float] = {}
+        # hot-bucket cache: streams insert runs of rows at one time, so
+        # the common insert() is a single list append (no dict lookups,
+        # no wallclock check).  Invalidate wherever staged lists are
+        # popped or re-filed (merge_staged_through / emit_time).
+        self._hot_time: Time | None = None
+        self._hot_list: list[Delta] | None = None
         self.finished = False
         # upsert sessions key rows and treat same-key insert as replace
         self.upsert = False
@@ -356,8 +362,26 @@ class InputNode(Node):
                 "declares append_only=True but the source produced a "
                 "deletion"
             )
-        self._staged[time].append((key, row, diff))
-        self._staged_wallclock.setdefault(time, _monotonic())
+        if time == self._hot_time:
+            self._hot_list.append((key, row, diff))
+            return
+        lst = self._staged[time]
+        lst.append((key, row, diff))
+        self._hot_time, self._hot_list = time, lst
+        if time not in self._staged_wallclock:
+            self._staged_wallclock[time] = _monotonic()
+
+    def take_staged(self, time: Time, default=None):
+        """Pop a staged bucket.  EVERY external pop must come through here
+        (or ``put_staged``): both invalidate the hot-bucket insert cache,
+        which otherwise keeps appending to the orphaned list object."""
+        self._hot_time = self._hot_list = None
+        return self._staged.pop(time, default)
+
+    def put_staged(self, time: Time, deltas: list) -> None:
+        """Re-file a bucket (see ``take_staged``)."""
+        self._hot_time = self._hot_list = None
+        self._staged[time] = deltas
 
     def pending_times(self) -> list[Time]:
         return sorted(self._staged.keys())
@@ -366,6 +390,7 @@ class InputNode(Node):
         """Fold rows staged at earlier times into epoch ``time`` (the runner
         picks one commit timestamp across all inputs), keeping the earliest
         ingest wallclock so latency probes measure from first arrival."""
+        self._hot_time = self._hot_list = None
         below = [st for st in self._staged if st <= time]
         if len(below) == 1:
             # single staged bucket: move the list object itself so a
@@ -395,26 +420,31 @@ class InputNode(Node):
         if wall is not None:
             ew = self.scope.epoch_wallclock
             ew[time] = min(ew.get(time, wall), wall)
-        deltas = self._staged.pop(time, [])
+        deltas = self.take_staged(time, [])
         if self.upsert:
             # multiple updates of one key within an epoch must chain
             # (each retracts the PREVIOUS value, not the epoch-start one):
             # `seen` overlays committed state with this epoch's staged rows
-            out = []
-            seen: dict[int, Row | None] = {}
-            state_get = self.state.get
-            _MISS = object()
-            for key, row, diff in deltas:
-                prev = seen.get(key, _MISS)
-                if prev is _MISS:
-                    prev = state_get(key)
-                if prev is not None:
-                    out.append((key, prev, -1))
-                if diff > 0:
-                    out.append((key, row, 1))
-                    seen[key] = row
-                else:
-                    seen[key] = None
+            nat = _get_native_module()
+            chain = getattr(nat, "upsert_chain", None) if nat else None
+            if chain is not None and isinstance(self.state, dict):
+                out = chain(deltas, self.state)
+            else:
+                out = []
+                seen: dict[int, Row | None] = {}
+                state_get = self.state.get
+                _MISS = object()
+                for key, row, diff in deltas:
+                    prev = seen.get(key, _MISS)
+                    if prev is _MISS:
+                        prev = state_get(key)
+                    if prev is not None:
+                        out.append((key, prev, -1))
+                    if diff > 0:
+                        out.append((key, row, 1))
+                        seen[key] = row
+                    else:
+                        seen[key] = None
             deltas = consolidate(out)
             self._update_state(deltas)
         else:
@@ -1914,7 +1944,7 @@ class IterateNode(Node):
             # next epoch (or finish) and exceeding the round budget
             for idx, iin in enumerate(self.iter_inputs):
                 acc = self._input_acc[idx]
-                for key, row, d in iin._staged.pop(0, []):
+                for key, row, d in iin.take_staged(0, []):
                     acc[(key, row)] -= d
                     if acc[(key, row)] == 0:
                         del acc[(key, row)]

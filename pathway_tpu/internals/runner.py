@@ -545,10 +545,10 @@ def _event_loop_coordinated(
         # route each staged row to the worker owning its key shard; a
         # non-partitioned source read on worker 0 scatters here
         for inp in inputs:
-            staged = inp._staged.pop(t, [])
+            staged = inp.take_staged(t, [])
             merged = ctx.exchange_deltas(("in", inp.id, t), staged, None)
             if merged:
-                inp._staged[t] = merged
+                inp.put_staged(t, merged)
             inp.emit_time(t)
         result.epoch_failed = True
         scope.run_epoch(t)

@@ -816,6 +816,98 @@ static PyObject *py_consolidate_dirty(PyObject *, PyObject *arg) {
 // setup & module def
 // ---------------------------------------------------------------------------
 
+// ---------------------------------------------------------------------------
+// upsert_chain: the per-row half of InputNode.emit_time's upsert session
+// (dataflow.py).  For each (key, row, diff): retract the key's previous
+// value — this epoch's staged overlay first, then committed state — and
+// (diff > 0) insert the new row.  Keys are engine 128-bit ints (PyLong),
+// so the dict lookups cannot re-enter Python.  Returns the new delta list
+// (retraction-before-insert per key, in arrival order).
+// ---------------------------------------------------------------------------
+
+static PyObject *py_upsert_chain(PyObject *, PyObject *args) {
+  PyObject *deltas, *state;
+  if (!PyArg_ParseTuple(args, "OO", &deltas, &state)) return nullptr;
+  if (!PyDict_Check(state)) {
+    PyErr_SetString(PyExc_TypeError, "state must be a dict");
+    return nullptr;
+  }
+  PyObject *seq = PySequence_List(deltas);
+  if (!seq) return nullptr;
+  PyObject *seen = PyDict_New();
+  PyObject *out = PyList_New(0);
+  PyObject *one = PyLong_FromLong(1);
+  PyObject *neg_one = PyLong_FromLong(-1);
+  auto fail = [&]() -> PyObject * {
+    Py_XDECREF(seen);
+    Py_XDECREF(out);
+    Py_XDECREF(one);
+    Py_XDECREF(neg_one);
+    Py_DECREF(seq);
+    return nullptr;
+  };
+  if (!seen || !out || !one || !neg_one) return fail();
+  Py_ssize_t n = PyList_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *fast = PySequence_Fast(PyList_GET_ITEM(seq, i),
+                                     "delta must be (key, row, diff)");
+    if (!fast) return fail();
+    if (PySequence_Fast_GET_SIZE(fast) != 3) {
+      Py_DECREF(fast);
+      PyErr_SetString(PyExc_ValueError,
+                      "delta must have exactly 3 fields (key, row, diff)");
+      return fail();
+    }
+    PyObject *key = PySequence_Fast_GET_ITEM(fast, 0);
+    PyObject *row = PySequence_Fast_GET_ITEM(fast, 1);
+    long long dv = PyLong_AsLongLong(PySequence_Fast_GET_ITEM(fast, 2));
+    if (dv == -1 && PyErr_Occurred()) {
+      Py_DECREF(fast);
+      return fail();
+    }
+    PyObject *prev = PyDict_GetItemWithError(seen, key);  // borrowed
+    if (!prev) {
+      if (PyErr_Occurred()) {
+        Py_DECREF(fast);
+        return fail();
+      }
+      prev = PyDict_GetItemWithError(state, key);  // borrowed
+      if (!prev && PyErr_Occurred()) {
+        Py_DECREF(fast);
+        return fail();
+      }
+    }
+    if (prev && prev != Py_None) {
+      PyObject *t = PyTuple_Pack(3, key, prev, neg_one);
+      int rc = t ? PyList_Append(out, t) : -1;
+      Py_XDECREF(t);
+      if (rc < 0) {
+        Py_DECREF(fast);
+        return fail();
+      }
+    }
+    if (dv > 0) {
+      PyObject *t = PyTuple_Pack(3, key, row, one);
+      int rc = t ? PyList_Append(out, t) : -1;
+      Py_XDECREF(t);
+      if (rc < 0 || PyDict_SetItem(seen, key, row) < 0) {
+        Py_DECREF(fast);
+        return fail();
+      }
+    } else if (PyDict_SetItem(seen, key, Py_None) < 0) {
+      Py_DECREF(fast);
+      return fail();
+    }
+    Py_DECREF(fast);
+  }
+  Py_DECREF(seen);
+  Py_DECREF(one);
+  Py_DECREF(neg_one);
+  Py_DECREF(seq);
+  return out;
+}
+
+
 static PyObject *py_setup(PyObject *, PyObject *args) {
   PyObject *pointer_cls, *json_cls, *pyobj_cls, *ndarray_cls, *error_obj,
       *encode_slow, *decode_slow_fn, *ser_slow;
@@ -1704,6 +1796,8 @@ static PyMethodDef methods[] = {
     {"blake2b_128", py_blake2b_128, METH_O, "blake2b-128 digest"},
     {"encode_row", py_encode_row, METH_O, "PWT1-encode a row"},
     {"decode_row", py_decode_row, METH_VARARGS, "PWT1-decode a row"},
+    {"upsert_chain", py_upsert_chain, METH_VARARGS,
+     "(deltas, state) -> chained retract+insert delta list"},
     {"consolidate_dirty", py_consolidate_dirty, METH_O,
      "accumulate a known-dirty delta list (retractions first)"},
     {"sequential_keys", py_sequential_keys, METH_VARARGS,
