@@ -320,3 +320,20 @@ def test_causal_lm_loss_masks_padding():
     ids2[:, 10:] = rng.integers(1, 64, size=(8, 6))  # perturb only padding
     _, loss_b = run(state, ids2, lengths)
     assert abs(float(loss_a) - float(loss_b)) < 1e-6
+
+
+def test_generation_batch_invariance():
+    """A row's greedy chain must not depend on what it is co-batched
+    with (padding rows are fully masked; the prefill bucket only changes
+    shapes, not math)."""
+    from pathway_tpu.models.decoder import DecoderLM
+
+    lm = DecoderLM("pw-tiny-decoder", max_cache=64, eos_id=None)
+    solo = lm.generate_ids([[5, 9, 3]], max_new_tokens=10)
+    batched = lm.generate_ids(
+        [[5, 9, 3], [7, 11, 2, 8, 1], [4]], max_new_tokens=10
+    )
+    assert batched[0] == solo[0]
+    # and independent of row order
+    shuffled = lm.generate_ids([[4], [5, 9, 3]], max_new_tokens=10)
+    assert shuffled[1] == solo[0]
