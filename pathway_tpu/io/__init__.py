@@ -35,13 +35,20 @@ from pathway_tpu.io import (
     slack,
     sqlite,
 )
-from pathway_tpu.io._subscribe import subscribe
+from pathway_tpu.io._subscribe import (
+    OnChangeCallback,
+    OnFinishCallback,
+    OnTimeEndCallback,
+    subscribe,
+)
 from pathway_tpu.io._utils import register_output
+from pathway_tpu.io.csv import CsvParserSettings
 
 __all__ = [
     "airbyte",
     "bigquery",
     "csv",
+    "CsvParserSettings",
     "debezium",
     "deltalake",
     "elasticsearch",
@@ -56,6 +63,9 @@ __all__ = [
     "mongodb",
     "nats",
     "null",
+    "OnChangeCallback",
+    "OnFinishCallback",
+    "OnTimeEndCallback",
     "plaintext",
     "postgres",
     "pubsub",

@@ -2,11 +2,35 @@
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Protocol
 
 from pathway_tpu.engine.types import Pointer
 from pathway_tpu.internals.table import Table
 from pathway_tpu.io import _utils
+
+
+class OnFinishCallback(Protocol):
+    """Callback called when the stream of changes ends, once per worker
+    (parity: internals/table_subscription.py:12)."""
+
+    def __call__(self) -> None: ...
+
+
+class OnChangeCallback(Protocol):
+    """Callback called on every change in the table with the key, the row
+    as a dict, the change time, and whether the change is an addition
+    (parity: internals/table_subscription.py:26)."""
+
+    def __call__(
+        self, key: Pointer, row: dict[str, Any], time: int, is_addition: bool
+    ) -> None: ...
+
+
+class OnTimeEndCallback(Protocol):
+    """Callback called when a processing time (minibatch) finishes
+    (parity: internals/table_subscription.py:60)."""
+
+    def __call__(self, time: int) -> None: ...
 
 
 def subscribe(

@@ -17,11 +17,19 @@ from pathway_tpu.xpacks.llm import (
 from pathway_tpu.xpacks.llm.document_store import DocumentStore, SlidesDocumentStore
 from pathway_tpu.xpacks.llm.question_answering import (
     AdaptiveRAGQuestionAnswerer,
+    BaseContextProcessor,
     BaseRAGQuestionAnswerer,
     DeckRetriever,
+    RAGClient,
+    SimpleContextProcessor,
     SummaryQuestionAnswerer,
+    send_post_request,
 )
-from pathway_tpu.xpacks.llm.vector_store import VectorStoreClient, VectorStoreServer
+from pathway_tpu.xpacks.llm.vector_store import (
+    SlidesVectorStoreServer,
+    VectorStoreClient,
+    VectorStoreServer,
+)
 
 __all__ = [
     "embedders",
@@ -34,9 +42,14 @@ __all__ = [
     "DocumentStore",
     "SlidesDocumentStore",
     "AdaptiveRAGQuestionAnswerer",
+    "BaseContextProcessor",
     "BaseRAGQuestionAnswerer",
     "DeckRetriever",
+    "RAGClient",
+    "SimpleContextProcessor",
     "SummaryQuestionAnswerer",
+    "send_post_request",
+    "SlidesVectorStoreServer",
     "VectorStoreClient",
     "VectorStoreServer",
 ]

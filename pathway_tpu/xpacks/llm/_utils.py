@@ -7,6 +7,24 @@ from typing import Any
 from pathway_tpu.engine.types import Json
 
 
+def send_post_request(
+    url: str, data: dict, headers: dict | None = None, timeout: int | None = None
+):
+    """POST JSON, raise on HTTP errors, return the parsed JSON response
+    (parity: question_answering.py:870)."""
+    import json as _json
+    import urllib.request
+
+    req = urllib.request.Request(
+        url,
+        data=_json.dumps(data).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return _json.loads(resp.read().decode())
+
+
 def _coerce_sync(fn):
     import asyncio
     import functools

@@ -1,15 +1,20 @@
-"""RAG question answering (parity: xpacks/llm/question_answering.py:97-788).
+"""RAG question answering (parity: xpacks/llm/question_answering.py:97-1030).
 
 ``BaseRAGQuestionAnswerer`` — retrieve top-k, prompt, answer.
 ``AdaptiveRAGQuestionAnswerer`` — geometric-k re-asking (:97-162): start
 with few documents; if the model answers "No information found", double
 the context and ask again.  ``SummaryQuestionAnswerer`` adds summarize.
 ``DeckRetriever`` — slide-deck retrieval app built on the same base.
+``BaseContextProcessor``/``SimpleContextProcessor`` (:221,:257) — pluggable
+docs→context assembly.  ``RAGClient`` (:879) — HTTP client for the servers.
 """
 
 from __future__ import annotations
 
-import asyncio
+import inspect
+import json as _json
+from abc import ABC, abstractmethod
+from dataclasses import dataclass, field
 from typing import Any
 
 import pathway_tpu as pw
@@ -18,9 +23,197 @@ from pathway_tpu.internals import expression as expr_mod
 from pathway_tpu.internals.expression import ApplyExpression, ColumnReference
 from pathway_tpu.internals.table import Table
 from pathway_tpu.internals.thisclass import this
+from pathway_tpu.internals.udfs import UDF
 from pathway_tpu.xpacks.llm import prompts
+from pathway_tpu.xpacks.llm._utils import send_post_request
 from pathway_tpu.xpacks.llm.document_store import DocumentStore
 from pathway_tpu.xpacks.llm.servers import QARestServer, QASummaryRestServer
+from pathway_tpu.xpacks.llm.vector_store import VectorStoreClient
+
+
+class BaseContextProcessor(ABC):
+    """Formats retrieved documents into the LLM context string
+    (parity: question_answering.py:221-252).
+
+    Subclasses implement ``docs_to_context``; ``apply`` normalizes the
+    incoming docs value (Json, list of Json, or list of dicts) first.
+    """
+
+    def maybe_unwrap_docs(self, docs) -> list:
+        if isinstance(docs, Json):
+            doc_ls = list(docs.value or ())
+        elif isinstance(docs, (list, tuple)):
+            doc_ls = [d.value if isinstance(d, Json) else d for d in docs]
+        else:
+            raise ValueError(
+                "`docs` argument is not Json | list[Json] | list[dict]; "
+                "check your pipeline (pw.reducers.tuple may help)"
+            )
+        if len(doc_ls) == 1 and isinstance(doc_ls[0], (list, tuple)):
+            doc_ls = list(doc_ls[0])
+        return [d.value if isinstance(d, Json) else d for d in doc_ls]
+
+    def apply(self, docs) -> str:
+        return self.docs_to_context(self.maybe_unwrap_docs(docs))
+
+    @abstractmethod
+    def docs_to_context(self, docs: list[dict]) -> str: ...
+
+    def as_udf(self) -> UDF:
+        u = UDF()
+        u.__wrapped__ = self.apply
+        return u
+
+
+@dataclass
+class SimpleContextProcessor(BaseContextProcessor):
+    """Keeps the listed metadata keys and joins documents with the joiner
+    (parity: question_answering.py:257-282)."""
+
+    context_metadata_keys: list[str] = field(default_factory=lambda: ["path"])
+    context_joiner: str = "\n\n"
+
+    def simplify_context_metadata(self, docs: list[dict]) -> list[dict]:
+        filtered = []
+        for doc in docs:
+            if not isinstance(doc, dict):
+                filtered.append({"text": str(doc)})
+                continue
+            entry = {"text": doc.get("text", "")}
+            metadata = doc.get("metadata", {}) or {}
+            if isinstance(metadata, Json):
+                metadata = metadata.value or {}
+            for key in self.context_metadata_keys:
+                if key in metadata:
+                    entry[key] = metadata[key]
+            filtered.append(entry)
+        return filtered
+
+    def docs_to_context(self, docs: list[dict]) -> str:
+        docs = self.simplify_context_metadata(docs)
+        return self.context_joiner.join(
+            _json.dumps(doc, ensure_ascii=False) for doc in docs
+        )
+
+
+def _geometric_answer_udf(
+    llm_chat_model,
+    n_starting_documents: int,
+    factor: int,
+    max_iterations: int,
+    strict_prompt: bool,
+):
+    """Async per-row geometric re-asking loop shared by the strategy
+    functions and AdaptiveRAGQuestionAnswerer (parity :97-162 semantics:
+    ask with k docs, multiply k by ``factor`` until answered or
+    ``max_iterations`` reached; None when no answer is found)."""
+    llm_fn = llm_chat_model.as_async_callable()
+    not_found = "No information found."
+
+    @pw.udf(executor=pw.udfs.async_executor())
+    async def geometric_answer(question: str, docs: Json) -> str | None:
+        doc_list = list(docs.value or ()) if isinstance(docs, Json) else list(docs or ())
+        texts = [
+            str(d.get("text", d)) if isinstance(d, dict) else str(d) for d in doc_list
+        ]
+        n = n_starting_documents
+        prev_size = -1
+        for _round in range(max_iterations):
+            subset = texts[:n]
+            if len(subset) == prev_size:
+                break  # context exhausted; re-asking would repeat verbatim
+            prev_size = len(subset)
+            context = "\n\n".join(subset)
+            if strict_prompt:
+                full_prompt = (
+                    "Use the below articles to answer the subsequent question. "
+                    f'Respond with json of the form {{"answer": "..."}}; if the '
+                    f'answer cannot be found, use "{not_found}".\n'
+                    f"Articles:\n{context}\nQuestion: {question}"
+                )
+            else:
+                full_prompt = (
+                    "Use the below articles to answer the subsequent question. "
+                    f'If the answer cannot be found, write "{not_found}"\n'
+                    f"Articles:\n{context}\nQuestion: {question}\nAnswer:"
+                )
+            res = await llm_fn([{"role": "user", "content": full_prompt}])
+            answer = str(res) if res is not None else ""
+            if strict_prompt and "{" in answer:
+                try:
+                    payload = _json.loads(answer[answer.find("{") : answer.find("}") + 1])
+                    answer = " ".join(str(v) for v in payload.values())
+                except (ValueError, AttributeError):
+                    pass
+            if answer and not_found.lower().rstrip(".") not in answer.lower():
+                return answer
+            n = min(n * factor, len(texts))
+        return None
+
+    return geometric_answer
+
+
+def answer_with_geometric_rag_strategy(
+    questions: ColumnReference,
+    documents: ColumnReference,
+    llm_chat_model,
+    n_starting_documents: int,
+    factor: int,
+    max_iterations: int,
+    strict_prompt: bool = False,
+) -> ColumnReference:
+    """Query the LLM with geometrically growing document context until an
+    answer is found (parity: question_answering.py:97-159).  Returns a
+    column of answers; None where no answer was found."""
+    geometric_answer = _geometric_answer_udf(
+        llm_chat_model, n_starting_documents, factor, max_iterations, strict_prompt
+    )
+    table = questions.table
+    # like the reference, the result table carries query/documents through
+    # so callers can select alongside the answer column
+    result = table.select(
+        query=questions,
+        documents=documents,
+        answer=geometric_answer(questions, documents),
+    )
+    return result.answer
+
+
+def answer_with_geometric_rag_strategy_from_index(
+    questions: ColumnReference,
+    index,
+    documents_column,
+    llm_chat_model,
+    n_starting_documents: int,
+    factor: int,
+    max_iterations: int,
+    metadata_filter=None,
+    strict_prompt: bool = False,
+) -> ColumnReference:
+    """Like :func:`answer_with_geometric_rag_strategy` but over-fetches the
+    documents once from ``index`` (parity: question_answering.py:162-218)."""
+    if isinstance(documents_column, ColumnReference):
+        documents_column_name = documents_column.name
+    else:
+        documents_column_name = documents_column
+    max_documents = n_starting_documents * (factor ** (max_iterations - 1))
+    # one over-fetch at the final context size; the reply table lives on the
+    # query universe with the data columns collapsed to ranked tuples
+    matches = index.query_as_of_now(
+        questions,
+        number_of_matches=max_documents,
+        collapse_rows=True,
+        metadata_filter=metadata_filter,
+    )
+    return answer_with_geometric_rag_strategy(
+        ColumnReference(matches, questions.name),
+        ColumnReference(matches, documents_column_name),
+        llm_chat_model,
+        n_starting_documents,
+        factor,
+        max_iterations,
+        strict_prompt=strict_prompt,
+    )
 
 
 class BaseQuestionAnswerer:
@@ -59,6 +252,7 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
         *,
         default_llm_name: str | None = None,
         prompt_template=None,
+        context_processor=None,
         search_topk: int = 6,
         summarize_template=None,
     ):
@@ -66,8 +260,51 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
         self.indexer = indexer
         self.search_topk = search_topk
         self.prompt_template = prompt_template or prompts.prompt_qa
+        if context_processor is None:
+            context_processor = SimpleContextProcessor()
+        if isinstance(context_processor, BaseContextProcessor):
+            self.docs_to_context_transformer = context_processor.as_udf()
+        elif isinstance(context_processor, UDF):
+            self.docs_to_context_transformer = context_processor
+        elif callable(context_processor):
+            u = UDF()
+            u.__wrapped__ = context_processor
+            self.docs_to_context_transformer = u
+        else:
+            raise ValueError(
+                "context_processor must be BaseContextProcessor | Callable | UDF, "
+                f"got {type(context_processor)}"
+            )
         self.summarize_template = summarize_template or prompts.prompt_summarize
         self.server: Any = None
+
+    def _prompt_expr(self, docs_ref, query_ref):
+        """Build the prompt column from docs + query.
+
+        A ``str`` template (reference ``RAGPromptTemplate`` form) and any
+        callable taking a ``context`` parameter go through the pluggable
+        context processor; legacy repo templates taking ``docs`` receive
+        the raw docs value and assemble context themselves.
+        """
+        template = self.prompt_template
+        if isinstance(template, str):
+            if "{context}" not in template or "{query}" not in template:
+                raise ValueError(
+                    "string prompt_template must contain {context} and {query}"
+                )
+            ctx = self.docs_to_context_transformer(docs_ref)
+            return ApplyExpression(
+                lambda c, q: template.format(context=c, query=q), str, ctx, query_ref
+            )
+        fn = template.__wrapped__ if isinstance(template, UDF) else template
+        try:
+            params = list(inspect.signature(fn).parameters)
+        except (TypeError, ValueError):
+            params = []
+        if params and params[0] == "context":
+            ctx = self.docs_to_context_transformer(docs_ref)
+            return template(ctx, query_ref)
+        return template(docs_ref, query_ref)
 
     # -- internal: fetch docs for a query table --
     def _retrieve_docs(self, queries: Table, k: int | None = None) -> Table:
@@ -90,7 +327,7 @@ class BaseRAGQuestionAnswerer(BaseQuestionAnswerer):
         """The /v1/pw_ai_answer handler (parity :387)."""
         with_docs = self._retrieve_docs(pw_ai_queries)
         prompted = with_docs.with_columns(
-            _pw_prompt=self.prompt_template(
+            _pw_prompt=self._prompt_expr(
                 ColumnReference(this, "docs"), ColumnReference(this, "prompt")
             )
         )
@@ -200,6 +437,7 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
         self.n_starting_documents = n_starting_documents
         self.factor = factor
         self.max_iterations = max_iterations
+        self.strict_prompt = strict_prompt
         self.not_found_response = "No information found."
 
     def answer_query(self, pw_ai_queries: Table) -> Table:
@@ -207,38 +445,26 @@ class AdaptiveRAGQuestionAnswerer(BaseRAGQuestionAnswerer):
             self.factor ** (self.max_iterations - 1)
         )
         with_docs = self._retrieve_docs(pw_ai_queries, k=max_docs)
-        # directly-awaitable form keeps the LLM UDF's retry/capacity/cache config
-        llm_fn = self.llm.as_async_callable()
-        n0, factor, rounds = self.n_starting_documents, self.factor, self.max_iterations
+        adaptive_answer = _geometric_answer_udf(
+            self.llm,
+            self.n_starting_documents,
+            self.factor,
+            self.max_iterations,
+            self.strict_prompt,
+        )
         not_found = self.not_found_response
 
-        @pw.udf(executor=pw.udfs.async_executor())
-        async def adaptive_answer(prompt: str, docs: Json) -> Json:
-            doc_list = docs.value if isinstance(docs, Json) else list(docs or ())
-            n = n0
-            answer = not_found
-            prev_size = -1
-            for _round in range(rounds):
-                subset = doc_list[:n]
-                if len(subset) == prev_size:
-                    break  # context exhausted; re-asking would repeat verbatim
-                prev_size = len(subset)
-                context = "\n\n".join(str(d.get("text", d)) for d in subset)
-                full_prompt = (
-                    "Use the below articles to answer the subsequent question. "
-                    f'If the answer cannot be found, write "{not_found}"\n'
-                    f"Articles:\n{context}\nQuestion: {prompt}\nAnswer:"
-                )
-                res = await llm_fn([{"role": "user", "content": full_prompt}])
-                answer = res
-                if res and not_found.lower().rstrip(".") not in str(res).lower():
-                    break
-                n = min(n * factor, len(doc_list))
-            return Json({"response": answer})
-
-        return with_docs.select(
-            result=adaptive_answer(
+        answered = with_docs.with_columns(
+            _pw_answer=adaptive_answer(
                 ColumnReference(this, "prompt"), ColumnReference(this, "docs")
+            )
+        )
+        return answered.select(
+            result=ApplyExpression(
+                lambda a: Json({"response": a if a is not None else not_found}),
+                None,
+                ColumnReference(this, "_pw_answer"),
+                _propagate_none=False,
             )
         )
 
@@ -291,3 +517,117 @@ class DeckRetriever(BaseQuestionAnswerer):
 
     def run_server(self, *args, **kwargs):
         return self.server.run_server(*args, **kwargs)
+
+
+class RAGClient:
+    """HTTP client for the RAG question-answering servers
+    (parity: question_answering.py:879-1030).
+
+    Either (``host`` and ``port``) or ``url`` must be set, not both.
+    """
+
+    def __init__(
+        self,
+        host: str | None = None,
+        port: int | None = None,
+        url: str | None = None,
+        timeout: int | None = 90,
+        additional_headers: dict | None = None,
+    ):
+        err = "Either (`host` and `port`) or `url` must be provided, but not both."
+        if url is not None:
+            if host is not None or port is not None:
+                raise ValueError(err)
+            self.url = url
+        else:
+            if host is None:
+                raise ValueError(err)
+            port = port or 80
+            protocol = "https" if port == 443 else "http"
+            self.url = f"{protocol}://{host}:{port}"
+        self.timeout = timeout
+        self.additional_headers = additional_headers or {}
+        self.index_client = VectorStoreClient(
+            url=self.url,
+            timeout=self.timeout,
+            additional_headers=self.additional_headers,
+        )
+
+    def retrieve(
+        self,
+        query: str,
+        k: int = 3,
+        metadata_filter: str | None = None,
+        filepath_globpattern: str | None = None,
+    ):
+        """Retrieve the k closest documents for ``query``."""
+        return self.index_client.query(
+            query=query,
+            k=k,
+            metadata_filter=metadata_filter,
+            filepath_globpattern=filepath_globpattern,
+        )
+
+    def statistics(self):
+        """Index statistics from the /v1/statistics endpoint."""
+        return self.index_client.get_vectorstore_statistics()
+
+    def pw_ai_answer(
+        self,
+        prompt: str,
+        filters: str | None = None,
+        model: str | None = None,
+        return_context_docs: bool | None = None,
+    ):
+        """Ask the RAG app a question (POST /v1/pw_ai_answer)."""
+        payload: dict = {"prompt": prompt}
+        if filters:
+            payload["filters"] = filters
+        if model:
+            payload["model"] = model
+        if return_context_docs is not None:
+            payload["return_context_docs"] = return_context_docs
+        return send_post_request(
+            f"{self.url}/v1/pw_ai_answer",
+            payload,
+            self.additional_headers,
+            self.timeout,
+        )
+
+    answer = pw_ai_answer
+
+    def pw_ai_summary(self, text_list: list[str], model: str | None = None):
+        """Summarize a list of texts (POST /v1/pw_ai_summary)."""
+        payload: dict = {"text_list": text_list}
+        if model:
+            payload["model"] = model
+        return send_post_request(
+            f"{self.url}/v1/pw_ai_summary",
+            payload,
+            self.additional_headers,
+            self.timeout,
+        )
+
+    summarize = pw_ai_summary
+
+    def pw_list_documents(
+        self, filters: str | None = None, keys: list[str] | None = ["path"]
+    ):
+        """List indexed documents (POST /v1/pw_list_documents), keeping
+        only ``keys`` from each document's metadata."""
+        payload: dict = {}
+        if filters:
+            payload["metadata_filter"] = filters
+        response = send_post_request(
+            f"{self.url}/v1/pw_list_documents",
+            payload,
+            self.additional_headers,
+            self.timeout,
+        )
+        if not response:
+            return []
+        if keys:
+            return [{k: v for k, v in dc.items() if k in keys} for dc in response]
+        return response
+
+    list_documents = pw_list_documents

@@ -137,6 +137,12 @@ class QARestServer(BaseRestServer):
             methods=("GET", "POST"),
         )
         self.serve(
+            "/v1/pw_list_documents",
+            rag_question_answerer.InputsQuerySchema,
+            rag_question_answerer.list_documents,
+            methods=("GET", "POST"),
+        )
+        self.serve(
             "/v2/list_documents",
             rag_question_answerer.InputsQuerySchema,
             rag_question_answerer.list_documents,

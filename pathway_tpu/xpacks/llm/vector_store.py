@@ -62,7 +62,7 @@ class VectorStoreServer:
             embedder = SentenceTransformerEmbedder()
         embedder = _as_embedder_udf(embedder)
         retriever_factory = BruteForceKnnFactory(embedder=embedder)
-        self.document_store = DocumentStore(
+        self.document_store = self._document_store_cls(
             list(docs),
             retriever_factory,
             parser=parser,
@@ -70,6 +70,8 @@ class VectorStoreServer:
             doc_post_processors=doc_post_processors,
         )
         self._server: DocumentStoreServer | None = None
+
+    _document_store_cls: type[DocumentStore] = DocumentStore
 
     # constructor adapters (parity :~200)
     @classmethod
@@ -160,13 +162,46 @@ class VectorStoreServer:
         terminate_on_error: bool = True,
     ):
         """Start the REST server + pipeline (parity :~600)."""
-        self._server = DocumentStoreServer(host, port, self.document_store)
+        # serve self (not the store) so subclass query overrides — e.g.
+        # SlidesVectorStoreServer.inputs_query — reach the HTTP endpoints
+        self._server = DocumentStoreServer(host, port, self)
         return self._server.run_server(
             threaded=threaded,
             with_cache=with_cache,
             cache_backend=cache_backend,
             terminate_on_error=terminate_on_error,
         )
+
+
+class SlidesVectorStoreServer(VectorStoreServer):
+    """Vector index server for the slide-search application
+    (parity: vector_store.py:588-648).
+
+    Uses the slide document store (default parser = ``SlideParser``) and
+    answers ``/v1/inputs`` with the per-slide metadata captured *after*
+    parsing and post-processing, with the bulky ``b64_image`` entries
+    stripped — the reference's modified ``pw_list_documents`` behavior.
+    """
+
+    excluded_response_metadata = ["b64_image"]
+
+    @property
+    def _document_store_cls(self):
+        from pathway_tpu.xpacks.llm.document_store import SlidesDocumentStore
+
+        return SlidesDocumentStore
+
+    def __init__(self, *docs, **kwargs):
+        super().__init__(*docs, **kwargs)
+        # the store's pack() reads its own attribute; propagate so
+        # subclass-level excluded_response_metadata config takes effect
+        self.document_store.excluded_response_metadata = self.excluded_response_metadata
+
+    def inputs_query(self, input_queries: Table) -> Table:
+        return self.document_store.parsed_documents_query(input_queries)
+
+    def parsed_documents_query(self, parse_docs_queries: Table) -> Table:
+        return self.document_store.parsed_documents_query(parse_docs_queries)
 
 
 class VectorStoreClient:
@@ -185,16 +220,11 @@ class VectorStoreClient:
         self.headers = {"Content-Type": "application/json", **(additional_headers or {})}
 
     def _post(self, route: str, payload: dict) -> Any:
-        import urllib.request
+        from pathway_tpu.xpacks.llm._utils import send_post_request
 
-        req = urllib.request.Request(
-            self.url + route,
-            data=_json.dumps(payload).encode(),
-            headers=self.headers,
-            method="POST",
+        return send_post_request(
+            self.url + route, payload, self.headers, self.timeout
         )
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return _json.loads(resp.read().decode())
 
     def query(
         self, query: str, k: int = 3, metadata_filter: str | None = None, filepath_globpattern: str | None = None

@@ -253,9 +253,12 @@ def test_reference_submodule_apis_covered():
 
     modules = [("io." + (p[:-3] if p.endswith(".py") else p)) for p in os.listdir(REF / "io") if not p.startswith("_")]
     modules += [
+        "io",  # pins pw.io.__all__ itself (CsvParserSettings, On*Callback, …)
         "stdlib.temporal", "stdlib.indexing",
         "xpacks.llm.embedders", "xpacks.llm.llms", "xpacks.llm.rerankers",
         "xpacks.llm.splitters", "xpacks.llm.parsers", "xpacks.llm.servers",
+        "xpacks.llm.question_answering", "xpacks.llm.vector_store",
+        "xpacks.llm.document_store",
         "udfs", "debug", "demo",
     ]
     failures = []

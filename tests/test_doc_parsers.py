@@ -360,3 +360,49 @@ def test_image_parser_sniffs_jpeg():
     ImageParser(llm=chat).__wrapped__(b"\xff\xd8\xff\xe0 fake jpeg")
     url = chat.calls[0][0]["content"][1]["image_url"]["url"]
     assert url.startswith("data:image/jpeg;base64,")
+
+
+def test_slides_vector_store_server():
+    """SlidesVectorStoreServer (parity: vector_store.py:588): slide store
+    under the legacy VectorStoreServer surface; /v1/inputs-style queries
+    return per-slide parsed metadata with b64_image stripped."""
+    import pathway_tpu as pw
+    from pathway_tpu.debug import _capture_table
+    from pathway_tpu.io._utils import make_static_input_table
+    from pathway_tpu.xpacks.llm import SlidesVectorStoreServer
+    from pathway_tpu.xpacks.llm.document_store import SlidesDocumentStore
+    from pathway_tpu.xpacks.llm.mocks import FakeEmbeddings
+
+    pw.G.clear()
+    deck = make_pptx([["alpha slide"], ["beta slide"]])
+    docs = make_static_input_table(
+        pw.schema_from_types(data=bytes, _metadata=Json),
+        [{"data": deck, "_metadata": Json({"path": "/d.pptx", "b64_image": "x"})}],
+    )
+    server = SlidesVectorStoreServer(docs, embedder=FakeEmbeddings())
+    assert isinstance(server.document_store, SlidesDocumentStore)
+
+    pq = make_static_input_table(
+        SlidesVectorStoreServer.InputsQuerySchema,
+        [{"metadata_filter": None, "filepath_globpattern": None}],
+    )
+    cap = _capture_table(server.inputs_query(pq))
+    (result,) = list(cap.final_rows().values())[0]
+    metas = result.value
+    assert {m["slide_number"] for m in metas} == {1, 2}
+    assert all("b64_image" not in m for m in metas)
+
+    pw.G.clear()
+    docs = make_static_input_table(
+        pw.schema_from_types(data=bytes, _metadata=Json),
+        [{"data": make_pptx([["gamma only"]]), "_metadata": Json({"path": "/g.pptx"})}],
+    )
+    server = SlidesVectorStoreServer(docs, embedder=FakeEmbeddings())
+    rq = make_static_input_table(
+        SlidesVectorStoreServer.RetrieveQuerySchema,
+        [{"query": "gamma only", "k": 1, "metadata_filter": None,
+          "filepath_globpattern": None}],
+    )
+    cap = _capture_table(server.retrieve_query(rq))
+    (result,) = list(cap.final_rows().values())[0]
+    assert "gamma" in result.value[0]["text"]

@@ -304,3 +304,186 @@ def test_adaptive_rag_full_tpu_serving_stack(monkeypatch):
         assert "systolic" in json.dumps(hit.value.value if hasattr(hit.value, "value") else hit.value)
     finally:
         shared_sentence_encoder.cache_clear()
+
+
+# ---------------------------------------------------------------------------
+# Context processors + RAG strategy functions
+# (parity: question_answering.py:97-282)
+# ---------------------------------------------------------------------------
+
+
+def test_simple_context_processor_formats_docs():
+    from pathway_tpu.xpacks.llm.question_answering import SimpleContextProcessor
+
+    proc = SimpleContextProcessor()
+    docs = [
+        {"text": "alpha", "metadata": {"path": "/a.txt", "b64_image": "zzz"}},
+        {"text": "beta", "metadata": {"path": "/b.txt"}},
+    ]
+    ctx = proc.apply(docs)
+    parts = ctx.split("\n\n")
+    assert len(parts) == 2
+    first = json.loads(parts[0])
+    # kept keys: text + the configured metadata keys, nothing else
+    assert first == {"text": "alpha", "path": "/a.txt"}
+    # custom joiner and metadata keys
+    proc2 = SimpleContextProcessor(context_metadata_keys=[], context_joiner=" | ")
+    assert proc2.apply(docs) == '{"text": "alpha"} | {"text": "beta"}'
+    # Json-wrapped docs unwrap like raw dicts
+    assert proc.apply(Json(docs)) == ctx
+    # single nested list unpacks (reducers.tuple shape)
+    assert proc.apply([docs]) == ctx
+
+
+def test_base_context_processor_rejects_garbage():
+    from pathway_tpu.xpacks.llm.question_answering import SimpleContextProcessor
+
+    with pytest.raises(ValueError):
+        SimpleContextProcessor().apply(42)
+
+
+def test_rag_string_prompt_template_with_context_processor():
+    """A str prompt_template ({context}/{query} placeholders, the reference
+    RAGPromptTemplate form) routes docs through the pluggable processor."""
+    from pathway_tpu.xpacks.llm.question_answering import BaseRAGQuestionAnswerer
+
+    docs = _docs([("alpha beta gamma", {"path": "/a.txt"})])
+    store = DocumentStore(docs, BruteForceKnnFactory(embedder=FakeEmbeddings()))
+
+    def shouty_context(docs) -> str:
+        items = docs.value if isinstance(docs, Json) else docs
+        return " // ".join(str(d.get("text", d)).upper() for d in items)
+
+    rag = BaseRAGQuestionAnswerer(
+        IdentityMockChat(),
+        store,
+        prompt_template="CTX=<{context}> Q=<{query}>",
+        context_processor=shouty_context,
+    )
+    queries = make_static_input_table(
+        rag.AnswerQuerySchema,
+        [{"prompt": "what is alpha?", "filters": None, "model": None,
+          "return_context_docs": False}],
+    )
+    (result,) = _one_result(rag.answer_query(queries))
+    out = result.value["response"]
+    assert "CTX=<ALPHA BETA GAMMA>" in out
+    assert "Q=<what is alpha?>" in out
+
+
+def test_rag_string_prompt_template_validates_placeholders():
+    from pathway_tpu.xpacks.llm.question_answering import BaseRAGQuestionAnswerer
+
+    docs = _docs([("alpha", {"path": "/a"})])
+    store = DocumentStore(docs, BruteForceKnnFactory(embedder=FakeEmbeddings()))
+    rag = BaseRAGQuestionAnswerer(
+        IdentityMockChat(), store, prompt_template="no placeholders here"
+    )
+    queries = make_static_input_table(
+        rag.AnswerQuerySchema,
+        [{"prompt": "q", "filters": None, "model": None,
+          "return_context_docs": False}],
+    )
+    with pytest.raises(ValueError, match="context"):
+        rag.answer_query(queries)
+
+
+def test_rag_context_callable_prompt_template():
+    """A callable template whose first parameter is named ``context`` gets
+    the processed context string (reference RAGFunctionPromptTemplate)."""
+    from pathway_tpu.xpacks.llm.question_answering import (
+        BaseRAGQuestionAnswerer,
+        SimpleContextProcessor,
+    )
+    from pathway_tpu.internals.expression import ApplyExpression
+
+    def template(context, query):
+        return ApplyExpression(
+            lambda c, q: f"[{c}]({q})", str, context, query
+        )
+
+    docs = _docs([("alpha beta", {"path": "/a.txt"})])
+    store = DocumentStore(docs, BruteForceKnnFactory(embedder=FakeEmbeddings()))
+    rag = BaseRAGQuestionAnswerer(
+        IdentityMockChat(),
+        store,
+        prompt_template=template,
+        context_processor=SimpleContextProcessor(context_metadata_keys=[]),
+    )
+    queries = make_static_input_table(
+        rag.AnswerQuerySchema,
+        [{"prompt": "q?", "filters": None, "model": None,
+          "return_context_docs": False}],
+    )
+    (result,) = _one_result(rag.answer_query(queries))
+    out = result.value["response"]
+    assert '[{"text": "alpha beta"}](q?)' in out
+
+
+def test_answer_with_geometric_rag_strategy():
+    """Strategy function over explicit question/documents columns: a chat
+    that needs >= 2 docs answers on the second round; an unanswerable row
+    yields None (parity :97-159)."""
+    from pathway_tpu.internals.udfs import UDF
+    from pathway_tpu.xpacks.llm.question_answering import (
+        answer_with_geometric_rag_strategy,
+    )
+
+    class NeedsTwoDocsChat(UDF):
+        def __init__(self):
+            super().__init__()
+
+            def chat(messages, **kwargs) -> str:
+                content = messages[-1]["content"] if not isinstance(messages, str) else messages
+                n_docs = content.count("doc-")
+                if "unanswerable" in content:
+                    return "No information found."
+                return "answer!" if n_docs >= 2 else "No information found."
+
+            self.__wrapped__ = chat
+
+    t = pw.debug.table_from_markdown(
+        """
+        q
+        findme
+        unanswerable
+        """
+    ).select(
+        q=pw.this.q,
+        docs=pw.make_tuple("doc-1", "doc-2", "doc-3", "doc-4"),
+    )
+    answers = answer_with_geometric_rag_strategy(
+        t.q, t.docs, NeedsTwoDocsChat(), n_starting_documents=1, factor=2,
+        max_iterations=3,
+    )
+    res = answers.table.select(q=pw.this.query, a=answers)
+    rows = {r[0]: r[1] for r in _capture_table(res).final_rows().values()}
+    assert rows["findme"] == "answer!"
+    assert rows["unanswerable"] is None
+
+
+def test_answer_with_geometric_rag_strategy_from_index():
+    from pathway_tpu.xpacks.llm.question_answering import (
+        answer_with_geometric_rag_strategy_from_index,
+    )
+    from pathway_tpu.stdlib.indexing import BruteForceKnn, DataIndex
+
+    data = _docs([("alpha beta gamma", {"path": "/a"})]).select(
+        text=pw.apply_with_type(lambda b: b.decode(), str, pw.this.data)
+    )
+    index = DataIndex(
+        data,
+        BruteForceKnn(data.text, embedder=FakeEmbeddings()),
+    )
+    queries = pw.debug.table_from_markdown("q\nanything")
+    answers = answer_with_geometric_rag_strategy_from_index(
+        queries.q,
+        index,
+        "text",
+        FakeChatModel(),
+        n_starting_documents=1,
+        factor=2,
+        max_iterations=2,
+    )
+    rows = list(_capture_table(answers.table.select(a=answers)).final_rows().values())
+    assert rows == [("Text",)]

@@ -126,6 +126,40 @@ def test_serve_callable_roundtrip(tmp_path):
         proc.wait(timeout=10)
 
 
+def test_rag_client_roundtrip(tmp_path):
+    """RAGClient (parity: question_answering.py:879) against a live QA
+    server: retrieve, statistics, pw_ai_answer, pw_list_documents."""
+    from pathway_tpu.xpacks.llm.question_answering import RAGClient
+
+    proc, port = _spawn(
+        tmp_path,
+        QA_SCRIPT,
+        lambda p: _post(p, "/v2/list_documents", {}, timeout=3),
+    )
+    try:
+        client = RAGClient(host="127.0.0.1", port=port, timeout=10)
+        docs = client.pw_list_documents()
+        assert sorted(d["path"] for d in docs) == ["/a.txt", "/b.txt"]
+        retrieved = client.retrieve("alpha beta gamma", k=1)
+        assert retrieved[0]["text"] == "alpha beta gamma"
+        answer = client.pw_ai_answer("what is alpha?")
+        text = answer["response"] if isinstance(answer, dict) else answer
+        assert "what is alpha?" in text
+        stats = client.statistics()
+        assert stats["file_count"] == 2
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+    # constructor contract: url xor host/port
+    with pytest.raises(ValueError):
+        RAGClient(host="h", url="http://x")
+    with pytest.raises(ValueError):
+        RAGClient()
+    assert RAGClient(url="http://x:1").url == "http://x:1"
+    assert RAGClient(host="h").url == "http://h:80"
+    assert RAGClient(host="h", port=443).url == "https://h:443"
+
+
 def test_qa_rest_server_answer_and_retrieve(tmp_path):
     proc, port = _spawn(
         tmp_path,
