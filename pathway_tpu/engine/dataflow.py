@@ -371,17 +371,29 @@ class InputNode(Node):
         if time not in self._staged_wallclock:
             self._staged_wallclock[time] = _monotonic()
 
-    def take_staged(self, time: Time, default=None):
-        """Pop a staged bucket.  EVERY external pop must come through here
-        (or ``put_staged``): both invalidate the hot-bucket insert cache,
-        which otherwise keeps appending to the orphaned list object."""
+    def _invalidate_hot(self) -> None:
+        """Drop the hot-bucket insert cache.  EVERY mutation of
+        ``_staged`` outside ``insert()`` must call this (directly or via
+        take_staged/put_staged/clear_staged) — a stale hot list keeps
+        receiving appends into an orphaned object, silently losing rows."""
         self._hot_time = self._hot_list = None
+
+    def take_staged(self, time: Time, default=None):
+        """Pop a staged bucket (invalidates the hot-bucket cache)."""
+        self._invalidate_hot()
         return self._staged.pop(time, default)
 
     def put_staged(self, time: Time, deltas: list) -> None:
         """Re-file a bucket (see ``take_staged``)."""
-        self._hot_time = self._hot_list = None
+        self._invalidate_hot()
         self._staged[time] = deltas
+
+    def clear_staged(self) -> None:
+        """Discard every staged bucket (persistence resume skips static
+        re-emission); keeps the hot cache consistent with the dicts."""
+        self._invalidate_hot()
+        self._staged.clear()
+        self._staged_wallclock.clear()
 
     def pending_times(self) -> list[Time]:
         return sorted(self._staged.keys())
@@ -390,7 +402,7 @@ class InputNode(Node):
         """Fold rows staged at earlier times into epoch ``time`` (the runner
         picks one commit timestamp across all inputs), keeping the earliest
         ingest wallclock so latency probes measure from first arrival."""
-        self._hot_time = self._hot_list = None
+        self._invalidate_hot()
         below = [st for st in self._staged if st <= time]
         if len(below) == 1:
             # single staged bucket: move the list object itself so a

@@ -546,8 +546,7 @@ def register_static_persistence(lowerer, node, schema=None) -> None:
         sid, schema_digest=None if schema is None else schema_digest(schema)
     )
     if state.offset is not None:
-        node._staged.clear()
-        node._staged_wallclock.clear()
+        node.clear_staged()
         return
     last_t = max(node._staged.keys(), default=0)
     state.pending_offsets.append(({"done": True}, last_t))

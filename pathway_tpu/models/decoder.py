@@ -709,7 +709,15 @@ def verify_block(tree, k_cache, v_cache, tokens, pos0, cfg: DecoderConfig):
 
 
 def speculative_decode_chunk(
-    tree, draft_tree, k_cache, v_cache, logits, pos, cfg: DecoderConfig, n_draft: int
+    tree,
+    draft_tree,
+    k_cache,
+    v_cache,
+    logits,
+    pos,
+    cfg: DecoderConfig,
+    n_draft: int,
+    done=None,
 ):
     """One greedy speculative round: draft ``n_draft`` tokens with
     ``draft_tree`` (sequential single-token decodes — cheap when the
@@ -732,6 +740,15 @@ def speculative_decode_chunk(
     positions (unaccepted writes are zeroed so the slots stay scatter-
     ready), and ``next_logits`` are the target logits after the last
     accepted token.
+
+    ``done [B] bool`` freezes finished rows: their ``n_match`` is 0, so
+    ``pos`` does not advance and every cache write for the round's block
+    is zeroed — a finished row's state is bit-identical across rounds.
+    Residual invariant (active rows only, final round): the block's last
+    draft positions may exceed the cache length ``C`` by up to
+    ``n_draft - 1``; ``verify_block``'s one-hot scatter (idx ==
+    positions) writes nothing for positions >= C, so overflow writes are
+    no-ops by construction.
     """
     B = logits.shape[0]
     C = k_cache.shape[2]
@@ -751,9 +768,13 @@ def speculative_decode_chunk(
     vlogits, k_cache, v_cache = verify_block(tree, k_cache, v_cache, toks, pos, cfg)
     pred = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)  # target's next-token
     match = (toks[:, 1:] == pred[:, :-1]).astype(jnp.int32)
-    n_match = 1 + jnp.cumprod(match, axis=1).sum(axis=1)  # [B] in 1..n_draft
+    n_match = 1 + jnp.cumprod(match, axis=1).sum(axis=1)  # [B] 0..n_draft (0 = done row)
+    if done is not None:
+        n_match = jnp.where(done, 0, n_match)
     next_logits = jnp.take_along_axis(
-        vlogits, (n_match - 1)[:, None, None].repeat(vlogits.shape[-1], 2), axis=1
+        vlogits,
+        jnp.maximum(n_match - 1, 0)[:, None, None].repeat(vlogits.shape[-1], 2),
+        axis=1,
     )[:, 0]
     # zero the rejected positions' K/V so those slots stay additive-ready
     cidx = jnp.arange(C)[None, :]
@@ -1067,8 +1088,8 @@ class DecoderLM:
         if spec is None:
             cfg = self.config
             spec = jax.jit(
-                lambda t, d, kc, vc, lg, ps: speculative_decode_chunk(
-                    t, d, kc, vc, lg, ps, cfg, n_draft
+                lambda t, d, kc, vc, lg, ps, dn: speculative_decode_chunk(
+                    t, d, kc, vc, lg, ps, cfg, n_draft, done=dn
                 )
             )
             self._spec_fns[n_draft] = spec
@@ -1088,8 +1109,10 @@ class DecoderLM:
         out: list[list[int]] = [[] for _ in range(B)]
         done = np.zeros(B, bool)
         while not done.all():
+            # done mask freezes finished rows on device: pos stays put and
+            # their block writes are zeroed (no work drift past cache end)
             toks, n_match, logits, kc, vc, pos = spec(
-                self.params, self._draft_tree, kc, vc, logits, pos
+                self.params, self._draft_tree, kc, vc, logits, pos, jnp.asarray(done)
             )
             htoks = np.asarray(toks)
             hn = np.asarray(n_match)

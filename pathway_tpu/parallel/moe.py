@@ -53,6 +53,12 @@ class MoEConfig:
     # tensor stays LINEAR in the total token count (C scales with Tg, not
     # T).  0 disables grouping (one global group).
     group_size: int = 4096
+    # the lossless serving path (full_capacity=True) sets C = Tg, making
+    # the dispatch/combine tensors [G, Tg, E, Tg] — quadratic in the
+    # group size.  Serving therefore uses this smaller group (and maps
+    # over groups one at a time) so large-batch MoE prefill cannot
+    # pressure HBM; 0 falls back to group_size.
+    serving_group_size: int = 1024
     dtype: Any = jnp.float32
 
     def capacity(self, n_tokens: int) -> int:
@@ -173,26 +179,36 @@ def moe_ffn(
     sharding constraints on the expert-major intermediates so the
     placement is pinned rather than inferred.
 
-    Tokens beyond ``cfg.group_size`` are chunked into GShard groups and
+    Tokens beyond the group size are chunked into GShard groups and
     dispatched group-locally (one ragged tail group padded and masked),
     keeping dispatch memory linear in the token count.
     ``full_capacity=True`` gives every token guaranteed slots — capacity
     ``C = Tg`` per group, which no expert can exceed, still linear in the
     token count (``T·E·Tg`` dispatch elements).  The serving paths
     (prefill and single-token decode) use it: capacity drops there would
-    silently degrade generations.  Training keeps the capacity-factor
-    drop policy, which is what makes routing learnable under a static
-    budget.
+    silently degrade generations.  Because ``C = Tg`` makes the per-group
+    tensors quadratic in the group size, serving uses the smaller
+    ``cfg.serving_group_size`` and processes groups one at a time
+    (``lax.map``), bounding transient HBM to a single group.  Training
+    keeps the capacity-factor drop policy (and the fully vmapped groups),
+    which is what makes routing learnable under a static budget.
     """
     orig_shape = x.shape
     H = orig_shape[-1]
     xt = x.reshape(-1, H)
     T = xt.shape[0]
-    if not cfg.group_size or T <= cfg.group_size:
+    group_size = cfg.group_size
+    if full_capacity and cfg.serving_group_size:
+        group_size = (
+            min(group_size, cfg.serving_group_size)
+            if group_size
+            else cfg.serving_group_size
+        )
+    if not group_size or T <= group_size:
         G, Tg = 1, T
     else:
-        G = -(-T // cfg.group_size)
-        Tg = cfg.group_size
+        G = -(-T // group_size)
+        Tg = group_size
     pad = G * Tg - T
     if pad:
         xt = jnp.concatenate([xt, jnp.zeros((pad, H), xt.dtype)], axis=0)
@@ -200,28 +216,45 @@ def moe_ffn(
     xg = xt.reshape(G, Tg, H)
     router_logits = xg.astype(jnp.float32) @ params["router"]  # [G, Tg, E]
     valid = (jnp.arange(G * Tg) < T).reshape(G, Tg)
-    dispatch, combine, aux_g = jax.vmap(
-        lambda lg, vg: _routing(lg, cfg, C, vg)
-    )(router_logits, valid)
+
+    def groups_ffn(router_logits, valid, xg):
+        """Dispatch → expert FFN → combine, vectorized over the leading
+        group axis; returns (y [G, Tg, H], aux [G])."""
+        dispatch, combine, aux_g = jax.vmap(
+            lambda lg, vg: _routing(lg, cfg, C, vg)
+        )(router_logits, valid)
+        dispatch = dispatch.astype(cfg.dtype)
+        expert_in = jnp.einsum("gtec,gth->gech", dispatch, xg.astype(cfg.dtype))
+        if mesh is not None and "expert" in mesh.axis_names:
+            expert_in = jax.lax.with_sharding_constraint(
+                expert_in, NamedSharding(mesh, P(None, "expert", None, None))
+            )
+        h = jax.nn.silu(_qeinsum("gech,ehf->gecf", expert_in, params["wg"]))
+        h = h * _qeinsum("gech,ehf->gecf", expert_in, params["wu"])
+        expert_out = _qeinsum("gecf,efh->gech", h, params["wd"])
+        if mesh is not None and "expert" in mesh.axis_names:
+            expert_out = jax.lax.with_sharding_constraint(
+                expert_out, NamedSharding(mesh, P(None, "expert", None, None))
+            )
+        y = jnp.einsum("gtec,gech->gth", combine.astype(cfg.dtype), expert_out)
+        return y, aux_g
+
+    if full_capacity and G > 1:
+        # one group live at a time: the [Tg, E, Tg] serving dispatch
+        # tensors never materialize for all groups together
+        y_g, aux_g = jax.lax.map(
+            lambda a: jax.tree_util.tree_map(
+                lambda t: t[0], groups_ffn(a[0][None], a[1][None], a[2][None])
+            ),
+            (router_logits, valid, xg),
+        )
+    else:
+        y_g, aux_g = groups_ffn(router_logits, valid, xg)
+
     # aux: weighted mean over groups by their real-token counts
     w = valid.astype(jnp.float32).sum(axis=1)
     aux = (aux_g * w).sum() / jnp.maximum(w.sum(), 1.0)
-    dispatch = dispatch.astype(cfg.dtype)
-
-    expert_in = jnp.einsum("gtec,gth->gech", dispatch, xg.astype(cfg.dtype))
-    if mesh is not None and "expert" in mesh.axis_names:
-        expert_in = jax.lax.with_sharding_constraint(
-            expert_in, NamedSharding(mesh, P(None, "expert", None, None))
-        )
-    h = jax.nn.silu(_qeinsum("gech,ehf->gecf", expert_in, params["wg"]))
-    h = h * _qeinsum("gech,ehf->gecf", expert_in, params["wu"])
-    expert_out = _qeinsum("gecf,efh->gech", h, params["wd"])
-    if mesh is not None and "expert" in mesh.axis_names:
-        expert_out = jax.lax.with_sharding_constraint(
-            expert_out, NamedSharding(mesh, P(None, "expert", None, None))
-        )
-    y = jnp.einsum("gtec,gech->gth", combine.astype(cfg.dtype), expert_out)
-    y = y.reshape(G * Tg, H)[:T]
+    y = y_g.reshape(G * Tg, H)[:T]
     return y.reshape(orig_shape).astype(x.dtype), aux
 
 

@@ -9,8 +9,6 @@ when those packages are installed.
 
 from __future__ import annotations
 
-import json as _json
-import threading
 from typing import Any, Callable
 
 import numpy as np

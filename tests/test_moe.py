@@ -152,3 +152,21 @@ def test_aux_loss_prefers_uniform_routing():
     _, _, aux_collapsed = _routing(collapsed, cfg, capacity=32)
     assert float(aux_uniform) == pytest.approx(1.0, abs=1e-4)
     assert float(aux_collapsed) == pytest.approx(4.0, abs=1e-2)
+
+
+def test_serving_group_map_matches_single_group():
+    # full_capacity serving path: the smaller serving group + lax.map over
+    # groups must reproduce the one-group result exactly (lossless — no
+    # token can overflow C = Tg regardless of grouping)
+    import dataclasses
+
+    base = MoEConfig(hidden=8, experts=4, intermediate=16, top_k=2,
+                     group_size=0, serving_group_size=0)
+    mapped = dataclasses.replace(base, serving_group_size=7)  # 5 groups via lax.map
+    params = init_moe_params(base, seed=12)
+    x = jax.random.normal(jax.random.PRNGKey(13), (32, 8), jnp.float32)
+    y_single, _ = moe_ffn(params, x, base, full_capacity=True)
+    y_mapped, _ = moe_ffn(params, x, mapped, full_capacity=True)
+    np.testing.assert_allclose(
+        np.asarray(y_mapped), np.asarray(y_single), rtol=1e-5, atol=1e-5
+    )

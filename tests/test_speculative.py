@@ -94,3 +94,23 @@ def test_speculative_rejects_quantized_target():
     lm = DecoderLM("pw-tiny-decoder", max_cache=64, quantize="int8")
     with pytest.raises(ValueError, match="float tree"):
         lm.generate_ids_speculative([[1, 2]], max_new_tokens=4)
+
+
+def test_done_mask_freezes_finished_rows():
+    """done=True rows accept 0 tokens, keep pos frozen and leave their
+    cache slice bit-identical across rounds (the out-of-range-scatter
+    invariant no longer carries finished rows)."""
+    tree = init_decoder_params(CFG, seed=2)
+    rng = np.random.default_rng(2)
+    B, S, K = 2, 5, 4
+    prompt = rng.integers(1, CFG.vocab_size, size=(B, S)).astype(np.int32)
+    lens = jnp.full((B,), S, jnp.int32)
+    logits, kc, vc = prefill(tree, jnp.asarray(prompt), lens, CFG, 32)
+    done = jnp.asarray([True, False])
+    _, n_match, _, kc2, vc2, pos = speculative_decode_chunk(
+        tree, tree, kc, vc, logits, lens, CFG, K, done=done
+    )
+    assert int(n_match[0]) == 0 and int(pos[0]) == S  # frozen
+    assert int(n_match[1]) == K and int(pos[1]) == S + K  # active row unaffected
+    np.testing.assert_array_equal(np.asarray(kc2[:, 0]), np.asarray(kc[:, 0]))
+    np.testing.assert_array_equal(np.asarray(vc2[:, 0]), np.asarray(vc[:, 0]))
