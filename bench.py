@@ -217,15 +217,18 @@ def child() -> None:
             signal.alarm(0)
             signal.signal(signal.SIGALRM, old)
 
-    # int8 runs LAST: its one fresh compile (the int8 program at the
-    # headline shape) is the only extra that could stall a cold window,
-    # and last position means a stall loses only itself
+    # int8 sits after the cheap extras: its fresh compile (the int8
+    # program at the headline shape) is the likeliest cold-window stall,
+    # and a stall there forfeits only itself and the serving extra
     for key, fn, seconds in (
         ("bge_mfu", lambda: _extra_bge_mfu(peak), 120),
         ("retrieval_625k", _extra_retrieval_p50, 120),
         ("profile_trace", lambda: _extra_profile_trace(fwd, params, ids, mask), 120),
         ("int8_encoder",
          lambda: _extra_int8_encoder(fwd, params, ids, mask, emb_per_sec), 180),
+        # runs LAST: it starts a daemon engine thread that lives until
+        # process exit, which must not sit under the other measurements
+        ("retrieval_serving", _extra_retrieval_serving, 420),
     ):
         try:
             result[key] = _with_deadline(fn, seconds)
@@ -333,6 +336,24 @@ def _extra_retrieval_p50() -> dict:
         file=sys.stderr,
     )
     return {"device_ms_per_query": round(device_ms, 3)}
+
+
+def _extra_retrieval_serving() -> dict:
+    """Full serving-path latency at the 625k-docs/chip north-star shard:
+    REST ingress → engine epoch → query embed → cached device search →
+    k-merge → JSON response, stage-clocked on the serving host
+    (benchmarks/retrieval_serving.py; VERDICT r4 weak #2)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    from retrieval_serving import measure
+
+    out = measure(625_000, n_queries=40, n_warmup=6)
+    print(
+        f"retrieval serving: colocated p50 {out['colocated_p50_ms']} ms "
+        f"(host {out['host_other_p50_ms']} + embed {out['embed_device_ms']} "
+        f"+ search {out['search_device_ms']})",
+        file=sys.stderr,
+    )
+    return out
 
 
 def _extra_profile_trace(fwd, params, ids, mask) -> str:

@@ -130,6 +130,20 @@ def attest_once() -> bool:
             paths.append(ret_path)
     except Exception as exc:  # noqa: BLE001 — retrieval evidence is best-effort
         print(f"attest_loop: retrieval capture failed: {exc}", file=sys.stderr)
+    # full serving-path retrieval latency at the north-star shard (REST →
+    # embed → device search → respond, stage-clocked server-side)
+    try:
+        srv = _run_json_bench("retrieval_serving.py", "625000", "60", timeout=1200)
+        if srv is not None and srv.get("platform") == "tpu":
+            srv["attested_at_utc"] = stamp
+            srv["git_head"] = head
+            srv_path = os.path.join(ATTEST_DIR, f"SERVING_attested_{stamp}.json")
+            with open(srv_path, "w") as f:
+                json.dump(srv, f, indent=1)
+                f.write("\n")
+            paths.append(srv_path)
+    except Exception as exc:  # noqa: BLE001
+        print(f"attest_loop: serving capture failed: {exc}", file=sys.stderr)
     # decoder serving throughput (tinyllama-class prefill + cached decode)
     try:
         # cold windows compile four decode programs (float/int8 chunks,
