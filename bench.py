@@ -68,6 +68,58 @@ def _analytic_flops_per_seq(cfg, seq: int) -> float:
     return float(cfg.layers * per_token_layer * seq)
 
 
+def _aot_dir() -> str:
+    d = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks", ".aot"
+    )
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _try_load_aot(tag: str):
+    """Deserialize a previously compiled executable — skips tracing AND
+    compilation, so a driver tunnel window costs seconds (VERDICT r4 next
+    #2).  Any mismatch (device kind, jax/runtime version) falls back to
+    the jit path; the file is then rewritten."""
+    import pickle
+
+    path = os.path.join(_aot_dir(), tag + ".pkl")
+    if not os.path.exists(path):
+        return None
+    try:
+        from jax.experimental import serialize_executable as se
+
+        with open(path, "rb") as f:
+            payload = pickle.load(f)
+        loaded = se.deserialize_and_load(
+            payload["serialized"], payload["in_tree"], payload["out_tree"]
+        )
+        print(f"AOT executable loaded: {tag}", file=sys.stderr)
+        return loaded
+    except Exception as exc:  # noqa: BLE001
+        print(f"AOT load failed ({tag}): {exc}; recompiling", file=sys.stderr)
+        return None
+
+
+def _save_aot(tag: str, compiled) -> None:
+    import pickle
+
+    try:
+        from jax.experimental import serialize_executable as se
+
+        serialized, in_tree, out_tree = se.serialize(compiled)
+        path = os.path.join(_aot_dir(), tag + ".pkl")
+        with open(path + ".tmp", "wb") as f:
+            pickle.dump(
+                {"serialized": serialized, "in_tree": in_tree, "out_tree": out_tree},
+                f,
+            )
+        os.replace(path + ".tmp", path)
+        print(f"AOT executable saved: {tag}", file=sys.stderr)
+    except Exception as exc:  # noqa: BLE001
+        print(f"AOT save failed ({tag}): {exc}", file=sys.stderr)
+
+
 def _measure_encoder(
     model_name: str, batch: int, iters: int, windows: int, warmup: int
 ):
@@ -78,6 +130,10 @@ def _measure_encoder(
     materialization via a scalar D2H fetch: under the remote TPU tunnel
     block_until_ready can return before execution finishes, so timing
     hangs a data dependency off every iteration instead.
+
+    On accelerators the measurement loop runs the AOT-serialized compiled
+    executable when one is cached (and serializes it after a fresh
+    compile), so repeat windows skip compilation entirely.
 
     Returns (emb_per_sec, best_dt, cfg, fwd, params, ids, mask) — the jit
     artifacts are returned so callers (profile trace) can reuse them.
@@ -110,15 +166,32 @@ def _measure_encoder(
     )
     mask = jnp.ones((batch, SEQ), jnp.int32)
 
+    on_accel = jax.default_backend() not in ("cpu",)
+    run = fwd
+    if on_accel:
+        kind = getattr(jax.devices()[0], "device_kind", "dev").replace(" ", "_")
+        tag = f"{model_name}_{batch}x{SEQ}_{kind}_jax{jax.__version__}"
+        run = _try_load_aot(tag)
+        if run is not None:
+            try:  # trial call: deserialization can succeed yet bind to a
+                # stale device topology — fall back to compiling if so
+                float(run(params, ids, mask).sum())
+            except Exception as exc:  # noqa: BLE001
+                print(f"AOT trial call failed ({exc}); recompiling", file=sys.stderr)
+                run = None
+        if run is None:
+            run = fwd.lower(params, ids, mask).compile()
+            _save_aot(tag, run)
+
     for _ in range(warmup):
-        float(fwd(params, ids, mask).sum())
+        float(run(params, ids, mask).sum())
 
     emb_per_sec, best_dt = 0.0, 0.0
     for _ in range(windows):
         t0 = time.perf_counter()
         acc = None
         for _ in range(iters):
-            out = fwd(params, ids, mask)
+            out = run(params, ids, mask)
             s = out.sum()
             acc = s if acc is None else acc + s
         assert np.isfinite(float(acc))  # D2H of a scalar syncs the chain
@@ -217,9 +290,17 @@ def child() -> None:
             signal.alarm(0)
             signal.signal(signal.SIGALRM, old)
 
+    # the measurement loop may have run the AOT executable, leaving fwd's
+    # jit cache cold — warm it here (persistent-cache hit, seconds) so the
+    # profile trace stays compile-free and the int8 extra's warm-reference
+    # premise holds; a stall here only risks the extras, never the headline
+    try:
+        _with_deadline(lambda: float(fwd(params, ids, mask).sum()), 120)
+    except Exception as exc:  # noqa: BLE001
+        result["fwd_warm_error"] = f"{type(exc).__name__}: {exc}"[:200]
+
     # int8 sits after the cheap extras: its fresh compile (the int8
-    # program at the headline shape) is the likeliest cold-window stall,
-    # and a stall there forfeits only itself and the serving extra
+    # program at the headline shape) is the likeliest cold-window stall
     for key, fn, seconds in (
         ("bge_mfu", lambda: _extra_bge_mfu(peak), 120),
         ("retrieval_625k", _extra_retrieval_p50, 120),
@@ -234,7 +315,10 @@ def child() -> None:
             result[key] = _with_deadline(fn, seconds)
         except Exception as exc:  # noqa: BLE001
             result[f"{key}_error"] = f"{type(exc).__name__}: {exc}"[:200]
-    print(json.dumps(result))
+        # re-print after every extra: the parent keeps the LAST matching
+        # line, so a later extra blowing the child deadline loses only
+        # the not-yet-run extras, not completed ones
+        print(json.dumps(result), flush=True)
 
 
 def _extra_bge_mfu(peak: float) -> float:
