@@ -161,6 +161,7 @@ class Node:
         # monitoring counters (ProberStats analog, graph.rs:512)
         self.rows_in = 0
         self.rows_out = 0
+        self.step_seconds = 0.0  # cumulative time in step(), probe-read
         # multi-worker exchange declaration (engine/comm.py WorkerContext):
         # port -> routing-key fn (None = route by row key), or gather-to-0
         # for globally-ordered operators.  The exchange point is exactly
@@ -546,6 +547,11 @@ class ExprNode(Node):
         self.fn = fn
         # (needed_col_indices, [fn per out col], [out dtype per out col])
         self.vec_select = None
+        # join-select projection spec ((src, idx), ...) — set by the
+        # Lowerer when every output is a plain left/right column or id
+        # pick over JoinNode payload rows; one native C pass replaces the
+        # per-row accessor closures (pure copies — no new Errors possible)
+        self.vec_join_project = None
         for d in deps:
             d.require_state()
 
@@ -566,6 +572,11 @@ class ExprNode(Node):
                     out_cols.append(("P", f))
                     continue
                 arr = f(cols, n)
+                if isinstance(arr, list):  # Python-object column (tuples)
+                    if len(arr) != n:
+                        return None
+                    out_cols.append(("U", arr))
+                    continue
                 if not vc.result_kind_ok(arr, d):
                     return None
                 out_cols.append(arr)
@@ -577,7 +588,25 @@ class ExprNode(Node):
         deltas = self.take_pending()
         clean_in = isinstance(deltas, CleanDeltas)
         out = None
-        if self.vec_select is not None and len(deltas) >= _vec_threshold():
+        if self.vec_join_project is not None and deltas:
+            from pathway_tpu.internals import vector_compiler as vc
+
+            nat = _get_native_module()
+            if vc.ENABLED and nat is not None and hasattr(nat, "project_join_rows"):
+                res = nat.project_join_rows(deltas, self.vec_join_project)
+                if res is not None:  # None = malformed shape, row path
+                    out, err_keys = res
+                    for ek in err_keys or ():
+                        # row-path parity: copied Error cells are logged
+                        self.scope.error_log.append(
+                            (
+                                self,
+                                ek,
+                                "expression evaluated to Error (division by "
+                                "zero, bad cast, or type error)",
+                            )
+                        )
+        if out is None and self.vec_select is not None and len(deltas) >= _vec_threshold():
             out = self._try_columnar(deltas)
         if out is None:
             out = []
@@ -1002,6 +1031,15 @@ class JoinNode(Node):
         # for outer modes: per row match count
         self._left_matches: Counter = Counter()
         self._right_matches: Counter = Counter()
+        # native inner-join fast path: the Lowerer sets (l_idxs, r_idxs,
+        # okey_mode) when the join keys are plain column picks and the mode
+        # is inner; the whole delta-join step then runs in _native.cpp with
+        # the SAME semantics (None/Error keys match nothing, 128-bit jk
+        # hashing, identical output keys).  Chosen once per node — the two
+        # index representations never mix within a run.
+        self.native_spec: tuple | None = None
+        self._native_idx = None
+        self._nat = None
 
     def _infer_append_only(self) -> bool:
         # inner joins of append-only sides only ever add pairs; outer modes
@@ -1031,7 +1069,89 @@ class JoinNode(Node):
         okey = self.out_key_fn(lkey, None, jk)
         out.append((okey, (lkey, None, lrow, None), sign))
 
+    def _native_cap(self):
+        if self.native_spec is None:
+            return None
+        if self._native_idx is None:
+            nat = _get_native_module()
+            if nat is None or not hasattr(nat, "join_step"):
+                self.native_spec = None
+                return None
+            self._nat = nat
+            self._native_idx = nat.join_new()
+            # a snapshot restored into the row-path dicts before the first
+            # step (path availability changed across runs): migrate it
+            if self._left_idx or self._right_idx:
+                l_idxs, r_idxs, _ = self.native_spec
+                for side, idx_map, key_idxs in (
+                    (0, self._left_idx, l_idxs),
+                    (1, self._right_idx, r_idxs),
+                ):
+                    items = [
+                        (k, row)
+                        for bucket in idx_map.values()
+                        for k, row in bucket.items()
+                    ]
+                    nat.join_load(self._native_idx, side, items, key_idxs)
+                self._left_idx.clear()
+                self._right_idx.clear()
+        return self._native_idx
+
+    def persist_dump(self):
+        if self._native_idx is not None:
+            data = super().persist_dump() or {}
+            data["__native_join"] = self._nat.join_dump(self._native_idx)
+            return data
+        return super().persist_dump()
+
+    def persist_load(self, data) -> None:
+        data = dict(data)  # callers may reuse the dump; never mutate it
+        nj = data.pop("__native_join", None)
+        super().persist_load(data)
+        if nj is None:
+            return
+        cap = self._native_cap()
+        if cap is not None:
+            l_idxs, r_idxs, _ = self.native_spec
+            self._nat.join_load(cap, 0, nj[0], l_idxs)
+            self._nat.join_load(cap, 1, nj[1], r_idxs)
+        else:
+            # native unavailable in this run: rebuild the row-path dicts
+            for items, idx_map, key_fn in (
+                (nj[0], self._left_idx, self.left_key_fn),
+                (nj[1], self._right_idx, self.right_key_fn),
+            ):
+                for key, row in items:
+                    jk = key_fn(key, row)
+                    if jk is not None:
+                        idx_map[jk][key] = row
+
     def step(self, time):
+        cap = self._native_cap()
+        if cap is not None:
+            dl = consolidate(self.take_pending(0))
+            dr = consolidate(self.take_pending(1))
+            l_idxs, r_idxs, mode = self.native_spec
+            raw, replaced = self._nat.join_step(
+                cap, dl, dr, l_idxs, r_idxs, mode
+            )
+            if (
+                mode == 0
+                and not replaced
+                and isinstance(dl, CleanDeltas)
+                and isinstance(dr, CleanDeltas)
+            ):
+                # clean inputs + fresh row keys: every emitted pair
+                # (lkey, rkey) is distinct, so the hash-pair okeys are
+                # distinct and all diffs are +1 — provably clean output
+                out = CleanDeltas(raw)
+            else:
+                out = consolidate(raw)
+            if self.keep_state:
+                self._update_state(out)
+            self.send(out, time)
+            return
+
         out: list[Delta] = []
         dl = consolidate(self.take_pending(0))
         dr = consolidate(self.take_pending(1))
@@ -1163,34 +1283,47 @@ class GroupByNode(Node):
         if not vc.ENABLED:
             return False
         gidx, red_cols = self.vec_group
-        needed = {gidx} | {vidx for kind, vidx in red_cols if kind != "count"}
-        # shared materializer: uniform-Python-type + int64-range checks.
-        # Raw form keeps str columns as Python lists so the group keys can
-        # hash-group natively (np.unique on a 1M-row U-array pays a full
-        # array build plus a sort — the hot spot of the wordcount epoch).
-        raw = vc.materialize_delta_columns_raw(deltas, needed)
+        multi = isinstance(gidx, tuple)  # multi-column group key
         gvals_list = None
         inv = None
-        if raw is NotImplemented:
-            cols = vc.materialize_delta_columns(deltas, needed)
-            if cols is None:
+        if multi:
+            needed = {vidx for kind, vidx in red_cols if kind != "count"}
+            cols = vc.materialize_delta_columns(deltas, needed) if needed else {}
+            if needed and cols is None:
                 return False
-        elif raw is None:
-            return False
+            # group keys are Python tuples straight off the rows — the
+            # native hash grouping keys on the same objects the row path's
+            # dict does, so equality semantics (incl. NaN identity) match
+            keys = [tuple(row[i] for i in gidx) for (_k, row, _d) in deltas]
+            gvals_list, inv = vc.group_indices(keys)
         else:
-            cols = {}
-            for i, (kind, payload) in raw.items():
-                if i == gidx and kind == "U":
-                    gvals_list, inv = vc.group_indices(payload)
-                    cols[i] = payload  # raw list; only grouped, never math
-                else:
-                    cols[i] = vc.wrap_native_col(kind, payload)
-        garr = cols[gidx]
-        if gvals_list is None:
-            # NaN group keys: np.unique collapses all NaNs into one group
-            # while the row path's dict keeps one group per NaN object — bail
-            if garr.dtype.kind == "f" and np.isnan(garr).any():
+            needed = {gidx} | {vidx for kind, vidx in red_cols if kind != "count"}
+            # shared materializer: uniform-Python-type + int64-range checks.
+            # Raw form keeps str columns as Python lists so the group keys
+            # can hash-group natively (np.unique on a 1M-row U-array pays a
+            # full array build plus a sort — the wordcount hot spot).
+            raw = vc.materialize_delta_columns_raw(deltas, needed)
+            if raw is NotImplemented:
+                cols = vc.materialize_delta_columns(deltas, needed)
+                if cols is None:
+                    return False
+            elif raw is None:
                 return False
+            else:
+                cols = {}
+                for i, (kind, payload) in raw.items():
+                    if i == gidx and kind == "U":
+                        gvals_list, inv = vc.group_indices(payload)
+                        cols[i] = payload  # raw list; grouped, never math
+                    else:
+                        cols[i] = vc.wrap_native_col(kind, payload)
+            garr = cols[gidx]
+            if gvals_list is None:
+                # NaN group keys: np.unique collapses all NaNs into one
+                # group while the row path's dict keeps one group per NaN
+                # object — bail
+                if garr.dtype.kind == "f" and np.isnan(garr).any():
+                    return False
         val_arrs = [
             None if kind == "count" else cols[vidx] for kind, vidx in red_cols
         ]
@@ -1225,6 +1358,8 @@ class GroupByNode(Node):
             uniq, inv = np.unique(garr, return_inverse=True)
             gvals_list = uniq.tolist()
         n_groups = len(gvals_list)
+        if n_groups == 0:
+            return True
         counts = np.zeros(n_groups, np.int64)
         np.add.at(counts, inv, diffs)
         contribs = []
@@ -1259,7 +1394,7 @@ class GroupByNode(Node):
             c.tolist() if isinstance(c, np.ndarray) else c for c in contribs
         ]
         for ui, gval in enumerate(gvals):
-            gk = (gval,)
+            gk = gval if multi else (gval,)
             states = self._ensure_group(gk)
             for state, contrib in zip(states, contribs_l):
                 if contrib is None:
@@ -2075,7 +2210,11 @@ class Scope:
             try:
                 if worker is not None:
                     worker.exchange_node(node, time)
+                t0 = _monotonic()
                 node.step(time)
+                # cumulative per-operator step time feeds the live
+                # dashboard / metrics (progress_reporter.rs analog)
+                node.step_seconds += _monotonic() - t0
             except Exception as exc:
                 self._note_user_frame(node, exc)
                 raise

@@ -2004,11 +2004,14 @@ class GroupedTable:
                     return None
                 return binder.col_index[e.name]
 
-            if grouped_by_id or inst_expr is not None or len(g_exprs) != 1:
+            if grouped_by_id or inst_expr is not None or not g_exprs:
                 return None
-            gidx = plain_idx(g_exprs[0])
-            if gidx is None:
+            g_idxs = tuple(plain_idx(e) for e in g_exprs)
+            if any(i is None for i in g_idxs):
                 return None
+            # single-column groups keep the scalar spec (numpy unique /
+            # native raw grouping); multi-column groups hash-group tuples
+            gidx = g_idxs[0] if len(g_idxs) == 1 else g_idxs
             red_cols = []
             for r in slots:
                 red = r._reducer
@@ -2100,6 +2103,21 @@ class JoinResult(Joinable):
                 return True
         return False
 
+    @staticmethod
+    def _side_of(tbl, left_table, right_table) -> str | None:
+        """'left'/'right'/None — the ONE left/right/ThisPlaceholder
+        dispatch rule shared by out_key_fn, the native okey-mode
+        detection and the projection spec (they must never desync)."""
+        if tbl is left_table or (
+            isinstance(tbl, ThisPlaceholder) and tbl._kind == "left"
+        ):
+            return "left"
+        if tbl is right_table or (
+            isinstance(tbl, ThisPlaceholder) and tbl._kind == "right"
+        ):
+            return "right"
+        return None
+
     def _lower_join(self, lowerer: Lowerer) -> df.JoinNode:
         lnode = lowerer.node(self._left)
         rnode = lowerer.node(self._right)
@@ -2122,18 +2140,19 @@ class JoinResult(Joinable):
         id_param = self._id_param
         left_table, right_table = self._left, self._right
 
+        id_side = None
+        if (
+            id_param is not None
+            and isinstance(id_param, ColumnReference)
+            and id_param.name == "id"
+        ):
+            id_side = JoinResult._side_of(id_param.table, left_table, right_table)
+
         def out_key_fn(lkey, rkey, jk):
-            if id_param is not None and isinstance(id_param, ColumnReference):
-                if id_param.name == "id":
-                    src = id_param.table
-                    if src is left_table or (
-                        isinstance(src, ThisPlaceholder) and src._kind == "left"
-                    ):
-                        return lkey if lkey is not None else hash_values([None, rkey])
-                    if src is right_table or (
-                        isinstance(src, ThisPlaceholder) and src._kind == "right"
-                    ):
-                        return rkey if rkey is not None else hash_values([lkey, None])
+            if id_side == "left":
+                return lkey if lkey is not None else hash_values([None, rkey])
+            if id_side == "right":
+                return rkey if rkey is not None else hash_values([lkey, None])
             return hash_values(
                 [
                     Pointer(lkey) if lkey is not None else None,
@@ -2141,7 +2160,7 @@ class JoinResult(Joinable):
                 ]
             )
 
-        return df.JoinNode(
+        node = df.JoinNode(
             lowerer.scope,
             lnode,
             rnode,
@@ -2151,6 +2170,44 @@ class JoinResult(Joinable):
             left_outer=self._mode in (JoinMode.LEFT, JoinMode.OUTER),
             right_outer=self._mode in (JoinMode.RIGHT, JoinMode.OUTER),
         )
+        if self._mode is JoinMode.INNER:
+            from pathway_tpu.internals import vector_compiler as vc
+
+            # plain-column inner joins run the whole delta-join step in the
+            # native C++ index (reference join hot path, dataflow.rs:2740);
+            # okey modes mirror out_key_fn above exactly
+            l_idxs = [vc.passthrough_index(e, lbinder) for e in self._left_on]
+            r_idxs = [vc.passthrough_index(e, rbinder) for e in self._right_on]
+
+            def _hashable_key_dtypes() -> bool:
+                """The native index matches by serialized bytes; the row
+                path by Python equality.  They agree only for same-dtype
+                keys whose equality is byte equality: int/str/bytes/bool/
+                Pointer.  Floats are out (-0.0 == 0.0 with different
+                bytes, nan != nan with equal bytes); cross-dtype pairs
+                are out (True == 1, 1 == 1.0 across columns)."""
+                exact = {dt.INT, dt.STR, dt.BYTES, dt.BOOL, dt.POINTER}
+                for le, re_ in zip(self._left_on, self._right_on):
+                    lcol = left_table.schema.__columns__.get(le.name)
+                    rcol = right_table.schema.__columns__.get(re_.name)
+                    if lcol is None or rcol is None:
+                        return False
+                    ld = lcol.dtype.strip_optional()
+                    rd = rcol.dtype.strip_optional()
+                    if ld is not rd or ld not in exact:
+                        return False
+                return True
+
+            if (
+                vc.ENABLED
+                and l_idxs
+                and None not in l_idxs
+                and None not in r_idxs
+                and _hashable_key_dtypes()
+            ):
+                mode = {"left": 1, "right": 2}.get(id_side, 0)
+                node.native_spec = (tuple(l_idxs), tuple(r_idxs), mode)
+        return node
 
     def select(self, *args, **kwargs) -> Table:
         exprs: dict[str, Any] = {}
@@ -2298,6 +2355,35 @@ class JoinResult(Joinable):
 
         jr = self
 
+        def _project_spec():
+            """((src, idx), ...) when every output is a plain left/right
+            column or id pick — the native join projection's contract
+            (srcs: 0 lrow[idx], 1 rrow[idx], 2/3 left/right id, 4 out id).
+            None when any expression needs the row interpreter."""
+            l_names = left_table.column_names()
+            r_names = right_table.column_names()
+            spec = []
+            for e in exprs.values():
+                if not isinstance(e, ColumnReference):
+                    return None
+                tbl, name = e.table, e.name
+                side = JoinResult._side_of(tbl, left_table, right_table)
+                if side is None and isinstance(tbl, ThisPlaceholder):
+                    if name == "id":
+                        spec.append((4, -1))
+                        continue
+                    in_l, in_r = name in l_names, name in r_names
+                    if in_l and in_r:
+                        return None  # ambiguity error stays on the row path
+                    side = "left" if in_l else ("right" if in_r else None)
+                if side == "left":
+                    spec.append((2, -1) if name == "id" else (0, l_names.index(name)))
+                elif side == "right":
+                    spec.append((3, -1) if name == "id" else (1, r_names.index(name)))
+                else:
+                    return None
+            return tuple(spec)
+
         def build(lowerer: Lowerer) -> df.Node:
             join_node = jr._lower_join(lowerer)
             binder = JoinBinder(lowerer)
@@ -2306,7 +2392,9 @@ class JoinResult(Joinable):
             def fn(key, row):
                 return tuple(f(key, row) for f in fns)
 
-            return df.ExprNode(lowerer.scope, join_node, fn)
+            node = df.ExprNode(lowerer.scope, join_node, fn)
+            node.vec_join_project = _project_spec()
+            return node
 
         tmp_binder = JoinBinder(None)
         cols = {}

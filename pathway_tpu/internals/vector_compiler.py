@@ -40,6 +40,7 @@ from pathway_tpu.internals.expression import (
     IfElseExpression,
     IsNoneExpression,
     IsNotNoneExpression,
+    MakeTupleExpression,
     UnwrapExpression,
 )
 from pathway_tpu.internals.thisclass import ThisPlaceholder
@@ -184,6 +185,28 @@ def _compile(e, binder, needed: set[int]) -> VecFn | None:
 
     if isinstance(e, UnwrapExpression):
         return _compile(e._expr, binder, needed)
+
+    if isinstance(e, MakeTupleExpression):
+        fs = [_compile(a, binder, needed) for a in e._args]
+        if any(f is None for f in fs):
+            return None
+
+        def mk(cols, n):
+            lists = []
+            for f in fs:
+                v = f(cols, n)
+                if isinstance(v, np.ndarray):
+                    # a shared-NaN object groups rows on the row path but
+                    # tolist() would mint distinct NaNs — bail to keep
+                    # group-key equality semantics identical
+                    if v.dtype.kind == "f" and np.isnan(v).any():
+                        raise VecBail
+                    lists.append(v.tolist())
+                else:
+                    lists.append(list(v))
+            return list(zip(*lists)) if lists else [()] * n
+
+        return mk
 
     if isinstance(e, CastExpression):  # Convert (from Json) stays row-wise
         f = _compile(e._expr, binder, needed)
@@ -432,14 +455,14 @@ def rebuild_delta_rows(deltas: list, out_cols: list, n: int) -> list:
             else:  # U / object / narrow dtypes: go through Python scalars
                 packed.append(("U", arr.tolist()))
         return rb(deltas, packed)
-    out_lists = [
-        (
-            [row[arr[1]] for (_, row, _) in deltas]
-            if isinstance(arr, tuple)
-            else arr.tolist()
-        )
-        for arr in out_cols
-    ]
+    def _as_list(arr):
+        if isinstance(arr, tuple):
+            if arr[0] == "U":  # pre-built Python values (tuple columns)
+                return arr[1]
+            return [row[arr[1]] for (_, row, _) in deltas]  # ("P", idx)
+        return arr.tolist()
+
+    out_lists = [_as_list(arr) for arr in out_cols]
     out_rows = list(zip(*out_lists)) if out_lists else [()] * n
     return [
         (key, new_row, diff)

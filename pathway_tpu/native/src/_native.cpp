@@ -1766,7 +1766,595 @@ static PyObject *py_hnsw_stats(PyObject *, PyObject *arg) {
                        (unsigned long)ix->n_dead);
 }
 
+// ---------------------------------------------------------------------------
+// Native inner equi-join (reference hot path: src/engine/dataflow.rs:2740).
+//
+// The Python JoinNode.step costs ~µs/row in closure calls, tuple builds and
+// per-pair hash_values round trips.  This C++ index holds both sides keyed
+// by the blake2b-128 of the join-key column values (the same 128-bit key
+// discipline the whole engine uses) and runs the full delta-join rule
+// (dL⋈R then dR⋈L′) in one call per epoch.  Semantics mirror the row path
+// exactly: None/Error join keys match nothing and are not stored (SQL null
+// semantics); inserts replace, removals drop; emission diff = delta diff.
+// ---------------------------------------------------------------------------
+
+namespace joinx {
+
+struct U128 {
+  uint64_t lo = 0, hi = 0;
+  bool operator==(const U128 &o) const { return lo == o.lo && hi == o.hi; }
+};
+struct U128H {
+  size_t operator()(const U128 &k) const {
+    return (size_t)(k.lo ^ (k.hi * 0x9E3779B97F4A7C15ull));
+  }
+};
+struct Entry {
+  U128 kh;        // row-key hash (bucket membership)
+  PyObject *key;  // owned
+  PyObject *row;  // owned
+};
+// buckets are small vectors, not maps: the common join has a handful of
+// rows per key, where a linear scan beats a per-key unordered_map heap
+// allocation by ~2x; a heavily skewed bucket degrades removals to O(rows)
+using Bucket = std::vector<Entry>;
+using Side = std::unordered_map<U128, Bucket, U128H>;  // jk-hash -> rows
+
+struct Index {
+  Side sides[2];  // 0 = left, 1 = right
+  ~Index() {
+    for (auto &side : sides)
+      for (auto &b : side)
+        for (auto &e : b.second) {
+          Py_DECREF(e.key);
+          Py_DECREF(e.row);
+        }
+  }
+};
+
+}  // namespace joinx
+
+static void join_capsule_free(PyObject *cap) {
+  delete (joinx::Index *)PyCapsule_GetPointer(cap, "pathway_tpu.join");
+}
+
+static joinx::Index *join_from(PyObject *cap) {
+  return (joinx::Index *)PyCapsule_GetPointer(cap, "pathway_tpu.join");
+}
+
+static PyObject *py_join_new(PyObject *, PyObject *) {
+  return PyCapsule_New(new joinx::Index(), "pathway_tpu.join",
+                       join_capsule_free);
+}
+
+// 128-bit <-> PyLong converters.  On CPython <= 3.12 the private-but-
+// exported byte-array functions skip all object churn; 3.13 changed
+// _PyLong_AsByteArray's signature (added with_exceptions), so any newer
+// interpreter takes the portable PyNumber path — slower, never ABI-wrong.
+#if PY_VERSION_HEX < 0x030D0000
+#define PW_HAVE_LONG_BYTEARRAY 1
+extern "C" {
+PyObject *_PyLong_FromByteArray(const unsigned char *bytes, size_t n,
+                                int little_endian, int is_signed);
+int _PyLong_AsByteArray(PyLongObject *v, unsigned char *bytes, size_t n,
+                        int little_endian, int is_signed);
+}
+#else
+#define PW_HAVE_LONG_BYTEARRAY 0
+#endif
+
+static PyObject *pylong_from_u128(uint64_t lo, uint64_t hi) {
+#if PW_HAVE_LONG_BYTEARRAY
+  uint8_t bytes[16];
+  std::memcpy(bytes, &lo, 8);
+  std::memcpy(bytes + 8, &hi, 8);
+  return _PyLong_FromByteArray(bytes, 16, 1, 0);
+#else
+  PyObject *plo = PyLong_FromUnsignedLongLong(lo);
+  PyObject *phi = PyLong_FromUnsignedLongLong(hi);
+  PyObject *sixtyfour = PyLong_FromLong(64);
+  PyObject *shifted = phi ? PyNumber_Lshift(phi, sixtyfour) : nullptr;
+  PyObject *res = shifted ? PyNumber_Or(shifted, plo) : nullptr;
+  Py_XDECREF(plo);
+  Py_XDECREF(phi);
+  Py_XDECREF(sixtyfour);
+  Py_XDECREF(shifted);
+  return res;
+#endif
+}
+
+// portable 128-bit extraction (mask low 64, shift for high)
+static bool u128_of_pylong_slow(PyObject *v, joinx::U128 *out) {
+  out->lo = PyLong_AsUnsignedLongLongMask(v);
+  if (PyErr_Occurred()) return false;
+  PyObject *sixtyfour = PyLong_FromLong(64);
+  PyObject *shifted = PyNumber_Rshift(v, sixtyfour);
+  Py_DECREF(sixtyfour);
+  if (!shifted) return false;
+  out->hi = PyLong_AsUnsignedLongLongMask(shifted);
+  Py_DECREF(shifted);
+  return !PyErr_Occurred();
+}
+
+// 128-bit row key from its PyLong
+static bool u128_of_pylong(PyObject *v, joinx::U128 *out) {
+#if PW_HAVE_LONG_BYTEARRAY
+  uint8_t bytes[16];
+  if (_PyLong_AsByteArray((PyLongObject *)v, bytes, 16, 1, 0) < 0) {
+    // negative or >128-bit keys never occur (KEY_MASK); be exact anyway
+    PyErr_Clear();
+    return u128_of_pylong_slow(v, out);
+  }
+  std::memcpy(&out->lo, bytes, 8);
+  std::memcpy(&out->hi, bytes + 8, 8);
+  return true;
+#else
+  return u128_of_pylong_slow(v, out);
+#endif
+}
+
+// join key of a row: blake2b-128 of ser_value over the key columns.
+// Returns 1 ok, 0 null-key (None/Error present — matches nothing), -1 error.
+// ``buf`` is caller-provided so row loops reuse one allocation.
+static int join_key_of(PyObject *row, PyObject *idxs, Buf &buf,
+                       joinx::U128 *out) {
+  buf.d.clear();
+  Py_ssize_t n = PyTuple_GET_SIZE(idxs);
+  bool row_is_tuple = PyTuple_Check(row);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    Py_ssize_t idx = PyLong_AsSsize_t(PyTuple_GET_ITEM(idxs, i));
+    if (idx < 0 && PyErr_Occurred()) return -1;
+    PyObject *v;
+    if (row_is_tuple) {
+      if (idx >= PyTuple_GET_SIZE(row)) {
+        PyErr_SetString(PyExc_IndexError, "join key index out of range");
+        return -1;
+      }
+      v = PyTuple_GET_ITEM(row, idx);
+    } else {
+      v = PySequence_GetItem(row, idx);
+      if (!v) return -1;
+      Py_DECREF(v);  // row holds a ref; borrow like the tuple path
+    }
+    if (v == Py_None || Py_TYPE(v) == Py_TYPE(g_error_obj)) return 0;
+    if (!ser_value(v, buf)) return -1;
+  }
+  uint8_t digest[16];
+  blake2b_hash(digest, 16, buf.d.data(), buf.d.size());
+  std::memcpy(&out->lo, digest, 8);
+  std::memcpy(&out->hi, digest + 8, 8);
+  return 1;
+}
+
+// output row key: mode 0 = hash_values([Pointer(lkey), Pointer(rkey)]),
+// mode 1 = lkey (join id'd to the left side), mode 2 = rkey
+static PyObject *join_okey(int mode, PyObject *lkey, PyObject *rkey,
+                           const joinx::U128 &lk, const joinx::U128 &rk) {
+  if (mode == 1) {
+    Py_INCREF(lkey);
+    return lkey;
+  }
+  if (mode == 2) {
+    Py_INCREF(rkey);
+    return rkey;
+  }
+  // ser(Pointer) is tag 0x06 + 16-byte LE value — build both inline
+  uint8_t data[34];
+  data[0] = 0x06;
+  std::memcpy(data + 1, &lk.lo, 8);
+  std::memcpy(data + 9, &lk.hi, 8);
+  data[17] = 0x06;
+  std::memcpy(data + 18, &rk.lo, 8);
+  std::memcpy(data + 26, &rk.hi, 8);
+  uint8_t digest[16];
+  blake2b_hash(digest, 16, data, 34);
+  uint64_t lo, hi;
+  std::memcpy(&lo, digest, 8);
+  std::memcpy(&hi, digest + 8, 8);
+  return pylong_from_u128(lo, hi);
+}
+
+static int join_emit(PyObject *out, int mode, PyObject *lkey, PyObject *rkey,
+                     PyObject *lrow, PyObject *rrow, const joinx::U128 &lk,
+                     const joinx::U128 &rk, PyObject *diff) {
+  PyObject *okey = join_okey(mode, lkey, rkey, lk, rk);
+  if (!okey) return -1;
+  PyObject *payload = PyTuple_Pack(4, lkey, rkey, lrow, rrow);
+  if (!payload) {
+    Py_DECREF(okey);
+    return -1;
+  }
+  PyObject *item = PyTuple_New(3);
+  if (!item) {
+    Py_DECREF(okey);
+    Py_DECREF(payload);
+    return -1;
+  }
+  Py_INCREF(diff);
+  PyTuple_SET_ITEM(item, 0, okey);
+  PyTuple_SET_ITEM(item, 1, payload);
+  PyTuple_SET_ITEM(item, 2, diff);
+  int rc = PyList_Append(out, item);
+  Py_DECREF(item);
+  return rc;
+}
+
+// apply one side's deltas: probe the other side, then update own index.
+// side 0 = deltas are left rows, 1 = right rows.  *replaced is set when an
+// insert overwrote an existing row key (cleanliness analysis cares).
+static int join_apply_side(joinx::Index *ix, int side, PyObject *deltas,
+                           PyObject *idxs, int mode, PyObject *out,
+                           bool *replaced) {
+  auto &mine = ix->sides[side];
+  auto &other = ix->sides[1 - side];
+  PyObject *seq = PySequence_Fast(deltas, "join deltas must be a sequence");
+  if (!seq) return -1;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  mine.reserve(mine.size() + (size_t)n);
+  Buf buf;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *d = PySequence_Fast_GET_ITEM(seq, i);
+    PyObject *key = PyTuple_GET_ITEM(d, 0);
+    PyObject *row = PyTuple_GET_ITEM(d, 1);
+    PyObject *diff = PyTuple_GET_ITEM(d, 2);
+    joinx::U128 jk;
+    int st = join_key_of(row, idxs, buf, &jk);
+    if (st < 0) {
+      Py_DECREF(seq);
+      return -1;
+    }
+    if (st == 0) continue;  // null join key: no match, not stored
+    joinx::U128 kh;
+    if (!u128_of_pylong(key, &kh)) {
+      Py_DECREF(seq);
+      return -1;
+    }
+    auto oit = other.find(jk);
+    if (oit != other.end()) {
+      for (auto &e : oit->second) {
+        int rc = side == 0
+                     ? join_emit(out, mode, key, e.key, row, e.row, kh, e.kh,
+                                 diff)
+                     : join_emit(out, mode, e.key, key, e.row, row, e.kh, kh,
+                                 diff);
+        if (rc < 0) {
+          Py_DECREF(seq);
+          return -1;
+        }
+      }
+    }
+    long long dval = PyLong_AsLongLong(diff);
+    if (dval == -1 && PyErr_Occurred()) {
+      Py_DECREF(seq);
+      return -1;
+    }
+    if (dval > 0) {
+      auto &bucket = mine[jk];
+      joinx::Entry *found = nullptr;
+      for (auto &e : bucket)
+        if (e.kh == kh) {
+          found = &e;
+          break;
+        }
+      if (found) {  // replace (row path: dict put)
+        *replaced = true;
+        Py_DECREF(found->key);
+        Py_DECREF(found->row);
+        Py_INCREF(key);
+        Py_INCREF(row);
+        found->key = key;
+        found->row = row;
+      } else {
+        Py_INCREF(key);
+        Py_INCREF(row);
+        bucket.push_back({kh, key, row});
+      }
+    } else {
+      auto mit = mine.find(jk);
+      if (mit != mine.end()) {
+        auto &bucket = mit->second;
+        for (size_t bi = 0; bi < bucket.size(); bi++)
+          if (bucket[bi].kh == kh) {
+            Py_DECREF(bucket[bi].key);
+            Py_DECREF(bucket[bi].row);
+            bucket.erase(bucket.begin() + bi);
+            break;
+          }
+        if (bucket.empty()) mine.erase(mit);
+      }
+    }
+  }
+  Py_DECREF(seq);
+  return 0;
+}
+
+// (capsule, left_deltas, right_deltas, l_idxs, r_idxs, okey_mode)
+//   -> (out list, replaced: bool)
+static PyObject *py_join_step(PyObject *, PyObject *args) {
+  PyObject *cap, *dl, *dr, *l_idxs, *r_idxs;
+  int mode;
+  if (!PyArg_ParseTuple(args, "OOOO!O!i", &cap, &dl, &dr, &PyTuple_Type,
+                        &l_idxs, &PyTuple_Type, &r_idxs, &mode))
+    return nullptr;
+  auto *ix = join_from(cap);
+  if (!ix) return nullptr;
+  PyObject *out = PyList_New(0);
+  if (!out) return nullptr;
+  bool replaced = false;
+  // delta-join rule: dL against R, then dR against L' (already incl. dL)
+  if (join_apply_side(ix, 0, dl, l_idxs, mode, out, &replaced) < 0 ||
+      join_apply_side(ix, 1, dr, r_idxs, mode, out, &replaced) < 0) {
+    Py_DECREF(out);
+    return nullptr;
+  }
+  PyObject *res = Py_BuildValue("(Oi)", out, replaced ? 1 : 0);
+  Py_DECREF(out);
+  return res;
+}
+
+// (capsule) -> ([(key, row), ...] left, [(key, row), ...] right) — for
+// operator snapshots; join keys are recomputed from the rows on load
+static PyObject *py_join_dump(PyObject *, PyObject *arg) {
+  auto *ix = join_from(arg);
+  if (!ix) return nullptr;
+  PyObject *sides[2] = {nullptr, nullptr};
+  for (int s = 0; s < 2; s++) {
+    PyObject *lst = PyList_New(0);
+    if (!lst) {
+      Py_XDECREF(sides[0]);
+      return nullptr;
+    }
+    for (auto &b : ix->sides[s])
+      for (auto &e : b.second) {
+        PyObject *pair = PyTuple_Pack(2, e.key, e.row);
+        if (!pair || PyList_Append(lst, pair) < 0) {
+          Py_XDECREF(pair);
+          Py_DECREF(lst);
+          Py_XDECREF(sides[0]);
+          return nullptr;
+        }
+        Py_DECREF(pair);
+      }
+    sides[s] = lst;
+  }
+  PyObject *res = PyTuple_Pack(2, sides[0], sides[1]);
+  Py_DECREF(sides[0]);
+  Py_DECREF(sides[1]);
+  return res;
+}
+
+// (capsule, side, items, idxs) -> None; re-inserts snapshot rows
+static PyObject *py_join_load(PyObject *, PyObject *args) {
+  PyObject *cap, *items, *idxs;
+  int side;
+  if (!PyArg_ParseTuple(args, "OiOO!", &cap, &side, &items, &PyTuple_Type,
+                        &idxs))
+    return nullptr;
+  auto *ix = join_from(cap);
+  if (!ix || side < 0 || side > 1) {
+    if (ix) PyErr_SetString(PyExc_ValueError, "side must be 0 or 1");
+    return nullptr;
+  }
+  PyObject *seq = PySequence_Fast(items, "join_load expects a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  Buf buf;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *pair = PySequence_Fast_GET_ITEM(seq, i);
+    PyObject *key = PyTuple_GET_ITEM(pair, 0);
+    PyObject *row = PyTuple_GET_ITEM(pair, 1);
+    joinx::U128 jk, kh;
+    int st = join_key_of(row, idxs, buf, &jk);
+    if (st < 0 || (st == 1 && !u128_of_pylong(key, &kh))) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    if (st == 0) continue;
+    auto &bucket = ix->sides[side][jk];
+    joinx::Entry *found = nullptr;
+    for (auto &e : bucket)
+      if (e.kh == kh) { found = &e; break; }
+    Py_INCREF(key);
+    Py_INCREF(row);
+    if (found) {
+      Py_DECREF(found->key);
+      Py_DECREF(found->row);
+      found->key = key;
+      found->row = row;
+    } else {
+      bucket.push_back({kh, key, row});
+    }
+  }
+  Py_DECREF(seq);
+  Py_RETURN_NONE;
+}
+
+// Pointer(key) without the Python-level call: tp_alloc + slot store.
+// Engine keys are already & KEY_MASK (KEY_MASK = 2^128-1, an identity for
+// the non-negative 128-bit hashes every key derives from), so skipping
+// __init__'s mask is exact.
+static PyObject *make_pointer_fast(PyObject *key) {
+  static PyObject *value_name = nullptr;
+  if (!value_name) {
+    value_name = PyUnicode_InternFromString("value");
+    if (!value_name) return nullptr;
+  }
+  PyTypeObject *tp = (PyTypeObject *)g_pointer_cls;
+  PyObject *obj = tp->tp_alloc(tp, 0);
+  if (!obj) return nullptr;
+  if (PyObject_SetAttr(obj, value_name, key) < 0) {
+    Py_DECREF(obj);
+    return nullptr;
+  }
+  return obj;
+}
+
+// (deltas, spec) -> ([(key, projected_row, diff)], err_keys | None) — the
+// join-select projection over (lkey, rkey, lrow, rrow) payload rows in
+// one C pass.  spec entries: (src, idx) with src 0 = lrow[idx] (None when
+// lrow is None), 1 = rrow[idx], 2 = Pointer(lkey) or None, 3 =
+// Pointer(rkey) or None, 4 = Pointer(out key).  Mirrors table.py
+// JoinBinder accessors.  err_keys lists keys of inserted rows whose
+// projection carries an Error value (the row path logs those; parity).
+// Returns None (not an exception) on any malformed payload shape — the
+// caller then falls back to the row interpreter, like the other native
+// fast paths in this file.
+static PyObject *py_project_join_rows(PyObject *, PyObject *args) {
+  PyObject *deltas, *spec;
+  if (!PyArg_ParseTuple(args, "OO!", &deltas, &PyTuple_Type, &spec))
+    return nullptr;
+  Py_ssize_t n_out = PyTuple_GET_SIZE(spec);
+  // decode the spec once
+  std::vector<std::pair<long, long>> cols(n_out);
+  for (Py_ssize_t i = 0; i < n_out; i++) {
+    PyObject *entry = PyTuple_GET_ITEM(spec, i);
+    cols[i] = {PyLong_AsLong(PyTuple_GET_ITEM(entry, 0)),
+               PyLong_AsLong(PyTuple_GET_ITEM(entry, 1))};
+    if (PyErr_Occurred()) return nullptr;
+  }
+  PyObject *seq = PySequence_Fast(deltas, "project expects a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  PyObject *out = PyList_New(n);
+  PyObject *err_keys = nullptr;
+  PyTypeObject *err_type = Py_TYPE(g_error_obj);
+  if (!out) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  // 0 = ok, 1 = bail (fall back to the row path), 2 = error set
+  auto one = [&](Py_ssize_t i) -> int {
+    PyObject *d = PySequence_Fast_GET_ITEM(seq, i);
+    if (!PyTuple_Check(d) || PyTuple_GET_SIZE(d) != 3) return 1;
+    PyObject *key = PyTuple_GET_ITEM(d, 0);
+    PyObject *payload = PyTuple_GET_ITEM(d, 1);
+    PyObject *diff = PyTuple_GET_ITEM(d, 2);
+    if (!PyTuple_Check(payload) || PyTuple_GET_SIZE(payload) != 4) return 1;
+    PyObject *lkey = PyTuple_GET_ITEM(payload, 0);
+    PyObject *rkey = PyTuple_GET_ITEM(payload, 1);
+    PyObject *lrow = PyTuple_GET_ITEM(payload, 2);
+    PyObject *rrow = PyTuple_GET_ITEM(payload, 3);
+    PyObject *row = PyTuple_New(n_out);
+    bool has_err = false;
+    if (!row) return 2;
+    for (Py_ssize_t c = 0; c < n_out; c++) {
+      long src_ = cols[c].first, idx = cols[c].second;
+      PyObject *v = nullptr;
+      switch (src_) {
+        case 0:
+        case 1: {
+          PyObject *r = src_ == 0 ? lrow : rrow;
+          if (r == Py_None) {
+            Py_INCREF(Py_None);
+            v = Py_None;
+          } else {
+            if (!PyTuple_Check(r) || idx >= PyTuple_GET_SIZE(r)) {
+              Py_DECREF(row);
+              return 1;
+            }
+            v = PyTuple_GET_ITEM(r, idx);
+            if (Py_TYPE(v) == err_type) has_err = true;
+            Py_INCREF(v);
+          }
+          break;
+        }
+        case 2:
+        case 3: {
+          PyObject *k = src_ == 2 ? lkey : rkey;
+          if (k == Py_None) {
+            Py_INCREF(Py_None);
+            v = Py_None;
+          } else {
+            v = make_pointer_fast(k);
+          }
+          break;
+        }
+        case 4:
+          v = make_pointer_fast(key);
+          break;
+        default:
+          PyErr_SetString(PyExc_ValueError, "bad projection src");
+      }
+      if (!v) {
+        Py_DECREF(row);
+        return 2;
+      }
+      PyTuple_SET_ITEM(row, c, v);
+    }
+    if (has_err) {
+      // row-path parity: an inserted row whose projection carries an
+      // Error cell is logged (the payload itself never holds a top-level
+      // Error, so the row path's "new Error" condition reduces to this)
+      long long dv = PyLong_AsLongLong(diff);
+      if (dv == -1 && PyErr_Occurred()) {
+        Py_DECREF(row);
+        return 2;
+      }
+      if (dv > 0) {
+        if (!err_keys) {
+          err_keys = PyList_New(0);
+          if (!err_keys) {
+            Py_DECREF(row);
+            return 2;
+          }
+        }
+        if (PyList_Append(err_keys, key) < 0) {
+          Py_DECREF(row);
+          return 2;
+        }
+      }
+    }
+    PyObject *item = PyTuple_New(3);
+    if (!item) {
+      Py_DECREF(row);
+      return 2;
+    }
+    Py_INCREF(key);
+    Py_INCREF(diff);
+    PyTuple_SET_ITEM(item, 0, key);
+    PyTuple_SET_ITEM(item, 1, row);
+    PyTuple_SET_ITEM(item, 2, diff);
+    PyList_SET_ITEM(out, i, item);
+    return 0;
+  };
+  for (Py_ssize_t i = 0; i < n; i++) {
+    int rc = one(i);
+    if (rc == 0) continue;
+    Py_DECREF(seq);
+    Py_DECREF(out);
+    Py_XDECREF(err_keys);
+    if (rc == 1) Py_RETURN_NONE;  // malformed: caller uses the row path
+    return nullptr;
+  }
+  Py_DECREF(seq);
+  PyObject *res =
+      Py_BuildValue("(OO)", out, err_keys ? err_keys : Py_None);
+  Py_DECREF(out);
+  Py_XDECREF(err_keys);
+  return res;
+}
+
+static PyObject *py_join_stats(PyObject *, PyObject *arg) {
+  auto *ix = join_from(arg);
+  if (!ix) return nullptr;
+  size_t counts[2] = {0, 0};
+  for (int s = 0; s < 2; s++)
+    for (auto &b : ix->sides[s]) counts[s] += b.second.size();
+  return Py_BuildValue("(kk)", (unsigned long)counts[0],
+                       (unsigned long)counts[1]);
+}
+
 static PyMethodDef methods[] = {
+    {"join_new", py_join_new, METH_NOARGS, "native equi-join index capsule"},
+    {"join_step", py_join_step, METH_VARARGS,
+     "(capsule, dl, dr, l_idxs, r_idxs, okey_mode) -> output deltas"},
+    {"join_dump", py_join_dump, METH_O,
+     "(capsule) -> (left [(key, row)], right [(key, row)])"},
+    {"join_load", py_join_load, METH_VARARGS,
+     "(capsule, side, items, idxs) re-inserts snapshot rows"},
+    {"join_stats", py_join_stats, METH_O, "(capsule) -> (n_left, n_right)"},
+    {"project_join_rows", py_project_join_rows, METH_VARARGS,
+     "(join deltas, ((src, idx), ...)) -> projected deltas"},
     {"materialize_columns", py_materialize_columns, METH_VARARGS,
      "(rows|deltas, needed tuple, from_deltas) -> {idx: (kind, buf|list)} "
      "or None on bail"},
