@@ -265,6 +265,34 @@ def windowby(
             )
     else:
         win = window
+        if _tumbling_vectorizable(table, time_expr, win):
+            # tumbling over a non-optional int column assigns EXACTLY one
+            # window per row via plain arithmetic: the start/end columns
+            # compile onto the columnar path (no per-row _assign call, no
+            # flatten), and the multi-key columnar groupby reduces them.
+            # Python // floors, matching _assign's floor for negatives.
+            origin = win.duration * 0 if win.origin is None else win.origin
+            d = win.duration
+
+            def start_of():
+                return ((time_expr - origin) // d) * d + origin
+
+            cols = {
+                "_pw_time": time_expr,
+                "_pw_window_start": start_of(),
+                "_pw_window_end": start_of() + d,
+                # the window value is the (start, end) pair, as on the
+                # flatten path; make_tuple compiles columnar
+                "_pw_window": expr_mod.MakeTupleExpression(
+                    start_of(), start_of() + d
+                ),
+            }
+            if instance is not None:
+                cols["_pw_instance"] = instance
+            assigned = table.with_columns(**cols)
+            if behavior is not None:
+                assigned = _apply_behavior(assigned, behavior)
+            return WindowGroupedTable(assigned, has_instance=instance is not None)
 
         def windows_of(t):
             if t is None:
@@ -292,6 +320,30 @@ def windowby(
         if behavior is not None:
             assigned = _apply_behavior(assigned, behavior)
     return WindowGroupedTable(assigned, has_instance=instance is not None)
+
+
+def _tumbling_vectorizable(table: Table, time_expr, win) -> bool:
+    """The arithmetic fast path is exact only for non-optional int time
+    columns with int duration/origin (float times keep float // float
+    quirks on the row path; None times must drop the row, which the
+    windows_of path does and arithmetic cannot)."""
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.internals.thisclass import ThisPlaceholder
+
+    if not isinstance(win, TumblingWindow):
+        return False
+    if not isinstance(win.duration, int) or win.duration == 0:
+        return False
+    if win.origin is not None and not isinstance(win.origin, int):
+        return False
+    if not isinstance(time_expr, ColumnReference):
+        return False
+    tbl = time_expr.table
+    if isinstance(tbl, ThisPlaceholder):
+        tbl = table
+    sch = getattr(tbl, "schema", None)
+    col = sch.__columns__.get(time_expr.name) if sch is not None else None
+    return col is not None and col.dtype is dt.INT
 
 
 def _apply_behavior(assigned: Table, behavior: Behavior) -> Table:
