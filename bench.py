@@ -76,6 +76,23 @@ def _aot_dir() -> str:
     return d
 
 
+def _encoder_code_fingerprint() -> str:
+    """Hash of the sources that define the headline program — the cache
+    key must change when the program does, or a stale executable would be
+    measured as if it were the new code."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=8)
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)), "pathway_tpu")
+    for rel in ("models/encoder.py", "ops/attention.py"):
+        try:
+            with open(os.path.join(base, rel), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(rel.encode())
+    return h.hexdigest()
+
+
 def _try_load_aot(tag: str):
     """Deserialize a previously compiled executable — skips tracing AND
     compilation, so a driver tunnel window costs seconds (VERDICT r4 next
@@ -170,7 +187,10 @@ def _measure_encoder(
     run = fwd
     if on_accel:
         kind = getattr(jax.devices()[0], "device_kind", "dev").replace(" ", "_")
-        tag = f"{model_name}_{batch}x{SEQ}_{kind}_jax{jax.__version__}"
+        tag = (
+            f"{model_name}_{batch}x{SEQ}_{kind}_jax{jax.__version__}"
+            f"_src{_encoder_code_fingerprint()}"
+        )
         run = _try_load_aot(tag)
         if run is not None:
             try:  # trial call: deserialization can succeed yet bind to a

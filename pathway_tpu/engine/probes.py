@@ -34,6 +34,8 @@ class OperatorStats:
     lag_ms: float | None = None  # now - wallclock of that epoch, if known
     rows_in: int = 0
     rows_out: int = 0
+    step_ms: float = 0.0  # cumulative time spent in step()
+    errors: int = 0  # rows this operator poisoned/logged (error-log count)
     done: bool = False
 
     def merge(self, other: "OperatorStats") -> "OperatorStats":
@@ -43,6 +45,8 @@ class OperatorStats:
             lag_ms=max_opt(self.lag_ms, other.lag_ms),
             rows_in=self.rows_in + other.rows_in,
             rows_out=self.rows_out + other.rows_out,
+            step_ms=self.step_ms + other.step_ms,
+            errors=self.errors + other.errors,
             done=self.done and other.done,
         )
 
@@ -104,12 +108,19 @@ class Prober:
         inputs = OperatorStats(name="input", done=done)
         outputs = OperatorStats(name="output", done=done)
         row_counts: dict[int, int] = {}
+        err_counts: dict[int, int] = {}
+        for err_node, _key, _msg in self.scope.error_log:
+            nid = getattr(err_node, "id", None)
+            if nid is not None:
+                err_counts[nid] = err_counts.get(nid, 0) + 1
         for node in self.scope.nodes:
             st = OperatorStats(
                 name=getattr(node, "name", None) or "node",
                 time=t,
                 rows_in=node.rows_in,
                 rows_out=node.rows_out,
+                step_ms=node.step_seconds * 1000.0,
+                errors=err_counts.get(node.id, 0),
                 done=done or (isinstance(node, InputNode) and node.finished),
             )
             if seen is not None:

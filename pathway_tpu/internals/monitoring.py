@@ -121,6 +121,8 @@ class StatsMonitor:
         table.add_column("lag (ms)", justify="right")
         table.add_column("rows in", justify="right")
         table.add_column("rows out", justify="right")
+        table.add_column("step (ms)", justify="right")
+        table.add_column("errors", justify="right")
         for name, op in self._rows():
             table.add_row(
                 name + (" [done]" if op.done else ""),
@@ -128,6 +130,8 @@ class StatsMonitor:
                 "-" if op.lag_ms is None else f"{op.lag_ms:.0f}",
                 str(op.rows_in),
                 str(op.rows_out),
+                f"{op.step_ms:.1f}",
+                str(op.errors) if op.errors else "-",
             )
         header = Text(
             f"epochs: {self.stats.epochs}"

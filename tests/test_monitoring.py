@@ -117,3 +117,37 @@ def test_run_with_http_server(monkeypatch):
     finally:
         monkeypatch.delenv("PATHWAY_MONITORING_HTTP_PORT")
         refresh_config()
+
+
+def test_live_per_operator_dashboard_all_level():
+    """monitor_level=ALL shows live per-operator rows with step time and
+    error counts (reference internals/monitoring.py:165-226 parity;
+    VERDICT r4 next #8)."""
+    import io as _io
+
+    from rich.console import Console
+
+    buf = _io.StringIO()
+    console = Console(file=buf, force_terminal=False, width=140)
+    monitor = StatsMonitor(MonitoringLevel.ALL, console=console).start()
+    try:
+        t = T("a | b\n1 | 2\n3 | 0\n5 | 4")
+        # 3/0 poisons one row through the division — an error-log entry
+        res = t.select(q=pw.this.a // pw.this.b)
+        pw.io.subscribe(res, on_change=lambda **kw: None)
+        scope_result = pw.run(
+            monitoring_level=MonitoringLevel.NONE, terminate_on_error=False
+        )
+        monitor.update(scope_result.prober.stats)
+    finally:
+        monitor.close()
+    out = buf.getvalue()
+    # per-operator rows (not just input/output)
+    assert "select" in out and "static" in out
+    # the new columns rendered
+    assert "step (ms)" in out and "errors" in out
+    stats = scope_result.prober.stats
+    per_op = list(stats.operator_stats.values())
+    assert any(op.step_ms > 0 for op in per_op), "step time collected"
+    select_ops = [op for op in per_op if op.name == "select"]
+    assert sum(op.errors for op in select_ops) == 1, "error count attributed"
