@@ -125,13 +125,27 @@ def _save_aot(tag: str, compiled) -> None:
         from jax.experimental import serialize_executable as se
 
         serialized, in_tree, out_tree = se.serialize(compiled)
-        path = os.path.join(_aot_dir(), tag + ".pkl")
+        d = _aot_dir()
+        path = os.path.join(d, tag + ".pkl")
         with open(path + ".tmp", "wb") as f:
             pickle.dump(
                 {"serialized": serialized, "in_tree": in_tree, "out_tree": out_tree},
                 f,
             )
         os.replace(path + ".tmp", path)
+        # evict stale revisions of the SAME program (tens of MB each): the
+        # tag's _src fingerprint changes on every encoder edit
+        prefix = tag.split("_src")[0]
+        for f_name in os.listdir(d):
+            if (
+                f_name.startswith(prefix)
+                and f_name.endswith(".pkl")
+                and f_name != tag + ".pkl"
+            ):
+                try:
+                    os.remove(os.path.join(d, f_name))
+                except OSError:
+                    pass
         print(f"AOT executable saved: {tag}", file=sys.stderr)
     except Exception as exc:  # noqa: BLE001
         print(f"AOT save failed ({tag}): {exc}", file=sys.stderr)

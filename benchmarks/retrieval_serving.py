@@ -226,6 +226,25 @@ def measure(
     embed_ms = sorted((e - s) * 1000.0 for s, e in embed_calls)
     search_ms = sorted((e - s) * 1000.0 for s, e in search_calls)
 
+    # sub-stage decomposition (sequential queries: one embed + one search
+    # call per e2e window): where host_other actually goes
+    def first_in(window, calls):
+        s, e = window
+        for cs, ce in calls:
+            if cs >= s and cs < e:
+                return (cs, ce)
+        return None
+
+    pre_ms, gap_ms, post_ms = [], [], []
+    for w in e2e:
+        emb = first_in(w, embed_calls)
+        sea = first_in(w, search_calls)
+        if emb and sea:
+            pre_ms.append((emb[0] - w[0]) * 1000.0)  # ingress -> embed
+            gap_ms.append((sea[0] - emb[1]) * 1000.0)  # embed -> search
+            post_ms.append((w[1] - sea[1]) * 1000.0)  # search -> response
+    pre_ms.sort(), gap_ms.sort(), post_ms.sort()
+
     # ---- amortized device time (round trips amortize over a chain) ----
     import jax.numpy as jnp
 
@@ -284,6 +303,9 @@ def measure(
         "host_other_p99_ms": round(host_p99, 3),
         "embed_call_p50_ms": round(_percentile(embed_ms, 0.50), 3),
         "search_call_p50_ms": round(_percentile(search_ms, 0.50), 3),
+        "ingress_to_embed_p50_ms": round(_percentile(pre_ms, 0.50), 3),
+        "embed_to_search_p50_ms": round(_percentile(gap_ms, 0.50), 3),
+        "search_to_response_p50_ms": round(_percentile(post_ms, 0.50), 3),
         "embed_device_ms": round(embed_device_ms, 3),
         "search_device_ms": round(search_dev, 3),
         "search_device_fallback": search_device_ms is None,

@@ -92,6 +92,11 @@ class Prober:
         self.scope = scope
         self.callbacks: list[Callable[[ProberStats], None]] = list(callbacks or [])
         self.stats = ProberStats()
+        # incremental error attribution: only entries appended since the
+        # last update are scanned (the log is unbounded on long
+        # terminate_on_error=False streams)
+        self._err_counts: dict[int, int] = {}
+        self._err_scan_pos = 0
 
     def update(self, *, done: bool = False, epochs: int | None = None) -> ProberStats:
         from pathway_tpu.engine.dataflow import InputNode, OutputNode
@@ -108,11 +113,13 @@ class Prober:
         inputs = OperatorStats(name="input", done=done)
         outputs = OperatorStats(name="output", done=done)
         row_counts: dict[int, int] = {}
-        err_counts: dict[int, int] = {}
-        for err_node, _key, _msg in self.scope.error_log:
+        err_counts = self._err_counts
+        log = self.scope.error_log
+        for err_node, _key, _msg in log[self._err_scan_pos :]:
             nid = getattr(err_node, "id", None)
             if nid is not None:
                 err_counts[nid] = err_counts.get(nid, 0) + 1
+        self._err_scan_pos = len(log)
         for node in self.scope.nodes:
             st = OperatorStats(
                 name=getattr(node, "name", None) or "node",

@@ -371,6 +371,16 @@ def _event_loop(
         )
     inputs = _input_nodes(scope)
     pollers = lowerer.pollers
+    # per-run wake signal: reader threads set it on enqueue so the idle
+    # park below ends immediately (per-run, NOT process-wide — a shared
+    # event would busy-spin this loop whenever another run streams)
+    import threading as _threading
+
+    wake = _threading.Event()
+    for p in pollers:
+        q = getattr(p, "q", None)
+        if q is not None and hasattr(q, "wake"):
+            q.wake = wake
     last_time = -1
     drain_spins = 0  # consecutive idle drain epochs (quiesce guard)
     # snapshot_interval_ms=0 means "as often as possible" (reference
@@ -444,7 +454,10 @@ def _event_loop(
         # timer-driven COMMITs keep arriving with no new epochs, and the
         # offsets for the last processed epoch must still reach the broker
         _ack_sources(pollers, persisted=False, up_to_time=last_time)
-        _time.sleep(0.001)
+        # park until a reader signals new data (or the 1 ms cap): serving
+        # queries wake the loop immediately instead of riding out the park
+        wake.wait(0.001)
+        wake.clear()
     scope.current_time = max(scope.current_time, last_time)
     scope.finish()
     result.clean_finish = True

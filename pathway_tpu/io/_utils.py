@@ -159,6 +159,23 @@ class _RowCountEmit:
         self.put(item)
 
 
+class _WakingQueue(queue.Queue):
+    """queue.Queue whose put also signals the owning runner's idle wait.
+
+    ``wake`` is a PER-RUN event the runner attaches before its loop (a
+    process-wide signal would turn one run's park into a busy spin while
+    another run streams); until attached, puts are plain puts.
+    """
+
+    wake: "threading.Event | None" = None
+
+    def put(self, item, block=True, timeout=None):  # noqa: A003
+        super().put(item, block, timeout)
+        w = self.wake
+        if w is not None:
+            w.set()
+
+
 class _QueuePoller:
     """Moves queued rows into the InputNode; stamps commit times.
 
@@ -171,7 +188,7 @@ class _QueuePoller:
         schema: type[schema_mod.Schema],
         autocommit_duration_ms: int | None,
     ):
-        self.q: queue.Queue = queue.Queue()
+        self.q: queue.Queue = _WakingQueue()
         self.input_node = input_node
         self.names = list(schema.__columns__.keys())
         self.dtypes = [schema.__columns__[n].dtype for n in self.names]

@@ -97,6 +97,16 @@ class AsyncMicroBatcher:
     async def _flusher(self, key: int) -> None:
         # flush everything pending on this loop until it quiesces
         try:
+            # first flush is IMMEDIATE: two zero-sleeps let every already-
+            # scheduled same-tick submitter enqueue (the engine gathers an
+            # epoch's rows in one tick), then the batch goes — a lone
+            # serving query pays no fixed flush_delay latency.  Stragglers
+            # that submit after awaiting something else are caught by the
+            # flush_delay rounds below.
+            await asyncio.sleep(0)
+            await asyncio.sleep(0)
+            while self._per_loop.get(key):
+                self._flush(key)
             while True:
                 await asyncio.sleep(self.flush_delay)
                 pending = self._per_loop.get(key)
