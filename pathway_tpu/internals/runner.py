@@ -160,6 +160,9 @@ def run(
         scope.worker = worker_ctx
 
     lowerer = Lowerer(scope)
+    # pw.run(debug=True): connectors with debug_data= lower to static
+    # tables of that data (reference operator_handler.py:110)
+    lowerer.debug_mode = debug
 
     storage = _make_storage(persistence_config)
     if storage is not None:

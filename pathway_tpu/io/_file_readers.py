@@ -21,17 +21,27 @@ from pathway_tpu.engine.types import Json
 from pathway_tpu.io._utils import COMMIT, Offset, Reader
 
 
-def _list_files(path: str) -> list[str]:
+def _list_files(path: str, object_pattern: str = "*") -> list[str]:
+    import fnmatch
+
     if os.path.isdir(path):
         out = []
         for root, _dirs, files in os.walk(path):
             for f in sorted(files):
-                out.append(os.path.join(root, f))
+                # object_pattern filters by file NAME (reference
+                # io/_utils.py object_pattern semantics)
+                if fnmatch.fnmatch(f, object_pattern):
+                    out.append(os.path.join(root, f))
         return sorted(out)
-    matched = sorted(_glob.glob(path))
+    matched = sorted(
+        p for p in _glob.glob(path)
+        if fnmatch.fnmatch(os.path.basename(p), object_pattern)
+    )
     if matched:
         return matched
-    if os.path.exists(path):
+    if os.path.exists(path) and fnmatch.fnmatch(
+        os.path.basename(path), object_pattern
+    ):
         return [path]
     return []
 
@@ -78,7 +88,9 @@ class FileReader(Reader):
         streaming: bool,
         poll_interval: float = 0.5,
         with_metadata: bool = False,
+        object_pattern: str = "*",
     ):
+        self.object_pattern = object_pattern
         self.path = path
         self.parse_file = parse_file
         self.streaming = streaming
@@ -96,7 +108,7 @@ class FileReader(Reader):
         return self
 
     def _my_files(self) -> list[str]:
-        files = _list_files(self.path)
+        files = _list_files(self.path, self.object_pattern)
         if self._stripe is None:
             return files
         wid, n = self._stripe

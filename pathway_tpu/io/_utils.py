@@ -159,6 +159,35 @@ class _RowCountEmit:
         self.put(item)
 
 
+class CommitThrottle:
+    """``min_commit_frequency`` gate for lake sinks: at most one commit per
+    interval (ms); ``force`` (end of stream) always passes.  None = every
+    flush commits."""
+
+    __slots__ = ("interval_ms", "_last")
+
+    def __init__(self, interval_ms: int | None):
+        self.interval_ms = interval_ms
+        self._last = 0.0
+
+    def ready(self, force: bool = False) -> bool:
+        if force or self.interval_ms is None:
+            self._last = _time.monotonic()
+            return True
+        now = _time.monotonic()
+        if (now - self._last) * 1000.0 < self.interval_ms:
+            return False
+        self._last = now
+        return True
+
+
+def with_metadata_schema(schema: type[schema_mod.Schema]) -> type[schema_mod.Schema]:
+    """Append the ``_metadata`` Json column (with_metadata=True readers)."""
+    cols = dict(schema.__columns__)
+    cols["_metadata"] = schema_mod.ColumnSchema(name="_metadata", dtype=dt.JSON)
+    return schema_mod.schema_from_columns(cols)
+
+
 class _WakingQueue(queue.Queue):
     """queue.Queue whose put also signals the owning runner's idle wait.
 
@@ -376,6 +405,24 @@ class _QueuePoller:
             request(seq)
 
 
+def debug_rows(debug_data: Any, schema: type[schema_mod.Schema]) -> list[dict]:
+    """Normalize ``debug_data`` (pandas DataFrame or iterable of row
+    dicts) to row dicts (reference: datasource.debug_datasource + the
+    debug branch of operator_handler.py:110 — static data replaces the
+    source under ``pw.run(debug=True)``)."""
+    if debug_data is None:
+        return []
+    if hasattr(debug_data, "to_dict"):  # pandas DataFrame
+        return list(debug_data.to_dict(orient="records"))
+    if isinstance(debug_data, (str, bytes)):
+        raise TypeError(
+            "debug_data must be a pandas DataFrame or an iterable of row "
+            "dicts; for markdown tables use "
+            "pw.debug.table_from_markdown(...) and pass its rows"
+        )
+    return [dict(r) for r in debug_data]
+
+
 def make_input_table(
     schema: type[schema_mod.Schema],
     reader_factory: Callable[[], Reader],
@@ -383,10 +430,15 @@ def make_input_table(
     autocommit_duration_ms: int | None = 1500,
     upsert: bool = False,
     name: str | None = None,
+    debug_data: Any = None,
 ) -> Table:
     """Build a Table backed by a threaded reader (one thread per run)."""
 
     def build(lowerer: Lowerer) -> df.Node:
+        if debug_data is not None and getattr(lowerer, "debug_mode", False):
+            # pw.run(debug=True): static debug rows replace the live source
+            static = make_static_input_table(schema, debug_rows(debug_data, schema))
+            return lowerer.node(static)
         node = df.InputNode(lowerer.scope)
         node.upsert = upsert
         if upsert:

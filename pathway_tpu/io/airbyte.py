@@ -244,17 +244,29 @@ class _AirbyteReader(Reader):
 
 
 def read(
-    config: dict | str,
+    config: dict | str | None = None,
     streams: list[str] | None = None,
     *,
+    config_file_path: str | None = None,
     mode: str = "streaming",
     refresh_interval_ms: int = 60_000,
     execution_type: str | None = None,
+    enforce_method: str | None = None,
     env_vars: dict | None = None,
+    gcp_region: str | None = None,
+    gcp_job_name: str | None = None,
+    service_user_credentials_file: str | None = None,
     autocommit_duration_ms: int | None = 1500,
+    debug_data: Any = None,
     name: str | None = None,
 ) -> Table:
     """Run an Airbyte source and stream its records.
+
+    ``config_file_path`` is the reference's spelling for a YAML config
+    path (equivalent to passing the path as ``config``).  The GCP Cloud
+    Run execution tier (``enforce_method``/``gcp_*``) is not available in
+    this build — requesting it raises instead of silently running the
+    connector locally.
 
     ``config``: the connection mapping (or a path to the YAML written by
     ``pathway_tpu airbyte create-source``) with ``source.exec_command`` (a
@@ -262,6 +274,18 @@ def read(
     ``source.config`` for the connector's own settings.  Rows have columns
     ``stream`` (str) and ``data`` (json), like the reference connector.
     """
+    if config_file_path is not None:
+        if config is not None:
+            raise ValueError("pass config= or config_file_path=, not both")
+        config = config_file_path
+    if config is None:
+        raise ValueError("airbyte.read requires config= (mapping or YAML path)")
+    if enforce_method not in (None, "venv", "local"):
+        raise NotImplementedError(
+            f"airbyte.read: execution method {enforce_method!r} (GCP Cloud "
+            "Run) is not available in this build; the connector protocol "
+            "runs locally"
+        )
     if execution_type not in (None, "local"):
         raise ValueError(
             f"execution_type={execution_type!r} is not supported in this "
@@ -292,6 +316,7 @@ def read(
         lambda: reader,
         autocommit_duration_ms=autocommit_duration_ms,
         name=name,
+        debug_data=debug_data,
     )
 
 

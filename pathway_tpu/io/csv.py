@@ -40,6 +40,8 @@ def read(
     autocommit_duration_ms: int | None = 1500,
     name: str | None = None,
     with_metadata: bool = False,
+    object_pattern: str = "*",
+    debug_data: Any = None,
     value_columns: list[str] | None = None,
     primary_key: list[str] | None = None,
     types: dict | None = None,
@@ -103,10 +105,12 @@ def read(
     return _utils.make_input_table(
         schema,
         lambda: FileReader(
-            path, typed_parse, streaming=streaming, with_metadata=with_metadata
+            path, typed_parse, streaming=streaming,
+            with_metadata=with_metadata, object_pattern=object_pattern,
         ),
         autocommit_duration_ms=autocommit_duration_ms,
         name=name,
+        debug_data=debug_data,
     )
 
 

@@ -84,11 +84,17 @@ def read(
     topic_name: str | None = None,
     *,
     schema: type[schema_mod.Schema] | None = None,
+    db_type: str | None = None,
     autocommit_duration_ms: int | None = 1500,
+    debug_data: Any = None,
     name: str | None = None,
     **kwargs: Any,
 ) -> Table:
     """Read a Debezium CDC topic into a live table.
+
+    ``db_type`` (postgres/mongodb) is accepted for parity; the envelope
+    parser here auto-detects both payload shapes, so the hint only
+    documents intent.
 
     Reference: ``pw.io.debezium.read`` (python/pathway/io/debezium).
     """
@@ -112,6 +118,7 @@ def read(
             schema,
             commit_interval_s=(autocommit_duration_ms or 1500) / 1000.0,
         ),
+        debug_data=debug_data,
         autocommit_duration_ms=autocommit_duration_ms,
         name=name,
     )

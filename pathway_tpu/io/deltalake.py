@@ -72,7 +72,10 @@ def _list_versions(uri: str) -> list[int]:
 
 
 class _DeltaSink:
-    def __init__(self, uri: str, table: Table):
+    def __init__(self, uri: str, table: Table, min_commit_frequency: int | None = None):
+        # milliseconds between delta commits (None = every epoch flush):
+        # bounds the version count a high-epoch-rate stream produces
+        self._throttle = _utils.CommitThrottle(min_commit_frequency)
         self.uri = uri
         reserved = {"time", "diff", "_pw_key"} & set(table.column_names())
         if reserved:
@@ -188,13 +191,15 @@ class _DeltaSink:
         with self._lock:
             self._rows.append(row)
 
-    def flush(self, _time_arg: int | None = None) -> None:
+    def flush(self, _time_arg: int | None = None, *, force: bool = False) -> None:
         import pyarrow as pa
         import pyarrow.parquet as pq
 
         with self._lock:
             if not self._rows:
                 return
+            if not self._throttle.ready(force):
+                return  # hold rows until the commit interval elapses
             rows, self._rows = self._rows, []
         self._ensure_table()
         cols = {n: [r[i] for r in rows] for i, n in enumerate(self.names)}
@@ -221,11 +226,18 @@ def write(
     table: Table,
     uri: str,
     *,
+    min_commit_frequency: int | None = None,
+    s3_connection_settings: Any = None,
     name: str | None = None,
     _sink_factory: Any = None,
 ) -> None:
     """Append the change stream to a Delta table at ``uri``."""
-    sink = (_sink_factory or _DeltaSink)(uri, table)
+    if s3_connection_settings is not None:
+        raise NotImplementedError(
+            "deltalake.write: S3-backed Delta logs are not supported in "
+            "this build; write to a local path and sync"
+        )
+    sink = (_sink_factory or _DeltaSink)(uri, table, min_commit_frequency)
 
     def on_data(key, row, time, diff):
         plain = tuple(
@@ -237,7 +249,8 @@ def write(
         table,
         on_data,
         on_time_end=sink.flush,
-        on_end=sink.flush,
+        # end of stream always commits, regardless of min_commit_frequency
+        on_end=lambda: sink.flush(force=True),
         name=name or f"deltalake:{uri}",
     )
 
@@ -249,11 +262,19 @@ class DeltaReadError(RuntimeError):
 class _DeltaReader(Reader):
     supports_offsets = True
 
-    def __init__(self, uri: str, schema, mode: str, poll_interval_s: float = 2.0):
+    def __init__(
+        self,
+        uri: str,
+        schema,
+        mode: str,
+        poll_interval_s: float = 2.0,
+        start_from_timestamp_ms: int | None = None,
+    ):
         self.uri = uri
         self.schema = schema
         self.mode = mode
         self.poll_interval_s = poll_interval_s
+        self.start_from_timestamp_ms = start_from_timestamp_ms
         self._applied_version = -1
         # names of parts this reader emitted live (streaming): a remove of a
         # file that was vacuumed before we could re-read it is unrecoverable
@@ -357,9 +378,35 @@ class _DeltaReader(Reader):
                     acc.add(a["remove"]["path"])
         return parsed, removed_after
 
+    def _seek_to_timestamp(self) -> None:
+        """start_from_timestamp_ms: consume-without-emitting every version
+        whose commit timestamp precedes the cutoff (the reference's
+        changes-after-timestamp streaming semantics, data_lake/delta.rs
+        start_from_timestamp_ms)."""
+        if self.start_from_timestamp_ms is None or self._applied_version >= 0:
+            return
+        last_before = -1
+        for v in _list_versions(self.uri):
+            ts = None
+            try:
+                with open(_log_path(self.uri, v)) as f:
+                    for line in f:
+                        action = _json.loads(line)
+                        info = action.get("commitInfo")
+                        if info is not None:
+                            ts = info.get("timestamp")
+                            break
+            except OSError:
+                break
+            if ts is not None and ts >= self.start_from_timestamp_ms:
+                break
+            last_before = v
+        self._applied_version = last_before
+
     def run(self, emit) -> None:
         names = list(self.schema.__columns__.keys())
         has_diff_col = "diff" in names
+        self._seek_to_timestamp()
         self._load_checkpoint(names, has_diff_col, emit)
         while True:
             versions = [
@@ -460,16 +507,32 @@ def read(
     *,
     schema: type[schema_mod.Schema] | None = None,
     mode: str = "streaming",
+    start_from_timestamp_ms: int | None = None,
+    s3_connection_settings: Any = None,
     autocommit_duration_ms: int | None = 1500,
+    debug_data: Any = None,
     name: str | None = None,
     **kwargs: Any,
 ) -> Table:
-    """Read a Delta table (static snapshot or streaming new versions)."""
+    """Read a Delta table (static snapshot or streaming new versions).
+
+    ``start_from_timestamp_ms`` emits only changes committed at/after the
+    timestamp.  S3-backed tables are not reachable from this runtime —
+    ``s3_connection_settings`` raises rather than silently reading nothing.
+    """
+    if s3_connection_settings is not None:
+        raise NotImplementedError(
+            "deltalake.read: S3-backed Delta logs are not supported in this "
+            "build; sync the table to a local path first"
+        )
     if schema is None:
         raise ValueError("deltalake.read requires schema=")
     return _utils.make_input_table(
         schema,
-        lambda: _DeltaReader(uri, schema, mode),
+        lambda: _DeltaReader(
+            uri, schema, mode, start_from_timestamp_ms=start_from_timestamp_ms
+        ),
         autocommit_duration_ms=autocommit_duration_ms,
         name=name,
+        debug_data=debug_data,
     )

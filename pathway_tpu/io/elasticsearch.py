@@ -138,13 +138,25 @@ class _BulkSink:
 
 def write(
     table: Table,
-    elasticsearch_params: ElasticSearchParams,
+    host: "str | ElasticSearchParams",
+    auth: "ElasticSearchAuth | None" = None,
+    index_name: str | None = None,
     *,
     max_batch_size: int | None = None,
     name: str | None = None,
     _sink_factory: Any = None,
 ) -> None:
-    """Index the table into Elasticsearch; row key is the document id."""
+    """Index the table into Elasticsearch; row key is the document id.
+
+    Accepts the reference's positional form ``write(table, host, auth,
+    index_name)`` or a prebuilt ``ElasticSearchParams`` as the second
+    argument."""
+    if isinstance(host, ElasticSearchParams):
+        elasticsearch_params = host
+    else:
+        if index_name is None:
+            raise ValueError("elasticsearch.write requires index_name=")
+        elasticsearch_params = ElasticSearchParams(host, index_name, auth)
     names = table.column_names()
     sink = (_sink_factory or _BulkSink)(elasticsearch_params, max_batch_size)
     index = elasticsearch_params.index_name

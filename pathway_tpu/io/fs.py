@@ -42,6 +42,7 @@ def read(
     object_pattern: str = "*",
     with_metadata: bool = False,
     autocommit_duration_ms: int | None = 1500,
+    debug_data: Any = None,
     name: str | None = None,
     **kwargs: Any,
 ) -> Table:
@@ -54,6 +55,8 @@ def read(
             mode=mode,
             autocommit_duration_ms=autocommit_duration_ms,
             with_metadata=with_metadata,
+            object_pattern=object_pattern,
+            debug_data=debug_data,
         )
     if format == "json":
         return _jsonlines_mod.read(
@@ -63,6 +66,8 @@ def read(
             json_field_paths=json_field_paths,
             autocommit_duration_ms=autocommit_duration_ms,
             with_metadata=with_metadata,
+            object_pattern=object_pattern,
+            debug_data=debug_data,
         )
     if format == "plaintext":
         parse, dtype = plaintext_parse_file, dt.STR
@@ -76,17 +81,22 @@ def read(
     return _utils.make_input_table(
         out_schema,
         lambda: FileReader(
-            path, parse, streaming=streaming, with_metadata=with_metadata
+            path, parse, streaming=streaming,
+            with_metadata=with_metadata, object_pattern=object_pattern,
         ),
         autocommit_duration_ms=autocommit_duration_ms,
         name=name,
+        debug_data=debug_data,
     )
 
 
-def write(table: Table, filename: str, *, format: str = "json", **kwargs: Any) -> None:
+def write(
+    table: Table, filename: str, *, format: str = "json",
+    name: str | None = None, **kwargs: Any,
+) -> None:
     if format in ("json", "jsonlines"):
-        _jsonlines_mod.write(table, filename)
+        _jsonlines_mod.write(table, filename, name=name)
     elif format == "csv":
-        _csv_mod.write(table, filename)
+        _csv_mod.write(table, filename, name=name)
     else:
         raise ValueError(f"unknown fs write format {format!r}")

@@ -38,13 +38,30 @@ _DEFAULT_API = "https://www.googleapis.com"
 class _GDriveReader(Reader):
     supports_offsets = True
 
-    def __init__(self, creds, object_id: str, mode: str, refresh_interval: float, api_base: str, with_metadata: bool):
+    def __init__(
+        self,
+        creds,
+        object_id: str,
+        mode: str,
+        refresh_interval: float,
+        api_base: str,
+        with_metadata: bool,
+        file_name_pattern: "str | list[str] | None" = None,
+        object_size_limit: int | None = None,
+    ):
         self.creds = creds
         self.object_id = object_id
         self.mode = mode
         self.refresh_interval = refresh_interval
         self.api_base = api_base
         self.with_metadata = with_metadata
+        # glob pattern(s) on the file NAME; None keeps everything
+        self.file_name_pattern = (
+            [file_name_pattern]
+            if isinstance(file_name_pattern, str)
+            else file_name_pattern
+        )
+        self.object_size_limit = object_size_limit
         self._seen: dict[str, str] = {}  # file id -> modifiedTime
 
     def seek(self, offset: Any) -> None:
@@ -102,7 +119,22 @@ class _GDriveReader(Reader):
                     # other native types (forms, maps, …) have no export
                 else:
                     out.append(f)
-        return out
+        return [f for f in out if self._accepts(f)]
+
+    def _accepts(self, f: dict) -> bool:
+        import fnmatch
+
+        if self.file_name_pattern is not None and not any(
+            fnmatch.fnmatch(f.get("name", ""), p) for p in self.file_name_pattern
+        ):
+            return False
+        if self.object_size_limit is not None:
+            try:
+                if int(f.get("size", 0)) > self.object_size_limit:
+                    return False
+            except (TypeError, ValueError):
+                pass
+        return True
 
     def _download(self, f: dict) -> bytes:
         mime = f.get("mimeType", "")
@@ -162,6 +194,8 @@ def read(
     mode: str = "streaming",
     refresh_interval: float = 30.0,
     with_metadata: bool = False,
+    file_name_pattern: "str | list[str] | None" = None,
+    object_size_limit: int | None = None,
     autocommit_duration_ms: int | None = 1500,
     name: str | None = None,
     _api_base: str = _DEFAULT_API,
@@ -181,7 +215,9 @@ def read(
     return _utils.make_input_table(
         schema,
         lambda: _GDriveReader(
-            creds, object_id, mode, refresh_interval, _api_base, with_metadata
+            creds, object_id, mode, refresh_interval, _api_base, with_metadata,
+            file_name_pattern=file_name_pattern,
+            object_size_limit=object_size_limit,
         ),
         autocommit_duration_ms=autocommit_duration_ms,
         upsert=True,  # modified files replace their previous row

@@ -121,7 +121,9 @@ def _current_metadata(uri: str) -> tuple[dict, int] | None:
 
 
 class _IcebergSink:
-    def __init__(self, uri: str, table: Table):
+    def __init__(self, uri: str, table: Table, min_commit_frequency: int | None = None):
+        # milliseconds between commits (None = every epoch flush)
+        self._throttle = _utils.CommitThrottle(min_commit_frequency)
         self.uri = uri
         reserved = {"time", "diff", "_pw_key"} & set(table.column_names())
         if reserved:
@@ -205,12 +207,14 @@ class _IcebergSink:
         with self._lock:
             self._rows.append(row)
 
-    def flush(self, _time_arg: int | None = None) -> None:
+    def flush(self, _time_arg: int | None = None, *, force: bool = False) -> None:
         import pyarrow as pa
         import pyarrow.parquet as pq
 
         with self._lock:
             if not self._rows:
+                return
+            if not self._throttle.ready(force):
                 return
             rows, self._rows = self._rows, []
         self._load_state()
@@ -295,6 +299,8 @@ def write(
     namespace: list[str] | None = None,
     table_name: str | None = None,
     *,
+    warehouse: str | None = None,
+    min_commit_frequency: int | None = None,
     uri: str | None = None,
     name: str | None = None,
     _sink_factory: Any = None,
@@ -306,10 +312,11 @@ def write(
     path when ``uri`` is not given.
     """
     if uri is None:
-        if catalog_uri is None or table_name is None:
+        root = warehouse or catalog_uri
+        if root is None or table_name is None:
             raise ValueError("provide uri= (table directory) or catalog args")
-        uri = os.path.join(catalog_uri, *(namespace or []), table_name)
-    sink = (_sink_factory or _IcebergSink)(uri, table)
+        uri = os.path.join(root, *(namespace or []), table_name)
+    sink = (_sink_factory or _IcebergSink)(uri, table, min_commit_frequency)
 
     def on_data(key, row, time, diff):
         plain = tuple(
@@ -321,7 +328,7 @@ def write(
         table,
         on_data,
         on_time_end=sink.flush,
-        on_end=sink.flush,
+        on_end=lambda: sink.flush(force=True),
         name=name or f"iceberg:{uri}",
     )
 
@@ -429,10 +436,12 @@ def read(
     namespace: list[str] | None = None,
     table_name: str | None = None,
     *,
+    warehouse: str | None = None,
     uri: str | None = None,
     schema: type[schema_mod.Schema] | None = None,
     mode: str = "streaming",
     autocommit_duration_ms: int | None = 1500,
+    debug_data: Any = None,
     name: str | None = None,
     **kwargs: Any,
 ) -> Table:
@@ -440,12 +449,14 @@ def read(
     if schema is None:
         raise ValueError("iceberg.read requires schema=")
     if uri is None:
-        if catalog_uri is None or table_name is None:
+        root = warehouse or catalog_uri
+        if root is None or table_name is None:
             raise ValueError("provide uri= (table directory) or catalog args")
-        uri = os.path.join(catalog_uri, *(namespace or []), table_name)
+        uri = os.path.join(root, *(namespace or []), table_name)
     return _utils.make_input_table(
         schema,
         lambda: _IcebergReader(uri, schema, mode),
         autocommit_duration_ms=autocommit_duration_ms,
         name=name,
+        debug_data=debug_data,
     )

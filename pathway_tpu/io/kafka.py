@@ -17,7 +17,9 @@ from pathway_tpu.engine.types import Json
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import schema as schema_mod
 from pathway_tpu.internals.table import Table
+from pathway_tpu.engine.types import hash_values
 from pathway_tpu.io import _utils
+from pathway_tpu.io.jsonlines import _extract_path
 from pathway_tpu.io._utils import COMMIT, Reader
 
 
@@ -48,12 +50,30 @@ class _KafkaReader(Reader):
     # out, as in the reference's KafkaReader (data_storage.rs:766)
     max_allowed_consecutive_errors = 32
 
-    def __init__(self, rdkafka_settings, topic, format, schema, commit_interval_s=1.5):
+    def __init__(
+        self,
+        rdkafka_settings,
+        topic,
+        format,
+        schema,
+        commit_interval_s=1.5,
+        *,
+        json_field_paths=None,
+        with_metadata=False,
+        autogenerate_key=False,
+        start_from_timestamp_ms=None,
+    ):
         self.settings = rdkafka_settings
         self.topic = topic
         self.format = format
         self.schema = schema
         self.commit_interval_s = commit_interval_s
+        self.json_field_paths = json_field_paths
+        self.with_metadata = with_metadata
+        # raw format: False keys rows by the Kafka message key (upsert-like
+        # identity per key), True autogenerates fresh row keys
+        self.autogenerate_key = autogenerate_key
+        self.start_from_timestamp_ms = start_from_timestamp_ms
         # multi-worker: (worker_id, worker_count) → manual assignment of
         # partitions with partition % worker_count == worker_id (the
         # reference's partitioned-source rule, worker-architecture.md:40)
@@ -173,6 +193,37 @@ class _KafkaReader(Reader):
                 )
             else:
                 consumer.subscribe([self.topic])
+            if self.start_from_timestamp_ms is not None:
+                # pin EVERY partition to the first offset at/after the
+                # timestamp: one assign() with the offsets embedded (this
+                # replaces any subscription — a timestamp-pinned start is
+                # a manual-assignment read).  Partitions with no message
+                # at/after the cutoff start at the end (nothing older may
+                # be emitted); lookup failures raise rather than silently
+                # replaying from auto.offset.reset.
+                meta = consumer.list_topics(self.topic, timeout=10.0)
+                parts = sorted(meta.topics[self.topic].partitions.keys())
+                if self._stripe is not None:
+                    parts = self._my_partitions(parts)
+                tps = [
+                    client.TopicPartition(
+                        self.topic, p, self.start_from_timestamp_ms
+                    )
+                    for p in parts
+                ]
+                resolved = consumer.offsets_for_times(tps, timeout=10.0)
+                seek_tps = []
+                for tp in resolved:
+                    if tp.error is not None:
+                        raise RuntimeError(
+                            f"kafka: offsets_for_times failed for partition "
+                            f"{tp.partition}: {tp.error}"
+                        )
+                    offset = tp.offset if tp.offset >= 0 else client.OFFSET_END
+                    seek_tps.append(
+                        client.TopicPartition(self.topic, tp.partition, offset)
+                    )
+                consumer.assign(seek_tps)
 
             def positions():
                 try:
@@ -191,7 +242,23 @@ class _KafkaReader(Reader):
                     # emit before any COMMIT marker: poll() already advanced
                     # the position past this message, so the marker's
                     # snapshot must only be taken once the row is emitted
-                    self._emit_payload(msg.value(), names, emit)
+                    ts = msg.timestamp()
+                    self._emit_payload(
+                        msg.value(),
+                        names,
+                        emit,
+                        key=msg.key(),
+                        meta=(
+                            {
+                                "topic": msg.topic(),
+                                "partition": msg.partition(),
+                                "offset": msg.offset(),
+                                "timestamp_millis": ts[1] if ts else None,
+                            }
+                            if self.with_metadata
+                            else None
+                        ),
+                    )
                 now = _time.monotonic()
                 if msg is None or (now - last_epoch) >= self.commit_interval_s:
                     # epoch boundary on idle AND on a timer under load —
@@ -237,6 +304,35 @@ class _KafkaReader(Reader):
                 consumer = client.KafkaConsumer(
                     self.topic, **self._kafka_python_kwargs(group_id)
                 )
+            if self.start_from_timestamp_ms is not None:
+                # timestamp-pinned start is a manual-assignment read: no
+                # group-join race, every partition seeked deterministically
+                parts = None
+                for _ in range(20):
+                    parts = consumer.partitions_for_topic(self.topic)
+                    if parts:
+                        break
+                    _time.sleep(0.5)
+                if not parts:
+                    raise RuntimeError(
+                        f"kafka: no partition metadata for topic "
+                        f"{self.topic!r}; cannot seek by timestamp"
+                    )
+                if self._stripe is not None:
+                    parts = self._my_partitions(sorted(parts))
+                tp_cls = client.TopicPartition
+                tps = [tp_cls(self.topic, p) for p in sorted(parts)]
+                consumer.unsubscribe()
+                consumer.assign(tps)
+                found = consumer.offsets_for_times(
+                    {tp: self.start_from_timestamp_ms for tp in tps}
+                )
+                for tp in tps:
+                    ot = (found or {}).get(tp)
+                    if ot is not None and ot.offset is not None:
+                        consumer.seek(tp, ot.offset)
+                    else:
+                        consumer.seek_to_end(tp)  # nothing at/after cutoff
             meta_cls = getattr(client, "OffsetAndMetadata", None)
 
             def positions():
@@ -260,7 +356,22 @@ class _KafkaReader(Reader):
                 now = _time.monotonic()
                 for records in batches.values():
                     for msg in records:
-                        self._emit_payload(msg.value, names, emit)
+                        self._emit_payload(
+                            msg.value,
+                            names,
+                            emit,
+                            key=msg.key,
+                            meta=(
+                                {
+                                    "topic": msg.topic,
+                                    "partition": msg.partition,
+                                    "offset": msg.offset,
+                                    "timestamp_millis": msg.timestamp,
+                                }
+                                if self.with_metadata
+                                else None
+                            ),
+                        )
                 if not batches or (now - last_epoch) >= self.commit_interval_s:
                     emit(COMMIT)
                     if group_id:  # kafka-python asserts group_id on commit()
@@ -271,22 +382,39 @@ class _KafkaReader(Reader):
                     if offsets:
                         self._try_commit(lambda: consumer.commit(offsets=offsets))
 
-    def _emit_payload(self, payload: bytes, names, emit) -> None:
-        if self.format == "raw":
-            emit({"data": payload})
+    def _emit_payload(self, payload: bytes, names, emit, *, key=None, meta=None) -> None:
+        row = None
+        if self.format in ("raw", "plaintext"):
+            row = (
+                {"data": payload}
+                if self.format == "raw"
+                else {"data": payload.decode("utf-8", errors="replace")}
+            )
+            if not self.autogenerate_key and key is not None:
+                # message-keyed rows: same Kafka key -> same row identity
+                # (reference default for raw/plaintext)
+                row["_pw_key"] = hash_values([key])
         elif self.format in ("json", "jsonlines"):
             try:
                 obj = _json.loads(payload)
             except _json.JSONDecodeError:
                 return
-            emit(
-                {
-                    n: (Json(v) if isinstance(v, (dict, list)) else v)
-                    for n, v in ((n, obj.get(n)) for n in names)
-                }
-            )
-        elif self.format == "plaintext":
-            emit({"data": payload.decode("utf-8", errors="replace")})
+            paths = self.json_field_paths
+            row = {}
+            for n in names:
+                if n == "_metadata":
+                    continue
+                v = (
+                    _extract_path(obj, paths[n])
+                    if paths and n in paths
+                    else obj.get(n)
+                )
+                row[n] = Json(v) if isinstance(v, (dict, list)) else v
+        if row is None:
+            return
+        if meta is not None:
+            row["_metadata"] = Json(meta)
+        emit(row)
 
 
 def read(
@@ -295,16 +423,31 @@ def read(
     *,
     schema: type[schema_mod.Schema] | None = None,
     format: str = "raw",
+    json_field_paths: dict | None = None,
+    autogenerate_key: bool = False,
+    with_metadata: bool = False,
+    start_from_timestamp_ms: int | None = None,
+    parallel_readers: int | None = None,
     autocommit_duration_ms: int | None = 1500,
+    debug_data: Any = None,
     name: str | None = None,
     **kwargs: Any,
 ) -> Table:
+    """Read a Kafka topic (parity: pw.io.kafka.read).
+
+    ``parallel_readers`` is advisory here: partition striping across
+    worker processes is this engine's read parallelism (one consumer per
+    worker), so the knob is accepted for API parity but does not spawn
+    extra threads inside one worker.
+    """
     if format == "raw" and schema is None:
         schema = schema_mod.schema_from_types(data=bytes)
     elif format == "plaintext" and schema is None:
         schema = schema_mod.schema_from_types(data=str)
     elif schema is None:
         raise ValueError("kafka.read with json format requires schema=")
+    if with_metadata:
+        schema = _utils.with_metadata_schema(schema)
     return _utils.make_input_table(
         schema,
         lambda: _KafkaReader(
@@ -313,9 +456,14 @@ def read(
             format,
             schema,
             commit_interval_s=(autocommit_duration_ms or 1500) / 1000.0,
+            json_field_paths=json_field_paths,
+            with_metadata=with_metadata,
+            autogenerate_key=autogenerate_key,
+            start_from_timestamp_ms=start_from_timestamp_ms,
         ),
         autocommit_duration_ms=autocommit_duration_ms,
         name=name,
+        debug_data=debug_data,
     )
 
 
@@ -325,19 +473,76 @@ def write(
     topic_name: str | None = None,
     *,
     format: str = "json",
+    delimiter: str = ",",
+    key: Any = None,
+    value: Any = None,
+    headers: Any = None,
     name: str | None = None,
     **kwargs: Any,
 ) -> None:
+    """Write rows to a Kafka topic (parity: pw.io.kafka.write).
+
+    ``key``/``value``/``headers`` are column references: the message key,
+    a single-column payload (raw/plaintext formats), and per-message
+    Kafka headers built from the named columns.
+    """
     kind, client = _get_client()
     names = table.column_names()
     topic = topic_name or kwargs.get("topic")
+
+    def _col_idx(ref, what):
+        n = getattr(ref, "name", ref)
+        if n not in names:
+            raise ValueError(f"kafka.write {what}= column {n!r} not in table")
+        return names.index(n)
+
+    key_idx = _col_idx(key, "key") if key is not None else None
+    value_idx = _col_idx(value, "value") if value is not None else None
+    header_idxs = (
+        [(getattr(h, "name", h), _col_idx(h, "headers")) for h in headers]
+        if headers
+        else None
+    )
+
+    def _as_bytes(v) -> bytes:
+        if isinstance(v, bytes):
+            return v
+        return str(_plain(v)).encode()
+
+    def payload_of(row, time, diff) -> bytes:
+        if format in ("raw", "plaintext"):
+            if value_idx is not None:
+                return _as_bytes(row[value_idx])
+            if len(names) != 1:
+                raise ValueError(
+                    f"kafka.write format={format!r} needs value= or a "
+                    "single-column table"
+                )
+            return _as_bytes(row[0])
+        if format == "dsv":
+            vals = [str(_plain(v)) for v in row] + [str(time), str(diff)]
+            return delimiter.join(vals).encode()
+        obj = {n: _plain(v) for n, v in zip(names, row)}
+        obj["time"], obj["diff"] = time, diff
+        return _json.dumps(obj).encode()
+
+    def msg_kwargs(row) -> dict:
+        out = {}
+        if key_idx is not None:
+            out["key"] = _as_bytes(row[key_idx])
+        if header_idxs is not None:
+            out["headers"] = [
+                (hn, _as_bytes(row[i])) for hn, i in header_idxs
+            ]
+        return out
+
     if kind == "confluent":
         producer = client.Producer(rdkafka_settings)
 
-        def on_data(key, row, time, diff):
-            obj = {n: _plain(v) for n, v in zip(names, row)}
-            obj["time"], obj["diff"] = time, diff
-            producer.produce(topic, _json.dumps(obj).encode())
+        def on_data(key_, row, time, diff):
+            producer.produce(
+                topic, payload_of(row, time, diff), **msg_kwargs(row)
+            )
             producer.poll(0)
 
         _utils.register_output(table, on_data, on_end=producer.flush, name=f"kafka:{topic}")
@@ -346,10 +551,10 @@ def write(
             bootstrap_servers=rdkafka_settings.get("bootstrap.servers")
         )
 
-        def on_data(key, row, time, diff):
-            obj = {n: _plain(v) for n, v in zip(names, row)}
-            obj["time"], obj["diff"] = time, diff
-            producer.send(topic, _json.dumps(obj).encode())
+        def on_data(key_, row, time, diff):
+            producer.send(
+                topic, payload_of(row, time, diff), **msg_kwargs(row)
+            )
 
         _utils.register_output(table, on_data, on_end=producer.flush, name=f"kafka:{topic}")
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 import http.client
 import json as _json
 import threading
+import time as _time
 import urllib.parse
 from typing import Any
 
@@ -25,7 +26,16 @@ def _plain(v: Any):
 
 
 class _HttpSink:
-    def __init__(self, endpoint: str, headers: dict[str, str] | None):
+    def __init__(
+        self,
+        endpoint: str,
+        headers: dict[str, str] | None,
+        *,
+        n_retries: int = 0,
+        retry_policy: Any = None,
+        connect_timeout_ms: int | None = None,
+        request_timeout_ms: int | None = None,
+    ):
         parsed = urllib.parse.urlparse(
             endpoint if "//" in endpoint else "http://" + endpoint
         )
@@ -33,6 +43,16 @@ class _HttpSink:
         self.netloc = parsed.netloc
         self.path = parsed.path or "/"
         self.headers = {"Content-Type": "application/json", **(headers or {})}
+        self.n_retries = n_retries
+        self.retry_policy_factory = retry_policy
+        # one connection timeout: the stdlib client has a single deadline
+        # covering connect + request; the stricter of the two applies
+        timeouts = [
+            t / 1000.0
+            for t in (connect_timeout_ms, request_timeout_ms)
+            if t is not None
+        ]
+        self.timeout = min(timeouts) if timeouts else 30
         self._rows: list[dict] = []
         self._lock = threading.Lock()
 
@@ -49,15 +69,35 @@ class _HttpSink:
                     if not self._rows:
                         return
                     obj = self._rows[0]
-                if conn is None:
-                    conn = conn_cls(self.netloc, timeout=30)
-                conn.request(
-                    "POST", self.path, body=_json.dumps(obj).encode(), headers=self.headers
+                attempts = 0
+                policy = (
+                    self.retry_policy_factory.default()
+                    if hasattr(self.retry_policy_factory, "default")
+                    else self.retry_policy_factory
                 )
-                resp = conn.getresponse()
-                resp.read()
-                if resp.status >= 300:
-                    raise RuntimeError(f"logstash POST failed ({resp.status})")
+                while True:
+                    try:
+                        if conn is None:
+                            conn = conn_cls(self.netloc, timeout=self.timeout)
+                        conn.request(
+                            "POST", self.path, body=_json.dumps(obj).encode(), headers=self.headers
+                        )
+                        resp = conn.getresponse()
+                        resp.read()
+                        if resp.status >= 300:
+                            raise RuntimeError(
+                                f"logstash POST failed ({resp.status})"
+                            )
+                        break
+                    except Exception:
+                        if conn is not None:
+                            conn.close()
+                            conn = None
+                        attempts += 1
+                        if attempts > self.n_retries:
+                            raise
+                        if policy is not None:
+                            _time.sleep(policy.wait_duration_before_retry())
                 # drain only after the row is durably posted — a mid-flush
                 # failure keeps the remainder for the next flush
                 with self._lock:
@@ -70,13 +110,28 @@ class _HttpSink:
 def write(
     table: Table,
     endpoint: str,
+    n_retries: int = 0,
+    retry_policy: Any = None,
+    connect_timeout_ms: int | None = None,
+    request_timeout_ms: int | None = None,
     *,
     headers: dict[str, str] | None = None,
     name: str | None = None,
     _sink_factory: Any = None,
 ) -> None:
+    if retry_policy is None:
+        from pathway_tpu.io.http import RetryPolicy
+
+        retry_policy = RetryPolicy
     names = table.column_names()
-    sink = (_sink_factory or _HttpSink)(endpoint, headers)
+    sink = (_sink_factory or _HttpSink)(
+        endpoint,
+        headers,
+        n_retries=n_retries,
+        retry_policy=retry_policy,
+        connect_timeout_ms=connect_timeout_ms,
+        request_timeout_ms=request_timeout_ms,
+    )
 
     def on_data(key, row, time, diff):
         obj = {n: _plain(v) for n, v in zip(names, row)}

@@ -53,8 +53,29 @@ def read(
     minio_settings: MinIOSettings,
     *,
     format: str = "csv",
+    schema: Any = None,
+    mode: str = "streaming",
+    csv_settings: Any = None,
+    json_field_paths: dict | None = None,
+    downloader_threads_count: int | None = None,
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    debug_data: Any = None,
+    name: str | None = None,
     **kwargs: Any,
 ) -> Table:
     return _s3.read(
-        path, aws_s3_settings=minio_settings.as_s3(), format=format, **kwargs
+        path,
+        aws_s3_settings=minio_settings.as_s3(),
+        format=format,
+        schema=schema,
+        mode=mode,
+        csv_settings=csv_settings,
+        json_field_paths=json_field_paths,
+        downloader_threads_count=downloader_threads_count,
+        with_metadata=with_metadata,
+        autocommit_duration_ms=autocommit_duration_ms,
+        debug_data=debug_data,
+        name=name,
+        **kwargs,
     )

@@ -144,10 +144,17 @@ class MongoConnection:
 
 
 class _MongoSink:
-    def __init__(self, connection_string: str, database: str, collection: str):
+    def __init__(
+        self,
+        connection_string: str,
+        database: str,
+        collection: str,
+        max_batch_size: int | None = None,
+    ):
         self.connection_string = connection_string
         self.database = database
         self.collection = collection
+        self.max_batch_size = max_batch_size
         self._conn: MongoConnection | None = None
         self._inserts: list[dict] = []
         self._deletes: list[dict] = []
@@ -182,10 +189,15 @@ class _MongoSink:
                 )
                 self._deletes = []
             if self._inserts:
-                conn.command(
-                    self.database,
-                    {"insert": self.collection, "documents": self._inserts},
-                )
+                chunk = self.max_batch_size or len(self._inserts)
+                for i in range(0, len(self._inserts), chunk):
+                    conn.command(
+                        self.database,
+                        {
+                            "insert": self.collection,
+                            "documents": self._inserts[i : i + chunk],
+                        },
+                    )
                 self._inserts = []
 
     def close(self) -> None:
@@ -201,12 +213,15 @@ def write(
     database: str,
     collection: str,
     *,
+    max_batch_size: int | None = None,
     name: str | None = None,
     _sink_factory: Any = None,
 ) -> None:
     """Maintain the table in a MongoDB collection (row key as ``_id``)."""
     names = table.column_names()
-    sink = (_sink_factory or _MongoSink)(connection_string, database, collection)
+    sink = (_sink_factory or _MongoSink)(
+        connection_string, database, collection, max_batch_size
+    )
 
     def on_data(key, row, time, diff):
         doc_id = str(Pointer(key))

@@ -86,12 +86,21 @@ class _NatsReader(Reader):
     # data_storage.rs:1788)
     max_allowed_consecutive_errors = 32
 
-    def __init__(self, uri: str, topic: str, format: str, schema, queue_group: str | None):
+    def __init__(
+        self,
+        uri: str,
+        topic: str,
+        format: str,
+        schema,
+        queue_group: str | None,
+        json_field_paths: dict | None = None,
+    ):
         self.uri = uri
         self.topic = topic
         self.format = format
         self.schema = schema
         self.queue_group = queue_group
+        self.json_field_paths = json_field_paths
 
     def partition(self, worker_id: int, worker_count: int) -> "_NatsReader":
         # all workers subscribe in one queue group: the server load-balances
@@ -152,10 +161,24 @@ class _NatsReader(Reader):
                 return
             if not isinstance(obj, dict):
                 return  # arrays/scalars carry no named columns — skip
+            paths = self.json_field_paths
+            if paths:
+                from pathway_tpu.io.jsonlines import _extract_path
+
+                row = {
+                    n: (
+                        _extract_path(obj, paths[n])
+                        if n in paths
+                        else obj.get(n)
+                    )
+                    for n in names
+                }
+            else:
+                row = {n: obj.get(n) for n in names}
             emit(
                 {
                     n: (Json(v) if isinstance(v, (dict, list)) else v)
-                    for n, v in ((n, obj.get(n)) for n in names)
+                    for n, v in row.items()
                 }
             )
 
@@ -167,10 +190,18 @@ def read(
     schema: type[schema_mod.Schema] | None = None,
     format: str = "json",
     queue_group: str | None = None,
+    json_field_paths: dict | None = None,
+    parallel_readers: int | None = None,
     autocommit_duration_ms: int | None = 1500,
+    debug_data: Any = None,
     name: str | None = None,
     **kwargs: Any,
 ) -> Table:
+    """Read a NATS subject (parity: pw.io.nats.read).
+
+    ``parallel_readers`` is advisory here: queue-group striping across
+    worker processes is this engine's read parallelism.
+    """
     if format in ("raw", "plaintext") and schema is None:
         schema = schema_mod.schema_from_types(
             data=bytes if format == "raw" else str
@@ -179,9 +210,13 @@ def read(
         raise ValueError("nats.read with json format requires schema=")
     return _utils.make_input_table(
         schema,
-        lambda: _NatsReader(uri, topic, format, schema, queue_group),
+        lambda: _NatsReader(
+            uri, topic, format, schema, queue_group,
+            json_field_paths=json_field_paths,
+        ),
         autocommit_duration_ms=autocommit_duration_ms,
         name=name,
+        debug_data=debug_data,
     )
 
 
@@ -234,16 +269,51 @@ def write(
     *,
     topic: str,
     format: str = "json",
+    delimiter: str = ",",
+    value: Any = None,
+    headers: Any = None,
     name: str | None = None,
     _sink_factory: Any = None,
 ) -> None:
+    """Publish rows to a NATS subject (parity: pw.io.nats.write).
+
+    ``value`` selects a single column as the raw payload; ``headers``
+    (accepted for parity) are not transmitted — core NATS publish as
+    implemented here has no header frame (HPUB); a configured header set
+    raises rather than being dropped silently.
+    """
+    if headers:
+        raise NotImplementedError(
+            "nats.write: headers require the HPUB protocol, which this "
+            "client does not speak yet"
+        )
     names = table.column_names()
     sink = (_sink_factory or _NatsSink)(uri, topic)
+    value_idx = None
+    if value is not None:
+        vn = getattr(value, "name", value)
+        if vn not in names:
+            raise ValueError(f"nats.write value= column {vn!r} not in table")
+        value_idx = names.index(vn)
 
-    def on_data(key, row, time, diff):
+    if value_idx is None and format in ("raw", "plaintext") and len(names) != 1:
+        raise ValueError(
+            f"nats.write format={format!r} needs value= or a single-column table"
+        )
+
+    def payload_of(row, time, diff) -> bytes:
+        if format in ("raw", "plaintext"):
+            v = row[value_idx] if value_idx is not None else row[0]
+            return v if isinstance(v, bytes) else str(_utils.plain_value(v)).encode()
+        if format == "dsv":
+            vals = [str(_utils.plain_value(v)) for v in row] + [str(time), str(diff)]
+            return delimiter.join(vals).encode()
         obj = {n: _utils.plain_value(v) for n, v in zip(names, row)}
         obj["time"], obj["diff"] = time, diff
-        sink.publish(_json.dumps(obj).encode())
+        return _json.dumps(obj).encode()
+
+    def on_data(key, row, time, diff):
+        sink.publish(payload_of(row, time, diff))
 
     _utils.register_output(
         table, on_data, on_end=sink.close, name=name or f"nats:{topic}"

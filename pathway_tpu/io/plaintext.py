@@ -15,12 +15,14 @@ def read(
     object_pattern: str = "*",
     with_metadata: bool = False,
     autocommit_duration_ms: int | None = 1500,
+    debug_data: Any = None,
     name: str | None = None,
     **kwargs: Any,
 ) -> Table:
     return _fs.read(
         path,
         format="plaintext",
+        debug_data=debug_data,
         mode=mode,
         object_pattern=object_pattern,
         with_metadata=with_metadata,

@@ -59,12 +59,36 @@ class _PubSubSink:
             self._messages = []
 
 
+class _ClientPublisherSink:
+    """Adapter over a user-supplied Pub/Sub publisher client."""
+
+    def __init__(self, publisher: Any, project_id: str, topic_id: str):
+        self.publisher = publisher
+        if hasattr(publisher, "topic_path"):
+            self.topic = publisher.topic_path(project_id, topic_id)
+        else:
+            self.topic = f"projects/{project_id}/topics/{topic_id}"
+        self._futures: list = []
+
+    def add(self, payload: bytes, attributes: dict | None = None) -> None:
+        self._futures.append(
+            self.publisher.publish(self.topic, data=payload, **(attributes or {}))
+        )
+
+    def flush(self, _time: int | None = None) -> None:
+        futures, self._futures = self._futures, []
+        for f in futures:
+            if hasattr(f, "result"):
+                f.result(timeout=60)
+
+
 def write(
     table: Table,
-    project_id: str,
-    topic_id: str,
-    service_user_credentials_file: str,
+    project_id: str | None = None,
+    topic_id: str | None = None,
+    service_user_credentials_file: str | None = None,
     *,
+    publisher: Any = None,
     name: str | None = None,
     _api_base: str = _DEFAULT_API,
     _sink_factory: Any = None,
@@ -72,12 +96,24 @@ def write(
     """Publish the change stream to a Pub/Sub topic.
 
     Reference: ``pw.io.pubsub.write`` (python/pathway/io/pubsub).
+    ``publisher`` takes a prebuilt google-cloud-pubsub PublisherClient
+    (or any object with ``publish(topic, data=...)``) instead of a
+    service-account file; messages then go through that client.
     """
     names = table.column_names()
-    creds = ServiceAccountCredentials.from_file(
-        service_user_credentials_file, [_SCOPE]
-    )
-    sink = (_sink_factory or _PubSubSink)(creds, project_id, topic_id, _api_base)
+    if publisher is not None:
+        if project_id is None or topic_id is None:
+            raise ValueError("pubsub.write with publisher= needs project_id and topic_id")
+        sink = _ClientPublisherSink(publisher, project_id, topic_id)
+    else:
+        if service_user_credentials_file is None:
+            raise ValueError(
+                "pubsub.write requires service_user_credentials_file= or publisher="
+            )
+        creds = ServiceAccountCredentials.from_file(
+            service_user_credentials_file, [_SCOPE]
+        )
+        sink = (_sink_factory or _PubSubSink)(creds, project_id, topic_id, _api_base)
 
     def on_data(key, row, time, diff):
         obj = {n: _utils.plain_value(v, bytes_as="base64") for n, v in zip(names, row)}

@@ -66,12 +66,21 @@ def _modified(source: Any, path: str) -> str:
 class _VfsReader(Reader):
     supports_offsets = True
 
-    def __init__(self, source: Any, path: str, format: str, mode: str, refresh_interval: float):
+    def __init__(
+        self,
+        source: Any,
+        path: str,
+        format: str,
+        mode: str,
+        refresh_interval: float,
+        with_metadata: bool = False,
+    ):
         self.source = source
         self.path = path
         self.format = format
         self.mode = mode
         self.refresh_interval = refresh_interval
+        self.with_metadata = with_metadata
         self._done: dict[str, str] = {}  # path -> modified stamp
 
     def seek(self, offset: Any) -> None:
@@ -97,9 +106,14 @@ class _VfsReader(Reader):
                 # _pw_key = path: the input session runs in upsert mode, so
                 # a re-read modified file REPLACES its previous row (the
                 # engine retracts the old contents itself)
-                emit(
-                    {"data": data, "path": p, "modified_at": stamp, "_pw_key": p}
-                )
+                row = {"data": data, "path": p, "modified_at": stamp, "_pw_key": p}
+                if self.with_metadata:
+                    from pathway_tpu.engine.types import Json
+
+                    row["_metadata"] = Json(
+                        {"path": p, "modified_at": stamp, "size": len(data)}
+                    )
+                emit(row)
                 self._done[p] = stamp
                 changed = True
             # deleted files leave the table
@@ -122,6 +136,7 @@ def read(
     format: str = "binary",
     mode: str = "streaming",
     refresh_interval: float = 30.0,
+    with_metadata: bool = False,
     autocommit_duration_ms: int | None = 1500,
     name: str | None = None,
     **kwargs: Any,
@@ -138,9 +153,13 @@ def read(
             "modified_at": schema_mod.ColumnSchema(name="modified_at", dtype=dt.STR),
         }
     )
+    if with_metadata:
+        schema = _utils.with_metadata_schema(schema)
     return _utils.make_input_table(
         schema,
-        lambda: _VfsReader(source, path, format, mode, refresh_interval),
+        lambda: _VfsReader(
+            source, path, format, mode, refresh_interval, with_metadata
+        ),
         autocommit_duration_ms=autocommit_duration_ms,
         upsert=True,  # modified objects replace their previous row
         name=name,

@@ -45,6 +45,8 @@ class _S3Reader(Reader):
         csv_settings: dict | None,
         poll_interval_s: float = 5.0,
         with_metadata: bool = False,
+        json_field_paths: dict | None = None,
+        downloader_threads_count: int | None = None,
     ):
         self.client = client
         self.prefix = prefix
@@ -54,6 +56,8 @@ class _S3Reader(Reader):
         self.csv_settings = csv_settings or {}
         self.poll_interval_s = poll_interval_s
         self.with_metadata = with_metadata
+        self.json_field_paths = json_field_paths
+        self.downloader_threads_count = downloader_threads_count
         # progress = high-water mark over (last_modified, key): O(1)-ish
         # offsets, and an object overwritten in place gets a newer
         # last_modified so it is re-read (the scanner's modified-object
@@ -134,9 +138,19 @@ class _S3Reader(Reader):
                 if names is None:
                     yield {k: Json(v) if isinstance(v, (dict, list)) else v for k, v in obj.items()}
                 else:
+                    paths = self.json_field_paths
+                    if paths:
+                        from pathway_tpu.io.jsonlines import _extract_path
+
+                        picked = (
+                            (n, _extract_path(obj, paths[n]) if n in paths else obj.get(n))
+                            for n in names
+                        )
+                    else:
+                        picked = ((n, obj.get(n)) for n in names)
                     yield {
                         n: (Json(v) if isinstance(v, (dict, list)) else v)
-                        for n, v in ((n, obj.get(n)) for n in names)
+                        for n, v in picked
                     }
         elif self.format == "plaintext":
             for line in body.decode("utf-8", errors="replace").splitlines():
@@ -156,8 +170,7 @@ class _S3Reader(Reader):
                 for o in sorted(objects, key=lambda o: (self._stamp(o), o["key"]))
                 if self._is_new(o) and self._mine(o["key"])
             ]
-            for obj in new:
-                body = self.client.get_object(obj["key"])
+            def _emit_object(obj, body):
                 for row in self._rows_of(obj["key"], body):
                     if self.with_metadata:
                         row["_metadata"] = Json(
@@ -167,6 +180,28 @@ class _S3Reader(Reader):
                 self._advance(obj)
                 emit(self._offset())
                 emit(COMMIT)
+
+            n_threads = self.downloader_threads_count or 1
+            if n_threads > 1 and len(new) > 1:
+                # parallel GETs, ordered emission; chunked so at most one
+                # chunk of bodies is resident at a time
+                from concurrent.futures import ThreadPoolExecutor
+
+                chunk = 4 * n_threads
+                with ThreadPoolExecutor(n_threads) as ex:
+                    for i in range(0, len(new), chunk):
+                        batch = new[i : i + chunk]
+                        bodies = list(
+                            ex.map(
+                                lambda o: self.client.get_object(o["key"]),
+                                batch,
+                            )
+                        )
+                        for obj, body in zip(batch, bodies):
+                            _emit_object(obj, body)
+            else:
+                for obj in new:
+                    _emit_object(obj, self.client.get_object(obj["key"]))
             if self.mode == "static":
                 return
             _time.sleep(self.poll_interval_s)
@@ -180,8 +215,11 @@ def read(
     schema: type[schema_mod.Schema] | None = None,
     mode: str = "streaming",
     csv_settings: Any = None,
+    json_field_paths: dict | None = None,
+    downloader_threads_count: int | None = None,
     with_metadata: bool = False,
     autocommit_duration_ms: int | None = 1500,
+    debug_data: Any = None,
     name: str | None = None,
     **kwargs: Any,
 ) -> Table:
@@ -219,9 +257,12 @@ def read(
             mode,
             csv_kw,
             with_metadata=with_metadata,
+            json_field_paths=json_field_paths,
+            downloader_threads_count=downloader_threads_count,
         ),
         autocommit_duration_ms=autocommit_duration_ms,
         name=name,
+        debug_data=debug_data,
     )
 
 

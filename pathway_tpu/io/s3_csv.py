@@ -16,7 +16,26 @@ def read(
     path: str,
     *,
     aws_s3_settings: AwsS3Settings | None = None,
+    schema: Any = None,
+    mode: str = "streaming",
+    csv_settings: Any = None,
+    with_metadata: bool = False,
+    autocommit_duration_ms: int | None = 1500,
+    debug_data: Any = None,
+    name: str | None = None,
     **kwargs: Any,
 ) -> Table:
     kwargs.pop("format", None)
-    return _s3.read(path, aws_s3_settings=aws_s3_settings, format="csv", **kwargs)
+    return _s3.read(
+        path,
+        aws_s3_settings=aws_s3_settings,
+        format="csv",
+        schema=schema,
+        mode=mode,
+        csv_settings=csv_settings,
+        with_metadata=with_metadata,
+        autocommit_duration_ms=autocommit_duration_ms,
+        debug_data=debug_data,
+        name=name,
+        **kwargs,
+    )

@@ -73,6 +73,7 @@ def read(
     mode: str = "streaming",
     autocommit_duration_ms: int | None = 1500,
     name: str | None = None,
+    debug_data: Any = None,
     **kwargs: Any,
 ) -> Table:
     from pathway_tpu.io._file_readers import only_mode
@@ -82,4 +83,5 @@ def read(
         schema,
         lambda: _SqliteReader(path, table_name, schema, streaming),
         autocommit_duration_ms=autocommit_duration_ms,
+        debug_data=debug_data,
     )
