@@ -88,8 +88,14 @@ class Prober:
     register one.
     """
 
-    def __init__(self, scope: "Scope", callbacks: list[Callable[[ProberStats], None]] | None = None):
+    def __init__(
+        self,
+        scope: "Scope",
+        callbacks: list[Callable[[ProberStats], None]] | None = None,
+        pollers: list | None = None,
+    ):
         self.scope = scope
+        self.pollers = list(pollers or [])
         self.callbacks: list[Callable[[ProberStats], None]] = list(callbacks or [])
         self.stats = ProberStats()
         # incremental error attribution: only entries appended since the
@@ -143,11 +149,19 @@ class Prober:
             if isinstance(node, OutputNode):
                 outputs = outputs.merge(st)
                 outputs.done = done
+        connectors = [
+            ConnectorStats(
+                name=getattr(p, "name", "source"),
+                rows=getattr(getattr(p, "input_node", None), "rows_in", 0),
+                finished=bool(getattr(p, "finished", False)),
+            )
+            for p in self.pollers
+        ]
         self.stats = ProberStats(
             input_stats=inputs,
             output_stats=outputs,
             operator_stats=ops,
-            connector_stats=self.stats.connector_stats,
+            connector_stats=connectors,
             # epoch count is owned by the runner's loop when provided; the
             # final done-snapshot re-reads counters, it is not a new epoch
             epochs=(

@@ -106,6 +106,14 @@ class StatsMonitor:
             ("input", s.input_stats),
             ("output", s.output_stats),
         ]
+        # per-connector ingestion rows (connectors/monitoring.rs analog)
+        for c in s.connector_stats:
+            rows.append(
+                (
+                    f"src:{c.name}",
+                    OperatorStats(name=c.name, rows_in=c.rows, rows_out=c.rows, done=c.finished),
+                )
+            )
         if self.level == MonitoringLevel.ALL:
             rows += [(f"{op.name}#{oid}", op) for oid, op in s.operator_stats.items()]
         return rows

@@ -224,7 +224,7 @@ def run(
                 run_id=config.run_id,
             ).start()
         with monitor_stats(monitoring_level) as monitor:
-            prober = Prober(scope)
+            prober = Prober(scope, pollers=lowerer.pollers)
             if monitor is not None:
                 prober.callbacks.append(monitor.update)
             if http_server is not None:
@@ -359,6 +359,20 @@ def _ack_sources(pollers, *, persisted: bool, up_to_time: int | None = None) -> 
             ack(up_to_time)
 
 
+def _attach_wake(pollers) -> "Any":
+    """Per-run wake signal: reader threads set it on enqueue so the idle
+    park ends immediately (per-run, NOT process-wide — a shared event
+    would busy-spin one run's loop while another run streams)."""
+    import threading as _threading
+
+    wake = _threading.Event()
+    for p in pollers:
+        q = getattr(p, "q", None)
+        if q is not None and hasattr(q, "wake"):
+            q.wake = wake
+    return wake
+
+
 def _event_loop(
     scope: df.Scope,
     lowerer: Lowerer,
@@ -374,16 +388,7 @@ def _event_loop(
         )
     inputs = _input_nodes(scope)
     pollers = lowerer.pollers
-    # per-run wake signal: reader threads set it on enqueue so the idle
-    # park below ends immediately (per-run, NOT process-wide — a shared
-    # event would busy-spin this loop whenever another run streams)
-    import threading as _threading
-
-    wake = _threading.Event()
-    for p in pollers:
-        q = getattr(p, "q", None)
-        if q is not None and hasattr(q, "wake"):
-            q.wake = wake
+    wake = _attach_wake(pollers)
     last_time = -1
     drain_spins = 0  # consecutive idle drain epochs (quiesce guard)
     # snapshot_interval_ms=0 means "as often as possible" (reference
@@ -488,6 +493,7 @@ def _event_loop_coordinated(
     mesh = ctx.mesh
     inputs = _input_nodes(scope)
     pollers = lowerer.pollers
+    wake = _attach_wake(pollers)
     last_time = -1
     drain_spins = 0
     round_ = 0
@@ -554,7 +560,8 @@ def _event_loop_coordinated(
             continue
         if kind == "idle":
             _ack_sources(pollers, persisted=False, up_to_time=last_time)
-            _time.sleep(0.001)
+            wake.wait(0.001)
+            wake.clear()
             continue
         for inp in inputs:
             inp.merge_staged_through(t)

@@ -159,6 +159,51 @@ class _RowCountEmit:
         self.put(item)
 
 
+def make_payload_formatter(
+    names: list[str],
+    format: str,
+    *,
+    delimiter: str = ",",
+    value=None,
+    sink: str = "write",
+):
+    """Shared message-framing for broker sinks (kafka/nats write).
+
+    Returns ``payload_of(row, time, diff) -> bytes`` for json/dsv/raw/
+    plaintext formats; ``value=`` selects the payload column for the raw
+    forms, otherwise a single-column table is required (checked eagerly).
+    """
+    value_idx = None
+    if value is not None:
+        vn = getattr(value, "name", value)
+        if vn not in names:
+            raise ValueError(f"{sink} value= column {vn!r} not in table")
+        value_idx = names.index(vn)
+    if value_idx is None and format in ("raw", "plaintext") and len(names) != 1:
+        raise ValueError(
+            f"{sink} format={format!r} needs value= or a single-column table"
+        )
+
+    def as_bytes(v) -> bytes:
+        if isinstance(v, bytes):
+            return v
+        return str(plain_value(v)).encode()
+
+    def payload_of(row, time, diff) -> bytes:
+        if format in ("raw", "plaintext"):
+            return as_bytes(row[value_idx if value_idx is not None else 0])
+        if format == "dsv":
+            vals = [str(plain_value(v)) for v in row] + [str(time), str(diff)]
+            return delimiter.join(vals).encode()
+        import json as _json
+
+        obj = {n: plain_value(v) for n, v in zip(names, row)}
+        obj["time"], obj["diff"] = time, diff
+        return _json.dumps(obj).encode()
+
+    return payload_of
+
+
 class CommitThrottle:
     """``min_commit_frequency`` gate for lake sinks: at most one commit per
     interval (ms); ``force`` (end of stream) always passes.  None = every
@@ -238,6 +283,7 @@ class _QueuePoller:
         # at commit boundaries instead (offset frontier stays None)
         self.flush_on_commit = False
         self.reader: Reader | None = None
+        self.name = "source"  # monitoring label, set by make_input_table
         self._drained_commits = 0  # COMMIT sentinels this poller has consumed
         # (marker seq, epoch time its rows were stamped with) awaiting the
         # engine's durability point; popped by ack_processed
@@ -449,6 +495,8 @@ def make_input_table(
         poller = _QueuePoller(node, schema, autocommit_duration_ms)
         worker = getattr(lowerer.scope, "worker", None)
         reader = reader_factory()
+        # per-connector monitoring identity (connectors/monitoring.rs)
+        poller.name = name or type(reader).__name__.lstrip("_")
         if worker is not None and worker.worker_count > 1:
             reader = reader.partition(worker.worker_id, worker.worker_count)
             if reader is None:

@@ -448,6 +448,11 @@ def read(
         raise ValueError("kafka.read with json format requires schema=")
     if with_metadata:
         schema = _utils.with_metadata_schema(schema)
+    # message-keyed rows (raw/plaintext, autogenerate_key=False) carry the
+    # Kafka key as row identity: an upsert session makes a repeated key
+    # REPLACE its predecessor (compacted-topic semantics) instead of
+    # stacking duplicate rows under one id
+    keyed_by_message = not autogenerate_key and format in ("raw", "plaintext")
     return _utils.make_input_table(
         schema,
         lambda: _KafkaReader(
@@ -462,6 +467,7 @@ def read(
             start_from_timestamp_ms=start_from_timestamp_ms,
         ),
         autocommit_duration_ms=autocommit_duration_ms,
+        upsert=keyed_by_message,
         name=name,
         debug_data=debug_data,
     )
@@ -497,34 +503,19 @@ def write(
         return names.index(n)
 
     key_idx = _col_idx(key, "key") if key is not None else None
-    value_idx = _col_idx(value, "value") if value is not None else None
     header_idxs = (
         [(getattr(h, "name", h), _col_idx(h, "headers")) for h in headers]
         if headers
         else None
+    )
+    payload_of = _utils.make_payload_formatter(
+        names, format, delimiter=delimiter, value=value, sink="kafka.write"
     )
 
     def _as_bytes(v) -> bytes:
         if isinstance(v, bytes):
             return v
         return str(_plain(v)).encode()
-
-    def payload_of(row, time, diff) -> bytes:
-        if format in ("raw", "plaintext"):
-            if value_idx is not None:
-                return _as_bytes(row[value_idx])
-            if len(names) != 1:
-                raise ValueError(
-                    f"kafka.write format={format!r} needs value= or a "
-                    "single-column table"
-                )
-            return _as_bytes(row[0])
-        if format == "dsv":
-            vals = [str(_plain(v)) for v in row] + [str(time), str(diff)]
-            return delimiter.join(vals).encode()
-        obj = {n: _plain(v) for n, v in zip(names, row)}
-        obj["time"], obj["diff"] = time, diff
-        return _json.dumps(obj).encode()
 
     def msg_kwargs(row) -> dict:
         out = {}

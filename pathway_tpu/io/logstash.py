@@ -70,11 +70,17 @@ class _HttpSink:
                         return
                     obj = self._rows[0]
                 attempts = 0
-                policy = (
-                    self.retry_policy_factory.default()
-                    if hasattr(self.retry_policy_factory, "default")
-                    else self.retry_policy_factory
-                )
+                proto = self.retry_policy_factory
+                if isinstance(proto, type):  # a policy CLASS: fresh default
+                    policy = proto.default()
+                elif proto is not None:
+                    # an instance: copy so each row's retry sequence starts
+                    # from the configured first delay (the policy mutates)
+                    import copy as _copy
+
+                    policy = _copy.copy(proto)
+                else:
+                    policy = None
                 while True:
                     try:
                         if conn is None:

@@ -289,28 +289,9 @@ def write(
         )
     names = table.column_names()
     sink = (_sink_factory or _NatsSink)(uri, topic)
-    value_idx = None
-    if value is not None:
-        vn = getattr(value, "name", value)
-        if vn not in names:
-            raise ValueError(f"nats.write value= column {vn!r} not in table")
-        value_idx = names.index(vn)
-
-    if value_idx is None and format in ("raw", "plaintext") and len(names) != 1:
-        raise ValueError(
-            f"nats.write format={format!r} needs value= or a single-column table"
-        )
-
-    def payload_of(row, time, diff) -> bytes:
-        if format in ("raw", "plaintext"):
-            v = row[value_idx] if value_idx is not None else row[0]
-            return v if isinstance(v, bytes) else str(_utils.plain_value(v)).encode()
-        if format == "dsv":
-            vals = [str(_utils.plain_value(v)) for v in row] + [str(time), str(diff)]
-            return delimiter.join(vals).encode()
-        obj = {n: _utils.plain_value(v) for n, v in zip(names, row)}
-        obj["time"], obj["diff"] = time, diff
-        return _json.dumps(obj).encode()
+    payload_of = _utils.make_payload_formatter(
+        names, format, delimiter=delimiter, value=value, sink="nats.write"
+    )
 
     def on_data(key, row, time, diff):
         sink.publish(payload_of(row, time, diff))

@@ -214,3 +214,58 @@ def test_delta_s3_settings_rejected_loudly(tmp_path):
             schema=pw.schema_from_types(x=int),
             s3_connection_settings=object(),
         )
+
+
+def test_kafka_message_keyed_rows_replace():
+    """autogenerate_key=False raw reads are upsert sessions: a repeated
+    Kafka key REPLACES the prior row (compacted-topic semantics) instead
+    of stacking duplicates under one id."""
+    from pathway_tpu.io import _utils
+    from pathway_tpu.io.kafka import _KafkaReader
+
+    G.clear()
+    schema = pw.schema_from_types(data=bytes)
+
+    class _ScriptedReader(_KafkaReader):
+        def run(self, emit):
+            self._emit_payload(b"v1", ["data"], emit, key=b"order-1")
+            self._emit_payload(b"v2", ["data"], emit, key=b"order-1")
+            emit(_utils.COMMIT)
+            emit(_utils.FINISH)
+
+    t = _utils.make_input_table(
+        schema,
+        lambda: _ScriptedReader({}, "t", "raw", schema, autogenerate_key=False),
+        upsert=True,  # what kafka.read now passes for message-keyed reads
+    )
+    deltas = []
+    pw.io.subscribe(
+        t,
+        on_change=lambda key, row, time, is_addition: deltas.append(
+            (row["data"], is_addition)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    G.clear()
+    # the final state holds only v2; v1 was retracted by the upsert
+    net = {}
+    for data, add in deltas:
+        net[data] = net.get(data, 0) + (1 if add else -1)
+    assert {k: v for k, v in net.items() if v} == {b"v2": 1}, deltas
+
+
+def test_kafka_read_wires_upsert_for_message_keys(monkeypatch):
+    from pathway_tpu.io import _utils, kafka as kafka_mod
+
+    captured = {}
+    orig = _utils.make_input_table
+
+    def spy(schema, factory, **kw):
+        captured.update(kw)
+        return orig(schema, factory, **kw)
+
+    monkeypatch.setattr(kafka_mod._utils, "make_input_table", spy)
+    kafka_mod.read({}, "t", format="raw")  # default autogenerate_key=False
+    assert captured["upsert"] is True
+    kafka_mod.read({}, "t", format="raw", autogenerate_key=True)
+    assert captured["upsert"] is False

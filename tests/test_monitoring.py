@@ -151,3 +151,35 @@ def test_live_per_operator_dashboard_all_level():
     assert any(op.step_ms > 0 for op in per_op), "step time collected"
     select_ops = [op for op in per_op if op.name == "select"]
     assert sum(op.errors for op in select_ops) == 1, "error count attributed"
+
+
+def test_connector_stats_populated(tmp_path):
+    """Per-connector ingestion stats (connectors/monitoring.rs analog)
+    appear in ProberStats and on the dashboard.  Static debug tables have
+    no reader thread, so a real file connector drives this."""
+    import io as _io
+
+    from rich.console import Console
+
+    (tmp_path / "in.csv").write_text("a,b\n1,2\n3,4\n5,6\n")
+    t = pw.io.csv.read(
+        str(tmp_path),
+        schema=pw.schema_from_types(a=int, b=int),
+        mode="static",
+        name="orders",
+    )
+    pw.io.subscribe(t, on_change=lambda **kw: None)
+    result = pw.run(monitoring_level=MonitoringLevel.NONE)
+    stats = result.prober.stats
+    assert stats.connector_stats, "connector stats must be populated"
+    c = stats.connector_stats[0]
+    assert c.name == "orders" and c.rows == 3 and c.finished
+
+    buf = _io.StringIO()
+    console = Console(file=buf, force_terminal=False, width=140)
+    monitor = StatsMonitor(MonitoringLevel.IN_OUT, console=console).start()
+    try:
+        monitor.update(stats)
+    finally:
+        monitor.close()
+    assert "src:" in buf.getvalue()
