@@ -684,13 +684,26 @@ class FlattenNode(Node):
         # the origin key (e.g. hash(origin, position)); only then can clean
         # input imply clean output
         self.key_fresh = key_fresh
+        # (col_idx, with_origin) when fn is the standard Table.flatten
+        # shape — the whole per-item loop (incl. the hash-derived fresh
+        # keys) then runs in _native.cpp
+        self.vec_flatten: tuple[int, bool] | None = None
 
     def step(self, time):
         deltas = self.take_pending()
-        out = []
-        for key, row, diff in deltas:
-            for new_key, new_row in self.fn(key, row):
-                out.append((new_key, new_row, diff))
+        out = None
+        if self.vec_flatten is not None and deltas:
+            from pathway_tpu.internals import vector_compiler as vc
+
+            nat = _get_native_module()
+            if vc.ENABLED and nat is not None and hasattr(nat, "flatten_deltas"):
+                col_idx, with_origin = self.vec_flatten
+                out = nat.flatten_deltas(deltas, col_idx, with_origin)
+        if out is None:
+            out = []
+            for key, row, diff in deltas:
+                for new_key, new_row in self.fn(key, row):
+                    out.append((new_key, new_row, diff))
         if self.key_fresh and isinstance(deltas, CleanDeltas):
             out = CleanDeltas(out)
         else:

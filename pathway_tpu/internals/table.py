@@ -959,7 +959,9 @@ class Table(Joinable):
                     yield (new_key, new_row)
 
             # new keys are hash(origin key, position): pairwise distinct
-            return df.FlattenNode(lowerer.scope, base, fn, key_fresh=True)
+            node = df.FlattenNode(lowerer.scope, base, fn, key_fresh=True)
+            node.vec_flatten = (col_idx, origin_id is not None)
+            return node
 
         cols = dict(self._schema.__columns__)
         inner_t = cols[col].dtype.strip_optional()

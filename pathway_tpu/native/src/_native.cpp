@@ -2334,6 +2334,135 @@ static PyObject *py_project_join_rows(PyObject *, PyObject *args) {
   return res;
 }
 
+// (deltas, col_idx, with_origin) -> [(new_key, new_row, diff)] or None.
+// The Table.flatten hot loop in one C pass: one output row per element of
+// the iterable column, new_key = hash_values([Pointer(key), pos]) built
+// without Python objects, tuple splice in C.  None = bail to the row path
+// (malformed rows); non-iterable cell values flatten as a single item and
+// None cells emit nothing, exactly like the Python fn.
+static PyObject *py_flatten_deltas(PyObject *, PyObject *args) {
+  PyObject *deltas;
+  Py_ssize_t col_idx;
+  int with_origin;
+  if (!PyArg_ParseTuple(args, "Oni", &deltas, &col_idx, &with_origin))
+    return nullptr;
+  PyObject *seq = PySequence_Fast(deltas, "flatten expects a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  // shape prevalidation BEFORE any cell is touched: the bail-to-row-path
+  // contract must be side-effect-free (a one-shot iterator cell consumed
+  // by a partial native pass would be empty when the row path re-runs)
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *d = PySequence_Fast_GET_ITEM(seq, i);
+    if (!PyTuple_Check(d) || PyTuple_GET_SIZE(d) != 3 ||
+        !PyLong_Check(PyTuple_GET_ITEM(d, 0)) ||
+        !PyTuple_Check(PyTuple_GET_ITEM(d, 1)) ||
+        col_idx >= PyTuple_GET_SIZE(PyTuple_GET_ITEM(d, 1))) {
+      Py_DECREF(seq);
+      Py_RETURN_NONE;  // malformed: the row path handles it
+    }
+  }
+  PyObject *out = PyList_New(0);
+  if (!out) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  // 0 ok; 2 error (shapes already validated — no bail from here on)
+  auto one = [&](Py_ssize_t i) -> int {
+    PyObject *d = PySequence_Fast_GET_ITEM(seq, i);
+    PyObject *key = PyTuple_GET_ITEM(d, 0);
+    PyObject *row = PyTuple_GET_ITEM(d, 1);
+    PyObject *diff = PyTuple_GET_ITEM(d, 2);
+    Py_ssize_t width = PyTuple_GET_SIZE(row);
+    PyObject *cell = PyTuple_GET_ITEM(row, col_idx);
+    if (cell == Py_None) return 0;  // None flattens to nothing
+    PyObject *items = PySequence_Fast(cell, "");
+    bool single = false;
+    if (!items) {
+      // the row path's contract: only TypeError means "not iterable —
+      // flatten as a single item"; anything else propagates
+      if (!PyErr_ExceptionMatches(PyExc_TypeError)) return 2;
+      PyErr_Clear();
+      single = true;
+    }
+    Py_ssize_t m = single ? 1 : PySequence_Fast_GET_SIZE(items);
+    joinx::U128 kh;
+    if (!u128_of_pylong(key, &kh)) {
+      Py_XDECREF(items);
+      return 2;
+    }
+    // ser prefix: Pointer tag + 16-byte key (shared by every position)
+    uint8_t buf[1 + 16 + 1 + 16];
+    buf[0] = 0x06;
+    std::memcpy(buf + 1, &kh.lo, 8);
+    std::memcpy(buf + 9, &kh.hi, 8);
+    buf[17] = 0x02;  // int tag; positions are small non-negative ints
+    PyObject *origin = nullptr;
+    if (with_origin) {
+      origin = make_pointer_fast(key);
+      if (!origin) {
+        Py_XDECREF(items);
+        return 2;
+      }
+    }
+    int rc = 0;
+    for (Py_ssize_t pos = 0; pos < m && rc == 0; pos++) {
+      PyObject *item =
+          single ? cell : PySequence_Fast_GET_ITEM(items, pos);
+      int64_t p = (int64_t)pos;
+      std::memcpy(buf + 18, &p, 8);
+      std::memset(buf + 26, 0, 8);  // i128 little-endian, non-negative
+      uint8_t digest[16];
+      blake2b_hash(digest, 16, buf, sizeof(buf));
+      uint64_t lo, hi;
+      std::memcpy(&lo, digest, 8);
+      std::memcpy(&hi, digest + 8, 8);
+      PyObject *new_key = pylong_from_u128(lo, hi);
+      PyObject *new_row = PyTuple_New(width + (with_origin ? 1 : 0));
+      if (!new_key || !new_row) {
+        Py_XDECREF(new_key);
+        Py_XDECREF(new_row);
+        rc = 2;
+        break;
+      }
+      for (Py_ssize_t c = 0; c < width; c++) {
+        PyObject *v = c == col_idx ? item : PyTuple_GET_ITEM(row, c);
+        Py_INCREF(v);
+        PyTuple_SET_ITEM(new_row, c, v);
+      }
+      if (with_origin) {
+        Py_INCREF(origin);
+        PyTuple_SET_ITEM(new_row, width, origin);
+      }
+      PyObject *entry = PyTuple_New(3);
+      if (!entry) {
+        Py_DECREF(new_key);
+        Py_DECREF(new_row);
+        rc = 2;
+        break;
+      }
+      Py_INCREF(diff);
+      PyTuple_SET_ITEM(entry, 0, new_key);
+      PyTuple_SET_ITEM(entry, 1, new_row);
+      PyTuple_SET_ITEM(entry, 2, diff);
+      if (PyList_Append(out, entry) < 0) rc = 2;
+      Py_DECREF(entry);
+    }
+    Py_XDECREF(origin);
+    Py_XDECREF(items);
+    return rc;
+  };
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (one(i) != 0) {
+      Py_DECREF(seq);
+      Py_DECREF(out);
+      return nullptr;  // exception set; errors propagate, never re-run
+    }
+  }
+  Py_DECREF(seq);
+  return out;
+}
+
 static PyObject *py_join_stats(PyObject *, PyObject *arg) {
   auto *ix = join_from(arg);
   if (!ix) return nullptr;
@@ -2355,6 +2484,8 @@ static PyMethodDef methods[] = {
     {"join_stats", py_join_stats, METH_O, "(capsule) -> (n_left, n_right)"},
     {"project_join_rows", py_project_join_rows, METH_VARARGS,
      "(join deltas, ((src, idx), ...)) -> projected deltas"},
+    {"flatten_deltas", py_flatten_deltas, METH_VARARGS,
+     "(deltas, col_idx, with_origin) -> flattened deltas or None"},
     {"materialize_columns", py_materialize_columns, METH_VARARGS,
      "(rows|deltas, needed tuple, from_deltas) -> {idx: (kind, buf|list)} "
      "or None on bail"},

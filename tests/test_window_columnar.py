@@ -164,3 +164,55 @@ def test_multi_key_groupby_uses_columnar_step():
         G.clear()
     assert len(rows_out) == 12
     assert used["n"] > 0
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_native_flatten_stream_parity_fuzz(seed):
+    """Native flatten_deltas must match the row path exactly: keys
+    (hash of origin+position), rows, diffs — across tuples, strings,
+    None cells, scalars, and origin_id."""
+    rng = random.Random(400 + seed)
+    from pathway_tpu.engine.types import Json
+
+    rows = []
+    for i in range(200):
+        kind = rng.randrange(5)
+        if kind == 0:
+            v = tuple(rng.randrange(10) for _ in range(rng.randrange(4)))
+        elif kind == 1:
+            v = "ab"[: rng.randrange(3)]
+        elif kind == 2:
+            v = None
+        elif kind == 3:
+            v = rng.randrange(100)  # scalar: flattens to itself
+        else:
+            v = (Json({"a": i}),)
+        rows.append({"v": v, "tag": i})
+    schema = pw.schema_from_types(v=object, tag=int)
+
+    def build(origin):
+        t = make_static_input_table(schema, rows)
+        kw = {"origin_id": "orig"} if origin else {}
+        return t.flatten(pw.this.v, **kw)
+
+    for origin in (False, True):
+        native = _run_stream(lambda: build(origin), True)
+        row = _run_stream(lambda: build(origin), False)
+        assert native == row, f"seed={seed} origin={origin}"
+
+
+def test_sliding_windowby_parity_with_native_flatten():
+    rows = [{"at": (i * 7) % 400, "v": i} for i in range(N)]
+    schema = pw.schema_from_types(at=int, v=int)
+
+    def build():
+        t = make_static_input_table(schema, rows)
+        return t.windowby(
+            pw.this.at, window=pw.temporal.sliding(hop=10, duration=30)
+        ).reduce(
+            start=pw.this._pw_window_start,
+            n=pw.reducers.count(),
+            total=pw.reducers.sum(pw.this.v),
+        )
+
+    assert _run_stream(build, True) == _run_stream(build, False)
