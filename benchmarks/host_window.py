@@ -20,7 +20,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-def build_pipeline(n_rows: int):
+def build_pipeline(n_rows: int, shape: str = "tumbling"):
     import pathway_tpu as pw
     from pathway_tpu.io._utils import make_static_input_table
 
@@ -29,9 +29,12 @@ def build_pipeline(n_rows: int):
         for i in range(n_rows)
     ]
     t = make_static_input_table(pw.schema_from_types(at=int, v=int), rows)
-    return t.windowby(
-        pw.this.at, window=pw.temporal.tumbling(duration=500)
-    ).reduce(
+    window = (
+        pw.temporal.tumbling(duration=500)
+        if shape == "tumbling"
+        else pw.temporal.sliding(hop=100, duration=300)
+    )
+    return t.windowby(pw.this.at, window=window).reduce(
         start=pw.this._pw_window_start,
         n=pw.reducers.count(),
         total=pw.reducers.sum(pw.this.v),
@@ -39,7 +42,7 @@ def build_pipeline(n_rows: int):
     )
 
 
-def run_once(n_rows: int, columnar: bool):
+def run_once(n_rows: int, columnar: bool, shape: str = "tumbling"):
     from pathway_tpu.engine import dataflow as df
     from pathway_tpu.internals import vector_compiler as vc
     from pathway_tpu.internals.parse_graph import G
@@ -48,7 +51,7 @@ def run_once(n_rows: int, columnar: bool):
     G.clear()
     vc.set_enabled(columnar)
     try:
-        result = build_pipeline(n_rows)
+        result = build_pipeline(n_rows, shape)
         collected = []
 
         def attach(lowerer, node):
@@ -69,34 +72,35 @@ def run_once(n_rows: int, columnar: bool):
 
 def main() -> None:
     n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 300_000
-    results = {}
-    outputs = {}
-    for label, columnar in (("columnar", True), ("row", False)):
-        dt_s, collected = run_once(n_rows, columnar)
-        rate = n_rows / dt_s
-        results[label] = rate
-        outputs[label] = sorted((r for r, d in collected if d > 0), key=repr)
+    for shape in ("tumbling", "sliding"):
+        results = {}
+        outputs = {}
+        for label, columnar in (("columnar", True), ("row", False)):
+            dt_s, collected = run_once(n_rows, columnar, shape)
+            rate = n_rows / dt_s
+            results[label] = rate
+            outputs[label] = sorted((r for r, d in collected if d > 0), key=repr)
+            print(
+                json.dumps(
+                    {
+                        "metric": f"host_window_{shape}_rows_per_sec_{label}",
+                        "value": round(rate, 1),
+                        "unit": "rows/s",
+                        "rows": n_rows,
+                        "seconds": round(dt_s, 3),
+                    }
+                )
+            )
+        assert outputs["columnar"] == outputs["row"], f"{shape} paths diverged!"
         print(
             json.dumps(
                 {
-                    "metric": f"host_window_rows_per_sec_{label}",
-                    "value": round(rate, 1),
-                    "unit": "rows/s",
-                    "rows": n_rows,
-                    "seconds": round(dt_s, 3),
+                    "metric": f"host_window_{shape}_columnar_speedup",
+                    "value": round(results["columnar"] / results["row"], 2),
+                    "unit": "x",
                 }
             )
         )
-    assert outputs["columnar"] == outputs["row"], "window paths diverged!"
-    print(
-        json.dumps(
-            {
-                "metric": "host_window_columnar_speedup",
-                "value": round(results["columnar"] / results["row"], 2),
-                "unit": "x",
-            }
-        )
-    )
 
 
 if __name__ == "__main__":

@@ -735,6 +735,47 @@ class ReindexNode(Node):
         self.send(out, time)
 
 
+class SaltRekeyNode(Node):
+    """Deterministic injective rekey: new key = hash(Pointer(key), salt).
+
+    Backs the vectorized sliding-window assignment (one branch per window
+    offset, concatenated): distinct inputs at a fixed salt never collide,
+    so no duplicate-detection state is needed and cleanliness carries.
+    """
+
+    name = "salt_rekey"
+    preserves_append_only = True
+
+    def __init__(self, scope, inp: Node, salt: int):
+        super().__init__(scope, [inp])
+        self.salt = salt
+        self.exchange_routes = {
+            0: lambda k, r: hash_values([Pointer(k), self.salt])
+        }
+
+    def step(self, time):
+        deltas = self.take_pending()
+        out = None
+        nat = _get_native_module()
+        if nat is not None and hasattr(nat, "rekey_deltas") and deltas:
+            out = nat.rekey_deltas(deltas, self.salt)
+        if out is None:
+            salt = self.salt
+            out = [
+                (hash_values([Pointer(k), salt]), row, d)
+                for k, row, d in deltas
+            ]
+        # injective key map, diffs unchanged: clean input stays clean
+        out = (
+            CleanDeltas(out)
+            if isinstance(deltas, CleanDeltas)
+            else consolidate(out)
+        )
+        if self.keep_state:
+            self._update_state(out)
+        self.send(out, time)
+
+
 class ConcatNode(Node):
     name = "concat"
     preserves_append_only = True

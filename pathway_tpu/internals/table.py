@@ -1038,6 +1038,17 @@ class Table(Joinable):
         return Table(self._schema, build, universe=Universe())
 
     # -- set ops --
+    def _rekey_salted(self, salt: int) -> "Table":
+        """Injective deterministic rekey: new id = hash(old id, salt).
+        Internal — backs the vectorized sliding-window branches (each
+        branch needs distinct, replay-stable keys)."""
+
+        def build(lowerer: Lowerer) -> df.Node:
+            base = lowerer.node(self)
+            return df.SaltRekeyNode(lowerer.scope, base, salt)
+
+        return Table(self.schema, build, universe=Universe())
+
     def concat(self, *others: "Table") -> "Table":
         r"""Union of rows of same-schema tables (keys must be disjoint).
 
@@ -1080,15 +1091,10 @@ class Table(Joinable):
         return reindexed[0].concat(*reindexed[1:])
 
     def _reindex_tagged(self, tag: int) -> "Table":
-        def build(lowerer: Lowerer) -> df.Node:
-            base = lowerer.node(self)
-
-            def key_fn(key, row):
-                return hash_values([Pointer(key), tag])
-
-            return df.ReindexNode(lowerer.scope, base, key_fn)
-
-        return Table(self._schema, build, universe=Universe())
+        # same injective hash(Pointer(id), tag) recipe as the sliding
+        # branches: the salted-rekey node needs no duplicate-detection
+        # state and runs the native C pass
+        return self._rekey_salted(tag)
 
     def update_rows(self, other: "Table") -> "Table":
         r"""Upsert: rows of ``other`` replace/extend rows with the same key.

@@ -2463,6 +2463,73 @@ static PyObject *py_flatten_deltas(PyObject *, PyObject *args) {
   return out;
 }
 
+// (deltas, salt) -> [(hash_values([Pointer(key), salt]), row, diff)] or
+// None when a key is not a plain int (row path handles it).  Injective
+// for distinct keys at a fixed salt — the salted-branch rekey of the
+// vectorized sliding-window assignment.
+static PyObject *py_rekey_deltas(PyObject *, PyObject *args) {
+  PyObject *deltas;
+  long long salt;
+  if (!PyArg_ParseTuple(args, "OL", &deltas, &salt)) return nullptr;
+  PyObject *seq = PySequence_Fast(deltas, "rekey expects a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *d = PySequence_Fast_GET_ITEM(seq, i);
+    if (!PyTuple_Check(d) || PyTuple_GET_SIZE(d) != 3 ||
+        !PyLong_Check(PyTuple_GET_ITEM(d, 0))) {
+      Py_DECREF(seq);
+      Py_RETURN_NONE;
+    }
+  }
+  PyObject *out = PyList_New(n);
+  if (!out) {
+    Py_DECREF(seq);
+    return nullptr;
+  }
+  uint8_t buf[1 + 16 + 1 + 16];
+  buf[0] = 0x06;
+  buf[17] = 0x02;
+  int64_t s = (int64_t)salt;
+  std::memcpy(buf + 18, &s, 8);
+  std::memset(buf + 26, s < 0 ? 0xFF : 0x00, 8);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *d = PySequence_Fast_GET_ITEM(seq, i);
+    PyObject *key = PyTuple_GET_ITEM(d, 0);
+    joinx::U128 kh;
+    if (!u128_of_pylong(key, &kh)) {
+      Py_DECREF(seq);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    std::memcpy(buf + 1, &kh.lo, 8);
+    std::memcpy(buf + 9, &kh.hi, 8);
+    uint8_t digest[16];
+    blake2b_hash(digest, 16, buf, sizeof(buf));
+    uint64_t lo, hi;
+    std::memcpy(&lo, digest, 8);
+    std::memcpy(&hi, digest + 8, 8);
+    PyObject *new_key = pylong_from_u128(lo, hi);
+    PyObject *entry = new_key ? PyTuple_New(3) : nullptr;
+    if (!entry) {
+      Py_XDECREF(new_key);
+      Py_DECREF(seq);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyObject *row = PyTuple_GET_ITEM(d, 1);
+    PyObject *diff = PyTuple_GET_ITEM(d, 2);
+    Py_INCREF(row);
+    Py_INCREF(diff);
+    PyTuple_SET_ITEM(entry, 0, new_key);
+    PyTuple_SET_ITEM(entry, 1, row);
+    PyTuple_SET_ITEM(entry, 2, diff);
+    PyList_SET_ITEM(out, i, entry);
+  }
+  Py_DECREF(seq);
+  return out;
+}
+
 static PyObject *py_join_stats(PyObject *, PyObject *arg) {
   auto *ix = join_from(arg);
   if (!ix) return nullptr;
@@ -2486,6 +2553,8 @@ static PyMethodDef methods[] = {
      "(join deltas, ((src, idx), ...)) -> projected deltas"},
     {"flatten_deltas", py_flatten_deltas, METH_VARARGS,
      "(deltas, col_idx, with_origin) -> flattened deltas or None"},
+    {"rekey_deltas", py_rekey_deltas, METH_VARARGS,
+     "(deltas, salt) -> salted-hash rekeyed deltas or None"},
     {"materialize_columns", py_materialize_columns, METH_VARARGS,
      "(rows|deltas, needed tuple, from_deltas) -> {idx: (kind, buf|list)} "
      "or None on bail"},

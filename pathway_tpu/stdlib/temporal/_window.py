@@ -265,6 +265,42 @@ def windowby(
             )
     else:
         win = window
+        if _sliding_vectorizable(table, time_expr, win):
+            # duration = m·hop over an int time column: every row is in
+            # EXACTLY m windows, so the assignment becomes m fully
+            # columnar branches (arithmetic starts, make_tuple windows),
+            # each injectively rekeyed (native salted hash) and
+            # concatenated — no per-row _assign, no flatten
+            origin = 0 if win.origin is None else win.origin
+            hop, duration = win.hop, win.duration
+            m = duration // hop
+
+            def base_of():
+                return ((time_expr - origin) // hop) * hop + origin
+
+            branches = []
+            for j in range(m):
+                # ascending starts, like _assign's reversed output
+                shift = (m - 1 - j) * hop
+                start = base_of() - shift
+                cols = {
+                    "_pw_time": time_expr,
+                    "_pw_window_start": start,
+                    "_pw_window_end": start + duration,
+                    "_pw_window": expr_mod.MakeTupleExpression(
+                        start, start + duration
+                    ),
+                }
+                if instance is not None:
+                    cols["_pw_instance"] = instance
+                b = table.with_columns(**cols)
+                if m > 1:  # rekey exists only to keep concat branches disjoint
+                    b = b._rekey_salted(j)
+                branches.append(b)
+            assigned = branches[0].concat(*branches[1:]) if m > 1 else branches[0]
+            if behavior is not None:
+                assigned = _apply_behavior(assigned, behavior)
+            return WindowGroupedTable(assigned, has_instance=instance is not None)
         if _tumbling_vectorizable(table, time_expr, win):
             # tumbling over a non-optional int column assigns EXACTLY one
             # window per row via plain arithmetic: the start/end columns
@@ -322,20 +358,24 @@ def windowby(
     return WindowGroupedTable(assigned, has_instance=instance is not None)
 
 
-def _tumbling_vectorizable(table: Table, time_expr, win) -> bool:
-    """The arithmetic fast path is exact only for non-optional int time
-    columns with int duration/origin (float times keep float // float
-    quirks on the row path; None times must drop the row, which the
-    windows_of path does and arithmetic cannot)."""
-    from pathway_tpu.internals import dtype as dt
-    from pathway_tpu.internals.thisclass import ThisPlaceholder
-
-    if not isinstance(win, TumblingWindow):
+def _sliding_vectorizable(table: Table, time_expr, win) -> bool:
+    """Sliding fast path: int time column, int hop/duration with duration
+    an exact multiple of hop (constant windows-per-row), int origin."""
+    if not isinstance(win, SlidingWindow):
         return False
-    if not isinstance(win.duration, int) or win.duration == 0:
+    if not (isinstance(win.hop, int) and isinstance(win.duration, int)):
+        return False
+    if win.hop <= 0 or win.duration <= 0 or win.duration % win.hop != 0:
         return False
     if win.origin is not None and not isinstance(win.origin, int):
         return False
+    return _int_time_column(table, time_expr)
+
+
+def _int_time_column(table: Table, time_expr) -> bool:
+    from pathway_tpu.internals import dtype as dt
+    from pathway_tpu.internals.thisclass import ThisPlaceholder
+
     if not isinstance(time_expr, ColumnReference):
         return False
     tbl = time_expr.table
@@ -344,6 +384,20 @@ def _tumbling_vectorizable(table: Table, time_expr, win) -> bool:
     sch = getattr(tbl, "schema", None)
     col = sch.__columns__.get(time_expr.name) if sch is not None else None
     return col is not None and col.dtype is dt.INT
+
+
+def _tumbling_vectorizable(table: Table, time_expr, win) -> bool:
+    """The arithmetic fast path is exact only for non-optional int time
+    columns with int duration/origin (float times keep float // float
+    quirks on the row path; None times must drop the row, which the
+    windows_of path does and arithmetic cannot)."""
+    if not isinstance(win, TumblingWindow):
+        return False
+    if not isinstance(win.duration, int) or win.duration == 0:
+        return False
+    if win.origin is not None and not isinstance(win.origin, int):
+        return False
+    return _int_time_column(table, time_expr)
 
 
 def _apply_behavior(assigned: Table, behavior: Behavior) -> Table:

@@ -216,3 +216,124 @@ def test_sliding_windowby_parity_with_native_flatten():
         )
 
     assert _run_stream(build, True) == _run_stream(build, False)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_sliding_branch_path_vs_flatten_path(seed, monkeypatch):
+    """The vectorized sliding assignment (m columnar branches + salted
+    rekey + concat) must produce IDENTICAL reduce streams to the original
+    per-row flatten path, across hops/durations/origins/instances and
+    retraction epochs."""
+    from pathway_tpu.stdlib.temporal import _window as wmod
+
+    rng = random.Random(500 + seed)
+    hop = rng.choice([3, 10, 50])
+    m = rng.choice([1, 2, 4])
+    duration = hop * m
+    origin = rng.choice([None, 0, -7])
+    use_instance = seed % 2 == 0
+    rows = [
+        {
+            "at": rng.randrange(-500, 500),
+            "v": rng.randrange(-50, 50),
+            "g": rng.choice(["a", "b"]),
+        }
+        for _ in range(300)
+    ]
+    schema = pw.schema_from_types(at=int, v=int, g=str)
+
+    def build():
+        t = make_static_input_table(schema, rows)
+        kw = {"window": pw.temporal.sliding(hop=hop, duration=duration, origin=origin)}
+        if use_instance:
+            kw["instance"] = pw.this.g
+        return t.windowby(pw.this.at, **kw).reduce(
+            start=pw.this._pw_window_start,
+            end=pw.this._pw_window_end,
+            n=pw.reducers.count(),
+            total=pw.reducers.sum(pw.this.v),
+        )
+
+    fast = _run_stream(build, True)
+    monkeypatch.setattr(wmod, "_sliding_vectorizable", lambda *a: False)
+    flatten = _run_stream(build, True)
+    assert fast == flatten, f"hop={hop} m={m} origin={origin} inst={use_instance}"
+    assert len(fast) > 0
+
+
+def test_sliding_branch_path_retraction_parity(monkeypatch):
+    """Epoch-timed inserts AND retractions through the branch path match
+    the flatten path (exercises SaltRekeyNode's dirty consolidate)."""
+    from tests.utils import T
+    from pathway_tpu.stdlib.temporal import _window as wmod
+
+    def build():
+        t = T(
+            """
+            at | v | _time | _diff
+            2  | 1 | 2     | 1
+            7  | 2 | 2     | 1
+            2  | 1 | 6     | -1
+            9  | 3 | 6     | 1
+            """
+        )
+        return t.windowby(
+            pw.this.at, window=pw.temporal.sliding(hop=5, duration=10)
+        ).reduce(
+            start=pw.this._pw_window_start,
+            n=pw.reducers.count(),
+            total=pw.reducers.sum(pw.this.v),
+        )
+
+    fast = _run_stream(build, True)
+    monkeypatch.setattr(wmod, "_sliding_vectorizable", lambda *a: False)
+    flatten = _run_stream(build, True)
+    assert fast == flatten
+    assert any(d < 0 for (_, _, _, d) in fast)
+
+
+def test_sliding_branch_path_with_behavior(monkeypatch):
+    """Behaviors (buffer/freeze on epoch-timed streams) compose with the
+    branch assignment identically to the flatten path."""
+    from tests.utils import T
+    from pathway_tpu.stdlib.temporal import _window as wmod
+
+    def build():
+        t = T(
+            """
+            at | v | _time | _diff
+            2  | 1 | 2     | 1
+            7  | 2 | 4     | 1
+            13 | 3 | 6     | 1
+            22 | 4 | 8     | 1
+            """
+        )
+        return t.windowby(
+            pw.this.at,
+            window=pw.temporal.sliding(hop=5, duration=10),
+            behavior=pw.temporal.common_behavior(cutoff=15),
+        ).reduce(
+            start=pw.this._pw_window_start,
+            n=pw.reducers.count(),
+        )
+
+    fast = _run_stream(build, True)
+    monkeypatch.setattr(wmod, "_sliding_vectorizable", lambda *a: False)
+    flatten = _run_stream(build, True)
+    assert fast == flatten
+
+
+def test_sliding_non_multiple_duration_keeps_flatten_path():
+    from pathway_tpu.stdlib.temporal._window import (
+        SlidingWindow,
+        _sliding_vectorizable,
+    )
+
+    rows = [{"at": i, "v": i} for i in range(10)]
+    schema = pw.schema_from_types(at=int, v=int)
+    G.clear()
+    t = make_static_input_table(schema, rows)
+    assert not _sliding_vectorizable(t, pw.this.at, SlidingWindow(hop=3, duration=7))
+    assert not _sliding_vectorizable(t, pw.this.at, SlidingWindow(hop=3, duration=0.3))
+    assert _sliding_vectorizable(t, pw.this.at, SlidingWindow(hop=3, duration=9))
+    G.clear()
