@@ -2392,6 +2392,100 @@ class JoinResult(Joinable):
                     return None
             return tuple(spec)
 
+        def _flat_select() -> "Table | None":
+            """Computed join-selects as: native flat projection of every
+            REFERENCED side column → a standard (vec-compilable) select
+            over the flat table.  The join step and the column extraction
+            stay native; only the arithmetic runs in the expression
+            engine — which vectorizes it.  None = unsupported shape (the
+            row path handles it, including its error surfaces)."""
+            l_names = left_table.column_names()
+            r_names = right_table.column_names()
+            refs: list[ColumnReference] = []
+
+            def walk(e):
+                if isinstance(e, ColumnReference):
+                    refs.append(e)
+                    return
+                for s in e._sub_expressions():
+                    walk(s)
+
+            for e in exprs.values():
+                if not isinstance(e, expr_mod.ColumnExpression):
+                    return None
+                walk(e)
+
+            needed: dict[str, tuple[int, int]] = {}  # name -> (src, idx)
+            sides: dict[str, str] = {}
+            for ref in refs:
+                name = ref.name
+                if name == "id":
+                    return None  # id refs keep the row path
+                side = JoinResult._side_of(ref.table, left_table, right_table)
+                if side is None and isinstance(ref.table, ThisPlaceholder):
+                    in_l, in_r = name in l_names, name in r_names
+                    if in_l == in_r:
+                        return None  # ambiguous / unknown: row path raises
+                    side = "left" if in_l else "right"
+                if side is None:
+                    return None
+                if sides.get(name, side) != side:
+                    return None  # same name from both sides: would collide
+                sides[name] = side
+                if name not in needed:
+                    src = 0 if side == "left" else 1
+                    names_ = l_names if side == "left" else r_names
+                    if name not in names_:
+                        return None
+                    needed[name] = (src, names_.index(name))
+            if not needed:
+                return None
+
+            flat_names = list(needed)
+            spec = tuple(needed[n] for n in flat_names)
+            tmp = JoinBinder(None)
+            cols = {}
+            for n in flat_names:
+                side_tbl = left_table if sides[n] == "left" else right_table
+                try:
+                    d = tmp.resolve_dtype(ColumnReference(side_tbl, n))
+                except Exception:
+                    d = dt.ANY
+                cols[n] = schema_mod.ColumnSchema(name=n, dtype=d)
+
+            def flat_build(lowerer: Lowerer) -> df.Node:
+                join_node = jr._lower_join(lowerer)
+                binder = JoinBinder(lowerer)
+                accs = [
+                    binder.resolve(
+                        ColumnReference(
+                            left_ph if sides[n] == "left" else right_ph, n
+                        )
+                    )
+                    for n in flat_names
+                ]
+
+                def fn(key, row):
+                    return tuple(a(key, row) for a in accs)
+
+                node = df.ExprNode(lowerer.scope, join_node, fn)
+                node.vec_join_project = spec
+                return node
+
+            flat_t = Table(
+                schema_mod.schema_from_columns(cols), flat_build, universe=Universe()
+            )
+            mapping = {
+                id(left_table): flat_t,
+                id(right_table): flat_t,
+                id(left_ph): flat_t,
+                id(right_ph): flat_t,
+                id(this): flat_t,
+            }
+            return flat_t.select(
+                **{n: e._substitute(mapping) for n, e in exprs.items()}
+            )
+
         def build(lowerer: Lowerer) -> df.Node:
             join_node = jr._lower_join(lowerer)
             binder = JoinBinder(lowerer)
@@ -2403,6 +2497,16 @@ class JoinResult(Joinable):
             node = df.ExprNode(lowerer.scope, join_node, fn)
             node.vec_join_project = _project_spec()
             return node
+
+        from pathway_tpu.internals import vector_compiler as _vc
+
+        if _vc.ENABLED and _project_spec() is None:
+            # only worthwhile with the vector compiler on (the flat graph
+            # adds a node whose payoff is the columnar expression pass);
+            # off also serves as the parity toggle for tests
+            flat = _flat_select()
+            if flat is not None:
+                return flat
 
         tmp_binder = JoinBinder(None)
         cols = {}
