@@ -68,7 +68,21 @@ class BaseContextProcessor(ABC):
 @dataclass
 class SimpleContextProcessor(BaseContextProcessor):
     """Keeps the listed metadata keys and joins documents with the joiner
-    (parity: question_answering.py:257-282)."""
+    (parity: question_answering.py:257-282).
+
+    Example:
+
+    >>> from pathway_tpu.xpacks.llm.question_answering import SimpleContextProcessor
+    >>> proc = SimpleContextProcessor(context_metadata_keys=["path"])
+    >>> docs = [
+    ...     {"text": "alpha", "metadata": {"path": "/a.txt", "b64_image": "x"}},
+    ...     {"text": "beta", "metadata": {"path": "/b.txt"}},
+    ... ]
+    >>> print(proc.apply(docs))
+    {"text": "alpha", "path": "/a.txt"}
+    <BLANKLINE>
+    {"text": "beta", "path": "/b.txt"}
+    """
 
     context_metadata_keys: list[str] = field(default_factory=lambda: ["path"])
     context_joiner: str = "\n\n"

@@ -28,6 +28,7 @@ MODULES = [
     "pathway_tpu.stdlib.stateful",
     "pathway_tpu.internals.expressions.string",
     "pathway_tpu.internals.expressions.numerical",
+    "pathway_tpu.xpacks.llm.question_answering",
     "pathway_tpu.internals.expressions.date_time",
     "pathway_tpu.internals.iterate",
     "pathway_tpu.stdlib.graphs.pagerank",
