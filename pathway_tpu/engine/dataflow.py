@@ -1187,11 +1187,14 @@ class JoinNode(Node):
             dr = consolidate(self.take_pending(1))
             l_idxs, r_idxs, mode = self.native_spec
             raw, replaced = self._nat.join_step(
-                cap, dl, dr, l_idxs, r_idxs, mode
+                cap, dl, dr, l_idxs, r_idxs, mode,
+                int(self.left_outer), int(self.right_outer),
             )
             if (
                 mode == 0
                 and not replaced
+                and not self.left_outer
+                and not self.right_outer
                 and isinstance(dl, CleanDeltas)
                 and isinstance(dr, CleanDeltas)
             ):
@@ -1231,7 +1234,10 @@ class JoinNode(Node):
                     elif old + diff == 0:
                         self._null_left(rkey, rrow, jk, 1, out)
             if self.left_outer:
-                self._left_matches[lkey] += diff * n_matches
+                # a dict-put REPLACE keeps the count: matches tracks live
+                # right rows, which a same-key re-insert does not change
+                if diff < 0 or lkey not in self._left_idx.get(jk, {}):
+                    self._left_matches[lkey] += diff * n_matches
                 if n_matches == 0:
                     self._null_right(lkey, lrow, jk, diff, out)
             if diff > 0:
@@ -1261,7 +1267,8 @@ class JoinNode(Node):
                     elif old + diff == 0:
                         self._null_right(lkey, lrow, jk, 1, out)
             if self.right_outer:
-                self._right_matches[rkey] += diff * n_matches
+                if diff < 0 or rkey not in self._right_idx.get(jk, {}):
+                    self._right_matches[rkey] += diff * n_matches
                 if n_matches == 0:
                     self._null_left(rkey, rrow, jk, diff, out)
             if diff > 0:

@@ -196,6 +196,52 @@ def compile_expr(e: ColumnExpression, binder: Binder) -> RowFn:
         kfns = {k: compile_expr(v, binder) for k, v in e._kwargs.items()}
         fun = e._fun
         propagate_none = e._propagate_none
+        err_cls = Error
+
+        if not kfns and len(fns) == 1:
+            # the dominant shape (one positional arg, no kwargs): no list
+            # build, no generator-based error scans — this wrapper runs
+            # once per row on every Apply in a pipeline
+            f0 = fns[0]
+
+            def apply_fn1(key, row):
+                a = f0(key, row)
+                if isinstance(a, err_cls):
+                    return ERROR
+                if propagate_none and a is None:
+                    return None
+                try:
+                    return fun(a)
+                except Exception:
+                    from pathway_tpu.internals import config as _cfg
+
+                    if _cfg.get_config().terminate_on_error:
+                        raise
+                    return ERROR
+
+            return apply_fn1
+
+        if not kfns:
+
+            def apply_fn_pos(key, row):
+                args = [f(key, row) for f in fns]
+                for a in args:
+                    if isinstance(a, err_cls):
+                        return ERROR
+                if propagate_none:
+                    for a in args:
+                        if a is None:
+                            return None
+                try:
+                    return fun(*args)
+                except Exception:
+                    from pathway_tpu.internals import config as _cfg
+
+                    if _cfg.get_config().terminate_on_error:
+                        raise
+                    return ERROR
+
+            return apply_fn_pos
 
         def apply_fn(key, row):
             args = [f(key, row) for f in fns]

@@ -2178,43 +2178,47 @@ class JoinResult(Joinable):
             left_outer=self._mode in (JoinMode.LEFT, JoinMode.OUTER),
             right_outer=self._mode in (JoinMode.RIGHT, JoinMode.OUTER),
         )
-        if self._mode is JoinMode.INNER:
-            from pathway_tpu.internals import vector_compiler as vc
+        from pathway_tpu.internals import vector_compiler as vc
 
-            # plain-column inner joins run the whole delta-join step in the
-            # native C++ index (reference join hot path, dataflow.rs:2740);
-            # okey modes mirror out_key_fn above exactly
-            l_idxs = [vc.passthrough_index(e, lbinder) for e in self._left_on]
-            r_idxs = [vc.passthrough_index(e, rbinder) for e in self._right_on]
+        # plain-column equi-joins run the whole delta-join step in the
+        # native C++ index (reference join hot path, dataflow.rs:2740);
+        # okey modes mirror out_key_fn above exactly.  Outer modes are
+        # supported for the default hash-pair out keys (modes 1/2 with
+        # a nullable counterpart keep the row path: their null-pad key
+        # derivation serializes the RAW key, a distinct recipe).
+        l_idxs = [vc.passthrough_index(e, lbinder) for e in self._left_on]
+        r_idxs = [vc.passthrough_index(e, rbinder) for e in self._right_on]
 
-            def _hashable_key_dtypes() -> bool:
-                """The native index matches by serialized bytes; the row
-                path by Python equality.  They agree only for same-dtype
-                keys whose equality is byte equality: int/str/bytes/bool/
-                Pointer.  Floats are out (-0.0 == 0.0 with different
-                bytes, nan != nan with equal bytes); cross-dtype pairs
-                are out (True == 1, 1 == 1.0 across columns)."""
-                exact = {dt.INT, dt.STR, dt.BYTES, dt.BOOL, dt.POINTER}
-                for le, re_ in zip(self._left_on, self._right_on):
-                    lcol = left_table.schema.__columns__.get(le.name)
-                    rcol = right_table.schema.__columns__.get(re_.name)
-                    if lcol is None or rcol is None:
-                        return False
-                    ld = lcol.dtype.strip_optional()
-                    rd = rcol.dtype.strip_optional()
-                    if ld is not rd or ld not in exact:
-                        return False
-                return True
+        def _hashable_key_dtypes() -> bool:
+            """The native index matches by serialized bytes; the row
+            path by Python equality.  They agree only for same-dtype
+            keys whose equality is byte equality: int/str/bytes/bool/
+            Pointer.  Floats are out (-0.0 == 0.0 with different
+            bytes, nan != nan with equal bytes); cross-dtype pairs
+            are out (True == 1, 1 == 1.0 across columns)."""
+            exact = {dt.INT, dt.STR, dt.BYTES, dt.BOOL, dt.POINTER}
+            for le, re_ in zip(self._left_on, self._right_on):
+                lcol = left_table.schema.__columns__.get(le.name)
+                rcol = right_table.schema.__columns__.get(re_.name)
+                if lcol is None or rcol is None:
+                    return False
+                ld = lcol.dtype.strip_optional()
+                rd = rcol.dtype.strip_optional()
+                if ld is not rd or ld not in exact:
+                    return False
+            return True
 
-            if (
-                vc.ENABLED
-                and l_idxs
-                and None not in l_idxs
-                and None not in r_idxs
-                and _hashable_key_dtypes()
-            ):
-                mode = {"left": 1, "right": 2}.get(id_side, 0)
-                node.native_spec = (tuple(l_idxs), tuple(r_idxs), mode)
+        mode = {"left": 1, "right": 2}.get(id_side, 0)
+        outer = self._mode is not JoinMode.INNER
+        if (
+            vc.ENABLED
+            and l_idxs
+            and None not in l_idxs
+            and None not in r_idxs
+            and _hashable_key_dtypes()
+            and not (outer and mode != 0)
+        ):
+            node.native_spec = (tuple(l_idxs), tuple(r_idxs), mode)
         return node
 
     def select(self, *args, **kwargs) -> Table:

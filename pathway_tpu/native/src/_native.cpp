@@ -1793,6 +1793,7 @@ struct Entry {
   U128 kh;        // row-key hash (bucket membership)
   PyObject *key;  // owned
   PyObject *row;  // owned
+  long long matches = 0;  // outer modes: live matches on the other side
 };
 // buckets are small vectors, not maps: the common join has a handful of
 // rows per key, where a linear scan beats a per-key unordered_map heap
@@ -1954,6 +1955,55 @@ static PyObject *join_okey(int mode, PyObject *lkey, PyObject *rkey,
   return pylong_from_u128(lo, hi);
 }
 
+// hash_values([Pointer(k), None]) / ([None, Pointer(k)]) for null-padded
+// outer rows (ser None = single 0x00 byte)
+static PyObject *join_okey_null(bool left_null, const joinx::U128 &k) {
+  uint8_t buf[18];
+  if (left_null) {
+    buf[0] = 0x00;
+    buf[1] = 0x06;
+    std::memcpy(buf + 2, &k.lo, 8);
+    std::memcpy(buf + 10, &k.hi, 8);
+  } else {
+    buf[0] = 0x06;
+    std::memcpy(buf + 1, &k.lo, 8);
+    std::memcpy(buf + 9, &k.hi, 8);
+    buf[17] = 0x00;
+  }
+  uint8_t digest[16];
+  blake2b_hash(digest, 16, buf, 18);
+  uint64_t lo, hi;
+  std::memcpy(&lo, digest, 8);
+  std::memcpy(&hi, digest + 8, 8);
+  return pylong_from_u128(lo, hi);
+}
+
+// one null-padded outer row: (okey, (key, None, row, None), diff) when
+// null_side == 1 (right null), or (okey, (None, key, None, row), diff)
+static int join_emit_null(PyObject *out, int null_side, PyObject *key,
+                          PyObject *row, const joinx::U128 &kh,
+                          long long diff) {
+  PyObject *okey = join_okey_null(null_side == 0, kh);
+  if (!okey) return -1;
+  PyObject *payload =
+      null_side == 1 ? PyTuple_Pack(4, key, Py_None, row, Py_None)
+                     : PyTuple_Pack(4, Py_None, key, Py_None, row);
+  PyObject *pdiff = payload ? PyLong_FromLongLong(diff) : nullptr;
+  PyObject *item = pdiff ? PyTuple_New(3) : nullptr;
+  if (!item) {
+    Py_DECREF(okey);
+    Py_XDECREF(payload);
+    Py_XDECREF(pdiff);
+    return -1;
+  }
+  PyTuple_SET_ITEM(item, 0, okey);
+  PyTuple_SET_ITEM(item, 1, payload);
+  PyTuple_SET_ITEM(item, 2, pdiff);
+  int rc = PyList_Append(out, item);
+  Py_DECREF(item);
+  return rc;
+}
+
 static int join_emit(PyObject *out, int mode, PyObject *lkey, PyObject *rkey,
                      PyObject *lrow, PyObject *rrow, const joinx::U128 &lk,
                      const joinx::U128 &rk, PyObject *diff) {
@@ -1982,9 +2032,14 @@ static int join_emit(PyObject *out, int mode, PyObject *lkey, PyObject *rkey,
 // apply one side's deltas: probe the other side, then update own index.
 // side 0 = deltas are left rows, 1 = right rows.  *replaced is set when an
 // insert overwrote an existing row key (cleanliness analysis cares).
+// mine_outer = THIS side is outer (its unmatched rows get null pads);
+// other_outer = the probed side is outer (its rows' match counts
+// transition as this side's deltas arrive) — the exact bookkeeping of
+// JoinNode.step's row path.
 static int join_apply_side(joinx::Index *ix, int side, PyObject *deltas,
                            PyObject *idxs, int mode, PyObject *out,
-                           bool *replaced) {
+                           bool *replaced, bool mine_outer,
+                           bool other_outer) {
   auto &mine = ix->sides[side];
   auto &other = ix->sides[1 - side];
   PyObject *seq = PySequence_Fast(deltas, "join deltas must be a sequence");
@@ -1997,19 +2052,34 @@ static int join_apply_side(joinx::Index *ix, int side, PyObject *deltas,
     PyObject *key = PyTuple_GET_ITEM(d, 0);
     PyObject *row = PyTuple_GET_ITEM(d, 1);
     PyObject *diff = PyTuple_GET_ITEM(d, 2);
+    long long dval = PyLong_AsLongLong(diff);
+    if (dval == -1 && PyErr_Occurred()) {
+      Py_DECREF(seq);
+      return -1;
+    }
     joinx::U128 jk;
     int st = join_key_of(row, idxs, buf, &jk);
     if (st < 0) {
       Py_DECREF(seq);
       return -1;
     }
-    if (st == 0) continue;  // null join key: no match, not stored
     joinx::U128 kh;
     if (!u128_of_pylong(key, &kh)) {
       Py_DECREF(seq);
       return -1;
     }
+    if (st == 0) {
+      // null join key matches nothing (SQL), but an outer side still
+      // carries the row with a null-padded partner
+      if (mine_outer &&
+          join_emit_null(out, side == 0 ? 1 : 0, key, row, kh, dval) < 0) {
+        Py_DECREF(seq);
+        return -1;
+      }
+      continue;
+    }
     auto oit = other.find(jk);
+    long long n_matches = oit == other.end() ? 0 : (long long)oit->second.size();
     if (oit != other.end()) {
       for (auto &e : oit->second) {
         int rc = side == 0
@@ -2017,14 +2087,27 @@ static int join_apply_side(joinx::Index *ix, int side, PyObject *deltas,
                                  diff)
                      : join_emit(out, mode, e.key, key, e.row, row, e.kh, kh,
                                  diff);
+        if (rc == 0 && other_outer) {
+          // the probed row's match count transitions: its null pad
+          // retracts on the first match, reappears on the last unmatch
+          long long old = e.matches;
+          e.matches = old + dval;
+          if (old == 0 && dval > 0) {
+            rc = join_emit_null(out, side == 0 ? 0 : 1, e.key, e.row, e.kh,
+                                -1);
+          } else if (old + dval == 0) {
+            rc = join_emit_null(out, side == 0 ? 0 : 1, e.key, e.row, e.kh,
+                                1);
+          }
+        }
         if (rc < 0) {
           Py_DECREF(seq);
           return -1;
         }
       }
     }
-    long long dval = PyLong_AsLongLong(diff);
-    if (dval == -1 && PyErr_Occurred()) {
+    if (mine_outer && n_matches == 0 &&
+        join_emit_null(out, side == 0 ? 1 : 0, key, row, kh, dval) < 0) {
       Py_DECREF(seq);
       return -1;
     }
@@ -2036,7 +2119,7 @@ static int join_apply_side(joinx::Index *ix, int side, PyObject *deltas,
           found = &e;
           break;
         }
-      if (found) {  // replace (row path: dict put)
+      if (found) {  // replace (row path: dict put, match count kept)
         *replaced = true;
         Py_DECREF(found->key);
         Py_DECREF(found->row);
@@ -2047,7 +2130,7 @@ static int join_apply_side(joinx::Index *ix, int side, PyObject *deltas,
       } else {
         Py_INCREF(key);
         Py_INCREF(row);
-        bucket.push_back({kh, key, row});
+        bucket.push_back({kh, key, row, n_matches});
       }
     } else {
       auto mit = mine.find(jk);
@@ -2068,13 +2151,14 @@ static int join_apply_side(joinx::Index *ix, int side, PyObject *deltas,
   return 0;
 }
 
-// (capsule, left_deltas, right_deltas, l_idxs, r_idxs, okey_mode)
-//   -> (out list, replaced: bool)
+// (capsule, left_deltas, right_deltas, l_idxs, r_idxs, okey_mode,
+//  left_outer, right_outer) -> (out list, replaced: bool)
 static PyObject *py_join_step(PyObject *, PyObject *args) {
   PyObject *cap, *dl, *dr, *l_idxs, *r_idxs;
-  int mode;
-  if (!PyArg_ParseTuple(args, "OOOO!O!i", &cap, &dl, &dr, &PyTuple_Type,
-                        &l_idxs, &PyTuple_Type, &r_idxs, &mode))
+  int mode, left_outer = 0, right_outer = 0;
+  if (!PyArg_ParseTuple(args, "OOOO!O!i|ii", &cap, &dl, &dr, &PyTuple_Type,
+                        &l_idxs, &PyTuple_Type, &r_idxs, &mode, &left_outer,
+                        &right_outer))
     return nullptr;
   auto *ix = join_from(cap);
   if (!ix) return nullptr;
@@ -2082,8 +2166,10 @@ static PyObject *py_join_step(PyObject *, PyObject *args) {
   if (!out) return nullptr;
   bool replaced = false;
   // delta-join rule: dL against R, then dR against L' (already incl. dL)
-  if (join_apply_side(ix, 0, dl, l_idxs, mode, out, &replaced) < 0 ||
-      join_apply_side(ix, 1, dr, r_idxs, mode, out, &replaced) < 0) {
+  if (join_apply_side(ix, 0, dl, l_idxs, mode, out, &replaced,
+                      left_outer != 0, right_outer != 0) < 0 ||
+      join_apply_side(ix, 1, dr, r_idxs, mode, out, &replaced,
+                      right_outer != 0, left_outer != 0) < 0) {
     Py_DECREF(out);
     return nullptr;
   }
@@ -2166,6 +2252,16 @@ static PyObject *py_join_load(PyObject *, PyObject *args) {
     }
   }
   Py_DECREF(seq);
+  // recount outer match counters from the live invariant (count = size of
+  // the other side's bucket); cheap, and correct whichever side loads last
+  for (int s = 0; s < 2; s++) {
+    auto &other = ix->sides[1 - s];
+    for (auto &b : ix->sides[s]) {
+      auto oit = other.find(b.first);
+      long long m = oit == other.end() ? 0 : (long long)oit->second.size();
+      for (auto &e : b.second) e.matches = m;
+    }
+  }
   Py_RETURN_NONE;
 }
 

@@ -177,14 +177,61 @@ def test_expression_keys_fall_back_to_row_path():
     assert _run_stream(build, True) == _run_stream(build, False)
 
 
-def test_outer_modes_keep_row_path():
+@pytest.mark.parametrize("how", ["left", "right", "outer"])
+@pytest.mark.parametrize("seed", range(3))
+def test_outer_join_stream_parity_fuzz(how, seed):
+    """Outer modes on the native path: null-pad emission and match-count
+    transitions must reproduce the row path's streams exactly, including
+    retraction epochs flipping rows between matched and padded."""
+    from tests.utils import T
+
+    rng = random.Random(800 + seed)
+    # partially overlapping key ranges: both sides get unmatched rows
+    lrows = [(rng.randrange(4), rng.randrange(-9, 9)) for _ in range(40)]
+    rrows = [(2 + rng.randrange(4), rng.randrange(-9, 9)) for _ in range(30)]
+    l_retracts = [r for i, r in enumerate(lrows) if i % 4 == 0]
+    r_retracts = [r for i, r in enumerate(rrows) if i % 3 == 0]
+
+    def md(rows2, names, retracts):
+        lines = [" | ".join(names + ["_time", "_diff"])]
+        for r in rows2:
+            lines.append(" | ".join(str(x) for x in r) + " | 2 | 1")
+        for r in retracts:
+            lines.append(" | ".join(str(x) for x in r) + " | 6 | -1")
+        return T("\n".join(lines))
+
+    def build():
+        lt = md(lrows, ["k", "v"], l_retracts)
+        rt = md(rrows, ["k", "w"], r_retracts)
+        join = {
+            "left": lt.join_left,
+            "right": lt.join_right,
+            "outer": lt.join_outer,
+        }[how]
+        return join(rt, lt.k == rt.k).select(
+            k=pw.left.k, lv=pw.left.v, rv=pw.right.w
+        )
+
+    native = _run_stream(build, True)
+    row = _run_stream(build, False)
+    assert native == row, f"how={how} seed={seed}"
+    # padded rows (a None side) and retractions must both be present
+    assert any(None in r[1] for r in native), "no padded rows exercised"
+    assert any(d < 0 for (_, _, _, d) in native)
+    used = _spy_paths(build)
+    assert used["native"] > 0 and used["row"] == 0, used
+
+
+def test_outer_join_with_id_param_keeps_row_path():
+    """id=left.id outer joins keep the row path (their null-pad out-key
+    recipe serializes the RAW key — a distinct derivation)."""
     rows = [{"k": i % 3, "v": i} for i in range(9)]
     schema = pw.schema_from_types(k=int, v=int)
 
     def build():
         lt = make_static_input_table(schema, rows)
         rt = make_static_input_table(schema, rows[:3])
-        return lt.join_left(rt, lt.k == rt.k).select(
+        return lt.join_left(rt, lt.k == rt.k, id=lt.id).select(
             lv=pw.left.v, rv=pw.right.v
         )
 
@@ -400,3 +447,45 @@ def test_interval_join_stream_parity_and_flat_activation():
     assert fast == row
     assert used["flat"] > 0, "flat projection path did not activate"
     assert any(d < 0 for (_, _, _, d) in fast)  # retraction flowed through
+
+
+def test_outer_join_replace_delta_parity():
+    """A same-key re-insert (naked replace) must not double-count matches
+    on either path: after the matching right row retracts, exactly ONE
+    null pad appears (the live-invariant count; the row path previously
+    += on replace and never padded)."""
+    from pathway_tpu import native as native_mod
+
+    def drive(use_native: bool):
+        node = df.JoinNode(
+            df.Scope(),
+            df.StaticNode(df.Scope(), []),
+            df.StaticNode(df.Scope(), []),
+            lambda k, r: (r[0],),
+            lambda k, r: (r[0],),
+            lambda lk, rk, jk: 0,  # out keys irrelevant here
+            left_outer=True,
+        )
+        if use_native:
+            node.native_spec = ((0,), (0,), 0)
+        sent = []
+        node.send = lambda out, t: sent.append(list(out))
+        # epoch 1: L and R match
+        node.pending[0].extend([(1, ("a", 10), 1)])
+        node.pending[1].extend([(7, ("a", 70), 1)])
+        node.step(0)
+        # epoch 2: naked replace of L (no retraction)
+        node.pending[0].extend([(1, ("a", 11), 1)])
+        node.step(2)
+        # epoch 3: the matching right row retracts -> ONE null pad
+        node.pending[1].extend([(7, ("a", 70), -1)])
+        node.step(4)
+        pads = [
+            d for out in sent for (k, p, d) in out if p[1] is None and p[3] is None
+        ]
+        return pads
+
+    nat = native_mod.get()
+    if nat is None or not hasattr(nat, "join_step"):
+        pytest.skip("native module unavailable")
+    assert drive(True) == drive(False) == [1]
