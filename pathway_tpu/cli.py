@@ -54,11 +54,21 @@ def spawn_program(
     program: str,
     arguments: tuple[str, ...],
     env_base: dict[str, str],
+    supervise: bool = False,
+    max_restarts: int = 3,
 ) -> NoReturn:
-    """Launch ``processes`` copies of ``program`` forming one SPMD cluster."""
+    """Launch ``processes`` copies of ``program`` forming one SPMD cluster.
+
+    With ``supervise=True`` a crashed worker does not end the run: the
+    supervisor (``engine/supervisor.py``) rolls the whole group back to
+    the last committed persistence checkpoint and respawns it, up to
+    ``max_restarts`` times — same run id, ports and comm secret, so the
+    recovered cluster resumes exactly where the snapshots left off.
+    """
     click.echo(
         f"[pathway_tpu] launching SPMD cluster: {processes} process(es), "
-        f"ports {first_port}..{first_port + processes - 1}",
+        f"ports {first_port}..{first_port + processes - 1}"
+        + (f", supervised (max {max_restarts} restarts)" if supervise else ""),
         err=True,
     )
     run_id = str(uuid.uuid4())
@@ -67,6 +77,35 @@ def spawn_program(
     # for this run
     env_base = dict(env_base)
     env_base.setdefault("PATHWAY_COMM_SECRET", secrets.token_hex(16))
+
+    if supervise:
+        from pathway_tpu.engine.supervisor import (
+            ENV_ATTEMPT,
+            Supervisor,
+            SupervisorError,
+        )
+
+        def spawn_one(process_id: int, attempt: int) -> subprocess.Popen:
+            env = _cluster_env(
+                env_base,
+                threads=threads,
+                processes=processes,
+                first_port=first_port,
+                process_id=process_id,
+                run_id=run_id,
+            )
+            env[ENV_ATTEMPT] = str(attempt)
+            return subprocess.Popen([program, *arguments], env=env)
+
+        try:
+            Supervisor(
+                spawn_one, processes, max_restarts=max_restarts
+            ).run()
+        except SupervisorError as exc:
+            click.echo(f"[pathway_tpu] {exc}", err=True)
+            sys.exit(1)
+        sys.exit(0)
+
     handles: list[subprocess.Popen] = []
     try:
         # spawn inside the try: a mid-spawn failure (EAGAIN, missing
@@ -140,9 +179,22 @@ _SPAWN_SETTINGS = {"allow_interspersed_args": False, "show_default": True}
     "jax.distributed.initialize so jax.devices() spans the cluster "
     "(coordinator derived from the PATHWAY_* env)",
 )
+@click.option(
+    "--supervise",
+    is_flag=True,
+    help="restart the cluster from the last committed persistence "
+    "checkpoint when a worker dies (engine/supervisor.py)",
+)
+@click.option(
+    "--max-restarts",
+    metavar="N",
+    type=click.IntRange(min=0),
+    default=3,
+    help="supervised mode: give up after N recoveries",
+)
 @click.argument("program")
 @click.argument("arguments", nargs=-1)
-def spawn(threads, processes, first_port, record, record_path, jax_distributed, program, arguments):
+def spawn(threads, processes, first_port, record, record_path, jax_distributed, supervise, max_restarts, program, arguments):
     """Run PROGRAM as an SPMD cluster of identical processes."""
     env = (
         _recording_env(
@@ -160,6 +212,8 @@ def spawn(threads, processes, first_port, record, record_path, jax_distributed, 
         program=program,
         arguments=arguments,
         env_base=env,
+        supervise=supervise,
+        max_restarts=max_restarts,
     )
 
 

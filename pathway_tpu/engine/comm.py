@@ -17,20 +17,35 @@ record-at-a-time dataflow:
   ``shard_to_worker(key, n)`` — exactly the reference's rule.
 
 Wire format: a mutual HMAC-SHA256 handshake (shared secret from
-``PATHWAY_COMM_SECRET``; ``cli spawn`` generates a fresh one per run), then
-8-byte big-endian length + PWT1-typed ``(tag, payload)`` frames — the same
-typed codec the persistence layer uses (``engine/codec.py``, native-
-accelerated), matching the reference's typed bincode exchange
-(``zero_copy/tcp.rs``) rather than trusting arbitrary object streams.
+``PATHWAY_COMM_SECRET``; ``cli spawn`` generates a fresh one per run), a
+16-byte **resume header** ``(incarnation, last-seq-received)``, then
+16-byte ``(length, sequence)`` headers framing PWT1-typed ``(tag,
+payload)`` bodies — the same typed codec the persistence layer uses
+(``engine/codec.py``, native-accelerated), matching the reference's typed
+bincode exchange rather than trusting arbitrary object streams.
 Unauthenticated or malformed peers are rejected before any frame decode.
-Everything rides localhost/DCN TCP; dense device state never crosses here
-(it lives in HBM and moves over ICI via XLA collectives — see
-``pathway_tpu/parallel/``).
+
+Fault tolerance (see ``docs/fault_tolerance.md``): a transient link
+failure — TCP reset, dropped/corrupted frame — no longer poisons the
+mesh.  Every link keeps a bounded retransmit buffer of unacknowledged
+frames; heartbeat frames piggyback cumulative acks and detect hung peers;
+a failed link reconnects with bounded exponential backoff + jitter (the
+``udfs`` retry schedule — one backoff policy for the whole codebase) and
+resynchronizes from the peer's last received sequence number, so deltas
+are delivered exactly once across the reconnect.  Only when the reconnect
+window is exhausted, or the peer comes back as a **new incarnation**
+(respawned process), is the peer declared dead — the supervisor
+(``engine/supervisor.py``) then restarts the cluster from the last
+committed checkpoint.  Everything rides localhost/DCN TCP; dense device
+state never crosses here (it lives in HBM and moves over ICI via XLA
+collectives — see ``pathway_tpu/parallel/``).
 """
 
 from __future__ import annotations
 
 import hmac as _hmac
+import itertools as _itertools
+import logging
 import os
 import secrets as _secrets
 import socket
@@ -41,12 +56,33 @@ from collections import defaultdict, deque
 from typing import Any, Callable, Hashable
 
 from pathway_tpu.engine import codec as _codec
+from pathway_tpu.engine import faults as _faults
 from pathway_tpu.engine.types import shard_to_worker
 
-_FRAME = struct.Struct(">Q")
+_log = logging.getLogger("pathway_tpu.comm")
+
+_FRAME = struct.Struct(">Q")  # handshake worker id / heartbeat ack body
+_HDR = struct.Struct(">QQ")  # (payload length, sequence); sequence 0 = control
+_RESUME = struct.Struct(">QQ")  # (incarnation, last sequence received from you)
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 CONNECT_TIMEOUT_S = 60.0
+# receive timeout default; per-mesh override via PATHWAY_COMM_RECV_TIMEOUT_S
+# (read at mesh construction, like the frame cap below)
 RECV_TIMEOUT_S = 300.0
 HANDSHAKE_TIMEOUT_S = 10.0
+# liveness + recovery tunables (all per-mesh, env-overridable):
+HEARTBEAT_INTERVAL_S = 2.0  # PATHWAY_COMM_HEARTBEAT_S
+HEARTBEAT_TIMEOUT_S = 30.0  # PATHWAY_COMM_HEARTBEAT_TIMEOUT_S
+RECONNECT_WINDOW_S = 15.0  # PATHWAY_COMM_RECONNECT_WINDOW_S
+SEND_BUFFER_MB = 64  # PATHWAY_COMM_SEND_BUFFER_MB
 # frame-size cap: a corrupt or hostile length field must not OOM the
 # worker.  256 MiB default comfortably covers real epoch batches (tune via
 # PATHWAY_COMM_MAX_FRAME_MB for enormous-epoch deployments).
@@ -111,6 +147,9 @@ def _handshake_accept(sock: socket.socket, secret: bytes) -> int:
 
 
 def _encode_frame(tag: Hashable, payload: Any) -> bytes:
+    """Legacy 8-byte-length framing, kept for the wire-security tests that
+    hand-craft malformed frames; mesh traffic uses ``(length, seq)``
+    headers (``_HDR``) stamped in :meth:`TcpMesh.send`."""
     blob = _codec.encode_row((tag, payload))
     return _FRAME.pack(len(blob)) + blob
 
@@ -125,12 +164,53 @@ def _decode_frame(blob: bytes, typed_only: bool) -> tuple[Hashable, Any]:
     return row[0], row[1]
 
 
+class _Link:
+    """Per-peer duplex link state.
+
+    Locking: ``cv`` guards connection state (sock/gen/ready/dead/
+    relinking/recv_seq/last_seen/peer incarnation) and is the condition
+    senders and reconnect threads wait on; ``send_lock`` serializes socket
+    writes and guards send-side state (send_seq, retransmit buffer).  The
+    two are never held nested.
+    """
+
+    __slots__ = (
+        "peer", "sock", "gen", "ready", "dead", "relinking",
+        "relink_deadline", "cv", "send_lock", "send_seq", "sent_buf",
+        "sent_bytes", "evicted_seq", "unacked_since", "recv_seq",
+        "peer_inc", "last_seen",
+    )
+
+    def __init__(self, peer: int):
+        self.peer = peer
+        self.sock: socket.socket | None = None
+        self.gen = 0  # bumped on every (re)attach; stale readers check it
+        self.ready = False
+        self.dead = False
+        self.relinking = False
+        self.relink_deadline: float | None = None
+        self.cv = threading.Condition()
+        self.send_lock = threading.Lock()
+        self.send_seq = 0
+        self.sent_buf: deque[tuple[int, bytes]] = deque()  # (seq, wire)
+        self.sent_bytes = 0
+        # highest sequence ever evicted unacked from the buffer: a resync
+        # is lossless iff the peer already holds everything up to here
+        self.evicted_seq = 0
+        self.unacked_since: float | None = None
+        self.recv_seq = 0  # highest in-order sequence received
+        self.peer_inc: int | None = None  # peer process incarnation
+        self.last_seen = time.monotonic()
+
+
 class TcpMesh:
     """Full mesh of TCP links between N worker processes.
 
     Worker ``i`` listens on ``first_port + i``; workers with higher ids dial
     workers with lower ids, so every pair has exactly one duplex link.
-    A reader thread per link demultiplexes frames into per-(src, tag) queues.
+    A reader thread per link demultiplexes frames into per-(src, tag)
+    queues.  Links survive transient failures via the retransmit/resync
+    protocol described in the module docstring.
     """
 
     def __init__(
@@ -156,138 +236,527 @@ class TcpMesh:
                 f"{worker_count} workers"
             )
         self.peer_hosts = peer_hosts
-        self._socks: dict[int, socket.socket] = {}
-        self._send_locks: dict[int, threading.Lock] = {}
+        # a fresh random incarnation per mesh instance: after a crash +
+        # respawn the peer's resume header proves it is a NEW process, so
+        # stale pre-crash frames and sequence state must be discarded
+        self.incarnation = int.from_bytes(_secrets.token_bytes(8), "big") or 1
+        self.recv_timeout = _env_float("PATHWAY_COMM_RECV_TIMEOUT_S", RECV_TIMEOUT_S)
+        self.heartbeat_interval = _env_float(
+            "PATHWAY_COMM_HEARTBEAT_S", HEARTBEAT_INTERVAL_S
+        )
+        self.heartbeat_timeout = _env_float(
+            "PATHWAY_COMM_HEARTBEAT_TIMEOUT_S", HEARTBEAT_TIMEOUT_S
+        )
+        self.reconnect_window = _env_float(
+            "PATHWAY_COMM_RECONNECT_WINDOW_S", RECONNECT_WINDOW_S
+        )
+        # the retransmit buffer must hold at least one max-size frame, or
+        # a single legal frame would be evicted the moment it is sent and
+        # any reconnect before its ack would falsely declare the peer dead
+        self.send_buffer_bytes = max(
+            int(_env_float("PATHWAY_COMM_SEND_BUFFER_MB", SEND_BUFFER_MB))
+            << 20,
+            MAX_FRAME_BYTES + _HDR.size,
+        )
+        plan = _faults.active_plan()
+        self._fault_comm = plan is not None and plan.has(
+            "comm_drop", "comm_reset", "comm_corrupt", "comm_delay"
+        )
+        self._links: dict[int, _Link] = {}
         self._inbox: dict[tuple[int, Hashable], deque] = defaultdict(deque)
         self._cv = threading.Condition()
         self._closed = False
         self._threads: list[threading.Thread] = []
         self._listener: socket.socket | None = None
+        self._acceptor: threading.Thread | None = None
+        self._hb_stop = threading.Event()
+        self._acc_lock = threading.Lock()
+        self._accepted: set[int] = set()
+        self._acc_done = threading.Event()
+        self._acc_err: list[BaseException] = []
+
+    def _reconnect_delays(self):
+        """Bounded backoff schedule for link reconnects — the udfs
+        ``ExponentialBackoffRetryStrategy`` (one policy codebase-wide),
+        preceded by one immediate attempt."""
+        from pathway_tpu.internals.udfs.retries import (
+            ExponentialBackoffRetryStrategy,
+        )
+
+        strategy = ExponentialBackoffRetryStrategy(
+            max_retries=12, initial_delay=50, backoff_factor=1.7, jitter_ms=50
+        )
+        return _itertools.chain([0.0], strategy.delays())
 
     # -- setup -----------------------------------------------------------
     def start(self) -> "TcpMesh":
         if self.worker_count <= 1:
             return self
+        try:
+            return self._start()
+        except BaseException:
+            # a failed start must release the listener port and every
+            # half-open link — callers retry with a fresh mesh
+            self.close()
+            raise
+
+    def _start(self) -> "TcpMesh":
         listen_host = "" if self.peer_hosts is not None else self.host
         self._listener = socket.create_server(
             (listen_host, self.first_port + self.worker_id), reuse_port=False
         )
-        self._listener.settimeout(CONNECT_TIMEOUT_S)
+        self._listener.settimeout(1.0)
         accept_from = [w for w in range(self.worker_count) if w > self.worker_id]
         dial_to = [w for w in range(self.worker_count) if w < self.worker_id]
-
-        accepted: dict[int, socket.socket] = {}
-        acc_err: list[BaseException] = []
-
-        acc_lock = threading.Lock()
-        acc_done = threading.Event()
-
-        def handshake_one(sock: socket.socket) -> None:
-            # per-connection thread: a stalled or malicious client burns
-            # only its own HANDSHAKE_TIMEOUT_S, never the accept loop
-            try:
-                sock.settimeout(HANDSHAKE_TIMEOUT_S)
-                peer = _handshake_accept(sock, self.secret)
-                with acc_lock:
-                    if peer not in accept_from or peer in accepted:
-                        raise CommError(f"unexpected peer id {peer}")
-                    sock.settimeout(None)
-                    accepted[peer] = sock
-                    if len(accepted) == len(accept_from):
-                        acc_done.set()
-            except (CommError, OSError, EOFError):
-                try:
-                    sock.close()
-                except OSError:
-                    pass
-
-        def accept_loop():
-            # a connection that fails the handshake (port scanner, stray
-            # client, wrong secret) is dropped and accepting continues;
-            # only listener-socket errors abort the loop
-            try:
-                while not acc_done.is_set():
-                    try:
-                        sock, _addr = self._listener.accept()
-                    except TimeoutError:
-                        break  # start() reports which peers are missing
-                    threading.Thread(
-                        target=handshake_one, args=(sock,), daemon=True
-                    ).start()
-            except BaseException as exc:  # noqa: BLE001 — re-raised by start()
-                acc_err.append(exc)
+        for w in accept_from + dial_to:
+            self._links[w] = _Link(w)
 
         if not accept_from:
-            acc_done.set()
-        acceptor = threading.Thread(target=accept_loop, daemon=True)
-        acceptor.start()
+            self._acc_done.set()
+        self._acceptor = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"pathway:comm-accept-{self.worker_id}",
+        )
+        self._acceptor.start()
 
         for peer in dial_to:
-            peer_host = (
-                self.peer_hosts[peer] if self.peer_hosts is not None else self.host
+            sock = _dial(
+                self._peer_host(peer), self.first_port + peer,
+                self.worker_id, self.secret,
             )
-            self._socks[peer] = _dial(
-                peer_host, self.first_port + peer, self.worker_id, self.secret
-            )
+            self._attach(peer, sock)
 
-        # wait on the completion event, not the thread: the acceptor may
-        # still be blocked in accept() (it lingers as a daemon rejecting
-        # stray connections until close() shuts the listener)
-        done = acc_done.wait(CONNECT_TIMEOUT_S)
-        if acc_err:
-            raise CommError(f"worker {self.worker_id}: accept failed: {acc_err[0]}")
-        if not done or len(accepted) != len(accept_from):
+        done = self._acc_done.wait(CONNECT_TIMEOUT_S)
+        if self._acc_err:
+            raise CommError(
+                f"worker {self.worker_id}: accept failed: {self._acc_err[0]}"
+            )
+        if not done:
+            with self._acc_lock:
+                missing = sorted(set(accept_from) - self._accepted)
             raise CommError(
                 f"worker {self.worker_id}: timed out waiting for peers "
-                f"{sorted(set(accept_from) - set(accepted))}"
+                f"{missing}"
             )
-        self._socks.update(accepted)
-
-        for peer, sock in self._socks.items():
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._send_locks[peer] = threading.Lock()
-            t = threading.Thread(
-                target=self._reader, args=(peer, sock), daemon=True,
-                name=f"pathway:comm-{self.worker_id}<-{peer}",
-            )
-            t.start()
-            self._threads.append(t)
+        hb = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name=f"pathway:comm-hb-{self.worker_id}",
+        )
+        hb.start()
+        self._threads.append(hb)
         return self
 
-    def _reader(self, peer: int, sock: socket.socket) -> None:
+    def _peer_host(self, peer: int) -> str:
+        return self.peer_hosts[peer] if self.peer_hosts is not None else self.host
+
+    def _accept_loop(self) -> None:
+        # runs for the life of the mesh: initial peers handshake here, and
+        # so do RECONNECTING peers after a link failure.  A connection that
+        # fails the handshake (port scanner, stray client, wrong secret) is
+        # dropped and accepting continues; only listener-socket errors end
+        # the loop.
+        while not self._closed:
+            try:
+                sock, _addr = self._listener.accept()
+            except TimeoutError:
+                continue
+            except OSError:
+                return  # listener closed (close()) or broken
+            except BaseException as exc:  # noqa: BLE001 — surfaced by start()
+                self._acc_err.append(exc)
+                return
+            threading.Thread(
+                target=self._handshake_one, args=(sock,), daemon=True
+            ).start()
+
+    def _handshake_one(self, sock: socket.socket) -> None:
+        # per-connection thread: a stalled or malicious client burns only
+        # its own HANDSHAKE_TIMEOUT_S, never the accept loop
         try:
+            sock.settimeout(HANDSHAKE_TIMEOUT_S)
+            peer = _handshake_accept(sock, self.secret)
+            if peer <= self.worker_id or peer not in self._links:
+                raise CommError(f"unexpected peer id {peer}")
+            sock.settimeout(None)
+            self._attach(peer, sock)
+            with self._acc_lock:
+                self._accepted.add(peer)
+                expect = sum(1 for w in self._links if w > self.worker_id)
+                if len(self._accepted) == expect:
+                    self._acc_done.set()
+        except (CommError, OSError, EOFError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _attach(self, peer: int, sock: socket.socket) -> None:
+        """Install (or replace) the socket of a link and start its reader.
+        The reader performs the resume exchange before the link goes ready."""
+        try:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        link = self._links[peer]
+        with link.cv:
+            link.gen += 1
+            gen = link.gen
+            old = link.sock
+            link.sock = sock
+            link.ready = False
+            if old is not None:
+                _close_quietly(old)  # stale reader exits via gen check
+        t = threading.Thread(
+            target=self._reader, args=(peer, link, sock, gen), daemon=True,
+            name=f"pathway:comm-{self.worker_id}<-{peer}",
+        )
+        t.start()
+        # prune finished readers so a flaky network (a new reader per
+        # reconnect) cannot grow this list without bound
+        self._threads = [x for x in self._threads if x.is_alive()]
+        self._threads.append(t)
+
+    # -- per-link reader / resume ---------------------------------------
+    def _reader(self, peer: int, link: _Link, sock: socket.socket, gen: int) -> None:
+        try:
+            self._resume_link(peer, link, sock, gen)
+            sock.settimeout(None)
             while not self._closed:
-                header = _recv_exact(sock, _FRAME.size)
-                (size,) = _FRAME.unpack(header)
+                header = _recv_exact(sock, _HDR.size)
+                size, seq = _HDR.unpack(header)
                 if size > MAX_FRAME_BYTES:
                     raise ValueError(f"comm frame of {size} bytes exceeds cap")
                 blob = _recv_exact(sock, size)
+                # every mutation below re-checks gen under the owning lock:
+                # a superseded reader (its socket replaced by a reconnect)
+                # must not write stale seq/ack/inbox state over the state
+                # the new link's resume just (re)established
+                with link.cv:
+                    if link.gen != gen:
+                        return
+                    link.last_seen = time.monotonic()
+                if seq == 0:
+                    # control frame: heartbeat carrying the peer's
+                    # cumulative ack — retire acknowledged frames
+                    if size >= _FRAME.size:
+                        (ack,) = _FRAME.unpack(blob[: _FRAME.size])
+                        with link.send_lock:
+                            if link.gen == gen:
+                                self._trim_acked(link, ack)
+                    continue
+                with link.cv:
+                    if link.gen != gen:
+                        return
+                    if seq <= link.recv_seq:
+                        continue  # duplicate from a resync retransmit
+                    if seq != link.recv_seq + 1:
+                        # a frame vanished from the stream (injected drop /
+                        # half-written frame before a reset): framing is
+                        # intact but data is missing — force a resync
+                        raise ValueError(
+                            f"sequence gap from worker {peer}: got {seq}, "
+                            f"expected {link.recv_seq + 1}"
+                        )
                 # no shared secret = unauthenticated link: refuse pickled
                 # values so a reachable port is not code execution
                 tag, payload = _decode_frame(blob, typed_only=not self.secret)
-                with self._cv:
-                    self._inbox[(peer, tag)].append(payload)
-                    self._cv.notify_all()
+                with link.cv:
+                    if link.gen != gen:
+                        return
+                    link.recv_seq = seq
+                    # nested cv → _cv is the one lock-nesting order used
+                    # anywhere, so the advance + enqueue stay atomic w.r.t.
+                    # a concurrent purge/resume
+                    with self._cv:
+                        self._inbox[(peer, tag)].append(payload)
+                        self._cv.notify_all()
         except Exception as exc:  # noqa: BLE001
             # socket errors AND decode errors land here: a malformed or
-            # corrupt frame means framing is lost and the link is unusable,
-            # so any failure is treated exactly like a dead peer (the
-            # waiting recv() raises CommError; the process survives).
-            # Decode refusals are logged — "peer disconnected" alone would
-            # hide e.g. the typed-only pickle refusal and its remedy.
+            # corrupt frame means framing is lost and the link is unusable
+            # as-is.  Unlike the pre-recovery design this is no longer
+            # instantly fatal — the link re-handshakes and resynchronizes
+            # from the last acked sequence; only an exhausted reconnect
+            # window (or an unrecoverable resync) declares the peer dead.
             if isinstance(exc, ValueError):
-                import logging
-
-                logging.getLogger("pathway_tpu.comm").error(
-                    "worker %d: dropping link to peer %d: %s",
-                    self.worker_id,
-                    peer,
-                    exc,
+                _log.error(
+                    "worker %d: link to peer %d failed: %s",
+                    self.worker_id, peer, exc,
                 )
-            if not self._closed:
-                with self._cv:
-                    self._inbox[(peer, _PEER_DEAD)].append(None)
-                    self._cv.notify_all()
+            self._on_link_failure(peer, link, sock, gen, exc)
+
+    def _resume_link(
+        self, peer: int, link: _Link, sock: socket.socket, gen: int
+    ) -> None:
+        """Post-handshake resume exchange; sets the link ready on success."""
+        sock.settimeout(HANDSHAKE_TIMEOUT_S)
+        with link.cv:
+            my_ack = link.recv_seq
+        with link.send_lock:
+            sock.sendall(_RESUME.pack(self.incarnation, my_ack))
+        peer_inc, peer_ack = _RESUME.unpack(_recv_exact(sock, _RESUME.size))
+        with link.cv:
+            if link.gen != gen or self._closed:
+                raise OSError("link superseded during resume")
+            # first connect, or the peer is a respawned process: no
+            # cross-incarnation delivery — reset both directions and
+            # purge frames queued from the previous incarnation so a
+            # rejoined worker never consumes pre-crash data
+            new_inc = peer_inc != link.peer_inc
+            if link.dead and not new_inc:
+                # the death purged this peer's inbox, so our recv_seq
+                # over-reports what survived — a same-incarnation resume
+                # would silently skip those frames.  Only a respawned
+                # (new-incarnation) peer may revive a dead link.
+                raise OSError("peer was declared dead; refusing resume")
+            purge = new_inc and link.peer_inc is not None
+            link.peer_inc = peer_inc
+            if new_inc:
+                link.recv_seq = 0
+                link.dead = False
+        if purge:
+            self._purge_inbox(peer, notify=True)
+        resend: list[bytes] = []
+        with link.send_lock:
+            if new_inc:
+                link.send_seq = 0
+                link.sent_buf.clear()
+                link.sent_bytes = 0
+                link.evicted_seq = 0
+                link.unacked_since = None
+            elif peer_ack < link.send_seq and (
+                peer_ack < link.evicted_seq
+                or (link.sent_buf and link.sent_buf[0][0] > peer_ack + 1)
+            ):
+                raise CommError(
+                    f"cannot resync link to worker {peer}: frames past the "
+                    f"{self.send_buffer_bytes >> 20} MiB retransmit buffer "
+                    "were lost (raise PATHWAY_COMM_SEND_BUFFER_MB)"
+                )
+            else:
+                self._trim_acked(link, peer_ack)
+                resend = [wire for _s, wire in link.sent_buf]
+        if not resend:
+            if not self._set_ready(link, gen):
+                raise OSError("link superseded during resume")
+            return
+        # Retransmit OFF the reader thread: the reader must reach its frame
+        # loop and drain the peer's (symmetric) retransmission while this
+        # backlog is written, or two peers with large bidirectional backlogs
+        # deadlock against full kernel socket buffers.  Ordering is safe:
+        # normal senders wait for `ready`, which is set only after this
+        # thread holds send_lock — nothing can interleave ahead of the
+        # backlog.
+        def retransmit() -> None:
+            try:
+                with link.send_lock:
+                    if not self._set_ready(link, gen):
+                        return
+                    for wire in resend:
+                        sock.sendall(wire)
+                _log.info(
+                    "worker %d: link to peer %d resynced, retransmitted "
+                    "%d frame(s)", self.worker_id, peer, len(resend),
+                )
+            except OSError as exc:
+                self._on_link_failure(peer, link, sock, gen, exc)
+
+        threading.Thread(
+            target=retransmit, daemon=True,
+            name=f"pathway:comm-resend-{self.worker_id}-{peer}",
+        ).start()
+
+    def _set_ready(self, link: _Link, gen: int) -> bool:
+        with link.cv:
+            if link.gen != gen or self._closed:
+                return False
+            link.ready = True
+            link.relinking = False
+            link.relink_deadline = None
+            link.last_seen = time.monotonic()
+            link.cv.notify_all()
+            return True
+
+    @staticmethod
+    def _trim_acked(link: _Link, ack: int) -> None:
+        """Retire buffered frames the peer confirmed (call with send_lock)."""
+        trimmed = False
+        while link.sent_buf and link.sent_buf[0][0] <= ack:
+            _seq, wire = link.sent_buf.popleft()
+            link.sent_bytes -= len(wire)
+            trimmed = True
+        if trimmed:
+            link.unacked_since = None if not link.sent_buf else time.monotonic()
+
+    # -- failure handling / reconnect ------------------------------------
+    def _on_link_failure(
+        self,
+        peer: int,
+        link: _Link,
+        sock: socket.socket,
+        gen: int,
+        exc: BaseException,
+    ) -> None:
+        _close_quietly(sock)
+        if isinstance(exc, CommError):
+            with link.cv:
+                if self._closed or link.dead or link.gen != gen:
+                    return  # a superseded reader must not kill the new link
+            # resync refused (retransmit gap / auth): unrecoverable
+            self._mark_dead(peer, link, str(exc))
+            return
+        with link.cv:
+            if self._closed or link.dead or link.gen != gen:
+                return
+            link.ready = False
+            now = time.monotonic()
+            if link.relink_deadline is None:
+                link.relink_deadline = now + self.reconnect_window
+            expired = now > link.relink_deadline
+            if not expired:
+                if link.relinking:
+                    return  # an active reconnect thread owns this link
+                link.relinking = True
+        if expired:
+            self._mark_dead(
+                peer, link,
+                f"reconnect window ({self.reconnect_window:g}s) exhausted: {exc}",
+            )
+            return
+        _log.warning(
+            "worker %d: link to peer %d dropped (%s); reconnecting",
+            self.worker_id, peer, exc,
+        )
+        if peer < self.worker_id:
+            target = self._redial_loop  # we dialed this peer originally
+        else:
+            target = self._await_reaccept  # the peer dials us back
+        threading.Thread(
+            target=target, args=(peer, link), daemon=True,
+            name=f"pathway:comm-relink-{self.worker_id}-{peer}",
+        ).start()
+
+    def _redial_loop(self, peer: int, link: _Link) -> None:
+        with link.cv:
+            deadline = link.relink_deadline or (
+                time.monotonic() + self.reconnect_window
+            )
+        for delay in self._reconnect_delays():
+            if self._closed or link.dead:
+                return
+            if delay:
+                time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+            if time.monotonic() > deadline:
+                break
+            try:
+                sock = _dial(
+                    self._peer_host(peer), self.first_port + peer,
+                    self.worker_id, self.secret,
+                    deadline_s=min(5.0, max(0.5, deadline - time.monotonic())),
+                )
+            except CommError as exc:
+                if getattr(exc, "retryable", False):
+                    continue  # peer unreachable this attempt; keep trying
+                # auth mismatch: peer is alive but holds a different
+                # secret — retrying cannot help
+                self._mark_dead(peer, link, str(exc))
+                return
+            except OSError:
+                continue
+            self._attach(peer, sock)
+            with link.cv:
+                link.cv.wait_for(
+                    lambda: link.ready or link.dead or self._closed,
+                    timeout=HANDSHAKE_TIMEOUT_S + 1.0,
+                )
+                if link.ready or link.dead or self._closed:
+                    return
+            # resume failed; loop for another attempt
+        self._mark_dead(peer, link, "reconnect attempts exhausted")
+
+    def _await_reaccept(self, peer: int, link: _Link) -> None:
+        # listener side of the link: the peer re-dials us; the accept loop
+        # re-attaches and the reader resumes — we just enforce the window
+        with link.cv:
+            deadline = link.relink_deadline or (
+                time.monotonic() + self.reconnect_window
+            )
+            link.cv.wait_for(
+                lambda: link.ready or link.dead or self._closed,
+                timeout=max(0.0, deadline - time.monotonic()),
+            )
+            if link.ready or link.dead or self._closed:
+                return
+        self._mark_dead(peer, link, "peer did not reconnect in time")
+
+    def _mark_dead(self, peer: int, link: _Link, why: str) -> None:
+        with link.cv:
+            if link.dead:
+                return
+            link.dead = True
+            link.ready = False
+            link.relinking = False
+            if link.sock is not None:
+                _close_quietly(link.sock)
+            link.cv.notify_all()
+        _log.error(
+            "worker %d: peer %d declared dead: %s", self.worker_id, peer, why
+        )
+        with self._cv:
+            # stale frames from the dead incarnation must not be consumed
+            # by anyone (least of all a respawned peer's exchange rounds)
+            self._purge_inbox(peer, notify=False)
+            self._inbox[(peer, _PEER_DEAD)].append(None)
+            self._cv.notify_all()
+
+    def _purge_inbox(self, peer: int, *, notify: bool) -> None:
+        def drop() -> None:
+            for key in [k for k in self._inbox if k[0] == peer]:
+                del self._inbox[key]
+
+        if notify:
+            with self._cv:
+                drop()
+                self._cv.notify_all()
+        else:
+            drop()  # caller holds self._cv
+
+    # -- heartbeats -------------------------------------------------------
+    def _heartbeat_loop(self) -> None:
+        """Per-link liveness: send heartbeat+ack frames; force-fail links
+        whose peer went silent or stopped acking (a hung process looks
+        healthy to TCP — only traffic proves liveness)."""
+        while not self._hb_stop.wait(self.heartbeat_interval):
+            if self._closed:
+                return
+            now = time.monotonic()
+            for link in self._links.values():
+                with link.cv:
+                    if not link.ready or link.dead:
+                        continue
+                    sock = link.sock
+                    ack = link.recv_seq
+                    silent = now - link.last_seen > self.heartbeat_timeout
+                with link.send_lock:
+                    stalled = (
+                        link.unacked_since is not None
+                        and now - link.unacked_since > self.heartbeat_timeout
+                    )
+                if silent or stalled:
+                    # reader wakes with an error → reconnect path decides
+                    _log.warning(
+                        "worker %d: peer %d %s for >%gs; cycling link",
+                        self.worker_id, link.peer,
+                        "silent" if silent else "not acking",
+                        self.heartbeat_timeout,
+                    )
+                    _close_quietly(sock)
+                    continue
+                hb = _HDR.pack(_FRAME.size, 0) + _FRAME.pack(ack)
+                with link.send_lock:
+                    try:
+                        sock.sendall(hb)
+                    except OSError:
+                        pass  # the reader sees the same failure
 
     # -- point to point --------------------------------------------------
     def send(self, dest: int, tag: Hashable, payload: Any) -> None:
@@ -298,20 +767,90 @@ class TcpMesh:
                 self._inbox[(dest, tag)].append(payload)
                 self._cv.notify_all()
             return
-        frame = _encode_frame(tag, payload)
-        if len(frame) > MAX_FRAME_BYTES:
+        blob = _codec.encode_row((tag, payload))
+        if len(blob) > MAX_FRAME_BYTES:
             # fail fast on the sender with the actionable message — the
             # receiver would just drop the link as "peer disconnected"
             raise CommError(
-                f"comm frame of {len(frame)} bytes exceeds the "
+                f"comm frame of {len(blob)} bytes exceeds the "
                 f"{MAX_FRAME_BYTES}-byte cap; raise PATHWAY_COMM_MAX_FRAME_MB "
                 "on every worker for enormous-epoch workloads"
             )
-        sock = self._socks[dest]
-        with self._send_locks[dest]:
-            sock.sendall(frame)
+        link = self._links[dest]
+        deadline = time.monotonic() + self.reconnect_window + HANDSHAKE_TIMEOUT_S
+        with link.cv:
+            link.cv.wait_for(
+                lambda: link.ready or link.dead or self._closed,
+                timeout=max(0.0, deadline - time.monotonic()),
+            )
+            if link.dead:
+                raise CommError(
+                    f"worker {self.worker_id}: peer {dest} disconnected "
+                    f"while sending {tag!r}"
+                )
+            if not link.ready:
+                raise CommError(
+                    f"worker {self.worker_id}: link to peer {dest} not "
+                    f"ready within {self.reconnect_window:g}s"
+                )
+        drop = corrupt = reset = None
+        if self._fault_comm:
+            spec = _faults.check("comm_delay", worker=self.worker_id, peer=dest)
+            if spec is not None:
+                time.sleep(spec.delay_ms / 1000.0)
+            drop = _faults.check("comm_drop", worker=self.worker_id, peer=dest)
+            if drop is None:
+                corrupt = _faults.check(
+                    "comm_corrupt", worker=self.worker_id, peer=dest
+                )
+                if corrupt is None:
+                    reset = _faults.check(
+                        "comm_reset", worker=self.worker_id, peer=dest
+                    )
+        with link.send_lock:
+            link.send_seq += 1
+            wire = _HDR.pack(len(blob), link.send_seq) + blob
+            link.sent_buf.append((link.send_seq, wire))
+            if not link.unacked_since:
+                link.unacked_since = time.monotonic()
+            link.sent_bytes += len(wire)
+            while link.sent_bytes > self.send_buffer_bytes and link.sent_buf:
+                evicted, old = link.sent_buf.popleft()
+                link.sent_bytes -= len(old)
+                # resync below this seq is now impossible; if the link
+                # drops before the peer acks past it, the peer is dead
+                link.evicted_seq = max(link.evicted_seq, evicted)
+                _log.warning(
+                    "worker %d: retransmit buffer to peer %d overflowed; "
+                    "evicted unacked frame %d (raise "
+                    "PATHWAY_COMM_SEND_BUFFER_MB to keep reconnects "
+                    "lossless)",
+                    self.worker_id, dest, evicted,
+                )
+            out: bytes | None = wire
+            if drop is not None:
+                out = None  # the frame vanishes, as if eaten by a reset
+            elif corrupt is not None:
+                # bit-flip the payload on the wire only — the retransmit
+                # buffer keeps the pristine frame for the resync
+                out = wire[: _HDR.size] + bytes(b ^ 0xFF for b in blob)
+            sock = link.sock
+            if out is not None and sock is not None:
+                try:
+                    sock.sendall(out)
+                except OSError:
+                    # the link just failed under us: the frame is in the
+                    # retransmit buffer; the reader drives the reconnect
+                    # and the resync re-delivers it
+                    pass
+            if (drop is not None or reset is not None) and sock is not None:
+                _close_quietly(sock)  # injected TCP reset
 
-    def recv(self, src: int, tag: Hashable, timeout: float = RECV_TIMEOUT_S) -> Any:
+    def recv(
+        self, src: int, tag: Hashable, timeout: float | None = None
+    ) -> Any:
+        if timeout is None:
+            timeout = self.recv_timeout
         deadline = time.monotonic() + timeout
         with self._cv:
             while True:
@@ -329,7 +868,8 @@ class TcpMesh:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise CommError(
-                        f"worker {self.worker_id}: timeout waiting for "
+                        f"worker {self.worker_id}: timeout after {timeout:g}s "
+                        f"(PATHWAY_COMM_RECV_TIMEOUT_S) waiting for "
                         f"{tag!r} from worker {src}"
                     )
                 self._cv.wait(min(remaining, 1.0))
@@ -373,23 +913,45 @@ class TcpMesh:
 
     def close(self) -> None:
         self._closed = True
-        for sock in self._socks.values():
-            try:
-                sock.shutdown(socket.SHUT_RDWR)
-            except OSError:
-                pass
-            try:
-                sock.close()
-            except OSError:
-                pass
+        self._hb_stop.set()
+        for link in self._links.values():
+            with link.cv:
+                if link.sock is not None:
+                    try:
+                        link.sock.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    _close_quietly(link.sock)
+                link.cv.notify_all()
         if self._listener is not None:
+            try:
+                # wake an accept() blocked in the acceptor thread so the
+                # port is actually released, not merely marked for close
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._listener.close()
             except OSError:
                 pass
+        acceptor = self._acceptor
+        if acceptor is not None and acceptor is not threading.current_thread():
+            acceptor.join(3.0)
+        with self._cv:
+            # per-peer inbox state dies with the mesh: a later mesh (or a
+            # respawned worker joining one) must never see pre-close frames
+            self._inbox.clear()
+            self._cv.notify_all()
 
 
 _PEER_DEAD = ("__peer_dead__",)
+
+
+def _close_quietly(sock: socket.socket) -> None:
+    try:
+        sock.close()
+    except OSError:
+        pass
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -404,8 +966,14 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def _dial(host: str, port: int, my_id: int, secret: bytes) -> socket.socket:
-    deadline = time.monotonic() + CONNECT_TIMEOUT_S
+def _dial(
+    host: str,
+    port: int,
+    my_id: int,
+    secret: bytes,
+    deadline_s: float = CONNECT_TIMEOUT_S,
+) -> socket.socket:
+    deadline = time.monotonic() + deadline_s
     last: Exception | None = None
     while time.monotonic() < deadline:
         try:
@@ -430,7 +998,9 @@ def _dial(host: str, port: int, my_id: int, secret: bytes) -> socket.socket:
             sock.close()
             last = exc
             time.sleep(0.1)
-    raise CommError(f"could not reach worker at {host}:{port}: {last}")
+    err = CommError(f"could not reach worker at {host}:{port}: {last}")
+    err.retryable = True  # unreachable ≠ unauthorized: reconnects may retry
+    raise err
 
 
 class WorkerContext:

@@ -2247,6 +2247,9 @@ class Scope:
         # single-process.  Only ever set on the root scope — nested scopes
         # (iterate bodies) always run locally.
         self.worker = None
+        # processed-epoch counter: the index fault plans' `crash` specs
+        # target (engine/faults.py) — counts run_epoch calls, root scope only
+        self.epochs_run = 0
 
     def _register(self, node: Node) -> int:
         self.nodes.append(node)
@@ -2267,6 +2270,19 @@ class Scope:
         """
         self.current_time = time
         worker = self.worker
+        if self.parent is None:
+            # epoch-boundary crash injection (chaos tests / soak runs):
+            # SIGKILLs the process here when the active fault plan says so —
+            # the boundary is where the supervisor's recovery guarantee
+            # (resume from the last committed checkpoint) must hold
+            from pathway_tpu.engine import faults as _faults
+
+            if _faults.active_plan() is not None:
+                _faults.maybe_crash(
+                    worker=worker.worker_id if worker is not None else 0,
+                    epoch=self.epochs_run,
+                )
+            self.epochs_run += 1
         for node in self.nodes:
             try:
                 if worker is not None:

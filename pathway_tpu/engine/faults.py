@@ -1,0 +1,320 @@
+"""Deterministic fault injection for the engine and runtime layers.
+
+The reference framework earns its consistency story with a Rust engine
+that is exercised by chaos-style integration tests (the wordcount
+recovery harness SIGKILLs pipeline processes mid-run).  This module is
+the equivalent lever for this engine: a seeded, declarative **fault
+plan** that the comm mesh, the persistence backends, the connector read
+loop, and the epoch loop all consult, so failure paths are exercised
+deterministically from unit tests — and from soak runs via the
+``PATHWAY_FAULT_PLAN`` environment variable.
+
+Plan format (JSON, also accepted as a Python list of dicts)::
+
+    {"seed": 7, "faults": [
+        {"kind": "comm_drop",    "worker": 0, "peer": 1, "nth": 2},
+        {"kind": "comm_reset",   "worker": 1, "nth": 5},
+        {"kind": "comm_corrupt", "worker": 0, "peer": 1, "nth": 1},
+        {"kind": "comm_delay",   "worker": 0, "delay_ms": 50, "prob": 0.2},
+        {"kind": "crash",        "worker": 1, "at_epoch": 3, "attempt": 0},
+        {"kind": "blob_put",     "nth": 2, "key": "metadata"},
+        {"kind": "blob_get",     "prob": 0.1, "max_times": 3},
+        {"kind": "connector_read", "source": "CsvReader", "nth": 4}
+    ]}
+
+Matching rules:
+
+* ``worker``/``peer``/``attempt`` match exactly when present (``attempt``
+  is the supervisor restart attempt, ``PATHWAY_RESTART_ATTEMPT``; a spec
+  without it fires on any attempt).
+* ``key``/``source`` are substring filters on the blob key / reader name.
+* ``nth`` fires exactly once, on the Nth **matching** event (1-based).
+* ``prob`` fires with the given probability per matching event, from a
+  per-spec seeded RNG (same seed → same firing pattern), bounded by
+  ``max_times`` (default unbounded).
+* ``at_epoch`` (crash only) matches the 0-based processed-epoch index.
+
+Fault kinds and their injection sites:
+
+========== =============================================================
+comm_drop    ``TcpMesh.send``: the frame is NOT written and the link is
+             severed — simulates a frame lost to a TCP reset.  The
+             retransmit buffer + reconnect resync must re-deliver it.
+comm_reset   ``TcpMesh.send``: the frame IS written, then the link is
+             severed — resync must not re-deliver it twice (seq dedup).
+comm_corrupt ``TcpMesh.send``: a bit-flipped copy goes on the wire; the
+             receiver's decode failure must drop the link, and resync
+             must re-deliver the pristine frame from the send buffer.
+comm_delay   ``TcpMesh.send``: sleep ``delay_ms`` before the write.
+crash        ``Scope.run_epoch``: SIGKILL the current process at the
+             chosen epoch boundary (a hard worker death, not an
+             exception — nothing gets to flush).
+blob_put /   ``FlakyBackend``: the wrapped ``BlobBackend`` call raises
+blob_get /   ``InjectedFault`` instead of performing the I/O.
+blob_delete
+connector_read  The reader supervision loop (``io/_utils.py``): the Nth
+             emitted item raises before it is enqueued, exercising the
+             consecutive-error budget + restart/reseek path.
+========== =============================================================
+"""
+
+from __future__ import annotations
+
+import json as _json
+import os
+import random
+import signal
+import threading
+from typing import Any
+
+from pathway_tpu.engine.persistence import BlobBackend
+
+ENV_PLAN = "PATHWAY_FAULT_PLAN"
+ENV_ATTEMPT = "PATHWAY_RESTART_ATTEMPT"
+
+_COMM_KINDS = ("comm_drop", "comm_reset", "comm_corrupt", "comm_delay")
+_BLOB_KINDS = ("blob_put", "blob_get", "blob_delete")
+KINDS = _COMM_KINDS + _BLOB_KINDS + ("crash", "connector_read")
+
+
+class InjectedFault(IOError):
+    """An error raised by the fault plan, never by real infrastructure."""
+
+
+def restart_attempt() -> int:
+    """Supervisor restart attempt of this process (0 = first launch)."""
+    try:
+        return int(os.environ.get(ENV_ATTEMPT, "0") or "0")
+    except ValueError:
+        return 0
+
+
+class FaultSpec:
+    """One declarative fault; counts its own matches and firings."""
+
+    __slots__ = (
+        "kind", "worker", "peer", "nth", "prob", "delay_ms", "at_epoch",
+        "key", "source", "attempt", "max_times", "seen", "fired", "_rng",
+    )
+
+    def __init__(self, spec: dict[str, Any], *, seed: int, index: int):
+        kind = spec.get("kind")
+        if kind not in KINDS:
+            raise ValueError(
+                f"fault plan: unknown kind {kind!r} (valid: {', '.join(KINDS)})"
+            )
+        self.kind = kind
+        self.worker = spec.get("worker")
+        self.peer = spec.get("peer")
+        self.nth = spec.get("nth")
+        self.prob = spec.get("prob")
+        self.delay_ms = float(spec.get("delay_ms", 0.0))
+        self.at_epoch = spec.get("at_epoch")
+        self.key = spec.get("key")
+        self.source = spec.get("source")
+        self.attempt = spec.get("attempt")
+        self.max_times = spec.get("max_times")
+        if self.nth is None and self.prob is None and self.at_epoch is None:
+            self.nth = 1  # a bare spec fires once, on the first match
+        self.seen = 0
+        self.fired = 0
+        # per-spec RNG: the firing pattern of a prob-spec depends only on
+        # (plan seed, spec position), never on interleaving with other specs
+        self._rng = random.Random(f"{seed}:{index}")
+
+    def _matches(self, ctx: dict[str, Any]) -> bool:
+        if self.worker is not None and ctx.get("worker") != self.worker:
+            return False
+        if self.peer is not None and ctx.get("peer") != self.peer:
+            return False
+        if self.attempt is not None and restart_attempt() != self.attempt:
+            return False
+        if self.key is not None and self.key not in str(ctx.get("key", "")):
+            return False
+        if self.source is not None and self.source not in str(
+            ctx.get("source", "")
+        ):
+            return False
+        if self.at_epoch is not None and ctx.get("epoch") != self.at_epoch:
+            return False
+        return True
+
+    def consider(self, ctx: dict[str, Any]) -> bool:
+        """Record one matching event; True if the fault fires on it."""
+        if not self._matches(ctx):
+            return False
+        self.seen += 1
+        if self.max_times is not None and self.fired >= self.max_times:
+            return False
+        if self.nth is not None:
+            fire = self.seen == self.nth
+        elif self.prob is not None:
+            fire = self._rng.random() < self.prob
+        else:  # at_epoch-only spec (crash): the match IS the trigger
+            fire = self.fired == 0
+        if fire:
+            self.fired += 1
+        return fire
+
+    def describe(self) -> str:
+        parts = [self.kind]
+        for name in ("worker", "peer", "nth", "prob", "at_epoch", "key", "source"):
+            v = getattr(self, name)
+            if v is not None:
+                parts.append(f"{name}={v}")
+        return " ".join(parts)
+
+
+class FaultPlan:
+    """A seeded set of :class:`FaultSpec`; thread-safe, deterministic."""
+
+    def __init__(self, faults: list[dict[str, Any]], *, seed: int = 0):
+        self.seed = seed
+        self.specs = [
+            FaultSpec(s, seed=seed, index=i) for i, s in enumerate(faults)
+        ]
+        self._kinds = {s.kind for s in self.specs}
+        self._lock = threading.Lock()
+        self.log: list[str] = []  # fired faults, for test assertions
+
+    @classmethod
+    def from_json(cls, raw: str) -> "FaultPlan":
+        obj = _json.loads(raw)
+        if isinstance(obj, list):
+            return cls(obj)
+        return cls(obj.get("faults", []), seed=int(obj.get("seed", 0)))
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan | None":
+        raw = os.environ.get(ENV_PLAN)
+        if not raw:
+            return None
+        return cls.from_json(raw)
+
+    def has(self, *kinds: str) -> bool:
+        return any(k in self._kinds for k in kinds)
+
+    def check(self, kind: str, **ctx: Any) -> FaultSpec | None:
+        """The firing spec for this event, or None.  Exactly one spec fires
+        per event (the first declared match), so plans stay readable."""
+        if kind not in self._kinds:
+            return None
+        with self._lock:
+            for spec in self.specs:
+                if spec.kind == kind and spec.consider(ctx):
+                    self.log.append(
+                        f"{spec.describe()} @ "
+                        + ",".join(f"{k}={v}" for k, v in sorted(ctx.items()))
+                    )
+                    return spec
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Process-wide active plan
+# ---------------------------------------------------------------------------
+
+_active: FaultPlan | None = None
+_env_loaded = False
+_load_lock = threading.Lock()
+
+
+def install_plan(plan: FaultPlan | None) -> None:
+    """Set (or clear, with None) the process-wide plan — test entry point."""
+    global _active, _env_loaded
+    with _load_lock:
+        _active = plan
+        _env_loaded = True  # an explicit install wins over the env
+
+
+def clear_plan() -> None:
+    """Forget any installed/env plan; the env is re-read on next access."""
+    global _active, _env_loaded
+    with _load_lock:
+        _active = None
+        _env_loaded = False
+
+
+def active_plan() -> FaultPlan | None:
+    """The installed plan, else one parsed from PATHWAY_FAULT_PLAN (cached).
+
+    Counters live on the plan object, so every injection site shares one
+    instance per process — "the 3rd put" means the 3rd put anywhere.
+    """
+    global _active, _env_loaded
+    if _env_loaded:
+        return _active
+    with _load_lock:
+        if not _env_loaded:
+            _active = FaultPlan.from_env()
+            _env_loaded = True
+    return _active
+
+
+def check(kind: str, **ctx: Any) -> FaultSpec | None:
+    plan = active_plan()
+    if plan is None:
+        return None
+    return plan.check(kind, **ctx)
+
+
+def maybe_crash(*, worker: int, epoch: int) -> None:
+    """Epoch-boundary crash injection: SIGKILL this process — a hard worker
+    death (no flush, no atexit), exactly what the supervisor must survive."""
+    plan = active_plan()
+    if plan is None or not plan.has("crash"):
+        return
+    if plan.check("crash", worker=worker, epoch=epoch) is not None:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+# ---------------------------------------------------------------------------
+# Flaky blob backend
+# ---------------------------------------------------------------------------
+
+
+class FlakyBackend(BlobBackend):
+    """A ``BlobBackend`` wrapper that fails calls per the fault plan.
+
+    With no explicit ``plan`` the process-wide active plan is consulted at
+    call time, so env-driven soak runs inject persistence faults without
+    any code change (``wrap_backend`` below is applied by the runner).
+    """
+
+    def __init__(self, inner: BlobBackend, plan: FaultPlan | None = None):
+        self.inner = inner
+        self.plan = plan
+
+    def _gate(self, kind: str, key: str) -> None:
+        plan = self.plan if self.plan is not None else active_plan()
+        if plan is None:
+            return
+        if plan.check(kind, key=key) is not None:
+            raise InjectedFault(f"injected {kind} failure for key {key!r}")
+
+    def put(self, key: str, data: bytes) -> None:
+        self._gate("blob_put", key)
+        self.inner.put(key, data)
+
+    def put_atomic(self, key: str, data: bytes) -> None:
+        self._gate("blob_put", key)
+        self.inner.put_atomic(key, data)
+
+    def get(self, key: str) -> bytes | None:
+        self._gate("blob_get", key)
+        return self.inner.get(key)
+
+    def list_keys(self, prefix: str) -> list[str]:
+        return self.inner.list_keys(prefix)
+
+    def delete(self, key: str) -> None:
+        self._gate("blob_delete", key)
+        self.inner.delete(key)
+
+
+def wrap_backend(backend: BlobBackend) -> BlobBackend:
+    """Wrap with FlakyBackend iff the active plan injects blob faults."""
+    plan = active_plan()
+    if plan is not None and plan.has(*_BLOB_KINDS):
+        return FlakyBackend(backend, plan)
+    return backend

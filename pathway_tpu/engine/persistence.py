@@ -102,6 +102,24 @@ class BlobBackend:
         self.put(key, data)
 
 
+def _fsync_dir(path: str) -> None:
+    """Flush a directory's entries (new files, renames) to stable storage.
+
+    Best-effort on filesystems that refuse O_DIRECTORY fsync (some network
+    mounts): the entry write is then only as durable as the mount allows.
+    """
+    try:
+        fd = os.open(path, os.O_RDONLY | getattr(os, "O_DIRECTORY", 0))
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 class FileBackend(BlobBackend):
     def __init__(self, root: str):
         self.root = root
@@ -111,16 +129,27 @@ class FileBackend(BlobBackend):
         return os.path.join(self.root, *key.split("/"))
 
     def put(self, key: str, data: bytes) -> None:
-        # fsync: the metadata commit (put_atomic) durably references chunks,
-        # so chunks themselves must be durable first
+        # Durability contract: after put() returns, the chunk survives a
+        # power-cut — the metadata commit (put_atomic) durably references
+        # chunks, so chunks themselves must be durable first.  fsyncing the
+        # FILE makes its bytes durable, but a newly-created directory ENTRY
+        # lives in the parent directory: without fsyncing the dirfd a crash
+        # can persist the metadata yet lose the chunk it points at.
         path = self._path(key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path, "wb") as f:
             f.write(data)
             f.flush()
             os.fsync(f.fileno())
+        _fsync_dir(os.path.dirname(path))
 
     def put_atomic(self, key: str, data: bytes) -> None:
+        # Durability contract: the rename is the commit point — after
+        # put_atomic() returns, a crash yields either the OLD or the NEW
+        # content, never a torn file and never a lost rename.  The rename
+        # itself is a parent-directory mutation, so the dirfd fsync below
+        # is what makes the commit durable (fsyncing the file alone leaves
+        # the rename in the page cache).
         path = self._path(key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
@@ -129,6 +158,7 @@ class FileBackend(BlobBackend):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(path))
 
     def get(self, key: str) -> bytes | None:
         path = self._path(key)

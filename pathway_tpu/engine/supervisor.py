@@ -1,0 +1,216 @@
+"""Supervised crash recovery for the multi-worker runtime.
+
+The reference framework's ancestor survives worker death because its
+persistence layer can always rewind a worker group to the last committed
+snapshot frontier (``src/persistence/tracker.rs``).  This module is the
+process-level half of that story for this engine: a **supervisor** that
+watches the N SPMD worker processes of a cluster and, on a confirmed
+worker death, rolls the whole group back to the last committed
+checkpoint and replays.
+
+Why whole-group restart (and not patching one worker back in)?  The
+epoch loop is BSP: every worker walks the identical DAG in lockstep and
+the collectives pair up positionally.  When a worker dies mid-epoch, the
+survivors hold in-memory operator state for epochs the dead worker never
+committed — state a respawned worker cannot reproduce.  The only
+consistent rollback point every worker agrees on is the last committed
+checkpoint (``engine/persistence.py`` commits are per-worker atomic
+metadata writes gated on processed epochs).  So the supervisor:
+
+1. detects the death (nonzero or signal exit);
+2. terminates the surviving workers (their un-committed progress is
+   exactly what must be rolled back — killing them IS the rollback);
+3. respawns all N workers with the same run id, ports, comm secret and
+   persistence root, after a backoff (the shared ``udfs`` retry
+   schedule).  Each worker resumes from its own committed snapshot
+   shard: committed events replay into the input sessions, readers seek
+   to the stored offset frontier, and the mesh re-forms.
+
+Sinks re-open their output files on restart, so the recovered run's
+final output is identical to an unfaulted run's — the property the
+kill-and-restart test in ``tests/test_supervised_recovery.py`` pins.
+
+Restart attempts are announced to workers via ``PATHWAY_RESTART_ATTEMPT``
+(the fault plan's ``attempt`` filter keys off it, so chaos tests can
+inject a crash on attempt 0 and let attempt 1 run clean).
+
+Worker handles are duck-typed: ``multiprocessing.Process`` (tests,
+in-repo harnesses) and ``subprocess.Popen`` (``pathway spawn
+--supervise``) both work.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Sequence
+
+_log = logging.getLogger("pathway_tpu.supervisor")
+
+# one constant for the restart-attempt protocol: the fault plan's
+# `attempt` filter and the jax coordinator-port offset read the same var
+from pathway_tpu.engine.faults import ENV_ATTEMPT  # noqa: E402,F401
+
+
+class SupervisorError(RuntimeError):
+    """The cluster kept failing past the restart budget."""
+
+
+class SupervisorResult:
+    __slots__ = ("attempts", "restarts", "exit_codes", "history")
+
+    def __init__(
+        self,
+        attempts: int,
+        restarts: int,
+        exit_codes: list[int],
+        history: list[list[int | None]],
+    ):
+        self.attempts = attempts  # launches performed (>= 1)
+        self.restarts = restarts  # recoveries performed (attempts - 1)
+        self.exit_codes = exit_codes  # final attempt's per-worker codes
+        # per-attempt worker exit codes at teardown time (negative =
+        # signal, e.g. -9 for the SIGKILL that triggered the recovery)
+        self.history = history
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"SupervisorResult(attempts={self.attempts}, "
+            f"restarts={self.restarts}, exit_codes={self.exit_codes})"
+        )
+
+
+# -- handle duck-typing (multiprocessing.Process | subprocess.Popen) -------
+
+
+def _exitcode(handle: Any) -> int | None:
+    if hasattr(handle, "exitcode"):  # multiprocessing.Process
+        return handle.exitcode
+    return handle.poll()  # subprocess.Popen
+
+
+def _alive(handle: Any) -> bool:
+    return _exitcode(handle) is None
+
+
+def _join(handle: Any, timeout: float) -> None:
+    if hasattr(handle, "join"):
+        handle.join(timeout)
+        return
+    try:
+        handle.wait(timeout)
+    except Exception:  # subprocess.TimeoutExpired
+        pass
+
+
+def _signal(handle: Any, *, hard: bool) -> None:
+    try:
+        if hard:
+            handle.kill()
+        else:
+            handle.terminate()
+    except (OSError, ValueError):
+        pass  # already gone
+
+
+class Supervisor:
+    """Run one SPMD worker group to completion, restarting it on failure.
+
+    ``spawn(worker_id, attempt)`` must start worker ``worker_id`` of the
+    group and return its handle; it is responsible for wiring the cluster
+    env (``PATHWAY_PROCESSES``/``PROCESS_ID``/``FIRST_PORT``/…) and for
+    exporting ``PATHWAY_RESTART_ATTEMPT=attempt`` into the worker.
+    """
+
+    def __init__(
+        self,
+        spawn: Callable[[int, int], Any],
+        n_workers: int,
+        *,
+        max_restarts: int = 3,
+        grace_s: float = 5.0,
+        poll_interval_s: float = 0.05,
+    ):
+        self.spawn = spawn
+        self.n_workers = n_workers
+        self.max_restarts = max_restarts
+        self.grace_s = grace_s
+        self.poll_interval_s = poll_interval_s
+
+    def _backoff_delays(self):
+        # the udfs backoff schedule — the same policy the comm mesh uses
+        # for link reconnects, applied between cluster restart attempts
+        from pathway_tpu.internals.udfs.retries import (
+            ExponentialBackoffRetryStrategy,
+        )
+
+        return ExponentialBackoffRetryStrategy(
+            max_retries=max(self.max_restarts, 1),
+            initial_delay=200,
+            backoff_factor=2,
+            jitter_ms=100,
+        ).delays()
+
+    def run(self) -> SupervisorResult:
+        delays = self._backoff_delays()
+        history: list[list[int | None]] = []
+        attempt = 0
+        handles: list[Any] = []
+        try:
+            while True:
+                handles = []
+                for w in range(self.n_workers):
+                    handles.append(self.spawn(w, attempt))
+                first_failed = self._watch(handles)
+                if first_failed is None:
+                    codes = [_exitcode(h) for h in handles]
+                    history.append(codes)
+                    return SupervisorResult(attempt + 1, attempt, codes, history)  # type: ignore[arg-type]
+                _log.warning(
+                    "worker %d died (exit %s) on attempt %d; rolling the "
+                    "group back to the last committed checkpoint",
+                    first_failed, _exitcode(handles[first_failed]), attempt,
+                )
+                self._stop_all(handles)
+                history.append([_exitcode(h) for h in handles])
+                if attempt >= self.max_restarts:
+                    raise SupervisorError(
+                        f"cluster failed {attempt + 1} time(s) "
+                        f"(restart budget {self.max_restarts}); last exit "
+                        f"codes {history[-1]}"
+                    )
+                time.sleep(next(delays))
+                attempt += 1
+        finally:
+            # any escape — Ctrl-C in _watch, a spawn() failure partway
+            # through launching the group — must not orphan live workers
+            # (they would wait on mesh peers forever); redundant stops of
+            # already-exited workers are no-ops
+            self._stop_all(handles)
+
+    def _watch(self, handles: Sequence[Any]) -> int | None:
+        """Block until all workers exit 0 (None) or one fails (its id)."""
+        while True:
+            all_done = True
+            for wid, handle in enumerate(handles):
+                code = _exitcode(handle)
+                if code is None:
+                    all_done = False
+                elif code != 0:
+                    return wid
+            if all_done:
+                return None
+            time.sleep(self.poll_interval_s)
+
+    def _stop_all(self, handles: Sequence[Any]) -> None:
+        """Terminate survivors: their uncommitted progress IS the rollback."""
+        for handle in handles:
+            if _alive(handle):
+                _signal(handle, hard=False)
+        deadline = time.monotonic() + self.grace_s
+        for handle in handles:
+            _join(handle, max(0.1, deadline - time.monotonic()))
+        for handle in handles:
+            if _alive(handle):
+                _signal(handle, hard=True)
+                _join(handle, 2.0)

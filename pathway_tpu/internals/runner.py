@@ -184,13 +184,17 @@ def run(
     http_server = None
     try:
         if storage is not None:
+            from pathway_tpu.engine import faults as _faults
             from pathway_tpu.engine import persistence as pz
 
-            if isinstance(storage.backend, pz.FileBackend):
+            base_backend = storage.backend
+            if isinstance(base_backend, _faults.FlakyBackend):
+                base_backend = base_backend.inner  # fault wrapper is I/O-only
+            if isinstance(base_backend, pz.FileBackend):
                 # UDF DiskCache shares the persistence root for this run
                 # only; acquired inside the try so any failure below still
                 # releases it in the finally
-                root_token = pz.acquire_active_root(storage.backend.root)
+                root_token = pz.acquire_active_root(base_backend.root)
 
         from pathway_tpu.engine.probes import Prober
         from pathway_tpu.internals.config import get_config
@@ -296,7 +300,7 @@ def _make_storage(persistence_config: Any):
         from pathway_tpu.engine import persistence as pz
 
         storage = pz.PersistentStorage(
-            pz.FileBackend(cfg.replay_storage),
+            _flaky_wrap(pz.FileBackend(cfg.replay_storage)),
             snapshot_interval_ms=0,
             worker=cfg.process_id,
         )
@@ -308,7 +312,7 @@ def _make_storage(persistence_config: Any):
         return None
     from pathway_tpu.engine import persistence as pz
 
-    backend = pz.backend_from_config(backend_cfg)
+    backend = _flaky_wrap(pz.backend_from_config(backend_cfg))
     storage = pz.PersistentStorage(
         backend,
         snapshot_interval_ms=getattr(persistence_config, "snapshot_interval_ms", 0),
@@ -325,6 +329,15 @@ def _make_storage(persistence_config: Any):
         persistence_config, "continue_after_replay", True
     )
     return storage
+
+
+def _flaky_wrap(backend: Any) -> Any:
+    """Blob-level fault injection (PATHWAY_FAULT_PLAN blob_* specs):
+    chaos/soak runs exercise checkpoint commit failure paths with no code
+    change — a no-op wrapper selection when no plan is active."""
+    from pathway_tpu.engine import faults as _faults
+
+    return _faults.wrap_backend(backend)
 
 
 def _normalize_access(access: Any) -> str | None:

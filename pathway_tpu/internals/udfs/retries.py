@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import asyncio
 import random
-from typing import Any, Callable
+from typing import Any, Callable, Iterator
 
 
 class AsyncRetryStrategy:
@@ -30,8 +30,20 @@ class ExponentialBackoffRetryStrategy(AsyncRetryStrategy):
         self.backoff_factor = backoff_factor
         self.jitter = jitter_ms / 1_000
 
-    async def invoke(self, fun: Callable, /, *args, **kwargs) -> Any:
+    def delays(self) -> "Iterator[float]":
+        """The backoff schedule in seconds, one entry per retry (jittered).
+
+        Shared by the async ``invoke`` below and by synchronous retriers
+        (the comm mesh's link-reconnect loop, ``engine/comm.py``) so the
+        whole codebase has exactly one backoff policy implementation.
+        """
         delay = self.initial_delay
+        for _ in range(self.max_retries):
+            yield delay + random.random() * self.jitter
+            delay *= self.backoff_factor
+
+    async def invoke(self, fun: Callable, /, *args, **kwargs) -> Any:
+        schedule = self.delays()
         for attempt in range(self.max_retries + 1):
             try:
                 return await fun(*args, **kwargs)
@@ -40,8 +52,7 @@ class ExponentialBackoffRetryStrategy(AsyncRetryStrategy):
             except Exception:
                 if attempt == self.max_retries:
                     raise
-                await asyncio.sleep(delay + random.random() * self.jitter)
-                delay *= self.backoff_factor
+                await asyncio.sleep(next(schedule))
         raise RuntimeError("unreachable")
 
 

@@ -578,11 +578,28 @@ def make_input_table(
             resets the count, like the reference's per-read() reset."""
             import logging
 
+            from pathway_tpu.engine import faults as _faults
+
             log = logging.getLogger("pathway_tpu.io")
+            # connector-read fault injection (PATHWAY_FAULT_PLAN): the Nth
+            # emitted item raises before enqueue, exercising this very
+            # supervision loop's budget + restart/reseek path
+            emit_fn = tracker
+            fault_plan = _faults.active_plan()
+            if fault_plan is not None and fault_plan.has("connector_read"):
+                source_name = type(reader).__name__
+
+                def emit_fn(item, _tracker=tracker):
+                    if fault_plan.check("connector_read", source=source_name):
+                        raise _faults.InjectedFault(
+                            f"injected connector_read failure in {source_name}"
+                        )
+                    _tracker(item)
+
             consecutive = 0
             while True:
                 try:
-                    reader.run(tracker)
+                    reader.run(emit_fn)
                     return True
                 except Exception as exc:
                     if tracker.progressed:

@@ -53,7 +53,16 @@ def initialize_distributed(
         coordinator_address = os.environ.get("PATHWAY_DEVICE_COORDINATOR")
     if coordinator_address is None:
         host = (cfg.peer_hosts[0] if cfg.peer_hosts else "127.0.0.1")
-        coordinator_address = f"{host}:{cfg.first_port + 1000}"
+        # supervised restarts (engine/supervisor.py) offset the derived
+        # coordinator port by the restart attempt: the previous attempt's
+        # coordinator may linger in FIN_WAIT/teardown for seconds after
+        # SIGKILL, and jax.distributed.initialize fails hard on a port that
+        # is merely slow to free — a fresh port per attempt sidesteps it
+        from pathway_tpu.engine.faults import restart_attempt
+
+        coordinator_address = (
+            f"{host}:{cfg.first_port + 1000 + restart_attempt()}"
+        )
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=nproc,

@@ -1,0 +1,428 @@
+"""Deterministic fault injection: plan semantics, flaky blob backends,
+connector read faults, and transient comm faults absorbed by resync.
+
+Extends the persistence test patterns (``tests/test_persistence.py``) with
+the chaos layer of ``engine/faults.py``: every test here is seeded and
+deterministic — the same plan always fires the same faults — so the
+failure paths run in tier-1 on every PR, not only in soak runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.engine import faults
+from pathway_tpu.engine import persistence as pz
+from pathway_tpu.engine.comm import CommError, TcpMesh
+from pathway_tpu.engine.dataflow import EngineError
+from pathway_tpu.io._utils import COMMIT, Reader, make_input_table
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.clear_plan()
+    yield
+    faults.clear_plan()
+
+
+def free_port(n: int = 2) -> int:
+    socks = []
+    try:
+        for _ in range(20):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        ports = sorted(s.getsockname()[1] for s in socks)
+        for i in range(len(ports) - n):
+            if ports[i + n - 1] - ports[i] == n - 1:
+                return ports[i]
+        return ports[0]
+    finally:
+        for s in socks:
+            s.close()
+
+
+# ---------------------------------------------------------------------------
+# Plan semantics
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_nth_fires_exactly_once_on_matching_events(self):
+        plan = faults.FaultPlan(
+            [{"kind": "blob_put", "nth": 3, "key": "meta"}]
+        )
+        fired = [
+            plan.check("blob_put", key=k) is not None
+            for k in ["meta/0", "chunk/0", "meta/1", "meta/2", "meta/3"]
+        ]
+        # chunk/0 does not match the key filter, so meta/2 is the 3rd match
+        assert fired == [False, False, False, True, False]
+
+    def test_prob_is_seed_deterministic(self):
+        plan1, plan2 = (
+            faults.FaultPlan([{"kind": "blob_get", "prob": 0.4}], seed=123),
+            faults.FaultPlan([{"kind": "blob_get", "prob": 0.4}], seed=123),
+        )
+        seq1 = [plan1.check("blob_get", key="k") is not None for _ in range(50)]
+        seq2 = [plan2.check("blob_get", key="k") is not None for _ in range(50)]
+        assert seq1 == seq2
+        assert any(seq1) and not all(seq1)
+
+    def test_from_env_and_attempt_filter(self, monkeypatch):
+        monkeypatch.setenv(
+            faults.ENV_PLAN,
+            json.dumps(
+                {
+                    "seed": 7,
+                    "faults": [{"kind": "crash", "worker": 1, "at_epoch": 2,
+                                "attempt": 0}],
+                }
+            ),
+        )
+        faults.clear_plan()
+        plan = faults.active_plan()
+        assert plan is not None and plan.has("crash")
+        # attempt 1 (a supervised restart): the crash spec must NOT fire
+        monkeypatch.setenv(faults.ENV_ATTEMPT, "1")
+        assert plan.check("crash", worker=1, epoch=2) is None
+        # attempt 0: fires exactly once, only at the matching epoch/worker
+        monkeypatch.setenv(faults.ENV_ATTEMPT, "0")
+        assert plan.check("crash", worker=0, epoch=2) is None
+        assert plan.check("crash", worker=1, epoch=1) is None
+        assert plan.check("crash", worker=1, epoch=2) is not None
+        assert plan.check("crash", worker=1, epoch=2) is None
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown kind"):
+            faults.FaultPlan([{"kind": "meteor_strike"}])
+
+
+# ---------------------------------------------------------------------------
+# Flaky blob backend ↔ checkpoint round-trip (the satellite guarantee:
+# a failed Nth put must leave the previous checkpoint loadable)
+# ---------------------------------------------------------------------------
+
+
+class TestFlakyBackend:
+    def _commit_one(self, backend, key, row):
+        st = pz.PersistentStorage(backend)
+        state = st.register_source("src")
+        state.log.record(key, row, 1)
+        state.pending_offset = {"rows": key}
+        state.log.flush_chunk()
+        st.commit()
+        return st
+
+    def _replayed(self, backend):
+        st = pz.PersistentStorage(backend)
+        state = st.register_source("src")
+        rows: list = []
+        st.replay_into(state, lambda k, r, d: rows.append((k, r, d)))
+        return rows, state.offset
+
+    def test_failed_chunk_put_keeps_previous_checkpoint(self, tmp_path):
+        raw = pz.FileBackend(str(tmp_path / "store"))
+        self._commit_one(raw, 1, ("a",))
+
+        flaky = faults.FlakyBackend(
+            raw, faults.FaultPlan([{"kind": "blob_put", "nth": 1}])
+        )
+        st2 = pz.PersistentStorage(flaky)
+        state2 = st2.register_source("src")
+        state2.log.record(2, ("b",), 1)
+        with pytest.raises(faults.InjectedFault):
+            state2.log.flush_chunk()
+
+        rows, offset = self._replayed(raw)
+        assert rows == [(1, ("a",), 1)]
+        assert offset == {"rows": 1}
+
+    def test_failed_metadata_commit_keeps_previous_checkpoint(self, tmp_path):
+        raw = pz.FileBackend(str(tmp_path / "store"))
+        self._commit_one(raw, 1, ("a",))
+
+        flaky = faults.FlakyBackend(
+            raw,
+            faults.FaultPlan([{"kind": "blob_put", "key": "metadata"}]),
+        )
+        st2 = pz.PersistentStorage(flaky)
+        state2 = st2.register_source("src")
+        state2.log.record(2, ("b",), 1)
+        state2.pending_offset = {"rows": 2}
+        state2.log.flush_chunk()  # chunk put succeeds (key filter)
+        with pytest.raises(faults.InjectedFault):
+            st2.commit()
+
+        # the orphaned chunk is ignored: metadata still references chunk 1
+        rows, offset = self._replayed(raw)
+        assert rows == [(1, ("a",), 1)]
+        assert offset == {"rows": 1}
+
+    def test_pipeline_commit_fault_then_resume_exactly_once(self, tmp_path):
+        """End-to-end: a run whose checkpoint commit fails mid-flight leaves
+        the PREVIOUS run's checkpoint loadable; the next clean run resumes
+        from it and lands on exactly-once totals."""
+        os.makedirs(tmp_path / "input")
+        (tmp_path / "input" / "a.csv").write_text("word\nfoo\nbar\nfoo\n")
+        pstore = str(tmp_path / "pstore")
+
+        def run_once(results):
+            t = pw.io.csv.read(
+                str(tmp_path / "input"),
+                schema=pw.schema_from_types(word=str),
+                mode="static",
+                name="words",
+            )
+            counts = t.groupby(t.word).reduce(t.word, n=pw.reducers.count())
+            pw.io.subscribe(
+                counts,
+                on_change=lambda key, row, time, is_addition: results.append(
+                    (row["word"], row["n"], is_addition)
+                ),
+            )
+            pw.run(
+                persistence_config=pw.persistence.Config(
+                    pw.persistence.Backend.filesystem(pstore)
+                )
+            )
+
+        r1: list = []
+        run_once(r1)  # clean checkpoint
+
+        # run 2: new input, but every metadata put fails → no new commit
+        pw.internals.parse_graph.G.clear()
+        (tmp_path / "input" / "b.csv").write_text("word\nfoo\nbaz\n")
+        faults.install_plan(
+            faults.FaultPlan(
+                [{"kind": "blob_put", "key": "metadata", "prob": 1.0}]
+            )
+        )
+        with pytest.raises(faults.InjectedFault):
+            run_once([])
+        faults.clear_plan()
+
+        # run 3: resumes from run 1's checkpoint; run 2's rows are re-read
+        pw.internals.parse_graph.G.clear()
+        r3: list = []
+        run_once(r3)
+        final: dict = {}
+        for word, n, add in r3:
+            if add:
+                final[word] = n
+            elif final.get(word) == n:
+                del final[word]
+        assert final == {"foo": 3, "bar": 1, "baz": 1}
+
+
+# ---------------------------------------------------------------------------
+# Connector read faults ride the reader tolerance budget
+# ---------------------------------------------------------------------------
+
+
+class KV(pw.Schema):
+    k: int
+
+
+def _collect(table) -> list:
+    rows: list = []
+    pw.io.subscribe(
+        table,
+        on_change=lambda key, row, time, is_addition: rows.append(
+            (row["k"], is_addition)
+        ),
+    )
+    pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    return rows
+
+
+class TestConnectorFaults:
+    def test_injected_read_fault_within_budget_exactly_once(self):
+        faults.install_plan(
+            faults.FaultPlan([{"kind": "connector_read", "nth": 3}])
+        )
+
+        class Steady(Reader):
+            max_allowed_consecutive_errors = 2
+
+            def run(self, emit):
+                for i in range(5):
+                    emit({"k": i})
+                emit(COMMIT)
+
+        t = make_input_table(KV, Steady, autocommit_duration_ms=50)
+        rows = _collect(t)
+        assert sorted(k for k, add in rows if add) == [0, 1, 2, 3, 4]
+        assert all(add for _, add in rows)
+
+    def test_injected_read_fault_past_budget_fails_cleanly(self):
+        faults.install_plan(
+            faults.FaultPlan(
+                [{"kind": "connector_read", "prob": 1.0, "source": "Doomed"}]
+            )
+        )
+
+        class Doomed(Reader):
+            max_allowed_consecutive_errors = 1
+
+            def run(self, emit):
+                emit({"k": 0})
+                emit(COMMIT)
+
+        t = make_input_table(KV, Doomed, autocommit_duration_ms=50)
+        with pytest.raises(EngineError, match="consecutive errors"):
+            _collect(t)
+
+
+# ---------------------------------------------------------------------------
+# Transient comm faults: drop / reset / corrupt absorbed by resync
+# ---------------------------------------------------------------------------
+
+
+def _mesh_pair(monkeypatch, port=None):
+    """Two meshes on localhost threads with fast recovery tunables."""
+    monkeypatch.setenv("PATHWAY_COMM_HEARTBEAT_S", "0.2")
+    monkeypatch.setenv("PATHWAY_COMM_HEARTBEAT_TIMEOUT_S", "10")
+    monkeypatch.setenv("PATHWAY_COMM_RECONNECT_WINDOW_S", "10")
+    port = port or free_port(2)
+    meshes: dict[int, TcpMesh] = {}
+    errs: list = []
+
+    def boot(wid):
+        try:
+            meshes[wid] = TcpMesh(wid, 2, port, secret="tok").start()
+        except Exception as exc:  # noqa: BLE001
+            errs.append((wid, exc))
+
+    threads = [threading.Thread(target=boot, args=(w,)) for w in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs, errs
+    return meshes[0], meshes[1]
+
+
+N_MSGS = 40
+
+
+class TestCommFaults:
+    @pytest.mark.parametrize("kind", ["comm_drop", "comm_reset", "comm_corrupt"])
+    def test_single_fault_absorbed_no_loss_no_dup(self, monkeypatch, kind):
+        """One injected frame drop / TCP reset / corruption mid-stream is
+        absorbed by the retransmit+resync protocol: all frames arrive, in
+        order, exactly once, and no CommError surfaces."""
+        faults.install_plan(
+            faults.FaultPlan(
+                [{"kind": kind, "worker": 0, "peer": 1, "nth": N_MSGS // 2}]
+            )
+        )
+        m0, m1 = _mesh_pair(monkeypatch)
+        try:
+            got: list = []
+
+            def consume():
+                for i in range(N_MSGS):
+                    got.append(m1.recv(0, "t", timeout=30))
+
+            consumer = threading.Thread(target=consume)
+            consumer.start()
+            for i in range(N_MSGS):
+                m0.send(1, "t", (i, "payload"))
+            consumer.join(30)
+            assert not consumer.is_alive()
+            assert got == [(i, "payload") for i in range(N_MSGS)]
+            plan = faults.active_plan()
+            assert plan is not None and plan.log, "fault must have fired"
+        finally:
+            m0.close()
+            m1.close()
+
+    def test_fault_during_alltoall_collectives_survive(self, monkeypatch):
+        """The BSP exchange pattern itself (alltoall both ways) rides out a
+        link reset without surfacing CommError to either worker."""
+        faults.install_plan(
+            faults.FaultPlan(
+                [{"kind": "comm_drop", "worker": 1, "peer": 0, "nth": 3}]
+            )
+        )
+        m0, m1 = _mesh_pair(monkeypatch)
+        try:
+            out: dict = {}
+
+            def run(mesh, wid):
+                for round_ in range(6):
+                    per_dest = [
+                        [(wid, round_, 0)], [(wid, round_, 1)]
+                    ]
+                    out[(wid, round_)] = mesh.alltoall(
+                        ("a2a", round_), per_dest
+                    )
+
+            t1 = threading.Thread(target=run, args=(m1, 1))
+            t1.start()
+            run(m0, 0)
+            t1.join(30)
+            assert not t1.is_alive()
+            for round_ in range(6):
+                assert out[(0, round_)] == [(0, round_, 0), (1, round_, 0)]
+                assert out[(1, round_)] == [(0, round_, 1), (1, round_, 1)]
+        finally:
+            m0.close()
+            m1.close()
+
+    def test_heartbeat_acks_drain_retransmit_buffer(self, monkeypatch):
+        """Heartbeats piggyback cumulative acks: without any reconnect the
+        sender's retransmit buffer empties once the peer has the frames."""
+        m0, m1 = _mesh_pair(monkeypatch)
+        try:
+            for i in range(5):
+                m0.send(1, "t", i)
+            for i in range(5):
+                assert m1.recv(0, "t", timeout=10) == i
+            link = m0._links[1]
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                with link.send_lock:
+                    if not link.sent_buf:
+                        break
+                time.sleep(0.05)
+            with link.send_lock:
+                assert not link.sent_buf, "acks never trimmed the buffer"
+        finally:
+            m0.close()
+            m1.close()
+
+    def test_dead_peer_still_detected(self, monkeypatch):
+        """Recovery must not hide REAL death: when a peer closes for good,
+        recv surfaces CommError once the reconnect window lapses."""
+        monkeypatch.setenv("PATHWAY_COMM_RECONNECT_WINDOW_S", "1")
+        m0, m1 = _mesh_pair(monkeypatch)
+        try:
+            m1.close()
+            with pytest.raises(CommError, match="disconnected|timeout"):
+                m0.recv(1, "never", timeout=15)
+        finally:
+            m0.close()
+
+    def test_recv_timeout_env_and_message(self, monkeypatch):
+        """Satellite: PATHWAY_COMM_RECV_TIMEOUT_S overrides the default and
+        the timeout error names the configured value."""
+        monkeypatch.setenv("PATHWAY_COMM_RECV_TIMEOUT_S", "0.3")
+        mesh = TcpMesh(0, 1, free_port(1))
+        assert mesh.recv_timeout == pytest.approx(0.3)
+        t0 = time.monotonic()
+        with pytest.raises(
+            CommError, match=r"timeout after 0\.3s \(PATHWAY_COMM_RECV_TIMEOUT_S\)"
+        ):
+            mesh.recv(0, "never")
+        assert time.monotonic() - t0 < 5
