@@ -56,6 +56,7 @@ def spawn_program(
     env_base: dict[str, str],
     supervise: bool = False,
     max_restarts: int = 3,
+    checkpoint_root: str | None = None,
 ) -> NoReturn:
     """Launch ``processes`` copies of ``program`` forming one SPMD cluster.
 
@@ -98,12 +99,36 @@ def spawn_program(
             return subprocess.Popen([program, *arguments], env=env)
 
         try:
-            Supervisor(
-                spawn_one, processes, max_restarts=max_restarts
+            result = Supervisor(
+                spawn_one,
+                processes,
+                max_restarts=max_restarts,
+                checkpoint_root=checkpoint_root,
             ).run()
         except SupervisorError as exc:
             click.echo(f"[pathway_tpu] {exc}", err=True)
             sys.exit(1)
+        if result.restarts:
+            click.echo(
+                f"[pathway_tpu] recovered after {result.restarts} restart(s) "
+                f"(last failure: {result.last_failure})",
+                err=True,
+            )
+        # corruption fallback can happen WITHOUT any crash (root damaged at
+        # rest before launch): report provenance whenever a worker rejected
+        # generations, not only after restarts
+        for wid, info in sorted(result.recovery.items()):
+            rejected = [g for g, _ in info.get("rejected") or []]
+            if not rejected and not result.restarts:
+                continue
+            click.echo(
+                f"[pathway_tpu] worker {wid}: resumed from verified "
+                f"generation {info.get('recovered_from')} "
+                f"(now at {info.get('generation')})"
+                + (f", rejected damaged generation(s) {rejected}"
+                   if rejected else ""),
+                err=True,
+            )
         sys.exit(0)
 
     handles: list[subprocess.Popen] = []
@@ -192,9 +217,18 @@ _SPAWN_SETTINGS = {"allow_interspersed_args": False, "show_default": True}
     default=3,
     help="supervised mode: give up after N recoveries",
 )
+@click.option(
+    "--checkpoint-root",
+    metavar="PATH",
+    type=str,
+    default=None,
+    help="supervised mode: the program's filesystem persistence root, so "
+    "recovery provenance (which verified generation each worker resumed "
+    "from) is reported after the run",
+)
 @click.argument("program")
 @click.argument("arguments", nargs=-1)
-def spawn(threads, processes, first_port, record, record_path, jax_distributed, supervise, max_restarts, program, arguments):
+def spawn(threads, processes, first_port, record, record_path, jax_distributed, supervise, max_restarts, checkpoint_root, program, arguments):
     """Run PROGRAM as an SPMD cluster of identical processes."""
     env = (
         _recording_env(
@@ -214,6 +248,7 @@ def spawn(threads, processes, first_port, record, record_path, jax_distributed, 
         env_base=env,
         supervise=supervise,
         max_restarts=max_restarts,
+        checkpoint_root=checkpoint_root,
     )
 
 
@@ -250,6 +285,80 @@ def replay(threads, processes, first_port, record_path, mode, continue_after_rep
             continue_after_replay=continue_after_replay,
         ),
     )
+
+
+@cli.command()
+@click.option(
+    "--worker",
+    metavar="N",
+    type=int,
+    default=None,
+    help="audit only this worker's checkpoint shard",
+)
+@click.option(
+    "--json", "as_json", is_flag=True, help="emit the machine-readable report"
+)
+@click.option(
+    "--repair",
+    is_flag=True,
+    help="quarantine damaged generations above each worker's newest "
+    "verified one (moved to quarantine/<worker>/, kept for forensics), "
+    "then re-audit — the deliberate unblock for configurations that "
+    "refuse to fall back silently",
+)
+@click.argument("root", type=click.Path(exists=True, file_okay=False))
+def scrub(worker, as_json, repair, root):
+    """Audit a filesystem persistence ROOT offline.
+
+    Verifies every retained checkpoint generation chunk-by-chunk
+    (integrity frames + manifest digests) without mutating anything
+    (unless --repair), and reports per-generation health.  Exits non-zero
+    when any worker's NEWEST generation fails verification — recovery
+    would silently fall back to an older generation, which deserves
+    operator attention.
+    """
+    import json as _json
+
+    from pathway_tpu.engine.persistence import (
+        FileBackend,
+        repair_root,
+        scrub_root,
+    )
+
+    backend = FileBackend(root)
+    if repair:
+        for action in repair_root(backend, worker=worker):
+            click.echo(f"[repair] {action}", err=True)
+    report = scrub_root(backend, worker=worker)
+    if as_json:
+        click.echo(_json.dumps(report, indent=2, sort_keys=True))
+    else:
+        click.echo(f"scrub of {report['backend']}")
+        if report.get("error"):
+            click.echo(f"  ERROR: {report['error']}")
+        if not report["workers"] and not report.get("error"):
+            click.echo("  no checkpoint state found")
+        for wid, wrep in sorted(report["workers"].items()):
+            status = "OK" if wrep["ok"] else "DAMAGED"
+            click.echo(
+                f"  worker {wid}: {status} — newest generation "
+                f"{wrep['newest']}, newest verified {wrep['newest_verified']}"
+                + (" (legacy pre-manifest metadata)"
+                   if wrep["legacy_metadata"] else "")
+            )
+            pointer_error = (wrep.get("pointer") or {}).get("error")
+            if pointer_error:
+                click.echo(f"    metadata pointer: {pointer_error}")
+            for entry in wrep["generations"]:
+                mark = "ok" if entry["ok"] else "CORRUPT"
+                click.echo(f"    generation {entry['generation']}: {mark}")
+                for problem in entry["problems"]:
+                    click.echo(f"      - {problem}")
+    click.echo(
+        f"[pathway_tpu] scrub: {'clean' if report['ok'] else 'DAMAGE FOUND'}",
+        err=True,
+    )
+    sys.exit(0 if report["ok"] else 1)
 
 
 @cli.command(name="spawn-from-env")

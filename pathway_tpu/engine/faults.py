@@ -17,8 +17,11 @@ Plan format (JSON, also accepted as a Python list of dicts)::
         {"kind": "comm_corrupt", "worker": 0, "peer": 1, "nth": 1},
         {"kind": "comm_delay",   "worker": 0, "delay_ms": 50, "prob": 0.2},
         {"kind": "crash",        "worker": 1, "at_epoch": 3, "attempt": 0},
-        {"kind": "blob_put",     "nth": 2, "key": "metadata"},
+        {"kind": "blob_put",     "nth": 2, "key": "manifests"},
         {"kind": "blob_get",     "prob": 0.1, "max_times": 3},
+        {"kind": "blob_bitflip", "key": "manifests/0/", "from_nth": 3},
+        {"kind": "blob_torn",    "key": "snapshots", "nth": 2, "frac": 0.5},
+        {"kind": "blob_truncate", "key": "operators", "nth": 1},
         {"kind": "connector_read", "source": "CsvReader", "nth": 4}
     ]}
 
@@ -29,6 +32,9 @@ Matching rules:
   without it fires on any attempt).
 * ``key``/``source`` are substring filters on the blob key / reader name.
 * ``nth`` fires exactly once, on the Nth **matching** event (1-based).
+* ``from_nth`` fires on EVERY matching event from the Nth onward (bounded
+  by ``max_times``) — e.g. "corrupt every checkpoint generation after the
+  second", the lever the corrupt-recovery chaos tests use.
 * ``prob`` fires with the given probability per matching event, from a
   per-spec seeded RNG (same seed → same firing pattern), bounded by
   ``max_times`` (default unbounded).
@@ -52,6 +58,16 @@ crash        ``Scope.run_epoch``: SIGKILL the current process at the
 blob_put /   ``FlakyBackend``: the wrapped ``BlobBackend`` call raises
 blob_get /   ``InjectedFault`` instead of performing the I/O.
 blob_delete
+blob_torn    ``FlakyBackend.put/put_atomic``: the write SUCCEEDS but only
+             a prefix of the data lands (``frac``, default 0.5) — a torn
+             write a power cut leaves behind.  The integrity frame
+             (``engine/codec.py``) must flag it on read.
+blob_truncate  Like blob_torn but keeps only ``keep_bytes`` (default 0):
+             the zero-length/short blob some filesystems leave after a
+             crash between create and write-back.
+blob_bitflip ``FlakyBackend.put/put_atomic``: one bit of the written data
+             is flipped (``bit`` index, default seeded) — storage-medium
+             bit rot.  CRC32C framing must flag it on read.
 connector_read  The reader supervision loop (``io/_utils.py``): the Nth
              emitted item raises before it is enqueued, exercising the
              consecutive-error budget + restart/reseek path.
@@ -74,7 +90,13 @@ ENV_ATTEMPT = "PATHWAY_RESTART_ATTEMPT"
 
 _COMM_KINDS = ("comm_drop", "comm_reset", "comm_corrupt", "comm_delay")
 _BLOB_KINDS = ("blob_put", "blob_get", "blob_delete")
-KINDS = _COMM_KINDS + _BLOB_KINDS + ("crash", "connector_read")
+# write-corruption kinds: the I/O succeeds but the stored bytes are damaged
+# (torn write / truncation / bit rot) — the persistence integrity frames
+# must catch them on the read side
+_BLOB_CORRUPT_KINDS = ("blob_torn", "blob_truncate", "blob_bitflip")
+KINDS = (
+    _COMM_KINDS + _BLOB_KINDS + _BLOB_CORRUPT_KINDS + ("crash", "connector_read")
+)
 
 
 class InjectedFault(IOError):
@@ -93,8 +115,9 @@ class FaultSpec:
     """One declarative fault; counts its own matches and firings."""
 
     __slots__ = (
-        "kind", "worker", "peer", "nth", "prob", "delay_ms", "at_epoch",
-        "key", "source", "attempt", "max_times", "seen", "fired", "_rng",
+        "kind", "worker", "peer", "nth", "from_nth", "prob", "delay_ms",
+        "at_epoch", "key", "source", "attempt", "max_times", "frac",
+        "keep_bytes", "bit", "seen", "fired", "_rng",
     )
 
     def __init__(self, spec: dict[str, Any], *, seed: int, index: int):
@@ -107,6 +130,7 @@ class FaultSpec:
         self.worker = spec.get("worker")
         self.peer = spec.get("peer")
         self.nth = spec.get("nth")
+        self.from_nth = spec.get("from_nth")
         self.prob = spec.get("prob")
         self.delay_ms = float(spec.get("delay_ms", 0.0))
         self.at_epoch = spec.get("at_epoch")
@@ -114,7 +138,16 @@ class FaultSpec:
         self.source = spec.get("source")
         self.attempt = spec.get("attempt")
         self.max_times = spec.get("max_times")
-        if self.nth is None and self.prob is None and self.at_epoch is None:
+        # corruption-kind knobs (blob_torn / blob_truncate / blob_bitflip)
+        self.frac = spec.get("frac")
+        self.keep_bytes = spec.get("keep_bytes")
+        self.bit = spec.get("bit")
+        if (
+            self.nth is None
+            and self.from_nth is None
+            and self.prob is None
+            and self.at_epoch is None
+        ):
             self.nth = 1  # a bare spec fires once, on the first match
         self.seen = 0
         self.fired = 0
@@ -148,6 +181,8 @@ class FaultSpec:
             return False
         if self.nth is not None:
             fire = self.seen == self.nth
+        elif self.from_nth is not None:
+            fire = self.seen >= self.from_nth
         elif self.prob is not None:
             fire = self._rng.random() < self.prob
         else:  # at_epoch-only spec (crash): the match IS the trigger
@@ -158,7 +193,10 @@ class FaultSpec:
 
     def describe(self) -> str:
         parts = [self.kind]
-        for name in ("worker", "peer", "nth", "prob", "at_epoch", "key", "source"):
+        for name in (
+            "worker", "peer", "nth", "from_nth", "prob", "at_epoch", "key",
+            "source",
+        ):
             v = getattr(self, name)
             if v is not None:
                 parts.append(f"{name}={v}")
@@ -274,7 +312,14 @@ def maybe_crash(*, worker: int, epoch: int) -> None:
 
 
 class FlakyBackend(BlobBackend):
-    """A ``BlobBackend`` wrapper that fails calls per the fault plan.
+    """A ``BlobBackend`` wrapper that fails OR corrupts calls per the plan.
+
+    Raising kinds (``blob_put``/``blob_get``/``blob_delete``) abort the
+    call with :class:`InjectedFault`.  Corruption kinds (``blob_torn``,
+    ``blob_truncate``, ``blob_bitflip``) let the write SUCCEED but damage
+    the stored bytes — exactly what real storage faults look like to the
+    process that wrote them, and what the persistence layer's integrity
+    frames + generation manifests must catch on the read side.
 
     With no explicit ``plan`` the process-wide active plan is consulted at
     call time, so env-driven soak runs inject persistence faults without
@@ -285,20 +330,50 @@ class FlakyBackend(BlobBackend):
         self.inner = inner
         self.plan = plan
 
+    def describe(self) -> str:
+        return self.inner.describe()
+
+    def _active(self) -> FaultPlan | None:
+        return self.plan if self.plan is not None else active_plan()
+
     def _gate(self, kind: str, key: str) -> None:
-        plan = self.plan if self.plan is not None else active_plan()
+        plan = self._active()
         if plan is None:
             return
         if plan.check(kind, key=key) is not None:
             raise InjectedFault(f"injected {kind} failure for key {key!r}")
 
+    def _mangle(self, key: str, data: bytes) -> bytes:
+        """Apply write-corruption specs (torn / truncate / bitflip)."""
+        plan = self._active()
+        if plan is None:
+            return data
+        spec = plan.check("blob_torn", key=key)
+        if spec is not None:
+            frac = spec.frac if spec.frac is not None else 0.5
+            data = data[: max(0, int(len(data) * float(frac)))]
+        spec = plan.check("blob_truncate", key=key)
+        if spec is not None:
+            data = data[: int(spec.keep_bytes or 0)]
+        spec = plan.check("blob_bitflip", key=key)
+        if spec is not None and data:
+            nbits = len(data) * 8
+            bit = (
+                int(spec.bit) if spec.bit is not None
+                else spec._rng.randrange(nbits)
+            ) % nbits
+            mangled = bytearray(data)
+            mangled[bit // 8] ^= 1 << (bit % 8)
+            data = bytes(mangled)
+        return data
+
     def put(self, key: str, data: bytes) -> None:
         self._gate("blob_put", key)
-        self.inner.put(key, data)
+        self.inner.put(key, self._mangle(key, data))
 
     def put_atomic(self, key: str, data: bytes) -> None:
         self._gate("blob_put", key)
-        self.inner.put_atomic(key, data)
+        self.inner.put_atomic(key, self._mangle(key, data))
 
     def get(self, key: str) -> bytes | None:
         self._gate("blob_get", key)
@@ -315,6 +390,6 @@ class FlakyBackend(BlobBackend):
 def wrap_backend(backend: BlobBackend) -> BlobBackend:
     """Wrap with FlakyBackend iff the active plan injects blob faults."""
     plan = active_plan()
-    if plan is not None and plan.has(*_BLOB_KINDS):
+    if plan is not None and plan.has(*_BLOB_KINDS, *_BLOB_CORRUPT_KINDS):
         return FlakyBackend(backend, plan)
     return backend

@@ -8,15 +8,23 @@ antichains (frontier.rs), and the file/S3/memory/mock backends
 
 * Each persisted source owns an append-only **event log** of encoded events
   (``engine/codec.py``), written one chunk per committed epoch.
-* A worker-level **metadata file** records, per source, how many chunks are
-  part of the last consistent snapshot plus the reader's **offset frontier**
-  (an opaque JSON-able object the reader knows how to ``seek`` to).  The
-  metadata write is atomic (tmp + rename), so a crash between chunk writes
-  and metadata commit simply ignores the trailing chunks — the same
-  "last consistent snapshot" rule the reference enforces with its antichains.
+* Every persisted artifact (snapshot chunk, manifest, operator dump) is
+  wrapped in a self-checking **integrity frame** (magic + version + length
+  + CRC32C, ``engine/codec.py``), so torn writes, truncations and bit rot
+  are *detected* at read time instead of silently corrupting recovery.
+* Each commit writes a per-generation **manifest** (chunk list + SHA-256
+  digests + operator/graph digest) atomically (tmp + rename / object PUT).
+  The manifest is the commit point; the last ``PATHWAY_CHECKPOINT_GENERATIONS``
+  manifests are retained with deferred GC, so recovery can fall back
+  generation-by-generation to the newest FULLY VERIFIED checkpoint when
+  the newest one is damaged.  A legacy ``metadata.json.<worker>`` pointer
+  is still written for humans and for the supervisor's post-mortems.
 * On resume, committed events replay into the input session at artificial
   time 0 (``ARTIFICIAL_TIME_ON_REWIND_START``, connectors/mod.rs:222-258)
   and the reader seeks to the stored frontier before producing new rows.
+
+``scrub_root`` audits a persistence root offline (the ``pathway_tpu scrub``
+CLI drives it) and reports per-generation health without mutating anything.
 
 Backend selection mirrors ``python/pathway/persistence/__init__.py``:
 filesystem / mock (in-memory) / s3 (gated on client library presence).
@@ -24,16 +32,38 @@ filesystem / mock (in-memory) / s3 (gated on client library presence).
 
 from __future__ import annotations
 
+import hashlib
 import json as _json
+import logging
 import os
 import pickle
 import threading
+import time as _time
 from contextvars import ContextVar
 from typing import Any
 
 from pathway_tpu.engine import codec
 
 METADATA_FILE = "metadata.json"
+MANIFEST_FORMAT = 1
+
+_log = logging.getLogger("pathway_tpu.persistence")
+
+
+class CheckpointError(RuntimeError):
+    """A committed checkpoint artifact is missing or failed verification."""
+
+
+def _retain_generations() -> int:
+    """How many committed generations to keep (deferred GC window)."""
+    try:
+        return max(1, int(os.environ.get("PATHWAY_CHECKPOINT_GENERATIONS", "3")))
+    except ValueError:
+        return 3
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
 
 # Filesystem root of the persistence backend of the currently-running
 # pipeline (UDF DiskCache reads it; PersistenceMode::UdfCaching,
@@ -86,6 +116,10 @@ def active_root() -> str | None:
 class BlobBackend:
     """Key → bytes store; keys are slash-separated paths."""
 
+    def describe(self) -> str:
+        """Human-readable location of this store, for error messages."""
+        return type(self).__name__
+
     def put(self, key: str, data: bytes) -> None:
         raise NotImplementedError
 
@@ -124,6 +158,9 @@ class FileBackend(BlobBackend):
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
+
+    def describe(self) -> str:
+        return f"file://{os.path.abspath(self.root)}"
 
     def _path(self, key: str) -> str:
         return os.path.join(self.root, *key.split("/"))
@@ -196,6 +233,9 @@ class MemoryBackend(BlobBackend):
         self.store: dict[str, bytes] = store if store is not None else {}
         self._lock = threading.Lock()
 
+    def describe(self) -> str:
+        return "memory"
+
     def put(self, key: str, data: bytes) -> None:
         with self._lock:
             self.store[key] = data
@@ -220,20 +260,41 @@ class _PrefixedObjectStore(BlobBackend):
     pipeline from scratch).  Object PUTs are atomic per object on these
     stores, so ``put_atomic`` is plain ``put``.
 
+    Transient errors (429 / 5xx / client-flagged ``is_transient``) are
+    retried with the shared udfs exponential-backoff schedule, bounded by
+    ``PATHWAY_BLOB_RETRIES`` (default 3; ``PATHWAY_BLOB_RETRY_INITIAL_MS``
+    tunes the first delay).  Auth errors (403) and not-found are NEVER
+    retried — a 403 is a configuration problem, and retrying it would only
+    delay the operator-visible failure.
+
     Subclasses set ``_error_cls`` and implement ``_put/_get/_list/_delete``.
     """
 
     _error_cls: type[Exception] = Exception
+    _TRANSIENT_STATUS = (429, 500, 502, 503, 504)
 
     def __init__(self, client: Any, prefix: str = ""):
         self.client = client
         self.prefix = prefix.strip("/")
+        try:
+            self.max_retries = max(
+                0, int(os.environ.get("PATHWAY_BLOB_RETRIES", "3"))
+            )
+        except ValueError:
+            self.max_retries = 3
+        try:
+            self.retry_initial_ms = max(
+                1, int(os.environ.get("PATHWAY_BLOB_RETRY_INITIAL_MS", "200"))
+            )
+        except ValueError:
+            self.retry_initial_ms = 200
 
     def _key(self, key: str) -> str:
         return f"{self.prefix}/{key}" if self.prefix else key
 
-    def put(self, key: str, data: bytes) -> None:
-        self._put(self._key(key), data)
+    def describe(self) -> str:
+        name = type(self).__name__.removesuffix("Backend").lower()
+        return f"{name}:{self.prefix}" if self.prefix else name
 
     @staticmethod
     def _is_not_found(exc: Exception) -> bool:
@@ -242,9 +303,53 @@ class _PrefixedObjectStore(BlobBackend):
             getattr(exc, "is_not_found", getattr(exc, "status", 0) == 404)
         )
 
+    def _is_transient(self, exc: Exception) -> bool:
+        if not isinstance(exc, self._error_cls):
+            return False
+        if self._is_not_found(exc):
+            return False
+        return bool(getattr(exc, "is_transient", False)) or (
+            getattr(exc, "status", None) in self._TRANSIENT_STATUS
+        )
+
+    def _with_retry(self, op: str, fn: Any, *args: Any) -> Any:
+        """Run one store call, retrying transient errors with udfs backoff."""
+        from pathway_tpu.internals.udfs.retries import (
+            ExponentialBackoffRetryStrategy,
+        )
+
+        delays = ExponentialBackoffRetryStrategy(
+            max_retries=self.max_retries,
+            initial_delay=self.retry_initial_ms,
+            backoff_factor=2,
+            jitter_ms=max(1, self.retry_initial_ms // 2),
+        ).delays()
+        attempt = 0
+        while True:
+            try:
+                return fn(*args)
+            except Exception as exc:
+                if not self._is_transient(exc):
+                    raise
+                try:
+                    delay = next(delays)
+                except StopIteration:
+                    raise exc  # retry budget exhausted: surface the error
+                attempt += 1
+                _log.warning(
+                    "%s: transient %s error on %s (attempt %d/%d): %s — "
+                    "retrying in %.2fs",
+                    self.describe(), op, args[0] if args else "?",
+                    attempt, self.max_retries, exc, delay,
+                )
+                _time.sleep(delay)
+
+    def put(self, key: str, data: bytes) -> None:
+        self._with_retry("put", self._put, self._key(key), data)
+
     def get(self, key: str) -> bytes | None:
         try:
-            return self._get(self._key(key))
+            return self._with_retry("get", self._get, self._key(key))
         except Exception as exc:
             if isinstance(exc, self._error_cls) and self._is_not_found(exc):
                 return None
@@ -253,11 +358,11 @@ class _PrefixedObjectStore(BlobBackend):
     def list_keys(self, prefix: str) -> list[str]:
         full = self._key(prefix)
         strip = len(self.prefix) + 1 if self.prefix else 0
-        return sorted(k[strip:] for k in self._list(full))
+        return sorted(k[strip:] for k in self._with_retry("list", self._list, full))
 
     def delete(self, key: str) -> None:
         try:
-            self._delete(self._key(key))
+            self._with_retry("delete", self._delete, self._key(key))
         except Exception as exc:
             if isinstance(exc, self._error_cls) and self._is_not_found(exc):
                 return
@@ -413,12 +518,20 @@ def backend_from_config(backend_cfg: Any) -> BlobBackend:
 
 
 class SnapshotLog:
-    """Append-only event log for one persisted source (input_snapshot.rs)."""
+    """Append-only event log for one persisted source (input_snapshot.rs).
+
+    Chunks are written inside an integrity frame (``codec.frame_blob``) and
+    their SHA-256 digests accumulate in ``chunk_digests`` so the commit can
+    pin the exact chunk contents into the generation manifest.  A ``None``
+    digest marks a chunk written before framing existed (legacy store):
+    it is read permissively but cannot be deep-verified.
+    """
 
     def __init__(self, backend: BlobBackend, worker: int, source_id: str):
         self.backend = backend
         self.prefix = f"snapshots/{worker}/{source_id}"
         self.chunks_written = 0
+        self.chunk_digests: list[str | None] = []
         self._buffer: list[bytes] = []
 
     def record(self, key: int, row: tuple, diff: int) -> None:
@@ -432,20 +545,81 @@ class SnapshotLog:
     def flush_chunk(self) -> None:
         if not self._buffer:
             return
-        data = b"".join(self._buffer)
+        framed = codec.frame_blob(b"".join(self._buffer))
         self._buffer.clear()
-        self.backend.put(f"{self.prefix}/{self.chunks_written:08d}", data)
-        self.chunks_written += 1
+        index = self.chunks_written
+        self.backend.put(f"{self.prefix}/{index:08d}", framed)
+        # keep digests index-aligned: a fallback resume overwrites orphaned
+        # chunks above the committed prefix, so truncate before appending
+        del self.chunk_digests[index:]
+        self.chunk_digests.append(_sha256(framed))
+        self.chunks_written = index + 1
 
-    def read_committed(self, committed_chunks: int):
-        """Yield (kind, key, row, time) from the first `committed_chunks`."""
+    def _chunk_context(self, i: int, generation: int) -> str:
+        return (
+            f"chunk {i} of {self.prefix} (generation {generation}) "
+            f"in backend {self.backend.describe()}"
+        )
+
+    def read_committed(
+        self,
+        committed_chunks: int,
+        *,
+        generation: int = 0,
+        digests: list[str | None] | None = None,
+        verified: set[str] | None = None,
+    ):
+        """Yield (kind, key, row, time) from the first `committed_chunks`.
+
+        Errors name the backend, the source log prefix and the generation,
+        so an operator can locate the damaged artifact directly.
+
+        ``verified`` — the storage's process-lifetime artifact cache: a
+        chunk whose ``key:digest`` token is present was already digest-
+        verified this process (by ``_load_state``), so replay skips
+        re-hashing it; resume then hashes each chunk once, not twice.
+        """
         for i in range(committed_chunks):
-            data = self.backend.get(f"{self.prefix}/{i:08d}")
+            key = f"{self.prefix}/{i:08d}"
+            data = self.backend.get(key)
             if data is None:
-                raise RuntimeError(
-                    f"persistence: missing committed chunk {i} for {self.prefix}"
+                raise CheckpointError(
+                    f"persistence: missing committed "
+                    + self._chunk_context(i, generation)
                 )
-            yield from codec.decode_events(data)
+            digest = digests[i] if digests is not None and i < len(digests) else None
+            if (
+                digest is not None
+                and (verified is None or f"{key}:{digest}" not in verified)
+                and _sha256(data) != digest
+            ):
+                raise CheckpointError(
+                    "persistence: digest mismatch on committed "
+                    + self._chunk_context(i, generation)
+                )
+            try:
+                payload = codec.unframe_blob(
+                    data,
+                    what=f"{self.prefix}/{i:08d}",
+                    allow_legacy=digest is None,
+                    # a matched SHA-256 digest subsumes the frame CRC
+                    verify_crc=digest is None,
+                )
+            except codec.IntegrityError as exc:
+                raise CheckpointError(
+                    f"persistence: corrupt committed "
+                    f"{self._chunk_context(i, generation)}: {exc}"
+                ) from exc
+            try:
+                yield from codec.decode_events(payload)
+            except ValueError as exc:
+                # legacy (digest-less) chunks can rot undetected by any
+                # frame; surface decode failures with the same locator
+                # context as frame/digest failures
+                raise CheckpointError(
+                    f"persistence: undecodable events in committed "
+                    f"{self._chunk_context(i, generation)}: {exc}"
+                ) from exc
 
 
 # ---------------------------------------------------------------------------
@@ -488,14 +662,47 @@ class PersistentStorage:
         self.snapshot_interval_ms = snapshot_interval_ms
         self.mode = mode
         self.sources: dict[str, SourceState] = {}
-        self._metadata = self._load_metadata()
-        self.replayed_rows = 0
+        self.retain_generations = _retain_generations()
+        # generational recovery state, filled by _load_state(): the adopted
+        # (verified) generation, the generations rejected on the way down,
+        # and whether we resumed from a pre-manifest legacy metadata file
+        self.generation = 0
+        self.recovered_generation = 0
+        self.rejected_generations: list[tuple[int, str]] = []
+        self.legacy_resume = False
+        # artifacts (chunks, operator dumps) that already passed digest +
+        # frame verification this process-lifetime; they are immutable once
+        # written, so GC's pre-delete re-verification only pays for the
+        # delta since the last check
+        self._verified_artifacts: set[str] = set()
         # PersistenceMode::OperatorPersisting (mod.rs:108-116): persist
         # operator arrangements instead of input event logs, so resume is
         # O(state) not O(history)
         self.operator_persistence = (
             getattr(mode, "name", None) == "OPERATOR_PERSISTING"
         )
+        self._metadata = self._load_state()
+        self.replayed_rows = 0
+        if (
+            self.operator_persistence
+            and self.rejected_generations
+            and int(os.environ.get("PATHWAY_PROCESSES", "1") or "1") > 1
+        ):
+            # input-log mode tolerates one worker falling back further than
+            # its peers (all state recomputes from replayed + re-read
+            # input), but restored OPERATOR state on the peers already
+            # contains the deltas this worker would re-send — the group
+            # would double-apply them.  There is no cross-worker generation
+            # consensus yet, so refuse rather than corrupt.
+            raise CheckpointError(
+                f"persistence: worker {self.worker} fell back past damaged "
+                f"generation(s) {[g for g, _ in self.rejected_generations]} "
+                "in operator-persisting mode, but the other workers of the "
+                "group may hold newer operator state — divergent rollback "
+                "would double-apply exchanged deltas. Repair the damaged "
+                "generation (see `pathway_tpu scrub`) or clear every "
+                "worker's shard to restart the group consistently."
+            )
         self._op_gen = int(self._metadata.get("operators", {}).get("gen", 0))
         # set by the runner: returns {node_id: bytes} of dirty operator
         # states + the graph digest, collected at commit time; confirm is
@@ -509,24 +716,121 @@ class PersistentStorage:
         self.snapshot_access: str | None = None
         self.continue_after_replay = True
 
-    # -- metadata --
+    # -- metadata / manifests --
     def _meta_key(self) -> str:
         return f"{METADATA_FILE}.{self.worker}"
 
-    def _load_metadata(self) -> dict:
+    def _manifest_prefix(self) -> str:
+        return f"manifests/{self.worker}/"
+
+    def _manifest_key(self, generation: int) -> str:
+        return f"{self._manifest_prefix()}{generation:08d}"
+
+    def _list_generations(self) -> dict[int, str]:
+        """{generation: manifest key} for every manifest blob on the store."""
+        out: dict[int, str] = {}
+        for key in self.backend.list_keys(self._manifest_prefix()):
+            tail = key.rsplit("/", 1)[-1]
+            if tail.isdigit():
+                out[int(tail)] = key
+        return out
+
+    def _load_state(self) -> dict:
+        """Adopt the newest FULLY VERIFIED generation, falling back
+        generation-by-generation past damaged ones (torn manifest, missing
+        or corrupt chunk, digest mismatch).  Raises :class:`CheckpointError`
+        when generations exist but none verifies — silently starting fresh
+        would break exactly-once for sources with externally committed
+        offsets.
+
+        Verification reads every chunk of the candidate generation BEFORE
+        adoption, and replay later re-fetches them (the verified-artifact
+        cache skips the re-hash, not the re-read): falling back is only
+        possible while nothing has been replayed into live input sessions
+        yet, so the doubled read is the price of never adopting a
+        generation that cannot be fully restored."""
+        gens = self._list_generations()
+        for gen in sorted(gens, reverse=True):
+            manifest, reason = _read_manifest(self.backend, gens[gen])
+            if manifest is None:
+                self.rejected_generations.append((gen, reason or "unreadable"))
+                continue
+            problems = verify_manifest(
+                self.backend, self.worker, manifest,
+                cache=self._verified_artifacts,
+            )
+            if problems:
+                self.rejected_generations.append(
+                    (gen, "; ".join(problems[:3]))
+                )
+                continue
+            self.generation = self.recovered_generation = gen
+            if self.rejected_generations:
+                _log.warning(
+                    "persistence: worker %d fell back to generation %d in "
+                    "%s — rejected newer generation(s): %s",
+                    self.worker, gen, self.backend.describe(),
+                    "; ".join(f"{g}: {r}" for g, r in self.rejected_generations),
+                )
+            return manifest
+        # no manifest verified — try the pre-generational metadata file
         raw = self.backend.get(self._meta_key())
-        if raw is None:
-            return {"sources": {}}
-        return _json.loads(raw.decode())
+        if raw is not None:
+            try:
+                obj = _json.loads(raw.decode())
+            except ValueError as exc:
+                raise CheckpointError(
+                    f"persistence: metadata file {self._meta_key()} in "
+                    f"backend {self.backend.describe()} is undecodable "
+                    f"({exc}) and no verified generation manifest exists"
+                ) from exc
+            if "generation" not in obj and "sources" in obj:
+                self.legacy_resume = True
+                return obj
+            if "generation" in obj and not gens:
+                # a new-format pointer survived but the manifests it points
+                # at are GONE (partial restore, deleted prefix): this root
+                # HAD committed state — starting fresh would silently
+                # duplicate processing for externally-committed offsets
+                raise CheckpointError(
+                    f"persistence: {self._meta_key()} in backend "
+                    f"{self.backend.describe()} records committed generation "
+                    f"{obj.get('generation')} but no generation manifests "
+                    "exist under "
+                    f"{self._manifest_prefix()!r} — the root was partially "
+                    "restored or its manifests were deleted (clear the "
+                    "persistence directory to deliberately start fresh)"
+                )
+        if self.rejected_generations:
+            raise CheckpointError(
+                f"persistence: worker {self.worker} has "
+                f"{len(self.rejected_generations)} checkpoint generation(s) "
+                f"in backend {self.backend.describe()} but NONE verified — "
+                "refusing to silently restart from scratch (run "
+                "`pathway_tpu scrub` on the root to inspect the damage; "
+                "clear the persistence directory to deliberately start "
+                "fresh). Rejected: "
+                + "; ".join(f"{g}: {r}" for g, r in self.rejected_generations)
+            )
+        return {"sources": {}}
 
     def commit(
         self, processed_up_to: int | None = None, full_operator_dump: bool = False
     ) -> None:
-        """Atomically record the current consistent snapshot frontier.
+        """Atomically commit the current consistent frontier as a new
+        checkpoint generation.
 
         Only chunks flushed at offset markers are committed — the mid-batch
         event buffer stays out, so the committed (chunks, offset) pair always
         refers to the same row prefix.  No-op when nothing advanced.
+
+        The atomically-written generation manifest (chunk list + digests +
+        operator/graph digest) IS the commit point; the legacy
+        ``metadata.json.<worker>`` pointer is refreshed afterwards for
+        humans and post-mortem tooling.  Superseded generations are GC'd
+        only once they fall out of the retention window
+        (``PATHWAY_CHECKPOINT_GENERATIONS``), so recovery always has
+        verified fallbacks.
 
         Operator-persisting mode additionally dumps dirty operator states
         (via ``collect_operator_states``) and gates source offsets on
@@ -543,52 +847,178 @@ class PersistentStorage:
             else:
                 st.committed_chunks = st.log.chunks_written
                 st.offset = st.pending_offset
-        metadata = {
+        metadata: dict[str, Any] = {
             "sources": {
                 sid: {
                     "chunks": st.committed_chunks,
                     "offset": _offset_to_json(st.offset),
                     "schema": st.schema_digest,
                     "key_seq": st.key_seq,
+                    "chunk_digests": st.log.chunk_digests[: st.committed_chunks],
                 }
                 for sid, st in self.sources.items()
             }
         }
         if self.operator_persistence and self.collect_operator_states is not None:
             dirty, digest = self.collect_operator_states(full_operator_dump)
-            op_meta = dict(self._metadata.get("operators", {}).get("nodes", {}))
+            op_meta = {
+                node_id: _op_ref(ref)
+                for node_id, ref in (
+                    self._metadata.get("operators", {}).get("nodes", {}).items()
+                )
+            }
             if dirty:
                 self._op_gen += 1
                 for node_id, blob in dirty.items():
                     key = f"operators/{self.worker}/{self._op_gen}/{node_id}"
-                    self.backend.put(key, blob)
-                    op_meta[str(node_id)] = key
+                    framed = codec.frame_blob(blob)
+                    self.backend.put(key, framed)
+                    op_meta[str(node_id)] = {
+                        "key": key,
+                        "digest": _sha256(framed),
+                    }
             metadata["operators"] = {
                 "gen": self._op_gen,
                 "digest": digest,
                 "nodes": op_meta,
             }
-        if metadata == self._metadata:
+        if _manifest_core(metadata) == _manifest_core(self._metadata):
             if self.confirm_operator_commit is not None:
                 self.confirm_operator_commit()  # nothing new: dumps are moot
             return
-        self._metadata = metadata
+        self.generation += 1
+        metadata["format"] = MANIFEST_FORMAT
+        metadata["generation"] = self.generation
+        # recovery provenance rides every manifest so the supervisor (and
+        # scrub) can reconstruct which generation a restart resumed from
+        metadata["recovered_from"] = self.recovered_generation
+        metadata["attempt"] = _restart_attempt()
+        metadata["rejected"] = [[g, r] for g, r in self.rejected_generations]
         self.backend.put_atomic(
-            self._meta_key(), _json.dumps(self._metadata).encode()
+            self._manifest_key(self.generation),
+            codec.frame_blob(_json.dumps(metadata).encode()),
         )
+        self._metadata = metadata
         if self.confirm_operator_commit is not None:
             self.confirm_operator_commit()
-        self._gc_operator_chunks()
+        # advisory pointer: unframed JSON, deliberately human-readable.
+        # Best-effort — the manifest above IS the durable commit, so a
+        # pointer write failure must not fail the commit (same rule as GC)
+        try:
+            self.backend.put_atomic(
+                self._meta_key(),
+                _json.dumps(
+                    {
+                        "format": MANIFEST_FORMAT,
+                        "generation": self.generation,
+                        "manifest": self._manifest_key(self.generation),
+                        "recovered_from": self.recovered_generation,
+                        "attempt": metadata["attempt"],
+                        "rejected": metadata["rejected"],
+                    }
+                ).encode(),
+            )
+        except Exception as exc:  # noqa: BLE001 - advisory artifact only
+            _log.warning(
+                "persistence: failed to refresh the advisory metadata "
+                "pointer %s (generation %d is committed regardless): %s",
+                self._meta_key(), self.generation, exc,
+            )
+        self._gc_generations()
 
-    def _gc_operator_chunks(self) -> None:
-        """Drop operator chunks superseded by the just-committed metadata."""
-        meta = self._metadata.get("operators")
-        if not meta:
-            return
-        live = set(meta.get("nodes", {}).values())
-        for key in self.backend.list_keys(f"operators/{self.worker}/"):
-            if key not in live:
-                self.backend.delete(key)
+    def _verify_current_generation(self) -> bool:
+        """Read back the just-committed generation and deep-verify it (with
+        the process-lifetime artifact cache, so steady state only pays for
+        the new delta).  This is the gate that keeps deferred GC honest: a
+        generation that was mangled on its way to stable storage (torn
+        write, bit rot in the write path) must never cause the deletion of
+        the older generations recovery would fall back to."""
+        key = self._manifest_key(self.generation)
+        raw = self.backend.get(key)
+        if raw is None:
+            return False
+        try:
+            codec.unframe_blob(raw, what=key)
+        except codec.IntegrityError:
+            return False
+        return not verify_manifest(
+            self.backend, self.worker, self._metadata,
+            cache=self._verified_artifacts,
+        )
+
+    def _gc_generations(self) -> None:
+        """Deferred GC: drop manifests past the retention window, then drop
+        operator chunks no retained (parseable) manifest references.  Input
+        log chunks are append-only prefixes shared by every retained
+        generation, so they are never deleted here.
+
+        Nothing is deleted unless the NEWEST generation passes read-back
+        verification: if what actually landed on the store is damaged, the
+        older generations are the only recovery points left and the window
+        simply grows until a sound commit lands.  GC failure must never
+        fail a commit — the commit is already durable."""
+        try:
+            gens = self._list_generations()
+            horizon = self.generation - self.retain_generations
+            doomed = [g for g in sorted(gens) if g <= horizon]
+            rejected_stale = {
+                g for g, _ in self.rejected_generations
+                if g > self.generation and g in gens
+            }
+            if (
+                not doomed
+                and not rejected_stale
+                and not self.operator_persistence
+            ):
+                return
+            if not self._verify_current_generation():
+                _log.warning(
+                    "persistence: generation %d failed read-back "
+                    "verification on %s — deferring GC, keeping %d older "
+                    "generation(s) as recovery fallbacks",
+                    self.generation, self.backend.describe(), len(doomed),
+                )
+                return
+            retained: list[tuple[int, str]] = []
+            for gen, key in sorted(gens.items()):
+                if gen in doomed:
+                    self.backend.delete(key)
+                else:
+                    retained.append((gen, key))
+            # stale damaged manifests ABOVE the current generation (the ones
+            # this resume rejected, minus slots already overwritten): this
+            # run's verified commit supersedes them, and leaving them would
+            # make every later resume re-reject them — and permanently trip
+            # the loud-failure guards (external-resume sources, operator
+            # multi-worker) even though a verified generation exists
+            for gen, key in retained:
+                if gen in rejected_stale:
+                    self.backend.delete(key)
+            retained = [
+                (g, k) for g, k in retained if g not in rejected_stale
+            ]
+            if not self.operator_persistence:
+                return
+            live: set[str] = set()
+            for gen, key in retained:
+                if gen == self.generation:
+                    manifest: Any = self._metadata
+                else:
+                    manifest, _reason = _read_manifest(self.backend, key)
+                    if manifest is None:
+                        continue  # corrupt manifest pins nothing
+                for ref in (
+                    (manifest.get("operators") or {}).get("nodes") or {}
+                ).values():
+                    live.add(_op_ref(ref)["key"])
+            for key in self.backend.list_keys(f"operators/{self.worker}/"):
+                if key not in live:
+                    self.backend.delete(key)
+        except Exception as exc:  # noqa: BLE001 - GC is best-effort
+            _log.warning(
+                "persistence: generation GC failed (will retry next "
+                "commit): %s", exc,
+            )
 
     def load_operator_states(self, digest: str) -> dict[int, bytes]:
         """Committed operator snapshots keyed by node id; {} on first run."""
@@ -602,11 +1032,35 @@ class PersistentStorage:
                 "(clear the persistence directory to start fresh)"
             )
         out = {}
-        for node_id, key in meta["nodes"].items():
+        for node_id, ref in meta["nodes"].items():
+            ref = _op_ref(ref)
+            key = ref["key"]
             blob = self.backend.get(key)
             if blob is None:
-                raise RuntimeError(f"persistence: missing operator chunk {key}")
-            out[int(node_id)] = blob
+                raise CheckpointError(
+                    f"persistence: missing operator chunk {key} "
+                    f"(generation {self.generation}) in backend "
+                    f"{self.backend.describe()}"
+                )
+            if ref.get("digest") is not None and _sha256(blob) != ref["digest"]:
+                raise CheckpointError(
+                    f"persistence: digest mismatch on operator chunk {key} "
+                    f"(generation {self.generation}) in backend "
+                    f"{self.backend.describe()}"
+                )
+            try:
+                out[int(node_id)] = codec.unframe_blob(
+                    blob,
+                    what=key,
+                    allow_legacy=ref.get("digest") is None,
+                    verify_crc=ref.get("digest") is None,
+                )
+            except codec.IntegrityError as exc:
+                raise CheckpointError(
+                    f"persistence: corrupt operator chunk {key} "
+                    f"(generation {self.generation}) in backend "
+                    f"{self.backend.describe()}: {exc}"
+                ) from exc
         return out
 
     @property
@@ -645,6 +1099,12 @@ class PersistentStorage:
         committed = int(meta.get("chunks", 0))
         offset = _offset_from_json(meta.get("offset"))
         log.chunks_written = committed  # append after the committed prefix
+        digests = meta.get("chunk_digests")
+        log.chunk_digests = (
+            list(digests[:committed])
+            if isinstance(digests, list)
+            else [None] * committed  # pre-manifest store: no pinned digests
+        )
         state = SourceState(log, committed, offset)
         state.schema_digest = schema_digest
         state.operator_mode = self.operator_persistence
@@ -662,7 +1122,12 @@ class PersistentStorage:
         if state.operator_mode:
             return 0
         n = 0
-        for kind, key, row, _t in state.log.read_committed(state.committed_chunks):
+        for kind, key, row, _t in state.log.read_committed(
+            state.committed_chunks,
+            generation=self.generation,
+            digests=state.log.chunk_digests,
+            verified=self._verified_artifacts,
+        ):
             if kind == codec.EV_INSERT:
                 insert(key, row, 1)
                 n += 1
@@ -671,6 +1136,275 @@ class PersistentStorage:
                 n += 1
         self.replayed_rows += n
         return n
+
+
+def _read_manifest(
+    backend: BlobBackend, key: str
+) -> tuple[dict | None, str | None]:
+    """Fetch + unframe + parse one generation manifest.
+
+    Returns ``(manifest, None)`` on success, ``(None, reason)`` when the
+    blob is gone or fails integrity/parsing — the single implementation
+    behind resume, GC and scrub so the three paths cannot drift.
+    """
+    raw = backend.get(key)
+    if raw is None:
+        return None, "manifest vanished"
+    try:
+        return _json.loads(codec.unframe_blob(raw, what=key).decode()), None
+    except (codec.IntegrityError, ValueError) as exc:
+        return None, f"manifest undecodable: {exc}"
+
+
+def _manifest_core(meta: dict) -> dict:
+    """The state-bearing part of a manifest: provenance fields (generation,
+    attempt, recovery trail) are excluded so "nothing advanced" commits stay
+    no-ops."""
+    return {k: meta[k] for k in ("sources", "operators") if k in meta}
+
+
+def _op_ref(ref: Any) -> dict:
+    """Normalize an operator-chunk reference (legacy plain key vs dict)."""
+    if isinstance(ref, dict):
+        return ref
+    return {"key": ref, "digest": None}
+
+
+def _restart_attempt() -> int:
+    """Supervisor restart attempt (dup of faults.restart_attempt; reading
+    the env directly avoids a persistence ↔ faults import cycle)."""
+    try:
+        return int(os.environ.get("PATHWAY_RESTART_ATTEMPT", "0") or "0")
+    except ValueError:
+        return 0
+
+
+def verify_manifest(
+    backend: BlobBackend,
+    worker: int,
+    manifest: dict,
+    *,
+    cache: set[str] | None = None,
+) -> list[str]:
+    """Deep-verify every artifact a generation manifest references.
+
+    Returns a list of problem descriptions (empty = generation is sound):
+    missing chunks, frame integrity failures (torn write / truncation /
+    bit rot), and digest mismatches, each naming the damaged key so an
+    operator can locate it in the store.
+
+    ``cache`` (optional) is a set of ``"key:digest"`` entries that already
+    verified; sound artifacts are added to it and skipped next time —
+    artifacts are immutable once written, so repeated in-process scans
+    (resume probing, per-commit GC gating) only pay for the new delta.
+    Offline audits (``scrub_root``) pass no cache and re-read everything.
+    """
+    problems: list[str] = []
+
+    def check(key: str, digest: str | None, label: str) -> None:
+        token = f"{key}:{digest}"
+        if cache is not None and digest is not None and token in cache:
+            return
+        data = backend.get(key)
+        if data is None:
+            problems.append(f"missing {label} {key}")
+            return
+        if digest is not None and _sha256(data) != digest:
+            problems.append(f"{label} {key}: digest mismatch")
+            return
+        try:
+            # a matched SHA-256 digest subsumes the frame CRC; still parse
+            # the header so torn frames are reported precisely
+            codec.unframe_blob(
+                data, what=key, allow_legacy=digest is None,
+                verify_crc=digest is None,
+            )
+        except codec.IntegrityError as exc:
+            problems.append(str(exc))
+            return
+        if cache is not None and digest is not None:
+            cache.add(token)
+
+    for sid, meta in (manifest.get("sources") or {}).items():
+        n = int(meta.get("chunks", 0))
+        digests = meta.get("chunk_digests")
+        if not isinstance(digests, list):
+            digests = [None] * n
+        elif len(digests) < n:
+            problems.append(
+                f"source {sid!r}: manifest lists {len(digests)} digest(s) "
+                f"for {n} committed chunk(s)"
+            )
+        for i in range(n):
+            check(
+                f"snapshots/{worker}/{sid}/{i:08d}",
+                digests[i] if i < len(digests) else None,
+                "chunk",
+            )
+    ops = manifest.get("operators") or {}
+    for node_id, ref in (ops.get("nodes") or {}).items():
+        ref = _op_ref(ref)
+        check(ref["key"], ref.get("digest"), f"operator chunk (node {node_id})")
+    return problems
+
+
+def scrub_root(
+    backend: BlobBackend, *, worker: int | None = None
+) -> dict[str, Any]:
+    """Offline audit of a persistence root: per-worker, per-generation
+    health, without mutating anything.  Drives ``pathway_tpu scrub``.
+
+    Report shape::
+
+        {"backend": "...", "ok": bool,
+         "workers": {w: {"generations": [{"generation": g, "ok": bool,
+                                          "problems": [...]}, ...],  # newest first
+                         "newest": g | None,
+                         "newest_verified": g | None,
+                         "legacy_metadata": bool,
+                         "pointer": {...} | None}}}
+
+    ``ok`` is True iff every audited worker's NEWEST generation verifies
+    (a root whose newest checkpoint is damaged recovers — via fallback —
+    but deserves operator attention: that is the non-zero-exit condition).
+    A worker with no generations at all is only healthy if it also has no
+    broken legacy metadata.
+    """
+    all_keys = backend.list_keys("")
+    workers: set[int] = set()
+    for key in all_keys:
+        parts = key.split("/")
+        if parts[0] in ("manifests", "snapshots", "operators") and len(parts) > 1:
+            if parts[1].isdigit():
+                workers.add(int(parts[1]))
+        elif parts[0].startswith(METADATA_FILE + "."):
+            tail = parts[0].rsplit(".", 1)[-1]
+            if tail.isdigit():
+                workers.add(int(tail))
+    report: dict[str, Any] = {
+        "backend": backend.describe(),
+        "ok": True,
+        "workers": {},
+    }
+    if worker is not None:
+        if worker not in workers:
+            # a filter that matches nothing must not read as "clean" —
+            # the operator asked about a shard that does not exist
+            report["ok"] = False
+            report["error"] = (
+                f"worker {worker} has no checkpoint state on this root "
+                f"(workers present: {sorted(workers) or 'none'})"
+            )
+            return report
+        workers &= {worker}
+    # per-invocation verification cache: retained generations share their
+    # append-only chunk prefix, so without it a K-generation audit would
+    # fetch and hash most chunks K times (artifacts are immutable and
+    # tokens are key:digest, so the cache cannot mask real damage)
+    audit_cache: set[str] = set()
+    for w in sorted(workers):
+        prefix = f"manifests/{w}/"
+        gens = sorted(
+            (
+                int(k.rsplit("/", 1)[-1])
+                for k in all_keys
+                if k.startswith(prefix) and k.rsplit("/", 1)[-1].isdigit()
+            ),
+            reverse=True,
+        )
+        entries = []
+        newest_verified = None
+        for gen in gens:
+            manifest, reason = _read_manifest(backend, f"{prefix}{gen:08d}")
+            if manifest is None:
+                problems = [reason or "unreadable"]
+            else:
+                problems = verify_manifest(
+                    backend, w, manifest, cache=audit_cache
+                )
+            if not problems and newest_verified is None:
+                newest_verified = gen
+            entries.append(
+                {"generation": gen, "ok": not problems, "problems": problems}
+            )
+        pointer = None
+        raw = backend.get(f"{METADATA_FILE}.{w}")
+        legacy = False
+        if raw is not None:
+            try:
+                pointer = _json.loads(raw.decode())
+                legacy = "generation" not in pointer and "sources" in pointer
+            except ValueError:
+                pointer = {"error": "metadata file undecodable"}
+            if pointer is not None and "generation" in pointer and not gens:
+                # resume refuses this root (partial restore: committed
+                # state recorded, manifests gone) — scrub must agree
+                pointer = dict(pointer)
+                pointer["error"] = (
+                    f"pointer records committed generation "
+                    f"{pointer.get('generation')} but no generation "
+                    "manifests exist (partially restored root)"
+                )
+        worker_ok = (
+            (entries[0]["ok"] if entries else True)
+            and not (pointer or {}).get("error")
+        )
+        report["workers"][w] = {
+            "generations": entries,
+            "newest": gens[0] if gens else None,
+            "newest_verified": newest_verified,
+            "legacy_metadata": legacy,
+            "pointer": pointer,
+            "ok": worker_ok,
+        }
+        report["ok"] = report["ok"] and worker_ok
+    return report
+
+
+def repair_root(
+    backend: BlobBackend, *, worker: int | None = None
+) -> list[str]:
+    """Quarantine damaged generations that sit ABOVE a worker's newest
+    fully verified one (``pathway_tpu scrub --repair``).
+
+    Resume already falls back past damaged generations, but configurations
+    where fallback would silently lose data (broker-offset sources,
+    operator-persisting multi-worker groups) refuse to start while damaged
+    newer generations exist.  This is the deliberate operator action those
+    errors point at: each damaged manifest is MOVED to
+    ``quarantine/<worker>/<generation>`` (kept for forensics, invisible to
+    resume), leaving the newest verified generation as the newest on the
+    root.  Returns a description of every action taken.
+    """
+    actions: list[str] = []
+    audit = scrub_root(backend, worker=worker)
+    for w, wrep in audit.get("workers", {}).items():
+        newest_verified = wrep.get("newest_verified")
+        if newest_verified is None and wrep["generations"]:
+            # nothing verifies: quarantining everything would turn a
+            # repairable-looking root into a refused partial restore —
+            # that calls for a human, not a tool
+            actions.append(
+                f"worker {w}: NO generation verifies — not quarantining "
+                "(clear the shard deliberately to start fresh)"
+            )
+            continue
+        for entry in wrep["generations"]:
+            gen = entry["generation"]
+            if entry["ok"] or gen < (newest_verified or 0):
+                continue  # sound, or a damaged gen fallback never reaches
+            src = f"manifests/{w}/{gen:08d}"
+            dst = f"quarantine/{w}/{gen:08d}"
+            blob = backend.get(src)
+            if blob is not None:
+                backend.put(dst, blob)
+            backend.delete(src)
+            actions.append(
+                f"worker {w}: quarantined damaged generation {gen} "
+                f"({'; '.join(entry['problems'][:2]) or 'unreadable'}) "
+                f"-> {dst}"
+            )
+    return actions
 
 
 def _offset_to_json(offset: Any) -> Any:

@@ -30,6 +30,14 @@ Sinks re-open their output files on restart, so the recovered run's
 final output is identical to an unfaulted run's — the property the
 kill-and-restart test in ``tests/test_supervised_recovery.py`` pins.
 
+Restarted workers do not trust the newest checkpoint blindly: the
+persistence layer (``engine/persistence.py``) verifies each generation's
+integrity frames + digests and falls back generation-by-generation to
+the newest VERIFIED one.  When the supervisor knows the persistence root
+(``checkpoint_root``), it reads the per-worker provenance back after the
+run and surfaces it on ``SupervisorResult.recovery`` for post-mortems,
+alongside ``last_failure`` (why the last restart happened).
+
 Restart attempts are announced to workers via ``PATHWAY_RESTART_ATTEMPT``
 (the fault plan's ``attempt`` filter keys off it, so chaos tests can
 inject a crash on attempt 0 and let attempt 1 run clean).
@@ -41,7 +49,9 @@ in-repo harnesses) and ``subprocess.Popen`` (``pathway spawn
 
 from __future__ import annotations
 
+import json
 import logging
+import random
 import time
 from typing import Any, Callable, Sequence
 
@@ -57,7 +67,10 @@ class SupervisorError(RuntimeError):
 
 
 class SupervisorResult:
-    __slots__ = ("attempts", "restarts", "exit_codes", "history")
+    __slots__ = (
+        "attempts", "restarts", "exit_codes", "history", "recovery",
+        "last_failure",
+    )
 
     def __init__(
         self,
@@ -65,6 +78,8 @@ class SupervisorResult:
         restarts: int,
         exit_codes: list[int],
         history: list[list[int | None]],
+        recovery: dict[int, dict] | None = None,
+        last_failure: str | None = None,
     ):
         self.attempts = attempts  # launches performed (>= 1)
         self.restarts = restarts  # recoveries performed (attempts - 1)
@@ -72,11 +87,22 @@ class SupervisorResult:
         # per-attempt worker exit codes at teardown time (negative =
         # signal, e.g. -9 for the SIGKILL that triggered the recovery)
         self.history = history
+        # post-mortem info read back from the persistence root (when the
+        # supervisor knows it): per-worker checkpoint provenance —
+        # {worker: {"generation", "recovered_from", "rejected", "attempt"}}.
+        # "recovered_from" is the generation the final attempt VERIFIED and
+        # resumed from; "rejected" lists [generation, reason] pairs the
+        # integrity scan refused (torn/corrupt/missing artifacts).
+        self.recovery = recovery or {}
+        # human-readable reason for the last recovery, e.g.
+        # "worker 1 exited -9 on attempt 0" — None for a clean first run
+        self.last_failure = last_failure
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (
             f"SupervisorResult(attempts={self.attempts}, "
-            f"restarts={self.restarts}, exit_codes={self.exit_codes})"
+            f"restarts={self.restarts}, exit_codes={self.exit_codes}, "
+            f"last_failure={self.last_failure!r})"
         )
 
 
@@ -130,12 +156,22 @@ class Supervisor:
         max_restarts: int = 3,
         grace_s: float = 5.0,
         poll_interval_s: float = 0.05,
+        restart_jitter_s: float = 0.5,
+        checkpoint_root: str | None = None,
     ):
         self.spawn = spawn
         self.n_workers = n_workers
         self.max_restarts = max_restarts
         self.grace_s = grace_s
         self.poll_interval_s = poll_interval_s
+        # extra uniform jitter on top of the backoff schedule: when many
+        # supervised clusters share infrastructure (one storage service,
+        # one k8s node pool), a correlated failure must not produce a
+        # thundering herd of simultaneous restarts
+        self.restart_jitter_s = restart_jitter_s
+        # filesystem persistence root (when known): lets the supervisor
+        # read back per-worker checkpoint provenance for post-mortems
+        self.checkpoint_root = checkpoint_root
 
     def _backoff_delays(self):
         # the udfs backoff schedule — the same policy the comm mesh uses
@@ -151,11 +187,83 @@ class Supervisor:
             jitter_ms=100,
         ).delays()
 
+    def _recovery_info(self) -> dict[int, dict]:
+        """Per-worker checkpoint provenance from the persistence root; {}
+        when the root is unknown or unreadable — post-mortem data is
+        best-effort.
+
+        The authoritative record is the newest readable generation
+        MANIFEST (provenance fields ride every commit); the advisory
+        ``metadata.json.<worker>`` pointer is only a fallback, since its
+        refresh is best-effort and may lag the real commit."""
+        if not self.checkpoint_root:
+            return {}
+        try:
+            import os
+
+            if not os.path.isdir(self.checkpoint_root):
+                # read-only forensics must not create a (possibly mistyped)
+                # root as a side effect of FileBackend's makedirs
+                _log.warning(
+                    "checkpoint root %s does not exist; no recovery "
+                    "provenance available", self.checkpoint_root,
+                )
+                return {}
+            from pathway_tpu.engine.persistence import (
+                METADATA_FILE,
+                FileBackend,
+                _read_manifest,
+            )
+
+            backend = FileBackend(self.checkpoint_root)
+            out: dict[int, dict] = {}
+            manifests: dict[int, list[str]] = {}
+            pointers: dict[int, str] = {}
+            for key in backend.list_keys(""):
+                parts = key.split("/")
+                if (
+                    parts[0] == "manifests"
+                    and len(parts) == 3
+                    and parts[1].isdigit()
+                    and parts[2].isdigit()
+                ):
+                    manifests.setdefault(int(parts[1]), []).append(key)
+                elif len(parts) == 1 and parts[0].startswith(
+                    METADATA_FILE + "."
+                ):
+                    tail = parts[0].rsplit(".", 1)[-1]
+                    if tail.isdigit():
+                        pointers[int(tail)] = key
+            for wid in sorted(set(manifests) | set(pointers)):
+                obj = None
+                for key in sorted(manifests.get(wid, []), reverse=True):
+                    obj, _reason = _read_manifest(backend, key)
+                    if obj is not None:
+                        break
+                if obj is None and wid in pointers:
+                    raw = backend.get(pointers[wid])
+                    if raw is not None:
+                        try:
+                            obj = json.loads(raw.decode())
+                        except ValueError:
+                            obj = None
+                if obj is not None and "generation" in obj:
+                    out[wid] = {
+                        "generation": obj.get("generation"),
+                        "recovered_from": obj.get("recovered_from"),
+                        "rejected": obj.get("rejected") or [],
+                        "attempt": obj.get("attempt"),
+                    }
+            return out
+        except Exception:  # noqa: BLE001 - never fail a run for forensics
+            return {}
+
     def run(self) -> SupervisorResult:
         delays = self._backoff_delays()
         history: list[list[int | None]] = []
         attempt = 0
         handles: list[Any] = []
+        last_failure: str | None = None
         try:
             while True:
                 handles = []
@@ -165,7 +273,23 @@ class Supervisor:
                 if first_failed is None:
                     codes = [_exitcode(h) for h in handles]
                     history.append(codes)
-                    return SupervisorResult(attempt + 1, attempt, codes, history)  # type: ignore[arg-type]
+                    recovery = self._recovery_info()
+                    for wid, info in sorted(recovery.items()):
+                        if info.get("rejected"):
+                            _log.warning(
+                                "worker %d recovered from VERIFIED generation "
+                                "%s after rejecting damaged generation(s) %s",
+                                wid, info.get("recovered_from"),
+                                [g for g, _ in info["rejected"]],
+                            )
+                    return SupervisorResult(
+                        attempt + 1, attempt, codes, history,  # type: ignore[arg-type]
+                        recovery=recovery, last_failure=last_failure,
+                    )
+                last_failure = (
+                    f"worker {first_failed} exited "
+                    f"{_exitcode(handles[first_failed])} on attempt {attempt}"
+                )
                 _log.warning(
                     "worker %d died (exit %s) on attempt %d; rolling the "
                     "group back to the last committed checkpoint",
@@ -177,9 +301,11 @@ class Supervisor:
                     raise SupervisorError(
                         f"cluster failed {attempt + 1} time(s) "
                         f"(restart budget {self.max_restarts}); last exit "
-                        f"codes {history[-1]}"
+                        f"codes {history[-1]}; last failure: {last_failure}"
                     )
-                time.sleep(next(delays))
+                time.sleep(
+                    next(delays) + random.uniform(0, self.restart_jitter_s)
+                )
                 attempt += 1
         finally:
             # any escape — Ctrl-C in _watch, a spawn() failure partway

@@ -256,33 +256,43 @@ def run(
             result.telemetry.close()
         if http_server is not None:
             http_server.close()
-        if storage is not None:
-            # also on interrupt/error: commit whatever frontier is consistent.
-            # Offsets never advance past the last PROCESSED epoch (rows
-            # staged for later epochs are not yet in any snapshot), and a
-            # failure mid-epoch must not dump half-stepped operator state —
-            # the previous consistent generation stays committed instead.
-            frontier = result.last_time if result.last_time is not None else -1
-            if result.epoch_failed and storage.operator_persistence:
-                import logging
-
-                logging.getLogger("pathway_tpu").warning(
-                    "run failed mid-epoch; keeping the previous consistent "
-                    "operator snapshot generation"
+        try:
+            if storage is not None:
+                # also on interrupt/error: commit whatever frontier is
+                # consistent.  Offsets never advance past the last PROCESSED
+                # epoch (rows staged for later epochs are not yet in any
+                # snapshot), and a failure mid-epoch must not dump
+                # half-stepped operator state — the previous consistent
+                # generation stays committed instead.
+                frontier = (
+                    result.last_time if result.last_time is not None else -1
                 )
-            else:
-                storage.commit(
-                    processed_up_to=frontier,
-                    full_operator_dump=result.clean_finish,
-                )
-            from pathway_tpu.engine import persistence as pz
+                if result.epoch_failed and storage.operator_persistence:
+                    import logging
 
-            pz.release_active_root(root_token)
-        for cleanup in lowerer.cleanups:
-            try:
-                cleanup()
-            except Exception:
-                pass
+                    logging.getLogger("pathway_tpu").warning(
+                        "run failed mid-epoch; keeping the previous "
+                        "consistent operator snapshot generation"
+                    )
+                else:
+                    storage.commit(
+                        processed_up_to=frontier,
+                        full_operator_dump=result.clean_finish,
+                    )
+        finally:
+            # the final commit may raise (failing store): the process-global
+            # UDF-cache root and the connector cleanups must be released
+            # regardless, or the leaked root poisons every later run in this
+            # process (e.g. persistence-derived sink key salts)
+            if storage is not None:
+                from pathway_tpu.engine import persistence as pz
+
+                pz.release_active_root(root_token)
+            for cleanup in lowerer.cleanups:
+                try:
+                    cleanup()
+                except Exception:
+                    pass
     return result
 
 

@@ -106,7 +106,14 @@ def add_trace_note(e: BaseException, frame: Frame) -> None:
         return  # first (innermost) note wins, like the reference
     note = _format_frame(frame)
     e._pathway_trace_note = note  # type: ignore[attr-defined]
-    e.add_note(note)
+    if hasattr(e, "add_note"):  # BaseException.add_note is 3.11+
+        e.add_note(note)
+    else:  # 3.10: emulate PEP 678 so tooling reading __notes__ still works
+        notes = getattr(e, "__notes__", None)
+        if notes is None:
+            notes = []
+            e.__notes__ = notes  # type: ignore[attr-defined]
+        notes.append(note)
 
 
 def _reraise_with_user_frame(e: Exception) -> None:

@@ -534,6 +534,26 @@ def make_input_table(
                 # reader thread, no live data (reference ReplayMode)
                 node.close()
                 return node
+            if reader.external_resume and getattr(
+                storage, "rejected_generations", None
+            ):
+                # broker-side offsets (Kafka consumer groups, ...) were
+                # committed for generations that integrity verification
+                # just rejected: the broker will never re-deliver the rows
+                # between the verified generation and its own offset, so
+                # resuming here would silently LOSE them.  Fail loudly.
+                from pathway_tpu.engine.persistence import CheckpointError
+
+                raise CheckpointError(
+                    f"persistence: source {sid!r} resumes from broker-side "
+                    "offsets, but checkpoint recovery fell back past "
+                    "damaged generation(s) "
+                    f"{[g for g, _ in storage.rejected_generations]} — the "
+                    "broker's committed offset may be ahead of the verified "
+                    "checkpoint and the gap would be lost. Repair the root "
+                    "(see `pathway_tpu scrub`), or rewind the consumer "
+                    "group / clear the persistence directory to re-ingest."
+                )
             poller.persist_state = state
             poller._auto_seq = state.key_seq
             if state.offset is not None:

@@ -147,7 +147,37 @@ class TestFlakyBackend:
         assert rows == [(1, ("a",), 1)]
         assert offset == {"rows": 1}
 
-    def test_failed_metadata_commit_keeps_previous_checkpoint(self, tmp_path):
+    def test_failed_manifest_commit_keeps_previous_checkpoint(self, tmp_path):
+        """The generation manifest is the commit point: when its atomic
+        write fails, the orphaned chunk is ignored and the previous
+        generation stays the recovery point."""
+        raw = pz.FileBackend(str(tmp_path / "store"))
+        self._commit_one(raw, 1, ("a",))
+
+        flaky = faults.FlakyBackend(
+            raw,
+            faults.FaultPlan([{"kind": "blob_put", "key": "manifests"}]),
+        )
+        st2 = pz.PersistentStorage(flaky)
+        state2 = st2.register_source("src")
+        state2.log.record(2, ("b",), 1)
+        state2.pending_offset = {"rows": 2}
+        state2.log.flush_chunk()  # chunk put succeeds (key filter)
+        with pytest.raises(faults.InjectedFault):
+            st2.commit()
+
+        # the orphaned chunk is ignored: generation 1 still references chunk 1
+        rows, offset = self._replayed(raw)
+        assert rows == [(1, ("a",), 1)]
+        assert offset == {"rows": 1}
+
+    def test_failed_pointer_write_after_manifest_commit_is_harmless(
+        self, tmp_path
+    ):
+        """The legacy metadata.json pointer is advisory: once the manifest
+        landed, the commit IS durable — a pointer write failure is logged
+        and swallowed (never fails the commit), and resume adopts the new
+        generation."""
         raw = pz.FileBackend(str(tmp_path / "store"))
         self._commit_one(raw, 1, ("a",))
 
@@ -159,14 +189,12 @@ class TestFlakyBackend:
         state2 = st2.register_source("src")
         state2.log.record(2, ("b",), 1)
         state2.pending_offset = {"rows": 2}
-        state2.log.flush_chunk()  # chunk put succeeds (key filter)
-        with pytest.raises(faults.InjectedFault):
-            st2.commit()
+        state2.log.flush_chunk()
+        st2.commit()  # manifest write succeeds; pointer failure swallowed
 
-        # the orphaned chunk is ignored: metadata still references chunk 1
         rows, offset = self._replayed(raw)
-        assert rows == [(1, ("a",), 1)]
-        assert offset == {"rows": 1}
+        assert rows == [(1, ("a",), 1), (2, ("b",), 1)]
+        assert offset == {"rows": 2}
 
     def test_pipeline_commit_fault_then_resume_exactly_once(self, tmp_path):
         """End-to-end: a run whose checkpoint commit fails mid-flight leaves
@@ -199,12 +227,12 @@ class TestFlakyBackend:
         r1: list = []
         run_once(r1)  # clean checkpoint
 
-        # run 2: new input, but every metadata put fails → no new commit
+        # run 2: new input, but every manifest put fails → no new commit
         pw.internals.parse_graph.G.clear()
         (tmp_path / "input" / "b.csv").write_text("word\nfoo\nbaz\n")
         faults.install_plan(
             faults.FaultPlan(
-                [{"kind": "blob_put", "key": "metadata", "prob": 1.0}]
+                [{"kind": "blob_put", "key": "manifests", "prob": 1.0}]
             )
         )
         with pytest.raises(faults.InjectedFault):

@@ -82,7 +82,61 @@ def _scenario(tmpdir: str) -> None:
     )
 
 
-def _worker_main(wid, attempt, n, port, tmpdir, plan_json):
+def _gated_scenario(tmpdir: str) -> None:
+    """Like ``_scenario`` but the source GATES on checkpoint progress: rows
+    10+ are only emitted once generation 1 exists on disk, rows 20+ once
+    generation 2 does.  This pins the interleaving the corrupt-checkpoint
+    test needs — a crash at epoch >= 25 is guaranteed to happen after at
+    least two generations were committed — without relying on timing."""
+    import pathway_tpu as pw
+
+    manifest_dir = os.path.join(tmpdir, "pstore", "manifests", "0")
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time as _t
+
+            def wait_for_generations(n):
+                deadline = _t.monotonic() + 20
+                while _t.monotonic() < deadline:
+                    try:
+                        committed = [
+                            f for f in os.listdir(manifest_dir)
+                            if not f.endswith(".tmp")  # put_atomic staging
+                        ]
+                        if len(committed) >= n:
+                            return
+                    except OSError:
+                        pass
+                    _t.sleep(0.01)
+                raise RuntimeError(
+                    f"gated source: generation {n} never appeared in "
+                    f"{manifest_dir}"
+                )
+
+            for i in range(N_ROWS):
+                if i == 10:
+                    wait_for_generations(1)
+                elif i == 20:
+                    wait_for_generations(2)
+                self.next(k=i % 3, v=1)
+                self.commit()
+                _t.sleep(ROW_DELAY_S)
+
+    t = pw.io.python.read(
+        Src(), schema=pw.schema_from_types(k=int, v=int), name="src"
+    )
+    counts = t.groupby(t.k).reduce(k=t.k, n=pw.reducers.count())
+    pw.io.jsonlines.write(counts, os.path.join(tmpdir, "counts.jsonl"))
+    pw.run(
+        persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem(os.path.join(tmpdir, "pstore")),
+            snapshot_interval_ms=50,
+        )
+    )
+
+
+def _worker_main(wid, attempt, n, port, tmpdir, plan_json, scenario=_scenario):
     os.environ["PATHWAY_PROCESSES"] = str(n)
     os.environ["PATHWAY_PROCESS_ID"] = str(wid)
     os.environ["PATHWAY_FIRST_PORT"] = str(port)
@@ -110,23 +164,30 @@ def _worker_main(wid, attempt, n, port, tmpdir, plan_json):
     refresh_config()
     faults.clear_plan()  # re-read THIS process's env, not the parent's cache
     G.clear()
-    _scenario(tmpdir)
+    scenario(tmpdir)
 
 
-def _run_supervised(tmpdir, plan_json, max_restarts=3):
+def _run_supervised(tmpdir, plan_json, max_restarts=3, scenario=_scenario):
     ctx = multiprocessing.get_context("fork")
     port = _free_port_base()
 
     def spawn(wid: int, attempt: int):
         p = ctx.Process(
             target=_worker_main,
-            args=(wid, attempt, N_WORKERS, port, str(tmpdir), plan_json),
+            args=(wid, attempt, N_WORKERS, port, str(tmpdir), plan_json,
+                  scenario),
             daemon=True,
         )
         p.start()
         return p
 
-    return Supervisor(spawn, N_WORKERS, max_restarts=max_restarts).run()
+    return Supervisor(
+        spawn,
+        N_WORKERS,
+        max_restarts=max_restarts,
+        restart_jitter_s=0.05,
+        checkpoint_root=os.path.join(str(tmpdir), "pstore"),
+    ).run()
 
 
 def canonical_bytes(tmpdir) -> bytes:
@@ -188,6 +249,73 @@ def test_sigkill_one_worker_supervisor_recovers_byte_identical(tmp_path):
 
     assert canonical_bytes(faulted_dir) == expected
     # and the totals are the exactly-once ground truth
+    net = dict(json.loads(expected.decode()))
+    got = {json.loads(k)["k"]: json.loads(k)["n"] for k in net}
+    assert got == {0: 15, 1: 15, 2: 15}, got
+
+
+def test_corrupt_newest_checkpoint_falls_back_to_verified_generation(tmp_path):
+    """Acceptance: the fault plan bit-flips every checkpoint generation
+    manifest worker 0 writes from the 2nd onward (attempt 0 only), then
+    SIGKILLs worker 1 mid-run.  The supervised restart must NOT trust the
+    newest (damaged) checkpoint: integrity verification rejects the
+    corrupt generation(s), recovery falls back to the newest VERIFIED
+    generation, and the final output is byte-identical to an unfaulted
+    run's.  The recovery provenance — which generation was used, which
+    were rejected — surfaces on SupervisorResult for post-mortems."""
+    clean_dir = tmp_path / "clean"
+    clean_dir.mkdir()
+    res_clean = _run_supervised(
+        clean_dir, plan_json=None, scenario=_gated_scenario
+    )
+    assert res_clean.restarts == 0, res_clean.history
+    assert res_clean.last_failure is None
+    expected = canonical_bytes(clean_dir)
+    assert expected != b"[]"
+
+    faulted_dir = tmp_path / "faulted"
+    faulted_dir.mkdir()
+    plan = json.dumps(
+        {
+            "seed": 13,
+            "faults": [
+                # the source log lives on worker 0 (non-partitioned reader):
+                # damage every generation manifest after the first...
+                {
+                    "kind": "blob_bitflip",
+                    "key": "manifests/0/",
+                    "from_nth": 2,
+                    "attempt": 0,
+                },
+                # ...then hard-kill worker 1.  The gated source only emits
+                # row 20+ (so epoch 25 only happens) once two generations
+                # exist on disk, making newest-is-damaged deterministic.
+                {"kind": "crash", "worker": 1, "at_epoch": 25, "attempt": 0},
+            ],
+        }
+    )
+    res = _run_supervised(
+        faulted_dir, plan_json=plan, scenario=_gated_scenario
+    )
+
+    assert res.restarts >= 1, res.history
+    assert res.history[0][1] == -signal.SIGKILL, res.history
+    assert res.exit_codes == [0] * N_WORKERS, res.history
+    assert res.last_failure is not None and "worker 1" in res.last_failure
+
+    # worker 0's recovery rejected the damaged generation(s) and resumed
+    # from an earlier verified one
+    assert 0 in res.recovery, res.recovery
+    info = res.recovery[0]
+    assert info["rejected"], res.recovery
+    rejected_gens = [g for g, _reason in info["rejected"]]
+    assert info["recovered_from"] >= 1
+    assert all(g > info["recovered_from"] for g in rejected_gens), info
+    # the restarted run committed verified generations past the fallback
+    assert info["generation"] > info["recovered_from"], info
+
+    # ...and the net output a consumer sees is byte-identical anyway
+    assert canonical_bytes(faulted_dir) == expected
     net = dict(json.loads(expected.decode()))
     got = {json.loads(k)["k"]: json.loads(k)["n"] for k in net}
     assert got == {0: 15, 1: 15, 2: 15}, got
