@@ -19,6 +19,7 @@ import io as _io
 import json as _json
 import pickle
 import struct
+import threading as _threading
 from typing import Any, Iterable
 
 import numpy as np
@@ -102,6 +103,7 @@ class _Crc32cEngine:
         self.table = np.array(_crc32c_make_table(), dtype=np.uint32)
         self.pos_tables: Any = None  # (BLOCK, 256) uint32, built lazily
         self.advance_basis: Any = None  # step0^BLOCK images of the 32 bits
+        self._build_lock = _threading.Lock()
 
     def _step0_vec(self, v):
         return (v >> np.uint32(8)) ^ self.table[v & np.uint32(0xFF)]
@@ -113,11 +115,14 @@ class _Crc32cEngine:
         for j in range(self.BLOCK - 2, -1, -1):
             cur = self._step0_vec(cur)
             tabs[j] = cur
-        self.pos_tables = tabs
         basis = np.array([1 << i for i in range(32)], dtype=np.uint32)
         for _ in range(self.BLOCK):
             basis = self._step0_vec(basis)
         self.advance_basis = [int(x) for x in basis]
+        # publish pos_tables LAST: concurrent update() calls gate on it, so
+        # advance_basis must already be visible (the checkpoint writer pool
+        # frames chunks from several threads at once)
+        self.pos_tables = tabs
 
     def _advance(self, state: int) -> int:
         """Apply ``step0^BLOCK`` to a 32-bit register via its basis images."""
@@ -143,7 +148,9 @@ class _Crc32cEngine:
         if n_blocks == 0:
             return self.update_bytes(state, data)
         if self.pos_tables is None:
-            self._build()
+            with self._build_lock:
+                if self.pos_tables is None:
+                    self._build()
         arr = np.frombuffer(data, dtype=np.uint8, count=n_blocks * self.BLOCK)
         arr = arr.reshape(n_blocks, self.BLOCK)
         pos = np.arange(self.BLOCK)[None, :]
@@ -160,15 +167,32 @@ class _Crc32cEngine:
 
 
 _crc32c_engine: _Crc32cEngine | None = None
+_crc32c_engine_lock = _threading.Lock()
 
 
 def crc32c(data: bytes | memoryview, crc: int = 0) -> int:
-    """CRC-32C (Castagnoli) of ``data``; chainable via the ``crc`` arg."""
+    """CRC-32C (Castagnoli) of ``data``; chainable via the ``crc`` arg.
+    Native path: hardware SSE4.2 CRC with the GIL released (GB/s — the
+    writer pool frames chunks truly concurrently with the epoch loop);
+    the vectorized-numpy engine below is the fallback.  Thread-safe:
+    engines and their lazy tables build exactly once."""
+    from pathway_tpu.engine.types import _native
+
+    native = _native()
+    if native is not None and hasattr(native, "crc32c"):
+        # no bytes() copy: the native side takes any C-contiguous buffer
+        # ("y*"), and copying MB-scale chunks here (under the GIL) would
+        # re-serialize the writer-pool threads the native path unblocks
+        return native.crc32c(data, crc)
     global _crc32c_engine
-    if _crc32c_engine is None:
-        _crc32c_engine = _Crc32cEngine()
+    engine = _crc32c_engine
+    if engine is None:
+        with _crc32c_engine_lock:
+            if _crc32c_engine is None:
+                _crc32c_engine = _Crc32cEngine()
+            engine = _crc32c_engine
     state = ~crc & 0xFFFFFFFF
-    state = _crc32c_engine.update(state, bytes(data))
+    state = engine.update(state, bytes(data))
     return ~state & 0xFFFFFFFF
 
 
@@ -530,6 +554,24 @@ def encode_event(kind: int, key: int = 0, row: tuple = (), time: int = 0) -> byt
         out.write(payload)
     elif kind == EV_ADVANCE_TIME:
         out.write(_U64.pack(time))
+    return out.getvalue()
+
+
+def encode_events(events: Iterable[tuple]) -> bytes:
+    """Encode ``(kind, key, row, time)`` tuples into one chunk payload —
+    the batched form of :func:`encode_event` (single buffer, native-
+    accelerated).  The checkpoint writer pool encodes whole raw-event
+    batches through this so the epoch loop never pays the serializer."""
+    from pathway_tpu.engine.types import _native
+
+    native = _native()
+    if native is not None and hasattr(native, "encode_events"):
+        return native.encode_events(
+            events if isinstance(events, (list, tuple)) else list(events)
+        )
+    out = _io.BytesIO()
+    for kind, key, row, time in events:
+        out.write(encode_event(kind, key, row, time))
     return out.getvalue()
 
 

@@ -83,6 +83,12 @@ HEARTBEAT_INTERVAL_S = 2.0  # PATHWAY_COMM_HEARTBEAT_S
 HEARTBEAT_TIMEOUT_S = 30.0  # PATHWAY_COMM_HEARTBEAT_TIMEOUT_S
 RECONNECT_WINDOW_S = 15.0  # PATHWAY_COMM_RECONNECT_WINDOW_S
 SEND_BUFFER_MB = 64  # PATHWAY_COMM_SEND_BUFFER_MB
+# PATHWAY_COMM_SEND_DEADLINE_S — deadline on any single blocking socket
+# write (SO_SNDTIMEO): a hung peer with a full TCP buffer can otherwise
+# park a data-phase sendall forever WHILE it holds send_lock.  0 disables.
+# Defaults to the (possibly env-overridden) heartbeat timeout — a peer
+# that cannot drain one frame for that long is treated exactly like one
+# that stopped acking — so there is no separate module constant.
 # frame-size cap: a corrupt or hostile length field must not OOM the
 # worker.  256 MiB default comfortably covers real epoch batches (tune via
 # PATHWAY_COMM_MAX_FRAME_MB for enormous-epoch deployments).
@@ -250,6 +256,10 @@ class TcpMesh:
         self.reconnect_window = _env_float(
             "PATHWAY_COMM_RECONNECT_WINDOW_S", RECONNECT_WINDOW_S
         )
+        self.send_deadline = _env_float(
+            "PATHWAY_COMM_SEND_DEADLINE_S",
+            max(self.heartbeat_timeout, 1.0),
+        )
         # the retransmit buffer must hold at least one max-size frame, or
         # a single legal frame would be evicted the moment it is sent and
         # any reconnect before its ack would falsely declare the peer dead
@@ -397,6 +407,21 @@ class TcpMesh:
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         except OSError:
             pass
+        if self.send_deadline > 0:
+            # SO_SNDTIMEO bounds each blocking WRITE syscall only (recv
+            # stays governed by its own timeouts), so a data-phase sendall
+            # to a hung peer errors out instead of parking forever while it
+            # holds send_lock.  The frame stays in the retransmit buffer;
+            # the failed link is cycled and resync re-delivers it.
+            try:
+                sec = int(self.send_deadline)
+                usec = int((self.send_deadline - sec) * 1e6)
+                sock.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                    struct.pack("ll", sec, usec),
+                )
+            except (OSError, struct.error):
+                pass  # platform without SO_SNDTIMEO: keep the old behavior
         link = self._links[peer]
         with link.cv:
             link.gen += 1
@@ -724,7 +749,16 @@ class TcpMesh:
     def _heartbeat_loop(self) -> None:
         """Per-link liveness: send heartbeat+ack frames; force-fail links
         whose peer went silent or stopped acking (a hung process looks
-        healthy to TCP — only traffic proves liveness)."""
+        healthy to TCP — only traffic proves liveness).
+
+        This loop must NEVER block on a link's ``send_lock``: a data-phase
+        ``sendall`` to a hung peer can hold that lock for up to the send
+        deadline, and one such peer must not stall staleness detection —
+        or heartbeats — for every OTHER peer.  So staleness is computed
+        from lock-free reads (worst case one interval stale), the force-
+        close happens outside any lock, and the heartbeat write itself is
+        skipped when the lock is busy (an in-progress data send is itself
+        evidence the link is being driven; the ack rides the next tick)."""
         while not self._hb_stop.wait(self.heartbeat_interval):
             if self._closed:
                 return
@@ -736,11 +770,14 @@ class TcpMesh:
                     sock = link.sock
                     ack = link.recv_seq
                     silent = now - link.last_seen > self.heartbeat_timeout
-                with link.send_lock:
-                    stalled = (
-                        link.unacked_since is not None
-                        and now - link.unacked_since > self.heartbeat_timeout
-                    )
+                # unacked_since is read WITHOUT send_lock: a torn read costs
+                # at most one stale interval, while taking the lock could
+                # block behind a sendall stuck on this very hung peer
+                unacked_since = link.unacked_since
+                stalled = (
+                    unacked_since is not None
+                    and now - unacked_since > self.heartbeat_timeout
+                )
                 if silent or stalled:
                     # reader wakes with an error → reconnect path decides
                     _log.warning(
@@ -752,11 +789,24 @@ class TcpMesh:
                     _close_quietly(sock)
                     continue
                 hb = _HDR.pack(_FRAME.size, 0) + _FRAME.pack(ack)
-                with link.send_lock:
-                    try:
-                        sock.sendall(hb)
-                    except OSError:
-                        pass  # the reader sees the same failure
+                # BOUNDED wait for the lock: a wedged data sendall costs at
+                # most 50 ms per tick (vs. blocking forever, the PR-1
+                # residue), while sustained back-to-back data sends — which
+                # release the lock between frames — cannot starve the
+                # heartbeat indefinitely: acks ride only on heartbeat
+                # frames, and a peer that stopped receiving them would
+                # force-fail a perfectly healthy link as "not acking"
+                if not link.send_lock.acquire(timeout=0.05):
+                    continue  # truly wedged; retry next tick
+                try:
+                    sock.sendall(hb)
+                except OSError:
+                    # includes a send-deadline expiry: progress on the
+                    # socket is unknowable, so cycle the link promptly
+                    # instead of waiting for the reader to notice
+                    _close_quietly(sock)
+                finally:
+                    link.send_lock.release()
 
     # -- point to point --------------------------------------------------
     def send(self, dest: int, tag: Hashable, payload: Any) -> None:
@@ -839,10 +889,14 @@ class TcpMesh:
                 try:
                     sock.sendall(out)
                 except OSError:
-                    # the link just failed under us: the frame is in the
-                    # retransmit buffer; the reader drives the reconnect
-                    # and the resync re-delivers it
-                    pass
+                    # the link just failed under us — including a send-
+                    # deadline expiry on a hung peer (SO_SNDTIMEO), where
+                    # how much of the frame left the kernel is unknowable.
+                    # The frame is in the retransmit buffer; close the
+                    # socket so the reader fails over NOW (a deadline
+                    # expiry alone would never wake it) and the resync
+                    # re-delivers from the last acked sequence.
+                    _close_quietly(sock)
             if (drop is not None or reset is not None) and sock is not None:
                 _close_quietly(sock)  # injected TCP reset
 

@@ -55,6 +55,11 @@ comm_delay   ``TcpMesh.send``: sleep ``delay_ms`` before the write.
 crash        ``Scope.run_epoch``: SIGKILL the current process at the
              chosen epoch boundary (a hard worker death, not an
              exception — nothing gets to flush).
+writer_crash ``persistence._WriterPool``: SIGKILL from a checkpoint
+             writer thread mid-async-commit (artifact hashed, upload
+             pending) — the staged generation must stay unreferenced
+             because its manifest never published.  ``key`` filters on
+             the artifact key (e.g. ``"snapshots"``).
 blob_put /   ``FlakyBackend``: the wrapped ``BlobBackend`` call raises
 blob_get /   ``InjectedFault`` instead of performing the I/O.
 blob_delete
@@ -95,7 +100,10 @@ _BLOB_KINDS = ("blob_put", "blob_get", "blob_delete")
 # must catch them on the read side
 _BLOB_CORRUPT_KINDS = ("blob_torn", "blob_truncate", "blob_bitflip")
 KINDS = (
-    _COMM_KINDS + _BLOB_KINDS + _BLOB_CORRUPT_KINDS + ("crash", "connector_read")
+    _COMM_KINDS
+    + _BLOB_KINDS
+    + _BLOB_CORRUPT_KINDS
+    + ("crash", "writer_crash", "connector_read")
 )
 
 
@@ -306,6 +314,20 @@ def maybe_crash(*, worker: int, epoch: int) -> None:
         os.kill(os.getpid(), signal.SIGKILL)
 
 
+def maybe_crash_writer(*, worker: int, key: str) -> None:
+    """Async-commit crash injection: SIGKILL from a checkpoint writer-pool
+    thread MID-FLIGHT — after the artifact was framed and hashed, before
+    its upload.  Some chunks of the staged generation may already be on the
+    store, the generation manifest is not: the crash must leave only an
+    unreferenced partial generation (invisible to resume and to
+    ``pathway_tpu scrub``), which supervised recovery rolls past."""
+    plan = active_plan()
+    if plan is None or not plan.has("writer_crash"):
+        return
+    if plan.check("writer_crash", worker=worker, key=key) is not None:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
 # ---------------------------------------------------------------------------
 # Flaky blob backend
 # ---------------------------------------------------------------------------
@@ -374,6 +396,13 @@ class FlakyBackend(BlobBackend):
     def put_atomic(self, key: str, data: bytes) -> None:
         self._gate("blob_put", key)
         self.inner.put_atomic(key, self._mangle(key, data))
+
+    def put_staged(self, key: str, data: bytes) -> None:
+        self._gate("blob_put", key)
+        self.inner.put_staged(key, self._mangle(key, data))
+
+    def sync_staged(self, keys: list[str]) -> None:
+        self.inner.sync_staged(keys)
 
     def get(self, key: str) -> bytes | None:
         self._gate("blob_get", key)

@@ -258,6 +258,41 @@ class Supervisor:
         except Exception:  # noqa: BLE001 - never fail a run for forensics
             return {}
 
+    def _settle_checkpoints(self) -> None:
+        """Settle async-commit residue on the persistence root after the
+        whole group is confirmed dead, before the restart is accounted.
+
+        A worker killed mid-pipelined-commit can leave two kinds of debris:
+        ``*.tmp`` staging files from a ``put_atomic`` that never renamed
+        (invisible to resume — ``list_keys`` skips them — but accumulating
+        across restarts), and unreferenced partial generations (chunks
+        whose manifest never published).  The staging files are swept here;
+        partial generations are deliberately left alone — the respawned
+        workers overwrite the orphaned chunk slots in place and operator GC
+        collects unreferenced dumps, and deleting them here would race a
+        slow-dying writer thread's last put."""
+        if not self.checkpoint_root:
+            return
+        import os
+
+        if not os.path.isdir(self.checkpoint_root):
+            return
+        removed = 0
+        for dirpath, _dirs, files in os.walk(self.checkpoint_root):
+            for name in files:
+                if not name.endswith(".tmp"):
+                    continue
+                try:
+                    os.remove(os.path.join(dirpath, name))
+                    removed += 1
+                except OSError:
+                    pass  # best-effort sweep, never fail a restart for it
+        if removed:
+            _log.info(
+                "settled %d stale checkpoint staging file(s) under %s "
+                "before restart", removed, self.checkpoint_root,
+            )
+
     def run(self) -> SupervisorResult:
         delays = self._backoff_delays()
         history: list[list[int | None]] = []
@@ -296,6 +331,11 @@ class Supervisor:
                     first_failed, _exitcode(handles[first_failed]), attempt,
                 )
                 self._stop_all(handles)
+                # every worker process is dead: in-flight async commits are
+                # drained by construction, so settle their residue on the
+                # root BEFORE this attempt is accounted and the respawn
+                # resumes from what actually landed
+                self._settle_checkpoints()
                 history.append([_exitcode(h) for h in handles])
                 if attempt >= self.max_restarts:
                     raise SupervisorError(

@@ -43,6 +43,14 @@ PROCESS_CPU_USER_TIME = "process.cpu.utime"
 PROCESS_CPU_SYSTEM_TIME = "process.cpu.stime"
 INPUT_LATENCY = "latency.input"
 OUTPUT_LATENCY = "latency.output"
+# checkpoint-commit pipeline gauges (engine/persistence.CommitMetrics):
+# cumulative stage seconds under "checkpoint.commit.<stage>" for the
+# stages below, plus the in-flight gauges — how much durability work is
+# overlapping the epoch loop right now
+CHECKPOINT_COMMIT_PREFIX = "checkpoint.commit."
+CHECKPOINT_COMMIT_STAGES = ("buffer", "frame", "hash", "upload", "barrier")
+CHECKPOINT_INFLIGHT_BYTES = "checkpoint.inflight.bytes"
+CHECKPOINT_INFLIGHT_JOBS = "checkpoint.inflight.jobs"
 
 LOCAL_DEV_NAMESPACE = "local-dev"
 
@@ -272,9 +280,14 @@ class Telemetry:
         stats_supplier: Callable[[], Any] | None = None,
         *,
         interval_s: float = PERIODIC_READER_INTERVAL_S,
+        extra_metrics: Callable[[], dict[str, float] | None] | None = None,
     ):
         self.config = config
         self.stats_supplier = stats_supplier
+        # extra gauge supplier (name → value), merged into every sample;
+        # the runner wires the persistence CommitMetrics snapshot here so
+        # commit-stage timings and in-flight bytes ride the same exports
+        self.extra_metrics = extra_metrics
         self.interval_s = interval_s
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -293,6 +306,12 @@ class Telemetry:
                 metrics[INPUT_LATENCY] = stats.input_stats.lag_ms
             if stats.output_stats.lag_ms is not None:
                 metrics[OUTPUT_LATENCY] = stats.output_stats.lag_ms
+        if self.extra_metrics is not None:
+            try:
+                metrics.update(self.extra_metrics() or {})
+            except Exception as exc:  # noqa: BLE001
+                # a gauge supplier must never break the sampler
+                logger.debug("extra metrics supplier failed: %s", exc)
         return {
             "resource": self.config.resource(),
             "metrics": metrics,

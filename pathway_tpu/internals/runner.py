@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import time as _time
+from collections import deque
 from typing import Any, Callable
 
 from pathway_tpu.engine import dataflow as df
@@ -216,6 +217,11 @@ def run(
                 trace_parent=os.environ.get("TRACEPARENT"),
             ),
             lambda: result.prober.stats if result.prober is not None else None,
+            # commit-pipeline gauges (stage timings, in-flight bytes) ride
+            # the same metric exports as the process/latency gauges
+            extra_metrics=(
+                storage.metrics.snapshot if storage is not None else None
+            ),
         ).start()
         result.telemetry = telemetry
 
@@ -263,7 +269,11 @@ def run(
                 # epoch (rows staged for later epochs are not yet in any
                 # snapshot), and a failure mid-epoch must not dump
                 # half-stepped operator state — the previous consistent
-                # generation stays committed instead.
+                # generation stays committed instead.  This final commit()
+                # is the shutdown DRAIN of the async pipeline: it publishes
+                # every staged generation in order, barriers on in-flight
+                # chunk writes, and only then commits the final frontier —
+                # so a clean finish commits exactly the flushed frontier.
                 frontier = (
                     result.last_time if result.last_time is not None else -1
                 )
@@ -279,6 +289,12 @@ def run(
                         processed_up_to=frontier,
                         full_operator_dump=result.clean_finish,
                     )
+                    # this drain-commit durably covers every drained commit
+                    # marker (their chunks were flushed at drain), so
+                    # release the tail acks the in-loop published_seq
+                    # gating may still be holding — snapshots staged but
+                    # not yet published when the loop exited
+                    _ack_sources(lowerer.pollers, persisted=True)
         finally:
             # the final commit may raise (failing store): the process-global
             # UDF-cache root and the connector cleanups must be released
@@ -365,11 +381,22 @@ def _input_nodes(scope: df.Scope) -> list[df.InputNode]:
     return [n for n in scope.nodes if isinstance(n, df.InputNode)]
 
 
-def _ack_sources(pollers, *, persisted: bool, up_to_time: int | None = None) -> None:
+def _ack_sources(
+    pollers,
+    *,
+    persisted: bool,
+    up_to_time: int | None = None,
+    marker_frontiers: dict | None = None,
+) -> None:
     """Tell external-offset sources (Kafka groups) a durability point passed.
 
-    ``persisted=True``: called after ``storage.commit()`` — acks pollers
-    whose rows land in input snapshots (replay covers them).
+    ``persisted=True``: called when ``storage.published_seq`` advances —
+    a staged snapshot became durable (its generation manifest published,
+    or a confirmed no-op) — and acks pollers whose rows land in input
+    snapshots (replay covers them), gated on ``marker_frontiers`` (the
+    per-poller drained-marker frontier captured when that snapshot was
+    STAGED): markers drained while the publish was in flight belong to a
+    later snapshot and must not be acked by this one.
     ``persisted=False``: called after an epoch ran — acks pollers with no
     snapshot state, gated on the epoch time.
     """
@@ -378,8 +405,23 @@ def _ack_sources(pollers, *, persisted: bool, up_to_time: int | None = None) -> 
         if ack is None:
             continue
         has_snapshots = getattr(poller, "persist_state", None) is not None
-        if has_snapshots == persisted:
+        if has_snapshots != persisted:
+            continue
+        if persisted and marker_frontiers is not None:
+            ack(up_to_marker=marker_frontiers.get(id(poller)))
+        else:
             ack(up_to_time)
+
+
+def _marker_frontiers(pollers) -> dict:
+    """{id(poller): drained-marker frontier} for persisted pollers, taken
+    at snapshot-STAGING time — what the staged snapshot actually covers."""
+    out: dict = {}
+    for poller in pollers:
+        frontier = getattr(poller, "marker_frontier", None)
+        if frontier is not None and getattr(poller, "persist_state", None) is not None:
+            out[id(poller)] = frontier()
+    return out
 
 
 def _attach_wake(pollers) -> "Any":
@@ -420,16 +462,32 @@ def _event_loop(
         (storage.snapshot_interval_ms / 1000.0) if storage is not None else None
     )
     last_snapshot = _time.monotonic()
+    # (staged durability seq, marker frontiers at staging) awaiting publish
+    pending_acks: deque = deque()
     while True:
         if (
             storage is not None
             and (_time.monotonic() - last_snapshot) >= snapshot_interval
         ):
-            storage.commit(processed_up_to=last_time)
+            # non-blocking commit: chunk framing/hash/upload and the
+            # manifest barrier run on the persistence writer pool while
+            # this loop keeps computing epochs (engine/persistence.py);
+            # the run's final commit (run()'s finally) drains the pipeline
+            staged = storage.commit_async(processed_up_to=last_time)
+            pending_acks.append((staged, _marker_frontiers(pollers)))
             last_snapshot = _time.monotonic()
-            # snapshot persisted: sources whose rows are in it may commit
-            # their broker offsets for everything it covers
-            _ack_sources(pollers, persisted=True)
+        while (
+            storage is not None
+            and pending_acks
+            and storage.published_seq >= pending_acks[0][0]
+        ):
+            # a staged snapshot became DURABLE (its generation manifest
+            # published, or a confirmed no-op): sources whose rows are in
+            # it may now commit their broker offsets — only up to the
+            # marker frontier captured when it was staged, and never on
+            # commit_async returning, which precedes durability
+            _seq, frontiers = pending_acks.popleft()
+            _ack_sources(pollers, persisted=True, marker_frontiers=frontiers)
         exhausted = True
         for poller in pollers:
             if not poller.poll():
@@ -524,14 +582,25 @@ def _event_loop_coordinated(
         (storage.snapshot_interval_ms / 1000.0) if storage is not None else None
     )
     last_snapshot = _time.monotonic()
+    pending_acks: deque = deque()  # (staged seq, marker frontiers)
     while True:
         if (
             storage is not None
             and (_time.monotonic() - last_snapshot) >= snapshot_interval
         ):
-            storage.commit(processed_up_to=last_time)
+            # non-blocking: durability I/O overlaps the BSP epoch rounds
+            staged = storage.commit_async(processed_up_to=last_time)
+            pending_acks.append((staged, _marker_frontiers(pollers)))
             last_snapshot = _time.monotonic()
-            _ack_sources(pollers, persisted=True)
+        while (
+            storage is not None
+            and pending_acks
+            and storage.published_seq >= pending_acks[0][0]
+        ):
+            # broker offsets ack only once the staged snapshot is durable,
+            # and only up to the marker frontier captured at staging
+            _seq, frontiers = pending_acks.popleft()
+            _ack_sources(pollers, persisted=True, marker_frontiers=frontiers)
         exhausted = True
         for poller in pollers:
             if not poller.poll():

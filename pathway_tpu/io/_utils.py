@@ -430,21 +430,40 @@ class _QueuePoller:
                     self.persist_state.log.flush_chunk()
         return False
 
-    def ack_processed(self, up_to_time: int | None = None) -> None:
+    def marker_frontier(self) -> int:
+        """Highest COMMIT-marker sequence drained so far.  The runner
+        captures this when it STAGES an async snapshot: only markers below
+        the captured frontier are covered by that snapshot, so the ack
+        that follows its publication must stop there (markers drained
+        while the publish was in flight belong to a later snapshot)."""
+        return self._drained_commits
+
+    def ack_processed(
+        self,
+        up_to_time: int | None = None,
+        *,
+        up_to_marker: int | None = None,
+    ) -> None:
         """Durability point reached: let the reader commit its external
         offsets (on its own thread) for every COMMIT marker whose rows are
         covered.  ``up_to_time`` — the epoch the engine just processed —
         gates markers for non-persisted sources (rows staged for a later
-        epoch are still in memory only); ``None`` means all drained markers
-        are durable (their snapshot chunks were flushed and committed).
-        The reader commits the offsets it captured at the marker — never
-        its live position, which may already cover unprocessed rows."""
+        epoch are still in memory only); ``up_to_marker`` gates on the
+        marker frontier a published snapshot actually covers (see
+        :meth:`marker_frontier`); ``None`` for both means all drained
+        markers are durable.  The reader commits the offsets it captured
+        at the marker — never its live position, which may already cover
+        unprocessed rows."""
         request = getattr(self.reader, "request_offset_commit", None)
         if request is None or not self._commit_markers:
             return
         seq = None
         while self._commit_markers and (
-            up_to_time is None or self._commit_markers[0][1] <= up_to_time
+            (up_to_time is None or self._commit_markers[0][1] <= up_to_time)
+            and (
+                up_to_marker is None
+                or self._commit_markers[0][0] <= up_to_marker
+            )
         ):
             seq = self._commit_markers.popleft()[0]
         if seq is not None:

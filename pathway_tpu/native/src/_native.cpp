@@ -462,6 +462,66 @@ static bool encode_value(PyObject *v, Buf &out) {
   return true;
 }
 
+// ---------------------------------------------------------------------------
+// CRC-32C (Castagnoli) — hardware SSE4.2 when -march=native provides it,
+// slicing-free byte table otherwise.  Releases the GIL: the checkpoint
+// writer pool frames chunks concurrently with the epoch loop, and a
+// GIL-holding checksum would serialize them right back.
+// ---------------------------------------------------------------------------
+
+#if defined(__SSE4_2__)
+#include <nmmintrin.h>
+#endif
+
+static uint32_t crc32c_table_[256];
+static bool crc32c_table_ready_ = false;
+
+static void crc32c_build_table() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; j++)
+      crc = (crc & 1) ? (crc >> 1) ^ 0x82F63B78u : crc >> 1;
+    crc32c_table_[i] = crc;
+  }
+  crc32c_table_ready_ = true;
+}
+
+static uint32_t crc32c_update(uint32_t state, const uint8_t *p, size_t n) {
+#if defined(__SSE4_2__)
+  while (n >= 8) {
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    state = (uint32_t)_mm_crc32_u64((uint64_t)state, v);
+    p += 8;
+    n -= 8;
+  }
+  while (n--) state = _mm_crc32_u8(state, *p++);
+  return state;
+#else
+  while (n--) state = crc32c_table_[(state ^ *p++) & 0xFF] ^ (state >> 8);
+  return state;
+#endif
+}
+
+// crc32c(bytes_like, crc=0) -> int  (chainable, like codec.crc32c)
+static PyObject *py_crc32c(PyObject *, PyObject *args) {
+  Py_buffer view;
+  unsigned long crc = 0;
+  if (!PyArg_ParseTuple(args, "y*|k", &view, &crc)) return nullptr;
+  // build the table fallback WHILE STILL HOLDING the GIL: crc32c_update
+  // runs GIL-released, and a lazy build there would be a C++ data race
+  // between writer-pool threads
+  if (!crc32c_table_ready_) crc32c_build_table();
+  uint32_t state = ~(uint32_t)crc;
+  const uint8_t *p = (const uint8_t *)view.buf;
+  size_t n = (size_t)view.len;
+  Py_BEGIN_ALLOW_THREADS;
+  state = crc32c_update(state, p, n);
+  Py_END_ALLOW_THREADS;
+  PyBuffer_Release(&view);
+  return PyLong_FromUnsignedLong(~state & 0xFFFFFFFFu);
+}
+
 // encode_row(tuple_or_seq) -> bytes
 static PyObject *py_encode_row(PyObject *, PyObject *arg) {
   PyObject *seq = PySequence_Fast(arg, "encode_row expects a sequence");
@@ -471,6 +531,92 @@ static PyObject *py_encode_row(PyObject *, PyObject *arg) {
   out.u64((uint64_t)n);
   for (Py_ssize_t i = 0; i < n; i++) {
     if (!encode_value(PySequence_Fast_GET_ITEM(seq, i), out)) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+  }
+  Py_DECREF(seq);
+  return PyBytes_FromStringAndSize((const char *)out.d.data(), out.d.size());
+}
+
+// append a Python int's low 128 bits as unsigned little-endian (the
+// `key & ((1 << 128) - 1)` masking of codec.encode_event)
+static bool append_u128_long(Buf &out, PyObject *val) {
+  uint64_t lo = PyLong_AsUnsignedLongLongMask(val);
+  if (lo == (uint64_t)-1 && PyErr_Occurred()) return false;
+  PyObject *sixtyfour = PyLong_FromLong(64);
+  PyObject *shifted = PyNumber_Rshift(val, sixtyfour);
+  Py_DECREF(sixtyfour);
+  if (!shifted) return false;
+  uint64_t hi = PyLong_AsUnsignedLongLongMask(shifted);
+  Py_DECREF(shifted);
+  if (hi == (uint64_t)-1 && PyErr_Occurred()) return false;
+  out.raw(&lo, 8);
+  out.raw(&hi, 8);
+  return true;
+}
+
+// encode_events(seq of (kind, key, row, time)) -> bytes
+// Batched codec.encode_event: one buffer per snapshot chunk, the row
+// payload length patched in place — no per-event allocations.  The
+// checkpoint writer pool encodes whole raw-event batches here so the
+// epoch loop never pays the per-row serializer (input_snapshot.rs
+// serialization analog).
+static PyObject *py_encode_events(PyObject *, PyObject *arg) {
+  PyObject *seq = PySequence_Fast(arg, "encode_events expects a sequence");
+  if (!seq) return nullptr;
+  Buf out;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *evseq = PySequence_Fast(
+        PySequence_Fast_GET_ITEM(seq, i),
+        "encode_events: event must be a sequence");
+    if (!evseq) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    bool ok = PySequence_Fast_GET_SIZE(evseq) == 4;
+    if (!ok) {
+      PyErr_SetString(PyExc_ValueError,
+                      "encode_events: expected (kind, key, row, time)");
+    } else {
+      long kind = PyLong_AsLong(PySequence_Fast_GET_ITEM(evseq, 0));
+      ok = !(kind == -1 && PyErr_Occurred());
+      if (ok) {
+        out.u8((uint8_t)kind);
+        if (kind == 1 || kind == 2) {  // EV_INSERT / EV_DELETE
+          ok = append_u128_long(out, PySequence_Fast_GET_ITEM(evseq, 1));
+          if (ok) {
+            size_t len_at = out.d.size();
+            out.u64(0);  // payload length, patched below
+            size_t start = out.d.size();
+            PyObject *rowseq = PySequence_Fast(
+                PySequence_Fast_GET_ITEM(evseq, 2),
+                "encode_events: row must be a sequence");
+            ok = rowseq != nullptr;
+            if (ok) {
+              Py_ssize_t rn = PySequence_Fast_GET_SIZE(rowseq);
+              out.u64((uint64_t)rn);
+              for (Py_ssize_t j = 0; ok && j < rn; j++) {
+                ok = encode_value(PySequence_Fast_GET_ITEM(rowseq, j), out);
+              }
+              Py_DECREF(rowseq);
+            }
+            if (ok) {
+              uint64_t plen = (uint64_t)(out.d.size() - start);
+              std::memcpy(out.d.data() + len_at, &plen, 8);
+            }
+          }
+        } else if (kind == 3) {  // EV_ADVANCE_TIME
+          uint64_t t = PyLong_AsUnsignedLongLongMask(
+              PySequence_Fast_GET_ITEM(evseq, 3));
+          ok = !(t == (uint64_t)-1 && PyErr_Occurred());
+          if (ok) out.u64(t);
+        }  // EV_FINISHED and others: kind byte only, like encode_event
+      }
+    }
+    Py_DECREF(evseq);
+    if (!ok) {
       Py_DECREF(seq);
       return nullptr;
     }
@@ -2679,6 +2825,10 @@ static PyMethodDef methods[] = {
     {"hash_values", py_hash_values, METH_O, "stable 128-bit value hash"},
     {"blake2b_128", py_blake2b_128, METH_O, "blake2b-128 digest"},
     {"encode_row", py_encode_row, METH_O, "PWT1-encode a row"},
+    {"encode_events", py_encode_events, METH_O,
+     "PWT1-encode a batch of snapshot events"},
+    {"crc32c", py_crc32c, METH_VARARGS,
+     "CRC-32C (Castagnoli), hardware-accelerated, GIL-released"},
     {"decode_row", py_decode_row, METH_VARARGS, "PWT1-decode a row"},
     {"upsert_chain", py_upsert_chain, METH_VARARGS,
      "(deltas, state) -> chained retract+insert delta list"},

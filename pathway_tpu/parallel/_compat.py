@@ -1,0 +1,49 @@
+"""JAX API compatibility shims for the parallel layer.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to the
+top-level ``jax.shard_map`` export, and its replication-check kwarg was
+renamed ``check_rep`` → ``check_vma`` along the way.  Every module here
+imports it from this shim so both vintages work — the container pins an
+older jax than the one the newest call-site syntax targets.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+try:  # newer jax: top-level export (kwarg: check_vma)
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4/0.5: experimental home (kwarg: check_rep)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+try:
+    _PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+except (TypeError, ValueError):  # pragma: no cover - exotic builds
+    _PARAMS = frozenset()
+
+
+def pcast(x: Any, axes: Any, *, to: str = "varying") -> Any:
+    """``jax.lax.pcast`` when this build tracks varying-manifest axes;
+    identity otherwise (older jax does not type-check carry variance, so
+    there is nothing to cast)."""
+    import jax
+
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, axes, to=to)
+
+
+def shard_map(f: Any, **kwargs: Any) -> Any:
+    """``jax.shard_map`` with the replication-check kwarg translated to
+    whatever this jax build understands (dropped if it knows neither)."""
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        value = kwargs.pop("check_vma")
+        if "check_rep" in _PARAMS:
+            kwargs["check_rep"] = value
+    elif "check_rep" in kwargs and "check_rep" not in _PARAMS:
+        value = kwargs.pop("check_rep")
+        if "check_vma" in _PARAMS:
+            kwargs["check_vma"] = value
+    return _shard_map(f, **kwargs)

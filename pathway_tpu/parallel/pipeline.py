@@ -42,8 +42,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pathway_tpu.parallel._compat import shard_map
 
 from pathway_tpu.models.decoder import DecoderConfig, decoder_layer, _rms, _sw_mask
 

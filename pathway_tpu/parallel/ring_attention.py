@@ -28,8 +28,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pathway_tpu.parallel._compat import pcast, shard_map
 
 NEG_INF = -1e30
 
@@ -71,7 +72,7 @@ def _ring_attention_local(q, k, v, bias, *, heads: int, axis_name: str):
     # front (they become varying after one ppermute'd step; scan requires
     # carry types to be loop-invariant)
     def varying(x):
-        return jax.lax.pcast(x, (axis_name,), to="varying")
+        return pcast(x, (axis_name,), to="varying")
 
     m0 = varying(jnp.full((B, heads, S_blk), NEG_INF, jnp.float32))
     l0 = varying(jnp.zeros((B, heads, S_blk), jnp.float32))

@@ -140,6 +140,34 @@ class TestFlakyBackend:
         st2 = pz.PersistentStorage(flaky)
         state2 = st2.register_source("src")
         state2.log.record(2, ("b",), 1)
+        # the chunk write runs on the async writer pool: flush_chunk hands
+        # off without blocking, and the injected failure surfaces at the
+        # commit barrier — the manifest referencing the missing chunk is
+        # never published
+        state2.log.flush_chunk()
+        state2.pending_offset = {"rows": 2}
+        with pytest.raises(pz.CheckpointError, match="async write"):
+            st2.commit()
+
+        rows, offset = self._replayed(raw)
+        assert rows == [(1, ("a",), 1)]
+        assert offset == {"rows": 1}
+
+    def test_failed_chunk_put_raises_inline_in_sync_mode(
+        self, tmp_path, monkeypatch
+    ):
+        """PATHWAY_CHECKPOINT_WRITERS=0 keeps the pre-pipelining inline
+        path: the injected put failure escapes flush_chunk itself."""
+        monkeypatch.setenv("PATHWAY_CHECKPOINT_WRITERS", "0")
+        raw = pz.FileBackend(str(tmp_path / "store"))
+        self._commit_one(raw, 1, ("a",))
+
+        flaky = faults.FlakyBackend(
+            raw, faults.FaultPlan([{"kind": "blob_put", "nth": 1}])
+        )
+        st2 = pz.PersistentStorage(flaky)
+        state2 = st2.register_source("src")
+        state2.log.record(2, ("b",), 1)
         with pytest.raises(faults.InjectedFault):
             state2.log.flush_chunk()
 
@@ -235,7 +263,11 @@ class TestFlakyBackend:
                 [{"kind": "blob_put", "key": "manifests", "prob": 1.0}]
             )
         )
-        with pytest.raises(faults.InjectedFault):
+        # the manifest put fails on the async committer thread, so it
+        # surfaces as the sticky CheckpointError the drain re-raises
+        # (chained from the InjectedFault); in sync mode
+        # (PATHWAY_CHECKPOINT_WRITERS=0) the InjectedFault escapes directly
+        with pytest.raises((faults.InjectedFault, pz.CheckpointError)):
             run_once([])
         faults.clear_plan()
 
@@ -426,6 +458,73 @@ class TestCommFaults:
                 time.sleep(0.05)
             with link.send_lock:
                 assert not link.sent_buf, "acks never trimmed the buffer"
+        finally:
+            m0.close()
+            m1.close()
+
+    def test_heartbeat_loop_not_blocked_by_held_send_lock(self, monkeypatch):
+        """PR-1 residue fix: the heartbeat loop must SKIP a link whose
+        ``send_lock`` is held (a data-phase ``sendall`` can sit on it for
+        up to the send deadline when a peer hangs), never block on it —
+        otherwise hung-peer detection and heartbeats to every OTHER peer
+        stall behind one wedged link."""
+        monkeypatch.setenv("PATHWAY_COMM_HEARTBEAT_S", "0.1")
+        port = free_port(3)
+        meshes: dict[int, TcpMesh] = {}
+        errs: list = []
+
+        def boot(wid):
+            try:
+                meshes[wid] = TcpMesh(wid, 3, port, secret="tok").start()
+            except Exception as exc:  # noqa: BLE001
+                errs.append((wid, exc))
+
+        threads = [threading.Thread(target=boot, args=(w,)) for w in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not errs, errs
+        try:
+            # wedge worker 0's link to peer 1 exactly like a data send
+            # stuck inside sendall; 0's heartbeat loop iterates peer 1
+            # FIRST, so the old code would park here forever
+            link01 = meshes[0]._links[1]
+            assert link01.send_lock.acquire(timeout=5)
+            try:
+                link20 = meshes[2]._links[0]
+                with link20.cv:
+                    seen_before = link20.last_seen
+                time.sleep(1.0)  # ~10 heartbeat intervals
+                with link20.cv:
+                    seen_after = link20.last_seen
+                assert seen_after > seen_before, (
+                    "worker 0's heartbeats to peer 2 stalled behind "
+                    "peer 1's held send_lock"
+                )
+            finally:
+                link01.send_lock.release()
+        finally:
+            for mesh in meshes.values():
+                mesh.close()
+
+    def test_send_deadline_configured_on_sockets(self, monkeypatch):
+        """The data-phase sendall deadline (SO_SNDTIMEO) is set from
+        PATHWAY_COMM_SEND_DEADLINE_S so a hung peer with a full TCP buffer
+        cannot park a sender (holding send_lock) indefinitely."""
+        import socket as _socket
+        import struct as _struct
+
+        monkeypatch.setenv("PATHWAY_COMM_SEND_DEADLINE_S", "7.5")
+        m0, m1 = _mesh_pair(monkeypatch)
+        try:
+            assert m0.send_deadline == pytest.approx(7.5)
+            sock = m0._links[1].sock
+            raw = sock.getsockopt(
+                _socket.SOL_SOCKET, _socket.SO_SNDTIMEO, _struct.calcsize("ll")
+            )
+            sec, usec = _struct.unpack("ll", raw)
+            assert sec + usec / 1e6 == pytest.approx(7.5)
         finally:
             m0.close()
             m1.close()

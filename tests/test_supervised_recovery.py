@@ -253,6 +253,12 @@ def test_sigkill_one_worker_supervisor_recovers_byte_identical(tmp_path):
     got = {json.loads(k)["k"]: json.loads(k)["n"] for k in net}
     assert got == {0: 15, 1: 15, 2: 15}, got
 
+    # recovery left a healthy root: the offline audit agrees
+    from pathway_tpu.engine import persistence as pz
+
+    report = pz.scrub_root(pz.FileBackend(str(faulted_dir / "pstore")))
+    assert report["ok"] is True, report
+
 
 def test_corrupt_newest_checkpoint_falls_back_to_verified_generation(tmp_path):
     """Acceptance: the fault plan bit-flips every checkpoint generation
@@ -319,6 +325,63 @@ def test_corrupt_newest_checkpoint_falls_back_to_verified_generation(tmp_path):
     net = dict(json.loads(expected.decode()))
     got = {json.loads(k)["k"]: json.loads(k)["n"] for k in net}
     assert got == {0: 15, 1: 15, 2: 15}, got
+
+
+def test_sigkill_mid_async_commit_recovers_and_scrub_is_clean(tmp_path):
+    """Acceptance: a ``writer_crash`` fault SIGKILLs worker 0 from inside
+    its checkpoint writer pool MID-async-commit — some chunks of the
+    staged generation are on disk, its manifest never published.
+    Supervised recovery must resume from the last fully landed generation
+    and converge to the unfaulted net output, and the offline audit
+    (``pathway_tpu scrub``) must see a CLEAN root after the kill: the
+    partial generation is unreachable because no manifest references it."""
+    clean_dir = tmp_path / "clean"
+    clean_dir.mkdir()
+    res_clean = _run_supervised(
+        clean_dir, plan_json=None, scenario=_gated_scenario
+    )
+    assert res_clean.restarts == 0, res_clean.history
+    expected = canonical_bytes(clean_dir)
+    assert expected != b"[]"
+
+    faulted_dir = tmp_path / "faulted"
+    faulted_dir.mkdir()
+    plan = json.dumps(
+        {
+            "seed": 23,
+            "faults": [
+                # worker 0 owns the source log (non-partitioned reader);
+                # the gated source only reaches row 10 once generation 1
+                # is on disk, so by its 12th chunk write at least one
+                # generation has fully landed — the kill then leaves a
+                # NEWER generation mid-flight
+                {
+                    "kind": "writer_crash",
+                    "worker": 0,
+                    "key": "snapshots/",
+                    "nth": 12,
+                    "attempt": 0,
+                },
+            ],
+        }
+    )
+    res = _run_supervised(
+        faulted_dir, plan_json=plan, scenario=_gated_scenario
+    )
+
+    assert res.restarts >= 1, res.history
+    assert res.history[0][0] == -signal.SIGKILL, res.history
+    assert res.exit_codes == [0] * N_WORKERS, res.history
+    assert canonical_bytes(faulted_dir) == expected
+    net = dict(json.loads(expected.decode()))
+    got = {json.loads(k)["k"]: json.loads(k)["n"] for k in net}
+    assert got == {0: 15, 1: 15, 2: 15}, got
+
+    # acceptance: no partial generation is reachable after the chaos kill
+    from pathway_tpu.engine import persistence as pz
+
+    report = pz.scrub_root(pz.FileBackend(str(faulted_dir / "pstore")))
+    assert report["ok"] is True, report
 
 
 def test_transient_comm_fault_absorbed_without_restart(tmp_path):

@@ -132,6 +132,35 @@ def test_sample_contains_reference_gauges():
     assert sample["resource"]["service.namespace"] == "local-dev"
 
 
+def test_commit_pipeline_gauges_ride_the_sample():
+    """The persistence CommitMetrics snapshot merges into every metrics
+    sample (stage timings + in-flight gauges), and a failing supplier
+    never breaks the sampler."""
+    from pathway_tpu.engine.persistence import CommitMetrics
+    from pathway_tpu.engine.telemetry import (
+        CHECKPOINT_COMMIT_PREFIX,
+        CHECKPOINT_COMMIT_STAGES,
+        CHECKPOINT_INFLIGHT_BYTES,
+    )
+
+    metrics = CommitMetrics()
+    metrics.add_stage("upload", 0.25)
+    metrics.job_started(1024)
+    cfg = TelemetryConfig.create(license=License.new(None), run_id="r9")
+    t = Telemetry(cfg, extra_metrics=metrics.snapshot)
+    sample = t.sample()
+    for stage in CHECKPOINT_COMMIT_STAGES:
+        assert CHECKPOINT_COMMIT_PREFIX + stage in sample["metrics"]
+    assert sample["metrics"][CHECKPOINT_COMMIT_PREFIX + "upload"] == 0.25
+    assert sample["metrics"][CHECKPOINT_INFLIGHT_BYTES] == 1024.0
+
+    def broken():
+        raise RuntimeError("supplier died")
+
+    t_broken = Telemetry(cfg, extra_metrics=broken)
+    assert PROCESS_MEMORY_USAGE in t_broken.sample()["metrics"]
+
+
 def test_trace_parent_root_id():
     cfg = TelemetryConfig.create(
         license=License.new(None),
