@@ -1,0 +1,145 @@
+"""Observability overhead benchmark: what does the instrumentation cost?
+
+The unified metrics registry (``engine/metrics.py``) stamps every epoch
+(duration histogram, flight-recorder ring append) and every comm frame
+(counter adds).  This harness prices that on a many-epoch host workload:
+the identical pipeline runs with the registry ENABLED (default) and
+DISABLED (``pathway_tpu.engine.metrics.set_enabled(False)`` — every
+registry update returns immediately, the lever
+``PATHWAY_METRICS_DISABLED`` maps to), interleaved per rep so machine
+noise hits both modes equally, with medians reported per repo
+convention.  The flight recorder is deliberately ungated (crash
+forensics stay on even with metrics disabled), so the end-to-end delta
+isolates the registry; ``micro_cost_us`` prices registry + recorder
+together.
+
+Acceptance (ISSUE 4): instrumented epoch-loop overhead <= 2% median.
+
+Prints one JSON line per mode:
+  {"metric": "telemetry_overhead_rows_per_sec", "mode": ..., "value": N, ...}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_BATCHES = 1000  # one commit marker per batch -> ~one epoch per batch
+BATCH_ROWS = 25
+REPS = 7
+
+
+def run_once(enabled: bool) -> float:
+    import pathway_tpu as pw
+    from pathway_tpu.engine import metrics as em
+    from pathway_tpu.internals.parse_graph import G
+
+    G.clear()
+    em.set_enabled(enabled)
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            row = 0
+            for _ in range(N_BATCHES):
+                for _ in range(BATCH_ROWS):
+                    self.next(k=row % 97, v=1)
+                    row += 1
+                self.commit()
+
+    t = pw.io.python.read(
+        Src(), schema=pw.schema_from_types(k=int, v=int), name="src"
+    )
+    counts = t.groupby(t.k).reduce(k=t.k, n=pw.reducers.count())
+    seen = []
+    pw.io.subscribe(counts, on_change=lambda **kw: seen.append(None))
+    t0 = time.perf_counter()
+    result = pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    dt = time.perf_counter() - t0
+    em.set_enabled(True)
+    assert result.epochs >= N_BATCHES // 2, result.epochs
+    return (N_BATCHES * BATCH_ROWS) / dt
+
+
+def micro_cost_us() -> float:
+    """Noise-free bound: µs per epoch of the instrumentation itself (one
+    histogram observe + one flight-recorder append + two perf_counter
+    reads) measured in isolation — what the end-to-end comparison is
+    trying to resolve under 2-3x machine noise."""
+    from pathway_tpu.engine import flight_recorder as fr
+    from pathway_tpu.engine import metrics as em
+
+    hist = em.get_registry().histogram(
+        "bench.micro.ms", buckets=(0.1, 1, 10, 100)
+    )
+    rec = fr.get_recorder()
+    n = 100_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        s = time.perf_counter()
+        hist.observe((time.perf_counter() - s) * 1000.0)
+        rec.record("epoch", time=i, index=i)
+    return (time.perf_counter() - t0) / n * 1e6
+
+
+def main() -> None:
+    # interleaved reps: container throughput swings 2-3x between runs, so
+    # alternating modes within each rep (and taking medians) is the only
+    # honest comparison on this rig
+    samples: dict[str, list[float]] = {"on": [], "off": []}
+    run_once(True)  # warm-ups (jit, imports, allocator) outside the
+    run_once(False)  # measurement — the rig speeds up over its first runs
+    run_once(True)
+    for rep in range(REPS):
+        # alternate order per rep: a monotonic machine-speed trend (cold
+        # caches easing, a noisy neighbor leaving) must not systematically
+        # favor whichever mode runs second
+        order = (True, False) if rep % 2 == 0 else (False, True)
+        for enabled in order:
+            samples["on" if enabled else "off"].append(run_once(enabled))
+    medians = {mode: statistics.median(vals) for mode, vals in samples.items()}
+    for mode in ("off", "on"):
+        print(
+            json.dumps(
+                {
+                    "metric": "telemetry_overhead_rows_per_sec",
+                    "mode": mode,
+                    "value": round(medians[mode]),
+                    "reps": REPS,
+                    "rows": N_BATCHES * BATCH_ROWS,
+                    "samples": [round(v) for v in samples[mode]],
+                }
+            )
+        )
+    # paired ratios: each rep's on/off runs are wall-clock neighbors, so a
+    # machine-speed drift across the session cancels inside the ratio
+    ratios = [on / off for on, off in zip(samples["on"], samples["off"])]
+    overhead = 1.0 - statistics.median(ratios)
+    print(
+        json.dumps(
+            {
+                "metric": "telemetry_overhead_pct",
+                "value": round(overhead * 100.0, 2),
+                "acceptance": "<= 2% median",
+                "paired_ratios": [round(r, 3) for r in ratios],
+            }
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "telemetry_micro_cost_us_per_epoch",
+                "value": round(micro_cost_us(), 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -78,6 +78,14 @@ def spawn_program(
     # for this run
     env_base = dict(env_base)
     env_base.setdefault("PATHWAY_COMM_SECRET", secrets.token_hex(16))
+    # one trace per run: every worker inherits this traceparent, so its
+    # epoch/commit/recovery spans correlate into a single trace in any
+    # OTLP collector (worker 0 re-broadcasts it over the mesh for workers
+    # launched outside spawn); restarts keep it — a recovery is part of
+    # the same run's story
+    from pathway_tpu.engine.telemetry import mint_traceparent
+
+    env_base.setdefault("TRACEPARENT", mint_traceparent())
 
     if supervise:
         from pathway_tpu.engine.supervisor import (
@@ -98,6 +106,16 @@ def spawn_program(
             env[ENV_ATTEMPT] = str(attempt)
             return subprocess.Popen([program, *arguments], env=env)
 
+        def echo_post_mortem(post_mortem: dict) -> None:
+            for wid, info in sorted(post_mortem.get("workers", {}).items()):
+                click.echo(
+                    f"[pathway_tpu] worker {wid}: "
+                    f"{len(info.get('dumps', []))} flight-recorder dump(s) "
+                    f"(last reason: {(info.get('reasons') or [None])[-1]}) — "
+                    f"inspect with `pathway_tpu blackbox {checkpoint_root}`",
+                    err=True,
+                )
+
         try:
             result = Supervisor(
                 spawn_one,
@@ -107,6 +125,9 @@ def spawn_program(
             ).run()
         except SupervisorError as exc:
             click.echo(f"[pathway_tpu] {exc}", err=True)
+            # the crash-loop black boxes are the post-mortem evidence —
+            # point the operator at them before giving up
+            echo_post_mortem(exc.post_mortem)
             sys.exit(1)
         if result.restarts:
             click.echo(
@@ -129,6 +150,7 @@ def spawn_program(
                    if rejected else ""),
                 err=True,
             )
+        echo_post_mortem(result.post_mortem)
         sys.exit(0)
 
     handles: list[subprocess.Popen] = []
@@ -359,6 +381,85 @@ def scrub(worker, as_json, repair, root):
         err=True,
     )
     sys.exit(0 if report["ok"] else 1)
+
+
+@cli.command()
+@click.option(
+    "--worker",
+    metavar="N",
+    type=int,
+    default=None,
+    help="show only this worker's dumps",
+)
+@click.option(
+    "--tail",
+    metavar="N",
+    type=click.IntRange(min=1),
+    default=20,
+    help="events to show from the end of each dump's ring",
+)
+@click.option(
+    "--json", "as_json", is_flag=True, help="emit the raw dumps as JSON"
+)
+@click.argument("root", type=click.Path(exists=True, file_okay=False))
+def blackbox(worker, tail, as_json, root):
+    """Pretty-print crash flight-recorder dumps under a persistence ROOT.
+
+    Workers dump their bounded event ring (epoch transitions, commit
+    publishes, comm reconnects, injected faults) to ``<ROOT>/blackbox/``
+    when they crash or a fault fires; the supervisor summarizes them on
+    ``SupervisorResult.post_mortem``.  This command renders the full
+    dumps for post-mortem analysis.  Exits non-zero when no dump exists.
+    """
+    import datetime
+    import json as _json
+
+    from pathway_tpu.engine.flight_recorder import gather_dumps
+
+    dumps = gather_dumps(root)
+    if worker is not None:
+        dumps = {w: d for w, d in dumps.items() if w == worker}
+    if as_json:
+        click.echo(_json.dumps(dumps, indent=2, sort_keys=True))
+        sys.exit(0 if dumps else 1)
+    if not dumps:
+        click.echo(
+            f"[pathway_tpu] no flight-recorder dumps under {root}/blackbox",
+            err=True,
+        )
+        sys.exit(1)
+
+    def when(ts):
+        # best-effort like the gather layer: a parseable-but-partial dump
+        # (hand-edited, older format) must render, not traceback
+        if not isinstance(ts, (int, float)):
+            return "--:--:--.---"
+        return datetime.datetime.fromtimestamp(ts).strftime("%H:%M:%S.%f")[:-3]
+
+    for wid, payloads in sorted(dumps.items()):
+        for payload in payloads:
+            events = payload.get("events") or []
+            click.echo(
+                f"worker {wid} · attempt {payload.get('attempt')} · "
+                f"pid {payload.get('pid')} · run {payload.get('run_id')}"
+            )
+            click.echo(f"  reason: {payload.get('reason')}")
+            if payload.get("trace_parent"):
+                click.echo(f"  trace:  {payload['trace_parent']}")
+            click.echo(
+                f"  events: {len(events)} recorded, last {min(tail, len(events))}:"
+            )
+            for ev in events[-tail:]:
+                detail = ", ".join(
+                    f"{k}={v}"
+                    for k, v in ev.items()
+                    if k not in ("ts", "mono", "seq", "kind")
+                )
+                click.echo(
+                    f"    {when(ev.get('ts'))}  #{str(ev.get('seq', '?')):>5}  "
+                    f"{str(ev.get('kind', '?')):<22}{detail}"
+                )
+    sys.exit(0)
 
 
 @cli.command(name="spawn-from-env")

@@ -57,6 +57,8 @@ from typing import Any, Callable, Hashable
 
 from pathway_tpu.engine import codec as _codec
 from pathway_tpu.engine import faults as _faults
+from pathway_tpu.engine import flight_recorder as _blackbox
+from pathway_tpu.engine import metrics as _metrics
 from pathway_tpu.engine.types import shard_to_worker
 
 _log = logging.getLogger("pathway_tpu.comm")
@@ -284,6 +286,40 @@ class TcpMesh:
         self._accepted: set[int] = set()
         self._acc_done = threading.Event()
         self._acc_err: list[BaseException] = []
+        # mesh observability: registered into the process-wide registry so
+        # /metrics and the OTLP exporter see comm health without touching
+        # the hot path (plain counter adds; see engine/metrics.py)
+        reg = _metrics.get_registry()
+        wl = {"worker": worker_id}
+        self._m_frames_sent = reg.counter(
+            "comm.frames.sent", "mesh data frames written", **wl
+        )
+        self._m_bytes_sent = reg.counter(
+            "comm.bytes.sent", "mesh bytes written (headers included)", **wl
+        )
+        self._m_frames_recv = reg.counter(
+            "comm.frames.received", "mesh data frames received", **wl
+        )
+        self._m_bytes_recv = reg.counter(
+            "comm.bytes.received", "mesh bytes received (headers included)", **wl
+        )
+        self._m_reconnects = reg.counter(
+            "comm.reconnects", "link reconnect attempts scheduled", **wl
+        )
+        self._m_retransmits = reg.counter(
+            "comm.retransmits", "frames re-delivered by link resyncs", **wl
+        )
+        self._m_evictions = reg.counter(
+            "comm.retransmit.evictions",
+            "unacked frames evicted from the retransmit buffer", **wl,
+        )
+        self._m_peers_dead = reg.counter(
+            "comm.peers.dead", "peers declared dead", **wl
+        )
+        self._m_staleness = reg.gauge(
+            "comm.heartbeat.staleness.s",
+            "seconds since the quietest live peer was last heard", **wl,
+        )
 
     def _reconnect_delays(self):
         """Bounded backoff schedule for link reconnects — the udfs
@@ -452,6 +488,9 @@ class TcpMesh:
                 if size > MAX_FRAME_BYTES:
                     raise ValueError(f"comm frame of {size} bytes exceeds cap")
                 blob = _recv_exact(sock, size)
+                self._m_bytes_recv.inc(_HDR.size + size)
+                if seq != 0:
+                    self._m_frames_recv.inc()
                 # every mutation below re-checks gen under the owning lock:
                 # a superseded reader (its socket replaced by a reconnect)
                 # must not write stale seq/ack/inbox state over the state
@@ -578,6 +617,11 @@ class TcpMesh:
                         return
                     for wire in resend:
                         sock.sendall(wire)
+                self._m_retransmits.inc(len(resend))
+                # retransmitted wire bytes really crossed the link again
+                # (frames.sent already counted them at first send; the
+                # retransmits counter reconciles the difference)
+                self._m_bytes_sent.inc(sum(len(w) for w in resend))
                 _log.info(
                     "worker %d: link to peer %d resynced, retransmitted "
                     "%d frame(s)", self.worker_id, peer, len(resend),
@@ -650,6 +694,10 @@ class TcpMesh:
         _log.warning(
             "worker %d: link to peer %d dropped (%s); reconnecting",
             self.worker_id, peer, exc,
+        )
+        self._m_reconnects.inc()
+        _blackbox.record(
+            "comm.reconnect", worker=self.worker_id, peer=peer, error=str(exc)
         )
         if peer < self.worker_id:
             target = self._redial_loop  # we dialed this peer originally
@@ -726,6 +774,10 @@ class TcpMesh:
         _log.error(
             "worker %d: peer %d declared dead: %s", self.worker_id, peer, why
         )
+        self._m_peers_dead.inc()
+        _blackbox.record(
+            "comm.peer_dead", worker=self.worker_id, peer=peer, why=why
+        )
         with self._cv:
             # stale frames from the dead incarnation must not be consumed
             # by anyone (least of all a respawned peer's exchange rounds)
@@ -763,13 +815,16 @@ class TcpMesh:
             if self._closed:
                 return
             now = time.monotonic()
+            max_stale = 0.0
             for link in self._links.values():
                 with link.cv:
                     if not link.ready or link.dead:
                         continue
                     sock = link.sock
                     ack = link.recv_seq
-                    silent = now - link.last_seen > self.heartbeat_timeout
+                    staleness = now - link.last_seen
+                    max_stale = max(max_stale, staleness)
+                    silent = staleness > self.heartbeat_timeout
                 # unacked_since is read WITHOUT send_lock: a torn read costs
                 # at most one stale interval, while taking the lock could
                 # block behind a sendall stuck on this very hung peer
@@ -800,6 +855,10 @@ class TcpMesh:
                     continue  # truly wedged; retry next tick
                 try:
                     sock.sendall(hb)
+                    # bytes symmetry with the receive side, which counts
+                    # control frames too (it cannot tell them apart until
+                    # after the header is read)
+                    self._m_bytes_sent.inc(len(hb))
                 except OSError:
                     # includes a send-deadline expiry: progress on the
                     # socket is unknowable, so cycle the link promptly
@@ -807,6 +866,7 @@ class TcpMesh:
                     _close_quietly(sock)
                 finally:
                     link.send_lock.release()
+            self._m_staleness.set(max_stale)
 
     # -- point to point --------------------------------------------------
     def send(self, dest: int, tag: Hashable, payload: Any) -> None:
@@ -864,9 +924,12 @@ class TcpMesh:
             if not link.unacked_since:
                 link.unacked_since = time.monotonic()
             link.sent_bytes += len(wire)
+            self._m_frames_sent.inc()
+            self._m_bytes_sent.inc(len(wire))
             while link.sent_bytes > self.send_buffer_bytes and link.sent_buf:
                 evicted, old = link.sent_buf.popleft()
                 link.sent_bytes -= len(old)
+                self._m_evictions.inc()
                 # resync below this seq is now impossible; if the link
                 # drops before the peer acks past it, the peer is dead
                 link.evicted_seq = max(link.evicted_seq, evicted)

@@ -88,6 +88,7 @@ import signal
 import threading
 from typing import Any
 
+from pathway_tpu.engine import flight_recorder as _blackbox
 from pathway_tpu.engine.persistence import BlobBackend
 
 ENV_PLAN = "PATHWAY_FAULT_PLAN"
@@ -252,6 +253,10 @@ class FaultPlan:
                         f"{spec.describe()} @ "
                         + ",".join(f"{k}={v}" for k, v in sorted(ctx.items()))
                     )
+                    # every fired injection lands in the crash flight
+                    # recorder, so a post-mortem dump shows WHICH fault
+                    # preceded the failure it is being read to explain
+                    _blackbox.record("fault.injected", fault=kind, **ctx)
                     return spec
         return None
 
@@ -311,6 +316,10 @@ def maybe_crash(*, worker: int, epoch: int) -> None:
     if plan is None or not plan.has("crash"):
         return
     if plan.check("crash", worker=worker, epoch=epoch) is not None:
+        # the black box is the only record that survives a SIGKILL: dump
+        # it BEFORE the kill (a real external SIGKILL leaves no dump,
+        # like a real flight recorder losing power)
+        _blackbox.dump(f"injected crash (worker {worker}, epoch {epoch})")
         os.kill(os.getpid(), signal.SIGKILL)
 
 
@@ -325,6 +334,9 @@ def maybe_crash_writer(*, worker: int, key: str) -> None:
     if plan is None or not plan.has("writer_crash"):
         return
     if plan.check("writer_crash", worker=worker, key=key) is not None:
+        _blackbox.dump(
+            f"injected writer crash (worker {worker}, key {key!r})"
+        )
         os.kill(os.getpid(), signal.SIGKILL)
 
 

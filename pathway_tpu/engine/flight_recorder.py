@@ -1,0 +1,231 @@
+"""Crash flight recorder: a bounded ring of structured runtime events.
+
+An aircraft-style black box for the worker runtime: every notable state
+transition — epoch starts, commit-barrier publishes, comm link drops and
+reconnects, injected faults, restart attempts — is appended to a bounded
+in-memory ring (cheap: one dict + deque append under a lock).  When the
+worker crashes or a fault fires, the ring is dumped as JSON to
+``<persistence root>/blackbox/`` so the supervisor
+(``engine/supervisor.py``) can gather every worker's last seconds into
+``SupervisorResult.post_mortem`` and the ``pathway_tpu blackbox`` CLI can
+pretty-print them long after the processes are gone.
+
+The recorder is process-global (one worker process = one recorder) and
+always records in memory; **dumping** requires a configured filesystem
+root (the runner wires it when the run persists to a ``FileBackend``).
+SIGKILL-style injected crashes dump *before* the kill
+(``engine/faults.py``); real uncaught failures dump from the runner's
+failure path.  A genuine external SIGKILL leaves no dump — exactly like
+a real black box losing power — but the supervisor still reconstructs
+the restart story from exit codes and checkpoint provenance.
+
+Events deliberately carry wall-clock AND monotonic stamps: wall clock
+correlates across workers (and with the run's trace), monotonic orders
+events within one process even across clock steps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any
+
+DEFAULT_CAPACITY = 512
+_DUMP_DIR = "blackbox"
+
+
+class FlightRecorder:
+    """Bounded ring of ``{"seq", "ts", "mono", "kind", ...}`` events."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._ring: deque[dict[str, Any]] = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        # dump context, set by configure(): without a root, record() still
+        # works (post-mortems via the in-process API) but dump() no-ops
+        self.root: str | None = None
+        self.worker = 0
+        self.run_id: str | None = None
+        self.trace_parent: str | None = None
+        self.attempt = 0
+        self._dumped: str | None = None  # path of the last dump, if any
+
+    # -- recording ---------------------------------------------------------
+    def record(self, kind: str, **fields: Any) -> None:
+        event = {
+            "ts": time.time(),
+            "mono": time.monotonic(),
+            "kind": kind,
+        }
+        event.update(fields)
+        with self._lock:
+            self._seq += 1
+            event["seq"] = self._seq
+            self._ring.append(event)
+
+    def events(self) -> list[dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def configure(
+        self,
+        *,
+        root: str | None = None,
+        worker: int | None = None,
+        run_id: str | None = None,
+        trace_parent: str | None = None,
+        attempt: int | None = None,
+    ) -> None:
+        """Attach dump context; each keyword only overwrites when given."""
+        with self._lock:
+            if root is not None:
+                self.root = root
+            if worker is not None:
+                self.worker = worker
+            if run_id is not None:
+                self.run_id = run_id
+            if trace_parent is not None:
+                self.trace_parent = trace_parent
+            if attempt is not None:
+                self.attempt = attempt
+
+    # -- dumping -----------------------------------------------------------
+    def dump(self, reason: str) -> str | None:
+        """Write the ring to ``<root>/blackbox/worker-<id>.attempt-<n>.json``
+        and return the path; None when no root is configured or the write
+        fails (a dying process must never die *harder* because its black
+        box could not be written).  The write is staged + renamed so the
+        gatherer never reads a torn dump."""
+        with self._lock:
+            root = self.root
+            if not root:
+                return None
+            payload = {
+                "worker": self.worker,
+                "attempt": self.attempt,
+                "run_id": self.run_id,
+                "trace_parent": self.trace_parent,
+                "reason": reason,
+                "pid": os.getpid(),
+                "dumped_at": time.time(),
+                "events": list(self._ring),
+            }
+        try:
+            dump_dir = os.path.join(root, _DUMP_DIR)
+            os.makedirs(dump_dir, exist_ok=True)
+            name = f"worker-{payload['worker']}.attempt-{payload['attempt']}.json"
+            path = os.path.join(dump_dir, name)
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                # default=repr: an event carrying a non-JSON value must
+                # degrade to its repr, never take the dump (or the
+                # injected SIGKILL behind it) down with a TypeError
+                json.dump(payload, f, default=repr)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            self._dumped = path
+            return path
+        except (OSError, ValueError):
+            return None
+
+    @property
+    def last_dump(self) -> str | None:
+        return self._dumped
+
+
+# ---------------------------------------------------------------------------
+# Gathering (supervisor / CLI side)
+# ---------------------------------------------------------------------------
+
+
+def gather_dumps(root: str) -> dict[int, list[dict[str, Any]]]:
+    """Read every flight-recorder dump under ``root`` into
+    ``{worker: [dump payloads, oldest attempt first]}``.  Torn or
+    unparseable files are skipped — post-mortem data is best-effort."""
+    out: dict[int, list[dict[str, Any]]] = {}
+    dump_dir = os.path.join(root, _DUMP_DIR)
+    try:
+        names = sorted(os.listdir(dump_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        path = os.path.join(dump_dir, name)
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            continue
+        payload["path"] = path
+        try:
+            worker = int(payload.get("worker", -1))
+        except (TypeError, ValueError):
+            worker = -1  # hand-edited/foreign dump: keep it, unattributed
+        out.setdefault(worker, []).append(payload)
+    for dumps in out.values():
+        dumps.sort(key=lambda d: (d.get("attempt", 0), d.get("dumped_at", 0.0)))
+    return out
+
+
+def summarize_dumps(
+    dumps: dict[int, list[dict[str, Any]]], *, tail: int = 5
+) -> dict[str, Any]:
+    """Compact ``SupervisorResult.post_mortem`` form of gathered dumps:
+    per-worker dump files, reasons, and the last few events of the most
+    recent dump — enough to read the crash story without reopening the
+    files (the full rings stay on disk for ``pathway_tpu blackbox``)."""
+    workers: dict[int, dict[str, Any]] = {}
+    for worker, payloads in sorted(dumps.items()):
+        last = payloads[-1]
+        events = last.get("events") or []
+        workers[worker] = {
+            "dumps": [p["path"] for p in payloads],
+            "reasons": [p.get("reason") for p in payloads],
+            "attempt": last.get("attempt"),
+            "events_recorded": len(events),
+            "last_events": [
+                {
+                    k: v
+                    for k, v in ev.items()
+                    if k not in ("mono",)
+                }
+                for ev in events[-tail:]
+            ],
+        }
+    return {"workers": workers}
+
+
+# ---------------------------------------------------------------------------
+# Process-wide recorder
+# ---------------------------------------------------------------------------
+
+_recorder: FlightRecorder | None = None
+_recorder_lock = threading.Lock()
+
+
+def get_recorder() -> FlightRecorder:
+    global _recorder
+    if _recorder is None:
+        with _recorder_lock:
+            if _recorder is None:
+                _recorder = FlightRecorder()
+    return _recorder
+
+
+def record(kind: str, **fields: Any) -> None:
+    """Append one event to the process-wide ring (always cheap)."""
+    get_recorder().record(kind, **fields)
+
+
+def configure(**kwargs: Any) -> None:
+    get_recorder().configure(**kwargs)
+
+
+def dump(reason: str) -> str | None:
+    """Dump the process-wide ring; see :meth:`FlightRecorder.dump`."""
+    return get_recorder().dump(reason)

@@ -17,6 +17,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from pathway_tpu.engine import metrics as _metrics
 from pathway_tpu.engine.probes import ProberStats
 
 DEFAULT_FIRST_PORT = 20000  # http_server.rs:83
@@ -26,16 +27,24 @@ def monitoring_port(process_id: int = 0, override: int | None = None) -> int:
     return override if override is not None else DEFAULT_FIRST_PORT + process_id
 
 
-def _esc(value: str) -> str:
-    """Escape a Prometheus label value per the exposition format."""
-    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+# one escaping rule for the whole /metrics body: the ProberStats section
+# here and the registry section it appends must never diverge
+_esc = _metrics.escape_label
 
 
-def render_prometheus(stats: ProberStats, run_id: str | None = None) -> str:
+def render_prometheus(
+    stats: ProberStats,
+    run_id: str | None = None,
+    registry: "_metrics.MetricsRegistry | None" = None,
+) -> str:
     """OpenMetrics text, gauge names matching the reference's exposition.
 
     HELP/TYPE headers are emitted once per metric name (strict parsers
-    reject duplicates), followed by that metric's samples.
+    reject duplicates), followed by that metric's samples.  With a
+    ``registry`` (the unified metrics registry, ``engine/metrics.py``) its
+    exposition — comm/persistence/supervisor counters, epoch histograms —
+    is appended before the terminator, so one scrape covers the whole
+    worker.
     """
     run_label = f'run_id="{_esc(run_id)}"' if run_id else ""
 
@@ -79,6 +88,12 @@ def render_prometheus(stats: ProberStats, run_id: str | None = None) -> str:
         lines.append(f"# TYPE {name} gauge")
         for label_str, value in samples:
             lines.append(f"{name}{label_str} {value}")
+    if registry is not None:
+        registry_text = registry.render_prometheus(
+            extra_labels={"run_id": run_id} if run_id else None
+        )
+        if registry_text:
+            lines.append(registry_text.rstrip("\n"))
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
@@ -115,16 +130,22 @@ class MonitoringServer:
         port: int | None = None,
         run_id: str | None = None,
         host: str = "127.0.0.1",
+        registry: "_metrics.MetricsRegistry | None" = None,
     ):
         self.run_id = run_id
         self._stats = ProberStats()  # swapped whole, never mutated in place
+        # the unified registry rides every /metrics scrape by default;
+        # pass registry explicitly to serve an isolated one (tests)
+        self.registry = registry if registry is not None else _metrics.get_registry()
         self.port = monitoring_port(process_id, port)
         server = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
                 if self.path.startswith("/metrics"):
-                    body = render_prometheus(server._stats, server.run_id)
+                    body = render_prometheus(
+                        server._stats, server.run_id, registry=server.registry
+                    )
                     ctype = "text/plain; version=0.0.4"
                 elif self.path.startswith("/status"):
                     body = render_status(server._stats, server.run_id)

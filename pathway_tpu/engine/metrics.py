@@ -1,0 +1,518 @@
+"""Unified runtime metrics registry: counters, gauges, histograms.
+
+Parity target: ``src/engine/telemetry.rs`` registers process gauges into
+one OTel meter and ``http_server.rs`` serves the latest ``ProberStats``;
+this module is the layer both lean on here — ONE registry per process
+that the comm mesh (``engine/comm.py``), the persistence pipeline
+(``engine/persistence.py``), the supervisor (``engine/supervisor.py``)
+and the runner/probes (``internals/runner.py``) all register into, and
+that every exporter reads from:
+
+* Prometheus text exposition — appended to ``/metrics`` on the
+  monitoring HTTP server (``engine/http_server.py``),
+* OTLP/HTTP+JSON — scalar metrics ride the gauge datapoints and
+  histograms map to real OTLP histogram datapoints
+  (``engine/telemetry.py``),
+* the console dashboard footer (``internals/monitoring.py``).
+
+Design constraints, in order:
+
+1. **Lock-cheap on hot paths.**  ``Counter.inc`` / ``Gauge.set`` are a
+   guarded float add / store — no lock.  CPython's GIL makes the single
+   ``+=`` on an instance slot atomic enough for telemetry (a torn
+   increment under free-threaded builds would cost one count, never a
+   crash); ``Histogram.observe`` takes a per-child lock because its
+   bucket-array update is multi-step, and it is called at epoch/commit
+   cadence, not per row.
+2. **Labels are first-class** but resolved once: ``family.labels(...)``
+   returns a child handle the caller keeps, so steady-state updates
+   never touch a dict.
+3. **Disable switch**: ``set_enabled(False)`` (or
+   ``PATHWAY_METRICS_DISABLED=1``) turns every update into an immediate
+   return — the lever ``benchmarks/telemetry_overhead.py`` uses to
+   price the instrumentation itself.
+
+Metric names are canonical **dotted** OTel-style names
+(``comm.bytes.sent``); the Prometheus renderer derives the exposition
+name by prefixing ``pathway_`` and mapping dots to underscores
+(``pathway_comm_bytes_sent``).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time as _time
+import weakref
+from bisect import bisect_left
+from typing import Any, Callable, Iterable
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "set_enabled",
+    "otlp_gauge",
+    "otlp_histogram",
+    "escape_label",
+    "DEFAULT_BUCKETS",
+]
+
+# Default histogram bounds (seconds-ish / ms-ish magnitudes): wide enough
+# for µs frame encodes and multi-second commit barriers alike.
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0,
+    500.0, 1000.0, 5000.0,
+)
+
+
+class _Enabled:
+    """Shared mutable on/off flag — one attribute read per update."""
+
+    __slots__ = ("on",)
+
+    def __init__(self, on: bool):
+        self.on = on
+
+
+def _label_key(labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonic counter child (one label set)."""
+
+    __slots__ = ("_value", "_enabled")
+
+    def __init__(self, enabled: _Enabled):
+        self._value = 0.0
+        self._enabled = enabled
+
+    def inc(self, amount: float = 1.0) -> None:
+        if self._enabled.on:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Point-in-time gauge child (one label set)."""
+
+    __slots__ = ("_value", "_enabled")
+
+    def __init__(self, enabled: _Enabled):
+        self._value = 0.0
+        self._enabled = enabled
+
+    def set(self, value: float) -> None:
+        if self._enabled.on:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        if self._enabled.on:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        if self._enabled.on:
+            self._value -= amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram child (one label set).
+
+    Buckets are cumulative-on-read (Prometheus ``le`` semantics) but
+    stored per-interval, so ``observe`` touches exactly one slot.
+    """
+
+    __slots__ = ("_enabled", "_bounds", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, enabled: _Enabled, bounds: tuple[float, ...]):
+        self._enabled = enabled
+        self._bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # last slot = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        if not self._enabled.on:
+            return
+        i = bisect_left(self._bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+            self._count += 1
+
+    def snapshot(self) -> tuple[tuple[float, ...], list[int], float, int]:
+        """(bounds, per-interval counts, sum, count) — a consistent read."""
+        with self._lock:
+            return self._bounds, list(self._counts), self._sum, self._count
+
+
+class _Family:
+    """One named metric family holding children keyed by label set."""
+
+    __slots__ = ("name", "help", "kind", "buckets", "_children", "_enabled", "_lock")
+
+    def __init__(
+        self,
+        name: str,
+        help_: str,
+        kind: str,
+        enabled: _Enabled,
+        buckets: tuple[float, ...] | None = None,
+    ):
+        self.name = name
+        self.help = help_
+        self.kind = kind  # "counter" | "gauge" | "histogram"
+        self.buckets = buckets
+        self._children: dict[tuple, Any] = {}
+        self._enabled = enabled
+        self._lock = threading.Lock()
+
+    def labels(self, **labels: Any):
+        key = _label_key(labels)
+        child = self._children.get(key)
+        if child is None:
+            with self._lock:
+                child = self._children.get(key)
+                if child is None:
+                    if self.kind == "counter":
+                        child = Counter(self._enabled)
+                    elif self.kind == "gauge":
+                        child = Gauge(self._enabled)
+                    else:
+                        child = Histogram(self._enabled, self.buckets or DEFAULT_BUCKETS)
+                    self._children[key] = child
+        return child
+
+    def items(self) -> list[tuple[tuple, Any]]:
+        with self._lock:
+            return list(self._children.items())
+
+
+class MetricsRegistry:
+    """Process-wide registry of metric families + pull-time collectors.
+
+    ``collector`` functions return flat ``{dotted-name: float}`` gauge
+    dicts read at render/export time — the bridge for subsystems that
+    already keep their own counters (``persistence.CommitMetrics``) and
+    for snapshot suppliers (``ProberStats`` totals).  They are held via
+    weakref to their owner, so a storage or prober that dies simply
+    drops out of the exposition.
+    """
+
+    def __init__(self, *, enabled: bool | None = None):
+        if enabled is None:
+            import os
+
+            enabled = os.environ.get("PATHWAY_METRICS_DISABLED", "") not in (
+                "1", "true", "yes", "on",
+            )
+        self._enabled = _Enabled(enabled)
+        self._families: dict[str, _Family] = {}
+        self._lock = threading.Lock()
+        # name -> weakref-able callable returning {name: value}
+        self._collectors: dict[str, Any] = {}
+
+    # -- family accessors --------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled.on
+
+    def set_enabled(self, on: bool) -> None:
+        self._enabled.on = bool(on)
+
+    def _family(
+        self,
+        name: str,
+        help_: str,
+        kind: str,
+        buckets: tuple[float, ...] | None = None,
+    ) -> _Family:
+        fam = self._families.get(name)
+        if fam is None:
+            with self._lock:
+                fam = self._families.get(name)
+                if fam is None:
+                    fam = _Family(name, help_, kind, self._enabled, buckets)
+                    self._families[name] = fam
+        if fam.kind != kind:
+            raise ValueError(
+                f"metric {name!r} already registered as a {fam.kind}, "
+                f"not a {kind}"
+            )
+        return fam
+
+    def counter(self, name: str, help_: str = "", **labels: Any) -> Counter:
+        return self._family(name, help_, "counter").labels(**labels)
+
+    def gauge(self, name: str, help_: str = "", **labels: Any) -> Gauge:
+        return self._family(name, help_, "gauge").labels(**labels)
+
+    def histogram(
+        self,
+        name: str,
+        help_: str = "",
+        buckets: Iterable[float] | None = None,
+        **labels: Any,
+    ) -> Histogram:
+        bounds = tuple(buckets) if buckets is not None else None
+        return self._family(name, help_, "histogram", bounds).labels(**labels)
+
+    # -- collectors --------------------------------------------------------
+    def register_collector(
+        self, name: str, fn: Callable[[], dict[str, float] | None]
+    ) -> None:
+        """Register a pull-time gauge supplier under a unique name
+        (re-registering the name replaces the previous supplier).  Bound
+        methods are held through a ``WeakMethod`` so the collector dies
+        with its owner."""
+        ref: Any
+        try:
+            ref = weakref.WeakMethod(fn)  # bound method: weak to the owner
+        except TypeError:
+            ref = lambda f=fn: f  # plain function/lambda: hold strongly
+        with self._lock:
+            self._collectors[name] = ref
+
+    def unregister_collector(self, name: str) -> None:
+        with self._lock:
+            self._collectors.pop(name, None)
+
+    def collect(self) -> dict[str, float]:
+        """Evaluate every live collector into one flat gauge dict."""
+        with self._lock:
+            refs = list(self._collectors.items())
+        out: dict[str, float] = {}
+        dead: list[tuple[str, Any]] = []
+        for name, ref in refs:
+            fn = ref()
+            if fn is None:
+                dead.append((name, ref))
+                continue
+            try:
+                out.update(fn() or {})
+            except Exception:  # noqa: BLE001 - a supplier must never break export
+                continue
+        if dead:
+            with self._lock:
+                for name, ref in dead:
+                    if self._collectors.get(name) is ref:  # unchanged slot
+                        self._collectors.pop(name, None)
+        return out
+
+    # -- reads -------------------------------------------------------------
+    def scalar_metrics(self) -> dict[str, float]:
+        """Flat ``{name[{labels}]: value}`` of counters/gauges + collector
+        output — the form the OTLP gauge exporter and the dashboard eat.
+        Labeled children get a ``name{k=v,...}`` suffix so distinct label
+        sets stay distinct."""
+        out: dict[str, float] = {}
+        with self._lock:
+            families = list(self._families.values())
+        for fam in families:
+            if fam.kind == "histogram":
+                continue
+            for key, child in fam.items():
+                if key:
+                    label_str = ",".join(f"{k}={v}" for k, v in key)
+                    out[f"{fam.name}{{{label_str}}}"] = child.value
+                else:
+                    out[fam.name] = child.value
+        out.update(self.collect())
+        return out
+
+    def histogram_points(self) -> list[dict[str, Any]]:
+        """Histogram snapshots in exporter-neutral form:
+        ``{name, labels, bounds, bucket_counts (per-interval), sum, count}``."""
+        points: list[dict[str, Any]] = []
+        with self._lock:
+            families = [f for f in self._families.values() if f.kind == "histogram"]
+        for fam in families:
+            for key, child in fam.items():
+                bounds, counts, total, n = child.snapshot()
+                points.append(
+                    {
+                        "name": fam.name,
+                        "labels": dict(key),
+                        "bounds": list(bounds),
+                        "bucket_counts": counts,
+                        "sum": total,
+                        "count": n,
+                    }
+                )
+        return points
+
+    # -- Prometheus text exposition ---------------------------------------
+    def render_prometheus(self, extra_labels: dict[str, str] | None = None) -> str:
+        """Exposition-format text for every family + collector gauge.
+
+        No trailing ``# EOF`` — the caller composing a full scrape body
+        (``engine/http_server.py``) appends it once."""
+        lines: list[str] = []
+        extra = _label_key(extra_labels or {})
+        with self._lock:
+            families = sorted(self._families.values(), key=lambda f: f.name)
+        for fam in families:
+            prom = _prom_name(fam.name)
+            items = fam.items()
+            if not items:
+                continue
+            lines.append(f"# HELP {prom} {fam.help or fam.name}")
+            lines.append(f"# TYPE {prom} {fam.kind}")
+            for key, child in items:
+                label_str = _prom_labels(key + extra)
+                if fam.kind == "histogram":
+                    bounds, counts, total, n = child.snapshot()
+                    cum = 0
+                    for bound, c in zip(bounds, counts):
+                        cum += c
+                        le = _prom_labels(
+                            key + extra + (("le", _format_bound(bound)),)
+                        )
+                        lines.append(f"{prom}_bucket{le} {cum}")
+                    cum += counts[-1]
+                    le = _prom_labels(key + extra + (("le", "+Inf"),))
+                    lines.append(f"{prom}_bucket{le} {cum}")
+                    lines.append(f"{prom}_sum{label_str} {_format_value(total)}")
+                    lines.append(f"{prom}_count{label_str} {n}")
+                else:
+                    lines.append(
+                        f"{prom}{label_str} {_format_value(child.value)}"
+                    )
+        collected = self.collect()
+        if collected:
+            for name in sorted(collected):
+                prom = _prom_name(name)
+                lines.append(f"# HELP {prom} {name}")
+                lines.append(f"# TYPE {prom} gauge")
+                lines.append(
+                    f"{prom}{_prom_labels(extra)} {_format_value(collected[name])}"
+                )
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    # -- OTLP mapping ------------------------------------------------------
+    def otlp_metrics(self, ts: float | None = None) -> list[dict]:
+        """This registry's families as OTLP JSON ``metrics`` entries —
+        scalars as gauge datapoints, histograms as histogram datapoints
+        (the opentelemetry-proto JSON mapping).  The caller wraps them in
+        its ``resourceMetrics`` envelope (``engine/telemetry.py``)."""
+        t_ns = str(int((ts if ts is not None else _time.time()) * 1e9))
+        out: list[dict] = []
+        for name, value in self.scalar_metrics().items():
+            out.append(otlp_gauge(name, value, t_ns))
+        for point in self.histogram_points():
+            out.append(otlp_histogram(point, t_ns))
+        return out
+
+
+def otlp_gauge(name: str, value: float, t_ns: str) -> dict:
+    """One scalar metric as an OTLP JSON gauge ``metrics`` entry.  A
+    ``"{k=v,...}"`` label suffix on the name (the ``scalar_metrics`` form)
+    becomes datapoint attributes — OTLP wants the clean base name."""
+    base, labels = split_labeled_name(name)
+    dp: dict[str, Any] = {"asDouble": float(value), "timeUnixNano": t_ns}
+    if labels:
+        dp["attributes"] = [
+            {"key": k, "value": {"stringValue": v}} for k, v in labels.items()
+        ]
+    return {"name": base, "gauge": {"dataPoints": [dp]}}
+
+
+def otlp_histogram(point: dict[str, Any], t_ns: str) -> dict:
+    """One exporter-neutral histogram point (``histogram_points`` form) as
+    an OTLP JSON ``metrics`` entry with a real histogram datapoint."""
+    dp: dict[str, Any] = {
+        "startTimeUnixNano": t_ns,
+        "timeUnixNano": t_ns,
+        "count": str(point["count"]),
+        "sum": point["sum"],
+        "bucketCounts": [str(c) for c in point["bucket_counts"]],
+        "explicitBounds": list(point["bounds"]),
+    }
+    if point.get("labels"):
+        dp["attributes"] = [
+            {"key": k, "value": {"stringValue": str(v)}}
+            for k, v in point["labels"].items()
+        ]
+    return {
+        "name": point["name"],
+        "histogram": {
+            "dataPoints": [dp],
+            "aggregationTemporality": 2,  # CUMULATIVE
+        },
+    }
+
+
+def _prom_name(name: str) -> str:
+    safe = "".join(c if c.isalnum() or c == "_" else "_" for c in name)
+    return safe if safe.startswith("pathway_") else f"pathway_{safe}"
+
+
+def escape_label(value: str) -> str:
+    """Escape a Prometheus label value per the exposition format."""
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_labels(key: tuple[tuple[str, str], ...]) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{escape_label(str(v))}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _format_bound(bound: float) -> str:
+    if bound == int(bound):
+        return str(int(bound)) + ".0"
+    return repr(bound)
+
+
+def _format_value(value: float) -> str:
+    if isinstance(value, float) and math.isfinite(value) and value == int(value):
+        return str(int(value))
+    return repr(float(value))
+
+
+def split_labeled_name(name: str) -> tuple[str, dict[str, str]]:
+    """``"a.b{k=v,k2=v2}"`` → ``("a.b", {"k": "v", "k2": "v2"})``."""
+    if not name.endswith("}") or "{" not in name:
+        return name, {}
+    base, _, rest = name.partition("{")
+    labels: dict[str, str] = {}
+    for pair in rest[:-1].split(","):
+        k, _, v = pair.partition("=")
+        if k:
+            labels[k] = v
+    return base, labels
+
+
+# ---------------------------------------------------------------------------
+# Process-wide default registry
+# ---------------------------------------------------------------------------
+
+_registry: MetricsRegistry | None = None
+_registry_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem registers into."""
+    global _registry
+    if _registry is None:
+        with _registry_lock:
+            if _registry is None:
+                _registry = MetricsRegistry()
+    return _registry
+
+
+def set_enabled(on: bool) -> None:
+    """Flip instrumentation on/off process-wide (benchmark lever)."""
+    get_registry().set_enabled(on)

@@ -52,6 +52,8 @@ from contextvars import ContextVar
 from typing import Any, Callable
 
 from pathway_tpu.engine import codec
+from pathway_tpu.engine import flight_recorder as _blackbox
+from pathway_tpu.engine import metrics as _registry
 
 METADATA_FILE = "metadata.json"
 MANIFEST_FORMAT = 1
@@ -645,6 +647,11 @@ class CommitMetrics:
         self.inflight_bytes = 0
         self.inflight_jobs = 0
         self.max_inflight_bytes = 0
+        # deferred-GC health: sweeps run, artifacts actually deleted, and
+        # sweeps deferred because the newest generation failed read-back
+        self.gc_runs = 0
+        self.gc_deleted = 0
+        self.gc_deferred = 0
 
     def add_stage(self, stage: str, seconds: float) -> None:
         with self._lock:
@@ -691,7 +698,17 @@ class CommitMetrics:
             out["checkpoint.bytes"] = float(self.bytes_written)
             out["checkpoint.commits"] = float(self.commits_published)
             out["checkpoint.commits.noop"] = float(self.commits_noop)
+            out["checkpoint.gc.runs"] = float(self.gc_runs)
+            out["checkpoint.gc.deleted"] = float(self.gc_deleted)
+            out["checkpoint.gc.deferred"] = float(self.gc_deferred)
             return out
+
+    def gc_run(self, *, deferred: bool, deleted: int = 0) -> None:
+        with self._lock:
+            self.gc_runs += 1
+            self.gc_deleted += deleted
+            if deferred:
+                self.gc_deferred += 1
 
 
 class _ArtifactJob:
@@ -1141,6 +1158,12 @@ class PersistentStorage:
         # the sticky first async failure (surfaced on the next
         # commit/commit_async/drain call)
         self.metrics = CommitMetrics()
+        # the commit-pipeline gauges ride the unified registry too, so the
+        # /metrics scrape and OTLP export see them without runner plumbing;
+        # WeakMethod registration means a dead storage drops out on its own
+        _registry.get_registry().register_collector(
+            f"persistence.worker{worker}", self.metrics.snapshot
+        )
         writers = _checkpoint_writers()
         self._pool: _WriterPool | None = (
             _WriterPool(
@@ -1177,6 +1200,15 @@ class PersistentStorage:
         self.operator_persistence = (
             getattr(mode, "name", None) == "OPERATOR_PERSISTING"
         )
+        # incremental GC indexes: this worker shard has exactly one writer
+        # (this storage), so the manifest/operator key sets can be
+        # maintained in memory after ONE full listing instead of walking
+        # the whole persistence root on every published generation.
+        # _known_generations seeds from _load_state()'s existing listing;
+        # _op_index stays None until the first operator GC pays its single
+        # full walk (catching residue from prior runs), then is O(delta).
+        self._known_generations: set[int] = set()
+        self._op_index: set[str] | None = None
         self._metadata = self._load_state()
         self.replayed_rows = 0
         if (
@@ -1237,7 +1269,8 @@ class PersistentStorage:
         or corrupt chunk, digest mismatch).  Raises :class:`CheckpointError`
         when generations exist but none verifies — silently starting fresh
         would break exactly-once for sources with externally committed
-        offsets.
+        offsets.  The one full manifest listing here also seeds the
+        in-memory generation index incremental GC runs against.
 
         Verification reads every chunk of the candidate generation BEFORE
         adoption, and replay later re-fetches them (the verified-artifact
@@ -1246,6 +1279,7 @@ class PersistentStorage:
         yet, so the doubled read is the price of never adopting a
         generation that cannot be fully restored."""
         gens = self._list_generations()
+        self._known_generations = set(gens)
         for gen in sorted(gens, reverse=True):
             manifest, reason = _read_manifest(self.backend, gens[gen])
             if manifest is None:
@@ -1261,6 +1295,10 @@ class PersistentStorage:
                 )
                 continue
             self.generation = self.recovered_generation = gen
+            _blackbox.record(
+                "checkpoint.recovery", worker=self.worker, generation=gen,
+                rejected=[g for g, _ in self.rejected_generations],
+            )
             if self.rejected_generations:
                 _log.warning(
                     "persistence: worker %d fell back to generation %d in "
@@ -1400,6 +1438,8 @@ class PersistentStorage:
                     jobs: list[tuple[str, _ArtifactJob]] = []
                     for node_id, blob in dirty.items():
                         key = f"operators/{self.worker}/{self._op_gen}/{node_id}"
+                        if self._op_index is not None:
+                            self._op_index.add(key)
                         ref = {"key": key, "digest": None}
                         op_meta[str(node_id)] = ref
                         jobs.append(
@@ -1430,6 +1470,8 @@ class PersistentStorage:
                 else:
                     for node_id, blob in dirty.items():
                         key = f"operators/{self.worker}/{self._op_gen}/{node_id}"
+                        if self._op_index is not None:
+                            self._op_index.add(key)
                         framed = codec.frame_blob(blob)
                         self.backend.put(key, framed)
                         op_meta[str(node_id)] = {
@@ -1681,7 +1723,12 @@ class PersistentStorage:
             self._manifest_key(self.generation),
             codec.frame_blob(_json.dumps(metadata).encode()),
         )
+        self._known_generations.add(self.generation)
         self._metadata = metadata
+        _blackbox.record(
+            "checkpoint.publish", worker=self.worker,
+            generation=self.generation,
+        )
         if confirm is not None:
             confirm()
         # advisory pointer: unframed JSON, deliberately human-readable.
@@ -1743,9 +1790,18 @@ class PersistentStorage:
         verification: if what actually landed on the store is damaged, the
         older generations are the only recovery points left and the window
         simply grows until a sound commit lands.  GC failure must never
-        fail a commit — the commit is already durable."""
+        fail a commit — the commit is already durable.
+
+        Steady-state cost is O(delta): the generation set is the in-memory
+        index maintained by ``_load_state``/``_publish_manifest`` (this
+        storage is the shard's only writer), and the operator-chunk set
+        pays ONE full listing on the first sweep (prior-run residue), then
+        is maintained per dump write — no per-publish walk of the
+        persistence root (``pathway_tpu scrub`` still walks everything)."""
         try:
-            gens = self._list_generations()
+            gens = {
+                g: self._manifest_key(g) for g in self._known_generations
+            }
             horizon = self.generation - self.retain_generations
             doomed = [g for g in sorted(gens) if g <= horizon]
             rejected_stale = {
@@ -1759,6 +1815,7 @@ class PersistentStorage:
             ):
                 return
             if not self._verify_current_generation():
+                self.metrics.gc_run(deferred=True)
                 _log.warning(
                     "persistence: generation %d failed read-back "
                     "verification on %s — deferring GC, keeping %d older "
@@ -1766,10 +1823,13 @@ class PersistentStorage:
                     self.generation, self.backend.describe(), len(doomed),
                 )
                 return
+            deleted = 0
             retained: list[tuple[int, str]] = []
             for gen, key in sorted(gens.items()):
                 if gen in doomed:
                     self.backend.delete(key)
+                    self._known_generations.discard(gen)
+                    deleted += 1
                 else:
                     retained.append((gen, key))
             # stale damaged manifests ABOVE the current generation (the ones
@@ -1781,10 +1841,13 @@ class PersistentStorage:
             for gen, key in retained:
                 if gen in rejected_stale:
                     self.backend.delete(key)
+                    self._known_generations.discard(gen)
+                    deleted += 1
             retained = [
                 (g, k) for g, k in retained if g not in rejected_stale
             ]
             if not self.operator_persistence:
+                self.metrics.gc_run(deferred=False, deleted=deleted)
                 return
             live: set[str] = set()
             for gen, key in retained:
@@ -1798,9 +1861,17 @@ class PersistentStorage:
                     (manifest.get("operators") or {}).get("nodes") or {}
                 ).values():
                     live.add(_op_ref(ref)["key"])
-            for key in self.backend.list_keys(f"operators/{self.worker}/"):
-                if key not in live:
-                    self.backend.delete(key)
+            if self._op_index is None:
+                # first sweep: the single full walk that folds in operator
+                # chunks left behind by previous runs of this root
+                self._op_index = set(
+                    self.backend.list_keys(f"operators/{self.worker}/")
+                )
+            for key in sorted(self._op_index - live):
+                self.backend.delete(key)
+                self._op_index.discard(key)
+                deleted += 1
+            self.metrics.gc_run(deferred=False, deleted=deleted)
         except Exception as exc:  # noqa: BLE001 - GC is best-effort
             _log.warning(
                 "persistence: generation GC failed (will retry next "
@@ -2145,6 +2216,12 @@ def scrub_root(
             "ok": worker_ok,
         }
         report["ok"] = report["ok"] and worker_ok
+    reg = _registry.get_registry()
+    reg.counter("persistence.scrub.runs", "offline scrub audits run").inc()
+    if not report["ok"]:
+        reg.counter(
+            "persistence.scrub.damaged", "scrub audits that found damage"
+        ).inc()
     return report
 
 

@@ -178,3 +178,25 @@ class Prober:
             # every node's state arena alive
             self.scope = None
         return self.stats
+
+    def metrics_snapshot(self) -> dict[str, float]:
+        """Flat gauge dict of the latest snapshot for the unified metrics
+        registry (``engine/metrics.py``): dataflow progress totals that
+        ride the same /metrics scrape and OTLP export as comm/persistence
+        health.  (Per-operator rows stay in the richer ProberStats render
+        of ``engine/http_server.py``.)"""
+        s = self.stats
+        out = {
+            "dataflow.epochs": float(s.epochs),
+            "dataflow.input.rows": float(s.input_stats.rows_out),
+            "dataflow.output.rows": float(s.output_stats.rows_in),
+            "dataflow.operators": float(len(s.operator_stats)),
+            "dataflow.errors": float(
+                sum(op.errors for op in s.operator_stats.values())
+            ),
+        }
+        if s.input_stats.lag_ms is not None:
+            out["dataflow.input.lag.ms"] = s.input_stats.lag_ms
+        if s.output_stats.lag_ms is not None:
+            out["dataflow.output.lag.ms"] = s.output_stats.lag_ms
+        return out

@@ -60,16 +60,24 @@ _log = logging.getLogger("pathway_tpu.supervisor")
 # one constant for the restart-attempt protocol: the fault plan's
 # `attempt` filter and the jax coordinator-port offset read the same var
 from pathway_tpu.engine.faults import ENV_ATTEMPT  # noqa: E402,F401
+from pathway_tpu.engine import metrics as _metrics  # noqa: E402
 
 
 class SupervisorError(RuntimeError):
-    """The cluster kept failing past the restart budget."""
+    """The cluster kept failing past the restart budget.
+
+    ``post_mortem`` carries the flight-recorder summary gathered from the
+    persistence root (same shape as ``SupervisorResult.post_mortem``) —
+    a crash loop is exactly the case the black box exists for.
+    """
+
+    post_mortem: dict = {}
 
 
 class SupervisorResult:
     __slots__ = (
         "attempts", "restarts", "exit_codes", "history", "recovery",
-        "last_failure",
+        "last_failure", "post_mortem",
     )
 
     def __init__(
@@ -80,6 +88,7 @@ class SupervisorResult:
         history: list[list[int | None]],
         recovery: dict[int, dict] | None = None,
         last_failure: str | None = None,
+        post_mortem: dict | None = None,
     ):
         self.attempts = attempts  # launches performed (>= 1)
         self.restarts = restarts  # recoveries performed (attempts - 1)
@@ -97,6 +106,13 @@ class SupervisorResult:
         # human-readable reason for the last recovery, e.g.
         # "worker 1 exited -9 on attempt 0" — None for a clean first run
         self.last_failure = last_failure
+        # flight-recorder post-mortem gathered from the persistence root
+        # (engine/flight_recorder.py): {"workers": {wid: {"dumps": [...],
+        # "reasons": [...], "last_events": [...]}}} — the last seconds of
+        # every worker that dumped its black box before dying.  {} when no
+        # root is known or no worker dumped.  ``pathway_tpu blackbox ROOT``
+        # renders the full dumps.
+        self.post_mortem = post_mortem or {}
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (
@@ -258,6 +274,42 @@ class Supervisor:
         except Exception:  # noqa: BLE001 - never fail a run for forensics
             return {}
 
+    def _post_mortem(self) -> dict:
+        """Flight-recorder dumps gathered from the persistence root into
+        the compact ``SupervisorResult.post_mortem`` form; {} when no root
+        is known or nothing dumped — like recovery provenance, post-mortem
+        data is best-effort and must never fail a run."""
+        if not self.checkpoint_root:
+            return {}
+        try:
+            from pathway_tpu.engine.flight_recorder import (
+                gather_dumps,
+                summarize_dumps,
+            )
+
+            dumps = gather_dumps(self.checkpoint_root)
+            # only THIS run's dumps: anything written before run() started
+            # (or missing its stamp — an older format) is a previous run's
+            # story and would misattribute old crashes to a clean run
+            cutoff = getattr(self, "_run_started_at", 0.0)
+            dumps = {
+                w: [p for p in ps if p.get("dumped_at", 0.0) >= cutoff]
+                for w, ps in dumps.items()
+            }
+            dumps = {w: ps for w, ps in dumps.items() if ps}
+            if not dumps:
+                return {}
+            summary = summarize_dumps(dumps)
+            for wid, info in sorted(summary.get("workers", {}).items()):
+                _log.info(
+                    "worker %d left %d flight-recorder dump(s); last "
+                    "reason: %s", wid, len(info.get("dumps", [])),
+                    (info.get("reasons") or [None])[-1],
+                )
+            return summary
+        except Exception:  # noqa: BLE001 - forensics only
+            return {}
+
     def _settle_checkpoints(self) -> None:
         """Settle async-commit residue on the persistence root after the
         whole group is confirmed dead, before the restart is accounted.
@@ -299,6 +351,10 @@ class Supervisor:
         attempt = 0
         handles: list[Any] = []
         last_failure: str | None = None
+        # post_mortem cutoff: dumps already on the root when THIS run
+        # starts belong to a previous run and must not be re-attributed
+        # to it (they stay on disk for `pathway_tpu blackbox`)
+        self._run_started_at = time.time()
         try:
             while True:
                 handles = []
@@ -320,11 +376,16 @@ class Supervisor:
                     return SupervisorResult(
                         attempt + 1, attempt, codes, history,  # type: ignore[arg-type]
                         recovery=recovery, last_failure=last_failure,
+                        post_mortem=self._post_mortem(),
                     )
                 last_failure = (
                     f"worker {first_failed} exited "
                     f"{_exitcode(handles[first_failed])} on attempt {attempt}"
                 )
+                _metrics.get_registry().counter(
+                    "supervisor.restarts",
+                    "cluster rollback-and-respawn recoveries performed",
+                ).inc()
                 _log.warning(
                     "worker %d died (exit %s) on attempt %d; rolling the "
                     "group back to the last committed checkpoint",
@@ -338,11 +399,16 @@ class Supervisor:
                 self._settle_checkpoints()
                 history.append([_exitcode(h) for h in handles])
                 if attempt >= self.max_restarts:
-                    raise SupervisorError(
+                    err = SupervisorError(
                         f"cluster failed {attempt + 1} time(s) "
                         f"(restart budget {self.max_restarts}); last exit "
                         f"codes {history[-1]}; last failure: {last_failure}"
                     )
+                    # a crash loop is exactly when the black box matters
+                    # most: the dumps ride the exception so callers (and
+                    # `spawn --supervise`) can point the operator at them
+                    err.post_mortem = self._post_mortem()
+                    raise err
                 time.sleep(
                     next(delays) + random.uniform(0, self.restart_jitter_s)
                 )

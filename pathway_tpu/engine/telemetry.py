@@ -31,12 +31,24 @@ import secrets
 import threading
 import time
 import urllib.request
-from contextlib import contextmanager
+from collections import deque
+from contextlib import contextmanager, nullcontext
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from pathway_tpu.engine import metrics as _metrics
+
 PERIODIC_READER_INTERVAL_S = 60.0
 EXPORT_TIMEOUT_S = 3.0
+# bounded non-blocking export queue: a slow or dead collector must never
+# stall the sampler or (worse) a span-emitting caller thread — when the
+# queue is full the OLDEST payload is dropped and counted in the
+# ``telemetry.export.dropped`` metric (freshness beats completeness)
+EXPORT_QUEUE_MAX = 256
+# in-process span retention: exported spans also land on ``Telemetry.spans``
+# for introspection/tests, but sampled per-epoch spans arrive forever in a
+# streaming run — keep only the most recent ones
+SPAN_BUFFER_MAX = 1024
 
 PROCESS_MEMORY_USAGE = "process.memory.usage"
 PROCESS_CPU_USER_TIME = "process.cpu.utime"
@@ -158,6 +170,16 @@ def _root_trace_id(trace_parent: str | None) -> str | None:
     return parts[1] if len(parts) >= 3 and len(parts[1]) == 32 else None
 
 
+def mint_traceparent() -> str:
+    """A fresh W3C ``traceparent`` header value (sampled flag set).
+
+    One per run: ``cli spawn`` mints it into the cluster environment and
+    worker 0 broadcasts it over the mesh to any worker that missed it
+    (``internals/runner.py``), so epoch/commit/recovery spans from every
+    worker of the run share one trace id in the collector."""
+    return f"00-{secrets.token_hex(16)}-{secrets.token_hex(8)}-01"
+
+
 def _process_metrics() -> dict[str, float]:
     utime, stime = os.times()[:2]
     metrics = {PROCESS_CPU_USER_TIME: utime, PROCESS_CPU_SYSTEM_TIME: stime}
@@ -199,6 +221,15 @@ def _otlp_attrs(d: dict) -> list[dict]:
 
 def _otlp_metrics(payload: dict) -> dict:
     t_ns = str(int(payload.get("ts", time.time()) * 1e9))
+    entries = [
+        _metrics.otlp_gauge(name, value, t_ns)
+        for name, value in payload["metrics"].items()
+    ]
+    # registry histograms (epoch latency, step time) map to REAL OTLP
+    # histogram datapoints, not flattened gauges — a collector can compute
+    # quantiles from the bucket counts
+    for point in payload.get("histograms") or ():
+        entries.append(_metrics.otlp_histogram(point, t_ns))
     return {
         "resourceMetrics": [
             {
@@ -206,20 +237,7 @@ def _otlp_metrics(payload: dict) -> dict:
                 "scopeMetrics": [
                     {
                         "scope": {"name": "pathway_tpu"},
-                        "metrics": [
-                            {
-                                "name": name,
-                                "gauge": {
-                                    "dataPoints": [
-                                        {
-                                            "asDouble": float(value),
-                                            "timeUnixNano": t_ns,
-                                        }
-                                    ]
-                                },
-                            }
-                            for name, value in payload["metrics"].items()
-                        ],
+                        "metrics": entries,
                     }
                 ],
             }
@@ -281,6 +299,7 @@ class Telemetry:
         *,
         interval_s: float = PERIODIC_READER_INTERVAL_S,
         extra_metrics: Callable[[], dict[str, float] | None] | None = None,
+        registry: "_metrics.MetricsRegistry | None" = None,
     ):
         self.config = config
         self.stats_supplier = stats_supplier
@@ -288,14 +307,29 @@ class Telemetry:
         # the runner wires the persistence CommitMetrics snapshot here so
         # commit-stage timings and in-flight bytes ride the same exports
         self.extra_metrics = extra_metrics
+        # the unified metrics registry (engine/metrics.py): its counters/
+        # gauges merge into every sample and its histograms export as OTLP
+        # histogram datapoints.  None keeps the pre-registry behavior
+        # (direct Telemetry constructions in tests).
+        self.registry = registry
         self.interval_s = interval_s
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
-        self.spans: list[dict] = []
+        # bounded: a streaming run emits sampled epoch spans indefinitely
+        self.spans: deque[dict] = deque(maxlen=SPAN_BUFFER_MAX)
         self._span_lock = threading.Lock()
         # one trace per run when no traceparent was propagated: all this
         # run's spans must correlate in the collector
         self._fallback_trace_id = secrets.token_hex(16)
+        # bounded non-blocking export queue (metrics AND spans): the
+        # sampler/span caller thread never blocks on a slow collector —
+        # it enqueues; one daemon thread drains; overflow drops the
+        # oldest payload and counts it
+        self.dropped_exports = 0
+        self._q: deque[tuple[str, dict, tuple[str, ...]]] = deque()
+        self._q_cv = threading.Condition()
+        self._q_thread: threading.Thread | None = None
+        self._q_closing = False
 
     # -- metrics -----------------------------------------------------------
     def sample(self) -> dict[str, Any]:
@@ -312,11 +346,18 @@ class Telemetry:
             except Exception as exc:  # noqa: BLE001
                 # a gauge supplier must never break the sampler
                 logger.debug("extra metrics supplier failed: %s", exc)
-        return {
+        payload: dict[str, Any] = {
             "resource": self.config.resource(),
             "metrics": metrics,
             "ts": time.time(),
         }
+        if self.registry is not None:
+            try:
+                metrics.update(self.registry.scalar_metrics())
+                payload["histograms"] = self.registry.histogram_points()
+            except Exception as exc:  # noqa: BLE001 - same rule as suppliers
+                logger.debug("metrics registry read failed: %s", exc)
+        return payload
 
     def _export(self, kind: str, payload: dict, servers: tuple[str, ...]) -> None:
         if self.config.protocol == "otlp-json":
@@ -325,8 +366,13 @@ class Telemetry:
             ).encode()
         elif self.config.protocol == "pathway-json":
             # legacy line-JSON (round-3 format) — exactly that format:
-            # fallback_trace_id is an otlp-only hint, not part of it
-            legacy = {k: v for k, v in payload.items() if k != "fallback_trace_id"}
+            # fallback_trace_id and the registry histogram points are
+            # otlp-only payload hints, not part of it
+            legacy = {
+                k: v
+                for k, v in payload.items()
+                if k not in ("fallback_trace_id", "histograms")
+            }
             body = json.dumps({"kind": kind, **legacy}).encode()
         else:
             # a directly-constructed config can bypass create()'s check;
@@ -343,6 +389,73 @@ class Telemetry:
                 urllib.request.urlopen(req, timeout=EXPORT_TIMEOUT_S).read()
             except Exception as exc:
                 logger.debug("telemetry export to %s failed: %s", url, exc)
+
+    # -- bounded export queue ----------------------------------------------
+    def _enqueue_export(
+        self, kind: str, payload: dict, servers: tuple[str, ...]
+    ) -> None:
+        """Queue one export without ever blocking the caller.  Overflow
+        drops the OLDEST queued payload (a fresh sample is worth more than
+        a stale one) and counts the drop — never silently."""
+        if not servers:
+            return
+        with self._q_cv:
+            if self._q_closing:
+                return
+            if len(self._q) >= EXPORT_QUEUE_MAX:
+                self._q.popleft()
+                self._record_drop()
+            self._q.append((kind, payload, servers))
+            if self._q_thread is None or not self._q_thread.is_alive():
+                self._q_thread = threading.Thread(
+                    target=self._q_loop, name="pathway:telemetry-export",
+                    daemon=True,
+                )
+                self._q_thread.start()
+            self._q_cv.notify_all()
+
+    def _record_drop(self) -> None:
+        self.dropped_exports += 1
+        # the drop is itself a metric: it rides /metrics and the next
+        # successful export, so a lossy collector link is visible — on
+        # THIS Telemetry's registry when one was wired (isolated-registry
+        # constructions must not cross-contaminate the global one)
+        (self.registry or _metrics.get_registry()).counter(
+            "telemetry.export.dropped",
+            "telemetry payloads dropped by the bounded export queue",
+        ).inc()
+
+    def _q_loop(self) -> None:
+        while True:
+            with self._q_cv:
+                while not self._q and not self._q_closing:
+                    # untimed: every producer (_enqueue_export) and the
+                    # closer (_drain_queue) notify under this cv
+                    self._q_cv.wait()
+                if not self._q:
+                    return  # closing and drained
+                kind, payload, servers = self._q.popleft()
+            try:
+                self._export(kind, payload, servers)
+            finally:
+                with self._q_cv:
+                    self._q_cv.notify_all()
+
+    def _drain_queue(self, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        with self._q_cv:
+            self._q_closing = True
+            self._q_cv.notify_all()
+            while self._q and time.monotonic() < deadline:
+                self._q_cv.wait(0.1)
+            leftovers = len(self._q)
+            self._q.clear()
+        for _ in range(leftovers):
+            self._record_drop()
+        thread = self._q_thread
+        if thread is not None:
+            thread.join(timeout=max(0.1, deadline - time.monotonic()))
+            self._q_thread = None
 
     # -- spans -------------------------------------------------------------
     @contextmanager
@@ -361,7 +474,9 @@ class Telemetry:
             with self._span_lock:
                 self.spans.append(record)
             if self.config.telemetry_enabled:
-                self._export(
+                # spans ride the bounded queue too: a dead collector must
+                # not add 3 s per endpoint to the span CALLER's thread
+                self._enqueue_export(
                     "traces",
                     {
                         "resource": self.config.resource(),
@@ -370,6 +485,16 @@ class Telemetry:
                     },
                     self.config.tracing_servers,
                 )
+
+    def epoch_span(self, time_: int, index: int, *, every: int = 16):
+        """A sampled per-epoch span context: every ``every``-th epoch gets
+        a real ``pathway.epoch`` span (correlated into the run's trace via
+        the propagated traceparent), the rest cost one modulo.  Only emits
+        when telemetry has an endpoint — zero-egress runs must not grow
+        the span list by one record per epoch."""
+        if not self.config.telemetry_enabled or index % max(1, every):
+            return nullcontext()
+        return self.span("pathway.epoch", epoch=time_, index=index)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "Telemetry":
@@ -383,15 +508,20 @@ class Telemetry:
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
-            self._export("metrics", self.sample(), self.config.metrics_servers)
+            self._enqueue_export(
+                "metrics", self.sample(), self.config.metrics_servers
+            )
 
     def close(self) -> None:
         self._stop.set()
         if self._thread is not None:
             # final flush so short runs still report once
-            self._export("metrics", self.sample(), self.config.metrics_servers)
+            self._enqueue_export(
+                "metrics", self.sample(), self.config.metrics_servers
+            )
             self._thread.join(timeout=5)
             self._thread = None
+        self._drain_queue()
 
 
 def maybe_run_telemetry_thread(

@@ -109,12 +109,28 @@ def _parse_offline_license(text: str) -> License:
     if alg != LICENSE_ALGORITHM:
         raise LicenseError(f"unsupported license algorithm {alg!r}")
     try:
-        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-            Ed25519PublicKey,
-        )
+        # optional dependency, guarded like trace.py's add_note shim: the
+        # cryptography wheel is preferred when present, but its absence
+        # degrades to the pure-Python RFC 8032 verifier — never to an
+        # ImportError that takes unrelated license paths down with it
+        try:
+            from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+                Ed25519PublicKey,
+            )
+        except ImportError:
+            from pathway_tpu.internals import _ed25519
 
-        verifier = Ed25519PublicKey.from_public_bytes(bytes.fromhex(PUBLIC_KEY))
-        verifier.verify(base64.b64decode(sig), b"license/" + enc.encode())
+            if not _ed25519.verify(
+                bytes.fromhex(PUBLIC_KEY),
+                base64.b64decode(sig),
+                b"license/" + enc.encode(),
+            ):
+                raise LicenseError("license signature verification failed")
+        else:
+            verifier = Ed25519PublicKey.from_public_bytes(
+                bytes.fromhex(PUBLIC_KEY)
+            )
+            verifier.verify(base64.b64decode(sig), b"license/" + enc.encode())
     except LicenseError:
         raise
     except Exception as exc:

@@ -118,6 +118,44 @@ class StatsMonitor:
             rows += [(f"{op.name}#{oid}", op) for oid, op in s.operator_stats.items()]
         return rows
 
+    def _runtime_summary(self) -> str | None:
+        """One-line comm/persistence health from the unified metrics
+        registry (``engine/metrics.py``) — the dashboard's view of the
+        same numbers ``/metrics`` and the OTLP exporter serve."""
+        from pathway_tpu.engine import metrics as _metrics
+
+        scalars = _metrics.get_registry().scalar_metrics()
+
+        def total(prefix: str) -> float:
+            return sum(
+                v for k, v in scalars.items()
+                if k == prefix or k.startswith(prefix + "{")
+            )
+
+        parts: list[str] = []
+        frames = total("comm.frames.sent")
+        if frames:
+            mb = total("comm.bytes.sent") / (1 << 20)
+            comm = f"comm: {int(frames)} frames / {mb:.1f} MiB sent"
+            reconnects = total("comm.reconnects")
+            if reconnects:
+                comm += f", {int(reconnects)} reconnect(s)"
+            parts.append(comm)
+        commits = total("checkpoint.commits")
+        if commits:
+            ckpt = (
+                f"checkpoint: {int(commits)} commit(s) / "
+                f"{total('checkpoint.bytes') / (1 << 20):.1f} MiB"
+            )
+            inflight = total("checkpoint.inflight.bytes")
+            if inflight:
+                ckpt += f", {inflight / (1 << 20):.1f} MiB in flight"
+            parts.append(ckpt)
+        dropped = total("telemetry.export.dropped")
+        if dropped:
+            parts.append(f"telemetry: {int(dropped)} export(s) dropped")
+        return " · ".join(parts) if parts else None
+
     def _render(self, final: bool = False):
         from rich.console import Group
         from rich.table import Table as RichTable
@@ -146,6 +184,9 @@ class StatsMonitor:
             + ("  (finished)" if final else "")
         )
         parts: list[Any] = [header, table]
+        summary = self._runtime_summary()
+        if summary:
+            parts.append(Text(summary))
         if self.log_buffer.lines:
             parts.append(Text("\n".join(self.log_buffer.lines[-5:])))
         return Group(*parts)

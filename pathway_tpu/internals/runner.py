@@ -13,6 +13,7 @@ from __future__ import annotations
 import os
 import time as _time
 from collections import deque
+from contextlib import nullcontext as _nullcontext
 from typing import Any, Callable
 
 from pathway_tpu.engine import dataflow as df
@@ -148,6 +149,7 @@ def run(
 
         initialize_distributed()
     worker_ctx = None
+    trace_parent = os.environ.get("TRACEPARENT")
     if _cfg.processes > 1:
         from pathway_tpu.engine.comm import TcpMesh, WorkerContext
 
@@ -159,6 +161,15 @@ def run(
         ).start()
         worker_ctx = WorkerContext(mesh)
         scope.worker = worker_ctx
+        # cross-worker trace correlation: worker 0 mints the run's
+        # traceparent (unless the deployment already exported one — `spawn`
+        # does) and broadcasts it over the fresh mesh, so epoch/commit/
+        # recovery spans from EVERY worker land in one trace
+        from pathway_tpu.engine.telemetry import mint_traceparent
+
+        if _cfg.process_id == 0 and not trace_parent:
+            trace_parent = mint_traceparent()
+        trace_parent = mesh.bcast(("traceparent",), trace_parent)
 
     lowerer = Lowerer(scope)
     # pw.run(debug=True): connectors with debug_data= lower to static
@@ -183,6 +194,7 @@ def run(
         _wire_operator_persistence(scope, storage)
     root_token = None
     http_server = None
+    persist_root = None  # filesystem persistence root, when there is one
     try:
         if storage is not None:
             from pathway_tpu.engine import faults as _faults
@@ -192,10 +204,11 @@ def run(
             if isinstance(base_backend, _faults.FlakyBackend):
                 base_backend = base_backend.inner  # fault wrapper is I/O-only
             if isinstance(base_backend, pz.FileBackend):
+                persist_root = base_backend.root
                 # UDF DiskCache shares the persistence root for this run
                 # only; acquired inside the try so any failure below still
                 # releases it in the finally
-                root_token = pz.acquire_active_root(base_backend.root)
+                root_token = pz.acquire_active_root(persist_root)
 
         from pathway_tpu.engine.probes import Prober
         from pathway_tpu.internals.config import get_config
@@ -208,22 +221,55 @@ def run(
         from pathway_tpu.engine.telemetry import Telemetry, TelemetryConfig
         from pathway_tpu.internals.license import License
 
+        from pathway_tpu.engine import flight_recorder as _blackbox
+        from pathway_tpu.engine import metrics as _registry
+
         license = License.new(config.license_key)
+        registry = _registry.get_registry()
         telemetry = Telemetry(
             TelemetryConfig.create(
                 license=license,
                 run_id=config.run_id,
                 monitoring_server=config.monitoring_server,
-                trace_parent=os.environ.get("TRACEPARENT"),
+                trace_parent=trace_parent,
             ),
             lambda: result.prober.stats if result.prober is not None else None,
-            # commit-pipeline gauges (stage timings, in-flight bytes) ride
-            # the same metric exports as the process/latency gauges
-            extra_metrics=(
-                storage.metrics.snapshot if storage is not None else None
-            ),
+            # the unified registry (comm/persistence/supervisor/runner
+            # instrumentation): scalars merge into every sample, histograms
+            # export as OTLP histogram datapoints.  The commit-pipeline
+            # gauges ride it too, through the collector PersistentStorage
+            # registers — no extra_metrics wiring needed
+            registry=registry,
         ).start()
         result.telemetry = telemetry
+
+        # crash flight recorder: dump context for this worker — the ring
+        # lands under <root>/blackbox/ on crash/fault, where the supervisor
+        # gathers it into SupervisorResult.post_mortem
+        from pathway_tpu.engine.faults import restart_attempt as _attempt
+
+        _blackbox.configure(
+            worker=config.process_id,
+            run_id=telemetry.config.run_id,
+            trace_parent=trace_parent,
+            attempt=_attempt(),
+        )
+        # restart provenance, mesh-visible: the supervisor increments its
+        # own supervisor.restarts counter, but that registry lives in the
+        # spawn process, which serves no /metrics — each worker knows the
+        # attempt that launched it, so the count is scrapeable here
+        registry.gauge(
+            "worker.restart.attempt",
+            "supervisor restarts performed before this worker launch",
+            worker=config.process_id,
+        ).set(_attempt())
+        # set (or clear) the dump root for THIS run: a run without a
+        # filesystem persistence root must not dump into a previous run's
+        _blackbox.get_recorder().root = persist_root
+        _blackbox.record(
+            "run.start", worker=config.process_id, attempt=_attempt(),
+            workers=config.processes,
+        )
 
         if with_http_server:
             from pathway_tpu.engine.http_server import MonitoringServer
@@ -240,13 +286,27 @@ def run(
             if http_server is not None:
                 prober.callbacks.append(http_server.update)
             result.prober = prober
+            # dataflow progress totals join the unified registry (the
+            # WeakMethod registration dies with the prober; each run
+            # replaces the previous run's collector under this name)
+            registry.register_collector(
+                "dataflow.prober", prober.metrics_snapshot
+            )
             with telemetry.span("pathway.run", workers=config.threads):
                 try:
                     _event_loop(
                         scope, lowerer, result, max_epochs=max_epochs,
-                        storage=storage, prober=prober,
+                        storage=storage, prober=prober, telemetry=telemetry,
                     )
-                except BaseException:
+                except BaseException as exc:
+                    # black-box the failure BEFORE unwinding: the ring's
+                    # last events are the crash story the supervisor (or
+                    # `pathway_tpu blackbox`) reads back post-mortem
+                    _blackbox.record(
+                        "run.failed", worker=config.process_id,
+                        error=repr(exc),
+                    )
+                    _blackbox.dump(f"run failed: {exc!r}")
                     # failure hooks: exported tables must flip to failed so
                     # concurrent importers raise instead of waiting forever
                     # (the scopeguard of dataflow/export.rs:143-146)
@@ -285,10 +345,18 @@ def run(
                         "consistent operator snapshot generation"
                     )
                 else:
-                    storage.commit(
-                        processed_up_to=frontier,
-                        full_operator_dump=result.clean_finish,
+                    # the shutdown drain-commit gets its own span so the
+                    # run's trace shows where final durability time went
+                    commit_span = (
+                        result.telemetry.span("pathway.commit", final=True)
+                        if result.telemetry is not None
+                        else _nullcontext()
                     )
+                    with commit_span:
+                        storage.commit(
+                            processed_up_to=frontier,
+                            full_operator_dump=result.clean_finish,
+                        )
                     # this drain-commit durably covers every drained commit
                     # marker (their chunks were flushed at drain), so
                     # release the tail acks the in-loop published_seq
@@ -438,6 +506,20 @@ def _attach_wake(pollers) -> "Any":
     return wake
 
 
+def _epoch_instruments():
+    """(histogram, recorder) pair the epoch loops stamp each epoch with:
+    a registry histogram of epoch wall time and the flight-recorder ring
+    (both bounded-cost; see engine/metrics.py, engine/flight_recorder.py)."""
+    from pathway_tpu.engine import flight_recorder as _blackbox
+    from pathway_tpu.engine import metrics as _registry
+
+    hist = _registry.get_registry().histogram(
+        "epoch.duration.ms", "wall time of one processed epoch (ms)",
+        buckets=(0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000, 5000, 10000),
+    )
+    return hist, _blackbox
+
+
 def _event_loop(
     scope: df.Scope,
     lowerer: Lowerer,
@@ -445,12 +527,14 @@ def _event_loop(
     max_epochs: int | None = None,
     storage: Any = None,
     prober: Any = None,
+    telemetry: Any = None,
 ) -> None:
     if scope.worker is not None:
         return _event_loop_coordinated(
             scope, lowerer, result, max_epochs=max_epochs, storage=storage,
-            prober=prober,
+            prober=prober, telemetry=telemetry,
         )
+    epoch_hist, blackbox = _epoch_instruments()
     inputs = _input_nodes(scope)
     pollers = lowerer.pollers
     wake = _attach_wake(pollers)
@@ -505,7 +589,16 @@ def _event_loop(
                 inp.merge_staged_through(t)
                 inp.emit_time(t)
             result.epoch_failed = True
-            scope.run_epoch(t)
+            t0 = _time.perf_counter()
+            span = (
+                telemetry.epoch_span(t, result.epochs)
+                if telemetry is not None
+                else _nullcontext()
+            )
+            with span:
+                scope.run_epoch(t)
+            epoch_hist.observe((_time.perf_counter() - t0) * 1000.0)
+            blackbox.record("epoch", time=t, index=result.epochs)
             result.epoch_failed = False
             drain_spins = 0
             last_time = t
@@ -561,6 +654,7 @@ def _event_loop_coordinated(
     max_epochs: int | None = None,
     storage: Any = None,
     prober: Any = None,
+    telemetry: Any = None,
 ) -> None:
     """Multi-worker BSP loop: worker 0 sequences epochs, every worker runs
     them in lockstep, exchanging rows at the declared exchange points.
@@ -572,6 +666,7 @@ def _event_loop_coordinated(
     """
     ctx = scope.worker
     mesh = ctx.mesh
+    epoch_hist, blackbox = _epoch_instruments()
     inputs = _input_nodes(scope)
     pollers = lowerer.pollers
     wake = _attach_wake(pollers)
@@ -666,7 +761,18 @@ def _event_loop_coordinated(
                 inp.put_staged(t, merged)
             inp.emit_time(t)
         result.epoch_failed = True
-        scope.run_epoch(t)
+        t0 = _time.perf_counter()
+        span = (
+            telemetry.epoch_span(t, result.epochs)
+            if telemetry is not None
+            else _nullcontext()
+        )
+        with span:
+            scope.run_epoch(t)
+        epoch_hist.observe((_time.perf_counter() - t0) * 1000.0)
+        blackbox.record(
+            "epoch", time=t, index=result.epochs, worker=mesh.worker_id
+        )
         result.epoch_failed = False
         drain_spins = 0  # an input-driven epoch proves progress
         last_time = t

@@ -1,0 +1,533 @@
+"""Mesh-wide observability tests: unified metrics registry, Prometheus +
+OTLP export (golden-format), the bounded telemetry export queue, the
+crash flight recorder, and the supervisor post-mortem path.
+
+Model: src/engine/telemetry.rs (gauges into one meter) +
+src/engine/http_server.rs (Prometheus exposition of live stats); the
+flight recorder is this engine's own addition — the black box the
+fault-tolerance story (PRs 1-3) was missing.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import signal
+import threading
+import time
+from collections import Counter as _Counter
+
+import pytest
+
+from pathway_tpu.engine import flight_recorder as fr
+from pathway_tpu.engine import metrics as em
+from pathway_tpu.engine.metrics import MetricsRegistry
+
+# --- registry ----------------------------------------------------------------
+
+
+def _sample_registry() -> MetricsRegistry:
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("comm.frames.sent", "mesh data frames written", worker=0)
+    c.inc(41)
+    c.inc()
+    reg.gauge("checkpoint.inflight.jobs", "in-flight artifact writes").set(3)
+    h = reg.histogram(
+        "epoch.duration.ms", "wall time of one processed epoch (ms)",
+        buckets=(1, 10, 100), worker=0,
+    )
+    for v in (0.5, 0.7, 5.0, 50.0, 5000.0):
+        h.observe(v)
+    return reg
+
+
+def test_registry_counter_gauge_histogram_basics():
+    reg = _sample_registry()
+    scalars = reg.scalar_metrics()
+    assert scalars["comm.frames.sent{worker=0}"] == 42.0
+    assert scalars["checkpoint.inflight.jobs"] == 3.0
+    (point,) = reg.histogram_points()
+    assert point["name"] == "epoch.duration.ms"
+    assert point["labels"] == {"worker": "0"}
+    assert point["bucket_counts"] == [2, 1, 1, 1]
+    assert point["count"] == 5 and point["sum"] == pytest.approx(5056.2)
+    # same name, same labels -> the same child handle
+    assert reg.counter("comm.frames.sent", worker=0) is reg.counter(
+        "comm.frames.sent", worker=0
+    )
+    # same name, different kind -> loud error, not silent aliasing
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("comm.frames.sent")
+
+
+def test_registry_disable_switch_stops_all_updates():
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("x.count")
+    h = reg.histogram("x.hist", buckets=(1,))
+    c.inc()
+    reg.set_enabled(False)
+    c.inc(100)
+    h.observe(5)
+    assert reg.scalar_metrics()["x.count"] == 1.0
+    assert reg.histogram_points()[0]["count"] == 0
+    reg.set_enabled(True)
+    c.inc()
+    assert reg.scalar_metrics()["x.count"] == 2.0
+
+
+def test_registry_collector_weakref_dies_with_owner():
+    class Owner:
+        def snapshot(self):
+            return {"owner.alive": 1.0}
+
+    reg = MetricsRegistry(enabled=True)
+    owner = Owner()
+    reg.register_collector("owner", owner.snapshot)
+    assert reg.collect() == {"owner.alive": 1.0}
+    del owner
+    assert reg.collect() == {}
+
+
+GOLDEN_PROMETHEUS = """\
+# HELP pathway_checkpoint_inflight_jobs in-flight artifact writes
+# TYPE pathway_checkpoint_inflight_jobs gauge
+pathway_checkpoint_inflight_jobs{run_id="r7"} 3
+# HELP pathway_comm_frames_sent mesh data frames written
+# TYPE pathway_comm_frames_sent counter
+pathway_comm_frames_sent{worker="0",run_id="r7"} 42
+# HELP pathway_epoch_duration_ms wall time of one processed epoch (ms)
+# TYPE pathway_epoch_duration_ms histogram
+pathway_epoch_duration_ms_bucket{worker="0",run_id="r7",le="1.0"} 2
+pathway_epoch_duration_ms_bucket{worker="0",run_id="r7",le="10.0"} 3
+pathway_epoch_duration_ms_bucket{worker="0",run_id="r7",le="100.0"} 4
+pathway_epoch_duration_ms_bucket{worker="0",run_id="r7",le="+Inf"} 5
+pathway_epoch_duration_ms_sum{worker="0",run_id="r7"} 5056.2
+pathway_epoch_duration_ms_count{worker="0",run_id="r7"} 5
+"""
+
+
+def test_prometheus_exposition_golden():
+    """The exact exposition text is pinned: name mangling (dots ->
+    underscores, pathway_ prefix), label merging, cumulative le buckets,
+    sum/count — a format regression breaks real scrape configs."""
+    reg = _sample_registry()
+    assert reg.render_prometheus(extra_labels={"run_id": "r7"}) == GOLDEN_PROMETHEUS
+
+
+GOLDEN_OTLP_HISTOGRAM = {
+    "name": "epoch.duration.ms",
+    "histogram": {
+        "dataPoints": [
+            {
+                "startTimeUnixNano": "1700000000000000000",
+                "timeUnixNano": "1700000000000000000",
+                "count": "5",
+                "sum": 5056.2,
+                "bucketCounts": ["2", "1", "1", "1"],
+                "explicitBounds": [1, 10, 100],
+                "attributes": [
+                    {"key": "worker", "value": {"stringValue": "0"}}
+                ],
+            }
+        ],
+        "aggregationTemporality": 2,
+    },
+}
+
+
+def test_otlp_histogram_mapping_golden():
+    """opentelemetry-proto JSON mapping pinned exactly: int64s as strings,
+    per-interval bucketCounts with the +Inf slot, explicitBounds, and
+    CUMULATIVE temporality — what a stock OTel collector validates."""
+    reg = _sample_registry()
+    entries = reg.otlp_metrics(ts=1700000000.0)
+    hist = next(e for e in entries if "histogram" in e)
+    assert hist == GOLDEN_OTLP_HISTOGRAM
+    gauges = {e["name"]: e for e in entries if "gauge" in e}
+    dp = gauges["comm.frames.sent"]["gauge"]["dataPoints"][0]
+    assert dp["asDouble"] == 42.0
+    assert dp["attributes"] == [
+        {"key": "worker", "value": {"stringValue": "0"}}
+    ]
+
+
+def test_telemetry_sample_carries_registry_and_otlp_histograms():
+    from pathway_tpu.engine.telemetry import (
+        Telemetry,
+        TelemetryConfig,
+        _otlp_metrics,
+    )
+
+    reg = _sample_registry()
+    cfg = TelemetryConfig.create(run_id="r8")
+    tele = Telemetry(cfg, registry=reg)
+    sample = tele.sample()
+    assert sample["metrics"]["comm.frames.sent{worker=0}"] == 42.0
+    assert sample["histograms"][0]["name"] == "epoch.duration.ms"
+    body = _otlp_metrics(sample)
+    metrics = body["resourceMetrics"][0]["scopeMetrics"][0]["metrics"]
+    names = {m["name"] for m in metrics}
+    assert "comm.frames.sent" in names  # label split out of the name
+    assert any("histogram" in m for m in metrics)
+
+
+def test_http_server_metrics_includes_registry():
+    import urllib.request
+
+    from pathway_tpu.engine.http_server import MonitoringServer
+    from pathway_tpu.engine.probes import ProberStats
+
+    reg = _sample_registry()
+    server = MonitoringServer(port=0, run_id="r9", registry=reg).start()
+    try:
+        port = server._httpd.server_address[1]
+        server.update(ProberStats(epochs=3))
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics") as r:
+            body = r.read().decode()
+    finally:
+        server.close()
+    assert 'epochs_total{run_id="r9"} 3' in body  # ProberStats render intact
+    assert 'pathway_comm_frames_sent{worker="0",run_id="r9"} 42' in body
+    assert "pathway_epoch_duration_ms_bucket" in body
+    assert body.rstrip().endswith("# EOF") and body.count("# EOF") == 1
+
+
+# --- bounded export queue ----------------------------------------------------
+
+
+def test_export_queue_bounds_and_counts_drops(monkeypatch):
+    from pathway_tpu.engine import telemetry as tmod
+    from pathway_tpu.engine.telemetry import Telemetry, TelemetryConfig
+    from pathway_tpu.internals.license import License
+
+    monkeypatch.setattr(tmod, "EXPORT_QUEUE_MAX", 4)
+    cfg = TelemetryConfig.create(
+        license=License.new("demo-license-key-with-telemetry-abc"),
+        monitoring_server="http://127.0.0.1:1",  # never reached
+        run_id="rq",
+    )
+    tele = Telemetry(cfg)
+    release = threading.Event()
+    exported = []
+
+    def slow_export(kind, payload, servers):
+        release.wait(5)
+        exported.append(kind)
+
+    tele._export = slow_export
+    servers = cfg.metrics_servers
+    for i in range(10):
+        tele._enqueue_export("metrics", {"i": i}, servers)
+    # 1 in flight + 4 queued; 5 dropped (oldest first), each counted
+    deadline = time.monotonic() + 2
+    while tele.dropped_exports < 5 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert tele.dropped_exports == 5
+    dropped_metric = em.get_registry().scalar_metrics()[
+        "telemetry.export.dropped"
+    ]
+    assert dropped_metric >= 5
+    release.set()
+    tele.close()
+    assert len(exported) == 5  # everything not dropped was delivered
+
+
+def test_span_does_not_block_on_slow_collector():
+    """A span caller must return immediately even when the collector
+    endpoint hangs — exports ride the queue thread."""
+    from pathway_tpu.engine.telemetry import Telemetry, TelemetryConfig
+    from pathway_tpu.internals.license import License
+
+    cfg = TelemetryConfig.create(
+        license=License.new("demo-license-key-with-telemetry-abc"),
+        monitoring_server="http://127.0.0.1:1",
+        run_id="rs",
+    )
+    tele = Telemetry(cfg)
+    blocker = threading.Event()
+    tele._export = lambda *a: blocker.wait(5)
+    t0 = time.perf_counter()
+    with tele.span("pathway.run", workers=1):
+        pass
+    assert time.perf_counter() - t0 < 0.5  # enqueue, not a 3 s POST timeout
+    blocker.set()
+    tele.close()
+
+
+# --- flight recorder ---------------------------------------------------------
+
+
+def test_flight_recorder_ring_is_bounded_and_dump_roundtrips(tmp_path):
+    rec = fr.FlightRecorder(capacity=8)
+    rec.configure(root=str(tmp_path), worker=2, run_id="run-x", attempt=1)
+    for i in range(20):
+        rec.record("epoch", time=i)
+    rec.record("fault.injected", fault="writer_crash", key="snapshots/0")
+    events = rec.events()
+    assert len(events) == 8  # bounded ring: oldest evicted
+    assert events[-1]["kind"] == "fault.injected"
+    assert events[-1]["seq"] == 21  # seq keeps counting past evictions
+
+    path = rec.dump("test crash")
+    assert path is not None and os.path.exists(path)
+    gathered = fr.gather_dumps(str(tmp_path))
+    assert list(gathered) == [2]
+    payload = gathered[2][0]
+    assert payload["reason"] == "test crash"
+    assert payload["run_id"] == "run-x" and payload["attempt"] == 1
+    assert payload["events"][-1]["kind"] == "fault.injected"
+
+    summary = fr.summarize_dumps(gathered, tail=3)
+    info = summary["workers"][2]
+    assert info["events_recorded"] == 8
+    assert [e["kind"] for e in info["last_events"]][-1] == "fault.injected"
+    assert info["dumps"] == [path]
+
+
+def test_flight_recorder_dump_without_root_is_noop(tmp_path):
+    rec = fr.FlightRecorder()
+    rec.record("epoch", time=0)
+    assert rec.dump("no root configured") is None
+    assert fr.gather_dumps(str(tmp_path)) == {}
+
+
+def test_blackbox_cli_renders_dump(tmp_path):
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import cli
+
+    rec = fr.FlightRecorder()
+    rec.configure(root=str(tmp_path), worker=0, run_id="run-cli")
+    rec.record("epoch", time=4)
+    rec.record("comm.reconnect", peer=1, error="boom")
+    rec.dump("SIGKILL injected")
+
+    runner = CliRunner()
+    result = runner.invoke(cli, ["blackbox", str(tmp_path)])
+    assert result.exit_code == 0, result.output
+    assert "SIGKILL injected" in result.output
+    assert "comm.reconnect" in result.output and "peer=1" in result.output
+
+    result = runner.invoke(cli, ["blackbox", "--json", str(tmp_path)])
+    assert result.exit_code == 0
+    assert json.loads(result.stdout)["0"][0]["reason"] == "SIGKILL injected"
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    result = runner.invoke(cli, ["blackbox", str(empty)])
+    assert result.exit_code == 1
+
+
+def test_traceparent_minting_well_formed():
+    from pathway_tpu.engine.telemetry import _root_trace_id, mint_traceparent
+
+    tp = mint_traceparent()
+    version, trace_id, span_id, flags = tp.split("-")
+    assert (version, flags) == ("00", "01")
+    assert len(trace_id) == 32 and len(span_id) == 16
+    int(trace_id, 16), int(span_id, 16)
+    assert _root_trace_id(tp) == trace_id
+    assert mint_traceparent() != tp
+
+
+# --- incremental GC ----------------------------------------------------------
+
+
+def test_gc_steady_state_never_walks_the_root(monkeypatch):
+    """ROADMAP [perf] residue: after the single listing at resume, the
+    per-publish GC must run entirely off the in-memory generation index —
+    zero list_keys calls — while still enforcing the retention window."""
+    from pathway_tpu.engine import persistence as pz
+
+    class CountingBackend(pz.MemoryBackend):
+        def __init__(self):
+            super().__init__({})
+            self.list_calls = 0
+
+        def list_keys(self, prefix):
+            self.list_calls += 1
+            return super().list_keys(prefix)
+
+    monkeypatch.setenv("PATHWAY_CHECKPOINT_GENERATIONS", "2")
+    backend = CountingBackend()
+    storage = pz.PersistentStorage(backend, worker=0)
+    calls_after_load = backend.list_calls
+    assert calls_after_load >= 1  # resume pays exactly the startup listing
+
+    st = storage.register_source("src")
+    for i in range(6):
+        st.log.record(i, (i,), 1)
+        st.log.flush_chunk()
+        st.pending_offset = i
+        storage.commit()
+    assert backend.list_calls == calls_after_load, (
+        "steady-state GC walked the persistence root"
+    )
+    assert storage.metrics.gc_runs >= 1 and storage.metrics.gc_deleted >= 1
+    gens = sorted(storage._list_generations())
+    assert gens == [5, 6], gens  # retention window enforced incrementally
+
+
+# --- chaos: writer_crash leaves a black box the supervisor surfaces ---------
+
+N_ROWS = 18
+ROW_DELAY_S = 0.02
+
+
+def _blackbox_scenario(tmpdir: str) -> None:
+    """Single-worker streaming pipeline whose source GATES on committed
+    generations (the `_gated_scenario` pattern): rows 6+ wait for
+    generation 1 on disk, rows 12+ for generation 2 — so the injected
+    ``writer_crash`` (below) deterministically fires after committed
+    state exists to recover from."""
+    import pathway_tpu as pw
+
+    manifest_dir = os.path.join(tmpdir, "pstore", "manifests", "0")
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            def wait_for_generations(n):
+                deadline = time.monotonic() + 20
+                while time.monotonic() < deadline:
+                    try:
+                        if len([
+                            f for f in os.listdir(manifest_dir)
+                            if not f.endswith(".tmp")
+                        ]) >= n:
+                            return
+                    except OSError:
+                        pass
+                    time.sleep(0.01)
+                raise RuntimeError(f"generation {n} never appeared")
+
+            for i in range(N_ROWS):
+                if i == 6:
+                    wait_for_generations(1)
+                elif i == 12:
+                    wait_for_generations(2)
+                self.next(k=i % 3, v=1)
+                self.commit()
+                time.sleep(ROW_DELAY_S)
+
+    t = pw.io.python.read(
+        Src(), schema=pw.schema_from_types(k=int, v=int), name="src"
+    )
+    counts = t.groupby(t.k).reduce(k=t.k, n=pw.reducers.count())
+    pw.io.jsonlines.write(counts, os.path.join(tmpdir, "counts.jsonl"))
+    pw.run(
+        monitoring_level=pw.MonitoringLevel.NONE,
+        persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem(os.path.join(tmpdir, "pstore")),
+            snapshot_interval_ms=20,
+        ),
+    )
+
+
+def _blackbox_worker_main(attempt: int, tmpdir: str, plan_json: str) -> None:
+    os.environ["PATHWAY_PROCESSES"] = "1"
+    os.environ["PATHWAY_PROCESS_ID"] = "0"
+    os.environ["PATHWAY_RESTART_ATTEMPT"] = str(attempt)
+    os.environ["PATHWAY_FAULT_PLAN"] = plan_json
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+
+    from pathway_tpu.engine import faults
+    from pathway_tpu.internals.config import refresh_config
+    from pathway_tpu.internals.parse_graph import G
+
+    refresh_config()
+    faults.clear_plan()
+    G.clear()
+    _blackbox_scenario(tmpdir)
+
+
+@pytest.mark.chaos
+def test_writer_crash_leaves_flight_recorder_dump_in_post_mortem(tmp_path):
+    """Acceptance: a ``writer_crash`` fault SIGKILLs the worker from its
+    checkpoint writer pool; the black box dumped just before the kill
+    must surface on ``SupervisorResult.post_mortem`` (which fault fired,
+    the last epochs before death), the supervised rerun must converge to
+    exactly-once output, and ``pathway_tpu blackbox`` must render it."""
+    from pathway_tpu.engine.supervisor import Supervisor
+
+    plan = json.dumps(
+        {
+            "seed": 5,
+            "faults": [
+                {
+                    "kind": "writer_crash",
+                    "worker": 0,
+                    "key": "snapshots/",
+                    "nth": 8,
+                    "attempt": 0,
+                }
+            ],
+        }
+    )
+    ctx = multiprocessing.get_context("fork")
+
+    def spawn(wid: int, attempt: int):
+        p = ctx.Process(
+            target=_blackbox_worker_main,
+            args=(attempt, str(tmp_path), plan),
+            daemon=True,
+        )
+        p.start()
+        return p
+
+    res = Supervisor(
+        spawn,
+        1,
+        max_restarts=3,
+        restart_jitter_s=0.05,
+        checkpoint_root=str(tmp_path / "pstore"),
+    ).run()
+
+    assert res.restarts >= 1, res.history
+    assert res.history[0][0] == -signal.SIGKILL, res.history
+    assert res.exit_codes == [0]
+
+    # the black box made it into the post-mortem
+    assert 0 in res.post_mortem.get("workers", {}), res.post_mortem
+    info = res.post_mortem["workers"][0]
+    assert info["dumps"] and all(os.path.exists(p) for p in info["dumps"])
+    assert any("writer crash" in (r or "") for r in info["reasons"])
+    kinds = [e["kind"] for e in info["last_events"]]
+    assert "fault.injected" in kinds, kinds
+    fault_ev = next(
+        e for e in info["last_events"] if e["kind"] == "fault.injected"
+    )
+    assert fault_ev["fault"] == "writer_crash"
+
+    # the recovered run is exactly-once
+    state: _Counter = _Counter()
+    with open(tmp_path / "counts.jsonl") as f:
+        for line in f:
+            obj = json.loads(line)
+            diff = obj.pop("diff")
+            obj.pop("time")
+            state[json.dumps(obj, sort_keys=True)] += diff
+    got = {
+        json.loads(k)["k"]: json.loads(k)["n"]
+        for k, c in state.items()
+        if c
+    }
+    assert got == {0: 6, 1: 6, 2: 6}, got
+
+    # and the CLI renders the dump
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import cli
+
+    result = CliRunner().invoke(
+        cli, ["blackbox", str(tmp_path / "pstore")]
+    )
+    assert result.exit_code == 0, result.output
+    assert "writer crash" in result.output
+    assert "fault.injected" in result.output

@@ -33,17 +33,31 @@ from tests.utils import T
 SIGNING_KEY = "682e082b20053bf9591b11eabeadd95a0378e9d6e39a05117e782eaea4485e0b"
 
 
-def make_license_file(entitlements, policy="enterprise", telemetry_required=False):
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+def _sign_ed25519(message: bytes) -> bytes:
+    """Sign with the cryptography wheel when present, else the pure-Python
+    RFC 8032 fallback — both produce the identical deterministic
+    signature, so the fixtures exercise whichever verifier license.py
+    resolved to in this environment."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+            Ed25519PrivateKey,
+        )
+    except ImportError:
+        from pathway_tpu.internals import _ed25519
 
+        return _ed25519.sign(bytes.fromhex(SIGNING_KEY), message)
+    signer = Ed25519PrivateKey.from_private_bytes(bytes.fromhex(SIGNING_KEY))
+    return signer.sign(message)
+
+
+def make_license_file(entitlements, policy="enterprise", telemetry_required=False):
     payload = {
         "entitlements": entitlements,
         "policy": policy,
         "telemetry_required": telemetry_required,
     }
     enc = base64.b64encode(json.dumps(payload).encode()).decode()
-    signer = Ed25519PrivateKey.from_private_bytes(bytes.fromhex(SIGNING_KEY))
-    sig = base64.b64encode(signer.sign(b"license/" + enc.encode())).decode()
+    sig = base64.b64encode(_sign_ed25519(b"license/" + enc.encode())).decode()
     outer = base64.b64encode(
         json.dumps({"enc": enc, "sig": sig, "alg": "base64+ed25519"}).encode()
     ).decode()
