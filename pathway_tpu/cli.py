@@ -90,6 +90,7 @@ def spawn_program(
     if supervise:
         from pathway_tpu.engine.supervisor import (
             ENV_ATTEMPT,
+            ENV_INCARNATION,
             Supervisor,
             SupervisorError,
         )
@@ -104,6 +105,13 @@ def spawn_program(
                 run_id=run_id,
             )
             env[ENV_ATTEMPT] = str(attempt)
+            # the supervisor bumps the root's incarnation lease before
+            # each attempt and exports it into ITS environ; copy it into
+            # the worker env so persistence fencing and the mesh handshake
+            # see the incarnation this attempt runs under
+            incarnation = os.environ.get(ENV_INCARNATION)
+            if incarnation is not None:
+                env[ENV_INCARNATION] = incarnation
             return subprocess.Popen([program, *arguments], env=env)
 
         def echo_post_mortem(post_mortem: dict) -> None:
@@ -358,6 +366,26 @@ def scrub(worker, as_json, repair, root):
         click.echo(f"scrub of {report['backend']}")
         if report.get("error"):
             click.echo(f"  ERROR: {report['error']}")
+        lease = report.get("lease")
+        if lease is not None:
+            if lease.get("ok"):
+                beacons = lease.get("progress_workers") or []
+                click.echo(
+                    f"  lease: incarnation {lease['incarnation']} "
+                    f"(owner: {lease.get('owner')})"
+                    + (f", progress beacons for workers {beacons}"
+                       if beacons else "")
+                )
+            else:
+                click.echo(f"  lease: DAMAGED — {lease.get('error')}")
+        bb = report.get("blackbox")
+        if bb is not None:
+            click.echo(
+                f"  blackbox: {bb['dumps']} flight-recorder dump(s) "
+                f"for worker(s) {bb['workers']}"
+                + (f", {len(bb['unreadable'])} unreadable"
+                   if bb["unreadable"] else "")
+            )
         if not report["workers"] and not report.get("error"):
             click.echo("  no checkpoint state found")
         for wid, wrep in sorted(report["workers"].items()):
@@ -373,7 +401,11 @@ def scrub(worker, as_json, repair, root):
                 click.echo(f"    metadata pointer: {pointer_error}")
             for entry in wrep["generations"]:
                 mark = "ok" if entry["ok"] else "CORRUPT"
-                click.echo(f"    generation {entry['generation']}: {mark}")
+                stamp = entry.get("incarnation")
+                click.echo(
+                    f"    generation {entry['generation']}: {mark}"
+                    + (f" (incarnation {stamp})" if stamp else "")
+                )
                 for problem in entry["problems"]:
                     click.echo(f"      - {problem}")
     click.echo(

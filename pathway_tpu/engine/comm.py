@@ -235,6 +235,26 @@ class TcpMesh:
         self.first_port = first_port
         self.host = host
         self.secret = _resolve_secret(secret)
+        # incarnation-fenced handshakes: when the supervisor runs this
+        # worker under an incarnation lease (PATHWAY_INCARNATION, see
+        # engine/supervisor.py), the handshake secret is derived from
+        # (secret, incarnation) — a zombie worker from a superseded
+        # restart attempt then FAILS authentication against the respawned
+        # cluster's mesh and is dropped before it can exchange a single
+        # frame, mirroring the persistence-root fencing.  The base secret
+        # keeps deciding typed-only decode (an incarnation number is
+        # public, so it must never upgrade an unauthenticated mesh).
+        self._auth_secret = self.secret
+        # lazy: persistence's env parse is the single authority on what
+        # counts as "this process holds an incarnation" (persistence does
+        # not import comm, so the import stays one-way)
+        from pathway_tpu.engine.persistence import writer_incarnation
+
+        fence_inc = writer_incarnation()
+        if self.secret and fence_inc > 0:
+            self._auth_secret = _hmac.new(
+                self.secret, b"incarnation:%d" % fence_inc, "sha256"
+            ).digest()
         # multi-host deployments (one process per k8s pod / TPU host):
         # peer_hosts[i] is worker i's hostname; ports stay first_port+i so
         # the same config also works on localhost
@@ -368,7 +388,7 @@ class TcpMesh:
         for peer in dial_to:
             sock = _dial(
                 self._peer_host(peer), self.first_port + peer,
-                self.worker_id, self.secret,
+                self.worker_id, self._auth_secret,
             )
             self._attach(peer, sock)
 
@@ -420,7 +440,7 @@ class TcpMesh:
         # its own HANDSHAKE_TIMEOUT_S, never the accept loop
         try:
             sock.settimeout(HANDSHAKE_TIMEOUT_S)
-            peer = _handshake_accept(sock, self.secret)
+            peer = _handshake_accept(sock, self._auth_secret)
             if peer <= self.worker_id or peer not in self._links:
                 raise CommError(f"unexpected peer id {peer}")
             sock.settimeout(None)
@@ -723,7 +743,7 @@ class TcpMesh:
             try:
                 sock = _dial(
                     self._peer_host(peer), self.first_port + peer,
-                    self.worker_id, self.secret,
+                    self.worker_id, self._auth_secret,
                     deadline_s=min(5.0, max(0.5, deadline - time.monotonic())),
                 )
             except CommError as exc:

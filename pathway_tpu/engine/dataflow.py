@@ -2282,6 +2282,12 @@ class Scope:
                     worker=worker.worker_id if worker is not None else 0,
                     epoch=self.epochs_run,
                 )
+                # hang injection shares the boundary: a wedged loop is the
+                # watchdog's problem, a SIGKILL is the supervisor's
+                _faults.maybe_hang(
+                    worker=worker.worker_id if worker is not None else 0,
+                    epoch=self.epochs_run,
+                )
             self.epochs_run += 1
         for node in self.nodes:
             try:

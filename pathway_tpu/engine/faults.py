@@ -17,6 +17,8 @@ Plan format (JSON, also accepted as a Python list of dicts)::
         {"kind": "comm_corrupt", "worker": 0, "peer": 1, "nth": 1},
         {"kind": "comm_delay",   "worker": 0, "delay_ms": 50, "prob": 0.2},
         {"kind": "crash",        "worker": 1, "at_epoch": 3, "attempt": 0},
+        {"kind": "hang",         "worker": 1, "at_epoch": 3, "attempt": 0},
+        {"kind": "zombie",       "worker": 0, "nth": 3, "attempt": 0},
         {"kind": "blob_put",     "nth": 2, "key": "manifests"},
         {"kind": "blob_get",     "prob": 0.1, "max_times": 3},
         {"kind": "blob_bitflip", "key": "manifests/0/", "from_nth": 3},
@@ -55,6 +57,18 @@ comm_delay   ``TcpMesh.send``: sleep ``delay_ms`` before the write.
 crash        ``Scope.run_epoch``: SIGKILL the current process at the
              chosen epoch boundary (a hard worker death, not an
              exception — nothing gets to flush).
+hang         ``Scope.run_epoch``: WEDGE the epoch loop at the chosen epoch
+             boundary — the process stays alive but makes no progress (a
+             deadlock / stuck blob I/O stand-in).  Only a signal ends it:
+             the supervisor's progress watchdog must detect the stall,
+             SIGUSR1 a flight-recorder dump out of it, then escalate
+             SIGTERM → SIGKILL and restart the group.
+zombie       ``persistence._publish_manifest``: stall the Nth manifest
+             publish until the root's lease shows a NEWER incarnation
+             (bounded by ``delay_ms``, default 30 s) — a stale writer from
+             a superseded restart attempt publishing late.  The
+             incarnation fence must then reject the publish
+             (``FencedError``) and the worker must self-terminate.
 writer_crash ``persistence._WriterPool``: SIGKILL from a checkpoint
              writer thread mid-async-commit (artifact hashed, upload
              pending) — the staged generation must stay unreferenced
@@ -86,6 +100,7 @@ import os
 import random
 import signal
 import threading
+import time as _time
 from typing import Any
 
 from pathway_tpu.engine import flight_recorder as _blackbox
@@ -104,7 +119,7 @@ KINDS = (
     _COMM_KINDS
     + _BLOB_KINDS
     + _BLOB_CORRUPT_KINDS
-    + ("crash", "writer_crash", "connector_read")
+    + ("crash", "writer_crash", "hang", "zombie", "connector_read")
 )
 
 
@@ -321,6 +336,25 @@ def maybe_crash(*, worker: int, epoch: int) -> None:
         # like a real flight recorder losing power)
         _blackbox.dump(f"injected crash (worker {worker}, epoch {epoch})")
         os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_hang(*, worker: int, epoch: int) -> None:
+    """Epoch-boundary hang injection: WEDGE the epoch loop forever — the
+    process stays alive, heartbeats may even keep flowing on comm threads,
+    but no epoch ever completes.  Exactly the silent-stall failure mode
+    the supervisor's progress watchdog exists for: no exit code, no
+    exception, just a progress file whose mtime stops moving.
+
+    The wedge is a plain interruptible sleep loop so the watchdog's
+    SIGUSR1 (flight-recorder dump) still runs in this main thread before
+    SIGTERM/SIGKILL ends the process."""
+    plan = active_plan()
+    if plan is None or not plan.has("hang"):
+        return
+    if plan.check("hang", worker=worker, epoch=epoch) is not None:
+        _blackbox.record("fault.hang", worker=worker, epoch=epoch)
+        while True:  # only a signal ends this — that is the point
+            _time.sleep(0.05)
 
 
 def maybe_crash_writer(*, worker: int, key: str) -> None:

@@ -42,7 +42,11 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY):
         self._ring: deque[dict[str, Any]] = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        # reentrant: the watchdog's SIGUSR1 handler (internals/runner.py)
+        # calls record()/dump() on the MAIN thread and may interrupt a
+        # record() that already holds this lock — a plain Lock would
+        # deadlock the whole worker inside the signal handler
+        self._lock = threading.RLock()
         self._seq = 0
         # dump context, set by configure(): without a root, record() still
         # works (post-mortems via the in-process API) but dump() no-ops
@@ -51,6 +55,12 @@ class FlightRecorder:
         self.run_id: str | None = None
         self.trace_parent: str | None = None
         self.attempt = 0
+        # cluster incarnation of this process (PATHWAY_INCARNATION); when
+        # set, dump() is FENCED like every other write to the persistence
+        # root — a zombie from a superseded restart attempt must not drop
+        # its stale story into the live cluster's blackbox/ directory
+        # (the supervisor's post-mortem gather would misattribute it)
+        self.incarnation = 0
         self._dumped: str | None = None  # path of the last dump, if any
 
     # -- recording ---------------------------------------------------------
@@ -78,6 +88,7 @@ class FlightRecorder:
         run_id: str | None = None,
         trace_parent: str | None = None,
         attempt: int | None = None,
+        incarnation: int | None = None,
     ) -> None:
         """Attach dump context; each keyword only overwrites when given."""
         with self._lock:
@@ -91,14 +102,26 @@ class FlightRecorder:
                 self.trace_parent = trace_parent
             if attempt is not None:
                 self.attempt = attempt
+            if incarnation is not None:
+                self.incarnation = incarnation
 
     # -- dumping -----------------------------------------------------------
-    def dump(self, reason: str) -> str | None:
+    def dump(self, reason: str, *, suffix: str | None = None) -> str | None:
         """Write the ring to ``<root>/blackbox/worker-<id>.attempt-<n>.json``
         and return the path; None when no root is configured or the write
         fails (a dying process must never die *harder* because its black
         box could not be written).  The write is staged + renamed so the
-        gatherer never reads a torn dump."""
+        gatherer never reads a torn dump.
+
+        ``suffix`` gives a dump its own file (``...attempt-<n>.<suffix>``)
+        so it cannot clobber — or be clobbered by — the attempt's crash
+        dump: the watchdog's SIGUSR1 dump uses it, because a worker that
+        stalls, gets dumped, and is then killed must leave BOTH stories.
+
+        Fenced like every persistence-root write: when this process
+        carries an incarnation and the root's lease shows a newer one, the
+        dump is refused — a zombie's stale ring must not pollute the live
+        cluster's post-mortems."""
         with self._lock:
             root = self.root
             if not root:
@@ -108,15 +131,21 @@ class FlightRecorder:
                 "attempt": self.attempt,
                 "run_id": self.run_id,
                 "trace_parent": self.trace_parent,
+                "incarnation": self.incarnation,
                 "reason": reason,
                 "pid": os.getpid(),
                 "dumped_at": time.time(),
                 "events": list(self._ring),
             }
+        if payload["incarnation"] and self._fenced(
+            root, payload["incarnation"], payload["worker"]
+        ):
+            return None
         try:
             dump_dir = os.path.join(root, _DUMP_DIR)
             os.makedirs(dump_dir, exist_ok=True)
-            name = f"worker-{payload['worker']}.attempt-{payload['attempt']}.json"
+            name = f"worker-{payload['worker']}.attempt-{payload['attempt']}"
+            name += f".{suffix}.json" if suffix else ".json"
             path = os.path.join(dump_dir, name)
             tmp = f"{path}.tmp"
             with open(tmp, "w") as f:
@@ -131,6 +160,32 @@ class FlightRecorder:
             return path
         except (OSError, ValueError):
             return None
+
+    @staticmethod
+    def _fenced(root: str, incarnation: int, worker: int) -> bool:
+        """True when the root's lease shows a newer incarnation than ours.
+        Best-effort and never raising: a dying process must still get its
+        dump out when the lease is unreadable — only a POSITIVE newer-lease
+        reading fences.  (Lazy import: persistence imports this module at
+        load, so the dependency must stay one-way at import time.)"""
+        try:
+            from pathway_tpu.engine import persistence as _pz
+
+            lease = _pz.read_lease(_pz.FileBackend(root))
+            if lease is not None and lease["incarnation"] > incarnation:
+                from pathway_tpu.engine import metrics as _metrics
+
+                # same labeled series persistence._check_fence counts into
+                _metrics.get_registry().counter(
+                    "persistence.fenced",
+                    "commit-point writes rejected because a newer "
+                    "incarnation owns the root",
+                    worker=worker,
+                ).inc()
+                return True
+        except Exception:  # noqa: BLE001 - forensics must never fail
+            pass
+        return False
 
     @property
     def last_dump(self) -> str | None:

@@ -58,11 +58,94 @@ from pathway_tpu.engine import metrics as _registry
 METADATA_FILE = "metadata.json"
 MANIFEST_FORMAT = 1
 
+# -- incarnation fencing (split-brain protection) ---------------------------
+# The supervisor owns a LEASE on the persistence root: a monotonically
+# increasing *incarnation* number bumped before every (re)launch of the
+# worker group, exported to workers via PATHWAY_INCARNATION.  Every
+# commit-point write re-reads the lease and REFUSES to publish when it
+# shows a newer incarnation — a zombie worker from a superseded restart
+# attempt (alive but partitioned, SIGKILL not yet delivered) can therefore
+# never splice a stale generation into a root the respawned cluster owns.
+LEASE_KEY = "lease/LEASE"
+LEASE_FORMAT = 1
+ENV_INCARNATION = "PATHWAY_INCARNATION"
+
 _log = logging.getLogger("pathway_tpu.persistence")
 
 
 class CheckpointError(RuntimeError):
     """A committed checkpoint artifact is missing or failed verification."""
+
+
+class FencedError(CheckpointError):
+    """A newer cluster incarnation owns this persistence root.
+
+    Raised instead of performing a commit-point write (generation-manifest
+    publish, advisory-pointer refresh) when the on-root lease shows an
+    incarnation newer than this writer's ``PATHWAY_INCARNATION``.  The only
+    correct reaction is to STOP: this process is a zombie from a superseded
+    restart attempt, and anything it publishes would corrupt the live
+    cluster's recovery provenance.  The runner lets it propagate, so the
+    worker exits nonzero and its peers drop it from the mesh.
+    """
+
+
+def writer_incarnation() -> int:
+    """This process's cluster incarnation (``PATHWAY_INCARNATION``); 0 when
+    unleased — solo runs without a supervisor skip fencing entirely."""
+    try:
+        return int(os.environ.get(ENV_INCARNATION, "0") or "0")
+    except ValueError:
+        return 0
+
+
+def _decode_lease(raw: bytes | None) -> dict | None:
+    """Decode a raw lease blob; None when absent, torn, or malformed."""
+    if raw is None:
+        return None
+    try:
+        obj = _json.loads(codec.unframe_blob(raw, what=LEASE_KEY).decode())
+    except (codec.IntegrityError, ValueError):
+        return None
+    if not isinstance(obj, dict) or not isinstance(obj.get("incarnation"), int):
+        return None
+    return obj
+
+
+def read_lease(backend: "BlobBackend") -> dict | None:
+    """The root's lease object, or None when absent/unreadable.
+
+    Unreadable is treated as absent on the WRITE path (a torn lease must
+    not brick every writer); ``scrub_root`` reports it as damage so an
+    operator notices."""
+    return _decode_lease(backend.get(LEASE_KEY))
+
+
+def acquire_lease(
+    backend: "BlobBackend",
+    *,
+    owner: str | None = None,
+    run_id: str | None = None,
+) -> int:
+    """Bump the root's lease to the next incarnation and return it.
+
+    Monotonic across runs of the same root: a fresh supervisor on a reused
+    root starts ABOVE every incarnation that ever wrote there, so any
+    lingering zombie from a previous run is fenced on its next publish.
+    Single-supervisor protocol — the lease serializes worker incarnations
+    under one supervisor, it is not a distributed lock between supervisors.
+    """
+    current = read_lease(backend)
+    incarnation = (current["incarnation"] if current else 0) + 1
+    lease = {
+        "format": LEASE_FORMAT,
+        "incarnation": incarnation,
+        "acquired_at": _time.time(),
+        "owner": owner or f"pid-{os.getpid()}",
+        "run_id": run_id,
+    }
+    backend.put_atomic(LEASE_KEY, codec.frame_blob(_json.dumps(lease).encode()))
+    return incarnation
 
 
 def _retain_generations() -> int:
@@ -1140,6 +1223,10 @@ class PersistentStorage:
         self.mode = mode
         self.sources: dict[str, SourceState] = {}
         self.retain_generations = _retain_generations()
+        # the cluster incarnation this writer belongs to (0 = unleased solo
+        # run, fencing disabled).  Every commit-point write re-checks the
+        # on-root lease against it — see FencedError.
+        self.incarnation = writer_incarnation()
         # generational recovery state, filled by _load_state(): the adopted
         # (verified) generation, the generations rejected on the way down,
         # and whether we resumed from a pre-manifest legacy metadata file
@@ -1231,6 +1318,10 @@ class PersistentStorage:
                 "generation (see `pathway_tpu scrub`) or clear every "
                 "worker's shard to restart the group consistently."
             )
+        # fast-fail for zombies: a stale-incarnation worker must not even
+        # resume (its replay would feed a run whose every publish will be
+        # rejected anyway) — cheap, because the lease is one tiny read
+        self._check_fence("resume from")
         self._op_gen = int(self._metadata.get("operators", {}).get("gen", 0))
         # set by the runner: returns {node_id: bytes} of dirty operator
         # states + the graph digest, collected at commit time; confirm is
@@ -1243,6 +1334,55 @@ class PersistentStorage:
         # whether live connector data follows the replayed prefix
         self.snapshot_access: str | None = None
         self.continue_after_replay = True
+
+    # -- incarnation fencing --
+    def _check_fence(self, what: str) -> None:
+        """Refuse ``what`` when the root's lease shows a newer incarnation.
+
+        Called immediately before every commit-point write.  One tiny
+        lease read per publish (publishes are already rate-limited); a
+        missing or unreadable lease never fences — fencing is only as
+        strong as the supervisor that maintains the lease, and a solo run
+        (incarnation 0) skips the check entirely."""
+        if self.incarnation <= 0:
+            return
+        lease = read_lease(self.backend)
+        if lease is None or lease["incarnation"] <= self.incarnation:
+            return
+        _registry.get_registry().counter(
+            "persistence.fenced",
+            "commit-point writes rejected because a newer incarnation "
+            "owns the root",
+            worker=self.worker,
+        ).inc()
+        _blackbox.record(
+            "persistence.fenced", worker=self.worker, what=what,
+            incarnation=self.incarnation, lease=lease["incarnation"],
+        )
+        raise FencedError(
+            f"persistence: worker {self.worker} of incarnation "
+            f"{self.incarnation} is fenced off {self.backend.describe()}: "
+            f"the lease shows incarnation {lease['incarnation']} — a newer "
+            f"cluster incarnation owns this root; refusing to {what} "
+            "(this process is a zombie from a superseded restart attempt "
+            "and must terminate)"
+        )
+
+    def _zombie_stall(self, spec: Any) -> None:
+        """The ``zombie`` fault: wedge this publish until the lease shows a
+        NEWER incarnation — the deterministic re-creation of a stale writer
+        whose in-flight publish lands after the respawned cluster took
+        over.  The fence check that follows must then reject it.  Bounded
+        (``delay_ms``, default 30 s) so a mis-set plan cannot hang a run
+        forever; gating is on on-disk lease state, never on timing."""
+        deadline = _time.monotonic() + (
+            float(spec.delay_ms) / 1000.0 if spec.delay_ms else 30.0
+        )
+        while _time.monotonic() < deadline:
+            lease = read_lease(self.backend)
+            if lease is not None and lease["incarnation"] > self.incarnation:
+                return
+            _time.sleep(0.02)
 
     # -- metadata / manifests --
     def _meta_key(self) -> str:
@@ -1711,13 +1851,34 @@ class PersistentStorage:
         rate-limit the two best-effort follow-ups (both are advisory /
         deferred by contract; a lagging pointer or a temporarily oversized
         retention window changes no recovery semantics)."""
+        # chaos hook: a `zombie` fault wedges this publish until the lease
+        # is superseded, modelling a stale writer publishing late (lazy
+        # import keeps persistence ↔ faults acyclic at module load)
+        from pathway_tpu.engine import faults as _faults
+
+        spec = _faults.check(
+            "zombie", worker=self.worker,
+            key=self._manifest_key(self.generation + 1),
+        )
+        if spec is not None:
+            self._zombie_stall(spec)
+        # incarnation fence: THE split-brain gate.  Checked here, after the
+        # barrier and immediately before the commit point, so a zombie
+        # worker can never splice a stale generation (or refresh the
+        # advisory pointer, which follows below) into a root a newer
+        # incarnation owns.
+        self._check_fence("publish a generation manifest")
         self.generation += 1
         metadata["format"] = MANIFEST_FORMAT
         metadata["generation"] = self.generation
         # recovery provenance rides every manifest so the supervisor (and
-        # scrub) can reconstruct which generation a restart resumed from
+        # scrub) can reconstruct which generation a restart resumed from —
+        # the incarnation stamp lets scrub cross-check every generation
+        # against the lease (a stamp above the lease means fencing was
+        # bypassed and the root deserves operator attention)
         metadata["recovered_from"] = self.recovered_generation
         metadata["attempt"] = _restart_attempt()
+        metadata["incarnation"] = self.incarnation
         metadata["rejected"] = [[g, r] for g, r in self.rejected_generations]
         self.backend.put_atomic(
             self._manifest_key(self.generation),
@@ -1746,6 +1907,7 @@ class PersistentStorage:
                             "manifest": self._manifest_key(self.generation),
                             "recovered_from": self.recovered_generation,
                             "attempt": metadata["attempt"],
+                            "incarnation": self.incarnation,
                             "rejected": metadata["rejected"],
                         }
                     ).encode(),
@@ -2127,6 +2289,15 @@ def scrub_root(
     but deserves operator attention: that is the non-zero-exit condition).
     A worker with no generations at all is only healthy if it also has no
     broken legacy metadata.
+
+    The ``lease/`` directory (incarnation fencing) and ``blackbox/`` dumps
+    (crash flight recorder) are first-class residents of a persistence
+    root, not foreign keys: the lease is unframed + validated (an
+    unreadable lease, or any generation manifest stamped with an
+    incarnation ABOVE the lease's, fails the audit — the latter means a
+    fencing bypass), and flight-recorder dumps are parse-checked
+    best-effort (they are torn-tolerant by design, so damage is reported
+    but never fails the root).
     """
     all_keys = backend.list_keys("")
     workers: set[int] = set()
@@ -2144,6 +2315,61 @@ def scrub_root(
         "ok": True,
         "workers": {},
     }
+    # -- lease (incarnation fencing) audit --
+    lease_report: dict[str, Any] | None = None
+    lease_incarnation: int | None = None
+    lease_raw = backend.get(LEASE_KEY)  # one read: presence AND decode
+    if lease_raw is not None:
+        lease = _decode_lease(lease_raw)
+        if lease is None:
+            # an unreadable lease is the fencing authority gone dark:
+            # writers treat it as absent (and stop fencing), so the audit
+            # must fail loudly instead of reading as clean
+            lease_report = {
+                "ok": False,
+                "error": "lease undecodable (torn or corrupt frame)",
+            }
+            report["ok"] = False
+        else:
+            lease_incarnation = lease["incarnation"]
+            lease_report = {
+                "ok": True,
+                "incarnation": lease_incarnation,
+                "owner": lease.get("owner"),
+                "run_id": lease.get("run_id"),
+            }
+    if lease_report is not None:
+        # progress beacons live beside the lease; count them so the audit
+        # acknowledges them as first-class rather than unexplained keys
+        lease_report["progress_workers"] = sorted(
+            int(k.rsplit(".", 1)[-1])
+            for k in all_keys
+            if k.startswith("lease/progress.")
+            and k.rsplit(".", 1)[-1].isdigit()
+        )
+        report["lease"] = lease_report
+    # -- flight-recorder dump audit (best-effort, never fails the root) --
+    dump_keys = [
+        k for k in all_keys
+        if k.startswith("blackbox/") and k.endswith(".json")
+    ]
+    if dump_keys:
+        unreadable: list[str] = []
+        dump_workers: set[int] = set()
+        for key in dump_keys:
+            raw = backend.get(key)
+            try:
+                payload = _json.loads((raw or b"").decode())
+                if not isinstance(payload.get("dumped_at"), (int, float)):
+                    raise ValueError("missing dumped_at stamp")
+                dump_workers.add(int(payload.get("worker", -1)))
+            except (ValueError, TypeError, AttributeError):
+                unreadable.append(key)
+        report["blackbox"] = {
+            "dumps": len(dump_keys),
+            "workers": sorted(dump_workers),
+            "unreadable": unreadable,
+        }
     if worker is not None:
         if worker not in workers:
             # a filter that matches nothing must not read as "clean" —
@@ -2174,16 +2400,36 @@ def scrub_root(
         newest_verified = None
         for gen in gens:
             manifest, reason = _read_manifest(backend, f"{prefix}{gen:08d}")
+            stamp = None
             if manifest is None:
                 problems = [reason or "unreadable"]
             else:
                 problems = verify_manifest(
                     backend, w, manifest, cache=audit_cache
                 )
+                stamp = manifest.get("incarnation")
+                if (
+                    lease_incarnation is not None
+                    and isinstance(stamp, int)
+                    and stamp > lease_incarnation
+                ):
+                    # a generation stamped ABOVE the lease means a writer
+                    # published without holding a current incarnation —
+                    # the fencing protocol was bypassed or the lease was
+                    # rolled back; either way the root needs an operator
+                    problems = problems + [
+                        f"manifest stamped with incarnation {stamp} above "
+                        f"the lease's {lease_incarnation} (fencing bypass)"
+                    ]
             if not problems and newest_verified is None:
                 newest_verified = gen
             entries.append(
-                {"generation": gen, "ok": not problems, "problems": problems}
+                {
+                    "generation": gen,
+                    "ok": not problems,
+                    "problems": problems,
+                    "incarnation": stamp,
+                }
             )
         pointer = None
         raw = backend.get(f"{METADATA_FILE}.{w}")

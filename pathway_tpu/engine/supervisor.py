@@ -42,6 +42,28 @@ Restart attempts are announced to workers via ``PATHWAY_RESTART_ATTEMPT``
 (the fault plan's ``attempt`` filter keys off it, so chaos tests can
 inject a crash on attempt 0 and let attempt 1 run clean).
 
+Two hazards the restart loop alone cannot handle, both covered here:
+
+* **Split-brain zombies.**  A worker from a superseded attempt that is
+  not actually dead yet (partitioned, SIGKILL in flight, wedged past its
+  send deadline) could publish a stale checkpoint generation into the
+  same persistence root the respawned cluster now writes.  Before every
+  (re)launch the supervisor therefore bumps an **incarnation lease** on
+  the root (``engine/persistence.py:acquire_lease``) and exports the new
+  incarnation to the workers via ``PATHWAY_INCARNATION``; every
+  commit-point write in the persistence layer re-checks the lease and a
+  stale writer gets ``FencedError`` instead of a publish.
+
+* **Silent hangs.**  A live-but-stuck worker (deadlocked epoch loop,
+  wedged blob I/O) produces no exit code, so the death-watch never fires.
+  Workers touch a progress beacon (``<root>/lease/progress.<id>``) from
+  their epoch loop; the watch loop doubles as a **progress watchdog**:
+  when a beacon goes stale past ``PATHWAY_EPOCH_DEADLINE_S`` the hung
+  worker is sent SIGUSR1 (flight-recorder dump to ``<root>/blackbox/``),
+  then SIGTERM, then SIGKILL — converting the hang into an ordinary
+  supervised restart, with the hang recorded on
+  ``SupervisorResult.last_failure`` and the dump in ``post_mortem``.
+
 Worker handles are duck-typed: ``multiprocessing.Process`` (tests,
 in-repo harnesses) and ``subprocess.Popen`` (``pathway spawn
 --supervise``) both work.
@@ -51,7 +73,9 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import random
+import signal as _signal_mod
 import time
 from typing import Any, Callable, Sequence
 
@@ -61,6 +85,32 @@ _log = logging.getLogger("pathway_tpu.supervisor")
 # `attempt` filter and the jax coordinator-port offset read the same var
 from pathway_tpu.engine.faults import ENV_ATTEMPT  # noqa: E402,F401
 from pathway_tpu.engine import metrics as _metrics  # noqa: E402
+
+# mirrors persistence.ENV_INCARNATION (pinned equal by a test) — a literal
+# here keeps this module's import-time persistence dependency lazy, like
+# every other persistence touch in this file
+ENV_INCARNATION = "PATHWAY_INCARNATION"
+
+ENV_EPOCH_DEADLINE = "PATHWAY_EPOCH_DEADLINE_S"
+# escalation pacing: SIGUSR1 (dump request) → this grace → SIGTERM; the
+# SIGTERM → SIGKILL grace reuses the supervisor's grace_s
+WATCHDOG_DUMP_GRACE_S = 1.0
+# before a worker's FIRST beacon touch of an attempt, allow at least this
+# long: worker startup (interpreter, jax import, mesh formation) produces
+# no progress yet and must not read as a hang under a tight epoch deadline
+WATCHDOG_BOOT_GRACE_S = 30.0
+
+
+def _epoch_deadline_from_env() -> float | None:
+    """``PATHWAY_EPOCH_DEADLINE_S`` as a positive float, else None (the
+    watchdog stays off — a run with long legitimate gaps between epochs
+    must opt in with a deadline that fits its cadence)."""
+    raw = os.environ.get(ENV_EPOCH_DEADLINE, "")
+    try:
+        value = float(raw) if raw else 0.0
+    except ValueError:
+        return None
+    return value if value > 0 else None
 
 
 class SupervisorError(RuntimeError):
@@ -155,6 +205,132 @@ def _signal(handle: Any, *, hard: bool) -> None:
         pass  # already gone
 
 
+def _pid(handle: Any) -> int | None:
+    """OS pid of a worker handle (both Process and Popen expose .pid)."""
+    return getattr(handle, "pid", None)
+
+
+class _ProgressWatchdog:
+    """Hung-worker detection riding the supervisor's watch loop.
+
+    Workers touch a progress beacon — ``<root>/lease/progress.<id>``,
+    mtime refreshed from the epoch loop (``internals/runner.py``) — so
+    "no progress" is an on-disk fact the supervisor can read without any
+    channel to the worker.  When a live worker's beacon age exceeds the
+    epoch deadline, escalate:
+
+    1. SIGUSR1 — the worker's runner dumps its flight recorder to
+       ``<root>/blackbox/`` (a hang leaves no crash dump otherwise: the
+       black box must be pulled OUT of the wreck before it is made one);
+    2. after ``WATCHDOG_DUMP_GRACE_S``: SIGTERM;
+    3. after the supervisor's ``grace_s``: SIGKILL.
+
+    The death is then picked up by the ordinary death-watch and routed
+    through the restart budget; the hang description lands in
+    ``Supervisor._hangs`` so ``last_failure`` tells the real story.
+
+    The beacon clock for a worker starts at attempt launch (a fresh
+    worker has not touched anything yet), so the deadline must exceed
+    worker startup time.  State is per-attempt: a new `_watch` call gets
+    a new watchdog.
+    """
+
+    def __init__(self, supervisor: "Supervisor"):
+        self.sup = supervisor
+        self.deadline = float(supervisor.epoch_deadline_s or 0.0)
+        self.started_at = time.time()
+        # wid -> (phase, phase_entered_at); phases: sigusr1 -> term -> kill
+        self._phase: dict[int, tuple[str, float]] = {}
+        reg = _metrics.get_registry()
+        self._kills = reg.counter(
+            "supervisor.watchdog.kills",
+            "hung workers killed by the progress watchdog",
+        )
+        self._age_gauges = {
+            w: reg.gauge(
+                "worker.last_progress.age_s",
+                "seconds since the worker's last epoch-progress beacon",
+                worker=w,
+            )
+            for w in range(supervisor.n_workers)
+        }
+
+    def _beacon_age(self, wid: int) -> tuple[float, bool]:
+        """(seconds since last progress, touched-this-attempt).  A beacon
+        older than the attempt start (or missing) belongs to a previous
+        attempt: the clock then runs from attempt launch, and the boot
+        grace applies."""
+        path = os.path.join(
+            self.sup.checkpoint_root, "lease", f"progress.{wid}"
+        )
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            mtime = 0.0
+        touched = mtime > self.started_at
+        return time.time() - max(mtime, self.started_at), touched
+
+    def poll(self, handles: Sequence[Any]) -> None:
+        now = time.time()
+        for wid, handle in enumerate(handles):
+            if _exitcode(handle) is not None:
+                continue  # dead workers are the death-watch's business
+            age, touched = self._beacon_age(wid)
+            gauge = self._age_gauges.get(wid)
+            if gauge is not None:
+                gauge.set(age)
+            state = self._phase.get(wid)
+            if state is None:
+                threshold = (
+                    self.deadline
+                    if touched
+                    else max(self.deadline, WATCHDOG_BOOT_GRACE_S)
+                )
+                if age <= threshold:
+                    continue
+                # stall confirmed: ask for the black box FIRST — the hung
+                # process can often still run a signal handler even when
+                # its epoch loop never returns
+                reason = (
+                    f"no epoch progress for {age:.1f}s "
+                    f"(deadline {self.deadline:.1f}s)"
+                )
+                self.sup._hangs[wid] = reason
+                _log.warning(
+                    "watchdog: worker %d is hung (%s) — requesting a "
+                    "flight-recorder dump (SIGUSR1), then killing it into "
+                    "a supervised restart", wid, reason,
+                )
+                pid = _pid(handle)
+                if pid is not None:
+                    try:
+                        os.kill(pid, _signal_mod.SIGUSR1)
+                    except (OSError, ValueError):
+                        pass
+                self._phase[wid] = ("sigusr1", now)
+            elif state[0] == "sigusr1":
+                if age <= self.deadline:
+                    # the worker resumed touching its beacon during the
+                    # dump grace — a slow epoch, not a hang: stand down
+                    # before anything lethal (only the SIGUSR1 dump
+                    # happened, which is harmless forensics)
+                    _log.warning(
+                        "watchdog: worker %d resumed progress "
+                        "(beacon age %.1fs) — aborting the kill escalation",
+                        wid, age,
+                    )
+                    del self._phase[wid]
+                    self.sup._hangs.pop(wid, None)
+                elif now - state[1] >= WATCHDOG_DUMP_GRACE_S:
+                    self._kills.inc()
+                    _signal(handle, hard=False)
+                    self._phase[wid] = ("term", now)
+            elif state[0] == "term":
+                if now - state[1] >= self.sup.grace_s:
+                    _signal(handle, hard=True)
+                    self._phase[wid] = ("kill", now)
+
+
 class Supervisor:
     """Run one SPMD worker group to completion, restarting it on failure.
 
@@ -174,6 +350,7 @@ class Supervisor:
         poll_interval_s: float = 0.05,
         restart_jitter_s: float = 0.5,
         checkpoint_root: str | None = None,
+        epoch_deadline_s: float | None = None,
     ):
         self.spawn = spawn
         self.n_workers = n_workers
@@ -186,8 +363,27 @@ class Supervisor:
         # thundering herd of simultaneous restarts
         self.restart_jitter_s = restart_jitter_s
         # filesystem persistence root (when known): lets the supervisor
-        # read back per-worker checkpoint provenance for post-mortems
+        # read back per-worker checkpoint provenance for post-mortems,
+        # own the incarnation lease, and watch the progress beacons
         self.checkpoint_root = checkpoint_root
+        # progress-watchdog deadline: a worker whose epoch loop makes no
+        # progress for this long is dumped (SIGUSR1) and then killed into
+        # an ordinary supervised restart.  None (and no env override)
+        # disables the watchdog.  The deadline must exceed worker startup
+        # time: the clock for a worker starts at attempt launch until its
+        # first beacon touch.
+        self.epoch_deadline_s = (
+            epoch_deadline_s
+            if epoch_deadline_s is not None
+            else _epoch_deadline_from_env()
+        )
+        # the incarnation this attempt's workers were launched under
+        # (None when no checkpoint root is known — fencing needs a root)
+        self.incarnation: int | None = None
+        # {worker id: hang description} for the CURRENT attempt — filled
+        # by the watchdog when it starts killing a stalled worker, read by
+        # run() to put hang provenance on last_failure
+        self._hangs: dict[int, str] = {}
 
     def _backoff_delays(self):
         # the udfs backoff schedule — the same policy the comm mesh uses
@@ -345,6 +541,37 @@ class Supervisor:
                 "before restart", removed, self.checkpoint_root,
             )
 
+    def _acquire_incarnation(self, attempt: int) -> None:
+        """Bump the root's incarnation lease for this attempt and export it
+        to the workers about to spawn (``PATHWAY_INCARNATION`` — fork-based
+        spawners inherit the supervisor's environ; ``cli spawn`` copies it
+        into the subprocess env explicitly).  Acquired BEFORE the group
+        launches, so by the time any new worker can write, every writer of
+        a previous attempt is already fenced.  Best-effort: a root that
+        cannot hold a lease (read-only, no root at all) degrades to the
+        pre-fencing behavior with a warning rather than refusing to run."""
+        self._hangs = {}
+        if not self.checkpoint_root:
+            return
+        try:
+            from pathway_tpu.engine import persistence as pz
+
+            self.incarnation = pz.acquire_lease(
+                pz.FileBackend(self.checkpoint_root),
+                owner=f"supervisor pid {os.getpid()} attempt {attempt}",
+            )
+            os.environ[ENV_INCARNATION] = str(self.incarnation)
+            _log.info(
+                "attempt %d runs as incarnation %d (lease on %s)",
+                attempt, self.incarnation, self.checkpoint_root,
+            )
+        except Exception as exc:  # noqa: BLE001 - fencing is best-effort
+            _log.warning(
+                "could not acquire the incarnation lease on %s (%s); "
+                "zombie-writer fencing is OFF for this run",
+                self.checkpoint_root, exc,
+            )
+
     def run(self) -> SupervisorResult:
         delays = self._backoff_delays()
         history: list[list[int | None]] = []
@@ -357,6 +584,7 @@ class Supervisor:
         self._run_started_at = time.time()
         try:
             while True:
+                self._acquire_incarnation(attempt)
                 handles = []
                 for w in range(self.n_workers):
                     handles.append(self.spawn(w, attempt))
@@ -378,10 +606,22 @@ class Supervisor:
                         recovery=recovery, last_failure=last_failure,
                         post_mortem=self._post_mortem(),
                     )
-                last_failure = (
-                    f"worker {first_failed} exited "
-                    f"{_exitcode(handles[first_failed])} on attempt {attempt}"
-                )
+                hang = self._hangs.get(first_failed)
+                if hang is not None:
+                    # the exit code alone would read like an ordinary crash;
+                    # the restart was actually the watchdog converting a
+                    # silent stall into a supervised recovery
+                    last_failure = (
+                        f"worker {first_failed} hung ({hang}) on attempt "
+                        f"{attempt}; watchdog killed it (exit "
+                        f"{_exitcode(handles[first_failed])})"
+                    )
+                else:
+                    last_failure = (
+                        f"worker {first_failed} exited "
+                        f"{_exitcode(handles[first_failed])} on attempt "
+                        f"{attempt}"
+                    )
                 _metrics.get_registry().counter(
                     "supervisor.restarts",
                     "cluster rollback-and-respawn recoveries performed",
@@ -419,9 +659,24 @@ class Supervisor:
             # (they would wait on mesh peers forever); redundant stops of
             # already-exited workers are no-ops
             self._stop_all(handles)
+            # do not leak THIS run's incarnation into the host process:
+            # later (unsupervised) runs in the same process would stamp
+            # and fence against a lease they do not participate in
+            if self.incarnation is not None:
+                os.environ.pop(ENV_INCARNATION, None)
 
     def _watch(self, handles: Sequence[Any]) -> int | None:
-        """Block until all workers exit 0 (None) or one fails (its id)."""
+        """Block until all workers exit 0 (None) or one fails (its id).
+
+        The loop doubles as the progress watchdog: each poll also checks
+        every live worker's progress beacon and escalates
+        SIGUSR1 → SIGTERM → SIGKILL on a stalled one, whose death the
+        death-watch above then routes through the ordinary restart path."""
+        watchdog = (
+            _ProgressWatchdog(self)
+            if self.epoch_deadline_s and self.checkpoint_root
+            else None
+        )
         while True:
             all_done = True
             for wid, handle in enumerate(handles):
@@ -432,6 +687,8 @@ class Supervisor:
                     return wid
             if all_done:
                 return None
+            if watchdog is not None:
+                watchdog.poll(handles)
             time.sleep(self.poll_interval_s)
 
     def _stop_all(self, handles: Sequence[Any]) -> None:

@@ -195,6 +195,8 @@ def run(
     root_token = None
     http_server = None
     persist_root = None  # filesystem persistence root, when there is one
+    prev_usr1 = None
+    usr1_installed = False
     try:
         if storage is not None:
             from pathway_tpu.engine import faults as _faults
@@ -247,13 +249,47 @@ def run(
         # lands under <root>/blackbox/ on crash/fault, where the supervisor
         # gathers it into SupervisorResult.post_mortem
         from pathway_tpu.engine.faults import restart_attempt as _attempt
+        from pathway_tpu.engine.persistence import writer_incarnation
 
         _blackbox.configure(
             worker=config.process_id,
             run_id=telemetry.config.run_id,
             trace_parent=trace_parent,
             attempt=_attempt(),
+            # the dump path is fenced like every persistence-root write:
+            # a zombie from a superseded incarnation must not drop its
+            # stale ring into the live cluster's blackbox/
+            incarnation=writer_incarnation(),
         )
+        # hung-worker protocol, worker side: SIGUSR1 from the supervisor's
+        # progress watchdog pulls the flight recorder out of a wedged
+        # process BEFORE the SIGTERM/SIGKILL escalation destroys it.  The
+        # distinct dump suffix keeps the hang story from clobbering (or
+        # being clobbered by) this attempt's crash dump.  Main thread only
+        # (signal.signal refuses elsewhere — e.g. Table.live() runs);
+        # restored in the finally so embedding processes keep their own
+        # handler after the run.
+        import signal as _signal
+        import threading as _threading
+
+        if _threading.current_thread() is _threading.main_thread():
+            def _usr1_dump(signum, frame):
+                _blackbox.record(
+                    "watchdog.sigusr1", worker=config.process_id,
+                )
+                _blackbox.get_recorder().dump(
+                    "watchdog: epoch-progress deadline exceeded (SIGUSR1)",
+                    suffix="watchdog",
+                )
+
+            try:
+                prev_usr1 = _signal.signal(_signal.SIGUSR1, _usr1_dump)
+                usr1_installed = True
+            except (ValueError, OSError, AttributeError):
+                prev_usr1 = None
+        # the watchdog's on-disk liveness signal; a no-op without a
+        # filesystem persistence root
+        beacon = _ProgressBeacon(persist_root, config.process_id)
         # restart provenance, mesh-visible: the supervisor increments its
         # own supervisor.restarts counter, but that registry lives in the
         # spawn process, which serves no /metrics — each worker knows the
@@ -297,6 +333,7 @@ def run(
                     _event_loop(
                         scope, lowerer, result, max_epochs=max_epochs,
                         storage=storage, prober=prober, telemetry=telemetry,
+                        beacon=beacon,
                     )
                 except BaseException as exc:
                     # black-box the failure BEFORE unwinding: the ring's
@@ -316,6 +353,16 @@ def run(
                             abort()
                     raise
     finally:
+        if usr1_installed:
+            import signal as _signal
+
+            try:
+                _signal.signal(
+                    _signal.SIGUSR1,
+                    prev_usr1 if prev_usr1 is not None else _signal.SIG_DFL,
+                )
+            except (ValueError, OSError):
+                pass
         if worker_ctx is not None:
             worker_ctx.close()
         if result.telemetry is not None:
@@ -506,6 +553,62 @@ def _attach_wake(pollers) -> "Any":
     return wake
 
 
+class _ProgressBeacon:
+    """Epoch-loop liveness beacon for the supervisor's hung-worker watchdog.
+
+    The epoch loop touches ``<root>/lease/progress.<worker>`` — on every
+    processed epoch AND on idle iterations — so the beacon's mtime means
+    "the event loop is alive and scheduling", not "input is flowing": an
+    idle-but-healthy stream keeps touching, a deadlocked epoch loop or a
+    wedged commit drain stops.  Rate-limited to one write per 0.25 s; the
+    write is a tiny pid overwrite, so the steady-state cost is four small
+    writes per second.  A run without a filesystem persistence root has no
+    beacon (and the supervisor has no watchdog for it), and so does an
+    UNSUPERVISED run — nothing would ever read the beacon, and a solo
+    run's root should not grow a ``lease/`` directory no lease owns.
+    """
+
+    _MIN_INTERVAL_S = 0.25
+
+    def __init__(self, root: str | None, worker: int):
+        # supervised is recognizable from the worker side: the supervisor
+        # exports PATHWAY_INCARNATION with the lease, and an env-configured
+        # watchdog leaves PATHWAY_EPOCH_DEADLINE_S visible here too
+        if root is not None:
+            from pathway_tpu.engine.persistence import writer_incarnation
+            from pathway_tpu.engine.supervisor import ENV_EPOCH_DEADLINE
+
+            if writer_incarnation() <= 0 and not os.environ.get(
+                ENV_EPOCH_DEADLINE
+            ):
+                root = None
+        self.path = (
+            os.path.join(root, "lease", f"progress.{worker}")
+            if root
+            else None
+        )
+        self._last = 0.0
+        if self.path is not None:
+            try:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            except OSError:
+                self.path = None
+        self.touch(force=True)
+
+    def touch(self, force: bool = False) -> None:
+        if self.path is None:
+            return
+        now = _time.monotonic()
+        if not force and now - self._last < self._MIN_INTERVAL_S:
+            return
+        self._last = now
+        try:
+            with open(self.path, "w") as f:
+                f.write(str(os.getpid()))
+        except OSError:
+            pass  # liveness reporting must never take the worker down
+
+
 def _epoch_instruments():
     """(histogram, recorder) pair the epoch loops stamp each epoch with:
     a registry histogram of epoch wall time and the flight-recorder ring
@@ -528,12 +631,15 @@ def _event_loop(
     storage: Any = None,
     prober: Any = None,
     telemetry: Any = None,
+    beacon: Any = None,
 ) -> None:
     if scope.worker is not None:
         return _event_loop_coordinated(
             scope, lowerer, result, max_epochs=max_epochs, storage=storage,
-            prober=prober, telemetry=telemetry,
+            prober=prober, telemetry=telemetry, beacon=beacon,
         )
+    if beacon is None:
+        beacon = _ProgressBeacon(None, 0)
     epoch_hist, blackbox = _epoch_instruments()
     inputs = _input_nodes(scope)
     pollers = lowerer.pollers
@@ -549,6 +655,10 @@ def _event_loop(
     # (staged durability seq, marker frontiers at staging) awaiting publish
     pending_acks: deque = deque()
     while True:
+        # liveness beacon: touched on EVERY loop iteration (idle included),
+        # so its mtime proves the event loop schedules — a wedged epoch or
+        # a deadlock stops it and the supervisor's watchdog takes over
+        beacon.touch()
         if (
             storage is not None
             and (_time.monotonic() - last_snapshot) >= snapshot_interval
@@ -655,6 +765,7 @@ def _event_loop_coordinated(
     storage: Any = None,
     prober: Any = None,
     telemetry: Any = None,
+    beacon: Any = None,
 ) -> None:
     """Multi-worker BSP loop: worker 0 sequences epochs, every worker runs
     them in lockstep, exchanging rows at the declared exchange points.
@@ -666,6 +777,8 @@ def _event_loop_coordinated(
     """
     ctx = scope.worker
     mesh = ctx.mesh
+    if beacon is None:
+        beacon = _ProgressBeacon(None, 0)
     epoch_hist, blackbox = _epoch_instruments()
     inputs = _input_nodes(scope)
     pollers = lowerer.pollers
@@ -679,6 +792,8 @@ def _event_loop_coordinated(
     last_snapshot = _time.monotonic()
     pending_acks: deque = deque()  # (staged seq, marker frontiers)
     while True:
+        # event-loop liveness for the supervisor's watchdog (idle included)
+        beacon.touch()
         if (
             storage is not None
             and (_time.monotonic() - last_snapshot) >= snapshot_interval

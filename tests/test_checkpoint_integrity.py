@@ -531,6 +531,8 @@ class TestAsyncCommit:
         # the commit barrier must keep the manifest unpublished
         import time as _t
 
+        # chaos-lint: bounded-window — one-sided determinism check (the
+        # manifest must NOT appear while the chunk is gated), not a wait
         _t.sleep(0.15)
         assert not [k for k in store if k.startswith("manifests/")]
         backend.release.set()

@@ -495,7 +495,9 @@ class TestCommFaults:
                 link20 = meshes[2]._links[0]
                 with link20.cv:
                     seen_before = link20.last_seen
-                time.sleep(1.0)  # ~10 heartbeat intervals
+                # chaos-lint: bounded-window — a deliberate observation
+                # window (~10 heartbeat intervals), not synchronization
+                time.sleep(1.0)
                 with link20.cv:
                     seen_after = link20.last_seen
                 assert seen_after > seen_before, (

@@ -1,0 +1,676 @@
+"""Incarnation fencing + hung-worker watchdog.
+
+Two failure modes the PR 1-4 stack still lost, both reproduced here
+deterministically:
+
+* a **zombie worker** from a superseded restart attempt publishing a
+  stale generation manifest into a persistence root the respawned
+  cluster now owns (split-brain corruption of recovery provenance) —
+  killed by the incarnation lease: the supervisor bumps
+  ``lease/LEASE`` before every launch, every commit-point write
+  re-checks it, and a stale writer gets :class:`FencedError`;
+
+* a **live-but-hung worker** (wedged epoch loop) stalling a run forever
+  because the supervisor only reacted to process exit — killed by the
+  progress watchdog: workers touch ``lease/progress.<id>`` from the
+  epoch loop, and a beacon stale past ``PATHWAY_EPOCH_DEADLINE_S``
+  triggers SIGUSR1 (flight-recorder dump) → SIGTERM → SIGKILL and an
+  ordinary supervised restart.
+
+Interleavings are pinned by gating on ON-DISK state (manifests on disk,
+the lease's incarnation), never on timing — the ``_gated_scenario``
+pattern ``tests/test_chaos_lint.py`` now enforces for this suite.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import signal
+import time
+
+import pytest
+
+from pathway_tpu.engine import flight_recorder as fr
+from pathway_tpu.engine import metrics as em
+from pathway_tpu.engine import persistence as pz
+from pathway_tpu.engine.persistence import FencedError
+
+
+# ---------------------------------------------------------------------------
+# lease + fence units
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_env_incarnation_mirrors_persistence():
+    # supervisor.py keeps its own literal so its import-time dependency on
+    # persistence stays lazy; the two constants must never drift apart
+    from pathway_tpu.engine.supervisor import ENV_INCARNATION
+
+    assert ENV_INCARNATION == pz.ENV_INCARNATION
+
+
+def test_lease_acquire_is_monotonic():
+    backend = pz.MemoryBackend({})
+    assert pz.read_lease(backend) is None
+    assert pz.acquire_lease(backend) == 1
+    assert pz.acquire_lease(backend) == 2
+    lease = pz.read_lease(backend)
+    assert lease["incarnation"] == 2
+    assert lease["format"] == pz.LEASE_FORMAT
+
+
+def test_read_lease_tolerates_damage():
+    backend = pz.MemoryBackend({})
+    pz.acquire_lease(backend)
+    backend.put(pz.LEASE_KEY, b"not a framed lease")
+    # the WRITE path treats an unreadable lease as absent (a torn lease
+    # must not brick every writer); scrub reports it as damage instead
+    assert pz.read_lease(backend) is None
+    # and a fresh acquisition recovers by restarting the count
+    assert pz.acquire_lease(backend) == 1
+
+
+def _commit_one(storage: pz.PersistentStorage, state, i: int) -> None:
+    state.log.record(i, (i,), 1)
+    state.log.flush_chunk()
+    state.pending_offset = i
+    storage.commit()
+
+
+def test_publish_fenced_when_lease_shows_newer_incarnation(monkeypatch):
+    monkeypatch.setenv(pz.ENV_INCARNATION, "1")
+    backend = pz.MemoryBackend({})
+    assert pz.acquire_lease(backend) == 1
+    storage = pz.PersistentStorage(backend, worker=0)
+    assert storage.incarnation == 1
+    state = storage.register_source("src")
+    _commit_one(storage, state, 0)  # same incarnation: publishes fine
+    manifests_before = [k for k in backend.store if k.startswith("manifests/")]
+    assert manifests_before
+
+    pz.acquire_lease(backend)  # incarnation 2 takes over the root
+    with pytest.raises(FencedError, match="incarnation 2"):
+        _commit_one(storage, state, 1)
+    # the publish was REJECTED: no new manifest, and the fence counted
+    manifests_after = [k for k in backend.store if k.startswith("manifests/")]
+    assert manifests_after == manifests_before
+    fenced = em.get_registry().scalar_metrics().get(
+        "persistence.fenced{worker=0}", 0.0
+    )
+    assert fenced >= 1.0
+
+
+def test_stale_incarnation_is_fenced_at_resume(monkeypatch):
+    backend = pz.MemoryBackend({})
+    monkeypatch.setenv(pz.ENV_INCARNATION, "1")
+    pz.acquire_lease(backend)
+    pz.PersistentStorage(backend, worker=0)  # lease == incarnation: fine
+    pz.acquire_lease(backend)
+    with pytest.raises(FencedError, match="resume"):
+        pz.PersistentStorage(backend, worker=0)
+
+
+def test_manifest_and_pointer_carry_incarnation_stamp(monkeypatch):
+    monkeypatch.setenv(pz.ENV_INCARNATION, "3")
+    backend = pz.MemoryBackend({})
+    pz.acquire_lease(backend), pz.acquire_lease(backend), pz.acquire_lease(backend)
+    storage = pz.PersistentStorage(backend, worker=0)
+    state = storage.register_source("src")
+    _commit_one(storage, state, 0)
+    manifest, reason = pz._read_manifest(backend, "manifests/0/00000001")
+    assert reason is None and manifest["incarnation"] == 3
+    pointer = json.loads(backend.get("metadata.json.0").decode())
+    assert pointer["incarnation"] == 3
+
+
+def test_async_commit_surfaces_fence_on_drain(monkeypatch):
+    monkeypatch.setenv(pz.ENV_INCARNATION, "1")
+    monkeypatch.setenv("PATHWAY_CHECKPOINT_PUBLISH_INTERVAL_MS", "0")
+    backend = pz.MemoryBackend({})
+    pz.acquire_lease(backend)
+    storage = pz.PersistentStorage(backend, worker=0)
+    state = storage.register_source("src")
+    state.log.record(0, (0,), 1)
+    state.log.flush_chunk()
+    state.pending_offset = 0
+    storage.commit_async()
+    storage.drain()  # incarnation 1 still owns the root: publishes
+    assert storage.published_seq >= 1
+
+    pz.acquire_lease(backend)  # superseded mid-run
+    state.log.record(1, (1,), 1)
+    state.log.flush_chunk()
+    state.pending_offset = 1
+    storage.commit_async()
+    # the committer thread hit the fence; the sticky failure surfaces on
+    # the next synchronization point exactly like other async failures
+    with pytest.raises(FencedError):
+        storage.drain()
+
+
+def test_blackbox_dump_fenced_for_stale_incarnation(tmp_path):
+    backend = pz.FileBackend(str(tmp_path))
+    pz.acquire_lease(backend)
+    pz.acquire_lease(backend)  # lease is at incarnation 2
+
+    stale = fr.FlightRecorder()
+    stale.configure(root=str(tmp_path), worker=0, incarnation=1)
+    stale.record("epoch", time=0)
+    assert stale.dump("zombie story") is None  # refused, nothing written
+    assert fr.gather_dumps(str(tmp_path)) == {}
+
+    live = fr.FlightRecorder()
+    live.configure(root=str(tmp_path), worker=0, incarnation=2)
+    live.record("epoch", time=0)
+    path = live.dump("live story")
+    assert path is not None
+    payload = fr.gather_dumps(str(tmp_path))[0][0]
+    assert payload["incarnation"] == 2
+
+
+def test_watchdog_dump_gets_its_own_file(tmp_path):
+    rec = fr.FlightRecorder()
+    rec.configure(root=str(tmp_path), worker=0, attempt=1)
+    rec.record("epoch", time=0)
+    hang_dump = rec.dump("watchdog: stall", suffix="watchdog")
+    crash_dump = rec.dump("run failed")
+    assert hang_dump != crash_dump
+    dumps = fr.gather_dumps(str(tmp_path))[0]
+    # both stories survive: the stall dump cannot clobber the crash dump
+    assert sorted(p["reason"] for p in dumps) == [
+        "run failed", "watchdog: stall",
+    ]
+
+
+def test_watchdog_stands_down_when_progress_resumes(tmp_path):
+    """A worker that resumes touching its beacon during the dump grace is
+    NOT killed: the escalation aborts between SIGUSR1 and SIGTERM, and
+    ``supervisor.watchdog.kills`` counts only actual kills.  Time is
+    driven through beacon mtimes and phase timestamps — no sleeps."""
+    from pathway_tpu.engine.supervisor import Supervisor, _ProgressWatchdog
+
+    class Handle:
+        # no .pid attribute: the SIGUSR1 step is skipped; exitcode None
+        # means alive; terminate()/kill() record the escalation
+        exitcode = None
+
+        def __init__(self):
+            self.calls = []
+
+        def terminate(self):
+            self.calls.append("term")
+
+        def kill(self):
+            self.calls.append("kill")
+
+    root = tmp_path / "pstore"
+    (root / "lease").mkdir(parents=True)
+    beacon = root / "lease" / "progress.0"
+    beacon.write_text("")
+
+    sup = Supervisor(
+        lambda w, a: None, 1, checkpoint_root=str(root), epoch_deadline_s=10.0
+    )
+    sup._hangs = {}
+    wd = _ProgressWatchdog(sup)
+    handle = Handle()
+    now = time.time()
+
+    # stale beacon, touched this attempt: stall verdict -> sigusr1 phase
+    wd.started_at = now - 1000.0
+    os.utime(beacon, (now - 50.0, now - 50.0))
+    kills_before = em.get_registry().scalar_metrics().get(
+        "supervisor.watchdog.kills", 0.0
+    )
+    wd.poll([handle])
+    assert wd._phase[0][0] == "sigusr1"
+    assert 0 in sup._hangs
+
+    # the worker comes back: beacon fresh again -> escalation aborts
+    os.utime(beacon, (now, now))
+    wd.poll([handle])
+    assert 0 not in wd._phase
+    assert 0 not in sup._hangs
+    assert handle.calls == []  # nothing lethal happened
+    kills = em.get_registry().scalar_metrics().get(
+        "supervisor.watchdog.kills", 0.0
+    )
+    assert kills == kills_before  # a stand-down is not a kill
+
+    # still hung past the dump grace -> SIGTERM, and THAT is the kill
+    os.utime(beacon, (now - 50.0, now - 50.0))
+    wd.poll([handle])
+    wd._phase[0] = ("sigusr1", now - 5.0)
+    wd.poll([handle])
+    assert handle.calls == ["term"]
+    kills = em.get_registry().scalar_metrics()["supervisor.watchdog.kills"]
+    assert kills == kills_before + 1
+
+
+# ---------------------------------------------------------------------------
+# scrub: lease/ + blackbox/ are first-class
+# ---------------------------------------------------------------------------
+
+
+def _seeded_root(tmp_path, monkeypatch, incarnation: int = 1):
+    backend = pz.FileBackend(str(tmp_path))
+    for _ in range(incarnation):
+        pz.acquire_lease(backend)
+    monkeypatch.setenv(pz.ENV_INCARNATION, str(incarnation))
+    storage = pz.PersistentStorage(backend, worker=0)
+    state = storage.register_source("src")
+    _commit_one(storage, state, 0)
+    return backend
+
+
+def test_scrub_audits_lease_and_blackbox_as_first_class(tmp_path, monkeypatch):
+    backend = _seeded_root(tmp_path, monkeypatch)
+    (tmp_path / "lease" / "progress.0").write_text("12345")
+    rec = fr.FlightRecorder()
+    rec.configure(root=str(tmp_path), worker=0, incarnation=1)
+    rec.record("epoch", time=0)
+    rec.dump("crash for the audit")
+
+    report = pz.scrub_root(backend)
+    assert report["ok"] is True, report
+    assert report["lease"]["incarnation"] == 1
+    assert report["lease"]["progress_workers"] == [0]
+    assert report["blackbox"]["dumps"] == 1
+    assert report["blackbox"]["workers"] == [0]
+    assert report["blackbox"]["unreadable"] == []
+    entry = report["workers"][0]["generations"][0]
+    assert entry["incarnation"] == 1
+
+
+def test_scrub_flags_fencing_bypass_and_torn_lease(tmp_path, monkeypatch):
+    # a generation stamped ABOVE the lease means a writer published
+    # without holding a current incarnation — that is exactly the
+    # split-brain scrub exists to catch
+    backend = _seeded_root(tmp_path, monkeypatch, incarnation=1)
+    monkeypatch.setenv(pz.ENV_INCARNATION, "5")
+    storage = pz.PersistentStorage(backend, worker=0)
+    state = storage.register_source("src")
+    _commit_one(storage, state, 1)  # lease still at 1: stamp 5 > lease 1
+    report = pz.scrub_root(backend)
+    assert report["ok"] is False, report
+    newest = report["workers"][0]["generations"][0]
+    assert any("fencing bypass" in p for p in newest["problems"]), newest
+
+    # a torn lease is the fencing authority gone dark: loud, not clean
+    path = tmp_path / "lease" / "LEASE"
+    path.write_bytes(path.read_bytes()[:7])
+    report = pz.scrub_root(backend)
+    assert report["ok"] is False
+    assert "undecodable" in report["lease"]["error"]
+
+
+def test_scrub_cli_renders_lease_and_blackbox(tmp_path, monkeypatch):
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import cli
+
+    _seeded_root(tmp_path, monkeypatch)
+    rec = fr.FlightRecorder()
+    rec.configure(root=str(tmp_path), worker=0, incarnation=1)
+    rec.dump("cli render")
+    result = CliRunner().invoke(cli, ["scrub", str(tmp_path)])
+    assert result.exit_code == 0, result.output
+    assert "lease: incarnation 1" in result.output
+    assert "blackbox: 1 flight-recorder dump(s)" in result.output
+    assert "(incarnation 1)" in result.output
+
+
+# ---------------------------------------------------------------------------
+# supervisor knobs + comm handshake fencing
+# ---------------------------------------------------------------------------
+
+
+def test_supervisor_epoch_deadline_from_env(monkeypatch):
+    from pathway_tpu.engine.supervisor import Supervisor
+
+    monkeypatch.delenv("PATHWAY_EPOCH_DEADLINE_S", raising=False)
+    assert Supervisor(lambda w, a: None, 1).epoch_deadline_s is None
+    monkeypatch.setenv("PATHWAY_EPOCH_DEADLINE_S", "2.5")
+    assert Supervisor(lambda w, a: None, 1).epoch_deadline_s == 2.5
+    # an explicit argument wins over the env
+    assert (
+        Supervisor(lambda w, a: None, 1, epoch_deadline_s=9.0).epoch_deadline_s
+        == 9.0
+    )
+    monkeypatch.setenv("PATHWAY_EPOCH_DEADLINE_S", "bogus")
+    assert Supervisor(lambda w, a: None, 1).epoch_deadline_s is None
+
+
+def test_mesh_handshake_binds_to_incarnation(monkeypatch):
+    """A zombie from a superseded incarnation must fail mesh
+    authentication: the handshake secret is derived from
+    (secret, incarnation), so stale peers drop before any frame."""
+    import socket
+    import threading
+
+    from pathway_tpu.engine.comm import (
+        CommError,
+        TcpMesh,
+        _handshake_accept,
+        _handshake_dial,
+    )
+
+    monkeypatch.setenv("PATHWAY_COMM_SECRET", "fence-test")
+    monkeypatch.setenv("PATHWAY_INCARNATION", "1")
+    stale = TcpMesh(0, 2, 10000)
+    monkeypatch.setenv("PATHWAY_INCARNATION", "2")
+    live = TcpMesh(1, 2, 10000)
+    same = TcpMesh(0, 2, 10000)
+    assert stale._auth_secret != live._auth_secret
+    assert same._auth_secret == live._auth_secret
+    # the derived secret never weakens typed-only decode for open meshes
+    monkeypatch.setenv("PATHWAY_COMM_SECRET", "")
+    open_mesh = TcpMesh(0, 2, 10000)
+    assert open_mesh._auth_secret == b""
+
+    a, b = socket.socketpair()
+    errors: list[Exception] = []
+
+    def accept():
+        try:
+            _handshake_accept(b, live._auth_secret)
+        except Exception as exc:  # noqa: BLE001 - asserted below
+            errors.append(exc)
+
+    t = threading.Thread(target=accept)
+    t.start()
+    with pytest.raises(CommError, match="authentication"):
+        _handshake_dial(a, 0, stale._auth_secret)
+    # the dialer refuses the listener's proof and hangs up; the accept
+    # side then fails too (EOF or its own auth mismatch) — either way the
+    # stale peer never authenticated
+    a.close()
+    t.join(5)
+    b.close()
+    assert errors, "stale-incarnation handshake must not complete"
+
+
+# ---------------------------------------------------------------------------
+# chaos: the two acceptance scenarios
+# ---------------------------------------------------------------------------
+
+N_ROWS = 18
+ROW_DELAY_S = 0.02
+
+
+def _fence_scenario(tmpdir: str, out_name: str) -> None:
+    """Single-worker streaming pipeline, `_gated_scenario` pattern: rows
+    6+ wait for generation 1 on disk, rows 12+ for generation 2 — so the
+    run deterministically spans at least three manifest publishes."""
+    import pathway_tpu as pw
+
+    manifest_dir = os.path.join(tmpdir, "pstore", "manifests", "0")
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            def wait_for_generations(n):
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    try:
+                        if len([
+                            f for f in os.listdir(manifest_dir)
+                            if not f.endswith(".tmp")
+                        ]) >= n:
+                            return
+                    except OSError:
+                        pass
+                    time.sleep(0.01)
+                raise RuntimeError(f"generation {n} never appeared")
+
+            for i in range(N_ROWS):
+                if i == 6:
+                    wait_for_generations(1)
+                elif i == 12:
+                    wait_for_generations(2)
+                self.next(k=i % 3, v=1)
+                self.commit()
+                time.sleep(ROW_DELAY_S)
+
+    t = pw.io.python.read(
+        Src(), schema=pw.schema_from_types(k=int, v=int), name="src"
+    )
+    counts = t.groupby(t.k).reduce(k=t.k, n=pw.reducers.count())
+    pw.io.jsonlines.write(counts, os.path.join(tmpdir, out_name))
+    pw.run(
+        monitoring_level=pw.MonitoringLevel.NONE,
+        persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem(os.path.join(tmpdir, "pstore")),
+            snapshot_interval_ms=20,
+        ),
+    )
+
+
+def _fence_worker_main(
+    tmpdir: str,
+    out_name: str,
+    incarnation: int | None,
+    attempt: int,
+    plan_json: str,
+) -> None:
+    os.environ["PATHWAY_PROCESSES"] = "1"
+    os.environ["PATHWAY_PROCESS_ID"] = "0"
+    os.environ["PATHWAY_RESTART_ATTEMPT"] = str(attempt)
+    if incarnation is not None:
+        # None = keep whatever the spawner exported (the supervisor's
+        # lease bump in the hang test below)
+        os.environ["PATHWAY_INCARNATION"] = str(incarnation)
+    if plan_json:
+        os.environ["PATHWAY_FAULT_PLAN"] = plan_json
+    else:
+        os.environ.pop("PATHWAY_FAULT_PLAN", None)
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+
+    from pathway_tpu.engine import faults
+    from pathway_tpu.internals.config import refresh_config
+    from pathway_tpu.internals.parse_graph import G
+
+    refresh_config()
+    faults.clear_plan()
+    G.clear()
+    _fence_scenario(tmpdir, out_name)
+
+
+def _wait_for_on_disk(predicate, what: str, deadline_s: float = 60.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"on-disk gate never opened: {what}")
+
+
+@pytest.mark.chaos
+def test_zombie_publish_is_fenced_and_new_incarnation_owns_root(tmp_path):
+    """Acceptance: a ``zombie`` fault stalls a worker's third manifest
+    publish until the lease is superseded — the stale publish must be
+    REJECTED (FencedError, worker self-terminates nonzero), the root must
+    scrub clean, and resume must select only the new incarnation's
+    generations."""
+    ctx = multiprocessing.get_context("fork")
+    pstore = tmp_path / "pstore"
+    backend = pz.FileBackend(str(pstore))
+    assert pz.acquire_lease(backend, owner="test-supervisor") == 1
+
+    plan = json.dumps(
+        {
+            "seed": 3,
+            "faults": [{"kind": "zombie", "worker": 0, "nth": 3}],
+        }
+    )
+    zombie = ctx.Process(
+        target=_fence_worker_main,
+        args=(str(tmp_path), "counts-a.jsonl", 1, 0, plan),
+        daemon=True,
+    )
+    zombie.start()
+
+    manifest_dir = pstore / "manifests" / "0"
+    _wait_for_on_disk(
+        lambda: manifest_dir.is_dir()
+        and len([f for f in os.listdir(manifest_dir)
+                 if not f.endswith(".tmp")]) >= 2,
+        "two generations from incarnation 1",
+    )
+    # incarnation 2 takes over the root; the zombie's stalled third
+    # publish now wakes, hits the fence, and the worker dies on it
+    assert pz.acquire_lease(backend, owner="test-supervisor") == 2
+    zombie.join(60)
+    assert zombie.exitcode is not None, "zombie never terminated"
+    assert zombie.exitcode != 0, "a fenced worker must self-terminate"
+
+    gens_before = sorted(
+        f for f in os.listdir(manifest_dir) if not f.endswith(".tmp")
+    )
+    # the fenced publish wrote NOTHING: every manifest is incarnation 1's
+    for name in gens_before:
+        manifest, reason = pz._read_manifest(backend, f"manifests/0/{name}")
+        assert reason is None and manifest["incarnation"] == 1
+
+    # the new incarnation resumes and owns the root
+    successor = ctx.Process(
+        target=_fence_worker_main,
+        args=(str(tmp_path), "counts-b.jsonl", 2, 1, ""),
+        daemon=True,
+    )
+    successor.start()
+    successor.join(120)
+    assert successor.exitcode == 0
+
+    # resume selected only the newest (incarnation-2) generations: the
+    # newest manifest on the root is stamped 2 and records its recovery
+    gens = sorted(
+        int(f) for f in os.listdir(manifest_dir) if not f.endswith(".tmp")
+    )
+    newest, _ = pz._read_manifest(backend, f"manifests/0/{gens[-1]:08d}")
+    assert newest["incarnation"] == 2
+    assert newest["recovered_from"] >= 1
+
+    # the offline audit agrees, machine- and human-readable
+    report = pz.scrub_root(backend)
+    assert report["ok"] is True, report
+    assert report["lease"]["incarnation"] == 2
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import cli
+
+    result = CliRunner().invoke(cli, ["scrub", str(pstore)])
+    assert result.exit_code == 0, result.output
+
+    # and the successor's output is the exactly-once ground truth
+    from collections import Counter
+
+    state: Counter = Counter()
+    with open(tmp_path / "counts-b.jsonl") as f:
+        for line in f:
+            obj = json.loads(line)
+            diff = obj.pop("diff")
+            obj.pop("time")
+            state[json.dumps(obj, sort_keys=True)] += diff
+    got = {
+        json.loads(k)["k"]: json.loads(k)["n"]
+        for k, c in state.items()
+        if c
+    }
+    assert got == {0: 6, 1: 6, 2: 6}, got
+
+
+def _hang_worker_main(attempt: int, tmpdir: str, plan_json: str) -> None:
+    _fence_worker_main(tmpdir, "counts.jsonl", None, attempt, plan_json)
+
+
+@pytest.mark.chaos
+def test_hung_worker_watchdog_converts_stall_to_supervised_restart(tmp_path):
+    """Acceptance: a ``hang`` fault wedges the epoch loop; the progress
+    watchdog detects the stale beacon within PATHWAY_EPOCH_DEADLINE_S,
+    pulls a flight-recorder dump out of the wedged worker (SIGUSR1),
+    escalates SIGTERM→SIGKILL, and the supervisor restarts the group —
+    hang provenance on ``last_failure``, the dump in ``post_mortem``,
+    exactly-once output."""
+    from pathway_tpu.engine.supervisor import Supervisor
+
+    plan = json.dumps(
+        {
+            "seed": 9,
+            "faults": [
+                {"kind": "hang", "worker": 0, "at_epoch": 14, "attempt": 0}
+            ],
+        }
+    )
+    ctx = multiprocessing.get_context("fork")
+
+    def spawn(wid: int, attempt: int):
+        p = ctx.Process(
+            target=_hang_worker_main,
+            args=(attempt, str(tmp_path), plan),
+            daemon=True,
+        )
+        p.start()
+        return p
+
+    kills_before = em.get_registry().scalar_metrics().get(
+        "supervisor.watchdog.kills", 0.0
+    )
+    res = Supervisor(
+        spawn,
+        1,
+        max_restarts=3,
+        restart_jitter_s=0.05,
+        grace_s=2.0,
+        checkpoint_root=str(tmp_path / "pstore"),
+        epoch_deadline_s=2.0,
+    ).run()
+
+    assert res.restarts >= 1, res.history
+    # the watchdog's escalation killed it: SIGTERM normally, SIGKILL if
+    # the process shrugged the TERM off
+    assert res.history[0][0] in (-signal.SIGTERM, -signal.SIGKILL), res.history
+    assert res.exit_codes == [0]
+    assert "hung" in res.last_failure and "watchdog" in res.last_failure, (
+        res.last_failure
+    )
+    kills_after = em.get_registry().scalar_metrics()[
+        "supervisor.watchdog.kills"
+    ]
+    assert kills_after >= kills_before + 1
+
+    # the SIGUSR1 dump made it out of the wedged process and into the
+    # post-mortem, alongside any crash dumps, filtered by this run's start
+    assert 0 in res.post_mortem.get("workers", {}), res.post_mortem
+    info = res.post_mortem["workers"][0]
+    assert any("watchdog" in (r or "") for r in info["reasons"]), info
+    watchdog_dumps = [p for p in info["dumps"] if "watchdog" in p]
+    assert watchdog_dumps and all(os.path.exists(p) for p in watchdog_dumps)
+
+    # the recovered run is exactly-once
+    from collections import Counter
+
+    state: Counter = Counter()
+    with open(tmp_path / "counts.jsonl") as f:
+        for line in f:
+            obj = json.loads(line)
+            diff = obj.pop("diff")
+            obj.pop("time")
+            state[json.dumps(obj, sort_keys=True)] += diff
+    got = {
+        json.loads(k)["k"]: json.loads(k)["n"]
+        for k, c in state.items()
+        if c
+    }
+    assert got == {0: 6, 1: 6, 2: 6}, got
+
+    # and the root survived the whole ordeal
+    report = pz.scrub_root(pz.FileBackend(str(tmp_path / "pstore")))
+    assert report["ok"] is True, report

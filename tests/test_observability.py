@@ -39,6 +39,17 @@ def _sample_registry() -> MetricsRegistry:
     )
     for v in (0.5, 0.7, 5.0, 50.0, 5000.0):
         h.observe(v)
+    # the hung-worker watchdog surface (engine/supervisor.py): kill counter
+    # plus the per-worker progress-age gauge the watchdog refreshes
+    reg.counter(
+        "supervisor.watchdog.kills",
+        "hung workers killed by the progress watchdog",
+    ).inc()
+    reg.gauge(
+        "worker.last_progress.age_s",
+        "seconds since the worker's last epoch-progress beacon",
+        worker=1,
+    ).set(7.5)
     return reg
 
 
@@ -104,6 +115,12 @@ pathway_epoch_duration_ms_bucket{worker="0",run_id="r7",le="100.0"} 4
 pathway_epoch_duration_ms_bucket{worker="0",run_id="r7",le="+Inf"} 5
 pathway_epoch_duration_ms_sum{worker="0",run_id="r7"} 5056.2
 pathway_epoch_duration_ms_count{worker="0",run_id="r7"} 5
+# HELP pathway_supervisor_watchdog_kills hung workers killed by the progress watchdog
+# TYPE pathway_supervisor_watchdog_kills counter
+pathway_supervisor_watchdog_kills{run_id="r7"} 1
+# HELP pathway_worker_last_progress_age_s seconds since the worker's last epoch-progress beacon
+# TYPE pathway_worker_last_progress_age_s gauge
+pathway_worker_last_progress_age_s{worker="1",run_id="r7"} 7.5
 """
 
 
@@ -149,6 +166,14 @@ def test_otlp_histogram_mapping_golden():
     assert dp["asDouble"] == 42.0
     assert dp["attributes"] == [
         {"key": "worker", "value": {"stringValue": "0"}}
+    ]
+    # the watchdog surface rides the same export: counter + labeled gauge
+    dp = gauges["supervisor.watchdog.kills"]["gauge"]["dataPoints"][0]
+    assert dp["asDouble"] == 1.0
+    dp = gauges["worker.last_progress.age_s"]["gauge"]["dataPoints"][0]
+    assert dp["asDouble"] == 7.5
+    assert dp["attributes"] == [
+        {"key": "worker", "value": {"stringValue": "1"}}
     ]
 
 
