@@ -109,7 +109,9 @@ def spawn_program(
             # each attempt and exports it into ITS environ; copy it into
             # the worker env so persistence fencing and the mesh handshake
             # see the incarnation this attempt runs under
-            incarnation = os.environ.get(ENV_INCARNATION)
+            from pathway_tpu.internals.config import env_raw
+
+            incarnation = env_raw(ENV_INCARNATION)
             if incarnation is not None:
                 env[ENV_INCARNATION] = incarnation
             return subprocess.Popen([program, *arguments], env=env)
@@ -494,10 +496,82 @@ def blackbox(worker, tail, as_json, root):
     sys.exit(0)
 
 
+@cli.command()
+@click.option(
+    "--json", "as_json", is_flag=True, help="emit the report as JSON"
+)
+@click.option(
+    "--rules",
+    "rule_ids",
+    metavar="ID[,ID...]",
+    default=None,
+    help="run only these rule ids (default: every rule)",
+)
+@click.option(
+    "--list-rules", is_flag=True, help="print the rule catalogue and exit"
+)
+@click.option(
+    "--update-config-docs",
+    is_flag=True,
+    help="regenerate docs/configuration.md from the env-knob registry "
+    "(internals/config.py:ENV_KNOBS) and exit",
+)
+@click.argument("paths", nargs=-1, type=click.Path(exists=True))
+def lint(as_json, rule_ids, list_rules, update_config_docs, paths):
+    """Run the repo-native static analyzer over PATHS.
+
+    Default paths are the installed ``pathway_tpu`` package and its
+    sibling ``tests/`` tree.  Rules prove thread-context safety (no
+    blocking calls on the epoch loop or signal paths, timed waits on
+    supervised background threads), lock-order consistency, env-knob and
+    metric-name registry discipline, jit recompile discipline, and the
+    chaos-suite sleep policy — see ``docs/static_analysis.md``.
+
+    Exits non-zero when any unsuppressed finding remains.  Suppressions
+    (``# pathway-lint: disable=<rule> — <reason>``) are audited: a
+    reasonless or useless suppression is itself a finding.
+    """
+    from pathway_tpu.analysis import RULES, report_to_text, run_lint
+
+    if list_rules:
+        width = max(len(rid) for rid in RULES)
+        for rid in sorted(RULES):
+            click.echo(f"{rid:<{width}}  {RULES[rid].doc}")
+        sys.exit(0)
+    pkg_dir = os.path.dirname(os.path.abspath(pw.__file__))
+    repo_root = os.path.dirname(pkg_dir)
+    if update_config_docs:
+        from pathway_tpu.internals.config import render_env_docs
+
+        doc_path = os.path.join(repo_root, "docs", "configuration.md")
+        os.makedirs(os.path.dirname(doc_path), exist_ok=True)
+        with open(doc_path, "w", encoding="utf-8") as f:
+            f.write(render_env_docs())
+        click.echo(f"[pathway_tpu] wrote {doc_path}")
+        sys.exit(0)
+    if not paths:
+        paths = [pkg_dir]
+        tests_dir = os.path.join(repo_root, "tests")
+        if os.path.isdir(tests_dir):
+            paths.append(tests_dir)
+    selected = None
+    if rule_ids:
+        selected = [r.strip() for r in rule_ids.split(",") if r.strip()]
+    try:
+        report = run_lint(paths, rules=selected)
+    except ValueError as exc:  # unknown rule id
+        click.echo(f"[pathway_tpu] {exc}", err=True)
+        sys.exit(2)
+    click.echo(report_to_text(report, as_json=as_json))
+    sys.exit(0 if report.ok else 1)
+
+
 @cli.command(name="spawn-from-env")
 def spawn_from_env():
     """Re-exec ``spawn`` with arguments from PATHWAY_SPAWN_ARGS."""
-    spawn_args = os.environ.get("PATHWAY_SPAWN_ARGS")
+    from pathway_tpu.internals.config import env_str
+
+    spawn_args = env_str("PATHWAY_SPAWN_ARGS")
     if spawn_args is None:
         click.echo("PATHWAY_SPAWN_ARGS variable is unspecified, exiting...", err=True)
         return

@@ -103,7 +103,14 @@ class _Crc32cEngine:
         self.table = np.array(_crc32c_make_table(), dtype=np.uint32)
         self.pos_tables: Any = None  # (BLOCK, 256) uint32, built lazily
         self.advance_basis: Any = None  # step0^BLOCK images of the 32 bits
-        self._build_lock = _threading.Lock()
+        # reentrant: CRC verification runs on the SIGUSR1 flight-recorder
+        # dump path (lease read → unframe), which may interrupt the main
+        # thread mid-build while it holds this lock.  The build is
+        # idempotent and publishes pos_tables LAST, so a reentrant
+        # rebuild is wasted work, never a torn table — while a plain
+        # Lock here would deadlock the handler (the PR-3 lazy-table race,
+        # signal edition).
+        self._build_lock = _threading.RLock()
 
     def _step0_vec(self, v):
         return (v >> np.uint32(8)) ^ self.table[v & np.uint32(0xFF)]
@@ -167,7 +174,9 @@ class _Crc32cEngine:
 
 
 _crc32c_engine: _Crc32cEngine | None = None
-_crc32c_engine_lock = _threading.Lock()
+# reentrant: crc32c() is reachable from the SIGUSR1 handler (see
+# _ResolvingTable note above) — engine construction is idempotent
+_crc32c_engine_lock = _threading.RLock()
 
 
 def crc32c(data: bytes | memoryview, crc: int = 0) -> int:

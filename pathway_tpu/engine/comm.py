@@ -69,10 +69,9 @@ _RESUME = struct.Struct(">QQ")  # (incarnation, last sequence received from you)
 
 
 def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+    from pathway_tpu.internals.config import env_float
+
+    return env_float(name, default)
 
 
 CONNECT_TIMEOUT_S = 60.0
@@ -94,9 +93,9 @@ SEND_BUFFER_MB = 64  # PATHWAY_COMM_SEND_BUFFER_MB
 # frame-size cap: a corrupt or hostile length field must not OOM the
 # worker.  256 MiB default comfortably covers real epoch batches (tune via
 # PATHWAY_COMM_MAX_FRAME_MB for enormous-epoch deployments).
-MAX_FRAME_BYTES = (
-    int(os.environ.get("PATHWAY_COMM_MAX_FRAME_MB", "256") or "256") << 20
-)
+from pathway_tpu.internals.config import env_int as _env_int  # noqa: E402
+
+MAX_FRAME_BYTES = _env_int("PATHWAY_COMM_MAX_FRAME_MB") << 20
 
 _MAGIC = b"PWC1"
 _NONCE = 16
@@ -118,7 +117,9 @@ def _resolve_secret(secret: bytes | str | None) -> bytes:
     PATHWAY_COMM_SECRET for any mesh that crosses a machine boundary.
     """
     if secret is None:
-        secret = os.environ.get("PATHWAY_COMM_SECRET", "")
+        from pathway_tpu.internals.config import env_str
+
+        secret = env_str("PATHWAY_COMM_SECRET")
     if isinstance(secret, str):
         secret = secret.encode()
     return secret
@@ -818,6 +819,7 @@ class TcpMesh:
             drop()  # caller holds self._cv
 
     # -- heartbeats -------------------------------------------------------
+    # pathway-lint: context=heartbeat
     def _heartbeat_loop(self) -> None:
         """Per-link liveness: send heartbeat+ack frames; force-fail links
         whose peer went silent or stopped acking (a hung process looks

@@ -129,10 +129,9 @@ class InjectedFault(IOError):
 
 def restart_attempt() -> int:
     """Supervisor restart attempt of this process (0 = first launch)."""
-    try:
-        return int(os.environ.get(ENV_ATTEMPT, "0") or "0")
-    except ValueError:
-        return 0
+    from pathway_tpu.internals.config import env_int
+
+    return env_int(ENV_ATTEMPT)
 
 
 class FaultSpec:
@@ -248,7 +247,9 @@ class FaultPlan:
 
     @classmethod
     def from_env(cls) -> "FaultPlan | None":
-        raw = os.environ.get(ENV_PLAN)
+        from pathway_tpu.internals.config import env_raw
+
+        raw = env_raw(ENV_PLAN)
         if not raw:
             return None
         return cls.from_json(raw)
@@ -354,6 +355,7 @@ def maybe_hang(*, worker: int, epoch: int) -> None:
     if plan.check("hang", worker=worker, epoch=epoch) is not None:
         _blackbox.record("fault.hang", worker=worker, epoch=epoch)
         while True:  # only a signal ends this — that is the point
+            # pathway-lint: disable=ctx-blocking-call — the hang injector exists to wedge the epoch loop (watchdog chaos tests); blocking IS the feature
             _time.sleep(0.05)
 
 

@@ -260,7 +260,11 @@ def summarize_dumps(
 # ---------------------------------------------------------------------------
 
 _recorder: FlightRecorder | None = None
-_recorder_lock = threading.Lock()
+# reentrant like FlightRecorder._lock: the SIGUSR1 handler calls
+# get_recorder() on the main thread and may interrupt a first-call
+# construction already inside this lock (reentry double-creates a
+# recorder whose events are lost; a plain Lock deadlocks the handler)
+_recorder_lock = threading.RLock()
 
 
 def get_recorder() -> FlightRecorder:

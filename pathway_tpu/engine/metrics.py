@@ -67,6 +67,87 @@ DEFAULT_BUCKETS = (
     500.0, 1000.0, 5000.0,
 )
 
+# ---------------------------------------------------------------------------
+# The declared metric-name registry.
+#
+# Every dotted metric name the process exports — registered directly
+# (counter/gauge/histogram), emitted by a pull-time collector, or merged
+# into telemetry samples — is declared here: name -> (kind, doc).
+# ``pathway_tpu lint`` enforces it (rule ``metric-undeclared``): a
+# registration under an undeclared literal, or under a name the checker
+# cannot resolve statically (``metric-nonliteral``), fails the gate, so
+# dashboards and alerts can trust this table to be the complete,
+# stable namespace.  Kinds: counter | gauge | histogram | collector
+# (collector = a register_collector() supplier name; its emitted gauges
+# are declared individually as kind "gauge").
+# ---------------------------------------------------------------------------
+
+METRICS: dict[str, tuple[str, str]] = {
+    # comm mesh (engine/comm.py)
+    "comm.frames.sent": ("counter", "data/control frames written to peers"),
+    "comm.frames.received": ("counter", "frames read from peers"),
+    "comm.bytes.sent": ("counter", "bytes written to peers (headers included)"),
+    "comm.bytes.received": ("counter", "bytes read from peers"),
+    "comm.reconnects": ("counter", "link reconnect attempts"),
+    "comm.retransmits": ("counter", "frames retransmitted after a resync"),
+    "comm.retransmit.evictions": (
+        "counter", "unacked frames evicted from a full retransmit buffer"),
+    "comm.peers.dead": ("counter", "peers declared dead past the reconnect window"),
+    "comm.heartbeat.staleness.s": (
+        "gauge", "max seconds since any live peer was last heard from"),
+    # epoch loop / dataflow (internals/runner.py, engine/probes.py)
+    "epoch.duration.ms": ("histogram", "wall time of one processed epoch (ms)"),
+    "dataflow.prober": ("collector", "dataflow progress totals supplier"),
+    "dataflow.epochs": ("gauge", "epochs processed by this worker"),
+    "dataflow.input.rows": ("gauge", "rows ingested across input nodes"),
+    "dataflow.output.rows": ("gauge", "rows delivered across output nodes"),
+    "dataflow.operators": ("gauge", "operator count of the lowered graph"),
+    "dataflow.errors": ("gauge", "rows poisoned/logged by operators"),
+    "dataflow.input.lag.ms": ("gauge", "input-side processing lag"),
+    "dataflow.output.lag.ms": ("gauge", "output-side processing lag"),
+    # persistence commit pipeline (engine/persistence.py, CommitMetrics)
+    "persistence.fenced": (
+        "counter", "commit-point writes rejected: a newer incarnation owns the root"),
+    "persistence.scrub.runs": ("counter", "offline scrub audits run"),
+    "persistence.scrub.damaged": (
+        "counter", "scrub audits that found damage"),
+    "checkpoint.commit.buffer": ("gauge", "cumulative encode/join seconds"),
+    "checkpoint.commit.frame": ("gauge", "cumulative integrity-framing seconds"),
+    "checkpoint.commit.hash": ("gauge", "cumulative SHA-256 seconds"),
+    "checkpoint.commit.upload": ("gauge", "cumulative blob upload seconds"),
+    "checkpoint.commit.barrier": ("gauge", "cumulative commit-barrier seconds"),
+    "checkpoint.commit.backpressure": (
+        "gauge", "seconds the epoch thread stalled on the in-flight byte cap"),
+    "checkpoint.inflight.bytes": ("gauge", "snapshot bytes in flight to the store"),
+    "checkpoint.inflight.jobs": ("gauge", "artifact writes in flight"),
+    "checkpoint.inflight.bytes.max": ("gauge", "high-water mark of in-flight bytes"),
+    "checkpoint.artifacts": ("gauge", "artifacts durably written"),
+    "checkpoint.bytes": ("gauge", "artifact bytes durably written"),
+    "checkpoint.commits": ("gauge", "generation manifests published"),
+    "checkpoint.commits.noop": ("gauge", "commits confirmed as no-ops"),
+    "checkpoint.gc.runs": ("gauge", "deferred-GC sweeps run"),
+    "checkpoint.gc.deleted": ("gauge", "artifacts deleted by GC"),
+    "checkpoint.gc.deferred": (
+        "gauge", "GC sweeps deferred: newest generation failed read-back"),
+    # supervisor (engine/supervisor.py)
+    "supervisor.restarts": (
+        "counter", "cluster rollback-and-respawn recoveries performed"),
+    "supervisor.watchdog.kills": (
+        "counter", "hung workers killed by the progress watchdog"),
+    "worker.restart.attempt": (
+        "gauge", "supervisor restarts performed before this worker launch"),
+    "worker.last_progress.age_s": (
+        "gauge", "seconds since the worker's last epoch-progress beacon"),
+    # telemetry (engine/telemetry.py)
+    "telemetry.export.dropped": (
+        "counter", "telemetry payloads dropped by the bounded export queue"),
+    "process.memory.usage": ("gauge", "resident set size in bytes"),
+    "process.cpu.utime": ("gauge", "user CPU seconds"),
+    "process.cpu.stime": ("gauge", "system CPU seconds"),
+    "latency.input": ("gauge", "input lag of the latest ProberStats (ms)"),
+    "latency.output": ("gauge", "output lag of the latest ProberStats (ms)"),
+}
+
 
 class _Enabled:
     """Shared mutable on/off flag — one attribute read per update."""
@@ -176,7 +257,13 @@ class _Family:
         self.buckets = buckets
         self._children: dict[tuple, Any] = {}
         self._enabled = enabled
-        self._lock = threading.Lock()
+        # reentrant: counters are registered from the SIGUSR1 flight-
+        # recorder path (persistence.fenced via FlightRecorder._fenced),
+        # and the handler may interrupt the main thread inside labels() —
+        # a plain Lock would deadlock the worker in the handler.  The
+        # worst reentrant outcome is a double-created child (one lost
+        # count), never a crash.
+        self._lock = threading.RLock()
 
     def labels(self, **labels: Any):
         key = _label_key(labels)
@@ -212,14 +299,16 @@ class MetricsRegistry:
 
     def __init__(self, *, enabled: bool | None = None):
         if enabled is None:
-            import os
+            from pathway_tpu.internals.config import env_bool
 
-            enabled = os.environ.get("PATHWAY_METRICS_DISABLED", "") not in (
-                "1", "true", "yes", "on",
-            )
+            enabled = not env_bool("PATHWAY_METRICS_DISABLED")
         self._enabled = _Enabled(enabled)
         self._families: dict[str, _Family] = {}
-        self._lock = threading.Lock()
+        # reentrant for the same reason as _Family._lock: the SIGUSR1
+        # handler's fence-counter registration may interrupt a frame that
+        # already holds this lock (a torn double-create loses one count;
+        # a plain Lock loses the worker)
+        self._lock = threading.RLock()
         # name -> weakref-able callable returning {name: value}
         self._collectors: dict[str, Any] = {}
 
@@ -500,7 +589,9 @@ def split_labeled_name(name: str) -> tuple[str, dict[str, str]]:
 # ---------------------------------------------------------------------------
 
 _registry: MetricsRegistry | None = None
-_registry_lock = threading.Lock()
+# reentrant: get_registry() sits on the SIGUSR1 flight-recorder path and
+# may interrupt a first-call construction on the main thread
+_registry_lock = threading.RLock()
 
 
 def get_registry() -> MetricsRegistry:

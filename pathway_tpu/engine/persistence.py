@@ -93,10 +93,9 @@ class FencedError(CheckpointError):
 def writer_incarnation() -> int:
     """This process's cluster incarnation (``PATHWAY_INCARNATION``); 0 when
     unleased — solo runs without a supervisor skip fencing entirely."""
-    try:
-        return int(os.environ.get(ENV_INCARNATION, "0") or "0")
-    except ValueError:
-        return 0
+    from pathway_tpu.internals.config import env_int
+
+    return env_int(ENV_INCARNATION)
 
 
 def _decode_lease(raw: bytes | None) -> dict | None:
@@ -150,29 +149,25 @@ def acquire_lease(
 
 def _retain_generations() -> int:
     """How many committed generations to keep (deferred GC window)."""
-    try:
-        return max(1, int(os.environ.get("PATHWAY_CHECKPOINT_GENERATIONS", "3")))
-    except ValueError:
-        return 3
+    from pathway_tpu.internals.config import env_int
+
+    return max(1, env_int("PATHWAY_CHECKPOINT_GENERATIONS"))
 
 
 def _checkpoint_writers() -> int:
     """Background checkpoint writer threads; 0 = fully synchronous commits
     (the pre-pipelining inline path)."""
-    try:
-        return max(0, int(os.environ.get("PATHWAY_CHECKPOINT_WRITERS", "2")))
-    except ValueError:
-        return 2
+    from pathway_tpu.internals.config import env_int
+
+    return max(0, env_int("PATHWAY_CHECKPOINT_WRITERS"))
 
 
 def _inflight_cap_bytes() -> int:
     """Backpressure bound: bytes of raw snapshot data the epoch thread may
     hand to the writer pool before it must stall and let uploads drain."""
-    try:
-        mb = max(1, int(os.environ.get("PATHWAY_CHECKPOINT_INFLIGHT_MB", "256")))
-    except ValueError:
-        mb = 256
-    return mb << 20
+    from pathway_tpu.internals.config import env_int
+
+    return max(1, env_int("PATHWAY_CHECKPOINT_INFLIGHT_MB")) << 20
 
 
 def _publish_interval_s() -> float:
@@ -182,14 +177,9 @@ def _publish_interval_s() -> float:
     buys lower durability lag at the price of more manifest/fsync
     overhead per second.  0 publishes as fast as the store allows.
     Blocking commits (drains, finals) ignore it."""
-    try:
-        ms = max(
-            0.0,
-            float(os.environ.get("PATHWAY_CHECKPOINT_PUBLISH_INTERVAL_MS", "20")),
-        )
-    except ValueError:
-        ms = 20.0
-    return ms / 1000.0
+    from pathway_tpu.internals.config import env_float
+
+    return max(0.0, env_float("PATHWAY_CHECKPOINT_PUBLISH_INTERVAL_MS")) / 1000.0
 
 
 def _sha256(data: bytes) -> str:
@@ -468,18 +458,10 @@ class _PrefixedObjectStore(BlobBackend):
     def __init__(self, client: Any, prefix: str = ""):
         self.client = client
         self.prefix = prefix.strip("/")
-        try:
-            self.max_retries = max(
-                0, int(os.environ.get("PATHWAY_BLOB_RETRIES", "3"))
-            )
-        except ValueError:
-            self.max_retries = 3
-        try:
-            self.retry_initial_ms = max(
-                1, int(os.environ.get("PATHWAY_BLOB_RETRY_INITIAL_MS", "200"))
-            )
-        except ValueError:
-            self.retry_initial_ms = 200
+        from pathway_tpu.internals.config import env_int
+
+        self.max_retries = max(0, env_int("PATHWAY_BLOB_RETRIES"))
+        self.retry_initial_ms = max(1, env_int("PATHWAY_BLOB_RETRY_INITIAL_MS"))
 
     def _key(self, key: str) -> str:
         return f"{self.prefix}/{key}" if self.prefix else key
@@ -913,6 +895,7 @@ class _WriterPool:
             t.start()
             self._threads.append(t)
 
+    # pathway-lint: context=writer
     def _run(self) -> None:
         while True:
             with self._cv:
@@ -1301,7 +1284,7 @@ class PersistentStorage:
         if (
             self.operator_persistence
             and self.rejected_generations
-            and int(os.environ.get("PATHWAY_PROCESSES", "1") or "1") > 1
+            and _cluster_processes() > 1
         ):
             # input-log mode tolerates one worker falling back further than
             # its peers (all state recomputes from replayed + re-read
@@ -1743,6 +1726,7 @@ class PersistentStorage:
             )
             self._committer.start()
 
+    # pathway-lint: context=committer
     def _committer_loop(self) -> None:
         """Single consumer of the pending queue: generations publish in
         submission order, so the manifest sequence on the store is exactly
@@ -2192,11 +2176,19 @@ def _op_ref(ref: Any) -> dict:
 
 def _restart_attempt() -> int:
     """Supervisor restart attempt (dup of faults.restart_attempt; reading
-    the env directly avoids a persistence ↔ faults import cycle)."""
-    try:
-        return int(os.environ.get("PATHWAY_RESTART_ATTEMPT", "0") or "0")
-    except ValueError:
-        return 0
+    the env registry directly avoids a persistence ↔ faults import cycle)."""
+    from pathway_tpu.internals.config import env_int
+
+    return env_int("PATHWAY_RESTART_ATTEMPT")
+
+
+def _cluster_processes() -> int:
+    """Live ``PATHWAY_PROCESSES`` read (not the cached PathwayConfig:
+    resume may run before the config snapshot of a freshly-spawned worker
+    exists, and tests repoint the env between runs)."""
+    from pathway_tpu.internals.config import env_int
+
+    return env_int("PATHWAY_PROCESSES")
 
 
 def verify_manifest(

@@ -105,7 +105,9 @@ def _epoch_deadline_from_env() -> float | None:
     """``PATHWAY_EPOCH_DEADLINE_S`` as a positive float, else None (the
     watchdog stays off — a run with long legitimate gaps between epochs
     must opt in with a deadline that fits its cadence)."""
-    raw = os.environ.get(ENV_EPOCH_DEADLINE, "")
+    from pathway_tpu.internals.config import env_raw
+
+    raw = env_raw(ENV_EPOCH_DEADLINE) or ""
     try:
         value = float(raw) if raw else 0.0
     except ValueError:
@@ -665,6 +667,7 @@ class Supervisor:
             if self.incarnation is not None:
                 os.environ.pop(ENV_INCARNATION, None)
 
+    # pathway-lint: context=watchdog
     def _watch(self, handles: Sequence[Any]) -> int | None:
         """Block until all workers exit 0 (None) or one fails (its id).
 

@@ -107,15 +107,15 @@ class TelemetryConfig:
         if monitoring_server is not None and license is not None:
             license.check_entitlements(["monitoring"])
         servers = (monitoring_server,) if monitoring_server else ()
+        from pathway_tpu.internals.config import env_str
+
         requested = (
             protocol
             if protocol is not None
-            else os.environ.get("PATHWAY_TELEMETRY_PROTOCOL", "otlp-json")
+            else env_str("PATHWAY_TELEMETRY_PROTOCOL")
         )
-        instance_id = os.environ.get("PATHWAY_SERVICE_INSTANCE_ID") or secrets.token_hex(8)
-        namespace = (
-            os.environ.get("PATHWAY_SERVICE_NAMESPACE") or LOCAL_DEV_NAMESPACE
-        )
+        instance_id = env_str("PATHWAY_SERVICE_INSTANCE_ID") or secrets.token_hex(8)
+        namespace = env_str("PATHWAY_SERVICE_NAMESPACE") or LOCAL_DEV_NAMESPACE
         return cls(
             telemetry_enabled=bool(servers),
             metrics_servers=tuple(servers),
@@ -425,13 +425,16 @@ class Telemetry:
             "telemetry payloads dropped by the bounded export queue",
         ).inc()
 
+    # pathway-lint: context=telemetry
     def _q_loop(self) -> None:
         while True:
             with self._q_cv:
                 while not self._q and not self._q_closing:
-                    # untimed: every producer (_enqueue_export) and the
-                    # closer (_drain_queue) notify under this cv
-                    self._q_cv.wait()
+                    # timed re-check: producers (_enqueue_export) and the
+                    # closer (_drain_queue) notify under this cv, but a
+                    # supervised background thread never waits unbounded —
+                    # the loop condition decides, the timeout only paces
+                    self._q_cv.wait(timeout=1.0)
                 if not self._q:
                     return  # closing and drained
                 kind, payload, servers = self._q.popleft()
@@ -506,6 +509,7 @@ class Telemetry:
         self._thread.start()
         return self
 
+    # pathway-lint: context=telemetry
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
             self._enqueue_export(

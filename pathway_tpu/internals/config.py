@@ -1,8 +1,23 @@
-"""Env-var-driven runtime configuration.
+"""Env-var-driven runtime configuration + the declared knob registry.
 
 Parity target: ``/root/reference/python/pathway/internals/config.py`` (173
 LoC) + engine-side ``src/engine/dataflow/config.rs:88-127``.  Same env
 variables, same context-local override mechanism.
+
+Every ``PATHWAY_*`` environment knob the package reads is DECLARED here
+in :data:`ENV_KNOBS` — name, type, default, one-line doc, owning
+subsystem — and read through the typed accessors (:func:`env_bool`,
+:func:`env_int`, :func:`env_float`, :func:`env_str`, :func:`env_raw`).
+``pathway_tpu lint`` enforces both halves: a direct ``os.environ`` read
+of a ``PATHWAY_*`` name outside this module is an ``env-direct-read``
+finding, and an undeclared name anywhere is ``env-undeclared``.
+``docs/configuration.md`` is GENERATED from this registry
+(:func:`render_env_docs`; regenerate with ``pathway_tpu lint
+--update-config-docs``) and pinned in sync by the lint gate.
+
+Accessors read ``os.environ`` live (no caching): worker processes get
+their knobs from the spawning supervisor's environment, and tests
+monkeypatch freely between runs.
 """
 
 from __future__ import annotations
@@ -13,22 +28,294 @@ import os
 from contextvars import ContextVar
 from typing import Any
 
+# ---------------------------------------------------------------------------
+# The declared environment-knob registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvKnob:
+    """One declared ``PATHWAY_*`` environment knob."""
+
+    name: str
+    kind: str  # "bool" | "int" | "float" | "str"
+    default: Any
+    doc: str
+    subsystem: str
+
+
+def _k(name: str, kind: str, default: Any, doc: str, subsystem: str) -> EnvKnob:
+    return EnvKnob(name, kind, default, doc, subsystem)
+
+
+ENV_KNOBS: tuple[EnvKnob, ...] = (
+    # -- core runtime (this module) -----------------------------------------
+    _k("PATHWAY_IGNORE_ASSERTS", "bool", False,
+       "skip `pw.assert_*` runtime checks", "core"),
+    _k("PATHWAY_RUNTIME_TYPECHECKING", "bool", False,
+       "enable runtime schema/type checking of expressions", "core"),
+    _k("PATHWAY_TERMINATE_ON_ERROR", "bool", True,
+       "terminate the run on the first operator error (else poison rows "
+       "and continue)", "core"),
+    _k("PATHWAY_REPLAY_STORAGE", "str", None,
+       "persistence root for record/replay runs (enables persistence "
+       "without an explicit `pw.persistence.Config`)", "core"),
+    _k("PATHWAY_SNAPSHOT_ACCESS", "str", None,
+       "`record` | `replay` — connector snapshot mode for record/replay "
+       "runs", "core"),
+    _k("PATHWAY_PERSISTENCE_MODE", "str", None,
+       "persistence/replay pacing mode (`batch` | `speedrun`)", "core"),
+    _k("PATHWAY_REPLAY_MODE", "str", None,
+       "legacy alias of PATHWAY_PERSISTENCE_MODE written by "
+       "`pathway_tpu replay --mode`", "core"),
+    _k("PATHWAY_CONTINUE_AFTER_REPLAY", "bool", False,
+       "keep consuming live connector data after a recorded stream "
+       "drains", "core"),
+    _k("PATHWAY_LICENSE_KEY", "str", None,
+       "license key for entitlement checks (internals/license.py)", "core"),
+    _k("PATHWAY_MONITORING_SERVER", "str", None,
+       "OTLP/HTTP collector endpoint for telemetry export (zero egress "
+       "when unset)", "core"),
+    _k("PATHWAY_THREADS", "int", 1,
+       "worker threads per spawned process (accepted for parity; the "
+       "device mesh is what scales compute)", "core"),
+    _k("PATHWAY_PROCESSES", "int", 1,
+       "SPMD cluster size: identical processes forming one TCP mesh",
+       "core"),
+    _k("PATHWAY_PROCESS_ID", "int", 0,
+       "this worker's id within the cluster, in [0, PATHWAY_PROCESSES)",
+       "core"),
+    _k("PATHWAY_FIRST_PORT", "int", 10000,
+       "base port of the worker TCP mesh (worker i listens on "
+       "FIRST_PORT + i)", "core"),
+    _k("PATHWAY_PEER_HOSTS", "str", None,
+       "comma-separated hostname per worker id for multi-host meshes "
+       "(unset = localhost mesh)", "core"),
+    _k("PATHWAY_RUN_ID", "str", None,
+       "cluster run id minted by `pathway_tpu spawn` (one per run, kept "
+       "across supervised restarts)", "core"),
+    _k("PATHWAY_MONITORING_HTTP_PORT", "int", None,
+       "serve `GET /metrics` + the HTML dashboard on this port (worker i "
+       "uses port + i)", "core"),
+    # -- comm mesh (engine/comm.py) -----------------------------------------
+    _k("PATHWAY_COMM_SECRET", "str", "",
+       "shared mesh handshake secret (`spawn` mints one per run); empty "
+       "disables authentication and pickled frame values", "comm"),
+    _k("PATHWAY_COMM_MAX_FRAME_MB", "int", 256,
+       "frame-size cap in MiB — a corrupt or hostile length field must "
+       "not OOM the worker", "comm"),
+    _k("PATHWAY_COMM_RECV_TIMEOUT_S", "float", 300.0,
+       "deadline for `recv()` waiting on a tagged frame", "comm"),
+    _k("PATHWAY_COMM_HEARTBEAT_S", "float", 2.0,
+       "heartbeat send interval per link", "comm"),
+    _k("PATHWAY_COMM_HEARTBEAT_TIMEOUT_S", "float", 30.0,
+       "force-fail a link whose peer was silent (or stopped acking) for "
+       "this long", "comm"),
+    _k("PATHWAY_COMM_RECONNECT_WINDOW_S", "float", 15.0,
+       "window a failed link may reconnect + resync before the peer is "
+       "declared dead and its inbox purged", "comm"),
+    _k("PATHWAY_COMM_SEND_DEADLINE_S", "float", None,
+       "SO_SNDTIMEO deadline on any single blocking socket write "
+       "(default: the heartbeat timeout; 0 disables)", "comm"),
+    _k("PATHWAY_COMM_SEND_BUFFER_MB", "float", 64.0,
+       "per-link retransmit buffer in MiB (unacked frames kept for "
+       "reconnect resync)", "comm"),
+    # -- fault injection (engine/faults.py) ---------------------------------
+    _k("PATHWAY_FAULT_PLAN", "str", None,
+       "seeded fault-injection plan (JSON) for chaos/soak runs", "faults"),
+    _k("PATHWAY_RESTART_ATTEMPT", "int", 0,
+       "supervisor restart attempt announced to workers (fault `attempt` "
+       "filters key off it)", "faults"),
+    # -- metrics / telemetry ------------------------------------------------
+    _k("PATHWAY_METRICS_DISABLED", "bool", False,
+       "kill switch: turn every metric update into an immediate return "
+       "(the benchmark lever)", "metrics"),
+    _k("PATHWAY_TELEMETRY_PROTOCOL", "str", "otlp-json",
+       "telemetry wire format: `otlp-json` | `pathway-json` (legacy line "
+       "JSON)", "metrics"),
+    _k("PATHWAY_SERVICE_INSTANCE_ID", "str", None,
+       "OTel `service.instance.id` resource attribute (default: random "
+       "per process)", "metrics"),
+    _k("PATHWAY_SERVICE_NAMESPACE", "str", "local-dev",
+       "OTel `service.namespace` resource attribute", "metrics"),
+    # -- persistence (engine/persistence.py) --------------------------------
+    _k("PATHWAY_INCARNATION", "int", 0,
+       "cluster incarnation lease this worker runs under (exported by "
+       "the supervisor; fences zombie writers out of the root)",
+       "persistence"),
+    _k("PATHWAY_CHECKPOINT_GENERATIONS", "int", 3,
+       "committed checkpoint generations retained (the deferred-GC "
+       "fallback window)", "persistence"),
+    _k("PATHWAY_CHECKPOINT_WRITERS", "int", 2,
+       "background checkpoint writer threads; 0 = fully synchronous "
+       "commits", "persistence"),
+    _k("PATHWAY_CHECKPOINT_INFLIGHT_MB", "int", 256,
+       "cap of in-flight snapshot bytes before commit staging "
+       "backpressures the epoch thread", "persistence"),
+    _k("PATHWAY_CHECKPOINT_PUBLISH_INTERVAL_MS", "float", 20.0,
+       "minimum spacing between pipelined manifest publishes (staged "
+       "frontiers conflate while the committer waits)", "persistence"),
+    _k("PATHWAY_BLOB_RETRIES", "int", 3,
+       "bounded retries for transient object-store errors", "persistence"),
+    _k("PATHWAY_BLOB_RETRY_INITIAL_MS", "int", 200,
+       "initial backoff of the blob retry schedule", "persistence"),
+    _k("PATHWAY_PERSISTENT_STORAGE", "str", None,
+       "filesystem root for the UDF DiskCache when no persistence config "
+       "is active", "persistence"),
+    # -- supervisor (engine/supervisor.py) ----------------------------------
+    _k("PATHWAY_EPOCH_DEADLINE_S", "float", None,
+       "hung-worker watchdog: no epoch progress for this long → SIGUSR1 "
+       "(flight-recorder dump) → SIGTERM → SIGKILL into a supervised "
+       "restart (unset or <= 0 disables)", "supervisor"),
+    # -- devices (parallel/mesh.py, internals/runner.py) --------------------
+    _k("PATHWAY_JAX_DISTRIBUTED", "bool", False,
+       "form a multi-host JAX device mesh too (`spawn "
+       "--jax-distributed`): each process joins one global mesh",
+       "devices"),
+    _k("PATHWAY_DEVICE_COORDINATOR", "str", None,
+       "host:port of the jax.distributed coordinator (default derived "
+       "from worker 0's host and the mesh ports)", "devices"),
+    # -- models / native kernels --------------------------------------------
+    _k("PATHWAY_FUSED_ENCODER", "bool", True,
+       "use the fused/packed encoder inference path", "models"),
+    _k("PATHWAY_ENCODER_QUANTIZE", "str", None,
+       "`int8` enables weight-only-quantized encoder inference", "models"),
+    _k("PATHWAY_NATIVE", "bool", True,
+       "`0` disables the native C++ kernels (numpy/python fallback)",
+       "models"),
+    # -- CLI ----------------------------------------------------------------
+    _k("PATHWAY_SPAWN_ARGS", "str", None,
+       "arguments for `pathway_tpu spawn-from-env` (the k8s-operator "
+       "hook)", "cli"),
+)
+
+ENV_REGISTRY: dict[str, EnvKnob] = {k.name: k for k in ENV_KNOBS}
+
+_SUBSYSTEM_TITLES = (
+    ("core", "Core runtime (`internals/config.py`)"),
+    ("comm", "Worker mesh (`engine/comm.py`)"),
+    ("faults", "Fault injection (`engine/faults.py`)"),
+    ("metrics", "Metrics & telemetry (`engine/metrics.py`, `engine/telemetry.py`)"),
+    ("persistence", "Persistence (`engine/persistence.py`)"),
+    ("supervisor", "Supervisor (`engine/supervisor.py`)"),
+    ("devices", "Device mesh (`parallel/mesh.py`)"),
+    ("models", "Models & native kernels"),
+    ("cli", "CLI (`pathway_tpu/cli.py`)"),
+)
+
+_TRUTHY = ("1", "true", "yes", "on")
+_FALSY = ("0", "false", "no", "off")
+
+
+def _knob(name: str) -> EnvKnob:
+    knob = ENV_REGISTRY.get(name)
+    if knob is None:
+        raise KeyError(
+            f"{name} is not a declared environment knob — add it to "
+            "internals/config.py:ENV_KNOBS (name, type, default, doc) and "
+            "regenerate docs/configuration.md"
+        )
+    return knob
+
+
+def env_raw(name: str) -> str | None:
+    """The raw environment value of a DECLARED knob (None when unset).
+    For knobs whose parse is deliberately custom (e.g. the watchdog
+    deadline's positive-float-or-off semantics)."""
+    _knob(name)
+    return os.environ.get(name)
+
+
+def env_str(name: str, default: Any = ...) -> Any:
+    knob = _knob(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        return knob.default if default is ... else default
+    return raw
+
+
+def env_bool(name: str, default: Any = ...) -> bool:
+    knob = _knob(name)
+    fallback = knob.default if default is ... else default
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        # empty = unset (the `PATHWAY_NATIVE=` shell idiom keeps the
+        # default), matching env_int/env_float — NOT falsy
+        return bool(fallback)
+    v = raw.strip().lower()
+    if v in _TRUTHY:
+        return True
+    if v in _FALSY:
+        return False
+    return bool(fallback)
+
+
+def env_int(name: str, default: Any = ...) -> Any:
+    knob = _knob(name)
+    fallback = knob.default if default is ... else default
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return fallback
+    try:
+        return int(raw)
+    except ValueError:
+        return fallback
+
+
+def env_float(name: str, default: Any = ...) -> Any:
+    knob = _knob(name)
+    fallback = knob.default if default is ... else default
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return fallback
+    try:
+        return float(raw)
+    except ValueError:
+        return fallback
+
+
+def render_env_docs() -> str:
+    """``docs/configuration.md``, generated.  The lint gate pins the file
+    byte-identical to this render (rule ``env-docs-stale``)."""
+    lines = [
+        "# Configuration knobs",
+        "",
+        "<!-- GENERATED FILE — do not edit. -->",
+        "<!-- Source: pathway_tpu/internals/config.py:ENV_KNOBS. -->",
+        "<!-- Regenerate: pathway_tpu lint --update-config-docs -->",
+        "",
+        "Every `PATHWAY_*` environment variable the runtime reads, in one",
+        "declared registry (`internals/config.py:ENV_KNOBS`).  Code reads",
+        "these through typed accessors (`config.env_bool` / `env_int` /",
+        "`env_float` / `env_str` / `env_raw`); `pathway_tpu lint` rejects",
+        "direct `os.environ` reads (`env-direct-read`) and undeclared",
+        "names (`env-undeclared`), so this page is complete by",
+        "construction.",
+        "",
+    ]
+    for key, title in _SUBSYSTEM_TITLES:
+        knobs = [k for k in ENV_KNOBS if k.subsystem == key]
+        if not knobs:
+            continue
+        lines.append(f"## {title}")
+        lines.append("")
+        lines.append("| Variable | Type | Default | Meaning |")
+        lines.append("|---|---|---|---|")
+        for k in knobs:
+            default = "—" if k.default is None else repr(k.default)
+            lines.append(
+                f"| `{k.name}` | {k.kind} | `{default}` | {k.doc} |"
+            )
+        lines.append("")
+    return "\n".join(lines)
+
 
 def _env_bool(name: str, default: bool = False) -> bool:
-    v = os.environ.get(name)
-    if v is None:
-        return default
-    return v.strip().lower() in ("1", "true", "yes", "on")
+    return env_bool(name, default)
 
 
 def _env_int(name: str, default: int) -> int:
-    v = os.environ.get(name)
-    if v is None:
-        return default
-    try:
-        return int(v)
-    except ValueError:
-        return default
+    return env_int(name, default)
 
 
 @dataclasses.dataclass
@@ -44,22 +331,22 @@ class PathwayConfig:
         default_factory=lambda: _env_bool("PATHWAY_TERMINATE_ON_ERROR", True)
     )
     replay_storage: str | None = dataclasses.field(
-        default_factory=lambda: os.environ.get("PATHWAY_REPLAY_STORAGE")
+        default_factory=lambda: env_str("PATHWAY_REPLAY_STORAGE")
     )
     snapshot_access: str | None = dataclasses.field(
-        default_factory=lambda: os.environ.get("PATHWAY_SNAPSHOT_ACCESS")
+        default_factory=lambda: env_str("PATHWAY_SNAPSHOT_ACCESS")
     )
     persistence_mode: str | None = dataclasses.field(
-        default_factory=lambda: os.environ.get("PATHWAY_PERSISTENCE_MODE")
+        default_factory=lambda: env_str("PATHWAY_PERSISTENCE_MODE")
     )
     continue_after_replay: bool = dataclasses.field(
         default_factory=lambda: _env_bool("PATHWAY_CONTINUE_AFTER_REPLAY")
     )
     license_key: str | None = dataclasses.field(
-        default_factory=lambda: os.environ.get("PATHWAY_LICENSE_KEY")
+        default_factory=lambda: env_str("PATHWAY_LICENSE_KEY")
     )
     monitoring_server: str | None = dataclasses.field(
-        default_factory=lambda: os.environ.get("PATHWAY_MONITORING_SERVER")
+        default_factory=lambda: env_str("PATHWAY_MONITORING_SERVER")
     )
     # worker topology (config.rs:88-120)
     threads: int = dataclasses.field(default_factory=lambda: _env_int("PATHWAY_THREADS", 1))
@@ -72,18 +359,16 @@ class PathwayConfig:
     # (PATHWAY_PEER_HOSTS=pod-0.svc,pod-1.svc,...); empty = localhost mesh
     peer_hosts: list | None = dataclasses.field(
         default_factory=lambda: (
-            [h.strip() for h in os.environ["PATHWAY_PEER_HOSTS"].split(",")]
-            if os.environ.get("PATHWAY_PEER_HOSTS")
+            [h.strip() for h in env_str("PATHWAY_PEER_HOSTS", "").split(",")]
+            if env_str("PATHWAY_PEER_HOSTS")
             else None
         )
     )
-    run_id: str | None = dataclasses.field(default_factory=lambda: os.environ.get("PATHWAY_RUN_ID"))
+    run_id: str | None = dataclasses.field(
+        default_factory=lambda: env_str("PATHWAY_RUN_ID")
+    )
     monitoring_http_port: int | None = dataclasses.field(
-        default_factory=lambda: (
-            int(os.environ["PATHWAY_MONITORING_HTTP_PORT"])
-            if "PATHWAY_MONITORING_HTTP_PORT" in os.environ
-            else None
-        )
+        default_factory=lambda: env_int("PATHWAY_MONITORING_HTTP_PORT")
     )
 
     @property

@@ -140,8 +140,10 @@ def run(
     # (engine/comm.py; the reference's timely Cluster config analog).
     from pathway_tpu.internals.config import get_config as _get_config
 
+    from pathway_tpu.internals.config import env_bool as _env_bool
+
     _cfg = _get_config()
-    if _cfg.processes > 1 and os.environ.get("PATHWAY_JAX_DISTRIBUTED") == "1":
+    if _cfg.processes > 1 and _env_bool("PATHWAY_JAX_DISTRIBUTED"):
         # `pathway spawn --jax-distributed`: the host workers double as JAX
         # processes of one global device mesh (DCN between hosts) — must
         # run before any backend init
@@ -273,6 +275,7 @@ def run(
         import threading as _threading
 
         if _threading.current_thread() is _threading.main_thread():
+            # pathway-lint: context=signal
             def _usr1_dump(signum, frame):
                 _blackbox.record(
                     "watchdog.sigusr1", worker=config.process_id,
@@ -577,10 +580,9 @@ class _ProgressBeacon:
         if root is not None:
             from pathway_tpu.engine.persistence import writer_incarnation
             from pathway_tpu.engine.supervisor import ENV_EPOCH_DEADLINE
+            from pathway_tpu.internals.config import env_raw
 
-            if writer_incarnation() <= 0 and not os.environ.get(
-                ENV_EPOCH_DEADLINE
-            ):
+            if writer_incarnation() <= 0 and not env_raw(ENV_EPOCH_DEADLINE):
                 root = None
         self.path = (
             os.path.join(root, "lease", f"progress.{worker}")
@@ -623,6 +625,7 @@ def _epoch_instruments():
     return hist, _blackbox
 
 
+# pathway-lint: context=epoch
 def _event_loop(
     scope: df.Scope,
     lowerer: Lowerer,
@@ -757,6 +760,7 @@ def _event_loop(
         prober.update(done=True, epochs=result.epochs)
 
 
+# pathway-lint: context=epoch
 def _event_loop_coordinated(
     scope: df.Scope,
     lowerer: Lowerer,

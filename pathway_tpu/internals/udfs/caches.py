@@ -63,10 +63,11 @@ class DiskCache(CacheStrategy):
         # (context-local, so concurrent runs each see their own), then the
         # env override, then a local default
         from pathway_tpu.engine import persistence as pz
+        from pathway_tpu.internals.config import env_str
 
         root = (
             pz.active_root()
-            or os.environ.get("PATHWAY_PERSISTENT_STORAGE")
+            or env_str("PATHWAY_PERSISTENT_STORAGE")
             or ".pathway_tpu_cache"
         )
         return os.path.join(root, "udf_cache", self.name or "default")

@@ -509,7 +509,9 @@ class _JitModel:
         # PATHWAY_FUSED_ENCODER=0 falls back to the stock module lowering.
         # `_infer_params` is whatever tree `_apply` consumes, so weight
         # updates flow through `set_params` on either path.
-        self._fused = os.environ.get("PATHWAY_FUSED_ENCODER", "1") != "0"
+        from pathway_tpu.internals.config import env_bool, env_str
+
+        self._fused = env_bool("PATHWAY_FUSED_ENCODER")
         # PATHWAY_ENCODER_QUANTIZE=int8 (or quantize="int8") switches the
         # fused path to W8A8 matmuls — 2x the MXU peak on v5e-class chips,
         # embedding fidelity pinned by tests/test_quantized_encoder.py.
@@ -519,7 +521,7 @@ class _JitModel:
         env_q = (
             None
             if module_cls is CrossEncoderModule
-            else os.environ.get("PATHWAY_ENCODER_QUANTIZE")
+            else env_str("PATHWAY_ENCODER_QUANTIZE")
         )
         self._quantize = quantize or env_q or None
         if self._quantize not in (None, "int8"):

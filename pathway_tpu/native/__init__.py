@@ -155,7 +155,9 @@ def get():
     with _lock:
         if _loaded:
             return _module
-        if os.environ.get("PATHWAY_NATIVE", "1") == "0":
+        from pathway_tpu.internals.config import env_bool
+
+        if not env_bool("PATHWAY_NATIVE"):
             _module = None
         else:
             try:

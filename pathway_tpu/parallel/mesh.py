@@ -50,7 +50,9 @@ def initialize_distributed(
     if nproc <= 1:
         return False
     if coordinator_address is None:
-        coordinator_address = os.environ.get("PATHWAY_DEVICE_COORDINATOR")
+        from pathway_tpu.internals.config import env_str
+
+        coordinator_address = env_str("PATHWAY_DEVICE_COORDINATOR")
     if coordinator_address is None:
         host = (cfg.peer_hosts[0] if cfg.peer_hosts else "127.0.0.1")
         # supervised restarts (engine/supervisor.py) offset the derived

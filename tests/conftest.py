@@ -23,6 +23,11 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# the lint golden corpus holds deliberately-broken snippets (syntax
+# errors, fake chaos test files) for tests/test_static_analysis.py —
+# they are lint INPUT, never importable test modules
+collect_ignore_glob = ["lint_corpus/*"]
+
 
 @pytest.fixture(autouse=True)
 def clear_graph():

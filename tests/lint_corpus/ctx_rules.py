@@ -1,0 +1,46 @@
+"""Known-bad corpus: thread-context rules.
+
+Each marked line must produce exactly the finding named by its
+``# EXPECT:`` comment when this directory is linted explicitly
+(tests/test_static_analysis.py::test_golden_corpus).
+"""
+
+import threading
+import time
+
+
+# pathway-lint: context=epoch
+def epoch_loop_body():
+    time.sleep(1.0)  # EXPECT: ctx-blocking-call
+    return 7
+
+
+# pathway-lint: context=epoch
+def epoch_calls_helper():
+    # context propagation: the sleep is in the callee, flagged there
+    return _blocking_helper()
+
+
+def _blocking_helper():
+    time.sleep(0.5)  # EXPECT: ctx-blocking-call
+    return 1
+
+
+# pathway-lint: context=committer
+def committer_loop_body():
+    lock = threading.Lock()
+    lock.acquire()  # EXPECT: ctx-untimed-wait
+    lock.release()
+
+
+class SignalPath:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rlock = threading.RLock()
+
+    # pathway-lint: context=signal
+    def on_signal(self):
+        with self._lock:  # EXPECT: signal-unsafe-lock
+            pass
+        with self._rlock:  # reentrant: fine on a signal path
+            pass

@@ -1,0 +1,41 @@
+"""Known-bad corpus: jit recompile discipline."""
+
+import jax
+
+
+def model(x):
+    return x
+
+
+def immediate_call(x):
+    return jax.jit(model)(x)  # EXPECT: jit-immediate-call
+
+
+def wrapper_in_loop(batches):
+    out = []
+    for batch in batches:
+        fn = jax.jit(model)  # EXPECT: jit-in-loop
+        out.append(fn(batch))
+    return out
+
+
+def uncached_wrapper(x):
+    fn = jax.jit(model)  # EXPECT: jit-uncached-wrap
+    return fn(x)
+
+
+def nonhashable_static(x, cache):
+    fn = jax.jit(model, static_argnums=(1,))
+    cache["fn"] = fn  # durable sink: not an uncached-wrap finding
+    return fn(x, [1, 2, 3])  # EXPECT: jit-nonhashable-static
+
+
+class CachedOk:
+    def __init__(self):
+        # stored on self: compiled once per instance — must NOT be flagged
+        self._apply = jax.jit(model)
+
+
+def factory_ok():
+    # returned: the caller owns the cache — must NOT be flagged
+    return jax.jit(model)

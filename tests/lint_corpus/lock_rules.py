@@ -1,0 +1,24 @@
+"""Known-bad corpus: lock-order inversion and self-deadlock."""
+
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+
+
+def takes_a_then_b():
+    with LOCK_A:
+        with LOCK_B:  # EXPECT: lock-order
+            pass
+
+
+def takes_b_then_a():
+    with LOCK_B:
+        with LOCK_A:  # EXPECT: lock-order
+            pass
+
+
+def reacquires_plain_lock():
+    with LOCK_A:
+        with LOCK_A:  # EXPECT: lock-order
+            pass

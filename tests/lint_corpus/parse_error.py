@@ -1,0 +1,4 @@
+"""Known-bad corpus: a file that does not parse degrades to a finding."""
+
+def broken(:  # EXPECT: parse-error
+    pass

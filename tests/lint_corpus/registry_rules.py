@@ -1,0 +1,25 @@
+"""Known-bad corpus: env-knob and metric-name registry discipline."""
+
+import os
+
+
+def reads_declared_knob_directly():
+    # declared in ENV_KNOBS, but read outside the typed accessors
+    return os.environ.get("PATHWAY_CHECKPOINT_WRITERS")  # EXPECT: env-direct-read
+
+
+def reads_undeclared_knob():
+    return os.environ.get("PATHWAY_CORPUS_BOGUS_KNOB")  # EXPECT: env-undeclared,env-direct-read
+
+
+def registers_undeclared_metric(registry):
+    return registry.counter("corpus.bogus.total", "not in METRICS")  # EXPECT: metric-undeclared
+
+
+def registers_wrong_kind(registry):
+    # declared as a histogram in engine/metrics.py:METRICS
+    return registry.counter("epoch.duration.ms", "kind mismatch")  # EXPECT: metric-undeclared
+
+
+def registers_computed_name(registry, suffix):
+    return registry.gauge("corpus." + suffix, "unresolvable name")  # EXPECT: metric-nonliteral

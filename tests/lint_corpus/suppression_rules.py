@@ -1,0 +1,29 @@
+"""Known-bad corpus: the suppression audit itself.
+
+Marker scheme note: ``# EXPECT-BELOW`` sits one line above the expected
+finding — a marker ON a suppression comment line would parse as part of
+the suppression's reason.
+"""
+
+import time
+
+
+# pathway-lint: context=epoch
+def suppressed_with_reason():
+    # pathway-lint: disable=ctx-blocking-call — corpus: a valid, used suppression
+    time.sleep(1.0)  # silenced: must appear in report.suppressed, not findings
+
+
+# pathway-lint: context=epoch
+def suppressed_without_reason():
+    # EXPECT-BELOW: bad-suppression
+    # pathway-lint: disable=ctx-blocking-call
+    time.sleep(1.0)
+
+
+def unknown_rule_name():
+    return 1  # pathway-lint: disable=not-a-real-rule — nonsense id  # EXPECT: bad-suppression
+
+
+def silences_nothing():
+    return 2  # pathway-lint: disable=lock-order — nothing here acquires locks  # EXPECT: unused-suppression

@@ -1,0 +1,32 @@
+"""Known-bad corpus: chaos-suite sleep policy.
+
+Named like a real chaos test file (the rule keys on the basename); the
+conftest collect_ignore keeps pytest from importing it.
+"""
+
+import time
+
+ROW_DELAY_S = 0.03
+LONG_DELAY_S = 0.75
+
+
+def settle_by_sleeping():
+    time.sleep(1.0)  # EXPECT: chaos-bounded-sleep
+
+
+def sleeps_via_module_constant():
+    time.sleep(LONG_DELAY_S)  # EXPECT: chaos-bounded-sleep
+
+
+def paces_rows_ok():
+    time.sleep(ROW_DELAY_S)  # pacing <= 0.05s: fine
+
+
+def polls_ok(done):
+    while not done():
+        time.sleep(0.2)  # poll step: the loop condition decides
+
+
+def bounded_window_ok():
+    # chaos-lint: bounded-window
+    time.sleep(0.5)

@@ -1,19 +1,25 @@
 """Repo lint: the chaos suite must not synchronize on ``time.sleep``.
 
-The supervised-recovery and fault-injection tests pin interleavings that
-genuinely matter (crash after N generations, zombie publish after the
-lease bump).  On the noisy shared-tenant CI rig, any "sleep long enough
-and hope" synchronization turns those into flakes — the repo convention
-is to GATE on on-disk state instead (the ``_gated_scenario`` pattern:
-poll a manifest directory / the lease file under a deadline).
+THIN WRAPPER — the rule body migrated into the static-analysis framework
+as the first-class ``chaos-bounded-sleep`` rule
+(``pathway_tpu/analysis/chaos.py``), where ``pathway_tpu lint`` and the
+tier-1 gate (``tests/test_static_analysis.py``) run it over the whole
+tree.  This file stays so the suite's history remains bisectable: the
+test name and the behavior it pins are unchanged from PR 5.
 
-This lint walks the chaos test files' ASTs and rejects every
-``*.sleep(...)`` call unless it is one of:
+The policy (enforced by the rule, documented here as before): the
+supervised-recovery and fault-injection tests pin interleavings that
+genuinely matter; on the noisy shared-tenant CI rig, any "sleep long
+enough and hope" synchronization turns those into flakes — the repo
+convention is to GATE on on-disk state instead (the ``_gated_scenario``
+pattern).  Every ``*.sleep(...)`` call in a chaos test file is rejected
+unless it is one of:
 
 * a **poll step inside a ``while`` loop** — the gated-wait idiom (the
   loop condition, not the sleep, decides when to proceed);
-* a **pacing sleep** with a constant argument ≤ 0.05 s (row emission
-  pacing; small enough to never be a hidden synchronization window);
+* a **pacing sleep** with a constant (or module-constant) argument
+  ≤ 0.05 s (row emission pacing; small enough to never be a hidden
+  synchronization window);
 * explicitly annotated ``# chaos-lint: bounded-window`` on the call line
   or the two lines above — a deliberate, documented observation window
   (asserting something does NOT happen within it), never a wait for
@@ -22,103 +28,28 @@ This lint walks the chaos test files' ASTs and rejects every
 
 from __future__ import annotations
 
-import ast
 import os
+
+from pathway_tpu.analysis import chaos
+from pathway_tpu.analysis.core import SourceFile
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
-CHAOS_FILES = (
-    "test_supervised_recovery.py",
-    "test_fault_injection.py",
-    "test_checkpoint_integrity.py",
-    "test_observability.py",
-    "test_fencing_watchdog.py",
-)
-
-PACING_MAX_S = 0.05
-MARKER = "chaos-lint: bounded-window"
-
-
-def _module_constants(tree: ast.Module) -> dict[str, float]:
-    """Module-level numeric assignments (ROW_DELAY_S = 0.03 and friends)."""
-    out: dict[str, float] = {}
-    for node in tree.body:
-        if isinstance(node, ast.Assign) and isinstance(
-            node.value, ast.Constant
-        ):
-            value = node.value.value
-            if isinstance(value, (int, float)) and not isinstance(value, bool):
-                for target in node.targets:
-                    if isinstance(target, ast.Name):
-                        out[target.id] = float(value)
-    return out
-
-
-def _sleep_calls(tree: ast.Module):
-    """Yield (call node, inside_while) for every ``<x>.sleep(...)``."""
-    parents: dict[ast.AST, ast.AST] = {}
-    for node in ast.walk(tree):
-        for child in ast.iter_child_nodes(node):
-            parents[child] = node
-    for node in ast.walk(tree):
-        if not (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr == "sleep"
-        ):
-            continue
-        inside_while = False
-        cursor: ast.AST | None = node
-        while cursor is not None:
-            cursor = parents.get(cursor)
-            if isinstance(cursor, ast.While):
-                inside_while = True
-                break
-            if isinstance(
-                cursor, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
-            ):
-                # a while loop in an ENCLOSING function does not make this
-                # sleep a poll step of it
-                break
-        yield node, inside_while
-
-
-def _constant_arg(call: ast.Call, constants: dict[str, float]) -> float | None:
-    if len(call.args) != 1:
-        return None
-    arg = call.args[0]
-    if isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float)):
-        return float(arg.value)
-    if isinstance(arg, ast.Name):
-        return constants.get(arg.id)
-    return None
+# re-exported for older debugging workflows: the rule module owns the
+# authoritative constants now
+CHAOS_FILES = chaos.CHAOS_FILES
+PACING_MAX_S = chaos.PACING_MAX_S
+MARKER = chaos.MARKER
 
 
 def test_chaos_suite_never_synchronizes_on_sleep():
     violations: list[str] = []
     for name in CHAOS_FILES:
         path = os.path.join(HERE, name)
-        with open(path) as f:
+        with open(path, encoding="utf-8") as f:
             source = f.read()
-        lines = source.splitlines()
-        tree = ast.parse(source, filename=name)
-        constants = _module_constants(tree)
-        for call, inside_while in _sleep_calls(tree):
-            if inside_while:
-                continue  # gated poll step: the loop condition decides
-            value = _constant_arg(call, constants)
-            if value is not None and value <= PACING_MAX_S:
-                continue  # row pacing, too short to hide a wait
-            window = lines[max(0, call.lineno - 3) : call.lineno]
-            if any(MARKER in line for line in window):
-                continue  # documented bounded observation window
-            violations.append(
-                f"{name}:{call.lineno}: bare sleep"
-                f"({ast.unparse(call.args[0]) if call.args else ''}) — "
-                "gate on on-disk state (while-loop poll) instead, or pace "
-                f"with a constant <= {PACING_MAX_S}s, or annotate "
-                f"`# {MARKER}`"
-            )
+        file = SourceFile(path, name, source)
+        violations.extend(f.render() for f in chaos.check_file(file))
     assert not violations, (
         "time.sleep-based synchronization in the chaos suite:\n  "
         + "\n  ".join(violations)
