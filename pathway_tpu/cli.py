@@ -493,6 +493,14 @@ def blackbox(worker, tail, as_json, root):
                     f"    {when(ev.get('ts'))}  #{str(ev.get('seq', '?')):>5}  "
                     f"{str(ev.get('kind', '?')):<22}{detail}"
                 )
+            profile = payload.get("profiler")
+            if profile:
+                # where the time went, not just what happened: the final
+                # profiler snapshot captured at dump time
+                from pathway_tpu.engine.profiler import render_snapshot
+
+                for line in render_snapshot(profile).splitlines():
+                    click.echo(f"  {line}")
     sys.exit(0)
 
 
@@ -564,6 +572,227 @@ def lint(as_json, rule_ids, list_rules, update_config_docs, paths):
         sys.exit(2)
     click.echo(report_to_text(report, as_json=as_json))
     sys.exit(0 if report.ok else 1)
+
+
+@cli.command()
+@click.option(
+    "--top",
+    metavar="N",
+    type=click.IntRange(min=1),
+    default=None,
+    help="operators to show (default: the PATHWAY_PROFILE_TOP knob)",
+)
+@click.option(
+    "--json", "as_json", is_flag=True, help="emit the raw snapshot(s) as JSON"
+)
+@click.argument("source", type=click.Path(exists=True))
+def profile(top, as_json, source):
+    """Render a per-operator attribution tree from profiler output.
+
+    SOURCE is either a profiler snapshot JSON (written at run end when
+    ``PATHWAY_PROFILE=1`` and ``PATHWAY_PROFILE_OUTPUT=<path>`` are set)
+    or a filesystem persistence root, whose flight-recorder dumps under
+    ``blackbox/`` carry final profiler snapshots (see
+    ``docs/observability.md``).  Exits non-zero when SOURCE holds no
+    profile.
+    """
+    import json as _json
+
+    from pathway_tpu.engine.profiler import render_snapshot
+    from pathway_tpu.internals.config import env_int
+
+    top = top or env_int("PATHWAY_PROFILE_TOP")
+    snapshots: list[tuple[str, dict]] = []
+    if os.path.isdir(source):
+        from pathway_tpu.engine.flight_recorder import gather_dumps
+
+        for wid, payloads in sorted(gather_dumps(source).items()):
+            for payload in payloads:
+                prof = payload.get("profiler")
+                if prof:
+                    snapshots.append(
+                        (f"worker {wid} · attempt {payload.get('attempt')}",
+                         prof)
+                    )
+    else:
+        try:
+            with open(source, encoding="utf-8") as f:
+                payload = _json.load(f)
+        except (OSError, ValueError) as exc:
+            click.echo(f"[pathway_tpu] unreadable snapshot: {exc}", err=True)
+            sys.exit(2)
+        # tolerate any JSON top level (the command's own --json output is
+        # a list) — anything without a snapshot dict falls through to the
+        # friendly no-profile exit below
+        prof = (
+            payload.get("profiler", payload)
+            if isinstance(payload, dict)
+            else None
+        )
+        if isinstance(prof, dict) and "operators" in prof:
+            snapshots.append((source, prof))
+    if not snapshots:
+        click.echo(
+            f"[pathway_tpu] no profiler snapshot in {source} — run with "
+            "PATHWAY_PROFILE=1 (and PATHWAY_PROFILE_OUTPUT=<path>, or read "
+            "a persistence root with flight-recorder dumps)",
+            err=True,
+        )
+        sys.exit(1)
+    if as_json:
+        # a list, not a dict: one worker/attempt can leave several dumps
+        # (watchdog + crash) whose labels collide — none may be dropped
+        click.echo(
+            _json.dumps(
+                [
+                    {"label": label, "snapshot": snap}
+                    for label, snap in snapshots
+                ],
+                indent=2,
+                sort_keys=True,
+            )
+        )
+        sys.exit(0)
+    for label, snap in snapshots:
+        if len(snapshots) > 1:
+            click.echo(label)
+        click.echo(render_snapshot(snap, top=top))
+    sys.exit(0)
+
+
+def _load_harness():
+    """Import ``benchmarks/harness.py`` by path (the benchmarks tree sits
+    beside the package, not inside it)."""
+    import importlib.util
+
+    pkg_dir = os.path.dirname(os.path.abspath(pw.__file__))
+    path = os.path.join(os.path.dirname(pkg_dir), "benchmarks", "harness.py")
+    if not os.path.isfile(path):
+        raise click.ClickException(
+            f"benchmark harness not found at {path} (the `bench` command "
+            "needs the repository's benchmarks/ tree)"
+        )
+    name = "pathway_bench_harness"
+    if name in sys.modules:
+        return sys.modules[name]
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    # registered before exec: dataclass decorators resolve their module
+    # through sys.modules
+    sys.modules[name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+@cli.command()
+@click.option(
+    "--smoke/--full",
+    "smoke",
+    default=True,
+    help="suite scale: smoke (small sizes, tier-1-friendly) or full",
+)
+@click.option(
+    "--check",
+    is_flag=True,
+    help="compare against committed baselines (benchmarks/baselines/); "
+    "exit non-zero on a regression past the noise-tolerant thresholds",
+)
+@click.option(
+    "--update-baselines",
+    is_flag=True,
+    help="write this run's medians/IQR as the new baselines",
+)
+@click.option(
+    "--update-results",
+    is_flag=True,
+    help="regenerate the harness tables in benchmarks/RESULTS.md",
+)
+@click.option("--reps", metavar="N", type=click.IntRange(min=1), default=None,
+              help="repetitions per benchmark (default: per-mode)")
+@click.option("--only", metavar="NAME", multiple=True,
+              help="run only these benchmarks (repeatable)")
+@click.option("--baseline-dir", metavar="PATH", type=str, default=None,
+              help="baseline directory override")
+@click.option("--json", "json_path", metavar="PATH", type=str, default=None,
+              help="also write the machine-readable results JSON here")
+def bench(smoke, check, update_baselines, update_results, reps, only,
+          baseline_dir, json_path):
+    """Run the benchmark suite and check for regressions.
+
+    Runs the repository's host benchmarks (``benchmarks/host_*.py`` and
+    friends) in smoke or full mode, reports per-metric medians + IQR with
+    an environment fingerprint, and — with ``--check`` — compares against
+    the committed baselines with noise-tolerant thresholds (see
+    ``docs/benchmarking.md``).
+    """
+    harness = _load_harness()
+    mode = "smoke" if smoke else "full"
+    # the check must compare against the PREVIOUSLY committed baseline,
+    # loaded before the suite runs (fail fast: a missing baseline should
+    # not cost minutes of benchmarking first) and before
+    # --update-baselines overwrites it — otherwise `--update-baselines
+    # --check` would compare the run against itself and bless any
+    # regression
+    try:
+        prior_baseline = (
+            harness.load_baseline(mode, baseline_dir=baseline_dir)
+            if check
+            else None
+        )
+        if check and prior_baseline is None and not update_baselines:
+            click.echo(
+                f"[pathway_tpu] no committed baseline for mode {mode!r} — "
+                "run `pathway_tpu bench --update-baselines` first",
+                err=True,
+            )
+            sys.exit(2)
+        results = harness.run_suite(
+            mode=mode, reps=reps, only=list(only) or None, echo=click.echo
+        )
+        if json_path:
+            harness.write_results(results, json_path)
+            click.echo(
+                f"[pathway_tpu] results written to {json_path}", err=True
+            )
+        # the regression check runs BEFORE any baseline/RESULTS update: a
+        # failing check must leave the committed files untouched, or a
+        # simple re-run of the same command would report OK against the
+        # freshly blessed regression
+        report = (
+            harness.compare(results, prior_baseline)
+            if check and prior_baseline is not None
+            else None
+        )
+        if report is not None and not report["ok"]:
+            click.echo(harness.render_report(report))
+            click.echo(
+                "[pathway_tpu] regression detected — baseline/RESULTS "
+                "updates skipped (fix or re-anchor deliberately)",
+                err=True,
+            )
+            sys.exit(1)
+        if update_baselines:
+            path = harness.update_baseline(results, baseline_dir=baseline_dir)
+            click.echo(f"[pathway_tpu] baseline written to {path}", err=True)
+        if update_results:
+            path = harness.update_results_md(results)
+            click.echo(
+                f"[pathway_tpu] results table updated in {path}", err=True
+            )
+    except harness.HarnessError as exc:
+        raise click.ClickException(str(exc)) from exc
+    if not check:
+        sys.exit(0)
+    if report is None:
+        # bootstrap: no prior baseline existed; this run just created
+        # the first one, so there is nothing to regress against
+        click.echo(
+            "[pathway_tpu] bench check: OK (bootstrap — baseline "
+            "created by this run; future runs check against it)"
+        )
+        sys.exit(0)
+    click.echo(harness.render_report(report))
+    sys.exit(0)
 
 
 @cli.command(name="spawn-from-env")

@@ -62,6 +62,10 @@ class FlightRecorder:
         # (the supervisor's post-mortem gather would misattribute it)
         self.incarnation = 0
         self._dumped: str | None = None  # path of the last dump, if any
+        # optional profiler supplier (engine/profiler.py): when set, every
+        # dump carries a final top-N operator attribution snapshot, so a
+        # post-mortem says where the time went, not just what happened
+        self._profile_supplier: Any = None
 
     # -- recording ---------------------------------------------------------
     def record(self, kind: str, **fields: Any) -> None:
@@ -105,6 +109,13 @@ class FlightRecorder:
             if incarnation is not None:
                 self.incarnation = incarnation
 
+    def set_profile_supplier(self, fn: Any) -> None:
+        """Attach (or clear, with ``None``) the callable whose snapshot
+        dict rides every subsequent dump under the ``profiler`` key.  The
+        runner sets it for the run's lifetime and clears it on exit, so
+        the global recorder never outlives a run's node arena."""
+        self._profile_supplier = fn
+
     # -- dumping -----------------------------------------------------------
     def dump(self, reason: str, *, suffix: str | None = None) -> str | None:
         """Write the ring to ``<root>/blackbox/worker-<id>.attempt-<n>.json``
@@ -137,6 +148,16 @@ class FlightRecorder:
                 "dumped_at": time.time(),
                 "events": list(self._ring),
             }
+            supplier = self._profile_supplier
+        if supplier is not None:
+            # outside the lock (the supplier scans the node arena) and
+            # never fatal: a dump without a profile beats no dump
+            try:
+                profile = supplier()
+            except Exception:  # noqa: BLE001 - forensics must never fail
+                profile = None
+            if profile:
+                payload["profiler"] = profile
         if payload["incarnation"] and self._fenced(
             root, payload["incarnation"], payload["worker"]
         ):

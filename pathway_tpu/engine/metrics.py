@@ -67,6 +67,20 @@ DEFAULT_BUCKETS = (
     500.0, 1000.0, 5000.0,
 )
 
+# Millisecond-scale bounds for the epoch/commit/profiler histograms: host
+# epochs and manifest publishes cluster in 0.1–100 ms, where the default
+# bounds collapse everything into two buckets and flatten the quantile
+# estimates derived from them (Histogram.quantile).
+MS_BUCKETS = (
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+    500.0, 1000.0, 2500.0, 5000.0, 10000.0,
+)
+
+# Quantiles derived from every histogram's fixed buckets at read time,
+# surfaced as synthetic gauges (`<name>.p50` …) in the Prometheus
+# exposition, OTLP export, and the console dashboard footer.
+QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
+
 # ---------------------------------------------------------------------------
 # The declared metric-name registry.
 #
@@ -97,6 +111,8 @@ METRICS: dict[str, tuple[str, str]] = {
         "gauge", "max seconds since any live peer was last heard from"),
     # epoch loop / dataflow (internals/runner.py, engine/probes.py)
     "epoch.duration.ms": ("histogram", "wall time of one processed epoch (ms)"),
+    "commit.duration.ms": (
+        "histogram", "wall time of one generation-manifest publish (ms)"),
     "dataflow.prober": ("collector", "dataflow progress totals supplier"),
     "dataflow.epochs": ("gauge", "epochs processed by this worker"),
     "dataflow.input.rows": ("gauge", "rows ingested across input nodes"),
@@ -138,6 +154,26 @@ METRICS: dict[str, tuple[str, str]] = {
         "gauge", "supervisor restarts performed before this worker launch"),
     "worker.last_progress.age_s": (
         "gauge", "seconds since the worker's last epoch-progress beacon"),
+    # per-operator epoch profiler (engine/profiler.py)
+    "profiler.operators": (
+        "collector", "top-N per-operator attribution snapshot supplier"),
+    "profiler.operator.seconds": (
+        "gauge", "cumulative step seconds of a top-N operator"),
+    "profiler.operator.rows": (
+        "gauge", "cumulative rows consumed by a top-N operator"),
+    "profiler.epochs.sampled": (
+        "gauge", "profiler sampling passes taken this run"),
+    # JAX device accounting (engine/profiler.py jax.monitoring listeners)
+    "jax.compile.count": (
+        "counter", "XLA backend compilations observed in this process"),
+    "jax.compile.seconds": (
+        "counter", "cumulative XLA backend compile wall seconds"),
+    "jax.cache.miss": (
+        "counter", "jit cache misses (fresh jaxpr traces) observed"),
+    "jax.transfer.h2d.bytes": (
+        "counter", "explicit host-to-device transfer bytes (device_put)"),
+    "jax.transfer.d2h.bytes": (
+        "counter", "explicit device-to-host transfer bytes (device_get)"),
     # telemetry (engine/telemetry.py)
     "telemetry.export.dropped": (
         "counter", "telemetry payloads dropped by the bounded export queue"),
@@ -236,6 +272,24 @@ class Histogram:
         """(bounds, per-interval counts, sum, count) — a consistent read."""
         with self._lock:
             return self._bounds, list(self._counts), self._sum, self._count
+
+    def quantile(self, q: float) -> float | None:
+        """Estimate the ``q``-quantile from the fixed buckets (linear
+        interpolation within the holding bucket — Prometheus
+        ``histogram_quantile`` semantics).  Observations in the +Inf
+        bucket clamp to the highest finite bound; ``None`` when empty."""
+        bounds, counts, _total, n = self.snapshot()
+        if n == 0 or not bounds:
+            return None
+        rank = q * n
+        cum = 0
+        lo = 0.0
+        for bound, c in zip(bounds, counts):
+            if c and cum + c >= rank:
+                return lo + (rank - cum) / c * (bound - lo)
+            cum += c
+            lo = bound
+        return float(bounds[-1])
 
 
 class _Family:
@@ -404,7 +458,9 @@ class MetricsRegistry:
         """Flat ``{name[{labels}]: value}`` of counters/gauges + collector
         output — the form the OTLP gauge exporter and the dashboard eat.
         Labeled children get a ``name{k=v,...}`` suffix so distinct label
-        sets stay distinct."""
+        sets stay distinct.  Histogram quantile estimates ride along as
+        derived ``<name>.p50/.p95/.p99`` gauges, so every scalar surface
+        (OTLP, dashboard) sees latency percentiles for free."""
         out: dict[str, float] = {}
         with self._lock:
             families = list(self._families.values())
@@ -417,7 +473,27 @@ class MetricsRegistry:
                     out[f"{fam.name}{{{label_str}}}"] = child.value
                 else:
                     out[fam.name] = child.value
+        out.update(self.histogram_quantiles())
         out.update(self.collect())
+        return out
+
+    def histogram_quantiles(self) -> dict[str, float]:
+        """Derived ``{name.pXX[{labels}]: value}`` gauges for every
+        non-empty histogram child (see :data:`QUANTILES`)."""
+        out: dict[str, float] = {}
+        with self._lock:
+            families = [f for f in self._families.values() if f.kind == "histogram"]
+        for fam in families:
+            for key, child in fam.items():
+                for suffix, q in QUANTILES:
+                    value = child.quantile(q)
+                    if value is None:
+                        continue
+                    name = f"{fam.name}.{suffix}"
+                    if key:
+                        label_str = ",".join(f"{k}={v}" for k, v in key)
+                        name = f"{name}{{{label_str}}}"
+                    out[name] = value
         return out
 
     def histogram_points(self) -> list[dict[str, Any]]:
@@ -478,15 +554,48 @@ class MetricsRegistry:
                     lines.append(
                         f"{prom}{label_str} {_format_value(child.value)}"
                     )
+            if fam.kind == "histogram":
+                # bucket-derived quantile gauges, one synthetic family per
+                # quantile — scrapers that can't run histogram_quantile()
+                # (and the dashboard footer) read percentiles directly
+                for suffix, q in QUANTILES:
+                    qsamples = [
+                        (key, child.quantile(q)) for key, child in items
+                    ]
+                    qsamples = [(k, v) for k, v in qsamples if v is not None]
+                    if not qsamples:
+                        continue
+                    lines.append(
+                        f"# HELP {prom}_{suffix} {suffix} estimate of "
+                        f"{fam.help or fam.name}"
+                    )
+                    lines.append(f"# TYPE {prom}_{suffix} gauge")
+                    for key, value in qsamples:
+                        lines.append(
+                            f"{prom}_{suffix}{_prom_labels(key + extra)} "
+                            f"{_format_value(value)}"
+                        )
         collected = self.collect()
         if collected:
+            # collector keys may carry a "{k=v,...}" label suffix (the
+            # profiler's per-operator gauges do): split it into real
+            # Prometheus labels — mangling it into the metric NAME would
+            # mint a new family per label set (unbounded name cardinality)
+            grouped: dict[str, list[tuple[tuple, float]]] = {}
             for name in sorted(collected):
-                prom = _prom_name(name)
-                lines.append(f"# HELP {prom} {name}")
-                lines.append(f"# TYPE {prom} gauge")
-                lines.append(
-                    f"{prom}{_prom_labels(extra)} {_format_value(collected[name])}"
+                base, labels = split_labeled_name(name)
+                grouped.setdefault(base, []).append(
+                    (_label_key(labels), collected[name])
                 )
+            for base, samples in grouped.items():
+                prom = _prom_name(base)
+                lines.append(f"# HELP {prom} {base}")
+                lines.append(f"# TYPE {prom} gauge")
+                for key, value in samples:
+                    lines.append(
+                        f"{prom}{_prom_labels(key + extra)} "
+                        f"{_format_value(value)}"
+                    )
         return "\n".join(lines) + ("\n" if lines else "")
 
     # -- OTLP mapping ------------------------------------------------------

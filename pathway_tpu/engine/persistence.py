@@ -1234,6 +1234,16 @@ class PersistentStorage:
         _registry.get_registry().register_collector(
             f"persistence.worker{worker}", self.metrics.snapshot
         )
+        # per-publish wall time on ms-scale bounds: the quantile estimates
+        # (commit.duration.ms.p95 etc., engine/metrics.py) need buckets
+        # that resolve the 0.1-100 ms publishes the pipelined committer
+        # actually produces
+        self._commit_hist = _registry.get_registry().histogram(
+            "commit.duration.ms",
+            "wall time of one generation-manifest publish (ms)",
+            buckets=_registry.MS_BUCKETS,
+            worker=worker,
+        )
         writers = _checkpoint_writers()
         self._pool: _WriterPool | None = (
             _WriterPool(
@@ -1835,6 +1845,7 @@ class PersistentStorage:
         rate-limit the two best-effort follow-ups (both are advisory /
         deferred by contract; a lagging pointer or a temporarily oversized
         retention window changes no recovery semantics)."""
+        _publish_t0 = _time.perf_counter()
         # chaos hook: a `zombie` fault wedges this publish until the lease
         # is superseded, modelling a stale writer publishing late (lazy
         # import keeps persistence ↔ faults acyclic at module load)
@@ -1905,6 +1916,9 @@ class PersistentStorage:
         if run_gc:
             self._last_gc = _time.monotonic()
             self._gc_generations()
+        self._commit_hist.observe(
+            (_time.perf_counter() - _publish_t0) * 1000.0
+        )
 
     def _verify_current_generation(self) -> bool:
         """Read back the just-committed generation and deep-verify it (with

@@ -1,0 +1,348 @@
+"""Performance observability: per-operator epoch profiler + JAX accounting.
+
+Two measurement surfaces the perf arc (DeviceExecutor batching, columnar
+hot path, serving loop — see ROADMAP.md) is pinned on:
+
+* **Per-operator epoch profiler** (:class:`EpochProfiler`).  The epoch
+  loop already stamps every operator step with a monotonic timer and row
+  counters (``engine/dataflow.py:Scope.run_epoch`` accumulates
+  ``node.step_seconds`` / ``rows_in`` / ``rows_out``); the profiler turns
+  those always-on counters into top-N attribution snapshots at a sampled
+  cadence — *where the epoch time went*, by operator.  Snapshots export
+  through the unified metrics registry (``profiler.operator.*``), ride
+  crash flight-recorder dumps (``engine/flight_recorder.py``), land in a
+  ``PATHWAY_PROFILE_OUTPUT`` JSON at run end, and render as a tree via
+  ``pathway_tpu profile``.  Sampling is gated by the ``PATHWAY_PROFILE_*``
+  knob family so steady-state overhead is one modulo test per epoch when
+  off-cadence and a plain attribute scan (no locks, no allocation per
+  node beyond the snapshot list) every ``PATHWAY_PROFILE_SAMPLE_EVERY``
+  epochs — priced by ``benchmarks/profiler_overhead.py``.
+
+* **JAX device accounting** (:func:`install_jax_accounting`).  The
+  dynamic half of the "recompile-count == 0 in steady state" pin whose
+  static half is ``pathway_tpu lint``'s jit rules (``analysis/jit.py``):
+  ``jax.monitoring`` listeners count every fresh jaxpr trace
+  (``jax.cache.miss`` — a jit cache hit traces nothing), every XLA
+  backend compilation (``jax.compile.count``) and its wall seconds
+  (``jax.compile.seconds``).  A steady-state epoch loop feeding warm
+  bucketed shapes must hold ``jax.cache.miss`` flat — pinned by
+  ``tests/test_jax_accounting.py``.  Explicit host<->device transfer
+  bytes (``jax.transfer.*``) are counted by opt-in wrappers around
+  ``jax.device_put``/``jax.device_get`` (``PATHWAY_PROFILE_TRANSFERS``);
+  transfers implicit in jit dispatch are invisible to the host layer and
+  stay out of scope.
+
+Listeners and counters register into the process-wide registry
+(``engine/metrics.py``), so the profile rides every surface the rest of
+the observability stack already has: ``/metrics`` scrapes, OTLP export,
+and the console dashboard footer (p95 epoch latency + compile count).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from pathway_tpu.engine import metrics as _metrics
+
+__all__ = [
+    "EpochProfiler",
+    "install_jax_accounting",
+    "install_transfer_accounting",
+    "uninstall_transfer_accounting",
+    "render_snapshot",
+]
+
+# jax.monitoring event names this build observes (jax 0.4.x; a renamed
+# event in a future jax simply stops matching — counters hold at zero
+# rather than breaking the run)
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+class EpochProfiler:
+    """Sampled top-N per-operator attribution over a running dataflow.
+
+    One instance per run (the runner keeps it on ``RunResult.profiler``;
+    the registry collector holds it weakly, so it dies with the result).
+    ``on_epoch`` is the epoch-loop hook: a cheap cadence gate, then an
+    attribute scan over the node arena — never a lock, never I/O.
+    """
+
+    def __init__(
+        self,
+        *,
+        enabled: bool | None = None,
+        sample_every: int | None = None,
+        top_n: int | None = None,
+        output_path: str | None = None,
+    ):
+        from pathway_tpu.internals.config import env_bool, env_int, env_str
+
+        self.enabled = (
+            env_bool("PATHWAY_PROFILE") if enabled is None else bool(enabled)
+        )
+        every = (
+            env_int("PATHWAY_PROFILE_SAMPLE_EVERY")
+            if sample_every is None
+            else sample_every
+        )
+        self.sample_every = max(1, int(every or 1))
+        top = env_int("PATHWAY_PROFILE_TOP") if top_n is None else top_n
+        self.top_n = max(1, int(top or 1))
+        self.output_path = (
+            env_str("PATHWAY_PROFILE_OUTPUT")
+            if output_path is None
+            else output_path
+        )
+        self.epochs_sampled = 0
+        self._snapshot: dict[str, Any] | None = None
+
+    # -- epoch-loop hook ---------------------------------------------------
+    def on_epoch(self, scope: Any, epochs: int) -> None:
+        """Called after every processed epoch; samples on cadence only."""
+        if not self.enabled or epochs % self.sample_every:
+            return
+        self.sample(scope, epochs)
+
+    def sample(self, scope: Any, epochs: int) -> dict[str, Any]:
+        """Aggregate the node arena's cumulative counters into a top-N
+        snapshot.  Reads plain attributes only — safe from the epoch
+        thread and (for crash snapshots) from a signal handler."""
+        ranked: list[Any] = sorted(
+            scope.nodes, key=lambda n: n.step_seconds, reverse=True
+        )
+        total = sum(n.step_seconds for n in ranked)
+        operators = [
+            {
+                "id": node.id,
+                "name": getattr(node, "name", None) or "node",
+                "seconds": node.step_seconds,
+                "share": (node.step_seconds / total) if total else 0.0,
+                "rows_in": node.rows_in,
+                "rows_out": node.rows_out,
+                "inputs": [inp.id for inp in node.inputs],
+            }
+            for node in ranked[: self.top_n]
+        ]
+        self.epochs_sampled += 1
+        self._snapshot = {
+            "epochs": epochs,
+            "operators_total": len(ranked),
+            "total_step_seconds": total,
+            "operators": operators,
+        }
+        return self._snapshot
+
+    def crash_snapshot(self, scope: Any) -> dict[str, Any] | None:
+        """A fresh snapshot for post-mortems, regardless of the sampling
+        gate — the underlying timers are always on, so a crash dump can
+        always say where the time went.  Never raises (forensics)."""
+        try:
+            return self.sample(scope, getattr(scope, "epochs_run", 0))
+        except Exception:  # noqa: BLE001 - a dying process must still dump
+            return self._snapshot
+
+    @property
+    def snapshot(self) -> dict[str, Any] | None:
+        return self._snapshot
+
+    # -- exports -----------------------------------------------------------
+    def metrics_snapshot(self) -> dict[str, float]:
+        """Registry collector: the latest snapshot's top-N as labeled
+        gauges (bounded cardinality — only sampled leaders export)."""
+        snap = self._snapshot
+        if snap is None:
+            return {}
+        out: dict[str, float] = {
+            "profiler.epochs.sampled": float(self.epochs_sampled),
+        }
+        for op in snap["operators"]:
+            labels = f"id={op['id']},operator={op['name']}"
+            out[f"profiler.operator.seconds{{{labels}}}"] = op["seconds"]
+            out[f"profiler.operator.rows{{{labels}}}"] = float(op["rows_in"])
+        return out
+
+    def write_output(self) -> str | None:
+        """Persist the final snapshot to ``PATHWAY_PROFILE_OUTPUT``;
+        best-effort (a failed profile write must never fail the run)."""
+        if not self.output_path or self._snapshot is None:
+            return None
+        try:
+            tmp = f"{self.output_path}.tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(self._snapshot, f, indent=2, sort_keys=True)
+            os.replace(tmp, self.output_path)
+            return self.output_path
+        except OSError:
+            return None
+
+
+# ---------------------------------------------------------------------------
+# JAX accounting: compile / jit-cache / transfer counters
+# ---------------------------------------------------------------------------
+
+_jax_installed = False
+_orig_device_put = None
+_orig_device_get = None
+
+
+def install_jax_accounting(force: bool = False) -> bool:
+    """Register ``jax.monitoring`` listeners feeding the unified registry.
+
+    Idempotent and process-global (jax offers no per-run listener scope);
+    the listeners bind registry children once, so the per-event cost is a
+    string compare + a guarded float add.  Gated by ``PATHWAY_PROFILE_JAX``
+    unless ``force`` (tests).  Returns whether accounting is active.
+    """
+    global _jax_installed
+    if _jax_installed:
+        return True
+    if not force:
+        from pathway_tpu.internals.config import env_bool
+
+        if not env_bool("PATHWAY_PROFILE_JAX"):
+            return False
+    try:
+        from jax import monitoring as _jm
+    except Exception:  # noqa: BLE001 - no jax, no device accounting
+        return False
+    reg = _metrics.get_registry()
+    cache_miss = reg.counter(
+        "jax.cache.miss", "jit cache misses (fresh jaxpr traces) observed"
+    )
+    compile_count = reg.counter(
+        "jax.compile.count", "XLA backend compilations observed"
+    )
+    compile_seconds = reg.counter(
+        "jax.compile.seconds", "cumulative XLA backend compile wall seconds"
+    )
+
+    def _on_duration(event: str, duration: float, **_kw: Any) -> None:
+        # one compare per event kind; monitoring fires only on cache
+        # misses and compiles, so steady state pays nothing at all
+        if event == _TRACE_EVENT:
+            cache_miss.inc()
+        elif event == _BACKEND_COMPILE_EVENT:
+            compile_count.inc()
+            compile_seconds.inc(duration)
+
+    _jm.register_event_duration_secs_listener(_on_duration)
+    _jax_installed = True
+    return True
+
+
+def _tree_nbytes(value: Any) -> int:
+    try:
+        import jax
+
+        return sum(
+            int(getattr(leaf, "nbytes", 0) or 0)
+            for leaf in jax.tree_util.tree_leaves(value)
+        )
+    except Exception:  # noqa: BLE001 - accounting must never break a put
+        return 0
+
+
+def install_transfer_accounting(force: bool = False) -> bool:
+    """Wrap ``jax.device_put`` / ``jax.device_get`` with byte counters.
+
+    Counts *explicit* transfers only — arguments implicitly committed by
+    jit dispatch never pass through these entry points.  Opt-in
+    (``PATHWAY_PROFILE_TRANSFERS``) because it monkeypatches public jax
+    attributes; reversible via :func:`uninstall_transfer_accounting`.
+    """
+    global _orig_device_put, _orig_device_get
+    if _orig_device_put is not None:
+        return True
+    if not force:
+        from pathway_tpu.internals.config import env_bool
+
+        if not env_bool("PATHWAY_PROFILE_TRANSFERS"):
+            return False
+    try:
+        import jax
+    except Exception:  # noqa: BLE001
+        return False
+    reg = _metrics.get_registry()
+    h2d = reg.counter(
+        "jax.transfer.h2d.bytes", "explicit host-to-device transfer bytes"
+    )
+    d2h = reg.counter(
+        "jax.transfer.d2h.bytes", "explicit device-to-host transfer bytes"
+    )
+    _orig_device_put = jax.device_put
+    _orig_device_get = jax.device_get
+
+    def device_put(x, *args, **kwargs):
+        h2d.inc(_tree_nbytes(x))
+        return _orig_device_put(x, *args, **kwargs)
+
+    def device_get(x):
+        d2h.inc(_tree_nbytes(x))
+        return _orig_device_get(x)
+
+    jax.device_put = device_put
+    jax.device_get = device_get
+    return True
+
+
+def uninstall_transfer_accounting() -> None:
+    global _orig_device_put, _orig_device_get
+    if _orig_device_put is None:
+        return
+    import jax
+
+    jax.device_put = _orig_device_put
+    jax.device_get = _orig_device_get
+    _orig_device_put = None
+    _orig_device_get = None
+
+
+# ---------------------------------------------------------------------------
+# Snapshot rendering (CLI / post-mortem)
+# ---------------------------------------------------------------------------
+
+
+def render_snapshot(snapshot: dict[str, Any], *, top: int | None = None) -> str:
+    """Human-readable top-N attribution tree of one profiler snapshot.
+
+    Operators print by cumulative step time with a share bar; each line
+    names its input operators (``<- name#id``), so the hot chain reads as
+    a tree even though the graph is a DAG.
+    """
+    # .get() everywhere: this renders foreign artifacts (hand-edited or
+    # cross-version flight-recorder dumps) — a partial snapshot must
+    # render best-effort, never traceback mid-blackbox-listing
+    ops = snapshot.get("operators") or []
+    if top is not None:
+        ops = ops[:top]
+    total = snapshot.get("total_step_seconds") or 0.0
+    names = {op.get("id"): op.get("name", "op") for op in ops}
+    lines = [
+        f"profile: {snapshot.get('epochs', '?')} epochs · "
+        f"{snapshot.get('operators_total', len(ops))} operators · "
+        f"{total:.3f} s total operator time"
+    ]
+    if not ops:
+        lines.append("  (no operator samples)")
+        return "\n".join(lines)
+
+    def tag(op) -> str:
+        return f"{op.get('name', 'op')}#{op.get('id', '?')}"
+
+    width = max(len(tag(op)) for op in ops)
+    for op in ops:
+        share = op.get("share") or 0.0
+        bar = "#" * max(1, round(share * 20)) if total else ""
+        inputs = ", ".join(
+            f"{names.get(i, 'op')}#{i}" for i in op.get("inputs") or []
+        )
+        lines.append(
+            f"  {tag(op):<{width}}  "
+            f"{op.get('seconds') or 0.0:>9.3f} s  {share:>6.1%}  {bar:<20}  "
+            f"rows {op.get('rows_in', '?')}->{op.get('rows_out', '?')}"
+            + (f"  <- {inputs}" if inputs else "")
+        )
+    return "\n".join(lines)

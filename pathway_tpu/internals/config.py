@@ -138,6 +138,32 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
        "per process)", "metrics"),
     _k("PATHWAY_SERVICE_NAMESPACE", "str", "local-dev",
        "OTel `service.namespace` resource attribute", "metrics"),
+    # -- per-operator profiler / device accounting (engine/profiler.py) -----
+    _k("PATHWAY_PROFILE", "bool", False,
+       "enable the per-operator epoch profiler (top-N attribution "
+       "snapshots exported as `profiler.operator.*`)", "profiler"),
+    _k("PATHWAY_PROFILE_SAMPLE_EVERY", "int", 16,
+       "profiler sampling cadence: aggregate operator totals every N "
+       "processed epochs", "profiler"),
+    _k("PATHWAY_PROFILE_TOP", "int", 20,
+       "operators kept per profiler snapshot (bounds metric cardinality "
+       "and the CLI render)", "profiler"),
+    _k("PATHWAY_PROFILE_OUTPUT", "str", None,
+       "write the run's final profiler snapshot to this JSON path "
+       "(render it with `pathway_tpu profile <path>`)", "profiler"),
+    _k("PATHWAY_PROFILE_JAX", "bool", True,
+       "install jax.monitoring listeners counting compilations, jit "
+       "cache misses and compile seconds (`jax.compile.*`, "
+       "`jax.cache.miss`)", "profiler"),
+    _k("PATHWAY_PROFILE_TRANSFERS", "bool", False,
+       "wrap jax.device_put/device_get to count explicit host<->device "
+       "transfer bytes (`jax.transfer.*`)", "profiler"),
+    # -- benchmark harness (benchmarks/harness.py) --------------------------
+    _k("PATHWAY_BENCH_BASELINE_DIR", "str", None,
+       "directory of committed benchmark baselines (default: "
+       "benchmarks/baselines/)", "bench"),
+    _k("PATHWAY_BENCH_REPS", "int", None,
+       "override the per-mode benchmark repetition count", "bench"),
     # -- persistence (engine/persistence.py) --------------------------------
     _k("PATHWAY_INCARNATION", "int", 0,
        "cluster incarnation lease this worker runs under (exported by "
@@ -196,6 +222,8 @@ _SUBSYSTEM_TITLES = (
     ("comm", "Worker mesh (`engine/comm.py`)"),
     ("faults", "Fault injection (`engine/faults.py`)"),
     ("metrics", "Metrics & telemetry (`engine/metrics.py`, `engine/telemetry.py`)"),
+    ("profiler", "Profiler & device accounting (`engine/profiler.py`)"),
+    ("bench", "Benchmark harness (`benchmarks/harness.py`)"),
     ("persistence", "Persistence (`engine/persistence.py`)"),
     ("supervisor", "Supervisor (`engine/supervisor.py`)"),
     ("devices", "Device mesh (`parallel/mesh.py`)"),

@@ -132,7 +132,25 @@ class StatsMonitor:
                 if k == prefix or k.startswith(prefix + "{")
             )
 
+        def peak(prefix: str) -> float | None:
+            # quantile gauges must not SUM across label children (a p95 is
+            # not additive) — report the worst child instead
+            vals = [
+                v for k, v in scalars.items()
+                if k == prefix or k.startswith(prefix + "{")
+            ]
+            return max(vals) if vals else None
+
         parts: list[str] = []
+        epoch_p95 = peak("epoch.duration.ms.p95")
+        if epoch_p95 is not None:
+            parts.append(f"epoch p95: {epoch_p95:.1f} ms")
+        compiles = total("jax.compile.count")
+        if compiles:
+            parts.append(
+                f"jit: {int(compiles)} compile(s) / "
+                f"{int(total('jax.cache.miss'))} cache miss(es)"
+            )
         frames = total("comm.frames.sent")
         if frames:
             mb = total("comm.bytes.sent") / (1 << 20)

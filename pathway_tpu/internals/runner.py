@@ -45,6 +45,7 @@ class RunResult:
         self.epochs = 0
         self.prober = None  # engine.probes.Prober when monitoring ran
         self.telemetry = None  # engine.telemetry.Telemetry for this run
+        self.profiler = None  # engine.profiler.EpochProfiler for this run
         self.last_time: int | None = None  # last processed epoch
         self.clean_finish = False
         # an exception escaped mid-run_epoch: node states are inconsistent
@@ -310,6 +311,25 @@ def run(
             workers=config.processes,
         )
 
+        # performance observability (engine/profiler.py): per-operator
+        # attribution sampled off the always-on step timers, JAX compile/
+        # cache-miss accounting (the dynamic recompile-count==0 pin), and
+        # a final profiler snapshot riding every flight-recorder dump so
+        # post-mortems say where the time went
+        from pathway_tpu.engine import profiler as _profiler
+
+        profiler = _profiler.EpochProfiler()
+        result.profiler = profiler
+        if profiler.enabled:
+            registry.register_collector(
+                "profiler.operators", profiler.metrics_snapshot
+            )
+        _profiler.install_jax_accounting()
+        _profiler.install_transfer_accounting()
+        _blackbox.get_recorder().set_profile_supplier(
+            lambda: profiler.crash_snapshot(scope)
+        )
+
         if with_http_server:
             from pathway_tpu.engine.http_server import MonitoringServer
 
@@ -337,6 +357,9 @@ def run(
                         scope, lowerer, result, max_epochs=max_epochs,
                         storage=storage, prober=prober, telemetry=telemetry,
                         beacon=beacon,
+                        # None when disabled, so the default configuration
+                        # pays zero per-epoch cost (not even the call)
+                        profiler=profiler if profiler.enabled else None,
                     )
                 except BaseException as exc:
                     # black-box the failure BEFORE unwinding: the ring's
@@ -366,6 +389,17 @@ def run(
                 )
             except (ValueError, OSError):
                 pass
+        if result.profiler is not None:
+            # the run's profile outlives the run: final snapshot to the
+            # PATHWAY_PROFILE_OUTPUT path (best-effort), and the crash
+            # supplier cleared so the recorder stops referencing this
+            # run's node arena
+            from pathway_tpu.engine import flight_recorder as _blackbox
+
+            if result.profiler.enabled:
+                result.profiler.sample(scope, result.epochs)
+                result.profiler.write_output()
+            _blackbox.get_recorder().set_profile_supplier(None)
         if worker_ctx is not None:
             worker_ctx.close()
         if result.telemetry is not None:
@@ -620,7 +654,7 @@ def _epoch_instruments():
 
     hist = _registry.get_registry().histogram(
         "epoch.duration.ms", "wall time of one processed epoch (ms)",
-        buckets=(0.1, 0.5, 1, 5, 10, 50, 100, 500, 1000, 5000, 10000),
+        buckets=_registry.MS_BUCKETS,
     )
     return hist, _blackbox
 
@@ -635,11 +669,13 @@ def _event_loop(
     prober: Any = None,
     telemetry: Any = None,
     beacon: Any = None,
+    profiler: Any = None,
 ) -> None:
     if scope.worker is not None:
         return _event_loop_coordinated(
             scope, lowerer, result, max_epochs=max_epochs, storage=storage,
             prober=prober, telemetry=telemetry, beacon=beacon,
+            profiler=profiler,
         )
     if beacon is None:
         beacon = _ProgressBeacon(None, 0)
@@ -717,6 +753,10 @@ def _event_loop(
             last_time = t
             result.last_time = t
             result.epochs += 1
+            if profiler is not None:
+                # cadence-gated top-N attribution off the per-node step
+                # timers run_epoch already maintains (engine/profiler.py)
+                profiler.on_epoch(scope, result.epochs)
             # sources without input snapshots (no persistence, or UDF-cache-
             # only mode): the processed epoch is their durability boundary —
             # broker offsets may cover rows up to it, and no further
@@ -770,6 +810,7 @@ def _event_loop_coordinated(
     prober: Any = None,
     telemetry: Any = None,
     beacon: Any = None,
+    profiler: Any = None,
 ) -> None:
     """Multi-worker BSP loop: worker 0 sequences epochs, every worker runs
     them in lockstep, exchanging rows at the declared exchange points.
@@ -897,6 +938,8 @@ def _event_loop_coordinated(
         last_time = t
         result.last_time = t
         result.epochs += 1
+        if profiler is not None:
+            profiler.on_epoch(scope, result.epochs)
         _ack_sources(pollers, persisted=False, up_to_time=t)
         if prober is not None and prober.callbacks:
             prober.update(epochs=result.epochs)

@@ -1,0 +1,234 @@
+"""Benchmark regression harness (tier-1 gate for ISSUE 8).
+
+Pins the harness contract: `pathway_tpu bench --smoke --check` against a
+fixture baseline passes when nothing changed, an injected 3x slowdown is
+flagged, thresholds follow the documented noise policy, and the
+machine-readable results carry an environment fingerprint.
+"""
+
+from __future__ import annotations
+
+import copy
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def harness():
+    import sys
+
+    path = os.path.join(REPO_ROOT, "benchmarks", "harness.py")
+    name = "bench_harness_under_test"
+    spec = importlib.util.spec_from_file_location(name, path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module  # dataclass decorators need the registration
+    spec.loader.exec_module(module)
+    return module
+
+
+def _results(harness, metrics: dict[str, float], only=None):
+    return {
+        "mode": "smoke",
+        "created_at": 0.0,
+        "reps": 1,
+        "only": only,
+        "fingerprint": harness.environment_fingerprint(),
+        "metrics": {
+            name: {
+                "median": value,
+                "iqr": 0.0,
+                "samples": [value],
+                "direction": harness.metric_direction(name),
+            }
+            for name, value in metrics.items()
+        },
+    }
+
+
+def test_metric_direction_heuristics(harness):
+    assert harness.metric_direction("host_wordcount_rows_per_sec_columnar") == "higher"
+    assert harness.metric_direction("host_wordcount_columnar_speedup") == "higher"
+    # throughput wins over the family name saying "overhead"
+    assert harness.metric_direction("telemetry_overhead_rows_per_sec.on") == "higher"
+    assert harness.metric_direction("telemetry_overhead_pct") == "lower"
+    assert harness.metric_direction("telemetry_micro_cost_us_per_epoch") == "lower"
+    assert harness.metric_direction("profiler_overhead_pct") == "lower"
+    # refuses to guess: an unclassified cost metric would otherwise have
+    # its regressions reported as improvements
+    with pytest.raises(harness.HarnessError, match="cannot classify"):
+        harness.metric_direction("recompiles_per_run")
+    # every metric the committed suite emits must classify
+    for bench_metrics in (
+        ("host_churn_rows_per_sec", "host_join_native_speedup"),
+        ("profiler_amortized_us_per_epoch", "profiler_sample_us"),
+    ):
+        for name in bench_metrics:
+            assert harness.metric_direction(name) in ("higher", "lower")
+
+
+def test_compare_passes_unchanged_and_flags_3x_slowdown(harness, tmp_path):
+    results = _results(
+        harness,
+        {
+            "host_wordcount_rows_per_sec_columnar": 300_000.0,
+            "host_wordcount_columnar_speedup": 3.0,
+            "profiler_overhead_pct": 0.2,
+        },
+    )
+    harness.update_baseline(results, baseline_dir=str(tmp_path))
+    baseline = harness.load_baseline("smoke", baseline_dir=str(tmp_path))
+    assert baseline is not None
+
+    # unchanged run: clean pass
+    report = harness.compare(copy.deepcopy(results), baseline)
+    assert report["ok"], report
+    assert not report["regressions"] and not report["missing"]
+
+    # 3x throughput slowdown: flagged (ratio 0.33 < default min 0.4)
+    slow = copy.deepcopy(results)
+    slow["metrics"]["host_wordcount_rows_per_sec_columnar"]["median"] = 100_000.0
+    report = harness.compare(slow, baseline)
+    assert not report["ok"]
+    assert [r["metric"] for r in report["regressions"]] == [
+        "host_wordcount_rows_per_sec_columnar"
+    ]
+    assert "REGRESSION" in harness.render_report(report)
+
+    # 3x cost increase on a lower-better metric: flagged too
+    costly = copy.deepcopy(results)
+    costly["metrics"]["profiler_overhead_pct"]["median"] = 0.6
+    report = harness.compare(costly, baseline)
+    assert not report["ok"]
+    assert report["regressions"][0]["metric"] == "profiler_overhead_pct"
+
+
+def test_noisy_baselines_get_wide_thresholds(harness):
+    quiet = harness.baseline_entry(
+        {"median": 100.0, "iqr": 5.0, "direction": "higher"}
+    )
+    noisy = harness.baseline_entry(
+        {"median": 100.0, "iqr": 60.0, "direction": "higher"}
+    )
+    assert quiet["min_ratio"] == harness.DEFAULT_MIN_RATIO
+    assert noisy["min_ratio"] == harness.NOISY_MIN_RATIO
+    noisy_cost = harness.baseline_entry(
+        {"median": 10.0, "iqr": 9.0, "direction": "lower"}
+    )
+    assert noisy_cost["max_ratio"] == harness.NOISY_MAX_RATIO
+
+
+def test_missing_metric_fails_only_unfiltered_runs(harness, tmp_path):
+    results = _results(harness, {"a_rows_per_sec": 10.0, "b_rows_per_sec": 10.0})
+    harness.update_baseline(results, baseline_dir=str(tmp_path))
+    baseline = harness.load_baseline("smoke", baseline_dir=str(tmp_path))
+    subset = _results(harness, {"a_rows_per_sec": 10.0}, only=["a"])
+    report = harness.compare(subset, baseline)
+    assert report["missing"] == ["b_rows_per_sec"] and report["ok"]
+    unfiltered = _results(harness, {"a_rows_per_sec": 10.0})
+    report = harness.compare(unfiltered, baseline)
+    assert not report["ok"]
+
+
+def test_subset_baseline_update_merges_instead_of_wiping(harness, tmp_path):
+    full = _results(harness, {"a_rows_per_sec": 10.0, "b_rows_per_sec": 20.0})
+    harness.update_baseline(full, baseline_dir=str(tmp_path))
+    subset = _results(harness, {"a_rows_per_sec": 12.0}, only=["a"])
+    harness.update_baseline(subset, baseline_dir=str(tmp_path))
+    merged = harness.load_baseline("smoke", baseline_dir=str(tmp_path))
+    assert merged["metrics"]["a_rows_per_sec"]["median"] == 12.0
+    assert merged["metrics"]["b_rows_per_sec"]["median"] == 20.0  # kept
+    # and the RESULTS.md table refuses subset regeneration outright
+    with pytest.raises(harness.HarnessError, match="subset"):
+        harness.update_results_md(subset, path=str(tmp_path / "R.md"))
+
+
+def test_only_validation_distinguishes_unknown_from_mode(harness):
+    with pytest.raises(harness.HarnessError, match="unknown benchmark"):
+        harness.run_suite(mode="smoke", only=["no_such_bench"], reps=1)
+    with pytest.raises(harness.HarnessError, match="not part of smoke"):
+        harness.run_suite(mode="smoke", only=["telemetry_overhead"], reps=1)
+
+
+def test_fingerprint_changes_are_reported_not_fatal(harness, tmp_path):
+    results = _results(harness, {"a_rows_per_sec": 10.0})
+    harness.update_baseline(results, baseline_dir=str(tmp_path))
+    baseline = harness.load_baseline("smoke", baseline_dir=str(tmp_path))
+    baseline["fingerprint"]["cpu_model"] = "some other rig"
+    report = harness.compare(results, baseline)
+    assert report["ok"] and "cpu_model" in report["fingerprint_changed"]
+    assert "fingerprint differs" in harness.render_report(report)
+
+
+def test_results_md_block_is_idempotent(harness, tmp_path):
+    results = _results(harness, {"a_rows_per_sec": 10.0})
+    path = tmp_path / "RESULTS.md"
+    path.write_text("# Benchmark results\n\nprose stays.\n")
+    harness.update_results_md(results, path=str(path))
+    text1 = path.read_text()
+    assert "prose stays." in text1 and "a_rows_per_sec" in text1
+    results["metrics"]["a_rows_per_sec"]["median"] = 20.0
+    harness.update_results_md(results, path=str(path))
+    text2 = path.read_text()
+    assert text2.count("bench:harness:smoke:begin") == 1
+    assert "| `a_rows_per_sec` | 20 |" in text2
+
+
+def test_bench_cli_smoke_check_roundtrip(harness, tmp_path):
+    """`pathway_tpu bench --smoke --check` against a fixture baseline:
+    one real benchmark subprocess, baseline written from its results,
+    unchanged check passes, tampered (3x) baseline flags a regression."""
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import cli
+
+    baseline_dir = tmp_path / "baselines"
+    results_path = tmp_path / "results.json"
+    runner = CliRunner()
+    args = [
+        "bench", "--smoke", "--reps", "1", "--only", "host_wordcount",
+        "--baseline-dir", str(baseline_dir),
+        "--json", str(results_path),
+        "--update-baselines", "--check",
+    ]
+    result = runner.invoke(cli, args, catch_exceptions=False)
+    # no prior baseline: the check bootstraps (creates, does not compare)
+    assert result.exit_code == 0, result.output
+    assert "bootstrap" in result.output
+
+    results = json.loads(results_path.read_text())
+    assert results["fingerprint"]["python"]
+    assert "host_wordcount_rows_per_sec_columnar" in results["metrics"]
+
+    # inject a 3x slowdown by inflating the committed baseline medians
+    baseline_path = baseline_dir / "smoke.json"
+    baseline = json.loads(baseline_path.read_text())
+    for entry in baseline["metrics"].values():
+        if entry["direction"] == "higher":
+            entry["median"] *= 3.0
+    report = harness.compare(results, baseline)
+    assert not report["ok"]
+    assert any(
+        "rows_per_sec" in r["metric"] for r in report["regressions"]
+    )
+
+    # with a prior baseline present, `--update-baselines --check` must
+    # compare against the PRIOR baseline, and a FAILING check must skip
+    # the baseline rewrite — otherwise re-running the same command would
+    # bless the regression.  5x-inflated prior medians make the fresh
+    # run read as a regression regardless of rig noise.
+    for entry in baseline["metrics"].values():
+        if entry["direction"] == "higher":
+            entry["median"] *= 5.0 / 3.0  # now 5x the measured run
+    baseline_path.write_text(json.dumps(baseline))
+    result = runner.invoke(cli, args, catch_exceptions=False)
+    assert result.exit_code == 1, result.output
+    assert "REGRESSION" in result.output
+    assert "updates skipped" in result.output
+    # the committed baseline still holds the (inflated) prior numbers
+    untouched = json.loads(baseline_path.read_text())
+    assert untouched == baseline

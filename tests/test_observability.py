@@ -115,6 +115,15 @@ pathway_epoch_duration_ms_bucket{worker="0",run_id="r7",le="100.0"} 4
 pathway_epoch_duration_ms_bucket{worker="0",run_id="r7",le="+Inf"} 5
 pathway_epoch_duration_ms_sum{worker="0",run_id="r7"} 5056.2
 pathway_epoch_duration_ms_count{worker="0",run_id="r7"} 5
+# HELP pathway_epoch_duration_ms_p50 p50 estimate of wall time of one processed epoch (ms)
+# TYPE pathway_epoch_duration_ms_p50 gauge
+pathway_epoch_duration_ms_p50{worker="0",run_id="r7"} 5.5
+# HELP pathway_epoch_duration_ms_p95 p95 estimate of wall time of one processed epoch (ms)
+# TYPE pathway_epoch_duration_ms_p95 gauge
+pathway_epoch_duration_ms_p95{worker="0",run_id="r7"} 100
+# HELP pathway_epoch_duration_ms_p99 p99 estimate of wall time of one processed epoch (ms)
+# TYPE pathway_epoch_duration_ms_p99 gauge
+pathway_epoch_duration_ms_p99{worker="0",run_id="r7"} 100
 # HELP pathway_supervisor_watchdog_kills hung workers killed by the progress watchdog
 # TYPE pathway_supervisor_watchdog_kills counter
 pathway_supervisor_watchdog_kills{run_id="r7"} 1

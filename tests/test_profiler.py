@@ -1,0 +1,305 @@
+"""Per-operator epoch profiler + latency quantiles (ISSUE 8 tentpole).
+
+Covers: bucket-derived quantile estimation (`engine/metrics.py`), the
+sampled top-N attribution profiler (`engine/profiler.py`), its registry
+export and run-end snapshot output, the flight-recorder integration
+(post-mortems say where the time went), the `pathway_tpu profile` CLI
+render, and the dashboard footer's p95/compile-count line.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from pathway_tpu.engine import metrics as em
+from pathway_tpu.engine.profiler import EpochProfiler, render_snapshot
+
+# --- quantile estimation -----------------------------------------------------
+
+
+def test_histogram_quantile_interpolates_within_bucket():
+    reg = em.MetricsRegistry(enabled=True)
+    h = reg.histogram("epoch.duration.ms", buckets=(1, 10, 100))
+    for v in (0.5, 0.7, 5.0, 50.0, 5000.0):
+        h.observe(v)
+    assert h.quantile(0.5) == pytest.approx(5.5)
+    # observations past the last bound clamp to the highest finite bound
+    assert h.quantile(0.95) == pytest.approx(100.0)
+    assert h.quantile(0.99) == pytest.approx(100.0)
+
+
+def test_histogram_quantile_empty_and_first_bucket():
+    reg = em.MetricsRegistry(enabled=True)
+    h = reg.histogram("epoch.duration.ms", buckets=(2.0, 4.0))
+    assert h.quantile(0.5) is None
+    h.observe(1.0)
+    h.observe(1.0)
+    # all mass in the first bucket: interpolate from 0 toward the bound
+    assert 0.0 < h.quantile(0.5) <= 2.0
+
+
+def test_registry_quantiles_ride_scalar_metrics_and_otlp():
+    reg = em.MetricsRegistry(enabled=True)
+    h = reg.histogram("epoch.duration.ms", buckets=(1, 10, 100), worker=0)
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v)
+    scalars = reg.scalar_metrics()
+    assert scalars["epoch.duration.ms.p50{worker=0}"] == pytest.approx(5.5)
+    names = {entry["name"] for entry in reg.otlp_metrics(ts=1.0)}
+    assert "epoch.duration.ms.p95" in names
+
+
+def test_ms_buckets_resolve_millisecond_epochs():
+    """The satellite fix: epoch-scale (0.1-100 ms) observations must not
+    collapse into one bucket (the old seconds-magnitude default), or the
+    derived quantiles are meaningless."""
+    reg = em.MetricsRegistry(enabled=True)
+    h = reg.histogram("epoch.duration.ms", buckets=em.MS_BUCKETS)
+    for v in (0.3, 0.8, 1.5, 3.0, 7.0, 20.0, 80.0):
+        h.observe(v)
+    _bounds, counts, _s, _n = h.snapshot()
+    assert sum(1 for c in counts if c) >= 6  # spread across buckets
+    assert 2.0 < h.quantile(0.5) < 7.0
+
+
+# --- the profiler ------------------------------------------------------------
+
+
+class _FakeNode:
+    def __init__(self, id_, name, seconds, rows_in=0, rows_out=0, inputs=()):
+        self.id = id_
+        self.name = name
+        self.step_seconds = seconds
+        self.rows_in = rows_in
+        self.rows_out = rows_out
+        self.inputs = list(inputs)
+
+
+class _FakeScope:
+    def __init__(self, nodes):
+        self.nodes = nodes
+        self.epochs_run = 7
+
+
+def _scope():
+    a = _FakeNode(0, "input", 0.1, rows_in=100, rows_out=100)
+    b = _FakeNode(1, "groupby", 2.0, rows_in=100, rows_out=10, inputs=(a,))
+    c = _FakeNode(2, "output", 0.4, rows_in=10, inputs=(b,))
+    return _FakeScope([a, b, c])
+
+
+def test_profiler_sample_orders_and_attributes():
+    prof = EpochProfiler(enabled=True, sample_every=1, top_n=2, output_path="")
+    snap = prof.sample(_scope(), epochs=12)
+    assert snap["epochs"] == 12
+    assert snap["operators_total"] == 3
+    assert snap["total_step_seconds"] == pytest.approx(2.5)
+    assert [op["name"] for op in snap["operators"]] == ["groupby", "output"]
+    top = snap["operators"][0]
+    assert top["share"] == pytest.approx(0.8)
+    assert top["inputs"] == [0]
+
+
+def test_profiler_sampling_cadence_gates_on_epoch():
+    prof = EpochProfiler(enabled=True, sample_every=4, top_n=5, output_path="")
+    scope = _scope()
+    for epoch in range(1, 9):
+        prof.on_epoch(scope, epoch)
+    assert prof.epochs_sampled == 2  # epochs 4 and 8 only
+    disabled = EpochProfiler(enabled=False, sample_every=1, output_path="")
+    disabled.on_epoch(scope, 1)
+    assert disabled.snapshot is None
+
+
+def test_profiler_metrics_snapshot_exports_topn_gauges():
+    prof = EpochProfiler(enabled=True, sample_every=1, top_n=1, output_path="")
+    assert prof.metrics_snapshot() == {}  # nothing sampled yet
+    prof.sample(_scope(), epochs=3)
+    flat = prof.metrics_snapshot()
+    assert flat["profiler.epochs.sampled"] == 1.0
+    assert flat["profiler.operator.seconds{id=1,operator=groupby}"] == (
+        pytest.approx(2.0)
+    )
+    assert flat["profiler.operator.rows{id=1,operator=groupby}"] == 100.0
+    # top_n bounds cardinality: only the leader exports
+    assert not any("operator=output" in k for k in flat)
+
+
+def test_profiler_collector_renders_as_labeled_prometheus_samples():
+    """Labeled collector keys (`name{id=..,operator=..}`) must become real
+    Prometheus labels — mangled into the metric NAME they would mint one
+    family per operator (unbounded name cardinality for scrapers)."""
+    prof = EpochProfiler(enabled=True, sample_every=1, top_n=2, output_path="")
+    prof.sample(_scope(), epochs=5)
+    reg = em.MetricsRegistry(enabled=True)
+    reg.register_collector("profiler.operators", prof.metrics_snapshot)
+    text = reg.render_prometheus()
+    assert (
+        'pathway_profiler_operator_seconds{id="1",operator="groupby"} 2'
+        in text
+    )
+    # one family header, two labeled samples — not one family per operator
+    assert text.count("# TYPE pathway_profiler_operator_seconds gauge") == 1
+    assert text.count("pathway_profiler_operator_seconds{") == 2
+
+
+def test_profiler_env_knobs_and_output_file(tmp_path, monkeypatch):
+    out = tmp_path / "prof.json"
+    monkeypatch.setenv("PATHWAY_PROFILE", "1")
+    monkeypatch.setenv("PATHWAY_PROFILE_SAMPLE_EVERY", "2")
+    monkeypatch.setenv("PATHWAY_PROFILE_TOP", "1")
+    monkeypatch.setenv("PATHWAY_PROFILE_OUTPUT", str(out))
+    prof = EpochProfiler()
+    assert prof.enabled and prof.sample_every == 2 and prof.top_n == 1
+    prof.sample(_scope(), epochs=2)
+    assert prof.write_output() == str(out)
+    snap = json.loads(out.read_text())
+    assert snap["operators"][0]["name"] == "groupby"
+
+
+def test_profiled_run_end_to_end(tmp_path, monkeypatch):
+    """A real pipeline under PATHWAY_PROFILE=1: registry gauges appear and
+    the run-end snapshot lands at PATHWAY_PROFILE_OUTPUT."""
+    import pathway_tpu as pw
+
+    out = tmp_path / "run-profile.json"
+    monkeypatch.setenv("PATHWAY_PROFILE", "1")
+    monkeypatch.setenv("PATHWAY_PROFILE_SAMPLE_EVERY", "1")
+    monkeypatch.setenv("PATHWAY_PROFILE_OUTPUT", str(out))
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            for i in range(12):
+                self.next(k=i % 3, v=1)
+                if i % 3 == 0:
+                    self.commit()
+
+    t = pw.io.python.read(
+        Src(), schema=pw.schema_from_types(k=int, v=int), name="src"
+    )
+    counts = t.groupby(t.k).reduce(k=t.k, n=pw.reducers.count())
+    seen = []
+    pw.io.subscribe(counts, on_change=lambda **kw: seen.append(None))
+    result = pw.run(monitoring_level=pw.MonitoringLevel.NONE)
+    assert result.profiler is not None and result.profiler.enabled
+    assert result.profiler.epochs_sampled >= 1
+    snap = json.loads(out.read_text())
+    names = {op["name"] for op in snap["operators"]}
+    assert "groupby" in names
+    flat = em.get_registry().scalar_metrics()
+    assert any(k.startswith("profiler.operator.seconds{") for k in flat)
+    assert flat.get("profiler.epochs.sampled", 0) >= 1
+
+
+# --- flight-recorder integration --------------------------------------------
+
+
+def test_dump_carries_profiler_snapshot_and_blackbox_renders_it(tmp_path):
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import cli
+    from pathway_tpu.engine import flight_recorder as fr
+
+    prof = EpochProfiler(enabled=True, sample_every=1, top_n=3, output_path="")
+    scope = _scope()
+    rec = fr.FlightRecorder()
+    rec.configure(root=str(tmp_path), worker=0, run_id="run-prof")
+    rec.set_profile_supplier(lambda: prof.crash_snapshot(scope))
+    rec.record("epoch", time=2)
+    try:
+        path = rec.dump("test crash with profile")
+    finally:
+        rec.set_profile_supplier(None)
+    payload = json.loads(open(path).read())
+    assert payload["profiler"]["operators"][0]["name"] == "groupby"
+
+    result = CliRunner().invoke(cli, ["blackbox", str(tmp_path)])
+    assert result.exit_code == 0, result.output
+    assert "groupby#1" in result.output
+    assert "total operator time" in result.output
+
+
+def test_dump_survives_broken_profile_supplier(tmp_path):
+    from pathway_tpu.engine import flight_recorder as fr
+
+    rec = fr.FlightRecorder()
+    rec.configure(root=str(tmp_path), worker=1, run_id="r")
+    rec.set_profile_supplier(lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    rec.record("epoch", time=0)
+    try:
+        path = rec.dump("crash")
+    finally:
+        rec.set_profile_supplier(None)
+    assert path is not None
+    assert "profiler" not in json.loads(open(path).read())
+
+
+# --- the profile CLI ---------------------------------------------------------
+
+
+def test_profile_cli_renders_snapshot_file_and_root(tmp_path):
+    from click.testing import CliRunner
+
+    from pathway_tpu.engine import flight_recorder as fr
+
+    from pathway_tpu.cli import cli
+
+    prof = EpochProfiler(enabled=True, sample_every=1, top_n=3, output_path="")
+    snap = prof.sample(_scope(), epochs=9)
+    snap_path = tmp_path / "prof.json"
+    snap_path.write_text(json.dumps(snap))
+
+    runner = CliRunner()
+    result = runner.invoke(cli, ["profile", str(snap_path)])
+    assert result.exit_code == 0, result.output
+    assert "groupby#1" in result.output and "<- input#0" in result.output
+
+    result = runner.invoke(cli, ["profile", "--top", "1", str(snap_path)])
+    assert result.exit_code == 0
+    assert "output#2" not in result.output
+
+    # a persistence root: render the dumps' profiler sections
+    root = tmp_path / "pstore"
+    root.mkdir()
+    rec = fr.FlightRecorder()
+    rec.configure(root=str(root), worker=0, run_id="r")
+    rec.set_profile_supplier(lambda: snap)
+    rec.record("epoch", time=0)
+    try:
+        rec.dump("crash")
+    finally:
+        rec.set_profile_supplier(None)
+    result = runner.invoke(cli, ["profile", str(root)])
+    assert result.exit_code == 0, result.output
+    assert "groupby#1" in result.output
+
+    # no profile anywhere -> exit 1
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    result = runner.invoke(cli, ["profile", str(empty)])
+    assert result.exit_code == 1
+
+
+# --- dashboard footer --------------------------------------------------------
+
+
+def test_dashboard_footer_shows_p95_and_compile_count():
+    from pathway_tpu.internals.monitoring import MonitoringLevel, StatsMonitor
+
+    reg = em.get_registry()
+    h = reg.histogram(
+        "epoch.duration.ms", "wall time of one processed epoch (ms)",
+        buckets=em.MS_BUCKETS,
+    )
+    for v in (1.0, 2.0, 3.0, 40.0):
+        h.observe(v)
+    reg.counter(
+        "jax.compile.count", "XLA backend compilations observed"
+    ).inc(3)
+    monitor = StatsMonitor(MonitoringLevel.IN_OUT)
+    summary = monitor._runtime_summary()
+    assert summary is not None
+    assert "epoch p95" in summary
+    assert "compile(s)" in summary
