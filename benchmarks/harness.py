@@ -88,6 +88,9 @@ SUITE: tuple[Bench, ...] = (
     Bench(
         "profiler_overhead", "profiler_overhead.py", ("smoke",), (),
     ),
+    Bench(
+        "freshness_overhead", "freshness_overhead.py", ("smoke",), (),
+    ),
 )
 
 MODE_REPS = {"smoke": 3, "full": 3}

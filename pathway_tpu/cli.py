@@ -453,15 +453,20 @@ def blackbox(worker, tail, as_json, root):
     dumps = gather_dumps(root)
     if worker is not None:
         dumps = {w: d for w, d in dumps.items() if w == worker}
-    if as_json:
-        click.echo(_json.dumps(dumps, indent=2, sort_keys=True))
-        sys.exit(0 if dumps else 1)
     if not dumps:
+        # missing or empty blackbox/: a clear non-zero exit, whatever the
+        # output mode — an operator piping --json must still see why
         click.echo(
-            f"[pathway_tpu] no flight-recorder dumps under {root}/blackbox",
+            f"[pathway_tpu] no flight-recorder dumps under {root}/blackbox "
+            "— nothing crashed there, or this is not a persistence root",
             err=True,
         )
+        if as_json:
+            click.echo(_json.dumps({}))
         sys.exit(1)
+    if as_json:
+        click.echo(_json.dumps(dumps, indent=2, sort_keys=True))
+        sys.exit(0)
 
     def when(ts):
         # best-effort like the gather layer: a parseable-but-partial dump
@@ -500,6 +505,14 @@ def blackbox(worker, tail, as_json, root):
                 from pathway_tpu.engine.profiler import render_snapshot
 
                 for line in render_snapshot(profile).splitlines():
+                    click.echo(f"  {line}")
+            freshness = payload.get("freshness")
+            if freshness:
+                # ...and what was STUCK: the final watermark/backlog
+                # snapshot (engine/freshness.py)
+                from pathway_tpu.engine.freshness import render_freshness
+
+                for line in render_freshness(freshness).splitlines():
                     click.echo(f"  {line}")
     sys.exit(0)
 
@@ -658,6 +671,104 @@ def profile(top, as_json, source):
             click.echo(label)
         click.echo(render_snapshot(snap, top=top))
     sys.exit(0)
+
+
+@cli.command()
+@click.option(
+    "--url",
+    metavar="URL",
+    type=str,
+    default=None,
+    help="full /status URL (overrides --port/--process-id)",
+)
+@click.option(
+    "--port",
+    metavar="PORT",
+    type=int,
+    default=None,
+    help="monitoring HTTP port (default: PATHWAY_MONITORING_HTTP_PORT, "
+    "else 20000 + process id)",
+)
+@click.option(
+    "--process-id",
+    metavar="N",
+    type=int,
+    default=0,
+    help="worker whose endpoint to poll (port defaults to 20000 + N)",
+)
+@click.option(
+    "--interval",
+    metavar="SECONDS",
+    type=float,
+    default=None,
+    help="refresh interval (default: the PATHWAY_STATUS_REFRESH_S knob)",
+)
+@click.option(
+    "--once", is_flag=True, help="render a single frame and exit (no loop)"
+)
+@click.option(
+    "--json", "as_json", is_flag=True, help="emit the raw /status JSON"
+)
+def top(url, port, process_id, interval, once, as_json):
+    """Live per-operator backlog + freshness view of a running pipeline.
+
+    Polls ``GET /status`` on the monitoring HTTP server (enable it with
+    ``pw.run(with_http_server=True)`` or ``PATHWAY_MONITORING_HTTP_PORT``)
+    and renders epoch rate, per-output staleness and end-to-end latency
+    quantiles (``freshness.*``), the ranked ``backlog.*`` wait points,
+    and the per-operator progress table — see ``docs/observability.md``,
+    "Freshness & backpressure".  Exits non-zero with a clear message when
+    the endpoint is unreachable.
+    """
+    import json as _json
+    import time as _time_mod
+
+    from pathway_tpu.engine.http_server import monitoring_port
+    from pathway_tpu.internals.config import env_float, env_int
+    from pathway_tpu.internals.top import (
+        StatusUnavailable,
+        fetch_status,
+        render_top,
+        status_url,
+    )
+
+    if url is None:
+        if port is None:
+            port = env_int("PATHWAY_MONITORING_HTTP_PORT")
+        url = status_url(monitoring_port(process_id, port))
+    if interval is None:
+        interval = env_float("PATHWAY_STATUS_REFRESH_S")  # declared default 1.0
+    # an explicit small value clamps (never silently reverts to the
+    # default); 0.1 s is the floor so a typo cannot hot-spin the server
+    interval = max(0.1, float(interval))
+    prev = None
+    prev_t = None
+    while True:
+        try:
+            status = fetch_status(url)
+        except StatusUnavailable as exc:
+            click.echo(f"[pathway_tpu] {exc}", err=True)
+            sys.exit(1)
+        now = _time_mod.monotonic()
+        if as_json:
+            click.echo(_json.dumps(status, indent=2, sort_keys=True))
+        else:
+            if not once:
+                click.clear()
+            # epoch rate derives from the MEASURED elapsed time between
+            # polls, not the configured interval — slow fetches must not
+            # overstate the rate
+            click.echo(
+                render_top(
+                    status,
+                    prev,
+                    interval_s=(now - prev_t) if prev_t else None,
+                )
+            )
+        if once:
+            sys.exit(0)
+        prev, prev_t = status, now
+        _time_mod.sleep(interval)
 
 
 def _load_harness():

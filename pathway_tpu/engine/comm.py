@@ -341,6 +341,13 @@ class TcpMesh:
             "comm.heartbeat.staleness.s",
             "seconds since the quietest live peer was last heard", **wl,
         )
+        # per-peer inbox depth joins the backlog.* backpressure namespace
+        # (engine/freshness.py) at pull time — a receiver whose epoch loop
+        # falls behind its peers shows up here, ranked against every other
+        # place records wait.  WeakMethod registration: dies with the mesh.
+        reg.register_collector(
+            f"comm.inbox.worker{worker_id}", self._backlog_snapshot
+        )
 
     def _reconnect_delays(self):
         """Bounded backoff schedule for link reconnects — the udfs
@@ -817,6 +824,29 @@ class TcpMesh:
                 self._cv.notify_all()
         else:
             drop()  # caller holds self._cv
+
+    def _backlog_snapshot(self) -> dict[str, float]:
+        """Pull-time collector: frames waiting per peer inbox, in the
+        ``backlog.*`` backpressure namespace (``engine/freshness.py``).
+        Runs at scrape/export cadence off the hot path; the brief ``_cv``
+        hold is the same one every recv already takes."""
+        # every peer gets a series, zero included — a drained inbox must
+        # report 0, not vanish and leave the scraper serving its last
+        # (possibly huge) value for the staleness window
+        counts: dict[int, int] = {
+            peer: 0 for peer in range(self.worker_count)
+            if peer != self.worker_id
+        }
+        with self._cv:
+            for (peer, tag), q in self._inbox.items():
+                if tag is _PEER_DEAD:
+                    continue
+                counts[peer] = counts.get(peer, 0) + len(q)
+        return {
+            f"backlog.comm.inbox{{peer={peer},worker={self.worker_id}}}":
+                float(n)
+            for peer, n in counts.items()
+        }
 
     # -- heartbeats -------------------------------------------------------
     # pathway-lint: context=heartbeat

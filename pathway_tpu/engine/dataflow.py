@@ -345,6 +345,11 @@ class InputNode(Node):
         self._hot_time: Time | None = None
         self._hot_list: list[Delta] | None = None
         self.finished = False
+        # ingest low-watermark of the epoch this node last emitted: the
+        # earliest staged-row wall-clock folded into that epoch (set by
+        # emit_time, read by the freshness tracker's per-operator
+        # min-ingest-frontier pass — engine/freshness.py)
+        self.epoch_ingest_wallclock: float | None = None
         # upsert sessions key rows and treat same-key insert as replace
         self.upsert = False
         # set by the io layer when the source schema declares append_only
@@ -430,6 +435,7 @@ class InputNode(Node):
 
     def emit_time(self, time: Time) -> None:
         wall = self._staged_wallclock.pop(time, None)
+        self.epoch_ingest_wallclock = wall
         if wall is not None:
             ew = self.scope.epoch_wallclock
             ew[time] = min(ew.get(time, wall), wall)
@@ -2019,6 +2025,9 @@ class OutputNode(Node):
         self.on_end = on_end
         self.on_frontier = on_frontier
         self._saw_data_this_epoch = False
+        # sink label from the registration (runner.run sets it): the
+        # per-output identity freshness metrics are keyed by
+        self.sink_name: str | None = None
         scope.outputs.append(self)
 
     def step(self, time):

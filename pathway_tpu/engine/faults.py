@@ -24,7 +24,9 @@ Plan format (JSON, also accepted as a Python list of dicts)::
         {"kind": "blob_bitflip", "key": "manifests/0/", "from_nth": 3},
         {"kind": "blob_torn",    "key": "snapshots", "nth": 2, "frac": 0.5},
         {"kind": "blob_truncate", "key": "operators", "nth": 1},
-        {"kind": "connector_read", "source": "CsvReader", "nth": 4}
+        {"kind": "connector_read", "source": "CsvReader", "nth": 4},
+        {"kind": "connector_stall", "source": "SubjectReader", "nth": 3,
+         "delay_ms": 500}
     ]}
 
 Matching rules:
@@ -90,6 +92,13 @@ blob_bitflip ``FlakyBackend.put/put_atomic``: one bit of the written data
 connector_read  The reader supervision loop (``io/_utils.py``): the Nth
              emitted item raises before it is enqueued, exercising the
              consecutive-error budget + restart/reseek path.
+connector_stall  The reader supervision loop: the Nth emitted item is
+             DELAYED by ``delay_ms`` (required for any effect; a spec
+             without it stalls 0 ms) before enqueue — a
+             stuck broker / slow upstream stand-in.  No error is raised
+             and no epoch slows down; only the data-plane freshness
+             layer (``engine/freshness.py``: ``output.staleness.s``)
+             can see it — exactly what its chaos tests prove.
 ========== =============================================================
 """
 
@@ -119,7 +128,10 @@ KINDS = (
     _COMM_KINDS
     + _BLOB_KINDS
     + _BLOB_CORRUPT_KINDS
-    + ("crash", "writer_crash", "hang", "zombie", "connector_read")
+    + (
+        "crash", "writer_crash", "hang", "zombie", "connector_read",
+        "connector_stall",
+    )
 )
 
 

@@ -66,6 +66,9 @@ class FlightRecorder:
         # dump carries a final top-N operator attribution snapshot, so a
         # post-mortem says where the time went, not just what happened
         self._profile_supplier: Any = None
+        # optional freshness supplier (engine/freshness.py): final
+        # watermark/backlog snapshot — what was STUCK, not just slow
+        self._freshness_supplier: Any = None
 
     # -- recording ---------------------------------------------------------
     def record(self, kind: str, **fields: Any) -> None:
@@ -116,6 +119,12 @@ class FlightRecorder:
         the global recorder never outlives a run's node arena."""
         self._profile_supplier = fn
 
+    def set_freshness_supplier(self, fn: Any) -> None:
+        """Attach (or clear) the callable whose watermark/backlog snapshot
+        rides every subsequent dump under the ``freshness`` key (same
+        lifetime contract as :meth:`set_profile_supplier`)."""
+        self._freshness_supplier = fn
+
     # -- dumping -----------------------------------------------------------
     def dump(self, reason: str, *, suffix: str | None = None) -> str | None:
         """Write the ring to ``<root>/blackbox/worker-<id>.attempt-<n>.json``
@@ -149,6 +158,7 @@ class FlightRecorder:
                 "events": list(self._ring),
             }
             supplier = self._profile_supplier
+            freshness_supplier = self._freshness_supplier
         if supplier is not None:
             # outside the lock (the supplier scans the node arena) and
             # never fatal: a dump without a profile beats no dump
@@ -158,6 +168,14 @@ class FlightRecorder:
                 profile = None
             if profile:
                 payload["profiler"] = profile
+        if freshness_supplier is not None:
+            # same contract: the watermark/backlog story is best-effort
+            try:
+                freshness = freshness_supplier()
+            except Exception:  # noqa: BLE001 - forensics must never fail
+                freshness = None
+            if freshness:
+                payload["freshness"] = freshness
         if payload["incarnation"] and self._fenced(
             root, payload["incarnation"], payload["worker"]
         ):

@@ -98,7 +98,17 @@ def render_prometheus(
     return "\n".join(lines) + "\n"
 
 
-def render_status(stats: ProberStats, run_id: str | None = None) -> str:
+def render_status(
+    stats: ProberStats,
+    run_id: str | None = None,
+    registry: "_metrics.MetricsRegistry | None" = None,
+) -> str:
+    """The ``GET /status`` JSON body: dataflow progress plus — with a
+    registry — the data-plane view ``pathway_tpu top`` renders: per-output
+    freshness (staleness + e2e latency quantiles), the ``backlog.*``
+    backpressure ranking, and epoch-duration quantiles.  Keys are only
+    ever added here; existing consumers keep parsing."""
+
     def op(s):
         return {
             "name": s.name,
@@ -106,18 +116,37 @@ def render_status(stats: ProberStats, run_id: str | None = None) -> str:
             "lag_ms": s.lag_ms,
             "rows_in": s.rows_in,
             "rows_out": s.rows_out,
+            "step_ms": s.step_ms,
             "done": s.done,
         }
 
-    return json.dumps(
-        {
-            "run_id": run_id,
-            "epochs": stats.epochs,
-            "input": op(stats.input_stats),
-            "output": op(stats.output_stats),
-            "operators": {str(k): op(v) for k, v in stats.operator_stats.items()},
+    payload = {
+        "run_id": run_id,
+        "epochs": stats.epochs,
+        "input": op(stats.input_stats),
+        "output": op(stats.output_stats),
+        "operators": {str(k): op(v) for k, v in stats.operator_stats.items()},
+        "connectors": [
+            {"name": c.name, "rows": c.rows, "finished": c.finished}
+            for c in stats.connector_stats
+        ],
+    }
+    if registry is not None:
+        scalars = registry.scalar_metrics()
+        payload["freshness"] = {
+            k: v
+            for k, v in scalars.items()
+            if k.startswith(("freshness.", "output.staleness"))
         }
-    )
+        payload["backlog"] = {
+            k: v for k, v in scalars.items() if k.startswith("backlog.")
+        }
+        payload["epoch"] = {
+            k: v
+            for k, v in scalars.items()
+            if k.startswith("epoch.duration.ms.")
+        }
+    return json.dumps(payload)
 
 
 class MonitoringServer:
@@ -148,7 +177,9 @@ class MonitoringServer:
                     )
                     ctype = "text/plain; version=0.0.4"
                 elif self.path.startswith("/status"):
-                    body = render_status(server._stats, server.run_id)
+                    body = render_status(
+                        server._stats, server.run_id, registry=server.registry
+                    )
                     ctype = "application/json"
                 else:
                     self.send_error(404)

@@ -174,6 +174,35 @@ METRICS: dict[str, tuple[str, str]] = {
         "counter", "explicit host-to-device transfer bytes (device_put)"),
     "jax.transfer.d2h.bytes": (
         "counter", "explicit device-to-host transfer bytes (device_get)"),
+    # data-plane freshness & backpressure (engine/freshness.py)
+    "freshness.tracker": (
+        "collector", "freshness/backlog gauge supplier (the run's tracker)"),
+    "freshness.e2e.ms": (
+        "histogram", "ingest-to-delivery latency of output updates (ms)"),
+    "output.staleness.s": (
+        "gauge", "seconds since the ingest stamp of the newest data an "
+        "output reflects"),
+    "freshness.mesh.staleness.s": (
+        "gauge", "worst output staleness across the worker mesh (worker 0)"),
+    "backlog.connector.queue": (
+        "gauge", "items waiting in a connector's reader queue"),
+    "backlog.connector.idle.s": (
+        "gauge", "seconds since an unfinished source last staged a row "
+        "(the one-branch-stall signal)"),
+    "backlog.ingest.rows": (
+        "gauge", "rows staged at an input, not yet folded into an epoch"),
+    "backlog.ingest.age.s": (
+        "gauge", "age of the oldest staged row waiting at an input"),
+    "backlog.epochs.pending": (
+        "gauge", "distinct staged epoch timestamps awaiting processing"),
+    "backlog.comm.inbox": (
+        "gauge", "frames waiting in per-peer mesh inboxes (engine/comm.py)"),
+    "backlog.checkpoint.bytes": (
+        "gauge", "snapshot bytes in flight to the store (backlog alias of "
+        "checkpoint.inflight.bytes)"),
+    "backlog.checkpoint.jobs": (
+        "gauge", "artifact writes in flight (backlog alias of "
+        "checkpoint.inflight.jobs)"),
     # telemetry (engine/telemetry.py)
     "telemetry.export.dropped": (
         "counter", "telemetry payloads dropped by the bounded export queue"),

@@ -758,6 +758,11 @@ class CommitMetrics:
             out["checkpoint.commit.backpressure"] = self.backpressure_s
             out["checkpoint.inflight.bytes"] = float(self.inflight_bytes)
             out["checkpoint.inflight.jobs"] = float(self.inflight_jobs)
+            # the same in-flight state under the unified backlog.*
+            # backpressure namespace (engine/freshness.py), so one view
+            # ranks the commit pipeline against every other wait point
+            out["backlog.checkpoint.bytes"] = float(self.inflight_bytes)
+            out["backlog.checkpoint.jobs"] = float(self.inflight_jobs)
             out["checkpoint.inflight.bytes.max"] = float(self.max_inflight_bytes)
             out["checkpoint.artifacts"] = float(self.artifacts)
             out["checkpoint.bytes"] = float(self.bytes_written)

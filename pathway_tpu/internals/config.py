@@ -158,6 +158,14 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
     _k("PATHWAY_PROFILE_TRANSFERS", "bool", False,
        "wrap jax.device_put/device_get to count explicit host<->device "
        "transfer bytes (`jax.transfer.*`)", "profiler"),
+    # -- data-plane freshness & backpressure (engine/freshness.py) ----------
+    _k("PATHWAY_FRESHNESS", "bool", True,
+       "track ingest-time freshness (per-output `freshness.e2e.ms` / "
+       "`output.staleness.s`) and `backlog.*` backpressure gauges; `0` "
+       "removes the per-epoch watermark pass entirely", "freshness"),
+    _k("PATHWAY_STATUS_REFRESH_S", "float", 1.0,
+       "default poll interval of the `pathway_tpu top` live view "
+       "(`GET /status` on the monitoring HTTP server)", "freshness"),
     # -- benchmark harness (benchmarks/harness.py) --------------------------
     _k("PATHWAY_BENCH_BASELINE_DIR", "str", None,
        "directory of committed benchmark baselines (default: "
@@ -223,6 +231,7 @@ _SUBSYSTEM_TITLES = (
     ("faults", "Fault injection (`engine/faults.py`)"),
     ("metrics", "Metrics & telemetry (`engine/metrics.py`, `engine/telemetry.py`)"),
     ("profiler", "Profiler & device accounting (`engine/profiler.py`)"),
+    ("freshness", "Freshness & backpressure (`engine/freshness.py`)"),
     ("bench", "Benchmark harness (`benchmarks/harness.py`)"),
     ("persistence", "Persistence (`engine/persistence.py`)"),
     ("supervisor", "Supervisor (`engine/supervisor.py`)"),

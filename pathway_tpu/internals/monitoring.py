@@ -142,6 +142,13 @@ class StatsMonitor:
             return max(vals) if vals else None
 
         parts: list[str] = []
+        stale = peak("output.staleness.s")
+        if stale is not None:
+            # worst-output freshness: how old is the newest data any
+            # output reflects right now (engine/freshness.py) — rising
+            # here with a flat epoch p95 means a starved source, not a
+            # slow pipeline
+            parts.append(f"staleness: {stale:.2f} s (worst output)")
         epoch_p95 = peak("epoch.duration.ms.p95")
         if epoch_p95 is not None:
             parts.append(f"epoch p95: {epoch_p95:.1f} ms")

@@ -46,6 +46,7 @@ class RunResult:
         self.prober = None  # engine.probes.Prober when monitoring ran
         self.telemetry = None  # engine.telemetry.Telemetry for this run
         self.profiler = None  # engine.profiler.EpochProfiler for this run
+        self.freshness = None  # engine.freshness.FreshnessTracker for this run
         self.last_time: int | None = None  # last processed epoch
         self.clean_finish = False
         # an exception escaped mid-run_epoch: node states are inconsistent
@@ -184,9 +185,24 @@ def run(
         lowerer.persistence_storage = storage
 
     # lower all sinks (tree-shaking is implicit: only sink cones are built)
+    sink_labels: set[str] = set()
     for name, table, attach in (list(G.sinks) if _sinks is None else _sinks):
         node = lowerer.node(table)
-        attach(lowerer, node)
+        sink_node = attach(lowerer, node)
+        # per-output identity for the freshness/staleness metrics: the
+        # registration name is the label operators and dashboards rank
+        # by.  Colliding names (two default-named subscribes, or distinct
+        # raw names that sanitize to the same label value) get a node id
+        # suffix — sharing one label would let a stalled output hide
+        # behind a healthy one refreshing the same staleness gauge.
+        if isinstance(sink_node, df.OutputNode) and sink_node.sink_name is None:
+            from pathway_tpu.engine.freshness import safe_label
+
+            label = safe_label(name)
+            if label in sink_labels:
+                label = f"{label}#{sink_node.id}"
+            sink_node.sink_name = label
+            sink_labels.add(label)
 
     # append-only analysis must run before any state is restored or stepped:
     # GroupByNode picks its accumulator variant off the inferred flags
@@ -330,6 +346,26 @@ def run(
             lambda: profiler.crash_snapshot(scope)
         )
 
+        # data-plane observability (engine/freshness.py): ingest-time
+        # low-watermark propagation (per-output e2e latency + staleness)
+        # and backlog.* backpressure attribution — the "where records
+        # wait" complement of the profiler's "where CPU burns"
+        from pathway_tpu.engine import freshness as _freshness
+
+        freshness = _freshness.FreshnessTracker()
+        result.freshness = freshness
+        if freshness.enabled:
+            freshness.attach(scope, lowerer.pollers)
+            registry.register_collector(
+                "freshness.tracker", freshness.metrics_snapshot
+            )
+            # post-mortems say what was STUCK, not just where time went:
+            # every flight-recorder dump carries the final watermark/
+            # backlog snapshot next to the profiler's attribution
+            _blackbox.get_recorder().set_freshness_supplier(
+                freshness.crash_snapshot
+            )
+
         if with_http_server:
             from pathway_tpu.engine.http_server import MonitoringServer
 
@@ -360,6 +396,7 @@ def run(
                         # None when disabled, so the default configuration
                         # pays zero per-epoch cost (not even the call)
                         profiler=profiler if profiler.enabled else None,
+                        freshness=freshness if freshness.enabled else None,
                     )
                 except BaseException as exc:
                     # black-box the failure BEFORE unwinding: the ring's
@@ -400,6 +437,12 @@ def run(
                 result.profiler.sample(scope, result.epochs)
                 result.profiler.write_output()
             _blackbox.get_recorder().set_profile_supplier(None)
+        if result.freshness is not None:
+            # same lifetime rule for the freshness supplier: the recorder
+            # must not outlive this run's pollers and node arena
+            from pathway_tpu.engine import flight_recorder as _blackbox
+
+            _blackbox.get_recorder().set_freshness_supplier(None)
         if worker_ctx is not None:
             worker_ctx.close()
         if result.telemetry is not None:
@@ -670,12 +713,13 @@ def _event_loop(
     telemetry: Any = None,
     beacon: Any = None,
     profiler: Any = None,
+    freshness: Any = None,
 ) -> None:
     if scope.worker is not None:
         return _event_loop_coordinated(
             scope, lowerer, result, max_epochs=max_epochs, storage=storage,
             prober=prober, telemetry=telemetry, beacon=beacon,
-            profiler=profiler,
+            profiler=profiler, freshness=freshness,
         )
     if beacon is None:
         beacon = _ProgressBeacon(None, 0)
@@ -757,6 +801,10 @@ def _event_loop(
                 # cadence-gated top-N attribution off the per-node step
                 # timers run_epoch already maintains (engine/profiler.py)
                 profiler.on_epoch(scope, result.epochs)
+            if freshness is not None:
+                # propagate the ingest low-watermark frontier and record
+                # per-output delivery latency (engine/freshness.py)
+                freshness.after_epoch(scope)
             # sources without input snapshots (no persistence, or UDF-cache-
             # only mode): the processed epoch is their durability boundary —
             # broker offsets may cover rows up to it, and no further
@@ -811,6 +859,7 @@ def _event_loop_coordinated(
     telemetry: Any = None,
     beacon: Any = None,
     profiler: Any = None,
+    freshness: Any = None,
 ) -> None:
     """Multi-worker BSP loop: worker 0 sequences epochs, every worker runs
     them in lockstep, exchanging rows at the declared exchange points.
@@ -868,17 +917,29 @@ def _event_loop_coordinated(
 
         local_pending = any(n.has_pending() for n in scope.nodes)
         round_ += 1
+        # the epoch-negotiation gather doubles as the mesh-wide freshness
+        # aggregation path: each worker ships its worst output staleness,
+        # worker 0 publishes the cluster maximum (one gauge, zero extra
+        # collectives — the PR-4 trace-broadcast pattern)
+        local_stale = (
+            freshness.worst_staleness() if freshness is not None else None
+        )
         gathered = mesh.gather(
-            ("epoch", round_), (local_min, all_finished, local_pending)
+            ("epoch", round_),
+            (local_min, all_finished, local_pending, local_stale),
         )
         if mesh.worker_id == 0:
-            mins = [m for m, _f, _p in gathered if m is not None]
+            if freshness is not None:
+                freshness.record_mesh_staleness(
+                    [s for _m, _f, _p, s in gathered]
+                )
+            mins = [m for m, _f, _p, _s in gathered if m is not None]
             if mins:
                 t = min(mins)
                 if t <= last_time:
                     t = last_time + 2  # strictly increasing, even
                 decision = ("epoch", t)
-            elif any(p for _m, _f, p in gathered):
+            elif any(p for _m, _f, p, _s in gathered):
                 # boundary-produced deltas (error logs, buffer releases)
                 # drain in lockstep on every worker
                 drain_spins += 1
@@ -886,7 +947,7 @@ def _event_loop_coordinated(
                     decision = ("stop", None)  # non-quiescing node; bail
                 else:
                     decision = ("drain", last_time + 2)
-            elif all(fin for _m, fin, _p in gathered):
+            elif all(fin for _m, fin, _p, _s in gathered):
                 decision = ("stop", None)
             else:
                 decision = ("idle", None)
@@ -940,6 +1001,8 @@ def _event_loop_coordinated(
         result.epochs += 1
         if profiler is not None:
             profiler.on_epoch(scope, result.epochs)
+        if freshness is not None:
+            freshness.after_epoch(scope)
         _ack_sources(pollers, persisted=False, up_to_time=t)
         if prober is not None and prober.callbacks:
             prober.update(epochs=result.epochs)

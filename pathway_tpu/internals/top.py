@@ -1,0 +1,170 @@
+"""``pathway_tpu top`` — a live data-plane view over ``GET /status``.
+
+The htop of a running pipeline: polls the monitoring HTTP server
+(``engine/http_server.py``, enabled with ``pw.run(with_http_server=True)``
+or ``PATHWAY_MONITORING_HTTP_PORT``) and renders, per refresh:
+
+* header — run id, epochs processed, **epoch rate** (derived from the
+  delta between polls), epoch-duration p50/p95/p99;
+* freshness — per-output staleness and end-to-end ingest→delivery
+  latency quantiles (``engine/freshness.py``);
+* backlog — every ``backlog.*`` wait point, ranked worst-first, so the
+  bottleneck stage reads off the top line;
+* operators — the per-operator progress table of the ``/status`` body.
+
+Pure functions (`render_top`) are separated from I/O (`fetch_status`) so
+tests pin the render without a server and the CLI stays a thin loop.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from pathway_tpu.engine.metrics import split_labeled_name
+
+
+class StatusUnavailable(RuntimeError):
+    """The monitoring endpoint could not be reached or parsed — rendered
+    by the CLI as a clear non-zero exit, never a traceback."""
+
+
+def status_url(port: int, host: str = "127.0.0.1") -> str:
+    return f"http://{host}:{port}/status"
+
+
+def fetch_status(url: str, timeout: float = 2.0) -> dict[str, Any]:
+    """One ``GET /status`` poll; raises :class:`StatusUnavailable` with an
+    actionable message on any failure (server down, wrong port, bad
+    body)."""
+    import http.client
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            body = r.read().decode()
+    except (
+        urllib.error.URLError,
+        # a non-HTTP listener on the polled port (the comm mesh port is
+        # an easy mix-up) raises BadStatusLine — an HTTPException, not a
+        # URLError — and must get the same clean exit
+        http.client.HTTPException,
+        OSError,
+        TimeoutError,
+    ) as exc:
+        raise StatusUnavailable(
+            f"cannot reach {url} ({exc}) — is the pipeline running with "
+            "with_http_server=True (or PATHWAY_MONITORING_HTTP_PORT set)?"
+        ) from exc
+    try:
+        payload = json.loads(body)
+    except ValueError as exc:
+        raise StatusUnavailable(
+            f"{url} returned a non-JSON body ({exc}) — not a pathway_tpu "
+            "monitoring endpoint?"
+        ) from exc
+    if not isinstance(payload, dict):
+        raise StatusUnavailable(f"{url} returned non-object JSON")
+    return payload
+
+
+def _labeled(section: dict[str, float], base: str) -> dict[str, float]:
+    """``{label-value: value}`` for every ``base{...}`` key of a scalar
+    section, keyed by the first label's value (output=, source=, peer=)."""
+    out: dict[str, float] = {}
+    for key, value in (section or {}).items():
+        name, labels = split_labeled_name(key)
+        if name != base:
+            continue
+        label = next(iter(labels.values()), "") if labels else ""
+        out[label] = value
+    return out
+
+
+def render_top(
+    status: dict[str, Any],
+    prev: dict[str, Any] | None = None,
+    interval_s: float | None = None,
+) -> str:
+    """One frame of the live view from a ``/status`` payload (tolerates
+    partial payloads from older servers — sections simply drop out)."""
+    lines: list[str] = []
+    epochs = status.get("epochs") or 0
+    header = f"pathway_tpu top · run {status.get('run_id') or '-'} · epochs {epochs}"
+    if prev is not None and interval_s:
+        rate = max(0, epochs - (prev.get("epochs") or 0)) / interval_s
+        header += f" · {rate:.1f} epochs/s"
+    epoch_q = status.get("epoch") or {}
+    quantiles = [
+        f"{suffix[-3:]} {epoch_q[key]:.2f} ms"
+        for suffix in ("p50", "p95", "p99")
+        for key in (f"epoch.duration.ms.{suffix}",)
+        if key in epoch_q
+    ]
+    if quantiles:
+        header += " · epoch " + " / ".join(quantiles)
+    lines.append(header)
+
+    freshness = status.get("freshness") or {}
+    staleness = _labeled(freshness, "output.staleness.s")
+    if staleness:
+        lines.append("")
+        lines.append("freshness (per output)")
+        e2e = {
+            q: _labeled(freshness, f"freshness.e2e.ms.{q}")
+            for q in ("p50", "p95", "p99")
+        }
+        for label in sorted(staleness, key=lambda k: -staleness[k]):
+            row = f"  {label:<24} staleness {staleness[label]:>8.2f} s"
+            qs = [
+                f"{q} {e2e[q][label]:.1f} ms"
+                for q in ("p50", "p95", "p99")
+                if label in e2e[q]
+            ]
+            if qs:
+                row += "   e2e " + " / ".join(qs)
+            lines.append(row)
+        mesh = freshness.get("freshness.mesh.staleness.s")
+        if mesh is not None:
+            lines.append(f"  mesh worst staleness: {mesh:.2f} s")
+
+    backlog = status.get("backlog") or {}
+    ranked = sorted(backlog.items(), key=lambda kv: -kv[1])
+    nonzero = [(k, v) for k, v in ranked if v]
+    if backlog:
+        lines.append("")
+        lines.append("backlog (worst first)")
+        if not nonzero:
+            lines.append("  (all queues empty)")
+        for key, value in nonzero:
+            base, labels = split_labeled_name(key)
+            label_str = (
+                " [" + ",".join(f"{k}={v}" for k, v in labels.items()) + "]"
+                if labels
+                else ""
+            )
+            lines.append(f"  {base + label_str:<44} {value:>12g}")
+
+    operators = status.get("operators") or {}
+    if operators:
+        lines.append("")
+        lines.append(
+            f"  {'operator':<20} {'rows in':>10} {'rows out':>10} "
+            f"{'step ms':>9} {'lag ms':>8}"
+        )
+        rows = sorted(
+            operators.items(),
+            key=lambda kv: -(kv[1].get("step_ms") or 0.0),
+        )
+        for op_id, op in rows:
+            name = f"{op.get('name', 'op')}#{op_id}"
+            lag = op.get("lag_ms")
+            lines.append(
+                f"  {name:<20} {op.get('rows_in', 0):>10} "
+                f"{op.get('rows_out', 0):>10} "
+                f"{op.get('step_ms') or 0.0:>9.1f} "
+                f"{'-' if lag is None else format(lag, '.0f'):>8}"
+                + ("  [done]" if op.get("done") else "")
+            )
+    return "\n".join(lines)

@@ -278,6 +278,14 @@ class _QueuePoller:
         self._staged = False
         self._last_commit = _time.monotonic()
         self.finished = False
+        # monotonic stamp of the last DATA row this source staged; the
+        # freshness layer derives backlog.connector.idle.s from it, so a
+        # one-branch stall (this source quiet, siblings flowing — the
+        # low-watermark deliberately excludes idle inputs, Flink-style)
+        # still has a per-source signal.  Initialized at construction:
+        # a source that never stages its FIRST row (dead topic, wrong
+        # path) must show a growing idle age, not no signal at all
+        self.last_row_mono: float = _time.monotonic()
         self.persist_state: Any = None  # engine.persistence.SourceState
         # external-resume sources emit no Offset markers; their chunks flush
         # at commit boundaries instead (offset frontier stays None)
@@ -322,6 +330,7 @@ class _QueuePoller:
                     log.record(key, vrow, 1)
         if rows:
             self._staged = True
+            self.last_row_mono = _time.monotonic()
 
     def _key_of(self, values: list, row: Mapping) -> int:
         if "_pw_key" in row:
@@ -413,6 +422,7 @@ class _QueuePoller:
             if self.persist_state is not None and not self.persist_state.operator_mode:
                 self.persist_state.log.record(key, vrow, diff)
             self._staged = True
+            self.last_row_mono = _time.monotonic()
         if self._staged and (_time.monotonic() - self._last_commit) >= self.autocommit:
             # operator-persisting sources close epochs only at COMMIT/Offset
             # markers: a timer-closed epoch could be processed and dumped
@@ -625,7 +635,9 @@ def make_input_table(
             # supervision loop's budget + restart/reseek path
             emit_fn = tracker
             fault_plan = _faults.active_plan()
-            if fault_plan is not None and fault_plan.has("connector_read"):
+            if fault_plan is not None and fault_plan.has(
+                "connector_read", "connector_stall"
+            ):
                 source_name = type(reader).__name__
 
                 def emit_fn(item, _tracker=tracker):
@@ -633,6 +645,18 @@ def make_input_table(
                         raise _faults.InjectedFault(
                             f"injected connector_read failure in {source_name}"
                         )
+                    stall = fault_plan.check(
+                        "connector_stall", source=source_name
+                    )
+                    if stall is not None:
+                        # a stuck upstream: the item arrives LATE, nothing
+                        # errors, no epoch slows — only output.staleness.s
+                        # (engine/freshness.py) can see this happen.  The
+                        # delay is honored exactly as declared (a spec
+                        # without delay_ms stalls 0 ms, i.e. not at all)
+                        deadline = _time.monotonic() + stall.delay_ms / 1000.0
+                        while _time.monotonic() < deadline:
+                            _time.sleep(0.02)  # interruptible pacing
                     _tracker(item)
 
             consecutive = 0

@@ -50,6 +50,24 @@ def _sample_registry() -> MetricsRegistry:
         "seconds since the worker's last epoch-progress beacon",
         worker=1,
     ).set(7.5)
+    # the data-plane freshness/backpressure surface (engine/freshness.py):
+    # per-output staleness plus two backlog.* wait points, so the golden
+    # pins the families dashboards rank bottlenecks by
+    reg.gauge(
+        "output.staleness.s",
+        "seconds since the ingest stamp of the newest data an output "
+        "reflects",
+        output="sink",
+    ).set(2.5)
+    reg.gauge(
+        "backlog.connector.queue",
+        "items waiting in a connector's reader queue",
+        source="src",
+    ).set(4)
+    reg.gauge(
+        "backlog.epochs.pending",
+        "distinct staged epoch timestamps awaiting processing",
+    ).set(1)
     return reg
 
 
@@ -101,6 +119,12 @@ def test_registry_collector_weakref_dies_with_owner():
 
 
 GOLDEN_PROMETHEUS = """\
+# HELP pathway_backlog_connector_queue items waiting in a connector's reader queue
+# TYPE pathway_backlog_connector_queue gauge
+pathway_backlog_connector_queue{source="src",run_id="r7"} 4
+# HELP pathway_backlog_epochs_pending distinct staged epoch timestamps awaiting processing
+# TYPE pathway_backlog_epochs_pending gauge
+pathway_backlog_epochs_pending{run_id="r7"} 1
 # HELP pathway_checkpoint_inflight_jobs in-flight artifact writes
 # TYPE pathway_checkpoint_inflight_jobs gauge
 pathway_checkpoint_inflight_jobs{run_id="r7"} 3
@@ -124,6 +148,9 @@ pathway_epoch_duration_ms_p95{worker="0",run_id="r7"} 100
 # HELP pathway_epoch_duration_ms_p99 p99 estimate of wall time of one processed epoch (ms)
 # TYPE pathway_epoch_duration_ms_p99 gauge
 pathway_epoch_duration_ms_p99{worker="0",run_id="r7"} 100
+# HELP pathway_output_staleness_s seconds since the ingest stamp of the newest data an output reflects
+# TYPE pathway_output_staleness_s gauge
+pathway_output_staleness_s{output="sink",run_id="r7"} 2.5
 # HELP pathway_supervisor_watchdog_kills hung workers killed by the progress watchdog
 # TYPE pathway_supervisor_watchdog_kills counter
 pathway_supervisor_watchdog_kills{run_id="r7"} 1
@@ -184,6 +211,17 @@ def test_otlp_histogram_mapping_golden():
     assert dp["attributes"] == [
         {"key": "worker", "value": {"stringValue": "1"}}
     ]
+    # the freshness/backlog families ride the same OTLP export
+    dp = gauges["output.staleness.s"]["gauge"]["dataPoints"][0]
+    assert dp["asDouble"] == 2.5
+    assert dp["attributes"] == [
+        {"key": "output", "value": {"stringValue": "sink"}}
+    ]
+    dp = gauges["backlog.connector.queue"]["gauge"]["dataPoints"][0]
+    assert dp["asDouble"] == 4.0
+    assert gauges["backlog.epochs.pending"]["gauge"]["dataPoints"][0][
+        "asDouble"
+    ] == 1.0
 
 
 def test_telemetry_sample_carries_registry_and_otlp_histograms():
