@@ -91,6 +91,11 @@ SUITE: tuple[Bench, ...] = (
     Bench(
         "freshness_overhead", "freshness_overhead.py", ("smoke",), (),
     ),
+    # elastic rescale: time-to-recover of a repartitioning (N -> N')
+    # resume vs a same-topology one, plus its read amplification
+    Bench(
+        "rescale_recovery", "rescale_recovery.py", ("smoke",), ("full",),
+    ),
 )
 
 MODE_REPS = {"smoke": 3, "full": 3}

@@ -1,0 +1,117 @@
+"""Rescale-recovery benchmark: what does an N -> N' resume cost?
+
+An elastic rescale (docs/fault_tolerance.md, "Elastic rescale") resumes
+by reading EVERY old worker's committed chunks on EVERY new worker and
+keeping each worker's shard (``shard_to_worker(key, N')``), so its read
+amplification is ~N' relative to a same-topology resume (which reads each
+chunk exactly once, on its owner).  This harness prices both paths on the
+same committed root, so `pathway_tpu bench --smoke --check` catches
+recovery-time regressions in the repartition machinery:
+
+* ``rescale_same_n_resume_ms`` — resume the root at its own topology;
+* ``rescale_repartition_resume_ms`` — resume it at N' = N/2;
+* ``rescale_read_amplification_cost`` — chunks read during refs replay
+  divided by chunks committed (expected ~N'; a jump means the dedup or
+  the converged-shard detection broke and chunks are re-read).
+
+Usage: ``python benchmarks/rescale_recovery.py [smoke|full]``
+Prints one JSON line per metric (harness.py protocol).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_OLD = 4
+N_NEW = 2
+SCHEMA = "k:INT|v:INT"
+
+
+def _key(w: int, i: int) -> int:
+    return ((w * 100_000 + i + 1) << 16) | ((w * 7919 + i * 31) & 0xFFFF)
+
+
+def _seed(root: str, chunks: int, rows_per_chunk: int) -> int:
+    """Commit ``chunks`` chunks of ``rows_per_chunk`` rows per old worker;
+    returns the total committed chunk count."""
+    from pathway_tpu.engine import persistence as pz
+
+    os.environ["PATHWAY_PROCESSES"] = str(N_OLD)
+    backend = pz.FileBackend(root)
+    for w in range(N_OLD):
+        storage = pz.PersistentStorage(backend, worker=w)
+        state = storage.register_source(f"src-w{w}", schema_digest=SCHEMA)
+        for c in range(chunks):
+            for i in range(rows_per_chunk):
+                state.log.record(_key(w, c * rows_per_chunk + i), (w, i), 1)
+            state.log.flush_chunk()
+        state.pending_offset = {f"file-{w}": [1.0, chunks * rows_per_chunk]}
+        storage.commit()
+    return N_OLD * chunks
+
+
+def _resume(root: str, n: int) -> int:
+    """Resume every worker of topology ``n`` and replay; returns rows."""
+    from pathway_tpu.engine import persistence as pz
+
+    os.environ["PATHWAY_PROCESSES"] = str(n)
+    backend = pz.FileBackend(root)
+    total = 0
+    for w in range(n):
+        storage = pz.PersistentStorage(backend, worker=w)
+        sid = f"src-w{w}" if n > 1 else "src"
+        state = storage.register_source(sid, schema_digest=SCHEMA)
+        total += storage.replay_into(state, lambda k, r, d: None)
+    return total
+
+
+def main() -> None:
+    smoke = len(sys.argv) > 1 and sys.argv[1] == "smoke"
+    chunks = 3 if smoke else 8
+    rows_per_chunk = 400 if smoke else 4000
+
+    from pathway_tpu.engine import metrics as em
+
+    with tempfile.TemporaryDirectory(prefix="pw-rescale-") as root:
+        committed_chunks = _seed(root, chunks, rows_per_chunk)
+        total_rows = N_OLD * chunks * rows_per_chunk
+
+        t0 = time.perf_counter()
+        rows_same = _resume(root, N_OLD)
+        same_ms = (time.perf_counter() - t0) * 1000.0
+        assert rows_same == total_rows, (rows_same, total_rows)
+
+        chunks_before = em.get_registry().scalar_metrics()
+        t0 = time.perf_counter()
+        rows_rescale = _resume(root, N_NEW)
+        rescale_ms = (time.perf_counter() - t0) * 1000.0
+        assert rows_rescale == total_rows, (rows_rescale, total_rows)
+        chunks_after = em.get_registry().scalar_metrics()
+
+        chunks_read = sum(
+            chunks_after.get(f"persistence.repartition.chunks{{worker={w}}}", 0.0)
+            - chunks_before.get(
+                f"persistence.repartition.chunks{{worker={w}}}", 0.0
+            )
+            for w in range(N_NEW)
+        )
+        amplification = chunks_read / committed_chunks
+
+    for metric, value in (
+        ("rescale_same_n_resume_ms", same_ms),
+        ("rescale_repartition_resume_ms", rescale_ms),
+        ("rescale_read_amplification_cost", amplification),
+    ):
+        print(json.dumps({"metric": metric, "value": round(value, 4)}))
+
+
+if __name__ == "__main__":
+    main()

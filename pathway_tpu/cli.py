@@ -57,6 +57,7 @@ def spawn_program(
     supervise: bool = False,
     max_restarts: int = 3,
     checkpoint_root: str | None = None,
+    shrink_on_loss: bool | None = None,
 ) -> NoReturn:
     """Launch ``processes`` copies of ``program`` forming one SPMD cluster.
 
@@ -65,6 +66,15 @@ def spawn_program(
     the last committed persistence checkpoint and respawns it, up to
     ``max_restarts`` times — same run id, ports and comm secret, so the
     recovered cluster resumes exactly where the snapshots left off.
+
+    Elastic rescale: relaunching a supervised run with a DIFFERENT ``-n``
+    on the same ``--checkpoint-root`` is supported — the supervisor
+    records the new topology in the incarnation lease and the workers
+    re-partition checkpointed state by shard range on resume.  With
+    ``shrink_on_loss=True`` (or ``PATHWAY_DEGRADED_SHRINK=1``) the
+    supervisor performs that rescale on its own when the same worker
+    fails every attempt of a spent restart budget — a permanently lost
+    host completes the run at the surviving count instead of failing it.
     """
     click.echo(
         f"[pathway_tpu] launching SPMD cluster: {processes} process(es), "
@@ -95,11 +105,16 @@ def spawn_program(
             SupervisorError,
         )
 
-        def spawn_one(process_id: int, attempt: int) -> subprocess.Popen:
+        def spawn_one(
+            process_id: int, attempt: int, n_workers: int = processes
+        ) -> subprocess.Popen:
+            # n_workers is the CURRENT cluster size (the supervisor passes
+            # it explicitly so a degraded-mode shrink launches the smaller
+            # topology with a matching PATHWAY_PROCESSES)
             env = _cluster_env(
                 env_base,
                 threads=threads,
-                processes=processes,
+                processes=n_workers,
                 first_port=first_port,
                 process_id=process_id,
                 run_id=run_id,
@@ -132,6 +147,7 @@ def spawn_program(
                 processes,
                 max_restarts=max_restarts,
                 checkpoint_root=checkpoint_root,
+                shrink_on_loss=shrink_on_loss,
             ).run()
         except SupervisorError as exc:
             click.echo(f"[pathway_tpu] {exc}", err=True)
@@ -143,6 +159,15 @@ def spawn_program(
             click.echo(
                 f"[pathway_tpu] recovered after {result.restarts} restart(s) "
                 f"(last failure: {result.last_failure})",
+                err=True,
+            )
+        for rescale in result.rescales:
+            click.echo(
+                f"[pathway_tpu] degraded-mode shrink: worker "
+                f"{rescale['lost_worker']} treated as permanently lost on "
+                f"attempt {rescale['attempt']} — cluster rescaled "
+                f"{rescale['from']} -> {rescale['to']} worker(s); state "
+                "re-partitioned by shard range",
                 err=True,
             )
         # corruption fallback can happen WITHOUT any crash (root damaged at
@@ -258,10 +283,26 @@ _SPAWN_SETTINGS = {"allow_interspersed_args": False, "show_default": True}
     "recovery provenance (which verified generation each worker resumed "
     "from) is reported after the run",
 )
+@click.option(
+    "--shrink-on-loss",
+    is_flag=True,
+    default=None,
+    help="supervised mode: when the SAME worker fails every attempt of a "
+    "spent restart budget (a permanently lost host, not a crash loop), "
+    "rescale the cluster to the surviving count instead of failing — "
+    "checkpointed state re-partitions by shard range on resume "
+    "(PATHWAY_DEGRADED_SHRINK=1 is the env form)",
+)
 @click.argument("program")
 @click.argument("arguments", nargs=-1)
-def spawn(threads, processes, first_port, record, record_path, jax_distributed, supervise, max_restarts, checkpoint_root, program, arguments):
-    """Run PROGRAM as an SPMD cluster of identical processes."""
+def spawn(threads, processes, first_port, record, record_path, jax_distributed, supervise, max_restarts, checkpoint_root, shrink_on_loss, program, arguments):
+    """Run PROGRAM as an SPMD cluster of identical processes.
+
+    Re-running a supervised program with a different ``-n`` against the
+    same ``--checkpoint-root`` performs an elastic rescale: resume
+    re-partitions the committed snapshots by shard range under the new
+    worker count (see docs/fault_tolerance.md, "Elastic rescale").
+    """
     env = (
         _recording_env(
             access="record", record_path=record_path, continue_after_replay=True
@@ -281,6 +322,7 @@ def spawn(threads, processes, first_port, record, record_path, jax_distributed, 
         supervise=supervise,
         max_restarts=max_restarts,
         checkpoint_root=checkpoint_root,
+        shrink_on_loss=shrink_on_loss,
     )
 
 
@@ -375,11 +417,22 @@ def scrub(worker, as_json, repair, root):
                 click.echo(
                     f"  lease: incarnation {lease['incarnation']} "
                     f"(owner: {lease.get('owner')})"
+                    + (f", topology {lease['workers']} worker(s)"
+                       if isinstance(lease.get("workers"), int) else "")
                     + (f", progress beacons for workers {beacons}"
                        if beacons else "")
                 )
             else:
                 click.echo(f"  lease: DAMAGED — {lease.get('error')}")
+        topo = report.get("topology")
+        if topo is not None:
+            history = topo.get("history") or []
+            if len(history) > 1:
+                trail = " -> ".join(
+                    f"{h.get('workers')}@inc{h.get('incarnation')}"
+                    for h in history
+                )
+                click.echo(f"  rescale history: {trail}")
         bb = report.get("blackbox")
         if bb is not None:
             click.echo(
@@ -392,6 +445,10 @@ def scrub(worker, as_json, repair, root):
             click.echo("  no checkpoint state found")
         for wid, wrep in sorted(report["workers"].items()):
             status = "OK" if wrep["ok"] else "DAMAGED"
+            if wrep.get("orphaned"):
+                status = f"ORPHANED ({wrep.get('status', 'fenced, pending GC')})"
+            elif wrep.get("pending_repartition"):
+                status += " (old topology, pending repartition)"
             click.echo(
                 f"  worker {wid}: {status} — newest generation "
                 f"{wrep['newest']}, newest verified {wrep['newest_verified']}"
@@ -404,9 +461,19 @@ def scrub(worker, as_json, repair, root):
             for entry in wrep["generations"]:
                 mark = "ok" if entry["ok"] else "CORRUPT"
                 stamp = entry.get("incarnation")
+                topo_stamp = entry.get("topology")
+                notes = []
+                if stamp:
+                    notes.append(f"incarnation {stamp}")
+                if topo_stamp:
+                    notes.append(f"topology {topo_stamp}")
+                if entry.get("repartitioned_from"):
+                    notes.append(
+                        f"repartitioned from {entry['repartitioned_from']}"
+                    )
                 click.echo(
                     f"    generation {entry['generation']}: {mark}"
-                    + (f" (incarnation {stamp})" if stamp else "")
+                    + (f" ({', '.join(notes)})" if notes else "")
                 )
                 for problem in entry["problems"]:
                     click.echo(f"      - {problem}")

@@ -127,6 +127,14 @@ METRICS: dict[str, tuple[str, str]] = {
     "persistence.scrub.runs": ("counter", "offline scrub audits run"),
     "persistence.scrub.damaged": (
         "counter", "scrub audits that found damage"),
+    # elastic rescale (engine/persistence.py repartition resume)
+    "persistence.repartition.sources": (
+        "counter", "base sources re-partitioned by a topology-rescale resume"),
+    "persistence.repartition.rows": (
+        "counter", "rows replayed from superseded-topology logs (post shard "
+        "filter)"),
+    "persistence.repartition.chunks": (
+        "counter", "superseded-topology chunks read during refs replay"),
     "checkpoint.commit.buffer": ("gauge", "cumulative encode/join seconds"),
     "checkpoint.commit.frame": ("gauge", "cumulative integrity-framing seconds"),
     "checkpoint.commit.hash": ("gauge", "cumulative SHA-256 seconds"),
@@ -148,6 +156,9 @@ METRICS: dict[str, tuple[str, str]] = {
     # supervisor (engine/supervisor.py)
     "supervisor.restarts": (
         "counter", "cluster rollback-and-respawn recoveries performed"),
+    "supervisor.rescales": (
+        "counter", "degraded-mode cluster rescales performed (worker-loss "
+        "shrink)"),
     "supervisor.watchdog.kills": (
         "counter", "hung workers killed by the progress watchdog"),
     "worker.restart.attempt": (

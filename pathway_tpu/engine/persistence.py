@@ -70,6 +70,18 @@ LEASE_KEY = "lease/LEASE"
 LEASE_FORMAT = 1
 ENV_INCARNATION = "PATHWAY_INCARNATION"
 
+# -- elastic rescale (topology marker) --------------------------------------
+# The root-level record of the CURRENT topology epoch: {"seq", "workers",
+# "from_workers", "at"}.  ``seq`` increments on every rescale, and every
+# manifest is stamped with the epoch it was published under — that is what
+# makes a STALE shard detectable even when its stamped worker count
+# coincidentally matches the current one (a 2 -> 1 -> 2 round trip).  The
+# marker is written by the repartitioning workers themselves (idempotent:
+# every worker of one rescale computes the same successor epoch), so it
+# exists on supervised and solo roots alike.
+TOPOLOGY_KEY = "topology/CURRENT"
+TOPOLOGY_FORMAT = 1
+
 _log = logging.getLogger("pathway_tpu.persistence")
 
 
@@ -125,6 +137,7 @@ def acquire_lease(
     *,
     owner: str | None = None,
     run_id: str | None = None,
+    workers: int | None = None,
 ) -> int:
     """Bump the root's lease to the next incarnation and return it.
 
@@ -133,18 +146,154 @@ def acquire_lease(
     lingering zombie from a previous run is fenced on its next publish.
     Single-supervisor protocol — the lease serializes worker incarnations
     under one supervisor, it is not a distributed lock between supervisors.
+
+    ``workers`` records the TARGET TOPOLOGY of this incarnation — the
+    worker count the supervisor is about to launch.  The lease is the
+    authoritative record an elastic rescale leaves behind: workers verify
+    their own ``PATHWAY_PROCESSES`` against it at boot (the topology
+    handshake in ``internals/runner.py``), and ``pathway_tpu scrub``
+    renders the rescale history kept in ``topology_history`` (bounded to
+    the last 16 changes).  ``None`` carries the previous recorded topology
+    forward unchanged.
     """
     current = read_lease(backend)
     incarnation = (current["incarnation"] if current else 0) + 1
+    history = list((current or {}).get("topology_history") or [])
+    recorded = workers if workers is not None else (current or {}).get("workers")
+    if workers is not None and (
+        not history or history[-1].get("workers") != workers
+    ):
+        history.append(
+            {
+                "incarnation": incarnation,
+                "workers": workers,
+                "at": _time.time(),
+            }
+        )
     lease = {
         "format": LEASE_FORMAT,
         "incarnation": incarnation,
         "acquired_at": _time.time(),
         "owner": owner or f"pid-{os.getpid()}",
         "run_id": run_id,
+        "workers": recorded,
+        "topology_history": history[-16:],
     }
     backend.put_atomic(LEASE_KEY, codec.frame_blob(_json.dumps(lease).encode()))
     return incarnation
+
+
+def read_topology_marker(backend: "BlobBackend") -> dict | None:
+    """The root's current topology-epoch marker, or None when absent or
+    unreadable (a pre-rescale root has none; an unreadable marker degrades
+    to stamp-based detection and scrub reports it)."""
+    raw = backend.get(TOPOLOGY_KEY)
+    if raw is None:
+        return None
+    try:
+        obj = _json.loads(
+            codec.unframe_blob(raw, what=TOPOLOGY_KEY).decode()
+        )
+    except (codec.IntegrityError, ValueError):
+        return None
+    if (
+        not isinstance(obj, dict)
+        or not isinstance(obj.get("seq"), int)
+        or not isinstance(obj.get("workers"), int)
+    ):
+        return None
+    return obj
+
+
+def read_lease_file(root: str) -> dict | None:
+    """Read a filesystem root's lease WITHOUT constructing a FileBackend
+    (which would mkdir the root as a side effect) — the boot-time topology
+    handshake must stay read-only.  None when absent or unreadable."""
+    path = os.path.join(root, *LEASE_KEY.split("/"))
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return None
+    return _decode_lease(raw)
+
+
+_BASE_SID_RE = None
+
+
+def base_source_id(source_id: str) -> str:
+    """Strip the per-worker ``-w<N>`` suffix of a snapshot source id.
+
+    Multi-worker runs shard source logs as ``<name>-w<worker>``; a
+    topology rescale matches old and new logs by this BASE name, so
+    ``src-w3`` of a 4-worker root and ``src-w1`` of its 2-worker successor
+    are the same logical source."""
+    global _BASE_SID_RE
+    if _BASE_SID_RE is None:
+        import re
+
+        _BASE_SID_RE = re.compile(r"-w\d+$")
+    return _BASE_SID_RE.sub("", source_id)
+
+
+def merge_offsets(offsets: list[Any], *, source: str = "?") -> Any:
+    """Merge the reader offset frontiers of several old-topology workers
+    into one frontier the re-striped reader can ``seek`` to.
+
+    Per-file progress maps (the FileReader/S3 shape: ``{path: [mtime,
+    units]}``) union — stripes are disjoint, and on the rare overlap (a
+    file reassigned mid-rescale) the entry with the larger trailing
+    progress value wins.  Row-count frontiers (``{"rows": n}``) cannot be
+    re-striped: they are only mergeable when a single old worker held one
+    (the non-partitioned-source case, which reads on worker 0 under every
+    topology).  Opaque non-dict offsets merge only when identical.
+    Raises :class:`CheckpointError` on an unmergeable combination — the
+    source then cannot rescale and the operator must intervene.
+    """
+    present = [o for o in offsets if o is not None]
+    if not present:
+        return None
+    if len(present) == 1:
+        return present[0]
+    if all(isinstance(o, dict) for o in present):
+        if any("rows" in o for o in present):
+            rows = [o for o in present if "rows" in o]
+            if len(rows) > 1:
+                raise CheckpointError(
+                    f"persistence: source {source!r} committed row-count "
+                    f"offset frontiers on {len(rows)} old workers — "
+                    "row-count frontiers cannot be re-striped across a "
+                    "topology rescale (give the source an offset-aware "
+                    "reader, or clear the persistence root)"
+                )
+        merged: dict = {}
+        for off in present:
+            for k, v in off.items():
+                if k not in merged:
+                    merged[k] = v
+                    continue
+                prev = merged[k]
+                try:
+                    # per-file progress entries are [mtime, units]: keep
+                    # the one that consumed more
+                    if (
+                        isinstance(v, (list, tuple))
+                        and isinstance(prev, (list, tuple))
+                        and len(v) == len(prev) >= 1
+                        and v[-1] > prev[-1]
+                    ):
+                        merged[k] = v
+                except TypeError:
+                    pass  # incomparable: first wins, deterministically
+        return merged
+    first = present[0]
+    if all(o == first for o in present[1:]):
+        return first
+    raise CheckpointError(
+        f"persistence: source {source!r} committed opaque offset frontiers "
+        "that differ across old workers — this source cannot rescale "
+        "(clear the persistence root to deliberately re-ingest)"
+    )
 
 
 def _retain_generations() -> int:
@@ -348,16 +497,42 @@ class FileBackend(BlobBackend):
         # content, never a torn file and never a lost rename.  The rename
         # itself is a parent-directory mutation, so the dirfd fsync below
         # is what makes the commit durable (fsyncing the file alone leaves
-        # the rename in the page cache).
+        # the rename in the page cache).  The staging name is per-process:
+        # cluster-shared keys (the topology marker) are written by several
+        # workers concurrently, and a shared ``.tmp`` would let one
+        # writer's rename consume another's staging file mid-flight.
         path = self._path(key)
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
+        tmp = f"{path}.{os.getpid()}.tmp"
         with open(tmp, "wb") as f:
             f.write(data)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
         _fsync_dir(os.path.dirname(path))
+        # unlike the old fixed ".tmp" name, a per-pid staging file is
+        # never reclaimed by the next writer of its key, so a crash
+        # mid-put_atomic would leak it forever; opportunistically sweep
+        # stale siblings (a live put_atomic stages and renames within
+        # seconds — minutes-old staging files have no owner).  Supervised
+        # restarts also settle *.tmp residue; this covers solo runs.
+        self._sweep_stale_staging(os.path.dirname(path))
+
+    @staticmethod
+    def _sweep_stale_staging(dirname: str, *, max_age_s: float = 300.0) -> None:
+        cutoff = _time.time() - max_age_s
+        try:
+            with os.scandir(dirname) as entries:
+                for entry in entries:
+                    if not entry.name.endswith(".tmp"):
+                        continue
+                    try:
+                        if entry.stat().st_mtime < cutoff:
+                            os.remove(entry.path)
+                    except OSError:
+                        pass
+        except OSError:
+            pass
 
     def put_staged(self, key: str, data: bytes) -> None:
         # file BYTES are made durable here (the writer pool spreads these
@@ -1113,61 +1288,104 @@ class SnapshotLog:
         self,
         committed_chunks: int,
         *,
+        start: int = 0,
         generation: int = 0,
         digests: list[str | None] | None = None,
         verified: set[str] | None = None,
     ):
-        """Yield (kind, key, row, time) from the first `committed_chunks`.
+        """Yield (kind, key, row, time) from chunks [start, committed_chunks).
 
         Errors name the backend, the source log prefix and the generation,
         so an operator can locate the damaged artifact directly.
+
+        ``start`` — the first chunk index belonging to this log's own
+        range (``SourceState.chunk_start``): a log re-seeded by a topology
+        rescale appends ABOVE the superseded topology's committed chunks,
+        whose rows are covered by the manifest's ``refs`` instead.
 
         ``verified`` — the storage's process-lifetime artifact cache: a
         chunk whose ``key:digest`` token is present was already digest-
         verified this process (by ``_load_state``), so replay skips
         re-hashing it; resume then hashes each chunk once, not twice.
         """
-        for i in range(committed_chunks):
-            key = f"{self.prefix}/{i:08d}"
-            data = self.backend.get(key)
-            if data is None:
-                raise CheckpointError(
-                    f"persistence: missing committed "
-                    + self._chunk_context(i, generation)
-                )
-            digest = digests[i] if digests is not None and i < len(digests) else None
-            if (
-                digest is not None
-                and (verified is None or f"{key}:{digest}" not in verified)
-                and _sha256(data) != digest
-            ):
-                raise CheckpointError(
-                    "persistence: digest mismatch on committed "
-                    + self._chunk_context(i, generation)
-                )
-            try:
-                payload = codec.unframe_blob(
-                    data,
-                    what=f"{self.prefix}/{i:08d}",
-                    allow_legacy=digest is None,
-                    # a matched SHA-256 digest subsumes the frame CRC
-                    verify_crc=digest is None,
-                )
-            except codec.IntegrityError as exc:
-                raise CheckpointError(
-                    f"persistence: corrupt committed "
-                    f"{self._chunk_context(i, generation)}: {exc}"
-                ) from exc
-            try:
-                yield from codec.decode_events(payload)
-            except ValueError as exc:
-                # legacy (digest-less) chunks can rot undetected by any
-                # frame; surface decode failures with the same locator
-                # context as frame/digest failures
-                raise CheckpointError(
-                    f"persistence: undecodable events in committed "
-                    f"{self._chunk_context(i, generation)}: {exc}"
-                ) from exc
+        yield from _read_chunks(
+            self.backend,
+            self.prefix,
+            start,
+            committed_chunks,
+            digests,
+            digests_base=0,
+            generation=generation,
+            verified=verified,
+        )
+
+
+def _read_chunks(
+    backend: BlobBackend,
+    prefix: str,
+    start: int,
+    end: int,
+    digests: list[str | None] | None,
+    *,
+    digests_base: int = 0,
+    generation: int = 0,
+    verified: set[str] | None = None,
+):
+    """Yield decoded events from chunks ``[start, end)`` of one log prefix.
+
+    The single chunk-read path shared by own-log replay
+    (:meth:`SnapshotLog.read_committed`) and cross-worker ``refs`` replay
+    after a topology rescale, so integrity handling cannot drift between
+    them.  ``digests[i - digests_base]`` pins chunk ``i`` (manifest ref
+    entries store digests relative to their own ``start``)."""
+
+    def context(i: int) -> str:
+        return (
+            f"chunk {i} of {prefix} (generation {generation}) "
+            f"in backend {backend.describe()}"
+        )
+
+    for i in range(start, end):
+        key = f"{prefix}/{i:08d}"
+        data = backend.get(key)
+        if data is None:
+            raise CheckpointError(
+                "persistence: missing committed " + context(i)
+            )
+        j = i - digests_base
+        digest = (
+            digests[j] if digests is not None and 0 <= j < len(digests) else None
+        )
+        if (
+            digest is not None
+            and (verified is None or f"{key}:{digest}" not in verified)
+            and _sha256(data) != digest
+        ):
+            raise CheckpointError(
+                "persistence: digest mismatch on committed " + context(i)
+            )
+        try:
+            payload = codec.unframe_blob(
+                data,
+                what=key,
+                allow_legacy=digest is None,
+                # a matched SHA-256 digest subsumes the frame CRC
+                verify_crc=digest is None,
+            )
+        except codec.IntegrityError as exc:
+            raise CheckpointError(
+                f"persistence: corrupt committed {context(i)}: {exc}"
+            ) from exc
+        try:
+            yield from codec.decode_events(payload)
+        except ValueError as exc:
+            # legacy (digest-less) chunks can rot undetected by any
+            # frame; surface decode failures with the same locator
+            # context as frame/digest failures
+            raise CheckpointError(
+                f"persistence: undecodable events in committed "
+                f"{context(i)}: {exc}"
+            ) from exc
 
 
 # ---------------------------------------------------------------------------
@@ -1192,6 +1410,22 @@ class SourceState:
         # the sequence so fresh rows never collide with keys that already
         # live inside restored operator state / replayed snapshots
         self.key_seq = 0
+        # elastic-rescale state (engine-wide design: docs/fault_tolerance.md
+        # "Elastic rescale"): chunk_start is the first chunk index of this
+        # log's OWN range — a rescale re-seeds the log above the superseded
+        # topology's committed chunks so they are never clobbered; refs are
+        # pinned references {worker, source, start, chunks, chunk_digests}
+        # to committed chunk ranges of OTHER (old-topology) logs, replayed
+        # filtered by shard_to_worker(key, current_topology) and carried
+        # forward in every manifest so the scheme composes across chained
+        # rescales
+        self.chunk_start = 0
+        self.refs: list[dict] = []
+        # the BASE (worker-suffix-free) source name, recorded in every
+        # manifest so rescale matching never has to guess whether a
+        # trailing ``-w<N>`` was appended by the engine or is part of the
+        # user's own name
+        self.base: str | None = None
 
 
 class PersistentStorage:
@@ -1294,6 +1528,22 @@ class PersistentStorage:
         # full walk (catching residue from prior runs), then is O(delta).
         self._known_generations: set[int] = set()
         self._op_index: set[str] | None = None
+        # elastic rescale: the topology (worker count) THIS process runs
+        # under; _load_state compares it against the topology stamped on
+        # the root's newest manifests and, on mismatch, enters repartition
+        # resume — gathering every old worker's newest verified generation
+        # into per-base-source refs replayed by shard range.
+        self.topology = max(1, _cluster_processes())
+        # the topology EPOCH this storage runs in (see TOPOLOGY_KEY):
+        # incremented by every rescale, stamped into every manifest, and
+        # the staleness test for shards whose stamped worker count
+        # coincidentally matches the current one
+        self.topology_seq = 0
+        self.repartitioned_from: int | None = None
+        # base source name -> {"offset", "key_seq", "schema", "refs",
+        # "own_chunks"} gathered from the superseded topology's manifests;
+        # None outside repartition resume
+        self._repartition: dict[str, dict] | None = None
         self._metadata = self._load_state()
         self.replayed_rows = 0
         if (
@@ -1401,6 +1651,194 @@ class PersistentStorage:
                 out[int(tail)] = key
         return out
 
+    def _scan_root_manifests(self) -> dict[int, list[tuple[int, str]]]:
+        """{worker: [(generation, key) newest-first]} for EVERY manifest on
+        the root — the cross-worker view a topology-rescale resume (and
+        orphan-topology GC) reads.  One listing; the common same-topology
+        resume never calls this."""
+        out: dict[int, list[tuple[int, str]]] = {}
+        for key in self.backend.list_keys("manifests/"):
+            parts = key.split("/")
+            if len(parts) == 3 and parts[1].isdigit() and parts[2].isdigit():
+                out.setdefault(int(parts[1]), []).append((int(parts[2]), key))
+        for entries in out.values():
+            entries.sort(reverse=True)
+        return out
+
+    def _write_topology_marker(self, marker: dict | None) -> None:
+        """Publish (or refresh) the root's topology-epoch marker for the
+        epoch this repartition opened.  Idempotent across the workers of
+        one rescale: they all compute the same (seq, workers) and the
+        write is a whole-blob atomic put."""
+        if (
+            marker is not None
+            and marker.get("workers") == self.topology
+            and marker.get("seq") == self.topology_seq
+        ):
+            return
+        payload = {
+            "format": TOPOLOGY_FORMAT,
+            "seq": self.topology_seq,
+            "workers": self.topology,
+            "from_workers": self.repartitioned_from,
+            "at": _time.time(),
+        }
+        self.backend.put_atomic(
+            TOPOLOGY_KEY, codec.frame_blob(_json.dumps(payload).encode())
+        )
+
+    def _gather_repartition(
+        self,
+        root: dict[int, list[tuple[int, str]]],
+        own_adopted: dict | None,
+        *,
+        seq: int,
+    ) -> dict[str, dict]:
+        """Gather the superseded topology's committed state into per-base
+        repartition metadata: for every worker prefix on the root, adopt
+        its newest fully verified generation, flatten its sources' own
+        chunk ranges and carried ``refs`` into one deduplicated ref set per
+        BASE source name, and merge the reader offset frontiers.
+
+        A converged worker (manifest already stamped with the CURRENT
+        topology — the mixed state a crash mid-rescale leaves) contributes
+        only its carried refs: its own post-rescale chunks are replayed by
+        that worker itself, unfiltered, and re-routed by the exchange.  An
+        unconverged or orphaned worker's own range becomes a ref, replayed
+        by every new worker filtered to its shard.  Refs dedup by
+        ``(worker, source, start)`` and a containment filter — the same
+        old range reached through several manifests must replay once,
+        while DISJOINT sub-ranges of one log (a carried ref covering the
+        original epoch plus the own range a later epoch appended above
+        it) must each survive."""
+        if self.operator_persistence:
+            raise CheckpointError(
+                f"persistence: worker {self.worker} found checkpoints "
+                f"written under a different worker topology in backend "
+                f"{self.backend.describe()}, but operator-persisting "
+                "snapshots are opaque per-node state and cannot be "
+                "re-partitioned by shard range. Resume at the original "
+                "worker count, or clear the persistence root to start "
+                "fresh."
+            )
+        bases: dict[str, dict] = {}
+        refs_seen: dict[tuple[str, int, str, int], dict] = {}
+        for w in sorted(root):
+            if w == self.worker:
+                adopted = own_adopted  # already verified (or absent)
+            else:
+                adopted = None
+                for gen, key in root[w]:
+                    manifest, reason = _read_manifest(self.backend, key)
+                    if manifest is None:
+                        self.rejected_generations.append(
+                            (gen, f"worker {w}: {reason or 'unreadable'}")
+                        )
+                        continue
+                    problems = verify_manifest(
+                        self.backend, w, manifest,
+                        cache=self._verified_artifacts,
+                    )
+                    if problems:
+                        self.rejected_generations.append(
+                            (gen, f"worker {w}: " + "; ".join(problems[:3]))
+                        )
+                        continue
+                    adopted = manifest
+                    break
+            if adopted is None and root[w]:
+                # symmetric for every shard, our own included: a worker
+                # whose generations all failed verification cannot be
+                # silently dropped from the repartition
+                raise CheckpointError(
+                    f"persistence: topology rescale needs worker {w}'s "
+                    f"committed state, but none of its {len(root[w])} "
+                    f"generation(s) in backend {self.backend.describe()} "
+                    "verified — refusing to repartition with data loss "
+                    "(run `pathway_tpu scrub` to inspect the damage)"
+                )
+            if adopted is None:
+                continue
+            m_top = adopted.get("topology")
+            # converged = already republished in the epoch being joined:
+            # that worker replays its own post-rescale chunks itself.  A
+            # NEW rescale (seq above every stamp) converges nothing.
+            converged = (
+                isinstance(m_top, int)
+                and m_top == self.topology
+                and int(adopted.get("topology_seq", 0)) == seq
+            )
+            for sid, meta in (adopted.get("sources") or {}).items():
+                # the recorded base name is authoritative (a user-chosen
+                # name may itself end in -w<N>); the strip heuristic only
+                # covers manifests written before base recording
+                base = meta.get("base") or base_source_id(sid)
+                entry = bases.setdefault(
+                    base,
+                    {"offsets": [], "key_seq": 0, "schema": None, "own": {}},
+                )
+                entry["offsets"].append(_offset_from_json(meta.get("offset")))
+                if entry["schema"] is None and meta.get("schema") is not None:
+                    entry["schema"] = meta["schema"]
+                start = int(meta.get("chunk_start", 0))
+                chunks = int(meta.get("chunks", 0))
+                if w == self.worker:
+                    entry["key_seq"] = max(
+                        entry["key_seq"], int(meta.get("key_seq", 0))
+                    )
+                    entry["own"][sid] = chunks
+                candidates = list(meta.get("refs") or [])
+                if not converged and chunks > start:
+                    candidates.append(
+                        {
+                            "worker": w,
+                            "source": sid,
+                            "start": start,
+                            "chunks": chunks,
+                            "chunk_digests": list(
+                                meta.get("chunk_digests") or []
+                            ),
+                        }
+                    )
+                for ref in candidates:
+                    rkey = (
+                        base,
+                        int(ref["worker"]),
+                        str(ref["source"]),
+                        int(ref.get("start", 0)),
+                    )
+                    prev = refs_seen.get(rkey)
+                    if prev is None or int(ref["chunks"]) > int(prev["chunks"]):
+                        refs_seen[rkey] = dict(ref)
+        for base, entry in bases.items():
+            # per-log range filter: ranges of one (worker, source) log are
+            # generated start-monotone across epochs, so any two are
+            # disjoint or nested — keep every range not fully covered by
+            # an already-kept one (dedup), never conflate disjoint ones
+            groups: dict[tuple[int, str], list[dict]] = {}
+            for (b, w, s, _start), ref in refs_seen.items():
+                if b == base:
+                    groups.setdefault((w, s), []).append(ref)
+            refs: list[dict] = []
+            for w, s in sorted(groups):
+                ranges = sorted(
+                    groups[(w, s)],
+                    key=lambda r: (int(r.get("start", 0)), -int(r["chunks"])),
+                )
+                kept: list[dict] = []
+                for ref in ranges:
+                    rs, rc = int(ref.get("start", 0)), int(ref["chunks"])
+                    if any(
+                        int(k.get("start", 0)) <= rs and int(k["chunks"]) >= rc
+                        for k in kept
+                    ):
+                        continue
+                    kept.append(ref)
+                refs.extend(kept)
+            entry["refs"] = refs
+            entry["offset"] = merge_offsets(entry.pop("offsets"), source=base)
+        return bases
+
     def _load_state(self) -> dict:
         """Adopt the newest FULLY VERIFIED generation, falling back
         generation-by-generation past damaged ones (torn manifest, missing
@@ -1410,6 +1848,13 @@ class PersistentStorage:
         offsets.  The one full manifest listing here also seeds the
         in-memory generation index incremental GC runs against.
 
+        When the adopted manifest (or the rest of the root) was written
+        under a DIFFERENT worker topology than this process runs in, the
+        resume becomes a **repartition resume**: the committed state of
+        every old worker is gathered into shard-filtered ``refs``
+        (:meth:`_gather_repartition`) and this worker starts a fresh
+        metadata lineage that republishes under the new topology.
+
         Verification reads every chunk of the candidate generation BEFORE
         adoption, and replay later re-fetches them (the verified-artifact
         cache skips the re-hash, not the re-read): falling back is only
@@ -1418,6 +1863,8 @@ class PersistentStorage:
         generation that cannot be fully restored."""
         gens = self._list_generations()
         self._known_generations = set(gens)
+        adopted: dict | None = None
+        adopted_gen = 0
         for gen in sorted(gens, reverse=True):
             manifest, reason = _read_manifest(self.backend, gens[gen])
             if manifest is None:
@@ -1432,6 +1879,129 @@ class PersistentStorage:
                     (gen, "; ".join(problems[:3]))
                 )
                 continue
+            adopted, adopted_gen = manifest, gen
+            break
+        # -- elastic rescale detection ---------------------------------
+        own_topo = adopted.get("topology") if adopted is not None else None
+        own_seq = (
+            int(adopted.get("topology_seq", 0)) if adopted is not None else 0
+        )
+        marker = read_topology_marker(self.backend)
+        repartition_from: int | None = None
+        new_seq = 0
+        root_manifests: dict[int, list[tuple[int, str]]] = {}
+        if marker is not None:
+            self.topology_seq = int(marker["seq"])
+            if marker["workers"] != self.topology:
+                # a NEW rescale of a root that has rescaled before: open
+                # the next topology epoch
+                repartition_from = int(marker["workers"])
+                new_seq = int(marker["seq"]) + 1
+            elif (
+                adopted is None
+                or own_topo != self.topology
+                or own_seq != int(marker["seq"])
+            ):
+                # this shard is STALE for the current epoch (it crashed
+                # mid-rescale, or its stamped worker count only
+                # coincidentally matches after a round trip) — or absent
+                # entirely: re-join the current epoch by gathering refs
+                root_manifests = self._scan_root_manifests()
+                repartition_from = (
+                    own_topo
+                    if isinstance(own_topo, int)
+                    and own_topo != self.topology
+                    else int(marker.get("from_workers") or marker["workers"])
+                )
+                new_seq = int(marker["seq"])
+        elif adopted is None or not isinstance(own_topo, int) or (
+            own_topo != self.topology
+        ):
+            root_manifests = self._scan_root_manifests()
+            if isinstance(own_topo, int) and own_topo != self.topology:
+                repartition_from = own_topo
+            else:
+                orphans = [w for w in root_manifests if w >= self.topology]
+                stamp = None
+                if adopted is None:
+                    # no committed state of our own: a rescale is still
+                    # recognizable from the peers' topology stamps
+                    for w in sorted(root_manifests):
+                        if w == self.worker:
+                            continue
+                        for _gen, key in root_manifests[w]:
+                            m, _r = _read_manifest(self.backend, key)
+                            if m is None:
+                                continue
+                            t = m.get("topology")
+                            if isinstance(t, int):
+                                stamp = t
+                            break
+                        if stamp is not None:
+                            break
+                if stamp is not None and stamp != self.topology:
+                    repartition_from = stamp
+                elif orphans:
+                    # worker prefixes outside the current topology: the
+                    # root was written by a larger (possibly pre-stamp)
+                    # cluster — their shards must be re-partitioned, not
+                    # silently dropped
+                    repartition_from = max(orphans) + 1
+            if repartition_from is not None:
+                new_seq = 1  # the root's first rescale opens epoch 1
+            elif (
+                adopted is not None
+                and not isinstance(own_topo, int)
+                and self.topology > 1
+            ):
+                # a pre-topology-stamp root resumed multi-worker: a GROW
+                # of such a root is undetectable (stamps are what make the
+                # old stripe layout provable), so it would resume
+                # mis-striped silently.  We cannot distinguish it from a
+                # legitimate same-count resume — warn loudly instead of
+                # breaking legacy roots.
+                _log.warning(
+                    "persistence: worker %d resumes a legacy checkpoint "
+                    "root (no topology stamps) under %d workers — if this "
+                    "root was written by a DIFFERENT worker count, the "
+                    "resume is mis-striped; legacy roots can only be "
+                    "resumed at their original count (this run's commits "
+                    "add the stamps that make future rescales safe)",
+                    self.worker, self.topology,
+                )
+        if repartition_from is not None:
+            # a zombie from a superseded incarnation must not even begin
+            # re-partitioning (let alone write the topology marker below)
+            self._check_fence("repartition the root")
+            if not root_manifests:
+                root_manifests = self._scan_root_manifests()
+            self.repartitioned_from = repartition_from
+            self.topology_seq = new_seq
+            self.generation = self.recovered_generation = adopted_gen
+            self._repartition = self._gather_repartition(
+                root_manifests, own_adopted=adopted, seq=new_seq
+            )
+            self._write_topology_marker(marker)
+            _registry.get_registry().counter(
+                "persistence.repartition.sources",
+                "base sources re-partitioned by a topology-rescale resume",
+                worker=self.worker,
+            ).inc(max(1, len(self._repartition)))
+            _blackbox.record(
+                "checkpoint.repartition", worker=self.worker,
+                from_topology=repartition_from, to_topology=self.topology,
+                bases=sorted(self._repartition),
+            )
+            _log.warning(
+                "persistence: worker %d resumes under topology %d from a "
+                "root written by %d worker(s) in %s — re-partitioning %d "
+                "source(s) by shard range",
+                self.worker, self.topology, repartition_from,
+                self.backend.describe(), len(self._repartition),
+            )
+            return {"sources": {}}
+        if adopted is not None:
+            gen = adopted_gen
             self.generation = self.recovered_generation = gen
             _blackbox.record(
                 "checkpoint.recovery", worker=self.worker, generation=gen,
@@ -1444,7 +2014,7 @@ class PersistentStorage:
                     self.worker, gen, self.backend.describe(),
                     "; ".join(f"{g}: {r}" for g, r in self.rejected_generations),
                 )
-            return manifest
+            return adopted
         # no manifest verified — try the pre-generational metadata file
         raw = self.backend.get(self._meta_key())
         if raw is not None:
@@ -1509,6 +2079,25 @@ class PersistentStorage:
             for sid, st in sorted(self.sources.items())
         ]
 
+    @staticmethod
+    def _source_meta(st: SourceState) -> dict:
+        """Manifest source entry WITHOUT chunk digests (the async path
+        fills those in post-barrier).  ``chunk_start``/``refs`` ride every
+        manifest so a rescaled root stays self-describing across resumes."""
+        meta: dict[str, Any] = {
+            "chunks": st.committed_chunks,
+            "offset": _offset_to_json(st.offset),
+            "schema": st.schema_digest,
+            "key_seq": st.key_seq,
+        }
+        if st.base is not None:
+            meta["base"] = st.base
+        if st.chunk_start:
+            meta["chunk_start"] = st.chunk_start
+        if st.refs:
+            meta["refs"] = st.refs
+        return meta
+
     def commit(
         self, processed_up_to: int | None = None, full_operator_dump: bool = False
     ) -> int:
@@ -1551,11 +2140,10 @@ class PersistentStorage:
         metadata: dict[str, Any] = {
             "sources": {
                 sid: {
-                    "chunks": st.committed_chunks,
-                    "offset": _offset_to_json(st.offset),
-                    "schema": st.schema_digest,
-                    "key_seq": st.key_seq,
-                    "chunk_digests": st.log.chunk_digests[: st.committed_chunks],
+                    **self._source_meta(st),
+                    "chunk_digests": st.log.chunk_digests[
+                        st.chunk_start : st.committed_chunks
+                    ],
                 }
                 for sid, st in self.sources.items()
             }
@@ -1670,12 +2258,7 @@ class PersistentStorage:
         sig = self._state_sig()
         sources = {
             sid: (
-                {
-                    "chunks": st.committed_chunks,
-                    "offset": _offset_to_json(st.offset),
-                    "schema": st.schema_digest,
-                    "key_seq": st.key_seq,
-                },
+                self._source_meta(st),
                 None if st.operator_mode else st.log,
             )
             for sid, st in self.sources.items()
@@ -1812,9 +2395,14 @@ class PersistentStorage:
                 sid: {
                     **meta,
                     # digests resolved on the pool before each job reads
-                    # done, so post-barrier they are all present
+                    # done, so post-barrier they are all present; the
+                    # manifest stores them for the log's OWN range only
                     "chunk_digests": (
-                        list(log.chunk_digests[: meta["chunks"]])
+                        list(
+                            log.chunk_digests[
+                                meta.get("chunk_start", 0) : meta["chunks"]
+                            ]
+                        )
                         if log is not None
                         else []
                     ),
@@ -1879,6 +2467,15 @@ class PersistentStorage:
         metadata["recovered_from"] = self.recovered_generation
         metadata["attempt"] = _restart_attempt()
         metadata["incarnation"] = self.incarnation
+        # the topology stamp is what makes elastic rescale detectable: a
+        # resume under a different worker count sees the mismatch and
+        # re-partitions (see _load_state); repartitioned_from records the
+        # rescale provenance the supervisor surfaces on
+        # SupervisorResult.recovery
+        metadata["topology"] = self.topology
+        metadata["topology_seq"] = self.topology_seq
+        if self.repartitioned_from is not None:
+            metadata["repartitioned_from"] = self.repartitioned_from
         metadata["rejected"] = [[g, r] for g, r in self.rejected_generations]
         self.backend.put_atomic(
             self._manifest_key(self.generation),
@@ -1908,6 +2505,7 @@ class PersistentStorage:
                             "recovered_from": self.recovered_generation,
                             "attempt": metadata["attempt"],
                             "incarnation": self.incarnation,
+                            "topology": self.topology,
                             "rejected": metadata["rejected"],
                         }
                     ).encode(),
@@ -1973,9 +2571,18 @@ class PersistentStorage:
                 g for g, _ in self.rejected_generations
                 if g > self.generation and g in gens
             }
+            # a rescaled root (topology epoch > 0) owes one orphan-shard
+            # sweep per process on worker 0; never-rescaled roots skip at
+            # zero cost and the sticky done-flag ends it after one pass
+            orphan_pending = (
+                self.worker == 0
+                and self.topology_seq > 0
+                and not getattr(self, "_orphan_gc_done", False)
+            )
             if (
                 not doomed
                 and not rejected_stale
+                and not orphan_pending
                 and not self.operator_persistence
             ):
                 return
@@ -2011,6 +2618,7 @@ class PersistentStorage:
             retained = [
                 (g, k) for g, k in retained if g not in rejected_stale
             ]
+            deleted += self._gc_orphan_topology()
             if not self.operator_persistence:
                 self.metrics.gc_run(deferred=False, deleted=deleted)
                 return
@@ -2042,6 +2650,61 @@ class PersistentStorage:
                 "persistence: generation GC failed (will retry next "
                 "commit): %s", exc,
             )
+
+    def _gc_orphan_topology(self) -> int:
+        """Sweep the shard debris a SHRINK leaves behind: manifests,
+        advisory pointers and progress beacons of worker ids outside the
+        current topology.  Their snapshot chunks are deliberately KEPT —
+        every live worker's manifests pin them through ``refs``.
+
+        Worker 0 only, and only once EVERY live worker's newest readable
+        manifest is stamped with the current topology: until then a crash
+        could still force a live worker back into repartition resume,
+        which reads the orphaned manifests.  A sticky done-flag keeps the
+        post-sweep steady state at zero extra listings (the O(delta) GC
+        contract)."""
+        if (
+            self.worker != 0
+            or self.topology_seq <= 0  # never-rescaled roots: zero cost
+            or self.operator_persistence
+            or getattr(self, "_orphan_gc_done", False)
+        ):
+            return 0
+        root = self._scan_root_manifests()
+        orphans = sorted(w for w in root if w >= self.topology)
+        if not orphans:
+            self._orphan_gc_done = True
+            return 0
+        for w in range(self.topology):
+            entries = root.get(w) or []
+            if not entries:
+                continue  # a worker that never committed pins nothing
+            if w == 0:
+                converged = True  # our own publish carries the stamp
+            else:
+                newest, _reason = _read_manifest(self.backend, entries[0][1])
+                converged = (
+                    newest is not None
+                    and newest.get("topology") == self.topology
+                    and int(newest.get("topology_seq", 0))
+                    == self.topology_seq
+                )
+            if not converged:
+                return 0  # defer: the root has not converged yet
+        deleted = 0
+        for w in orphans:
+            for _gen, key in root[w]:
+                self.backend.delete(key)
+                deleted += 1
+            self.backend.delete(f"{METADATA_FILE}.{w}")
+            self.backend.delete(f"lease/progress.{w}")
+        self._orphan_gc_done = True
+        _log.info(
+            "persistence: GC'd %d orphaned manifest(s) of superseded "
+            "worker(s) %s (snapshot chunks stay pinned by refs)",
+            deleted, orphans,
+        )
+        return deleted
 
     def load_operator_states(self, digest: str) -> dict[int, bytes]:
         """Committed operator snapshots keyed by node id; {} on first run."""
@@ -2095,15 +2758,37 @@ class PersistentStorage:
         return name != "UDF_CACHING"
 
     # -- sources --
+    def has_repartition_state(
+        self, source_id: str, base: str | None = None
+    ) -> bool:
+        """True when ``source_id`` must register on THIS worker even if its
+        reader partitions to nothing here: either a repartition resume
+        holds gathered state for its base name, or the adopted manifest
+        carries ``refs`` for it (a root that rescaled in its past keeps
+        every worker replaying its shard of the referenced old logs)."""
+        if self._repartition is not None:
+            return (base or base_source_id(source_id)) in self._repartition
+        meta = self._metadata.get("sources", {}).get(source_id)
+        return bool(meta and meta.get("refs"))
+
     def register_source(
-        self, source_id: str, schema_digest: str | None = None
+        self,
+        source_id: str,
+        schema_digest: str | None = None,
+        *,
+        base: str | None = None,
     ) -> SourceState:
         if source_id in self.sources:
             raise ValueError(
                 f"persistence: duplicate source name {source_id!r}; give each "
                 "persisted connector a unique name="
             )
+        base = base or base_source_id(source_id)
         log = SnapshotLog(self.backend, self.worker, source_id, pool=self._pool)
+        if self._repartition is not None:
+            return self._register_repartitioned(
+                source_id, log, schema_digest, base
+            )
         meta = self._metadata["sources"].get(source_id, {})
         stored_digest = meta.get("schema")
         if (
@@ -2120,18 +2805,81 @@ class PersistentStorage:
                 "persistence directory)."
             )
         committed = int(meta.get("chunks", 0))
+        start = min(int(meta.get("chunk_start", 0)), committed)
         offset = _offset_from_json(meta.get("offset"))
         log.chunks_written = committed  # append after the committed prefix
         digests = meta.get("chunk_digests")
-        log.chunk_digests = (
-            list(digests[:committed])
+        # the manifest stores digests for the log's OWN range
+        # [chunk_start, chunks); below chunk_start live superseded-topology
+        # chunks covered by refs — pad so the list stays absolute-indexed
+        log.chunk_digests = [None] * start + (
+            list(digests[: committed - start])
             if isinstance(digests, list)
-            else [None] * committed  # pre-manifest store: no pinned digests
+            else [None] * (committed - start)
         )
         state = SourceState(log, committed, offset)
         state.schema_digest = schema_digest
         state.operator_mode = self.operator_persistence
         state.key_seq = int(meta.get("key_seq", 0))
+        state.chunk_start = start
+        state.refs = [dict(r) for r in (meta.get("refs") or [])]
+        state.base = base
+        self.sources[source_id] = state
+        return state
+
+    def _register_repartitioned(
+        self,
+        source_id: str,
+        log: SnapshotLog,
+        schema_digest: str | None,
+        base: str,
+    ) -> SourceState:
+        """Repartition-resume registration: seed the state from the gathered
+        cross-worker base metadata instead of this worker's own manifest.
+        The fresh log appends ABOVE this worker's own superseded committed
+        range (when the old and new source ids coincide), so old chunks —
+        still referenced by every new worker's refs — are never clobbered."""
+        entry = (self._repartition or {}).get(base)
+        if entry is None:
+            # a source the old topology never committed: genuinely fresh
+            state = SourceState(log, 0, None)
+            state.schema_digest = schema_digest
+            state.operator_mode = self.operator_persistence
+            state.base = base
+            self.sources[source_id] = state
+            return state
+        stored_digest = entry.get("schema")
+        if (
+            schema_digest is not None
+            and stored_digest is not None
+            and stored_digest != schema_digest
+        ):
+            raise ValueError(
+                f"persistence: source {source_id!r} has a snapshot with a "
+                "different schema — the program changed between runs. Give "
+                "persisted connectors stable name= arguments (or clear the "
+                "persistence directory)."
+            )
+        start = int(entry["own"].get(source_id, 0))
+        # refs under MY OWN prefix also pin chunk ranges the fresh log
+        # must not clobber — e.g. a round-tripped sid (src-w0 at N=2,
+        # again at N=2 after a 2 -> 1 -> 2 trip) whose old range is only
+        # reachable through carried refs, not my newest manifest
+        for ref in entry.get("refs") or []:
+            if (
+                int(ref["worker"]) == self.worker
+                and str(ref["source"]) == source_id
+            ):
+                start = max(start, int(ref["chunks"]))
+        log.chunks_written = start
+        log.chunk_digests = [None] * start
+        state = SourceState(log, start, entry.get("offset"))
+        state.schema_digest = schema_digest
+        state.operator_mode = self.operator_persistence
+        state.key_seq = int(entry.get("key_seq", 0))
+        state.chunk_start = start
+        state.refs = [dict(r) for r in entry.get("refs") or []]
+        state.base = base
         self.sources[source_id] = state
         return state
 
@@ -2141,12 +2889,65 @@ class PersistentStorage:
         Returns the number of replayed row events (mod.rs:222-258 rewind).
         Operator-persisting mode replays nothing — restored operator states
         already contain the effect of every committed row.
+
+        Two row populations replay, in order:
+
+        * **refs** — committed chunk ranges of superseded-topology logs
+          (this worker's own old range included), read FILTERED to this
+          worker's shard (``shard_to_worker(key, topology) == worker``).
+          Every worker of the new topology replays every ref the same way,
+          so the shard union covers the old row set exactly once and each
+          row lands directly on its owner — the read amplification the
+          rescale benchmark (``benchmarks/rescale_recovery.py``) prices;
+        * **own chunks** ``[chunk_start, committed)`` — this worker's own
+          (current-topology) ingest log, replayed UNfiltered; the
+          coordinated epoch loop's post-ingest exchange re-routes them by
+          key shard exactly like live rows.
         """
         if state.operator_mode:
             return 0
+        from pathway_tpu.engine.types import shard_to_worker
+
         n = 0
+        if state.refs:
+            reg = _registry.get_registry()
+            rows_kept = reg.counter(
+                "persistence.repartition.rows",
+                "rows replayed from superseded-topology logs (post shard "
+                "filter)",
+                worker=self.worker,
+            )
+            chunks_read = reg.counter(
+                "persistence.repartition.chunks",
+                "superseded-topology chunks read during refs replay",
+                worker=self.worker,
+            )
+            for ref in state.refs:
+                start = int(ref.get("start", 0))
+                end = int(ref["chunks"])
+                chunks_read.inc(end - start)
+                for kind, key, row, _t in _read_chunks(
+                    self.backend,
+                    f"snapshots/{int(ref['worker'])}/{ref['source']}",
+                    start,
+                    end,
+                    ref.get("chunk_digests"),
+                    digests_base=start,
+                    generation=self.generation,
+                    verified=self._verified_artifacts,
+                ):
+                    if shard_to_worker(key, self.topology) != self.worker:
+                        continue
+                    if kind == codec.EV_INSERT:
+                        insert(key, row, 1)
+                        n += 1
+                    elif kind == codec.EV_DELETE:
+                        insert(key, row, -1)
+                        n += 1
+            rows_kept.inc(n)
         for kind, key, row, _t in state.log.read_committed(
             state.committed_chunks,
+            start=state.chunk_start,
             generation=self.generation,
             digests=state.log.chunk_digests,
             verified=self._verified_artifacts,
@@ -2258,20 +3059,46 @@ def verify_manifest(
 
     for sid, meta in (manifest.get("sources") or {}).items():
         n = int(meta.get("chunks", 0))
+        start = min(int(meta.get("chunk_start", 0)), n)
+        own = n - start
         digests = meta.get("chunk_digests")
         if not isinstance(digests, list):
-            digests = [None] * n
-        elif len(digests) < n:
+            digests = [None] * own
+        elif len(digests) < own:
             problems.append(
                 f"source {sid!r}: manifest lists {len(digests)} digest(s) "
-                f"for {n} committed chunk(s)"
+                f"for {own} committed chunk(s)"
             )
-        for i in range(n):
+        for i in range(start, n):
+            j = i - start
             check(
                 f"snapshots/{worker}/{sid}/{i:08d}",
-                digests[i] if i < len(digests) else None,
+                digests[j] if j < len(digests) else None,
                 "chunk",
             )
+        # refs pin superseded-topology chunk ranges this generation's
+        # replay still reads — damage there is damage HERE
+        for ref in meta.get("refs") or []:
+            try:
+                rworker = int(ref["worker"])
+                rsource = str(ref["source"])
+                rstart = int(ref.get("start", 0))
+                rn = int(ref["chunks"])
+            except (KeyError, TypeError, ValueError):
+                problems.append(
+                    f"source {sid!r}: malformed repartition ref {ref!r}"
+                )
+                continue
+            rdigests = ref.get("chunk_digests")
+            if not isinstance(rdigests, list):
+                rdigests = []
+            for i in range(rstart, rn):
+                j = i - rstart
+                check(
+                    f"snapshots/{rworker}/{rsource}/{i:08d}",
+                    rdigests[j] if j < len(rdigests) else None,
+                    f"ref chunk (source {sid!r})",
+                )
     ops = manifest.get("operators") or {}
     for node_id, ref in (ops.get("nodes") or {}).items():
         ref = _op_ref(ref)
@@ -2348,6 +3175,10 @@ def scrub_root(
                 "incarnation": lease_incarnation,
                 "owner": lease.get("owner"),
                 "run_id": lease.get("run_id"),
+                # elastic-rescale provenance: the target topology of the
+                # current incarnation and the recorded rescale history
+                "workers": lease.get("workers"),
+                "topology_history": lease.get("topology_history") or [],
             }
     if lease_report is not None:
         # progress beacons live beside the lease; count them so the audit
@@ -2397,6 +3228,7 @@ def scrub_root(
     # fetch and hash most chunks K times (artifacts are immutable and
     # tokens are key:digest, so the cache cannot mask real damage)
     audit_cache: set[str] = set()
+    newest_stamps: list[tuple[int, int]] = []  # (incarnation, topology)
     for w in sorted(workers):
         prefix = f"manifests/{w}/"
         gens = sorted(
@@ -2412,6 +3244,7 @@ def scrub_root(
         for gen in gens:
             manifest, reason = _read_manifest(backend, f"{prefix}{gen:08d}")
             stamp = None
+            topo = None
             if manifest is None:
                 problems = [reason or "unreadable"]
             else:
@@ -2419,6 +3252,11 @@ def scrub_root(
                     backend, w, manifest, cache=audit_cache
                 )
                 stamp = manifest.get("incarnation")
+                topo = manifest.get("topology")
+                if gen == gens[0] and isinstance(stamp, int) and isinstance(
+                    topo, int
+                ):
+                    newest_stamps.append((stamp, topo))
                 if (
                     lease_incarnation is not None
                     and isinstance(stamp, int)
@@ -2440,6 +3278,17 @@ def scrub_root(
                     "ok": not problems,
                     "problems": problems,
                     "incarnation": stamp,
+                    "topology": topo,
+                    "topology_seq": (
+                        manifest.get("topology_seq", 0)
+                        if manifest is not None
+                        else None
+                    ),
+                    "repartitioned_from": (
+                        manifest.get("repartitioned_from")
+                        if manifest is not None
+                        else None
+                    ),
                 }
             )
         pointer = None
@@ -2473,6 +3322,57 @@ def scrub_root(
             "ok": worker_ok,
         }
         report["ok"] = report["ok"] and worker_ok
+    # -- topology audit (elastic rescale) -----------------------------------
+    # The cluster's CURRENT worker count: the data plane's topology-epoch
+    # marker first (written by the repartitioning workers themselves),
+    # then the lease's recorded target, then the topology stamped by the
+    # most recent (highest-incarnation) writer.
+    marker = read_topology_marker(backend)
+    current_workers = None
+    if marker is not None:
+        current_workers = marker["workers"]
+    elif lease_report is not None and isinstance(
+        lease_report.get("workers"), int
+    ):
+        current_workers = lease_report["workers"]
+    elif newest_stamps:
+        current_workers = max(newest_stamps)[1]
+    if current_workers is not None:
+        report["topology"] = {
+            "workers": current_workers,
+            "seq": (marker or {}).get("seq", 0),
+            "repartitioned_from": (marker or {}).get("from_workers"),
+            "history": (lease_report or {}).get("topology_history") or [],
+        }
+        for w, wrep in report["workers"].items():
+            entries = wrep["generations"]
+            newest_topo = entries[0].get("topology") if entries else None
+            if w >= current_workers:
+                # a shard of a superseded (larger) topology: its manifests
+                # are fenced debris awaiting orphan GC, never damage — the
+                # live workers' refs pin its CHUNKS, and damage there is
+                # reported on the live manifests that reference them
+                wrep["orphaned"] = True
+                wrep["status"] = "fenced, pending GC"
+                if not wrep["ok"]:
+                    wrep["ok"] = True
+                    report["ok"] = all(
+                        rep["ok"] for rep in report["workers"].values()
+                    ) and (lease_report is None or lease_report["ok"])
+            elif entries and (
+                (
+                    isinstance(newest_topo, int)
+                    and newest_topo != current_workers
+                )
+                or (
+                    marker is not None
+                    and entries[0].get("topology_seq") is not None
+                    and entries[0]["topology_seq"] != marker["seq"]
+                )
+            ):
+                # a live worker that has not republished under the current
+                # topology epoch yet: mid-rescale, not damage
+                wrep["pending_repartition"] = True
     reg = _registry.get_registry()
     reg.counter("persistence.scrub.runs", "offline scrub audits run").inc()
     if not report["ok"]:

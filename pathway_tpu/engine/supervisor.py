@@ -71,6 +71,7 @@ in-repo harnesses) and ``subprocess.Popen`` (``pathway spawn
 
 from __future__ import annotations
 
+import inspect
 import json
 import logging
 import os
@@ -129,7 +130,7 @@ class SupervisorError(RuntimeError):
 class SupervisorResult:
     __slots__ = (
         "attempts", "restarts", "exit_codes", "history", "recovery",
-        "last_failure", "post_mortem",
+        "last_failure", "post_mortem", "rescales",
     )
 
     def __init__(
@@ -141,6 +142,7 @@ class SupervisorResult:
         recovery: dict[int, dict] | None = None,
         last_failure: str | None = None,
         post_mortem: dict | None = None,
+        rescales: list[dict] | None = None,
     ):
         self.attempts = attempts  # launches performed (>= 1)
         self.restarts = restarts  # recoveries performed (attempts - 1)
@@ -165,6 +167,10 @@ class SupervisorResult:
         # root is known or no worker dumped.  ``pathway_tpu blackbox ROOT``
         # renders the full dumps.
         self.post_mortem = post_mortem or {}
+        # degraded-mode shrink provenance: one entry per rescale performed
+        # by this run — {"from", "to", "lost_worker", "attempt", "reason"}.
+        # Empty for a run that never lost a worker permanently.
+        self.rescales = rescales or []
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (
@@ -353,10 +359,37 @@ class Supervisor:
         restart_jitter_s: float = 0.5,
         checkpoint_root: str | None = None,
         epoch_deadline_s: float | None = None,
+        shrink_on_loss: bool | None = None,
     ):
         self.spawn = spawn
         self.n_workers = n_workers
         self.max_restarts = max_restarts
+        # degraded-mode shrink (opt-in): when the SAME worker failed on
+        # every attempt of a spent restart budget — the permanently-lost-
+        # host signature, not an ordinary crash loop — rescale the cluster
+        # to the surviving count instead of failing the run.  The resumed
+        # workers re-partition checkpointed state by shard range
+        # (engine/persistence.py repartition resume).  None reads the
+        # PATHWAY_DEGRADED_SHRINK knob.
+        if shrink_on_loss is None:
+            from pathway_tpu.internals.config import env_bool
+
+            shrink_on_loss = env_bool("PATHWAY_DEGRADED_SHRINK")
+        self.shrink_on_loss = bool(shrink_on_loss)
+        # rescale provenance (mirrored onto SupervisorResult.rescales)
+        self.rescales: list[dict] = []
+        # does the spawn callback accept the CURRENT cluster size?  A
+        # shrink changes n_workers between attempts, and the spawner must
+        # export the new PATHWAY_PROCESSES; two-arg spawners (fixed-size
+        # callers, older tests) keep working unchanged.
+        try:
+            params = inspect.signature(spawn).parameters
+            self._spawn_takes_workers = any(
+                p.kind is inspect.Parameter.VAR_KEYWORD or name == "n_workers"
+                for name, p in params.items()
+            )
+        except (TypeError, ValueError):
+            self._spawn_takes_workers = False
         self.grace_s = grace_s
         self.poll_interval_s = poll_interval_s
         # extra uniform jitter on top of the backoff schedule: when many
@@ -467,6 +500,12 @@ class Supervisor:
                         "recovered_from": obj.get("recovered_from"),
                         "rejected": obj.get("rejected") or [],
                         "attempt": obj.get("attempt"),
+                        # elastic-rescale provenance: the topology this
+                        # worker last committed under, and the superseded
+                        # topology it re-partitioned from (None = never
+                        # rescaled)
+                        "topology": obj.get("topology"),
+                        "repartitioned_from": obj.get("repartitioned_from"),
                     }
             return out
         except Exception:  # noqa: BLE001 - never fail a run for forensics
@@ -561,11 +600,18 @@ class Supervisor:
             self.incarnation = pz.acquire_lease(
                 pz.FileBackend(self.checkpoint_root),
                 owner=f"supervisor pid {os.getpid()} attempt {attempt}",
+                # the lease records the TARGET TOPOLOGY of this attempt:
+                # workers verify PATHWAY_PROCESSES against it at boot (the
+                # topology handshake), and scrub renders the rescale
+                # history it accumulates
+                workers=self.n_workers,
             )
             os.environ[ENV_INCARNATION] = str(self.incarnation)
             _log.info(
-                "attempt %d runs as incarnation %d (lease on %s)",
-                attempt, self.incarnation, self.checkpoint_root,
+                "attempt %d runs as incarnation %d over %d worker(s) "
+                "(lease on %s)",
+                attempt, self.incarnation, self.n_workers,
+                self.checkpoint_root,
             )
         except Exception as exc:  # noqa: BLE001 - fencing is best-effort
             _log.warning(
@@ -574,12 +620,24 @@ class Supervisor:
                 self.checkpoint_root, exc,
             )
 
+    def _spawn_one(self, worker_id: int, attempt: int) -> Any:
+        if self._spawn_takes_workers:
+            return self.spawn(worker_id, attempt, n_workers=self.n_workers)
+        return self.spawn(worker_id, attempt)
+
     def run(self) -> SupervisorResult:
         delays = self._backoff_delays()
         history: list[list[int | None]] = []
         attempt = 0
         handles: list[Any] = []
         last_failure: str | None = None
+        # degraded-mode shrink bookkeeping: the attempt the current restart
+        # budget started at (a shrink grants the smaller cluster a fresh
+        # budget), and the same-worker failure streak that distinguishes a
+        # permanently lost host from an ordinary crash loop
+        budget_anchor = 0
+        last_failed: int | None = None
+        same_fail_streak = 0
         # post_mortem cutoff: dumps already on the root when THIS run
         # starts belong to a previous run and must not be re-attributed
         # to it (they stay on disk for `pathway_tpu blackbox`)
@@ -588,9 +646,22 @@ class Supervisor:
             while True:
                 self._acquire_incarnation(attempt)
                 handles = []
+                spawn_failure: tuple[int, BaseException] | None = None
                 for w in range(self.n_workers):
-                    handles.append(self.spawn(w, attempt))
-                first_failed = self._watch(handles)
+                    try:
+                        handles.append(self._spawn_one(w, attempt))
+                    except Exception as exc:  # noqa: BLE001 - a dead host
+                        # a spawn that cannot even launch (host gone,
+                        # scheduler refusal) is a worker failure, not a
+                        # supervisor crash: route it through the restart /
+                        # shrink machinery like any other death
+                        spawn_failure = (w, exc)
+                        break
+                first_failed = (
+                    self._watch(handles)
+                    if spawn_failure is None
+                    else spawn_failure[0]
+                )
                 if first_failed is None:
                     codes = [_exitcode(h) for h in handles]
                     history.append(codes)
@@ -607,9 +678,15 @@ class Supervisor:
                         attempt + 1, attempt, codes, history,  # type: ignore[arg-type]
                         recovery=recovery, last_failure=last_failure,
                         post_mortem=self._post_mortem(),
+                        rescales=list(self.rescales),
                     )
                 hang = self._hangs.get(first_failed)
-                if hang is not None:
+                if spawn_failure is not None:
+                    last_failure = (
+                        f"worker {first_failed} failed to spawn on attempt "
+                        f"{attempt}: {spawn_failure[1]}"
+                    )
+                elif hang is not None:
                     # the exit code alone would read like an ordinary crash;
                     # the restart was actually the watchdog converting a
                     # silent stall into a supervised recovery
@@ -629,9 +706,9 @@ class Supervisor:
                     "cluster rollback-and-respawn recoveries performed",
                 ).inc()
                 _log.warning(
-                    "worker %d died (exit %s) on attempt %d; rolling the "
+                    "worker %d failed (%s) on attempt %d; rolling the "
                     "group back to the last committed checkpoint",
-                    first_failed, _exitcode(handles[first_failed]), attempt,
+                    first_failed, last_failure, attempt,
                 )
                 self._stop_all(handles)
                 # every worker process is dead: in-flight async commits are
@@ -639,18 +716,75 @@ class Supervisor:
                 # root BEFORE this attempt is accounted and the respawn
                 # resumes from what actually landed
                 self._settle_checkpoints()
-                history.append([_exitcode(h) for h in handles])
-                if attempt >= self.max_restarts:
-                    err = SupervisorError(
-                        f"cluster failed {attempt + 1} time(s) "
-                        f"(restart budget {self.max_restarts}); last exit "
-                        f"codes {history[-1]}; last failure: {last_failure}"
+                codes = [_exitcode(h) for h in handles]
+                codes += [None] * (self.n_workers - len(codes))
+                history.append(codes)
+                if first_failed == last_failed:
+                    same_fail_streak += 1
+                else:
+                    last_failed, same_fail_streak = first_failed, 1
+                if attempt - budget_anchor >= self.max_restarts:
+                    # restart budget spent.  The permanently-lost-host
+                    # signature — the SAME worker failed every attempt of
+                    # the budget — can be absorbed by degraded-mode shrink
+                    # (opt-in); anything else is a crash loop and fails.
+                    consistent_loss = (
+                        same_fail_streak >= attempt - budget_anchor + 1
                     )
-                    # a crash loop is exactly when the black box matters
-                    # most: the dumps ride the exception so callers (and
-                    # `spawn --supervise`) can point the operator at them
-                    err.post_mortem = self._post_mortem()
-                    raise err
+                    if (
+                        self.shrink_on_loss
+                        and self.n_workers > 1
+                        and consistent_loss
+                    ):
+                        new_n = self.n_workers - 1
+                        self.rescales.append(
+                            {
+                                "from": self.n_workers,
+                                "to": new_n,
+                                "lost_worker": first_failed,
+                                "attempt": attempt,
+                                "reason": last_failure,
+                            }
+                        )
+                        _metrics.get_registry().counter(
+                            "supervisor.rescales",
+                            "degraded-mode cluster rescales performed "
+                            "(worker-loss shrink)",
+                        ).inc()
+                        _log.warning(
+                            "worker %d failed on every attempt of the spent "
+                            "restart budget — treating it as permanently "
+                            "lost and rescaling the cluster %d -> %d "
+                            "worker(s); checkpointed state re-partitions by "
+                            "shard range on resume",
+                            first_failed, self.n_workers, new_n,
+                        )
+                        self.n_workers = new_n
+                        budget_anchor = attempt + 1
+                        last_failed, same_fail_streak = None, 0
+                        delays = self._backoff_delays()  # fresh schedule
+                    else:
+                        hint = (
+                            " (the same worker failed every attempt — a "
+                            "permanently lost host can be absorbed with "
+                            "degraded-mode shrink: PATHWAY_DEGRADED_SHRINK=1 "
+                            "or `spawn --supervise --shrink-on-loss`)"
+                            if consistent_loss
+                            and not self.shrink_on_loss
+                            and self.n_workers > 1
+                            else ""
+                        )
+                        err = SupervisorError(
+                            f"cluster failed {attempt + 1} time(s) "
+                            f"(restart budget {self.max_restarts}); last exit "
+                            f"codes {history[-1]}; last failure: "
+                            f"{last_failure}{hint}"
+                        )
+                        # a crash loop is exactly when the black box matters
+                        # most: the dumps ride the exception so callers (and
+                        # `spawn --supervise`) can point the operator at them
+                        err.post_mortem = self._post_mortem()
+                        raise err
                 time.sleep(
                     next(delays) + random.uniform(0, self.restart_jitter_s)
                 )

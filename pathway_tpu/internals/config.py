@@ -201,6 +201,11 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
        "hung-worker watchdog: no epoch progress for this long → SIGUSR1 "
        "(flight-recorder dump) → SIGTERM → SIGKILL into a supervised "
        "restart (unset or <= 0 disables)", "supervisor"),
+    _k("PATHWAY_DEGRADED_SHRINK", "bool", False,
+       "degraded-mode shrink (opt-in): when the same worker fails every "
+       "attempt of a spent restart budget, rescale the supervised cluster "
+       "to the surviving count instead of failing — checkpointed state "
+       "re-partitions by shard range on resume", "supervisor"),
     # -- devices (parallel/mesh.py, internals/runner.py) --------------------
     _k("PATHWAY_JAX_DISTRIBUTED", "bool", False,
        "form a multi-host JAX device mesh too (`spawn "

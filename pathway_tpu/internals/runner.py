@@ -145,6 +145,12 @@ def run(
     from pathway_tpu.internals.config import env_bool as _env_bool
 
     _cfg = _get_config()
+    # topology handshake: a supervised worker's mesh size must be the
+    # lease-recorded topology, not whatever argv happened to say — an
+    # operator relaunching with a stale -n (or a k8s replica count scaled
+    # behind the supervisor's back) must fail loudly BEFORE the mesh
+    # forms, not resume with a silently mis-sharded cluster
+    _topology_handshake(persistence_config, _cfg)
     if _cfg.processes > 1 and _env_bool("PATHWAY_JAX_DISTRIBUTED"):
         # `pathway spawn --jax-distributed`: the host workers double as JAX
         # processes of one global device mesh (DCN between hosts) — must
@@ -505,6 +511,56 @@ def run(
                 except Exception:
                     pass
     return result
+
+
+def _topology_handshake(persistence_config: Any, cfg: Any) -> None:
+    """Verify this worker's launch topology against the lease on its
+    persistence root (supervised runs only — the supervisor records the
+    target worker count in the incarnation lease before every launch).
+
+    The mesh is sized from ``PATHWAY_PROCESSES``; this check makes the
+    LEASE the authority: a mismatch means the supervisor and the worker
+    disagree about the cluster shape, and resuming would mis-shard every
+    exchanged row.  Read-only — a missing root, missing lease, or a lease
+    without a recorded topology (pre-rescale roots) passes silently.
+    """
+    from pathway_tpu.engine.persistence import (
+        read_lease_file,
+        writer_incarnation,
+    )
+
+    if writer_incarnation() <= 0:
+        return  # unsupervised: no lease authority to handshake with
+    root = None
+    backend_cfg = getattr(persistence_config, "backend", None)
+    if backend_cfg is not None:
+        if getattr(backend_cfg, "kind", None) == "filesystem":
+            root = getattr(backend_cfg, "path", None)
+    elif persistence_config is None and cfg.replay_storage:
+        root = cfg.replay_storage
+    if not root or not os.path.isdir(root):
+        return
+    lease = read_lease_file(root)
+    if lease is None:
+        return
+    workers = lease.get("workers")
+    if not isinstance(workers, int):
+        return
+    if workers != cfg.processes:
+        raise RuntimeError(
+            f"topology handshake failed: the lease on {root} records a "
+            f"cluster of {workers} worker(s) (incarnation "
+            f"{lease['incarnation']}), but this worker was launched with "
+            f"PATHWAY_PROCESSES={cfg.processes} — the supervisor and the "
+            "worker disagree about the mesh size. Relaunch through "
+            f"`pathway_tpu spawn --supervise -n {workers}`, or rescale "
+            "deliberately by re-running the supervisor at the new count."
+        )
+    if cfg.process_id >= workers:
+        raise RuntimeError(
+            f"topology handshake failed: worker id {cfg.process_id} is "
+            f"outside the leased topology of {workers} worker(s) on {root}"
+        )
 
 
 def _make_storage(persistence_config: Any):

@@ -95,7 +95,19 @@ class Reader:
         reference's rule for non-partitioned sources — read everything on
         one worker, the post-ingest exchange scatters the rows
         (docs/.../10.worker-architecture.md:40-42, dataflow.rs:1414-1437).
-        Returning ``None`` means this worker reads nothing."""
+        Returning ``None`` means this worker reads nothing.
+
+        Contract (pinned by ``tests/test_rescale_repartition.py``): the
+        call must be IDEMPOTENT under re-partitioning — calling it again
+        with a different ``(worker_id, worker_count)`` (an elastic rescale
+        re-striping the source) must leave exactly the new stripe active,
+        never a union or intersection with the old one, so rescaled
+        readers neither drop nor double-read paths/partitions.  Progress
+        state (``seek`` frontiers) must be stripe-independent: a rescaled
+        reader may be seeked to a frontier MERGED from several old
+        workers, and must resume each path/partition it now owns from the
+        recorded position while simply ignoring entries outside its
+        stripe."""
         return self if worker_id == 0 else None
 
 
@@ -526,36 +538,63 @@ def make_input_table(
         reader = reader_factory()
         # per-connector monitoring identity (connectors/monitoring.rs)
         poller.name = name or type(reader).__name__.lstrip("_")
-        if worker is not None and worker.worker_count > 1:
-            reader = reader.partition(worker.worker_id, worker.worker_count)
-            if reader is None:
-                node.close()  # this worker owns no slice of the source
-                return node
-            # salt autogenerated row keys by worker so striped partitions
-            # never collide in the shared 128-bit key space
-            poller._seq_base = worker.worker_id << 64
-        poller.reader = reader
 
-        # persistence: replay committed snapshot, seek reader past it
+        # persistence identity FIRST: the source counter advances for
+        # every source on every worker — workers whose reader partitions
+        # to nothing included — so unnamed sources keep the same base id
+        # across workers and across topology rescales (the repartition
+        # resume matches old and new logs by this BASE name)
         storage = getattr(lowerer, "persistence_storage", None)
         if storage is not None and not storage.input_snapshots_enabled:
             storage = None  # UDF-caching-only mode: no input snapshots
-        skip_rows = 0
+        sid = None
+        base_sid = None
         if storage is not None:
             counter = getattr(lowerer, "_source_counter", 0)
             lowerer._source_counter = counter + 1
-            sid = name or f"source_{counter}"
+            base_sid = sid = name or f"source_{counter}"
             if worker is not None and worker.worker_count > 1:
                 # worker-sharded snapshot files (tracker.rs worker sharding)
                 sid = f"{sid}-w{worker.worker_id}"
+        if worker is not None and worker.worker_count > 1:
+            reader = reader.partition(worker.worker_id, worker.worker_count)
+            # salt autogenerated row keys by worker so striped partitions
+            # never collide in the shared 128-bit key space
+            poller._seq_base = worker.worker_id << 64
+        if reader is None and (
+            sid is None or not storage.has_repartition_state(sid, base_sid)
+        ):
+            node.close()  # this worker owns no slice of the source
+            return node
+        poller.reader = reader
+
+        # persistence: replay committed snapshot, seek reader past it
+        skip_rows = 0
+        if storage is not None:
+            # the explicit base keeps rescale matching exact even for
+            # user names that themselves end in `-w<N>`
             state = storage.register_source(
-                sid, schema_digest=schema_digest(schema)
+                sid, schema_digest=schema_digest(schema), base=base_sid
             )
             access = getattr(storage, "snapshot_access", None)
             if access != "record":
                 storage.replay_into(
                     state, lambda k, r, d: node.insert(k, r, 0, d)
                 )
+            if reader is None:
+                # refs-only worker (elastic rescale): this worker owns no
+                # reader slice, but it DOES own a shard of the replayed
+                # state — the rows just staged above — and its registration
+                # keeps the refs committed in every future manifest.  No
+                # reader thread, no poller: the staged epoch drains like a
+                # static source's.  The merged offset frontier belongs to
+                # whichever worker actually READS the source; committing it
+                # here too would hand a later rescale duplicate frontiers
+                # for one base source.
+                state.offset = None
+                state.pending_offset = None
+                node.close()
+                return node
             if access == "replay" and not getattr(
                 storage, "continue_after_replay", True
             ):
@@ -735,12 +774,14 @@ def register_static_persistence(lowerer, node, schema=None) -> None:
         return
     counter = getattr(lowerer, "_source_counter", 0)
     lowerer._source_counter = counter + 1
-    sid = f"static_{counter}"
+    base_sid = sid = f"static_{counter}"
     worker = getattr(lowerer.scope, "worker", None)
     if worker is not None and worker.worker_count > 1:
         sid = f"{sid}-w{worker.worker_id}"
     state = storage.register_source(
-        sid, schema_digest=None if schema is None else schema_digest(schema)
+        sid,
+        schema_digest=None if schema is None else schema_digest(schema),
+        base=base_sid,
     )
     if state.offset is not None:
         node.clear_staged()
@@ -812,13 +853,40 @@ def worker_part_path(filename: str) -> str:
     """Per-worker output path: in multi-process runs each worker writes its
     own shard of the output stream, so file sinks get a ``.part-N`` suffix
     for workers > 0 (worker 0 keeps the plain name; single-process is
-    unchanged).  The combined output is the union of the part files."""
+    unchanged).  The combined output is the union of the part files.
+
+    Worker 0 of a SUPERVISED run additionally sweeps part files OUTSIDE
+    the current topology: an elastic shrink (degraded-mode rescale,
+    ``docs/fault_tolerance.md``) leaves the dead workers' ``.part-N``
+    shards behind, and since the combined output is a union, stale shards
+    from a larger topology would double-count rows the rescaled workers
+    re-emit.  Gated on the incarnation lease (supervised runs only): an
+    unrelated standalone run that happens to target the same filename
+    must never destroy another run's output shards."""
+    from pathway_tpu.engine.persistence import writer_incarnation
     from pathway_tpu.internals.config import get_config
 
     cfg = get_config()
+    if cfg.process_id == 0 and writer_incarnation() > 0:
+        _sweep_stale_parts(filename, cfg.processes)
     if cfg.processes > 1 and cfg.process_id > 0:
         return f"{filename}.part-{cfg.process_id}"
     return filename
+
+
+def _sweep_stale_parts(filename: str, processes: int) -> None:
+    """Best-effort unlink of ``<filename>.part-N`` shards with N outside
+    the current worker topology (see :func:`worker_part_path`)."""
+    import glob as _glob
+    import os as _os
+
+    for path in _glob.glob(f"{_glob.escape(filename)}.part-*"):
+        tail = path.rsplit("-", 1)[-1]
+        if tail.isdigit() and int(tail) >= processes:
+            try:
+                _os.remove(path)
+            except OSError:
+                pass
 
 
 def plain_value(v: Any, *, bytes_as: str = "text") -> Any:

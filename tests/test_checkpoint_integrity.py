@@ -686,20 +686,34 @@ class TestFallbackGuards:
         class Mode:
             name = "OPERATOR_PERSISTING"
 
-        store: dict = {}
-        backend = pz.MemoryBackend(store)
-        for payload in (b"v1", b"v2"):
-            st = pz.PersistentStorage(backend, mode=Mode())
-            st.collect_operator_states = lambda full, p=payload: ({5: p}, "g")
-            st.commit()
-        _flip_bit(store, "manifests/0/00000002")
+        def seed(monkey_processes: str) -> pz.MemoryBackend:
+            # manifests carry a topology stamp: the seed must be written
+            # under the SAME worker count the resume runs at, or the
+            # resume (rightly) reads it as an elastic rescale instead
+            monkeypatch.setenv("PATHWAY_PROCESSES", monkey_processes)
+            backend = pz.MemoryBackend({})
+            for payload in (b"v1", b"v2"):
+                st = pz.PersistentStorage(backend, mode=Mode())
+                st.collect_operator_states = (
+                    lambda full, p=payload: ({5: p}, "g")
+                )
+                st.commit()
+            _flip_bit(backend.store, "manifests/0/00000002")
+            return backend
+
         # single-process: fallback is fine
-        monkeypatch.setenv("PATHWAY_PROCESSES", "1")
+        backend = seed("1")
         st = pz.PersistentStorage(backend, mode=Mode())
         assert st.generation == 1
         # multi-worker group: refuse
-        monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+        backend = seed("2")
         with pytest.raises(pz.CheckpointError, match="double-apply"):
+            pz.PersistentStorage(backend, mode=Mode())
+        # and a topology RESCALE of an operator-persisting root refuses
+        # with its own message: per-node operator state has no shard ranges
+        backend = seed("1")
+        monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+        with pytest.raises(pz.CheckpointError, match="re-partitioned"):
             pz.PersistentStorage(backend, mode=Mode())
 
     def test_external_resume_source_refuses_fallen_back_checkpoint(self):

@@ -319,7 +319,7 @@ def test_scrub_cli_renders_lease_and_blackbox(tmp_path, monkeypatch):
     assert result.exit_code == 0, result.output
     assert "lease: incarnation 1" in result.output
     assert "blackbox: 1 flight-recorder dump(s)" in result.output
-    assert "(incarnation 1)" in result.output
+    assert "(incarnation 1, topology 1)" in result.output
 
 
 # ---------------------------------------------------------------------------
@@ -589,6 +589,118 @@ def test_zombie_publish_is_fenced_and_new_incarnation_owns_root(tmp_path):
 
 def _hang_worker_main(attempt: int, tmpdir: str, plan_json: str) -> None:
     _fence_worker_main(tmpdir, "counts.jsonl", None, attempt, plan_json)
+
+
+@pytest.mark.chaos
+def test_fenced_straggler_cannot_publish_during_repartition(
+    tmp_path, monkeypatch
+):
+    """ISSUE 10 chaos: a stale-incarnation straggler that is itself MID-
+    REPARTITION (resuming a 2-worker root at N'=1) gets superseded before
+    its first publish — the ``zombie`` fault stalls that publish until the
+    lease moves, and the incarnation fence must reject it: the straggler
+    self-terminates without splicing any new-topology generation into the
+    root, and the successor incarnation repartitions cleanly to the
+    exactly-once output.  Gated on on-disk state (the topology marker, the
+    lease) — no timing assumptions."""
+    import pathway_tpu as pw
+    from pathway_tpu.engine.types import sequential_key
+    from pathway_tpu.io._utils import schema_digest
+
+    ctx = multiprocessing.get_context("fork")
+    pstore = tmp_path / "pstore"
+    backend = pz.FileBackend(str(pstore))
+
+    # seed a topology-2 root: worker 0 committed 6 rows of the pipeline's
+    # source (the non-partitioned reader lives on worker 0 under every
+    # topology), worker 1 held no sources — the realistic shape
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    assert pz.acquire_lease(backend, owner="seed-supervisor", workers=2) == 1
+    storage = pz.PersistentStorage(backend, worker=0)
+    digest = schema_digest(pw.schema_from_types(k=int, v=int))
+    state = storage.register_source("src-w0", schema_digest=digest)
+    for i in range(6):
+        state.log.record(sequential_key(i), (i % 3, 1), 1)
+    state.key_seq = 6
+    state.log.flush_chunk()
+    state.pending_offset = {"rows": 6}
+    storage.commit()
+    monkeypatch.delenv("PATHWAY_PROCESSES")
+
+    # incarnation 2 launches the rescale to N'=1 — with a zombie fault
+    # stalling its FIRST manifest publish until the lease moves on
+    assert pz.acquire_lease(backend, owner="test-supervisor", workers=1) == 2
+    plan = json.dumps(
+        {"seed": 5, "faults": [{"kind": "zombie", "worker": 0, "nth": 1}]}
+    )
+    straggler = ctx.Process(
+        target=_fence_worker_main,
+        args=(str(tmp_path), "counts-a.jsonl", 2, 0, plan),
+        daemon=True,
+    )
+    straggler.start()
+    marker_path = pstore / "topology" / "CURRENT"
+    _wait_for_on_disk(
+        lambda: marker_path.exists(),
+        "the straggler's repartition wrote the topology marker",
+    )
+    # supersede the straggler BEFORE its stalled publish can land
+    assert pz.acquire_lease(backend, owner="test-supervisor", workers=1) == 3
+    straggler.join(60)
+    assert straggler.exitcode is not None, "straggler never terminated"
+    assert straggler.exitcode != 0, "a fenced straggler must self-terminate"
+    # the fenced publish wrote NOTHING: every manifest on the root is
+    # still the seed topology's
+    for name in os.listdir(pstore / "manifests" / "0"):
+        if name.endswith(".tmp"):
+            continue
+        manifest, reason = pz._read_manifest(
+            backend, f"manifests/0/{name}"
+        )
+        assert reason is None and manifest["topology"] == 2, name
+
+    # the successor incarnation repartitions the same root cleanly
+    successor = ctx.Process(
+        target=_fence_worker_main,
+        args=(str(tmp_path), "counts-b.jsonl", 3, 1, ""),
+        daemon=True,
+    )
+    successor.start()
+    successor.join(120)
+    assert successor.exitcode == 0
+
+    gens = sorted(
+        int(f) for f in os.listdir(pstore / "manifests" / "0")
+        if not f.endswith(".tmp")
+    )
+    newest, reason = pz._read_manifest(
+        backend, f"manifests/0/{gens[-1]:08d}"
+    )
+    assert reason is None
+    assert newest["topology"] == 1
+    assert newest["repartitioned_from"] == 2
+    assert newest["incarnation"] == 3
+
+    report = pz.scrub_root(backend)
+    assert report["ok"] is True, report
+    assert report["topology"]["workers"] == 1
+
+    # exactly-once: 6 replayed + 12 live rows, one count per key
+    from collections import Counter
+
+    state_counter: Counter = Counter()
+    with open(tmp_path / "counts-b.jsonl") as f:
+        for line in f:
+            obj = json.loads(line)
+            diff = obj.pop("diff")
+            obj.pop("time")
+            state_counter[json.dumps(obj, sort_keys=True)] += diff
+    got = {
+        json.loads(k)["k"]: json.loads(k)["n"]
+        for k, c in state_counter.items()
+        if c
+    }
+    assert got == {0: 6, 1: 6, 2: 6}, got
 
 
 @pytest.mark.chaos

@@ -66,16 +66,23 @@ def test_sigkill_mid_stream_then_resume_exactly_once(tmp_path):
         target=_worker, args=(pstore, out1, N_ROWS, ROW_DELAY_S), daemon=True
     )
     p.start()
-    # wait for proof of mid-stream progress, then kill without warning
+    # wait for proof of mid-stream progress AND a committed snapshot on
+    # disk, then kill without warning — gating the kill on on-disk state
+    # (not a fixed sleep) keeps the "a snapshot covers a genuine prefix"
+    # precondition deterministic under rig load
     deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
-        if os.path.exists(out1) and Path(out1).stat().st_size > 0:
+        if (
+            os.path.exists(out1)
+            and Path(out1).stat().st_size > 0
+            and os.path.isdir(pstore)
+            and any(f.startswith("metadata") for f in os.listdir(pstore))
+        ):
             break
         time.sleep(0.02)
     else:
         p.terminate()
-        pytest.fail("worker produced no output within 30s")
-    time.sleep(3 * ROW_DELAY_S)  # let a snapshot cover a genuine prefix
+        pytest.fail("worker produced no output + snapshot within 30s")
     os.kill(p.pid, signal.SIGKILL)
     p.join(10)
 

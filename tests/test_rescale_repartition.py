@@ -1,0 +1,688 @@
+"""Elastic rescale-via-recovery: shard-range snapshot repartitioning.
+
+Covers the repartition machinery at every layer below the chaos suite:
+
+* the routing-stability PROPERTY the whole design rests on — for any
+  (N, N') pair, ``shard_to_worker`` partitions the 2^16 shard space so
+  every key lands on exactly one new worker and the union of reassigned
+  shard ranges covers the old assignment exactly;
+* persistence repartition resume (shrink / grow / chained), ``refs``
+  carry-forward, ``chunk_start`` log re-seeding, offset-frontier merging,
+  damaged-old-shard refusal, orphan-topology GC, scrub topology audit;
+* the supervisor's degraded-mode shrink and its provenance;
+* the connector stripe-reassignment contract (``Reader.partition`` is
+  idempotent under re-partitioning; merged ``seek`` frontiers resume
+  without dropping or double-reading).
+
+The end-to-end chaos acceptance (N=4 -> 2 -> 4 round trip under a
+mid-commit SIGKILL; fenced stragglers during repartition) lives in
+``tests/test_supervised_recovery.py`` / ``tests/test_fencing_watchdog.py``.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from pathway_tpu.engine import metrics as em
+from pathway_tpu.engine import persistence as pz
+from pathway_tpu.engine.types import SHARD_MASK, shard_of, shard_to_worker
+
+SCHEMA = "k:INT|v:INT"
+
+
+# ---------------------------------------------------------------------------
+# Satellite: routing-stability property
+# ---------------------------------------------------------------------------
+
+
+def test_shard_repartition_property():
+    """For all (N, N') in 1..8: the new assignment is a PARTITION of the
+    shard space (every shard owned by exactly one new worker), and every
+    old worker's shard set is covered exactly by its reassignments — no
+    shard dropped, none double-owned.  This is the invariant that makes
+    filtered refs replay exactly-once across the new cluster."""
+    shards = np.arange(SHARD_MASK + 1, dtype=np.int64)
+    for n_old in range(1, 9):
+        old_owner = shards % n_old
+        for n_new in range(1, 9):
+            new_owner = shards % n_new
+            assert new_owner.min() >= 0 and new_owner.max() <= n_new - 1
+            # partition: per-worker shard counts sum to the full space
+            counts = np.bincount(new_owner, minlength=n_new)
+            assert int(counts.sum()) == SHARD_MASK + 1
+            for w_old in range(n_old):
+                olds = shards[old_owner == w_old]
+                pieces = [olds[(olds % n_new) == w] for w in range(n_new)]
+                reassigned = np.concatenate(pieces)
+                # exact cover: same size (no drop/double) and same set
+                assert reassigned.size == olds.size
+                assert np.array_equal(np.sort(reassigned), olds)
+
+
+def test_shard_to_worker_routes_random_keys_by_shard_field():
+    import random
+
+    r = random.Random(3)
+    for _ in range(500):
+        key = r.getrandbits(128)
+        for n in range(1, 9):
+            owner = shard_to_worker(key, n)
+            assert owner == shard_of(key) % n
+            assert 0 <= owner < n
+
+
+# ---------------------------------------------------------------------------
+# Offset-frontier merging
+# ---------------------------------------------------------------------------
+
+
+def test_merge_offsets_unions_per_file_progress():
+    a = {"f1": [1.0, 5], "f2": [1.0, 3]}
+    b = {"f3": [2.0, 7], "f2": [3.0, 9]}
+    merged = pz.merge_offsets([a, None, b], source="src")
+    assert merged == {"f1": [1.0, 5], "f2": [3.0, 9], "f3": [2.0, 7]}
+    assert pz.merge_offsets([None, None]) is None
+    assert pz.merge_offsets([a]) == a
+    # identical opaque offsets pass through; divergent ones refuse
+    assert pz.merge_offsets([("x", 1), ("x", 1)]) == ("x", 1)
+    with pytest.raises(pz.CheckpointError, match="cannot rescale"):
+        pz.merge_offsets([("x", 1), ("y", 2)], source="src")
+
+
+def test_merge_offsets_refuses_multiple_row_count_frontiers():
+    # row-count frontiers are per-reader-stripe and cannot be re-striped
+    with pytest.raises(pz.CheckpointError, match="row-count"):
+        pz.merge_offsets([{"rows": 5}, {"rows": 7}], source="src")
+    # a single one (the non-partitioned worker-0 source) carries over
+    assert pz.merge_offsets([{"rows": 5}, None]) == {"rows": 5}
+
+
+def test_base_source_id_strips_worker_suffix():
+    assert pz.base_source_id("src-w0") == "src"
+    assert pz.base_source_id("src-w13") == "src"
+    assert pz.base_source_id("src") == "src"
+    assert pz.base_source_id("source_2-w1") == "source_2"
+    assert pz.base_source_id("a-war") == "a-war"  # not a worker suffix
+
+
+# ---------------------------------------------------------------------------
+# Persistence repartition resume
+# ---------------------------------------------------------------------------
+
+
+def _key(w: int, i: int) -> int:
+    # deterministic keys spanning many shards (low 16 bits are the shard)
+    return ((w * 1000 + i + 1) << 16) | ((w * 7919 + i * 31) & 0xFFFF)
+
+
+def _seed_topology(
+    backend: pz.BlobBackend,
+    n: int,
+    monkeypatch,
+    *,
+    rows: int = 12,
+    offsets: dict[int, dict] | None = None,
+) -> list[tuple[int, tuple, int]]:
+    """Commit one generation per worker under topology ``n``; returns the
+    committed (key, row, diff) multiset."""
+    monkeypatch.setenv("PATHWAY_PROCESSES", str(n))
+    committed: list[tuple[int, tuple, int]] = []
+    for w in range(n):
+        storage = pz.PersistentStorage(backend, worker=w)
+        sid = f"src-w{w}" if n > 1 else "src"
+        state = storage.register_source(sid, schema_digest=SCHEMA)
+        for i in range(rows):
+            key = _key(w, i)
+            state.log.record(key, (w, i), 1)
+            committed.append((key, (w, i), 1))
+        state.log.flush_chunk()
+        state.pending_offset = (offsets or {}).get(
+            w, {f"file-{w}": [1.0, rows]}
+        )
+        storage.commit()
+    return committed
+
+
+def _replay_topology(
+    backend: pz.BlobBackend, n: int, monkeypatch
+) -> tuple[list[tuple[int, tuple, int]], list[pz.PersistentStorage]]:
+    """Resume every worker of topology ``n`` and replay; returns the
+    cluster-wide replayed multiset and the storages."""
+    monkeypatch.setenv("PATHWAY_PROCESSES", str(n))
+    replayed: list[tuple[int, tuple, int]] = []
+    storages = []
+    for w in range(n):
+        storage = pz.PersistentStorage(backend, worker=w)
+        sid = f"src-w{w}" if n > 1 else "src"
+        state = storage.register_source(sid, schema_digest=SCHEMA)
+        rows: list[tuple[int, tuple, int]] = []
+        storage.replay_into(
+            state, lambda k, r, d, rows=rows: rows.append((k, r, d))
+        )
+        if storage.repartitioned_from is not None:
+            # repartition replay is shard-filtered: every replayed row is
+            # already owned by this worker (no exchange needed for refs)
+            for k, _r, _d in rows:
+                assert shard_to_worker(k, n) == w
+        replayed.extend(rows)
+        storages.append((storage, state))
+    return replayed, storages
+
+
+def test_repartition_shrink_2_to_1_replays_exactly_once(monkeypatch):
+    backend = pz.MemoryBackend({})
+    committed = _seed_topology(backend, 2, monkeypatch)
+    replayed, storages = _replay_topology(backend, 1, monkeypatch)
+    assert sorted(replayed) == sorted(committed)
+    storage, state = storages[0]
+    assert storage.repartitioned_from == 2
+    assert state.refs and len(state.refs) == 2
+    # the merged offset frontier unions the old workers' per-file maps
+    assert state.offset == {"file-0": [1.0, 12], "file-1": [1.0, 12]}
+    fired = em.get_registry().scalar_metrics().get(
+        "persistence.repartition.sources{worker=0}", 0.0
+    )
+    assert fired >= 1.0
+
+
+def test_repartition_grow_1_to_3_covers_disjointly(monkeypatch):
+    backend = pz.MemoryBackend({})
+    committed = _seed_topology(backend, 1, monkeypatch, rows=40)
+    replayed, storages = _replay_topology(backend, 3, monkeypatch)
+    assert sorted(replayed) == sorted(committed)
+    for storage, _state in storages:
+        assert storage.repartitioned_from == 1
+
+
+def test_repartition_republish_converges_and_composes(monkeypatch):
+    """After a 2 -> 1 rescale the worker republishes under the new
+    topology (refs + chunk_start in the manifest, topology stamped); a
+    SECOND resume at the same count takes the normal path and replays the
+    identical multiset plus post-rescale rows; a FURTHER rescale back to
+    2 composes through the carried refs."""
+    backend = pz.MemoryBackend({})
+    committed = _seed_topology(backend, 2, monkeypatch)
+    _replayed, storages = _replay_topology(backend, 1, monkeypatch)
+    storage, state = storages[0]
+    # post-rescale rows, committed under the new topology
+    extra = []
+    for i in range(8):
+        key = _key(9, i)
+        state.log.record(key, (9, i), 1)
+        extra.append((key, (9, i), 1))
+    state.log.flush_chunk()
+    state.pending_offset = {"file-9": [1.0, 8]}
+    storage.commit()
+    manifest, reason = pz._read_manifest(
+        backend, f"manifests/0/{storage.generation:08d}"
+    )
+    assert reason is None
+    assert manifest["topology"] == 1
+    assert manifest["repartitioned_from"] == 2
+    src = manifest["sources"]["src"]
+    assert src["refs"] and len(src["refs"]) == 2
+    # the fresh manifest deep-verifies, refs included
+    assert pz.verify_manifest(backend, 0, manifest) == []
+
+    # same-topology resume: normal path, identical multiset + extras
+    replayed2, storages2 = _replay_topology(backend, 1, monkeypatch)
+    assert storages2[0][0].repartitioned_from is None
+    assert sorted(replayed2) == sorted(committed + extra)
+
+    # chained rescale back to 2: composes through carried refs
+    replayed3, storages3 = _replay_topology(backend, 2, monkeypatch)
+    assert sorted(replayed3) == sorted(committed + extra)
+    for st, _ in storages3:
+        assert st.repartitioned_from == 1
+
+
+def test_repartition_preserves_old_chunks_via_chunk_start(monkeypatch):
+    """When old and new source ids coincide (worker 0 of a 4 -> 2 shrink
+    keeps sid ``src-w0``), the re-seeded log appends ABOVE the superseded
+    committed range: old chunk files — still pinned by every new worker's
+    refs — are never clobbered."""
+    backend = pz.MemoryBackend({})
+    committed = _seed_topology(backend, 4, monkeypatch, rows=6)
+    old_chunk = backend.get("snapshots/0/src-w0/00000000")
+    assert old_chunk is not None
+
+    _replayed, storages = _replay_topology(backend, 2, monkeypatch)
+    storage, state = storages[0]
+    assert state.chunk_start == 1 and state.log.chunks_written == 1
+    key = _key(5, 0)
+    state.log.record(key, (5, 0), 1)
+    state.log.flush_chunk()
+    state.pending_offset = {"file-5": [1.0, 1]}
+    storage.commit()
+    # the old chunk 0 is byte-identical; the new row landed in chunk 1
+    assert backend.get("snapshots/0/src-w0/00000000") == old_chunk
+    assert backend.get("snapshots/0/src-w0/00000001") is not None
+    manifest, _ = pz._read_manifest(
+        backend, f"manifests/0/{storage.generation:08d}"
+    )
+    src = manifest["sources"]["src-w0"]
+    assert src["chunk_start"] == 1 and src["chunks"] == 2
+    assert len(src["chunk_digests"]) == 1  # own range only
+    assert pz.verify_manifest(backend, 0, manifest) == []
+    # a later same-topology resume replays old rows via refs + the new
+    # row via the own range — exactly once each
+    replayed2, _ = _replay_topology(backend, 2, monkeypatch)
+    assert sorted(replayed2) == sorted(committed + [(key, (5, 0), 1)])
+
+
+def test_chained_rescale_with_ingest_keeps_disjoint_ranges(monkeypatch):
+    """A chained rescale where the SAME source id exists in consecutive
+    topologies (worker 0's ``src-w0`` at N=4 and again at N'=2) produces
+    two DISJOINT ranges of one log: the carried ref over the original
+    epoch and the own range the rescaled epoch appended above it
+    (``chunk_start``).  A later rescale must keep both — deduping them by
+    log name alone would silently drop the older rows."""
+    backend = pz.MemoryBackend({})
+    committed = _seed_topology(backend, 4, monkeypatch, rows=6)
+    _replayed, storages = _replay_topology(backend, 2, monkeypatch)
+    extra = []
+    for w, (storage, state) in enumerate(storages):
+        # real post-rescale ingest on BOTH workers of the middle topology
+        key = _key(8 + w, 0)
+        state.log.record(key, (8 + w, 0), 1)
+        extra.append((key, (8 + w, 0), 1))
+        state.log.flush_chunk()
+        state.pending_offset = {f"file-{8 + w}": [1.0, 1]}
+        storage.commit()
+    replayed, _ = _replay_topology(backend, 1, monkeypatch)
+    assert sorted(replayed) == sorted(committed + extra)
+    # and chaining onward still composes
+    replayed3, _ = _replay_topology(backend, 3, monkeypatch)
+    assert sorted(replayed3) == sorted(committed + extra)
+
+
+def test_repartition_refuses_damaged_old_shard(monkeypatch):
+    backend = pz.MemoryBackend({})
+    _seed_topology(backend, 2, monkeypatch)
+    # bit-flip worker 1's only manifest: its committed state is needed
+    blob = bytearray(backend.get("manifests/1/00000001"))
+    blob[len(blob) // 2] ^= 0x10
+    backend.put("manifests/1/00000001", bytes(blob))
+    monkeypatch.setenv("PATHWAY_PROCESSES", "1")
+    with pytest.raises(pz.CheckpointError, match="data loss"):
+        pz.PersistentStorage(backend, worker=0)
+
+
+def test_repartition_refuses_damaged_own_shard_symmetrically(monkeypatch):
+    """The data-loss guard applies to the resuming worker's OWN shard
+    exactly like to every peer's: a worker whose generations all fail
+    verification must not silently drop its old state into a rescale."""
+    backend = pz.MemoryBackend({})
+    _seed_topology(backend, 2, monkeypatch)
+    blob = bytearray(backend.get("manifests/0/00000001"))
+    blob[len(blob) // 2] ^= 0x10
+    backend.put("manifests/0/00000001", bytes(blob))
+    monkeypatch.setenv("PATHWAY_PROCESSES", "1")
+    with pytest.raises(pz.CheckpointError, match="data loss"):
+        pz.PersistentStorage(backend, worker=0)
+
+
+def test_repartition_matches_user_names_ending_in_worker_suffix(monkeypatch):
+    """A user-chosen source name that itself ends in ``-w<N>`` must match
+    across a rescale: the manifest records the explicit base name, so the
+    strip heuristic is never guessed against user names."""
+    backend = pz.MemoryBackend({})
+    monkeypatch.setenv("PATHWAY_PROCESSES", "1")
+    storage = pz.PersistentStorage(backend, worker=0)
+    state = storage.register_source(
+        "clicks-w2", schema_digest=SCHEMA, base="clicks-w2"
+    )
+    committed = []
+    for i in range(10):
+        key = _key(0, i)
+        state.log.record(key, (0, i), 1)
+        committed.append((key, (0, i), 1))
+    state.log.flush_chunk()
+    state.pending_offset = {"f": [1.0, 10]}
+    storage.commit()
+
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    replayed = []
+    for w in range(2):
+        st = pz.PersistentStorage(backend, worker=w)
+        assert st.repartitioned_from == 1
+        assert st.has_repartition_state(f"clicks-w2-w{w}", "clicks-w2")
+        s = st.register_source(
+            f"clicks-w2-w{w}", schema_digest=SCHEMA, base="clicks-w2"
+        )
+        assert s.refs, "gathered state must match the recorded base"
+        st.replay_into(s, lambda k, r, d: replayed.append((k, r, d)))
+    assert sorted(replayed) == sorted(committed)
+
+
+def test_repartition_single_row_count_frontier_carries_over(monkeypatch):
+    backend = pz.MemoryBackend({})
+    _seed_topology(
+        backend, 2, monkeypatch,
+        offsets={0: {"rows": 12}, 1: None},
+    )
+    _replayed, storages = _replay_topology(backend, 1, monkeypatch)
+    assert storages[0][1].offset == {"rows": 12}
+
+
+def test_orphan_topology_gc_sweeps_manifests_keeps_chunks(monkeypatch):
+    backend = pz.MemoryBackend({})
+    committed = _seed_topology(backend, 2, monkeypatch)
+    pz.acquire_lease(backend, workers=2)
+
+    # before convergence: scrub classifies worker 1 as pending, not damage
+    pz.acquire_lease(backend, workers=1)
+    report = pz.scrub_root(backend)
+    assert report["ok"] is True, report
+    assert report["topology"]["workers"] == 1
+    assert report["workers"][1]["orphaned"] is True
+    assert report["workers"][1]["status"] == "fenced, pending GC"
+    history = report["topology"]["history"]
+    assert [h["workers"] for h in history] == [2, 1]
+
+    _replayed, storages = _replay_topology(backend, 1, monkeypatch)
+    storage, state = storages[0]
+    state.log.record(_key(5, 1), (5, 1), 1)
+    state.log.flush_chunk()
+    state.pending_offset = {"file-5": [1.0, 1]}
+    storage.commit()
+    # worker 0 converged (topology-1 manifest published): the orphaned
+    # worker-1 manifests/pointer are swept, its CHUNKS stay (pinned by
+    # the refs every new manifest carries)
+    assert backend.list_keys("manifests/1/") == []
+    assert backend.get("metadata.json.1") is None
+    assert backend.list_keys("snapshots/1/") != []
+    report = pz.scrub_root(backend)
+    assert report["ok"] is True, report
+    # and the root still replays the full multiset afterwards
+    replayed, _ = _replay_topology(backend, 1, monkeypatch)
+    assert sorted(replayed) == sorted(committed + [(_key(5, 1), (5, 1), 1)])
+
+
+def test_scrub_cli_renders_rescale_history(tmp_path, monkeypatch):
+    from click.testing import CliRunner
+
+    from pathway_tpu.cli import cli
+
+    backend = pz.FileBackend(str(tmp_path))
+    _seed_topology(backend, 2, monkeypatch)
+    pz.acquire_lease(backend, workers=2)
+    pz.acquire_lease(backend, workers=1)
+    result = CliRunner().invoke(cli, ["scrub", str(tmp_path)])
+    assert result.exit_code == 0, result.output
+    assert "topology 1 worker(s)" in result.output
+    assert "rescale history: 2@inc1 -> 1@inc2" in result.output
+    assert "ORPHANED (fenced, pending GC)" in result.output
+
+
+def test_lease_records_topology_and_history():
+    backend = pz.MemoryBackend({})
+    assert pz.acquire_lease(backend, workers=4) == 1
+    assert pz.acquire_lease(backend, workers=4) == 2
+    assert pz.acquire_lease(backend, workers=2) == 3
+    lease = pz.read_lease(backend)
+    assert lease["workers"] == 2
+    assert [
+        (h["incarnation"], h["workers"]) for h in lease["topology_history"]
+    ] == [(1, 4), (3, 2)]
+    # workers=None carries the recorded topology forward
+    pz.acquire_lease(backend)
+    lease = pz.read_lease(backend)
+    assert lease["workers"] == 2
+    assert len(lease["topology_history"]) == 2
+
+
+def test_read_lease_file_is_read_only(tmp_path):
+    missing = tmp_path / "nope"
+    assert pz.read_lease_file(str(missing)) is None
+    assert not missing.exists()  # must not mkdir as a side effect
+    backend = pz.FileBackend(str(tmp_path / "root"))
+    pz.acquire_lease(backend, workers=3)
+    lease = pz.read_lease_file(str(tmp_path / "root"))
+    assert lease["workers"] == 3
+
+
+# ---------------------------------------------------------------------------
+# Runner topology handshake
+# ---------------------------------------------------------------------------
+
+
+def test_topology_handshake_rejects_mismatched_launch(tmp_path, monkeypatch):
+    from types import SimpleNamespace
+
+    from pathway_tpu.internals.runner import _topology_handshake
+
+    backend = pz.FileBackend(str(tmp_path))
+    pz.acquire_lease(backend, workers=2)
+    monkeypatch.setenv("PATHWAY_INCARNATION", "1")
+    cfg = SimpleNamespace(
+        processes=1, process_id=0, replay_storage=str(tmp_path)
+    )
+    with pytest.raises(RuntimeError, match="topology handshake"):
+        _topology_handshake(None, cfg)
+    # the matching topology passes
+    ok = SimpleNamespace(
+        processes=2, process_id=1, replay_storage=str(tmp_path)
+    )
+    _topology_handshake(None, ok)
+    # a worker id outside the leased topology is refused
+    bad_id = SimpleNamespace(
+        processes=2, process_id=7, replay_storage=str(tmp_path)
+    )
+    with pytest.raises(RuntimeError, match="outside the leased topology"):
+        _topology_handshake(None, bad_id)
+    # unsupervised runs (no incarnation) never handshake
+    monkeypatch.delenv("PATHWAY_INCARNATION")
+    _topology_handshake(None, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor degraded-mode shrink
+# ---------------------------------------------------------------------------
+
+
+class _Handle:
+    def __init__(self, code):
+        self.exitcode = code
+
+    def terminate(self):
+        pass
+
+    def kill(self):
+        pass
+
+    def join(self, timeout=None):
+        pass
+
+
+def test_supervisor_shrinks_after_consistent_worker_loss(tmp_path):
+    from pathway_tpu.engine.supervisor import Supervisor
+
+    calls: list[tuple[int, int, int]] = []
+
+    def spawn(worker_id: int, attempt: int, n_workers: int = 0):
+        calls.append((attempt, worker_id, n_workers))
+        if n_workers == 2 and worker_id == 1:
+            return _Handle(1)  # worker 1's host is gone: fails every time
+        return _Handle(0)
+
+    kills_before = em.get_registry().scalar_metrics().get(
+        "supervisor.rescales", 0.0
+    )
+    sup = Supervisor(
+        spawn, 2, max_restarts=1, restart_jitter_s=0.0,
+        shrink_on_loss=True, checkpoint_root=str(tmp_path),
+    )
+    res = sup.run()
+    assert res.exit_codes == [0]
+    assert len(res.rescales) == 1
+    rescale = res.rescales[0]
+    assert rescale["from"] == 2 and rescale["to"] == 1
+    assert rescale["lost_worker"] == 1
+    # the spawner was handed the CURRENT cluster size on every attempt
+    assert {n for _a, _w, n in calls} == {2, 1}
+    assert em.get_registry().scalar_metrics()["supervisor.rescales"] == (
+        kills_before + 1
+    )
+    # the lease records the rescale trail for scrub
+    lease = pz.read_lease_file(str(tmp_path))
+    assert lease["workers"] == 1
+    assert [h["workers"] for h in lease["topology_history"]] == [2, 1]
+
+
+def test_supervisor_shrink_off_fails_with_hint(tmp_path):
+    from pathway_tpu.engine.supervisor import Supervisor, SupervisorError
+
+    def spawn(worker_id: int, attempt: int, n_workers: int = 0):
+        return _Handle(1 if worker_id == 1 else 0)
+
+    with pytest.raises(SupervisorError, match="degraded-mode shrink"):
+        Supervisor(
+            spawn, 2, max_restarts=1, restart_jitter_s=0.0,
+            shrink_on_loss=False, checkpoint_root=str(tmp_path),
+        ).run()
+
+
+def test_supervisor_shrink_does_not_mask_crash_loops(tmp_path):
+    """Alternating worker failures are a crash loop, not a lost host: the
+    shrink heuristic must NOT fire and the budget must fail the run."""
+    from pathway_tpu.engine.supervisor import Supervisor, SupervisorError
+
+    def spawn(worker_id: int, attempt: int, n_workers: int = 0):
+        return _Handle(1 if worker_id == attempt % 2 else 0)
+
+    sup = Supervisor(
+        spawn, 2, max_restarts=1, restart_jitter_s=0.0,
+        shrink_on_loss=True, checkpoint_root=str(tmp_path),
+    )
+    with pytest.raises(SupervisorError, match="restart budget"):
+        sup.run()
+    assert sup.rescales == []
+
+
+def test_supervisor_spawn_failure_counts_as_worker_loss(tmp_path):
+    """A host so dead that spawn() itself raises is routed through the
+    same shrink machinery (with max_restarts=0 the first failure spends
+    the budget and the shrink fires immediately)."""
+    from pathway_tpu.engine.supervisor import Supervisor
+
+    def spawn(worker_id: int, attempt: int, n_workers: int = 0):
+        if n_workers == 2 and worker_id == 1:
+            raise OSError("no such host")
+        return _Handle(0)
+
+    res = Supervisor(
+        spawn, 2, max_restarts=0, restart_jitter_s=0.0,
+        shrink_on_loss=True, checkpoint_root=str(tmp_path),
+    ).run()
+    assert res.exit_codes == [0]
+    assert len(res.rescales) == 1
+    assert "failed to spawn" in res.rescales[0]["reason"]
+
+
+def test_supervisor_two_arg_spawner_still_works(tmp_path):
+    from pathway_tpu.engine.supervisor import Supervisor
+
+    res = Supervisor(
+        lambda w, a: _Handle(0), 2, restart_jitter_s=0.0,
+        checkpoint_root=str(tmp_path),
+    ).run()
+    assert res.exit_codes == [0, 0]
+    assert res.rescales == []
+
+
+# ---------------------------------------------------------------------------
+# Satellite: connector stripe reassignment
+# ---------------------------------------------------------------------------
+
+
+def test_file_reader_repartition_is_idempotent_and_seeks_merged(tmp_path):
+    from pathway_tpu.io._file_readers import (
+        FileReader,
+        _list_files,
+        _path_owner,
+        plaintext_parse_file,
+    )
+
+    for i in range(8):
+        (tmp_path / f"in-{i}.txt").write_text("first\nsecond\n")
+    files = _list_files(str(tmp_path))
+    reader = FileReader(str(tmp_path), plaintext_parse_file, streaming=False)
+    reader.partition(0, 4)
+    old_stripe = set(reader._my_files())
+    reader.partition(1, 2)  # re-stripe under the new topology
+    new_stripe = {f for f in files if _path_owner(f, 2) == 1}
+    # idempotent: exactly the new stripe — no union, no intersection
+    assert set(reader._my_files()) == new_stripe
+    assert new_stripe != old_stripe or len(files) <= 1
+
+    # merged frontier from several old workers: every file already has
+    # one consumed line; the rescaled reader resumes each OWNED file at
+    # line 2 and ignores entries outside its stripe
+    merged = {f: [os.stat(f).st_mtime - 1, 1] for f in files}
+    reader.seek(merged)
+    emitted: list = []
+    reader.run(emitted.append)
+    rows = [e for e in emitted if isinstance(e, dict)]
+    assert len(rows) == len(new_stripe)  # one remaining line per owned file
+    assert all(r["data"] == "second" for r in rows)
+
+
+def test_kafka_reader_repartition_is_idempotent():
+    from pathway_tpu.io.kafka import _KafkaReader
+
+    reader = _KafkaReader({}, "topic", "json", None)
+    parts = list(range(8))
+    assert reader._my_partitions(parts) == parts  # unpartitioned: all
+    reader.partition(0, 4)
+    assert reader._my_partitions(parts) == [0, 4]
+    reader.partition(1, 2)  # re-stripe: exactly the new assignment
+    assert reader._my_partitions(parts) == [1, 3, 5, 7]
+
+
+def test_s3_reader_repartition_is_idempotent():
+    s3 = pytest.importorskip("pathway_tpu.io.s3")
+
+    reader = object.__new__(s3._S3Reader)
+    reader._stripe = None
+    reader.partition(0, 4)
+    first = {k for k in "abcdefgh" if reader._mine(k)}
+    reader.partition(1, 2)
+    second = {k for k in "abcdefgh" if reader._mine(k)}
+    from pathway_tpu.engine.types import hash_values
+
+    assert second == {k for k in "abcdefgh" if hash_values([k]) % 2 == 1}
+    assert first != second or len(second) == 0
+
+
+def test_stale_part_sweep_removes_out_of_topology_shards(tmp_path, monkeypatch):
+    from pathway_tpu.internals.config import refresh_config
+    from pathway_tpu.io._utils import worker_part_path
+
+    out = tmp_path / "counts.jsonl"
+    out.write_text("")
+    for w in (1, 2, 3):
+        (tmp_path / f"counts.jsonl.part-{w}").write_text("stale")
+    monkeypatch.setenv("PATHWAY_PROCESSES", "2")
+    monkeypatch.setenv("PATHWAY_PROCESS_ID", "0")
+    refresh_config()
+    try:
+        # UNSUPERVISED runs never sweep: an unrelated standalone run that
+        # targets the same filename must not destroy other runs' shards
+        assert worker_part_path(str(out)) == str(out)
+        assert (tmp_path / "counts.jsonl.part-3").exists()
+        # supervised (incarnation leased): parts outside the 2-worker
+        # topology are swept; part-1 survives
+        monkeypatch.setenv("PATHWAY_INCARNATION", "1")
+        assert worker_part_path(str(out)) == str(out)
+        assert (tmp_path / "counts.jsonl.part-1").exists()
+        assert not (tmp_path / "counts.jsonl.part-2").exists()
+        assert not (tmp_path / "counts.jsonl.part-3").exists()
+    finally:
+        monkeypatch.delenv("PATHWAY_PROCESSES")
+        monkeypatch.delenv("PATHWAY_PROCESS_ID")
+        monkeypatch.delenv("PATHWAY_INCARNATION", raising=False)
+        refresh_config()

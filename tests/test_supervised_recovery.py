@@ -167,14 +167,24 @@ def _worker_main(wid, attempt, n, port, tmpdir, plan_json, scenario=_scenario):
     scenario(tmpdir)
 
 
-def _run_supervised(tmpdir, plan_json, max_restarts=3, scenario=_scenario):
+def _run_supervised(
+    tmpdir,
+    plan_json,
+    max_restarts=3,
+    scenario=_scenario,
+    n=N_WORKERS,
+    shrink_on_loss=None,
+):
     ctx = multiprocessing.get_context("fork")
-    port = _free_port_base()
+    port = _free_port_base(max(n, N_WORKERS))
 
-    def spawn(wid: int, attempt: int):
+    def spawn(wid: int, attempt: int, n_workers: int = n):
+        # n_workers is the CURRENT cluster size: a degraded-mode shrink
+        # relaunches the group smaller, and the workers' PATHWAY_PROCESSES
+        # must follow
         p = ctx.Process(
             target=_worker_main,
-            args=(wid, attempt, N_WORKERS, port, str(tmpdir), plan_json,
+            args=(wid, attempt, n_workers, port, str(tmpdir), plan_json,
                   scenario),
             daemon=True,
         )
@@ -183,19 +193,20 @@ def _run_supervised(tmpdir, plan_json, max_restarts=3, scenario=_scenario):
 
     return Supervisor(
         spawn,
-        N_WORKERS,
+        n,
         max_restarts=max_restarts,
         restart_jitter_s=0.05,
         checkpoint_root=os.path.join(str(tmpdir), "pstore"),
+        shrink_on_loss=shrink_on_loss,
     ).run()
 
 
-def canonical_bytes(tmpdir) -> bytes:
+def canonical_bytes(tmpdir, name="counts.jsonl", workers=N_WORKERS) -> bytes:
     """Canonical serialized net output across all worker sink shards."""
     state: Counter = Counter()
-    base = Path(tmpdir) / "counts.jsonl"
+    base = Path(tmpdir) / name
     paths = [base] + [
-        Path(f"{base}.part-{w}") for w in range(1, N_WORKERS + 1)
+        Path(f"{base}.part-{w}") for w in range(1, workers + 1)
     ]
     for path in paths:
         if not path.exists():
@@ -414,3 +425,196 @@ def test_supervisor_gives_up_past_restart_budget(tmp_path):
     )
     with pytest.raises(SupervisorError, match="restart budget"):
         _run_supervised(tmp_path, plan_json=plan, max_restarts=1)
+
+
+# ---------------------------------------------------------------------------
+# Elastic rescale-via-recovery (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+def _rescale_scenario(tmpdir: str, out_name: str = "counts.jsonl") -> None:
+    """The ``_gated_scenario`` pipeline with a parameterized output table:
+    each phase of a rescale round trip writes its own table, so part files
+    from a larger topology cannot contaminate a later phase's canonical
+    output.  Gating on on-disk generations keeps the mid-commit kill
+    deterministic; on resumed phases the generations already exist and the
+    gates open instantly."""
+    import pathway_tpu as pw
+
+    manifest_dir = os.path.join(tmpdir, "pstore", "manifests", "0")
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time as _t
+
+            def wait_for_generations(n):
+                deadline = _t.monotonic() + 20
+                while _t.monotonic() < deadline:
+                    try:
+                        committed = [
+                            f for f in os.listdir(manifest_dir)
+                            if not f.endswith(".tmp")  # put_atomic staging
+                        ]
+                        if len(committed) >= n:
+                            return
+                    except OSError:
+                        pass
+                    _t.sleep(0.01)
+                raise RuntimeError(
+                    f"gated source: generation {n} never appeared in "
+                    f"{manifest_dir}"
+                )
+
+            for i in range(N_ROWS):
+                if i == 10:
+                    wait_for_generations(1)
+                elif i == 20:
+                    wait_for_generations(2)
+                self.next(k=i % 3, v=1)
+                self.commit()
+                _t.sleep(ROW_DELAY_S)
+
+    t = pw.io.python.read(
+        Src(), schema=pw.schema_from_types(k=int, v=int), name="src"
+    )
+    counts = t.groupby(t.k).reduce(k=t.k, n=pw.reducers.count())
+    pw.io.jsonlines.write(counts, os.path.join(tmpdir, out_name))
+    pw.run(
+        persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem(os.path.join(tmpdir, "pstore")),
+            snapshot_interval_ms=50,
+        )
+    )
+
+
+def test_rescale_round_trip_4_2_4_byte_identical_under_mid_commit_kill(
+    tmp_path,
+):
+    """ISSUE 10 acceptance: a supervised run checkpointed at N=4 — with a
+    ``writer_crash`` SIGKILL mid-async-commit — resumes at N'=2 (shard-
+    range repartition) and again at N'=4, each phase's final output table
+    byte-identical to an uninterrupted N=4 run; the root scrubs clean and
+    records the full rescale history."""
+    from functools import partial
+
+    from pathway_tpu.engine import persistence as pz
+
+    clean_dir = tmp_path / "clean"
+    clean_dir.mkdir()
+    res_clean = _run_supervised(
+        clean_dir, plan_json=None, n=4,
+        scenario=partial(_rescale_scenario, out_name="counts.jsonl"),
+    )
+    assert res_clean.restarts == 0, res_clean.history
+    expected = canonical_bytes(clean_dir, workers=4)
+    assert expected != b"[]"
+
+    root = tmp_path / "live"
+    root.mkdir()
+    # phase A — N=4, SIGKILLed from inside the checkpoint writer pool
+    # mid-async-commit (chunks landed, manifest unpublished), recovered
+    plan = json.dumps(
+        {
+            "seed": 23,
+            "faults": [
+                {
+                    "kind": "writer_crash",
+                    "worker": 0,
+                    "key": "snapshots/",
+                    "nth": 12,
+                    "attempt": 0,
+                },
+            ],
+        }
+    )
+    res_a = _run_supervised(
+        root, plan_json=plan, n=4,
+        scenario=partial(_rescale_scenario, out_name="counts-a.jsonl"),
+    )
+    assert res_a.restarts >= 1, res_a.history
+    assert res_a.history[0][0] == -signal.SIGKILL, res_a.history
+    assert canonical_bytes(root, "counts-a.jsonl", 4) == expected
+
+    # phase B — resume the same root at N'=2: repartition resume
+    res_b = _run_supervised(
+        root, plan_json=None, n=2,
+        scenario=partial(_rescale_scenario, out_name="counts-b.jsonl"),
+    )
+    assert res_b.restarts == 0, res_b.history
+    assert res_b.exit_codes == [0, 0]
+    assert canonical_bytes(root, "counts-b.jsonl", 2) == expected
+    # rescale provenance on SupervisorResult.recovery
+    assert res_b.recovery[0]["topology"] == 2, res_b.recovery
+    assert res_b.recovery[0]["repartitioned_from"] == 4, res_b.recovery
+
+    # phase C — and back up to N'=4
+    res_c = _run_supervised(
+        root, plan_json=None, n=4,
+        scenario=partial(_rescale_scenario, out_name="counts-c.jsonl"),
+    )
+    assert res_c.restarts == 0, res_c.history
+    assert canonical_bytes(root, "counts-c.jsonl", 4) == expected
+    assert res_c.recovery[0]["topology"] == 4, res_c.recovery
+    assert res_c.recovery[0]["repartitioned_from"] == 2, res_c.recovery
+
+    # the surviving root is sound and remembers the whole trip
+    report = pz.scrub_root(pz.FileBackend(str(root / "pstore")))
+    assert report["ok"] is True, report
+    assert report["topology"]["workers"] == 4
+    assert [
+        h["workers"] for h in report["topology"]["history"]
+    ] == [4, 2, 4], report["topology"]
+
+
+def test_degraded_shrink_completes_run_and_repartitions(tmp_path):
+    """ISSUE 10 acceptance: a permanently lost worker (the same worker
+    crashing on every attempt of the budget) is absorbed by opt-in
+    degraded-mode shrink — the cluster rescales 2 -> 1, the run COMPLETES
+    with the exactly-once output, and the rescale is visible on
+    ``SupervisorResult.rescales``/``recovery`` and in the lease."""
+    from functools import partial
+
+    from pathway_tpu.engine import persistence as pz
+
+    clean_dir = tmp_path / "clean"
+    clean_dir.mkdir()
+    res_clean = _run_supervised(
+        clean_dir, plan_json=None, n=2,
+        scenario=partial(_rescale_scenario, out_name="counts.jsonl"),
+    )
+    assert res_clean.restarts == 0, res_clean.history
+    expected = canonical_bytes(clean_dir, workers=2)
+    assert expected != b"[]"
+
+    faulted = tmp_path / "faulted"
+    faulted.mkdir()
+    # worker 1 dies at epoch 14 on EVERY attempt (no attempt filter): the
+    # lost-host signature.  The gated source guarantees at least one
+    # committed generation exists by then, so the shrunk resume really
+    # repartitions instead of starting fresh.
+    plan = json.dumps(
+        {
+            "seed": 31,
+            "faults": [{"kind": "crash", "worker": 1, "at_epoch": 14}],
+        }
+    )
+    res = _run_supervised(
+        faulted, plan_json=plan, n=2, max_restarts=1, shrink_on_loss=True,
+        scenario=partial(_rescale_scenario, out_name="counts.jsonl"),
+    )
+    assert len(res.rescales) == 1, res.rescales
+    assert res.rescales[0]["from"] == 2 and res.rescales[0]["to"] == 1
+    assert res.rescales[0]["lost_worker"] == 1
+    assert res.exit_codes == [0], res.history
+    # exactly-once output, stale part files of the dead worker swept
+    assert canonical_bytes(faulted, workers=2) == expected
+    assert not (faulted / "counts.jsonl.part-1").exists()
+    # provenance: the surviving worker committed under the new topology,
+    # repartitioned from the old one
+    assert res.recovery[0]["topology"] == 1, res.recovery
+    assert res.recovery[0]["repartitioned_from"] == 2, res.recovery
+    report = pz.scrub_root(pz.FileBackend(str(faulted / "pstore")))
+    assert report["ok"] is True, report
+    lease = pz.read_lease_file(str(faulted / "pstore"))
+    assert lease["workers"] == 1
+    assert [h["workers"] for h in lease["topology_history"]] == [2, 1]
