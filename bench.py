@@ -442,11 +442,11 @@ def _extra_retrieval_p50() -> dict:
     mask = jnp.where(jnp.arange(cap) < n_docs, 0.0, -jnp.inf).astype(jnp.float32)
     qs = jax.random.normal(jax.random.PRNGKey(1), (64, 384), jnp.float32)
     qs = qs / jnp.linalg.norm(qs, axis=1, keepdims=True)
-    kernel = topk_ops._masked_topk_jax
+    kernel = topk_ops.masked_topk_jitted()
     dev_qs = [qs[j][None, :] for j in range(64)]
-    np.asarray(kernel(docs, mask, dev_qs[0], "ip", 10)[0])  # warm + compile
+    np.asarray(kernel(docs, mask, dev_qs[0], metric="ip", k=10)[0])  # warm + compile
     t0 = time.perf_counter()
-    outs = [kernel(docs, mask, q, "ip", 10)[1] for q in dev_qs]
+    outs = [kernel(docs, mask, q, metric="ip", k=10)[1] for q in dev_qs]
     np.asarray(jnp.concatenate(outs))  # one D2H sync for the whole chain
     device_ms = (time.perf_counter() - t0) * 1000.0 / len(dev_qs)
     print(

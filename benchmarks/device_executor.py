@@ -1,0 +1,148 @@
+"""DeviceExecutor benchmark: bucketed dispatch vs ad-hoc per-shape jit,
+and epoch-thread overlap from async dispatch.
+
+Two CPU-measurable claims (the rig has no reachable TPU; the shape
+discipline transfers unchanged when one appears):
+
+* **Bucketing beats ad-hoc shapes.**  A churning stream of ragged batch
+  sizes through one jitted callable: the ad-hoc path feeds raw shapes
+  (one XLA compile per distinct size — exactly what every call site did
+  before ISSUE 11); the executor path buckets onto powers of two after a
+  warmup pass.  Same inputs, same math, compile count is the difference.
+
+* **Async dispatch overlaps the epoch thread.**  The same device work
+  issued synchronously (host prep blocks on each device call) vs through
+  the executor's dispatch queue (host prep of batch i+1 overlaps device
+  execution of batch i — the PR 3 async-committer pattern applied to
+  compute; XLA releases the GIL while it runs).
+
+Protocol: one JSON line per metric (see docs/benchmarking.md).  Ratio
+metrics (`*_speedup`) are noise-immune by construction and carry the
+regression gate; wall-clock ms ride along for context.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from pathway_tpu.device import BucketPolicy, DeviceExecutor
+
+
+def _forward(w, x):
+    for _ in range(4):
+        x = jnp.tanh(x @ w)
+    return x
+
+
+def _ragged_sizes(steps: int, max_rows: int, seed: int = 7) -> list[int]:
+    rng = np.random.default_rng(seed)
+    return [int(n) for n in rng.integers(1, max_rows + 1, size=steps)]
+
+
+def bench_bucketing(steps: int, max_rows: int, dim: int) -> dict[str, float]:
+    sizes = _ragged_sizes(steps, max_rows)
+    w = np.random.default_rng(0).normal(size=(dim, dim)).astype(np.float32) * 0.1
+    batches = [
+        np.random.default_rng(i).normal(size=(n, dim)).astype(np.float32)
+        for i, n in enumerate(sizes)
+    ]
+
+    # ad hoc: one jit wrapper, raw ragged shapes — a compile per distinct size
+    adhoc = jax.jit(_forward)
+    t0 = time.perf_counter()
+    for x in batches:
+        np.asarray(adhoc(w, x))
+    adhoc_s = time.perf_counter() - t0
+
+    # executor: bucketed shapes, warmup included in the measured time
+    ex = DeviceExecutor(collector_name=None)
+    ex.register(
+        "bench:forward",
+        _forward,
+        policy=BucketPolicy(max_bucket=1 << (max_rows - 1).bit_length()),
+    )
+    t0 = time.perf_counter()
+    ex.warmup(
+        "bench:forward", row_shapes=((dim,),), dtypes=(np.float32,), operands=(w,)
+    )
+    for x in batches:
+        ex.run_batch("bench:forward", (x,), operands=(w,))
+    bucketed_s = time.perf_counter() - t0
+    assert ex.stats("bench:forward")["cold"] == 0  # warmup covered every key
+
+    return {
+        "device_executor_adhoc_ms": adhoc_s * 1000.0,
+        "device_executor_bucketed_ms": bucketed_s * 1000.0,
+        "device_executor_bucketed_speedup": adhoc_s / bucketed_s,
+    }
+
+
+def bench_overlap(batches: int, rows: int, dim: int) -> dict[str, float]:
+    w = np.random.default_rng(0).normal(size=(dim, dim)).astype(np.float32) * 0.1
+    x = np.random.default_rng(1).normal(size=(rows, dim)).astype(np.float32)
+    jitted = jax.jit(_forward)
+    np.asarray(jitted(w, x))  # warm: overlap is a steady-state claim
+
+    def device_work():
+        return np.asarray(jitted(w, x))
+
+    def host_work():
+        # epoch-thread stand-in: tokenize/consolidate-grade numpy churn
+        a = np.random.default_rng(2).normal(size=(rows, dim)).astype(np.float32)
+        for _ in range(6):
+            a = a @ w
+        return a
+
+    # synchronous: the epoch thread blocks on every device call
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        host_work()
+        device_work()
+    sync_s = time.perf_counter() - t0
+
+    # async: device batch i runs on the dispatch thread while the epoch
+    # thread preps batch i+1
+    ex = DeviceExecutor(collector_name=None)
+    try:
+        t0 = time.perf_counter()
+        futures = []
+        for _ in range(batches):
+            futures.append(ex.submit(device_work, name="bench:overlap"))
+            host_work()
+        for fut in futures:
+            fut.result(timeout=120.0)
+        async_s = time.perf_counter() - t0
+    finally:
+        ex.close()
+
+    return {
+        "device_executor_sync_ms": sync_s * 1000.0,
+        "device_executor_async_ms": async_s * 1000.0,
+        "device_executor_overlap_speedup": sync_s / async_s,
+    }
+
+
+def main() -> None:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "smoke"
+    if mode == "full":
+        metrics = bench_bucketing(steps=120, max_rows=128, dim=256)
+        metrics.update(bench_overlap(batches=60, rows=512, dim=512))
+    else:
+        metrics = bench_bucketing(steps=40, max_rows=64, dim=128)
+        metrics.update(bench_overlap(batches=30, rows=256, dim=384))
+    for name, value in metrics.items():
+        print(json.dumps({"metric": name, "value": value}))
+
+
+if __name__ == "__main__":
+    main()

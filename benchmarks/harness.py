@@ -96,6 +96,11 @@ SUITE: tuple[Bench, ...] = (
     Bench(
         "rescale_recovery", "rescale_recovery.py", ("smoke",), ("full",),
     ),
+    # DeviceExecutor: bucketed dispatch vs ad-hoc per-shape jit + the
+    # epoch-thread overlap won by async dispatch
+    Bench(
+        "device_executor", "device_executor.py", ("smoke",), ("full",),
+    ),
 )
 
 MODE_REPS = {"smoke": 3, "full": 3}

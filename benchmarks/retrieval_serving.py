@@ -249,7 +249,7 @@ def measure(
     import jax.numpy as jnp
 
     enc = embedder._encoder
-    from pathway_tpu.models.encoder import bucket_batch, bucket_seq_len, pad_batch
+    from pathway_tpu.models.tokenizer import bucket_batch, bucket_seq_len, pad_batch
 
     ids = enc.tokenizer.encode("measured query 0 about topic 0")
     b = bucket_batch(1, enc.max_batch)
@@ -269,10 +269,13 @@ def measure(
         q = rng.normal(size=(1, DIM)).astype(np.float32)
         q /= np.linalg.norm(q)
         jq = jnp.asarray(q)
-        kern = topk_ops._masked_topk_jax
-        np.asarray(kern(cache._padded, cache._mask, jq, "ip", K)[0])
+        kern = topk_ops.masked_topk_jitted()
+        np.asarray(kern(cache._padded, cache._mask, jq, metric="ip", k=K)[0])
         t0 = time.perf_counter()
-        outs = [kern(cache._padded, cache._mask, jq, "ip", K)[1] for _ in range(reps)]
+        outs = [
+            kern(cache._padded, cache._mask, jq, metric="ip", k=K)[1]
+            for _ in range(reps)
+        ]
         np.asarray(jnp.concatenate(outs))
         search_device_ms = (time.perf_counter() - t0) * 1000.0 / reps
 

@@ -122,6 +122,7 @@ class PersistenceMode(_enum.Enum):
 
 # subpackages (imported lazily-ish at the bottom to avoid cycles)
 from pathway_tpu import debug  # noqa: E402
+from pathway_tpu import device  # noqa: E402
 from pathway_tpu import io  # noqa: E402
 from pathway_tpu import demo  # noqa: E402
 from pathway_tpu import persistence  # noqa: E402

@@ -30,6 +30,7 @@ CHAOS_FILES = (
     "test_checkpoint_integrity.py",
     "test_observability.py",
     "test_fencing_watchdog.py",
+    "test_device_executor.py",
 )
 
 PACING_MAX_S = 0.05

@@ -22,6 +22,7 @@ context         policy         meaning
 ``watchdog``    ``bounded``    supervisor progress watchdog
 ``telemetry``   ``bounded``    telemetry sampler + export-queue drain
 ``heartbeat``   ``bounded``    comm-mesh heartbeat loop
+``device``      ``bounded``    DeviceExecutor dispatch thread
 ==============  =============  ==================================================
 
 ``bounded`` contexts may sleep and do I/O — that is their job — but
@@ -52,6 +53,7 @@ POLICIES = {
     "watchdog": "bounded",
     "telemetry": "bounded",
     "heartbeat": "bounded",
+    "device": "bounded",
 }
 
 _SUBPROCESS_FNS = {"run", "call", "check_call", "check_output", "getoutput"}

@@ -22,6 +22,16 @@ shapes that *guarantee* recompiles before the code ever runs:
   every call re-hash-fails into a recompile (and on older jax, a
   ``TypeError``).
 
+* ``jit-outside-executor`` — any ``jax.jit``/``pjit`` construction in
+  ``xpacks/`` or ``stdlib/``: since the DeviceExecutor landed
+  (``pathway_tpu/device/``) it is the ONE sanctioned jit entry point for
+  model/index code — it owns batch bucketing, the explicit compile-cache
+  keys, warmup, and the dispatch metrics.  A direct jit there compiles
+  outside that discipline: no bucket policy, no ``device.cache.cold``
+  accounting, invisible to ``warmup()``.  Register the callable instead
+  (``executor.register(...)`` + ``run_batch``).  Suppressible like every
+  rule when a site genuinely cannot route through the executor.
+
 Shape-*value* variance (ragged batches hitting a jitted function) is
 invisible to static analysis — that half of the pin stays with the
 runtime counter; the bucketing helper these rules push call sites
@@ -31,6 +41,7 @@ toward is what makes the runtime pin reachable.
 from __future__ import annotations
 
 import ast
+import os
 from typing import Iterable
 
 from pathway_tpu.analysis.core import Finding, Project, Rule, SourceFile
@@ -235,8 +246,49 @@ def _check_nonhashable_static(file: SourceFile) -> Iterable[Finding]:
                     )
 
 
+# path segments whose files must route jit through the DeviceExecutor
+_EXECUTOR_GUARDED_SEGMENTS = {"xpacks", "stdlib"}
+
+
+def _check_outside_executor(file: SourceFile) -> Iterable[Finding]:
+    """Every jit construction in an executor-guarded tree is a finding —
+    decorator or not: the objection is to the compile cache existing
+    outside the executor's discipline, not to any one call shape."""
+    parts = set(file.display_path.replace(os.sep, "/").split("/"))
+    if not (parts & _EXECUTOR_GUARDED_SEGMENTS):
+        return
+    flagged: list[ast.AST] = []
+    for node in ast.walk(file.tree):
+        if isinstance(node, ast.Call) and (
+            _is_jit_call(node) or _is_jit_callable(node)
+        ):
+            flagged.append(node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # bare `@jax.jit` decorators are Attribute nodes, not Calls
+            flagged.extend(
+                d
+                for d in node.decorator_list
+                if not isinstance(d, ast.Call) and _is_jit_callable(d)
+            )
+    seen_lines: set[int] = set()
+    for node in flagged:
+        if node.lineno in seen_lines:
+            continue
+        seen_lines.add(node.lineno)
+        yield Finding(
+            "jit-outside-executor",
+            file.display_path,
+            node.lineno,
+            "direct jax.jit in an xpacks/stdlib module — the "
+            "DeviceExecutor (pathway_tpu/device/) is the sanctioned jit "
+            "entry point: register the callable and dispatch via "
+            "run_batch so bucketing, cache-key accounting and warmup "
+            "apply",
+        )
+
+
 def _cached_jit_findings(project: Project) -> list[Finding]:
-    """One walk (and one parent-map build) per file serves all four
+    """One walk (and one parent-map build) per file serves all five
     rules — they filter by id from this shared pass."""
     cached = getattr(project, "_jit_findings", None)
     if cached is None:
@@ -244,6 +296,7 @@ def _cached_jit_findings(project: Project) -> list[Finding]:
         for file in project.package_files:
             cached.extend(_check_file(file))
             cached.extend(_check_nonhashable_static(file))
+            cached.extend(_check_outside_executor(file))
         project._jit_findings = cached  # type: ignore[attr-defined]
     return cached
 
@@ -275,5 +328,10 @@ RULES = [
         "jit-nonhashable-static",
         "container literal passed in a static_argnums/static_argnames slot",
         _run("jit-nonhashable-static"),
+    ),
+    Rule(
+        "jit-outside-executor",
+        "jax.jit in xpacks/stdlib outside the DeviceExecutor entry point",
+        _run("jit-outside-executor"),
     ),
 ]

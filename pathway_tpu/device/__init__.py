@@ -1,0 +1,29 @@
+"""Fixed-shape, bucketed, async device dispatch (docs/device_executor.md).
+
+The subsystem the ROADMAP's perf arc rides on: ``DeviceExecutor`` owns
+batch bucketing + padding masks (``bucketing.py``), jit compile-cache
+discipline with explicit keys and warmup, and an async dispatch queue
+with a bounded in-flight budget exported as ``backlog.device.*``.
+"""
+
+from pathway_tpu.device.bucketing import (
+    BatchChunk,
+    BucketPolicy,
+    pad_batch_dim,
+    stack_rows,
+)
+from pathway_tpu.device.executor import (
+    DeviceExecutor,
+    DeviceFuture,
+    get_default_executor,
+)
+
+__all__ = [
+    "BatchChunk",
+    "BucketPolicy",
+    "DeviceExecutor",
+    "DeviceFuture",
+    "get_default_executor",
+    "pad_batch_dim",
+    "stack_rows",
+]

@@ -1,0 +1,158 @@
+"""Bucketing planner: ragged row-delta batches → fixed-shape device batches.
+
+The streaming engine hands the device path ragged batches — whatever row
+count an epoch happened to produce.  Feeding those shapes to ``jax.jit``
+directly would retrace per distinct batch size and the steady-state
+``jax.cache.miss == 0`` pin (``tests/test_jax_accounting.py``) could
+never hold.  This module is the ONE place batch shapes are decided:
+
+* :class:`BucketPolicy` rounds a row count up to a small declared set of
+  power-of-two buckets, so every jitted callable compiles once per
+  bucket and then only ever sees warm shapes;
+* :func:`BucketPolicy.plan` splits a batch larger than the biggest
+  bucket into full-bucket chunks plus one bucketed remainder;
+* :func:`pad_batch_dim` pads the batch axis up to the bucket and returns
+  the row-validity mask (padded rows are zeros + mask 0, and the row-wise
+  kernels this repo jits — encoder trunks, top-k scans — provably cannot
+  leak a padded row into a real row's output; pinned by
+  ``tests/test_device_executor.py``);
+* :func:`stack_rows` stacks per-row arrays into one batch, REFUSING
+  dtype or trailing-shape mixes loudly — silently co-batching an f32 row
+  with an f64 one would either upcast the whole batch (a 2x HBM bill) or
+  corrupt values, and both are bugs at the call site, not here.
+
+Sequence-length bucketing stays with the tokenizer
+(``models/tokenizer.py:bucket_seq_len``): it is a domain decision made
+before rows reach the executor; this planner owns the batch axis only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import numpy as np
+
+# the default declared bucket set: powers of two from a lone serving
+# query up to the default max batch.  Small on purpose — every bucket is
+# one more compile per callable.
+DEFAULT_MAX_BUCKET = 512
+
+
+def next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchChunk:
+    """One fixed-shape chunk of a planned ragged batch."""
+
+    start: int  # first row of the chunk in the submitted batch
+    count: int  # real rows in the chunk
+    bucket: int  # padded (compiled) batch size, count <= bucket
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPolicy:
+    """Rounds ragged row counts up to declared power-of-two buckets.
+
+    ``min_bucket=1`` keeps a lone serving query cheap (it compiles its
+    own bucket rather than paying a 8-64x padded batch); raise it when a
+    workload is batch-heavy and compile count matters more than the
+    occasional small-batch padding.
+    """
+
+    min_bucket: int = 1
+    max_bucket: int = DEFAULT_MAX_BUCKET
+
+    def __post_init__(self):
+        if self.min_bucket < 1:
+            raise ValueError("min_bucket must be >= 1")
+        if self.max_bucket < self.min_bucket:
+            raise ValueError("max_bucket must be >= min_bucket")
+
+    def bucket_for(self, n: int) -> int:
+        """The compiled batch size for ``n`` rows (n <= max_bucket)."""
+        if n < 1:
+            raise ValueError("cannot bucket an empty batch")
+        if n > self.max_bucket:
+            raise ValueError(
+                f"batch of {n} exceeds the largest bucket "
+                f"{self.max_bucket}; plan() splits it first"
+            )
+        return min(max(next_pow2(n), self.min_bucket), self.max_bucket)
+
+    def buckets(self) -> tuple[int, ...]:
+        """Every bucket this policy can emit, ascending — the warmup set."""
+        out = []
+        b = self.min_bucket
+        if b & (b - 1):
+            b = next_pow2(b)
+        while b < self.max_bucket:
+            out.append(b)
+            b <<= 1
+        out.append(self.max_bucket)
+        return tuple(out)
+
+    def plan(self, n: int) -> list[BatchChunk]:
+        """Split ``n`` rows into fixed-shape chunks: full ``max_bucket``
+        chunks first, then one bucketed remainder.  Every chunk's bucket
+        is from :meth:`buckets`, so a warmed callable never recompiles."""
+        if n < 1:
+            raise ValueError("cannot plan an empty batch")
+        chunks: list[BatchChunk] = []
+        start = 0
+        while n - start > self.max_bucket:
+            chunks.append(BatchChunk(start, self.max_bucket, self.max_bucket))
+            start += self.max_bucket
+        rest = n - start
+        chunks.append(BatchChunk(start, rest, self.bucket_for(rest)))
+        return chunks
+
+
+def pad_batch_dim(
+    array: np.ndarray, bucket: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pad ``array``'s leading (batch) axis with zero rows up to
+    ``bucket``; returns ``(padded, mask)`` with ``mask[i] = 1.0`` for
+    real rows.  A no-copy passthrough when already exactly bucket-sized."""
+    n = array.shape[0]
+    if n > bucket:
+        raise ValueError(f"batch of {n} does not fit bucket {bucket}")
+    mask = np.zeros((bucket,), dtype=np.float32)
+    mask[:n] = 1.0
+    if n == bucket:
+        return array, mask
+    padded = np.zeros((bucket,) + array.shape[1:], dtype=array.dtype)
+    padded[:n] = array
+    return padded, mask
+
+
+def stack_rows(rows: Sequence[np.ndarray]) -> tuple[np.ndarray, int]:
+    """Stack per-row arrays into one ``[n, ...]`` batch, refusing mixes.
+
+    Returns ``(batch, n_rows)``.  Raises :class:`ValueError` when rows
+    disagree on dtype or trailing shape — the dtype-mix refusal the
+    bucketing contract promises (a mixed batch would silently upcast or
+    corrupt; the caller must split by dtype before submitting)."""
+    if not rows:
+        raise ValueError("cannot stack an empty row list")
+    first = np.asarray(rows[0])
+    arrays = [first]
+    for i, row in enumerate(rows[1:], start=1):
+        arr = np.asarray(row)
+        if arr.dtype != first.dtype:
+            raise ValueError(
+                f"dtype mix in one device batch: row 0 is {first.dtype}, "
+                f"row {i} is {arr.dtype} — split the batch by dtype"
+            )
+        if arr.shape != first.shape:
+            raise ValueError(
+                f"shape mix in one device batch: row 0 is {first.shape}, "
+                f"row {i} is {arr.shape} — pad rows to one shape first"
+            )
+        arrays.append(arr)
+    return np.stack(arrays), len(arrays)

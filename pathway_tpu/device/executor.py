@@ -1,0 +1,623 @@
+"""DeviceExecutor: the one sanctioned device-dispatch path.
+
+Every jitted hot-path callable in this repo (encoder towers, rerankers,
+the indexing top-k scan) used to shape its own batches ad hoc; this
+module centralizes the three disciplines the device path needs
+(ROADMAP "DeviceExecutor" arc; WindVE's collaborative CPU↔device queue
+in PAPERS.md is the model):
+
+1. **Fixed shapes** — :meth:`DeviceExecutor.run_batch` plans ragged row
+   batches onto the declared power-of-two buckets
+   (``device/bucketing.py``), pads with masked zero rows, and splits
+   oversized batches, so a registered callable compiles once per bucket
+   and steady-state ``jax.cache.miss`` stays at zero (the PR 8 dynamic
+   counter is the pin, ``tests/test_jax_accounting.py``).
+
+2. **Compile-cache discipline** — callables are registered once
+   (:meth:`register`) and jitted once; every dispatch computes an
+   explicit cache key (callable id, bucket shapes, dtypes, static args,
+   backend) so cold compiles are *counted* (``device.cache.cold``) and
+   can be paid ahead of traffic via :meth:`warmup`.  ``pathway_tpu
+   lint`` enforces the other half: a direct ``jax.jit`` call site in
+   ``xpacks/``/``stdlib/`` is a ``jit-outside-executor`` finding.
+
+3. **Async dispatch with bounded in-flight budget** — :meth:`submit`
+   queues host-side batch jobs onto a dispatch thread and hands a
+   :class:`DeviceFuture` back, so device work overlaps epoch execution
+   (the PR 3 async-committer overlap pattern applied to compute).  The
+   budget is bytes + requests (``PATHWAY_DEVICE_INFLIGHT_MB`` /
+   ``PATHWAY_DEVICE_INFLIGHT_REQUESTS``); a full queue backpressures the
+   submitter and the stall is *counted* (``device.backpressure.s``).
+   Queue depth/bytes/age export under ``backlog.device.*`` so a device
+   stall is attributable next to every other wait point in the system
+   (PR 9's backpressure namespace) — proven by the ``device_stall``
+   chaos fault (``engine/faults.py``).
+
+``AsyncMicroBatcher`` (``utils/batching.py``) is the coalescing
+front-end over :meth:`submit`; model code reaches :meth:`run_batch`
+from inside its batch callbacks.  The two layers compose: submit owns
+the queue and the budget, run_batch owns shapes and the compile cache,
+and run_batch is safe to call from a dispatch-thread job (it executes
+inline, never re-enters the queue).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+from pathway_tpu.device.bucketing import (
+    BucketPolicy,
+    pad_batch_dim,
+)
+from pathway_tpu.engine import metrics as _metrics
+
+__all__ = [
+    "DeviceExecutor",
+    "DeviceFuture",
+    "get_default_executor",
+]
+
+try:
+    import jax
+
+    _HAVE_JAX = True
+except Exception:  # pragma: no cover - jax is a baked-in dependency
+    _HAVE_JAX = False
+
+
+class DeviceFuture:
+    """Thread-safe future for one queued device job.
+
+    The epoch thread holds these while the dispatch thread works; waits
+    are sliced (1 s) so a supervised worker blocked here still touches
+    its progress beacon machinery rather than vanishing into an untimed
+    wait."""
+
+    __slots__ = ("_event", "_result", "_exc", "_callbacks", "_lock")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result: Any = None
+        self._exc: BaseException | None = None
+        self._callbacks: list[Callable[["DeviceFuture"], None]] = []
+        self._lock = threading.Lock()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def set_result(self, value: Any) -> None:
+        with self._lock:
+            self._result = value
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            self._run_callback(cb)
+
+    def set_exception(self, exc: BaseException) -> None:
+        with self._lock:
+            self._exc = exc
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            self._run_callback(cb)
+
+    def _run_callback(self, cb: Callable[["DeviceFuture"], None]) -> None:
+        try:
+            cb(self)
+        except Exception:  # noqa: BLE001 - a bad callback must not kill dispatch
+            pass
+
+    def add_done_callback(self, cb: Callable[["DeviceFuture"], None]) -> None:
+        """Run ``cb(self)`` once resolved (immediately when already done).
+        Callbacks run on the dispatch thread — keep them cheap."""
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(cb)
+                return
+        self._run_callback(cb)
+
+    def result(self, timeout: float | None = None) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._event.is_set():
+            remaining = 1.0
+            if deadline is not None:
+                remaining = min(1.0, deadline - time.monotonic())
+                if remaining <= 0:
+                    raise TimeoutError("device job did not complete in time")
+            self._event.wait(timeout=remaining)
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _Registered:
+    """One registered traceable: its jit wrapper + compile-key ledger."""
+
+    __slots__ = (
+        "name", "jitted", "policy", "seen_keys", "dispatches", "cold",
+        "warmed", "lock",
+    )
+
+    def __init__(self, name: str, jitted: Callable, policy: BucketPolicy):
+        self.name = name
+        self.jitted = jitted
+        self.policy = policy
+        self.seen_keys: set[tuple] = set()
+        self.dispatches = 0
+        self.cold = 0
+        self.warmed = 0
+        # guards the ledger only (never held around the device call):
+        # run_batch is legal from epoch, serving, and dispatch threads
+        # concurrently, and a check-then-act race on seen_keys would
+        # double-count cold compiles — tripping the "nonzero cold after
+        # warmup is a bug" invariant spuriously
+        self.lock = threading.Lock()
+
+
+class _Job:
+    """One queued host-side batch job (the submit path)."""
+
+    __slots__ = ("name", "fn", "future", "nbytes", "enqueued_at")
+
+    def __init__(self, name: str, fn: Callable[[], Any], nbytes: int):
+        self.name = name
+        self.fn = fn
+        self.future = DeviceFuture()
+        self.nbytes = max(0, int(nbytes))
+        self.enqueued_at = time.monotonic()
+
+
+def _donation_enabled() -> bool:
+    """``PATHWAY_DEVICE_DONATE``: ``auto`` donates only where XLA
+    implements donation (not the CPU backend, which would warn per
+    call), ``on``/``off`` force it."""
+    from pathway_tpu.internals.config import env_str
+
+    mode = (env_str("PATHWAY_DEVICE_DONATE") or "auto").strip().lower()
+    if mode in ("on", "1", "true"):
+        return True
+    if mode in ("off", "0", "false"):
+        return False
+    return _HAVE_JAX and jax.default_backend() not in ("cpu",)
+
+
+class DeviceExecutor:
+    """Bucketed, cache-disciplined, async device dispatch (one per
+    process in practice — :func:`get_default_executor`)."""
+
+    def __init__(
+        self,
+        *,
+        max_inflight_mb: float | None = None,
+        max_inflight_requests: int | None = None,
+        collector_name: str | None = "device.executor",
+    ):
+        from pathway_tpu.internals.config import env_float, env_int
+
+        if max_inflight_mb is None:
+            max_inflight_mb = env_float("PATHWAY_DEVICE_INFLIGHT_MB")
+        if max_inflight_requests is None:
+            max_inflight_requests = env_int("PATHWAY_DEVICE_INFLIGHT_REQUESTS")
+        self.max_inflight_bytes = int(float(max_inflight_mb) * 1024 * 1024)
+        self.max_inflight_requests = int(max_inflight_requests)
+        self._callables: dict[str, _Registered] = {}
+        self._queue: list[_Job] = []
+        self._running: _Job | None = None
+        self._inflight_bytes = 0
+        self._cond = threading.Condition()
+        self._thread: threading.Thread | None = None
+        self._stop = False
+        reg = _metrics.get_registry()
+        self._m_batches = reg.counter(
+            "device.dispatch.batches", "fixed-shape device batches dispatched"
+        )
+        self._m_rows = reg.counter(
+            "device.dispatch.rows", "real rows dispatched through the executor"
+        )
+        self._m_pad = reg.counter(
+            "device.pad.rows", "padding rows added by bucketing"
+        )
+        self._m_cold = reg.counter(
+            "device.cache.cold", "first dispatches of a new compile-cache key"
+        )
+        self._m_warm = reg.counter(
+            "device.warmup.compiles", "compile-cache keys paid ahead by warmup()"
+        )
+        self._m_jobs = reg.counter(
+            "device.jobs", "async host-side batch jobs dispatched"
+        )
+        self._m_backpressure = reg.counter(
+            "device.backpressure.s",
+            "seconds submitters stalled on the in-flight budget",
+        )
+        self._m_dispatch_ms = reg.histogram(
+            "device.dispatch.ms",
+            "wall time of one dispatched device call (ms)",
+            buckets=_metrics.MS_BUCKETS,
+        )
+        self._m_job_ms = reg.histogram(
+            "device.job.ms",
+            "wall time of one async host-side batch job (ms)",
+            buckets=_metrics.MS_BUCKETS,
+        )
+        if collector_name:
+            reg.register_collector(collector_name, self.metrics_snapshot)
+
+    # -- registration & compile-cache discipline -----------------------------
+
+    def register(
+        self,
+        name: str,
+        fn: Callable,
+        *,
+        static_argnames: Sequence[str] = (),
+        donate_argnums: Sequence[int] = (),
+        policy: BucketPolicy | None = None,
+    ) -> str:
+        """Register traceable ``fn`` under ``name`` and jit it ONCE.
+
+        ``fn`` is called as ``fn(*operands, *arrays, **static)`` where
+        the arrays carry the bucketed batch axis.  ``donate_argnums``
+        name the array positions safe to donate (fresh padded buffers);
+        donation is applied only where the backend implements it (see
+        ``PATHWAY_DEVICE_DONATE``).  Re-registering a name replaces the
+        callable and resets its compile ledger."""
+        if policy is None:
+            from pathway_tpu.internals.config import env_int
+
+            policy = BucketPolicy(max_bucket=env_int("PATHWAY_DEVICE_MAX_BATCH"))
+        jitted = self._jit_wrap(fn, tuple(static_argnames), tuple(donate_argnums))
+        self._callables[name] = _Registered(name, jitted, policy)
+        return name
+
+    def _jit_wrap(
+        self,
+        fn: Callable,
+        static_argnames: tuple[str, ...],
+        donate_argnums: tuple[int, ...],
+    ) -> Callable:
+        if not _HAVE_JAX:
+            return fn
+        kwargs: dict[str, Any] = {}
+        if static_argnames:
+            kwargs["static_argnames"] = static_argnames
+        if donate_argnums and _donation_enabled():
+            kwargs["donate_argnums"] = donate_argnums
+        return jax.jit(fn, **kwargs)
+
+    def registered(self, name: str) -> bool:
+        return name in self._callables
+
+    def jitted(self, name: str) -> Callable:
+        """The raw compiled wrapper of a registered callable — for
+        benchmarks/tests that feed pre-padded fixed shapes directly.
+        Production code goes through :meth:`run_batch`, which is what
+        keeps the shapes on-bucket."""
+        return self._callables[name].jitted
+
+    def cache_keys(self, name: str) -> set[tuple]:
+        """The compile-cache keys this executor has dispatched (or
+        warmed) for ``name`` — the discipline ledger, for tests and
+        ``warmup`` planning."""
+        entry = self._callables[name]
+        with entry.lock:
+            return set(entry.seen_keys)
+
+    def stats(self, name: str) -> dict[str, int]:
+        entry = self._callables[name]
+        with entry.lock:
+            return {
+                "dispatches": entry.dispatches,
+                "cold": entry.cold,
+                "warmed": entry.warmed,
+                "keys": len(entry.seen_keys),
+            }
+
+    @staticmethod
+    def _cache_key(
+        operands: tuple, arrays: tuple, static: dict[str, Any] | None
+    ) -> tuple:
+        """Explicit cache key: every leaf's (shape, dtype) + static args
+        + backend.  Mirrors what jit keys on, so ``seen_keys`` tracks
+        the real compile cache one-to-one."""
+        leaves: list[tuple] = []
+        if _HAVE_JAX:
+            flat = jax.tree_util.tree_leaves((operands, arrays))
+        else:
+            flat = list(operands) + list(arrays)
+        for leaf in flat:
+            leaves.append(
+                (tuple(getattr(leaf, "shape", ())), str(getattr(leaf, "dtype", type(leaf).__name__)))
+            )
+        static_key = tuple(sorted((static or {}).items()))
+        backend = jax.default_backend() if _HAVE_JAX else "host"
+        return (tuple(leaves), static_key, backend)
+
+    def _dispatch_fixed(
+        self,
+        entry: _Registered,
+        operands: tuple,
+        arrays: tuple,
+        static: dict[str, Any] | None,
+        *,
+        warmup: bool = False,
+    ) -> Any:
+        key = self._cache_key(operands, arrays, static)
+        with entry.lock:
+            fresh = key not in entry.seen_keys
+            if fresh:
+                entry.seen_keys.add(key)
+                if warmup:
+                    entry.warmed += 1
+                else:
+                    entry.cold += 1
+            entry.dispatches += 1
+        if fresh:
+            (self._m_warm if warmup else self._m_cold).inc()
+        t0 = time.monotonic()
+        out = entry.jitted(*operands, *arrays, **(static or {}))
+        if _HAVE_JAX:
+            out = jax.tree_util.tree_map(np.asarray, out)
+        self._m_dispatch_ms.observe((time.monotonic() - t0) * 1000.0)
+        self._m_batches.inc()
+        return out
+
+    # -- the fixed-shape inline path -----------------------------------------
+
+    def run_batch(
+        self,
+        name: str,
+        arrays: Sequence[np.ndarray],
+        n_rows: int | None = None,
+        *,
+        operands: Sequence[Any] = (),
+        static: dict[str, Any] | None = None,
+    ) -> Any:
+        """Run a ragged batch through the registered callable on warm
+        bucketed shapes; returns outputs with padding sliced off.
+
+        ``arrays`` share a leading batch axis of ``n_rows`` (defaulting
+        to the first array's).  Batches above the policy's largest
+        bucket are split; each chunk is padded to its bucket with zero
+        rows.  Outputs (a single array or a tuple/list of arrays, each
+        leading with the batch axis) are unpadded and concatenated back
+        to ``n_rows``.  Executes inline on the calling thread — safe
+        from a dispatch-thread job; use :meth:`submit` for async."""
+        entry = self._callables[name]
+        arrays = tuple(np.asarray(a) for a in arrays)
+        if n_rows is None:
+            n_rows = arrays[0].shape[0]
+        if n_rows == 0:
+            raise ValueError("cannot dispatch an empty batch")
+        for a in arrays:
+            if a.shape[0] != n_rows:
+                raise ValueError(
+                    f"batch arrays disagree on row count: {a.shape[0]} != {n_rows}"
+                )
+        operands = tuple(operands)
+        chunk_outs: list[Any] = []
+        for chunk in entry.policy.plan(n_rows):
+            padded = tuple(
+                pad_batch_dim(a[chunk.start : chunk.start + chunk.count], chunk.bucket)[0]
+                for a in arrays
+            )
+            self._m_rows.inc(chunk.count)
+            self._m_pad.inc(chunk.bucket - chunk.count)
+            out = self._dispatch_fixed(entry, operands, padded, static)
+            chunk_outs.append(_slice_rows(out, chunk.count))
+        if len(chunk_outs) == 1:
+            return chunk_outs[0]
+        return _concat_rows(chunk_outs)
+
+    def warmup(
+        self,
+        name: str,
+        row_shapes: Sequence[tuple[int, ...]],
+        dtypes: Sequence[Any],
+        *,
+        operands: Sequence[Any] = (),
+        static: dict[str, Any] | None = None,
+        buckets: Sequence[int] | None = None,
+    ) -> int:
+        """Pay every bucket's compile before traffic arrives.
+
+        ``row_shapes``/``dtypes`` describe one row of each array (the
+        trailing shape, without the batch axis).  Returns the number of
+        cache keys compiled.  Warmed keys count under
+        ``device.warmup.compiles``, not ``device.cache.cold`` — after a
+        full warmup, any nonzero cold counter is a discipline bug."""
+        entry = self._callables[name]
+        if buckets is None:
+            buckets = entry.policy.buckets()
+        before = len(entry.seen_keys)
+        for bucket in buckets:
+            arrays = tuple(
+                np.zeros((bucket,) + tuple(shape), dtype=dtype)
+                for shape, dtype in zip(row_shapes, dtypes)
+            )
+            self._dispatch_fixed(
+                entry, tuple(operands), arrays, static, warmup=True
+            )
+        return len(entry.seen_keys) - before
+
+    # -- the async host-job path ---------------------------------------------
+
+    def submit(
+        self,
+        fn: Callable[[], Any],
+        *,
+        name: str = "host",
+        nbytes: int = 0,
+        timeout_s: float | None = None,
+    ) -> DeviceFuture:
+        """Queue ``fn()`` onto the dispatch thread; returns its future.
+
+        Blocks (bounded, counted) while the in-flight budget — requests
+        and bytes — is exhausted: that stall IS the backpressure signal,
+        surfaced as ``device.backpressure.s`` and attributable live via
+        ``backlog.device.*``.  Never call from the dispatch thread (a
+        dispatch-thread job that needs device work calls
+        :meth:`run_batch` inline instead)."""
+        if (
+            self._thread is not None
+            and threading.current_thread() is self._thread
+        ):
+            raise RuntimeError(
+                "submit() called from the dispatch thread — run_batch() "
+                "is the inline API for dispatch-side device work"
+            )
+        job = _Job(name, fn, nbytes)
+        deadline = (
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
+        stalled = 0.0
+        try:
+            with self._cond:
+                while self._over_budget():
+                    if deadline is not None and time.monotonic() >= deadline:
+                        raise TimeoutError(
+                            "device executor in-flight budget full past deadline"
+                        )
+                    t0 = time.monotonic()
+                    self._cond.wait(timeout=0.1)
+                    stalled += time.monotonic() - t0
+                self._inflight_bytes += job.nbytes
+                self._queue.append(job)
+                self._ensure_thread()
+                self._cond.notify_all()
+        finally:
+            # a timed-out submit stalled too — the count must not hide it
+            if stalled:
+                self._m_backpressure.inc(stalled)
+        return job.future
+
+    def _over_budget(self) -> bool:
+        inflight = len(self._queue) + (1 if self._running is not None else 0)
+        return (
+            inflight >= self.max_inflight_requests
+            or self._inflight_bytes >= self.max_inflight_bytes
+        )
+
+    def _ensure_thread(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name="device-dispatch", daemon=True
+        )
+        self._thread.start()
+
+    # pathway-lint: context=device
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait(timeout=1.0)
+                if self._stop and not self._queue:
+                    return
+                job = self._queue.pop(0)
+                self._running = job
+            try:
+                self._run_job(job)
+            finally:
+                with self._cond:
+                    self._running = None
+                    self._inflight_bytes -= job.nbytes
+                    self._cond.notify_all()
+
+    def _run_job(self, job: _Job) -> None:
+        self._maybe_stall(job)
+        t0 = time.monotonic()
+        try:
+            result = job.fn()
+        except BaseException as exc:  # noqa: BLE001 - delivered to the waiter
+            job.future.set_exception(exc)
+            return
+        # a host job's wall time (tokenize + inner run_batch calls) is a
+        # different quantity from one device call — separate histogram
+        self._m_job_ms.observe((time.monotonic() - t0) * 1000.0)
+        self._m_jobs.inc()
+        job.future.set_result(result)
+
+    def _maybe_stall(self, job: _Job) -> None:
+        """``device_stall`` fault injection: delay dispatch, no error —
+        only ``backlog.device.*`` and the freshness layer can see it."""
+        from pathway_tpu.engine import faults
+
+        spec = faults.check("device_stall", source=job.name)
+        if spec is None:
+            return
+        deadline = time.monotonic() + spec.delay_ms / 1000.0
+        while time.monotonic() < deadline and not self._stop:
+            time.sleep(0.05)
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Stop the dispatch thread after draining the queue (tests)."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join(timeout=timeout_s)
+
+    # -- observability -------------------------------------------------------
+
+    def metrics_snapshot(self) -> dict[str, float]:
+        """Registry collector: the ``backlog.device.*`` namespace."""
+        with self._cond:
+            jobs = list(self._queue)
+            if self._running is not None:
+                jobs.append(self._running)
+            inflight_bytes = self._inflight_bytes
+        now = time.monotonic()
+        out = {
+            "backlog.device.queue": float(len(jobs)),
+            "backlog.device.bytes": float(inflight_bytes),
+        }
+        if jobs:
+            out["backlog.device.age.s"] = max(
+                0.0, now - min(j.enqueued_at for j in jobs)
+            )
+        else:
+            out["backlog.device.age.s"] = 0.0
+        return out
+
+
+def _slice_rows(out: Any, count: int) -> Any:
+    if isinstance(out, (tuple, list)):
+        return type(out)(np.asarray(o)[:count] for o in out)
+    return np.asarray(out)[:count]
+
+
+def _concat_rows(chunks: list[Any]) -> Any:
+    first = chunks[0]
+    if isinstance(first, (tuple, list)):
+        return type(first)(
+            np.concatenate([c[i] for c in chunks], axis=0)
+            for i in range(len(first))
+        )
+    return np.concatenate(chunks, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Process-wide default executor
+# ---------------------------------------------------------------------------
+
+_default: DeviceExecutor | None = None
+_default_lock = threading.Lock()
+
+
+def get_default_executor() -> DeviceExecutor:
+    """The process-wide executor every stock caller (encoder towers,
+    indexing top-k, the micro-batcher front-end) shares — one queue, one
+    budget, one ``backlog.device.*`` story."""
+    global _default
+    if _default is None:
+        with _default_lock:
+            if _default is None:
+                _default = DeviceExecutor()
+    return _default

@@ -1896,6 +1896,16 @@ class ExternalIndexNode(Node):
         # ops/topk.py DeviceIndexCache(mesh))
         self.exchange_gather0 = True
 
+    def _search_many(self, qrows: list) -> list:
+        """One batched index scan for the epoch's query rows: a
+        ``search_many``-capable index (``stdlib/indexing``) answers every
+        row in one bucketed DeviceExecutor dispatch; others fall back to
+        per-row search."""
+        many = getattr(self.index, "search_many", None)
+        if many is not None:
+            return many(qrows)
+        return [self.index.search(qrow) for qrow in qrows]
+
     def step(self, time):
         out = []
         dd = consolidate(self.take_pending(0))
@@ -1908,22 +1918,34 @@ class ExternalIndexNode(Node):
             else:
                 self.index.remove(key)
                 self._data_rows.pop(key, None)
-        # new/removed queries
+        # new/removed queries — new ones answered in one epoch batch
+        new_queries: list[tuple[int, Row]] = []
         for qkey, qrow, diff in dq:
             if diff > 0:
                 self._queries[qkey] = qrow
-                result = self.index.search(qrow)
-                ans = self.res_fn(qkey, qrow, result)
-                self._answers[qkey] = ans
-                out.append((qkey, ans, 1))
+                new_queries.append((qkey, qrow))
             else:
                 self._queries.pop(qkey, None)
                 old = self._answers.pop(qkey, None)
                 if old is not None:
                     out.append((qkey, old, -1))
-        if index_changed:
-            for qkey, qrow in self._queries.items():
-                result = self.index.search(qrow)
+        if new_queries:
+            results = self._search_many([qrow for _, qrow in new_queries])
+            for (qkey, qrow), result in zip(new_queries, results):
+                ans = self.res_fn(qkey, qrow, result)
+                self._answers[qkey] = ans
+                out.append((qkey, ans, 1))
+        if index_changed and self._queries:
+            fresh = {qkey for qkey, _ in new_queries}
+            # new queries were just answered against the post-add index;
+            # only pre-existing ones can have a changed answer
+            rerun = [
+                (qkey, qrow)
+                for qkey, qrow in self._queries.items()
+                if qkey not in fresh
+            ]
+            results = self._search_many([qrow for _, qrow in rerun])
+            for (qkey, qrow), result in zip(rerun, results):
                 ans = self.res_fn(qkey, qrow, result)
                 old = self._answers.get(qkey)
                 if old != ans:

@@ -26,6 +26,8 @@ Plan format (JSON, also accepted as a Python list of dicts)::
         {"kind": "blob_truncate", "key": "operators", "nth": 1},
         {"kind": "connector_read", "source": "CsvReader", "nth": 4},
         {"kind": "connector_stall", "source": "SubjectReader", "nth": 3,
+         "delay_ms": 500},
+        {"kind": "device_stall", "source": "encoder", "nth": 1,
          "delay_ms": 500}
     ]}
 
@@ -99,6 +101,14 @@ connector_stall  The reader supervision loop: the Nth emitted item is
              and no epoch slows down; only the data-plane freshness
              layer (``engine/freshness.py``: ``output.staleness.s``)
              can see it — exactly what its chaos tests prove.
+device_stall  The DeviceExecutor dispatch thread (``pathway_tpu/device/
+             executor.py``): the Nth dispatched batch job is DELAYED by
+             ``delay_ms`` before it runs — a slow device / saturated
+             interconnect stand-in.  No error, and the epoch thread is
+             never slowed (dispatch is async): only ``backlog.device.*``
+             and the freshness layer can see it, which is exactly what
+             the device-executor chaos test proves.  ``source`` filters
+             on the submitted job name (e.g. the batcher name).
 ========== =============================================================
 """
 
@@ -130,7 +140,7 @@ KINDS = (
     + _BLOB_CORRUPT_KINDS
     + (
         "crash", "writer_crash", "hang", "zombie", "connector_read",
-        "connector_stall",
+        "connector_stall", "device_stall",
     )
 )
 

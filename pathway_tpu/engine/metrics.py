@@ -214,6 +214,39 @@ METRICS: dict[str, tuple[str, str]] = {
     "backlog.checkpoint.jobs": (
         "gauge", "artifact writes in flight (backlog alias of "
         "checkpoint.inflight.jobs)"),
+    # device executor (pathway_tpu/device/executor.py)
+    "device.dispatch.batches": (
+        "counter", "fixed-shape device batches dispatched by the executor"),
+    "device.dispatch.rows": (
+        "counter", "real rows dispatched through the executor"),
+    "device.dispatch.ms": (
+        "histogram", "wall time of one dispatched device call (ms)"),
+    "device.job.ms": (
+        "histogram", "wall time of one async host-side batch job (ms) — "
+        "host prep included, unlike device.dispatch.ms"),
+    "device.pad.rows": (
+        "counter", "padding rows added by batch bucketing"),
+    "device.cache.cold": (
+        "counter", "first dispatches of a new compile-cache key (a cold "
+        "compile paid in the serving path rather than by warmup)"),
+    "device.warmup.compiles": (
+        "counter", "compile-cache keys paid ahead of traffic by warmup()"),
+    "device.jobs": (
+        "counter", "async host-side batch jobs run by the dispatch thread"),
+    "device.backpressure.s": (
+        "counter", "seconds submitters stalled on the executor's in-flight "
+        "budget"),
+    "device.executor": (
+        "collector", "device-dispatch backlog gauge supplier (the process "
+        "executor)"),
+    "backlog.device.queue": (
+        "gauge", "batch jobs queued or running on the device-dispatch "
+        "thread"),
+    "backlog.device.bytes": (
+        "gauge", "submitted batch bytes in flight through the dispatch "
+        "queue"),
+    "backlog.device.age.s": (
+        "gauge", "age of the oldest batch job still in the dispatch queue"),
     # telemetry (engine/telemetry.py)
     "telemetry.export.dropped": (
         "counter", "telemetry payloads dropped by the bounded export queue"),

@@ -206,6 +206,21 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
        "attempt of a spent restart budget, rescale the supervised cluster "
        "to the surviving count instead of failing — checkpointed state "
        "re-partitions by shard range on resume", "supervisor"),
+    # -- device executor (pathway_tpu/device/) ------------------------------
+    _k("PATHWAY_DEVICE_MAX_BATCH", "int", 512,
+       "largest batch bucket of the DeviceExecutor's default bucketing "
+       "policy (bigger batches split; smaller round up to powers of two)",
+       "executor"),
+    _k("PATHWAY_DEVICE_INFLIGHT_MB", "float", 256.0,
+       "in-flight byte budget of the async device-dispatch queue; a full "
+       "budget backpressures submitters (counted as "
+       "`device.backpressure.s`)", "executor"),
+    _k("PATHWAY_DEVICE_INFLIGHT_REQUESTS", "int", 64,
+       "in-flight request budget of the async device-dispatch queue",
+       "executor"),
+    _k("PATHWAY_DEVICE_DONATE", "str", "auto",
+       "donate padded input buffers to jitted device calls: `auto` "
+       "(backends with donation support), `on`, `off`", "executor"),
     # -- devices (parallel/mesh.py, internals/runner.py) --------------------
     _k("PATHWAY_JAX_DISTRIBUTED", "bool", False,
        "form a multi-host JAX device mesh too (`spawn "
@@ -240,6 +255,7 @@ _SUBSYSTEM_TITLES = (
     ("bench", "Benchmark harness (`benchmarks/harness.py`)"),
     ("persistence", "Persistence (`engine/persistence.py`)"),
     ("supervisor", "Supervisor (`engine/supervisor.py`)"),
+    ("executor", "Device executor (`pathway_tpu/device/`)"),
     ("devices", "Device mesh (`parallel/mesh.py`)"),
     ("models", "Models & native kernels"),
     ("cli", "CLI (`pathway_tpu/cli.py`)"),

@@ -1645,6 +1645,22 @@ class Table(Joinable):
                         qrow[q_filt_idx] if q_filt_idx is not None else None,
                     )
 
+                def search_many(self, qrows):
+                    # one bucketed device dispatch per epoch when the
+                    # inner index batches (stdlib/indexing KNN does)
+                    reqs = [
+                        (
+                            qrow[q_col_idx],
+                            qrow[q_k_idx] if q_k_idx is not None else default_k,
+                            qrow[q_filt_idx] if q_filt_idx is not None else None,
+                        )
+                        for qrow in qrows
+                    ]
+                    many = getattr(index, "search_many", None)
+                    if many is not None:
+                        return many(reqs)
+                    return [index.search(*req) for req in reqs]
+
             def res_fn(qkey, qrow, result):
                 # result: list[(data_key, score)]
                 return (tuple((Pointer(k), s) for k, s in result),)

@@ -32,7 +32,6 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from pathway_tpu.models.tokenizer import (
-    bucket_batch,
     bucket_seq_len,
     load_tokenizer,
     pad_batch,
@@ -489,7 +488,9 @@ def init_model_params(module, model_name: str, config: EncoderConfig, seed: int 
 
 
 class _JitModel:
-    """Shared machinery: init params, bucket shapes, jit per bucket."""
+    """Shared machinery: init params, bucket shapes, one DeviceExecutor
+    registration per model instance (the executor owns jit + batch
+    bucketing + compile-cache discipline — docs/device_executor.md)."""
 
     def __init__(self, module_cls, model_name: str, seed: int = 0,
                  max_batch: int = 512, quantize: str | None = None):
@@ -536,14 +537,28 @@ class _JitModel:
             )
             cfg = self.config
             self._infer_params = self._pack(self.params)
-            self._apply = jax.jit(
-                lambda tree, ids, mask: fused(tree, ids, mask, cfg)
-            )
+            traceable = lambda tree, ids, mask: fused(tree, ids, mask, cfg)  # noqa: E731
         else:
             self._infer_params = self.params
-            self._apply = jax.jit(
-                lambda params, ids, mask: self.module.apply(params, ids, mask)
+            traceable = lambda params, ids, mask: self.module.apply(  # noqa: E731
+                params, ids, mask
             )
+        from pathway_tpu.device import BucketPolicy, get_default_executor
+
+        # keyed by everything the traceable closes over (module class,
+        # config via model_name, fused mode, bucket policy): a re-created
+        # instance REPLACES the registration (old closure + compile cache
+        # drop) instead of growing the process-global executor forever.
+        # No donation: the raw `_apply` wrapper is a public surface whose
+        # callers (benchmarks) legitimately reuse device arrays across
+        # calls — donating would delete their buffers on non-CPU backends.
+        self._executor = get_default_executor()
+        self._callable = self._executor.register(
+            f"encoder:{module_cls.__name__}:{model_name}"
+            f":b{self.max_batch}:f{int(self._fused)}",
+            traceable,
+            policy=BucketPolicy(max_bucket=self.max_batch),
+        )
 
     def _pack(self, params):
         tree = pack_fast_params(params, self.config)
@@ -559,23 +574,40 @@ class _JitModel:
     def n_params(self) -> int:
         return sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(self.params))
 
+    @property
+    def _apply(self):
+        """The raw compiled wrapper (pre-padded fixed shapes only) — kept
+        for benchmarks that bypass tokenization; streaming traffic goes
+        through :meth:`_run_padded` → ``DeviceExecutor.run_batch``."""
+        return self._executor.jitted(self._callable)
+
+    def warmup(self, *, seq_lens: tuple[int, ...] = (), buckets=None) -> int:
+        """Pay every (batch bucket × seq bucket) compile before traffic;
+        returns the number of cache keys compiled."""
+        seq_lens = seq_lens or (bucket_seq_len(self.config.max_len),)
+        compiled = 0
+        for seq in seq_lens:
+            compiled += self._executor.warmup(
+                self._callable,
+                row_shapes=((seq,), (seq,)),
+                dtypes=(np.int32, np.int32),
+                operands=(self._infer_params,),
+                buckets=buckets,
+            )
+        return compiled
+
     def _run_padded(self, id_lists: list[list[int]], max_length: int | None = None) -> np.ndarray:
-        """Pad to (bucketed batch, bucketed seq) and run; returns unpadded."""
+        """Pad to the bucketed seq length and hand the ragged batch to
+        the DeviceExecutor: it buckets/pads the batch axis, splits
+        oversized batches, and dispatches on warm compiled shapes."""
         if not id_lists:
             return np.zeros((0,), dtype=np.float32)
         longest = max(len(x) for x in id_lists)
         seq = bucket_seq_len(min(longest, max_length or self.config.max_len))
-        out_chunks = []
-        i = 0
-        while i < len(id_lists):
-            chunk = id_lists[i : i + self.max_batch]
-            b = bucket_batch(len(chunk), self.max_batch)
-            padded = chunk + [[0]] * (b - len(chunk))
-            ids, mask = pad_batch(padded, seq)
-            res = self._apply(self._infer_params, jnp.asarray(ids), jnp.asarray(mask))
-            out_chunks.append(np.asarray(res)[: len(chunk)])
-            i += self.max_batch
-        return np.concatenate(out_chunks, axis=0)
+        ids, mask = pad_batch(id_lists, seq)
+        return self._executor.run_batch(
+            self._callable, (ids, mask), operands=(self._infer_params,)
+        )
 
 
 class SentenceEncoder(_JitModel):

@@ -88,14 +88,38 @@ if _HAVE_JAX:
         v, pos = jax.lax.top_k(vals.reshape(Q, blocks * k), k)
         return v, jnp.take_along_axis(gidx.reshape(Q, blocks * k), pos, axis=1)
 
-    @functools.partial(jax.jit, static_argnames=("metric", "k"))
-    def _masked_topk_jax(matrix, mask, queries, metric: str, k: int):
+    def masked_topk_block(matrix, mask, queries, *, metric: str, k: int):
+        """Traceable masked top-k — registered on the DeviceExecutor
+        (the sanctioned jit entry point), which buckets the query batch
+        so churning query counts never recompile."""
         scores = score_block(matrix, queries, metric)
         # keep the dot out of the top_k fusion: XLA (notably on CPU) would
         # otherwise inline the GEMM into the sort fusion and lose the fast
         # matmul path — measured 18x slower without the barrier
         scores = jax.lax.optimization_barrier(scores)
         return exact_topk(scores + mask[None, :], k)
+
+    _TOPK_CALLABLE = "indexing:masked_topk"
+
+    def _topk_executor():
+        """The default executor with the masked top-k registered once."""
+        from pathway_tpu.device import get_default_executor
+
+        ex = get_default_executor()
+        if not ex.registered(_TOPK_CALLABLE):
+            ex.register(
+                _TOPK_CALLABLE,
+                masked_topk_block,
+                static_argnames=("metric", "k"),
+            )
+        return ex
+
+    def masked_topk_jitted():
+        """The compiled masked top-k wrapper for pre-padded fixed shapes
+        — the raw-kernel surface the retrieval benchmarks time.  Call
+        with keyword ``metric=``/``k=``; production code goes through
+        ``topk_search_cached`` (executor-bucketed)."""
+        return _topk_executor().jitted(_TOPK_CALLABLE)
 
     @functools.partial(jax.jit, static_argnames=("k",))
     def _topk_jax(scores, k: int):
@@ -222,8 +246,11 @@ def topk_search_cached(
             kernel_metric,
         )
         return np.asarray(idx), np.asarray(vals)
-    vals, idx = _masked_topk_jax(
-        device_matrix, mask, jnp.asarray(q), kernel_metric, k_eff
+    vals, idx = _topk_executor().run_batch(
+        _TOPK_CALLABLE,
+        (q.astype(np.float32, copy=False),),
+        operands=(device_matrix, mask),
+        static={"metric": kernel_metric, "k": k_eff},
     )
     return np.asarray(idx), np.asarray(vals)
 

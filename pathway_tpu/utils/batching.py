@@ -1,25 +1,67 @@
-"""Async micro-batching: the streaming→device bridge.
+"""Async micro-batching: the streaming→device coalescing front-end.
 
 The north star's key mechanism (BASELINE.json): "the Python-UDF bridge
 batches row-deltas coming out of the dataflow into fixed-shape device
-arrays so embed/rerank calls hit a warm XLA cache."  Embedder/reranker UDFs
-are *async*: the engine's AsyncValuesNode launches one coroutine per row of
-an epoch concurrently (§3.3 semantics), and this batcher coalesces all
-concurrently-pending requests into large device batches.
+arrays so embed/rerank calls hit a warm XLA cache."  Embedder/reranker
+UDFs are *async*: the engine's AsyncValuesNode launches one coroutine per
+row of an epoch concurrently (§3.3 semantics), and this batcher coalesces
+all concurrently-pending requests into large batches.
+
+Since the DeviceExecutor landed (``pathway_tpu/device/``), the batcher is
+a THIN front-end: it only coalesces; the executor owns dispatch (its
+queue, its in-flight budget, its ``backlog.device.*`` attribution), and
+the model code inside ``process_batch`` reaches the executor's bucketed
+fixed-shape path (``run_batch``).  Two consequences, both deliberate:
+
+* **Pending state is shared across event loops.**  The engine runs each
+  epoch's gather under a fresh ``asyncio.run`` loop, and serving threads
+  run their own loops; the old per-``id(loop)`` pending dict split one
+  logical stream into per-loop fragment batches (and leaked state when a
+  loop died before its flusher drained — ``id()`` values recycle).  Now
+  one lock-guarded pending list serves every loop, each waiter remembers
+  its own loop, and results come home via ``call_soon_threadsafe``.
+* **The event loop never blocks on device work.**  Batches run on the
+  executor's dispatch thread, so the loop keeps gathering/tokenizing the
+  next rows while the device chews the previous batch — the PR 3
+  async-committer overlap pattern applied to compute (measured by
+  ``benchmarks/device_executor.py``).
 """
 
 from __future__ import annotations
 
 import asyncio
+import threading
+import weakref
 from typing import Any, Callable, Sequence
+
+import numpy as np
+
+
+def _batch_nbytes(items: list) -> int:
+    """Best-effort byte estimate for the executor's in-flight budget."""
+    total = 0
+    for item in items:
+        if isinstance(item, np.ndarray):
+            total += item.nbytes
+        elif isinstance(item, (bytes, str)):
+            total += len(item)
+        elif isinstance(item, tuple):
+            total += _batch_nbytes(list(item))
+    return total
 
 
 class AsyncMicroBatcher:
     """Coalesces concurrent async submissions into batched process calls.
 
-    ``process_batch(items) -> results`` runs synchronously (typically a jit
-    call).  Per-event-loop state: the engine may run each epoch under a fresh
-    asyncio loop.
+    ``process_batch(items) -> results`` is the batch callback (typically
+    tokenize + ``DeviceExecutor.run_batch``), and it always runs
+    off-loop.  ``run_in_thread=False`` (embedders/rerankers: ms-scale
+    batches) routes through the executor's dispatch queue — bounded
+    budget, ``backlog.device.*`` attribution, ``device_stall``
+    injectable.  ``run_in_thread=True`` (LLM generation: seconds-long
+    batches) runs each batch on its own thread instead, exactly as
+    before — a 5 s generation batch must not head-of-line-block every
+    embedder batch behind the single dispatch thread.
     """
 
     def __init__(
@@ -28,91 +70,152 @@ class AsyncMicroBatcher:
         max_batch_size: int = 256,
         flush_delay: float = 0.002,
         run_in_thread: bool = False,
+        executor=None,
+        name: str | None = None,
     ):
-        """``run_in_thread=True`` runs each batch via ``asyncio.to_thread``
-        so the event loop stays responsive during long device calls (LLM
-        generation takes seconds; embedder batches take milliseconds and
-        keep the default synchronous flush)."""
         self.process_batch = process_batch
         self.max_batch_size = max_batch_size
         self.flush_delay = flush_delay
         self.run_in_thread = run_in_thread
-        self._per_loop: dict[int, list] = {}
-        # strong refs: the loop only weak-refs tasks, and a GC'd batch
-        # task would strand its futures forever
+        self.name = name or getattr(process_batch, "__name__", "batch")
+        self._executor = executor
+        # ONE pending list across every event loop (see module docstring);
+        # entries are (item, loop, asyncio.Future)
+        self._pending: list[tuple[Any, Any, Any]] = []
+        self._lock = threading.Lock()
+        # loops that currently have a live flusher task.  Keyed by
+        # id(loop) but VALIDATED against a weakref to the loop object: a
+        # loop closed without cancelling its tasks never runs the
+        # flusher's cleanup, and a later loop recycling the same id must
+        # not inherit the stale entry (its submissions would never spawn
+        # a flusher and could hang).
+        self._flushers: dict[int, Any] = {}
+        # strong refs: the loop only weak-refs tasks, and a GC'd flusher
+        # would strand its pending items
         self._tasks: set = set()
+
+    def _exec(self):
+        if self._executor is None:
+            from pathway_tpu.device import get_default_executor
+
+            self._executor = get_default_executor()
+        return self._executor
 
     async def submit(self, item: Any) -> Any:
         loop = asyncio.get_running_loop()
-        key = id(loop)
-        pending = self._per_loop.get(key)
-        if pending is None:
-            pending = self._per_loop[key] = []
-            loop.create_task(self._flusher(key))
         future = loop.create_future()
-        pending.append((item, future))
-        if len(pending) >= self.max_batch_size:
-            self._flush(key)
-        return await future
-
-    def _flush(self, key: int) -> None:
-        pending = self._per_loop.get(key)
-        if not pending:
-            return
-        batch = pending[: self.max_batch_size]
-        del pending[: self.max_batch_size]
-        if self.run_in_thread:
-            task = asyncio.get_running_loop().create_task(
-                self._run_batch_async(batch)
-            )
+        flush_now = False
+        spawn_flusher = False
+        key = id(loop)
+        with self._lock:
+            self._pending.append((item, loop, future))
+            if len(self._pending) >= self.max_batch_size:
+                flush_now = True
+            ref = self._flushers.get(key)
+            if ref is None or ref() is not loop:  # absent, dead, or recycled id
+                self._flushers[key] = weakref.ref(loop)
+                spawn_flusher = True
+        if spawn_flusher:
+            task = loop.create_task(self._flusher(key))
             self._tasks.add(task)
             task.add_done_callback(self._tasks.discard)
-        else:
-            self._run_batch(batch)
+        if flush_now:
+            self.flush()
+        return await future
 
-    def _run_batch(self, batch: list) -> None:
-        items = [it for (it, _f) in batch]
-        try:
-            results = self.process_batch(items)
-            for (_it, fut), res in zip(batch, results):
-                if not fut.done():
-                    fut.set_result(res)
-        except Exception as exc:
-            for _it, fut in batch:
-                if not fut.done():
-                    fut.set_exception(exc)
+    def flush(self) -> None:
+        """Hand every full (or closing) batch of pending items to the
+        executor's dispatch queue.  Callable from any thread."""
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                batch = self._pending[: self.max_batch_size]
+                del self._pending[: self.max_batch_size]
+            self._dispatch(batch)
 
-    async def _run_batch_async(self, batch: list) -> None:
-        items = [it for (it, _f) in batch]
-        try:
-            results = await asyncio.to_thread(self.process_batch, items)
-            for (_it, fut), res in zip(batch, results):
-                if not fut.done():
-                    fut.set_result(res)
-        except Exception as exc:  # noqa: BLE001 — deliver to every waiter
-            for _it, fut in batch:
-                if not fut.done():
-                    fut.set_exception(exc)
+    def _dispatch(self, batch: list[tuple[Any, Any, Any]]) -> None:
+        items = [item for (item, _loop, _fut) in batch]
+        waiters = [(loop, fut) for (_item, loop, fut) in batch]
+
+        def job():
+            return self.process_batch(items)
+
+        def deliver(device_future) -> None:
+            try:
+                results = list(device_future.result(timeout=0))
+                if len(results) != len(waiters):
+                    raise ValueError(
+                        f"process_batch returned {len(results)} results "
+                        f"for {len(waiters)} items"
+                    )
+                payload = [(fut, res, None) for (_l, fut), res in zip(waiters, results)]
+            except BaseException as exc:  # noqa: BLE001 - delivered to every waiter
+                payload = [(fut, None, exc) for (_l, fut) in waiters]
+            for (loop, _f), (fut, res, exc) in zip(waiters, payload):
+                try:
+                    loop.call_soon_threadsafe(_resolve, fut, res, exc)
+                except RuntimeError:
+                    # the waiter's loop closed before delivery (its epoch
+                    # was torn down); nothing is listening anymore
+                    pass
+
+        if self.run_in_thread:
+            # seconds-long batches (LLM generation) get their own thread:
+            # serializing them behind the shared dispatch thread would
+            # head-of-line-block every ms-scale embedder batch
+            from pathway_tpu.device.executor import DeviceFuture
+
+            future = DeviceFuture()
+
+            def run_detached():
+                try:
+                    future.set_result(job())
+                except BaseException as exc:  # noqa: BLE001 - delivered to waiters
+                    future.set_exception(exc)
+
+            future.add_done_callback(deliver)
+            threading.Thread(
+                target=run_detached, name=f"batch:{self.name}", daemon=True
+            ).start()
+            return
+        device_future = self._exec().submit(
+            job, name=self.name, nbytes=_batch_nbytes(items)
+        )
+        device_future.add_done_callback(deliver)
 
     async def _flusher(self, key: int) -> None:
-        # flush everything pending on this loop until it quiesces
+        # first flush is IMMEDIATE: two zero-sleeps let every already-
+        # scheduled same-tick submitter enqueue (the engine gathers an
+        # epoch's rows in one tick), then the batch goes — a lone serving
+        # query pays no fixed flush_delay latency.  Stragglers that submit
+        # after awaiting something else are caught by the flush_delay
+        # rounds below.
+        loop = asyncio.get_running_loop()
         try:
-            # first flush is IMMEDIATE: two zero-sleeps let every already-
-            # scheduled same-tick submitter enqueue (the engine gathers an
-            # epoch's rows in one tick), then the batch goes — a lone
-            # serving query pays no fixed flush_delay latency.  Stragglers
-            # that submit after awaiting something else are caught by the
-            # flush_delay rounds below.
             await asyncio.sleep(0)
             await asyncio.sleep(0)
-            while self._per_loop.get(key):
-                self._flush(key)
+            self.flush()
             while True:
                 await asyncio.sleep(self.flush_delay)
-                pending = self._per_loop.get(key)
-                if not pending:
-                    break
-                while self._per_loop.get(key):
-                    self._flush(key)
+                with self._lock:
+                    if not self._pending:
+                        return
+                self.flush()
         finally:
-            self._per_loop.pop(key, None)
+            with self._lock:
+                # drop only OUR entry — a recycled id may already hold a
+                # newer loop's ref (submit validates refs, so a stale
+                # entry is harmless, but don't evict a live one)
+                ref = self._flushers.get(key)
+                if ref is not None and ref() in (loop, None):
+                    self._flushers.pop(key, None)
+
+
+def _resolve(fut, result, exc) -> None:
+    if fut.done():
+        return
+    if exc is not None:
+        fut.set_exception(exc)
+    else:
+        fut.set_result(result)

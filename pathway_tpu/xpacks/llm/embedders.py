@@ -66,7 +66,9 @@ class SentenceTransformerEmbedder(BaseEmbedder):
 
             self._encoder = shared_sentence_encoder(model)
         self._batcher = AsyncMicroBatcher(
-            self._process_batch, max_batch_size=max_batch_size
+            self._process_batch,
+            max_batch_size=max_batch_size,
+            name=f"embedder:{model}",
         )
 
         async def embed(text: str) -> np.ndarray:
@@ -201,13 +203,19 @@ class MultimodalEmbedder(BaseEmbedder):
 
         self.model_name = model
         self._encoder = shared_multimodal_encoder(model)
+        from pathway_tpu.device import stack_rows
+
         self._text_batcher = AsyncMicroBatcher(
             lambda texts: list(self._encoder.embed_texts(texts)),
             max_batch_size=max_batch_size,
+            name=f"embedder:{model}:text",
         )
+        # stack_rows (not np.stack): a dtype/shape mix in one coalesced
+        # image batch fails loudly instead of silently upcasting
         self._image_batcher = AsyncMicroBatcher(
-            lambda imgs: list(self._encoder.embed_images(np.stack(imgs))),
+            lambda imgs: list(self._encoder.embed_images(stack_rows(imgs)[0])),
             max_batch_size=max_batch_size,
+            name=f"embedder:{model}:image",
         )
 
         async def embed(input: Any = None, **kwargs) -> np.ndarray:

@@ -37,7 +37,11 @@ class CrossEncoderReranker(UDF):
         from pathway_tpu.models import shared_cross_encoder
 
         self._ce = shared_cross_encoder(model_name)
-        self._batcher = AsyncMicroBatcher(self._process, max_batch_size=max_batch_size)
+        self._batcher = AsyncMicroBatcher(
+            self._process,
+            max_batch_size=max_batch_size,
+            name=f"reranker:{model_name}",
+        )
 
         async def rerank(doc: str, query: str) -> float:
             return await self._batcher.submit((query or "", _doc_text(doc)))

@@ -1,0 +1,25 @@
+"""Corpus: jit-outside-executor — direct jit in an executor-guarded tree.
+
+This file lives under a ``xpacks/`` path segment on purpose: since the
+DeviceExecutor landed, model/index code under ``xpacks/`` and
+``stdlib/`` must register callables on it instead of building private
+jit wrappers (no bucket policy, no cache-key accounting, invisible to
+warmup).  Module-level wraps and decorators are fine for the other jit
+rules — and still findings for this one.
+"""
+
+import functools
+
+import jax
+
+_fwd = jax.jit(lambda x: x * 2)  # EXPECT: jit-outside-executor
+
+
+@jax.jit  # EXPECT: jit-outside-executor
+def _tower(x):
+    return x + 1
+
+
+@functools.partial(jax.jit, static_argnames=("k",))  # EXPECT: jit-outside-executor
+def _scan(x, k):
+    return x[:k]

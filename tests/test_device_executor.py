@@ -1,0 +1,521 @@
+"""DeviceExecutor subsystem tests (ISSUE 11).
+
+Four property groups, each load-bearing:
+
+* **Bucketing-policy edge cases** — batch of 1, batch > largest bucket
+  (split), dtype/shape mix refusal, and mask correctness: padded rows
+  provably do not change unpadded outputs at the same compiled shape.
+* **Compile-cache discipline** — explicit keys, cold-vs-warmed
+  accounting, and warmup() paying every bucket ahead of traffic.
+* **Async dispatch** — futures, bounded in-flight budget backpressure,
+  the ``backlog.device.*`` gauges, and the micro-batcher front-end
+  coalescing across event-loop re-creation (the per-``id(loop)`` state
+  split the old batcher had).
+* **Chaos acceptance** — an injected ``device_stall`` is visible ONLY to
+  ``backlog.device.*`` and the PR 9 freshness layer: epoch-duration
+  buckets stay flat while staleness and queue age move.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from pathway_tpu.device import (
+    BucketPolicy,
+    DeviceExecutor,
+    get_default_executor,
+    pad_batch_dim,
+    stack_rows,
+)
+from pathway_tpu.engine import dataflow as df
+from pathway_tpu.engine import faults
+from pathway_tpu.engine import metrics as em
+from pathway_tpu.engine.freshness import FreshnessTracker
+from pathway_tpu.utils.batching import AsyncMicroBatcher
+
+# --- bucketing policy --------------------------------------------------------
+
+
+def test_bucket_policy_rounds_up_to_powers_of_two():
+    p = BucketPolicy(max_bucket=64)
+    assert p.buckets() == (1, 2, 4, 8, 16, 32, 64)
+    assert p.bucket_for(1) == 1
+    assert p.bucket_for(3) == 4
+    assert p.bucket_for(33) == 64
+    assert p.bucket_for(64) == 64
+
+
+def test_bucket_policy_batch_of_one_plans_smallest_bucket():
+    [chunk] = BucketPolicy(max_bucket=512).plan(1)
+    assert (chunk.start, chunk.count, chunk.bucket) == (0, 1, 1)
+    # a raised floor pads the lone row up to the declared minimum
+    [chunk] = BucketPolicy(min_bucket=8, max_bucket=512).plan(1)
+    assert chunk.bucket == 8
+
+
+def test_bucket_policy_oversized_batch_splits():
+    chunks = BucketPolicy(max_bucket=16).plan(37)
+    assert [(c.start, c.count, c.bucket) for c in chunks] == [
+        (0, 16, 16),
+        (16, 16, 16),
+        (32, 5, 8),
+    ]
+    # every chunk's bucket is from the declared set — warmup covers it
+    declared = set(BucketPolicy(max_bucket=16).buckets())
+    assert {c.bucket for c in chunks} <= declared
+
+
+def test_bucket_policy_refuses_empty_and_misfits():
+    p = BucketPolicy(max_bucket=8)
+    with pytest.raises(ValueError):
+        p.plan(0)
+    with pytest.raises(ValueError):
+        p.bucket_for(9)  # plan() splits; bucket_for refuses
+    with pytest.raises(ValueError):
+        BucketPolicy(min_bucket=0)
+
+
+def test_stack_rows_refuses_dtype_and_shape_mixes():
+    with pytest.raises(ValueError, match="dtype mix"):
+        stack_rows([np.zeros(3, np.float32), np.zeros(3, np.float64)])
+    with pytest.raises(ValueError, match="shape mix"):
+        stack_rows([np.zeros((2, 2), np.float32), np.zeros((3, 2), np.float32)])
+    batch, n = stack_rows([np.ones(3, np.float32)] * 5)
+    assert batch.shape == (5, 3) and n == 5
+
+
+def test_pad_batch_dim_mask_marks_real_rows():
+    arr = np.arange(6, dtype=np.float32).reshape(3, 2)
+    padded, mask = pad_batch_dim(arr, 8)
+    assert padded.shape == (8, 2)
+    assert mask.tolist() == [1, 1, 1, 0, 0, 0, 0, 0]
+    assert (padded[3:] == 0).all()
+    same, mask2 = pad_batch_dim(arr, 3)
+    assert same is arr and mask2.tolist() == [1, 1, 1]
+
+
+# --- fixed-shape dispatch + compile-cache discipline -------------------------
+
+
+def _rowwise_executor(max_bucket=8):
+    ex = DeviceExecutor(collector_name=None)
+    ex.register(
+        "rowsum",
+        lambda x: jnp.sum(x * x, axis=1),
+        policy=BucketPolicy(max_bucket=max_bucket),
+    )
+    return ex
+
+
+def test_padded_rows_provably_do_not_change_unpadded_outputs():
+    """THE mask-correctness pin: the same rows, co-batched with padding
+    (bucket 4, 3 real rows) vs a full bucket, produce bit-identical
+    outputs — row-wise kernels cannot see their pad neighbors."""
+    ex = _rowwise_executor()
+    rows = np.random.default_rng(7).normal(size=(4, 16)).astype(np.float32)
+    full = ex.run_batch("rowsum", (rows,))  # bucket 4, no padding
+    padded = ex.run_batch("rowsum", (rows[:3],))  # bucket 4, 1 pad row
+    assert padded.shape == (3,)
+    np.testing.assert_array_equal(full[:3], padded)
+
+
+def test_oversized_batch_splits_and_reassembles_in_order():
+    ex = _rowwise_executor(max_bucket=8)
+    rows = np.arange(19 * 2, dtype=np.float32).reshape(19, 2)
+    out = ex.run_batch("rowsum", (rows,))
+    np.testing.assert_allclose(out, (rows * rows).sum(axis=1), rtol=1e-6)
+    # 19 rows over max bucket 8: chunks 8+8+3 → buckets 8, 8, 4
+    assert ex.stats("rowsum")["dispatches"] == 3
+    assert ex.stats("rowsum")["keys"] == 2  # (8, 2) and (4, 2)
+
+
+def test_tuple_outputs_unpad_per_leaf():
+    ex = DeviceExecutor(collector_name=None)
+    ex.register(
+        "pair",
+        lambda x: (x * 2.0, jnp.sum(x, axis=1)),
+        policy=BucketPolicy(max_bucket=8),
+    )
+    rows = np.ones((3, 4), np.float32)
+    doubled, sums = ex.run_batch("pair", (rows,))
+    assert doubled.shape == (3, 4) and sums.shape == (3,)
+
+
+def test_warmup_pays_every_bucket_and_steady_state_is_never_cold():
+    ex = _rowwise_executor(max_bucket=16)
+    compiled = ex.warmup("rowsum", row_shapes=((4,),), dtypes=(np.float32,))
+    assert compiled == len(BucketPolicy(max_bucket=16).buckets())
+    before = ex.stats("rowsum")
+    assert before["cold"] == 0 and before["warmed"] == compiled
+    # churning ragged sizes after a full warmup: zero cold dispatches
+    rng = np.random.default_rng(3)
+    for n in (1, 3, 7, 13, 16, 2, 11):
+        ex.run_batch("rowsum", (rng.normal(size=(n, 4)).astype(np.float32),))
+    after = ex.stats("rowsum")
+    assert after["cold"] == 0
+    assert after["keys"] == compiled
+
+
+def test_static_args_extend_the_cache_key():
+    ex = DeviceExecutor(collector_name=None)
+    ex.register(
+        "topk",
+        lambda x, *, k: jnp.sort(x, axis=1)[:, -k:],
+        static_argnames=("k",),
+        policy=BucketPolicy(max_bucket=8),
+    )
+    rows = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+    ex.run_batch("topk", (rows,), static={"k": 2})
+    ex.run_batch("topk", (rows,), static={"k": 3})
+    ex.run_batch("topk", (rows,), static={"k": 2})  # warm
+    assert ex.stats("topk")["keys"] == 2
+
+
+def test_rerun_registration_resets_the_ledger():
+    ex = _rowwise_executor()
+    ex.run_batch("rowsum", (np.ones((2, 4), np.float32),))
+    ex.register("rowsum", lambda x: jnp.sum(x, axis=1), policy=BucketPolicy(max_bucket=8))
+    assert ex.stats("rowsum") == {"dispatches": 0, "cold": 0, "warmed": 0, "keys": 0}
+
+
+# --- async dispatch: futures, budget, backlog --------------------------------
+
+
+def test_submit_returns_future_and_runs_off_thread():
+    ex = DeviceExecutor(collector_name=None)
+    try:
+        caller = threading.current_thread().name
+        fut = ex.submit(lambda: threading.current_thread().name, name="probe")
+        assert fut.result(timeout=5.0) != caller
+        assert fut.done()
+    finally:
+        ex.close()
+
+
+def test_submit_propagates_job_exceptions():
+    ex = DeviceExecutor(collector_name=None)
+    try:
+
+        def boom():
+            raise RuntimeError("device fell over")
+
+        with pytest.raises(RuntimeError, match="device fell over"):
+            ex.submit(boom, name="boom").result(timeout=5.0)
+    finally:
+        ex.close()
+
+
+def test_inflight_budget_backpressures_and_counts_the_stall():
+    ex = DeviceExecutor(
+        max_inflight_requests=1, max_inflight_mb=1024, collector_name=None
+    )
+    try:
+        release = threading.Event()
+        started = threading.Event()
+
+        def slow():
+            started.set()
+            while not release.wait(timeout=0.05):
+                pass
+            return "slow"
+
+        before = em.get_registry().scalar_metrics().get("device.backpressure.s", 0.0)
+        first = ex.submit(slow, name="slow")
+        assert started.wait(timeout=5.0)
+        # budget full (1 running): a second submit must time out while blocked
+        with pytest.raises(TimeoutError):
+            ex.submit(lambda: "second", name="second", timeout_s=0.3)
+        release.set()
+        assert first.result(timeout=5.0) == "slow"
+        second = ex.submit(lambda: "second", name="second", timeout_s=5.0)
+        assert second.result(timeout=5.0) == "second"
+        after = em.get_registry().scalar_metrics().get("device.backpressure.s", 0.0)
+        assert after > before  # the stall was counted, not silent
+    finally:
+        release.set()
+        ex.close()
+
+
+def test_backlog_device_gauges_track_queue_and_age():
+    ex = DeviceExecutor(collector_name=None)
+    try:
+        release = threading.Event()
+        started = threading.Event()
+
+        def slow():
+            started.set()
+            while not release.wait(timeout=0.05):
+                pass
+
+        ex.submit(slow, name="slow", nbytes=1000)
+        ex.submit(lambda: None, name="queued", nbytes=500)
+        assert started.wait(timeout=5.0)
+        snap = ex.metrics_snapshot()
+        assert snap["backlog.device.queue"] == 2.0
+        assert snap["backlog.device.bytes"] == 1500.0
+        assert snap["backlog.device.age.s"] >= 0.0
+        release.set()
+        deadline = time.monotonic() + 5.0
+        while ex.metrics_snapshot()["backlog.device.queue"] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        snap = ex.metrics_snapshot()
+        assert snap["backlog.device.queue"] == 0.0
+        assert snap["backlog.device.bytes"] == 0.0
+    finally:
+        release.set()
+        ex.close()
+
+
+def test_submit_from_dispatch_thread_is_refused():
+    ex = DeviceExecutor(collector_name=None)
+    try:
+
+        def nested():
+            ex.submit(lambda: None, name="inner")
+
+        with pytest.raises(RuntimeError, match="dispatch thread"):
+            ex.submit(nested, name="outer").result(timeout=5.0)
+    finally:
+        ex.close()
+
+
+# --- the micro-batcher front-end ---------------------------------------------
+
+
+def test_batcher_coalesces_across_event_loop_recreation():
+    """The satellite pin: the engine runs each epoch under a fresh
+    ``asyncio.run`` loop (and serving threads run their own loops); the
+    executor-backed batcher keeps ONE pending list, so submissions from
+    two concurrently-live loops coalesce into one process call."""
+    ex = DeviceExecutor(collector_name=None)
+    try:
+        batch_sizes: list[int] = []
+        gate = threading.Event()
+
+        def process(items):
+            batch_sizes.append(len(items))
+            return [i * 10 for i in items]
+
+        batcher = AsyncMicroBatcher(
+            process, max_batch_size=64, flush_delay=0.01, executor=ex
+        )
+        # hold the dispatch thread so both loops' items are pending together
+        ex.submit(lambda: gate.wait(timeout=5.0), name="gate")
+
+        results: dict[str, list] = {}
+        barrier = threading.Barrier(2, timeout=5.0)
+
+        def run_loop(tag: str, base: int):
+            async def main():
+                barrier.wait()
+                out = await asyncio.gather(
+                    *(batcher.submit(base + i) for i in range(10))
+                )
+                return out
+
+            results[tag] = asyncio.run(main())
+
+        threads = [
+            threading.Thread(target=run_loop, args=("a", 0)),
+            threading.Thread(target=run_loop, args=("b", 100)),
+        ]
+        for t in threads:
+            t.start()
+        # both loops have submitted once their flush jobs queue behind the
+        # gate; poll until the shared pending list drained into jobs
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with batcher._lock:
+                if not batcher._pending and len(batcher._flushers) == 0:
+                    break
+            time.sleep(0.01)
+        gate.set()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert results["a"] == [i * 10 for i in range(10)]
+        assert results["b"] == [(100 + i) * 10 for i in range(10)]
+        # the two loops' rows coalesced rather than fragmenting per loop
+        assert max(batch_sizes) == 20, batch_sizes
+        with batcher._lock:
+            assert not batcher._pending  # nothing stranded across loops
+    finally:
+        gate.set()
+        ex.close()
+
+
+def test_batcher_sequential_fresh_loops_leave_no_stranded_state():
+    ex = DeviceExecutor(collector_name=None)
+    try:
+        batcher = AsyncMicroBatcher(
+            lambda items: [i + 1 for i in items],
+            max_batch_size=8,
+            flush_delay=0.001,
+            executor=ex,
+        )
+
+        async def main():
+            return await asyncio.gather(*(batcher.submit(i) for i in range(20)))
+
+        for _ in range(3):  # three fresh loops, same batcher
+            assert asyncio.run(main()) == list(range(1, 21))
+        with batcher._lock:
+            assert not batcher._pending
+            assert not batcher._flushers
+    finally:
+        ex.close()
+
+
+def test_batcher_result_count_mismatch_fails_every_waiter():
+    ex = DeviceExecutor(collector_name=None)
+    try:
+        batcher = AsyncMicroBatcher(
+            lambda items: items[:-1], max_batch_size=8, executor=ex
+        )
+
+        async def main():
+            with pytest.raises(ValueError, match="results"):
+                await asyncio.gather(batcher.submit(1), batcher.submit(2))
+
+        asyncio.run(main())
+    finally:
+        ex.close()
+
+
+# --- chaos acceptance: device_stall ------------------------------------------
+
+STALL_MS = 600.0
+
+
+@pytest.mark.chaos
+def test_device_stall_moves_backlog_and_staleness_while_epochs_stay_flat():
+    """ISSUE 11 acceptance pin: a stalled device dispatch is attributable
+    — ``backlog.device.age.s`` and ``output.staleness.s`` move while the
+    epoch thread keeps closing fast epochs (no epoch-duration bucket
+    above 250 ms fills).  The PR 8 profiler is blind to this by
+    construction: the wait lives on the dispatch queue, not in any
+    operator's step time."""
+    plan = faults.FaultPlan(
+        [{"kind": "device_stall", "source": "chaos-embed", "nth": 1,
+          "delay_ms": STALL_MS}],
+        seed=11,
+    )
+    faults.install_plan(plan)
+    ex = DeviceExecutor(collector_name=None)
+    try:
+        # a tiny synthetic dataflow: rows ingested now, output delivered
+        # only when the (stalled) device future lands
+        scope = df.Scope()
+        inp = df.InputNode(scope)
+        out = df.OutputNode(scope, inp)
+        out.sink_name = "device-sink"
+        tracker = FreshnessTracker(enabled=True)
+        tracker.attach(scope, [])
+
+        # one pre-stall delivery stamps the output's watermark: staleness
+        # is "age of the newest data the output reflects", so it needs a
+        # delivered epoch to age from
+        inp.epoch_ingest_wallclock = time.monotonic()
+        out._saw_data_this_epoch = True
+        tracker.after_epoch(scope, now=time.monotonic())
+
+        fut = ex.submit(lambda: "embedded", name="chaos-embed")
+
+        epoch_hist = em.get_registry().histogram(
+            "epoch.duration.ms", buckets=em.MS_BUCKETS, chaos="device-stall"
+        )
+        ages: list[float] = []
+        stale: list[float] = []
+        # fast epochs keep closing while the dispatch is stalled; the
+        # output has nothing to deliver yet so its staleness grows
+        while not fut.done():
+            t0 = time.monotonic()
+            out._saw_data_this_epoch = False
+            tracker.after_epoch(scope, now=time.monotonic())
+            epoch_hist.observe((time.monotonic() - t0) * 1000.0)
+            snap = ex.metrics_snapshot()
+            ages.append(snap["backlog.device.age.s"])
+            stale.append(
+                tracker.staleness(now=time.monotonic()).get("device-sink", 0.0)
+            )
+            time.sleep(0.01)
+        assert fut.result(timeout=5.0) == "embedded"
+        # the future landed: the output delivers and staleness resets
+        inp.epoch_ingest_wallclock = time.monotonic()
+        out._saw_data_this_epoch = True
+        tracker.after_epoch(scope, now=time.monotonic())
+    finally:
+        faults.clear_plan()
+        ex.close()
+
+    assert [s for s in plan.log if "device_stall" in s], plan.log
+    # (1) the dispatch queue SAW the stall: oldest-job age grew past half
+    # the injected delay, and so did the stalled output's staleness
+    assert max(ages) >= (STALL_MS / 1000.0) * 0.5, max(ages)
+    assert max(stale) >= (STALL_MS / 1000.0) * 0.5, max(stale)
+    # (2) the epoch thread NEVER saw it: every epoch closed fast — all
+    # duration buckets above 250 ms stay empty
+    bounds, counts, _total, n = epoch_hist.snapshot()
+    assert n == len(ages)
+    slow = sum(
+        c for bound, c in zip(list(bounds) + [float("inf")], counts)
+        if bound > 250.0
+    )
+    assert slow == 0, (bounds, counts)
+    # (3) after delivery the output is fresh again
+    assert tracker.staleness(now=time.monotonic())["device-sink"] < 1.0
+
+
+# --- integration: the stock paths route through the executor ------------------
+
+
+def test_default_executor_is_shared_and_collector_registered():
+    ex = get_default_executor()
+    assert ex is get_default_executor()
+    snap = em.get_registry().collect()
+    assert "backlog.device.queue" in snap
+
+
+def test_indexing_topk_routes_through_the_executor():
+    from pathway_tpu.ops import topk as topk_ops
+
+    matrix = np.random.default_rng(0).normal(size=(512, 16)).astype(np.float32)
+    cache = topk_ops.DeviceIndexCache()
+    ex = get_default_executor()
+    name = "indexing:masked_topk"
+    idx, scores = topk_ops.topk_search_cached(
+        matrix, matrix[:3], 5, "cos", cache=cache, version=1
+    )
+    assert idx.shape == (3, 5) and ex.registered(name)
+    before = ex.stats(name)["keys"]
+    # same query-batch bucket again: no new cache key
+    topk_ops.topk_search_cached(
+        matrix, matrix[3:6], 5, "cos", cache=cache, version=1
+    )
+    assert ex.stats(name)["keys"] == before
+    # exact self-match survives the executor detour
+    assert idx[0][0] == 0
+
+
+def test_search_many_batches_an_epochs_queries_into_one_dispatch():
+    from pathway_tpu.stdlib.indexing.nearest_neighbors import (
+        BruteForceKnnIndex,
+        DistanceMetric,
+    )
+
+    index = BruteForceKnnIndex(DistanceMetric.COS)
+    rng = np.random.default_rng(1)
+    vecs = rng.normal(size=(400, 8)).astype(np.float32)
+    for i in range(400):
+        index.add(i, vecs[i])
+    requests = [(vecs[i], 3, None) for i in (0, 7, 42, 99)]
+    batched = index.search_many(requests)
+    single = [index.search(vecs[i], 3) for i in (0, 7, 42, 99)]
+    assert [r[0][0] for r in batched] == [0, 7, 42, 99]
+    assert batched == single  # one dispatch, same answers

@@ -96,6 +96,47 @@ def test_forced_shape_change_moves_the_miss_counter(jitted_encoder):
     assert after["compiles"] > before["compiles"]
 
 
+def test_executor_churning_ragged_batches_record_zero_misses(jitted_encoder):
+    """THE DeviceExecutor pin (ISSUE 11): a churning stream of RAGGED
+    batch sizes through the executor's bucketed path — after warmup,
+    `jax.cache.miss` must not move at all.  This is the half the static
+    jit rules cannot see (shape-value variance), closed dynamically."""
+    del jitted_encoder  # only need the module-scoped accounting install
+    from pathway_tpu.device import BucketPolicy, DeviceExecutor
+    from pathway_tpu.models.encoder import SentenceEncoderModule
+
+    module = SentenceEncoderModule(_CFG)
+    params = module.init(
+        jax.random.PRNGKey(1),
+        jnp.zeros((1, 8), jnp.int32),
+        jnp.ones((1, 8), jnp.int32),
+    )
+    ex = DeviceExecutor(collector_name=None)
+    ex.register(
+        "accounting:encoder",
+        lambda p, ids, mask: module.apply(p, ids, mask),
+        policy=BucketPolicy(max_bucket=16),
+    )
+    ex.warmup(
+        "accounting:encoder",
+        row_shapes=((8,), (8,)),
+        dtypes=(np.int32, np.int32),
+        operands=(params,),
+    )
+    before = _counters()
+    rng = np.random.default_rng(11)
+    for _ in range(12):
+        n = int(rng.integers(1, 23))  # ragged, and sometimes > max bucket
+        ids = np.ones((n, 8), np.int32)
+        mask = np.ones((n, 8), np.int32)
+        out = ex.run_batch("accounting:encoder", (ids, mask), operands=(params,))
+        assert out.shape == (n, _CFG.hidden)
+    after = _counters()
+    assert after["miss"] - before["miss"] == 0.0
+    assert after["compiles"] - before["compiles"] == 0.0
+    assert ex.stats("accounting:encoder")["cold"] == 0
+
+
 def test_transfer_accounting_counts_explicit_bytes():
     assert install_transfer_accounting(force=True)
     try:

@@ -42,13 +42,25 @@ _EXPECT_RE = re.compile(r"#\s*EXPECT:\s*([a-z\-,]+)")
 _EXPECT_BELOW_RE = re.compile(r"#\s*EXPECT-BELOW:\s*([a-z\-,]+)")
 
 
+def _corpus_files() -> list[str]:
+    """Every corpus .py, recursively — path-scoped rules (e.g.
+    ``jit-outside-executor`` firing only under ``xpacks``/``stdlib``
+    segments) need their known-bad snippets in matching subtrees."""
+    out: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(CORPUS):
+        dirnames.sort()
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                out.append(os.path.join(dirpath, name))
+    return out
+
+
 def _expected_findings() -> set[tuple[str, int, str]]:
     """(basename, line, rule) for every EXPECT marker in the corpus."""
     expected: set[tuple[str, int, str]] = set()
-    for name in sorted(os.listdir(CORPUS)):
-        if not name.endswith(".py"):
-            continue
-        with open(os.path.join(CORPUS, name), encoding="utf-8") as f:
+    for path in _corpus_files():
+        name = os.path.basename(path)
+        with open(path, encoding="utf-8") as f:
             for lineno, line in enumerate(f, start=1):
                 m = _EXPECT_BELOW_RE.search(line)
                 if m is not None:
