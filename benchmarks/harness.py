@@ -101,6 +101,12 @@ SUITE: tuple[Bench, ...] = (
     Bench(
         "device_executor", "device_executor.py", ("smoke",), ("full",),
     ),
+    # device observability: per-dispatch cost of the PR 12 accounting
+    # rail (cost analysis, occupancy, padding, live bytes) vs the
+    # metrics kill switch — the ≤2%-of-a-1ms-epoch pin
+    Bench(
+        "device_obs_overhead", "device_obs_overhead.py", ("smoke",), ("full",),
+    ),
 )
 
 MODE_REPS = {"smoke": 3, "full": 3}
@@ -136,15 +142,30 @@ def environment_fingerprint() -> dict[str, Any]:
                     break
     except OSError:
         pass
+    # the JAX backend actually reached matters as much as the version:
+    # BENCH_r01–r06 were ambiguous about CPU fallback precisely because
+    # the fingerprint never said which backend/device kind ran them
+    jax_version = "unavailable"
+    jax_backend = "unavailable"
+    jax_device_kind = "unavailable"
+    jax_device_count = 0
     try:
         import jax
 
         jax_version = jax.__version__
+        jax_backend = jax.default_backend()
+        devices = jax.devices()
+        jax_device_count = len(devices)
+        if devices:
+            jax_device_kind = str(devices[0].device_kind)
     except Exception:  # noqa: BLE001 - fingerprinting must never fail
-        jax_version = "unavailable"
+        pass
     return {
         "python": platform.python_version(),
         "jax": jax_version,
+        "jax_backend": jax_backend,
+        "jax_device_kind": jax_device_kind,
+        "jax_device_count": jax_device_count,
         "platform": platform.platform(),
         "machine": platform.machine(),
         "cpus": os.cpu_count() or 0,
@@ -465,8 +486,10 @@ def render_results_table(results: dict[str, Any]) -> str:
     lines = [
         f"Generated by `pathway_tpu bench --{results['mode']}` on {stamp} "
         f"({results['reps']} rep(s); python {fp.get('python')}, "
-        f"jax {fp.get('jax')}, {fp.get('cpus')} cpu(s)).  Medians with "
-        "IQR; do not hand-edit between the markers.",
+        f"jax {fp.get('jax')} on backend **{fp.get('jax_backend', '?')}** "
+        f"({fp.get('jax_device_count', '?')}x "
+        f"{fp.get('jax_device_kind', '?')}), {fp.get('cpus')} cpu(s)).  "
+        "Medians with IQR; do not hand-edit between the markers.",
         "",
         "| metric | median | IQR | better |",
         "|---|---|---|---|",

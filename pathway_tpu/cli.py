@@ -19,7 +19,7 @@ import secrets
 import subprocess
 import sys
 import uuid
-from typing import NoReturn
+from typing import Any, NoReturn
 
 import click
 
@@ -581,6 +581,18 @@ def blackbox(worker, tail, as_json, root):
 
                 for line in render_freshness(freshness).splitlines():
                     click.echo(f"  {line}")
+            device = payload.get("device")
+            if device:
+                # ...and what the DEVICE was doing: the final executor
+                # snapshot (pathway_tpu/device/telemetry.py)
+                from pathway_tpu.device import render_device_snapshot
+
+                for line in render_device_snapshot(device).splitlines():
+                    click.echo(f"  {line}")
+            else:
+                # pre-device-observability dumps carry no device key —
+                # an explicit empty state, never a KeyError
+                click.echo("  device: (no snapshot in this dump)")
     sys.exit(0)
 
 
@@ -682,18 +694,23 @@ def profile(top, as_json, source):
     from pathway_tpu.internals.config import env_int
 
     top = top or env_int("PATHWAY_PROFILE_TOP")
-    snapshots: list[tuple[str, dict]] = []
+    # (label, profiler snapshot, device snapshot or None) — positionally
+    # paired, because one worker/attempt can leave several dumps
+    # (watchdog + crash) whose labels collide; ABSENT marks a bare
+    # PATHWAY_PROFILE_OUTPUT snapshot with no dump context at all, and
+    # None a dump that predates device observability (explicit empty
+    # state)
+    ABSENT = object()
+    snapshots: list[tuple[str, dict, Any]] = []
     if os.path.isdir(source):
         from pathway_tpu.engine.flight_recorder import gather_dumps
 
         for wid, payloads in sorted(gather_dumps(source).items()):
             for payload in payloads:
+                label = f"worker {wid} · attempt {payload.get('attempt')}"
                 prof = payload.get("profiler")
                 if prof:
-                    snapshots.append(
-                        (f"worker {wid} · attempt {payload.get('attempt')}",
-                         prof)
-                    )
+                    snapshots.append((label, prof, payload.get("device")))
     else:
         try:
             with open(source, encoding="utf-8") as f:
@@ -710,7 +727,16 @@ def profile(top, as_json, source):
             else None
         )
         if isinstance(prof, dict) and "operators" in prof:
-            snapshots.append((source, prof))
+            # a flight-recorder dump file gets the same device section
+            # (or empty state) as the directory form; a bare
+            # PATHWAY_PROFILE_OUTPUT snapshot has no dump context and
+            # gets neither
+            device = (
+                payload.get("device")
+                if isinstance(payload, dict) and "profiler" in payload
+                else ABSENT
+            )
+            snapshots.append((source, prof, device))
     if not snapshots:
         click.echo(
             f"[pathway_tpu] no profiler snapshot in {source} — run with "
@@ -722,21 +748,29 @@ def profile(top, as_json, source):
     if as_json:
         # a list, not a dict: one worker/attempt can leave several dumps
         # (watchdog + crash) whose labels collide — none may be dropped
-        click.echo(
-            _json.dumps(
-                [
-                    {"label": label, "snapshot": snap}
-                    for label, snap in snapshots
-                ],
-                indent=2,
-                sort_keys=True,
-            )
-        )
+        entries = []
+        for label, snap, device in snapshots:
+            entry: dict = {"label": label, "snapshot": snap}
+            if device is not ABSENT:
+                # the machine-readable form carries the same device
+                # section the text render shows (null = a dump that
+                # predates device observability)
+                entry["device"] = device
+            entries.append(entry)
+        click.echo(_json.dumps(entries, indent=2, sort_keys=True))
         sys.exit(0)
-    for label, snap in snapshots:
+    for label, snap, device in snapshots:
         if len(snapshots) > 1:
             click.echo(label)
         click.echo(render_snapshot(snap, top=top))
+        if device is ABSENT:
+            continue
+        if device:
+            from pathway_tpu.device import render_device_snapshot
+
+            click.echo(render_device_snapshot(device))
+        else:
+            click.echo("device: (no snapshot in this dump)")
     sys.exit(0)
 
 
@@ -790,19 +824,14 @@ def top(url, port, process_id, interval, once, as_json):
     import json as _json
     import time as _time_mod
 
-    from pathway_tpu.engine.http_server import monitoring_port
-    from pathway_tpu.internals.config import env_float, env_int
+    from pathway_tpu.internals.config import env_float
     from pathway_tpu.internals.top import (
         StatusUnavailable,
         fetch_status,
         render_top,
-        status_url,
     )
 
-    if url is None:
-        if port is None:
-            port = env_int("PATHWAY_MONITORING_HTTP_PORT")
-        url = status_url(monitoring_port(process_id, port))
+    url = _monitoring_url(url, port, process_id, "status")
     if interval is None:
         interval = env_float("PATHWAY_STATUS_REFRESH_S")  # declared default 1.0
     # an explicit small value clamps (never silently reverts to the
@@ -836,6 +865,302 @@ def top(url, port, process_id, interval, once, as_json):
             sys.exit(0)
         prev, prev_t = status, now
         _time_mod.sleep(interval)
+
+
+def _monitoring_url(url: str | None, port: int | None, process_id: int,
+                    endpoint: str) -> str:
+    """Resolve a monitoring-server URL the way ``top`` does: explicit
+    ``--url`` wins, else ``--port``/``PATHWAY_MONITORING_HTTP_PORT``/the
+    20000 + process-id default, with ``endpoint`` as the path."""
+    if url is not None:
+        return url
+    from pathway_tpu.engine.http_server import monitoring_port
+    from pathway_tpu.internals.config import env_int
+
+    if port is None:
+        port = env_int("PATHWAY_MONITORING_HTTP_PORT")
+    return f"http://127.0.0.1:{monitoring_port(process_id, port)}/{endpoint}"
+
+
+@cli.command()
+@click.option(
+    "--url",
+    metavar="URL",
+    type=str,
+    default=None,
+    help="full /trace URL (overrides --port/--process-id)",
+)
+@click.option(
+    "--port",
+    metavar="PORT",
+    type=int,
+    default=None,
+    help="monitoring HTTP port (default: PATHWAY_MONITORING_HTTP_PORT, "
+    "else 20000 + process id)",
+)
+@click.option(
+    "--process-id",
+    metavar="N",
+    type=int,
+    default=0,
+    help="worker whose device to trace (port defaults to 20000 + N)",
+)
+@click.option(
+    "--seconds",
+    metavar="S",
+    type=float,
+    default=3.0,
+    show_default=True,
+    help="capture duration",
+)
+def trace(url, port, process_id, seconds):
+    """Capture an on-demand jax.profiler trace from a running worker.
+
+    Asks the worker's monitoring HTTP server (``GET /trace?seconds=N``)
+    to run ``jax.profiler`` start/stop IN the worker process and dump a
+    TensorBoard-viewable trace directory under the worker's
+    ``PATHWAY_DEVICE_TRACE_DIR`` — see docs/observability.md, "Device
+    observability".  Exits non-zero with the server's reason when
+    capture is unavailable (no trace dir configured, capture already
+    running, endpoint unreachable).
+    """
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    target = _monitoring_url(url, port, process_id, "trace")
+    sep = "&" if "?" in target else "?"
+    target = f"{target}{sep}seconds={float(seconds)}"
+    click.echo(
+        f"[pathway_tpu] capturing {seconds:g} s of device trace via "
+        f"{target} ...",
+        err=True,
+    )
+    try:
+        # the server blocks for the capture duration; pad the timeout
+        with urllib.request.urlopen(target, timeout=seconds + 30.0) as r:
+            payload = _json.loads(r.read().decode())
+    except urllib.error.HTTPError as exc:
+        try:
+            reason = _json.loads(exc.read().decode()).get("error", str(exc))
+        except Exception:  # noqa: BLE001 - error body is best-effort
+            reason = str(exc)
+        click.echo(f"[pathway_tpu] trace capture failed: {reason}", err=True)
+        sys.exit(1)
+    except (OSError, ValueError) as exc:
+        click.echo(
+            f"[pathway_tpu] cannot reach {target} ({exc}) — is the pipeline "
+            "running with with_http_server=True (or "
+            "PATHWAY_MONITORING_HTTP_PORT set)?",
+            err=True,
+        )
+        sys.exit(1)
+    trace_dir = payload.get("trace_dir")
+    click.echo(f"[pathway_tpu] trace written to {trace_dir}")
+    click.echo(f"[pathway_tpu] view with: tensorboard --logdir {trace_dir}", err=True)
+    sys.exit(0)
+
+
+@cli.command()
+@click.option(
+    "--url",
+    metavar="URL",
+    type=str,
+    default=None,
+    help="full /status URL (overrides --port/--process-id)",
+)
+@click.option(
+    "--port",
+    metavar="PORT",
+    type=int,
+    default=None,
+    help="monitoring HTTP port (default: PATHWAY_MONITORING_HTTP_PORT, "
+    "else 20000 + process id)",
+)
+@click.option(
+    "--process-id",
+    metavar="N",
+    type=int,
+    default=0,
+    help="worker whose batch distribution to read",
+)
+@click.option(
+    "--max-buckets",
+    metavar="K",
+    type=click.IntRange(min=1),
+    default=8,
+    show_default=True,
+    help="bucket-set size budget (each bucket is one compile per callable)",
+)
+@click.option(
+    "--json", "as_json", is_flag=True, help="emit the report as JSON"
+)
+@click.argument(
+    "root", type=click.Path(exists=True, file_okay=False), required=False
+)
+def buckets(url, port, process_id, max_buckets, as_json, root):
+    """Replay the observed batch-size distribution; suggest better buckets.
+
+    Reads the ragged batch sizes the DeviceExecutor actually saw — live
+    from a running worker's ``GET /status`` device section, or post-hoc
+    from the flight-recorder dumps under a persistence ROOT — replays
+    them against the default power-of-two policy, and reports the bucket
+    set of at most ``--max-buckets`` sizes that minimizes padding waste
+    (``device/bucketing.py:suggest_buckets``).  Exits non-zero when no
+    batch distribution is available.
+    """
+    import json as _json
+
+    from pathway_tpu.device.bucketing import (
+        BucketPolicy,
+        next_pow2,
+        replay_waste,
+        suggest_buckets,
+    )
+
+    size_counts: dict[int, int] = {}
+    truncated = False
+    observed_max_batch: int | None = None
+    if root is not None:
+        from pathway_tpu.engine.flight_recorder import gather_dumps
+
+        for _wid, payloads in sorted(gather_dumps(root).items()):
+            # the accountant ledger is cumulative PER PROCESS: a worker
+            # attempt that dumped twice (watchdog then crash) repeats its
+            # earlier batches in the later dump — count only the newest
+            # dump of each attempt, summing across attempts (each attempt
+            # is a fresh process)
+            newest_per_attempt: dict[Any, dict] = {}
+            for payload in payloads:
+                key = payload.get("attempt")
+                prev = newest_per_attempt.get(key)
+                if prev is None or (payload.get("dumped_at") or 0) >= (
+                    prev.get("dumped_at") or 0
+                ):
+                    newest_per_attempt[key] = payload
+            for payload in newest_per_attempt.values():
+                device_snap = payload.get("device") or {}
+                try:
+                    observed_max_batch = int(device_snap["default_max_batch"])
+                except (KeyError, TypeError, ValueError):
+                    pass
+                sizes = (device_snap.get("cost") or {}).get(
+                    "batch_sizes"
+                ) or {}
+                for size, count in sizes.items():
+                    try:
+                        size_counts[int(size)] = (
+                            size_counts.get(int(size), 0) + int(count)
+                        )
+                    except (TypeError, ValueError):
+                        continue
+        source = f"flight-recorder dumps under {root}"
+    else:
+        from pathway_tpu.engine.metrics import split_labeled_name
+        from pathway_tpu.internals.top import StatusUnavailable, fetch_status
+
+        target = _monitoring_url(url, port, process_id, "status")
+        try:
+            status = fetch_status(target)
+        except StatusUnavailable as exc:
+            click.echo(f"[pathway_tpu] {exc}", err=True)
+            sys.exit(1)
+        device_section = status.get("device") or {}
+        if device_section.get("device.batch.max"):
+            observed_max_batch = int(device_section["device.batch.max"])
+        for key, value in device_section.items():
+            base, labels = split_labeled_name(key)
+            if base != "device.batch.rows" or "rows" not in labels:
+                continue
+            try:
+                size_counts[int(labels["rows"])] = int(value)
+            except (TypeError, ValueError):
+                continue
+        source = target
+        # the live feed exports only the most-frequent sizes
+        # (device/telemetry.py:BATCH_SIZE_EXPORT_TOP); at the cap the
+        # tail was dropped and the report must say so
+        from pathway_tpu.device.telemetry import BATCH_SIZE_EXPORT_TOP
+
+        truncated = len(size_counts) >= BATCH_SIZE_EXPORT_TOP
+    if not size_counts:
+        click.echo(
+            f"[pathway_tpu] no batch-size distribution in {source} — the "
+            "DeviceExecutor has not dispatched yet (or the dump predates "
+            "device observability)",
+            err=True,
+        )
+        sys.exit(1)
+    largest = max(size_counts)
+    # the baseline is the ANALYZED RUN's default policy: batches above
+    # its max split into full-bucket chunks, so replaying against
+    # next_pow2(largest) would invent waste the run never paid.  The
+    # snapshot/status carries the run's PATHWAY_DEVICE_MAX_BATCH; the
+    # analyst's own env is only the last-resort fallback (pre-PR-12
+    # dumps)
+    if observed_max_batch is None:
+        from pathway_tpu.internals.config import env_int
+
+        observed_max_batch = env_int("PATHWAY_DEVICE_MAX_BATCH")
+    current = BucketPolicy(
+        max_bucket=min(next_pow2(largest), int(observed_max_batch))
+    ).buckets()
+    current_pad, real = replay_waste(size_counts, current)
+    suggested = suggest_buckets(size_counts, max_buckets=max_buckets)
+    suggested_pad, _ = replay_waste(size_counts, suggested)
+
+    def frac(pad: int) -> float:
+        return pad / (pad + real) if (pad + real) else 0.0
+
+    report = {
+        "source": source,
+        "batches": sum(size_counts.values()),
+        "distinct_sizes": len(size_counts),
+        "truncated": truncated,
+        "largest": largest,
+        "real_rows": real,
+        "current": {
+            "buckets": list(current),
+            "pad_rows": current_pad,
+            "waste_fraction": frac(current_pad),
+        },
+        "suggested": {
+            "buckets": list(suggested),
+            "pad_rows": suggested_pad,
+            "waste_fraction": frac(suggested_pad),
+        },
+    }
+    if as_json:
+        click.echo(_json.dumps(report, indent=2, sort_keys=True))
+        sys.exit(0)
+    click.echo(
+        f"batch distribution: {report['batches']} batch(es), "
+        f"{report['distinct_sizes']} distinct size(s), largest {largest} "
+        f"({source})"
+    )
+    if truncated:
+        click.echo(
+            "  note: the live /status feed exports only the most-frequent "
+            "sizes — the tail of the distribution was dropped; read a "
+            "flight-recorder root for the full ledger"
+        )
+    click.echo(
+        f"  power-of-two policy {current}: {current_pad} pad row(s) "
+        f"({frac(current_pad):.1%} waste)"
+    )
+    click.echo(
+        f"  suggested buckets   {suggested}: {suggested_pad} pad row(s) "
+        f"({frac(suggested_pad):.1%} waste) — "
+        f"{len(suggested)} compile(s) per callable"
+    )
+    if suggested_pad < current_pad:
+        click.echo(
+            f"  apply with DeviceExecutor.register(..., policy=BucketPolicy("
+            f"sizes={suggested})) for the hot callables"
+        )
+    else:
+        click.echo("  the power-of-two policy is already near-optimal here")
+    sys.exit(0)
 
 
 def _load_harness():

@@ -2,28 +2,49 @@
 
 The subsystem the ROADMAP's perf arc rides on: ``DeviceExecutor`` owns
 batch bucketing + padding masks (``bucketing.py``), jit compile-cache
-discipline with explicit keys and warmup, and an async dispatch queue
-with a bounded in-flight budget exported as ``backlog.device.*``.
+discipline with explicit keys and warmup, an async dispatch queue with a
+bounded in-flight budget exported as ``backlog.device.*``, and the
+device observability layer (``telemetry.py``): XLA cost accounting at
+compile time, roofline utilization, padding/bucket efficiency, HBM
+tracking, and on-demand ``jax.profiler`` trace capture.
 """
 
 from pathway_tpu.device.bucketing import (
     BatchChunk,
     BucketPolicy,
     pad_batch_dim,
+    replay_waste,
     stack_rows,
+    suggest_buckets,
 )
 from pathway_tpu.device.executor import (
     DeviceExecutor,
     DeviceFuture,
+    default_executor_snapshot,
     get_default_executor,
+)
+from pathway_tpu.device.telemetry import (
+    CostAccountant,
+    TraceBusy,
+    TraceUnavailable,
+    capture_trace,
+    render_device_snapshot,
 )
 
 __all__ = [
     "BatchChunk",
     "BucketPolicy",
+    "CostAccountant",
     "DeviceExecutor",
     "DeviceFuture",
+    "TraceBusy",
+    "TraceUnavailable",
+    "capture_trace",
+    "default_executor_snapshot",
     "get_default_executor",
     "pad_batch_dim",
+    "render_device_snapshot",
+    "replay_waste",
     "stack_rows",
+    "suggest_buckets",
 ]

@@ -33,6 +33,15 @@ in PAPERS.md is the model):
    (PR 9's backpressure namespace) — proven by the ``device_stall``
    chaos fault (``engine/faults.py``).
 
+4. **Cost accounting at compile time** — every fresh cache key is
+   compiled through the AOT path (``jitted.lower().compile()``; the
+   executable is kept and reused, so it is still one backend compile
+   per key) and its ``cost_analysis()``/``memory_analysis()`` feed the
+   device observability layer (``device/telemetry.py``): flops totals,
+   roofline utilization, per-bucket occupancy, padding waste, and the
+   HBM live-bytes fallback — see docs/device_executor.md, "Cost
+   accounting & roofline".
+
 ``AsyncMicroBatcher`` (``utils/batching.py``) is the coalescing
 front-end over :meth:`submit`; model code reaches :meth:`run_batch`
 from inside its batch callbacks.  The two layers compose: submit owns
@@ -49,6 +58,7 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
+from pathway_tpu.device import telemetry as _dtel
 from pathway_tpu.device.bucketing import (
     BucketPolicy,
     pad_batch_dim,
@@ -58,6 +68,7 @@ from pathway_tpu.engine import metrics as _metrics
 __all__ = [
     "DeviceExecutor",
     "DeviceFuture",
+    "default_executor_snapshot",
     "get_default_executor",
 ]
 
@@ -134,12 +145,20 @@ class DeviceFuture:
         return self._result
 
 
+# sentinel marking a compile-cache key whose AOT compile is in flight
+_COMPILING = object()
+# how long a concurrent dispatcher waits for another thread's in-flight
+# compile before falling back to the jit path (a big TPU program can
+# legitimately compile for minutes; waiting beats a duplicate compile)
+_COMPILE_WAIT_S = 300.0
+
+
 class _Registered:
     """One registered traceable: its jit wrapper + compile-key ledger."""
 
     __slots__ = (
         "name", "jitted", "policy", "seen_keys", "dispatches", "cold",
-        "warmed", "lock",
+        "warmed", "lock", "cv", "compiled", "costs",
     )
 
     def __init__(self, name: str, jitted: Callable, policy: BucketPolicy):
@@ -147,6 +166,16 @@ class _Registered:
         self.jitted = jitted
         self.policy = policy
         self.seen_keys: set[tuple] = set()
+        # key -> AOT-compiled executable / compile-time cost dict
+        # (device/telemetry.py): the fresh-key path compiles through
+        # jitted.lower().compile() so cost_analysis() is captured at
+        # compile time and the SAME executable serves every later
+        # dispatch of the key — one backend compile either way.  While a
+        # compile is in flight the key maps to the _COMPILING sentinel;
+        # concurrent dispatchers of the same key wait on `cv` (bounded)
+        # instead of paying a duplicate backend compile via the jit path
+        self.compiled: dict[tuple, Any] = {}
+        self.costs: dict[tuple, dict[str, float]] = {}
         self.dispatches = 0
         self.cold = 0
         self.warmed = 0
@@ -156,6 +185,8 @@ class _Registered:
         # double-count cold compiles — tripping the "nonzero cold after
         # warmup is a bug" invariant spuriously
         self.lock = threading.Lock()
+        # signaled when an in-flight AOT compile resolves (shares `lock`)
+        self.cv = threading.Condition(self.lock)
 
 
 class _Job:
@@ -202,6 +233,10 @@ class DeviceExecutor:
             max_inflight_mb = env_float("PATHWAY_DEVICE_INFLIGHT_MB")
         if max_inflight_requests is None:
             max_inflight_requests = env_int("PATHWAY_DEVICE_INFLIGHT_REQUESTS")
+        # the default-policy cap THIS process runs with, stamped into the
+        # exported gauges/snapshots so `pathway_tpu buckets` replays the
+        # analyzed run's real configuration, not the analyst's shell env
+        self._default_max_batch = int(env_int("PATHWAY_DEVICE_MAX_BATCH"))
         self.max_inflight_bytes = int(float(max_inflight_mb) * 1024 * 1024)
         self.max_inflight_requests = int(max_inflight_requests)
         self._callables: dict[str, _Registered] = {}
@@ -244,6 +279,26 @@ class DeviceExecutor:
             "wall time of one async host-side batch job (ms)",
             buckets=_metrics.MS_BUCKETS,
         )
+        self._m_occupancy = reg.histogram(
+            "device.bucket.occupancy",
+            "real-row fraction of each dispatched bucket (1.0 = no padding)",
+            buckets=_metrics.OCCUPANCY_BUCKETS,
+        )
+        # device-path cost ledger (device/telemetry.py): compile-time XLA
+        # cost analysis x dispatch durations -> flops totals, roofline
+        # utilization, and the batch-size distribution `pathway_tpu
+        # buckets` replays
+        self._accountant = _dtel.CostAccountant(registry=reg)
+        # per-executor padding totals (the registry counters are shared
+        # family children across executors, so the waste FRACTION must be
+        # computed from this instance's own ledger)
+        self._pad_rows = 0
+        self._real_rows = 0
+        # live-bytes fallback for backends without memory_stats(): the
+        # argument+output+temp footprint of dispatches currently running
+        self._mem_lock = threading.Lock()
+        self._live_bytes = 0.0
+        self._live_peak = 0.0
         if collector_name:
             reg.register_collector(collector_name, self.metrics_snapshot)
 
@@ -337,6 +392,52 @@ class DeviceExecutor:
         backend = jax.default_backend() if _HAVE_JAX else "host"
         return (tuple(leaves), static_key, backend)
 
+    @staticmethod
+    def _cost_analysis_enabled() -> bool:
+        from pathway_tpu.internals.config import env_bool
+
+        return env_bool("PATHWAY_DEVICE_COST_ANALYSIS")
+
+    def _compile_key(
+        self,
+        entry: _Registered,
+        key: tuple,
+        operands: tuple,
+        arrays: tuple,
+        static: dict[str, Any] | None,
+    ) -> Any | None:
+        """AOT-compile a fresh cache key and capture its XLA cost.
+
+        ``jitted.lower().compile()`` and a plain jit call do NOT share a
+        compile cache, so the executable compiled here is kept and
+        reused for every later dispatch of the key — paying one backend
+        compile AND getting ``cost_analysis()``/``memory_analysis()`` at
+        compile time.  Any failure falls back to the jit call path (that
+        key's dispatches are then counted as *uncosted*, never lost).
+        The caller has already claimed the key with the ``_COMPILING``
+        sentinel inside the freshness critical section."""
+        try:
+            lowered = entry.jitted.lower(*operands, *arrays, **(static or {}))
+            compiled = lowered.compile()
+            cost = _dtel.extract_cost(compiled)
+        except Exception:  # noqa: BLE001 - accounting must never fail dispatch
+            return None  # the finally clears the sentinel and wakes waiters
+        else:
+            with entry.cv:
+                entry.compiled[key] = compiled
+                entry.costs[key] = cost
+                entry.cv.notify_all()
+            return compiled
+        finally:
+            # ANY exit that left the sentinel behind (including a
+            # BaseException unwinding through the compile) must clear it,
+            # or concurrent dispatchers of this key would block on a
+            # compile that is never coming
+            with entry.cv:
+                if entry.compiled.get(key) is _COMPILING:
+                    entry.compiled.pop(key, None)
+                entry.cv.notify_all()
+
     def _dispatch_fixed(
         self,
         entry: _Registered,
@@ -347,6 +448,7 @@ class DeviceExecutor:
         warmup: bool = False,
     ) -> Any:
         key = self._cache_key(operands, arrays, static)
+        aot = False
         with entry.lock:
             fresh = key not in entry.seen_keys
             if fresh:
@@ -355,15 +457,75 @@ class DeviceExecutor:
                     entry.warmed += 1
                 else:
                     entry.cold += 1
+                # resolved only on fresh keys (an env read per dispatch
+                # would tax the warm path for nothing)
+                aot = _HAVE_JAX and self._cost_analysis_enabled()
+                if aot:
+                    # claim the key IN the same critical section that
+                    # decided freshness: a concurrent dispatcher must see
+                    # the sentinel (and wait below), never a gap in which
+                    # it pays a duplicate backend compile via the jit path
+                    entry.compiled[key] = _COMPILING
             entry.dispatches += 1
+            compiled = entry.compiled.get(key)
+            cost = entry.costs.get(key)
         if fresh:
             (self._m_warm if warmup else self._m_cold).inc()
+            compiled = (
+                self._compile_key(entry, key, operands, arrays, static)
+                if aot
+                else None
+            )
+            with entry.lock:
+                cost = entry.costs.get(key)
+        elif compiled is _COMPILING:
+            # another thread is AOT-compiling this key right now: wait
+            # for its executable (timed slices, never unbounded) rather
+            # than paying a DUPLICATE backend compile through the jit
+            # path — the jit and AOT caches are separate
+            deadline = time.monotonic() + _COMPILE_WAIT_S
+            with entry.cv:
+                while (
+                    entry.compiled.get(key) is _COMPILING
+                    and time.monotonic() < deadline
+                ):
+                    entry.cv.wait(timeout=1.0)
+                compiled = entry.compiled.get(key)
+                cost = entry.costs.get(key)
+            if compiled is _COMPILING:  # compiler thread wedged/too slow
+                compiled = None
+                cost = None
+        # live-bytes tracking is part of the accounting rail: the kill
+        # switch (PATHWAY_METRICS_DISABLED) drops its lock sections too
+        footprint = 0.0
+        if self._accountant.enabled:
+            footprint = (
+                cost["argument_bytes"]
+                + cost["output_bytes"]
+                + cost["temp_bytes"]
+                if cost
+                else float(sum(getattr(a, "nbytes", 0) for a in arrays))
+            )
+            with self._mem_lock:
+                self._live_bytes += footprint
+                self._live_peak = max(self._live_peak, self._live_bytes)
         t0 = time.monotonic()
-        out = entry.jitted(*operands, *arrays, **(static or {}))
-        if _HAVE_JAX:
-            out = jax.tree_util.tree_map(np.asarray, out)
-        self._m_dispatch_ms.observe((time.monotonic() - t0) * 1000.0)
+        try:
+            if compiled is not None:
+                # statics are baked into the AOT executable at lowering
+                out = compiled(*operands, *arrays)
+            else:
+                out = entry.jitted(*operands, *arrays, **(static or {}))
+            if _HAVE_JAX:
+                out = jax.tree_util.tree_map(np.asarray, out)
+        finally:
+            if footprint:
+                with self._mem_lock:
+                    self._live_bytes -= footprint
+        duration = time.monotonic() - t0
+        self._m_dispatch_ms.observe(duration * 1000.0)
         self._m_batches.inc()
+        self._accountant.record_dispatch(cost, duration)
         return out
 
     # -- the fixed-shape inline path -----------------------------------------
@@ -399,7 +561,10 @@ class DeviceExecutor:
                     f"batch arrays disagree on row count: {a.shape[0]} != {n_rows}"
                 )
         operands = tuple(operands)
+        self._accountant.record_batch(n_rows)
         chunk_outs: list[Any] = []
+        batch_real = 0
+        batch_pad = 0
         for chunk in entry.policy.plan(n_rows):
             padded = tuple(
                 pad_batch_dim(a[chunk.start : chunk.start + chunk.count], chunk.bucket)[0]
@@ -407,8 +572,17 @@ class DeviceExecutor:
             )
             self._m_rows.inc(chunk.count)
             self._m_pad.inc(chunk.bucket - chunk.count)
+            self._m_occupancy.observe(chunk.count / chunk.bucket)
+            batch_real += chunk.count
+            batch_pad += chunk.bucket - chunk.count
             out = self._dispatch_fixed(entry, operands, padded, static)
             chunk_outs.append(_slice_rows(out, chunk.count))
+        # one locked update per batch: run_batch is legal from epoch,
+        # serving, and dispatch threads concurrently, and an unguarded
+        # += here would lose increments and understate padding waste
+        with self._mem_lock:
+            self._real_rows += batch_real
+            self._pad_rows += batch_pad
         if len(chunk_outs) == 1:
             return chunk_outs[0]
         return _concat_rows(chunk_outs)
@@ -566,8 +740,8 @@ class DeviceExecutor:
 
     # -- observability -------------------------------------------------------
 
-    def metrics_snapshot(self) -> dict[str, float]:
-        """Registry collector: the ``backlog.device.*`` namespace."""
+    def _queue_snapshot(self) -> dict[str, float]:
+        """The ``backlog.device.*`` slice: queue depth/bytes/oldest age."""
         with self._cond:
             jobs = list(self._queue)
             if self._running is not None:
@@ -585,6 +759,59 @@ class DeviceExecutor:
         else:
             out["backlog.device.age.s"] = 0.0
         return out
+
+    def _padding_snapshot(self) -> dict[str, float]:
+        with self._mem_lock:
+            pad, real = self._pad_rows, self._real_rows
+        total = pad + real
+        return {
+            "pad_rows": float(pad),
+            "real_rows": float(real),
+            "fraction": (pad / total) if total else 0.0,
+        }
+
+    def _hbm_snapshot(self) -> dict[str, Any]:
+        """Real allocator stats where the backend keeps them, else this
+        executor's tracked in-flight footprint (the CPU-rig fallback)."""
+        stats = _dtel.hbm_stats()
+        if stats is not None:
+            return {**stats, "source": "memory_stats"}
+        with self._mem_lock:
+            return {
+                "bytes_in_use": self._live_bytes,
+                "peak": self._live_peak,
+                "source": "executor",
+            }
+
+    def metrics_snapshot(self) -> dict[str, float]:
+        """Registry collector: ``backlog.device.*`` plus the device cost
+        gauges — utilization, padding waste, HBM — so one scrape covers
+        the whole device story."""
+        out = self._queue_snapshot()
+        out.update(self._accountant.gauges())
+        out["device.batch.max"] = float(self._default_max_batch)
+        padding = self._padding_snapshot()
+        out["device.padding.waste.rows"] = padding["pad_rows"]
+        out["device.padding.waste.fraction"] = padding["fraction"]
+        hbm = self._hbm_snapshot()
+        out["device.hbm.bytes_in_use"] = float(hbm["bytes_in_use"])
+        out["device.hbm.peak"] = float(hbm["peak"])
+        return out
+
+    def device_snapshot(self) -> dict[str, Any]:
+        """The full device story as one JSON-able dict — what rides
+        flight-recorder dumps (``set_device_supplier``) and feeds
+        ``pathway_tpu buckets`` from a post-mortem root."""
+        return {
+            "cost": self._accountant.snapshot(),
+            "default_max_batch": self._default_max_batch,
+            "padding": self._padding_snapshot(),
+            "hbm": self._hbm_snapshot(),
+            "queue": self._queue_snapshot(),
+            "callables": {
+                name: self.stats(name) for name in sorted(self._callables)
+            },
+        }
 
 
 def _slice_rows(out: Any, count: int) -> Any:
@@ -621,3 +848,13 @@ def get_default_executor() -> DeviceExecutor:
             if _default is None:
                 _default = DeviceExecutor()
     return _default
+
+
+def default_executor_snapshot() -> dict[str, Any] | None:
+    """The default executor's :meth:`DeviceExecutor.device_snapshot`,
+    WITHOUT instantiating one — the flight-recorder supplier
+    (``internals/runner.py``): a run that never touched the device path
+    dumps no device section rather than a zeroed one."""
+    if _default is None:
+        return None
+    return _default.device_snapshot()

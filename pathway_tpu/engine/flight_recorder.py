@@ -69,6 +69,10 @@ class FlightRecorder:
         # optional freshness supplier (engine/freshness.py): final
         # watermark/backlog snapshot — what was STUCK, not just slow
         self._freshness_supplier: Any = None
+        # optional device supplier (pathway_tpu/device/executor.py): final
+        # DeviceExecutor snapshot (cost/utilization/padding/HBM/queue) —
+        # what the DEVICE was doing when the process died
+        self._device_supplier: Any = None
 
     # -- recording ---------------------------------------------------------
     def record(self, kind: str, **fields: Any) -> None:
@@ -125,6 +129,13 @@ class FlightRecorder:
         lifetime contract as :meth:`set_profile_supplier`)."""
         self._freshness_supplier = fn
 
+    def set_device_supplier(self, fn: Any) -> None:
+        """Attach (or clear) the callable whose DeviceExecutor snapshot
+        rides every subsequent dump under the ``device`` key (same
+        lifetime contract as :meth:`set_profile_supplier`) — post-mortems
+        say what the device was doing, not just the host."""
+        self._device_supplier = fn
+
     # -- dumping -----------------------------------------------------------
     def dump(self, reason: str, *, suffix: str | None = None) -> str | None:
         """Write the ring to ``<root>/blackbox/worker-<id>.attempt-<n>.json``
@@ -159,6 +170,7 @@ class FlightRecorder:
             }
             supplier = self._profile_supplier
             freshness_supplier = self._freshness_supplier
+            device_supplier = self._device_supplier
         if supplier is not None:
             # outside the lock (the supplier scans the node arena) and
             # never fatal: a dump without a profile beats no dump
@@ -176,6 +188,15 @@ class FlightRecorder:
                 freshness = None
             if freshness:
                 payload["freshness"] = freshness
+        if device_supplier is not None:
+            # ...and what the DEVICE was doing: cost/utilization/padding/
+            # HBM/queue at dump time (best-effort like the others)
+            try:
+                device = device_supplier()
+            except Exception:  # noqa: BLE001 - forensics must never fail
+                device = None
+            if device:
+                payload["device"] = device
         if payload["incarnation"] and self._fenced(
             root, payload["incarnation"], payload["worker"]
         ):

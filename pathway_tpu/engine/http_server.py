@@ -132,6 +132,8 @@ def render_status(
         ],
     }
     if registry is not None:
+        from pathway_tpu.engine.telemetry import DEVICE_SECTION_PREFIX
+
         scalars = registry.scalar_metrics()
         payload["freshness"] = {
             k: v
@@ -146,7 +148,47 @@ def render_status(
             for k, v in scalars.items()
             if k.startswith("epoch.duration.ms.")
         }
+        # the device panel of `pathway_tpu top`: cost/utilization/padding/
+        # HBM gauges, dispatch counters and their quantile estimates, plus
+        # the jax compile accounting the executor discipline pins against
+        payload["device"] = {
+            k: v
+            for k, v in scalars.items()
+            if k.startswith((DEVICE_SECTION_PREFIX, "jax."))
+        }
     return json.dumps(payload)
+
+
+def _handle_trace(path: str) -> tuple[str, int]:
+    """``GET /trace?seconds=N`` → ``(JSON body, HTTP status)``.
+
+    200 with ``{"trace_dir": ..., "seconds": ...}`` on success; 400 on a
+    malformed duration; 409 while another capture runs; 503 when capture
+    is unavailable here (no ``PATHWAY_DEVICE_TRACE_DIR``, no
+    ``jax.profiler``).  Errors carry ``{"error": message}`` so the
+    ``pathway_tpu trace`` CLI can relay the reason verbatim."""
+    from urllib.parse import parse_qs, urlparse
+
+    from pathway_tpu.device import telemetry as _device_telemetry
+
+    query = parse_qs(urlparse(path).query)
+    raw = (query.get("seconds") or ["1.0"])[0]
+    try:
+        seconds = float(raw)
+    except ValueError:
+        return json.dumps({"error": f"bad seconds value {raw!r}"}), 400
+    try:
+        trace_dir = _device_telemetry.capture_trace(seconds)
+    except _device_telemetry.TraceBusy as exc:
+        return json.dumps({"error": str(exc)}), 409
+    except _device_telemetry.TraceUnavailable as exc:
+        return json.dumps({"error": str(exc)}), 503
+    except Exception as exc:  # noqa: BLE001 - the JSON error contract
+        # holds for EVERY failure (unwritable trace dir, a profiler
+        # session started outside our lock, ...): the CLI must relay the
+        # real reason, never a dead-connection guess
+        return json.dumps({"error": repr(exc)}), 500
+    return json.dumps({"trace_dir": trace_dir, "seconds": seconds}), 200
 
 
 class MonitoringServer:
@@ -181,11 +223,23 @@ class MonitoringServer:
                         server._stats, server.run_id, registry=server.registry
                     )
                     ctype = "application/json"
+                elif self.path.startswith("/trace"):
+                    # on-demand jax.profiler capture IN THIS PROCESS (the
+                    # live worker owns the device), blocking this handler
+                    # thread for the requested duration — the threading
+                    # server keeps /status and /metrics responsive
+                    body, status = _handle_trace(self.path)
+                    ctype = "application/json"
+                    self._reply(status, ctype, body)
+                    return
                 else:
                     self.send_error(404)
                     return
+                self._reply(200, ctype, body)
+
+            def _reply(self, status: int, ctype: str, body: str) -> None:
                 data = body.encode()
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()

@@ -76,6 +76,13 @@ MS_BUCKETS = (
     500.0, 1000.0, 2500.0, 5000.0, 10000.0,
 )
 
+# Occupancy-fraction bounds for the device bucket-efficiency histogram
+# (device/executor.py): each dispatched bucket observes real_rows/bucket
+# in (0, 1] — 1.0 means a full bucket, low buckets mean padding waste.
+OCCUPANCY_BUCKETS = (
+    0.0625, 0.125, 0.1875, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0,
+)
+
 # Quantiles derived from every histogram's fixed buckets at read time,
 # surfaced as synthetic gauges (`<name>.p50` …) in the Prometheus
 # exposition, OTLP export, and the console dashboard footer.
@@ -247,6 +254,44 @@ METRICS: dict[str, tuple[str, str]] = {
         "queue"),
     "backlog.device.age.s": (
         "gauge", "age of the oldest batch job still in the dispatch queue"),
+    # device cost accounting / roofline / HBM (pathway_tpu/device/telemetry.py)
+    "device.flops.total": (
+        "counter", "cost-analysis FLOPs moved by dispatched device batches"),
+    "device.bytes.accessed": (
+        "counter", "cost-analysis bytes accessed by dispatched device "
+        "batches (XLA's HBM-traffic estimate)"),
+    "device.achieved.flops_per_s": (
+        "gauge", "cumulative FLOPs over cumulative device-call wall seconds"),
+    "device.utilization": (
+        "gauge", "roofline utilization estimate: achieved FLOP/s over the "
+        "configured/auto-detected per-device peak"),
+    "device.peak.flops_per_s": (
+        "gauge", "the roofline denominator in use (PATHWAY_DEVICE_PEAK_FLOPS "
+        "or the device-kind table; CPU gets a measured-peak default)"),
+    "device.bucket.occupancy": (
+        "histogram", "real-row fraction of each dispatched bucket (1.0 = "
+        "no padding)"),
+    "device.padding.waste.rows": (
+        "gauge", "cumulative padding rows this executor dispatched"),
+    "device.padding.waste.fraction": (
+        "gauge", "padding rows over all dispatched rows — the bucket-set "
+        "efficiency `pathway_tpu buckets` optimizes"),
+    "device.batch.rows": (
+        "gauge", "observed ragged batch-size distribution (rows= label; "
+        "top sizes only) — the `pathway_tpu buckets` live feed"),
+    "device.batch.max": (
+        "gauge", "the default bucket-policy cap this process runs with "
+        "(PATHWAY_DEVICE_MAX_BATCH) — `pathway_tpu buckets` replays "
+        "against the analyzed run's value, not the analyst's env"),
+    "device.hbm.bytes_in_use": (
+        "gauge", "device memory in use: allocator memory_stats() where "
+        "available, the executor's in-flight footprint elsewhere"),
+    "device.hbm.peak": (
+        "gauge", "peak device memory observed (same source rules as "
+        "device.hbm.bytes_in_use)"),
+    "device.trace.captures": (
+        "counter", "on-demand jax.profiler traces captured (GET /trace, "
+        "`pathway_tpu trace`)"),
     # telemetry (engine/telemetry.py)
     "telemetry.export.dropped": (
         "counter", "telemetry payloads dropped by the bounded export queue"),

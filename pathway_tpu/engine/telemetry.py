@@ -63,6 +63,17 @@ CHECKPOINT_COMMIT_PREFIX = "checkpoint.commit."
 CHECKPOINT_COMMIT_STAGES = ("buffer", "frame", "hash", "upload", "barrier")
 CHECKPOINT_INFLIGHT_BYTES = "checkpoint.inflight.bytes"
 CHECKPOINT_INFLIGHT_JOBS = "checkpoint.inflight.jobs"
+# device-path observability gauges (pathway_tpu/device/telemetry.py):
+# exported through the unified registry like every family above, so they
+# ride every OTLP metrics sample automatically — named here so the
+# ``/status`` device section (engine/http_server.py), the dashboard
+# footer (internals/monitoring.py) and `pathway_tpu top` agree on one
+# spelling with the exporter
+DEVICE_SECTION_PREFIX = "device."
+DEVICE_UTILIZATION = "device.utilization"
+DEVICE_PADDING_WASTE_FRACTION = "device.padding.waste.fraction"
+DEVICE_HBM_BYTES_IN_USE = "device.hbm.bytes_in_use"
+DEVICE_HBM_PEAK = "device.hbm.peak"
 
 LOCAL_DEV_NAMESPACE = "local-dev"
 

@@ -221,6 +221,20 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
     _k("PATHWAY_DEVICE_DONATE", "str", "auto",
        "donate padded input buffers to jitted device calls: `auto` "
        "(backends with donation support), `on`, `off`", "executor"),
+    _k("PATHWAY_DEVICE_COST_ANALYSIS", "bool", True,
+       "capture XLA cost_analysis/memory_analysis per compile-cache key "
+       "(AOT compile path) feeding device.flops.total / "
+       "device.utilization; `0` falls back to plain jit dispatch with "
+       "uncosted accounting", "executor"),
+    _k("PATHWAY_DEVICE_PEAK_FLOPS", "float", None,
+       "per-device peak FLOP/s for the roofline utilization estimate "
+       "(default: auto-detected from the device kind; the CPU rig gets "
+       "a measured-peak default so the layer is testable without a TPU)",
+       "executor"),
+    _k("PATHWAY_DEVICE_TRACE_DIR", "str", None,
+       "base directory for on-demand jax.profiler traces (`GET "
+       "/trace?seconds=N` on the monitoring HTTP server, `pathway_tpu "
+       "trace`); unset disables capture", "executor"),
     # -- devices (parallel/mesh.py, internals/runner.py) --------------------
     _k("PATHWAY_JAX_DISTRIBUTED", "bool", False,
        "form a multi-host JAX device mesh too (`spawn "

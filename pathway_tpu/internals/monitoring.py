@@ -158,6 +158,25 @@ class StatsMonitor:
                 f"jit: {int(compiles)} compile(s) / "
                 f"{int(total('jax.cache.miss'))} cache miss(es)"
             )
+        from pathway_tpu.engine.telemetry import (
+            DEVICE_PADDING_WASTE_FRACTION,
+            DEVICE_UTILIZATION,
+        )
+
+        batches = total("device.dispatch.batches")
+        if batches:
+            # the device story in one clause: how busy, how wasteful —
+            # the full panel lives in `pathway_tpu top`
+            device = f"device: {int(batches)} batch(es)"
+            util = peak(DEVICE_UTILIZATION)
+            if util:
+                from pathway_tpu.device.telemetry import format_utilization
+
+                device += f", {format_utilization(util)} of peak"
+            waste = peak(DEVICE_PADDING_WASTE_FRACTION)
+            if waste:
+                device += f", {waste:.1%} padding"
+            parts.append(device)
         frames = total("comm.frames.sent")
         if frames:
             mb = total("comm.bytes.sent") / (1 << 20)

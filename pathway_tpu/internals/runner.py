@@ -352,6 +352,18 @@ def run(
             lambda: profiler.crash_snapshot(scope)
         )
 
+        # device observability (pathway_tpu/device/telemetry.py): every
+        # flight-recorder dump carries the final DeviceExecutor snapshot
+        # (cost/utilization/padding/HBM/queue) — post-mortems say what
+        # the device was doing.  The supplier never instantiates an
+        # executor: a run that never touched the device path dumps no
+        # device section
+        from pathway_tpu.device.executor import default_executor_snapshot
+
+        _blackbox.get_recorder().set_device_supplier(
+            default_executor_snapshot
+        )
+
         # data-plane observability (engine/freshness.py): ingest-time
         # low-watermark propagation (per-output e2e latency + staleness)
         # and backlog.* backpressure attribution — the "where records
@@ -449,6 +461,12 @@ def run(
             from pathway_tpu.engine import flight_recorder as _blackbox
 
             _blackbox.get_recorder().set_freshness_supplier(None)
+        # the device supplier references only the process-global executor
+        # (no run state), but clearing it keeps the recorder's lifetime
+        # contract uniform across all three suppliers
+        from pathway_tpu.engine import flight_recorder as _blackbox_dev
+
+        _blackbox_dev.get_recorder().set_device_supplier(None)
         if worker_ctx is not None:
             worker_ctx.close()
         if result.telemetry is not None:

@@ -10,6 +10,9 @@ or ``PATHWAY_MONITORING_HTTP_PORT``) and renders, per refresh:
   latency quantiles (``engine/freshness.py``);
 * backlog — every ``backlog.*`` wait point, ranked worst-first, so the
   bottleneck stage reads off the top line;
+* device — the DeviceExecutor panel (``pathway_tpu/device/``): dispatch
+  rate, queue depth/age, compile-cache cold/warm discipline, padding
+  waste, roofline utilization and HBM use;
 * operators — the per-operator progress table of the ``/status`` body.
 
 Pure functions (`render_top`) are separated from I/O (`fetch_status`) so
@@ -27,10 +30,6 @@ from pathway_tpu.engine.metrics import split_labeled_name
 class StatusUnavailable(RuntimeError):
     """The monitoring endpoint could not be reached or parsed — rendered
     by the CLI as a clear non-zero exit, never a traceback."""
-
-
-def status_url(port: int, host: str = "127.0.0.1") -> str:
-    return f"http://{host}:{port}/status"
 
 
 def fetch_status(url: str, timeout: float = 2.0) -> dict[str, Any]:
@@ -145,6 +144,71 @@ def render_top(
                 else ""
             )
             lines.append(f"  {base + label_str:<44} {value:>12g}")
+
+    device = status.get("device") or {}
+    if device:
+        lines.append("")
+        lines.append("device")
+        batches = device.get("device.dispatch.batches") or 0.0
+        row = f"  dispatch {int(batches)} batch(es)"
+        if prev is not None and interval_s:
+            prev_batches = (prev.get("device") or {}).get(
+                "device.dispatch.batches"
+            ) or 0.0
+            row += f" ({max(0.0, batches - prev_batches) / interval_s:.1f}/s)"
+        rows = device.get("device.dispatch.rows")
+        if rows is not None:
+            row += f" · {int(rows)} row(s)"
+        p95 = device.get("device.dispatch.ms.p95")
+        if p95 is not None:
+            row += f" · dispatch p95 {p95:.2f} ms"
+        lines.append(row)
+        backlog_all = status.get("backlog") or {}
+        queue = backlog_all.get("backlog.device.queue")
+        if queue is not None:
+            lines.append(
+                f"  queue {int(queue)} job(s) · "
+                f"{backlog_all.get('backlog.device.bytes', 0.0):.0f} B in "
+                "flight · oldest "
+                f"{backlog_all.get('backlog.device.age.s', 0.0):.2f} s"
+            )
+        cold = device.get("device.cache.cold")
+        warmed = device.get("device.warmup.compiles")
+        if cold is not None or warmed is not None:
+            # after a full warmup, nonzero cold is a discipline bug — the
+            # panel puts it next to the jit accounting that pins it
+            cache = f"  cache: cold {int(cold or 0)} / warmed {int(warmed or 0)}"
+            misses = device.get("jax.cache.miss")
+            if misses is not None:
+                cache += (
+                    f" · jit {int(device.get('jax.compile.count') or 0)} "
+                    f"compile(s) / {int(misses)} cache miss(es)"
+                )
+            lines.append(cache)
+        waste = device.get("device.padding.waste.fraction")
+        if waste is not None:
+            lines.append(
+                f"  padding waste {waste:.1%} "
+                f"({int(device.get('device.padding.waste.rows') or 0)} pad "
+                "row(s)) — replay with `pathway_tpu buckets`"
+            )
+        util = device.get("device.utilization")
+        if util is not None:
+            from pathway_tpu.device.telemetry import format_utilization
+
+            lines.append(
+                f"  utilization {format_utilization(util)} of "
+                f"{device.get('device.peak.flops_per_s') or 0.0:.3g} FLOP/s "
+                f"peak · achieved "
+                f"{device.get('device.achieved.flops_per_s') or 0.0:.3g} "
+                "FLOP/s"
+            )
+        hbm = device.get("device.hbm.bytes_in_use")
+        if hbm is not None:
+            lines.append(
+                f"  hbm {hbm / (1 << 20):.1f} MiB in use · peak "
+                f"{(device.get('device.hbm.peak') or 0.0) / (1 << 20):.1f} MiB"
+            )
 
     operators = status.get("operators") or {}
     if operators:

@@ -72,6 +72,31 @@ def test_bucket_policy_oversized_batch_splits():
     assert {c.bucket for c in chunks} <= declared
 
 
+def test_bucket_policy_explicit_sizes_form():
+    """The `pathway_tpu buckets` suggestion must be applicable verbatim:
+    an explicit-size policy rounds to the declared sizes, warms exactly
+    them, and splits against the largest."""
+    p = BucketPolicy(sizes=(19, 3))
+    assert p.buckets() == (3, 19)
+    assert p.bucket_for(1) == 3
+    assert p.bucket_for(4) == 19
+    assert p.bucket_for(19) == 19
+    # 40 rows over largest 19: chunks 19+19+2 -> remainder bucket 3
+    assert [(c.count, c.bucket) for c in p.plan(40)] == [
+        (19, 19), (19, 19), (2, 3),
+    ]
+    with pytest.raises(ValueError):
+        BucketPolicy(sizes=())
+    with pytest.raises(ValueError):
+        BucketPolicy(sizes=(0, 4))
+    # dispatch end-to-end on the explicit set: only declared buckets compile
+    ex = DeviceExecutor(collector_name=None)
+    ex.register("sized", lambda x: jnp.sum(x, axis=1), policy=BucketPolicy(sizes=(3, 19)))
+    ex.run_batch("sized", (np.ones((5, 2), np.float32),))
+    ex.run_batch("sized", (np.ones((2, 2), np.float32),))
+    assert ex.stats("sized")["keys"] == 2  # buckets 19 and 3
+
+
 def test_bucket_policy_refuses_empty_and_misfits():
     p = BucketPolicy(max_bucket=8)
     with pytest.raises(ValueError):
@@ -176,6 +201,36 @@ def test_static_args_extend_the_cache_key():
     ex.run_batch("topk", (rows,), static={"k": 3})
     ex.run_batch("topk", (rows,), static={"k": 2})  # warm
     assert ex.stats("topk")["keys"] == 2
+
+
+def test_padding_waste_pin_batch_of_one():
+    """ISSUE 12 bucket edge case: a lone row on a min_bucket=8 policy is
+    7/8 waste — the fraction gauge must say exactly that."""
+    ex = DeviceExecutor(collector_name=None)
+    ex.register(
+        "one",
+        lambda x: jnp.sum(x, axis=1),
+        policy=BucketPolicy(min_bucket=8, max_bucket=8),
+    )
+    ex.run_batch("one", (np.ones((1, 4), np.float32),))
+    snap = ex.metrics_snapshot()
+    assert snap["device.padding.waste.rows"] == 7.0
+    assert snap["device.padding.waste.fraction"] == pytest.approx(7.0 / 8.0)
+
+
+def test_padding_waste_pin_oversize_split():
+    """ISSUE 12 bucket edge case: 19 rows over max bucket 8 plans
+    8+8+3→4; only the remainder chunk pads (1 row), so waste is 1/20 of
+    dispatched rows — and every bucket's occupancy was observed."""
+    ex = _rowwise_executor(max_bucket=8)
+    ex.run_batch("rowsum", (np.ones((19, 4), np.float32),))
+    snap = ex.metrics_snapshot()
+    assert snap["device.padding.waste.rows"] == 1.0
+    assert snap["device.padding.waste.fraction"] == pytest.approx(1.0 / 20.0)
+    hist = em.get_registry().histogram(
+        "device.bucket.occupancy", buckets=em.OCCUPANCY_BUCKETS
+    )
+    assert hist.quantile(0.99) is not None
 
 
 def test_rerun_registration_resets_the_ledger():
