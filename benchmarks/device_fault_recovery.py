@@ -1,0 +1,236 @@
+"""Device fault-tolerance overhead + recovery-latency benchmark.
+
+ISSUE 13 wraps every DeviceExecutor dispatch in the typed-failure
+contract (classify → retry → breaker → fallback, ``device/resilience.py``).
+The wrapper must be invisible on the happy path: its steady-state cost is
+one breaker ``admit()`` (a lock + two compares), a try/except frame, and
+a per-chunk ledger — priced here as the same warmed dispatch loop with
+the rail ON vs ``PATHWAY_DEVICE_RESILIENCE=0`` (raw PR-11 dispatch),
+interleaved ON/OFF/OFF/ON so rig drift cancels.
+
+Acceptance (ISSUE 13): happy-path overhead of the classification/retry
+wrapper ≤ 2 % of dispatch cost.  Like PR 4's telemetry_overhead, the
+end-to-end A/B delta sits below this rig's noise floor (passes swing
+tens of µs between identical runs), so the binding number comes from a
+**microbench** that stubs the device call out entirely: the same
+run_batch path with a no-op dispatch, rail ON vs OFF, leaves ONLY the
+wrapper's Python cost — admit + record_success + the retry frame +
+ledger routing — measured at sub-µs resolution.
+
+The second quantity is the degraded path itself: how long a breaker trip
+takes end to end (the dispatch that eats the device failure, trips, and
+serves the same batch from the un-jitted host fallback) and the
+steady-state latency of an open-breaker fallback dispatch — the latency
+floor a device outage degrades to.
+
+Usage: ``python benchmarks/device_fault_recovery.py [smoke|full]``
+Prints one JSON line per metric (harness.py protocol).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+
+def _build_executor(resilience: bool, max_bucket: int = 64):
+    import jax.numpy as jnp
+
+    from pathway_tpu.device import BucketPolicy, DeviceExecutor
+
+    os.environ["PATHWAY_DEVICE_RESILIENCE"] = "1" if resilience else "0"
+    try:
+        ex = DeviceExecutor(collector_name=None)
+        ex.register(
+            "fault:rowsum",
+            lambda x: jnp.sum(x * x, axis=1),
+            policy=BucketPolicy(max_bucket=max_bucket),
+        )
+        ex.warmup("fault:rowsum", row_shapes=((64,),), dtypes=(np.float32,))
+    finally:
+        os.environ.pop("PATHWAY_DEVICE_RESILIENCE", None)
+    return ex
+
+
+def _one_pass_us(ex, batches: list[np.ndarray]) -> float:
+    """Per-dispatch wall time of one warmed run_batch pass (µs)."""
+    t0 = time.perf_counter()
+    for x in batches:
+        ex.run_batch("fault:rowsum", (x,))
+    return (time.perf_counter() - t0) / len(batches) * 1e6
+
+
+def _paired_delta_us(
+    ex, batches: list[np.ndarray], reps: int
+) -> tuple[float, float, float]:
+    """(median ON µs, median OFF µs, median paired ON−OFF delta µs).
+
+    The wrapper costs ~1 µs against a dispatch that costs hundreds, so
+    ONE executor (one compiled executable — a second executor's separate
+    XLA compile can differ by more than the effect being measured) is
+    toggled via ``set_resilience`` in an ON/OFF/OFF/ON sandwich *per
+    rep* and differenced pairwise — rig drift cancels inside each
+    sandwich instead of accumulating across arms."""
+    on_times: list[float] = []
+    off_times: list[float] = []
+    deltas: list[float] = []
+    for _ in range(reps):
+        ex.set_resilience(True)
+        a = _one_pass_us(ex, batches)
+        ex.set_resilience(False)
+        b = _one_pass_us(ex, batches)
+        c = _one_pass_us(ex, batches)
+        ex.set_resilience(True)
+        d = _one_pass_us(ex, batches)
+        on_times.extend((a, d))
+        off_times.extend((b, c))
+        deltas.append((a + d) / 2.0 - (b + c) / 2.0)
+    on_times.sort()
+    off_times.sort()
+    deltas.sort()
+    return (
+        on_times[len(on_times) // 2],
+        off_times[len(off_times) // 2],
+        deltas[len(deltas) // 2],
+    )
+
+
+def _wrapper_microbench_us(ex, batches: list[np.ndarray], reps: int) -> float:
+    """Median per-dispatch Python cost of the resilience rail alone.
+
+    The device call is stubbed to a shape-correct no-op, so ON−OFF
+    differences the wrapper and nothing else — the XLA/rig noise that
+    swamps the end-to-end A/B never enters."""
+    real = ex._dispatch_fixed
+    ex._dispatch_fixed = (
+        lambda entry, operands, arrays, static, warmup=False: np.zeros(
+            (arrays[0].shape[0],), np.float32
+        )
+    )
+    try:
+        deltas = []
+        for _ in range(reps):
+            ex.set_resilience(True)
+            a = _one_pass_us(ex, batches)
+            ex.set_resilience(False)
+            b = _one_pass_us(ex, batches)
+            c = _one_pass_us(ex, batches)
+            ex.set_resilience(True)
+            d = _one_pass_us(ex, batches)
+            deltas.append((a + d) / 2.0 - (b + c) / 2.0)
+        deltas.sort()
+        return max(0.0, deltas[len(deltas) // 2])
+    finally:
+        ex._dispatch_fixed = real
+        ex.set_resilience(True)
+
+
+def _trip_and_fallback_ms(reps: int) -> tuple[float, float]:
+    """(median breaker trip→fallback latency, median steady open-breaker
+    fallback dispatch), both ms.  Each rep uses a fresh executor and a
+    seeded one-shot ``device_error`` plan with threshold 1: the measured
+    call pays failure detection + trip + the host-fallback execution."""
+    import jax.numpy as jnp
+
+    from pathway_tpu.device import BucketPolicy, DeviceExecutor
+    from pathway_tpu.engine import faults
+
+    os.environ["PATHWAY_DEVICE_BREAKER_THRESHOLD"] = "1"
+    os.environ["PATHWAY_DEVICE_RETRIES"] = "0"
+    os.environ["PATHWAY_DEVICE_BREAKER_COOLDOWN_S"] = "3600"
+    trip_times: list[float] = []
+    fallback_times: list[float] = []
+    rows = np.random.default_rng(13).normal(size=(16, 64)).astype(np.float32)
+    try:
+        for _ in range(reps):
+            ex = DeviceExecutor(collector_name=None)
+            ex.register(
+                "fault:rowsum",
+                lambda x: jnp.sum(x * x, axis=1),
+                policy=BucketPolicy(max_bucket=64),
+            )
+            ex.warmup(
+                "fault:rowsum", row_shapes=((64,),), dtypes=(np.float32,)
+            )
+            faults.install_plan(
+                faults.FaultPlan(
+                    [{"kind": "device_error", "source": "fault:rowsum",
+                      "nth": 1}],
+                    seed=13,
+                )
+            )
+            t0 = time.perf_counter()
+            ex.run_batch("fault:rowsum", (rows,))  # fails, trips, falls back
+            trip_times.append((time.perf_counter() - t0) * 1e3)
+            faults.clear_plan()
+            # breaker is open (cooldown 1 h): steady fallback dispatches
+            t0 = time.perf_counter()
+            for _ in range(8):
+                ex.run_batch("fault:rowsum", (rows,))
+            fallback_times.append((time.perf_counter() - t0) / 8 * 1e3)
+    finally:
+        faults.clear_plan()
+        for knob in (
+            "PATHWAY_DEVICE_BREAKER_THRESHOLD",
+            "PATHWAY_DEVICE_RETRIES",
+            "PATHWAY_DEVICE_BREAKER_COOLDOWN_S",
+        ):
+            os.environ.pop(knob, None)
+    trip_times.sort()
+    fallback_times.sort()
+    return (
+        trip_times[len(trip_times) // 2],
+        fallback_times[len(fallback_times) // 2],
+    )
+
+
+def main() -> None:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "smoke"
+    n_batches = 64 if mode == "smoke" else 256
+    reps = 9 if mode == "smoke" else 21
+
+    ex = _build_executor(resilience=True)
+    rng = np.random.default_rng(13)
+    batches = [
+        rng.normal(size=(int(n), 64)).astype(np.float32)
+        for n in rng.integers(1, 65, size=n_batches)
+    ]
+    # prime the path (compiles paid, ledgers allocated)
+    _one_pass_us(ex, batches[:4])
+
+    # the end-to-end arms are reported for context (their DELTA sits
+    # below this rig's noise floor and is deliberately not a metric — a
+    # committed baseline of noise would only gate future PRs on dice)
+    on_us, off_us, _noise = _paired_delta_us(ex, batches, reps)
+
+    # the binding acceptance number: wrapper cost vs real dispatch cost,
+    # with the wrapper isolated by the no-op-dispatch microbench
+    wrapper_us = _wrapper_microbench_us(ex, batches, reps)
+    overhead_pct = (wrapper_us / off_us * 100.0) if off_us else 0.0
+
+    trip_ms, fallback_ms = _trip_and_fallback_ms(
+        reps=5 if mode == "smoke" else 11
+    )
+
+    for name, value in (
+        ("device_fault_on_us", round(on_us, 3)),
+        ("device_fault_off_us", round(off_us, 3)),
+        ("device_fault_wrapper_us", round(wrapper_us, 3)),
+        ("device_fault_overhead_pct", round(overhead_pct, 4)),
+        ("device_fault_trip_to_fallback_ms", round(trip_ms, 3)),
+        ("device_fault_fallback_dispatch_ms", round(fallback_ms, 3)),
+    ):
+        print(json.dumps({"metric": name, "value": value}))
+
+
+if __name__ == "__main__":
+    main()

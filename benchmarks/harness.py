@@ -107,6 +107,13 @@ SUITE: tuple[Bench, ...] = (
     Bench(
         "device_obs_overhead", "device_obs_overhead.py", ("smoke",), ("full",),
     ),
+    # device fault tolerance: happy-path cost of the classify/retry/
+    # breaker wrapper vs the PATHWAY_DEVICE_RESILIENCE kill switch
+    # (≤2% of dispatch cost pin) + breaker trip→host-fallback latency
+    Bench(
+        "device_fault_recovery", "device_fault_recovery.py",
+        ("smoke",), ("full",),
+    ),
 )
 
 MODE_REPS = {"smoke": 3, "full": 3}

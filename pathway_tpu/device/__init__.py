@@ -23,6 +23,17 @@ from pathway_tpu.device.executor import (
     default_executor_snapshot,
     get_default_executor,
 )
+from pathway_tpu.device.resilience import (
+    CircuitBreaker,
+    DeviceCompileError,
+    DeviceDispatchHangError,
+    DeviceJobError,
+    DeviceOOMError,
+    DeviceQuarantinedError,
+    ExecutorClosedError,
+    RetryPolicy,
+    TransientDeviceError,
+)
 from pathway_tpu.device.telemetry import (
     CostAccountant,
     TraceBusy,
@@ -34,11 +45,20 @@ from pathway_tpu.device.telemetry import (
 __all__ = [
     "BatchChunk",
     "BucketPolicy",
+    "CircuitBreaker",
     "CostAccountant",
+    "DeviceCompileError",
+    "DeviceDispatchHangError",
     "DeviceExecutor",
     "DeviceFuture",
+    "DeviceJobError",
+    "DeviceOOMError",
+    "DeviceQuarantinedError",
+    "ExecutorClosedError",
+    "RetryPolicy",
     "TraceBusy",
     "TraceUnavailable",
+    "TransientDeviceError",
     "capture_trace",
     "default_executor_snapshot",
     "get_default_executor",

@@ -114,18 +114,43 @@ class BucketPolicy:
         out.append(self.max_bucket)
         return tuple(out)
 
-    def plan(self, n: int) -> list[BatchChunk]:
-        """Split ``n`` rows into fixed-shape chunks: full ``max_bucket``
+    def next_smaller(self, bucket: int) -> int | None:
+        """The declared bucket just below ``bucket``, or ``None`` when
+        ``bucket`` is already the smallest — the OOM-ratchet step
+        (``executor.py``): a RESOURCE_EXHAUSTED chunk drops one bucket
+        and retries at the reduced footprint."""
+        below = [b for b in self.buckets() if b < bucket]
+        return below[-1] if below else None
+
+    def floor_bucket(self, cap: int) -> int:
+        """The largest declared bucket ≤ ``cap`` (the smallest declared
+        bucket when none fits) — clamps an OOM-ratcheted cap onto the
+        declared set so capped planning never emits an unwarmed shape."""
+        declared = self.buckets()
+        fitting = [b for b in declared if b <= cap]
+        return fitting[-1] if fitting else declared[0]
+
+    def plan(self, n: int, *, cap: int | None = None) -> list[BatchChunk]:
+        """Split ``n`` rows into fixed-shape chunks: full largest-bucket
         chunks first, then one bucketed remainder.  Every chunk's bucket
-        is from :meth:`buckets`, so a warmed callable never recompiles."""
+        is from :meth:`buckets`, so a warmed callable never recompiles.
+
+        ``cap`` (the per-callable OOM ratchet) bounds the largest chunk
+        below ``max_bucket``: under sustained memory pressure the same
+        batch plans into more, smaller chunks instead of one that OOMs."""
         if n < 1:
             raise ValueError("cannot plan an empty batch")
+        largest = self.max_bucket
+        if cap is not None:
+            largest = self.floor_bucket(min(cap, self.max_bucket))
         chunks: list[BatchChunk] = []
         start = 0
-        while n - start > self.max_bucket:
-            chunks.append(BatchChunk(start, self.max_bucket, self.max_bucket))
-            start += self.max_bucket
+        while n - start > largest:
+            chunks.append(BatchChunk(start, largest, largest))
+            start += largest
         rest = n - start
+        # rest <= largest and largest is declared, so the smallest
+        # declared bucket >= rest can never exceed the cap
         chunks.append(BatchChunk(start, rest, self.bucket_for(rest)))
         return chunks
 

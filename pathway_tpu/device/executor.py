@@ -42,6 +42,21 @@ in PAPERS.md is the model):
    HBM live-bytes fallback — see docs/device_executor.md, "Cost
    accounting & roofline".
 
+5. **Fault tolerance** (``device/resilience.py``) — every dispatch is
+   wrapped in the typed failure classifier: transient XLA errors get
+   bounded jittered retries (the udfs backoff policy), RESOURCE_EXHAUSTED
+   splits the batch onto smaller buckets and ratchets the callable's
+   max-bucket cap (``device.oom.splits``/``device.bucket.cap``), a
+   per-callable circuit breaker trips to the un-jitted **host fallback**
+   after K consecutive failures (``device.breaker.state``,
+   ``device.fallback.*``) with half-open probing, a batch that fails
+   retries AND fallback is quarantined with a typed error to its waiters
+   (``device.quarantine.*``), and a job that blows the hard dispatch
+   deadline fails its waiters while the wedged dispatch thread is torn
+   down and respawned (``device.dispatch.restarts``).  Kill switch:
+   ``PATHWAY_DEVICE_RESILIENCE=0``.  Contract: docs/fault_tolerance.md,
+   "Device-path failures".
+
 ``AsyncMicroBatcher`` (``utils/batching.py``) is the coalescing
 front-end over :meth:`submit`; model code reaches :meth:`run_batch`
 from inside its batch callbacks.  The two layers compose: submit owns
@@ -58,11 +73,13 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
+from pathway_tpu.device import resilience as _res
 from pathway_tpu.device import telemetry as _dtel
 from pathway_tpu.device.bucketing import (
     BucketPolicy,
     pad_batch_dim,
 )
+from pathway_tpu.engine import flight_recorder as _blackbox
 from pathway_tpu.engine import metrics as _metrics
 
 __all__ = [
@@ -101,7 +118,13 @@ class DeviceFuture:
         return self._event.is_set()
 
     def set_result(self, value: Any) -> None:
+        """Resolve once; a second resolution is ignored — an abandoned
+        (hang-escalated) job that eventually completes on its zombie
+        thread must not overwrite the typed error its waiters already
+        consumed."""
         with self._lock:
+            if self._event.is_set():
+                return
             self._result = value
             self._event.set()
             callbacks, self._callbacks = self._callbacks, []
@@ -110,6 +133,8 @@ class DeviceFuture:
 
     def set_exception(self, exc: BaseException) -> None:
         with self._lock:
+            if self._event.is_set():
+                return
             self._exc = exc
             self._event.set()
             callbacks, self._callbacks = self._callbacks, []
@@ -154,17 +179,44 @@ _COMPILE_WAIT_S = 300.0
 
 
 class _Registered:
-    """One registered traceable: its jit wrapper + compile-key ledger."""
+    """One registered traceable: its jit wrapper + compile-key ledger +
+    resilience state (breaker, retry policy, OOM bucket cap)."""
 
     __slots__ = (
         "name", "jitted", "policy", "seen_keys", "dispatches", "cold",
         "warmed", "lock", "cv", "compiled", "costs",
+        "fn", "host_fallback", "breaker", "retry", "bucket_cap",
+        "oom_splits", "fallback_batches", "failure_counts",
     )
 
-    def __init__(self, name: str, jitted: Callable, policy: BucketPolicy):
+    def __init__(
+        self,
+        name: str,
+        jitted: Callable,
+        policy: BucketPolicy,
+        *,
+        fn: Callable | None = None,
+        host_fallback: Callable | None = None,
+        breaker: "_res.CircuitBreaker | None" = None,
+        retry: "_res.RetryPolicy | None" = None,
+    ):
         self.name = name
         self.jitted = jitted
         self.policy = policy
+        # the raw (un-jitted) callable: the host-fallback path executes
+        # it eagerly on the SAME padded buffers, so a tripped breaker
+        # serves bit-equivalent results from the CPU
+        self.fn = fn
+        self.host_fallback = host_fallback if host_fallback is not None else fn
+        self.breaker = breaker
+        self.retry = retry
+        # OOM ratchet: the largest bucket this callable may still plan
+        # (None = uncapped).  Only ever shrinks — sustained memory
+        # pressure reduces footprint instead of crash-looping.
+        self.bucket_cap: int | None = None
+        self.oom_splits = 0
+        self.fallback_batches = 0
+        self.failure_counts: dict[str, int] = {}
         self.seen_keys: set[tuple] = set()
         # key -> AOT-compiled executable / compile-time cost dict
         # (device/telemetry.py): the fresh-key path compiles through
@@ -192,7 +244,10 @@ class _Registered:
 class _Job:
     """One queued host-side batch job (the submit path)."""
 
-    __slots__ = ("name", "fn", "future", "nbytes", "enqueued_at")
+    __slots__ = (
+        "name", "fn", "future", "nbytes", "enqueued_at", "started_at",
+        "abandoned", "finalized",
+    )
 
     def __init__(self, name: str, fn: Callable[[], Any], nbytes: int):
         self.name = name
@@ -200,6 +255,15 @@ class _Job:
         self.future = DeviceFuture()
         self.nbytes = max(0, int(nbytes))
         self.enqueued_at = time.monotonic()
+        # set by the dispatch loop when the job starts running — the
+        # hang watchdog measures the dispatch deadline from here
+        self.started_at: float | None = None
+        # set by the hang escalation: the (wedged) thread running this
+        # job has been written off; its eventual completion is ignored
+        self.abandoned = False
+        # in-flight byte accounting settled exactly once, whether by the
+        # dispatch loop, the hang escalation, or close()
+        self.finalized = False
 
 
 def _donation_enabled() -> bool:
@@ -239,6 +303,8 @@ class DeviceExecutor:
         self._default_max_batch = int(env_int("PATHWAY_DEVICE_MAX_BATCH"))
         self.max_inflight_bytes = int(float(max_inflight_mb) * 1024 * 1024)
         self.max_inflight_requests = int(max_inflight_requests)
+        from pathway_tpu.internals.config import env_bool
+
         self._callables: dict[str, _Registered] = {}
         self._queue: list[_Job] = []
         self._running: _Job | None = None
@@ -246,6 +312,23 @@ class DeviceExecutor:
         self._cond = threading.Condition()
         self._thread: threading.Thread | None = None
         self._stop = False
+        self._closed = False
+        # bumped on every dispatch-thread (re)spawn: a loop whose gen is
+        # superseded (hang escalation wrote it off) exits instead of
+        # delivering into a queue a fresh thread now owns
+        self._thread_gen = 0
+        self._watchdog: threading.Thread | None = None
+        # resilience rail (device/resilience.py): kill switch + the hard
+        # per-job dispatch deadline (0 = hang escalation disabled)
+        self._resilience = env_bool("PATHWAY_DEVICE_RESILIENCE")
+        self._dispatch_deadline_s = float(
+            env_float("PATHWAY_DEVICE_DISPATCH_DEADLINE_S") or 0.0
+        )
+        # never-set event: timed waits against it implement interruptible
+        # retry backoff (close() sets it so shutdown never waits out a
+        # backoff schedule)
+        self._retry_interrupt = threading.Event()
+        self._quarantine = _res.QuarantineLog.from_env()
         reg = _metrics.get_registry()
         self._m_batches = reg.counter(
             "device.dispatch.batches", "fixed-shape device batches dispatched"
@@ -284,6 +367,43 @@ class DeviceExecutor:
             "real-row fraction of each dispatched bucket (1.0 = no padding)",
             buckets=_metrics.OCCUPANCY_BUCKETS,
         )
+        # fault-tolerance counters (device/resilience.py)
+        self._m_retries = reg.counter(
+            "device.retry.attempts",
+            "transient device failures retried by the dispatch wrapper",
+        )
+        self._m_oom_splits = reg.counter(
+            "device.oom.splits",
+            "RESOURCE_EXHAUSTED chunks split onto smaller buckets",
+        )
+        self._m_breaker_trips = reg.counter(
+            "device.breaker.trips",
+            "circuit-breaker open transitions (K consecutive device "
+            "failures, or a failed half-open probe)",
+        )
+        self._m_fb_batches = reg.counter(
+            "device.fallback.batches",
+            "batches served by the un-jitted host-fallback path",
+        )
+        self._m_fb_rows = reg.counter(
+            "device.fallback.rows", "real rows served by the host fallback"
+        )
+        self._m_fb_ms = reg.histogram(
+            "device.fallback.ms",
+            "wall time of one host-fallback batch execution (ms)",
+            buckets=_metrics.MS_BUCKETS,
+        )
+        self._m_quarantine = reg.counter(
+            "device.quarantine.batches",
+            "poisoned batches quarantined (device retries AND host "
+            "fallback failed)",
+        )
+        self._m_restarts = reg.counter(
+            "device.dispatch.restarts",
+            "dispatch threads torn down and respawned after a hard "
+            "dispatch-deadline hang",
+        )
+        self._reg = reg
         # device-path cost ledger (device/telemetry.py): compile-time XLA
         # cost analysis x dispatch durations -> flops totals, roofline
         # utilization, and the batch-size distribution `pathway_tpu
@@ -312,6 +432,7 @@ class DeviceExecutor:
         static_argnames: Sequence[str] = (),
         donate_argnums: Sequence[int] = (),
         policy: BucketPolicy | None = None,
+        host_fallback: Callable | None = None,
     ) -> str:
         """Register traceable ``fn`` under ``name`` and jit it ONCE.
 
@@ -320,13 +441,29 @@ class DeviceExecutor:
         name the array positions safe to donate (fresh padded buffers);
         donation is applied only where the backend implements it (see
         ``PATHWAY_DEVICE_DONATE``).  Re-registering a name replaces the
-        callable and resets its compile ledger."""
+        callable and resets its compile ledger.
+
+        ``host_fallback`` overrides the CPU path a tripped circuit
+        breaker routes to; the default is ``fn`` itself executed
+        un-jitted on the same padded buffers (bit-equivalent by the
+        padding-mask contract).  Resilience state (breaker, retry
+        policy) is created from the ``PATHWAY_DEVICE_*`` knobs at
+        registration time; ``PATHWAY_DEVICE_RESILIENCE=0`` at executor
+        construction disables the whole rail."""
         if policy is None:
             from pathway_tpu.internals.config import env_int
 
             policy = BucketPolicy(max_bucket=env_int("PATHWAY_DEVICE_MAX_BATCH"))
         jitted = self._jit_wrap(fn, tuple(static_argnames), tuple(donate_argnums))
-        self._callables[name] = _Registered(name, jitted, policy)
+        self._callables[name] = _Registered(
+            name,
+            jitted,
+            policy,
+            fn=fn,
+            host_fallback=host_fallback,
+            breaker=_res.CircuitBreaker.from_env() if self._resilience else None,
+            retry=_res.RetryPolicy.from_env() if self._resilience else None,
+        )
         return name
 
     def _jit_wrap(
@@ -343,6 +480,20 @@ class DeviceExecutor:
         if donate_argnums and _donation_enabled():
             kwargs["donate_argnums"] = donate_argnums
         return jax.jit(fn, **kwargs)
+
+    def set_resilience(self, on: bool) -> None:
+        """Toggle the fault-tolerance rail at runtime — the benchmark /
+        test lever mirroring ``metrics.set_enabled``.  Turning it off
+        bypasses routing only (breaker state, caps and ledgers are
+        kept); turning it on creates resilience state for callables
+        registered while it was off."""
+        self._resilience = bool(on)
+        if on:
+            for entry in self._callables.values():
+                if entry.breaker is None:
+                    entry.breaker = _res.CircuitBreaker.from_env()
+                if entry.retry is None:
+                    entry.retry = _res.RetryPolicy.from_env()
 
     def registered(self, name: str) -> bool:
         return name in self._callables
@@ -511,6 +662,10 @@ class DeviceExecutor:
                 self._live_peak = max(self._live_peak, self._live_bytes)
         t0 = time.monotonic()
         try:
+            # fault injection sits INSIDE the dispatch so an injected
+            # failure flows through the same classify/retry/breaker
+            # machinery a real XLA error would (engine/faults.py)
+            self._maybe_inject_failure(entry.name)
             if compiled is not None:
                 # statics are baked into the AOT executable at lowering
                 out = compiled(*operands, *arrays)
@@ -527,6 +682,300 @@ class DeviceExecutor:
         self._m_batches.inc()
         self._accountant.record_dispatch(cost, duration)
         return out
+
+    # -- fault classification, retry, fallback, quarantine --------------------
+
+    def _maybe_inject_failure(self, name: str) -> None:
+        """``device_error`` / ``device_oom`` / ``device_compile_fail``
+        fault injection (``engine/faults.py``): raised HERE, inside the
+        dispatch, so injected failures take the exact classify / retry /
+        breaker / fallback path real XLA failures do."""
+        from pathway_tpu.engine import faults
+
+        plan = faults.active_plan()
+        if plan is None:
+            return
+        if plan.check("device_error", source=name) is not None:
+            raise _res.InjectedDeviceError(
+                f"INTERNAL: injected transient device failure ({name})"
+            )
+        if plan.check("device_oom", source=name) is not None:
+            raise _res.InjectedDeviceError(
+                f"RESOURCE_EXHAUSTED: injected device OOM ({name})"
+            )
+        if plan.check("device_compile_fail", source=name) is not None:
+            raise _res.InjectedDeviceError(
+                f"injected XLA compilation failure ({name})"
+            )
+
+    def _count_failure(
+        self, entry: _Registered, kind: str, exc: BaseException
+    ) -> None:
+        with entry.lock:
+            entry.failure_counts[kind] = entry.failure_counts.get(kind, 0) + 1
+        self._reg.counter(
+            "device.failures",
+            "classified device-path failures observed (kind label)",
+            kind=kind,
+        ).inc()
+        _blackbox.record(
+            "device.failure",
+            callable=entry.name,
+            failure=kind,
+            error=str(exc)[:200],
+        )
+
+    def _dispatch_with_retry(
+        self,
+        entry: _Registered,
+        operands: tuple,
+        arrays: tuple,
+        static: dict[str, Any] | None,
+        *,
+        warmup: bool = False,
+    ) -> Any:
+        """One fixed-shape dispatch under the typed-failure contract:
+        non-device exceptions propagate raw (a deterministic host bug
+        must not be retried into invisibility); device failures are
+        classified, counted, and — for transients only — retried on the
+        bounded jittered udfs backoff schedule, capped by the retry
+        deadline."""
+        retry = entry.retry
+        # the schedule is materialized lazily, on the FIRST failure: the
+        # happy path must not pay a strategy object + generator per
+        # dispatch (the ≤2%-of-dispatch-cost pin,
+        # benchmarks/device_fault_recovery.py)
+        delays = None
+        deadline = 0.0
+        attempt = 0
+        while True:
+            try:
+                return self._dispatch_fixed(
+                    entry, operands, arrays, static, warmup=warmup
+                )
+            except Exception as exc:  # noqa: BLE001 - classified below
+                typed = _res.classify(exc)
+                if typed is None:
+                    raise  # host bug, not a device failure
+                self._count_failure(entry, typed.kind, exc)
+                if typed is exc:
+                    raise  # already typed by a nested layer
+                if retry is None or typed.kind != "transient":
+                    raise typed from exc
+                if delays is None:
+                    delays = retry.delays()
+                    deadline = time.monotonic() + retry.deadline_s
+                attempt += 1
+                remaining = deadline - time.monotonic()
+                if attempt > retry.retries or remaining <= 0:
+                    raise typed from exc
+                self._m_retries.inc()
+                # interruptible timed wait (never a bare sleep): close()
+                # sets the event so shutdown never waits out a backoff
+                self._retry_interrupt.wait(
+                    timeout=min(next(delays), max(0.0, remaining))
+                )
+                if self._closed:
+                    raise _res.ExecutorClosedError(
+                        "device executor closed during retry backoff"
+                    ) from exc
+
+    def _ratchet(
+        self, entry: _Registered, cap: int, exc: BaseException
+    ) -> None:
+        """OOM graceful degradation: shrink the callable's max-bucket
+        cap (only ever downward) so sustained memory pressure reduces
+        device footprint instead of crash-looping."""
+        with entry.lock:
+            entry.bucket_cap = (
+                cap if entry.bucket_cap is None else min(entry.bucket_cap, cap)
+            )
+            entry.oom_splits += 1
+            new_cap = entry.bucket_cap
+        self._m_oom_splits.inc()
+        self._reg.gauge(
+            "device.bucket.cap",
+            "largest bucket a callable may plan after OOM ratcheting",
+            callable=entry.name,
+        ).set(float(new_cap))
+        _blackbox.record(
+            "device.oom.ratchet",
+            callable=entry.name,
+            cap=new_cap,
+            error=str(exc)[:200],
+        )
+
+    def _run_host_fallback(
+        self,
+        entry: _Registered,
+        operands: tuple,
+        padded: tuple,
+        static: dict[str, Any] | None,
+    ) -> Any:
+        """Un-jitted CPU execution of the registered callable on the
+        SAME padded buffers — the padding-mask contract that makes
+        bucketing correct also makes this bit-equivalent."""
+        fb = entry.host_fallback
+        if fb is None:
+            raise RuntimeError(
+                f"no host fallback registered for {entry.name!r}"
+            )
+        t0 = time.monotonic()
+        out = fb(*operands, *padded, **(static or {}))
+        if _HAVE_JAX:
+            out = jax.tree_util.tree_map(np.asarray, out)
+        self._m_fb_ms.observe((time.monotonic() - t0) * 1000.0)
+        return out
+
+    def _quarantine_batch(
+        self,
+        entry: _Registered,
+        padded: tuple,
+        count: int,
+        device_exc: BaseException | None,
+        fallback_exc: BaseException,
+    ) -> None:
+        record = self._quarantine.add(
+            entry.name, count, padded, device_exc, fallback_exc
+        )
+        self._m_quarantine.inc()
+        _blackbox.record(
+            "device.quarantine",
+            callable=entry.name,
+            rows=count,
+            device_error=record["device_error"],
+            fallback_error=record["fallback_error"],
+        )
+
+    def _ledger(self, count: int, bucket: int) -> None:
+        """Padding/occupancy accounting for one chunk that actually
+        served (device or fallback) at ``bucket``."""
+        self._m_rows.inc(count)
+        self._m_pad.inc(bucket - count)
+        self._m_occupancy.observe(count / bucket)
+        # locked: run_batch is legal from epoch, serving, and dispatch
+        # threads concurrently, and an unguarded += would lose increments
+        # and understate padding waste
+        with self._mem_lock:
+            self._real_rows += count
+            self._pad_rows += bucket - count
+
+    def _run_chunk(
+        self,
+        entry: _Registered,
+        operands: tuple,
+        rows: tuple,
+        count: int,
+        bucket: int,
+        static: dict[str, Any] | None,
+    ) -> list[Any]:
+        """Dispatch one planned chunk under the resilience contract;
+        returns the (unpadded) outputs, possibly from several smaller
+        dispatches after an OOM ratchet."""
+        padded = tuple(pad_batch_dim(r, bucket)[0] for r in rows)
+        breaker = entry.breaker if self._resilience else None
+        if breaker is None:
+            # resilience rail off: PR-11 behavior, raw errors to callers
+            out = self._dispatch_fixed(entry, operands, padded, static)
+            self._ledger(count, bucket)
+            return [_slice_rows(out, count)]
+        route = breaker.admit()
+        probe = route == "probe"
+        device_exc: BaseException | None = None
+        if route != "fallback":
+            try:
+                out = self._dispatch_with_retry(entry, operands, padded, static)
+            except _res.ExecutorClosedError:
+                # close() interrupted a retry backoff: not a device
+                # failure — no breaker count, no fallback compute on a
+                # closed executor; the waiter gets the typed closed error
+                if probe:
+                    breaker.abort_probe()
+                raise
+            except _res.DeviceOOMError as exc:
+                smaller = entry.policy.next_smaller(bucket)
+                if smaller is not None:
+                    # the device answered — it is responsive, just out of
+                    # memory: the ratchet (not the breaker) owns this
+                    breaker.record_success(probe=probe)
+                    self._ratchet(entry, smaller, exc)
+                    return self._run_rows(entry, operands, rows, count, static)
+                # already at the smallest bucket: a persistent failure
+                device_exc = exc
+                if breaker.record_failure(probe=probe):
+                    self._on_breaker_trip(entry)
+            except _res.DeviceJobError as exc:
+                device_exc = exc
+                if breaker.record_failure(probe=probe):
+                    self._on_breaker_trip(entry)
+            except BaseException:
+                # a host bug escaping raw (classify() refused to wrap
+                # it): the probe's outcome will never be reported — the
+                # slot must be released or the breaker latches into
+                # permanent fallback with a healthy device
+                if probe:
+                    breaker.abort_probe()
+                raise
+            else:
+                if breaker.record_success(probe=probe):
+                    _blackbox.record(
+                        "device.breaker.close", callable=entry.name
+                    )
+                self._ledger(count, bucket)
+                return [_slice_rows(out, count)]
+        # degraded mode: the un-jitted host path serves this batch
+        try:
+            out = self._run_host_fallback(entry, operands, padded, static)
+        except Exception as exc:  # noqa: BLE001 - the poisoned-batch terminus
+            self._quarantine_batch(entry, padded, count, device_exc, exc)
+            device_part = (
+                f"device failed ({device_exc})"
+                if device_exc is not None
+                else "device not attempted (breaker open)"
+            )
+            raise _res.DeviceQuarantinedError(
+                f"batch quarantined for {entry.name!r}: {device_part}; "
+                f"host fallback failed ({exc})"
+            ) from exc
+        with entry.lock:
+            entry.fallback_batches += 1
+        self._m_fb_batches.inc()
+        self._m_fb_rows.inc(count)
+        self._ledger(count, bucket)
+        return [_slice_rows(out, count)]
+
+    def _on_breaker_trip(self, entry: _Registered) -> None:
+        self._m_breaker_trips.inc()
+        _blackbox.record(
+            "device.breaker.open",
+            callable=entry.name,
+            threshold=entry.breaker.threshold if entry.breaker else 0,
+        )
+
+    def _run_rows(
+        self,
+        entry: _Registered,
+        operands: tuple,
+        arrays: tuple,
+        n_rows: int,
+        static: dict[str, Any] | None,
+    ) -> list[Any]:
+        """Plan ``n_rows`` under the callable's current OOM bucket cap
+        and dispatch every chunk; re-entered when a mid-stream ratchet
+        re-plans a failing chunk at a smaller cap."""
+        outs: list[Any] = []
+        with entry.lock:
+            cap = entry.bucket_cap
+        for chunk in entry.policy.plan(n_rows, cap=cap):
+            rows = tuple(
+                a[chunk.start : chunk.start + chunk.count] for a in arrays
+            )
+            outs.extend(
+                self._run_chunk(
+                    entry, operands, rows, chunk.count, chunk.bucket, static
+                )
+            )
+        return outs
 
     # -- the fixed-shape inline path -----------------------------------------
 
@@ -548,7 +997,25 @@ class DeviceExecutor:
         rows.  Outputs (a single array or a tuple/list of arrays, each
         leading with the batch axis) are unpadded and concatenated back
         to ``n_rows``.  Executes inline on the calling thread — safe
-        from a dispatch-thread job; use :meth:`submit` for async."""
+        from a dispatch-thread job; use :meth:`submit` for async.
+
+        Failure semantics (``device/resilience.py``): transient device
+        errors are retried, OOM splits onto smaller buckets and ratchets
+        the callable's cap, persistent failures trip the per-callable
+        breaker to the host fallback, and a batch that fails device AND
+        fallback raises :class:`DeviceQuarantinedError`.  Host bugs in
+        the callable itself always propagate raw."""
+        if self._closed and not (
+            self._thread is not None
+            and threading.current_thread() is self._thread
+        ):
+            # external callers are refused after close(); the dispatch
+            # thread itself stays admitted so close()'s drain window can
+            # finish queued jobs whose fn routes through run_batch (the
+            # AsyncMicroBatcher path) instead of failing them at the door
+            raise _res.ExecutorClosedError(
+                "run_batch() on a closed device executor"
+            )
         entry = self._callables[name]
         arrays = tuple(np.asarray(a) for a in arrays)
         if n_rows is None:
@@ -562,27 +1029,7 @@ class DeviceExecutor:
                 )
         operands = tuple(operands)
         self._accountant.record_batch(n_rows)
-        chunk_outs: list[Any] = []
-        batch_real = 0
-        batch_pad = 0
-        for chunk in entry.policy.plan(n_rows):
-            padded = tuple(
-                pad_batch_dim(a[chunk.start : chunk.start + chunk.count], chunk.bucket)[0]
-                for a in arrays
-            )
-            self._m_rows.inc(chunk.count)
-            self._m_pad.inc(chunk.bucket - chunk.count)
-            self._m_occupancy.observe(chunk.count / chunk.bucket)
-            batch_real += chunk.count
-            batch_pad += chunk.bucket - chunk.count
-            out = self._dispatch_fixed(entry, operands, padded, static)
-            chunk_outs.append(_slice_rows(out, chunk.count))
-        # one locked update per batch: run_batch is legal from epoch,
-        # serving, and dispatch threads concurrently, and an unguarded
-        # += here would lose increments and understate padding waste
-        with self._mem_lock:
-            self._real_rows += batch_real
-            self._pad_rows += batch_pad
+        chunk_outs = self._run_rows(entry, operands, arrays, n_rows, static)
         if len(chunk_outs) == 1:
             return chunk_outs[0]
         return _concat_rows(chunk_outs)
@@ -613,9 +1060,20 @@ class DeviceExecutor:
                 np.zeros((bucket,) + tuple(shape), dtype=dtype)
                 for shape, dtype in zip(row_shapes, dtypes)
             )
-            self._dispatch_fixed(
-                entry, tuple(operands), arrays, static, warmup=True
-            )
+            if self._resilience:
+                # warmup dispatches sit under the same typed-failure
+                # contract as traffic: transients retry on the bounded
+                # schedule instead of failing startup, and anything
+                # persistent surfaces as a typed DeviceJobError (the
+                # breaker/fallback stay out of it — warming the host
+                # path would compile nothing)
+                self._dispatch_with_retry(
+                    entry, tuple(operands), arrays, static, warmup=True
+                )
+            else:
+                self._dispatch_fixed(
+                    entry, tuple(operands), arrays, static, warmup=True
+                )
         return len(entry.seen_keys) - before
 
     # -- the async host-job path ---------------------------------------------
@@ -644,6 +1102,10 @@ class DeviceExecutor:
                 "submit() called from the dispatch thread — run_batch() "
                 "is the inline API for dispatch-side device work"
             )
+        if self._closed:
+            raise _res.ExecutorClosedError(
+                "submit() on a closed device executor"
+            )
         job = _Job(name, fn, nbytes)
         deadline = (
             None if timeout_s is None else time.monotonic() + timeout_s
@@ -656,9 +1118,23 @@ class DeviceExecutor:
                         raise TimeoutError(
                             "device executor in-flight budget full past deadline"
                         )
+                    if self._closed:
+                        raise _res.ExecutorClosedError(
+                            "device executor closed while submit() waited "
+                            "on the in-flight budget"
+                        )
                     t0 = time.monotonic()
                     self._cond.wait(timeout=0.1)
                     stalled += time.monotonic() - t0
+                if self._closed:
+                    # close() may free the budget (failing leftovers) and
+                    # wake this waiter with the loop condition now false —
+                    # enqueueing here would resurrect the dispatch thread
+                    # on a closed executor
+                    raise _res.ExecutorClosedError(
+                        "device executor closed while submit() waited "
+                        "on the in-flight budget"
+                    )
                 self._inflight_bytes += job.nbytes
                 self._queue.append(job)
                 self._ensure_thread()
@@ -677,39 +1153,78 @@ class DeviceExecutor:
         )
 
     def _ensure_thread(self) -> None:
+        """(Re)spawn the dispatch thread — caller holds ``_cond``."""
         if self._thread is not None and self._thread.is_alive():
             return
         self._stop = False
+        self._thread_gen += 1
         self._thread = threading.Thread(
-            target=self._dispatch_loop, name="device-dispatch", daemon=True
+            target=self._dispatch_loop,
+            args=(self._thread_gen,),
+            name="device-dispatch",
+            daemon=True,
         )
         self._thread.start()
+        if (
+            self._dispatch_deadline_s > 0
+            and (self._watchdog is None or not self._watchdog.is_alive())
+        ):
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop,
+                name="device-dispatch-watchdog",
+                daemon=True,
+            )
+            self._watchdog.start()
 
     # pathway-lint: context=device
-    def _dispatch_loop(self) -> None:
+    def _dispatch_loop(self, gen: int) -> None:
         while True:
             with self._cond:
-                while not self._queue and not self._stop:
+                while (
+                    not self._queue
+                    and not self._stop
+                    and self._thread_gen == gen
+                ):
                     self._cond.wait(timeout=1.0)
+                if self._thread_gen != gen:
+                    # superseded: a hang escalation wrote this thread off
+                    # and a fresh loop owns the queue now
+                    return
                 if self._stop and not self._queue:
                     return
                 job = self._queue.pop(0)
+                job.started_at = time.monotonic()
                 self._running = job
             try:
                 self._run_job(job)
             finally:
                 with self._cond:
-                    self._running = None
-                    self._inflight_bytes -= job.nbytes
+                    # settle the in-flight accounting exactly once: the
+                    # hang escalation (or close) may already have
+                    # finalized an abandoned job on this zombie thread
+                    if not job.finalized:
+                        job.finalized = True
+                        self._inflight_bytes -= job.nbytes
+                    if self._running is job:
+                        self._running = None
+                    superseded = self._thread_gen != gen
                     self._cond.notify_all()
+                if superseded:
+                    return
 
     def _run_job(self, job: _Job) -> None:
         self._maybe_stall(job)
+        self._maybe_hang(job)
         t0 = time.monotonic()
         try:
             result = job.fn()
         except BaseException as exc:  # noqa: BLE001 - delivered to the waiter
             job.future.set_exception(exc)
+            return
+        if job.abandoned:
+            # the watchdog already failed this job's waiters and
+            # respawned the dispatch thread; the late result is dropped
+            # (DeviceFuture resolves once) — just don't count it
             return
         # a host job's wall time (tokenize + inner run_batch calls) is a
         # different quantity from one device call — separate histogram
@@ -729,14 +1244,129 @@ class DeviceExecutor:
         while time.monotonic() < deadline and not self._stop:
             time.sleep(0.05)
 
-    def close(self, timeout_s: float = 5.0) -> None:
-        """Stop the dispatch thread after draining the queue (tests)."""
+    def _maybe_hang(self, job: _Job) -> None:
+        """``device_hang`` fault injection: WEDGE the dispatch thread on
+        this job (bounded by ``delay_ms``, default 60 s) — a stuck
+        device call / driver deadlock stand-in.  The job makes no
+        progress and raises nothing: only the hard dispatch deadline
+        (``PATHWAY_DEVICE_DISPATCH_DEADLINE_S``) can end it, by failing
+        the job and respawning the dispatch thread — exactly what its
+        chaos test proves."""
+        from pathway_tpu.engine import faults
+
+        spec = faults.check("device_hang", source=job.name)
+        if spec is None:
+            return
+        _blackbox.record("fault.device_hang", job=job.name)
+        limit = time.monotonic() + (spec.delay_ms or 60_000.0) / 1000.0
+        while (
+            time.monotonic() < limit
+            and not self._stop
+            and not job.abandoned
+        ):
+            time.sleep(0.05)
+
+    # pathway-lint: context=watchdog
+    def _watchdog_loop(self) -> None:
+        """Hard dispatch-deadline enforcement: a running job older than
+        ``PATHWAY_DEVICE_DISPATCH_DEADLINE_S`` gets failed with a typed
+        hang error and the (wedged) dispatch thread is written off and
+        respawned, so one stuck device call cannot freeze the whole
+        dispatch queue behind it."""
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                job = self._running
+                started = job.started_at if job is not None else None
+                self._cond.wait(timeout=0.1)
+            if (
+                job is not None
+                and started is not None
+                and time.monotonic() - started > self._dispatch_deadline_s
+            ):
+                self._escalate_hang(job)
+
+    def _escalate_hang(self, job: _Job) -> None:
         with self._cond:
+            # re-check under the lock: the job may have finished (or a
+            # concurrent escalation handled it) while we decided
+            if job.finalized or self._running is not job:
+                return
+            job.abandoned = True
+            job.finalized = True
+            self._running = None
+            self._inflight_bytes -= job.nbytes
+            age = time.monotonic() - (job.started_at or job.enqueued_at)
+            # write the wedged thread off and hand the queue to a fresh
+            # one (unless we are shutting down anyway)
+            self._thread = None
+            if not self._stop and not self._closed:
+                self._ensure_thread()
+            else:
+                self._thread_gen += 1
+            self._cond.notify_all()
+        self._m_restarts.inc()
+        self._reg.counter(
+            "device.failures",
+            "classified device-path failures observed (kind label)",
+            kind="hang",
+        ).inc()
+        _blackbox.record(
+            "device.dispatch.restart",
+            job=job.name,
+            age_s=round(age, 3),
+            deadline_s=self._dispatch_deadline_s,
+        )
+        job.future.set_exception(
+            _res.DeviceDispatchHangError(
+                f"dispatch of job {job.name!r} exceeded the hard deadline "
+                f"({self._dispatch_deadline_s:g} s); the dispatch thread "
+                "was restarted"
+            )
+        )
+
+    def close(self, timeout_s: float = 5.0) -> None:
+        """Shut the executor down: refuse new work, drain what the
+        dispatch thread can finish within ``timeout_s``, and FAIL (never
+        strand) every waiter still in flight with a typed
+        :class:`ExecutorClosedError`."""
+        with self._cond:
+            self._closed = True
             self._stop = True
+            self._retry_interrupt.set()
             self._cond.notify_all()
             thread = self._thread
         if thread is not None:
             thread.join(timeout=timeout_s)
+        leftovers: list[_Job] = []
+        with self._cond:
+            if thread is not None and thread.is_alive():
+                # wedged mid-job past the drain budget: write the thread
+                # off and fail its job — a stranded waiter is worse than
+                # an abandoned thread
+                self._thread_gen += 1
+                running = self._running
+                if running is not None and not running.finalized:
+                    running.abandoned = True
+                    running.finalized = True
+                    self._inflight_bytes -= running.nbytes
+                    leftovers.append(running)
+                    self._running = None
+            while self._queue:
+                job = self._queue.pop(0)
+                if not job.finalized:
+                    job.finalized = True
+                    self._inflight_bytes -= job.nbytes
+                leftovers.append(job)
+            self._cond.notify_all()
+        for job in leftovers:
+            job.future.set_exception(
+                _res.ExecutorClosedError(
+                    f"device executor closed before job {job.name!r} "
+                    "completed"
+                )
+            )
 
     # -- observability -------------------------------------------------------
 
@@ -785,8 +1415,9 @@ class DeviceExecutor:
 
     def metrics_snapshot(self) -> dict[str, float]:
         """Registry collector: ``backlog.device.*`` plus the device cost
-        gauges — utilization, padding waste, HBM — so one scrape covers
-        the whole device story."""
+        gauges — utilization, padding waste, HBM — and the resilience
+        state (per-callable breaker + OOM bucket cap, quarantine depth),
+        so one scrape covers the whole device story."""
         out = self._queue_snapshot()
         out.update(self._accountant.gauges())
         out["device.batch.max"] = float(self._default_max_batch)
@@ -796,7 +1427,37 @@ class DeviceExecutor:
         hbm = self._hbm_snapshot()
         out["device.hbm.bytes_in_use"] = float(hbm["bytes_in_use"])
         out["device.hbm.peak"] = float(hbm["peak"])
+        for name, entry in sorted(self._callables.items()):
+            if entry.breaker is not None:
+                out[f"device.breaker.state{{callable={name}}}"] = (
+                    entry.breaker.state_value()
+                )
+            with entry.lock:
+                cap = entry.bucket_cap
+            if cap is not None:
+                out[f"device.bucket.cap{{callable={name}}}"] = float(cap)
+        out["device.quarantine.records"] = float(len(self._quarantine))
         return out
+
+    def resilience_stats(self, name: str) -> dict[str, Any]:
+        """The fault-tolerance ledger of one registered callable —
+        breaker state, OOM ratchet, fallback/failure counts (tests and
+        the snapshot below)."""
+        entry = self._callables[name]
+        with entry.lock:
+            out: dict[str, Any] = {
+                "bucket_cap": entry.bucket_cap,
+                "oom_splits": entry.oom_splits,
+                "fallback_batches": entry.fallback_batches,
+                "failures": dict(entry.failure_counts),
+            }
+        out["breaker"] = (
+            entry.breaker.snapshot() if entry.breaker is not None else None
+        )
+        return out
+
+    def quarantine_records(self) -> list[dict[str, Any]]:
+        return self._quarantine.records()
 
     def device_snapshot(self) -> dict[str, Any]:
         """The full device story as one JSON-able dict — what rides
@@ -810,6 +1471,15 @@ class DeviceExecutor:
             "queue": self._queue_snapshot(),
             "callables": {
                 name: self.stats(name) for name in sorted(self._callables)
+            },
+            "resilience": {
+                "enabled": self._resilience,
+                "dispatch_deadline_s": self._dispatch_deadline_s,
+                "callables": {
+                    name: self.resilience_stats(name)
+                    for name in sorted(self._callables)
+                },
+                "quarantine": self.quarantine_records(),
             },
         }
 

@@ -524,6 +524,44 @@ def render_device_snapshot(snapshot: dict[str, Any]) -> str:
             f"{st.get('keys', 0)} compile key(s) "
             f"(cold {st.get('cold', 0)} / warmed {st.get('warmed', 0)})"
         )
+    resilience = snapshot.get("resilience") or {}
+    for name in sorted(resilience.get("callables") or {}):
+        st = (resilience["callables"].get(name) or {})
+        breaker = st.get("breaker") or {}
+        failures = st.get("failures") or {}
+        interesting = (
+            breaker.get("state") not in (None, "closed")
+            or breaker.get("trips")
+            or st.get("bucket_cap") is not None
+            or st.get("fallback_batches")
+            or failures
+        )
+        if not interesting:
+            continue  # healthy callables say nothing — failures stand out
+        parts = [f"breaker {breaker.get('state', '?')}"]
+        if breaker.get("trips"):
+            parts.append(f"{breaker['trips']} trip(s)")
+        if st.get("fallback_batches"):
+            parts.append(f"{st['fallback_batches']} fallback batch(es)")
+        if st.get("bucket_cap") is not None:
+            parts.append(
+                f"OOM-capped at bucket {st['bucket_cap']} "
+                f"({st.get('oom_splits', 0)} split(s))"
+            )
+        if failures:
+            parts.append(
+                "failures "
+                + ", ".join(f"{k}={v}" for k, v in sorted(failures.items()))
+            )
+        lines.append(f"  {name}: " + " · ".join(parts))
+    quarantine = resilience.get("quarantine") or []
+    if quarantine:
+        lines.append(f"  quarantine: {len(quarantine)} poisoned batch(es)")
+        for rec in quarantine[-3:]:
+            lines.append(
+                f"    {rec.get('callable', '?')}: {rec.get('rows', '?')} "
+                f"row(s) — {rec.get('fallback_error', '?')}"
+            )
     if len(lines) == 1:
         lines.append("  (no device activity recorded)")
     return "\n".join(lines)

@@ -28,7 +28,13 @@ Plan format (JSON, also accepted as a Python list of dicts)::
         {"kind": "connector_stall", "source": "SubjectReader", "nth": 3,
          "delay_ms": 500},
         {"kind": "device_stall", "source": "encoder", "nth": 1,
-         "delay_ms": 500}
+         "delay_ms": 500},
+        {"kind": "device_error", "source": "rowsum", "from_nth": 1,
+         "max_times": 5},
+        {"kind": "device_oom", "source": "rowsum", "nth": 2},
+        {"kind": "device_compile_fail", "source": "rowsum", "nth": 1},
+        {"kind": "device_hang", "source": "embed", "nth": 1,
+         "delay_ms": 10000}
     ]}
 
 Matching rules:
@@ -109,6 +115,30 @@ device_stall  The DeviceExecutor dispatch thread (``pathway_tpu/device/
              and the freshness layer can see it, which is exactly what
              the device-executor chaos test proves.  ``source`` filters
              on the submitted job name (e.g. the batcher name).
+device_error The DeviceExecutor's fixed-shape dispatch
+             (``_dispatch_fixed``): the Nth matching device call raises
+             an INTERNAL-flavored transient failure *inside* the
+             dispatch, so it takes the real classify → retry → breaker
+             → host-fallback path (``device/resilience.py``).  Repeated
+             with ``from_nth``/``max_times`` it trips the per-callable
+             circuit breaker — the device-fault chaos tests' lever.
+             ``source`` filters on the registered callable name.
+device_oom   Same site: the call raises RESOURCE_EXHAUSTED — the
+             executor must SPLIT the chunk onto a smaller bucket and
+             ratchet the callable's max-bucket cap
+             (``device.oom.splits`` / ``device.bucket.cap``) instead of
+             failing the stream.
+device_compile_fail  Same site: the call raises an XLA compilation
+             failure — deterministic, never retried; counts toward the
+             breaker and the batch serves from the host fallback.
+device_hang  The dispatch thread: the Nth matching batch job WEDGES
+             (bounded by ``delay_ms``, default 60 s) without raising —
+             a stuck device call / driver deadlock.  Only the hard
+             dispatch deadline (``PATHWAY_DEVICE_DISPATCH_DEADLINE_S``)
+             ends it: the job's waiters get a typed hang error and the
+             dispatch thread is torn down and respawned
+             (``device.dispatch.restarts``).  ``source`` filters on the
+             submitted job name.
 ========== =============================================================
 """
 
@@ -140,7 +170,8 @@ KINDS = (
     + _BLOB_CORRUPT_KINDS
     + (
         "crash", "writer_crash", "hang", "zombie", "connector_read",
-        "connector_stall", "device_stall",
+        "connector_stall", "device_stall", "device_error", "device_oom",
+        "device_compile_fail", "device_hang",
     )
 )
 

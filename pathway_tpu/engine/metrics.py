@@ -292,6 +292,41 @@ METRICS: dict[str, tuple[str, str]] = {
     "device.trace.captures": (
         "counter", "on-demand jax.profiler traces captured (GET /trace, "
         "`pathway_tpu trace`)"),
+    # device fault tolerance (pathway_tpu/device/resilience.py)
+    "device.failures": (
+        "counter", "classified device-path failures observed, labeled by "
+        "kind (transient/oom/compile/hang)"),
+    "device.retry.attempts": (
+        "counter", "transient device failures retried by the dispatch "
+        "wrapper (bounded jittered backoff)"),
+    "device.oom.splits": (
+        "counter", "RESOURCE_EXHAUSTED chunks split onto smaller buckets "
+        "by the OOM ratchet"),
+    "device.bucket.cap": (
+        "gauge", "largest bucket a callable may plan after OOM ratcheting "
+        "(callable= label; absent while uncapped)"),
+    "device.breaker.state": (
+        "gauge", "per-callable circuit-breaker state (callable= label): "
+        "0 closed, 0.5 half-open, 1 open"),
+    "device.breaker.trips": (
+        "counter", "circuit-breaker open transitions (K consecutive "
+        "device failures, or a failed half-open probe)"),
+    "device.fallback.batches": (
+        "counter", "batches served by the un-jitted host-fallback path "
+        "while a breaker is open (or after retries failed)"),
+    "device.fallback.rows": (
+        "counter", "real rows served by the host fallback"),
+    "device.fallback.ms": (
+        "histogram", "wall time of one host-fallback batch execution (ms)"),
+    "device.quarantine.batches": (
+        "counter", "poisoned batches quarantined: device retries AND host "
+        "fallback failed (waiters get DeviceQuarantinedError)"),
+    "device.quarantine.records": (
+        "gauge", "quarantine records currently retained "
+        "(PATHWAY_DEVICE_QUARANTINE_KEEP newest)"),
+    "device.dispatch.restarts": (
+        "counter", "dispatch threads torn down and respawned after a "
+        "hard dispatch-deadline hang (PATHWAY_DEVICE_DISPATCH_DEADLINE_S)"),
     # telemetry (engine/telemetry.py)
     "telemetry.export.dropped": (
         "counter", "telemetry payloads dropped by the bounded export queue"),

@@ -235,6 +235,41 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
        "base directory for on-demand jax.profiler traces (`GET "
        "/trace?seconds=N` on the monitoring HTTP server, `pathway_tpu "
        "trace`); unset disables capture", "executor"),
+    _k("PATHWAY_DEVICE_RESILIENCE", "bool", True,
+       "device-path fault tolerance rail (typed failure classes, "
+       "retries, OOM bucket ratchet, circuit breaker, quarantine); `0` "
+       "reverts to raw PR-11 dispatch where any device error fails the "
+       "caller", "executor"),
+    _k("PATHWAY_DEVICE_RETRIES", "int", 2,
+       "bounded retries for TRANSIENT device failures per dispatch "
+       "(jittered exponential backoff, the shared udfs policy); compile "
+       "failures and OOM are never retried at the same shape", "executor"),
+    _k("PATHWAY_DEVICE_RETRY_DEADLINE_S", "float", 30.0,
+       "wall-clock cap on one dispatch's whole retry affair — the retry "
+       "loop must never outlast the freshness SLO it protects",
+       "executor"),
+    _k("PATHWAY_DEVICE_RETRY_BACKOFF_MS", "float", 50.0,
+       "initial backoff before the first device retry (doubles per "
+       "attempt, jittered by half the initial)", "executor"),
+    _k("PATHWAY_DEVICE_BREAKER_THRESHOLD", "int", 5,
+       "consecutive device failures (retries already spent) that trip a "
+       "callable's circuit breaker OPEN — dispatches then route to the "
+       "un-jitted host fallback (`device.breaker.state`, "
+       "`device.fallback.*`)", "executor"),
+    _k("PATHWAY_DEVICE_BREAKER_COOLDOWN_S", "float", 10.0,
+       "open-breaker cooldown before one half-open probe is admitted "
+       "back to the device (success closes, failure re-opens)",
+       "executor"),
+    _k("PATHWAY_DEVICE_DISPATCH_DEADLINE_S", "float", 0.0,
+       "hard per-job dispatch deadline: a queued batch job running "
+       "longer is failed with a typed hang error and the dispatch "
+       "thread is respawned (`device.dispatch.restarts`); 0 disables "
+       "hang escalation (long LLM-generation jobs use their own "
+       "threads)", "executor"),
+    _k("PATHWAY_DEVICE_QUARANTINE_KEEP", "int", 32,
+       "poisoned-batch quarantine records retained per executor "
+       "(newest kept; the total is still counted by "
+       "`device.quarantine.batches`)", "executor"),
     # -- devices (parallel/mesh.py, internals/runner.py) --------------------
     _k("PATHWAY_JAX_DISTRIBUTED", "bool", False,
        "form a multi-host JAX device mesh too (`spawn "

@@ -12,7 +12,9 @@ or ``PATHWAY_MONITORING_HTTP_PORT``) and renders, per refresh:
   bottleneck stage reads off the top line;
 * device — the DeviceExecutor panel (``pathway_tpu/device/``): dispatch
   rate, queue depth/age, compile-cache cold/warm discipline, padding
-  waste, roofline utilization and HBM use;
+  waste, roofline utilization and HBM use, plus the fault-tolerance
+  state (tripped circuit breakers, OOM bucket caps, host-fallback /
+  quarantine / dispatch-restart counts);
 * operators — the per-operator progress table of the ``/status`` body.
 
 Pure functions (`render_top`) are separated from I/O (`fetch_status`) so
@@ -208,6 +210,38 @@ def render_top(
             lines.append(
                 f"  hbm {hbm / (1 << 20):.1f} MiB in use · peak "
                 f"{(device.get('device.hbm.peak') or 0.0) / (1 << 20):.1f} MiB"
+            )
+        # fault-tolerance panel (device/resilience.py): per-callable
+        # breaker state plus the degraded-mode counters — a tripped
+        # breaker or a quarantined batch must be visible at a glance
+        breakers = _labeled(device, "device.breaker.state")
+        tripped = {
+            name: value for name, value in breakers.items() if value
+        }
+        if tripped:
+            states = ", ".join(
+                f"{name} {'OPEN' if value >= 1.0 else 'half-open'}"
+                for name, value in sorted(tripped.items())
+            )
+            lines.append(f"  breaker: {states}")
+        caps = _labeled(device, "device.bucket.cap")
+        if caps:
+            lines.append(
+                "  oom ratchet: "
+                + ", ".join(
+                    f"{name} capped at bucket {int(cap)}"
+                    for name, cap in sorted(caps.items())
+                )
+                + f" ({int(device.get('device.oom.splits') or 0)} split(s))"
+            )
+        fallback = device.get("device.fallback.batches")
+        quarantined = device.get("device.quarantine.batches")
+        restarts = device.get("device.dispatch.restarts")
+        if fallback or quarantined or restarts:
+            lines.append(
+                f"  degraded: {int(fallback or 0)} host-fallback batch(es) "
+                f"· {int(quarantined or 0)} quarantined "
+                f"· {int(restarts or 0)} dispatch restart(s)"
             )
 
     operators = status.get("operators") or {}

@@ -179,9 +179,20 @@ class AsyncMicroBatcher:
                 target=run_detached, name=f"batch:{self.name}", daemon=True
             ).start()
             return
-        device_future = self._exec().submit(
-            job, name=self.name, nbytes=_batch_nbytes(items)
-        )
+        try:
+            device_future = self._exec().submit(
+                job, name=self.name, nbytes=_batch_nbytes(items)
+            )
+        except BaseException as exc:  # noqa: BLE001 - delivered to every waiter
+            # submit() itself can fail (ExecutorClosedError after close,
+            # a budget timeout) — every coalesced waiter must get the
+            # typed error rather than hang on a batch that never queued
+            for loop, fut in waiters:
+                try:
+                    loop.call_soon_threadsafe(_resolve, fut, None, exc)
+                except RuntimeError:
+                    pass  # that waiter's loop already closed
+            return
         device_future.add_done_callback(deliver)
 
     async def _flusher(self, key: int) -> None:
