@@ -82,6 +82,9 @@ SUITE: tuple[Bench, ...] = (
     Bench("host_churn", "host_churn.py", ("50000", "3"), ("500000", "5")),
     Bench("host_window", "host_window.py", ("50000",), ("300000",)),
     Bench("host_join", "host_join.py", ("50000",), ("300000",)),
+    # groupby/reduce hot path: columnar group-index + bulk reducer updates
+    # vs the row-wise oracle (single- and multi-column group keys)
+    Bench("host_groupby", "host_groupby.py", ("50000",), ("300000",)),
     # end-to-end + microbench cost of the instrumentation itself; its
     # interleaved-rep protocol is slow, so full mode only
     Bench("telemetry_overhead", "telemetry_overhead.py", (), (), in_smoke=False),

@@ -1,0 +1,123 @@
+"""Host-engine groupby/reduce throughput: columnar vs row path.
+
+ISSUE 14: joins (`host_join.py`) and windows (`host_window.py`) were
+benchmarked; the groupby/reduce hot path — group-index building + bulk
+reducer updates in ``GroupByNode._step_columnar`` — was not.  This harness
+runs the identical groupby→reduce pipeline twice (vector compiler ON and
+OFF) over two canonical shapes: a single int group key (metric rollup)
+and a multi-column key (the windowby-reduce shape after PR 14 extended
+the columnar spec to instance columns).
+
+Usage: python benchmarks/host_groupby.py [n_rows]
+Prints one JSON line per metric plus the speedup summaries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_GROUPS = 2_000
+
+
+def build_pipeline(n_rows: int, shape: str):
+    import pathway_tpu as pw
+    from pathway_tpu.io._utils import make_static_input_table
+
+    rows = [
+        {
+            "k": (i * 7919) % N_GROUPS,
+            "inst": i % 5,
+            "v": (i * 31) % 1000,
+            "w": float((i * 13) % 500),
+        }
+        for i in range(n_rows)
+    ]
+    t = make_static_input_table(
+        pw.schema_from_types(k=int, inst=int, v=int, w=float), rows
+    )
+    if shape == "single":
+        return t.groupby(pw.this.k).reduce(
+            k=pw.this.k,
+            n=pw.reducers.count(),
+            tot=pw.reducers.sum(pw.this.v),
+            wsum=pw.reducers.sum(pw.this.w),
+            hi=pw.reducers.max(pw.this.v),
+        )
+    return t.groupby(pw.this.k, pw.this.inst).reduce(
+        k=pw.this.k,
+        inst=pw.this.inst,
+        n=pw.reducers.count(),
+        tot=pw.reducers.sum(pw.this.v),
+        lo=pw.reducers.min(pw.this.v),
+    )
+
+
+def run_once(n_rows: int, columnar: bool, shape: str):
+    from pathway_tpu.engine import dataflow as df
+    from pathway_tpu.internals import vector_compiler as vc
+    from pathway_tpu.internals.parse_graph import G
+    from pathway_tpu.internals.runner import run_pipeline_to_completion
+
+    G.clear()
+    vc.set_enabled(columnar)
+    try:
+        result = build_pipeline(n_rows, shape)
+        collected = []
+
+        def attach(lowerer, node):
+            return df.OutputNode(
+                lowerer.scope,
+                node,
+                on_data=lambda key, row, t, diff: collected.append((row, diff)),
+            )
+
+        t0 = time.perf_counter()
+        run_pipeline_to_completion([(result, attach)])
+        dt_s = time.perf_counter() - t0
+    finally:
+        vc.set_enabled(True)
+        G.clear()
+    return dt_s, collected
+
+
+def main() -> None:
+    n_rows = int(sys.argv[1]) if len(sys.argv) > 1 else 300_000
+    for shape in ("single", "multi"):
+        results = {}
+        outputs = {}
+        for label, columnar in (("columnar", True), ("row", False)):
+            dt_s, collected = run_once(n_rows, columnar, shape)
+            rate = n_rows / dt_s
+            results[label] = rate
+            outputs[label] = sorted((r for r, d in collected if d > 0), key=repr)
+            print(
+                json.dumps(
+                    {
+                        "metric": f"host_groupby_{shape}_rows_per_sec_{label}",
+                        "value": round(rate, 1),
+                        "unit": "rows/s",
+                        "rows": n_rows,
+                        "seconds": round(dt_s, 3),
+                    }
+                )
+            )
+        assert outputs["columnar"] == outputs["row"], f"{shape} paths diverged!"
+        print(
+            json.dumps(
+                {
+                    "metric": f"host_groupby_{shape}_columnar_speedup",
+                    "value": round(results["columnar"] / results["row"], 2),
+                    "unit": "x",
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

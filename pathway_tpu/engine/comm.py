@@ -1196,20 +1196,36 @@ class WorkerContext:
         tag: Hashable,
         deltas: list,
         route: Callable[[int, Any], int] | None,
+        route_cols: "tuple[tuple, bool] | None" = None,
     ) -> list:
         """All-to-all one delta list. ``route(key, row) -> routing key``;
-        ``None`` routes by the row key itself."""
-        per_dest: list[list] = [[] for _ in range(self.worker_count)]
-        for key, row, diff in deltas:
-            if route is None:
-                rk = key
-            else:
-                try:
-                    rk = route(key, row)
-                except Exception:
-                    rk = key  # poisoned rows resolve locally; the node's own
-                    # step reports the error through the error log
-            per_dest[self.owner_of(rk)].append((key, row, diff))
+        ``None`` routes by the row key itself.  ``route_cols`` = (key
+        column indices, hash_none) batches the key-hash+route loop into
+        one native pass (``route_deltas``) with identical semantics —
+        the per-row Python loop below is the oracle and the fallback."""
+        per_dest: list[list] | None = None
+        if route_cols is not None and deltas:
+            from pathway_tpu.engine.types import _native
+            from pathway_tpu.internals import vector_compiler as vc
+
+            nat = _native()
+            if vc.ENABLED and nat is not None and hasattr(nat, "route_deltas"):
+                idxs, hash_none = route_cols
+                per_dest = nat.route_deltas(
+                    list(deltas), idxs, self.worker_count, hash_none
+                )
+        if per_dest is None:
+            per_dest = [[] for _ in range(self.worker_count)]
+            for key, row, diff in deltas:
+                if route is None:
+                    rk = key
+                else:
+                    try:
+                        rk = route(key, row)
+                    except Exception:
+                        rk = key  # poisoned rows resolve locally; the node's
+                        # own step reports the error through the error log
+                per_dest[self.owner_of(rk)].append((key, row, diff))
         return self.mesh.alltoall(tag, per_dest)
 
     def gather0_deltas(self, tag: Hashable, deltas: list) -> list:
@@ -1237,7 +1253,15 @@ class WorkerContext:
                     # still ran alltoall for declared ports only, so skip
                     node.pending[port] = pending
                     continue
-                merged = self.exchange_deltas(tag, pending, route)
+                specs = getattr(node, "exchange_route_cols", None)
+                route_cols = (
+                    specs.get(port)
+                    if specs is not None and route is not None
+                    else None
+                )
+                merged = self.exchange_deltas(
+                    tag, pending, route, route_cols=route_cols
+                )
             if merged:
                 node.pending[port] = merged
 

@@ -130,10 +130,60 @@ def _vec_threshold() -> int:
     return vc.VEC_THRESHOLD
 
 
+def _vec_temporal_arrays(node, deltas, op):
+    """The temporal operators' shared columnar pre-pass: materialize the
+    epoch batch's time/threshold columns once and apply the affine offsets
+    (``engine/dataflow.py`` Buffer/Freeze/Forget all lower to ``column +
+    const`` time math — see ``Table._temporal_op``).  Returns ``(t, thr)``
+    arrays or None on a counted bail; dtype-kind mixes between the columns
+    or against the running watermark bail because numpy's promotion would
+    compare inexactly where the row path's Python scalars are exact."""
+    from pathway_tpu.internals import vector_compiler as vc
+
+    t_idx, t_off, thr_idx, thr_off = node.vec_temporal
+    cols = vc.materialize_delta_columns(deltas, {t_idx, thr_idx})
+    if cols is None:
+        vc.note_bail(op, "dirty-column")
+        return None
+    try:
+        t = vc.affine_values(cols, t_idx, t_off)
+        thr = vc.affine_values(cols, thr_idx, thr_off)
+    except vc.VecBail:
+        vc.note_bail(op, "value-guard")
+        return None
+    if t.dtype.kind != thr.dtype.kind:
+        vc.note_bail(op, "dtype-mix")
+        return None
+    if t.dtype.kind == "f":
+        import numpy as np
+
+        # NaN diverges from the row oracle: t.max() would poison the
+        # watermark where the sequential `t > wm` scan skips NaN, and a
+        # NaN threshold wedges the forget expiry heap's ordering
+        if np.isnan(t).any() or np.isnan(thr).any():
+            vc.note_bail(op, "nan-time")
+            return None
+    wm = node._watermark
+    if wm is not None and (
+        (t.dtype.kind == "i" and type(wm) is not int)
+        or (t.dtype.kind == "f" and type(wm) is not float)
+    ):
+        vc.note_bail(op, "watermark-dtype")
+        return None
+    return t, thr
+
+
 class Node:
     """A dataflow operator. Subclasses implement ``step``."""
 
     name: str = "node"
+    # Execution-path attribution (engine/profiler.py snapshots render each
+    # operator as columnar / row / mixed): operators with a columnar fast
+    # path bump vec_batches when a batch ran it and row_batches when a
+    # batch fell to the row-wise evaluator.  Class-level zeros keep nodes
+    # without fast paths attribute-cheap; the first bump shadows them.
+    vec_batches: int = 0
+    row_batches: int = 0
     # Append-only dataflow analysis (parity: column properties threaded
     # through lowering, python/pathway/internals/column_properties.py,
     # consumed by the engine's append_only_or_deterministic switches,
@@ -569,6 +619,7 @@ class ExprNode(Node):
         needed, out_fns, out_dtypes = self.vec_select
         cols = vc.materialize_delta_columns(deltas, needed)
         if cols is None:
+            vc.note_bail("select", "dirty-column")
             return None
         n = len(deltas)
         try:
@@ -580,13 +631,16 @@ class ExprNode(Node):
                 arr = f(cols, n)
                 if isinstance(arr, list):  # Python-object column (tuples)
                     if len(arr) != n:
+                        vc.note_bail("select", "length-mismatch")
                         return None
                     out_cols.append(("U", arr))
                     continue
                 if not vc.result_kind_ok(arr, d):
+                    vc.note_bail("select", "result-dtype")
                     return None
                 out_cols.append(arr)
         except vc.VecBail:
+            vc.note_bail("select", "value-guard")
             return None
         return vc.rebuild_delta_rows(deltas, out_cols, n)
 
@@ -614,6 +668,13 @@ class ExprNode(Node):
                         )
         if out is None and self.vec_select is not None and len(deltas) >= _vec_threshold():
             out = self._try_columnar(deltas)
+        if deltas and (
+            self.vec_select is not None or self.vec_join_project is not None
+        ):
+            if out is None:
+                self.row_batches += 1
+            else:
+                self.vec_batches += 1
         if out is None:
             out = []
             for key, row, diff in deltas:
@@ -705,6 +766,11 @@ class FlattenNode(Node):
             if vc.ENABLED and nat is not None and hasattr(nat, "flatten_deltas"):
                 col_idx, with_origin = self.vec_flatten
                 out = nat.flatten_deltas(deltas, col_idx, with_origin)
+        if deltas and self.vec_flatten is not None:
+            if out is None:
+                self.row_batches += 1
+            else:
+                self.vec_batches += 1
         if out is None:
             out = []
             for key, row, diff in deltas:
@@ -765,6 +831,11 @@ class SaltRekeyNode(Node):
         nat = _get_native_module()
         if nat is not None and hasattr(nat, "rekey_deltas") and deltas:
             out = nat.rekey_deltas(deltas, self.salt)
+        if deltas:
+            if out is None:
+                self.row_batches += 1
+            else:
+                self.vec_batches += 1
         if out is None:
             salt = self.salt
             out = [
@@ -1100,6 +1171,11 @@ class JoinNode(Node):
         self.native_spec: tuple | None = None
         self._native_idx = None
         self._nat = None
+        # batched exchange routing (engine/comm.py): per-port
+        # (key column indices, hash_none flag) when the join keys are
+        # plain column picks — the per-row key-hash+route loop then runs
+        # in one native pass with identical hash_values semantics
+        self.exchange_route_cols: dict[int, tuple[tuple, bool]] | None = None
 
     def _infer_append_only(self) -> bool:
         # inner joins of append-only sides only ever add pairs; outer modes
@@ -1135,6 +1211,9 @@ class JoinNode(Node):
         if self._native_idx is None:
             nat = _get_native_module()
             if nat is None or not hasattr(nat, "join_step"):
+                from pathway_tpu.internals import vector_compiler as vc
+
+                vc.note_bail("join", "native-unavailable")
                 self.native_spec = None
                 return None
             self._nat = nat
@@ -1191,6 +1270,8 @@ class JoinNode(Node):
         if cap is not None:
             dl = consolidate(self.take_pending(0))
             dr = consolidate(self.take_pending(1))
+            if dl or dr:
+                self.vec_batches += 1
             l_idxs, r_idxs, mode = self.native_spec
             raw, replaced = self._nat.join_step(
                 cap, dl, dr, l_idxs, r_idxs, mode,
@@ -1218,6 +1299,8 @@ class JoinNode(Node):
         out: list[Delta] = []
         dl = consolidate(self.take_pending(0))
         dr = consolidate(self.take_pending(1))
+        if dl or dr:
+            self.row_batches += 1
 
         # apply left deltas against current right index
         for lkey, lrow, diff in dl:
@@ -1313,6 +1396,10 @@ class GroupByNode(Node):
         }
         self.group_key_fn = group_key_fn
         self.out_key_fn = out_key_fn
+        # batched exchange routing (engine/comm.py): (group-key column
+        # indices, hash_none=True) when the group keys are plain column
+        # picks — set by the Lowerer alongside vec_group
+        self.exchange_route_cols: dict[int, tuple[tuple, bool]] | None = None
         self.reducer_specs = list(reducer_specs)
         self.result_fn = result_fn or (lambda gk, vals: tuple(vals))
         self._groups: dict[tuple, list] = {}
@@ -1357,11 +1444,18 @@ class GroupByNode(Node):
             needed = {vidx for kind, vidx in red_cols if kind != "count"}
             cols = vc.materialize_delta_columns(deltas, needed) if needed else {}
             if needed and cols is None:
+                vc.note_bail("groupby", "dirty-column")
                 return False
             # group keys are Python tuples straight off the rows — the
             # native hash grouping keys on the same objects the row path's
-            # dict does, so equality semantics (incl. NaN identity) match
-            keys = [tuple(row[i] for i in gidx) for (_k, row, _d) in deltas]
+            # dict does, so equality semantics (incl. NaN identity) match;
+            # the per-row tuple build itself is one native pass too
+            nat = _get_native_module()
+            gather = getattr(nat, "gather_key_rows", None) if nat else None
+            if gather is not None:
+                keys = gather(deltas, tuple(gidx))
+            else:
+                keys = [tuple(row[i] for i in gidx) for (_k, row, _d) in deltas]
             gvals_list, inv = vc.group_indices(keys)
         else:
             needed = {gidx} | {vidx for kind, vidx in red_cols if kind != "count"}
@@ -1373,8 +1467,10 @@ class GroupByNode(Node):
             if raw is NotImplemented:
                 cols = vc.materialize_delta_columns(deltas, needed)
                 if cols is None:
+                    vc.note_bail("groupby", "dirty-column")
                     return False
             elif raw is None:
+                vc.note_bail("groupby", "dirty-column")
                 return False
             else:
                 cols = {}
@@ -1390,6 +1486,7 @@ class GroupByNode(Node):
                 # group while the row path's dict keeps one group per NaN
                 # object — bail
                 if garr.dtype.kind == "f" and np.isnan(garr).any():
+                    vc.note_bail("groupby", "nan-group-key")
                     return False
         val_arrs = [
             None if kind == "count" else cols[vidx] for kind, vidx in red_cols
@@ -1404,11 +1501,13 @@ class GroupByNode(Node):
             # sums need numeric columns; min/max works on any materialized
             # dtype (incl. str) since it only groups and counts
             if kind == "sum" and varr.dtype.kind not in "bif":
+                vc.note_bail("groupby", "sum-dtype")
                 return False
             # NaN breaks the mm multiset grouping: np.unique collapses all
             # NaNs into one entry while the row path's Counter keeps one
             # entry per object — bail to the row path to keep parity
             if kind == "mm" and varr.dtype.kind == "f" and np.isnan(varr).any():
+                vc.note_bail("groupby", "nan-minmax")
                 return False
         diffs = vc.delta_diffs(deltas)
         max_diff = vc._abs_bound(diffs)
@@ -1420,6 +1519,7 @@ class GroupByNode(Node):
                 and varr.dtype.kind == "i"
                 and vc._abs_bound(varr) * max_diff * max(1, len(deltas)) > vc._I64_MAX
             ):
+                vc.note_bail("groupby", "sum-overflow")
                 return False
         if gvals_list is None:
             uniq, inv = np.unique(garr, return_inverse=True)
@@ -1483,6 +1583,11 @@ class GroupByNode(Node):
         handled = False
         if self.vec_group is not None and len(deltas) >= _vec_threshold():
             handled = self._step_columnar(deltas, touched)
+        if deltas and self.vec_group is not None:
+            if handled:
+                self.vec_batches += 1
+            else:
+                self.row_batches += 1
         if not handled:
             for key, row, diff in deltas:
                 gk = self.group_key_fn(key, row)
@@ -1606,22 +1711,82 @@ class BufferNode(Node):
         self._held: list[Delta] = []
         self._watermark = None
         self.exchange_routes = {0: None}  # buffer state lives with key owner
+        # columnar fast path (set by the Lowerer when time/threshold lower
+        # to column + const): (t_idx, t_off, thr_idx, thr_off).  While
+        # every ingest batch materializes columnar, _held_thr caches the
+        # held rows' thresholds as one array and the release scan becomes
+        # a single vector compare + native split; any bail reverts the
+        # node to the row path (the oracle) until the buffer drains.
+        self.vec_temporal: tuple | None = None
+        self._held_thr = None  # np.ndarray | None (None = row mode)
+
+    def _ingest_columnar(self, incoming) -> bool:
+        import numpy as np
+
+        from pathway_tpu.internals import vector_compiler as vc
+
+        if self.vec_temporal is None or not vc.ENABLED:
+            return False
+        if self._held and self._held_thr is None:
+            return False  # uncached held rows: stay row-wise until drained
+        if not incoming:
+            return True
+        arrays = _vec_temporal_arrays(self, incoming, "buffer")
+        if arrays is None:
+            return False
+        t, thr = arrays
+        held_thr = self._held_thr
+        if (
+            held_thr is not None
+            and len(held_thr)
+            and held_thr.dtype.kind != thr.dtype.kind
+        ):
+            vc.note_bail("buffer", "dtype-mix")
+            return False
+        tmax = t.max().item()
+        if self._watermark is None or tmax > self._watermark:
+            self._watermark = tmax
+        self._held_thr = (
+            thr
+            if held_thr is None or not len(held_thr)
+            else np.concatenate([held_thr, thr])
+        )
+        return True
 
     def step(self, time):
+        from pathway_tpu.internals import vector_compiler as vc
+
         incoming = self.take_pending()
-        for key, row, diff in incoming:
-            t = self.time_fn(key, row)
-            if self._watermark is None or t > self._watermark:
-                self._watermark = t
+        vec = self._ingest_columnar(incoming)
+        if not vec:
+            self._held_thr = None
+            for key, row, diff in incoming:
+                t = self.time_fn(key, row)
+                if self._watermark is None or t > self._watermark:
+                    self._watermark = t
         self._held.extend(incoming)
-        release, keep = [], []
-        for key, row, diff in self._held:
-            thr = self.threshold_fn(key, row)
-            if self._watermark is not None and thr <= self._watermark:
-                release.append((key, row, diff))
-            else:
-                keep.append((key, row, diff))
-        self._held = keep
+        wm = self._watermark
+        if vec and self._held_thr is not None:
+            if incoming or self._held:
+                self.vec_batches += 1
+            held_thr = self._held_thr
+            release: list[Delta] = []
+            if len(held_thr) and wm is not None:
+                mask = held_thr <= wm
+                if mask.any():
+                    release, self._held = vc.split_deltas(self._held, mask)
+                    self._held_thr = held_thr[~mask]
+        else:
+            if incoming or self._held:
+                self.row_batches += 1
+            release, keep = [], []
+            for key, row, diff in self._held:
+                thr = self.threshold_fn(key, row)
+                if wm is not None and thr <= wm:
+                    release.append((key, row, diff))
+                else:
+                    keep.append((key, row, diff))
+            self._held = keep
         release = consolidate(release)
         if self.keep_state:
             self._update_state(release)
@@ -1630,6 +1795,7 @@ class BufferNode(Node):
     def on_finish(self):
         release = consolidate(self._held)
         self._held = []
+        self._held_thr = None  # empty buffer: columnar mode may resume
         if self.keep_state:
             self._update_state(release)
         self.send(release, self.scope.current_time)
@@ -1650,24 +1816,104 @@ class ForgetNode(Node):
         self._alive: dict[int, Row] = {}
         self._watermark = None
         self.exchange_routes = {0: None}  # alive-set lives with key owner
+        # columnar fast path (see BufferNode): batches materialize their
+        # time/threshold columns once, and expiry runs off a threshold
+        # min-heap (O(expired log n) per epoch) instead of re-evaluating
+        # threshold_fn over the whole alive set every epoch.  A bail
+        # reverts the node to the legacy full-sweep (the oracle).
+        self.vec_temporal: tuple | None = None
+        self._expiry: list = []  # min-heap of (thr, seq, key, row)
+        self._alive_thr: dict[int, Any] = {}
+        self._heap_seq = 0
+        self._sweep_legacy = False
+
+    def persist_load(self, data) -> None:
+        super().persist_load(data)
+        # a restored alive-set has no heap entries; the legacy sweep is
+        # the semantics reference and needs none
+        self._sweep_legacy = True
+
+    def _ingest_columnar(self, deltas, out) -> bool:
+        import heapq
+
+        from pathway_tpu.internals import vector_compiler as vc
+
+        if self.vec_temporal is None or not vc.ENABLED or self._sweep_legacy:
+            return False
+        if not deltas:
+            return True
+        arrays = _vec_temporal_arrays(self, deltas, "forget")
+        if arrays is None:
+            return False
+        t, thr = arrays
+        tmax = t.max().item()
+        if self._watermark is None or tmax > self._watermark:
+            self._watermark = tmax
+        out.extend(deltas)
+        alive = self._alive
+        alive_thr = self._alive_thr
+        expiry = self._expiry
+        seq = self._heap_seq
+        for (key, row, diff), thr_v in zip(deltas, thr.tolist()):
+            if diff > 0:
+                alive[key] = row
+                alive_thr[key] = thr_v
+                seq += 1
+                heapq.heappush(expiry, (thr_v, seq, key, row))
+            else:
+                alive.pop(key, None)
+                alive_thr.pop(key, None)
+        self._heap_seq = seq
+        return True
 
     def step(self, time):
+        import heapq
+
         out = []
-        for key, row, diff in consolidate(self.take_pending()):
-            t = self.time_fn(key, row)
-            if self._watermark is None or t > self._watermark:
-                self._watermark = t
-            out.append((key, row, diff))
-            if diff > 0:
-                self._alive[key] = row
+        deltas = consolidate(self.take_pending())
+        vec = self._ingest_columnar(deltas, out)
+        if not vec:
+            if not self._sweep_legacy:
+                # heap entries no longer cover the alive set; the legacy
+                # sweep takes over until the alive set drains
+                self._sweep_legacy = True
+                self._expiry.clear()
+                self._alive_thr.clear()
+            for key, row, diff in deltas:
+                t = self.time_fn(key, row)
+                if self._watermark is None or t > self._watermark:
+                    self._watermark = t
+                out.append((key, row, diff))
+                if diff > 0:
+                    self._alive[key] = row
+                else:
+                    self._alive.pop(key, None)
+        if deltas:
+            if vec:
+                self.vec_batches += 1
             else:
-                self._alive.pop(key, None)
-        if self._watermark is not None:
-            for key in list(self._alive):
-                row = self._alive[key]
-                if self.threshold_fn(key, row) <= self._watermark:
+                self.row_batches += 1
+        wm = self._watermark
+        if wm is not None:
+            if self._sweep_legacy:
+                for key in list(self._alive):
+                    row = self._alive[key]
+                    if self.threshold_fn(key, row) <= wm:
+                        out.append((key, row, -1))
+                        del self._alive[key]
+                if not self._alive:
+                    self._sweep_legacy = False  # drained: fast path resumes
+            else:
+                expiry = self._expiry
+                alive = self._alive
+                alive_thr = self._alive_thr
+                while expiry and expiry[0][0] <= wm:
+                    thr_v, _seq, key, row = heapq.heappop(expiry)
+                    if alive.get(key) != row or alive_thr.get(key) != thr_v:
+                        continue  # superseded entry (rekeyed or retracted)
                     out.append((key, row, -1))
-                    del self._alive[key]
+                    del alive[key]
+                    del alive_thr[key]
         out = consolidate(out)
         if self.keep_state:
             self._update_state(out)
@@ -1686,17 +1932,55 @@ class FreezeNode(Node):
         self.time_fn = time_fn
         self.threshold_fn = threshold_fn
         self._watermark = None
+        # columnar fast path (see BufferNode): the admit/advance scan has
+        # a sequential data dependence (later rows see earlier KEPT rows'
+        # watermark), so it runs as one native freeze_scan pass over the
+        # materialized time/threshold columns rather than a numpy op
+        self.vec_temporal: tuple | None = None
+
+    def _step_columnar(self, deltas):
+        from pathway_tpu.internals import vector_compiler as vc
+
+        # stateless per batch (unlike the buffer's held-threshold cache),
+        # so the standard small-batch gate applies: below the threshold
+        # the row loop beats materialize + array ops
+        if (
+            self.vec_temporal is None
+            or not vc.ENABLED
+            or len(deltas) < _vec_threshold()
+        ):
+            return None
+        arrays = _vec_temporal_arrays(self, deltas, "freeze")
+        if arrays is None:
+            return None
+        t, thr = arrays
+        import numpy as np
+
+        mask, new_wm = vc.freeze_scan(t, thr, self._watermark)
+        self._watermark = new_wm
+        n_cols = len(deltas[0][1])
+        return vc.filter_deltas(
+            deltas, np.frombuffer(bytes(mask), np.uint8), n_cols
+        )
 
     def step(self, time):
-        out = []
-        for key, row, diff in consolidate(self.take_pending()):
-            t = self.time_fn(key, row)
-            thr = self.threshold_fn(key, row)
-            if self._watermark is not None and thr <= self._watermark:
-                continue  # frozen: late data dropped
-            if self._watermark is None or t > self._watermark:
-                self._watermark = t
-            out.append((key, row, diff))
+        deltas = consolidate(self.take_pending())
+        out = self._step_columnar(deltas)
+        if deltas:
+            if out is None:
+                self.row_batches += 1
+            else:
+                self.vec_batches += 1
+        if out is None:
+            out = []
+            for key, row, diff in deltas:
+                t = self.time_fn(key, row)
+                thr = self.threshold_fn(key, row)
+                if self._watermark is not None and thr <= self._watermark:
+                    continue  # frozen: late data dropped
+                if self._watermark is None or t > self._watermark:
+                    self._watermark = t
+                out.append((key, row, diff))
         out = consolidate(out)
         if self.keep_state:
             self._update_state(out)

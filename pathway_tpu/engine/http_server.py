@@ -156,6 +156,12 @@ def render_status(
             for k, v in scalars.items()
             if k.startswith((DEVICE_SECTION_PREFIX, "jax."))
         }
+        # columnar execution health: bail counters by op/reason — a
+        # pipeline silently running row-wise shows up here and in the
+        # `pathway_tpu top` columnar line
+        payload["columnar"] = {
+            k: v for k, v in scalars.items() if k.startswith("columnar.")
+        }
     return json.dumps(payload)
 
 

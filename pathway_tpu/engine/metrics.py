@@ -172,6 +172,11 @@ METRICS: dict[str, tuple[str, str]] = {
         "gauge", "supervisor restarts performed before this worker launch"),
     "worker.last_progress.age_s": (
         "gauge", "seconds since the worker's last epoch-progress beacon"),
+    # columnar execution path (internals/vector_compiler.py)
+    "columnar.bail.count": (
+        "counter", "columnar fast-path batches that fell back to the "
+        "row-wise evaluator, by op= and reason= (a silently bailing "
+        "pipeline runs at row speed while benchmarking columnar)"),
     # per-operator epoch profiler (engine/profiler.py)
     "profiler.operators": (
         "collector", "top-N per-operator attribution snapshot supplier"),

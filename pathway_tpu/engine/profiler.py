@@ -61,6 +61,32 @@ _TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
 _BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 
+def _node_path(node: Any) -> str | None:
+    """Columnar-vs-row attribution of one operator: which evaluator its
+    batches actually ran through (``None`` = the operator has no columnar
+    fast path, or saw no batches)."""
+    vec = getattr(node, "vec_batches", 0)
+    row = getattr(node, "row_batches", 0)
+    if vec and row:
+        return "mixed"
+    if vec:
+        return "columnar"
+    if row:
+        return "row"
+    return None
+
+
+def _bail_snapshot() -> list[dict[str, Any]]:
+    """Top columnar-bail reasons (never raises — snapshots must always
+    build, including from the crash path)."""
+    try:
+        from pathway_tpu.internals import vector_compiler as vc
+
+        return vc.bail_snapshot()
+    except Exception:  # noqa: BLE001 - forensics must not fail the sample
+        return []
+
+
 class EpochProfiler:
     """Sampled top-N per-operator attribution over a running dataflow.
 
@@ -123,6 +149,10 @@ class EpochProfiler:
                 "rows_in": node.rows_in,
                 "rows_out": node.rows_out,
                 "inputs": [inp.id for inp in node.inputs],
+                # which execution path actually ran (engine/dataflow.py
+                # vec_batches/row_batches): "columnar" / "row" / "mixed",
+                # None for operators without a columnar fast path
+                "path": _node_path(node),
             }
             for node in ranked[: self.top_n]
         ]
@@ -132,6 +162,7 @@ class EpochProfiler:
             "operators_total": len(ranked),
             "total_step_seconds": total,
             "operators": operators,
+            "bails": _bail_snapshot(),
         }
         return self._snapshot
 
@@ -339,10 +370,20 @@ def render_snapshot(snapshot: dict[str, Any], *, top: int | None = None) -> str:
         inputs = ", ".join(
             f"{names.get(i, 'op')}#{i}" for i in op.get("inputs") or []
         )
+        path = op.get("path")
         lines.append(
             f"  {tag(op):<{width}}  "
             f"{op.get('seconds') or 0.0:>9.3f} s  {share:>6.1%}  {bar:<20}  "
             f"rows {op.get('rows_in', '?')}->{op.get('rows_out', '?')}"
+            + (f"  [{path}]" if path else "")
             + (f"  <- {inputs}" if inputs else "")
         )
+    bails = snapshot.get("bails") or []
+    if bails:
+        lines.append("  columnar bails (fast path fell back to row-wise):")
+        for b in bails:
+            lines.append(
+                f"    {b.get('op', '?')}/{b.get('reason', '?')}: "
+                f"{b.get('count', '?')}"
+            )
     return "\n".join(lines)

@@ -286,6 +286,10 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
     _k("PATHWAY_NATIVE", "bool", True,
        "`0` disables the native C++ kernels (numpy/python fallback)",
        "models"),
+    _k("PATHWAY_COLUMNAR", "bool", True,
+       "`0` forces every operator onto the row-wise reference evaluator "
+       "(disables the columnar fast paths; see docs/columnar.md)",
+       "models"),
     # -- CLI ----------------------------------------------------------------
     _k("PATHWAY_SPAWN_ARGS", "str", None,
        "arguments for `pathway_tpu spawn-from-env` (the k8s-operator "

@@ -862,12 +862,15 @@ class Table(Joinable):
                     f_vec, needed = vec
                     cols = vc.materialize_delta_columns(deltas, needed)
                     if cols is None:
+                        vc.note_bail("filter", "dirty-column")
                         return None
                     try:
                         mask = f_vec(cols, len(deltas))
                     except vc.VecBail:
+                        vc.note_bail("filter", "value-guard")
                         return None
                     if mask.dtype.kind != "b":
+                        vc.note_bail("filter", "result-dtype")
                         return None
                     return vc.filter_deltas(deltas, mask, n_cols)
 
@@ -880,6 +883,11 @@ class Table(Joinable):
                         and len(deltas) >= vc.VEC_THRESHOLD
                     ):
                         out = self_inner._try_columnar(deltas)
+                    if deltas and vec is not None:
+                        if out is None:
+                            self_inner.row_batches += 1
+                        else:
+                            self_inner.vec_batches += 1
                     if out is None:
                         out = []
                         for key, row, diff in deltas:
@@ -1713,12 +1721,23 @@ class Table(Joinable):
         time_e = _desugar(expr_mod._wrap(time_column), self)
 
         def build(lowerer: Lowerer) -> df.Node:
+            from pathway_tpu.internals import vector_compiler as vc
+
             base = lowerer.node(self)
             binder = RowBinder(lowerer, self)
             tf = compile_expr(time_e, binder)
             thf = compile_expr(thr_e, binder)
             node_in = _fetch_chain(lowerer, base, binder)
             node = node_cls(lowerer.scope, node_in, tf, thf)
+            # columnar spec: window behaviors lower their time/threshold
+            # math to column ± const, so the whole epoch batch's pane
+            # admit/expiry arithmetic can run as array ops (the node bails
+            # back to tf/thf — the oracle — on anything the arrays cannot
+            # honor exactly)
+            spec_t = vc.affine_index(time_e, binder)
+            spec_thr = vc.affine_index(thr_e, binder)
+            if spec_t is not None and spec_thr is not None:
+                node.vec_temporal = (*spec_t, *spec_thr)
             return _trim_if_needed(lowerer, node, binder, len(self.column_names()))
 
         return Table(self._schema, build, universe=Universe(parent=self._universe))
@@ -2006,32 +2025,55 @@ class GroupedTable:
             gb_node.vec_group = _vec_group_spec(
                 g_exprs, inst_expr, grouped_by_id, slots, binder
             )
+            key_idxs = _group_key_idxs(g_exprs, inst_expr, grouped_by_id, binder)
+            if key_idxs is not None:
+                # batched exchange routing: the group route key is
+                # hash_values over exactly these column values, so the
+                # per-row route loop collapses to one native pass
+                # (hash_none=True: group keys hash Nones like any value)
+                gb_node.exchange_route_cols = {0: (key_idxs, True)}
             return gb_node
+
+        def _plain_col_idx(e, binder):
+            from pathway_tpu.internals.thisclass import ThisPlaceholder
+
+            if not isinstance(e, ColumnReference):
+                return None
+            if not (isinstance(e.table, ThisPlaceholder) or e.table is binder.table):
+                return None
+            if e.name == "id" or e.name not in binder.col_index:
+                return None
+            return binder.col_index[e.name]
+
+        def _group_key_idxs(g_exprs, inst_expr, grouped_by_id, binder):
+            """Column indices whose row values ARE the group key tuple (in
+            group-key order, instance last) — None when any key is not a
+            plain same-table column."""
+            if grouped_by_id or not g_exprs:
+                return None
+            idxs = [_plain_col_idx(e, binder) for e in g_exprs]
+            if inst_expr is not None:
+                idxs.append(_plain_col_idx(inst_expr, binder))
+            if any(i is None for i in idxs):
+                return None
+            return tuple(idxs)
 
         def _vec_group_spec(g_exprs, inst_expr, grouped_by_id, slots, binder):
             """Columnar groupby spec (GroupByNode.vec_group) when the shape
-            allows it: one plain grouping column, count/sum/avg/min/max
-            reducers over plain columns.  Anything else keeps the row path."""
+            allows it: plain grouping columns (instance included — it is
+            just one more key column), count/sum/avg/min/max reducers over
+            plain columns.  Anything else keeps the row path."""
             from pathway_tpu.internals.reducers import (
                 AvgReducer,
                 CountReducer,
                 SumReducer,
             )
-            from pathway_tpu.internals.thisclass import ThisPlaceholder
 
             def plain_idx(e):
-                if not isinstance(e, ColumnReference):
-                    return None
-                if not (isinstance(e.table, ThisPlaceholder) or e.table is binder.table):
-                    return None
-                if e.name == "id" or e.name not in binder.col_index:
-                    return None
-                return binder.col_index[e.name]
+                return _plain_col_idx(e, binder)
 
-            if grouped_by_id or inst_expr is not None or not g_exprs:
-                return None
-            g_idxs = tuple(plain_idx(e) for e in g_exprs)
-            if any(i is None for i in g_idxs):
+            g_idxs = _group_key_idxs(g_exprs, inst_expr, grouped_by_id, binder)
+            if g_idxs is None:
                 return None
             # single-column groups keep the scalar spec (numpy unique /
             # native raw grouping); multi-column groups hash-group tuples
@@ -2235,6 +2277,16 @@ class JoinResult(Joinable):
             and not (outer and mode != 0)
         ):
             node.native_spec = (tuple(l_idxs), tuple(r_idxs), mode)
+        if vc.ENABLED and l_idxs and None not in l_idxs and None not in r_idxs:
+            # batched exchange routing: the route key is hash_values over
+            # the raw join-key column values (none_guard semantics: a
+            # None/Error key value routes the row by its own key), which
+            # the native route kernel reproduces byte-for-byte — no
+            # dtype gate needed, unlike the index fast path above
+            node.exchange_route_cols = {
+                0: (tuple(l_idxs), False),
+                1: (tuple(r_idxs), False),
+            }
         return node
 
     def select(self, *args, **kwargs) -> Table:

@@ -244,6 +244,35 @@ def render_top(
                 f"· {int(restarts or 0)} dispatch restart(s)"
             )
 
+    columnar = status.get("columnar") or {}
+    bail_total = sum(
+        v for k, v in columnar.items() if k.startswith("columnar.bail.count")
+    )
+    if bail_total:
+        # silent columnar→row fall-backs: the pipeline is paying row-wise
+        # cost on operators its benchmarks ran columnar (docs/columnar.md)
+        top_bails = sorted(
+            (
+                (k, v)
+                for k, v in columnar.items()
+                if k.startswith("columnar.bail.count") and v
+            ),
+            key=lambda kv: -kv[1],
+        )[:3]
+        detail = ", ".join(
+            "{}={:g}".format(
+                ",".join(
+                    f"{lk}:{lv}"
+                    for lk, lv in split_labeled_name(k)[1].items()
+                )
+                or "total",
+                v,
+            )
+            for k, v in top_bails
+        )
+        lines.append("")
+        lines.append(f"columnar: {int(bail_total)} bail(s) — {detail}")
+
     operators = status.get("operators") or {}
     if operators:
         lines.append("")

@@ -22,6 +22,7 @@ numpy where detectable.
 
 from __future__ import annotations
 
+from collections import Counter as _Counter
 from typing import Any, Callable
 
 import numpy as np
@@ -47,9 +48,21 @@ from pathway_tpu.internals.thisclass import ThisPlaceholder
 
 VEC_THRESHOLD = 64  # below this, per-row beats transpose + dispatch
 
+
+def _env_enabled() -> bool:
+    # declared kill switch (PATHWAY_COLUMNAR): ops can force the row-wise
+    # reference path fleet-wide without a code change
+    try:
+        from pathway_tpu.internals.config import env_bool
+
+        return env_bool("PATHWAY_COLUMNAR")
+    except Exception:  # noqa: BLE001 - config must never break compilation
+        return True
+
+
 # process-wide switch (benchmark baselines, debugging); the row path is the
 # reference semantics, the vector path must be observationally identical
-ENABLED = True
+ENABLED = _env_enabled()
 
 
 def set_enabled(flag: bool) -> None:
@@ -62,6 +75,49 @@ VecFn = Callable[[dict, int], np.ndarray]  # (columns by index, n) -> array
 class VecBail(Exception):
     """Data-dependent condition the vector path cannot honor; caller falls
     back to the per-row interpreter for this batch."""
+
+
+# ---------------------------------------------------------------------------
+# bail accounting: every fall-back from a columnar fast path to the row-wise
+# evaluator is counted per (operator, reason) — silent bails were invisible
+# before, so a pipeline could quietly run 5x slower than its benchmark twin.
+# Mirrored two ways: the metrics registry (`columnar.bail.count{op=,reason=}`,
+# /status + `pathway_tpu top`) and a process-local Counter the profiler
+# snapshot embeds (`pathway_tpu profile` renders the top reasons).
+# ---------------------------------------------------------------------------
+
+BAIL_COUNTS: _Counter = _Counter()
+
+_bail_children: dict[tuple[str, str], Any] = {}
+
+
+def note_bail(op: str, reason: str) -> None:
+    """Record one columnar→row fall-back of operator kind ``op``."""
+    BAIL_COUNTS[(op, reason)] += 1
+    child = _bail_children.get((op, reason))
+    if child is None:
+        try:
+            from pathway_tpu.engine import metrics as _metrics
+
+            child = _metrics.get_registry().counter(
+                "columnar.bail.count",
+                "columnar fast-path batches that fell back to the row-wise "
+                "evaluator",
+                op=op,
+                reason=reason,
+            )
+        except Exception:  # noqa: BLE001 - accounting must never break a step
+            return
+        _bail_children[(op, reason)] = child
+    child.inc()
+
+
+def bail_snapshot(top: int = 8) -> list[dict[str, Any]]:
+    """Top bail reasons for profiler snapshots / post-mortems."""
+    return [
+        {"op": op, "reason": reason, "count": count}
+        for (op, reason), count in BAIL_COUNTS.most_common(top)
+    ]
 
 
 def _const_array(v, n: int) -> np.ndarray:
@@ -85,6 +141,113 @@ def passthrough_index(e, binder) -> int | None:
         ):
             return binder.col_index[e.name]
     return None
+
+
+def affine_index(e, binder) -> tuple[int, int | float] | None:
+    """``(col_idx, const_offset)`` when ``e`` is a same-table column plus/
+    minus a numeric constant (the shape every temporal threshold lowers to:
+    ``time``, ``time + delay``, ``end + cutoff``).  The temporal operators'
+    columnar path then evaluates the whole epoch's times/thresholds as one
+    array op.  None for anything else — the row path stays the oracle."""
+    idx = passthrough_index(e, binder)
+    if idx is not None:
+        return idx, 0
+    if isinstance(e, ColumnBinaryOpExpression) and e._op in ("+", "-"):
+        left, right = e._left, e._right
+        lidx = passthrough_index(left, binder)
+        if (
+            lidx is not None
+            and isinstance(right, ColumnConstExpression)
+            and type(right._val) in (int, float)
+        ):
+            off = right._val
+            return lidx, (-off if e._op == "-" else off)
+        ridx = passthrough_index(right, binder)
+        if (
+            e._op == "+"
+            and ridx is not None
+            and isinstance(left, ColumnConstExpression)
+            and type(left._val) in (int, float)
+        ):
+            return ridx, left._val
+    return None
+
+
+def affine_values(
+    cols: dict[int, np.ndarray], idx: int, offset: int | float
+) -> np.ndarray:
+    """Apply an :func:`affine_index` offset to a materialized column with
+    row-path exactness: numeric columns only, int offsets guarded against
+    int64 wrap (the row path adds Python bignums)."""
+    arr = cols[idx]
+    if arr.dtype.kind not in "if":
+        raise VecBail
+    if offset == 0 and isinstance(offset, int):
+        return arr
+    if arr.dtype.kind == "i" and isinstance(offset, int):
+        if _abs_bound(arr) + abs(offset) > _I64_MAX:
+            raise VecBail
+    return arr + offset
+
+
+def split_deltas(deltas: list, mask) -> tuple[list, list]:
+    """Partition a delta list by a uint8/bool mask (kept, dropped), rows
+    untouched — the batched form of the temporal buffers' release scan.
+    Native single pass when available."""
+    sd = _native_sym("split_deltas")
+    if sd is not None:
+        return sd(deltas, np.ascontiguousarray(mask, dtype=np.uint8))
+    kept: list = []
+    dropped: list = []
+    for d, keep in zip(deltas, np.asarray(mask).tolist()):
+        (kept if keep else dropped).append(d)
+    return kept, dropped
+
+
+def freeze_scan(
+    t: np.ndarray, thr: np.ndarray, watermark
+) -> tuple[bytearray, Any]:
+    """FreezeNode's sequential admit/advance scan over one epoch batch:
+    a row is kept unless ``thr <= watermark``; kept rows advance the
+    watermark to ``max(watermark, t)`` *as the scan runs* (later rows see
+    earlier rows' watermark).  Returns ``(keep mask, new watermark)``.
+
+    Native single pass (GIL-released) when available; the Python loop over
+    unboxed scalars is the fallback and matches the row path exactly."""
+    fs = _native_sym("freeze_scan")
+    if (
+        fs is not None
+        and t.dtype.kind == thr.dtype.kind
+        and t.dtype.kind in "if"
+        and t.dtype.itemsize == 8
+        and thr.dtype.itemsize == 8
+    ):
+        kind = "q" if t.dtype.kind == "i" else "d"
+        wm = watermark
+        if wm is not None and kind == "q" and (
+            not isinstance(wm, int) or not (-(2**63) <= wm < 2**63)
+        ):
+            fs = None  # mixed/bignum watermark: take the exact scalar loop
+        elif wm is not None and kind == "d" and not isinstance(wm, float):
+            fs = None
+        if fs is not None:
+            return fs(
+                kind,
+                np.ascontiguousarray(t),
+                np.ascontiguousarray(thr),
+                wm,
+            )
+    tl = t.tolist()
+    thl = thr.tolist()
+    wm = watermark
+    mask = bytearray(len(tl))
+    for i in range(len(tl)):
+        if wm is not None and thl[i] <= wm:
+            continue
+        if wm is None or tl[i] > wm:
+            wm = tl[i]
+        mask[i] = 1
+    return mask, wm
 
 
 def try_compile_vec(e: ColumnExpression, binder) -> tuple[VecFn, set[int]] | None:
@@ -352,6 +515,9 @@ def _native_sym(name: str):
                 "filter_deltas",
                 "group_indices",
                 "delta_diffs",
+                "split_deltas",
+                "freeze_scan",
+                "route_deltas",
             ):
                 syms[n] = getattr(mod, n, None)
         except Exception:

@@ -1480,6 +1480,282 @@ fail:
   return nullptr;
 }
 
+// gather_key_rows(deltas, idxs) -> [tuple(row[i] for i in idxs), ...]
+// The multi-column groupby's per-row key-tuple build as one C pass; the
+// tuples then hash-group through group_indices (same PyDict semantics as
+// the row path's arrangement dict).
+static PyObject *py_gather_key_rows(PyObject *, PyObject *args) {
+  PyObject *deltas, *idxs;
+  if (!PyArg_ParseTuple(args, "O!O!", &PyList_Type, &deltas, &PyTuple_Type,
+                        &idxs))
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(deltas);
+  Py_ssize_t n_keys = PyTuple_GET_SIZE(idxs);
+  std::vector<Py_ssize_t> kidx(n_keys);
+  for (Py_ssize_t c = 0; c < n_keys; c++) {
+    kidx[c] = PyLong_AsSsize_t(PyTuple_GET_ITEM(idxs, c));
+    if (kidx[c] < 0) {
+      if (!PyErr_Occurred())
+        PyErr_SetString(PyExc_ValueError, "gather: bad key index");
+      return nullptr;
+    }
+  }
+  PyObject *out = PyList_New(n);
+  if (!out) return nullptr;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *item = PyList_GET_ITEM(deltas, i);
+    if (!PyTuple_Check(item) || PyTuple_GET_SIZE(item) != 3) {
+      PyErr_SetString(PyExc_ValueError, "gather: deltas must be triples");
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyObject *row = PyTuple_GET_ITEM(item, 1);
+    PyObject *key = PyTuple_New(n_keys);
+    if (!key) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    for (Py_ssize_t c = 0; c < n_keys; c++) {
+      if (!PyTuple_Check(row) || kidx[c] >= PyTuple_GET_SIZE(row)) {
+        PyErr_SetString(PyExc_ValueError, "gather: key index out of range");
+        Py_DECREF(key);
+        Py_DECREF(out);
+        return nullptr;
+      }
+      PyObject *v = PyTuple_GET_ITEM(row, kidx[c]);
+      Py_INCREF(v);
+      PyTuple_SET_ITEM(key, c, v);
+    }
+    PyList_SET_ITEM(out, i, key);
+  }
+  return out;
+}
+
+// split_deltas(deltas, mask) -> (kept, dropped): partition a delta list by
+// a uint8 mask without touching rows — the temporal buffers' release scan
+// (BufferNode) and freeze/forget admit paths run it once per epoch batch.
+static PyObject *py_split_deltas(PyObject *, PyObject *args) {
+  PyObject *deltas, *mask_obj;
+  if (!PyArg_ParseTuple(args, "O!O", &PyList_Type, &deltas, &mask_obj))
+    return nullptr;
+  Py_buffer mask;
+  if (PyObject_GetBuffer(mask_obj, &mask, PyBUF_CONTIG_RO) != 0)
+    return nullptr;
+  Py_ssize_t n = PyList_GET_SIZE(deltas);
+  if (mask.len != n) {
+    PyBuffer_Release(&mask);
+    PyErr_SetString(PyExc_ValueError, "split: mask length mismatch");
+    return nullptr;
+  }
+  const char *m = (const char *)mask.buf;
+  PyObject *kept = PyList_New(0);
+  PyObject *dropped = PyList_New(0);
+  if (!kept || !dropped) {
+    Py_XDECREF(kept);
+    Py_XDECREF(dropped);
+    PyBuffer_Release(&mask);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i < n; i++) {
+    if (PyList_Append(m[i] ? kept : dropped, PyList_GET_ITEM(deltas, i)) !=
+        0) {
+      Py_DECREF(kept);
+      Py_DECREF(dropped);
+      PyBuffer_Release(&mask);
+      return nullptr;
+    }
+  }
+  PyBuffer_Release(&mask);
+  return Py_BuildValue("(NN)", kept, dropped);
+}
+
+// freeze_scan(kind "q"|"d", t buffer, thr buffer, watermark|None)
+//   -> (keep-mask bytearray, new watermark|None)
+// FreezeNode's sequential admit/advance scan as one GIL-released pass:
+// row i is kept unless thr[i] <= wm; kept rows advance wm to max(wm, t[i])
+// *in scan order* (later rows see earlier rows' watermark — the data
+// dependence that keeps this out of numpy).
+static PyObject *py_freeze_scan(PyObject *, PyObject *args) {
+  const char *kind;
+  PyObject *t_obj, *thr_obj, *wm_obj;
+  if (!PyArg_ParseTuple(args, "sOOO", &kind, &t_obj, &thr_obj, &wm_obj))
+    return nullptr;
+  Py_buffer t, thr;
+  if (PyObject_GetBuffer(t_obj, &t, PyBUF_CONTIG_RO) != 0) return nullptr;
+  if (PyObject_GetBuffer(thr_obj, &thr, PyBUF_CONTIG_RO) != 0) {
+    PyBuffer_Release(&t);
+    return nullptr;
+  }
+  PyObject *result = nullptr;
+  Py_ssize_t n = t.len / 8;
+  bool is_int = kind[0] == 'q';
+  bool has_wm = wm_obj != Py_None;
+  int64_t wm_i = 0;
+  double wm_d = 0.0;
+  bool ok = true;
+  if (t.len != thr.len || t.len % 8 != 0) {
+    PyErr_SetString(PyExc_ValueError, "freeze_scan: buffer length mismatch");
+    ok = false;
+  } else if (kind[0] != 'q' && kind[0] != 'd') {
+    PyErr_SetString(PyExc_ValueError, "freeze_scan: unknown kind");
+    ok = false;
+  } else if (has_wm) {
+    if (is_int) {
+      wm_i = PyLong_AsLongLong(wm_obj);
+      if (wm_i == -1 && PyErr_Occurred()) ok = false;
+    } else {
+      wm_d = PyFloat_AsDouble(wm_obj);
+      if (wm_d == -1.0 && PyErr_Occurred()) ok = false;
+    }
+  }
+  PyObject *mask = ok ? PyByteArray_FromStringAndSize(nullptr, n) : nullptr;
+  if (ok && mask) {
+    char *m = PyByteArray_AS_STRING(mask);
+    const int64_t *ti = (const int64_t *)t.buf;
+    const int64_t *thi = (const int64_t *)thr.buf;
+    const double *td = (const double *)t.buf;
+    const double *thd = (const double *)thr.buf;
+    Py_BEGIN_ALLOW_THREADS
+    if (is_int) {
+      for (Py_ssize_t i = 0; i < n; i++) {
+        if (has_wm && thi[i] <= wm_i) {
+          m[i] = 0;
+          continue;
+        }
+        if (!has_wm || ti[i] > wm_i) {
+          wm_i = ti[i];
+          has_wm = true;
+        }
+        m[i] = 1;
+      }
+    } else {
+      for (Py_ssize_t i = 0; i < n; i++) {
+        if (has_wm && thd[i] <= wm_d) {
+          m[i] = 0;
+          continue;
+        }
+        if (!has_wm || td[i] > wm_d) {
+          wm_d = td[i];
+          has_wm = true;
+        }
+        m[i] = 1;
+      }
+    }
+    Py_END_ALLOW_THREADS
+    PyObject *wm_out;
+    if (!has_wm) {
+      wm_out = Py_None;
+      Py_INCREF(wm_out);
+    } else if (is_int) {
+      wm_out = PyLong_FromLongLong(wm_i);
+    } else {
+      wm_out = PyFloat_FromDouble(wm_d);
+    }
+    if (wm_out) result = Py_BuildValue("(NN)", mask, wm_out);
+    if (!result) Py_DECREF(mask);
+  } else {
+    Py_XDECREF(mask);
+  }
+  PyBuffer_Release(&t);
+  PyBuffer_Release(&thr);
+  return result;
+}
+
+// route_deltas(deltas, key_idxs, n_dest, hash_none) -> [dest lists]
+// The exchange hot loop (engine/comm.py exchange_deltas) batched: per row,
+// serialize the routing-key columns exactly as hash_values does, blake2b,
+// dest = (low 16 bits) % n_dest — the shard_to_worker rule.  hash_none=0
+// (equi-join none_guard semantics): a None/Error key value routes the row
+// by its own key; hash_none=1 (groupby keys): Nones hash like any value.
+// Any per-row serialization failure routes by row key, mirroring the
+// Python loop's per-row exception fallback.
+static PyObject *py_route_deltas(PyObject *, PyObject *args) {
+  PyObject *deltas, *idxs;
+  int n_dest, hash_none;
+  if (!PyArg_ParseTuple(args, "O!O!ip", &PyList_Type, &deltas, &PyTuple_Type,
+                        &idxs, &n_dest, &hash_none))
+    return nullptr;
+  if (n_dest <= 0) {
+    PyErr_SetString(PyExc_ValueError, "route: n_dest must be positive");
+    return nullptr;
+  }
+  Py_ssize_t n_keys = PyTuple_GET_SIZE(idxs);
+  std::vector<Py_ssize_t> kidx(n_keys);
+  for (Py_ssize_t c = 0; c < n_keys; c++) {
+    kidx[c] = PyLong_AsSsize_t(PyTuple_GET_ITEM(idxs, c));
+    if (kidx[c] < 0) {
+      if (!PyErr_Occurred())
+        PyErr_SetString(PyExc_ValueError, "route: bad key index");
+      return nullptr;
+    }
+  }
+  PyObject *out = PyList_New(n_dest);
+  if (!out) return nullptr;
+  for (int d = 0; d < n_dest; d++) {
+    PyObject *lst = PyList_New(0);
+    if (!lst) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyList_SET_ITEM(out, d, lst);
+  }
+  Py_ssize_t n = PyList_GET_SIZE(deltas);
+  Buf buf;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *item = PyList_GET_ITEM(deltas, i);
+    long dest = -1;
+    if (PyTuple_Check(item) && PyTuple_GET_SIZE(item) == 3) {
+      PyObject *row = PyTuple_GET_ITEM(item, 1);
+      bool by_key = false;
+      if (!PyTuple_Check(row)) {
+        by_key = true;
+      } else {
+        for (Py_ssize_t c = 0; c < n_keys && !by_key; c++) {
+          if (kidx[c] >= PyTuple_GET_SIZE(row)) {
+            by_key = true;
+            break;
+          }
+          PyObject *v = PyTuple_GET_ITEM(row, kidx[c]);
+          if (!hash_none && (v == Py_None || v == g_error_obj)) by_key = true;
+        }
+      }
+      if (!by_key) {
+        buf.d.clear();
+        for (Py_ssize_t c = 0; c < n_keys && !by_key; c++) {
+          if (!ser_value(PyTuple_GET_ITEM(row, kidx[c]), buf)) {
+            PyErr_Clear();  // per-row fallback, like the Python loop
+            by_key = true;
+          }
+        }
+      }
+      if (by_key) {
+        PyObject *key = PyTuple_GET_ITEM(item, 0);
+        uint64_t lo = PyLong_AsUnsignedLongLongMask(key);
+        if (lo == (uint64_t)-1 && PyErr_Occurred()) {
+          Py_DECREF(out);
+          return nullptr;  // a non-int row key crashes the Python loop too
+        }
+        dest = (long)((lo & 0xFFFFu) % (uint64_t)n_dest);
+      } else {
+        uint8_t digest[16];
+        blake2b_hash(digest, 16, buf.d.data(), buf.d.size());
+        uint64_t lo;
+        std::memcpy(&lo, digest, 8);
+        dest = (long)((lo & 0xFFFFu) % (uint64_t)n_dest);
+      }
+    } else {
+      PyErr_SetString(PyExc_ValueError, "route: deltas must be triples");
+      Py_DECREF(out);
+      return nullptr;
+    }
+    if (PyList_Append(PyList_GET_ITEM(out, dest), item) != 0) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+  }
+  return out;
+}
+
 // rebuild_delta_rows(deltas, cols) with cols entries:
 //   ("q"|"d"|"?", buffer) | ("U", list) | ("P", source column index) —
 //   "P" copies the value straight from the input row (passthrough)
@@ -2804,6 +3080,16 @@ static PyMethodDef methods[] = {
      "(deltas, [(kind, buf|list|src_idx), ...]) -> [(key, row, diff), ...]"},
     {"filter_deltas", py_filter_deltas, METH_VARARGS,
      "(deltas, uint8 mask buffer, n_cols) -> kept deltas, rows truncated"},
+    {"split_deltas", py_split_deltas, METH_VARARGS,
+     "(deltas, uint8 mask buffer) -> (kept, dropped), rows untouched"},
+    {"gather_key_rows", py_gather_key_rows, METH_VARARGS,
+     "(deltas, idxs) -> per-row key tuples (multi-column group keys)"},
+    {"freeze_scan", py_freeze_scan, METH_VARARGS,
+     "(kind, t buffer, thr buffer, watermark|None) -> (keep mask, new "
+     "watermark) — FreezeNode's sequential admit/advance scan"},
+    {"route_deltas", py_route_deltas, METH_VARARGS,
+     "(deltas, key_idxs, n_dest, hash_none) -> per-destination delta "
+     "lists (exchange shard routing, hash_values-compatible)"},
     {"stage_static", py_stage_static, METH_VARARGS,
      "(quads, clean_list_cls) -> [(time, deltas, clean)] partition + "
      "cleanliness proof; clean buckets built as clean_list_cls"},

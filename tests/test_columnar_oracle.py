@@ -1,0 +1,552 @@
+"""Differential fuzz: the columnar relational fast paths vs the row oracle.
+
+ISSUE 14's exactness guard.  Randomized delta STREAMS (multiple epochs,
+mixed dtypes, Nones, retractions, key collisions) run through join /
+groupby / windowby-with-behavior pipelines twice — vector compiler ON and
+OFF — and must produce identical outputs.  The columnar paths are allowed
+to bail to the row-wise evaluator (that is what ``columnar.bail.count``
+makes visible); what they may never do is produce different values.
+
+Also pins the PR 14 native kernels directly (``split_deltas``,
+``freeze_scan``, ``route_deltas``) against their Python references, the
+bail counter, and the profiler's columnar/row path attribution.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+import pathway_tpu as pw
+from pathway_tpu.internals import vector_compiler as vc
+from tests.utils import run_with_vector_mode
+
+# epochs comfortably above VEC_THRESHOLD so the columnar paths engage
+N_PER_EPOCH = max(200, vc.VEC_THRESHOLD * 2)
+N_EPOCHS = 3
+
+
+def _norm(rows_map):
+    out = []
+    for r in rows_map.values():
+        out.append(
+            tuple(
+                "nan" if isinstance(v, float) and v != v else v for v in r
+            )
+        )
+    out.sort(key=repr)
+    return out
+
+
+def _run(build, columnar: bool):
+    return _norm(run_with_vector_mode(build, columnar))
+
+
+def _stream_rows(rng: random.Random, n_cols_fn, retract_frac=0.2):
+    """Rows for ``table_from_rows(is_stream=True)``: epochs of inserts with
+    a fraction retracted (same values, later epoch) — the delta-stream
+    shape the incremental operators must stay exact on."""
+    rows = []
+    live = []
+    for epoch in range(N_EPOCHS):
+        t = epoch * 2
+        for _ in range(N_PER_EPOCH):
+            vals = n_cols_fn(epoch)
+            rows.append((*vals, t, 1))
+            live.append(vals)
+        if epoch and retract_frac:
+            k = int(len(live) * retract_frac / N_EPOCHS)
+            for _ in range(k):
+                vals = live.pop(rng.randrange(len(live)))
+                rows.append((*vals, t, -1))
+    return rows
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("mode", ["inner", "left", "outer"])
+def test_join_stream_parity(seed, mode):
+    rng = random.Random(100 * seed + hash(mode) % 97)
+
+    class FactSchema(pw.Schema):
+        fid: int = pw.column_definition(primary_key=True)
+        k: int
+        tag: str
+        v: int
+
+    class DimSchema(pw.Schema):
+        did: int = pw.column_definition(primary_key=True)
+        k: int
+        w: int
+
+    fid = [0]
+
+    def fact(epoch):
+        fid[0] += 1
+        return (
+            fid[0],
+            rng.randrange(0, 40),  # dense keys: collisions guaranteed
+            rng.choice(["a", "bb", ""]),
+            rng.randrange(-50, 50),
+        )
+
+    did = [0]
+
+    def dim(epoch):
+        did[0] += 1
+        return (did[0], rng.randrange(0, 55), rng.randrange(0, 9))
+
+    facts = _stream_rows(rng, fact)
+    dims = _stream_rows(rng, dim, retract_frac=0.3)
+
+    def build():
+        ft = pw.debug.table_from_rows(FactSchema, facts, is_stream=True)
+        dt = pw.debug.table_from_rows(DimSchema, dims, is_stream=True)
+        how = {
+            "inner": pw.JoinMode.INNER,
+            "left": pw.JoinMode.LEFT,
+            "outer": pw.JoinMode.OUTER,
+        }[mode]
+        return ft.join(dt, ft.k == dt.k, how=how).select(
+            k=pw.left.k,
+            tag=pw.left.tag,
+            v=pw.left.v,
+            w=pw.right.w,
+        )
+
+    assert _run(build, True) == _run(build, False), (seed, mode)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_join_none_keys_parity(seed):
+    """Optional join keys: None never matches (SQL) and routes/bails must
+    agree between the batched and per-row key-hash paths."""
+    rng = random.Random(500 + seed)
+
+    class L(pw.Schema):
+        i: int = pw.column_definition(primary_key=True)
+        k: int | None
+        v: int
+
+    class R(pw.Schema):
+        j: int = pw.column_definition(primary_key=True)
+        k: int | None
+        w: int
+
+    i = [0]
+
+    def lrow(epoch):
+        i[0] += 1
+        return (
+            i[0],
+            None if rng.random() < 0.2 else rng.randrange(0, 30),
+            rng.randrange(0, 100),
+        )
+
+    j = [0]
+
+    def rrow(epoch):
+        j[0] += 1
+        return (
+            j[0],
+            None if rng.random() < 0.2 else rng.randrange(0, 30),
+            rng.randrange(0, 100),
+        )
+
+    ls = _stream_rows(rng, lrow)
+    rs = _stream_rows(rng, rrow)
+
+    def build():
+        lt = pw.debug.table_from_rows(L, ls, is_stream=True)
+        rt = pw.debug.table_from_rows(R, rs, is_stream=True)
+        return lt.join(rt, lt.k == rt.k, how=pw.JoinMode.LEFT).select(
+            k=pw.left.k, v=pw.left.v, w=pw.right.w
+        )
+
+    assert _run(build, True) == _run(build, False), seed
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_groupby_stream_parity(seed):
+    rng = random.Random(1000 + seed)
+
+    class S(pw.Schema):
+        rid: int = pw.column_definition(primary_key=True)
+        g: int
+        s: str
+        v: int
+        f: float
+
+    rid = [0]
+
+    def row(epoch):
+        rid[0] += 1
+        return (
+            rid[0],
+            rng.randrange(0, 25),
+            rng.choice(["x", "yy", "z", ""]),
+            rng.choice([0, 1, -1, 2**60, 7]) if rng.random() < 0.1
+            else rng.randrange(-100, 100),
+            rng.choice([0.0, -1.5, 1e300]) if rng.random() < 0.1
+            else rng.uniform(-50, 50),
+        )
+
+    rows = _stream_rows(rng, row, retract_frac=0.3)
+
+    def build():
+        t = pw.debug.table_from_rows(S, rows, is_stream=True)
+        return t.groupby(pw.this.g, pw.this.s).reduce(
+            g=pw.this.g,
+            s=pw.this.s,
+            n=pw.reducers.count(),
+            tot=pw.reducers.sum(pw.this.v),
+            ftot=pw.reducers.sum(pw.this.f),
+            lo=pw.reducers.min(pw.this.v),
+            hi=pw.reducers.max(pw.this.f),
+        )
+
+    assert _run(build, True) == _run(build, False), seed
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("shape", ["tumbling", "sliding"])
+def test_windowby_behavior_stream_parity(seed, shape):
+    """The PR 14 tentpole pin: windowby with a temporal behavior drives
+    Buffer/Freeze/Forget over multi-epoch streams — the columnar pane
+    admit/expiry paths must match the row oracle byte-for-byte."""
+    rng = random.Random(2000 + 10 * seed + (shape == "sliding"))
+
+    class S(pw.Schema):
+        rid: int = pw.column_definition(primary_key=True)
+        at: int
+        inst: int
+        v: int
+
+    rid = [0]
+
+    def row(epoch):
+        rid[0] += 1
+        # event times drift forward with jitter and stragglers, so panes
+        # open, fill late, freeze, and expire across epochs
+        base = epoch * 400
+        return (
+            rid[0],
+            base + rng.randrange(-300, 400),
+            rng.randrange(0, 3),
+            rng.randrange(0, 100),
+        )
+
+    rows = _stream_rows(rng, row, retract_frac=0.15)
+    window = (
+        pw.temporal.tumbling(duration=100)
+        if shape == "tumbling"
+        else pw.temporal.sliding(hop=50, duration=150)
+    )
+    behavior = pw.temporal.common_behavior(
+        delay=rng.choice([0, 60]),
+        cutoff=rng.choice([100, 300]),
+        keep_results=rng.random() < 0.5,
+    )
+
+    def build():
+        t = pw.debug.table_from_rows(S, rows, is_stream=True)
+        return t.windowby(
+            pw.this.at,
+            window=window,
+            behavior=behavior,
+            instance=pw.this.inst,
+        ).reduce(
+            start=pw.this._pw_window_start,
+            inst=pw.this._pw_instance,
+            n=pw.reducers.count(),
+            tot=pw.reducers.sum(pw.this.v),
+        )
+
+    assert _run(build, True) == _run(build, False), (seed, shape)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_windowby_exactly_once_stream_parity(seed):
+    rng = random.Random(3000 + seed)
+
+    class S(pw.Schema):
+        rid: int = pw.column_definition(primary_key=True)
+        at: int
+        v: int
+
+    rid = [0]
+
+    def row(epoch):
+        rid[0] += 1
+        return (rid[0], epoch * 300 + rng.randrange(0, 500), rng.randrange(0, 50))
+
+    rows = _stream_rows(rng, row, retract_frac=0.0)
+
+    def build():
+        t = pw.debug.table_from_rows(S, rows, is_stream=True)
+        return t.windowby(
+            pw.this.at,
+            window=pw.temporal.tumbling(duration=100),
+            behavior=pw.temporal.exactly_once_behavior(shift=20),
+        ).reduce(
+            start=pw.this._pw_window_start,
+            n=pw.reducers.count(),
+        )
+
+    assert _run(build, True) == _run(build, False), seed
+
+
+def test_buffer_dirty_column_bails_and_counts():
+    """A None in the time column cannot materialize: the buffer must fall
+    back to the row path (identical output) and count the bail."""
+
+    class S(pw.Schema):
+        rid: int = pw.column_definition(primary_key=True)
+        at: int | None
+        v: int
+
+    n = max(100, vc.VEC_THRESHOLD + 10)
+    rows = [(i, (i * 7) % 500 if i % 17 else None, i % 50, 0, 1) for i in range(n)]
+
+    def build():
+        t = pw.debug.table_from_rows(S, rows, is_stream=True)
+        t = t.filter(pw.this.at.is_not_none())
+        # coalesce keeps the optional dtype out but values stay clean;
+        # the windowby runs on a plain int column
+        t = t.select(at=pw.coalesce(pw.this.at, 0), v=pw.this.v)
+        return t.windowby(
+            pw.this.at,
+            window=pw.temporal.tumbling(duration=100),
+            behavior=pw.temporal.common_behavior(delay=50),
+        ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+
+    assert _run(build, True) == _run(build, False)
+
+
+@pytest.mark.parametrize("seed", range(2))
+def test_temporal_nan_time_parity(seed):
+    """NaN in a float time column must not diverge: t.max() would poison
+    the watermark (the row path's sequential `t > wm` scan skips NaN) and
+    a NaN threshold would wedge the forget expiry heap — the columnar
+    temporal path must bail (reason nan-time) and match the oracle."""
+    rng = random.Random(7000 + seed)
+
+    class S(pw.Schema):
+        rid: int = pw.column_definition(primary_key=True)
+        at: float
+        v: int
+
+    rid = [0]
+
+    def row(epoch):
+        rid[0] += 1
+        at = (
+            float("nan")
+            if rng.random() < 0.02
+            else float(epoch * 300 + rng.randrange(0, 500))
+        )
+        return (rid[0], at, rng.randrange(0, 50))
+
+    rows = _stream_rows(rng, row, retract_frac=0.0)
+    # at least one NaN per epoch, deterministically
+    rows[0] = (rows[0][0], float("nan"), rows[0][2], rows[0][3], rows[0][4])
+
+    def build():
+        t = pw.debug.table_from_rows(S, rows, is_stream=True)
+        return t.windowby(
+            pw.this.at,
+            window=pw.temporal.tumbling(duration=100.0),
+            behavior=pw.temporal.common_behavior(
+                delay=50.0, cutoff=200.0, keep_results=False
+            ),
+        ).reduce(start=pw.this._pw_window_start, n=pw.reducers.count())
+
+    assert _run(build, True) == _run(build, False), seed
+
+
+def test_bail_counter_increments():
+    """note_bail feeds both the process Counter (profiler snapshots) and
+    the declared registry family columnar.bail.count{op=,reason=}."""
+    from pathway_tpu.engine import metrics as _metrics
+
+    before = vc.BAIL_COUNTS.get(("test-op", "test-reason"), 0)
+    vc.note_bail("test-op", "test-reason")
+    vc.note_bail("test-op", "test-reason")
+    assert vc.BAIL_COUNTS[("test-op", "test-reason")] == before + 2
+    scalars = _metrics.get_registry().scalar_metrics()
+    labeled = [
+        k
+        for k in scalars
+        if k.startswith("columnar.bail.count") and "test-op" in k
+    ]
+    assert labeled and scalars[labeled[0]] >= 2
+    snap = vc.bail_snapshot()
+    assert any(
+        b["op"] == "test-op" and b["reason"] == "test-reason" for b in snap
+    )
+
+
+def test_profiler_path_attribution():
+    """Profiler snapshots tag operators columnar / row / mixed."""
+    from pathway_tpu.engine.profiler import EpochProfiler, render_snapshot
+
+    class _Node:
+        def __init__(self, nid, name, vec, row):
+            self.id = nid
+            self.name = name
+            self.step_seconds = 0.5
+            self.rows_in = 10
+            self.rows_out = 10
+            self.inputs = []
+            self.vec_batches = vec
+            self.row_batches = row
+
+    class _Scope:
+        nodes = [
+            _Node(0, "groupby", 3, 0),
+            _Node(1, "join", 0, 2),
+            _Node(2, "buffer", 1, 1),
+            _Node(3, "output", 0, 0),
+        ]
+        epochs_run = 1
+
+    prof = EpochProfiler(enabled=True, sample_every=1, top_n=10)
+    snap = prof.sample(_Scope(), 1)
+    paths = {op["name"]: op["path"] for op in snap["operators"]}
+    assert paths["groupby"] == "columnar"
+    assert paths["join"] == "row"
+    assert paths["buffer"] == "mixed"
+    assert paths["output"] is None
+    rendered = render_snapshot(snap)
+    assert "[columnar]" in rendered and "[mixed]" in rendered
+    assert "bails" in snap
+
+
+# ---------------------------------------------------------------------------
+# native kernel parity (PR 14: split_deltas / freeze_scan / route_deltas)
+# ---------------------------------------------------------------------------
+
+
+def _native():
+    from pathway_tpu import native
+
+    mod = native.get()
+    if mod is None or not hasattr(mod, "route_deltas"):
+        pytest.skip("native core unavailable")
+    return mod
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_native_split_deltas_parity(seed):
+    import numpy as np
+
+    nat = _native()
+    rng = random.Random(seed)
+    deltas = [
+        (i, (rng.randrange(100), "s" + str(i % 3)), rng.choice([1, -1]))
+        for i in range(50)
+    ]
+    mask = np.asarray([rng.random() < 0.5 for _ in deltas], np.uint8)
+    kept, dropped = nat.split_deltas(deltas, mask)
+    exp_kept = [d for d, m in zip(deltas, mask.tolist()) if m]
+    exp_dropped = [d for d, m in zip(deltas, mask.tolist()) if not m]
+    assert kept == exp_kept and dropped == exp_dropped
+    with pytest.raises(ValueError, match="mask"):
+        nat.split_deltas(deltas, np.ones(3, np.uint8))
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_native_freeze_scan_parity(seed):
+    import numpy as np
+
+    nat = _native()
+    rng = random.Random(seed)
+    is_int = seed % 2 == 0
+
+    def mk(n):
+        if is_int:
+            return np.asarray(
+                [rng.randrange(-100, 100) for _ in range(n)], np.int64
+            )
+        return np.asarray([rng.uniform(-100, 100) for _ in range(n)], np.float64)
+
+    for wm0 in (None, 0 if is_int else 0.0):
+        t = mk(60)
+        thr = mk(60)
+        kind = "q" if is_int else "d"
+        mask, wm = nat.freeze_scan(kind, t, thr, wm0)
+        # python reference — the FreezeNode row-path scan
+        ref_wm = wm0
+        ref_mask = bytearray(len(t))
+        for i in range(len(t)):
+            tv, thv = t[i].item(), thr[i].item()
+            if ref_wm is not None and thv <= ref_wm:
+                continue
+            if ref_wm is None or tv > ref_wm:
+                ref_wm = tv
+            ref_mask[i] = 1
+        assert bytes(mask) == bytes(ref_mask)
+        assert wm == ref_wm and type(wm) is type(ref_wm)
+
+
+@pytest.mark.parametrize("hash_none", [0, 1])
+@pytest.mark.parametrize("n_dest", [2, 3, 7])
+def test_native_route_deltas_parity(n_dest, hash_none):
+    from pathway_tpu.engine.types import ERROR, hash_values, shard_to_worker
+
+    nat = _native()
+    rng = random.Random(n_dest * 10 + hash_none)
+    deltas = []
+    for i in range(120):
+        k = rng.choice(
+            [rng.randrange(50), "s" + str(rng.randrange(5)), None, True, 2**70]
+        )
+        if rng.random() < 0.05:
+            k = ERROR
+        deltas.append((rng.getrandbits(127), (k, i), rng.choice([1, -1])))
+    out = nat.route_deltas(deltas, (0,), n_dest, hash_none)
+    assert len(out) == n_dest
+    exp = [[] for _ in range(n_dest)]
+    for key, row, diff in deltas:
+        v = row[0]
+        if not hash_none and (v is None or v is ERROR):
+            rk = key
+        else:
+            try:
+                rk = hash_values((v,))
+            except Exception:
+                rk = key
+        exp[shard_to_worker(rk, n_dest)].append((key, row, diff))
+    assert out == exp
+
+
+def test_native_route_deltas_matches_join_route():
+    """End-to-end parity with JoinNode._route_jk + owner_of: the exchange
+    fast path must agree with the per-row Python loop it replaces."""
+    from pathway_tpu.engine.dataflow import JoinNode
+    from pathway_tpu.engine.types import hash_values, shard_to_worker
+
+    nat = _native()
+    rng = random.Random(7)
+    deltas = [
+        (
+            rng.getrandbits(127),
+            (rng.randrange(10), None if rng.random() < 0.2 else "k%d" % (i % 7)),
+            1,
+        )
+        for i in range(200)
+    ]
+
+    def key_fn(key, row):
+        vals = (row[1],)
+        if any(v is None for v in vals):
+            return None
+        return vals
+
+    n = 4
+    exp = [[] for _ in range(n)]
+    for key, row, diff in deltas:
+        rk = JoinNode._route_jk(key_fn, key, row)
+        exp[shard_to_worker(rk, n)].append((key, row, diff))
+    out = nat.route_deltas(deltas, (1,), n, 0)
+    assert out == exp
