@@ -99,6 +99,12 @@ SUITE: tuple[Bench, ...] = (
     Bench(
         "rescale_recovery", "rescale_recovery.py", ("smoke",), ("full",),
     ),
+    # autoscaler actuators: live shard-handoff downtime vs the restart
+    # fallback (backoff + rollback + redo) on identical roots — the
+    # handoff must stay measurably cheaper (handoff_speedup > 1)
+    Bench(
+        "rescale_handoff", "rescale_handoff.py", ("smoke",), ("full",),
+    ),
     # DeviceExecutor: bucketed dispatch vs ad-hoc per-shape jit + the
     # epoch-thread overlap won by async dispatch
     Bench(

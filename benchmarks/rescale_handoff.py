@@ -1,0 +1,191 @@
+"""Rescale-downtime benchmark: live shard handoff vs restart fallback.
+
+The autoscaler (engine/autoscaler.py) has two actuators for the same
+N -> N' decision.  The **live handoff** fences + drain-commits every
+worker's exact frontier and relaunches at N' immediately — nothing is
+lost, nothing sleeps.  The **restart fallback** (PR 10 machinery) rolls
+back to the last committed generation: it pays the supervisor's restart
+backoff, replays the same checkpoint, and then REDOES the uncommitted
+tail the rollback discarded.  This harness prices both paths on
+identical roots so `pathway_tpu bench --smoke --check` keeps the
+ordering honest — the handoff must stay measurably cheaper, or the
+autoscaler's whole reason to prefer it is gone:
+
+* ``handoff_rescale_ms`` — fence + drain-commit at N, repartition
+  resume at N' (the drained tail rides the checkpoint);
+* ``restart_rescale_ms`` — first restart-backoff delay (the
+  supervisor's real schedule, un-jittered), repartition resume at N'
+  without the tail, then re-ingest + commit the tail at N';
+* ``handoff_speedup`` — restart / handoff wall-clock ratio.
+
+Usage: ``python benchmarks/rescale_handoff.py [smoke|full]``
+Prints one JSON line per metric (harness.py protocol).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_OLD = 2
+N_NEW = 3
+SCHEMA = "k:INT|v:INT"
+
+
+def _key(w: int, i: int) -> int:
+    return ((w * 100_000 + i + 1) << 16) | ((w * 7919 + i * 31) & 0xFFFF)
+
+
+def _tail_key(i: int) -> int:
+    return ((500_000 + i + 1) << 16) | ((i * 131) & 0xFFFF)
+
+
+def _seed(root: str, chunks: int, rows_per_chunk: int) -> int:
+    """Commit ``chunks`` chunks of ``rows_per_chunk`` rows per old worker;
+    returns the committed row total."""
+    from pathway_tpu.engine import persistence as pz
+
+    os.environ["PATHWAY_PROCESSES"] = str(N_OLD)
+    backend = pz.FileBackend(root)
+    for w in range(N_OLD):
+        storage = pz.PersistentStorage(backend, worker=w)
+        state = storage.register_source(f"src-w{w}", schema_digest=SCHEMA)
+        for c in range(chunks):
+            for i in range(rows_per_chunk):
+                state.log.record(_key(w, c * rows_per_chunk + i), (w, i), 1)
+            state.log.flush_chunk()
+        state.pending_offset = {f"file-{w}": [1.0, chunks * rows_per_chunk]}
+        storage.commit()
+    return N_OLD * chunks * rows_per_chunk
+
+
+def _resume_old_with_tail(root: str, tail_rows: int, committed: int):
+    """Resume the old topology and stage (flush, do NOT commit) the
+    uncommitted tail — the in-flight work a rescale interrupts."""
+    from pathway_tpu.engine import persistence as pz
+    from pathway_tpu.engine.types import shard_to_worker
+
+    os.environ["PATHWAY_PROCESSES"] = str(N_OLD)
+    backend = pz.FileBackend(root)
+    storages = []
+    for w in range(N_OLD):
+        storage = pz.PersistentStorage(backend, worker=w)
+        state = storage.register_source(f"src-w{w}", schema_digest=SCHEMA)
+        storage.replay_into(state, lambda k, r, d: None)
+        storages.append((w, storage, state))
+    for w, _storage, state in storages:
+        staged = 0
+        for i in range(tail_rows):
+            key = _tail_key(i)
+            if shard_to_worker(key, N_OLD) != w:
+                continue
+            state.log.record(key, (9, i), 1)
+            staged += 1
+        if staged:
+            state.log.flush_chunk()
+            state.pending_offset = {f"file-{w}": [2.0, committed + staged]}
+    return backend, storages
+
+
+def _resume_new(root: str) -> int:
+    """Resume every worker of topology N' and replay; returns rows."""
+    from pathway_tpu.engine import persistence as pz
+
+    os.environ["PATHWAY_PROCESSES"] = str(N_NEW)
+    backend = pz.FileBackend(root)
+    total = 0
+    for w in range(N_NEW):
+        storage = pz.PersistentStorage(backend, worker=w)
+        state = storage.register_source(f"src-w{w}", schema_digest=SCHEMA)
+        total += storage.replay_into(state, lambda k, r, d: None)
+    return total
+
+
+def _redo_tail_at_new(root: str, tail_rows: int) -> None:
+    """The fallback's extra bill: re-ingest + commit the rolled-back tail
+    on its N' owners."""
+    from pathway_tpu.engine import persistence as pz
+    from pathway_tpu.engine.types import shard_to_worker
+
+    backend = pz.FileBackend(root)
+    for w in range(N_NEW):
+        storage = pz.PersistentStorage(backend, worker=w)
+        state = storage.register_source(f"src-w{w}", schema_digest=SCHEMA)
+        storage.replay_into(state, lambda k, r, d: None)
+        redone = 0
+        for i in range(tail_rows):
+            key = _tail_key(i)
+            if shard_to_worker(key, N_NEW) != w:
+                continue
+            state.log.record(key, (9, i), 1)
+            redone += 1
+        if redone:
+            state.log.flush_chunk()
+            state.pending_offset = {f"file-redo-{w}": [1.0, redone]}
+            storage.commit()
+
+
+def _restart_backoff_s() -> float:
+    """The first delay of the supervisor's real restart schedule
+    (engine/supervisor.py `_backoff_delays`), un-jittered for
+    determinism."""
+    from pathway_tpu.internals.udfs.retries import (
+        ExponentialBackoffRetryStrategy,
+    )
+
+    return next(
+        ExponentialBackoffRetryStrategy(
+            max_retries=1, initial_delay=200, backoff_factor=2, jitter_ms=0
+        ).delays()
+    )
+
+
+def main() -> None:
+    smoke = len(sys.argv) > 1 and sys.argv[1] == "smoke"
+    chunks = 2 if smoke else 6
+    rows_per_chunk = 400 if smoke else 2000
+    tail_rows = 800 if smoke else 4000
+
+    # -- live handoff: fence + drain-commit, resume at N' ------------------
+    with tempfile.TemporaryDirectory(prefix="pw-handoff-") as root:
+        committed = _seed(root, chunks, rows_per_chunk)
+        _backend, storages = _resume_old_with_tail(root, tail_rows, committed)
+
+        t0 = time.perf_counter()
+        for _w, storage, state in storages:
+            storage.fence_for_handoff(N_NEW)
+            storage.commit()  # the drain: publishes the staged tail
+        rows = _resume_new(root)
+        handoff_ms = (time.perf_counter() - t0) * 1000.0
+        assert rows == committed + tail_rows, (rows, committed, tail_rows)
+
+    # -- restart fallback: backoff, rolled-back resume at N', redo tail ---
+    with tempfile.TemporaryDirectory(prefix="pw-restart-") as root:
+        committed = _seed(root, chunks, rows_per_chunk)
+        # the tail was staged but never durable: a restart simply loses it
+        _resume_old_with_tail(root, tail_rows, committed)
+
+        t0 = time.perf_counter()
+        time.sleep(_restart_backoff_s())
+        rows = _resume_new(root)
+        _redo_tail_at_new(root, tail_rows)
+        restart_ms = (time.perf_counter() - t0) * 1000.0
+        assert rows == committed, (rows, committed)
+
+    for metric, value in (
+        ("handoff_rescale_ms", handoff_ms),
+        ("restart_rescale_ms", restart_ms),
+        ("handoff_speedup", restart_ms / handoff_ms),
+    ):
+        print(json.dumps({"metric": metric, "value": round(value, 4)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,7 @@ def spawn_program(
     max_restarts: int = 3,
     checkpoint_root: str | None = None,
     shrink_on_loss: bool | None = None,
+    autoscale: bool | None = None,
 ) -> NoReturn:
     """Launch ``processes`` copies of ``program`` forming one SPMD cluster.
 
@@ -96,6 +97,10 @@ def spawn_program(
     from pathway_tpu.engine.telemetry import mint_traceparent
 
     env_base.setdefault("TRACEPARENT", mint_traceparent())
+    if autoscale:
+        # the workers gate their load beacons + autoscaler panel wiring on
+        # the same knob the supervisor's controller reads
+        env_base["PATHWAY_AUTOSCALE"] = "1"
 
     if supervise:
         from pathway_tpu.engine.supervisor import (
@@ -148,6 +153,7 @@ def spawn_program(
                 max_restarts=max_restarts,
                 checkpoint_root=checkpoint_root,
                 shrink_on_loss=shrink_on_loss,
+                autoscale=autoscale,
             ).run()
         except SupervisorError as exc:
             click.echo(f"[pathway_tpu] {exc}", err=True)
@@ -162,14 +168,34 @@ def spawn_program(
                 err=True,
             )
         for rescale in result.rescales:
-            click.echo(
-                f"[pathway_tpu] degraded-mode shrink: worker "
-                f"{rescale['lost_worker']} treated as permanently lost on "
-                f"attempt {rescale['attempt']} — cluster rescaled "
-                f"{rescale['from']} -> {rescale['to']} worker(s); state "
-                "re-partitioned by shard range",
-                err=True,
-            )
+            kind = rescale.get("kind")
+            if kind == "autoscale":
+                click.echo(
+                    f"[pathway_tpu] autoscale ({rescale.get('action')}): "
+                    f"cluster rescaled {rescale['from']} -> {rescale['to']} "
+                    f"worker(s) via live shard handoff on attempt "
+                    f"{rescale['attempt']} ({rescale.get('reason')}); "
+                    f"{rescale.get('moving_shards')} shard(s) changed owner",
+                    err=True,
+                )
+            elif kind == "autoscale-fallback":
+                click.echo(
+                    f"[pathway_tpu] autoscale fallback: live handoff "
+                    f"{rescale['from']} -> {rescale['to']} worker(s) faulted "
+                    f"on attempt {rescale['attempt']}; applied the target "
+                    f"topology via restart-based rescale instead "
+                    f"({rescale.get('reason')})",
+                    err=True,
+                )
+            else:
+                click.echo(
+                    f"[pathway_tpu] degraded-mode shrink: worker "
+                    f"{rescale['lost_worker']} treated as permanently lost on "
+                    f"attempt {rescale['attempt']} — cluster rescaled "
+                    f"{rescale['from']} -> {rescale['to']} worker(s); state "
+                    "re-partitioned by shard range",
+                    err=True,
+                )
         # corruption fallback can happen WITHOUT any crash (root damaged at
         # rest before launch): report provenance whenever a worker rejected
         # generations, not only after restarts
@@ -293,9 +319,19 @@ _SPAWN_SETTINGS = {"allow_interspersed_args": False, "show_default": True}
     "checkpointed state re-partitions by shard range on resume "
     "(PATHWAY_DEGRADED_SHRINK=1 is the env form)",
 )
+@click.option(
+    "--autoscale",
+    is_flag=True,
+    default=None,
+    help="supervised mode: arm the load-adaptive scale controller — "
+    "sustained output staleness grows the cluster, sustained idleness "
+    "shrinks it, applied by live shard handoff with restart fallback "
+    "(bounds/thresholds via PATHWAY_AUTOSCALE_* knobs; "
+    "PATHWAY_AUTOSCALE=1 is the env form; requires --checkpoint-root)",
+)
 @click.argument("program")
 @click.argument("arguments", nargs=-1)
-def spawn(threads, processes, first_port, record, record_path, jax_distributed, supervise, max_restarts, checkpoint_root, shrink_on_loss, program, arguments):
+def spawn(threads, processes, first_port, record, record_path, jax_distributed, supervise, max_restarts, checkpoint_root, shrink_on_loss, autoscale, program, arguments):
     """Run PROGRAM as an SPMD cluster of identical processes.
 
     Re-running a supervised program with a different ``-n`` against the
@@ -323,6 +359,7 @@ def spawn(threads, processes, first_port, record, record_path, jax_distributed, 
         max_restarts=max_restarts,
         checkpoint_root=checkpoint_root,
         shrink_on_loss=shrink_on_loss,
+        autoscale=autoscale,
     )
 
 
@@ -593,6 +630,27 @@ def blackbox(worker, tail, as_json, root):
                 # pre-device-observability dumps carry no device key —
                 # an explicit empty state, never a KeyError
                 click.echo("  device: (no snapshot in this dump)")
+            autoscaler = payload.get("autoscaler")
+            if autoscaler:
+                # ...and what the scale controller was deciding: the
+                # supervisor-maintained state (engine/autoscaler.py) at
+                # dump time, with the tail of the decision log
+                click.echo(
+                    "  autoscaler: target "
+                    f"{autoscaler.get('target_workers')} worker(s) · "
+                    f"budget left {autoscaler.get('budget_left')} · "
+                    f"handoff state "
+                    f"{autoscaler.get('handoff_state') or 'idle'}"
+                )
+                for entry in (autoscaler.get("decisions") or [])[-5:]:
+                    click.echo(
+                        f"    {entry.get('action', '?'):<18}"
+                        + ", ".join(
+                            f"{k}={v}"
+                            for k, v in entry.items()
+                            if k not in ("action", "at")
+                        )
+                    )
     sys.exit(0)
 
 

@@ -46,6 +46,7 @@ from __future__ import annotations
 import hmac as _hmac
 import itertools as _itertools
 import logging
+import math
 import os
 import secrets as _secrets
 import socket
@@ -59,7 +60,7 @@ from pathway_tpu.engine import codec as _codec
 from pathway_tpu.engine import faults as _faults
 from pathway_tpu.engine import flight_recorder as _blackbox
 from pathway_tpu.engine import metrics as _metrics
-from pathway_tpu.engine.types import shard_to_worker
+from pathway_tpu.engine.types import SHARD_BITS, shard_to_worker
 
 _log = logging.getLogger("pathway_tpu.comm")
 
@@ -299,6 +300,7 @@ class TcpMesh:
         self._inbox: dict[tuple[int, Hashable], deque] = defaultdict(deque)
         self._cv = threading.Condition()
         self._closed = False
+        self._retiring = False  # see retire(): coordinated-teardown mode
         self._threads: list[threading.Thread] = []
         self._listener: socket.socket | None = None
         self._acceptor: threading.Thread | None = None
@@ -694,6 +696,12 @@ class TcpMesh:
         exc: BaseException,
     ) -> None:
         _close_quietly(sock)
+        if self._retiring:
+            # coordinated teardown: peers are LEAVING, not failing — no
+            # reconnect threads, no alarms; just mark the link down so any
+            # straggling recv unblocks on the dead sentinel
+            self._mark_dead(peer, link, "retired (coordinated handoff)")
+            return
         if isinstance(exc, CommError):
             with link.cv:
                 if self._closed or link.dead or link.gen != gen:
@@ -799,13 +807,22 @@ class TcpMesh:
             if link.sock is not None:
                 _close_quietly(link.sock)
             link.cv.notify_all()
-        _log.error(
-            "worker %d: peer %d declared dead: %s", self.worker_id, peer, why
-        )
-        self._m_peers_dead.inc()
-        _blackbox.record(
-            "comm.peer_dead", worker=self.worker_id, peer=peer, why=why
-        )
+        if self._retiring:
+            # expected departure during a coordinated handoff — keep the
+            # inbox purge + dead sentinel below (stragglers must still
+            # unblock) but none of the partition alarms
+            _log.debug(
+                "worker %d: peer %d retired: %s", self.worker_id, peer, why
+            )
+        else:
+            _log.error(
+                "worker %d: peer %d declared dead: %s",
+                self.worker_id, peer, why,
+            )
+            self._m_peers_dead.inc()
+            _blackbox.record(
+                "comm.peer_dead", worker=self.worker_id, peer=peer, why=why
+            )
         with self._cv:
             # stale frames from the dead incarnation must not be consumed
             # by anyone (least of all a respawned peer's exchange rounds)
@@ -1080,6 +1097,16 @@ class TcpMesh:
         self.gather(("barrier", tag), None)
         self.bcast(("barrier-go", tag))
 
+    def retire(self) -> None:
+        """Enter coordinated-teardown mode: this mesh is going away ON
+        PURPOSE (live shard handoff — every peer drains, barriers, and
+        exits together), so link failures from here on are the expected
+        sound of peers leaving, not faults.  Reconnect threads stop
+        spawning and peer-death goes quiet (no error logs, no
+        ``comm.peers.dead`` counts) — a handoff must not light up the
+        same alarms a real partition does."""
+        self._retiring = True
+
     def close(self) -> None:
         self._closed = True
         self._hb_stop.set()
@@ -1114,6 +1141,31 @@ class TcpMesh:
 
 
 _PEER_DEAD = ("__peer_dead__",)
+
+
+def moving_shards(n_old: int, n_new: int) -> int:
+    """How many of the 2**SHARD_BITS shard slots change owner when the
+    routing rule ``shard % n`` goes from ``n_old`` to ``n_new`` workers.
+
+    The cost model of a rescale decision: only these slots' state actually
+    migrates in a live handoff (the successor replays them filtered by
+    ``shard_to_worker(key, n_new)``), so the autoscaler's provenance log
+    records it alongside every grow/shrink — ``shard % n`` is not a
+    consistent hash, and this number says what that choice costs."""
+    n_old, n_new = max(1, n_old), max(1, n_new)
+    if n_old == n_new:
+        return 0
+    span = 1 << SHARD_BITS
+    # shard s moves iff s % n_old != s % n_new, which is periodic in
+    # lcm(n_old, n_new): count one period, scale to the 16-bit space
+    period = math.lcm(n_old, n_new)
+    moved_per_period = sum(
+        1 for s in range(period) if s % n_old != s % n_new
+    )
+    full, rem = divmod(span, period)
+    return full * moved_per_period + sum(
+        1 for s in range(rem) if s % n_old != s % n_new
+    )
 
 
 def _close_quietly(sock: socket.socket) -> None:

@@ -27,6 +27,9 @@ Plan format (JSON, also accepted as a Python list of dicts)::
         {"kind": "connector_read", "source": "CsvReader", "nth": 4},
         {"kind": "connector_stall", "source": "SubjectReader", "nth": 3,
          "delay_ms": 500},
+        {"kind": "load_spike", "source": "SubjectReader", "nth": 5,
+         "delay_ms": 2000},
+        {"kind": "handoff_crash", "worker": 1, "attempt": 0},
         {"kind": "device_stall", "source": "encoder", "nth": 1,
          "delay_ms": 500},
         {"kind": "device_error", "source": "rowsum", "from_nth": 1,
@@ -107,6 +110,20 @@ connector_stall  The reader supervision loop: the Nth emitted item is
              and no epoch slows down; only the data-plane freshness
              layer (``engine/freshness.py``: ``output.staleness.s``)
              can see it — exactly what its chaos tests prove.
+load_spike   The reader supervision loop: from the Nth emitted item, the
+             reader BUFFERS its output for a ``delay_ms`` window and then
+             flushes everything in one instantaneous burst.  No error, no
+             data change — delivered rows are byte-identical to an
+             unfaulted run — but downstream sees silence followed by a
+             backlog wall, so ``output.staleness.s`` and ``backlog.*``
+             climb deterministically.  The reproducible load wave the
+             autoscaler chaos tests (``engine/autoscaler.py``) drive the
+             scale controller with.
+handoff_crash  The live-handoff participation point in the epoch loop
+             (``internals/runner.py``): SIGKILL this worker AFTER its
+             handoff drain-commit fenced the storage but BEFORE its ack —
+             the mid-handoff death that must make the supervisor fall
+             back to the restart-based rescale, with nothing spliced.
 device_stall  The DeviceExecutor dispatch thread (``pathway_tpu/device/
              executor.py``): the Nth dispatched batch job is DELAYED by
              ``delay_ms`` before it runs — a slow device / saturated
@@ -170,8 +187,8 @@ KINDS = (
     + _BLOB_CORRUPT_KINDS
     + (
         "crash", "writer_crash", "hang", "zombie", "connector_read",
-        "connector_stall", "device_stall", "device_error", "device_oom",
-        "device_compile_fail", "device_hang",
+        "connector_stall", "load_spike", "handoff_crash", "device_stall",
+        "device_error", "device_oom", "device_compile_fail", "device_hang",
     )
 )
 
@@ -410,6 +427,25 @@ def maybe_hang(*, worker: int, epoch: int) -> None:
         while True:  # only a signal ends this — that is the point
             # pathway-lint: disable=ctx-blocking-call — the hang injector exists to wedge the epoch loop (watchdog chaos tests); blocking IS the feature
             _time.sleep(0.05)
+
+
+def maybe_crash_handoff(*, worker: int, to_workers: int) -> None:
+    """Mid-handoff crash injection: SIGKILL this worker between its handoff
+    drain-commit (storage already fenced, frontier already durable) and its
+    ack — the narrowest window of the live-handoff protocol.  The
+    supervisor must see the nonzero death inside the handoff window and
+    fall back to the restart-based rescale at the same target topology;
+    the fenced drain-commit stays the (valid) newest generation, so
+    nothing is spliced and ``pathway_tpu scrub`` stays clean."""
+    plan = active_plan()
+    if plan is None or not plan.has("handoff_crash"):
+        return
+    if plan.check("handoff_crash", worker=worker) is not None:
+        _blackbox.dump(
+            f"injected handoff crash (worker {worker}, "
+            f"handoff to {to_workers} worker(s))"
+        )
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 def maybe_crash_writer(*, worker: int, key: str) -> None:

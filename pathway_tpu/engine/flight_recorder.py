@@ -73,6 +73,10 @@ class FlightRecorder:
         # DeviceExecutor snapshot (cost/utilization/padding/HBM/queue) —
         # what the DEVICE was doing when the process died
         self._device_supplier: Any = None
+        # optional autoscaler supplier (engine/autoscaler.py): the scale
+        # controller's decision log + panel state — post-mortems say WHY
+        # a rescale fired (or why one was suppressed)
+        self._autoscaler_supplier: Any = None
 
     # -- recording ---------------------------------------------------------
     def record(self, kind: str, **fields: Any) -> None:
@@ -136,6 +140,14 @@ class FlightRecorder:
         say what the device was doing, not just the host."""
         self._device_supplier = fn
 
+    def set_autoscaler_supplier(self, fn: Any) -> None:
+        """Attach (or clear) the callable whose autoscaler state (decision
+        log, target topology, handoff phase) rides every subsequent dump
+        under the ``autoscaler`` key (same lifetime contract as
+        :meth:`set_profile_supplier`) — post-mortems say why the cluster
+        was scaling, not just that it died mid-rescale."""
+        self._autoscaler_supplier = fn
+
     # -- dumping -----------------------------------------------------------
     def dump(self, reason: str, *, suffix: str | None = None) -> str | None:
         """Write the ring to ``<root>/blackbox/worker-<id>.attempt-<n>.json``
@@ -171,6 +183,7 @@ class FlightRecorder:
             supplier = self._profile_supplier
             freshness_supplier = self._freshness_supplier
             device_supplier = self._device_supplier
+            autoscaler_supplier = self._autoscaler_supplier
         if supplier is not None:
             # outside the lock (the supplier scans the node arena) and
             # never fatal: a dump without a profile beats no dump
@@ -197,6 +210,15 @@ class FlightRecorder:
                 device = None
             if device:
                 payload["device"] = device
+        if autoscaler_supplier is not None:
+            # ...and why the cluster was SCALING: the controller's
+            # decision log + handoff phase (best-effort like the others)
+            try:
+                autoscaler = autoscaler_supplier()
+            except Exception:  # noqa: BLE001 - forensics must never fail
+                autoscaler = None
+            if autoscaler:
+                payload["autoscaler"] = autoscaler
         if payload["incarnation"] and self._fenced(
             root, payload["incarnation"], payload["worker"]
         ):

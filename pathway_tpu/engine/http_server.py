@@ -162,6 +162,13 @@ def render_status(
         payload["columnar"] = {
             k: v for k, v in scalars.items() if k.startswith("columnar.")
         }
+        # the autoscaler panel: target topology, budget, cooldown and
+        # handoff phase (gauges derived from lease/autoscaler.json by the
+        # collector each supervised worker registers; absent = autoscaling
+        # off or solo run)
+        payload["autoscaler"] = {
+            k: v for k, v in scalars.items() if k.startswith("autoscaler.")
+        }
     return json.dumps(payload)
 
 

@@ -168,6 +168,37 @@ METRICS: dict[str, tuple[str, str]] = {
         "shrink)"),
     "supervisor.watchdog.kills": (
         "counter", "hung workers killed by the progress watchdog"),
+    "supervisor.handoffs": (
+        "counter", "planned rescales completed by LIVE shard handoff "
+        "(coordinated drain + relaunch, no recovery rollback)"),
+    "supervisor.handoff.fallbacks": (
+        "counter", "live handoffs that faulted mid-flight and fell back "
+        "to the restart-based rescale"),
+    # load-adaptive autoscaler (engine/autoscaler.py)
+    "autoscaler.decisions": (
+        "counter", "scaling decisions fired (grow + shrink)"),
+    "autoscaler.budget.exhausted": (
+        "counter", "scaling decisions suppressed because the rescale "
+        "budget was spent"),
+    "autoscaler.state": (
+        "collector", "autoscaler panel gauge supplier (reads the "
+        "supervisor-maintained lease/autoscaler.json state file)"),
+    "autoscaler.target.workers": (
+        "gauge", "the worker count the scale controller currently targets"),
+    "autoscaler.budget.left": (
+        "gauge", "rescale decisions remaining in this supervisor run's "
+        "budget"),
+    "autoscaler.cooldown.remaining.s": (
+        "gauge", "seconds until the controller may fire again after the "
+        "last rescale"),
+    "autoscaler.phase": (
+        "gauge", "controller phase: 0 steady, 1 hot-dwell, 2 cooldown, "
+        "3 handoff in flight"),
+    "autoscaler.decisions.logged": (
+        "gauge", "entries in the bounded scaling-decision provenance log"),
+    "autoscaler.last.decision": (
+        "gauge", "target worker count of the newest decision, labelled "
+        "with its action (grow/shrink/suppressed-*)"),
     "worker.restart.attempt": (
         "gauge", "supervisor restarts performed before this worker launch"),
     "worker.last_progress.age_s": (

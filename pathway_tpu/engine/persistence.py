@@ -218,6 +218,143 @@ def read_lease_file(root: str) -> dict | None:
     return _decode_lease(raw)
 
 
+# -- live shard handoff coordination files --
+#
+# A planned rescale is coordinated through tiny advisory JSON files under
+# the root's ``lease/`` directory — the same location as the lease and the
+# progress beacons, and like them PLAIN (unframed) JSON written via
+# atomic tmp+rename: they are supervisor↔worker signaling, not recovery
+# state, so a torn or stale file degrades to "no handoff" and the
+# supervisor falls back to the restart-based rescale.  Protocol:
+#
+#   1. the supervisor posts ``lease/HANDOFF`` ({incarnation, from_workers,
+#      to_workers, reason}); workers ignore requests whose incarnation is
+#      not THEIR incarnation (a zombie must not join a handoff).
+#   2. worker 0 notices the request at an epoch boundary and broadcasts
+#      the handoff decision on the epoch channel; EVERY worker then drains
+#      a synchronous commit of its exact frontier (stamped ``handoff_to``),
+#      fences its own storage (``fence_for_handoff``), barriers, and
+#      writes ``lease/handoff.ack.<worker>`` before exiting cleanly.
+#   3. the supervisor sees all workers exit 0 WITH a complete ack set and
+#      relaunches at the new topology — the PR-10 repartition machinery
+#      replays the moving shard ranges from the acked frontiers.  Any
+#      other outcome (death, missing ack, deadline) → restart fallback.
+HANDOFF_KEY = "lease/HANDOFF"
+HANDOFF_ACK_PREFIX = "lease/handoff.ack."
+HANDOFF_FORMAT = 1
+
+
+def _lease_dir_write_json(root: str, key: str, obj: dict) -> None:
+    """Atomically (tmp+rename) write an advisory JSON file under the
+    root's lease/ directory without constructing a FileBackend."""
+    path = os.path.join(root, *key.split("/"))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        _json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _lease_dir_read_json(root: str, key: str) -> dict | None:
+    """Best-effort read of an advisory lease/ JSON file; None when absent,
+    torn, or malformed (advisory contract: damage degrades to absence)."""
+    path = os.path.join(root, *key.split("/"))
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            obj = _json.load(f)
+    except (OSError, ValueError):
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+def post_handoff_request(
+    root: str,
+    *,
+    incarnation: int,
+    from_workers: int,
+    to_workers: int,
+    reason: str = "",
+) -> None:
+    """Supervisor side: ask the CURRENT incarnation's workers to hand the
+    root off to ``to_workers`` at their next epoch boundary."""
+    _lease_dir_write_json(
+        root,
+        HANDOFF_KEY,
+        {
+            "format": HANDOFF_FORMAT,
+            "incarnation": incarnation,
+            "from_workers": from_workers,
+            "to_workers": to_workers,
+            "reason": reason,
+            "at": _time.time(),
+        },
+    )
+
+
+def read_handoff_request(root: str) -> dict | None:
+    """The pending handoff request, or None when absent/unreadable/not a
+    well-formed request (advisory: malformed never raises)."""
+    obj = _lease_dir_read_json(root, HANDOFF_KEY)
+    if (
+        obj is None
+        or not isinstance(obj.get("incarnation"), int)
+        or not isinstance(obj.get("to_workers"), int)
+        or obj["to_workers"] < 1
+    ):
+        return None
+    return obj
+
+
+def write_handoff_ack(
+    root: str,
+    worker: int,
+    *,
+    incarnation: int,
+    to_workers: int,
+    frontier: Any = None,
+) -> None:
+    """Worker side: record that this worker fenced + committed its exact
+    frontier for the handoff to ``to_workers`` and is about to exit."""
+    _lease_dir_write_json(
+        root,
+        f"{HANDOFF_ACK_PREFIX}{worker}",
+        {
+            "format": HANDOFF_FORMAT,
+            "worker": worker,
+            "incarnation": incarnation,
+            "to_workers": to_workers,
+            "frontier": frontier,
+            "at": _time.time(),
+        },
+    )
+
+
+def read_handoff_acks(root: str, workers: int) -> dict[int, dict]:
+    """{worker: ack} for every well-formed ack of workers 0..workers-1.
+    The supervisor declares a handoff successful only when the set is
+    COMPLETE and every ack matches the request's incarnation/target."""
+    out: dict[int, dict] = {}
+    for w in range(workers):
+        obj = _lease_dir_read_json(root, f"{HANDOFF_ACK_PREFIX}{w}")
+        if obj is not None and obj.get("worker") == w:
+            out[w] = obj
+    return out
+
+
+def clear_handoff(root: str, workers: int) -> None:
+    """Remove the request and every ack file — called by the supervisor
+    after a handoff concludes (either way), so a stale request can never
+    leak into the next incarnation."""
+    keys = [HANDOFF_KEY] + [
+        f"{HANDOFF_ACK_PREFIX}{w}" for w in range(workers)
+    ]
+    for key in keys:
+        try:
+            os.remove(os.path.join(root, *key.split("/")))
+        except OSError:
+            pass
+
+
 _BASE_SID_RE = None
 
 
@@ -1540,6 +1677,12 @@ class PersistentStorage:
         # coincidentally matches the current one
         self.topology_seq = 0
         self.repartitioned_from: int | None = None
+        # live shard handoff: once this storage has drained its handoff
+        # commit (stamped handoff_to), it is FENCED — later commits no-op
+        # (returning the already-durable seq) so the shutdown path's final
+        # commit cannot advance the frontier past what the acks recorded
+        self.handoff_fenced = False
+        self.handoff_to: int | None = None
         # base source name -> {"offset", "key_seq", "schema", "refs",
         # "own_chunks"} gathered from the superseded topology's manifests;
         # None outside repartition resume
@@ -1615,6 +1758,20 @@ class PersistentStorage:
             "(this process is a zombie from a superseded restart attempt "
             "and must terminate)"
         )
+
+    def fence_for_handoff(self, to_workers: int) -> None:
+        """Enter the handoff fence: the NEXT commit is the handoff commit
+        (stamped ``handoff_to``), every commit after it silently no-ops.
+
+        Called by the runner immediately before its handoff drain commit;
+        the fence guarantees the frontier recorded in the ack files is
+        exactly the frontier the successor topology replays — nothing the
+        shutdown path does afterwards can move it."""
+        self.handoff_to = to_workers
+
+    def _seal_handoff_fence(self) -> None:
+        if self.handoff_to is not None:
+            self.handoff_fenced = True
 
     def _zombie_stall(self, spec: Any) -> None:
         """The ``zombie`` fault: wedge this publish until the lease shows a
@@ -2128,6 +2285,13 @@ class PersistentStorage:
         on the writer pool — and gates source offsets on ``processed_up_to``
         (the last epoch the engine ran; None = all).
         """
+        if self.handoff_fenced:
+            # the handoff commit already landed and its frontier is what
+            # the ack files (and the successor topology) recorded — any
+            # later commit (the shutdown path's final full dump) must not
+            # move it.  Silent no-op by contract, not an error: the
+            # shutdown path is shared with ordinary clean finishes.
+            return self.published_seq
         self._drain_pending()
         self._advance_sources(processed_up_to)
         # commit barrier: every in-flight chunk of the committed prefix
@@ -2216,7 +2380,11 @@ class PersistentStorage:
             t0 = _time.perf_counter()
             self._pool.sync_staged_now()
             self.metrics.add_stage("barrier", _time.perf_counter() - t0)
-        if _manifest_core(metadata) == _manifest_core(self._metadata):
+        if self.handoff_to is None and (
+            _manifest_core(metadata) == _manifest_core(self._metadata)
+        ):
+            # (a handoff commit always publishes, even when nothing
+            # advanced: the handoff_to stamp must land on a manifest)
             if self.confirm_operator_commit is not None:
                 self.confirm_operator_commit()  # nothing new: dumps are moot
             self.metrics.commit_published(noop=True)
@@ -2226,6 +2394,7 @@ class PersistentStorage:
             )
             self.metrics.commit_published(noop=False)
         self._last_submit_sig = self._state_sig()
+        self._seal_handoff_fence()
         with self._pending_cv:
             self._seq += 1
             self.published_seq = self._seq
@@ -2248,6 +2417,8 @@ class PersistentStorage:
         only mark nodes clean once the manifest referencing their dumps is
         durably published (the drain-on-confirm rule).
         """
+        if self.handoff_fenced:
+            return self.published_seq  # see commit(): frontier is sealed
         if self._pool is None or (
             self.operator_persistence
             and self.collect_operator_states is not None
@@ -2476,6 +2647,11 @@ class PersistentStorage:
         metadata["topology_seq"] = self.topology_seq
         if self.repartitioned_from is not None:
             metadata["repartitioned_from"] = self.repartitioned_from
+        if self.handoff_to is not None:
+            # live-handoff provenance: this manifest is the exact frontier
+            # the worker fenced before the coordinated drain — the
+            # successor topology's repartition replay starts here
+            metadata["handoff_to"] = self.handoff_to
         metadata["rejected"] = [[g, r] for g, r in self.rejected_generations]
         self.backend.put_atomic(
             self._manifest_key(self.generation),
@@ -3189,6 +3365,27 @@ def scrub_root(
             if k.startswith("lease/progress.")
             and k.rsplit(".", 1)[-1].isdigit()
         )
+        # autoscaler residue (load beacons, a handoff request/acks left by
+        # a crash mid-handoff, the controller state file) is advisory by
+        # contract — reported so the audit explains the keys, never a
+        # failure: the supervisor clears it and falls back on relaunch
+        lease_report["load_workers"] = sorted(
+            int(k.rsplit(".", 1)[-1])
+            for k in all_keys
+            if k.startswith("lease/load.")
+            and k.rsplit(".", 1)[-1].isdigit()
+        )
+        handoff_acks = sorted(
+            int(k.rsplit(".", 1)[-1])
+            for k in all_keys
+            if k.startswith(HANDOFF_ACK_PREFIX)
+            and k.rsplit(".", 1)[-1].isdigit()
+        )
+        if HANDOFF_KEY in all_keys or handoff_acks:
+            lease_report["handoff"] = {
+                "pending_request": HANDOFF_KEY in all_keys,
+                "acks": handoff_acks,
+            }
         report["lease"] = lease_report
     # -- flight-recorder dump audit (best-effort, never fails the root) --
     dump_keys = [

@@ -360,10 +360,34 @@ class Supervisor:
         checkpoint_root: str | None = None,
         epoch_deadline_s: float | None = None,
         shrink_on_loss: bool | None = None,
+        autoscale: bool | None = None,
     ):
         self.spawn = spawn
         self.n_workers = n_workers
         self.max_restarts = max_restarts
+        # load-adaptive autoscaling (opt-in): a ScaleController rides the
+        # watch loop, reading the workers' load beacons and triggering
+        # grow/shrink rescales via live shard handoff (with restart
+        # fallback).  None reads the PATHWAY_AUTOSCALE knob.  Needs a
+        # checkpoint root — both the sensor feed (lease/load.<w>) and the
+        # actuator (lease/HANDOFF + repartition resume) live there.
+        from pathway_tpu.internals.config import env_bool, env_float
+
+        if autoscale is None:
+            autoscale = env_bool("PATHWAY_AUTOSCALE")
+        self.autoscale = bool(autoscale)
+        self.handoff_deadline_s = env_float(
+            "PATHWAY_AUTOSCALE_HANDOFF_DEADLINE_S"
+        )
+        self._controller: Any = None
+        # outcome of a handoff the last _watch call observed:
+        # {"kind": "live", ...} = all workers drained + acked, relaunch at
+        # the target without charging the restart budget; {"kind":
+        # "fallback", ...} = the handoff faulted mid-flight, fall back to
+        # a restart-based rescale at the same target topology
+        self._handoff_outcome: dict[str, Any] | None = None
+        self._as_last_observe = 0.0
+        self._as_last_state = 0.0
         # degraded-mode shrink (opt-in): when the SAME worker failed on
         # every attempt of a spent restart budget — the permanently-lost-
         # host signature, not an ordinary crash loop — rescale the cluster
@@ -642,6 +666,17 @@ class Supervisor:
         # starts belong to a previous run and must not be re-attributed
         # to it (they stay on disk for `pathway_tpu blackbox`)
         self._run_started_at = time.time()
+        self._controller = None
+        if self.autoscale and self.checkpoint_root:
+            from pathway_tpu.engine.autoscaler import ScaleController
+
+            self._controller = ScaleController(current=self.n_workers)
+            _log.info(
+                "autoscaler armed: %d..%d worker(s), staleness threshold "
+                "%.1fs, rescale budget %d",
+                self._controller.min_workers, self._controller.max_workers,
+                self._controller.staleness_hi_s, self._controller.budget,
+            )
         try:
             while True:
                 self._acquire_incarnation(attempt)
@@ -663,6 +698,51 @@ class Supervisor:
                     else spawn_failure[0]
                 )
                 if first_failed is None:
+                    outcome = self._handoff_outcome
+                    self._handoff_outcome = None
+                    if outcome is not None:
+                        # planned rescale, not a crash: every worker
+                        # exited 0.  Live = drain + ack completed, just
+                        # relaunch at N'; fallback = split exit (some
+                        # drained, some finished), restart at N' anyway.
+                        live = outcome["kind"] == "live"
+                        codes = [_exitcode(h) for h in handles]
+                        history.append(codes)
+                        self._settle_checkpoints()
+                        self._finish_handoff(
+                            outcome, attempt, live=live,
+                            failure=None if live else (
+                                "split exit: worker(s) "
+                                f"{outcome.get('partial_acks')} drained for "
+                                "the handoff while the rest finished"
+                            ),
+                        )
+                        # a planned rescale never charges the restart
+                        # budget: the resized cluster starts fresh
+                        budget_anchor = attempt + 1
+                        last_failed, same_fail_streak = None, 0
+                        delays = self._backoff_delays()
+                        attempt += 1
+                        continue  # no backoff: relaunch immediately
+                    if self._controller is not None and self.checkpoint_root:
+                        # clean finish with the autoscaler armed: drop any
+                        # unanswered request + beacons, persist the final
+                        # decision log for post-run inspection
+                        try:
+                            from pathway_tpu.engine import autoscaler as _as
+                            from pathway_tpu.engine import persistence as pz
+
+                            pz.clear_handoff(
+                                self.checkpoint_root, self.n_workers
+                            )
+                            _as.clear_load_beacons(
+                                self.checkpoint_root, self.n_workers
+                            )
+                            self._controller.write_state(
+                                self.checkpoint_root, time.monotonic()
+                            )
+                        except Exception:  # noqa: BLE001 - advisory only
+                            pass
                     codes = [_exitcode(h) for h in handles]
                     history.append(codes)
                     recovery = self._recovery_info()
@@ -701,6 +781,38 @@ class Supervisor:
                         f"{_exitcode(handles[first_failed])} on attempt "
                         f"{attempt}"
                     )
+                outcome = self._handoff_outcome
+                self._handoff_outcome = None
+                if outcome is not None:
+                    # the live handoff faulted mid-flight (a death during
+                    # the drain, or the ack deadline blew): fall back to
+                    # the restart-based rescale at the SAME target
+                    # topology.  Still a planned rescale — the resized
+                    # cluster gets a fresh restart budget, like
+                    # degraded-mode shrink does.
+                    last_failure = (
+                        f"live handoff to {outcome['to']} worker(s) "
+                        f"faulted ({last_failure}); falling back to a "
+                        f"restart-based rescale"
+                    )
+                    _log.warning("%s", last_failure)
+                    self._stop_all(handles)
+                    self._settle_checkpoints()
+                    codes = [_exitcode(h) for h in handles]
+                    codes += [None] * (self.n_workers - len(codes))
+                    history.append(codes)
+                    self._finish_handoff(
+                        outcome, attempt, live=False, failure=last_failure
+                    )
+                    budget_anchor = attempt + 1
+                    last_failed, same_fail_streak = None, 0
+                    delays = self._backoff_delays()
+                    time.sleep(
+                        next(delays)
+                        + random.uniform(0, self.restart_jitter_s)
+                    )
+                    attempt += 1
+                    continue
                 _metrics.get_registry().counter(
                     "supervisor.restarts",
                     "cluster rollback-and-respawn recoveries performed",
@@ -808,12 +920,26 @@ class Supervisor:
         The loop doubles as the progress watchdog: each poll also checks
         every live worker's progress beacon and escalates
         SIGUSR1 → SIGTERM → SIGKILL on a stalled one, whose death the
-        death-watch above then routes through the ordinary restart path."""
+        death-watch above then routes through the ordinary restart path.
+
+        When autoscaling is armed it is ALSO the scale controller's
+        sensor→actuator tick: each poll reads the workers' load beacons,
+        feeds them to the controller, and — on a decision — posts the
+        handoff request the workers drain against.  A pending handoff's
+        outcome is reported out-of-band on ``self._handoff_outcome``
+        (the int/None return keeps its original failure meaning)."""
         watchdog = (
             _ProgressWatchdog(self)
             if self.epoch_deadline_s and self.checkpoint_root
             else None
         )
+        self._handoff_outcome = None
+        controller = self._controller
+        pending: dict[str, Any] | None = None
+        if controller is not None:
+            # re-sync after any rescale (ours or degraded-mode shrink)
+            controller.current = self.n_workers
+            controller.handoff_state = ""
         while True:
             all_done = True
             for wid, handle in enumerate(handles):
@@ -821,12 +947,205 @@ class Supervisor:
                 if code is None:
                     all_done = False
                 elif code != 0:
+                    if pending is not None:
+                        # a death while the handoff drains poisons it:
+                        # all-or-nothing, so fall back to a restart rescale
+                        pending["kind"] = "fallback"
+                        self._handoff_outcome = pending
                     return wid
             if all_done:
+                if pending is not None:
+                    self._classify_handoff_exit(pending)
                 return None
             if watchdog is not None:
                 watchdog.poll(handles)
+            if controller is not None and self.incarnation is not None:
+                pending = self._autoscale_tick(controller, pending)
+                if pending is not None and pending.get("expired"):
+                    # deadline blown: a worker is wedged mid-drain.
+                    # Convert the wedge into an ordinary failure; run()
+                    # applies the target topology via the restart path
+                    # (the fallback contract).
+                    wid = int(pending.get("straggler", 0))
+                    self._hangs[wid] = (
+                        f"handoff to {pending['to']} worker(s) not "
+                        f"acknowledged within {self.handoff_deadline_s:.1f}s"
+                    )
+                    pending["kind"] = "fallback"
+                    self._handoff_outcome = pending
+                    return wid
             time.sleep(self.poll_interval_s)
+
+    def _autoscale_tick(
+        self, controller: Any, pending: dict[str, Any] | None
+    ) -> dict[str, Any] | None:
+        """One sensor→actuator poll: read load beacons, feed the
+        controller, post a handoff request on a decision.  Returns the
+        pending-handoff bookkeeping (None when no handoff is in flight)."""
+        now = time.monotonic()
+        if pending is not None:
+            # actuation in flight: no new decisions until it settles —
+            # just watch the deadline
+            if now >= pending["deadline"] and "expired" not in pending:
+                pending["expired"] = True
+                pending["straggler"] = self._first_unacked(pending["to"])
+            return pending
+        if now - self._as_last_observe < 0.1:
+            return None
+        self._as_last_observe = now
+        from pathway_tpu.engine import autoscaler as _as
+
+        beacons = _as.read_load_beacons(self.checkpoint_root, self.n_workers)
+        decision = None
+        if beacons:
+            # no fresh beacons = booting or torn-down workers, not calm:
+            # feed the controller only when the sensors are live, so an
+            # instrumentation gap can never read as sustained idleness
+            staleness_s, backlog = _as.worst_load(beacons)
+            decision = controller.observe(now, staleness_s, backlog)
+        if decision is not None:
+            from pathway_tpu.engine import persistence as pz
+
+            to_n = int(decision["to"])
+            pz.post_handoff_request(
+                self.checkpoint_root,
+                incarnation=self.incarnation,
+                from_workers=self.n_workers,
+                to_workers=to_n,
+                reason=str(decision.get("reason", "")),
+            )
+            controller.handoff_state = "handoff-requested"
+            _log.warning(
+                "autoscaler: posted live handoff request %d -> %d "
+                "worker(s) (%s; deadline %.1fs)",
+                self.n_workers, to_n, decision.get("reason", ""),
+                self.handoff_deadline_s,
+            )
+            pending = {
+                "to": to_n,
+                "decision": decision,
+                "deadline": now + self.handoff_deadline_s,
+            }
+        if decision is not None or now - self._as_last_state >= 0.5:
+            self._as_last_state = now
+            controller.write_state(self.checkpoint_root, now)
+        return pending
+
+    def _ack_valid(self, ack: dict | None, to_n: int) -> bool:
+        return (
+            ack is not None
+            and ack.get("incarnation") == self.incarnation
+            and ack.get("to_workers") == to_n
+        )
+
+    def _handoff_acks(self, to_n: int) -> list[int]:
+        """Worker ids that wrote a valid ack for the pending handoff."""
+        try:
+            from pathway_tpu.engine import persistence as pz
+
+            acks = pz.read_handoff_acks(self.checkpoint_root, self.n_workers)
+        except Exception:  # noqa: BLE001 - advisory files, never fatal
+            acks = {}
+        return [
+            w
+            for w in range(self.n_workers)
+            if self._ack_valid(acks.get(w), to_n)
+        ]
+
+    def _first_unacked(self, to_n: int) -> int:
+        acked = set(self._handoff_acks(to_n))
+        for w in range(self.n_workers):
+            if w not in acked:
+                return w
+        return 0
+
+    def _classify_handoff_exit(self, pending: dict[str, Any]) -> None:
+        """All workers exited 0 with a handoff pending — decide what
+        actually happened from the acks on the root."""
+        acked = self._handoff_acks(pending["to"])
+        if len(acked) == self.n_workers:
+            # every worker fenced, committed and acked: the live handoff
+            # completed — relaunch at the target picks the frontier up
+            pending["kind"] = "live"
+            self._handoff_outcome = pending
+        elif acked:
+            # split exit: some workers drained for the handoff, others
+            # finished for real.  The topology must still land at the
+            # target, but only a restart rescale can take it there.
+            pending["kind"] = "fallback"
+            pending["partial_acks"] = acked
+            self._handoff_outcome = pending
+        # zero acks: the sources finished before any worker saw the
+        # request — a genuine clean finish; run() clears the residue
+
+    def _finish_handoff(
+        self,
+        outcome: dict[str, Any],
+        attempt: int,
+        *,
+        live: bool,
+        failure: str | None = None,
+    ) -> None:
+        """Account a settled handoff (either path) and adopt the target
+        topology: rescale provenance + counters, decision-log note,
+        coordination-file cleanup, and ``self.n_workers = N'``."""
+        from pathway_tpu.engine import autoscaler as _as
+        from pathway_tpu.engine import comm as _comm
+        from pathway_tpu.engine import persistence as pz
+
+        from_n, to_n = self.n_workers, int(outcome["to"])
+        decision = outcome.get("decision") or {}
+        self.rescales.append(
+            {
+                "kind": "autoscale" if live else "autoscale-fallback",
+                "from": from_n,
+                "to": to_n,
+                "attempt": attempt,
+                "reason": failure or str(decision.get("reason", "")),
+                "action": str(decision.get("action", "")),
+                "moving_shards": _comm.moving_shards(from_n, to_n),
+            }
+        )
+        if live:
+            _metrics.get_registry().counter(
+                "supervisor.handoffs",
+                "live shard-range handoffs completed (rescale without a "
+                "rollback restart)",
+            ).inc()
+        else:
+            _metrics.get_registry().counter(
+                "supervisor.handoff.fallbacks",
+                "live handoffs that faulted mid-flight and fell back to "
+                "a restart-based rescale",
+            ).inc()
+        now = time.monotonic()
+        controller = self._controller
+        if controller is not None:
+            controller.current = to_n
+            controller.handoff_state = "done" if live else "fallback"
+            if live:
+                controller.note(now, "handoff-complete", to=to_n)
+            else:
+                controller.note(
+                    now, "handoff-fallback", to=to_n, failure=failure or ""
+                )
+        scope = max(from_n, to_n)
+        try:
+            pz.clear_handoff(self.checkpoint_root, scope)
+            _as.clear_load_beacons(self.checkpoint_root, scope)
+        except Exception:  # noqa: BLE001 - advisory files, never fatal
+            pass
+        if controller is not None and self.checkpoint_root:
+            controller.write_state(self.checkpoint_root, now)
+        _log.warning(
+            "rescaled %d -> %d worker(s) via %s (attempt %d); %d of %d "
+            "shard(s) change owners on resume",
+            from_n, to_n,
+            "live handoff" if live else "handoff fallback (restart)",
+            attempt, self.rescales[-1]["moving_shards"],
+            1 << _comm.SHARD_BITS,
+        )
+        self.n_workers = to_n
 
     def _stop_all(self, handles: Sequence[Any]) -> None:
         """Terminate survivors: their uncommitted progress IS the rollback."""

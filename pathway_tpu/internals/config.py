@@ -206,6 +206,40 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
        "attempt of a spent restart budget, rescale the supervised cluster "
        "to the surviving count instead of failing — checkpointed state "
        "re-partitions by shard range on resume", "supervisor"),
+    # -- autoscaler (engine/autoscaler.py) ----------------------------------
+    _k("PATHWAY_AUTOSCALE", "bool", False,
+       "load-adaptive autoscaling (opt-in): the supervisor polls worker "
+       "load beacons and grows/shrinks the cluster via live shard handoff "
+       "under the PATHWAY_AUTOSCALE_* budgets below", "autoscaler"),
+    _k("PATHWAY_AUTOSCALE_MIN_WORKERS", "int", 1,
+       "shrink floor: the controller never targets fewer workers than "
+       "this (and never below 1 regardless)", "autoscaler"),
+    _k("PATHWAY_AUTOSCALE_MAX_WORKERS", "int", 8,
+       "grow ceiling: the controller never targets more workers than "
+       "this", "autoscaler"),
+    _k("PATHWAY_AUTOSCALE_STALENESS_S", "float", 5.0,
+       "grow trigger: worst per-worker output staleness above this for a "
+       "full dwell window means the cluster is falling behind",
+       "autoscaler"),
+    _k("PATHWAY_AUTOSCALE_DWELL_S", "float", 10.0,
+       "hysteresis dwell: the grow trigger must hold CONTINUOUSLY for "
+       "this long before a rescale fires (one dip below threshold resets "
+       "the clock) — oscillating load never flaps", "autoscaler"),
+    _k("PATHWAY_AUTOSCALE_COOLDOWN_S", "float", 60.0,
+       "post-rescale cooldown: no further scaling decision (either "
+       "direction) for this long after a rescale fires", "autoscaler"),
+    _k("PATHWAY_AUTOSCALE_IDLE_S", "float", 30.0,
+       "shrink trigger: staleness comfortably low AND backlog ~empty "
+       "continuously for this long shrinks the cluster one step",
+       "autoscaler"),
+    _k("PATHWAY_AUTOSCALE_BUDGET", "int", 4,
+       "rescale budget: total grow/shrink decisions this supervisor run "
+       "may fire; exhaustion logs loudly and pins the topology",
+       "autoscaler"),
+    _k("PATHWAY_AUTOSCALE_HANDOFF_DEADLINE_S", "float", 30.0,
+       "live-handoff deadline: a posted handoff the workers have not "
+       "fully acked within this window falls back to the restart-based "
+       "rescale", "autoscaler"),
     # -- device executor (pathway_tpu/device/) ------------------------------
     _k("PATHWAY_DEVICE_MAX_BATCH", "int", 512,
        "largest batch bucket of the DeviceExecutor's default bucketing "
@@ -308,6 +342,7 @@ _SUBSYSTEM_TITLES = (
     ("bench", "Benchmark harness (`benchmarks/harness.py`)"),
     ("persistence", "Persistence (`engine/persistence.py`)"),
     ("supervisor", "Supervisor (`engine/supervisor.py`)"),
+    ("autoscaler", "Autoscaler (`engine/autoscaler.py`)"),
     ("executor", "Device executor (`pathway_tpu/device/`)"),
     ("devices", "Device mesh (`parallel/mesh.py`)"),
     ("models", "Models & native kernels"),

@@ -49,6 +49,12 @@ class RunResult:
         self.freshness = None  # engine.freshness.FreshnessTracker for this run
         self.last_time: int | None = None  # last processed epoch
         self.clean_finish = False
+        # set when the run exited through a LIVE HANDOFF: the worker
+        # drained + fenced its frontier for a planned rescale to this
+        # worker count and exited 0 WITHOUT finishing the scope — neither
+        # a clean finish nor a failure (the supervisor relaunches at the
+        # new topology and the run continues there)
+        self.handoff_to: int | None = None
         # an exception escaped mid-run_epoch: node states are inconsistent
         # (some nodes stepped the failing epoch, some did not)
         self.epoch_failed = False
@@ -316,6 +322,29 @@ def run(
         # the watchdog's on-disk liveness signal; a no-op without a
         # filesystem persistence root
         beacon = _ProgressBeacon(persist_root, config.process_id)
+        # live-handoff participation (engine/autoscaler.py): worker 0
+        # watches for the supervisor's handoff request at epoch
+        # boundaries; every worker acks its fenced frontier through the
+        # same sentinel.  Inert outside supervised runs (incarnation 0).
+        handoff_sentinel = _HandoffSentinel(
+            persist_root, config.process_id, config.processes
+        )
+        if handoff_sentinel.root is not None:
+            # the autoscaler panel rides this worker's observability
+            # surfaces: the supervisor maintains lease/autoscaler.json,
+            # the worker re-exports it as autoscaler.* gauges (for
+            # /status, /metrics, `pathway_tpu top`) and as the
+            # flight-recorder dump's `autoscaler` payload section
+            from pathway_tpu.engine import autoscaler as _autoscaler
+
+            _as_root = handoff_sentinel.root
+            registry.register_collector(
+                "autoscaler.state",
+                lambda: _autoscaler.state_metrics(_as_root),
+            )
+            _blackbox.get_recorder().set_autoscaler_supplier(
+                lambda: _autoscaler.read_state_file(_as_root)
+            )
         # restart provenance, mesh-visible: the supervisor increments its
         # own supervisor.restarts counter, but that registry lives in the
         # spawn process, which serves no /metrics — each worker knows the
@@ -415,6 +444,7 @@ def run(
                         # pays zero per-epoch cost (not even the call)
                         profiler=profiler if profiler.enabled else None,
                         freshness=freshness if freshness.enabled else None,
+                        handoff=handoff_sentinel,
                     )
                 except BaseException as exc:
                     # black-box the failure BEFORE unwinding: the ring's
@@ -467,6 +497,7 @@ def run(
         from pathway_tpu.engine import flight_recorder as _blackbox_dev
 
         _blackbox_dev.get_recorder().set_device_supplier(None)
+        _blackbox_dev.get_recorder().set_autoscaler_supplier(None)
         if worker_ctx is not None:
             worker_ctx.close()
         if result.telemetry is not None:
@@ -746,6 +777,20 @@ class _ProgressBeacon:
                 os.makedirs(os.path.dirname(self.path), exist_ok=True)
             except OSError:
                 self.path = None
+        # load beacon (engine/autoscaler.py): beside liveness, a
+        # supervised worker reports its load reading (worst output
+        # staleness + backlog) at the same rate-limited cadence — the
+        # sensor feed of the supervisor's scale controller.  Solo and
+        # autoscaling-off runs pay nothing, not even the supplier call.
+        self.root = root if self.path is not None else None
+        self.worker = worker
+        self._last_load = 0.0
+        if self.root is not None:
+            from pathway_tpu.engine.autoscaler import autoscale_enabled
+
+            self._load_enabled = autoscale_enabled()
+        else:
+            self._load_enabled = False
         self.touch(force=True)
 
     def touch(self, force: bool = False) -> None:
@@ -760,6 +805,152 @@ class _ProgressBeacon:
                 f.write(str(os.getpid()))
         except OSError:
             pass  # liveness reporting must never take the worker down
+
+    _LOAD_INTERVAL_S = 0.5
+
+    def report_load(self, supplier) -> None:
+        """Rate-limited load beacon write; ``supplier`` returns
+        ``(worst_staleness_s, backlog, epochs)`` and is only invoked when
+        a write is actually due (so the snapshot cost is paid at beacon
+        cadence, not per loop iteration)."""
+        if not self._load_enabled:
+            return
+        now = _time.monotonic()
+        if now - self._last_load < self._LOAD_INTERVAL_S:
+            return
+        self._last_load = now
+        from pathway_tpu.engine.autoscaler import write_load_beacon
+
+        try:
+            staleness_s, backlog, epochs = supplier()
+            write_load_beacon(
+                self.root, self.worker,
+                staleness_s=staleness_s, backlog=backlog, epochs=epochs,
+            )
+        except Exception:  # noqa: BLE001 - load reporting must never
+            pass  # take the worker down (same rule as touch())
+
+
+def _load_reading(freshness, result) -> tuple[float, float, int]:
+    """One (worst staleness, backlog, epochs) sensor reading for the load
+    beacon.  Backlog sums the row/queue-count families of the freshness
+    tracker's backlog attribution (ages excluded — mixing seconds into a
+    count would double-weight a stall the staleness number already
+    carries).  No tracker → (0, 0): an instrumentation gap reads as calm,
+    never as load."""
+    staleness = 0.0
+    backlog = 0.0
+    if freshness is not None:
+        staleness = freshness.worst_staleness() or 0.0
+        for key, value in freshness.metrics_snapshot().items():
+            if key.startswith(
+                (
+                    "backlog.ingest.rows",
+                    "backlog.connector.queue",
+                    "backlog.epochs.pending",
+                )
+            ):
+                backlog += value
+    return staleness, backlog, result.epochs
+
+
+class _HandoffSentinel:
+    """Worker-side watch for the supervisor's live-handoff request.
+
+    Worker 0 polls ``lease/HANDOFF`` (rate-limited file read) at epoch
+    boundaries and, on a valid request for THIS incarnation and a
+    DIFFERENT worker count, returns the target so the epoch loop can
+    broadcast the handoff decision.  Requests from other incarnations
+    (zombie roots, stale files a crashed supervisor left behind) are
+    ignored — the supervisor clears the files either way."""
+
+    _MIN_INTERVAL_S = 0.2
+
+    def __init__(self, root: str | None, worker: int, workers: int):
+        from pathway_tpu.engine.persistence import writer_incarnation
+
+        self.incarnation = writer_incarnation()
+        self.root = root if self.incarnation > 0 else None
+        self.worker = worker
+        self.workers = workers
+        self._last = 0.0
+
+    def poll(self) -> int | None:
+        """The pending handoff target (worker count), or None."""
+        if self.root is None:
+            return None
+        now = _time.monotonic()
+        if now - self._last < self._MIN_INTERVAL_S:
+            return None
+        self._last = now
+        from pathway_tpu.engine.persistence import read_handoff_request
+
+        req = read_handoff_request(self.root)
+        if (
+            req is None
+            or req["incarnation"] != self.incarnation
+            or req["to_workers"] == self.workers
+        ):
+            return None
+        return req["to_workers"]
+
+    def ack(self, to_workers: int, frontier: int) -> None:
+        if self.root is None:
+            return
+        from pathway_tpu.engine.persistence import write_handoff_ack
+
+        write_handoff_ack(
+            self.root, self.worker,
+            incarnation=self.incarnation, to_workers=to_workers,
+            frontier=frontier,
+        )
+
+
+def _handoff_exit(
+    result,
+    storage,
+    sentinel,
+    to_n: int,
+    frontier: int,
+    mesh=None,
+) -> None:
+    """The worker's half of a live handoff: drain-commit the EXACT
+    current frontier (stamped ``handoff_to``), fence the storage so
+    nothing later can move it, barrier with every peer (all-or-nothing —
+    one dead peer fails the collective and the supervisor falls back),
+    then ack and let the epoch loop break WITHOUT finishing the scope.
+
+    The injected ``handoff_crash`` fault (SIGKILL after the fence commit,
+    before the ack) lands between the commit and the barrier: exactly the
+    window where a real mid-handoff death leaves a fenced-but-unacked
+    root the restart fallback must absorb."""
+    from pathway_tpu.engine import faults as _faults
+    from pathway_tpu.engine import flight_recorder as _blackbox
+
+    _blackbox.record(
+        "handoff.begin", worker=sentinel.worker, to_workers=to_n,
+        frontier=frontier,
+    )
+    if storage is not None:
+        storage.fence_for_handoff(to_n)
+        # synchronous drain: publishes every staged async generation in
+        # order, then the handoff generation itself — the manifest the
+        # successor topology's repartition replay reads
+        storage.commit(processed_up_to=frontier)
+    _faults.maybe_crash_handoff(worker=sentinel.worker, to_workers=to_n)
+    if mesh is not None:
+        # retire FIRST: peer departures during the barrier (and after it,
+        # as everyone tears down) are the expected sound of a coordinated
+        # exit, not a partition — but a peer that DIED mid-handoff still
+        # fails the barrier with CommError, which is the point: the
+        # handoff is all-or-nothing and the supervisor falls back
+        mesh.retire()
+        mesh.barrier(("handoff", to_n))
+    sentinel.ack(to_n, frontier)
+    result.handoff_to = to_n
+    _blackbox.record(
+        "handoff.acked", worker=sentinel.worker, to_workers=to_n,
+    )
 
 
 def _epoch_instruments():
@@ -788,12 +979,13 @@ def _event_loop(
     beacon: Any = None,
     profiler: Any = None,
     freshness: Any = None,
+    handoff: Any = None,
 ) -> None:
     if scope.worker is not None:
         return _event_loop_coordinated(
             scope, lowerer, result, max_epochs=max_epochs, storage=storage,
             prober=prober, telemetry=telemetry, beacon=beacon,
-            profiler=profiler, freshness=freshness,
+            profiler=profiler, freshness=freshness, handoff=handoff,
         )
     if beacon is None:
         beacon = _ProgressBeacon(None, 0)
@@ -816,6 +1008,14 @@ def _event_loop(
         # so its mtime proves the event loop schedules — a wedged epoch or
         # a deadlock stops it and the supervisor's watchdog takes over
         beacon.touch()
+        beacon.report_load(lambda: _load_reading(freshness, result))
+        if handoff is not None:
+            to_n = handoff.poll()
+            if to_n is not None:
+                # planned rescale (single supervised worker: the grow
+                # from 1 starts here too): drain, fence, ack, exit 0
+                _handoff_exit(result, storage, handoff, to_n, last_time)
+                break
         if (
             storage is not None
             and (_time.monotonic() - last_snapshot) >= snapshot_interval
@@ -916,6 +1116,12 @@ def _event_loop(
         wake.wait(0.001)
         wake.clear()
     scope.current_time = max(scope.current_time, last_time)
+    if result.handoff_to is not None:
+        # live handoff: the scope is NOT finished — no on_finish hooks, no
+        # final flush; the run continues at the new topology from the
+        # fenced frontier, and finishing here would emit end-of-stream
+        # effects the successor would then replay on top of
+        return
     scope.finish()
     result.clean_finish = True
     if prober is not None:
@@ -934,6 +1140,7 @@ def _event_loop_coordinated(
     beacon: Any = None,
     profiler: Any = None,
     freshness: Any = None,
+    handoff: Any = None,
 ) -> None:
     """Multi-worker BSP loop: worker 0 sequences epochs, every worker runs
     them in lockstep, exchanging rows at the declared exchange points.
@@ -962,6 +1169,7 @@ def _event_loop_coordinated(
     while True:
         # event-loop liveness for the supervisor's watchdog (idle included)
         beacon.touch()
+        beacon.report_load(lambda: _load_reading(freshness, result))
         if (
             storage is not None
             and (_time.monotonic() - last_snapshot) >= snapshot_interval
@@ -1008,7 +1216,13 @@ def _event_loop_coordinated(
                     [s for _m, _f, _p, s in gathered]
                 )
             mins = [m for m, _f, _p, _s in gathered if m is not None]
-            if mins:
+            handoff_to = handoff.poll() if handoff is not None else None
+            if handoff_to is not None:
+                # planned rescale outranks everything: the fenced
+                # frontier must be THIS epoch boundary, before any more
+                # input folds in
+                decision = ("handoff", handoff_to)
+            elif mins:
                 t = min(mins)
                 if t <= last_time:
                     t = last_time + 2  # strictly increasing, even
@@ -1029,6 +1243,15 @@ def _event_loop_coordinated(
             decision = None
         kind, t = mesh.bcast(("epoch-go", round_), decision)
 
+        if kind == "handoff":
+            # every worker exits through the coordinated drain: commit
+            # the exact frontier (stamped handoff_to), fence, barrier
+            # (all-or-nothing), ack, and leave the loop WITHOUT finishing
+            # the scope — the supervisor relaunches at the new topology
+            _handoff_exit(
+                result, storage, handoff, t, last_time, mesh=mesh
+            )
+            break
         if kind == "stop":
             break
         if kind == "drain":
@@ -1083,6 +1306,8 @@ def _event_loop_coordinated(
         if max_epochs is not None and result.epochs >= max_epochs:
             break
     scope.current_time = max(scope.current_time, last_time)
+    if result.handoff_to is not None:
+        return  # live handoff: see the solo loop's exit note
     scope.finish()
     result.clean_finish = True
     if prober is not None:

@@ -273,6 +273,33 @@ def render_top(
         lines.append("")
         lines.append(f"columnar: {int(bail_total)} bail(s) — {detail}")
 
+    autoscaler = status.get("autoscaler") or {}
+    if autoscaler.get("autoscaler.target.workers"):
+        # the supervisor's scale-controller panel (lease/autoscaler.json
+        # via the worker's registry collector): target topology, budget,
+        # cooldown, and whether a live handoff is in flight right now
+        phase = {
+            0.0: "steady",
+            1.0: "hot (dwell running)",
+            2.0: "cooling down",
+            3.0: "HANDOFF IN FLIGHT",
+        }.get(autoscaler.get("autoscaler.phase") or 0.0, "steady")
+        lines.append("")
+        lines.append(
+            f"autoscaler: target {int(autoscaler['autoscaler.target.workers'])} "
+            f"worker(s) · {phase} · budget left "
+            f"{int(autoscaler.get('autoscaler.budget.left') or 0)}"
+        )
+        cooldown = autoscaler.get("autoscaler.cooldown.remaining.s") or 0.0
+        decisions = autoscaler.get("autoscaler.decisions.logged") or 0.0
+        detail = f"  {int(decisions)} decision(s) logged"
+        last = _labeled(autoscaler, "autoscaler.last.decision")
+        for action, target in sorted(last.items()):
+            detail += f" · last: {action} → {int(target)}"
+        if cooldown > 0:
+            detail += f" · cooldown {cooldown:.1f} s remaining"
+        lines.append(detail)
+
     operators = status.get("operators") or {}
     if operators:
         lines.append("")

@@ -673,13 +673,38 @@ def make_input_table(
             # emitted item raises before enqueue, exercising this very
             # supervision loop's budget + restart/reseek path
             emit_fn = tracker
+            # load_spike buffering state: while "until" is set, emitted
+            # items accumulate in "buf" and flush as one burst when the
+            # window lapses — downstream sees silence, then a wall
+            spike_state: dict = {"until": None, "buf": []}
+
+            def _flush_spike(wait: bool = False) -> None:
+                until = spike_state["until"]
+                if until is None:
+                    return
+                if wait:
+                    # the source drained mid-window: honor the declared
+                    # silence before the burst, or the spike would shrink
+                    # to however much input happened to remain
+                    while _time.monotonic() < until:
+                        _time.sleep(0.02)  # interruptible pacing
+                spike_state["until"] = None
+                buffered, spike_state["buf"] = spike_state["buf"], []
+                for held in buffered:
+                    tracker(held)
+
             fault_plan = _faults.active_plan()
             if fault_plan is not None and fault_plan.has(
-                "connector_read", "connector_stall"
+                "connector_read", "connector_stall", "load_spike"
             ):
                 source_name = type(reader).__name__
 
                 def emit_fn(item, _tracker=tracker):
+                    if spike_state["until"] is not None:
+                        if _time.monotonic() < spike_state["until"]:
+                            spike_state["buf"].append(item)
+                            return
+                        _flush_spike()  # window over: burst, then continue
                     if fault_plan.check("connector_read", source=source_name):
                         raise _faults.InjectedFault(
                             f"injected connector_read failure in {source_name}"
@@ -696,12 +721,26 @@ def make_input_table(
                         deadline = _time.monotonic() + stall.delay_ms / 1000.0
                         while _time.monotonic() < deadline:
                             _time.sleep(0.02)  # interruptible pacing
+                    spike = fault_plan.check("load_spike", source=source_name)
+                    if spike is not None:
+                        # deterministic load wave: buffer this and every
+                        # following item for delay_ms, then flush them as
+                        # one instantaneous burst.  No error, no reorder —
+                        # delivered rows stay byte-identical; only
+                        # staleness/backlog (and the autoscaler watching
+                        # them) can tell it happened
+                        spike_state["until"] = (
+                            _time.monotonic() + spike.delay_ms / 1000.0
+                        )
+                        spike_state["buf"].append(item)
+                        return
                     _tracker(item)
 
             consecutive = 0
             while True:
                 try:
                     reader.run(emit_fn)
+                    _flush_spike(wait=True)  # never swallow a buffered tail
                     return True
                 except Exception as exc:
                     if tracker.progressed:

@@ -1,0 +1,792 @@
+"""Load-adaptive autoscaler + live shard handoff (engine/autoscaler.py,
+engine/supervisor.py handoff orchestration, engine/persistence.py handoff
+files).
+
+Three layers of coverage, cheapest first:
+
+* **Controller hysteresis** — pure decision logic over an injected clock:
+  oscillating load never flaps, a dip resets the dwell clock, cooldown
+  blocks both directions, budget exhaustion is loud exactly once, and
+  the min/max bounds make shrink-below-floor and grow-above-cap
+  non-decisions rather than clamped ones.
+* **Supervisor orchestration** — fake worker handles plus a background
+  "cluster" thread that answers (or sabotages) the handoff protocol the
+  way real workers do: the live path relaunches at N' without charging
+  the restart budget (``max_restarts=0`` proves it), a death mid-drain
+  and a blown ack deadline both fall back to the restart-based rescale,
+  a split exit (some acked, some finished) falls back too, and zero
+  acks classify as a genuine clean finish.
+* **Chaos acceptance** — real supervised clusters under a seeded
+  ``load_spike``: sustained staleness grows 1→2 via live handoff, the
+  spike ends and sustained idleness shrinks back 2→1, with the canonical
+  net output byte-identical to an unscaled run; a SIGKILL injected into
+  the narrowest handoff window (``handoff_crash``: after the fenced
+  drain-commit, before the ack) falls back to a restart-based rescale
+  with a clean ``pathway_tpu scrub`` and nothing spliced.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import signal
+import socket
+import threading
+import time
+from collections import Counter
+from pathlib import Path
+
+import pytest
+
+from pathway_tpu.engine import autoscaler as asc
+from pathway_tpu.engine import comm
+from pathway_tpu.engine import metrics as em
+from pathway_tpu.engine import persistence as pz
+from pathway_tpu.engine.autoscaler import ScaleController
+from pathway_tpu.engine.supervisor import Supervisor
+
+
+def _controller(**overrides) -> ScaleController:
+    """A controller with every knob explicit — unit tests must not depend
+    on (or be perturbed by) the PATHWAY_AUTOSCALE_* environment."""
+    kwargs = dict(
+        current=2,
+        min_workers=1,
+        max_workers=4,
+        staleness_hi_s=1.0,
+        dwell_s=2.0,
+        cooldown_s=5.0,
+        idle_dwell_s=3.0,
+        budget=10,
+    )
+    kwargs.update(overrides)
+    return ScaleController(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# ScaleController hysteresis (pure logic, injected clock)
+# ---------------------------------------------------------------------------
+
+
+class TestScaleControllerHysteresis:
+    def test_oscillating_load_never_flaps(self):
+        """Load crossing the threshold faster than the dwell window must
+        never trigger — years of flapping input, zero decisions."""
+        c = _controller()
+        now = 0.0
+        for i in range(400):
+            now += 0.5
+            staleness = 5.0 if i % 2 else 0.1
+            assert c.observe(now, staleness, 5.0) is None
+        assert c.current == 2
+        assert list(c.decisions) == []
+        assert c.budget_left == 10
+
+    def test_dip_resets_the_dwell_clock(self):
+        c = _controller()
+        assert c.observe(0.0, 5.0, 1.0) is None  # hot clock starts
+        assert c.observe(1.9, 5.0, 1.0) is None  # 1.9s < 2.0s dwell
+        assert c.observe(2.0, 0.2, 1.0) is None  # one dip: clock resets
+        assert c.observe(2.1, 5.0, 1.0) is None  # hot clock restarts
+        assert c.observe(4.0, 5.0, 1.0) is None  # 1.9s again — still not
+        entry = c.observe(4.2, 5.0, 1.0)  # 2.1s sustained: grow
+        assert entry is not None and entry["action"] == "grow"
+        assert entry["from"] == 2 and entry["to"] == 3
+        assert c.current == 3
+        assert c.budget_left == 9
+        assert c.cooldown_remaining(4.2) == pytest.approx(5.0)
+
+    def test_cooldown_blocks_both_directions_dwell_carries_over(self):
+        c = _controller()
+        c.observe(0.0, 5.0, 1.0)
+        assert c.observe(2.0, 5.0, 1.0) is not None  # grow at t=2
+        # cooldown until t=7: sustained heat keeps the dwell clock running
+        # but no decision fires inside the window...
+        for t in (3.0, 4.0, 5.0, 6.0, 6.9):
+            assert c.observe(t, 5.0, 1.0) is None
+        # ...and the instant it expires, the already-satisfied dwell fires
+        # without re-paying the window
+        entry = c.observe(7.1, 5.0, 1.0)
+        assert entry is not None and entry["action"] == "grow"
+        assert entry["from"] == 3 and entry["to"] == 4
+
+    def test_sustained_idle_shrinks(self):
+        c = _controller()
+        assert c.observe(0.0, 0.1, 0.0) is None
+        assert c.observe(2.9, 0.1, 0.0) is None  # 2.9s < 3.0s idle dwell
+        entry = c.observe(3.1, 0.1, 0.0)
+        assert entry is not None and entry["action"] == "shrink"
+        assert entry["from"] == 2 and entry["to"] == 1
+        assert c.current == 1
+
+    def test_low_staleness_with_backlog_is_not_idle(self):
+        """Backlog piling up behind a fresh-looking output blocks the
+        shrink: idleness requires BOTH signals calm."""
+        c = _controller()
+        for t in (0.0, 2.0, 4.0, 6.0, 8.0):
+            assert c.observe(t, 0.1, 25.0) is None
+        assert list(c.decisions) == []
+
+    def test_shrink_never_below_floor(self):
+        c = _controller(current=1, min_workers=1)
+        for t in (0.0, 2.0, 4.0, 6.0):
+            assert c.observe(t, 0.0, 0.0) is None
+        assert c.current == 1
+        assert list(c.decisions) == []  # a non-decision, not a clamped one
+        assert c.budget_left == 10
+
+    def test_grow_never_above_cap(self):
+        c = _controller(current=4, max_workers=4)
+        for t in (0.0, 2.0, 4.0, 6.0):
+            assert c.observe(t, 9.0, 50.0) is None
+        assert c.current == 4
+        assert list(c.decisions) == []
+        assert c.budget_left == 10
+
+    def test_budget_exhaustion_is_loud_exactly_once(self):
+        before = em.get_registry().scalar_metrics().get(
+            "autoscaler.budget.exhausted", 0.0
+        )
+        c = _controller(current=1, budget=1, cooldown_s=0.0, dwell_s=0.5)
+        c.observe(0.0, 5.0, 1.0)
+        assert c.observe(0.5, 5.0, 1.0) is not None  # budget spent: 1→2
+        # the wanted second grow is suppressed — loudly, exactly once —
+        # and then the controller goes quiet with the topology pinned
+        for t in (1.0, 1.5, 2.0, 2.5, 3.0):
+            assert c.observe(t, 5.0, 1.0) is None
+        actions = [d["action"] for d in c.decisions]
+        assert actions == ["grow", "suppressed-grow"]
+        suppressed = c.decisions[-1]
+        assert "budget exhausted" in suppressed["reason"]
+        assert c.current == 2  # the suppressed decision moved nothing
+        assert em.get_registry().scalar_metrics()[
+            "autoscaler.budget.exhausted"
+        ] == before + 1
+
+
+# ---------------------------------------------------------------------------
+# Handoff coordination files + load beacons (advisory JSON beside the lease)
+# ---------------------------------------------------------------------------
+
+
+class TestHandoffFiles:
+    def test_request_ack_round_trip_and_clear(self, tmp_path):
+        root = str(tmp_path)
+        assert pz.read_handoff_request(root) is None
+        pz.post_handoff_request(
+            root, incarnation=3, from_workers=2, to_workers=3,
+            reason="staleness sustained",
+        )
+        req = pz.read_handoff_request(root)
+        assert req is not None
+        assert req["incarnation"] == 3
+        assert req["from_workers"] == 2 and req["to_workers"] == 3
+        pz.write_handoff_ack(root, 0, incarnation=3, to_workers=3, frontier=17)
+        pz.write_handoff_ack(root, 1, incarnation=3, to_workers=3, frontier=9)
+        acks = pz.read_handoff_acks(root, 2)
+        assert sorted(acks) == [0, 1]
+        assert acks[0]["frontier"] == 17 and acks[0]["to_workers"] == 3
+        pz.clear_handoff(root, 2)
+        assert pz.read_handoff_request(root) is None
+        assert pz.read_handoff_acks(root, 2) == {}
+
+    def test_malformed_request_reads_as_absent(self, tmp_path):
+        root = str(tmp_path)
+        lease = tmp_path / "lease"
+        lease.mkdir()
+        (lease / "HANDOFF").write_text("{torn mid-wri")  # torn write
+        assert pz.read_handoff_request(root) is None
+        (lease / "HANDOFF").write_text(
+            json.dumps({"incarnation": "x", "to_workers": 2})
+        )
+        assert pz.read_handoff_request(root) is None  # wrong types
+        (lease / "HANDOFF").write_text(
+            json.dumps({"incarnation": 1, "to_workers": 0})
+        )
+        assert pz.read_handoff_request(root) is None  # nonsense target
+
+
+class TestLoadBeacons:
+    def test_round_trip_worst_load_and_clear(self, tmp_path):
+        root = str(tmp_path)
+        asc.write_load_beacon(root, 0, staleness_s=1.5, backlog=3, epochs=7)
+        asc.write_load_beacon(root, 1, staleness_s=0.5, backlog=2, epochs=9)
+        beacons = asc.read_load_beacons(root, 2)
+        assert sorted(beacons) == [0, 1]
+        assert asc.worst_load(beacons) == (1.5, 5.0)
+        asc.clear_load_beacons(root, 2)
+        assert asc.read_load_beacons(root, 2) == {}
+
+    def test_stale_beacon_is_a_dead_sensor_not_a_reading(self, tmp_path):
+        root = str(tmp_path)
+        asc.write_load_beacon(root, 0, staleness_s=9.0, backlog=1, epochs=1)
+        # backdate worker 1's beacon past the freshness window
+        pz._lease_dir_write_json(
+            root, f"{asc.LOAD_PREFIX}1",
+            {"worker": 1, "staleness_s": 99.0, "backlog": 99.0,
+             "at": time.time() - 60.0},
+        )
+        beacons = asc.read_load_beacons(root, 2)
+        assert sorted(beacons) == [0]
+
+    def test_no_beacons_reads_as_calm(self):
+        assert asc.worst_load({}) == (0.0, 0.0)
+
+
+class TestMovingShards:
+    def test_same_topology_moves_nothing(self):
+        assert comm.moving_shards(2, 2) == 0
+        assert comm.moving_shards(1, 1) == 0
+
+    def test_known_counts_and_brute_force_agreement(self):
+        span = 1 << comm.SHARD_BITS
+        # 1→2: every odd shard changes owner
+        assert comm.moving_shards(1, 2) == span // 2
+        for n_old, n_new in ((1, 2), (2, 3), (3, 2), (2, 4), (5, 7)):
+            got = comm.moving_shards(n_old, n_new)
+            want = sum(1 for s in range(span) if s % n_old != s % n_new)
+            assert got == want, (n_old, n_new)
+            assert 0 < got < span
+
+
+# ---------------------------------------------------------------------------
+# State file + panel metrics (the /status, `top` and blackbox feed)
+# ---------------------------------------------------------------------------
+
+
+class TestStateFile:
+    def test_state_round_trip_and_panel_metrics(self, tmp_path):
+        root = str(tmp_path)
+        c = _controller(dwell_s=0.5, cooldown_s=60.0, budget=3)
+        c.observe(0.0, 5.0, 2.0)
+        assert c.observe(0.6, 5.0, 2.0) is not None  # grow 2→3
+        c.write_state(root, 0.6)
+        state = asc.read_state_file(root)
+        assert state is not None
+        assert state["target_workers"] == 3
+        assert state["budget_left"] == 2
+        assert state["last_decision"]["action"] == "grow"
+        metrics = asc.state_metrics(root)
+        assert metrics["autoscaler.target.workers"] == 3.0
+        assert metrics["autoscaler.budget.left"] == 2.0
+        assert metrics["autoscaler.phase"] == 2.0  # cooling down
+        assert metrics["autoscaler.decisions.logged"] == 1.0
+        # the decision's action rides as a label so the text survives the
+        # numeric scalar path into /status and the `top` panel
+        assert metrics["autoscaler.last.decision{action=grow}"] == 3.0
+
+    def test_handoff_state_is_the_loudest_phase(self, tmp_path):
+        root = str(tmp_path)
+        c = _controller()
+        c.handoff_state = "handoff-requested"
+        c.write_state(root, 1.0)
+        assert asc.state_metrics(root)["autoscaler.phase"] == 3.0
+
+    def test_cleared_state_reads_as_absent(self, tmp_path):
+        root = str(tmp_path)
+        _controller().write_state(root, 0.0)
+        assert asc.read_state_file(root) is not None
+        asc.clear_state_file(root)
+        assert asc.read_state_file(root) is None
+        assert asc.state_metrics(root) == {}
+
+
+# ---------------------------------------------------------------------------
+# Supervisor handoff orchestration (fake handles, background "cluster")
+# ---------------------------------------------------------------------------
+
+
+class _LiveHandle:
+    """Worker handle whose exit code the test (or its pump thread) flips."""
+
+    def __init__(self, code=None):
+        self.exitcode = code
+
+    def terminate(self):
+        if self.exitcode is None:
+            self.exitcode = -signal.SIGTERM
+
+    def kill(self):
+        if self.exitcode is None:
+            self.exitcode = -signal.SIGKILL
+
+    def join(self, timeout=None):
+        pass
+
+
+def _autoscale_knobs(monkeypatch, **extra):
+    knobs = {
+        "PATHWAY_AUTOSCALE_MIN_WORKERS": "1",
+        "PATHWAY_AUTOSCALE_MAX_WORKERS": "3",
+        "PATHWAY_AUTOSCALE_STALENESS_S": "0.3",
+        "PATHWAY_AUTOSCALE_DWELL_S": "0.2",
+        "PATHWAY_AUTOSCALE_COOLDOWN_S": "60",
+        "PATHWAY_AUTOSCALE_IDLE_S": "60",
+        "PATHWAY_AUTOSCALE_BUDGET": "4",
+    }
+    knobs.update(extra)
+    for key, val in knobs.items():
+        monkeypatch.setenv(key, val)
+
+
+def _pump(root, n_workers, stop, on_request):
+    """The background 'cluster': keep the load beacons hot until the
+    supervisor posts a handoff request, then hand it to ``on_request``
+    (which plays the workers' side of the protocol — or sabotages it)."""
+    while not stop.is_set():
+        for w in range(n_workers):
+            asc.write_load_beacon(
+                root, w, staleness_s=5.0, backlog=10.0, epochs=3
+            )
+        req = pz.read_handoff_request(root)
+        if req is not None and on_request(req):
+            return
+        stop.wait(0.02)
+
+
+def _scalar(name):
+    return em.get_registry().scalar_metrics().get(name, 0.0)
+
+
+class TestSupervisorHandoff:
+    def _run(self, root, spawn, stop, on_request, *, n=1, max_restarts=0):
+        pump = threading.Thread(
+            target=_pump, args=(root, n, stop, on_request), daemon=True
+        )
+        pump.start()
+        try:
+            sup = Supervisor(
+                spawn, n, max_restarts=max_restarts, restart_jitter_s=0.0,
+                checkpoint_root=root, autoscale=True,
+            )
+            return sup, sup.run()
+        finally:
+            stop.set()
+            pump.join(timeout=5)
+
+    def test_live_handoff_relaunches_without_charging_budget(
+        self, tmp_path, monkeypatch
+    ):
+        """All workers drain + ack + exit 0 → relaunch at N' with a fresh
+        restart budget (max_restarts=0 would fail the run otherwise)."""
+        _autoscale_knobs(monkeypatch)
+        root = str(tmp_path)
+        handoffs_before = _scalar("supervisor.handoffs")
+        spawned: list[tuple[int, int, int, _LiveHandle]] = []
+
+        def spawn(wid, attempt, n_workers=1):
+            handle = _LiveHandle(0 if attempt >= 1 else None)
+            spawned.append((attempt, wid, n_workers, handle))
+            return handle
+
+        def on_request(req):
+            for w in range(req["from_workers"]):
+                pz.write_handoff_ack(
+                    root, w, incarnation=req["incarnation"],
+                    to_workers=req["to_workers"], frontier=7,
+                )
+            for _a, _w, _n, handle in spawned:
+                if handle.exitcode is None:
+                    handle.exitcode = 0
+            return True
+
+        stop = threading.Event()
+        sup, res = self._run(root, spawn, stop, on_request)
+
+        assert len(res.rescales) == 1, res.rescales
+        rescale = res.rescales[0]
+        assert rescale["kind"] == "autoscale"
+        assert rescale["action"] == "grow"
+        assert rescale["from"] == 1 and rescale["to"] == 2
+        assert rescale["moving_shards"] == (1 << comm.SHARD_BITS) // 2
+        assert sup.n_workers == 2
+        assert res.exit_codes == [0, 0]
+        assert res.history == [[0], [0, 0]]
+        assert res.last_failure is None
+        # the relaunch was handed the NEW cluster size
+        assert [(w, n) for a, w, n, _h in spawned if a == 1] == [(0, 2), (1, 2)]
+        assert _scalar("supervisor.handoffs") == handoffs_before + 1
+        # coordination residue is gone; the decision log survives with the
+        # actuator-side completion note
+        assert pz.read_handoff_request(root) is None
+        assert asc.read_load_beacons(root, 2) == {}
+        state = asc.read_state_file(root)
+        assert state is not None and state["target_workers"] == 2
+        assert any(
+            d.get("action") == "handoff-complete" for d in state["decisions"]
+        )
+
+    def test_death_mid_drain_falls_back_to_restart_rescale(
+        self, tmp_path, monkeypatch
+    ):
+        """A nonzero exit while the handoff drains poisons it: the target
+        topology still lands, via the restart path, with a fresh budget."""
+        _autoscale_knobs(monkeypatch)
+        root = str(tmp_path)
+        fallbacks_before = _scalar("supervisor.handoff.fallbacks")
+        spawned: list[tuple[int, _LiveHandle]] = []
+
+        def spawn(wid, attempt, n_workers=1):
+            handle = _LiveHandle(0 if attempt >= 1 else None)
+            spawned.append((attempt, handle))
+            return handle
+
+        def on_request(req):
+            for attempt, handle in spawned:
+                if handle.exitcode is None:
+                    handle.exitcode = 1  # died mid-drain, no ack
+            return True
+
+        stop = threading.Event()
+        sup, res = self._run(root, spawn, stop, on_request)
+
+        assert len(res.rescales) == 1, res.rescales
+        rescale = res.rescales[0]
+        assert rescale["kind"] == "autoscale-fallback"
+        assert rescale["action"] == "grow"
+        assert rescale["from"] == 1 and rescale["to"] == 2
+        assert sup.n_workers == 2
+        assert res.history == [[1], [0, 0]]
+        assert "falling back to a restart-based rescale" in res.last_failure
+        assert _scalar("supervisor.handoff.fallbacks") == fallbacks_before + 1
+        state = asc.read_state_file(root)
+        assert any(
+            d.get("action") == "handoff-fallback" for d in state["decisions"]
+        )
+
+    def test_ack_deadline_converts_wedged_drain_to_fallback(
+        self, tmp_path, monkeypatch
+    ):
+        """No exit, no ack: the deadline names the straggler (hang
+        provenance, like the watchdog's) and falls back."""
+        _autoscale_knobs(monkeypatch)
+        monkeypatch.setenv("PATHWAY_AUTOSCALE_HANDOFF_DEADLINE_S", "0.4")
+        root = str(tmp_path)
+
+        def spawn(wid, attempt, n_workers=1):
+            return _LiveHandle(0 if attempt >= 1 else None)
+
+        stop = threading.Event()
+        sup, res = self._run(
+            root, spawn, stop, on_request=lambda req: False
+        )
+
+        assert len(res.rescales) == 1, res.rescales
+        assert res.rescales[0]["kind"] == "autoscale-fallback"
+        assert "not acknowledged within" in res.last_failure
+        assert "falling back to a restart-based rescale" in res.last_failure
+        assert sup.n_workers == 2
+        # the wedged worker was terminated, then the target applied
+        assert res.history[0] == [-signal.SIGTERM]
+
+    def test_split_exit_falls_back(self, tmp_path, monkeypatch):
+        """Some workers drained for the handoff while the rest finished
+        for real: only a restart rescale can land the target topology."""
+        _autoscale_knobs(monkeypatch)
+        root = str(tmp_path)
+        spawned: list[tuple[int, int, _LiveHandle]] = []
+
+        def spawn(wid, attempt, n_workers=2):
+            handle = _LiveHandle(0 if attempt >= 1 else None)
+            spawned.append((attempt, wid, handle))
+            return handle
+
+        def on_request(req):
+            # only worker 0 acks; both exit 0
+            pz.write_handoff_ack(
+                root, 0, incarnation=req["incarnation"],
+                to_workers=req["to_workers"], frontier=3,
+            )
+            for _a, _w, handle in spawned:
+                if handle.exitcode is None:
+                    handle.exitcode = 0
+            return True
+
+        stop = threading.Event()
+        sup, res = self._run(root, spawn, stop, on_request, n=2)
+
+        assert len(res.rescales) == 1, res.rescales
+        rescale = res.rescales[0]
+        assert rescale["kind"] == "autoscale-fallback"
+        assert rescale["from"] == 2 and rescale["to"] == 3
+        assert "split exit" in rescale["reason"]
+        assert sup.n_workers == 3
+
+    def test_zero_acks_is_a_genuine_clean_finish(self, tmp_path, monkeypatch):
+        """The sources finished before any worker saw the request: no
+        rescale happened, and the request residue is cleared."""
+        _autoscale_knobs(monkeypatch)
+        root = str(tmp_path)
+        spawned: list[_LiveHandle] = []
+
+        def spawn(wid, attempt, n_workers=1):
+            handle = _LiveHandle()
+            spawned.append(handle)
+            return handle
+
+        def on_request(req):
+            for handle in spawned:
+                if handle.exitcode is None:
+                    handle.exitcode = 0  # finished for real — no acks
+            return True
+
+        stop = threading.Event()
+        sup, res = self._run(root, spawn, stop, on_request)
+
+        assert res.rescales == []
+        assert sup.n_workers == 1
+        assert res.exit_codes == [0]
+        assert pz.read_handoff_request(root) is None
+
+
+# ---------------------------------------------------------------------------
+# Chaos acceptance: real supervised clusters under a seeded load_spike
+# ---------------------------------------------------------------------------
+
+N_ROWS = 160
+ROW_DELAY_S = 0.03
+
+
+def _free_port_base(n: int = 4) -> int:
+    socks = []
+    try:
+        base = None
+        for _ in range(20):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        ports = sorted(s.getsockname()[1] for s in socks)
+        for i in range(len(ports) - n):
+            if ports[i + n - 1] - ports[i] == n - 1:
+                base = ports[i]
+                break
+        return base or ports[0]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _scenario(tmpdir: str) -> None:
+    """Streaming source (per-row commits → many epochs), shard-exchanged
+    groupby, jsonlines sinks, frequent snapshots — the PR-10 rescale
+    scenario, long enough for a grow AND a shrink to land mid-stream."""
+    import pathway_tpu as pw
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time as _t
+
+            for i in range(N_ROWS):
+                self.next(k=i % 3, v=1)
+                self.commit()
+                _t.sleep(ROW_DELAY_S)
+
+    t = pw.io.python.read(
+        Src(), schema=pw.schema_from_types(k=int, v=int), name="src"
+    )
+    counts = t.groupby(t.k).reduce(k=t.k, n=pw.reducers.count())
+    pw.io.jsonlines.write(counts, os.path.join(tmpdir, "counts.jsonl"))
+    pw.run(
+        persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem(os.path.join(tmpdir, "pstore")),
+            snapshot_interval_ms=50,
+        )
+    )
+
+
+def _worker_main(wid, attempt, n, port, tmpdir, plan_json):
+    os.environ["PATHWAY_PROCESSES"] = str(n)
+    os.environ["PATHWAY_PROCESS_ID"] = str(wid)
+    os.environ["PATHWAY_FIRST_PORT"] = str(port)
+    os.environ["PATHWAY_THREADS"] = "1"
+    os.environ["PATHWAY_COMM_SECRET"] = "autoscale-test"
+    os.environ["PATHWAY_RESTART_ATTEMPT"] = str(attempt)
+    os.environ["PATHWAY_COMM_HEARTBEAT_S"] = "0.5"
+    os.environ["PATHWAY_COMM_RECONNECT_WINDOW_S"] = "5"
+    if plan_json:
+        os.environ["PATHWAY_FAULT_PLAN"] = plan_json
+    else:
+        os.environ.pop("PATHWAY_FAULT_PLAN", None)
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized by the forked parent (CPU)
+
+    from pathway_tpu.engine import faults
+    from pathway_tpu.internals.config import refresh_config
+    from pathway_tpu.internals.parse_graph import G
+
+    refresh_config()
+    faults.clear_plan()  # re-read THIS process's env, not the parent's cache
+    G.clear()
+    _scenario(tmpdir)
+
+
+def _run_supervised(tmpdir, plan_json, n=1, max_restarts=3, autoscale=None):
+    ctx = multiprocessing.get_context("fork")
+    port = _free_port_base(4)
+
+    def spawn(wid: int, attempt: int, n_workers: int = n):
+        p = ctx.Process(
+            target=_worker_main,
+            args=(wid, attempt, n_workers, port, str(tmpdir), plan_json),
+            daemon=True,
+        )
+        p.start()
+        return p
+
+    return Supervisor(
+        spawn,
+        n,
+        max_restarts=max_restarts,
+        restart_jitter_s=0.05,
+        checkpoint_root=os.path.join(str(tmpdir), "pstore"),
+        autoscale=autoscale,
+    ).run()
+
+
+def _canonical(tmpdir, workers) -> bytes:
+    """Canonical serialized net output across all worker sink shards."""
+    state: Counter = Counter()
+    base = Path(tmpdir) / "counts.jsonl"
+    paths = [base] + [
+        Path(f"{base}.part-{w}") for w in range(1, workers + 1)
+    ]
+    for path in paths:
+        if not path.exists():
+            continue
+        for line in path.read_text().splitlines():
+            obj = json.loads(line)
+            diff = obj.pop("diff")
+            obj.pop("time")
+            state[json.dumps(obj, sort_keys=True)] += diff
+    assert all(c >= 0 for c in state.values()), state
+    net = sorted((k, c) for k, c in state.items() if c)
+    return json.dumps(net).encode()
+
+
+_SPIKE = {
+    # ~row 40 (rows and per-row commits both pass the emit hook): silence
+    # for 2.5s, then the buffered rows land as one burst.  Attempt 0 only —
+    # a post-rescale replay must not re-trigger it.
+    "kind": "load_spike",
+    "source": "SubjectReader",
+    "nth": 80,
+    "delay_ms": 2500,
+    "attempt": 0,
+}
+
+_CHAOS_KNOBS = {
+    "PATHWAY_AUTOSCALE": "1",
+    "PATHWAY_AUTOSCALE_MIN_WORKERS": "1",
+    "PATHWAY_AUTOSCALE_MAX_WORKERS": "2",
+    "PATHWAY_AUTOSCALE_STALENESS_S": "0.6",
+    "PATHWAY_AUTOSCALE_DWELL_S": "0.6",
+    "PATHWAY_AUTOSCALE_COOLDOWN_S": "1.0",
+    "PATHWAY_AUTOSCALE_IDLE_S": "0.7",
+    "PATHWAY_AUTOSCALE_HANDOFF_DEADLINE_S": "20",
+}
+
+
+@pytest.fixture(scope="module")
+def clean_output(tmp_path_factory):
+    """The unscaled ground truth, computed once: a clean supervised run at
+    N=1 with autoscaling off."""
+    clean = tmp_path_factory.mktemp("autoscale-clean")
+    res = _run_supervised(clean, None, n=1)
+    assert res.restarts == 0, res.history
+    out = _canonical(clean, workers=1)
+    assert out != b"[]"
+    return out
+
+
+@pytest.mark.chaos
+def test_load_spike_grows_then_shrinks_back_byte_identical(
+    tmp_path, monkeypatch, clean_output
+):
+    """Acceptance: a seeded load spike sustains staleness past the
+    threshold → the controller grows 1→2 via live shard handoff; the spike
+    ends, sustained idleness shrinks 2→1 the same way; canonical outputs
+    are byte-identical to the unscaled run.  Budget 2 pins the decision
+    sequence: any further wanted rescale is suppressed (loudly), so
+    oscillation cannot ride provenance either."""
+    for key, val in {**_CHAOS_KNOBS, "PATHWAY_AUTOSCALE_BUDGET": "2"}.items():
+        monkeypatch.setenv(key, val)
+    plan = json.dumps({"seed": 11, "faults": [dict(_SPIKE)]})
+    handoffs_before = _scalar("supervisor.handoffs")
+
+    res = _run_supervised(tmp_path, plan, n=1)
+
+    moves = [
+        (r.get("action"), r["from"], r["to"]) for r in res.rescales
+    ]
+    assert moves == [("grow", 1, 2), ("shrink", 2, 1)], res.rescales
+    # the grow fires mid-spike with the whole tail of the stream ahead of
+    # it: it must land as a LIVE handoff.  The shrink races end-of-stream
+    # (a worker that drains its last row exits before acking), so the
+    # actuator may legitimately degrade to the restart fallback — the
+    # designed contract — as long as provenance says which one ran.
+    assert res.rescales[0]["kind"] == "autoscale", res.rescales
+    assert res.rescales[1]["kind"] in ("autoscale", "autoscale-fallback")
+    if res.rescales[1]["kind"] == "autoscale":
+        assert res.last_failure is None
+        assert _scalar("supervisor.handoffs") == handoffs_before + 2
+    else:
+        assert "falling back" in res.last_failure
+        assert _scalar("supervisor.handoffs") == handoffs_before + 1
+    # exactly-once across both live handoffs: not one row duplicated,
+    # dropped, or reordered relative to the unscaled run
+    assert _canonical(tmp_path, workers=2) == clean_output
+    root = os.path.join(str(tmp_path), "pstore")
+    report = pz.scrub_root(pz.FileBackend(root))
+    assert report["ok"] is True, report
+    assert pz.read_handoff_request(root) is None
+    # the decision log survived the run for post-mortems
+    state = asc.read_state_file(root)
+    assert state is not None
+    actions = [d.get("action") for d in state["decisions"]]
+    assert "grow" in actions and "shrink" in actions
+
+
+@pytest.mark.chaos
+def test_sigkill_mid_handoff_falls_back_to_restart_rescale(
+    tmp_path, monkeypatch, clean_output
+):
+    """Acceptance: SIGKILL injected into the narrowest handoff window
+    (after the fenced drain-commit, before the ack).  The supervisor sees
+    the death inside the handoff, falls back to the restart-based rescale
+    at the same target, and the fenced commit stays the valid newest
+    generation — nothing spliced, scrub clean, output byte-identical."""
+    for key, val in {**_CHAOS_KNOBS, "PATHWAY_AUTOSCALE_BUDGET": "1",
+                     "PATHWAY_AUTOSCALE_IDLE_S": "30"}.items():
+        monkeypatch.setenv(key, val)
+    plan = json.dumps(
+        {
+            "seed": 7,
+            "faults": [
+                dict(_SPIKE),
+                {"kind": "handoff_crash", "worker": 0, "attempt": 0},
+            ],
+        }
+    )
+    fallbacks_before = _scalar("supervisor.handoff.fallbacks")
+
+    res = _run_supervised(tmp_path, plan, n=1, max_restarts=2)
+
+    assert [
+        (r.get("kind"), r.get("action"), r["from"], r["to"])
+        for r in res.rescales
+    ] == [("autoscale-fallback", "grow", 1, 2)], res.rescales
+    assert res.history[0] == [-signal.SIGKILL], res.history
+    assert "falling back to a restart-based rescale" in (res.last_failure or "")
+    assert _scalar("supervisor.handoff.fallbacks") == fallbacks_before + 1
+    assert _canonical(tmp_path, workers=2) == clean_output
+    root = os.path.join(str(tmp_path), "pstore")
+    report = pz.scrub_root(pz.FileBackend(root))
+    assert report["ok"] is True, report
+    lease = pz.read_lease_file(root)
+    assert lease["workers"] == 2

@@ -380,7 +380,9 @@ def test_bail_counter_increments():
         if k.startswith("columnar.bail.count") and "test-op" in k
     ]
     assert labeled and scalars[labeled[0]] >= 2
-    snap = vc.bail_snapshot()
+    # ask for the full tally: earlier tests in the process may have
+    # accumulated real bails that would push test-op out of a top-8 cut
+    snap = vc.bail_snapshot(top=len(vc.BAIL_COUNTS))
     assert any(
         b["op"] == "test-op" and b["reason"] == "test-reason" for b in snap
     )

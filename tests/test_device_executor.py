@@ -361,6 +361,22 @@ def test_batcher_coalesces_across_event_loop_recreation():
         batcher = AsyncMicroBatcher(
             process, max_batch_size=64, flush_delay=0.01, executor=ex
         )
+        # the flusher's first flush is immediate (a lone query pays no
+        # flush_delay), so whether two loops' bursts share one window is
+        # scheduler luck — on a single core the threads run strictly
+        # sequentially and never would.  Hold the window open until both
+        # loops' items sit in the ONE shared pending list, then let one
+        # flush drain them: that shared drain is the actual pin.
+        real_flush = batcher.flush
+
+        def gated_flush():
+            with batcher._lock:
+                n = len(batcher._pending)
+            if n < 20:
+                return
+            real_flush()
+
+        batcher.flush = gated_flush
         # hold the dispatch thread so both loops' items are pending together
         ex.submit(lambda: gate.wait(timeout=5.0), name="gate")
 

@@ -741,6 +741,20 @@ def test_batcher_mid_coalesce_failure_fails_every_cross_loop_waiter_once(
     batcher = AsyncMicroBatcher(
         process, max_batch_size=64, flush_delay=0.01, executor=ex
     )
+    # the flusher's first flush is immediate, so two loops sharing one
+    # window is scheduler luck (never happens on a single core).  Hold
+    # the window open until both loops' items sit in the ONE shared
+    # pending list so the failure provably fans out across loops.
+    real_flush = batcher.flush
+
+    def gated_flush():
+        with batcher._lock:
+            n = len(batcher._pending)
+        if n < 10:
+            return
+        real_flush()
+
+    batcher.flush = gated_flush
     gate = threading.Event()
     try:
         # hold the dispatch thread so both loops' items coalesce

@@ -106,6 +106,21 @@ class TestFaultPlan:
         with pytest.raises(ValueError, match="unknown kind"):
             faults.FaultPlan([{"kind": "meteor_strike"}])
 
+    def test_autoscaler_kinds_in_catalog(self):
+        """The autoscaler chaos kinds are first-class plan citizens: a
+        deterministic load wave and the mid-handoff crash window."""
+        plan = faults.FaultPlan(
+            [
+                {"kind": "load_spike", "nth": 2, "delay_ms": 100},
+                {"kind": "handoff_crash", "worker": 0},
+            ]
+        )
+        assert plan.has("load_spike") and plan.has("handoff_crash")
+        assert plan.check("handoff_crash", worker=1) is None
+        assert plan.check("load_spike", source="Src") is None  # 1st: no fire
+        spike = plan.check("load_spike", source="Src")  # 2nd: fires
+        assert spike is not None and spike.delay_ms == 100
+
 
 # ---------------------------------------------------------------------------
 # Flaky blob backend ↔ checkpoint round-trip (the satellite guarantee:
@@ -341,6 +356,36 @@ class TestConnectorFaults:
         t = make_input_table(KV, Doomed, autocommit_duration_ms=50)
         with pytest.raises(EngineError, match="consecutive errors"):
             _collect(t)
+
+    def test_load_spike_buffers_then_bursts_exactly_once(self):
+        """``load_spike`` is load, not failure: from the 2nd emit the rows
+        go silent for the declared window, then land as one burst — no
+        error, no reorder, every row delivered exactly once.  (Only the
+        staleness/backlog sensors — and the autoscaler watching them —
+        can tell it happened.)"""
+        faults.install_plan(
+            faults.FaultPlan(
+                [{"kind": "load_spike", "source": "Bursty", "nth": 2,
+                  "delay_ms": 150}]
+            )
+        )
+
+        class Bursty(Reader):
+            max_allowed_consecutive_errors = 2
+
+            def run(self, emit):
+                for i in range(5):
+                    emit({"k": i})
+                emit(COMMIT)
+
+        t = make_input_table(KV, Bursty, autocommit_duration_ms=50)
+        started = time.monotonic()
+        rows = _collect(t)
+        # the declared silence was honored even though the source drained
+        # mid-window (the buffered tail must burst, never shrink the spike)
+        assert time.monotonic() - started >= 0.15
+        assert sorted(k for k, _add in rows) == [0, 1, 2, 3, 4]
+        assert all(add for _, add in rows)
 
 
 # ---------------------------------------------------------------------------

@@ -281,15 +281,22 @@ def test_export_queue_bounds_and_counts_drops(monkeypatch):
     )
     tele = Telemetry(cfg)
     release = threading.Event()
+    started = threading.Event()
     exported = []
 
     def slow_export(kind, payload, servers):
+        started.set()
         release.wait(5)
         exported.append(kind)
 
     tele._export = slow_export
     servers = cfg.metrics_servers
-    for i in range(10):
+    # pin the "1 in flight" half of the arithmetic: on a slow box the
+    # worker thread may not have picked anything up before the burst,
+    # which would turn 5 drops into 6
+    tele._enqueue_export("metrics", {"i": 0}, servers)
+    assert started.wait(5)
+    for i in range(1, 10):
         tele._enqueue_export("metrics", {"i": i}, servers)
     # 1 in flight + 4 queued; 5 dropped (oldest first), each counted
     deadline = time.monotonic() + 2
