@@ -123,6 +123,12 @@ SUITE: tuple[Bench, ...] = (
         "device_fault_recovery", "device_fault_recovery.py",
         ("smoke",), ("full",),
     ),
+    # serving-path overload: protected (admission wall) vs unprotected
+    # (PATHWAY_SERVE_ADMISSION=0) goodput + admitted p99 at ~3x the
+    # admitted budget — protection_speedup > 1 is the PR 17 pin
+    Bench(
+        "serving_overload", "serving_overload.py", ("smoke",), ("full",),
+    ),
 )
 
 MODE_REPS = {"smoke": 3, "full": 3}

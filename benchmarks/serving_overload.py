@@ -1,0 +1,275 @@
+"""Serving-path overload: protected vs unprotected at 2x capacity.
+
+PR 17 acceptance harness.  Stands up the real REST ingress
+(``pw.io.http.rest_connector`` behind the ``engine/serving.py``
+admission controller) in a subprocess, caps pipeline capacity with a
+fixed per-row service time, then offers a concurrent burst of ~2x what
+the admitted budget can absorb — twice:
+
+* **protected** — admission on (small in-flight + queue budgets, a
+  realistic request deadline).  Overflow is answered ``429``
+  immediately; admitted requests keep their latency.
+* **unprotected** — ``PATHWAY_SERVE_ADMISSION=0`` and a huge deadline:
+  the historical behaviour.  Every request is admitted, everyone queues
+  behind everyone, and the p99 collapses together (the overload hockey
+  stick).
+
+Reported metrics (smoke-gated against ``baselines/smoke.json``):
+
+* ``serving_overload_goodput_per_s``     — 200-responses per second of
+  burst wall time, protected phase (should sit near pipeline capacity);
+* ``serving_overload_admitted_p99_ms``   — p99 of *successful* request
+  latency under protection;
+* ``serving_overload_unprotected_p99_ms``— the same p99 with the wall
+  removed;
+* ``serving_overload_protection_speedup``— unprotected / protected p99:
+  how much latency the admission wall buys the requests it admits.
+  The pin: must stay > 1.
+
+Usage: python benchmarks/serving_overload.py [smoke|full]
+Prints one JSON line per metric.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Per-row service time inside the pipeline (a deliberate capacity cap —
+# the stand-in for model inference / index search on the serving path).
+WORK_MS = 25.0
+
+# Admission budgets for the protected phase.  16 admitted slots at
+# 25 ms/row serialized => ~400 ms to drain the admitted set; the other
+# ~2x of the burst is shed with 429 on arrival.
+INFLIGHT = 8
+QUEUE = 8
+
+SERVER_SCRIPT = """
+import sys
+import time
+
+import pathway_tpu as pw
+
+port = int(sys.argv[1])
+work_ms = float(sys.argv[2])
+
+
+class WorkSchema(pw.Schema):
+    a: int
+
+
+def slow_double(a: int) -> int:
+    time.sleep(work_ms / 1000.0)
+    return a * 2
+
+
+server = pw.io.http.PathwayWebserver(host="127.0.0.1", port=port)
+queries, respond = pw.io.http.rest_connector(
+    webserver=server, route="/work", schema=WorkSchema,
+    delete_completed_queries=True,
+)
+respond(queries.select(result=pw.apply(slow_double, pw.this.a)))
+pw.run(monitoring_level=pw.MonitoringLevel.NONE, terminate_on_error=False)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _post(port: int, payload: dict, timeout: float) -> tuple[int, float]:
+    """(status, latency_ms) — typed rejections included, never raised."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/work",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            status = resp.status
+            resp.read()
+    except urllib.error.HTTPError as err:
+        status = err.code
+        err.read()
+    return status, (time.perf_counter() - t0) * 1000.0
+
+
+def _spawn_server(script_path: str, port: int, extra_env: dict) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(extra_env)
+    proc = subprocess.Popen(
+        [sys.executable, script_path, str(port), str(WORK_MS)],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
+        env=env,
+    )
+    deadline = time.monotonic() + 60
+    last: object = None
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"server died: {proc.stderr.read().decode(errors='replace')}"
+            )
+        try:
+            status, _ = _post(port, {"a": 1}, timeout=5)
+            if status == 200:
+                return proc
+            last = f"HTTP {status}"
+        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
+            last = e
+        time.sleep(0.2)
+    proc.kill()
+    raise RuntimeError(f"server never became ready: {last}")
+
+
+def _burst(port: int, n: int, timeout: float) -> tuple[list[tuple[int, float]], float]:
+    """Fire ``n`` concurrent requests at once; return per-request
+    (status, latency_ms) and the burst wall time (first send to last
+    response — 429s return early, so this ends at the last admitted
+    completion)."""
+    results: list[tuple[int, float] | None] = [None] * n
+    barrier = threading.Barrier(n + 1)
+
+    def worker(i: int) -> None:
+        barrier.wait()
+        try:
+            results[i] = _post(port, {"a": i}, timeout=timeout)
+        except Exception:  # noqa: BLE001 - a client-side timeout is data
+            results[i] = (0, timeout * 1000.0)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True) for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join(timeout=timeout + 30)
+    elapsed = time.perf_counter() - t0
+    return [r for r in results if r is not None], elapsed
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return float("nan")
+    i = min(int(len(sorted_vals) * q), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def run_phase(
+    script_path: str, *, protected: bool, n_requests: int
+) -> dict:
+    port = _free_port()
+    if protected:
+        extra_env = {
+            "PATHWAY_SERVE_ADMISSION": "1",
+            "PATHWAY_SERVE_INFLIGHT": str(INFLIGHT),
+            "PATHWAY_SERVE_QUEUE": str(QUEUE),
+            "PATHWAY_SERVE_DEADLINE_MS": "15000",
+            # the burst is transient — keep the CoDel shedder out of the
+            # measurement so only the admission wall is priced
+            "PATHWAY_SERVE_QUEUE_DELAY_MS": "60000",
+        }
+        client_timeout = 30.0
+    else:
+        extra_env = {
+            "PATHWAY_SERVE_ADMISSION": "0",
+            # the historical contract: everyone waits as long as it takes
+            "PATHWAY_SERVE_DEADLINE_MS": "120000",
+        }
+        client_timeout = 180.0
+    proc = _spawn_server(script_path, port, extra_env)
+    try:
+        # warm the route (the readiness probe already served one row)
+        _post(port, {"a": 0}, timeout=10)
+        results, elapsed = _burst(port, n_requests, client_timeout)
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+    ok_ms = sorted(lat for status, lat in results if status == 200)
+    codes: dict[int, int] = {}
+    for status, _ in results:
+        codes[status] = codes.get(status, 0) + 1
+    return {
+        "protected": protected,
+        "n_requests": n_requests,
+        "codes": codes,
+        "ok": len(ok_ms),
+        "elapsed_s": elapsed,
+        "goodput_per_s": (len(ok_ms) / elapsed) if elapsed > 0 else 0.0,
+        "p50_ms": _percentile(ok_ms, 0.50),
+        "p99_ms": _percentile(ok_ms, 0.99),
+    }
+
+
+def main() -> None:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "smoke"
+    # ~3x the admitted budget (in-flight + queue = 16): well past 2x the
+    # capacity the protected wall admits, small enough to stay tier-1
+    # friendly in smoke
+    n_requests = 48 if mode == "smoke" else 128
+
+    script_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".serving_overload_server.py"
+    )
+    with open(script_path, "w", encoding="utf-8") as f:
+        f.write(SERVER_SCRIPT)
+    try:
+        prot = run_phase(script_path, protected=True, n_requests=n_requests)
+        unprot = run_phase(script_path, protected=False, n_requests=n_requests)
+    finally:
+        try:
+            os.remove(script_path)
+        except OSError:
+            pass
+
+    speedup = (
+        unprot["p99_ms"] / prot["p99_ms"] if prot["p99_ms"] else float("nan")
+    )
+    lines = [
+        {
+            "metric": "serving_overload_goodput_per_s",
+            "value": round(prot["goodput_per_s"], 2),
+            "unit": "req/s",
+            "detail": prot,
+        },
+        {
+            "metric": "serving_overload_admitted_p99_ms",
+            "value": round(prot["p99_ms"], 2),
+            "unit": "ms",
+        },
+        {
+            "metric": "serving_overload_unprotected_p99_ms",
+            "value": round(unprot["p99_ms"], 2),
+            "unit": "ms",
+            "detail": unprot,
+        },
+        {
+            "metric": "serving_overload_protection_speedup",
+            "value": round(speedup, 3),
+            "pin": "must stay > 1: admission must buy admitted-latency",
+        },
+    ]
+    for obj in lines:
+        print(json.dumps(obj))
+
+
+if __name__ == "__main__":
+    main()

@@ -651,6 +651,37 @@ def blackbox(worker, tail, as_json, root):
                             if k not in ("action", "at")
                         )
                     )
+            serving = payload.get("serving")
+            if serving:
+                # ...and what the SERVING edge was refusing: admission
+                # occupancy + shed/drain state (engine/serving.py) at
+                # dump time, with the quarantine tail
+                limits = serving.get("limits") or {}
+                flags = [
+                    flag
+                    for flag, on in (
+                        ("degraded", serving.get("degraded")),
+                        ("draining", serving.get("draining")),
+                        ("admission off", not serving.get("enabled", True)),
+                    )
+                    if on
+                ]
+                click.echo(
+                    f"  serving: {serving.get('inflight')}"
+                    f"/{limits.get('inflight')} in flight · queue "
+                    f"{serving.get('queue_depth')}/{limits.get('queue')}"
+                    + (" · " + ", ".join(flags) if flags else "")
+                )
+                if serving.get("quarantined_total"):
+                    click.echo(
+                        "    quarantined "
+                        f"{serving['quarantined_total']} request(s), last:"
+                    )
+                    for entry in serving.get("quarantine") or []:
+                        click.echo(
+                            f"      key={entry.get('key')} "
+                            f"{entry.get('error')}"
+                        )
     sys.exit(0)
 
 

@@ -1106,6 +1106,12 @@ class DeviceExecutor:
             raise _res.ExecutorClosedError(
                 "submit() on a closed device executor"
             )
+        # serving deadline propagation (shed-before-work): a request whose
+        # budget already lapsed must not queue a device dispatch — the
+        # client has been (or is being) answered 504 (engine/serving.py)
+        from pathway_tpu.engine import serving as _serving
+
+        _serving.shed_if_expired("device")
         job = _Job(name, fn, nbytes)
         deadline = (
             None if timeout_s is None else time.monotonic() + timeout_s

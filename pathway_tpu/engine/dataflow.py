@@ -46,6 +46,15 @@ Row = tuple
 Delta = tuple  # (key:int, row:Row, diff:int)
 
 
+def _serving_note_row_error(key: int, message: str) -> None:
+    """Poisoned-cell hook: if this row key is an in-flight REST request,
+    complete its waiting HTTP future as a typed 500 and quarantine the
+    record (engine/serving.py) — a cheap no-op when nothing is serving."""
+    from pathway_tpu.engine import serving as _serving
+
+    _serving.note_row_error(key, message)
+
+
 class CleanDeltas(list):
     """Delta list known to be all-insert (+1) with pairwise-distinct keys.
 
@@ -666,6 +675,9 @@ class ExprNode(Node):
                                 "zero, bad cast, or type error)",
                             )
                         )
+                        _serving_note_row_error(
+                            ek, "expression evaluated to Error"
+                        )
         if out is None and self.vec_select is not None and len(deltas) >= _vec_threshold():
             out = self._try_columnar(deltas)
         if deltas and (
@@ -697,6 +709,9 @@ class ExprNode(Node):
                             "expression evaluated to Error (division by "
                             "zero, bad cast, or type error)",
                         )
+                    )
+                    _serving_note_row_error(
+                        key, "expression evaluated to Error"
                     )
                 out.append((key, new_row, diff))
         # a 1:1 map preserves keys and diffs, hence cleanliness
@@ -2572,6 +2587,12 @@ class Scope:
 
     def report_row_error(self, node: Node, key: int, message: str) -> None:
         self.error_log.append((node, key, message))
+        # a row error on a serving request row completes the waiting HTTP
+        # future as a typed 500 NOW (before any terminate_on_error raise
+        # can wedge the client until its deadline) — no-op otherwise
+        from pathway_tpu.engine import serving as _serving
+
+        _serving.note_row_error(key, message)
         if self.terminate_on_error:
             raise EngineError(f"{node!r} key {Pointer(key)!r}: {message}")
 

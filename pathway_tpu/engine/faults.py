@@ -156,6 +156,19 @@ device_hang  The dispatch thread: the Nth matching batch job WEDGES
              dispatch thread is torn down and respawned
              (``device.dispatch.restarts``).  ``source`` filters on the
              submitted job name.
+request_flood  The REST admission path (``engine/serving.py``): a firing
+             spec saturates the whole admission budget with synthetic
+             in-flight requests for ``delay_ms`` (default 1000) — a
+             request flood without real sockets.  Real arrivals behind
+             it queue and overflow answers 429 + Retry-After, which is
+             exactly the serving-overload contract the chaos tests pin.
+             ``source`` filters on the route path.
+slow_handler  The REST request handler (``io/http/_server.py``): the Nth
+             matching request stalls ``delay_ms`` (async — the event
+             loop keeps serving) while holding its admission slot — a
+             slow pipeline / slow client stand-in that drives queue
+             delay up so shedding, degraded mode and 429/504 paths fire
+             deterministically.  ``source`` filters on the route path.
 ========== =============================================================
 """
 
@@ -189,6 +202,7 @@ KINDS = (
         "crash", "writer_crash", "hang", "zombie", "connector_read",
         "connector_stall", "load_spike", "handoff_crash", "device_stall",
         "device_error", "device_oom", "device_compile_fail", "device_hang",
+        "request_flood", "slow_handler",
     )
 )
 

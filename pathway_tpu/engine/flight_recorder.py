@@ -77,6 +77,11 @@ class FlightRecorder:
         # controller's decision log + panel state — post-mortems say WHY
         # a rescale fired (or why one was suppressed)
         self._autoscaler_supplier: Any = None
+        # optional serving supplier (engine/serving.py): the admission
+        # controller's final state (in-flight/queue depth, degraded/
+        # draining, quarantine tail) — post-mortems say what the SERVING
+        # edge was refusing when the process died
+        self._serving_supplier: Any = None
 
     # -- recording ---------------------------------------------------------
     def record(self, kind: str, **fields: Any) -> None:
@@ -148,6 +153,15 @@ class FlightRecorder:
         was scaling, not just that it died mid-rescale."""
         self._autoscaler_supplier = fn
 
+    def set_serving_supplier(self, fn: Any) -> None:
+        """Attach (or clear) the callable whose admission-controller
+        snapshot (in-flight/queue occupancy, degraded/draining flags,
+        quarantine tail) rides every subsequent dump under the
+        ``serving`` key (same lifetime contract as
+        :meth:`set_profile_supplier`) — post-mortems say what the serving
+        edge was shedding, not just that clients saw errors."""
+        self._serving_supplier = fn
+
     # -- dumping -----------------------------------------------------------
     def dump(self, reason: str, *, suffix: str | None = None) -> str | None:
         """Write the ring to ``<root>/blackbox/worker-<id>.attempt-<n>.json``
@@ -184,6 +198,7 @@ class FlightRecorder:
             freshness_supplier = self._freshness_supplier
             device_supplier = self._device_supplier
             autoscaler_supplier = self._autoscaler_supplier
+            serving_supplier = self._serving_supplier
         if supplier is not None:
             # outside the lock (the supplier scans the node arena) and
             # never fatal: a dump without a profile beats no dump
@@ -219,6 +234,15 @@ class FlightRecorder:
                 autoscaler = None
             if autoscaler:
                 payload["autoscaler"] = autoscaler
+        if serving_supplier is not None:
+            # ...and what the SERVING edge was refusing: admission
+            # occupancy + shed/drain state (best-effort like the others)
+            try:
+                serving_state = serving_supplier()
+            except Exception:  # noqa: BLE001 - forensics must never fail
+                serving_state = None
+            if serving_state:
+                payload["serving"] = serving_state
         if payload["incarnation"] and self._fenced(
             root, payload["incarnation"], payload["worker"]
         ):

@@ -169,6 +169,12 @@ def render_status(
         payload["autoscaler"] = {
             k: v for k, v in scalars.items() if k.startswith("autoscaler.")
         }
+        # the serving panel: admission occupancy, latency quantiles, shed/
+        # deadline counters and degraded/draining flags (absent = no REST
+        # ingress in this pipeline)
+        payload["serving"] = {
+            k: v for k, v in scalars.items() if k.startswith("serve.")
+        }
     return json.dumps(payload)
 
 

@@ -203,6 +203,57 @@ METRICS: dict[str, tuple[str, str]] = {
         "gauge", "supervisor restarts performed before this worker launch"),
     "worker.last_progress.age_s": (
         "gauge", "seconds since the worker's last epoch-progress beacon"),
+    # serving-path robustness (engine/serving.py, io/http/_server.py)
+    "serve.requests": (
+        "counter", "REST requests answered, by code= (200/400/429/500/"
+        "503/504) and route= — the by-status view of the serving front "
+        "door"),
+    "serve.inflight": (
+        "gauge", "REST requests admitted into the pipeline and not yet "
+        "answered (the count axis of the admission budget)"),
+    "serve.inflight.bytes": (
+        "gauge", "summed request-body bytes of in-flight REST requests "
+        "(the bytes axis of the admission budget)"),
+    "serve.queue.depth": (
+        "gauge", "REST requests waiting in the admission pending queue"),
+    "serve.queue.wait.ms": (
+        "histogram", "time a request spent queued before admission (ms) "
+        "— the CoDel-style delay signal the shedder watches"),
+    "serve.latency.ms": (
+        "histogram", "admitted-request end-to-end latency by route= (ms); "
+        "its p50 sizes the Retry-After hint on 429/503 rejects"),
+    "serve.shed": (
+        "counter", "requests shed before doing pipeline work, by reason= "
+        "(queue-full/degraded/queue-deadline/staged-expired/batcher/"
+        "device/draining/drain-timeout)"),
+    "serve.deadline.exceeded": (
+        "counter", "requests answered 504, by where= the deadline lapse "
+        "was caught (handler/queue/staging/batcher/device)"),
+    "serve.degraded": (
+        "gauge", "1 while the load shedder is engaged (sustained queue "
+        "delay above PATHWAY_SERVE_QUEUE_DELAY_MS); degraded-handler "
+        "routes serve their cheap path while set"),
+    "serve.degraded.transitions": (
+        "counter", "degraded-mode engage/disengage edges (flapping here "
+        "means the hysteresis knobs are too tight)"),
+    "serve.degraded.served": (
+        "counter", "requests answered by a registered degraded_handler "
+        "instead of the full pipeline, by route="),
+    "serve.draining": (
+        "gauge", "1 while the webserver is draining (stop-accept 503; "
+        "shutdown or live-handoff fence)"),
+    "serve.drain.ms": (
+        "histogram", "wall time from drain start to the last in-flight "
+        "request completing (ms)"),
+    "serve.quarantined": (
+        "counter", "request rows failed by the pipeline (poisoned cells "
+        "or row errors) completed as typed 500s and quarantined"),
+    "serve.flood.synthetic": (
+        "counter", "synthetic admissions injected by the request_flood "
+        "chaos fault kind"),
+    "serve.state": (
+        "collector", "serving admission/shedder/drain state gauge "
+        "supplier (engine/serving.py controller)"),
     # columnar execution path (internals/vector_compiler.py)
     "columnar.bail.count": (
         "counter", "columnar fast-path batches that fell back to the "

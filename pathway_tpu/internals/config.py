@@ -240,6 +240,44 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
        "live-handoff deadline: a posted handoff the workers have not "
        "fully acked within this window falls back to the restart-based "
        "rescale", "autoscaler"),
+    # -- serving path (engine/serving.py, io/http/) -------------------------
+    _k("PATHWAY_SERVE_ADMISSION", "bool", True,
+       "`0` disables the serving admission controller entirely (every "
+       "request is admitted immediately, no 429/queue/shedding — the "
+       "unprotected mode `benchmarks/serving_overload.py` measures "
+       "against)", "serving"),
+    _k("PATHWAY_SERVE_DEADLINE_MS", "float", 30000.0,
+       "default per-request deadline for REST queries (overridable per "
+       "request via the `X-Pathway-Deadline-Ms` header); a request that "
+       "cannot complete in budget is answered 504 and retracted before "
+       "burning further work", "serving"),
+    _k("PATHWAY_SERVE_INFLIGHT", "int", 64,
+       "admission: max REST requests concurrently inside the pipeline "
+       "(admitted, not yet answered); arrivals beyond it wait in the "
+       "pending queue", "serving"),
+    _k("PATHWAY_SERVE_INFLIGHT_MB", "float", 32.0,
+       "admission: max summed request-body bytes in flight; the bytes "
+       "axis of the same budget as `PATHWAY_SERVE_INFLIGHT`", "serving"),
+    _k("PATHWAY_SERVE_QUEUE", "int", 128,
+       "admission: max requests waiting for an in-flight slot; overflow "
+       "is answered 429 + Retry-After immediately (shed newest, never a "
+       "stranded socket)", "serving"),
+    _k("PATHWAY_SERVE_QUEUE_DELAY_MS", "float", 250.0,
+       "load shedding: CoDel-style target queue delay — admission waits "
+       "(or output staleness) sustained above this arm the shedder",
+       "serving"),
+    _k("PATHWAY_SERVE_SHED_DWELL_S", "float", 1.0,
+       "load shedding: queue delay must stay above target this long "
+       "before degraded mode engages (any dip resets the clock — the "
+       "`ScaleController` hysteresis shape)", "serving"),
+    _k("PATHWAY_SERVE_RECOVER_S", "float", 5.0,
+       "load shedding: queue delay must stay back under target this "
+       "long before degraded mode disengages", "serving"),
+    _k("PATHWAY_SERVE_DRAIN_S", "float", 10.0,
+       "graceful drain budget: on shutdown/live-handoff the webserver "
+       "stops accepting (503) and waits up to this long for in-flight "
+       "requests to complete before the handoff fence proceeds",
+       "serving"),
     # -- device executor (pathway_tpu/device/) ------------------------------
     _k("PATHWAY_DEVICE_MAX_BATCH", "int", 512,
        "largest batch bucket of the DeviceExecutor's default bucketing "
@@ -343,6 +381,7 @@ _SUBSYSTEM_TITLES = (
     ("persistence", "Persistence (`engine/persistence.py`)"),
     ("supervisor", "Supervisor (`engine/supervisor.py`)"),
     ("autoscaler", "Autoscaler (`engine/autoscaler.py`)"),
+    ("serving", "Serving path (`engine/serving.py`, `io/http/`)"),
     ("executor", "Device executor (`pathway_tpu/device/`)"),
     ("devices", "Device mesh (`parallel/mesh.py`)"),
     ("models", "Models & native kernels"),

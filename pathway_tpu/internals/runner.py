@@ -413,6 +413,19 @@ def run(
                 freshness.crash_snapshot
             )
 
+        # serving observability (engine/serving.py): every flight-recorder
+        # dump carries the admission controller's final snapshot (in-flight/
+        # queue occupancy, degraded/draining, quarantine tail), and the
+        # load shedder sees sustained *pipeline* pressure through the
+        # freshness sensor — both inert when no REST route ever admits
+        from pathway_tpu.engine import serving as _serving
+
+        _blackbox.get_recorder().set_serving_supplier(
+            _serving.snapshot_or_none
+        )
+        if freshness.enabled:
+            _serving.set_pressure_supplier(freshness.worst_staleness)
+
         if with_http_server:
             from pathway_tpu.engine.http_server import MonitoringServer
 
@@ -498,6 +511,12 @@ def run(
 
         _blackbox_dev.get_recorder().set_device_supplier(None)
         _blackbox_dev.get_recorder().set_autoscaler_supplier(None)
+        _blackbox_dev.get_recorder().set_serving_supplier(None)
+        # ...and the serving shedder must stop referencing this run's
+        # freshness tracker (same lifetime rule as the suppliers above)
+        from pathway_tpu.engine import serving as _serving_cleanup
+
+        _serving_cleanup.set_pressure_supplier(None)
         if worker_ctx is not None:
             worker_ctx.close()
         if result.telemetry is not None:
@@ -1012,10 +1031,20 @@ def _event_loop(
         if handoff is not None:
             to_n = handoff.poll()
             if to_n is not None:
-                # planned rescale (single supervised worker: the grow
-                # from 1 starts here too): drain, fence, ack, exit 0
-                _handoff_exit(result, storage, handoff, to_n, last_time)
-                break
+                from pathway_tpu.engine import serving as _serving
+
+                # serving drain gates the rescale: the first sighting of
+                # the handoff request stop-accepts (new requests get 503)
+                # and the epoch loop KEEPS running so in-flight requests
+                # complete — the sentinel re-returns to_n every poll, so
+                # the fence fires on the first boundary where every
+                # admitted request is answered (or the drain budget
+                # lapses).  Zero in-flight HTTP requests are dropped.
+                if _serving.ready_for_handoff():
+                    # planned rescale (single supervised worker: the grow
+                    # from 1 starts here too): drain, fence, ack, exit 0
+                    _handoff_exit(result, storage, handoff, to_n, last_time)
+                    break
         if (
             storage is not None
             and (_time.monotonic() - last_snapshot) >= snapshot_interval
@@ -1217,6 +1246,15 @@ def _event_loop_coordinated(
                 )
             mins = [m for m, _f, _p, _s in gathered if m is not None]
             handoff_to = handoff.poll() if handoff is not None else None
+            if handoff_to is not None:
+                from pathway_tpu.engine import serving as _serving
+
+                if not _serving.ready_for_handoff():
+                    # serving drain in progress (worker 0 owns the REST
+                    # ingress): stop-accept has begun, but in-flight
+                    # requests still need epochs — defer the rescale
+                    # decision; the sentinel re-returns to_n next round
+                    handoff_to = None
             if handoff_to is not None:
                 # planned rescale outranks everything: the fenced
                 # frontier must be THIS epoch boundary, before any more

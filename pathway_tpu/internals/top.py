@@ -15,6 +15,9 @@ or ``PATHWAY_MONITORING_HTTP_PORT``) and renders, per refresh:
   waste, roofline utilization and HBM use, plus the fault-tolerance
   state (tripped circuit breakers, OOM bucket caps, host-fallback /
   quarantine / dispatch-restart counts);
+* serving — the REST admission panel (``engine/serving.py``): in-flight
+  occupancy, queue depth, per-code request counts, latency quantiles,
+  shed/deadline counters, and the degraded/draining flags;
 * operators — the per-operator progress table of the ``/status`` body.
 
 Pure functions (`render_top`) are separated from I/O (`fetch_status`) so
@@ -299,6 +302,77 @@ def render_top(
         if cooldown > 0:
             detail += f" · cooldown {cooldown:.1f} s remaining"
         lines.append(detail)
+
+    serving = status.get("serving") or {}
+    if serving:
+        # the admission-controller panel (engine/serving.py): occupancy
+        # and the shed story — a 429 storm or an engaged shedder must be
+        # visible at a glance, next to the pressure that caused it
+        lines.append("")
+        inflight = serving.get("serve.inflight") or 0.0
+        inflight_b = serving.get("serve.inflight.bytes") or 0.0
+        depth = serving.get("serve.queue.depth") or 0.0
+        row = (
+            f"serving: {int(inflight)} in flight "
+            f"({inflight_b / (1 << 20):.2f} MiB) · queue {int(depth)}"
+        )
+        if serving.get("serve.draining"):
+            row += " · DRAINING"
+        elif serving.get("serve.degraded"):
+            row += " · DEGRADED (shedding)"
+        lines.append(row)
+        by_code: dict[str, float] = {}
+        sheds: dict[str, float] = {}
+        lapsed: dict[str, float] = {}
+        lats: dict[str, dict[str, float]] = {}
+        for key, value in serving.items():
+            name, labels = split_labeled_name(key)
+            if name == "serve.requests":
+                code = labels.get("code", "?")
+                by_code[code] = by_code.get(code, 0.0) + value
+            elif name == "serve.shed" and value:
+                sheds[labels.get("reason", "?")] = value
+            elif name == "serve.deadline.exceeded" and value:
+                lapsed[labels.get("where", "?")] = value
+            else:
+                for q in ("p50", "p95", "p99"):
+                    if name == f"serve.latency.ms.{q}":
+                        route = labels.get("route", "")
+                        lats.setdefault(route, {})[q] = value
+        if by_code:
+            lines.append(
+                "  requests: "
+                + " · ".join(
+                    f"{code}×{int(v)}" for code, v in sorted(by_code.items())
+                )
+            )
+        for route in sorted(lats):
+            qs = " / ".join(
+                f"{q} {lats[route][q]:.1f} ms"
+                for q in ("p50", "p95", "p99")
+                if q in lats[route]
+            )
+            lines.append(f"  latency [{route or '-'}]: {qs}")
+        quarantined = serving.get("serve.quarantined")
+        if sheds or lapsed or quarantined:
+            parts = []
+            if sheds:
+                parts.append(
+                    "shed "
+                    + ", ".join(
+                        f"{r}×{int(v)}" for r, v in sorted(sheds.items())
+                    )
+                )
+            if lapsed:
+                parts.append(
+                    "deadline "
+                    + ", ".join(
+                        f"{w}×{int(v)}" for w, v in sorted(lapsed.items())
+                    )
+                )
+            if quarantined:
+                parts.append(f"quarantined {int(quarantined)}")
+            lines.append("  " + " · ".join(parts))
 
     operators = status.get("operators") or {}
     if operators:

@@ -34,6 +34,10 @@ from pathway_tpu.internals.table import Lowerer, Table, Universe
 COMMIT = object()  # sentinel: force an epoch boundary
 FINISH = object()  # sentinel: source exhausted
 DELETE = "_pw_delete"  # row dict flag for deletions / upserts
+# row dict field: monotonic deadline stamp (engine/serving.py) — a row
+# whose deadline lapsed while queued is DROPPED at staging (its waiting
+# client is answered 504 immediately) instead of burning an epoch
+DEADLINE_TS = "_pw_deadline_ts"
 
 
 class RawRows:
@@ -425,6 +429,23 @@ class _QueuePoller:
                 continue
             row = item
             diff = -1 if row.get(DELETE) else 1
+            ddl = row.get(DEADLINE_TS)
+            if (
+                ddl is not None
+                and diff > 0
+                and "_pw_key" in row
+                and _time.monotonic() >= ddl
+            ):
+                # serving shed-before-work: the request's deadline lapsed
+                # while the row sat in the connector queue — never stage
+                # it; 504 the waiting client now (engine/serving.py)
+                from pathway_tpu.engine import serving as _serving
+
+                k = row["_pw_key"]
+                _serving.shed_staged(
+                    (k & KEY_MASK) if isinstance(k, int) else hash_values([k])
+                )
+                continue
             values = [
                 dt.coerce(row.get(n), d) for n, d in zip(self.names, self.dtypes)
             ]

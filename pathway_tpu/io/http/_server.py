@@ -1,9 +1,12 @@
 """aiohttp-based REST ingress (parity: io/http/_server.py).
 
 One ``PathwayWebserver`` per (host, port); multiple ``rest_connector`` routes
-register handlers.  Each request: assign a request id → push a row into the
-input table (via ConnectorSubject) → wait on a future completed by the
-response writer subscribed to the result table → reply.
+register handlers.  Each request: admission (``engine/serving.py`` — bounded
+in-flight budget, deadline-aware queue, 429/503 rejects with Retry-After) →
+assign a request id → push a deadline-stamped row into the input table (via
+ConnectorSubject) → wait on a future completed by the response writer
+subscribed to the result table (or failed typed by the pipeline error /
+staging-shed hooks) → reply.  See docs/serving.md for the contract.
 """
 
 from __future__ import annotations
@@ -12,14 +15,21 @@ import asyncio
 import itertools
 import json as _json
 import threading
+import time as _time
 from typing import Any
 
+from pathway_tpu.engine import serving
+from pathway_tpu.engine.freshness import safe_label
+from pathway_tpu.engine.metrics import MS_BUCKETS, get_registry
 from pathway_tpu.engine.types import Json, Pointer, hash_values
 from pathway_tpu.internals import dtype as dt
 from pathway_tpu.internals import schema as schema_mod
+from pathway_tpu.internals.config import env_float
 from pathway_tpu.internals.table import Table
 from pathway_tpu.io import _utils
 from pathway_tpu.io._utils import COMMIT, Reader
+
+DEADLINE_HEADER = "X-Pathway-Deadline-Ms"
 
 
 class EndpointExamples:
@@ -69,6 +79,7 @@ class PathwayWebserver:
         self._started = False
         self._loop: asyncio.AbstractEventLoop | None = None
         self._ready = threading.Event()
+        self._startup_error: BaseException | None = None
 
     def _add_route(
         self, route: str, methods: list[str], handler, *, schema=None, documentation=None
@@ -143,12 +154,17 @@ class PathwayWebserver:
                 return await handler(request)
 
             async def main():
-                app = web.Application()
-                app.router.add_route("*", "/{tail:.*}", dispatch)
-                runner = web.AppRunner(app)
-                await runner.setup()
-                site = web.TCPSite(runner, self.host, self.port)
-                await site.start()
+                try:
+                    app = web.Application()
+                    app.router.add_route("*", "/{tail:.*}", dispatch)
+                    runner = web.AppRunner(app)
+                    await runner.setup()
+                    site = web.TCPSite(runner, self.host, self.port)
+                    await site.start()
+                except BaseException as exc:  # bind failure, bad host, …
+                    self._startup_error = exc
+                    self._ready.set()
+                    return
                 self._ready.set()
                 while True:
                     await asyncio.sleep(3600)
@@ -159,64 +175,187 @@ class PathwayWebserver:
 
         t = threading.Thread(target=serve, name="pathway:webserver", daemon=True)
         t.start()
-        self._ready.wait(timeout=10)
+        # a swallowed bind failure here used to surface as every request
+        # timing out two minutes later — propagate loudly instead
+        if not self._ready.wait(timeout=10):
+            raise RuntimeError(
+                f"webserver on {self.host}:{self.port} did not become "
+                "ready within 10 s"
+            )
+        if self._startup_error is not None:
+            raise RuntimeError(
+                f"webserver failed to start on {self.host}:{self.port}: "
+                f"{self._startup_error!r} (is the port already in use?)"
+            ) from self._startup_error
 
 
 class _RestSubject(Reader):
-    """Bridges HTTP requests into the input table."""
+    """Bridges HTTP requests into the input table.
 
-    def __init__(self, webserver: PathwayWebserver, route: str, methods: list[str], schema, delete_completed_queries: bool, documentation=None):
+    Every request passes the process-global admission controller
+    (``engine/serving.py``) before its row is emitted, carries a
+    deadline (``X-Pathway-Deadline-Ms`` header, default
+    ``PATHWAY_SERVE_DEADLINE_MS``) stamped onto the row, and is answered
+    typed on every path — 400 malformed, 429 overloaded (+Retry-After),
+    503 draining, 504 deadline, 500 pipeline error — never a stranded
+    socket."""
+
+    def __init__(self, webserver: PathwayWebserver, route: str, methods: list[str], schema, delete_completed_queries: bool, documentation=None, degraded_handler=None):
         self.webserver = webserver
         self.route = route
         self.methods = methods
         self.schema = schema
         self.delete_completed_queries = delete_completed_queries
         self.documentation = documentation
+        self.degraded_handler = degraded_handler
         self.futures: dict[int, asyncio.Future] = {}
         self._seq = itertools.count()
         self._emit = None
         self._stop = threading.Event()
 
+    def _count(self, code: int, route_label: str) -> None:
+        get_registry().counter(
+            "serve.requests", "REST requests answered, by status code",
+            code=str(code), route=route_label,
+        ).inc()
+
+    def _reject(self, web, route_label: str, rej: serving.ServeRejected):
+        self._count(rej.status, route_label)
+        headers = {}
+        if rej.retry_after_s:
+            headers["Retry-After"] = str(int(rej.retry_after_s))
+        return web.json_response(
+            {"error": rej.message}, status=rej.status, headers=headers
+        )
+
     def run(self, emit) -> None:
         self._emit = emit
         names = list(self.schema.__columns__.keys())
         dtypes = {n: self.schema.__columns__[n].dtype for n in names}
+        route_label = safe_label(self.route)
 
         async def handler(request):
             from aiohttp import web
 
             if request.method in ("POST", "PUT", "PATCH"):
-                try:
-                    payload = await request.json()
-                except Exception:
+                body = await request.read()
+                if body:
+                    try:
+                        payload = _json.loads(body)
+                    except ValueError:
+                        self._count(400, route_label)
+                        return web.json_response(
+                            {"error": "malformed JSON payload"}, status=400
+                        )
+                    if not isinstance(payload, dict):
+                        self._count(400, route_label)
+                        return web.json_response(
+                            {"error": "JSON payload must be an object"},
+                            status=400,
+                        )
+                else:
                     payload = {}
             else:
+                body = b""
                 payload = dict(request.query)
-            rid = next(self._seq)
-            key = hash_values(["rest", id(self), rid])
-            row = {"_pw_key": key}
-            for n in names:
-                v = payload.get(n)
-                if dtypes[n].strip_optional() is dt.JSON and v is not None:
-                    v = Json(v)
-                row[n] = v
-            loop = asyncio.get_event_loop()
-            future = loop.create_future()
-            self.futures[key] = future
-            emit(row)
-            emit(COMMIT)
+            header = request.headers.get(DEADLINE_HEADER)
+            if header is not None:
+                try:
+                    deadline_ms = float(header)
+                    if deadline_ms <= 0:
+                        raise ValueError(header)
+                except ValueError:
+                    self._count(400, route_label)
+                    return web.json_response(
+                        {"error": f"invalid {DEADLINE_HEADER} header"},
+                        status=400,
+                    )
+            else:
+                deadline_ms = env_float("PATHWAY_SERVE_DEADLINE_MS")
+            deadline = serving.Deadline.from_ms(deadline_ms)
+            controller = serving.get_controller()
+            serving.maybe_flood(self.route)  # chaos: request_flood
             try:
-                result = await asyncio.wait_for(future, timeout=120)
-            except asyncio.TimeoutError:
-                return web.json_response({"error": "timeout"}, status=504)
+                ticket = await controller.admit(
+                    self.route, len(body), deadline
+                )
+            except serving.ServeRejected as rej:
+                return self._reject(web, route_label, rej)
+            started = _time.monotonic()
+            code = 500
+            try:
+                # chaos: slow_handler stalls while HOLDING the admission
+                # slot — queue delay climbs, shedding paths fire
+                stall_s = serving.slow_handler_delay_s(self.route)
+                if stall_s > 0.0:
+                    await asyncio.sleep(stall_s)
+                if controller.degraded and self.degraded_handler is not None:
+                    value = self.degraded_handler(payload)
+                    if asyncio.iscoroutine(value):
+                        value = await value
+                    code = 200
+                    get_registry().counter(
+                        "serve.degraded.served",
+                        "requests answered by a degraded_handler",
+                        route=route_label,
+                    ).inc()
+                    return web.json_response(
+                        _jsonable(value), headers={"X-Pathway-Degraded": "1"}
+                    )
+                rid = next(self._seq)
+                key = hash_values(["rest", id(self), rid])
+                row = {"_pw_key": key, _utils.DEADLINE_TS: deadline.at}
+                for n in names:
+                    v = payload.get(n)
+                    if dtypes[n].strip_optional() is dt.JSON and v is not None:
+                        v = Json(v)
+                    row[n] = v
+                loop = asyncio.get_event_loop()
+                future = loop.create_future()
+                self.futures[key] = future
+                serving.register_request(
+                    key, lambda status, msg, _k=key: self.fail(_k, status, msg)
+                )
+                emit(row)
+                emit(COMMIT)
+                try:
+                    result = await asyncio.wait_for(
+                        future, timeout=max(0.0, deadline.remaining_s())
+                    )
+                except asyncio.TimeoutError:
+                    code = 504
+                    serving.note_deadline_shed("handler")
+                    return web.json_response(
+                        {"error": "deadline exceeded"}, status=504
+                    )
+                finally:
+                    serving.unregister_request(key)
+                    self.futures.pop(key, None)
+                    if self.delete_completed_queries:
+                        drow = dict(row)
+                        drow[_utils.DELETE] = True
+                        emit(drow)
+                        emit(COMMIT)
+                if isinstance(result, serving.ServeRejected):
+                    # typed completion from the pipeline side: row error,
+                    # staging shed, or result retraction
+                    code = result.status
+                    return web.json_response(
+                        {"error": result.message}, status=result.status
+                    )
+                code = 200
+                return web.json_response(result)
             finally:
-                self.futures.pop(key, None)
-                if self.delete_completed_queries:
-                    drow = dict(row)
-                    drow[_utils.DELETE] = True
-                    emit(drow)
-                    emit(COMMIT)
-            return web.json_response(result)
+                latency_ms = (_time.monotonic() - started) * 1000.0
+                self._count(code, route_label)
+                if code == 200:
+                    get_registry().histogram(
+                        "serve.latency.ms",
+                        "admitted-request end-to-end latency (ms)",
+                        buckets=MS_BUCKETS,
+                        route=route_label,
+                    ).observe(latency_ms)
+                controller.release(ticket, code=code, latency_ms=latency_ms)
 
         self.webserver._add_route(
             self.route,
@@ -235,6 +374,22 @@ class _RestSubject(Reader):
             loop.call_soon_threadsafe(
                 lambda: future.done() or future.set_result(value)
             )
+
+    def fail(self, key: int, status: int, message: str) -> None:
+        """Complete a waiting request with a typed error (pipeline row
+        error, staging shed, or result retraction) — threadsafe, no-op
+        once the future resolved or the request finished."""
+        future = self.futures.get(key)
+        if future is None:
+            return
+        if status == 504:
+            err: serving.ServeRejected = serving.DeadlineExceededError(message)
+        else:
+            err = serving.RequestFailedError(message)
+        loop = future.get_loop()
+        loop.call_soon_threadsafe(
+            lambda: future.done() or future.set_result(err)
+        )
 
 
 def _jsonable(v):
@@ -271,8 +426,16 @@ def rest_connector(
     delete_completed_queries: bool = False,
     request_validator=None,
     documentation: EndpointDocumentation | None = None,
+    degraded_handler=None,
 ) -> tuple[Table, Any]:
-    """Returns (queries_table, response_writer)."""
+    """Returns (queries_table, response_writer).
+
+    ``degraded_handler`` — optional plain callable (or coroutine
+    function) ``payload_dict -> jsonable``: while the load shedder is
+    engaged (``serve.degraded`` gauge), requests to this route are
+    answered by it directly (``X-Pathway-Degraded: 1`` response header)
+    instead of entering the pipeline — e.g. retrieval without the rerank
+    stage.  See docs/serving.md."""
     if webserver is None:
         if host is None or port is None:
             raise ValueError("provide webserver= or host=/port=")
@@ -281,7 +444,7 @@ def rest_connector(
         schema = schema_mod.schema_from_types(query=str)
     subject = _RestSubject(
         webserver, route, list(methods), schema, delete_completed_queries,
-        documentation=documentation,
+        documentation=documentation, degraded_handler=degraded_handler,
     )
     table = _utils.make_input_table(
         schema,
@@ -294,6 +457,20 @@ def rest_connector(
 
         def on_data(key, row, time, diff):
             if diff <= 0:
+                # the pipeline retracted the result row while the client
+                # is still waiting (delete_completed_queries retractions
+                # arrive AFTER completion and no-op here): typed 500
+                # instead of a silent 504 two minutes later
+                subject.fail(key, 500, "result row retracted by the pipeline")
+                return
+            from pathway_tpu.engine.types import Error as _Error
+
+            if any(isinstance(v, _Error) for v in row):
+                # a poisoned cell (division by zero, bad cast) reached the
+                # response: typed 500, never a JSON-serialization crash
+                subject.fail(
+                    key, 500, "result row contains an error value"
+                )
                 return
             if "result" in names:
                 value = _jsonable(row[names.index("result")])

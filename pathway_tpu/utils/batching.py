@@ -80,8 +80,10 @@ class AsyncMicroBatcher:
         self.name = name or getattr(process_batch, "__name__", "batch")
         self._executor = executor
         # ONE pending list across every event loop (see module docstring);
-        # entries are (item, loop, asyncio.Future)
-        self._pending: list[tuple[Any, Any, Any]] = []
+        # entries are (item, loop, asyncio.Future, Deadline | None) — the
+        # deadline is the serving request's ambient budget, checked again
+        # at dispatch so an expired waiter never burns device work
+        self._pending: list[tuple[Any, Any, Any, Any]] = []
         self._lock = threading.Lock()
         # loops that currently have a live flusher task.  Keyed by
         # id(loop) but VALIDATED against a weakref to the loop object: a
@@ -102,13 +104,25 @@ class AsyncMicroBatcher:
         return self._executor
 
     async def submit(self, item: Any) -> Any:
+        from pathway_tpu.engine import serving
+
+        # serving deadline propagation (shed-before-work): an already-
+        # expired request never coalesces into a batch at all, and a live
+        # deadline rides along to be re-checked at dispatch time
+        deadline = serving.current_deadline()
+        if deadline is not None and deadline.expired():
+            serving.note_deadline_shed("batcher")
+            raise serving.DeadlineExceededError(
+                "request deadline lapsed before batch coalescing "
+                "(shed-before-work)"
+            )
         loop = asyncio.get_running_loop()
         future = loop.create_future()
         flush_now = False
         spawn_flusher = False
         key = id(loop)
         with self._lock:
-            self._pending.append((item, loop, future))
+            self._pending.append((item, loop, future, deadline))
             if len(self._pending) >= self.max_batch_size:
                 flush_now = True
             ref = self._flushers.get(key)
@@ -134,9 +148,34 @@ class AsyncMicroBatcher:
                 del self._pending[: self.max_batch_size]
             self._dispatch(batch)
 
-    def _dispatch(self, batch: list[tuple[Any, Any, Any]]) -> None:
-        items = [item for (item, _loop, _fut) in batch]
-        waiters = [(loop, fut) for (_item, loop, fut) in batch]
+    def _dispatch(self, batch: list[tuple[Any, Any, Any, Any]]) -> None:
+        # deadline re-check at the coalesce→dispatch boundary: waiters
+        # whose serving deadline lapsed while pending are failed typed
+        # here and excluded from the batch — the device never pays for a
+        # request the client has already been told is dead
+        live = batch
+        expired = [
+            entry for entry in batch
+            if entry[3] is not None and entry[3].expired()
+        ]
+        if expired:
+            from pathway_tpu.engine import serving
+
+            live = [entry for entry in batch if entry not in expired]
+            for _item, loop, fut, _ddl in expired:
+                serving.note_deadline_shed("batcher")
+                exc = serving.DeadlineExceededError(
+                    "request deadline lapsed while coalescing "
+                    "(shed-before-work)"
+                )
+                try:
+                    loop.call_soon_threadsafe(_resolve, fut, None, exc)
+                except RuntimeError:
+                    pass
+            if not live:
+                return
+        items = [item for (item, _loop, _fut, _ddl) in live]
+        waiters = [(loop, fut) for (_item, loop, fut, _ddl) in live]
 
         def job():
             return self.process_batch(items)

@@ -34,7 +34,17 @@ class BaseRestServer:
         retry_strategy=None,
         cache_strategy=None,
         documentation=None,
+        degraded_handler: Callable[[dict], Any] | None = None,
     ) -> None:
+        """Mount ``handler`` on ``route``.
+
+        ``degraded_handler`` is the overload fallback (engine/serving.py):
+        while the admission controller's shedder is engaged, requests to
+        this route are answered by the callable (sync or async,
+        ``payload dict -> jsonable``) instead of the pipeline — e.g. a
+        keyword-only retrieval when the embedding path is saturated.
+        Responses carry ``X-Pathway-Degraded: 1``.  Routes without one
+        shed with ``429`` instead."""
         queries, writer = rest_connector(
             webserver=self.webserver,
             route=route,
@@ -43,6 +53,7 @@ class BaseRestServer:
             autocommit_duration_ms=50,
             delete_completed_queries=False,
             documentation=documentation,
+            degraded_handler=degraded_handler,
         )
         writer(handler(queries))
         self._routes.append(route)

@@ -121,6 +121,24 @@ class TestFaultPlan:
         spike = plan.check("load_spike", source="Src")  # 2nd: fires
         assert spike is not None and spike.delay_ms == 100
 
+    def test_serving_kinds_in_catalog(self):
+        """The serving chaos kinds are first-class plan citizens: the
+        admission-saturating flood and the slot-holding slow handler,
+        both keyed by route (source=)."""
+        plan = faults.FaultPlan(
+            [
+                {"kind": "request_flood", "source": "/query", "delay_ms": 500},
+                {"kind": "slow_handler", "nth": 2, "delay_ms": 200},
+            ]
+        )
+        assert plan.has("request_flood") and plan.has("slow_handler")
+        assert plan.check("request_flood", source="/other") is None
+        flood = plan.check("request_flood", source="/query")
+        assert flood is not None and flood.delay_ms == 500
+        assert plan.check("slow_handler", source="/query") is None  # 1st
+        stall = plan.check("slow_handler", source="/query")  # 2nd: fires
+        assert stall is not None and stall.delay_ms == 200
+
 
 # ---------------------------------------------------------------------------
 # Flaky blob backend ↔ checkpoint round-trip (the satellite guarantee:
