@@ -158,6 +158,16 @@ def main() -> None:
             }
         )
     )
+    # harness-protocol lines (benchmarks/harness.py): one {metric, value}
+    # per number so the bench baseline carries decoder throughput too
+    for name, value in (
+        ("decoder_prefill_tokens_per_sec", prefill_tok_s),
+        ("decoder_decode_tokens_per_sec", decode_tok_s),
+        ("decoder_decode_int8_tokens_per_sec", decode_tok_s_int8),
+        ("decoder_decode_speculative_tokens_per_sec", spec_tok_s),
+        ("decoder_decode_ms_per_token", dt / steps * 1000.0),
+    ):
+        print(json.dumps({"metric": name, "value": round(value, 3)}))
 
 
 if __name__ == "__main__":

@@ -129,6 +129,17 @@ SUITE: tuple[Bench, ...] = (
     Bench(
         "serving_overload", "serving_overload.py", ("smoke",), ("full",),
     ),
+    # decoder program throughput: bucketed prefill + fused decode_chunk
+    # (+ int8 / self-speculative variants) — the static serving baseline
+    Bench(
+        "decoder_throughput", "decoder_throughput.py", (), (),
+    ),
+    # continuous batching + paged KV vs static batch-to-completion on an
+    # identical Poisson churn trace — serving_continuous_speedup >= 1.5
+    # with lower TTFT p95 is the ISSUE 18 pin
+    Bench(
+        "serving_generation", "serving_generation.py", ("smoke",), ("full",),
+    ),
 )
 
 MODE_REPS = {"smoke": 3, "full": 3}

@@ -37,7 +37,9 @@ Plan format (JSON, also accepted as a Python list of dicts)::
         {"kind": "device_oom", "source": "rowsum", "nth": 2},
         {"kind": "device_compile_fail", "source": "rowsum", "nth": 1},
         {"kind": "device_hang", "source": "embed", "nth": 1,
-         "delay_ms": 10000}
+         "delay_ms": 10000},
+        {"kind": "request_churn", "source": "pw-tiny-decoder", "nth": 3,
+         "count": 6}
     ]}
 
 Matching rules:
@@ -169,6 +171,15 @@ slow_handler  The REST request handler (``io/http/_server.py``): the Nth
              slow pipeline / slow client stand-in that drives queue
              delay up so shedding, degraded mode and 429/504 paths fire
              deterministically.  ``source`` filters on the route path.
+request_churn  The continuous-batching generation scheduler
+             (``serving/generation.py``): a firing spec injects a burst
+             of ``count`` (default 4) short synthetic requests into the
+             admission queue mid-tick — new arrivals landing while a
+             long generation holds slots.  The chaos test pins that the
+             burst's TTFT stays bounded (chunked prefill + per-step
+             admission: no head-of-line blocking) while the long
+             generation keeps producing.  ``source`` filters on the
+             model name.
 ========== =============================================================
 """
 
@@ -202,7 +213,7 @@ KINDS = (
         "crash", "writer_crash", "hang", "zombie", "connector_read",
         "connector_stall", "load_spike", "handoff_crash", "device_stall",
         "device_error", "device_oom", "device_compile_fail", "device_hang",
-        "request_flood", "slow_handler",
+        "request_flood", "slow_handler", "request_churn",
     )
 )
 
@@ -224,7 +235,7 @@ class FaultSpec:
     __slots__ = (
         "kind", "worker", "peer", "nth", "from_nth", "prob", "delay_ms",
         "at_epoch", "key", "source", "attempt", "max_times", "frac",
-        "keep_bytes", "bit", "seen", "fired", "_rng",
+        "keep_bytes", "bit", "count", "seen", "fired", "_rng",
     )
 
     def __init__(self, spec: dict[str, Any], *, seed: int, index: int):
@@ -249,6 +260,8 @@ class FaultSpec:
         self.frac = spec.get("frac")
         self.keep_bytes = spec.get("keep_bytes")
         self.bit = spec.get("bit")
+        # request_churn burst size
+        self.count = spec.get("count")
         if (
             self.nth is None
             and self.from_nth is None

@@ -82,6 +82,11 @@ class FlightRecorder:
         # draining, quarantine tail) — post-mortems say what the SERVING
         # edge was refusing when the process died
         self._serving_supplier: Any = None
+        # optional generation supplier (serving/generation.py): the
+        # continuous-batching scheduler's slot/page-pool occupancy —
+        # post-mortems say what the GENERATION loop was holding when
+        # the process died
+        self._generation_supplier: Any = None
 
     # -- recording ---------------------------------------------------------
     def record(self, kind: str, **fields: Any) -> None:
@@ -162,6 +167,15 @@ class FlightRecorder:
         edge was shedding, not just that clients saw errors."""
         self._serving_supplier = fn
 
+    def set_generation_supplier(self, fn: Any) -> None:
+        """Attach (or clear) the callable whose generation-scheduler
+        snapshot (slot occupancy, page-pool utilization, queue depth,
+        live/peak KV bytes) rides every subsequent dump under the
+        ``generation`` key (same lifetime contract as
+        :meth:`set_profile_supplier`) — post-mortems say which requests
+        held slots and pages, not just that tokens stopped."""
+        self._generation_supplier = fn
+
     # -- dumping -----------------------------------------------------------
     def dump(self, reason: str, *, suffix: str | None = None) -> str | None:
         """Write the ring to ``<root>/blackbox/worker-<id>.attempt-<n>.json``
@@ -199,6 +213,7 @@ class FlightRecorder:
             device_supplier = self._device_supplier
             autoscaler_supplier = self._autoscaler_supplier
             serving_supplier = self._serving_supplier
+            generation_supplier = self._generation_supplier
         if supplier is not None:
             # outside the lock (the supplier scans the node arena) and
             # never fatal: a dump without a profile beats no dump
@@ -243,6 +258,15 @@ class FlightRecorder:
                 serving_state = None
             if serving_state:
                 payload["serving"] = serving_state
+        if generation_supplier is not None:
+            # ...and what the GENERATION loop held: slot + page-pool
+            # occupancy at dump time (best-effort like the others)
+            try:
+                generation_state = generation_supplier()
+            except Exception:  # noqa: BLE001 - forensics must never fail
+                generation_state = None
+            if generation_state:
+                payload["generation"] = generation_state
         if payload["incarnation"] and self._fenced(
             root, payload["incarnation"], payload["worker"]
         ):

@@ -175,6 +175,12 @@ def render_status(
         payload["serving"] = {
             k: v for k, v in scalars.items() if k.startswith("serve.")
         }
+        # the generation panel: continuous-batching slot/queue occupancy,
+        # page-pool utilization, TTFT and throughput (absent = no
+        # decoder generation ran in this process)
+        payload["generation"] = {
+            k: v for k, v in scalars.items() if k.startswith("generate.")
+        }
     return json.dumps(payload)
 
 

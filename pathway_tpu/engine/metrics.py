@@ -228,7 +228,8 @@ METRICS: dict[str, tuple[str, str]] = {
         "device/draining/drain-timeout)"),
     "serve.deadline.exceeded": (
         "counter", "requests answered 504, by where= the deadline lapse "
-        "was caught (handler/queue/staging/batcher/device)"),
+        "was caught (handler/queue/staging/batcher/device/"
+        "generate-queue/decode)"),
     "serve.degraded": (
         "gauge", "1 while the load shedder is engaged (sustained queue "
         "delay above PATHWAY_SERVE_QUEUE_DELAY_MS); degraded-handler "
@@ -254,6 +255,53 @@ METRICS: dict[str, tuple[str, str]] = {
     "serve.state": (
         "collector", "serving admission/shedder/drain state gauge "
         "supplier (engine/serving.py controller)"),
+    # continuous-batching generation (serving/generation.py)
+    "generate.requests": (
+        "counter", "generation requests accepted into the continuous-"
+        "batching queue"),
+    "generate.queue.depth": (
+        "gauge", "requests waiting for a generation slot (bounded by "
+        "PATHWAY_GENERATE_QUEUE; overflow answers 429)"),
+    "generate.slots.active": (
+        "gauge", "generation slots occupied by a prefilling or decoding "
+        "request"),
+    "generate.slots.total": (
+        "gauge", "configured generation slot count "
+        "(PATHWAY_GENERATE_SLOTS — the device batch width)"),
+    "generate.pages.used": (
+        "gauge", "KV pool pages holding live tokens (page 0, the null "
+        "page, is never counted)"),
+    "generate.pages.total": (
+        "gauge", "allocatable KV pool pages (PATHWAY_GENERATE_PAGES "
+        "minus the reserved null page)"),
+    "generate.kv.bytes.live": (
+        "gauge", "bytes of KV pool backing live tokens — the paged "
+        "cache's actual footprint, vs generate.kv.bytes.dense"),
+    "generate.kv.bytes.peak": (
+        "gauge", "high-water mark of generate.kv.bytes.live since "
+        "scheduler start"),
+    "generate.kv.bytes.dense": (
+        "gauge", "what a dense slots x max_cache KV layout would hold "
+        "resident — the baseline the paged pool is measured against"),
+    "generate.tokens": (
+        "counter", "tokens generated across all requests (EOS not "
+        "counted)"),
+    "generate.tokens_per_s": (
+        "gauge", "sustained decode throughput over the trailing 5 s "
+        "window"),
+    "generate.ttft.ms": (
+        "histogram", "request submit to first generated token (ms) — "
+        "the latency continuous batching exists to bound under churn"),
+    "generate.prefill.chunks": (
+        "counter", "chunked-prefill programs dispatched (fixed "
+        "PATHWAY_GENERATE_PREFILL_CHUNK width, interleaved with decode "
+        "ticks)"),
+    "generate.decode.steps": (
+        "counter", "continuous decode ticks dispatched (one token per "
+        "active slot per tick)"),
+    "generate.churn.synthetic": (
+        "counter", "synthetic burst requests injected by the "
+        "request_churn chaos fault kind"),
     # columnar execution path (internals/vector_compiler.py)
     "columnar.bail.count": (
         "counter", "columnar fast-path batches that fell back to the "

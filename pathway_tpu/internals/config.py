@@ -278,6 +278,32 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
        "stops accepting (503) and waits up to this long for in-flight "
        "requests to complete before the handoff fence proceeds",
        "serving"),
+    # -- generation serving (pathway_tpu/serving/) --------------------------
+    _k("PATHWAY_GENERATE_CONTINUOUS", "bool", True,
+       "route `JaxChat` decoder generation through the continuous-"
+       "batching scheduler (paged KV, per-step admission); `0` reverts "
+       "to the static per-config `AsyncMicroBatcher` path "
+       "(docs/generation_serving.md)", "generate"),
+    _k("PATHWAY_GENERATE_SLOTS", "int", 8,
+       "generation slot count — the fixed device batch width of the "
+       "continuous decode step; finished rows free their slot every "
+       "tick", "generate"),
+    _k("PATHWAY_GENERATE_PAGE_SIZE", "int", 16,
+       "tokens per KV page; KV memory is allocated and freed in pages, "
+       "so footprint tracks live tokens instead of slots x max_cache",
+       "generate"),
+    _k("PATHWAY_GENERATE_PAGES", "int", 0,
+       "KV pool size in pages (page 0 is the reserved null page); 0 "
+       "auto-sizes to half the dense worst case, floored so one "
+       "full-cache request always fits", "generate"),
+    _k("PATHWAY_GENERATE_PREFILL_CHUNK", "int", 32,
+       "prompt tokens prefilled per tick per slot — chunked prefill "
+       "interleaves with decode so a long prompt cannot stall other "
+       "requests' token cadence", "generate"),
+    _k("PATHWAY_GENERATE_QUEUE", "int", 128,
+       "max requests queued for a generation slot; overflow is "
+       "answered 429 + Retry-After (page-pool exhaustion backpressures "
+       "here, never an OOM)", "generate"),
     # -- device executor (pathway_tpu/device/) ------------------------------
     _k("PATHWAY_DEVICE_MAX_BATCH", "int", 512,
        "largest batch bucket of the DeviceExecutor's default bucketing "
@@ -382,6 +408,7 @@ _SUBSYSTEM_TITLES = (
     ("supervisor", "Supervisor (`engine/supervisor.py`)"),
     ("autoscaler", "Autoscaler (`engine/autoscaler.py`)"),
     ("serving", "Serving path (`engine/serving.py`, `io/http/`)"),
+    ("generate", "Generation serving (`pathway_tpu/serving/`)"),
     ("executor", "Device executor (`pathway_tpu/device/`)"),
     ("devices", "Device mesh (`parallel/mesh.py`)"),
     ("models", "Models & native kernels"),

@@ -374,6 +374,50 @@ def render_top(
                 parts.append(f"quarantined {int(quarantined)}")
             lines.append("  " + " · ".join(parts))
 
+    generation = status.get("generation") or {}
+    if generation.get("generate.slots.total"):
+        # the continuous-batching panel (serving/generation.py): slot and
+        # page-pool occupancy tell at a glance whether the generation
+        # loop is compute-bound (slots full, pages free) or memory-bound
+        # (pages full, queue growing)
+        lines.append("")
+        active = generation.get("generate.slots.active") or 0.0
+        total = generation.get("generate.slots.total") or 0.0
+        depth = generation.get("generate.queue.depth") or 0.0
+        pages_used = generation.get("generate.pages.used") or 0.0
+        pages_total = generation.get("generate.pages.total") or 0.0
+        rate = generation.get("generate.tokens_per_s") or 0.0
+        lines.append(
+            f"generation: {int(active)}/{int(total)} slot(s) · queue "
+            f"{int(depth)} · pages {int(pages_used)}/{int(pages_total)} "
+            f"· {rate:.1f} tok/s"
+        )
+        live = generation.get("generate.kv.bytes.live") or 0.0
+        peak = generation.get("generate.kv.bytes.peak") or 0.0
+        dense = generation.get("generate.kv.bytes.dense") or 0.0
+        if dense:
+            lines.append(
+                f"  kv: {live / (1 << 20):.2f} MiB live · peak "
+                f"{peak / (1 << 20):.2f} MiB · dense layout would hold "
+                f"{dense / (1 << 20):.2f} MiB"
+            )
+        ttft: dict[str, float] = {}
+        for key, value in generation.items():
+            name, _labels = split_labeled_name(key)
+            for q in ("p50", "p95", "p99"):
+                if name == f"generate.ttft.ms.{q}":
+                    ttft[q] = value
+        if ttft:
+            qs = " / ".join(
+                f"{q} {ttft[q]:.1f} ms"
+                for q in ("p50", "p95", "p99")
+                if q in ttft
+            )
+            lines.append(f"  ttft: {qs}")
+        churn = generation.get("generate.churn.synthetic")
+        if churn:
+            lines.append(f"  churn: {int(churn)} synthetic burst request(s)")
+
     operators = status.get("operators") or {}
     if operators:
         lines.append("")

@@ -658,6 +658,228 @@ def decode_chunk(
     return toks, valids, logits, k_cache, v_cache, pos, done, key
 
 
+# ---------------------------------------------------------------------------
+# Paged KV cache (continuous-batching serving path)
+# ---------------------------------------------------------------------------
+#
+# The dense cache above is one [L, B, max_cache, KH, D] block per K/V —
+# every row pays for the worst case.  The paged layout stores KV in
+# fixed-size PAGES of a preallocated pool ([L, P, page, KH, D]) with a
+# per-slot block table mapping logical positions onto pages, so cache
+# memory scales with LIVE tokens (the Ragged Paged Attention layout,
+# PAPERS.md).  Page 0 is the reserved null page: unallocated block-table
+# entries point at it, padding writes land in it, and no slot's attention
+# mask ever reaches into it.  The continuous-batching scheduler
+# (pathway_tpu/serving/generation.py) owns the host-side PageAllocator
+# and drives the two device programs below; all compiled shapes are
+# static (slot count fixed, block-table width bucketed), so churning
+# request mixes replay warm programs — `jax.cache.miss == 0` in steady
+# state.
+
+
+def init_kv_pool(cfg: DecoderConfig, num_pages: int, page_size: int):
+    """Preallocate the paged KV pool: ``(k_pool, v_pool)``, each
+    ``[L, num_pages, page_size, KH, D]``.  Page 0 is the null page."""
+    shape = (cfg.layers, num_pages, page_size, cfg.kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+class PageExhaustedError(RuntimeError):
+    """The pool has no free page — admission control must keep the sum of
+    reserved pages within the pool, so hitting this mid-generation is a
+    scheduler bug, not an overload condition."""
+
+
+class PageAllocator:
+    """Host-side free-list allocator over the page pool.
+
+    Tracks which pool pages are free (page 0 is reserved as the null
+    page), per-slot block tables, and live/peak KV byte accounting — the
+    numbers behind ``generate.pages.*`` / ``generate.kv.bytes.*`` and the
+    peak-below-dense acceptance pin."""
+
+    def __init__(self, num_pages: int, page_size: int, bytes_per_token: int):
+        if num_pages < 2:
+            raise ValueError("pool needs >= 2 pages (page 0 is the null page)")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.bytes_per_token = bytes_per_token  # both K and V, all layers
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))
+        self.reserved = 0  # admission-reserved pages (not yet allocated)
+        self.peak_pages = 0
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return (self.num_pages - 1) - len(self._free)
+
+    def pages_for(self, tokens: int) -> int:
+        return -(-max(tokens, 1) // self.page_size)
+
+    def can_reserve(self, pages: int) -> bool:
+        return self.reserved + pages <= len(self._free)
+
+    def reserve(self, pages: int) -> None:
+        """Set aside capacity at admission time: the worst case of a
+        request (prompt + max_new_tokens) is reserved up front so a
+        mid-generation allocation can never fail (bounded queue instead
+        of OOM — the admission contract)."""
+        if not self.can_reserve(pages):
+            raise PageExhaustedError(
+                f"cannot reserve {pages} page(s): {len(self._free)} free, "
+                f"{self.reserved} already reserved"
+            )
+        self.reserved += pages
+
+    def alloc(self, *, reserved: bool = True) -> int:
+        """Take one free page (consuming one unit of reservation when
+        ``reserved``); pages are handed out lazily as tokens actually
+        arrive, so live bytes track live tokens, not reservations."""
+        if not self._free:
+            raise PageExhaustedError("page pool exhausted")
+        page = self._free.pop()
+        if reserved:
+            self.reserved -= 1
+        self.peak_pages = max(self.peak_pages, self.used_pages)
+        return page
+
+    def release(self, pages: list[int], *, unreserve: int = 0) -> None:
+        """Return a slot's pages (and any unused reservation) to the pool."""
+        for p in pages:
+            self._free.append(p)
+        self.reserved -= unreserve
+
+    @property
+    def live_bytes(self) -> int:
+        return self.used_pages * self.page_size * self.bytes_per_token
+
+    @property
+    def peak_bytes(self) -> int:
+        return self.peak_pages * self.page_size * self.bytes_per_token
+
+
+def kv_bytes_per_token(cfg: DecoderConfig) -> int:
+    """K + V bytes one token occupies across all layers — the paged-vs-
+    dense accounting unit."""
+    itemsize = jnp.dtype(cfg.dtype).itemsize
+    return 2 * cfg.layers * cfg.kv_heads * cfg.head_dim * itemsize
+
+
+def paged_decode_step(tree, k_pool, v_pool, block_tables, seq_lens, token,
+                      cfg: DecoderConfig):
+    """One generation step over paged KV: ``token`` ``[S]`` is written at
+    each slot's next position (``seq_lens`` ``[S]``), attention gathers
+    the slot's pages.  Returns ``(logits [S, V], k_pool, v_pool)``.
+
+    Shape-identical math to ``decode_step`` (pinned by tests): the
+    gathered context is just the dense cache rearranged through the block
+    table, and masked positions contribute exactly zero either way.
+    Inactive slots (block table all null) write into and gather from the
+    null page — finite garbage, masked everywhere, freeing the scheduler
+    from shipping an active-mask into the program.
+    """
+    from pathway_tpu.ops import attention as attention_ops
+
+    S = token.shape[0]
+    page = k_pool.shape[2]
+    C = block_tables.shape[1] * page
+    KH, D = cfg.kv_heads, cfg.head_dim
+    x = tree["embed"][token][:, None, :]  # [S, 1, H]
+    positions = seq_lens[:, None]  # [S, 1]
+    idx = jnp.arange(C)[None, None, :]
+    mask = idx <= seq_lens[:, None, None]  # [S, 1, C]
+    if cfg.sliding_window is not None:
+        mask = mask & _sw_mask(seq_lens[:, None, None], idx, cfg.sliding_window)
+
+    def layer(x, lp):
+        lp, kp, vp = lp
+        h = _rms(x, lp["ln0"], cfg.norm_eps)
+        q = _mm(h, lp["wq"]).reshape(S, 1, cfg.heads, D)
+        k = _mm(h, lp["wk"]).reshape(S, 1, KH, D)
+        v = _mm(h, lp["wv"]).reshape(S, 1, KH, D)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        kp = attention_ops.scatter_kv_pages(kp, block_tables, positions, k)
+        vp = attention_ops.scatter_kv_pages(vp, block_tables, positions, v)
+        ctx = attention_ops.paged_gqa_attention(q, kp, vp, block_tables, mask)
+        x = x + _mm(ctx, lp["wo"])
+        h = _rms(x, lp["ln1"], cfg.norm_eps)
+        mlp, _ = _ffn(lp, h, cfg, full_capacity=True)
+        return x + mlp, (kp, vp)
+
+    x, (k_pool, v_pool) = lax.scan(layer, x, (tree["layers"], k_pool, v_pool))
+    x = _rms(x, tree["final_norm"], cfg.norm_eps)
+    logits = _mm(x[:, 0, :], tree["lm_head"]).astype(jnp.float32)
+    return logits, k_pool, v_pool
+
+
+def paged_prefill_chunk(tree, k_pool, v_pool, block_tables, chunk_ids,
+                        chunk_lens, start, cfg: DecoderConfig):
+    """Prefill ONE chunk of each slot's prompt against paged KV.
+
+    ``chunk_ids`` ``[S, T]`` holds the next ``chunk_lens[s]`` prompt
+    tokens of each slot (ragged; 0-padded), starting at logical position
+    ``start[s]``.  The chunk's K/V is scattered into the slot's pages,
+    then each chunk query attends causally over the slot's whole context
+    so far (earlier chunks + this one) — chunked prefill is exactly full
+    prefill split along the query axis.  Returns ``(logits [S, V]`` at
+    each slot's LAST chunk token``, k_pool, v_pool)``; rows with
+    ``chunk_lens == 0`` produce garbage logits the scheduler ignores.
+
+    ``T`` is a fixed compile-time width: long prompts run several fixed
+    chunks instead of one variable program, which is what lets the
+    scheduler interleave prefill with decode without a decode-tick stall
+    (and without recompiles).
+    """
+    from pathway_tpu.ops import attention as attention_ops
+
+    S, T = chunk_ids.shape
+    page = k_pool.shape[2]
+    C = block_tables.shape[1] * page
+    KH, D = cfg.kv_heads, cfg.head_dim
+    x = tree["embed"][chunk_ids]  # [S, T, H]
+    positions = start[:, None] + jnp.arange(T)[None, :]  # [S, T]
+    valid_q = jnp.arange(T)[None, :] < chunk_lens[:, None]  # [S, T]
+    # padding queries (t >= chunk_lens, including whole rows with
+    # chunk_lens == 0: slots that are DECODING while others prefill) must
+    # scatter to the null page, never into a slot's live pages — at
+    # start == 0 they would overwrite already-cached real tokens
+    write_positions = jnp.where(valid_q, positions, jnp.int32(2**30))
+    idx = jnp.arange(C)[None, None, :]
+    mask = (idx <= positions[:, :, None]) & valid_q[:, :, None]
+    if cfg.sliding_window is not None:
+        mask = mask & _sw_mask(positions[:, :, None], idx, cfg.sliding_window)
+
+    def layer(x, lp):
+        lp, kp, vp = lp
+        h = _rms(x, lp["ln0"], cfg.norm_eps)
+        q = _mm(h, lp["wq"]).reshape(S, T, cfg.heads, D)
+        k = _mm(h, lp["wk"]).reshape(S, T, KH, D)
+        v = _mm(h, lp["wv"]).reshape(S, T, KH, D)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        kp = attention_ops.scatter_kv_pages(kp, block_tables, write_positions, k)
+        vp = attention_ops.scatter_kv_pages(vp, block_tables, write_positions, v)
+        ctx = attention_ops.paged_gqa_attention(q, kp, vp, block_tables, mask)
+        x = x + _mm(ctx, lp["wo"])
+        h = _rms(x, lp["ln1"], cfg.norm_eps)
+        mlp, _ = _ffn(lp, h, cfg, full_capacity=True)
+        return x + mlp, (kp, vp)
+
+    x, (k_pool, v_pool) = lax.scan(layer, x, (tree["layers"], k_pool, v_pool))
+    x = _rms(x, tree["final_norm"], cfg.norm_eps)
+    last = jnp.take_along_axis(
+        x,
+        jnp.maximum(chunk_lens - 1, 0)[:, None, None].repeat(cfg.hidden, 2),
+        axis=1,
+    )[:, 0, :]
+    logits = _mm(last, tree["lm_head"]).astype(jnp.float32)
+    return logits, k_pool, v_pool
+
+
 def verify_block(tree, k_cache, v_cache, tokens, pos0, cfg: DecoderConfig):
     """Forward ``K`` already-chosen tokens against the cache in ONE pass.
 

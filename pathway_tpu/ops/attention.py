@@ -128,6 +128,88 @@ def _xla_attention(q, k, v, mask_bias, heads: int):
     return jnp.swapaxes(ctx, 1, 2).reshape(B, S, H)
 
 
+# ---------------------------------------------------------------------------
+# Ragged paged attention (decoder serving path)
+# ---------------------------------------------------------------------------
+#
+# The continuous-batching decode loop (pathway_tpu/serving/generation.py)
+# keeps each request's KV in fixed-size PAGES of a preallocated pool
+# instead of one dense [B, max_cache] block: cache memory scales with live
+# tokens, and a per-slot block table maps logical positions onto pool
+# pages (the Ragged Paged Attention layout — PAPERS.md).  The gather below
+# is the XLA expression of that kernel: every compiled shape is static
+# (slot count fixed, page count bucketed by the scheduler), so a churning
+# request mix replays one warm program per bucket — `jax.cache.miss == 0`
+# in steady state.  On TPU the same layout drops into a Pallas kernel that
+# walks the block table with async HBM→VMEM copies per page; the gather
+# keeps the math and shapes identical everywhere else.
+
+
+def gather_kv_pages(pool, block_tables):
+    """Gather a slot-major KV view out of the page pool.
+
+    ``pool`` is ``[P, page, KH, D]`` (one layer's pages), ``block_tables``
+    ``[S, G]`` int32 page indices (entry 0 = the reserved null page for
+    unallocated tail entries).  Returns ``[S, G*page, KH, D]`` — each
+    slot's logical cache, contiguous again.  Garbage gathered through
+    null-page entries sits at positions >= the slot's length and is
+    masked out by the caller.
+    """
+    S, G = block_tables.shape
+    g = pool[block_tables]  # [S, G, page, KH, D]
+    return g.reshape(S, G * pool.shape[1], pool.shape[2], pool.shape[3])
+
+
+def paged_gqa_attention(q, k_pool, v_pool, block_tables, mask):
+    """GQA attention against paged KV: q ``[S, T, NH, D]``, pools
+    ``[P, page, KH, D]``, block_tables ``[S, G]``, mask ``[S, T, G*page]``
+    boolean (True = attend).  Same math as the dense decode path
+    (``models/decoder.py::_attend``) over the gathered context, so paged
+    and dense generations agree token-for-token."""
+    k = gather_kv_pages(k_pool, block_tables)  # [S, C, KH, D]
+    v = gather_kv_pages(v_pool, block_tables)
+    S, T, NH, D = q.shape
+    KH = k.shape[2]
+    G = NH // KH
+    qg = q.reshape(S, T, KH, G, D)
+    scores = jnp.einsum(
+        "stkgd,sckd->skgtc", qg, k, preferred_element_type=jnp.float32
+    ) / (D**0.5)
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    ctx = jnp.einsum("skgtc,sckd->stkgd", probs, v)
+    return ctx.reshape(S, T, NH * D)
+
+
+def scatter_kv_pages(pool, block_tables, positions, values):
+    """Write per-slot K or V rows into the page pool.
+
+    ``pool`` ``[P, page, KH, D]``; ``positions`` ``[S, T]`` logical token
+    positions per slot (page = pos // page_size via the slot's block
+    table); ``values`` ``[S, T, KH, D]``.  Returns the updated pool.
+    Positions whose block-table entry is 0 land in the reserved null page
+    — by construction those are only padding rows (inactive slots, tail
+    of a ragged prefill chunk), so null-page collisions are harmless: the
+    null page is never unmasked by any slot's attention."""
+    P, page = pool.shape[0], pool.shape[1]
+    S, T = positions.shape
+    G = block_tables.shape[1]
+    slot_of = positions // page  # [S, T] block-table column per write
+    page_idx = jnp.take_along_axis(
+        block_tables, jnp.clip(slot_of, 0, G - 1), axis=1
+    )  # [S, T]
+    # positions past the table's width (ragged padding rows) must land in
+    # the null page, NOT clip into the slot's last live page
+    page_idx = jnp.where(slot_of >= G, 0, page_idx)
+    flat = page_idx * page + positions % page  # [S, T] rows into [P*page]
+    pool_flat = pool.reshape(P * page, pool.shape[2], pool.shape[3])
+    pool_flat = pool_flat.at[flat.reshape(-1)].set(
+        values.reshape(S * T, values.shape[2], values.shape[3]),
+        mode="drop",
+    )
+    return pool_flat.reshape(pool.shape)
+
+
 @functools.partial(
     jax.jit, static_argnames=("heads", "block_seqs", "force_xla", "interpret")
 )

@@ -1,0 +1,23 @@
+"""Generation serving: continuous batching over the paged KV cache.
+
+``pathway_tpu.serving`` is the request-level serving layer for the local
+decoder LLM — the production generation loop the ROADMAP's "millions of
+users" arc calls for.  The admission/deadline edge lives in
+``engine/serving.py``; this package owns what happens BETWEEN admission
+and the device: slot scheduling, paged KV memory, chunked prefill, and
+per-step continuous batching (docs/generation_serving.md).
+"""
+
+from pathway_tpu.serving.generation import (
+    GenerationScheduler,
+    GenRequest,
+    reset_shared_schedulers,
+    shared_scheduler,
+)
+
+__all__ = [
+    "GenerationScheduler",
+    "GenRequest",
+    "reset_shared_schedulers",
+    "shared_scheduler",
+]

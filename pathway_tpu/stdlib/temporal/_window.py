@@ -425,6 +425,37 @@ def _apply_behavior(assigned: Table, behavior: Behavior) -> Table:
     return assigned
 
 
+def _sessions_of_loop(win: SessionWindow, times_tuple) -> tuple:
+    """Reference per-pair merge loop — the semantics oracle for the
+    vectorized gap path, and the only option for custom predicates."""
+    times = sorted(times_tuple)
+    out = []
+    cur_start = None
+    prev = None
+    for t in times:
+        if cur_start is None:
+            cur_start = t
+        elif not win.merges(prev, t):
+            out.append((cur_start, prev))
+            cur_start = t
+        prev = t
+    if cur_start is not None:
+        out.append((cur_start, prev))
+    return tuple(out)
+
+
+def _session_gap_vectorizable(table: Table, time_expr, win: SessionWindow) -> bool:
+    """Gap-based session fast path: int max_gap over a non-optional int
+    time column — the merge test is exact int64 arithmetic.  Float/
+    datetime gaps keep the reference loop (Python comparison semantics),
+    like the tumbling/sliding gates above."""
+    if not isinstance(win.max_gap, int):
+        return False
+    if not -(2**63) <= win.max_gap < 2**63:
+        return False  # bignum gap: numpy comparison would not be exact
+    return _int_time_column(table, time_expr)
+
+
 def _assign_sessions(table: Table, time_expr, window: SessionWindow, instance) -> Table:
     """Sessionization: group rows per instance, merge chains via the window
     predicate, emit (start, end) per session.  Incremental at instance
@@ -437,23 +468,52 @@ def _assign_sessions(table: Table, time_expr, window: SessionWindow, instance) -
     else:
         base = base.with_columns(_pw_instance=expr_mod.ColumnConstExpression(0))
 
+    from pathway_tpu.internals import vector_compiler as vc
+
     win = window
 
-    def sessions_of(times_tuple):
-        times = sorted(times_tuple)
-        out = []
-        cur_start = None
-        prev = None
-        for t in times:
-            if cur_start is None:
-                cur_start = t
-            elif not win.merges(prev, t):
-                out.append((cur_start, prev))
-                cur_start = t
-            prev = t
-        if cur_start is not None:
-            out.append((cur_start, prev))
-        return tuple(out)
+    if (
+        vc.ENABLED
+        and win.predicate is None
+        and _session_gap_vectorizable(table, time_expr, win)
+    ):
+        # gap-based sessions over an int time column: the merge decision
+        # is pure arithmetic (gap = t[i] - t[i-1] <= max_gap), so the
+        # per-instance chain merge becomes one numpy diff + boundary
+        # split instead of a Python loop over every event — the columnar
+        # form of the reference's instance-scoped session recompute
+        gap = win.max_gap
+
+        def sessions_of(times_tuple):
+            import numpy as np
+
+            if not times_tuple:
+                return ()
+            times = np.sort(np.asarray(times_tuple, dtype=np.int64))
+            if int(times[-1]) - int(times[0]) > 2**63 - 1:
+                # int64 diff would wrap; the reference loop uses Python
+                # bignums and stays exact
+                return _sessions_of_loop(win, times_tuple)
+            breaks = np.flatnonzero(np.diff(times) > gap)
+            starts = times[np.concatenate(([0], breaks + 1))]
+            ends = times[np.concatenate((breaks, [times.size - 1]))]
+            return tuple(zip(starts.tolist(), ends.tolist()))
+    else:
+        if vc.ENABLED and win.predicate is not None:
+            # a custom merge predicate is opaque Python — it must run
+            # per adjacent pair, so this assignment cannot vectorize.
+            # Classified under its own reason so `pathway_tpu top` and
+            # profiler snapshots attribute the row-speed cost to the
+            # predicate, not to a missing fast path.
+            vc.note_bail("session", "predicate-merge")
+        elif vc.ENABLED:
+            # max_gap over a non-int time column (float/datetime):
+            # arithmetic exactness isn't guaranteed columnar, keep the
+            # reference loop and say why
+            vc.note_bail("session", "time-dtype")
+
+        def sessions_of(times_tuple):
+            return _sessions_of_loop(win, times_tuple)
 
     # session boundaries per instance
     sessions = base.groupby(ColumnReference(this, "_pw_instance")).reduce(

@@ -235,6 +235,8 @@ class JaxChat(BaseChat):
         async def chat(messages: Any, **kwargs) -> str:
             import asyncio
 
+            from pathway_tpu.serving import generation
+
             if self._model is None:
                 # first call compiles; keep the loop free while it does,
                 # and hold a lock so concurrent rows build it only once
@@ -257,6 +259,29 @@ class JaxChat(BaseChat):
             min_p = None if min_p is None else float(min_p)
             rep = kwargs.get("repetition_penalty")
             rep = None if rep is None else float(rep)
+            # continuous batching: every sampling config shares ONE
+            # scheduler batch (per-slot temp/top_p/min_p ride as data in
+            # the compiled step), so a new config never waits for a
+            # static batch to drain.  top_k / repetition_penalty need
+            # per-row history state the fixed-shape step doesn't carry —
+            # those configs fall back to the static batcher below.
+            if (
+                generation.continuous_enabled()
+                and top_k is None
+                and rep is None
+            ):
+                sched = generation.shared_scheduler(
+                    self.model, max_cache=self.max_cache,
+                    quantize=self.quantize,
+                )
+                fut = sched.submit(
+                    _messages_to_prompt(messages),
+                    max_new_tokens=mnt,
+                    temperature=temp,
+                    top_p=top_p,
+                    min_p=min_p,
+                )
+                return await asyncio.wrap_future(fut)
             bkey = (mnt, temp, top_k, top_p, min_p, rep)
             batcher = self._batchers.get(bkey)
             if batcher is None:

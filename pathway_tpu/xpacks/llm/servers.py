@@ -4,6 +4,14 @@
 ``QASummaryRestServer`` and ``serve_callable`` — all built on
 ``pw.io.http.rest_connector``: requests are streaming rows, responses are
 delivered when the result row appears.
+
+Generation-backed routes (``/v1/pw_ai_answer``, ``/v2/answer``,
+``/v1/pw_ai_summary``) reach the decoder through the ``JaxChat`` UDF,
+which routes through the process-wide continuous-batching scheduler
+(``pathway_tpu/serving/generation.py``) — every route's requests share
+ONE per-step-admitted device batch with paged KV, so a long answer on
+one route never head-of-line-blocks a short one on another
+(docs/generation_serving.md).
 """
 
 from __future__ import annotations

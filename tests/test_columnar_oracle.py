@@ -296,6 +296,109 @@ def test_windowby_exactly_once_stream_parity(seed):
     assert _run(build, True) == _run(build, False), seed
 
 
+@pytest.mark.parametrize("seed", range(3))
+def test_session_windowby_stream_parity(seed):
+    """ISSUE 18 satellite: gap-based session assignment takes the
+    vectorized merge (numpy diff/split) when the vector path is on and
+    the reference per-pair loop when it is off — outputs must be
+    byte-identical across multi-epoch streams with retractions."""
+    rng = random.Random(4000 + seed)
+
+    class S(pw.Schema):
+        rid: int = pw.column_definition(primary_key=True)
+        at: int
+        inst: int
+        v: int
+
+    rid = [0]
+
+    def row(epoch):
+        rid[0] += 1
+        # clustered bursts with dead gaps so sessions split and merge as
+        # retractions rearrange chain boundaries across epochs
+        burst = rng.randrange(0, 12) * 100
+        return (
+            rid[0],
+            epoch * 1200 + burst + rng.randrange(0, 30),
+            rng.randrange(0, 3),
+            rng.randrange(0, 100),
+        )
+
+    rows = _stream_rows(rng, row, retract_frac=0.2)
+
+    def build():
+        t = pw.debug.table_from_rows(S, rows, is_stream=True)
+        return t.windowby(
+            pw.this.at,
+            window=pw.temporal.session(max_gap=40),
+            instance=pw.this.inst,
+        ).reduce(
+            start=pw.this._pw_window_start,
+            end=pw.this._pw_window_end,
+            inst=pw.this._pw_instance,
+            n=pw.reducers.count(),
+            tot=pw.reducers.sum(pw.this.v),
+        )
+
+    assert _run(build, True) == _run(build, False), seed
+
+
+def test_session_predicate_bails_with_dedicated_reason():
+    """A custom merge predicate cannot vectorize: the assignment must be
+    classified under its own bail reason (op=session reason=predicate-
+    merge), not lost in a generic bucket — and stay exact."""
+
+    class S(pw.Schema):
+        rid: int = pw.column_definition(primary_key=True)
+        at: int
+        v: int
+
+    rows = [(i, (i // 5) * 100 + i % 5, i, 0, 1) for i in range(40)]
+
+    def build():
+        t = pw.debug.table_from_rows(S, rows, is_stream=True)
+        return t.windowby(
+            pw.this.at,
+            window=pw.temporal.session(predicate=lambda a, b: b - a <= 10),
+        ).reduce(
+            start=pw.this._pw_window_start,
+            n=pw.reducers.count(),
+        )
+
+    before = vc.BAIL_COUNTS.get(("session", "predicate-merge"), 0)
+    assert _run(build, True) == _run(build, False)
+    assert vc.BAIL_COUNTS[("session", "predicate-merge")] > before
+
+
+def test_session_gap_vectorized_merge_is_exact():
+    """The numpy gap merge vs the reference loop, directly: random time
+    sets (duplicates, bursts, singletons) must split into identical
+    (start, end) session tuples."""
+    from pathway_tpu.stdlib.temporal._window import (
+        SessionWindow,
+        _sessions_of_loop,
+    )
+    import numpy as np
+
+    rng = random.Random(11)
+    for gap in (0, 1, 7, 40):
+        win = SessionWindow(max_gap=gap)
+        for _ in range(20):
+            times = tuple(
+                rng.randrange(0, 500) for _ in range(rng.randrange(0, 60))
+            )
+            ref = _sessions_of_loop(win, times)
+            if not times:
+                assert ref == ()
+                continue
+            arr = np.sort(np.asarray(times, dtype=np.int64))
+            breaks = np.flatnonzero(np.diff(arr) > gap)
+            starts = arr[np.concatenate(([0], breaks + 1))]
+            ends = arr[np.concatenate((breaks, [arr.size - 1]))]
+            got = tuple(zip(starts.tolist(), ends.tolist()))
+            assert got == ref, (gap, times)
+
+
 def test_buffer_dirty_column_bails_and_counts():
     """A None in the time column cannot materialize: the buffer must fall
     back to the row path (identical output) and count the bail."""

@@ -170,12 +170,17 @@ def test_unknown_model_name_raises():
         decoder_config_for("mistral-7b")  # typo'd preset name
 
 
-def test_jax_chat_microbatches_concurrent_rows():
-    """Concurrent rows of one epoch run as a single generate_many batch."""
+def test_jax_chat_microbatches_concurrent_rows(monkeypatch):
+    """Concurrent rows of one epoch run as a single generate_many batch.
+
+    Pins the STATIC fallback path (the one top_k / repetition_penalty
+    configs take) — the default continuous route is pinned below.
+    """
     import asyncio
 
     from pathway_tpu.xpacks.llm import llms
 
+    monkeypatch.setenv("PATHWAY_GENERATE_CONTINUOUS", "0")
     chat = llms.JaxChat(model="pw-tiny-decoder", max_new_tokens=3, max_cache=64)
     batch_sizes = []
     lm = DecoderLM("pw-tiny-decoder", max_cache=64, eos_id=None)
@@ -197,6 +202,43 @@ def test_jax_chat_microbatches_concurrent_rows():
     assert len(answers) == 5 and all(isinstance(a, str) for a in answers)
     assert max(batch_sizes) > 1  # rows actually coalesced
     assert sum(batch_sizes) == 5
+
+
+def test_jax_chat_routes_through_continuous_scheduler(monkeypatch):
+    """Default config serves chat through the shared continuous scheduler;
+    the static per-config batcher is never touched."""
+    import asyncio
+
+    from pathway_tpu.serving import generation
+    from pathway_tpu.xpacks.llm import llms
+
+    chat = llms.JaxChat(model="pw-tiny-decoder", max_new_tokens=3, max_cache=64)
+    static_calls = []
+    lm = DecoderLM("pw-tiny-decoder", max_cache=64, eos_id=None)
+    lm.generate_many = lambda *a, **kw: static_calls.append(a) or []
+    chat._model = lm
+
+    sched_calls = []
+    real_shared = generation.shared_scheduler
+
+    def spy_shared(*a, **kw):
+        sched_calls.append(a)
+        return real_shared(*a, **kw)
+
+    monkeypatch.setattr(generation, "shared_scheduler", spy_shared)
+
+    async def run():
+        return await asyncio.gather(
+            *(chat.__wrapped__(f"question {i}") for i in range(3))
+        )
+
+    try:
+        answers = asyncio.run(run())
+    finally:
+        generation.reset_shared_schedulers()
+    assert len(answers) == 3 and all(isinstance(a, str) for a in answers)
+    assert len(sched_calls) == 3
+    assert not static_calls  # static batcher bypassed entirely
 
 
 def test_tensor_parallel_decode_matches_single_device():

@@ -139,6 +139,20 @@ class TestFaultPlan:
         stall = plan.check("slow_handler", source="/query")  # 2nd: fires
         assert stall is not None and stall.delay_ms == 200
 
+    def test_generation_kinds_in_catalog(self):
+        """The generation chaos kind is a first-class plan citizen: a
+        burst of short requests mid-generation, keyed by model name,
+        carrying a burst size."""
+        plan = faults.FaultPlan(
+            [{"kind": "request_churn", "source": "pw-tiny", "nth": 2,
+              "count": 6}]
+        )
+        assert plan.has("request_churn")
+        assert plan.check("request_churn", source="other-model") is None
+        assert plan.check("request_churn", source="pw-tiny") is None  # 1st
+        churn = plan.check("request_churn", source="pw-tiny")  # 2nd: fires
+        assert churn is not None and churn.count == 6
+
 
 # ---------------------------------------------------------------------------
 # Flaky blob backend ↔ checkpoint round-trip (the satellite guarantee:
