@@ -112,8 +112,8 @@ def _wrapper_microbench_us(ex, batches: list[np.ndarray], reps: int) -> float:
     swamps the end-to-end A/B never enters."""
     real = ex._dispatch_fixed
     ex._dispatch_fixed = (
-        lambda entry, operands, arrays, static, warmup=False: np.zeros(
-            (arrays[0].shape[0],), np.float32
+        lambda entry, operands, arrays, static, warmup=False, note=None: (
+            np.zeros((arrays[0].shape[0],), np.float32)
         )
     )
     try:

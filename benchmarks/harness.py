@@ -140,6 +140,13 @@ SUITE: tuple[Bench, ...] = (
     Bench(
         "serving_generation", "serving_generation.py", ("smoke",), ("full",),
     ),
+    # request tracing: per-request cost of the PR 19 span/exemplar rail
+    # vs the PATHWAY_TRACE_REQUESTS kill switch — the ≤2%-of-a-5ms-
+    # request pin
+    Bench(
+        "request_trace_overhead", "request_trace_overhead.py",
+        ("smoke",), ("full",),
+    ),
 )
 
 MODE_REPS = {"smoke": 3, "full": 3}

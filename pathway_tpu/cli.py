@@ -956,6 +956,91 @@ def top(url, port, process_id, interval, once, as_json):
         _time_mod.sleep(interval)
 
 
+@cli.command()
+@click.argument(
+    "dump", required=False, type=click.Path(exists=True, dir_okay=False)
+)
+@click.option(
+    "--url",
+    metavar="URL",
+    type=str,
+    default=None,
+    help="full /status URL (overrides --port/--process-id)",
+)
+@click.option(
+    "--port",
+    metavar="PORT",
+    type=int,
+    default=None,
+    help="monitoring HTTP port (default: PATHWAY_MONITORING_HTTP_PORT, "
+    "else 20000 + process id)",
+)
+@click.option(
+    "--process-id",
+    metavar="N",
+    type=int,
+    default=0,
+    help="worker whose endpoint to poll (port defaults to 20000 + N)",
+)
+@click.option(
+    "-n",
+    "--limit",
+    metavar="N",
+    type=int,
+    default=10,
+    help="waterfalls to render (default 10)",
+)
+@click.option(
+    "--recent",
+    is_flag=True,
+    help="newest-first instead of slowest-first",
+)
+@click.option(
+    "--json", "as_json", is_flag=True, help="emit the raw trace JSON"
+)
+def requests(dump, url, port, process_id, limit, recent, as_json):
+    """Slowest-request waterfalls from the live span buffer or a dump.
+
+    Reads the finished-request trace ring (``engine/tracing.py``) either
+    from a running pipeline's ``GET /status`` ``requests`` section or —
+    with a DUMP argument — from a flight-recorder dump file's
+    ``requests`` payload, and renders each trace as a span waterfall:
+    admission, coalesce, device dispatch, and generation stages with
+    their offsets and durations.  See ``docs/observability.md``,
+    "Request tracing & SLOs".
+    """
+    import json as _json
+
+    from pathway_tpu.internals.top import (
+        StatusUnavailable,
+        fetch_status,
+        render_requests,
+    )
+
+    if dump is not None:
+        try:
+            with open(dump) as f:
+                payload = _json.load(f)
+        except (OSError, ValueError) as exc:
+            click.echo(f"[pathway_tpu] cannot read dump {dump}: {exc}", err=True)
+            sys.exit(1)
+        section = payload.get("requests") or {}
+    else:
+        status_url = _monitoring_url(url, port, process_id, "status")
+        try:
+            status = fetch_status(status_url)
+        except StatusUnavailable as exc:
+            click.echo(f"[pathway_tpu] {exc}", err=True)
+            sys.exit(1)
+        section = status.get("requests") or {}
+    traces = section.get("recent" if recent else "slowest") or []
+    if as_json:
+        click.echo(_json.dumps(traces[:limit], indent=2, sort_keys=True))
+        sys.exit(0)
+    click.echo(render_requests(traces, limit=limit))
+    sys.exit(0)
+
+
 def _monitoring_url(url: str | None, port: int | None, process_id: int,
                     endpoint: str) -> str:
     """Resolve a monitoring-server URL the way ``top`` does: explicit

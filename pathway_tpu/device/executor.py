@@ -69,6 +69,7 @@ from __future__ import annotations
 
 import threading
 import time
+from contextvars import ContextVar
 from typing import Any, Callable, Sequence
 
 import numpy as np
@@ -95,6 +96,26 @@ try:
     _HAVE_JAX = True
 except Exception:  # pragma: no cover - jax is a baked-in dependency
     _HAVE_JAX = False
+
+
+# request traces of the job currently executing on the dispatch thread —
+# set by ``_run_job`` so ``run_batch``/``_run_chunk`` (inline, same
+# thread) attribute their device spans to every coalesced waiter's trace
+_JOB_TRACES: ContextVar[tuple] = ContextVar(
+    "pathway_device_job_traces", default=()
+)
+
+
+def _current_traces() -> tuple:
+    """Traces device spans should attach to: the running job's (batched
+    submit path) or the ambient request trace (inline run_batch)."""
+    traces = _JOB_TRACES.get()
+    if traces:
+        return traces
+    from pathway_tpu.engine import tracing as _tracing
+
+    trace = _tracing.current_trace()
+    return (trace,) if trace is not None else ()
 
 
 class DeviceFuture:
@@ -246,14 +267,23 @@ class _Job:
 
     __slots__ = (
         "name", "fn", "future", "nbytes", "enqueued_at", "started_at",
-        "abandoned", "finalized",
+        "abandoned", "finalized", "traces",
     )
 
-    def __init__(self, name: str, fn: Callable[[], Any], nbytes: int):
+    def __init__(
+        self,
+        name: str,
+        fn: Callable[[], Any],
+        nbytes: int,
+        traces: tuple = (),
+    ):
         self.name = name
         self.fn = fn
         self.future = DeviceFuture()
         self.nbytes = max(0, int(nbytes))
+        # request traces this job serves (engine/tracing.py) — carried
+        # explicitly across the submit→dispatch thread hop
+        self.traces = traces
         self.enqueued_at = time.monotonic()
         # set by the dispatch loop when the job starts running — the
         # hang watchdog measures the dispatch deadline from here
@@ -597,6 +627,7 @@ class DeviceExecutor:
         static: dict[str, Any] | None,
         *,
         warmup: bool = False,
+        note: dict[str, Any] | None = None,
     ) -> Any:
         key = self._cache_key(operands, arrays, static)
         aot = False
@@ -620,6 +651,8 @@ class DeviceExecutor:
             entry.dispatches += 1
             compiled = entry.compiled.get(key)
             cost = entry.costs.get(key)
+        if note is not None:
+            note["cache"] = "cold" if fresh else "warm"
         if fresh:
             (self._m_warm if warmup else self._m_cold).inc()
             compiled = (
@@ -733,6 +766,7 @@ class DeviceExecutor:
         static: dict[str, Any] | None,
         *,
         warmup: bool = False,
+        note: dict[str, Any] | None = None,
     ) -> Any:
         """One fixed-shape dispatch under the typed-failure contract:
         non-device exceptions propagate raw (a deterministic host bug
@@ -751,7 +785,7 @@ class DeviceExecutor:
         while True:
             try:
                 return self._dispatch_fixed(
-                    entry, operands, arrays, static, warmup=warmup
+                    entry, operands, arrays, static, warmup=warmup, note=note
                 )
             except Exception as exc:  # noqa: BLE001 - classified below
                 typed = _res.classify(exc)
@@ -766,6 +800,8 @@ class DeviceExecutor:
                     delays = retry.delays()
                     deadline = time.monotonic() + retry.deadline_s
                 attempt += 1
+                if note is not None:
+                    note["retries"] = attempt
                 remaining = deadline - time.monotonic()
                 if attempt > retry.retries or remaining <= 0:
                     raise typed from exc
@@ -869,6 +905,46 @@ class DeviceExecutor:
         bucket: int,
         static: dict[str, Any] | None,
     ) -> list[Any]:
+        """Dispatch one planned chunk; when request traces are in scope
+        (a traced job on the dispatch thread, or an ambient trace on an
+        inline ``run_batch``), the chunk records a ``device.dispatch``
+        span per trace — bucket, rows, cache cold/warm, retries and
+        fallback attributes filled by the layers below via ``note``."""
+        traces = _current_traces()
+        if not traces:
+            return self._run_chunk_inner(
+                entry, operands, rows, count, bucket, static, None
+            )
+        note: dict[str, Any] = {}
+        started = time.time()
+        t0 = time.monotonic()
+        try:
+            return self._run_chunk_inner(
+                entry, operands, rows, count, bucket, static, note
+            )
+        finally:
+            duration_s = time.monotonic() - t0
+            for trace in traces:
+                trace.add_span(
+                    "device.dispatch",
+                    started,
+                    duration_s,
+                    callable=entry.name,
+                    bucket=bucket,
+                    rows=count,
+                    **note,
+                )
+
+    def _run_chunk_inner(
+        self,
+        entry: _Registered,
+        operands: tuple,
+        rows: tuple,
+        count: int,
+        bucket: int,
+        static: dict[str, Any] | None,
+        note: dict[str, Any] | None,
+    ) -> list[Any]:
         """Dispatch one planned chunk under the resilience contract;
         returns the (unpadded) outputs, possibly from several smaller
         dispatches after an OOM ratchet."""
@@ -876,7 +952,7 @@ class DeviceExecutor:
         breaker = entry.breaker if self._resilience else None
         if breaker is None:
             # resilience rail off: PR-11 behavior, raw errors to callers
-            out = self._dispatch_fixed(entry, operands, padded, static)
+            out = self._dispatch_fixed(entry, operands, padded, static, note=note)
             self._ledger(count, bucket)
             return [_slice_rows(out, count)]
         route = breaker.admit()
@@ -884,7 +960,9 @@ class DeviceExecutor:
         device_exc: BaseException | None = None
         if route != "fallback":
             try:
-                out = self._dispatch_with_retry(entry, operands, padded, static)
+                out = self._dispatch_with_retry(
+                    entry, operands, padded, static, note=note
+                )
             except _res.ExecutorClosedError:
                 # close() interrupted a retry backoff: not a device
                 # failure — no breaker count, no fallback compute on a
@@ -924,6 +1002,8 @@ class DeviceExecutor:
                 self._ledger(count, bucket)
                 return [_slice_rows(out, count)]
         # degraded mode: the un-jitted host path serves this batch
+        if note is not None:
+            note["fallback"] = True
         try:
             out = self._run_host_fallback(entry, operands, padded, static)
         except Exception as exc:  # noqa: BLE001 - the poisoned-batch terminus
@@ -1085,6 +1165,7 @@ class DeviceExecutor:
         name: str = "host",
         nbytes: int = 0,
         timeout_s: float | None = None,
+        traces: tuple = (),
     ) -> DeviceFuture:
         """Queue ``fn()`` onto the dispatch thread; returns its future.
 
@@ -1112,7 +1193,11 @@ class DeviceExecutor:
         from pathway_tpu.engine import serving as _serving
 
         _serving.shed_if_expired("device")
-        job = _Job(name, fn, nbytes)
+        if not traces:
+            # direct submit (no batcher in front): the ambient request
+            # trace of the submitting context is the one to carry over
+            traces = _current_traces()
+        job = _Job(name, fn, nbytes, traces=traces)
         deadline = (
             None if timeout_s is None else time.monotonic() + timeout_s
         )
@@ -1222,11 +1307,27 @@ class DeviceExecutor:
         self._maybe_stall(job)
         self._maybe_hang(job)
         t0 = time.monotonic()
+        started = time.time()
+        token = _JOB_TRACES.set(job.traces) if job.traces else None
         try:
             result = job.fn()
         except BaseException as exc:  # noqa: BLE001 - delivered to the waiter
             job.future.set_exception(exc)
             return
+        finally:
+            if token is not None:
+                _JOB_TRACES.reset(token)
+            if job.traces:
+                duration_s = time.monotonic() - t0
+                queue_wait_s = max(0.0, t0 - job.enqueued_at)
+                for trace in job.traces:
+                    trace.add_span(
+                        "device.job",
+                        started,
+                        duration_s,
+                        job=job.name,
+                        queue_wait_s=round(queue_wait_s, 6),
+                    )
         if job.abandoned:
             # the watchdog already failed this job's waiters and
             # respawned the dispatch thread; the late result is dropped

@@ -2265,6 +2265,24 @@ class ExternalIndexNode(Node):
             self.index.add(key, row)
 
 
+async def _run_udf_traced(fn, k, r):
+    """Run one async-UDF coroutine under the row's request trace, if any.
+
+    The serving handler binds row key → RequestTrace before committing the
+    request row (``tracing.bind_key``); this is the epoch-thread hop of the
+    trace — ``asyncio.gather`` wraps each coroutine in a Task with a copied
+    context, so the scope set here is task-local and concurrent rows never
+    bleed traces into each other.
+    """
+    from pathway_tpu.engine import tracing
+
+    trace = tracing.trace_for_key(k)
+    if trace is None:
+        return await fn(k, r)
+    with tracing.trace_scope(trace):
+        return await fn(k, r)
+
+
 class AsyncValuesNode(Node):
     """Computes extra columns with async functions: all rows of an epoch are
     awaited concurrently under one event loop, with an epoch barrier —
@@ -2295,7 +2313,9 @@ class AsyncValuesNode(Node):
 
             async def run_all():
                 coros = [
-                    fn(k, r) for (k, r) in to_run for fn in self.coro_fns
+                    _run_udf_traced(fn, k, r)
+                    for (k, r) in to_run
+                    for fn in self.coro_fns
                 ]
                 return await asyncio.gather(*coros, return_exceptions=True)
 

@@ -180,6 +180,13 @@ request_churn  The continuous-batching generation scheduler
              admission: no head-of-line blocking) while the long
              generation keeps producing.  ``source`` filters on the
              model name.
+trace_storm  The request-tracing layer (``engine/tracing.py``): a firing
+             spec bursts ``count`` (default 64) synthetic traced
+             requests, each carrying a deep chained span tree, through
+             the bounded telemetry export queue — proving the queue
+             drops oldest (``telemetry.export.dropped``) without ever
+             blocking the serving path.  ``source`` filters on the
+             route path.
 ========== =============================================================
 """
 
@@ -213,7 +220,7 @@ KINDS = (
         "crash", "writer_crash", "hang", "zombie", "connector_read",
         "connector_stall", "load_spike", "handoff_crash", "device_stall",
         "device_error", "device_oom", "device_compile_fail", "device_hang",
-        "request_flood", "slow_handler", "request_churn",
+        "request_flood", "slow_handler", "request_churn", "trace_storm",
     )
 )
 

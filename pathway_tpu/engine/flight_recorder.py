@@ -87,6 +87,15 @@ class FlightRecorder:
         # post-mortems say what the GENERATION loop was holding when
         # the process died
         self._generation_supplier: Any = None
+        # optional tracing supplier (engine/tracing.py): the finished-
+        # request ring (trace ids, durations, span trees) — post-mortems
+        # carry the last requests' waterfalls, and `pathway_tpu requests`
+        # can re-render them from the dump alone
+        self._tracing_supplier: Any = None
+        # optional SLO supplier (engine/slo.py): declared objectives with
+        # their burn rates and remaining budgets — post-mortems say which
+        # promises were being broken, not just which gauges moved
+        self._slo_supplier: Any = None
 
     # -- recording ---------------------------------------------------------
     def record(self, kind: str, **fields: Any) -> None:
@@ -176,6 +185,21 @@ class FlightRecorder:
         held slots and pages, not just that tokens stopped."""
         self._generation_supplier = fn
 
+    def set_tracing_supplier(self, fn: Any) -> None:
+        """Attach (or clear) the callable whose finished-request-ring
+        snapshot (trace ids, durations, span trees) rides every
+        subsequent dump under the ``requests`` key (same lifetime
+        contract as :meth:`set_profile_supplier`) — ``pathway_tpu
+        requests <dump.json>`` re-renders the waterfalls offline."""
+        self._tracing_supplier = fn
+
+    def set_slo_supplier(self, fn: Any) -> None:
+        """Attach (or clear) the callable whose SLO snapshot (objectives,
+        burn rates, remaining budgets) rides every subsequent dump under
+        the ``slo`` key (same lifetime contract as
+        :meth:`set_profile_supplier`)."""
+        self._slo_supplier = fn
+
     # -- dumping -----------------------------------------------------------
     def dump(self, reason: str, *, suffix: str | None = None) -> str | None:
         """Write the ring to ``<root>/blackbox/worker-<id>.attempt-<n>.json``
@@ -214,6 +238,8 @@ class FlightRecorder:
             autoscaler_supplier = self._autoscaler_supplier
             serving_supplier = self._serving_supplier
             generation_supplier = self._generation_supplier
+            tracing_supplier = self._tracing_supplier
+            slo_supplier = self._slo_supplier
         if supplier is not None:
             # outside the lock (the supplier scans the node arena) and
             # never fatal: a dump without a profile beats no dump
@@ -267,6 +293,24 @@ class FlightRecorder:
                 generation_state = None
             if generation_state:
                 payload["generation"] = generation_state
+        if tracing_supplier is not None:
+            # ...and the last REQUESTS' stories: the finished-trace ring
+            # with span trees (best-effort like the others)
+            try:
+                tracing_state = tracing_supplier()
+            except Exception:  # noqa: BLE001 - forensics must never fail
+                tracing_state = None
+            if tracing_state:
+                payload["requests"] = tracing_state
+        if slo_supplier is not None:
+            # ...and which PROMISES were being broken: declared SLOs with
+            # burn rates + budgets (best-effort like the others)
+            try:
+                slo_state = slo_supplier()
+            except Exception:  # noqa: BLE001 - forensics must never fail
+                slo_state = None
+            if slo_state:
+                payload["slo"] = slo_state
         if payload["incarnation"] and self._fenced(
             root, payload["incarnation"], payload["worker"]
         ):

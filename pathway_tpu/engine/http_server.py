@@ -181,7 +181,30 @@ def render_status(
         payload["generation"] = {
             k: v for k, v in scalars.items() if k.startswith("generate.")
         }
-    return json.dumps(payload)
+        # the requests panel (`pathway_tpu requests`): trace.* scalars,
+        # the slowest finished traces WITH span trees (waterfall source),
+        # and the per-bucket histogram exemplars linking a slow bucket to
+        # a real trace id
+        from pathway_tpu.engine import tracing as _tracing
+
+        payload["requests"] = {
+            "scalars": {
+                k: v for k, v in scalars.items() if k.startswith("trace.")
+            },
+            "slowest": _tracing.slowest_requests(10),
+            "recent": _tracing.recent_requests(10),
+            "exemplars": registry.exemplar_points(),
+        }
+        # the SLO panel: declared objectives with burn rates + budgets
+        # (the `slo.*` scalars ride the collector; the structured view
+        # feeds `pathway_tpu top` and flight-recorder dumps)
+        from pathway_tpu.engine import slo as _slo
+
+        payload["slo"] = _slo.get_evaluator().snapshot()
+    # default=repr: a span attribute carrying a non-JSON value (a numpy
+    # scalar from the device path) must degrade to its repr, never take
+    # the whole status endpoint down with a TypeError
+    return json.dumps(payload, default=repr)
 
 
 def _handle_trace(path: str) -> tuple[str, int]:

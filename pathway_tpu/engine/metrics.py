@@ -462,6 +462,42 @@ METRICS: dict[str, tuple[str, str]] = {
     "device.dispatch.restarts": (
         "counter", "dispatch threads torn down and respawned after a "
         "hard dispatch-deadline hang (PATHWAY_DEVICE_DISPATCH_DEADLINE_S)"),
+    # request-scoped tracing (engine/tracing.py)
+    "trace.requests": (
+        "counter", "request traces created by the serving path (W3C "
+        "traceparent adopted on ingress, minted otherwise)"),
+    "trace.spans": (
+        "counter", "request-scoped spans recorded (admission, coalesce, "
+        "device dispatch, generation stages)"),
+    "trace.spans.dropped": (
+        "counter", "request spans dropped by the per-trace span cap"),
+    "trace.storm.synthetic": (
+        "counter", "synthetic traces injected by the trace_storm chaos "
+        "fault kind"),
+    "trace.requests.state": (
+        "collector", "finished-request ring gauge supplier "
+        "(engine/tracing.py)"),
+    "trace.requests.buffered": (
+        "gauge", "finished request traces held in the bounded ring the "
+        "`pathway_tpu requests` CLI reads"),
+    "trace.requests.slowest.ms": (
+        "gauge", "duration of the slowest buffered request trace (ms)"),
+    "trace.requests.newest.ms": (
+        "gauge", "duration of the newest buffered request trace (ms)"),
+    # SLO engine (engine/slo.py)
+    "slo.state": (
+        "collector", "declared-SLO evaluation supplier (engine/slo.py)"),
+    "slo.budget.remaining": (
+        "gauge", "error-budget fraction remaining over the SLO's window, "
+        "by slo= (1 = untouched, 0 = exhausted, negative = overspent)"),
+    "slo.burn.rate": (
+        "gauge", "error-budget burn rate by slo= and window= (1.0 = "
+        "burning exactly the budget; sustained >1 exhausts it before the "
+        "window ends)"),
+    "slo.violations": (
+        "counter", "burn-rate threshold crossings (burn > 1 rising edges) "
+        "by slo= — each one also lands a flight-recorder slo.violation "
+        "event"),
     # telemetry (engine/telemetry.py)
     "telemetry.export.dropped": (
         "counter", "telemetry payloads dropped by the bounded export queue"),
@@ -537,7 +573,10 @@ class Histogram:
     stored per-interval, so ``observe`` touches exactly one slot.
     """
 
-    __slots__ = ("_enabled", "_bounds", "_counts", "_sum", "_count", "_lock")
+    __slots__ = (
+        "_enabled", "_bounds", "_counts", "_sum", "_count", "_lock",
+        "_exemplars",
+    )
 
     def __init__(self, enabled: _Enabled, bounds: tuple[float, ...]):
         self._enabled = enabled
@@ -546,8 +585,13 @@ class Histogram:
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
+        # bucket index -> (trace_id, value, unix ts): the LAST traced
+        # observation per bucket, rendered as an OpenMetrics exemplar
+        # (`# {trace_id=...}`) so a slow bucket links to a real request
+        # trace.  Lazily allocated — untraced histograms pay nothing.
+        self._exemplars: dict[int, tuple[str, float, float]] | None = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: str | None = None) -> None:
         if not self._enabled.on:
             return
         i = bisect_left(self._bounds, value)
@@ -555,11 +599,21 @@ class Histogram:
             self._counts[i] += 1
             self._sum += value
             self._count += 1
+            if trace_id:
+                if self._exemplars is None:
+                    self._exemplars = {}
+                self._exemplars[i] = (trace_id, value, _time.time())
 
     def snapshot(self) -> tuple[tuple[float, ...], list[int], float, int]:
         """(bounds, per-interval counts, sum, count) — a consistent read."""
         with self._lock:
             return self._bounds, list(self._counts), self._sum, self._count
+
+    def exemplars(self) -> dict[int, tuple[str, float, float]]:
+        """``{bucket index: (trace_id, value, ts)}`` — the +Inf bucket is
+        index ``len(bounds)``."""
+        with self._lock:
+            return dict(self._exemplars) if self._exemplars else {}
 
     def quantile(self, q: float) -> float | None:
         """Estimate the ``q``-quantile from the fixed buckets (linear
@@ -682,6 +736,12 @@ class MetricsRegistry:
                 f"not a {kind}"
             )
         return fam
+
+    def family(self, name: str) -> _Family | None:
+        """Read-only family lookup — ``None`` when nothing has touched
+        the name yet (the SLO evaluator reads families without creating
+        them, so a never-observed metric stays absent from exposition)."""
+        return self._families.get(name)
 
     def counter(self, name: str, help_: str = "", **labels: Any) -> Counter:
         return self._family(name, help_, "counter").labels(**labels)
@@ -826,16 +886,23 @@ class MetricsRegistry:
                 label_str = _prom_labels(key + extra)
                 if fam.kind == "histogram":
                     bounds, counts, total, n = child.snapshot()
+                    exemplars = child.exemplars()
                     cum = 0
-                    for bound, c in zip(bounds, counts):
+                    for i, (bound, c) in enumerate(zip(bounds, counts)):
                         cum += c
                         le = _prom_labels(
                             key + extra + (("le", _format_bound(bound)),)
                         )
-                        lines.append(f"{prom}_bucket{le} {cum}")
+                        lines.append(
+                            f"{prom}_bucket{le} {cum}"
+                            + _format_exemplar(exemplars.get(i))
+                        )
                     cum += counts[-1]
                     le = _prom_labels(key + extra + (("le", "+Inf"),))
-                    lines.append(f"{prom}_bucket{le} {cum}")
+                    lines.append(
+                        f"{prom}_bucket{le} {cum}"
+                        + _format_exemplar(exemplars.get(len(bounds)))
+                    )
                     lines.append(f"{prom}_sum{label_str} {_format_value(total)}")
                     lines.append(f"{prom}_count{label_str} {n}")
                 else:
@@ -885,6 +952,38 @@ class MetricsRegistry:
                         f"{_format_value(value)}"
                     )
         return "\n".join(lines) + ("\n" if lines else "")
+
+    def exemplar_points(self) -> dict[str, list[dict[str, Any]]]:
+        """Every histogram child's bucket exemplars, keyed by the
+        ``name{labels}`` scalar form — the ``/status`` exemplar view
+        (``engine/http_server.py``'s ``requests`` section)."""
+        out: dict[str, list[dict[str, Any]]] = {}
+        with self._lock:
+            families = [f for f in self._families.values() if f.kind == "histogram"]
+        for fam in families:
+            for key, child in fam.items():
+                exemplars = child.exemplars()
+                if not exemplars:
+                    continue
+                bounds = child.snapshot()[0]
+                name = fam.name
+                if key:
+                    label_str = ",".join(f"{k}={v}" for k, v in key)
+                    name = f"{name}{{{label_str}}}"
+                out[name] = [
+                    {
+                        "le": (
+                            _format_bound(bounds[i])
+                            if i < len(bounds)
+                            else "+Inf"
+                        ),
+                        "trace_id": trace_id,
+                        "value": value,
+                        "ts": ts,
+                    }
+                    for i, (trace_id, value, ts) in sorted(exemplars.items())
+                ]
+        return out
 
     # -- OTLP mapping ------------------------------------------------------
     def otlp_metrics(self, ts: float | None = None) -> list[dict]:
@@ -960,6 +1059,19 @@ def _format_bound(bound: float) -> str:
     if bound == int(bound):
         return str(int(bound)) + ".0"
     return repr(bound)
+
+
+def _format_exemplar(ex: tuple[str, float, float] | None) -> str:
+    """OpenMetrics exemplar suffix for one bucket line (empty when the
+    bucket never saw a traced observation):
+    ``# {trace_id="..."} <value> <ts>``."""
+    if ex is None:
+        return ""
+    trace_id, value, ts = ex
+    return (
+        f' # {{trace_id="{escape_label(str(trace_id))}"}} '
+        f"{_format_value(value)} {ts:.3f}"
+    )
 
 
 def _format_value(value: float) -> str:

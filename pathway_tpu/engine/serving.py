@@ -254,7 +254,7 @@ def shed_staged(key: int) -> None:
 class _Ticket:
     """One admitted request's claim on the in-flight budget."""
 
-    __slots__ = ("route", "nbytes", "synthetic", "admitted_at")
+    __slots__ = ("route", "nbytes", "synthetic", "admitted_at", "trace")
 
     def __init__(
         self,
@@ -267,6 +267,8 @@ class _Ticket:
         self.nbytes = int(nbytes)
         self.synthetic = synthetic
         self.admitted_at = admitted_at
+        # RequestTrace attached by ``admit`` (None when tracing is off)
+        self.trace = None
 
 
 class _Waiter:
@@ -377,11 +379,44 @@ class AdmissionController:
         self._outstanding[id(ticket)] = now
         return ticket
 
-    async def admit(self, route: str, nbytes: int, deadline: Deadline):
+    async def admit(
+        self,
+        route: str,
+        nbytes: int,
+        deadline: Deadline,
+        trace_parent: str | None = None,
+    ):
         """Admit or reject one request.  Returns a ticket to pass to
         :meth:`release`; raises a :class:`ServeRejected` subclass with the
         HTTP status + Retry-After already decided.  Never strands the
-        caller: every path answers within the request's own deadline."""
+        caller: every path answers within the request's own deadline.
+
+        The admission controller is also where the request's
+        :class:`~pathway_tpu.engine.tracing.RequestTrace` is born (the
+        ingress ``traceparent`` continues a caller's trace; otherwise one
+        is minted): the ticket carries it, and the admission wait —
+        fast-path or queued — becomes its first child span."""
+        from pathway_tpu.engine import tracing
+
+        trace = tracing.begin_request(route, trace_parent)
+        started = time.time()
+        try:
+            ticket = await self._admit(route, nbytes, deadline)
+        except ServeRejected as exc:
+            if trace is not None:
+                trace.finish(status=exc.status, reason=exc.reason)
+            raise
+        ticket.trace = trace
+        if trace is not None:
+            trace.add_span(
+                "serve.admission",
+                started,
+                max(0.0, time.time() - started),
+                inflight=self._inflight,
+            )
+        return ticket
+
+    async def _admit(self, route: str, nbytes: int, deadline: Deadline):
         import asyncio
 
         now = self._clock()

@@ -256,15 +256,27 @@ def _otlp_metrics(payload: dict) -> dict:
     }
 
 
+def _parent_span_id(trace_parent: str | None) -> str:
+    """span-id field of a W3C ``traceparent`` header value."""
+    parts = (trace_parent or "").split("-")
+    return parts[2] if len(parts) >= 4 and len(parts[2]) == 16 else ""
+
+
 def _otlp_traces(payload: dict) -> dict:
     span = payload["span"]
+    # ids are minted at span CREATION and carried on the record
+    # (emit_span); minting here at export time would break parent/child
+    # links and exemplar trace-id correlation.  The fallbacks below only
+    # serve legacy records constructed outside Telemetry.span().
     trace_id = (
-        _root_trace_id(span.get("trace_parent"))
+        span.get("trace_id")
+        or _root_trace_id(span.get("trace_parent"))
         or payload.get("fallback_trace_id")
         or secrets.token_hex(16)
     )
-    parent = (span.get("trace_parent") or "").split("-")
-    parent_span_id = parent[2] if len(parent) >= 4 and len(parent[2]) == 16 else ""
+    parent_span_id = span.get("parent_span_id")
+    if parent_span_id is None:
+        parent_span_id = _parent_span_id(span.get("trace_parent"))
     start_ns = int(span["start"] * 1e9)
     end_ns = start_ns + int(span["duration_s"] * 1e9)
     return {
@@ -277,7 +289,8 @@ def _otlp_traces(payload: dict) -> dict:
                         "spans": [
                             {
                                 "traceId": trace_id,
-                                "spanId": secrets.token_hex(8),
+                                "spanId": span.get("span_id")
+                                or secrets.token_hex(8),
                                 "parentSpanId": parent_span_id,
                                 "name": span["name"],
                                 "kind": 1,  # SPAN_KIND_INTERNAL
@@ -474,31 +487,51 @@ class Telemetry:
     # -- spans -------------------------------------------------------------
     @contextmanager
     def span(self, name: str, **attributes: Any):
+        # ids are minted HERE, at creation, and carried on the record:
+        # an export-time mint could never parent-link two spans of one
+        # request or correlate a histogram exemplar back to its trace
         start = time.time()
+        span_id = secrets.token_hex(8)
         try:
             yield
         finally:
-            record = {
-                "name": name,
-                "start": start,
-                "duration_s": time.time() - start,
-                "attributes": attributes,
-                "trace_parent": self.config.trace_parent,
-            }
-            with self._span_lock:
-                self.spans.append(record)
-            if self.config.telemetry_enabled:
-                # spans ride the bounded queue too: a dead collector must
-                # not add 3 s per endpoint to the span CALLER's thread
-                self._enqueue_export(
-                    "traces",
-                    {
-                        "resource": self.config.resource(),
-                        "span": record,
-                        "fallback_trace_id": self._fallback_trace_id,
-                    },
-                    self.config.tracing_servers,
-                )
+            self.emit_span(
+                {
+                    "name": name,
+                    "start": start,
+                    "duration_s": time.time() - start,
+                    "attributes": attributes,
+                    "trace_parent": self.config.trace_parent,
+                    "trace_id": _root_trace_id(self.config.trace_parent)
+                    or self._fallback_trace_id,
+                    "span_id": span_id,
+                    "parent_span_id": _parent_span_id(
+                        self.config.trace_parent
+                    ),
+                }
+            )
+
+    def emit_span(self, record: dict) -> None:
+        """Record one finished span (buffer + bounded export queue).
+
+        The record carries its own ``trace_id``/``span_id``/
+        ``parent_span_id`` (minted at creation); request-scoped tracing
+        (``engine/tracing.py``) feeds pre-built child-span records
+        through here so they ride the same bounded queue as run spans."""
+        with self._span_lock:
+            self.spans.append(record)
+        if self.config.telemetry_enabled:
+            # spans ride the bounded queue too: a dead collector must
+            # not add 3 s per endpoint to the span CALLER's thread
+            self._enqueue_export(
+                "traces",
+                {
+                    "resource": self.config.resource(),
+                    "span": record,
+                    "fallback_trace_id": self._fallback_trace_id,
+                },
+                self.config.tracing_servers,
+            )
 
     def epoch_span(self, time_: int, index: int, *, every: int = 16):
         """A sampled per-epoch span context: every ``every``-th epoch gets

@@ -138,6 +138,21 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
        "per process)", "metrics"),
     _k("PATHWAY_SERVICE_NAMESPACE", "str", "local-dev",
        "OTel `service.namespace` resource attribute", "metrics"),
+    # -- request tracing & SLOs (engine/tracing.py, engine/slo.py) ----------
+    _k("PATHWAY_TRACE_REQUESTS", "bool", True,
+       "request-scoped distributed tracing of the serving path (ingress/"
+       "admission/batcher/device/generation child spans, histogram "
+       "exemplars, the `pathway_tpu requests` waterfall); `0` removes "
+       "the per-request span layer entirely", "tracing"),
+    _k("PATHWAY_TRACE_BUFFER", "int", 256,
+       "finished request traces retained in the in-process ring the "
+       "`pathway_tpu requests` CLI, `/status` and flight-recorder dumps "
+       "read", "tracing"),
+    _k("PATHWAY_SLOS", "str", None,
+       "extra SLO declarations (semicolon-separated "
+       "`name: metric pNN < threshold over window`, e.g. "
+       "`latency: serve.latency.ms p95 < 250ms over 5m`) merged over "
+       "the built-in registry; a redeclared name overrides it", "tracing"),
     # -- per-operator profiler / device accounting (engine/profiler.py) -----
     _k("PATHWAY_PROFILE", "bool", False,
        "enable the per-operator epoch profiler (top-N attribution "
@@ -401,6 +416,7 @@ _SUBSYSTEM_TITLES = (
     ("comm", "Worker mesh (`engine/comm.py`)"),
     ("faults", "Fault injection (`engine/faults.py`)"),
     ("metrics", "Metrics & telemetry (`engine/metrics.py`, `engine/telemetry.py`)"),
+    ("tracing", "Request tracing & SLOs (`engine/tracing.py`, `engine/slo.py`)"),
     ("profiler", "Profiler & device accounting (`engine/profiler.py`)"),
     ("freshness", "Freshness & backpressure (`engine/freshness.py`)"),
     ("bench", "Benchmark harness (`benchmarks/harness.py`)"),

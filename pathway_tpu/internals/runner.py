@@ -426,6 +426,21 @@ def run(
         if freshness.enabled:
             _serving.set_pressure_supplier(freshness.worst_staleness)
 
+        # request tracing + SLOs (engine/tracing.py, engine/slo.py):
+        # request spans ride this run's bounded telemetry export queue,
+        # the declared-SLO evaluator joins the scrape path, and every
+        # flight-recorder dump carries the finished-request ring
+        # (waterfalls) and the SLO burn/budget snapshot
+        from pathway_tpu.engine import slo as _slo
+        from pathway_tpu.engine import tracing as _tracing
+
+        _tracing.set_exporter(telemetry)
+        _slo.install(registry)
+        _blackbox.get_recorder().set_tracing_supplier(_tracing.snapshot)
+        _blackbox.get_recorder().set_slo_supplier(
+            lambda: _slo.get_evaluator().snapshot()
+        )
+
         if with_http_server:
             from pathway_tpu.engine.http_server import MonitoringServer
 
@@ -512,11 +527,18 @@ def run(
         _blackbox_dev.get_recorder().set_device_supplier(None)
         _blackbox_dev.get_recorder().set_autoscaler_supplier(None)
         _blackbox_dev.get_recorder().set_serving_supplier(None)
+        _blackbox_dev.get_recorder().set_tracing_supplier(None)
+        _blackbox_dev.get_recorder().set_slo_supplier(None)
         # ...and the serving shedder must stop referencing this run's
         # freshness tracker (same lifetime rule as the suppliers above)
         from pathway_tpu.engine import serving as _serving_cleanup
 
         _serving_cleanup.set_pressure_supplier(None)
+        # the trace exporter holds this run's Telemetry: clear it before
+        # telemetry.close() so no late span enqueues into a closed queue
+        from pathway_tpu.engine import tracing as _tracing_cleanup
+
+        _tracing_cleanup.set_exporter(None)
         if worker_ctx is not None:
             worker_ctx.close()
         if result.telemetry is not None:

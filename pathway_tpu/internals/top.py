@@ -18,6 +18,10 @@ or ``PATHWAY_MONITORING_HTTP_PORT``) and renders, per refresh:
 * serving — the REST admission panel (``engine/serving.py``): in-flight
   occupancy, queue depth, per-code request counts, latency quantiles,
   shed/deadline counters, and the degraded/draining flags;
+* requests — request-trace volume and ring extremes
+  (``engine/tracing.py``; full waterfalls via ``pathway_tpu requests``);
+* slo — every declared objective (``engine/slo.py``) with its remaining
+  error budget and multi-window burn rates;
 * operators — the per-operator progress table of the ``/status`` body.
 
 Pure functions (`render_top`) are separated from I/O (`fetch_status`) so
@@ -84,6 +88,59 @@ def _labeled(section: dict[str, float], base: str) -> dict[str, float]:
         label = next(iter(labels.values()), "") if labels else ""
         out[label] = value
     return out
+
+
+def render_waterfall(trace: dict[str, Any], width: int = 32) -> str:
+    """One finished request trace as a span waterfall: each span's
+    offset/duration plus a proportional bar against the request's whole
+    duration — a slow request decomposes visually into queue wait vs
+    coalesce vs device dispatch vs generation ticks."""
+    trace_id = trace.get("trace_id") or "?"
+    duration_s = trace.get("duration_s") or 0.0
+    status = trace.get("status")
+    header = (
+        f"trace {trace_id} [{trace.get('route') or '-'}]"
+        f"{'' if status is None else f' {status}'}"
+        f" · {duration_s * 1000:.1f} ms · {len(trace.get('spans') or [])} "
+        "span(s)"
+    )
+    dropped = trace.get("spans_dropped") or 0
+    if dropped:
+        header += f" (+{dropped} dropped)"
+    lines = [header]
+    start0 = trace.get("start") or 0.0
+    total = max(duration_s, 1e-9)
+    spans = sorted(
+        trace.get("spans") or [], key=lambda s: (s.get("start") or 0.0)
+    )
+    for span in spans:
+        offset = max(0.0, (span.get("start") or 0.0) - start0)
+        dur = span.get("duration_s") or 0.0
+        pre = min(width - 1, int(offset / total * width))
+        bar_len = max(1, min(width - pre, int(round(dur / total * width))))
+        bar = "·" * pre + "█" * bar_len
+        attrs = span.get("attributes") or {}
+        attr_str = " ".join(f"{k}={v}" for k, v in attrs.items())
+        lines.append(
+            f"  {span.get('name', '?'):<24} {offset * 1000:>8.1f}ms "
+            f"+{dur * 1000:>8.1f}ms  |{bar:<{width}}|"
+            + (f"  {attr_str}" if attr_str else "")
+        )
+    return "\n".join(lines)
+
+
+def render_requests(
+    traces: list[dict[str, Any]], limit: int = 10, width: int = 32
+) -> str:
+    """The ``pathway_tpu requests`` body: up to ``limit`` waterfalls."""
+    if not traces:
+        return (
+            "no finished request traces buffered — is the serving path "
+            "live (and PATHWAY_TRACE_REQUESTS not 0)?"
+        )
+    return "\n\n".join(
+        render_waterfall(t, width=width) for t in traces[:limit]
+    )
 
 
 def render_top(
@@ -417,6 +474,46 @@ def render_top(
         churn = generation.get("generate.churn.synthetic")
         if churn:
             lines.append(f"  churn: {int(churn)} synthetic burst request(s)")
+
+    requests = status.get("requests") or {}
+    req_scalars = requests.get("scalars") or {}
+    if req_scalars.get("trace.requests"):
+        # the request-tracing line (engine/tracing.py): trace volume plus
+        # the buffered ring's extremes — `pathway_tpu requests` renders
+        # the full waterfalls
+        lines.append("")
+        row = (
+            f"requests: {int(req_scalars['trace.requests'])} traced · "
+            f"{int(req_scalars.get('trace.spans') or 0)} span(s) · "
+            f"{int(req_scalars.get('trace.requests.buffered') or 0)} buffered"
+        )
+        slowest = req_scalars.get("trace.requests.slowest.ms")
+        if slowest is not None:
+            row += f" · slowest {slowest:.1f} ms"
+        lines.append(row)
+
+    slo = status.get("slo") or {}
+    slos = slo.get("slos") or []
+    if slos:
+        # the SLO panel (engine/slo.py): every declared objective with
+        # its budget + burn — a violating SLO must read off one line
+        lines.append("")
+        lines.append("slo (budget remaining · burn by window)")
+        for entry in slos:
+            burns = entry.get("burn") or {}
+            burn_str = " / ".join(
+                f"{window} ×{burns[window]:.2f}" for window in sorted(burns)
+            )
+            row = (
+                f"  {entry.get('name', '?'):<16} "
+                f"[{entry.get('objective', '')}]  budget "
+                f"{entry.get('budget_remaining', 1.0):>6.1%}"
+            )
+            if burn_str:
+                row += f" · burn {burn_str}"
+            if entry.get("violating"):
+                row += " · VIOLATING"
+            lines.append(row)
 
     operators = status.get("operators") or {}
     if operators:

@@ -38,6 +38,10 @@ DELETE = "_pw_delete"  # row dict flag for deletions / upserts
 # whose deadline lapsed while queued is DROPPED at staging (its waiting
 # client is answered 504 immediately) instead of burning an epoch
 DEADLINE_TS = "_pw_deadline_ts"
+# row dict field: W3C traceparent of the request that emitted this row
+# (engine/tracing.py) — staging records a child span on the request's
+# trace so connector queue time is attributable per request
+TRACE_STAMP = "_pw_trace"
 
 
 class RawRows:
@@ -452,6 +456,15 @@ class _QueuePoller:
             key = self._key_of(values, row)
             vrow = tuple(values)
             self.input_node.insert(key, vrow, self._time, diff)
+            tp = row.get(TRACE_STAMP)
+            if tp is not None:
+                from pathway_tpu.engine import tracing as _tracing
+
+                tr = _tracing.active_trace(tp)
+                if tr is not None:
+                    tr.add_span(
+                        "serve.stage", _time.time(), 0.0, epoch=self._time
+                    )
             if self.persist_state is not None and not self.persist_state.operator_mode:
                 self.persist_state.log.record(key, vrow, diff)
             self._staged = True

@@ -18,7 +18,7 @@ import threading
 import time as _time
 from typing import Any
 
-from pathway_tpu.engine import serving
+from pathway_tpu.engine import serving, tracing
 from pathway_tpu.engine.freshness import safe_label
 from pathway_tpu.engine.metrics import MS_BUCKETS, get_registry
 from pathway_tpu.engine.types import Json, Pointer, hash_values
@@ -30,6 +30,7 @@ from pathway_tpu.io import _utils
 from pathway_tpu.io._utils import COMMIT, Reader
 
 DEADLINE_HEADER = "X-Pathway-Deadline-Ms"
+TRACEPARENT_HEADER = "traceparent"
 
 
 class EndpointExamples:
@@ -275,15 +276,30 @@ class _RestSubject(Reader):
             deadline = serving.Deadline.from_ms(deadline_ms)
             controller = serving.get_controller()
             serving.maybe_flood(self.route)  # chaos: request_flood
+            tracing.maybe_trace_storm(self.route)  # chaos: trace_storm
+            ingress_started = _time.time()
             try:
                 ticket = await controller.admit(
-                    self.route, len(body), deadline
+                    self.route,
+                    len(body),
+                    deadline,
+                    trace_parent=request.headers.get(TRACEPARENT_HEADER),
                 )
             except serving.ServeRejected as rej:
                 return self._reject(web, route_label, rej)
+            trace = ticket.trace
+            if trace is not None:
+                trace.add_span(
+                    "serve.ingress",
+                    ingress_started,
+                    max(0.0, _time.time() - ingress_started),
+                    method=request.method,
+                    nbytes=len(body),
+                )
             started = _time.monotonic()
             code = 500
             try:
+              with tracing.trace_scope(trace):
                 # chaos: slow_handler stalls while HOLDING the admission
                 # slot — queue delay climbs, shedding paths fire
                 stall_s = serving.slow_handler_delay_s(self.route)
@@ -305,6 +321,11 @@ class _RestSubject(Reader):
                 rid = next(self._seq)
                 key = hash_values(["rest", id(self), rid])
                 row = {"_pw_key": key, _utils.DEADLINE_TS: deadline.at}
+                if trace is not None:
+                    # the trace rides the row exactly like the deadline:
+                    # downstream wait points (staging, batcher, device)
+                    # attribute their spans to it without an ambient hop
+                    row[tracing.TRACE_STAMP] = trace.traceparent()
                 for n in names:
                     v = payload.get(n)
                     if dtypes[n].strip_optional() is dt.JSON and v is not None:
@@ -316,8 +337,13 @@ class _RestSubject(Reader):
                 serving.register_request(
                     key, lambda status, msg, _k=key: self.fail(_k, status, msg)
                 )
+                # key→trace binding: the async-UDF node re-enters this
+                # trace's scope when it computes this row (the epoch-
+                # thread hop of the trace)
+                tracing.bind_key(key, trace)
                 emit(row)
                 emit(COMMIT)
+                pipeline_started = _time.time()
                 try:
                     result = await asyncio.wait_for(
                         future, timeout=max(0.0, deadline.remaining_s())
@@ -329,7 +355,14 @@ class _RestSubject(Reader):
                         {"error": "deadline exceeded"}, status=504
                     )
                 finally:
+                    if trace is not None:
+                        trace.add_span(
+                            "serve.pipeline",
+                            pipeline_started,
+                            max(0.0, _time.time() - pipeline_started),
+                        )
                     serving.unregister_request(key)
+                    tracing.unbind_key(key)
                     self.futures.pop(key, None)
                     if self.delete_completed_queries:
                         drow = dict(row)
@@ -354,7 +387,12 @@ class _RestSubject(Reader):
                         "admitted-request end-to-end latency (ms)",
                         buckets=MS_BUCKETS,
                         route=route_label,
-                    ).observe(latency_ms)
+                    ).observe(
+                        latency_ms,
+                        trace_id=trace.trace_id if trace is not None else None,
+                    )
+                if trace is not None:
+                    trace.finish(status=code)
                 controller.release(ticket, code=code, latency_ms=latency_ms)
 
         self.webserver._add_route(

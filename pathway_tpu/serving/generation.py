@@ -64,6 +64,7 @@ class GenRequest:
         "prompt_ids", "max_new_tokens", "temperature", "top_p", "min_p",
         "deadline", "future", "loop_future", "synthetic", "submitted_at",
         "first_token_at", "finished_at", "out", "pages_reserved",
+        "trace", "submitted_wall", "first_token_wall",
     )
 
     def __init__(
@@ -76,6 +77,7 @@ class GenRequest:
         min_p: float | None = None,
         deadline=None,
         synthetic: bool = False,
+        trace=None,
     ):
         self.prompt_ids = prompt_ids
         self.max_new_tokens = max_new_tokens
@@ -86,6 +88,13 @@ class GenRequest:
         self.future: Future = Future()
         self.synthetic = synthetic
         self.submitted_at = time.monotonic()
+        # request trace (engine/tracing.py): captured at submit time in the
+        # caller's context, spans recorded from the scheduler thread — wall
+        # timestamps ride along because spans use wall-clock starts while
+        # the scheduler's own telemetry stays monotonic
+        self.trace = trace
+        self.submitted_wall = time.time()
+        self.first_token_wall: float | None = None
         self.first_token_at: float | None = None
         self.finished_at: float | None = None
         self.out: list[int] = []
@@ -265,6 +274,7 @@ class GenerationScheduler:
         :class:`DeadlineExceededError` when the request arrives already
         lapsed."""
         from pathway_tpu.engine import serving as edge
+        from pathway_tpu.engine import tracing
 
         if max_new_tokens >= self.max_cache:
             raise ValueError(
@@ -285,6 +295,7 @@ class GenerationScheduler:
         req = GenRequest(
             prompt_ids, max_new_tokens, temperature=temperature,
             top_p=top_p, min_p=min_p, deadline=deadline, synthetic=synthetic,
+            trace=tracing.current_trace(),
         )
         with self._lock:
             if len(self._queue) >= self.queue_limit:
@@ -463,6 +474,16 @@ class GenerationScheduler:
             self.allocator.reserve(need)
             req.pages_reserved = need
             i = free.pop(0)
+            if req.trace is not None:
+                # queue-wait span: submit → slot grant, attributed to the
+                # request's own trace (the scheduler thread has no ambient)
+                req.trace.add_span(
+                    "generate.queue",
+                    req.submitted_wall,
+                    max(0.0, time.time() - req.submitted_wall),
+                    slot=i,
+                    pages=need,
+                )
             slot = _Slot(req)
             self._slots[i] = slot
             self._block_tables[i, :] = 0
@@ -531,6 +552,7 @@ class GenerationScheduler:
         starts = np.zeros(self.slots, np.int32)
         take = np.zeros(self.slots, bool)
         finishing: list[int] = []
+        traced_chunks: list[tuple] = []
         with self._lock:
             for i in rows:
                 slot = self._slots[i]
@@ -545,17 +567,30 @@ class GenerationScheduler:
                 ids[i, :n] = chunk
                 chunk_lens[i] = n
                 starts[i] = done
+                if slot.req.trace is not None:
+                    traced_chunks.append((slot.req.trace, n, done))
                 if done + n >= slot.prompt_len:
                     take[i] = True
                     finishing.append(i)
             G = self._table_width()
             bt = self._block_tables[:, :G].copy()
+        chunk_started = time.time()
         self._logits, self._k_pool, self._v_pool = self._prefill_fn(
             self.lm.params, self._k_pool, self._v_pool, jnp.asarray(bt),
             jnp.asarray(ids), jnp.asarray(chunk_lens), jnp.asarray(starts),
             self._logits, jnp.asarray(take),
         )
         self._m_prefill_chunks.inc()
+        if traced_chunks:
+            # one shared prefill program, one span per traced request —
+            # the wall duration is the whole chunk's (work is fused), the
+            # attributes are the request's own chunk geometry
+            chunk_s = max(0.0, time.time() - chunk_started)
+            for trace, n, done in traced_chunks:
+                trace.add_span(
+                    "generate.prefill.chunk", chunk_started, chunk_s,
+                    chunk_len=int(n), prompt_start=int(done),
+                )
         with self._lock:
             for i in rows:
                 slot = self._slots[i]
@@ -605,7 +640,22 @@ class GenerationScheduler:
                 self._seq_lens[i] = slot.seq_len
                 if req.first_token_at is None:
                     req.first_token_at = t_now
-                    self._m_ttft.observe((t_now - req.submitted_at) * 1e3)
+                    req.first_token_wall = time.time()
+                    ttft_s = t_now - req.submitted_at
+                    self._m_ttft.observe(
+                        ttft_s * 1e3,
+                        trace_id=(
+                            req.trace.trace_id
+                            if req.trace is not None else None
+                        ),
+                    )
+                    if req.trace is not None:
+                        # TTFT span: submit → first sampled token, the
+                        # duration matches the histogram observation
+                        req.trace.add_span(
+                            "generate.ttft", req.submitted_wall, ttft_s,
+                            prompt_len=slot.prompt_len,
+                        )
                     if req.synthetic:
                         self._churn_ttfts.append(t_now - req.submitted_at)
                 stop = eos is not None and t == eos
@@ -614,6 +664,14 @@ class GenerationScheduler:
                     produced += 1
                 if stop or len(req.out) >= req.max_new_tokens:
                     req.finished_at = t_now
+                    if req.trace is not None:
+                        start = req.first_token_wall or req.submitted_wall
+                        req.trace.add_span(
+                            "generate.decode", start,
+                            max(0.0, time.time() - start),
+                            tokens=len(req.out),
+                            eos=bool(stop),
+                        )
                     self._release_slot(i)
                     if not req.future.done():
                         req.future.set_result(req.out)

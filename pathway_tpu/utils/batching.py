@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import asyncio
 import threading
+import time as _time
 import weakref
 from typing import Any, Callable, Sequence
 
@@ -80,10 +81,13 @@ class AsyncMicroBatcher:
         self.name = name or getattr(process_batch, "__name__", "batch")
         self._executor = executor
         # ONE pending list across every event loop (see module docstring);
-        # entries are (item, loop, asyncio.Future, Deadline | None) — the
-        # deadline is the serving request's ambient budget, checked again
-        # at dispatch so an expired waiter never burns device work
-        self._pending: list[tuple[Any, Any, Any, Any]] = []
+        # entries are (item, loop, asyncio.Future, Deadline | None,
+        # (RequestTrace, enqueued_at) | None) — the deadline is the
+        # serving request's ambient budget, checked again at dispatch so
+        # an expired waiter never burns device work, and the trace is the
+        # request's ambient RequestTrace so a coalesced batch parents each
+        # waiter's spans to its OWN trace (engine/tracing.py)
+        self._pending: list[tuple[Any, Any, Any, Any, Any]] = []
         self._lock = threading.Lock()
         # loops that currently have a live flusher task.  Keyed by
         # id(loop) but VALIDATED against a weakref to the loop object: a
@@ -104,7 +108,7 @@ class AsyncMicroBatcher:
         return self._executor
 
     async def submit(self, item: Any) -> Any:
-        from pathway_tpu.engine import serving
+        from pathway_tpu.engine import serving, tracing
 
         # serving deadline propagation (shed-before-work): an already-
         # expired request never coalesces into a batch at all, and a live
@@ -116,13 +120,18 @@ class AsyncMicroBatcher:
                 "request deadline lapsed before batch coalescing "
                 "(shed-before-work)"
             )
+        # trace propagation across the thread hop: the ambient trace is
+        # captured HERE (the waiter's own context) and rides the entry —
+        # the dispatch side may run on any thread/loop
+        trace = tracing.current_trace()
+        entry_trace = (trace, _time.time()) if trace is not None else None
         loop = asyncio.get_running_loop()
         future = loop.create_future()
         flush_now = False
         spawn_flusher = False
         key = id(loop)
         with self._lock:
-            self._pending.append((item, loop, future, deadline))
+            self._pending.append((item, loop, future, deadline, entry_trace))
             if len(self._pending) >= self.max_batch_size:
                 flush_now = True
             ref = self._flushers.get(key)
@@ -148,7 +157,7 @@ class AsyncMicroBatcher:
                 del self._pending[: self.max_batch_size]
             self._dispatch(batch)
 
-    def _dispatch(self, batch: list[tuple[Any, Any, Any, Any]]) -> None:
+    def _dispatch(self, batch: list[tuple[Any, Any, Any, Any, Any]]) -> None:
         # deadline re-check at the coalesce→dispatch boundary: waiters
         # whose serving deadline lapsed while pending are failed typed
         # here and excluded from the batch — the device never pays for a
@@ -162,7 +171,7 @@ class AsyncMicroBatcher:
             from pathway_tpu.engine import serving
 
             live = [entry for entry in batch if entry not in expired]
-            for _item, loop, fut, _ddl in expired:
+            for _item, loop, fut, _ddl, _tr in expired:
                 serving.note_deadline_shed("batcher")
                 exc = serving.DeadlineExceededError(
                     "request deadline lapsed while coalescing "
@@ -174,8 +183,23 @@ class AsyncMicroBatcher:
                     pass
             if not live:
                 return
-        items = [item for (item, _loop, _fut, _ddl) in live]
-        waiters = [(loop, fut) for (_item, loop, fut, _ddl) in live]
+        # per-waiter coalesce span: one batch, N traces — each waiter's
+        # span (its own coalesce wait) parents to its OWN trace
+        now = _time.time()
+        traces = []
+        for _item, _loop, _fut, _ddl, entry_trace in live:
+            if entry_trace is not None:
+                trace, enqueued_at = entry_trace
+                trace.add_span(
+                    "serve.batch",
+                    enqueued_at,
+                    max(0.0, now - enqueued_at),
+                    batcher=self.name,
+                    batch_size=len(live),
+                )
+                traces.append(trace)
+        items = [entry[0] for entry in live]
+        waiters = [(entry[1], entry[2]) for entry in live]
 
         def job():
             return self.process_batch(items)
@@ -203,15 +227,22 @@ class AsyncMicroBatcher:
             # seconds-long batches (LLM generation) get their own thread:
             # serializing them behind the shared dispatch thread would
             # head-of-line-block every ms-scale embedder batch
-            from pathway_tpu.device.executor import DeviceFuture
+            from pathway_tpu.device.executor import _JOB_TRACES, DeviceFuture
 
             future = DeviceFuture()
 
             def run_detached():
+                # the detached batch thread inherits the waiters' traces
+                # the same way a dispatch-thread job does, so run_batch
+                # calls inside record attributable device spans
+                token = _JOB_TRACES.set(tuple(traces)) if traces else None
                 try:
                     future.set_result(job())
                 except BaseException as exc:  # noqa: BLE001 - delivered to waiters
                     future.set_exception(exc)
+                finally:
+                    if token is not None:
+                        _JOB_TRACES.reset(token)
 
             future.add_done_callback(deliver)
             threading.Thread(
@@ -220,7 +251,10 @@ class AsyncMicroBatcher:
             return
         try:
             device_future = self._exec().submit(
-                job, name=self.name, nbytes=_batch_nbytes(items)
+                job,
+                name=self.name,
+                nbytes=_batch_nbytes(items),
+                traces=tuple(traces),
             )
         except BaseException as exc:  # noqa: BLE001 - delivered to every waiter
             # submit() itself can fail (ExecutorClosedError after close,

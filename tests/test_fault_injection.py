@@ -153,6 +153,20 @@ class TestFaultPlan:
         churn = plan.check("request_churn", source="pw-tiny")  # 2nd: fires
         assert churn is not None and churn.count == 6
 
+    def test_trace_storm_kind_in_catalog(self):
+        """The observability chaos kind is a first-class plan citizen: a
+        burst of synthetic traced requests with deep span trees, keyed
+        by route (source=), carrying the burst size."""
+        plan = faults.FaultPlan(
+            [{"kind": "trace_storm", "source": "/v1/q", "nth": 2,
+              "count": 16}]
+        )
+        assert plan.has("trace_storm")
+        assert plan.check("trace_storm", source="/other") is None
+        assert plan.check("trace_storm", source="/v1/q") is None  # 1st
+        storm = plan.check("trace_storm", source="/v1/q")  # 2nd: fires
+        assert storm is not None and storm.count == 16
+
 
 # ---------------------------------------------------------------------------
 # Flaky blob backend ↔ checkpoint round-trip (the satellite guarantee:
@@ -632,3 +646,91 @@ class TestCommFaults:
         ):
             mesh.recv(0, "never")
         assert time.monotonic() - t0 < 5
+
+
+# ---------------------------------------------------------------------------
+# trace_storm: the bounded span-export queue under a synthetic trace burst
+# ---------------------------------------------------------------------------
+
+
+class TestTraceStorm:
+    def test_storm_overflows_export_queue_without_blocking(self, monkeypatch):
+        """A seeded trace_storm bursts N synthetic traces (deep chained
+        span trees) through the bounded telemetry export queue: the queue
+        drops oldest (counting ``telemetry.export.dropped``), the burst
+        itself returns promptly — span recording NEVER blocks the serving
+        path on a wedged collector."""
+        from pathway_tpu.engine import metrics as em
+        from pathway_tpu.engine import telemetry as tmod
+        from pathway_tpu.engine import tracing
+        from pathway_tpu.engine.telemetry import Telemetry, TelemetryConfig
+        from pathway_tpu.internals.license import License
+
+        monkeypatch.setattr(tmod, "EXPORT_QUEUE_MAX", 8)
+        cfg = TelemetryConfig.create(
+            license=License.new("demo-license-key-with-telemetry-abc"),
+            monitoring_server="http://127.0.0.1:1",  # never reached
+            run_id="storm",
+        )
+        tele = Telemetry(cfg)
+        release = threading.Event()
+        tele._export = lambda *a: release.wait(10)  # wedged collector
+        tracing.reset_for_tests()
+        tracing.set_exporter(tele)
+        before_dropped = em.get_registry().counter(
+            "telemetry.export.dropped"
+        ).value
+        before_storm = em.get_registry().counter(
+            "trace.storm.synthetic"
+        ).value
+        faults.install_plan(
+            faults.FaultPlan(
+                [{"kind": "trace_storm", "source": "/v1/q", "count": 8}],
+                seed=13,
+            )
+        )
+        try:
+            t0 = time.monotonic()
+            n = tracing.maybe_trace_storm("/v1/q")
+            elapsed = time.monotonic() - t0
+            assert n == 8
+            # 8 traces x (12 chained spans + 1 root close) >> queue of 8:
+            # overflow must drop, not block
+            assert elapsed < 2.0
+            assert tele.dropped_exports > 0
+            scalars = em.get_registry().scalar_metrics()
+            assert (
+                scalars["telemetry.export.dropped"] - before_dropped
+                == tele.dropped_exports
+            )
+            assert scalars["trace.storm.synthetic"] - before_storm == 8.0
+            # every synthetic trace landed in the finished-request ring
+            # with its full span tree (root + STORM_TREE_DEPTH children)
+            recent = tracing.recent_requests(8)
+            assert len(recent) == 8
+            assert all(t["status"] == "storm" for t in recent)
+            assert all(
+                len(t["spans"]) == tracing.STORM_TREE_DEPTH + 1
+                for t in recent
+            )
+            # the chained parent links are real: depth k parents depth k-1
+            spans = {s["span_id"]: s for s in recent[0]["spans"]}
+            deepest = next(
+                s for s in recent[0]["spans"]
+                if s["name"] == f"storm.depth.{tracing.STORM_TREE_DEPTH - 1}"
+            )
+            hops = 0
+            cursor = deepest
+            while cursor["parent_span_id"] in spans:
+                cursor = spans[cursor["parent_span_id"]]
+                hops += 1
+            assert hops == tracing.STORM_TREE_DEPTH  # ...up to the root
+        finally:
+            release.set()
+            tracing.reset_for_tests()
+            tele.close()
+
+    def test_storm_does_not_fire_without_plan(self):
+        from pathway_tpu.engine import tracing
+
+        assert tracing.maybe_trace_storm("/v1/q") == 0

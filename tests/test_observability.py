@@ -410,6 +410,42 @@ def test_traceparent_minting_well_formed():
     assert mint_traceparent() != tp
 
 
+def test_otlp_traces_parent_linked_pair_golden():
+    """The spanId-minted-at-export bug pin: span ids are minted at span
+    CREATION and carried on the record, so (a) a child's parentSpanId is
+    exactly the root's spanId, and (b) exporting the same record twice
+    yields bit-identical OTLP documents — an export-time mint could do
+    neither."""
+    from pathway_tpu.engine import tracing
+    from pathway_tpu.engine.telemetry import TelemetryConfig, _otlp_traces
+
+    tracing.reset_for_tests()
+    trace = tracing.RequestTrace("/v1/q")
+    trace.add_span("serve.admission", 1_700_000_000.0, 0.002, inflight=1)
+    trace.finish(status=200)
+    resource = TelemetryConfig.create(run_id="rt").resource()
+
+    def export(rec):
+        body = _otlp_traces(
+            {"resource": resource, "span": rec, "fallback_trace_id": "f" * 32}
+        )
+        return body["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+
+    child_rec, root_rec = trace.spans
+    child, root = export(child_rec), export(root_rec)
+    assert child["traceId"] == root["traceId"] == trace.trace_id
+    assert root["spanId"] == trace.root_span_id
+    assert child["parentSpanId"] == root["spanId"]  # a REAL parent link
+    assert root["parentSpanId"] == ""  # minted root: no upstream caller
+    assert child["name"] == "serve.admission"
+    assert root["name"] == "serve.request"
+    assert child["startTimeUnixNano"] == "1700000000000000000"
+    assert child["endTimeUnixNano"] == "1700000000002000000"
+    # stability: a re-export (collector retry) is the SAME document
+    assert export(child_rec) == child and export(root_rec) == root
+    tracing.reset_for_tests()
+
+
 # --- incremental GC ----------------------------------------------------------
 
 

@@ -482,8 +482,8 @@ def test_batcher_dispatch_fails_lapsed_waiters_typed():
         live_fut = loop.create_future()
         b._dispatch(
             [
-                ("dead", loop, dead_fut, Deadline(time.monotonic() - 1.0)),
-                ("live", loop, live_fut, Deadline.from_ms(30_000)),
+                ("dead", loop, dead_fut, Deadline(time.monotonic() - 1.0), None),
+                ("live", loop, live_fut, Deadline.from_ms(30_000), None),
             ]
         )
         with pytest.raises(DeadlineExceededError):
