@@ -1,0 +1,191 @@
+"""Failover-downtime benchmark: warm-standby promotion vs restart-all.
+
+The supervisor (engine/supervisor.py) has two answers to an unplanned
+worker death.  **Promotion** (tier one) fences the dead worker id, hands
+its shard to a warm standby, and replays ONLY that shard's committed
+state while the survivors drain-commit and rejoin in-process — no
+process spawn, no backoff, no group-wide replay.  **Restart-all** (tier
+two, the PR 10 fallback) pays the supervisor's restart backoff, bumps
+the incarnation, replays EVERY worker's shard from the root, and redoes
+the whole uncommitted tail the rollback discarded.  This harness prices
+both paths on identical roots so ``pathway_tpu bench --smoke --check``
+keeps the ordering honest — the chaos acceptance for the standby
+subsystem pins promotion at >= 5x faster, and this benchmark is the
+committed record of that margin:
+
+* ``promote_failover_ms`` — per-worker fence bump + the full promote
+  request/ack/adopted protocol on the lease + survivor drain-commit +
+  dead-shard-only replay + dead-tail redo;
+* ``restart_failover_ms`` — first restart-backoff delay (the
+  supervisor's real schedule, un-jittered), incarnation bump, full
+  replay of every shard, then re-ingest + commit of every worker's
+  discarded tail;
+* ``promote_speedup`` — restart / promote wall-clock ratio.
+
+Usage: ``python benchmarks/failover_downtime.py [smoke|full]``
+Prints one JSON line per metric (harness.py protocol).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_WORKERS = 2
+DEAD = 1  # the worker the scenario kills
+SCHEMA = "k:INT|v:INT"
+
+
+def _key(w: int, i: int) -> int:
+    return ((w * 100_000 + i + 1) << 16) | ((w * 7919 + i * 31) & 0xFFFF)
+
+
+def _tail_key(w: int, i: int) -> int:
+    return ((500_000 + w * 50_000 + i + 1) << 16) | ((i * 131) & 0xFFFF)
+
+
+def _seed(root: str, chunks: int, rows_per_chunk: int) -> int:
+    """Commit ``chunks`` chunks of ``rows_per_chunk`` rows per worker and
+    lease the root (promotions are a supervised-run protocol); returns
+    the committed row total."""
+    from pathway_tpu.engine import persistence as pz
+
+    os.environ["PATHWAY_PROCESSES"] = str(N_WORKERS)
+    backend = pz.FileBackend(root)
+    pz.acquire_lease(backend, owner="bench", workers=N_WORKERS)
+    for w in range(N_WORKERS):
+        storage = pz.PersistentStorage(backend, worker=w)
+        state = storage.register_source(f"src-w{w}", schema_digest=SCHEMA)
+        for c in range(chunks):
+            for i in range(rows_per_chunk):
+                state.log.record(_key(w, c * rows_per_chunk + i), (w, i), 1)
+            state.log.flush_chunk()
+        state.pending_offset = {f"file-{w}": [1.0, chunks * rows_per_chunk]}
+        storage.commit()
+    return N_WORKERS * chunks * rows_per_chunk
+
+
+def _resume_with_tail(root: str, tail_rows: int, committed: int):
+    """Resume every worker and stage (flush, do NOT commit) an
+    uncommitted tail on each — the in-flight work a death interrupts."""
+    from pathway_tpu.engine import persistence as pz
+
+    backend = pz.FileBackend(root)
+    storages = []
+    for w in range(N_WORKERS):
+        storage = pz.PersistentStorage(backend, worker=w)
+        state = storage.register_source(f"src-w{w}", schema_digest=SCHEMA)
+        storage.replay_into(state, lambda k, r, d: None)
+        for i in range(tail_rows):
+            state.log.record(_tail_key(w, i), (9, i), 1)
+        state.log.flush_chunk()
+        state.pending_offset = {f"file-{w}": [2.0, committed + tail_rows]}
+        storages.append((w, storage, state))
+    return backend, storages
+
+
+def _replay_worker(root: str, w: int) -> int:
+    """Rebuild one worker's shard from the root; returns replayed rows."""
+    from pathway_tpu.engine import persistence as pz
+
+    backend = pz.FileBackend(root)
+    storage = pz.PersistentStorage(backend, worker=w)
+    state = storage.register_source(f"src-w{w}", schema_digest=SCHEMA)
+    return storage.replay_into(state, lambda k, r, d: None), storage, state
+
+
+def _redo_tail(storage, state, w: int, tail_rows: int, base_rows: int) -> None:
+    """Re-ingest + commit a worker's discarded tail."""
+    for i in range(tail_rows):
+        state.log.record(_tail_key(w, i), (9, i), 1)
+    state.log.flush_chunk()
+    state.pending_offset = {f"redo-{w}": [1.0, base_rows + tail_rows]}
+    storage.commit()
+
+
+def _restart_backoff_s() -> float:
+    """The first delay of the supervisor's real restart schedule
+    (engine/supervisor.py ``_backoff_delays``), un-jittered for
+    determinism."""
+    from pathway_tpu.internals.udfs.retries import (
+        ExponentialBackoffRetryStrategy,
+    )
+
+    return next(
+        ExponentialBackoffRetryStrategy(
+            max_retries=1, initial_delay=200, backoff_factor=2, jitter_ms=0
+        ).delays()
+    )
+
+
+def main() -> None:
+    smoke = len(sys.argv) > 1 and sys.argv[1] == "smoke"
+    chunks = 2 if smoke else 6
+    rows_per_chunk = 400 if smoke else 2000
+    tail_rows = 800 if smoke else 4000
+    per_worker = chunks * rows_per_chunk
+
+    from pathway_tpu.engine import persistence as pz
+
+    # -- tier one: fence + promote protocol + dead-shard-only replay ------
+    with tempfile.TemporaryDirectory(prefix="pw-promote-") as root:
+        committed = _seed(root, chunks, rows_per_chunk)
+        backend, storages = _resume_with_tail(root, tail_rows, committed)
+        survivors = [(w, s, st) for w, s, st in storages if w != DEAD]
+
+        t0 = time.perf_counter()
+        fence = pz.bump_worker_fence(backend, DEAD)
+        pz.post_promote_request(
+            root, incarnation=1, worker=DEAD, standby=0, fence=fence,
+            seq=1, workers=N_WORKERS, reason="bench: worker died",
+        )
+        pz.write_promote_ack(root, "standby", seq=1, worker=DEAD, incarnation=1)
+        for w, storage, _state in survivors:
+            # survivors drain-commit their frontier (tail included) and ack
+            storage.commit()
+            pz.write_promote_ack(root, w, seq=1, worker=DEAD, incarnation=1)
+        # the standby adopts: replays ONLY the dead worker's shard, then
+        # redoes the tail the death discarded on that shard alone
+        rows, storage, state = _replay_worker(root, DEAD)
+        pz.write_promote_ack(root, "adopted", seq=1, worker=DEAD, incarnation=1)
+        _redo_tail(storage, state, DEAD, tail_rows, per_worker)
+        pz.append_promotion(
+            root, {"seq": 1, "worker": DEAD, "standby": 0, "fence": fence},
+        )
+        pz.clear_promote(root, N_WORKERS)
+        promote_ms = (time.perf_counter() - t0) * 1000.0
+        assert rows == per_worker, (rows, per_worker)
+
+    # -- tier two: backoff + incarnation bump + full replay + full redo ---
+    with tempfile.TemporaryDirectory(prefix="pw-restart-") as root:
+        committed = _seed(root, chunks, rows_per_chunk)
+        backend, _storages = _resume_with_tail(root, tail_rows, committed)
+
+        t0 = time.perf_counter()
+        time.sleep(_restart_backoff_s())
+        pz.acquire_lease(backend, owner="bench", workers=N_WORKERS)
+        total = 0
+        for w in range(N_WORKERS):
+            rows, storage, state = _replay_worker(root, w)
+            total += rows
+            _redo_tail(storage, state, w, tail_rows, per_worker)
+        restart_ms = (time.perf_counter() - t0) * 1000.0
+        assert total == committed, (total, committed)
+
+    for metric, value in (
+        ("promote_failover_ms", promote_ms),
+        ("restart_failover_ms", restart_ms),
+        ("promote_speedup", restart_ms / promote_ms),
+    ):
+        print(json.dumps({"metric": metric, "value": round(value, 4)}))
+
+
+if __name__ == "__main__":
+    main()

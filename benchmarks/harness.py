@@ -147,6 +147,13 @@ SUITE: tuple[Bench, ...] = (
         "request_trace_overhead", "request_trace_overhead.py",
         ("smoke",), ("full",),
     ),
+    # unplanned worker loss: warm-standby promotion (fence + promote
+    # protocol + dead-shard-only replay) vs the restart-all fallback
+    # (backoff + incarnation bump + full replay + full tail redo) on
+    # identical roots — promote_speedup >= 5 is the standby chaos pin
+    Bench(
+        "failover_downtime", "failover_downtime.py", ("smoke",), ("full",),
+    ),
 )
 
 MODE_REPS = {"smoke": 3, "full": 3}

@@ -59,6 +59,7 @@ def spawn_program(
     checkpoint_root: str | None = None,
     shrink_on_loss: bool | None = None,
     autoscale: bool | None = None,
+    standbys: int | None = None,
 ) -> NoReturn:
     """Launch ``processes`` copies of ``program`` forming one SPMD cluster.
 
@@ -67,6 +68,12 @@ def spawn_program(
     the last committed persistence checkpoint and respawns it, up to
     ``max_restarts`` times — same run id, ports and comm secret, so the
     recovered cluster resumes exactly where the snapshots left off.
+
+    With ``standbys=K`` (or ``PATHWAY_STANDBY_COUNT``) the supervisor
+    also keeps K warm-standby processes tailing the checkpoint root
+    (``engine/standby.py``); a worker death is then absorbed by
+    promoting one — the survivors rejoin in place and never restart —
+    with the whole-group restart above as the fallback tier.
 
     Elastic rescale: relaunching a supervised run with a DIFFERENT ``-n``
     on the same ``--checkpoint-root`` is supported — the supervisor
@@ -134,6 +141,12 @@ def spawn_program(
             incarnation = env_raw(ENV_INCARNATION)
             if incarnation is not None:
                 env[ENV_INCARNATION] = incarnation
+            # exported by the supervisor around a STANDBY spawn (same
+            # env-export trick as the incarnation): the process boots
+            # into the tail loop instead of the worker path
+            standby_id = env_raw("PATHWAY_STANDBY_ID")
+            if standby_id is not None:
+                env["PATHWAY_STANDBY_ID"] = standby_id
             return subprocess.Popen([program, *arguments], env=env)
 
         def echo_post_mortem(post_mortem: dict) -> None:
@@ -154,6 +167,7 @@ def spawn_program(
                 checkpoint_root=checkpoint_root,
                 shrink_on_loss=shrink_on_loss,
                 autoscale=autoscale,
+                standbys=standbys,
             ).run()
         except SupervisorError as exc:
             click.echo(f"[pathway_tpu] {exc}", err=True)
@@ -165,6 +179,15 @@ def spawn_program(
             click.echo(
                 f"[pathway_tpu] recovered after {result.restarts} restart(s) "
                 f"(last failure: {result.last_failure})",
+                err=True,
+            )
+        for promo in result.promotions:
+            click.echo(
+                f"[pathway_tpu] standby promotion: standby "
+                f"{promo['standby']} adopted worker {promo['worker']} in "
+                f"{promo.get('duration_s')}s on attempt "
+                f"{promo.get('attempt')} ({promo.get('reason')}); the "
+                "surviving workers rejoined in place without a restart",
                 err=True,
             )
         for rescale in result.rescales:
@@ -329,9 +352,20 @@ _SPAWN_SETTINGS = {"allow_interspersed_args": False, "show_default": True}
     "(bounds/thresholds via PATHWAY_AUTOSCALE_* knobs; "
     "PATHWAY_AUTOSCALE=1 is the env form; requires --checkpoint-root)",
 )
+@click.option(
+    "--standbys",
+    metavar="K",
+    type=click.IntRange(min=0),
+    default=None,
+    help="supervised mode: keep K warm-standby processes tailing the "
+    "checkpoint root (engine/standby.py) so a worker death is absorbed "
+    "by promoting one — survivors rejoin in place, no group restart — "
+    "with restart as the fallback tier (PATHWAY_STANDBY_COUNT is the "
+    "env form; requires --checkpoint-root)",
+)
 @click.argument("program")
 @click.argument("arguments", nargs=-1)
-def spawn(threads, processes, first_port, record, record_path, jax_distributed, supervise, max_restarts, checkpoint_root, shrink_on_loss, autoscale, program, arguments):
+def spawn(threads, processes, first_port, record, record_path, jax_distributed, supervise, max_restarts, checkpoint_root, shrink_on_loss, autoscale, standbys, program, arguments):
     """Run PROGRAM as an SPMD cluster of identical processes.
 
     Re-running a supervised program with a different ``-n`` against the
@@ -360,6 +394,7 @@ def spawn(threads, processes, first_port, record, record_path, jax_distributed, 
         checkpoint_root=checkpoint_root,
         shrink_on_loss=shrink_on_loss,
         autoscale=autoscale,
+        standbys=standbys,
     )
 
 
@@ -461,6 +496,35 @@ def scrub(worker, as_json, repair, root):
                 )
             else:
                 click.echo(f"  lease: DAMAGED — {lease.get('error')}")
+            for sid, beacon in sorted((lease.get("standbys") or {}).items()):
+                cursors = beacon.get("cursors") or {}
+                trail = ", ".join(
+                    f"w{w}@g{g}"
+                    for w, g in sorted(
+                        cursors.items(), key=lambda item: int(item[0])
+                    )
+                )
+                click.echo(
+                    f"  standby {sid}: apply lag {beacon.get('lag_s')}s, "
+                    f"{beacon.get('verified_chunks')} chunk(s) verified"
+                    + (f", cursors {trail}" if trail
+                       else ", no generations applied yet")
+                )
+            promos = lease.get("promotions") or []
+            if promos:
+                click.echo(f"  promotion history ({len(promos)}):")
+                for p in promos:
+                    click.echo(
+                        f"    standby {p.get('standby')} -> worker "
+                        f"{p.get('worker')} in {p.get('duration_s')}s on "
+                        f"attempt {p.get('attempt')} ({p.get('reason')})"
+                    )
+            promote = lease.get("promote")
+            if promote and promote.get("pending_request"):
+                click.echo(
+                    "  promotion IN FLIGHT (acks: "
+                    f"{', '.join(promote.get('acks') or []) or 'none'})"
+                )
         topo = report.get("topology")
         if topo is not None:
             history = topo.get("history") or []

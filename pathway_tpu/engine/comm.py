@@ -107,6 +107,16 @@ class CommError(RuntimeError):
     pass
 
 
+class MeshPoisoned(CommError):
+    """This mesh was deliberately abandoned (``TcpMesh.poison``): a peer
+    died and the supervisor is promoting a warm standby into its worker
+    id, so every surviving worker must leave its blocked collectives NOW
+    and rejoin a fresh mesh in-process — waiting out heartbeat timeouts
+    (or the reconnect window) on a peer that will come back as a NEW
+    process would turn a sub-second promotion into a multi-second stall
+    or, worse, a whole-group restart."""
+
+
 def _resolve_secret(secret: bytes | str | None) -> bytes:
     """Shared handshake secret: explicit arg, else PATHWAY_COMM_SECRET
     (``cli spawn`` mints one per run).  Deliberately NOT the run id — the
@@ -301,6 +311,7 @@ class TcpMesh:
         self._cv = threading.Condition()
         self._closed = False
         self._retiring = False  # see retire(): coordinated-teardown mode
+        self._poisoned: str | None = None  # see poison(): promotion rejoin
         self._threads: list[threading.Thread] = []
         self._listener: socket.socket | None = None
         self._acceptor: threading.Thread | None = None
@@ -939,6 +950,7 @@ class TcpMesh:
 
     # -- point to point --------------------------------------------------
     def send(self, dest: int, tag: Hashable, payload: Any) -> None:
+        self._check_poison()
         if dest == self.worker_id:
             # the codec round-trips every value shape exactly (lists stay
             # lists, wrappers stay wrapped), so a self-send can skip it
@@ -959,9 +971,11 @@ class TcpMesh:
         deadline = time.monotonic() + self.reconnect_window + HANDSHAKE_TIMEOUT_S
         with link.cv:
             link.cv.wait_for(
-                lambda: link.ready or link.dead or self._closed,
+                lambda: link.ready or link.dead or self._closed
+                or self._poisoned is not None,
                 timeout=max(0.0, deadline - time.monotonic()),
             )
+            self._check_poison()
             if link.dead:
                 raise CommError(
                     f"worker {self.worker_id}: peer {dest} disconnected "
@@ -1040,6 +1054,7 @@ class TcpMesh:
         deadline = time.monotonic() + timeout
         with self._cv:
             while True:
+                self._check_poison()
                 q = self._inbox.get((src, tag))
                 if q:
                     payload = q.popleft()
@@ -1096,6 +1111,34 @@ class TcpMesh:
     def barrier(self, tag: Hashable) -> None:
         self.gather(("barrier", tag), None)
         self.bcast(("barrier-go", tag))
+
+    def poison(self, reason: str) -> None:
+        """Abandon this mesh: every blocked (and future) ``send``/``recv``
+        — and through them every collective — raises :class:`MeshPoisoned`
+        promptly instead of waiting out link timeouts.
+
+        The worker-side promotion sentinel calls this from its watcher
+        thread when the supervisor posts a PROMOTE request for a dead
+        peer: the epoch loop is parked inside a positionally-paired
+        collective that can never complete (the dead peer will return as
+        a NEW process with a fresh mesh incarnation), so the only correct
+        exit is to unwind, drain-commit the consistent frontier, and
+        rejoin a fresh mesh in-process.  Idempotent; the first reason
+        sticks."""
+        with self._cv:
+            if self._poisoned is not None:
+                return
+            self._poisoned = reason
+            self._cv.notify_all()
+        for link in self._links.values():
+            with link.cv:
+                link.cv.notify_all()
+
+    def _check_poison(self) -> None:
+        if self._poisoned is not None:
+            raise MeshPoisoned(
+                f"worker {self.worker_id}: mesh poisoned: {self._poisoned}"
+            )
 
     def retire(self) -> None:
         """Enter coordinated-teardown mode: this mesh is going away ON

@@ -39,7 +39,9 @@ Plan format (JSON, also accepted as a Python list of dicts)::
         {"kind": "device_hang", "source": "embed", "nth": 1,
          "delay_ms": 10000},
         {"kind": "request_churn", "source": "pw-tiny-decoder", "nth": 3,
-         "count": 6}
+         "count": 6},
+        {"kind": "standby_lag",   "worker": 2, "delay_ms": 400},
+        {"kind": "promote_crash", "worker": 2}
     ]}
 
 Matching rules:
@@ -187,6 +189,21 @@ trace_storm  The request-tracing layer (``engine/tracing.py``): a firing
              drops oldest (``telemetry.export.dropped``) without ever
              blocking the serving path.  ``source`` filters on the
              route path.
+standby_lag  The warm-standby tail loop (``engine/standby.py``): each
+             matching apply tick is DELAYED by ``delay_ms`` before the
+             standby verifies newly committed generations — a cold/
+             starved standby stand-in.  No error and nothing observable
+             to the primaries; only ``standby.lag.s`` (and a promotion's
+             replay tail) grows.  ``worker`` matches the STANDBY id.
+promote_crash  The promotion adoption point (``engine/standby.py``): a
+             standby that just acked a PROMOTE request — the dead
+             worker already fenced, the standby's ack already durable —
+             is SIGKILLed BEFORE it publishes anything as its new
+             worker id.  The narrowest window of the promotion
+             protocol: the supervisor must see the missing boot,
+             abort at the promote deadline, and fall back to the
+             whole-group restart (tier two), with the root left clean.
+             ``worker`` matches the STANDBY id.
 ========== =============================================================
 """
 
@@ -221,6 +238,7 @@ KINDS = (
         "connector_stall", "load_spike", "handoff_crash", "device_stall",
         "device_error", "device_oom", "device_compile_fail", "device_hang",
         "request_flood", "slow_handler", "request_churn", "trace_storm",
+        "standby_lag", "promote_crash",
     )
 )
 
@@ -478,6 +496,45 @@ def maybe_crash_handoff(*, worker: int, to_workers: int) -> None:
         _blackbox.dump(
             f"injected handoff crash (worker {worker}, "
             f"handoff to {to_workers} worker(s))"
+        )
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_standby_lag(*, standby: int) -> None:
+    """Warm-standby lag injection: delay this standby's apply tick by
+    ``delay_ms`` — no error, nothing the primaries can observe.  Only the
+    standby's apply-cursor beacon (``standby.lag.s``) grows, and a
+    promotion that lands during the window has a correspondingly longer
+    uncommitted tail to replay.  ``worker`` in the spec matches the
+    standby id (standbys have no worker id until promoted)."""
+    plan = active_plan()
+    if plan is None or not plan.has("standby_lag"):
+        return
+    spec = plan.check("standby_lag", worker=standby)
+    if spec is not None:
+        _blackbox.record(
+            "fault.standby_lag", standby=standby,
+            delay_ms=spec.delay_ms or 0,
+        )
+        _time.sleep((spec.delay_ms or 0) / 1000.0)
+
+
+def maybe_crash_promote(*, standby: int, worker: int) -> None:
+    """Mid-promotion crash injection: SIGKILL the adopting standby in the
+    narrowest window of the promotion protocol — AFTER its PROMOTE ack is
+    durable and the dead worker's fence is bumped, BEFORE it publishes
+    anything as its new worker id.  The supervisor must detect the
+    standby's death (or the missing boot at the promote deadline), abort
+    the promotion, and fall back to the whole-group restart, leaving the
+    root clean for the tier-two recovery.  ``worker`` in the spec matches
+    the standby id."""
+    plan = active_plan()
+    if plan is None or not plan.has("promote_crash"):
+        return
+    if plan.check("promote_crash", worker=standby) is not None:
+        _blackbox.dump(
+            f"injected promote crash (standby {standby}, adopting "
+            f"worker {worker})"
         )
         os.kill(os.getpid(), signal.SIGKILL)
 

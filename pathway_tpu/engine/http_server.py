@@ -169,6 +169,15 @@ def render_status(
         payload["autoscaler"] = {
             k: v for k, v in scalars.items() if k.startswith("autoscaler.")
         }
+        # the warm-standby panel: pool size, per-standby apply lag, and
+        # promotion history (gauges derived from lease/standby.<sid>
+        # beacons + lease/promotions.json by the collector each
+        # supervised worker registers; absent = no standby pool)
+        payload["standby"] = {
+            k: v
+            for k, v in scalars.items()
+            if k.startswith(("standby.", "supervisor.promotions"))
+        }
         # the serving panel: admission occupancy, latency quantiles, shed/
         # deadline counters and degraded/draining flags (absent = no REST
         # ingress in this pipeline)

@@ -174,6 +174,30 @@ METRICS: dict[str, tuple[str, str]] = {
     "supervisor.handoff.fallbacks": (
         "counter", "live handoffs that faulted mid-flight and fell back "
         "to the restart-based rescale"),
+    # warm-standby promotion (engine/standby.py, engine/supervisor.py)
+    "supervisor.promotions": (
+        "counter", "standby promotions performed (worker loss absorbed "
+        "without a group restart)"),
+    "supervisor.promotion.fallbacks": (
+        "counter", "standby promotions that aborted and fell back to a "
+        "whole-group restart"),
+    "standby.state": (
+        "collector", "warm-standby panel gauge supplier (reads the "
+        "root's lease/standby.<sid> beacons + promotion history): "
+        "standby.pool, standby.lag.s{standby=}, "
+        "standby.verified.chunks{standby=}, supervisor.promotions and "
+        "supervisor.promotions.last.worker"),
+    "standby.pool": (
+        "gauge", "standbys currently publishing an apply-cursor beacon"),
+    "standby.lag.s": (
+        "gauge", "age of the oldest committed generation the standby "
+        "has not yet verified, by standby= (0 = within one commit of "
+        "every shard)"),
+    "standby.verified.chunks": (
+        "gauge", "event-chunks deep-verified by the standby's tail "
+        "loop since it started, by standby="),
+    "supervisor.promotions.last.worker": (
+        "gauge", "worker id adopted by the newest completed promotion"),
     # load-adaptive autoscaler (engine/autoscaler.py)
     "autoscaler.decisions": (
         "counter", "scaling decisions fired (grow + shrink)"),

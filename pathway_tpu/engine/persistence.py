@@ -110,6 +110,14 @@ def writer_incarnation() -> int:
     return env_int(ENV_INCARNATION)
 
 
+def _worker_fence() -> int:
+    """This process's per-worker fence token (``PATHWAY_WORKER_FENCE``);
+    0 for anything but a promoted standby — see bump_worker_fence()."""
+    from pathway_tpu.internals.config import env_int
+
+    return env_int("PATHWAY_WORKER_FENCE")
+
+
 def _decode_lease(raw: bytes | None) -> dict | None:
     """Decode a raw lease blob; None when absent, torn, or malformed."""
     if raw is None:
@@ -353,6 +361,231 @@ def clear_handoff(root: str, workers: int) -> None:
             os.remove(os.path.join(root, *key.split("/")))
         except OSError:
             pass
+
+
+# -- warm-standby promotion coordination files --
+#
+# Unplanned worker loss is coordinated through the same advisory lease/
+# JSON mechanism as the planned handoff above — but the protocol is the
+# mirror image: the group does NOT exit.  On a worker death the
+# supervisor bumps the dead worker's PER-WORKER fence in the lease (so a
+# half-dead writer can never publish again without fencing the whole
+# incarnation — the survivors keep their incarnation and must keep
+# publishing), posts ``lease/PROMOTE``, and waits:
+#
+#   1. the chosen standby acks ``lease/promote.ack.standby``, adopts the
+#      dead worker's id + fence token, and falls into the normal worker
+#      boot path (resume from the dead shard's committed generations);
+#   2. every SURVIVOR's promote sentinel poisons its mesh, drain-commits
+#      its consistent frontier in-process, acks
+#      ``lease/promote.ack.<worker>``, and re-enters the event loop with
+#      a fresh mesh — the OS process never exits;
+#   3. the supervisor sees the complete ack set, records the promotion in
+#      ``lease/promotions.json`` (scrub/top provenance), and clears the
+#      request.  Any other outcome (standby death, missing ack, deadline)
+#      → the two-tier fallback: whole-group restart, exactly as before
+#      standbys existed.
+#
+# Standbys additionally maintain ``lease/standby.<sid>`` apply-cursor
+# beacons (per-worker verified-generation cursors + lag) so operators —
+# and ``pathway_tpu scrub`` — can see how warm the pool is.  All of it is
+# advisory: torn or stale files degrade to "no promotion" and the
+# restart fallback absorbs the loss.
+PROMOTE_KEY = "lease/PROMOTE"
+PROMOTE_ACK_PREFIX = "lease/promote.ack."
+PROMOTIONS_KEY = "lease/promotions.json"
+STANDBY_BEACON_PREFIX = "lease/standby."
+PROMOTE_FORMAT = 1
+
+
+def bump_worker_fence(backend: "BlobBackend", worker: int) -> int:
+    """Fence ``worker`` (and only it) out of the root: bump its entry in
+    the lease's per-worker fence map and return the new token.
+
+    The promoted standby inherits the new token (``PATHWAY_WORKER_FENCE``)
+    and passes ``_check_fence``; anything still writing as this worker
+    with an older token — the dead worker's lingering writer threads, a
+    zombie that was SIGKILLed but whose async publish is still in flight
+    on a remote store — is rejected at its next commit point.  Distinct
+    from the INCARNATION bump a whole-group restart performs: survivors
+    keep publishing under the same incarnation, so promotion must never
+    touch it.  ``acquire_lease`` rebuilds the lease without carrying
+    ``fences`` forward, so a later restart-all clears every per-worker
+    fence along with the incarnation bump that supersedes them."""
+    lease = read_lease(backend)
+    if lease is None:
+        raise CheckpointError(
+            "cannot fence a worker on an unleased root — promotion is a "
+            "supervised-run protocol and the supervisor owns the lease"
+        )
+    fences = dict(lease.get("fences") or {})
+    token = int(fences.get(str(worker), 0)) + 1
+    fences[str(worker)] = token
+    lease["fences"] = fences
+    backend.put_atomic(LEASE_KEY, codec.frame_blob(_json.dumps(lease).encode()))
+    return token
+
+
+def post_promote_request(
+    root: str,
+    *,
+    incarnation: int,
+    worker: int,
+    standby: int,
+    fence: int,
+    seq: int,
+    workers: int,
+    reason: str = "",
+) -> None:
+    """Supervisor side: ask standby ``standby`` to adopt dead ``worker``
+    and every survivor to rejoin the mesh in-process."""
+    _lease_dir_write_json(
+        root,
+        PROMOTE_KEY,
+        {
+            "format": PROMOTE_FORMAT,
+            "incarnation": incarnation,
+            "worker": worker,
+            "standby": standby,
+            "fence": fence,
+            "seq": seq,
+            "workers": workers,
+            "reason": reason,
+            "at": _time.time(),
+        },
+    )
+
+
+def read_promote_request(root: str) -> dict | None:
+    """The pending promotion request, or None when absent/unreadable/not
+    well-formed (advisory: malformed never raises)."""
+    obj = _lease_dir_read_json(root, PROMOTE_KEY)
+    if obj is None or not all(
+        isinstance(obj.get(k), int)
+        for k in ("incarnation", "worker", "standby", "fence", "seq", "workers")
+    ):
+        return None
+    return obj
+
+
+def write_promote_ack(
+    root: str, who: int | str, *, seq: int, worker: int, incarnation: int
+) -> None:
+    """Record participation in promotion ``seq``: ``who`` is a surviving
+    worker id, or the string ``"standby"`` for the adopting standby
+    (written BEFORE it takes the dead worker's id, so the ack never
+    collides with the survivors' numeric files)."""
+    _lease_dir_write_json(
+        root,
+        f"{PROMOTE_ACK_PREFIX}{who}",
+        {
+            "format": PROMOTE_FORMAT,
+            "who": str(who),
+            "seq": seq,
+            "worker": worker,
+            "incarnation": incarnation,
+            "at": _time.time(),
+        },
+    )
+
+
+def read_promote_acks(root: str, workers: int) -> dict[str, dict]:
+    """{who: ack} for every promotion ack present.  Keys are stringified
+    worker ids (survivors), ``"standby"`` (the chosen standby is alive
+    and participating), and ``"adopted"`` (the standby finished waiting
+    for the survivors and took the dead worker's identity — the
+    supervisor's completion trigger, written LAST so clearing the files
+    can never race the standby's own wait)."""
+    out: dict[str, dict] = {}
+    for who in ["standby", "adopted"] + [str(w) for w in range(workers)]:
+        obj = _lease_dir_read_json(root, f"{PROMOTE_ACK_PREFIX}{who}")
+        if obj is not None and obj.get("who") == who:
+            out[who] = obj
+    return out
+
+
+def clear_promote(root: str, workers: int) -> None:
+    """Remove the promotion request and every ack — supervisor side,
+    after a promotion concludes either way."""
+    keys = [
+        PROMOTE_KEY,
+        f"{PROMOTE_ACK_PREFIX}standby",
+        f"{PROMOTE_ACK_PREFIX}adopted",
+    ] + [f"{PROMOTE_ACK_PREFIX}{w}" for w in range(workers)]
+    for key in keys:
+        try:
+            os.remove(os.path.join(root, *key.split("/")))
+        except OSError:
+            pass
+
+
+_PROMOTIONS_CAP = 64
+
+
+def append_promotion(root: str, record: dict) -> None:
+    """Append one promotion record to the root's bounded promotion
+    history (``lease/promotions.json``) — the provenance ``pathway_tpu
+    scrub``/``top`` render and the workers re-export as the
+    ``supervisor.promotions`` counter."""
+    history = read_promotions(root)
+    history.append(record)
+    _lease_dir_write_json(
+        root, PROMOTIONS_KEY, {"promotions": history[-_PROMOTIONS_CAP:]}
+    )
+
+
+def read_promotions(root: str) -> list[dict]:
+    """The root's promotion history, oldest first; [] when absent/torn."""
+    obj = _lease_dir_read_json(root, PROMOTIONS_KEY)
+    if obj is None or not isinstance(obj.get("promotions"), list):
+        return []
+    return [p for p in obj["promotions"] if isinstance(p, dict)]
+
+
+def write_standby_beacon(
+    root: str,
+    standby: int,
+    *,
+    cursors: dict[int, int],
+    lag_s: float,
+    verified_chunks: int,
+    pid: int | None = None,
+) -> None:
+    """Standby side: publish this standby's apply cursor — the newest
+    verified generation per worker shard — plus its apply lag."""
+    _lease_dir_write_json(
+        root,
+        f"{STANDBY_BEACON_PREFIX}{standby}",
+        {
+            "format": PROMOTE_FORMAT,
+            "standby": standby,
+            "cursors": {str(w): g for w, g in cursors.items()},
+            "lag_s": lag_s,
+            "verified_chunks": verified_chunks,
+            "pid": pid if pid is not None else os.getpid(),
+            "at": _time.time(),
+        },
+    )
+
+
+def read_standby_beacons(root: str) -> dict[int, dict]:
+    """{standby id: beacon} for every well-formed standby apply-cursor
+    beacon under the root's lease/ directory."""
+    lease_dir = os.path.join(root, "lease")
+    prefix = STANDBY_BEACON_PREFIX.rsplit("/", 1)[-1]
+    out: dict[int, dict] = {}
+    try:
+        names = os.listdir(lease_dir)
+    except OSError:
+        return out
+    for name in names:
+        tail = name[len(prefix):]
+        if not name.startswith(prefix) or not tail.isdigit():
+            continue
+        obj = _lease_dir_read_json(root, f"lease/{name}")
+        if obj is not None and obj.get("standby") == int(tail):
+            out[int(tail)] = obj
+    return out
 
 
 _BASE_SID_RE = None
@@ -1586,6 +1819,11 @@ class PersistentStorage:
         # run, fencing disabled).  Every commit-point write re-checks the
         # on-root lease against it — see FencedError.
         self.incarnation = writer_incarnation()
+        # this writer's per-worker fence token (warm-standby promotion):
+        # a promoted standby carries the token bump_worker_fence() minted
+        # when its predecessor died; the predecessor's zombie writes carry
+        # the older token and are rejected at their next commit point
+        self.worker_fence = _worker_fence()
         # generational recovery state, filled by _load_state(): the adopted
         # (verified) generation, the generations rejected on the way down,
         # and whether we resumed from a pre-manifest legacy metadata file
@@ -1738,26 +1976,53 @@ class PersistentStorage:
         if self.incarnation <= 0:
             return
         lease = read_lease(self.backend)
-        if lease is None or lease["incarnation"] <= self.incarnation:
+        if lease is None:
             return
-        _registry.get_registry().counter(
-            "persistence.fenced",
-            "commit-point writes rejected because a newer incarnation "
-            "owns the root",
-            worker=self.worker,
-        ).inc()
-        _blackbox.record(
-            "persistence.fenced", worker=self.worker, what=what,
-            incarnation=self.incarnation, lease=lease["incarnation"],
-        )
-        raise FencedError(
-            f"persistence: worker {self.worker} of incarnation "
-            f"{self.incarnation} is fenced off {self.backend.describe()}: "
-            f"the lease shows incarnation {lease['incarnation']} — a newer "
-            f"cluster incarnation owns this root; refusing to {what} "
-            "(this process is a zombie from a superseded restart attempt "
-            "and must terminate)"
-        )
+        if lease["incarnation"] > self.incarnation:
+            _registry.get_registry().counter(
+                "persistence.fenced",
+                "commit-point writes rejected because a newer incarnation "
+                "owns the root",
+                worker=self.worker,
+            ).inc()
+            _blackbox.record(
+                "persistence.fenced", worker=self.worker, what=what,
+                incarnation=self.incarnation, lease=lease["incarnation"],
+            )
+            raise FencedError(
+                f"persistence: worker {self.worker} of incarnation "
+                f"{self.incarnation} is fenced off {self.backend.describe()}: "
+                f"the lease shows incarnation {lease['incarnation']} — a "
+                f"newer cluster incarnation owns this root; refusing to "
+                f"{what} (this process is a zombie from a superseded restart "
+                "attempt and must terminate)"
+            )
+        # per-worker fence (same incarnation): a warm-standby promotion
+        # fenced this worker id specifically — bump_worker_fence() minted
+        # a newer token for the promoted standby, and any writer still
+        # carrying the older token is the dead worker's zombie
+        fences = lease.get("fences") or {}
+        fence = fences.get(str(self.worker), 0)
+        if isinstance(fence, int) and fence > self.worker_fence:
+            _registry.get_registry().counter(
+                "persistence.fenced",
+                "commit-point writes rejected because a newer incarnation "
+                "owns the root",
+                worker=self.worker,
+            ).inc()
+            _blackbox.record(
+                "persistence.fenced", worker=self.worker, what=what,
+                incarnation=self.incarnation, worker_fence=self.worker_fence,
+                lease_fence=fence,
+            )
+            raise FencedError(
+                f"persistence: worker {self.worker} (fence token "
+                f"{self.worker_fence}) is fenced off "
+                f"{self.backend.describe()}: the lease carries per-worker "
+                f"fence {fence} — a standby was promoted into this worker "
+                f"id; refusing to {what} (this process is the dead "
+                "worker's zombie and must terminate)"
+            )
 
     def fence_for_handoff(self, to_workers: int) -> None:
         """Enter the handoff fence: the NEXT commit is the handoff commit
@@ -3385,6 +3650,54 @@ def scrub_root(
             lease_report["handoff"] = {
                 "pending_request": HANDOFF_KEY in all_keys,
                 "acks": handoff_acks,
+            }
+        # warm-standby residue: apply-cursor beacons, the promotion
+        # history, per-worker fences, and any PROMOTE request/acks a
+        # crash left behind.  All advisory (never a failure): a standby
+        # that stops beaconing just means the pool is cold, and the
+        # supervisor clears a stale PROMOTE on relaunch.
+        standbys: dict[int, dict[str, Any]] = {}
+        for key in all_keys:
+            tail = key.rsplit(".", 1)[-1]
+            if not key.startswith(STANDBY_BEACON_PREFIX) or not tail.isdigit():
+                continue
+            try:
+                beacon = _json.loads((backend.get(key) or b"").decode())
+            except (ValueError, AttributeError):
+                continue  # torn beacon: the next tick rewrites it
+            if isinstance(beacon, dict):
+                standbys[int(tail)] = {
+                    "lag_s": beacon.get("lag_s"),
+                    "cursors": beacon.get("cursors"),
+                    "verified_chunks": beacon.get("verified_chunks"),
+                    "at": beacon.get("at"),
+                }
+        if standbys:
+            lease_report["standbys"] = standbys
+        if PROMOTIONS_KEY in all_keys:
+            try:
+                hist = _json.loads(
+                    (backend.get(PROMOTIONS_KEY) or b"").decode()
+                )
+            except (ValueError, AttributeError):
+                hist = None
+            if isinstance(hist, dict) and isinstance(
+                hist.get("promotions"), list
+            ):
+                lease_report["promotions"] = hist["promotions"]
+        if lease_report.get("ok") and lease_raw is not None:
+            lease_obj = _decode_lease(lease_raw)
+            if lease_obj is not None and lease_obj.get("fences"):
+                lease_report["fences"] = lease_obj["fences"]
+        promote_acks = sorted(
+            k[len(PROMOTE_ACK_PREFIX):]
+            for k in all_keys
+            if k.startswith(PROMOTE_ACK_PREFIX)
+        )
+        if PROMOTE_KEY in all_keys or promote_acks:
+            lease_report["promote"] = {
+                "pending_request": PROMOTE_KEY in all_keys,
+                "acks": promote_acks,
             }
         report["lease"] = lease_report
     # -- flight-recorder dump audit (best-effort, never fails the root) --

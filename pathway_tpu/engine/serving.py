@@ -364,6 +364,19 @@ class AdmissionController:
     def set_pressure_supplier(self, fn: Callable[[], float] | None) -> None:
         self._pressure = fn
 
+    def oldest_outstanding_age_s(self, now: float | None = None) -> float:
+        """Age (seconds) of the oldest admitted request still unanswered;
+        0.0 when nothing is outstanding.  The shedder clamps its staleness
+        pressure signal to this (idleness is not overload), and the
+        default staleness SLO shares the same clamp (idleness is not
+        burn) — see ``engine/slo.py``."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            if not self._outstanding:
+                return 0.0
+            return max(0.0, now - min(self._outstanding.values()))
+
     # -- admission ---------------------------------------------------------
 
     def _has_capacity_locked(self, nbytes: int) -> bool:
@@ -895,6 +908,44 @@ def ready_for_handoff() -> bool:
         return True
     c.begin_drain()
     return c.drain_ready()
+
+
+def fail_inflight_for_promotion() -> int:
+    """A peer died and this worker is unwinding its mesh for an
+    in-process promotion rejoin: every registered in-flight request is
+    waiting on epochs the poisoned mesh will never run.  Answer them all
+    NOW with the typed 503 retry signal — a well-behaved client retries
+    after promotion completes (sub-second) instead of timing out across
+    the rejoin — and park new arrivals behind the drain gate until
+    :func:`resume_after_promotion` re-opens admission.  Returns the
+    number of requests answered."""
+    c = _controller
+    if c is not None:
+        c.begin_drain()
+    with _requests_lock:
+        keys = list(_requests)
+    failed = 0
+    for key in keys:
+        if fail_request(
+            key, 503,
+            "standby promotion in progress on this worker group; retry",
+        ):
+            failed += 1
+    if failed:
+        metrics_mod.get_registry().counter(
+            "serve.shed", "requests shed before pipeline work",
+            reason="promotion",
+        ).inc(failed)
+    return failed
+
+
+def resume_after_promotion() -> None:
+    """Re-open admission after a promotion rejoin (the ``run()`` wrapper
+    calls this between mesh lifetimes; the controller is process-global
+    and survives the rejoin, so its drain gate must be reset here)."""
+    c = _controller
+    if c is not None:
+        c.end_drain()
 
 
 def reset_for_tests() -> None:

@@ -76,6 +76,28 @@ DEFAULT_DECLARATIONS = (
     "staleness: output.staleness.s p95 < 5s over 5m"
 )
 
+# objectives over this family are serving-path staleness objectives:
+# their input is clamped to the oldest outstanding admitted request age
+# (the admission shedder's clamp) so an idle pipeline's frozen watermark
+# never reads as burn — see _evaluate_one
+STALENESS_METRIC = "output.staleness.s"
+
+
+def _oldest_outstanding_age_s() -> float | None:
+    """Age of the oldest admitted-but-unanswered serving request: 0.0
+    when the serving path is live but idle, None when no admission
+    controller exists in this process (a batch/non-serving pipeline —
+    staleness then keeps its plain watermark meaning, unclamped)."""
+    from pathway_tpu.engine import serving as _serving
+
+    c = _serving.controller_if_active()
+    if c is None:
+        return None
+    try:
+        return c.oldest_outstanding_age_s()
+    except Exception:  # noqa: BLE001 - the evaluator must never break a scrape
+        return None
+
 _DECL_RE = re.compile(
     r"""
     ^\s*(?P<name>[A-Za-z0-9_.-]+)\s*:\s*
@@ -362,6 +384,20 @@ class SLOEvaluator:
         counts = self._histogram_counts(slo)
         if counts is None:
             value = self._gauge_value(slo, scalars)
+            if value is not None and slo.metric == STALENESS_METRIC:
+                # an idle gap also grows output staleness (no input →
+                # frozen watermark), and idleness is not an SLO breach:
+                # when a serving admission controller is live the
+                # staleness objective shares its clamp — it counts only
+                # while an admitted request has actually been
+                # outstanding that long (0 when the serving path is
+                # idle), so sparse/idle pipelines stop burning budget
+                # under the defaults.  Without a controller (batch or
+                # non-serving pipelines) staleness keeps its plain
+                # watermark meaning, unclamped.
+                oldest = _oldest_outstanding_age_s()
+                if oldest is not None:
+                    value = min(value, oldest)
             if value is not None:
                 st.sample_total += 1.0
                 if value > slo.threshold:

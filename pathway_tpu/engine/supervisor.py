@@ -30,6 +30,27 @@ Sinks re-open their output files on restart, so the recovered run's
 final output is identical to an unfaulted run's — the property the
 kill-and-restart test in ``tests/test_supervised_recovery.py`` pins.
 
+Whole-group restart is the **fallback tier**.  With warm standbys armed
+(``spawn --supervise --standbys K`` / ``PATHWAY_STANDBY_COUNT``) the
+supervisor first tries a **standby promotion** (``engine/standby.py``):
+K extra processes tail the persistence root, deep-verifying every newly
+committed generation, so each standby stays within one commit of any
+shard.  On a worker death the supervisor bumps the dead worker's
+per-worker fence token (``bump_worker_fence`` — the dead worker's
+zombie can never publish again), posts a PROMOTE request on the root,
+and the chosen standby adopts the dead worker's identity while the
+SURVIVORS NEVER RESTART: each survivor's promote-watch poisons its mesh
+(``TcpMesh.poison``), drains to a consistent commit, and rejoins a
+fresh mesh in-process (``internals/runner.py``).  Only the dead
+worker's uncommitted tail is replayed — sub-second where the restart
+tier pays backoff plus a full N-worker resume.  A promotion that cannot
+start (no live standby, no root/lease, spent
+``PATHWAY_STANDBY_PROMOTIONS`` budget) or that faults mid-flight
+(standby death, a second worker death, a blown
+``PATHWAY_STANDBY_PROMOTE_DEADLINE_S``) falls back to the whole-group
+restart below — the two-tier recovery contract
+``tests/test_standby_promotion.py`` pins.
+
 Restarted workers do not trust the newest checkpoint blindly: the
 persistence layer (``engine/persistence.py``) verifies each generation's
 integrity frames + digests and falls back generation-by-generation to
@@ -130,7 +151,7 @@ class SupervisorError(RuntimeError):
 class SupervisorResult:
     __slots__ = (
         "attempts", "restarts", "exit_codes", "history", "recovery",
-        "last_failure", "post_mortem", "rescales",
+        "last_failure", "post_mortem", "rescales", "promotions",
     )
 
     def __init__(
@@ -143,6 +164,7 @@ class SupervisorResult:
         last_failure: str | None = None,
         post_mortem: dict | None = None,
         rescales: list[dict] | None = None,
+        promotions: list[dict] | None = None,
     ):
         self.attempts = attempts  # launches performed (>= 1)
         self.restarts = restarts  # recoveries performed (attempts - 1)
@@ -171,6 +193,12 @@ class SupervisorResult:
         # by this run — {"from", "to", "lost_worker", "attempt", "reason"}.
         # Empty for a run that never lost a worker permanently.
         self.rescales = rescales or []
+        # warm-standby promotion provenance: one entry per COMPLETED
+        # promotion — {"worker", "standby", "seq", "fence", "attempt",
+        # "duration_s", "reason"}.  A worker loss absorbed here never
+        # shows up in ``restarts``; aborted promotions fall back to the
+        # restart tier and are counted there instead.
+        self.promotions = promotions or []
 
     def __repr__(self) -> str:  # pragma: no cover - debugging aid
         return (
@@ -361,6 +389,7 @@ class Supervisor:
         epoch_deadline_s: float | None = None,
         shrink_on_loss: bool | None = None,
         autoscale: bool | None = None,
+        standbys: int | None = None,
     ):
         self.spawn = spawn
         self.n_workers = n_workers
@@ -371,7 +400,7 @@ class Supervisor:
         # fallback).  None reads the PATHWAY_AUTOSCALE knob.  Needs a
         # checkpoint root — both the sensor feed (lease/load.<w>) and the
         # actuator (lease/HANDOFF + repartition resume) live there.
-        from pathway_tpu.internals.config import env_bool, env_float
+        from pathway_tpu.internals.config import env_bool, env_float, env_int
 
         if autoscale is None:
             autoscale = env_bool("PATHWAY_AUTOSCALE")
@@ -402,6 +431,28 @@ class Supervisor:
         self.shrink_on_loss = bool(shrink_on_loss)
         # rescale provenance (mirrored onto SupervisorResult.rescales)
         self.rescales: list[dict] = []
+        # warm-standby pool (tier-one recovery): K extra processes tail
+        # the persistence root (engine/standby.py) so a worker death can
+        # be absorbed by promoting one instead of restarting the group.
+        # None reads the PATHWAY_STANDBY_COUNT knob; needs a checkpoint
+        # root (the PROMOTE protocol and the tail both live there).
+        if standbys is None:
+            standbys = env_int("PATHWAY_STANDBY_COUNT")
+        self.standbys = max(0, int(standbys or 0))
+        self.promote_deadline_s = env_float(
+            "PATHWAY_STANDBY_PROMOTE_DEADLINE_S"
+        )
+        self.max_promotions = env_int("PATHWAY_STANDBY_PROMOTIONS")
+        # completed-promotion provenance (mirrored onto
+        # SupervisorResult.promotions and the root's promotion history)
+        self.promotions: list[dict] = []
+        self._standby_handles: dict[int, Any] = {}
+        # the in-flight promotion's bookkeeping (None when idle) — also
+        # the autoscaler gate: no scale decisions while a shard changes
+        # owners via promotion
+        self._promotion: dict[str, Any] | None = None
+        self._promote_seq = 0
+        self._attempt = 0
         # does the spawn callback accept the CURRENT cluster size?  A
         # shrink changes n_workers between attempts, and the spawner must
         # export the new PATHWAY_PROCESSES; two-arg spawners (fixed-size
@@ -649,6 +700,216 @@ class Supervisor:
             return self.spawn(worker_id, attempt, n_workers=self.n_workers)
         return self.spawn(worker_id, attempt)
 
+    def _spawn_standbys(self, attempt: int) -> None:
+        """(Re)fill the warm-standby pool: any missing/dead standby is
+        respawned through the ordinary spawn callback with an id above
+        the worker range; the ``PATHWAY_STANDBY_ID`` export (same
+        env-export trick as the incarnation) flips the process into the
+        tail loop (``engine/standby.py``) instead of the worker boot
+        path.  Standbys are per-incarnation — a restart-all stops and
+        respawns them so their inherited ``PATHWAY_INCARNATION`` matches
+        the new lease and they honor its PROMOTE requests."""
+        if not self.standbys or not self.checkpoint_root:
+            return
+        for sid in range(self.standbys):
+            handle = self._standby_handles.get(sid)
+            if handle is not None and _alive(handle):
+                continue
+            os.environ["PATHWAY_STANDBY_ID"] = str(sid)
+            try:
+                self._standby_handles[sid] = self._spawn_one(
+                    self.n_workers + sid, attempt
+                )
+            except Exception as exc:  # noqa: BLE001 - a missing standby
+                # only narrows recovery to the restart tier; never fail
+                # the run for it
+                _log.warning("could not spawn standby %d: %s", sid, exc)
+            finally:
+                os.environ.pop("PATHWAY_STANDBY_ID", None)
+
+    def _stop_standbys(self) -> None:
+        handles = list(self._standby_handles.values())
+        self._standby_handles.clear()
+        if handles:
+            self._stop_all(handles)
+
+    def _begin_promotion(
+        self, wid: int, handles: Sequence[Any]
+    ) -> dict[str, Any] | None:
+        """Tier-one recovery: try to hand dead worker ``wid``'s shard to
+        a warm standby.  Bumps the dead worker's per-worker fence (its
+        zombie can never publish again) and posts the PROMOTE request
+        the standby and the survivors coordinate on.  Returns the
+        in-flight promotion's bookkeeping, or None when promotion cannot
+        start — no root/lease, no live standby, spent promotion budget —
+        in which case the caller takes the restart tier."""
+        if not self.checkpoint_root or self.incarnation is None:
+            return None
+        if len(self.promotions) >= self.max_promotions:
+            _log.warning(
+                "promotion budget spent (%d); worker %d's death takes "
+                "the restart tier", self.max_promotions, wid,
+            )
+            return None
+        live = {
+            sid: h for sid, h in self._standby_handles.items() if _alive(h)
+        }
+        if not live:
+            return None
+        from pathway_tpu.engine import persistence as pz
+
+        try:
+            beacons = pz.read_standby_beacons(self.checkpoint_root)
+        except Exception:  # noqa: BLE001 - advisory files, never fatal
+            beacons = {}
+        # freshest standby first: the smallest published apply lag means
+        # the least uncommitted tail to replay (no beacon sorts last)
+        sid = min(
+            live,
+            key=lambda s: (
+                s not in beacons,
+                float(beacons.get(s, {}).get("lag_s") or 0.0),
+                s,
+            ),
+        )
+        reason = (
+            f"worker {wid} exited {_exitcode(handles[wid])} on attempt "
+            f"{self._attempt}"
+        )
+        try:
+            fence = pz.bump_worker_fence(
+                pz.FileBackend(self.checkpoint_root), wid
+            )
+            self._promote_seq += 1
+            seq = self._promote_seq
+            pz.post_promote_request(
+                self.checkpoint_root,
+                incarnation=self.incarnation,
+                worker=wid,
+                standby=sid,
+                fence=fence,
+                seq=seq,
+                workers=self.n_workers,
+                reason=reason,
+            )
+        except Exception as exc:  # noqa: BLE001 - unleased/read-only root
+            _log.warning(
+                "could not post a promotion for worker %d (%s); taking "
+                "the restart tier", wid, exc,
+            )
+            return None
+        now = time.monotonic()
+        _log.warning(
+            "%s — promoting standby %d into its place (promotion %d, "
+            "fence %d, deadline %.1fs); survivors rejoin in place",
+            reason, sid, seq, fence, self.promote_deadline_s,
+        )
+        return {
+            "worker": wid,
+            "standby": sid,
+            "handle": live[sid],
+            "seq": seq,
+            "fence": fence,
+            "reason": reason,
+            "started": now,
+            "deadline": now + self.promote_deadline_s,
+        }
+
+    def _poll_promotion(
+        self, promo: dict[str, Any], handles: list[Any]
+    ) -> dict[str, Any] | None:
+        """One watch-loop poll of the in-flight promotion.  Returns None
+        once the standby has adopted (its handle is swapped into the dead
+        worker's slot and the pool refilled); returns ``promo`` while
+        still pending, with ``promo["failed"]`` set after an abort
+        (standby death, blown deadline) — the caller then routes the
+        original death through the restart tier."""
+        from pathway_tpu.engine import persistence as pz
+
+        now = time.monotonic()
+        try:
+            acks = pz.read_promote_acks(self.checkpoint_root, self.n_workers)
+        except Exception:  # noqa: BLE001 - advisory files, never fatal
+            acks = {}
+        adopted = acks.get("adopted")
+        if adopted is not None and adopted.get("seq") == promo["seq"]:
+            # the adopted marker is written strictly after the standby's
+            # survivor wait, so clearing the coordination files here can
+            # never race the standby's own reads of them
+            wid, sid = promo["worker"], promo["standby"]
+            handles[wid] = promo["handle"]
+            self._standby_handles.pop(sid, None)
+            record = {
+                "worker": wid,
+                "standby": sid,
+                "seq": promo["seq"],
+                "fence": promo["fence"],
+                "attempt": self._attempt,
+                "duration_s": round(now - promo["started"], 3),
+                "reason": promo["reason"],
+            }
+            self.promotions.append(record)
+            try:
+                pz.append_promotion(self.checkpoint_root, record)
+                pz.clear_promote(self.checkpoint_root, self.n_workers)
+            except Exception:  # noqa: BLE001 - advisory files
+                pass
+            _metrics.get_registry().counter(
+                "supervisor.promotions",
+                "standby promotions performed (worker loss absorbed "
+                "without a group restart)",
+            ).inc()
+            _log.warning(
+                "standby %d adopted worker %d in %.3fs (%s); the group "
+                "never restarted", sid, wid, record["duration_s"],
+                promo["reason"],
+            )
+            self._spawn_standbys(self._attempt)  # refill the pool
+            return None
+        abort = None
+        standby_code = _exitcode(promo["handle"])
+        if standby_code is not None:
+            abort = (
+                f"standby {promo['standby']} died mid-promotion "
+                f"(exit {standby_code})"
+            )
+        elif now >= promo["deadline"]:
+            abort = (
+                f"not adopted within {self.promote_deadline_s:.1f}s"
+            )
+        if abort is not None:
+            self._abort_promotion(promo, abort)
+            promo["failed"] = abort
+        return promo
+
+    def _abort_promotion(self, promo: dict[str, Any], why: str) -> None:
+        """Fall from the promotion tier to the restart tier: kill the
+        chosen standby (it may be mid-adoption holding the dead worker's
+        identity) and clear the coordination files so nothing half-done
+        outlives the abort.  The bumped fence needs no undo — the next
+        attempt's ``acquire_lease`` rewrites the lease without it."""
+        from pathway_tpu.engine import persistence as pz
+
+        _metrics.get_registry().counter(
+            "supervisor.promotion.fallbacks",
+            "standby promotions that aborted and fell back to a "
+            "whole-group restart",
+        ).inc()
+        _log.warning(
+            "promotion %d (standby %d -> worker %d) aborted: %s; "
+            "falling back to a whole-group restart",
+            promo["seq"], promo["standby"], promo["worker"], why,
+        )
+        handle = promo["handle"]
+        if _alive(handle):
+            _signal(handle, hard=True)
+            _join(handle, 2.0)
+        self._standby_handles.pop(promo["standby"], None)
+        try:
+            pz.clear_promote(self.checkpoint_root, self.n_workers)
+        except Exception:  # noqa: BLE001 - advisory files, never fatal
+            pass
+
     def run(self) -> SupervisorResult:
         delays = self._backoff_delays()
         history: list[list[int | None]] = []
@@ -680,6 +941,11 @@ class Supervisor:
         try:
             while True:
                 self._acquire_incarnation(attempt)
+                self._attempt = attempt
+                # the standby pool is per-incarnation: spawned after the
+                # lease bump so each standby inherits THIS attempt's
+                # PATHWAY_INCARNATION and honors its PROMOTE requests
+                self._spawn_standbys(attempt)
                 handles = []
                 spawn_failure: tuple[int, BaseException] | None = None
                 for w in range(self.n_workers):
@@ -759,6 +1025,7 @@ class Supervisor:
                         recovery=recovery, last_failure=last_failure,
                         post_mortem=self._post_mortem(),
                         rescales=list(self.rescales),
+                        promotions=list(self.promotions),
                     )
                 hang = self._hangs.get(first_failed)
                 if spawn_failure is not None:
@@ -797,6 +1064,7 @@ class Supervisor:
                     )
                     _log.warning("%s", last_failure)
                     self._stop_all(handles)
+                    self._stop_standbys()
                     self._settle_checkpoints()
                     codes = [_exitcode(h) for h in handles]
                     codes += [None] * (self.n_workers - len(codes))
@@ -823,6 +1091,9 @@ class Supervisor:
                     first_failed, last_failure, attempt,
                 )
                 self._stop_all(handles)
+                # standbys are per-incarnation: stop them too so the next
+                # attempt's respawn hands them the bumped incarnation
+                self._stop_standbys()
                 # every worker process is dead: in-flight async commits are
                 # drained by construction, so settle their residue on the
                 # root BEFORE this attempt is accounted and the respawn
@@ -907,6 +1178,7 @@ class Supervisor:
             # (they would wait on mesh peers forever); redundant stops of
             # already-exited workers are no-ops
             self._stop_all(handles)
+            self._stop_standbys()
             # do not leak THIS run's incarnation into the host process:
             # later (unsupervised) runs in the same process would stamp
             # and fence against a lease they do not participate in
@@ -934,6 +1206,7 @@ class Supervisor:
             else None
         )
         self._handoff_outcome = None
+        self._promotion = None
         controller = self._controller
         pending: dict[str, Any] | None = None
         if controller is not None:
@@ -942,9 +1215,15 @@ class Supervisor:
             controller.handoff_state = ""
         while True:
             all_done = True
+            promo = self._promotion
             for wid, handle in enumerate(handles):
                 code = _exitcode(handle)
                 if code is None:
+                    all_done = False
+                elif promo is not None and wid == promo["worker"]:
+                    # tier-one recovery in flight for this very death:
+                    # the dead handle stays in its slot until the chosen
+                    # standby adopts (or the promotion aborts below)
                     all_done = False
                 elif code != 0:
                     if pending is not None:
@@ -952,14 +1231,47 @@ class Supervisor:
                         # all-or-nothing, so fall back to a restart rescale
                         pending["kind"] = "fallback"
                         self._handoff_outcome = pending
-                    return wid
+                        return wid
+                    if promo is not None:
+                        # a SECOND death while a promotion drains: the
+                        # survivors' rejoin can never complete — abort
+                        # the promotion, take the restart tier for both
+                        self._abort_promotion(
+                            promo,
+                            f"worker {wid} also died (exit {code}) while "
+                            f"promotion {promo['seq']} was in flight",
+                        )
+                        self._promotion = None
+                        return wid
+                    # a death with no handoff pending: try the promotion
+                    # tier first; only when it cannot start does the
+                    # death surface to run()'s restart machinery
+                    self._promotion = promo = self._begin_promotion(
+                        wid, handles
+                    )
+                    if promo is None:
+                        return wid
+                    all_done = False
             if all_done:
                 if pending is not None:
                     self._classify_handoff_exit(pending)
                 return None
+            if promo is not None:
+                promo = self._poll_promotion(promo, handles)
+                if promo is not None and promo.get("failed"):
+                    self._promotion = None
+                    return promo["worker"]
+                self._promotion = promo
             if watchdog is not None:
                 watchdog.poll(handles)
-            if controller is not None and self.incarnation is not None:
+            if (
+                controller is not None
+                and self.incarnation is not None
+                # no scale decisions while a shard changes owners via
+                # promotion: the two actuators share the worker set and
+                # must not interleave (the race tests pin both orders)
+                and self._promotion is None
+            ):
                 pending = self._autoscale_tick(controller, pending)
                 if pending is not None and pending.get("expired"):
                     # deadline blown: a worker is wedged mid-drain.

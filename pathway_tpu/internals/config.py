@@ -221,6 +221,33 @@ ENV_KNOBS: tuple[EnvKnob, ...] = (
        "attempt of a spent restart budget, rescale the supervised cluster "
        "to the surviving count instead of failing — checkpointed state "
        "re-partitions by shard range on resume", "supervisor"),
+    _k("PATHWAY_STANDBY_COUNT", "int", 0,
+       "warm-standby pool size (opt-in, `spawn --supervise --standbys`): "
+       "K extra processes tail the persistence root so unplanned worker "
+       "loss promotes a standby instead of restarting the group",
+       "supervisor"),
+    _k("PATHWAY_STANDBY_ID", "int", None,
+       "exported by the supervisor into each standby process; its "
+       "presence is what routes a spawned worker into standby-tailer "
+       "mode instead of the event loop", "supervisor"),
+    _k("PATHWAY_STANDBY_POLL_S", "float", 0.2,
+       "standby tail cadence: how often a standby re-lists manifests, "
+       "verifies newly committed generations, and refreshes its "
+       "apply-cursor beacon", "supervisor"),
+    _k("PATHWAY_STANDBY_PROMOTE_DEADLINE_S", "float", 20.0,
+       "promotion deadline: if the standby + every survivor have not "
+       "acked the PROMOTE request within this budget, the supervisor "
+       "aborts the promotion and falls back to whole-group restart",
+       "supervisor"),
+    _k("PATHWAY_STANDBY_PROMOTIONS", "int", 8,
+       "per-run promotion budget (separate from the restart budget): "
+       "once spent, further worker deaths fall back to whole-group "
+       "restart", "supervisor"),
+    _k("PATHWAY_WORKER_FENCE", "int", 0,
+       "per-worker fence token (exported by the supervisor to a promoted "
+       "standby): commit-point writes carrying an older token than the "
+       "lease's fence map are the dead worker's zombie and are rejected",
+       "persistence"),
     # -- autoscaler (engine/autoscaler.py) ----------------------------------
     _k("PATHWAY_AUTOSCALE", "bool", False,
        "load-adaptive autoscaling (opt-in): the supervisor polls worker "

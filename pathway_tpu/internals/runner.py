@@ -120,7 +120,66 @@ def _wire_operator_persistence(scope: df.Scope, storage: Any) -> None:
     storage.confirm_operator_commit = confirm
 
 
-def run(
+def run(**kwargs: Any) -> RunResult:
+    """``pw.run`` — execute every registered sink to completion.
+
+    ``_sinks`` (internal) runs an explicit sink list instead of the
+    graph's registry — ``Table.live()`` uses it to run one export sink's
+    cone on a background thread while the interactive graph stays open
+    (the reference's ``runner.run_nodes([operator])``).
+
+    Two supervised-run detours wrap the single execution
+    (:func:`_run_once`); both are inert for ordinary runs:
+
+    * **standby mode** (``PATHWAY_STANDBY_ID`` exported by the
+      supervisor): instead of joining the mesh, the process tails the
+      persistence root (``engine/standby.py``) until the supervisor
+      either stops it or PROMOTES it into a dead worker's id — at which
+      point it falls through into the normal worker path below, already
+      wearing the dead worker's identity.
+    * **promotion rejoin**: when a PEER dies and a standby is being
+      promoted, this worker's mesh is poisoned
+      (:class:`~pathway_tpu.engine.comm.MeshPoisoned`) so the run
+      unwinds through its normal consistent drain-commit — and then,
+      instead of exiting for a whole-group restart, the loop here acks
+      the promotion and re-enters ``_run_once`` in-process: fresh mesh,
+      fresh graph, zero process-spawn cost, surviving workers never
+      restart.
+    """
+    from pathway_tpu.engine import standby as _standby
+
+    sid = _standby.standby_id()
+    if sid is not None:
+        root = _persistence_root(kwargs.get("persistence_config"))
+        if root is None:
+            raise RuntimeError(
+                "standby mode (PATHWAY_STANDBY_ID) requires a filesystem "
+                "persistence root to tail — spawn with --checkpoints"
+            )
+        if _standby.standby_main(root, sid) is None:
+            return RunResult()  # supervisor shutdown before any promotion
+        # promoted: this process adopted the dead worker's identity; fall
+        # through into the normal worker path
+    while True:
+        try:
+            return _run_once(**kwargs)
+        except BaseException as exc:
+            from pathway_tpu.engine.comm import CommError, MeshPoisoned
+
+            # a CommError on a dead peer counts as the poison signal when
+            # a promotion naming this incarnation is pending: the link
+            # heartbeat and the supervisor race to notice the death, and
+            # losing that race must not demote a promotion to a restart
+            if not isinstance(exc, MeshPoisoned) and not (
+                isinstance(exc, CommError)
+                and _pending_promotion(kwargs.get("persistence_config"))
+                is not None
+            ):
+                raise
+            _promotion_rejoin(kwargs.get("persistence_config"))
+
+
+def _run_once(
     *,
     debug: bool = False,
     monitoring_level: Any = None,
@@ -133,13 +192,8 @@ def run(
     _sinks: list | None = None,
     **kwargs: Any,
 ) -> RunResult:
-    """``pw.run`` — execute every registered sink to completion.
-
-    ``_sinks`` (internal) runs an explicit sink list instead of the
-    graph's registry — ``Table.live()`` uses it to run one export sink's
-    cone on a background thread while the interactive graph stays open
-    (the reference's ``runner.run_nodes([operator])``).
-    """
+    """One mesh lifetime of ``pw.run`` — see :func:`run` for the
+    standby/promotion wrapper that may call this more than once."""
     scope = df.Scope()
     scope.terminate_on_error = terminate_on_error
 
@@ -228,6 +282,7 @@ def run(
     persist_root = None  # filesystem persistence root, when there is one
     prev_usr1 = None
     usr1_installed = False
+    promote_watcher = None
     try:
         if storage is not None:
             from pathway_tpu.engine import faults as _faults
@@ -345,6 +400,24 @@ def run(
             _blackbox.get_recorder().set_autoscaler_supplier(
                 lambda: _autoscaler.read_state_file(_as_root)
             )
+            # warm-standby panel: apply-cursor beacons + promotion
+            # history re-exported as standby.* / supervisor.promotions
+            # gauges (the supervisor's own registry serves no /metrics)
+            from pathway_tpu.engine import standby as _standby_mod
+
+            registry.register_collector(
+                "standby.state",
+                lambda: _standby_mod.state_metrics(_as_root),
+            )
+            if worker_ctx is not None:
+                # promotion sentinel: a PROMOTE request on the root means
+                # a peer died and a standby is adopting its id — poison
+                # the mesh so this worker unwinds through its drain-commit
+                # and rejoins in-process (see run()), instead of waiting
+                # out heartbeats on a peer that returns as a new process
+                promote_watcher = _PromoteWatcher(
+                    _as_root, config.process_id, worker_ctx.mesh
+                ).start()
         # restart provenance, mesh-visible: the supervisor increments its
         # own supervisor.restarts counter, but that registry lives in the
         # spawn process, which serves no /metrics — each worker knows the
@@ -475,14 +548,31 @@ def run(
                         handoff=handoff_sentinel,
                     )
                 except BaseException as exc:
-                    # black-box the failure BEFORE unwinding: the ring's
-                    # last events are the crash story the supervisor (or
-                    # `pathway_tpu blackbox`) reads back post-mortem
-                    _blackbox.record(
-                        "run.failed", worker=config.process_id,
-                        error=repr(exc),
-                    )
-                    _blackbox.dump(f"run failed: {exc!r}")
+                    from pathway_tpu.engine.comm import MeshPoisoned
+
+                    if isinstance(exc, MeshPoisoned):
+                        # promotion rejoin, not a failure: run() acks and
+                        # re-enters after the finally's drain-commit.  No
+                        # crash dump — the blackbox ring stays for real
+                        # failures.  In-flight serving requests wait on
+                        # epochs this mesh will never run: answer them
+                        # with the typed retry signal now instead of
+                        # letting them time out across the rejoin.
+                        _blackbox.record(
+                            "promotion.rejoin", worker=config.process_id,
+                            reason=str(exc),
+                        )
+                        _serving.fail_inflight_for_promotion()
+                    else:
+                        # black-box the failure BEFORE unwinding: the
+                        # ring's last events are the crash story the
+                        # supervisor (or `pathway_tpu blackbox`) reads
+                        # back post-mortem
+                        _blackbox.record(
+                            "run.failed", worker=config.process_id,
+                            error=repr(exc),
+                        )
+                        _blackbox.dump(f"run failed: {exc!r}")
                     # failure hooks: exported tables must flip to failed so
                     # concurrent importers raise instead of waiting forever
                     # (the scopeguard of dataflow/export.rs:143-146)
@@ -539,6 +629,8 @@ def run(
         from pathway_tpu.engine import tracing as _tracing_cleanup
 
         _tracing_cleanup.set_exporter(None)
+        if promote_watcher is not None:
+            promote_watcher.stop()
         if worker_ctx is not None:
             worker_ctx.close()
         if result.telemetry is not None:
@@ -651,6 +743,70 @@ def _topology_handshake(persistence_config: Any, cfg: Any) -> None:
             f"topology handshake failed: worker id {cfg.process_id} is "
             f"outside the leased topology of {workers} worker(s) on {root}"
         )
+
+
+def _persistence_root(persistence_config: Any) -> str | None:
+    """This run's filesystem persistence root, or None — the same backend
+    unwrap ``_topology_handshake`` performs, shared by the standby branch
+    and the promotion-rejoin loop of :func:`run`."""
+    from pathway_tpu.internals.config import get_config
+
+    backend_cfg = getattr(persistence_config, "backend", None)
+    if backend_cfg is not None:
+        if getattr(backend_cfg, "kind", None) == "filesystem":
+            return getattr(backend_cfg, "path", None) or None
+        return None
+    if persistence_config is None:
+        return get_config().replay_storage or None
+    return None
+
+
+# promotion seqs this process already acked: the promote sentinel of the
+# NEXT mesh (post-rejoin) must not re-poison on the still-present PROMOTE
+# file while the supervisor collects the remaining acks
+_ACKED_PROMOTE_SEQS: set[int] = set()
+
+
+def _pending_promotion(persistence_config: Any) -> dict | None:
+    """The PROMOTE request this worker still owes a rejoin, or None."""
+    from pathway_tpu.engine import persistence as pz
+    from pathway_tpu.internals.config import get_config
+
+    root = _persistence_root(persistence_config)
+    if root is None or pz.writer_incarnation() <= 0:
+        return None
+    req = pz.read_promote_request(root)
+    if (
+        req is None
+        or req["incarnation"] != pz.writer_incarnation()
+        or req["worker"] == get_config().process_id
+        or req["seq"] in _ACKED_PROMOTE_SEQS
+    ):
+        return None
+    return req
+
+
+def _promotion_rejoin(persistence_config: Any) -> None:
+    """Between a poisoned ``_run_once`` and its re-entry: ack the PROMOTE
+    request (the drain-commit already ran in ``_run_once``'s finally, so
+    the ack certifies this worker's frontier is durable and its old mesh
+    is gone) and re-open the admission controller the unwind drained."""
+    from pathway_tpu.engine import persistence as pz
+    from pathway_tpu.engine import serving as _serving
+    from pathway_tpu.internals.config import get_config
+
+    req = _pending_promotion(persistence_config)
+    if req is not None:
+        root = _persistence_root(persistence_config)
+        pz.write_promote_ack(
+            root,
+            get_config().process_id,
+            seq=req["seq"],
+            worker=req["worker"],
+            incarnation=req["incarnation"],
+        )
+        _ACKED_PROMOTE_SEQS.add(req["seq"])
+    _serving.resume_after_promotion()
 
 
 def _make_storage(persistence_config: Any):
@@ -945,6 +1101,65 @@ class _HandoffSentinel:
             incarnation=self.incarnation, to_workers=to_workers,
             frontier=frontier,
         )
+
+
+class _PromoteWatcher:
+    """Background watch for the supervisor's PROMOTE request.
+
+    A promotion must interrupt survivors that are BLOCKED inside mesh
+    collectives (worker 0 gathering from the dead peer, everyone else
+    waiting on the epoch-go broadcast) — the epoch-boundary polling the
+    handoff sentinel uses can never fire there.  So this tiny daemon
+    thread polls ``lease/PROMOTE`` and, on a valid request for another
+    worker of THIS incarnation that this process has not already acked,
+    poisons the mesh: every blocked collective raises
+    :class:`~pathway_tpu.engine.comm.MeshPoisoned`, the run unwinds
+    through its consistent drain-commit, and ``run()`` rejoins
+    in-process.  One-shot per mesh lifetime."""
+
+    _POLL_S = 0.05
+
+    def __init__(self, root: str, worker: int, mesh: Any):
+        self.root = root
+        self.worker = worker
+        self.mesh = mesh
+        import threading as _threading
+
+        self._stop = _threading.Event()
+        self._thread = _threading.Thread(
+            target=self._watch, name=f"promote-watch-{worker}", daemon=True
+        )
+
+    def start(self) -> "_PromoteWatcher":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+    # pathway-lint: context=promote-watch
+    def _watch(self) -> None:
+        from pathway_tpu.engine import persistence as pz
+
+        incarnation = pz.writer_incarnation()
+        while not self._stop.wait(self._POLL_S):
+            try:
+                req = pz.read_promote_request(self.root)
+            except OSError:
+                continue
+            if (
+                req is None
+                or req["incarnation"] != incarnation
+                or req["worker"] == self.worker
+                or req["seq"] in _ACKED_PROMOTE_SEQS
+            ):
+                continue
+            self.mesh.poison(
+                f"promotion {req['seq']}: standby {req['standby']} is "
+                f"adopting worker {req['worker']}"
+            )
+            return
 
 
 def _handoff_exit(

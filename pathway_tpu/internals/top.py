@@ -360,6 +360,29 @@ def render_top(
             detail += f" · cooldown {cooldown:.1f} s remaining"
         lines.append(detail)
 
+    standby = status.get("standby") or {}
+    if standby.get("standby.pool") or standby.get("supervisor.promotions"):
+        # the warm-standby panel (engine/standby.py collector): pool
+        # size, per-standby apply lag, and how many worker deaths were
+        # absorbed by promotion instead of a group restart
+        lines.append("")
+        promotions = standby.get("supervisor.promotions") or 0.0
+        row = (
+            f"standby: pool {int(standby.get('standby.pool') or 0)} · "
+            f"{int(promotions)} promotion(s)"
+        )
+        last_worker = standby.get("supervisor.promotions.last.worker")
+        if promotions and last_worker is not None:
+            row += f" (last adopted worker {int(last_worker)})"
+        lines.append(row)
+        lags = _labeled(standby, "standby.lag.s")
+        chunks = _labeled(standby, "standby.verified.chunks")
+        for sid in sorted(lags):
+            detail = f"  standby {sid}: apply lag {lags[sid]:.2f} s"
+            if sid in chunks:
+                detail += f" · {int(chunks[sid])} chunk(s) verified"
+            lines.append(detail)
+
     serving = status.get("serving") or {}
     if serving:
         # the admission-controller panel (engine/serving.py): occupancy

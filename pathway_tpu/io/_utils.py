@@ -947,6 +947,65 @@ def worker_part_path(filename: str) -> str:
     return filename
 
 
+class WorkerPartFile:
+    """An output file handle bound to THIS WORKER's part shard, resolved
+    when the run starts (sink lowering) rather than when the sink is
+    registered at graph-build time.
+
+    Build-time resolution breaks under warm-standby promotion twice over:
+
+    * a standby process builds the sink graph under its STANDBY id, so an
+      eager ``open(worker_part_path(...))`` creates a ``.part-N`` shard
+      outside the worker topology — which worker 0's stale-shard sweep
+      then unlinks, leaving the promoted worker writing every row into an
+      unlinked inode;
+    * a surviving worker that rejoins in-process after a promotion
+      (``internals/runner.run``) replays its committed prefix into the
+      SAME still-open handle, appending duplicates of rows it already
+      wrote in its previous lifetime.
+
+    ``reopen()`` — wired to the sink's lowering via ``register_output``'s
+    ``on_start`` hook — fixes both: each run lifetime re-resolves the part
+    path under the worker id it holds NOW and truncates, so a replayed
+    prefix overwrites instead of duplicating, exactly like a whole-group
+    restart."""
+
+    def __init__(self, filename: str, *, newline: str | None = None,
+                 on_open: Callable[[Any], None] | None = None):
+        self._base = filename
+        self._newline = newline
+        self._on_open = on_open
+        self._f: Any = None
+
+    def reopen(self) -> None:
+        """Resolve the part path for the worker id this process holds now
+        and (re)open it truncated; called at sink lowering, once per run
+        lifetime."""
+        if self._f is not None:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+            self._f = None
+        import os as _os
+
+        path = worker_part_path(self._base)
+        dirname = _os.path.dirname(_os.path.abspath(path))
+        _os.makedirs(dirname, exist_ok=True)
+        self._f = open(path, "w", newline=self._newline)
+        if self._on_open is not None:
+            self._on_open(self._f)
+
+    def handle(self) -> Any:
+        if self._f is None:
+            self.reopen()
+        return self._f
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+
+
 def _sweep_stale_parts(filename: str, processes: int) -> None:
     """Best-effort unlink of ``<filename>.part-N`` shards with N outside
     the current worker topology (see :func:`worker_part_path`)."""
@@ -990,9 +1049,18 @@ def register_output(
     *,
     on_time_end: Callable[[int], None] | None = None,
     on_end: Callable[[], None] | None = None,
+    on_start: Callable[[], None] | None = None,
     name: str = "output",
 ) -> None:
     def attach(lowerer: Lowerer, node: df.Node):
+        if on_start is not None:
+            # run-lifetime hook: fires at sink lowering, so writers bind
+            # run-scoped resources (per-worker part files) under the
+            # worker identity this process holds NOW — not the one it had
+            # at graph build, which differs for promoted standbys, and
+            # fires again when a surviving worker rejoins in-process
+            # after a promotion (internals/runner.run)
+            on_start()
         return df.OutputNode(
             lowerer.scope, node, on_data=on_data, on_time_end=on_time_end, on_end=on_end
         )

@@ -241,20 +241,27 @@ def _convert(raw: str | None, dtype: dt.DType):
 
 class _CsvWriter:
     def __init__(self, filename: str, column_names: list[str]):
-        filename = _utils.worker_part_path(filename)
-        os.makedirs(os.path.dirname(os.path.abspath(filename)), exist_ok=True)
-        self._f = open(filename, "w", newline="")
-        self._w = _csv.writer(self._f)
-        self._w.writerow(column_names + ["time", "diff"])
+        # part path binds at RUN start, not build (see _JsonLinesWriter)
+        self._w: _csv.writer | None = None
+
+        def on_open(f):
+            self._w = _csv.writer(f)
+            self._w.writerow(column_names + ["time", "diff"])
+
+        self._file = _utils.WorkerPartFile(filename, newline="", on_open=on_open)
         self._lock = threading.Lock()
+
+    def start(self):
+        self._file.reopen()
 
     def write(self, key, row, time, diff):
         with self._lock:
+            f = self._file.handle()
             self._w.writerow([_fmt_cell(v) for v in row] + [time, diff])
-            self._f.flush()
+            f.flush()
 
     def close(self):
-        self._f.close()
+        self._file.close()
 
 
 def _fmt_cell(v):
@@ -269,6 +276,7 @@ def write(table: Table, filename: str, *, name: str | None = None, **kwargs: Any
     _utils.register_output(
         table,
         writer.write,
+        on_start=writer.start,
         on_end=writer.close,
         name=name or f"csv.write:{filename}",
     )

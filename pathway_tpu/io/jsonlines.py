@@ -155,23 +155,27 @@ def _jsonable(v):
 
 class _JsonLinesWriter:
     def __init__(self, filename: str, column_names: list[str]):
-        filename = _utils.worker_part_path(filename)
-        dirname = os.path.dirname(os.path.abspath(filename))
-        os.makedirs(dirname, exist_ok=True)
-        self._f = open(filename, "w")
+        # the part path binds at RUN start (register_output's on_start →
+        # start()), not here: at build time a warm standby still wears its
+        # standby id, and the shard must follow the promoted identity
+        self._file = _utils.WorkerPartFile(filename)
         self._names = column_names
         self._lock = threading.Lock()
+
+    def start(self):
+        self._file.reopen()
 
     def write(self, key, row, time, diff):
         obj = {n: _jsonable(v) for n, v in zip(self._names, row)}
         obj["time"] = time
         obj["diff"] = diff
         with self._lock:
-            self._f.write(_json.dumps(obj) + "\n")
-            self._f.flush()
+            f = self._file.handle()
+            f.write(_json.dumps(obj) + "\n")
+            f.flush()
 
     def close(self):
-        self._f.close()
+        self._file.close()
 
 
 def write(table: Table, filename: str, *, name: str | None = None, **kwargs: Any) -> None:
@@ -190,5 +194,6 @@ def write(table: Table, filename: str, *, name: str | None = None, **kwargs: Any
     """
     writer = _JsonLinesWriter(filename, table.column_names())
     _utils.register_output(
-        table, writer.write, on_end=writer.close, name=name or f"jsonlines.write:{filename}"
+        table, writer.write, on_start=writer.start, on_end=writer.close,
+        name=name or f"jsonlines.write:{filename}",
     )

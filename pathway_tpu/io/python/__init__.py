@@ -9,6 +9,7 @@ Bridged into the engine through the reader-thread/queue pattern — the role
 from __future__ import annotations
 
 import json as _json
+import threading
 from typing import Any
 
 from pathway_tpu.engine.types import Json
@@ -37,10 +38,27 @@ class ConnectorSubject:
     4
     """
 
-    _emit: Any = None
-
     def __init__(self, datasource_name: str | None = None):
         self._datasource_name = datasource_name
+
+    def _emit(self, item: Any) -> None:
+        # Resolved per reader-thread (bound by _SubjectReader.run). The
+        # same subject object can be re-run on a fresh reader thread while
+        # a superseded lifetime's run() is still mid-flight — a surviving
+        # worker rejoining in-process after a warm-standby promotion does
+        # exactly this — and a plain instance attribute would redirect the
+        # old thread's leftover rows into the new pipeline (double
+        # ingest).  Helper threads a subject spawns itself fall back to
+        # the most recent binding.
+        tl = self.__dict__.get("_emit_threads")
+        fn = getattr(tl, "fn", None) if tl is not None else None
+        if fn is None:
+            fn = self.__dict__.get("_emit_latest")
+        if fn is None:
+            raise RuntimeError(
+                "ConnectorSubject.next() called outside pw.io.python.read()"
+            )
+        fn(item)
 
     # --- user API ---
     def next(self, **kwargs) -> None:
@@ -89,7 +107,12 @@ class _SubjectReader(Reader):
         self.subject = subject
 
     def run(self, emit) -> None:
-        self.subject._emit = emit
+        # thread-scoped emit binding: see ConnectorSubject._emit
+        tl = self.subject.__dict__.setdefault(
+            "_emit_threads", threading.local()
+        )
+        tl.fn = emit
+        self.subject.__dict__["_emit_latest"] = emit
         try:
             self.subject.run()
         finally:

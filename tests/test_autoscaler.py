@@ -541,6 +541,126 @@ class TestSupervisorHandoff:
 
 
 # ---------------------------------------------------------------------------
+# Promotion × autoscaler: the two actuators share the worker set and must
+# never interleave — both race orders pinned
+# ---------------------------------------------------------------------------
+
+
+class TestPromotionAutoscalerRace:
+    def test_death_during_pending_handoff_wins_over_promotion(
+        self, tmp_path, monkeypatch
+    ):
+        """Order 1 — handoff first: a death while a handoff drains takes
+        the established fallback path, NEVER a promotion, even with a
+        live standby armed.  Mixing a shard adoption into a half-drained
+        topology change would double-assign shards."""
+        _autoscale_knobs(monkeypatch)
+        root = str(tmp_path)
+        promotions_before = _scalar("supervisor.promotions")
+        spawned: list[tuple[int, int, _LiveHandle]] = []
+
+        def spawn(wid, attempt, n_workers=1):
+            handle = _LiveHandle(0 if attempt >= 1 else None)
+            spawned.append((attempt, wid, handle))
+            return handle
+
+        def on_request(req):
+            # the primary dies mid-drain; the standby (wid 1 at attempt
+            # 0 — spawned before the workers) stays alive and tempting
+            for attempt, wid, handle in spawned:
+                if attempt == 0 and wid == 0:
+                    handle.exitcode = 1
+            return True
+
+        stop = threading.Event()
+        pump = threading.Thread(
+            target=_pump, args=(root, 1, stop, on_request), daemon=True
+        )
+        pump.start()
+        try:
+            sup = Supervisor(
+                spawn, 1, max_restarts=0, restart_jitter_s=0.0,
+                checkpoint_root=root, autoscale=True, standbys=1,
+            )
+            res = sup.run()
+        finally:
+            stop.set()
+            pump.join(timeout=5)
+
+        assert len(res.rescales) == 1, res.rescales
+        assert res.rescales[0]["kind"] == "autoscale-fallback"
+        assert sup.n_workers == 2
+        # the promotion tier never engaged: no PROMOTE request was ever
+        # posted, nothing adopted, the counter never moved
+        assert res.promotions == []
+        assert pz.read_promote_request(root) is None
+        assert _scalar("supervisor.promotions") == promotions_before
+        # the standby pool was refreshed for the resized incarnation
+        assert (0, 1) in {(a, w) for a, w, _h in spawned}  # attempt-0 pool
+        assert (1, 2) in {(a, w) for a, w, _h in spawned}  # attempt-1 pool
+
+    def test_promotion_in_flight_blocks_scale_decisions(
+        self, tmp_path, monkeypatch
+    ):
+        """Order 2 — promotion first: while a PROMOTE request is
+        outstanding the scale controller must not post a handoff, no
+        matter how hot the load beacons run; when the promotion aborts
+        (standby never adopts) recovery falls to the restart tier with
+        provenance, still without a rescale."""
+        _autoscale_knobs(monkeypatch)
+        monkeypatch.setenv("PATHWAY_STANDBY_PROMOTE_DEADLINE_S", "0.5")
+        root = str(tmp_path)
+        fallbacks_before = _scalar("supervisor.promotion.fallbacks")
+        seen = {"promote": False, "handoff_during_promotion": False}
+
+        def spawn(wid, attempt, n_workers=1):
+            if attempt == 0 and wid == 0:
+                return _LiveHandle(1)  # the primary is dead on arrival
+            return _LiveHandle(0 if attempt >= 1 else None)
+
+        def pump():
+            # hotter than any dwell: without the promotion gate the
+            # controller would decide grow within ~0.2s
+            while not stop.is_set():
+                asc.write_load_beacon(
+                    root, 0, staleness_s=5.0, backlog=10.0, epochs=3
+                )
+                if pz.read_promote_request(root) is not None:
+                    seen["promote"] = True
+                    if pz.read_handoff_request(root) is not None:
+                        seen["handoff_during_promotion"] = True
+                stop.wait(0.02)
+
+        stop = threading.Event()
+        thread = threading.Thread(target=pump, daemon=True)
+        thread.start()
+        try:
+            sup = Supervisor(
+                spawn, 1, max_restarts=1, restart_jitter_s=0.0,
+                checkpoint_root=root, autoscale=True, standbys=1,
+            )
+            res = sup.run()
+        finally:
+            stop.set()
+            thread.join(timeout=5)
+
+        # the promotion really was in flight, and no scale decision
+        # interleaved with it
+        assert seen["promote"], "PROMOTE request never observed"
+        assert not seen["handoff_during_promotion"]
+        assert res.rescales == []
+        # recovery fell to the restart tier (the standby never adopted)
+        assert res.promotions == []
+        assert res.history == [[1], [0]]
+        assert res.exit_codes == [0]
+        assert (
+            _scalar("supervisor.promotion.fallbacks") == fallbacks_before + 1
+        )
+        # abort cleared the coordination residue
+        assert pz.read_promote_request(root) is None
+
+
+# ---------------------------------------------------------------------------
 # Chaos acceptance: real supervised clusters under a seeded load_spike
 # ---------------------------------------------------------------------------
 

@@ -153,6 +153,44 @@ class TestFaultPlan:
         churn = plan.check("request_churn", source="pw-tiny")  # 2nd: fires
         assert churn is not None and churn.count == 6
 
+    def test_standby_kinds_in_catalog(self):
+        """The warm-standby chaos kinds are first-class plan citizens:
+        the starved standby tailer (``standby_lag`` — pure delay, no
+        error, ``worker`` matches the STANDBY id) and the mid-promotion
+        SIGKILL window (``promote_crash`` — after the fence bump and the
+        adopted ack, before the first publish as the new worker)."""
+        plan = faults.FaultPlan(
+            [
+                {"kind": "standby_lag", "worker": 1, "delay_ms": 400},
+                {"kind": "promote_crash", "worker": 0},
+            ]
+        )
+        assert plan.has("standby_lag") and plan.has("promote_crash")
+        # both key on the STANDBY ordinal, not the adopted worker id
+        assert plan.check("standby_lag", worker=0) is None
+        lag = plan.check("standby_lag", worker=1)
+        assert lag is not None and lag.delay_ms == 400
+        assert plan.check("promote_crash", worker=1) is None
+        assert plan.check("promote_crash", worker=0) is not None
+
+    def test_standby_lag_helper_sleeps_without_error(self, monkeypatch):
+        """``maybe_standby_lag`` is a delay, never an exception: the
+        starved standby keeps tailing, it just publishes real lag."""
+        plan = json.dumps(
+            {"faults": [{"kind": "standby_lag", "worker": 2,
+                         "delay_ms": 30}]}
+        )
+        monkeypatch.setenv("PATHWAY_FAULT_PLAN", plan)
+        faults.clear_plan()
+        try:
+            t0 = time.monotonic()
+            faults.maybe_standby_lag(standby=1)  # wrong standby: no-op
+            assert time.monotonic() - t0 < 0.025
+            faults.maybe_standby_lag(standby=2)  # fires: sleeps 30 ms
+            assert time.monotonic() - t0 >= 0.03
+        finally:
+            faults.clear_plan()
+
     def test_trace_storm_kind_in_catalog(self):
         """The observability chaos kind is a first-class plan citizen: a
         burst of synthetic traced requests with deep span trees, keyed

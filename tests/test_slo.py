@@ -262,6 +262,75 @@ def test_missing_family_burns_nothing():
 
 
 # ---------------------------------------------------------------------------
+# Staleness clamp: idleness is not burn (only while the serving path is live)
+# ---------------------------------------------------------------------------
+
+
+def _live_controller(monkeypatch, clock):
+    """Install a process-global admission controller with an injected
+    clock, as the REST ingress would on first request."""
+    from pathway_tpu.engine import serving
+
+    c = serving.AdmissionController(
+        inflight_limit=4,
+        inflight_bytes=1 << 20,
+        queue_limit=4,
+        target_delay_ms=100.0,
+        shed_dwell_s=1.0,
+        recover_s=1.0,
+        drain_s=1.0,
+        clock=clock,
+    )
+    monkeypatch.setattr(serving, "_controller", c)
+    return c
+
+
+def test_idle_serving_pipeline_burns_no_staleness_budget(monkeypatch):
+    """Regression: a serving pipeline between requests has a frozen
+    watermark, so ``output.staleness.s`` grows without bound — but with
+    ZERO admitted requests outstanding, no caller observes that
+    staleness, and the default staleness SLO must not burn budget."""
+    _live_controller(monkeypatch, clock=lambda: 1000.0)
+    reg = MetricsRegistry(enabled=True)
+    reg.gauge("output.staleness.s", "staleness", output="sink").set(120.0)
+    ev = SLOEvaluator(parse_slos(slo.default_declarations()), registry=reg)
+    ev.evaluate(now=0.0)
+    out = ev.evaluate(now=30.0)
+    assert out["slo.burn.rate{slo=staleness,window=5m}"] == 0.0
+    assert out["slo.budget.remaining{slo=staleness}"] == 1.0
+
+
+def test_outstanding_request_age_still_burns_staleness(monkeypatch):
+    """Counter-direction: the clamp filters idle time, not genuine
+    staleness seen by a waiting caller — a request outstanding longer
+    than the threshold keeps real burn counting."""
+    c = _live_controller(monkeypatch, clock=lambda: 1000.0)
+    with c._lock:  # an admitted request, unanswered for 30 s
+        c._outstanding[1] = 970.0
+    reg = MetricsRegistry(enabled=True)
+    reg.gauge("output.staleness.s", "staleness", output="sink").set(120.0)
+    ev = SLOEvaluator(parse_slos(slo.default_declarations()), registry=reg)
+    ev.evaluate(now=0.0)
+    out = ev.evaluate(now=30.0)
+    assert out["slo.burn.rate{slo=staleness,window=5m}"] > 1.0
+
+
+def test_no_controller_leaves_staleness_unclamped():
+    """Without an admission controller (batch / non-serving pipelines)
+    staleness keeps its plain watermark meaning — the clamp never
+    silences a genuinely stale non-serving pipeline."""
+    from pathway_tpu.engine import serving
+
+    assert serving.controller_if_active() is None
+    reg = MetricsRegistry(enabled=True)
+    reg.gauge("output.staleness.s", "staleness", output="sink").set(120.0)
+    ev = SLOEvaluator(parse_slos(slo.default_declarations()), registry=reg)
+    ev.evaluate(now=0.0)
+    out = ev.evaluate(now=30.0)
+    assert out["slo.burn.rate{slo=staleness,window=5m}"] > 1.0
+
+
+# ---------------------------------------------------------------------------
 # Collector integration + snapshot shape
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,349 @@
+"""Warm-standby promotion chaos acceptance: SIGKILL a cluster worker
+mid-async-commit with a standby pool armed; the supervisor PROMOTES the
+standby into the dead worker's shard instead of restarting the group —
+the survivors rejoin in-process (never respawned), only the dead
+shard's uncommitted tail is replayed, and the net output is
+byte-identical to an unfaulted run's.
+
+Two-tier recovery is pinned from both sides:
+
+* **tier one** — a clean promotion: ``SupervisorResult.restarts`` stays
+  0, ``SupervisorResult.promotions`` records the adoption, and the
+  spawn log proves no surviving worker process was ever re-created;
+* **tier two** — a ``promote_crash`` fault SIGKILLs the chosen standby
+  inside the narrowest promotion window (adopted ack durable, fence
+  bumped, nothing published as the new worker id): recovery converges
+  on the established whole-group restart and delivers the same bytes
+  anyway.
+
+Harness model: ``tests/test_supervised_recovery.py`` (fork-context
+worker processes running a streaming groupby under filesystem
+persistence), with two twists:
+
+* ``_worker_main`` must PRESERVE the inherited ``PATHWAY_STANDBY_ID``
+  — the supervisor exports it around the spawn, and it alone routes a
+  process into ``standby_main`` instead of the mesh;
+* the primary's death is an EXTERNAL ``SIGKILL`` from the test (fired
+  once at least two of its generations are committed), not a
+  plan-driven ``crash`` spec: an ``at_epoch`` spec would re-fire inside
+  the promoted standby, whose per-scope epoch counter restarts at 0 and
+  whose ``PATHWAY_RESTART_ATTEMPT`` legitimately stays 0 (a promotion
+  is not a restart).
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import signal
+import socket
+import threading
+import time
+from collections import Counter
+from pathlib import Path
+
+import pytest
+
+from pathway_tpu.engine import persistence as pz
+from pathway_tpu.engine.supervisor import Supervisor
+
+pytestmark = pytest.mark.chaos
+
+N_WORKERS = 2
+N_ROWS = 45
+ROW_DELAY_S = 0.03
+
+
+def _free_port_base(n: int = N_WORKERS) -> int:
+    socks = []
+    try:
+        base = None
+        for _ in range(20):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            socks.append(s)
+        ports = sorted(s.getsockname()[1] for s in socks)
+        for i in range(len(ports) - n):
+            if ports[i + n - 1] - ports[i] == n - 1:
+                base = ports[i]
+                break
+        return base or ports[0]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _scenario(tmpdir: str) -> None:
+    """Streaming source (per-row commits → many epochs), shard-exchanged
+    groupby, per-worker jsonlines sinks, frequent snapshots."""
+    import pathway_tpu as pw
+
+    class Src(pw.io.python.ConnectorSubject):
+        def run(self):
+            import time as _t
+
+            for i in range(N_ROWS):
+                self.next(k=i % 3, v=1)
+                self.commit()
+                _t.sleep(ROW_DELAY_S)
+
+    t = pw.io.python.read(
+        Src(), schema=pw.schema_from_types(k=int, v=int), name="src"
+    )
+    counts = t.groupby(t.k).reduce(k=t.k, n=pw.reducers.count())
+    pw.io.jsonlines.write(counts, os.path.join(tmpdir, "counts.jsonl"))
+    pw.run(
+        persistence_config=pw.persistence.Config(
+            pw.persistence.Backend.filesystem(os.path.join(tmpdir, "pstore")),
+            snapshot_interval_ms=50,
+        )
+    )
+
+
+def _worker_main(wid, attempt, n, port, tmpdir, plan_json):
+    # NOTE: PATHWAY_STANDBY_ID is deliberately NOT touched here — the
+    # supervisor exports it around a standby spawn and the fork child
+    # inherits it; that env var alone routes this process into
+    # standby_main instead of the mesh (internals/runner.py)
+    os.environ["PATHWAY_PROCESSES"] = str(n)
+    os.environ["PATHWAY_PROCESS_ID"] = str(wid)
+    os.environ["PATHWAY_FIRST_PORT"] = str(port)
+    os.environ["PATHWAY_THREADS"] = "1"
+    os.environ["PATHWAY_COMM_SECRET"] = "chaos-test"
+    os.environ["PATHWAY_RESTART_ATTEMPT"] = str(attempt)
+    os.environ["PATHWAY_COMM_HEARTBEAT_S"] = "0.5"
+    os.environ["PATHWAY_COMM_RECONNECT_WINDOW_S"] = "5"
+    if plan_json:
+        os.environ["PATHWAY_FAULT_PLAN"] = plan_json
+    else:
+        os.environ.pop("PATHWAY_FAULT_PLAN", None)
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass  # backend already initialized by the forked parent (CPU)
+
+    from pathway_tpu.engine import faults
+    from pathway_tpu.internals.config import refresh_config
+    from pathway_tpu.internals.parse_graph import G
+
+    refresh_config()
+    faults.clear_plan()  # re-read THIS process's env, not the parent's cache
+    G.clear()
+    _scenario(tmpdir)
+
+
+def _run_supervised(
+    tmpdir,
+    plan_json,
+    *,
+    max_restarts=3,
+    standbys=0,
+    procs=None,
+    spawn_log=None,
+):
+    ctx = multiprocessing.get_context("fork")
+    port = _free_port_base(N_WORKERS)
+
+    def spawn(wid: int, attempt: int, n_workers: int = N_WORKERS):
+        if spawn_log is not None:
+            spawn_log.append((attempt, wid))
+        p = ctx.Process(
+            target=_worker_main,
+            args=(wid, attempt, n_workers, port, str(tmpdir), plan_json),
+            daemon=True,
+        )
+        p.start()
+        if procs is not None:
+            procs[(attempt, wid)] = p
+        return p
+
+    return Supervisor(
+        spawn,
+        N_WORKERS,
+        max_restarts=max_restarts,
+        restart_jitter_s=0.05,
+        checkpoint_root=os.path.join(str(tmpdir), "pstore"),
+        standbys=standbys,
+    ).run()
+
+
+def _kill_worker_after_commits(tmpdir, procs, *, wid=1, min_gens=2):
+    """SIGKILL the attempt-0 ``wid`` worker once at least ``min_gens``
+    generation manifests are committed (worker 0 owns manifest
+    publishing) — the death then lands past real commits, so the
+    promotion genuinely resumes the shard and replays only the
+    uncommitted tail."""
+    mdir = Path(tmpdir) / "pstore" / "manifests" / "0"
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            gens = [f for f in os.listdir(mdir) if not f.endswith(".tmp")]
+        except OSError:
+            gens = []
+        if len(gens) >= min_gens:
+            break
+        time.sleep(0.02)
+    while time.monotonic() < deadline:
+        p = procs.get((0, wid))
+        if p is not None and p.pid:
+            try:
+                os.kill(p.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            return
+        time.sleep(0.02)
+
+
+def canonical_bytes(tmpdir, name="counts.jsonl", workers=N_WORKERS) -> bytes:
+    """Canonical serialized net output across all worker sink shards."""
+    state: Counter = Counter()
+    base = Path(tmpdir) / name
+    paths = [base] + [
+        Path(f"{base}.part-{w}") for w in range(1, workers + 1)
+    ]
+    for path in paths:
+        if not path.exists():
+            continue
+        for line in path.read_text().splitlines():
+            obj = json.loads(line)
+            diff = obj.pop("diff")
+            obj.pop("time")
+            state[json.dumps(obj, sort_keys=True)] += diff
+    assert all(c >= 0 for c in state.values()), state
+    net = sorted((k, c) for k, c in state.items() if c)
+    return json.dumps(net).encode()
+
+
+def test_sigkill_worker_promotes_standby_without_group_restart(tmp_path):
+    """Acceptance (tier one): SIGKILL worker 1 mid-run with one warm
+    standby armed.  The supervisor promotes the standby instead of
+    restarting the group: zero restarts, the survivors' processes are
+    never re-created, the promotion carries provenance, the output is
+    byte-identical to an unfaulted run's, and the offline audit sees a
+    clean root that remembers the adoption."""
+    clean_dir = tmp_path / "clean"
+    clean_dir.mkdir()
+    res_clean = _run_supervised(clean_dir, plan_json=None)
+    assert res_clean.restarts == 0, res_clean.history
+    expected = canonical_bytes(clean_dir)
+    assert expected != b"[]"
+
+    faulted_dir = tmp_path / "faulted"
+    faulted_dir.mkdir()
+    procs: dict[tuple[int, int], object] = {}
+    spawn_log: list[tuple[int, int]] = []
+    killer = threading.Thread(
+        target=_kill_worker_after_commits, args=(faulted_dir, procs),
+        daemon=True,
+    )
+    killer.start()
+    try:
+        res = _run_supervised(
+            faulted_dir, plan_json=None, standbys=1,
+            procs=procs, spawn_log=spawn_log,
+        )
+    finally:
+        killer.join(timeout=35)
+
+    # tier one engaged: the death was absorbed WITHOUT a group restart
+    assert res.restarts == 0, res.history
+    assert len(res.promotions) == 1, res.promotions
+    promo = res.promotions[0]
+    assert promo["worker"] == 1 and promo["standby"] == 0, promo
+    assert promo["attempt"] == 0
+    assert "worker 1 exited" in promo["reason"], promo
+    assert promo["duration_s"] >= 0.0
+    assert res.exit_codes == [0] * N_WORKERS, res.history
+
+    # the spawn log proves the two-tier contract: every WORKER process
+    # was created exactly once (the dead slot was adopted, not
+    # respawned), all on attempt 0; only the standby slot (wid >= N) may
+    # appear twice — the initial pool plus the post-promotion refill
+    counts = Counter(spawn_log)
+    assert counts[(0, 0)] == 1 and counts[(0, 1)] == 1, spawn_log
+    assert all(attempt == 0 for attempt, _wid in spawn_log), spawn_log
+    assert counts[(0, N_WORKERS)] >= 1, spawn_log  # the standby slot
+
+    assert canonical_bytes(faulted_dir) == expected
+    net = dict(json.loads(expected.decode()))
+    got = {json.loads(k)["k"]: json.loads(k)["n"] for k in net}
+    assert got == {0: 15, 1: 15, 2: 15}, got
+
+    # promotion left a healthy root, and the audit remembers it: the
+    # adoption history, the bumped per-worker fence, no pending PROMOTE
+    report = pz.scrub_root(pz.FileBackend(str(faulted_dir / "pstore")))
+    assert report["ok"] is True, report
+    lease = report["lease"]
+    assert [p["worker"] for p in lease.get("promotions", [])] == [1], lease
+    assert lease.get("fences", {}).get("1") == promo["fence"], lease
+    assert not lease.get("promote", {}).get("pending_request"), lease
+
+
+def test_promote_crash_falls_back_to_group_restart_byte_identical(
+    tmp_path, monkeypatch
+):
+    """Acceptance (tier two): the ``promote_crash`` fault SIGKILLs the
+    chosen standby inside the narrowest promotion window — adopted ack
+    durable, fence bumped, nothing yet published under the new worker
+    id.  Whichever way the supervisor observes it (death first → abort,
+    adopted-marker first → a dead handle in the worker slot), recovery
+    converges on the restart tier and the output is byte-identical."""
+    # one promotion attempt only: without the budget clamp the
+    # adopted-marker-first race would retry the promotion with the
+    # refilled standby (a fresh process re-arms the fault) up to the
+    # default budget before falling back
+    monkeypatch.setenv("PATHWAY_STANDBY_PROMOTIONS", "1")
+
+    clean_dir = tmp_path / "clean"
+    clean_dir.mkdir()
+    res_clean = _run_supervised(clean_dir, plan_json=None)
+    assert res_clean.restarts == 0, res_clean.history
+    expected = canonical_bytes(clean_dir)
+    assert expected != b"[]"
+
+    faulted_dir = tmp_path / "faulted"
+    faulted_dir.mkdir()
+    # keyed on the STANDBY ordinal: kill standby 0 in the promotion
+    # window, first launch only (the post-restart pool re-reads the plan
+    # with PATHWAY_RESTART_ATTEMPT=1 and must not re-fire)
+    plan = json.dumps(
+        {
+            "seed": 17,
+            "faults": [
+                {"kind": "promote_crash", "worker": 0, "attempt": 0},
+            ],
+        }
+    )
+    procs: dict[tuple[int, int], object] = {}
+    killer = threading.Thread(
+        target=_kill_worker_after_commits, args=(faulted_dir, procs),
+        daemon=True,
+    )
+    killer.start()
+    try:
+        res = _run_supervised(
+            faulted_dir, plan_json=plan, standbys=1, procs=procs
+        )
+    finally:
+        killer.join(timeout=35)
+
+    # tier two: the promotion never completed into a live worker — the
+    # group restart absorbed both the dead worker and the dead standby
+    assert res.restarts >= 1, res.history
+    assert res.exit_codes == [0] * N_WORKERS, res.history
+
+    assert canonical_bytes(faulted_dir) == expected
+    net = dict(json.loads(expected.decode()))
+    got = {json.loads(k)["k"]: json.loads(k)["n"] for k in net}
+    assert got == {0: 15, 1: 15, 2: 15}, got
+
+    # the root is sound; no PROMOTE residue survived the fallback
+    report = pz.scrub_root(pz.FileBackend(str(faulted_dir / "pstore")))
+    assert report["ok"] is True, report
+    assert not report["lease"].get("promote", {}).get(
+        "pending_request"
+    ), report["lease"]
